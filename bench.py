@@ -1,0 +1,68 @@
+"""Benchmark: ResNet-50 ImageNet training throughput (images/sec/chip).
+
+Mirrors the reference headline (models/utils/LocalOptimizerPerf.scala /
+DistriOptimizerPerf.scala: ResNet-50 synthetic-data sync-SGD step time).
+Baseline: published BigDL ResNet-50 throughput on a dual-socket Xeon node
+is ~57 img/s (BigDL whitepaper-era numbers, fp32 MKL); vs_baseline is
+ours / 57.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+BASELINE_IMG_PER_SEC = 57.0  # reference Xeon-node ResNet-50 throughput
+BATCH = 32
+WARMUP = 3
+ITERS = 10
+
+
+def main():
+    from bigdl_tpu import nn
+    from bigdl_tpu.models import resnet
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import make_train_step
+
+    model = resnet.build(class_num=1000, depth=50, dataset="imagenet")
+    criterion = nn.ClassNLLCriterion()
+    method = SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
+
+    params, state = model.init_params(0)
+    opt_state = method.init_state(params)
+    step = jax.jit(
+        make_train_step(model, criterion, method, mixed_precision=True),
+        donate_argnums=(0, 1, 2))
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(BATCH, 3, 224, 224).astype(np.float32))
+    y = jnp.asarray(rng.randint(1, 1001, BATCH).astype(np.float32))
+    key = jax.random.PRNGKey(0)
+
+    for _ in range(WARMUP):
+        params, opt_state, state, loss = step(params, opt_state, state, x, y,
+                                              key)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        params, opt_state, state, loss = step(params, opt_state, state, x, y,
+                                              key)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_per_sec = BATCH * ITERS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+"""bigdl_tpu — a TPU-native deep learning framework with the capabilities of
+BigDL (distributed training, Torch-style layer library, model zoo, data
+pipelines), re-designed for JAX/XLA on TPU.
+
+Compute path: jax/jit/lax (MXU matmuls & convs, bf16), autodiff instead of
+hand-written backward, lax.scan recurrence, shard_map+psum data parallelism
+over a jax.sharding.Mesh instead of Spark parameter-server all-reduce.
+"""
+
+__version__ = "0.1.0"
+
+from . import nn
+from . import optim
+from .utils.table import Table, T

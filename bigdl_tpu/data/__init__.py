@@ -1,0 +1,6 @@
+"""bigdl_tpu.data — dataset & transformer pipeline (≙ com.intel.analytics.bigdl.dataset)."""
+from .minibatch import Sample, MiniBatch, PaddingParam, samples_to_minibatch
+from .dataset import (DataSet, LocalArrayDataSet, ArrayMiniBatchDataSet,
+                      DistributedDataSet, TransformedDataSet, Transformer,
+                      ChainedTransformer, SampleToMiniBatch,
+                      FunctionTransformer)

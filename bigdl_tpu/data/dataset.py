@@ -1,0 +1,227 @@
+"""DataSet abstractions (≙ dataset/DataSet.scala, Transformer.scala).
+
+A DataSet yields batches (MiniBatch or (x, y) arrays).  Transformers compose
+with ``->`` like the reference (`dataset -> transformer`).  LocalDataSet
+shuffles/iterates host-side numpy; DistributedDataSet shards per mesh
+data-parallel group (the Spark-RDD partitioning analogue: each dp shard of
+the global batch is produced on its host and laid out on its mesh slice).
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Iterable, Iterator, List, Optional
+
+import numpy as np
+
+from .minibatch import MiniBatch, Sample, samples_to_minibatch, PaddingParam
+
+
+class Transformer:
+    """Composable iterator transform (≙ dataset/Transformer.scala)."""
+
+    def apply_iter(self, it: Iterator) -> Iterator:
+        raise NotImplementedError
+
+    def __call__(self, it: Iterable) -> Iterator:
+        return self.apply_iter(iter(it))
+
+    def __gt__(self, other):
+        raise TypeError("use `a -> b` spelled as a.then(b) or a >> b")
+
+    def __rshift__(self, other: "Transformer") -> "Transformer":
+        return ChainedTransformer(self, other)
+
+    def then(self, other: "Transformer") -> "Transformer":
+        return ChainedTransformer(self, other)
+
+
+class ChainedTransformer(Transformer):
+    def __init__(self, first, second):
+        self.first = first
+        self.second = second
+
+    def apply_iter(self, it):
+        return self.second.apply_iter(self.first.apply_iter(it))
+
+
+class SampleToMiniBatch(Transformer):
+    """≙ dataset/SampleToMiniBatch.scala; drops no samples — last partial
+    batch is emitted unless drop_last."""
+
+    def __init__(self, batch_size, feature_padding: Optional[PaddingParam] = None,
+                 label_padding: Optional[PaddingParam] = None, drop_last=False):
+        self.batch_size = batch_size
+        self.feature_padding = feature_padding
+        self.label_padding = label_padding
+        self.drop_last = drop_last
+
+    def apply_iter(self, it):
+        buf: List[Sample] = []
+        for s in it:
+            buf.append(s)
+            if len(buf) == self.batch_size:
+                yield samples_to_minibatch(buf, self.feature_padding,
+                                           self.label_padding)
+                buf = []
+        if buf and not self.drop_last:
+            yield samples_to_minibatch(buf, self.feature_padding,
+                                       self.label_padding)
+
+
+class FunctionTransformer(Transformer):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def apply_iter(self, it):
+        for x in it:
+            yield self.fn(x)
+
+
+class DataSet:
+    """Base dataset (≙ dataset/DataSet.scala AbstractDataSet)."""
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def shuffle(self):
+        return self
+
+    def data(self, train: bool) -> Iterator:
+        raise NotImplementedError
+
+    def transform(self, transformer: Transformer) -> "TransformedDataSet":
+        return TransformedDataSet(self, transformer)
+
+    # reference spelling: dataset -> transformer
+    def __rshift__(self, transformer):
+        return self.transform(transformer)
+
+    @staticmethod
+    def array(samples, batch_size=None, shuffle=True):
+        ds = LocalArrayDataSet(samples, shuffle=shuffle)
+        if batch_size is not None:
+            return ds.transform(SampleToMiniBatch(batch_size))
+        return ds
+
+    @staticmethod
+    def minibatch_arrays(x, y, batch_size, shuffle=True, drop_last=True,
+                         seed=0):
+        return ArrayMiniBatchDataSet(x, y, batch_size, shuffle=shuffle,
+                                     drop_last=drop_last, seed=seed)
+
+
+class LocalArrayDataSet(DataSet):
+    """In-memory list of Samples (≙ LocalArrayDataSet in DataSet.scala)."""
+
+    def __init__(self, samples, shuffle=True, seed=0):
+        self.samples = list(samples)
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+
+    def size(self):
+        return len(self.samples)
+
+    def shuffle(self):
+        self._rng.shuffle(self.samples)
+        return self
+
+    def data(self, train=True):
+        idx = np.arange(len(self.samples))
+        if train and self._shuffle:
+            self._rng.shuffle(idx)
+        for i in idx:
+            yield self.samples[i]
+
+
+class ArrayMiniBatchDataSet(DataSet):
+    """Dense (x, y) arrays batched without per-sample python overhead —
+    the fast path feeding the TPU."""
+
+    def __init__(self, x, y, batch_size, shuffle=True, drop_last=True, seed=0):
+        self.x = np.asarray(x)
+        self.y = None if y is None else np.asarray(y)
+        self.batch_size = batch_size
+        self._shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.RandomState(seed)
+
+    def size(self):
+        return self.x.shape[0]
+
+    def batches_per_epoch(self):
+        n = self.x.shape[0] // self.batch_size
+        if not self.drop_last and self.x.shape[0] % self.batch_size:
+            n += 1
+        return n
+
+    def data(self, train=True):
+        n = self.x.shape[0]
+        idx = np.arange(n)
+        if train and self._shuffle:
+            self._rng.shuffle(idx)
+        end = n - (n % self.batch_size) if self.drop_last else n
+        for start in range(0, end, self.batch_size):
+            sel = idx[start:start + self.batch_size]
+            xb = self.x[sel]
+            yb = None if self.y is None else self.y[sel]
+            yield MiniBatch(xb, yb)
+
+
+class TransformedDataSet(DataSet):
+    def __init__(self, base: DataSet, transformer: Transformer):
+        self.base = base
+        self.transformer = transformer
+
+    def size(self):
+        return self.base.size()
+
+    def shuffle(self):
+        self.base.shuffle()
+        return self
+
+    def data(self, train=True):
+        return self.transformer.apply_iter(self.base.data(train))
+
+    def batches_per_epoch(self):
+        if hasattr(self.transformer, "batch_size"):
+            return math.ceil(self.base.size() / self.transformer.batch_size)
+        if hasattr(self.base, "batches_per_epoch"):
+            return self.base.batches_per_epoch()
+        return None
+
+
+class DistributedDataSet(DataSet):
+    """Mesh-sharded dataset (≙ DistributedDataSet over Spark RDDs).
+
+    Wraps a global dataset; `data()` yields global batches whose leading dim
+    is divisible by the dp shard count.  Device placement onto the mesh is
+    done by DistriOptimizer via jax.device_put with the batch sharding; in a
+    multi-host pod each host feeds only its addressable shard
+    (process_index-strided slice), mirroring one Spark partition per
+    executor.
+    """
+
+    def __init__(self, base: DataSet, num_shards: int, shard_index: int = 0):
+        self.base = base
+        self.num_shards = num_shards
+        self.shard_index = shard_index
+
+    def size(self):
+        return self.base.size()
+
+    def shuffle(self):
+        self.base.shuffle()
+        return self
+
+    def batches_per_epoch(self):
+        return getattr(self.base, "batches_per_epoch", lambda: None)()
+
+    def data(self, train=True):
+        for mb in self.base.data(train):
+            if mb.size() % self.num_shards:
+                # truncate so every shard receives an equal, static shape
+                keep = mb.size() - (mb.size() % self.num_shards)
+                if keep == 0:
+                    continue
+                mb = mb.slice(1, keep)
+            yield mb

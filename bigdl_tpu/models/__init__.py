@@ -1,0 +1,2 @@
+"""bigdl_tpu.models — model zoo (≙ com.intel.analytics.bigdl.models)."""
+from . import lenet, resnet

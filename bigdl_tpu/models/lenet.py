@@ -1,0 +1,46 @@
+"""LeNet-5 (≙ models/lenet/LeNet5.scala).
+
+Same topology as the reference: conv(1→6,5x5) → tanh → maxpool → conv(6→12)
+→ tanh → maxpool → fc(100) → tanh → fc(classNum) → logsoftmax, and the
+graph-API variant.  Input is (B, 1, 28, 28) NCHW.
+"""
+from __future__ import annotations
+
+from ..nn import (Sequential, Reshape, SpatialConvolution, Tanh,
+                  SpatialMaxPooling, Linear, LogSoftMax, Graph, Input)
+
+
+def build(class_num: int = 10):
+    model = Sequential(name="LeNet5")
+    (model
+     .add(Reshape((1, 28, 28)))
+     .add(SpatialConvolution(1, 6, 5, 5, name="conv1_5x5"))
+     .add(Tanh())
+     .add(SpatialMaxPooling(2, 2, 2, 2))
+     .add(SpatialConvolution(6, 12, 5, 5, name="conv2_5x5"))
+     .add(Tanh())
+     .add(SpatialMaxPooling(2, 2, 2, 2))
+     .add(Reshape((12 * 4 * 4,)))
+     .add(Linear(12 * 4 * 4, 100, name="fc1"))
+     .add(Tanh())
+     .add(Linear(100, class_num, name="fc2"))
+     .add(LogSoftMax()))
+    return model
+
+
+def build_graph(class_num: int = 10):
+    """Graph-API variant (≙ LeNet5.scala graph())."""
+    inp = Input()
+    x = Reshape((1, 28, 28)).inputs(inp)
+    x = SpatialConvolution(1, 6, 5, 5, name="g_conv1_5x5").inputs(x)
+    x = Tanh().inputs(x)
+    x = SpatialMaxPooling(2, 2, 2, 2).inputs(x)
+    x = SpatialConvolution(6, 12, 5, 5, name="g_conv2_5x5").inputs(x)
+    x = Tanh().inputs(x)
+    x = SpatialMaxPooling(2, 2, 2, 2).inputs(x)
+    x = Reshape((12 * 4 * 4,)).inputs(x)
+    x = Linear(12 * 4 * 4, 100, name="g_fc1").inputs(x)
+    x = Tanh().inputs(x)
+    x = Linear(100, class_num, name="g_fc2").inputs(x)
+    out = LogSoftMax().inputs(x)
+    return Graph(inp, out, name="LeNet5Graph")

@@ -1,0 +1,143 @@
+"""ResNet (≙ models/resnet/ResNet.scala).
+
+Same topology rules as the reference: ImageNet variants {18, 34, 50, 101,
+152, 200} with basic/bottleneck blocks and shortcut types A/B/C
+(ResNet.scala:149-260); CIFAR-10 variant with depth 6n+2 basic blocks
+starting at 16 channels (ResNet.scala:265).
+
+TPU notes: NCHW convs lower to MXU via lax.conv_general_dilated; training
+runs bf16 with fp32 master weights via Optimizer.set_mixed_precision; BN in
+fp32.  No hand-written im2col/MKL — XLA handles tiling & fusion.
+"""
+from __future__ import annotations
+
+from ..nn import (Sequential, SpatialConvolution, SpatialBatchNormalization,
+                  ReLU, SpatialMaxPooling, SpatialAveragePooling, Linear,
+                  LogSoftMax, View, ConcatTable, CAddTable, Identity,
+                  MulConstant)
+
+
+class ShortcutType:
+    A = "A"  # zero-padded identity when channels grow (no params)
+    B = "B"  # 1x1 conv projection only when shapes differ (default)
+    C = "C"  # projection on every shortcut
+
+
+class _Builder:
+    def __init__(self, shortcut_type=ShortcutType.B):
+        self.i_channels = 0
+        self.shortcut_type = shortcut_type
+
+    def shortcut(self, n_input, n_output, stride):
+        use_conv = (self.shortcut_type == ShortcutType.C
+                    or (self.shortcut_type == ShortcutType.B
+                        and n_input != n_output))
+        if use_conv:
+            return Sequential(
+                SpatialConvolution(n_input, n_output, 1, 1, stride, stride,
+                                   with_bias=False),
+                SpatialBatchNormalization(n_output))
+        if n_input != n_output:
+            # type A: strided identity + zero pad channels
+            from ..nn import Padding
+            return Sequential(
+                SpatialAveragePooling(1, 1, stride, stride),
+                Padding(1, n_output - n_input, 3))
+        if stride != 1:
+            return SpatialAveragePooling(1, 1, stride, stride)
+        return Identity()
+
+    def basic_block(self, n, stride):
+        n_input = self.i_channels
+        self.i_channels = n
+        main = Sequential(
+            SpatialConvolution(n_input, n, 3, 3, stride, stride, 1, 1,
+                               with_bias=False),
+            SpatialBatchNormalization(n),
+            ReLU(),
+            SpatialConvolution(n, n, 3, 3, 1, 1, 1, 1, with_bias=False),
+            SpatialBatchNormalization(n))
+        return Sequential(
+            ConcatTable(main, self.shortcut(n_input, n, stride)),
+            CAddTable(),
+            ReLU())
+
+    def bottleneck(self, n, stride):
+        n_input = self.i_channels
+        self.i_channels = n * 4
+        main = Sequential(
+            SpatialConvolution(n_input, n, 1, 1, 1, 1, with_bias=False),
+            SpatialBatchNormalization(n),
+            ReLU(),
+            SpatialConvolution(n, n, 3, 3, stride, stride, 1, 1,
+                               with_bias=False),
+            SpatialBatchNormalization(n),
+            ReLU(),
+            SpatialConvolution(n, n * 4, 1, 1, 1, 1, with_bias=False),
+            SpatialBatchNormalization(n * 4))
+        return Sequential(
+            ConcatTable(main, self.shortcut(n_input, n * 4, stride)),
+            CAddTable(),
+            ReLU())
+
+    def layer(self, block, features, count, stride=1):
+        s = Sequential()
+        for i in range(count):
+            s.add(block(features, stride if i == 0 else 1))
+        return s
+
+
+# (loop config, final features, block kind) per depth — ResNet.scala cfg map
+_IMAGENET_CFG = {
+    18: ((2, 2, 2, 2), 512, "basic"),
+    34: ((3, 4, 6, 3), 512, "basic"),
+    50: ((3, 4, 6, 3), 2048, "bottleneck"),
+    101: ((3, 4, 23, 3), 2048, "bottleneck"),
+    152: ((3, 8, 36, 3), 2048, "bottleneck"),
+    200: ((3, 24, 36, 3), 2048, "bottleneck"),
+}
+
+
+def build(class_num=1000, depth=50, shortcut_type=ShortcutType.B,
+          dataset="imagenet", with_logsoftmax=True):
+    """≙ ResNet.apply (ResNet.scala:240)."""
+    b = _Builder(shortcut_type)
+    model = Sequential(name=f"ResNet{depth}_{dataset}")
+    if dataset == "imagenet":
+        cfg = _IMAGENET_CFG[depth]
+        (c1, c2, c3, c4), n_features, kind = cfg
+        block = b.bottleneck if kind == "bottleneck" else b.basic_block
+        b.i_channels = 64
+        (model
+         .add(SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, with_bias=False,
+                                 name="conv1"))
+         .add(SpatialBatchNormalization(64))
+         .add(ReLU())
+         .add(SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+         .add(b.layer(block, 64, c1))
+         .add(b.layer(block, 128, c2, 2))
+         .add(b.layer(block, 256, c3, 2))
+         .add(b.layer(block, 512, c4, 2))
+         .add(SpatialAveragePooling(7, 7, 1, 1))
+         .add(View(n_features))
+         .add(Linear(n_features, class_num, name="fc1000")))
+    elif dataset == "cifar10":
+        if (depth - 2) % 6 != 0:
+            raise ValueError("CIFAR-10 ResNet depth must be 6n+2")
+        n = (depth - 2) // 6
+        b.i_channels = 16
+        (model
+         .add(SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1, with_bias=False))
+         .add(SpatialBatchNormalization(16))
+         .add(ReLU())
+         .add(b.layer(b.basic_block, 16, n))
+         .add(b.layer(b.basic_block, 32, n, 2))
+         .add(b.layer(b.basic_block, 64, n, 2))
+         .add(SpatialAveragePooling(8, 8, 1, 1))
+         .add(View(64))
+         .add(Linear(64, class_num)))
+    else:
+        raise ValueError(f"unknown dataset {dataset}")
+    if with_logsoftmax:
+        model.add(LogSoftMax())
+    return model

@@ -1,0 +1,71 @@
+"""bigdl_tpu.nn — the layer/criterion library (≙ com.intel.analytics.bigdl.nn)."""
+from .module import Module, Criterion, Ctx
+from . import init
+from .init import (Zeros, Ones, ConstInit, RandomUniform, RandomNormal,
+                   Xavier, MsraFiller, BilinearFiller)
+from .containers import (Container, Sequential, Concat, ConcatTable,
+                         ParallelTable, MapTable, Bottle, Identity, Echo)
+from .graph import Graph, DynamicGraph, Input, Node
+from .linear import (Linear, Bilinear, CMul, CAdd, Add, Mul, Cosine,
+                     Euclidean, LookupTable)
+from .activation import (ReLU, ReLU6, Tanh, Sigmoid, ELU, LeakyReLU, PReLU,
+                         RReLU, SReLU, SoftMax, SoftMin, LogSoftMax,
+                         LogSigmoid, SoftPlus, SoftSign, HardTanh, Clamp,
+                         HardSigmoid, HardShrink, SoftShrink, TanhShrink,
+                         Threshold, BinaryThreshold, GELU, SiLU)
+from .conv import (SpatialConvolution, SpatialShareConvolution,
+                   SpatialDilatedConvolution, SpatialFullConvolution,
+                   SpatialSeparableConvolution, TemporalConvolution,
+                   VolumetricConvolution, VolumetricFullConvolution,
+                   LocallyConnected1D, LocallyConnected2D)
+from .pooling import (SpatialMaxPooling, SpatialAveragePooling,
+                      VolumetricMaxPooling, VolumetricAveragePooling,
+                      TemporalMaxPooling, UpSampling1D, UpSampling2D,
+                      UpSampling3D, ResizeBilinear)
+from .normalization import (BatchNormalization, SpatialBatchNormalization,
+                            LayerNormalization, RMSNorm, SpatialCrossMapLRN,
+                            SpatialWithinChannelLRN,
+                            SpatialSubtractiveNormalization,
+                            SpatialDivisiveNormalization,
+                            SpatialContrastiveNormalization, Normalize,
+                            NormalizeScale)
+from .dropout import (Dropout, GaussianDropout, GaussianNoise,
+                      GaussianSampler, SpatialDropout1D, SpatialDropout2D,
+                      SpatialDropout3D)
+from .elementwise import (Abs, AddConstant, MulConstant, Exp, Log, Log1p,
+                          Sqrt, Square, Power, Highway, Scale, L1Penalty,
+                          ActivityRegularization, NegativeEntropyPenalty)
+from .shape_ops import (Reshape, View, InferReshape, Squeeze, Unsqueeze,
+                        Transpose, Select, Narrow, Replicate, Padding,
+                        SpatialZeroPadding, Cropping2D, Cropping3D,
+                        Contiguous, Index, Tile, Pack, Reverse, Masking,
+                        Sum, Max, Min, Mean, Negative, GradientReversal,
+                        SplitAndSelect, StrideSlice)
+from .table_ops import (CAddTable, CSubTable, CMulTable, CDivTable,
+                        CMaxTable, CMinTable, CAveTable, JoinTable,
+                        SplitTable, BifurcateSplitTable, NarrowTable,
+                        SelectTable, FlattenTable, MixtureTable, DotProduct,
+                        MM, MV, CosineDistance, PairwiseDistance,
+                        CrossProduct, DenseToSparse)
+from .recurrent import (Cell, RnnCell, LSTM, LSTMPeephole, GRU,
+                        ConvLSTMPeephole, MultiRNNCell, Recurrent,
+                        BiRecurrent, RecurrentDecoder, TimeDistributed)
+from .criterion import (ClassNLLCriterion, CrossEntropyCriterion,
+                        CategoricalCrossEntropy, SoftmaxWithCriterion,
+                        MSECriterion, AbsCriterion, BCECriterion,
+                        SmoothL1Criterion, SmoothL1CriterionWithWeights,
+                        MarginCriterion, MarginRankingCriterion,
+                        HingeEmbeddingCriterion, L1HingeEmbeddingCriterion,
+                        CosineEmbeddingCriterion, CosineDistanceCriterion,
+                        CosineProximityCriterion, DistKLDivCriterion,
+                        KLDCriterion, GaussianCriterion,
+                        KullbackLeiblerDivergenceCriterion, PoissonCriterion,
+                        MeanAbsolutePercentageCriterion,
+                        MeanSquaredLogarithmicCriterion,
+                        MultiLabelMarginCriterion,
+                        MultiLabelSoftMarginCriterion, MultiMarginCriterion,
+                        SoftMarginCriterion, ClassSimplexCriterion,
+                        DiceCoefficientCriterion, L1Cost, DotProductCriterion,
+                        PGCriterion, MultiCriterion, ParallelCriterion,
+                        TimeDistributedCriterion, TimeDistributedMaskCriterion,
+                        TransformerCriterion)

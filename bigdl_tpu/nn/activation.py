@@ -1,0 +1,253 @@
+"""Activation layers.
+
+Reference files: nn/ReLU.scala, ReLU6.scala, Tanh.scala, Sigmoid.scala,
+ELU.scala, LeakyReLU.scala, PReLU.scala, RReLU.scala, SReLU.scala,
+SoftMax.scala, SoftMin.scala, LogSoftMax.scala, LogSigmoid.scala,
+SoftPlus.scala, SoftSign.scala, HardTanh.scala, HardSigmoid.scala,
+HardShrink.scala, SoftShrink.scala, TanhShrink.scala, Threshold.scala,
+BinaryThreshold.scala, Clamp.scala.
+
+All are elementwise; XLA fuses them into neighbouring matmul/conv kernels so
+they are effectively free on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .module import Module
+from .init import init_tensor, ConstInit
+
+
+class ReLU(Module):
+    def __init__(self, ip=False, name=None):
+        super().__init__(name=name)
+
+    def apply(self, params, x, ctx):
+        return jnp.maximum(x, 0)
+
+
+class ReLU6(Module):
+    def apply(self, params, x, ctx):
+        return jnp.clip(x, 0, 6)
+
+
+class Tanh(Module):
+    def apply(self, params, x, ctx):
+        return jnp.tanh(x)
+
+
+class Sigmoid(Module):
+    def apply(self, params, x, ctx):
+        return jax.nn.sigmoid(x)
+
+
+class ELU(Module):
+    def __init__(self, alpha=1.0, inplace=False, name=None):
+        super().__init__(name=name)
+        self.alpha = alpha
+
+    def apply(self, params, x, ctx):
+        return jnp.where(x > 0, x, self.alpha * jnp.expm1(x))
+
+
+class LeakyReLU(Module):
+    def __init__(self, negval=0.01, inplace=False, name=None):
+        super().__init__(name=name)
+        self.negval = negval
+
+    def apply(self, params, x, ctx):
+        return jnp.where(x >= 0, x, self.negval * x)
+
+
+class PReLU(Module):
+    """Learned negative slope; n_output_plane=0 means one shared parameter
+    (nn/PReLU.scala)."""
+
+    def __init__(self, n_output_plane=0, name=None):
+        super().__init__(name=name)
+        self.n_output_plane = n_output_plane
+
+    def init(self, rng):
+        n = max(self.n_output_plane, 1)
+        w = init_tensor(self, rng, (n,), n, n, ConstInit(0.25))
+        return {self.name: {"weight": w}}
+
+    def apply(self, params, x, ctx):
+        w = self.own(params)["weight"].astype(x.dtype)
+        if self.n_output_plane == 0:
+            a = w[0]
+        else:
+            # channel dim is axis 1 for (N,C,...) inputs, matching reference NCHW
+            shape = [1] * x.ndim
+            shape[1 if x.ndim > 1 else 0] = self.n_output_plane
+            a = w.reshape(shape)
+        return jnp.where(x >= 0, x, a * x)
+
+
+class RReLU(Module):
+    """Randomized leaky ReLU (nn/RReLU.scala): slope ~ U(lower, upper) in
+    training, (lower+upper)/2 in eval."""
+
+    def __init__(self, lower=1.0 / 8, upper=1.0 / 3, inplace=False, name=None):
+        super().__init__(name=name)
+        self.lower, self.upper = lower, upper
+
+    def apply(self, params, x, ctx):
+        if ctx.training:
+            a = jax.random.uniform(ctx.rng(self), x.shape, x.dtype,
+                                   self.lower, self.upper)
+        else:
+            a = (self.lower + self.upper) / 2.0
+        return jnp.where(x >= 0, x, a * x)
+
+
+class SReLU(Module):
+    """S-shaped ReLU with 4 learned params per channel (nn/SReLU.scala)."""
+
+    def __init__(self, shape, shared_axes=None, name=None):
+        super().__init__(name=name)
+        self.shape = tuple(shape)
+        self.shared_axes = shared_axes
+
+    def _param_shape(self):
+        shape = list(self.shape)
+        if self.shared_axes:
+            for ax in self.shared_axes:
+                shape[ax - 1] = 1
+        return tuple(shape)
+
+    def init(self, rng):
+        s = self._param_shape()
+        n = 1
+        return {self.name: {
+            "tleft": jnp.zeros(s, jnp.float32),
+            "aleft": jnp.full(s, 1.0, jnp.float32),
+            "tright": jnp.full(s, 1.0, jnp.float32),
+            "aright": jnp.full(s, 1.0, jnp.float32),
+        }}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        tl, al = p["tleft"].astype(x.dtype), p["aleft"].astype(x.dtype)
+        tr, ar = p["tright"].astype(x.dtype), p["aright"].astype(x.dtype)
+        y = jnp.where(x >= tr, tr + ar * (x - tr), x)
+        return jnp.where(y <= tl, tl + al * (y - tl), y)
+
+
+class SoftMax(Module):
+    """Softmax over the last dim for 1D/2D input (nn/SoftMax.scala)."""
+
+    def apply(self, params, x, ctx):
+        return jax.nn.softmax(x, axis=-1)
+
+
+class SoftMin(Module):
+    def apply(self, params, x, ctx):
+        return jax.nn.softmax(-x, axis=-1)
+
+
+class LogSoftMax(Module):
+    def apply(self, params, x, ctx):
+        return jax.nn.log_softmax(x, axis=-1)
+
+
+class LogSigmoid(Module):
+    def apply(self, params, x, ctx):
+        return jax.nn.log_sigmoid(x)
+
+
+class SoftPlus(Module):
+    def __init__(self, beta=1.0, name=None):
+        super().__init__(name=name)
+        self.beta = beta
+
+    def apply(self, params, x, ctx):
+        return jax.nn.softplus(self.beta * x) / self.beta
+
+
+class SoftSign(Module):
+    def apply(self, params, x, ctx):
+        return x / (1.0 + jnp.abs(x))
+
+
+class HardTanh(Module):
+    def __init__(self, min_value=-1.0, max_value=1.0, inplace=False, name=None):
+        super().__init__(name=name)
+        self.min_value, self.max_value = min_value, max_value
+
+    def apply(self, params, x, ctx):
+        return jnp.clip(x, self.min_value, self.max_value)
+
+
+class Clamp(HardTanh):
+    """nn/Clamp.scala — HardTanh with explicit bounds."""
+
+    def __init__(self, min_value, max_value, name=None):
+        super().__init__(min_value=float(min_value), max_value=float(max_value),
+                         name=name)
+
+
+class HardSigmoid(Module):
+    """clip(0.2x + 0.5, 0, 1) (nn/HardSigmoid.scala)."""
+
+    def apply(self, params, x, ctx):
+        return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+class HardShrink(Module):
+    def __init__(self, lambd=0.5, name=None):
+        super().__init__(name=name)
+        self.lambd = lambd
+
+    def apply(self, params, x, ctx):
+        return jnp.where(jnp.abs(x) > self.lambd, x, 0.0)
+
+
+class SoftShrink(Module):
+    def __init__(self, lambd=0.5, name=None):
+        super().__init__(name=name)
+        self.lambd = lambd
+
+    def apply(self, params, x, ctx):
+        return jnp.where(x > self.lambd, x - self.lambd,
+                         jnp.where(x < -self.lambd, x + self.lambd, 0.0))
+
+
+class TanhShrink(Module):
+    def apply(self, params, x, ctx):
+        return x - jnp.tanh(x)
+
+
+class Threshold(Module):
+    """x if x > th else value (nn/Threshold.scala)."""
+
+    def __init__(self, th=1e-6, v=0.0, ip=False, name=None):
+        super().__init__(name=name)
+        self.th, self.v = th, v
+
+    def apply(self, params, x, ctx):
+        return jnp.where(x > self.th, x, self.v)
+
+
+class BinaryThreshold(Module):
+    """1 if x > th else 0 (nn/BinaryThreshold.scala)."""
+
+    def __init__(self, th=1e-6, ip=False, name=None):
+        super().__init__(name=name)
+        self.th = th
+
+    def apply(self, params, x, ctx):
+        return (x > self.th).astype(x.dtype)
+
+
+class GELU(Module):
+    """TPU-era extra (used by the TransformerLM flagship)."""
+
+    def apply(self, params, x, ctx):
+        return jax.nn.gelu(x)
+
+
+class SiLU(Module):
+    def apply(self, params, x, ctx):
+        return jax.nn.silu(x)

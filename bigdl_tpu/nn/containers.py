@@ -1,0 +1,136 @@
+"""Containers (≙ nn/Container.scala, Sequential.scala, Concat.scala,
+ConcatTable.scala, ParallelTable.scala, MapTable.scala, Bottle.scala).
+
+Containers compose children's pure ``apply`` functions; XLA sees one fused
+graph, so there is no per-layer dispatch overhead at run time.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.table import Table, as_list
+from .module import Module
+
+
+class Container(Module):
+    def __init__(self, *mods, name=None):
+        super().__init__(name=name)
+        self._children = list(mods)
+
+    def add(self, module):
+        self._children.append(module)
+        return self
+
+    def children(self):
+        return list(self._children)
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return self._children[i]
+
+    def init(self, rng):
+        params = {}
+        for i, m in enumerate(self._children):
+            params.update(m.init(jax.random.fold_in(rng, i)))
+        return params
+
+    def initial_state(self):
+        state = {}
+        for m in self._children:
+            state.update(m.initial_state())
+        return state
+
+    def __repr__(self):
+        inner = ", ".join(repr(c) for c in self._children)
+        return f"{type(self).__name__}({inner})"
+
+
+class Sequential(Container):
+    """Feed each child the previous child's output (nn/Sequential.scala)."""
+
+    def apply(self, params, x, ctx):
+        for m in self._children:
+            x = m.apply(params, x, ctx)
+        return x
+
+
+class Concat(Container):
+    """Apply each child to the same input, concat outputs along `dimension`
+    (1-based, matching nn/Concat.scala)."""
+
+    def __init__(self, dimension, *mods, name=None):
+        super().__init__(*mods, name=name)
+        self.dimension = dimension
+
+    def apply(self, params, x, ctx):
+        outs = [m.apply(params, x, ctx) for m in self._children]
+        return jnp.concatenate(outs, axis=self.dimension - 1)
+
+
+class ConcatTable(Container):
+    """Apply each child to the same input, return a Table of outputs
+    (nn/ConcatTable.scala)."""
+
+    def apply(self, params, x, ctx):
+        return Table(*[m.apply(params, x, ctx) for m in self._children])
+
+
+class ParallelTable(Container):
+    """i-th child gets i-th element of the input table (nn/ParallelTable.scala)."""
+
+    def apply(self, params, x, ctx):
+        xs = as_list(x)
+        if len(xs) != len(self._children):
+            raise ValueError(
+                f"{self.name}: input table size {len(xs)} != children {len(self._children)}")
+        return Table(*[m.apply(params, e, ctx)
+                       for m, e in zip(self._children, xs)])
+
+
+class MapTable(Container):
+    """Apply one shared module to every element of the input table
+    (nn/MapTable.scala). Parameters are shared (single child)."""
+
+    def __init__(self, module=None, name=None):
+        super().__init__(*( [module] if module is not None else [] ), name=name)
+
+    def apply(self, params, x, ctx):
+        m = self._children[0]
+        return Table(*[m.apply(params, e, ctx) for e in as_list(x)])
+
+
+class Bottle(Container):
+    """Reshape a high-dim input to 2D, apply the child, reshape back
+    (nn/Bottle.scala). `n_input_dim` counts dims the child consumes."""
+
+    def __init__(self, module, n_input_dim=2, n_output_dim=None, name=None):
+        super().__init__(module, name=name)
+        self.n_input_dim = n_input_dim
+        self.n_output_dim = n_output_dim or n_input_dim
+
+    def apply(self, params, x, ctx):
+        shape = x.shape
+        lead = shape[:len(shape) - self.n_input_dim + 1]
+        flat = x.reshape((-1,) + shape[len(shape) - self.n_input_dim + 1:])
+        y = self._children[0].apply(params, flat, ctx)
+        return y.reshape(lead + y.shape[1:])
+
+
+class Identity(Module):
+    """nn/Identity.scala"""
+
+    def apply(self, params, x, ctx):
+        return x
+
+
+class Echo(Module):
+    """Print activity shape when tracing (nn/Echo.scala — debugging aid)."""
+
+    def apply(self, params, x, ctx):
+        for leaf in jax.tree_util.tree_leaves(x):
+            print(f"[{self.name}] shape={getattr(leaf, 'shape', None)} "
+                  f"dtype={getattr(leaf, 'dtype', None)}")
+        return x

@@ -1,0 +1,515 @@
+"""Convolution layers.
+
+Reference files: nn/SpatialConvolution.scala, SpatialDilatedConvolution.scala,
+SpatialFullConvolution.scala, SpatialSeparableConvolution.scala,
+SpatialShareConvolution.scala, TemporalConvolution.scala,
+VolumetricConvolution.scala, VolumetricFullConvolution.scala,
+LocallyConnected1D.scala, LocallyConnected2D.scala, nn/ops/DepthwiseConv2D.scala.
+
+The reference hand-codes im2col + MKL GEMM; here every conv is one
+``lax.conv_general_dilated`` call, which XLA tiles directly onto the MXU
+(bf16-friendly, fused with bias/activation neighbours).  Weight layout is
+(out, in/groups, kh, kw) = OIHW, matching the reference's NCHW default.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .module import Module
+from .init import Xavier, Zeros, RandomUniform, init_tensor
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _same_pad(in_size, stride, ksize, dilation=1):
+    """TF/Keras SAME padding split (lo, hi) for one spatial dim."""
+    eff_k = (ksize - 1) * dilation + 1
+    out = -(-in_size // stride)
+    pad = max(0, (out - 1) * stride + eff_k - in_size)
+    return pad // 2, pad - pad // 2
+
+
+class SpatialConvolution(Module):
+    """2D convolution (nn/SpatialConvolution.scala).
+
+    padW/padH = -1 selects SAME padding (reference convention); nGroup
+    maps to feature_group_count.  `format` is 'NCHW' (default) or 'NHWC'.
+    """
+
+    def __init__(self, n_input_plane, n_output_plane, kernel_w, kernel_h,
+                 stride_w=1, stride_h=1, pad_w=0, pad_h=0, n_group=1,
+                 propagate_back=True, w_regularizer=None, b_regularizer=None,
+                 with_bias=True, format="NCHW", name=None):
+        super().__init__(name=name)
+        if n_input_plane % n_group or n_output_plane % n_group:
+            raise ValueError("channels must be multiples of n_group")
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.n_group = n_group
+        self.with_bias = with_bias
+        self.format = format
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        kh, kw = self.kernel
+        fan_in = self.n_input_plane // self.n_group * kh * kw
+        fan_out = self.n_output_plane // self.n_group * kh * kw
+        w = init_tensor(self, k1,
+                        (self.n_output_plane, self.n_input_plane // self.n_group,
+                         kh, kw), fan_in, fan_out, Xavier())
+        p = {"weight": w}
+        if self.with_bias:
+            p["bias"] = init_tensor(self, k2, (self.n_output_plane,),
+                                    fan_in, fan_out, Zeros(), kind="bias")
+        return {self.name: p}
+
+    def _padding(self, x_spatial):
+        pads = []
+        for i, (p, k, s) in enumerate(zip(self.pad, self.kernel, self.stride)):
+            if p == -1:
+                pads.append(_same_pad(x_spatial[i], s, k))
+            else:
+                pads.append((p, p))
+        return pads
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        w = p["weight"].astype(x.dtype)
+        dn = ("NCHW", "OIHW", "NCHW") if self.format == "NCHW" \
+            else ("NHWC", "OIHW", "NHWC")
+        spatial = x.shape[2:4] if self.format == "NCHW" else x.shape[1:3]
+        y = lax.conv_general_dilated(
+            x, w, window_strides=self.stride, padding=self._padding(spatial),
+            feature_group_count=self.n_group,
+            dimension_numbers=dn)
+        if self.with_bias:
+            b = p["bias"].astype(x.dtype)
+            y = y + (b[None, :, None, None] if self.format == "NCHW"
+                     else b[None, None, None, :])
+        return y
+
+
+class SpatialShareConvolution(SpatialConvolution):
+    """nn/SpatialShareConvolution.scala — a memory-sharing variant of conv in
+    the reference; identical math, and on TPU XLA owns buffer reuse, so this
+    is an alias."""
+
+
+class SpatialDilatedConvolution(Module):
+    """nn/SpatialDilatedConvolution.scala."""
+
+    def __init__(self, n_input_plane, n_output_plane, kw, kh, dw=1, dh=1,
+                 pad_w=0, pad_h=0, dilation_w=1, dilation_h=1,
+                 w_regularizer=None, b_regularizer=None, with_bias=True,
+                 name=None):
+        super().__init__(name=name)
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (kh, kw)
+        self.stride = (dh, dw)
+        self.pad = (pad_h, pad_w)
+        self.dilation = (dilation_h, dilation_w)
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        kh, kw = self.kernel
+        fan_in = self.n_input_plane * kh * kw
+        fan_out = self.n_output_plane * kh * kw
+        w = init_tensor(self, k1, (self.n_output_plane, self.n_input_plane,
+                                   kh, kw), fan_in, fan_out, Xavier())
+        p = {"weight": w}
+        if self.with_bias:
+            p["bias"] = init_tensor(self, k2, (self.n_output_plane,),
+                                    fan_in, fan_out, Zeros(), kind="bias")
+        return {self.name: p}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        pads = []
+        for i, (pd, k, s) in enumerate(zip(self.pad, self.kernel, self.stride)):
+            if pd == -1:
+                pads.append(_same_pad(x.shape[2 + i], s, k, self.dilation[i]))
+            else:
+                pads.append((pd, pd))
+        y = lax.conv_general_dilated(
+            x, p["weight"].astype(x.dtype), window_strides=self.stride,
+            padding=pads, rhs_dilation=self.dilation,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if self.with_bias:
+            y = y + p["bias"].astype(x.dtype)[None, :, None, None]
+        return y
+
+
+class SpatialFullConvolution(Module):
+    """Transposed convolution (nn/SpatialFullConvolution.scala).
+
+    Weight layout (in, out, kh, kw) as in the reference; adjW/adjH add to the
+    output size.  Implemented as lhs-dilated conv (XLA's native transpose-conv
+    form) rather than col2im.
+    """
+
+    def __init__(self, n_input_plane, n_output_plane, kw, kh, dw=1, dh=1,
+                 pad_w=0, pad_h=0, adj_w=0, adj_h=0, n_group=1,
+                 no_bias=False, w_regularizer=None, b_regularizer=None,
+                 name=None):
+        super().__init__(name=name)
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (kh, kw)
+        self.stride = (dh, dw)
+        self.pad = (pad_h, pad_w)
+        self.adj = (adj_h, adj_w)
+        self.n_group = n_group
+        self.with_bias = not no_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        kh, kw = self.kernel
+        fan_in = self.n_input_plane // self.n_group * kh * kw
+        fan_out = self.n_output_plane // self.n_group * kh * kw
+        w = init_tensor(self, k1,
+                        (self.n_input_plane, self.n_output_plane // self.n_group,
+                         kh, kw), fan_in, fan_out, Xavier())
+        p = {"weight": w}
+        if self.with_bias:
+            p["bias"] = init_tensor(self, k2, (self.n_output_plane,),
+                                    fan_in, fan_out, Zeros(), kind="bias")
+        return {self.name: p}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        w = p["weight"].astype(x.dtype)
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        ph, pw = self.pad
+        ah, aw = self.adj
+        g = self.n_group
+        # out = (in-1)*stride - 2*pad + kernel + adj
+        pads = [(kh - 1 - ph, kh - 1 - ph + ah), (kw - 1 - pw, kw - 1 - pw + aw)]
+        # weight (I, O/g, kh, kw): flip spatially; for grouped conv XLA wants
+        # the rhs I dim = in/g with output blocks per group, so regroup
+        # (g, in/g, out/g, ...) -> (in/g, g*out/g, ...)
+        w = w[:, :, ::-1, ::-1]
+        if g > 1:
+            i_g = self.n_input_plane // g
+            o_g = self.n_output_plane // g
+            w = (w.reshape(g, i_g, o_g, kh, kw)
+                  .transpose(1, 0, 2, 3, 4)
+                  .reshape(i_g, g * o_g, kh, kw))
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1), padding=pads,
+            lhs_dilation=(sh, sw), feature_group_count=g,
+            dimension_numbers=("NCHW", "IOHW", "NCHW"))
+        if self.with_bias:
+            y = y + p["bias"].astype(x.dtype)[None, :, None, None]
+        return y
+
+
+class SpatialSeparableConvolution(Module):
+    """Depthwise conv followed by 1x1 pointwise conv
+    (nn/SpatialSeparableConvolution.scala)."""
+
+    def __init__(self, n_input_channel, n_output_channel, depth_multiplier,
+                 kw, kh, sw=1, sh=1, pw=0, ph=0, with_bias=True,
+                 data_format="NCHW", w_regularizer=None, b_regularizer=None,
+                 p_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.n_input_channel = n_input_channel
+        self.n_output_channel = n_output_channel
+        self.depth_multiplier = depth_multiplier
+        self.kernel = (kh, kw)
+        self.stride = (sh, sw)
+        self.pad = (ph, pw)
+        self.with_bias = with_bias
+        self.format = data_format
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        kh, kw = self.kernel
+        mid = self.n_input_channel * self.depth_multiplier
+        fan_in = kh * kw
+        dw = init_tensor(self, k1, (mid, 1, kh, kw), fan_in,
+                         self.depth_multiplier * kh * kw, Xavier())
+        pw_w = init_tensor(self, k2, (self.n_output_channel, mid, 1, 1),
+                           mid, self.n_output_channel, Xavier())
+        p = {"depth_weight": dw, "point_weight": pw_w}
+        if self.with_bias:
+            p["bias"] = init_tensor(self, k3, (self.n_output_channel,),
+                                    mid, self.n_output_channel, Zeros(),
+                                    kind="bias")
+        return {self.name: p}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        if self.format == "NHWC":
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        pads = []
+        for i, (pd, k, s) in enumerate(zip(self.pad, self.kernel, self.stride)):
+            pads.append(_same_pad(x.shape[2 + i], s, k) if pd == -1 else (pd, pd))
+        y = lax.conv_general_dilated(
+            x, p["depth_weight"].astype(x.dtype), window_strides=self.stride,
+            padding=pads, feature_group_count=self.n_input_channel,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        y = lax.conv_general_dilated(
+            y, p["point_weight"].astype(x.dtype), window_strides=(1, 1),
+            padding=[(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if self.with_bias:
+            y = y + p["bias"].astype(x.dtype)[None, :, None, None]
+        if self.format == "NHWC":
+            y = jnp.transpose(y, (0, 2, 3, 1))
+        return y
+
+
+class TemporalConvolution(Module):
+    """1D convolution over (B, T, inputFrameSize) (nn/TemporalConvolution.scala)."""
+
+    def __init__(self, input_frame_size, output_frame_size, kernel_w, stride_w=1,
+                 propagate_back=True, w_regularizer=None, b_regularizer=None,
+                 name=None):
+        super().__init__(name=name)
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in = self.input_frame_size * self.kernel_w
+        w = init_tensor(self, k1,
+                        (self.output_frame_size, self.input_frame_size,
+                         self.kernel_w),
+                        fan_in, self.output_frame_size, Xavier())
+        b = init_tensor(self, k2, (self.output_frame_size,), fan_in,
+                        self.output_frame_size, Zeros(), kind="bias")
+        return {self.name: {"weight": w, "bias": b}}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        # (B, T, C) -> NCW conv
+        xt = jnp.swapaxes(x, 1, 2)
+        y = lax.conv_general_dilated(
+            xt, p["weight"].astype(x.dtype), window_strides=(self.stride_w,),
+            padding=[(0, 0)], dimension_numbers=("NCH", "OIH", "NCH"))
+        y = y + p["bias"].astype(x.dtype)[None, :, None]
+        return jnp.swapaxes(y, 1, 2)
+
+
+class VolumetricConvolution(Module):
+    """3D convolution over (B, C, D, H, W) (nn/VolumetricConvolution.scala)."""
+
+    def __init__(self, n_input_plane, n_output_plane, k_t, k_w, k_h,
+                 d_t=1, d_w=1, d_h=1, pad_t=0, pad_w=0, pad_h=0,
+                 with_bias=True, w_regularizer=None, b_regularizer=None,
+                 name=None):
+        super().__init__(name=name)
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        kt, kh, kw = self.kernel
+        fan_in = self.n_input_plane * kt * kh * kw
+        fan_out = self.n_output_plane * kt * kh * kw
+        w = init_tensor(self, k1, (self.n_output_plane, self.n_input_plane,
+                                   kt, kh, kw), fan_in, fan_out, Xavier())
+        p = {"weight": w}
+        if self.with_bias:
+            p["bias"] = init_tensor(self, k2, (self.n_output_plane,),
+                                    fan_in, fan_out, Zeros(), kind="bias")
+        return {self.name: p}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        pads = []
+        for i, (pd, k, s) in enumerate(zip(self.pad, self.kernel, self.stride)):
+            pads.append(_same_pad(x.shape[2 + i], s, k) if pd == -1 else (pd, pd))
+        y = lax.conv_general_dilated(
+            x, p["weight"].astype(x.dtype), window_strides=self.stride,
+            padding=pads, dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        if self.with_bias:
+            y = y + p["bias"].astype(x.dtype)[None, :, None, None, None]
+        return y
+
+
+class VolumetricFullConvolution(Module):
+    """3D transposed convolution (nn/VolumetricFullConvolution.scala)."""
+
+    def __init__(self, n_input_plane, n_output_plane, k_t, k_w, k_h,
+                 d_t=1, d_w=1, d_h=1, pad_t=0, pad_w=0, pad_h=0,
+                 adj_t=0, adj_w=0, adj_h=0, n_group=1, no_bias=False,
+                 w_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.n_input_plane = n_input_plane
+        self.n_output_plane = n_output_plane
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t, d_h, d_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.adj = (adj_t, adj_h, adj_w)
+        self.n_group = n_group
+        self.with_bias = not no_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        kt, kh, kw = self.kernel
+        fan_in = self.n_input_plane // self.n_group * kt * kh * kw
+        w = init_tensor(self, k1,
+                        (self.n_input_plane, self.n_output_plane // self.n_group,
+                         kt, kh, kw), fan_in, fan_in, Xavier())
+        p = {"weight": w}
+        if self.with_bias:
+            p["bias"] = init_tensor(self, k2, (self.n_output_plane,),
+                                    fan_in, fan_in, Zeros(), kind="bias")
+        return {self.name: p}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        w = p["weight"].astype(x.dtype)[:, :, ::-1, ::-1, ::-1]
+        g = self.n_group
+        if g > 1:
+            i_g = self.n_input_plane // g
+            o_g = self.n_output_plane // g
+            kt, kh, kw = self.kernel
+            w = (w.reshape(g, i_g, o_g, kt, kh, kw)
+                  .transpose(1, 0, 2, 3, 4, 5)
+                  .reshape(i_g, g * o_g, kt, kh, kw))
+        pads = [(k - 1 - pd, k - 1 - pd + a)
+                for k, pd, a in zip(self.kernel, self.pad, self.adj)]
+        y = lax.conv_general_dilated(
+            x, w, window_strides=(1, 1, 1), padding=pads,
+            lhs_dilation=self.stride, feature_group_count=g,
+            dimension_numbers=("NCDHW", "IODHW", "NCDHW"))
+        if self.with_bias:
+            y = y + p["bias"].astype(x.dtype)[None, :, None, None, None]
+        return y
+
+
+class LocallyConnected2D(Module):
+    """Conv with untied (per-location) weights (nn/LocallyConnected2D.scala).
+
+    Implemented as patch extraction + batched einsum (one big MXU contraction
+    per call) instead of per-location loops.
+    """
+
+    def __init__(self, n_input_plane, input_width, input_height, n_output_plane,
+                 kernel_w, kernel_h, stride_w=1, stride_h=1, pad_w=0, pad_h=0,
+                 propagate_back=True, w_regularizer=None, b_regularizer=None,
+                 with_bias=True, format="NCHW", name=None):
+        super().__init__(name=name)
+        self.n_input_plane = n_input_plane
+        self.input_size = (input_height, input_width)
+        self.n_output_plane = n_output_plane
+        self.kernel = (kernel_h, kernel_w)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.with_bias = with_bias
+        self.format = format
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        kh, kw = self.kernel
+        self.out_h = (self.input_size[0] + 2 * pad_h - kh) // stride_h + 1
+        self.out_w = (self.input_size[1] + 2 * pad_w - kw) // stride_w + 1
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        kh, kw = self.kernel
+        fan_in = self.n_input_plane * kh * kw
+        w = init_tensor(self, k1,
+                        (self.out_h * self.out_w, self.n_output_plane,
+                         self.n_input_plane * kh * kw),
+                        fan_in, self.n_output_plane, Xavier())
+        p = {"weight": w}
+        if self.with_bias:
+            p["bias"] = init_tensor(
+                self, k2, (self.out_h * self.out_w, self.n_output_plane),
+                fan_in, self.n_output_plane, Zeros(), kind="bias")
+        return {self.name: p}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        if self.format == "NHWC":
+            x = jnp.transpose(x, (0, 3, 1, 2))
+        kh, kw = self.kernel
+        ph, pw = self.pad
+        x = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        patches = lax.conv_general_dilated_patches(
+            x, (kh, kw), self.stride, [(0, 0), (0, 0)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: (B, C*kh*kw, out_h, out_w)
+        b = patches.shape[0]
+        patches = patches.reshape(b, -1, self.out_h * self.out_w)
+        w = p["weight"].astype(x.dtype)  # (L, O, C*kh*kw)
+        y = jnp.einsum("bcl,loc->blo", patches, w)
+        if self.with_bias:
+            y = y + p["bias"].astype(x.dtype)[None]
+        y = y.reshape(b, self.out_h, self.out_w, self.n_output_plane)
+        if self.format == "NHWC":
+            return y
+        return jnp.transpose(y, (0, 3, 1, 2))
+
+
+class LocallyConnected1D(Module):
+    """nn/LocallyConnected1D.scala — untied TemporalConvolution."""
+
+    def __init__(self, n_input_frame, input_frame_size, output_frame_size,
+                 kernel_w, stride_w=1, propagate_back=True,
+                 w_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.n_input_frame = n_input_frame
+        self.input_frame_size = input_frame_size
+        self.output_frame_size = output_frame_size
+        self.kernel_w = kernel_w
+        self.stride_w = stride_w
+        self.n_output_frame = (n_input_frame - kernel_w) // stride_w + 1
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in = self.input_frame_size * self.kernel_w
+        w = init_tensor(self, k1,
+                        (self.n_output_frame, self.output_frame_size,
+                         fan_in), fan_in, self.output_frame_size, Xavier())
+        b = init_tensor(self, k2,
+                        (self.n_output_frame, self.output_frame_size),
+                        fan_in, self.output_frame_size, Zeros(), kind="bias")
+        return {self.name: {"weight": w, "bias": b}}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        # x: (B, T, C). Extract windows: (B, L, kernel_w*C)
+        idx = (jnp.arange(self.n_output_frame)[:, None] * self.stride_w
+               + jnp.arange(self.kernel_w)[None, :])
+        windows = x[:, idx, :]  # (B, L, kw, C)
+        b = windows.shape[0]
+        windows = windows.reshape(b, self.n_output_frame, -1)
+        w = p["weight"].astype(x.dtype)
+        y = jnp.einsum("blc,loc->blo", windows, w)
+        return y + p["bias"].astype(x.dtype)[None]

@@ -1,0 +1,608 @@
+"""Loss functions (criterions).
+
+Reference files: nn/ClassNLLCriterion.scala, CrossEntropyCriterion.scala,
+MSECriterion.scala, AbsCriterion.scala, BCECriterion.scala,
+MultiCriterion.scala, ParallelCriterion.scala, SmoothL1Criterion.scala,
+MarginCriterion.scala, MarginRankingCriterion.scala, HingeEmbeddingCriterion.scala,
+L1HingeEmbeddingCriterion.scala, CosineEmbeddingCriterion.scala,
+CosineDistanceCriterion.scala, CosineProximityCriterion.scala,
+DistKLDivCriterion.scala, KLDCriterion.scala, GaussianCriterion.scala,
+MultiLabelMarginCriterion.scala, MultiLabelSoftMarginCriterion.scala,
+MultiMarginCriterion.scala, SoftMarginCriterion.scala, ClassSimplexCriterion.scala,
+DiceCoefficientCriterion.scala, MeanAbsolutePercentageCriterion.scala,
+MeanSquaredLogarithmicCriterion.scala, KullbackLeiblerDivergenceCriterion.scala,
+PoissonCriterion.scala, L1Cost.scala, DotProductCriterion.scala, PGCriterion.scala,
+TimeDistributedCriterion.scala, TimeDistributedMaskCriterion.scala,
+CategoricalCrossEntropy.scala, SoftmaxWithCriterion.scala,
+CrossEntropy (ops), ClassNLL label convention: **targets are 1-based**
+class indices (Torch heritage), preserved here for API parity.
+
+Gradients come from JAX AD (Criterion.backward), so only the scalar loss is
+defined per criterion.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .module import Criterion
+from ..utils.table import as_list
+
+
+def _reduce(per_elem, size_average, weight_sum=None):
+    if size_average:
+        if weight_sum is not None:
+            return jnp.sum(per_elem) / jnp.maximum(weight_sum, 1e-12)
+        return jnp.mean(per_elem)
+    return jnp.sum(per_elem)
+
+
+class ClassNLLCriterion(Criterion):
+    """Negative log-likelihood over log-probabilities with 1-based integer
+    targets (nn/ClassNLLCriterion.scala).  `padding_value` targets contribute
+    zero loss; `logProbAsInput=False` takes probabilities instead."""
+
+    def __init__(self, weights=None, size_average=True, log_prob_as_input=True,
+                 padding_value=-1, zero_based_label=False, name=None):
+        super().__init__(name=name)
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+        self.log_prob_as_input = log_prob_as_input
+        self.padding_value = padding_value
+        self.zero_based_label = zero_based_label
+
+    def loss(self, output, target):
+        logp = output if self.log_prob_as_input else jnp.log(
+            jnp.maximum(output, 1e-8))
+        t = target.astype(jnp.int32).reshape(-1)
+        idx = t if self.zero_based_label else t - 1
+        valid = (t != self.padding_value)
+        idx_c = jnp.clip(idx, 0, logp.shape[-1] - 1)
+        logp2 = logp.reshape(-1, logp.shape[-1])
+        picked = jnp.take_along_axis(logp2, idx_c[:, None], axis=-1)[:, 0]
+        w = jnp.ones_like(picked) if self.weights is None \
+            else jnp.take(self.weights, idx_c)
+        w = w * valid.astype(picked.dtype)
+        return _reduce(-w * picked, self.size_average, jnp.sum(w))
+
+
+class CrossEntropyCriterion(Criterion):
+    """LogSoftMax + ClassNLL fused (nn/CrossEntropyCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average=True, zero_based_label=False,
+                 name=None):
+        super().__init__(name=name)
+        self.nll = ClassNLLCriterion(weights, size_average,
+                                     zero_based_label=zero_based_label)
+
+    def loss(self, output, target):
+        return self.nll.loss(jax.nn.log_softmax(output, axis=-1), target)
+
+
+class CategoricalCrossEntropy(Criterion):
+    """One-hot-target cross entropy over probabilities
+    (nn/CategoricalCrossEntropy.scala)."""
+
+    def loss(self, output, target):
+        logp = jnp.log(jnp.clip(output, 1e-8, 1.0))
+        return _reduce(-jnp.sum(target * logp, axis=-1), True)
+
+
+class SoftmaxWithCriterion(Criterion):
+    """Softmax + NLL with optional ignore label, Caffe-style
+    (nn/SoftmaxWithCriterion.scala). Input NCHW, target (N,1,H,W)."""
+
+    def __init__(self, ignore_label=None, normalize_mode="VALID", name=None):
+        super().__init__(name=name)
+        self.ignore_label = ignore_label
+        self.normalize_mode = normalize_mode
+
+    def loss(self, output, target):
+        logp = jax.nn.log_softmax(output, axis=1)
+        t = target.astype(jnp.int32).reshape(
+            target.shape[0], -1) - 1  # 1-based
+        logp2 = jnp.moveaxis(logp, 1, -1).reshape(-1, logp.shape[1])
+        tf = t.reshape(-1)
+        valid = jnp.ones_like(tf, dtype=logp.dtype) if self.ignore_label is None \
+            else (tf != self.ignore_label - 1).astype(logp.dtype)
+        picked = jnp.take_along_axis(
+            logp2, jnp.clip(tf, 0, logp.shape[1] - 1)[:, None], axis=-1)[:, 0]
+        total = -jnp.sum(picked * valid)
+        if self.normalize_mode == "VALID":
+            return total / jnp.maximum(jnp.sum(valid), 1.0)
+        if self.normalize_mode == "BATCH_SIZE":
+            return total / output.shape[0]
+        if self.normalize_mode == "FULL":
+            return total / tf.shape[0]
+        return total
+
+
+class MSECriterion(Criterion):
+    def __init__(self, size_average=True, name=None):
+        super().__init__(name=name)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        return _reduce((output - target) ** 2, self.size_average)
+
+
+class AbsCriterion(Criterion):
+    def __init__(self, size_average=True, name=None):
+        super().__init__(name=name)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        return _reduce(jnp.abs(output - target), self.size_average)
+
+
+class BCECriterion(Criterion):
+    """Binary cross entropy over probabilities (nn/BCECriterion.scala)."""
+
+    def __init__(self, weights=None, size_average=True, name=None):
+        super().__init__(name=name)
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        eps = 1e-12
+        o = jnp.clip(output, eps, 1 - eps)
+        per = -(target * jnp.log(o) + (1 - target) * jnp.log(1 - o))
+        if self.weights is not None:
+            per = per * self.weights
+        return _reduce(per, self.size_average)
+
+
+class SmoothL1Criterion(Criterion):
+    def __init__(self, size_average=True, name=None):
+        super().__init__(name=name)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        d = jnp.abs(output - target)
+        per = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+        return _reduce(per, self.size_average)
+
+
+class SmoothL1CriterionWithWeights(Criterion):
+    """nn/SmoothL1CriterionWithWeights.scala (Fast-RCNN bbox loss).
+    Input table target {t, inside_w, outside_w}."""
+
+    def __init__(self, sigma=1.0, num=0, name=None):
+        super().__init__(name=name)
+        self.sigma2 = sigma * sigma
+        self.num = num
+
+    def loss(self, output, target):
+        t, iw, ow = as_list(target)
+        d = (output - t) * iw
+        ad = jnp.abs(d)
+        per = jnp.where(ad < 1.0 / self.sigma2,
+                        0.5 * self.sigma2 * d * d, ad - 0.5 / self.sigma2)
+        total = jnp.sum(per * ow)
+        return total / self.num if self.num > 0 else total
+
+
+class MarginCriterion(Criterion):
+    """Hinge loss; targets +/-1 (nn/MarginCriterion.scala). squared=True
+    gives squared hinge."""
+
+    def __init__(self, margin=1.0, size_average=True, squared=False, name=None):
+        super().__init__(name=name)
+        self.margin = margin
+        self.size_average = size_average
+        self.squared = squared
+
+    def loss(self, output, target):
+        per = jnp.maximum(0.0, self.margin - output * target)
+        if self.squared:
+            per = per * per
+        return _reduce(per, self.size_average)
+
+
+class MarginRankingCriterion(Criterion):
+    """max(0, -y*(x1-x2) + margin) over table inputs (nn/MarginRankingCriterion.scala)."""
+
+    def __init__(self, margin=1.0, size_average=True, name=None):
+        super().__init__(name=name)
+        self.margin = margin
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        x1, x2 = as_list(output)
+        y = jnp.asarray(as_list(target)[0])
+        per = jnp.maximum(0.0, -y * (x1 - x2) + self.margin)
+        return _reduce(per, self.size_average)
+
+
+class HingeEmbeddingCriterion(Criterion):
+    """x if y==1 else max(0, margin - x) (nn/HingeEmbeddingCriterion.scala)."""
+
+    def __init__(self, margin=1.0, size_average=True, name=None):
+        super().__init__(name=name)
+        self.margin = margin
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        per = jnp.where(target == 1, output,
+                        jnp.maximum(0.0, self.margin - output))
+        return _reduce(per, self.size_average)
+
+
+class L1HingeEmbeddingCriterion(Criterion):
+    """L1 distance between pair; hinge on dissimilar pairs
+    (nn/L1HingeEmbeddingCriterion.scala). Target is +1 (similar) or -1."""
+
+    def __init__(self, margin=1.0, name=None):
+        super().__init__(name=name)
+        self.margin = margin
+
+    def loss(self, output, target):
+        x1, x2 = as_list(output)
+        y = jnp.asarray(as_list(target)[0]).reshape(())
+        d = jnp.sum(jnp.abs(x1 - x2))
+        return jnp.where(y > 0, d, jnp.maximum(0.0, self.margin - d))
+
+
+class CosineEmbeddingCriterion(Criterion):
+    """1-cos(x1,x2) for y=1; max(0, cos-margin) for y=-1
+    (nn/CosineEmbeddingCriterion.scala)."""
+
+    def __init__(self, margin=0.0, size_average=True, name=None):
+        super().__init__(name=name)
+        self.margin = margin
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        x1, x2 = as_list(output)
+        y = jnp.asarray(as_list(target)[0]).reshape(-1)
+        cos = jnp.sum(x1 * x2, -1) / jnp.maximum(
+            jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+        per = jnp.where(y > 0, 1.0 - cos,
+                        jnp.maximum(0.0, cos - self.margin))
+        return _reduce(per, self.size_average)
+
+
+class CosineDistanceCriterion(Criterion):
+    """1 - cos(x, target) (nn/CosineDistanceCriterion.scala)."""
+
+    def __init__(self, size_average=True, name=None):
+        super().__init__(name=name)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        cos = jnp.sum(output * target, -1) / jnp.maximum(
+            jnp.linalg.norm(output, axis=-1) * jnp.linalg.norm(target, axis=-1),
+            1e-12)
+        return _reduce(1.0 - cos, self.size_average)
+
+
+class CosineProximityCriterion(Criterion):
+    """-mean(cos of l2-normalized x,y) (nn/CosineProximityCriterion.scala)."""
+
+    def loss(self, output, target):
+        xn = output / jnp.maximum(
+            jnp.linalg.norm(output, axis=-1, keepdims=True), 1e-12)
+        yn = target / jnp.maximum(
+            jnp.linalg.norm(target, axis=-1, keepdims=True), 1e-12)
+        return -jnp.mean(jnp.sum(xn * yn, axis=-1))
+
+
+class DistKLDivCriterion(Criterion):
+    """KL(target || output) with output = log-probs (nn/DistKLDivCriterion.scala)."""
+
+    def __init__(self, size_average=True, name=None):
+        super().__init__(name=name)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        per = jnp.where(target > 0, target * (jnp.log(
+            jnp.maximum(target, 1e-12)) - output), 0.0)
+        if self.size_average:
+            return jnp.sum(per) / output.shape[0] if output.ndim > 1 \
+                else jnp.mean(per)
+        return jnp.sum(per)
+
+
+class KLDCriterion(Criterion):
+    """KL(N(mu, sigma^2) || N(0,1)) from table {mean, logvar}
+    (nn/KLDCriterion.scala — VAE latent loss)."""
+
+    def __init__(self, size_average=True, name=None):
+        super().__init__(name=name)
+        self.size_average = size_average
+
+    def loss(self, output, target=None):
+        mean, log_var = as_list(output)
+        per = 0.5 * (mean ** 2 + jnp.exp(log_var) - 1.0 - log_var)
+        return jnp.sum(per) / mean.shape[0] if self.size_average \
+            else jnp.sum(per)
+
+
+class GaussianCriterion(Criterion):
+    """-log N(target; mean, exp(logvar)) from table {mean, logvar}
+    (nn/GaussianCriterion.scala)."""
+
+    def loss(self, output, target):
+        mean, log_var = as_list(output)
+        per = 0.5 * (np.log(2 * np.pi) + log_var
+                     + (target - mean) ** 2 / jnp.exp(log_var))
+        return jnp.sum(per)
+
+
+class KullbackLeiblerDivergenceCriterion(Criterion):
+    """KL over probability vectors, keras-style, inputs clipped
+    (nn/KullbackLeiblerDivergenceCriterion.scala)."""
+
+    def loss(self, output, target):
+        y = jnp.clip(target, 1e-7, 1.0)
+        p = jnp.clip(output, 1e-7, 1.0)
+        return jnp.mean(jnp.sum(y * jnp.log(y / p), axis=-1))
+
+
+class PoissonCriterion(Criterion):
+    """mean(pred - target*log(pred)) (nn/PoissonCriterion.scala)."""
+
+    def loss(self, output, target):
+        return jnp.mean(output - target * jnp.log(jnp.maximum(output, 1e-7)))
+
+
+class MeanAbsolutePercentageCriterion(Criterion):
+    def loss(self, output, target):
+        diff = jnp.abs(target - output) / jnp.clip(jnp.abs(target), 1e-7, None)
+        return 100.0 * jnp.mean(diff)
+
+
+class MeanSquaredLogarithmicCriterion(Criterion):
+    def loss(self, output, target):
+        a = jnp.log(jnp.clip(output, 1e-7, None) + 1.0)
+        b = jnp.log(jnp.clip(target, 1e-7, None) + 1.0)
+        return jnp.mean((a - b) ** 2)
+
+
+class MultiLabelMarginCriterion(Criterion):
+    """Multi-label hinge (nn/MultiLabelMarginCriterion.scala): targets are
+    1-based label indices padded with 0."""
+
+    def __init__(self, size_average=True, name=None):
+        super().__init__(name=name)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        out2 = output.reshape(-1, output.shape[-1])
+        t2 = target.astype(jnp.int32).reshape(-1, output.shape[-1])
+        n, c = out2.shape
+        t_idx = jnp.clip(t2 - 1, 0, c - 1)
+        valid = (t2 > 0).astype(out2.dtype)  # (n, c)
+        is_target = jnp.zeros((n, c), out2.dtype)
+        is_target = jax.vmap(
+            lambda it, ti, v: it.at[ti].add(v))(is_target, t_idx, valid)
+        is_target = jnp.minimum(is_target, 1.0)
+        tgt_scores = jnp.take_along_axis(out2, t_idx, axis=-1)  # (n, c)
+        margins = 1.0 - tgt_scores[:, :, None] + out2[:, None, :]  # (n, c_t, c)
+        mask = valid[:, :, None] * (1.0 - is_target[:, None, :])
+        per = jnp.sum(jnp.maximum(margins, 0.0) * mask, axis=(1, 2)) / c
+        return _reduce(per, self.size_average)
+
+
+class MultiLabelSoftMarginCriterion(Criterion):
+    """Sigmoid + BCE per label (nn/MultiLabelSoftMarginCriterion.scala)."""
+
+    def __init__(self, weights=None, size_average=True, name=None):
+        super().__init__(name=name)
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        per = (jax.nn.softplus(-output) * target
+               + jax.nn.softplus(output) * (1 - target))
+        if self.weights is not None:
+            per = per * self.weights
+        per = jnp.mean(per, axis=-1)
+        return _reduce(per, self.size_average)
+
+
+class MultiMarginCriterion(Criterion):
+    """Multi-class hinge with 1-based integer target (nn/MultiMarginCriterion.scala)."""
+
+    def __init__(self, p=1, weights=None, margin=1.0, size_average=True,
+                 name=None):
+        super().__init__(name=name)
+        self.p = p
+        self.weights = None if weights is None else jnp.asarray(weights)
+        self.margin = margin
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        out2 = output.reshape(-1, output.shape[-1])
+        t = target.astype(jnp.int32).reshape(-1) - 1
+        n, c = out2.shape
+        tgt = jnp.take_along_axis(out2, t[:, None], axis=-1)
+        margins = jnp.maximum(0.0, self.margin - tgt + out2) ** self.p
+        if self.weights is not None:
+            margins = margins * jnp.take(self.weights, t)[:, None]
+        margins = margins * (1 - jax.nn.one_hot(t, c, dtype=out2.dtype))
+        per = jnp.sum(margins, axis=-1) / c
+        return _reduce(per, self.size_average)
+
+
+class SoftMarginCriterion(Criterion):
+    """mean(log(1+exp(-y*x))) (nn/SoftMarginCriterion.scala)."""
+
+    def __init__(self, size_average=True, name=None):
+        super().__init__(name=name)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        return _reduce(jax.nn.softplus(-output * target), self.size_average)
+
+
+class ClassSimplexCriterion(Criterion):
+    """MSE against simplex embedding of the (1-based) class
+    (nn/ClassSimplexCriterion.scala)."""
+
+    def __init__(self, n_classes, name=None):
+        super().__init__(name=name)
+        self.n_classes = n_classes
+        # regular simplex embedding in R^n: identity shifted so the n
+        # vertices are equidistant (closed form, equivalent to the
+        # reference's gram-schmidt construction up to rotation)
+        a = (1.0 - np.sqrt(1.0 + n_classes)) / n_classes
+        m = np.eye(n_classes, dtype=np.float32) + a / np.sqrt(n_classes)
+        self.simplex = jnp.asarray(m)
+
+    def loss(self, output, target):
+        t = target.astype(jnp.int32).reshape(-1) - 1
+        goal = jnp.take(self.simplex, t, axis=0)
+        return jnp.mean((output - goal) ** 2)
+
+
+class DiceCoefficientCriterion(Criterion):
+    """1 - dice overlap (nn/DiceCoefficientCriterion.scala)."""
+
+    def __init__(self, size_average=True, epsilon=1.0, name=None):
+        super().__init__(name=name)
+        self.epsilon = epsilon
+
+    def loss(self, output, target):
+        o = output.reshape(output.shape[0], -1)
+        t = target.reshape(target.shape[0], -1)
+        inter = jnp.sum(o * t, axis=-1)
+        union = jnp.sum(o, axis=-1) + jnp.sum(t, axis=-1)
+        dice = (2 * inter + self.epsilon) / (union + self.epsilon)
+        return jnp.mean(1.0 - dice)
+
+
+class L1Cost(Criterion):
+    """sum |x| (nn/L1Cost.scala)."""
+
+    def loss(self, output, target=None):
+        return jnp.sum(jnp.abs(output))
+
+
+class DotProductCriterion(Criterion):
+    """-sum(x * target) — maximizing dot product (nn/DotProductCriterion.scala
+    computes sum(x*y) as the loss with positive grad; sign preserved)."""
+
+    def __init__(self, size_average=False, name=None):
+        super().__init__(name=name)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        return _reduce(output * target, self.size_average)
+
+
+class PGCriterion(Criterion):
+    """Policy-gradient criterion (nn/PGCriterion.scala): -sum(log(p) * reward)
+    with input probabilities (or log-probs)."""
+
+    def __init__(self, size_average=False, name=None):
+        super().__init__(name=name)
+        self.size_average = size_average
+
+    def loss(self, output, target):
+        logp = jnp.log(jnp.maximum(output, 1e-8))
+        return _reduce(-logp * target, self.size_average)
+
+
+class MultiCriterion(Criterion):
+    """Weighted sum of criterions on the same (input, target)
+    (nn/MultiCriterion.scala)."""
+
+    def __init__(self, name=None):
+        super().__init__(name=name)
+        self.criterions = []
+        self.weights = []
+
+    def add(self, criterion, weight=1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def loss(self, output, target):
+        return sum(w * c.loss(output, target)
+                   for c, w in zip(self.criterions, self.weights))
+
+
+class ParallelCriterion(Criterion):
+    """i-th criterion applied to i-th (input, target) table element
+    (nn/ParallelCriterion.scala)."""
+
+    def __init__(self, repeat_target=False, name=None):
+        super().__init__(name=name)
+        self.repeat_target = repeat_target
+        self.criterions = []
+        self.weights = []
+
+    def add(self, criterion, weight=1.0):
+        self.criterions.append(criterion)
+        self.weights.append(weight)
+        return self
+
+    def loss(self, output, target):
+        outs = as_list(output)
+        tgts = [target] * len(outs) if self.repeat_target else as_list(target)
+        return sum(w * c.loss(o, t) for c, w, o, t in
+                   zip(self.criterions, self.weights, outs, tgts))
+
+
+class TimeDistributedCriterion(Criterion):
+    """Apply a criterion at every timestep of (B, T, ...) input
+    (nn/TimeDistributedCriterion.scala)."""
+
+    def __init__(self, critrn, size_average=False, dimension=2, name=None):
+        super().__init__(name=name)
+        self.critrn = critrn
+        self.size_average = size_average
+        self.dimension = dimension
+
+    def loss(self, output, target):
+        ax = self.dimension - 1
+        n = output.shape[ax]
+        total = 0.0
+        o_parts = jnp.split(output, n, axis=ax)
+        t_parts = jnp.split(target, n, axis=ax)
+        for o, t in zip(o_parts, t_parts):
+            total = total + self.critrn.loss(jnp.squeeze(o, axis=ax),
+                                             jnp.squeeze(t, axis=ax))
+        return total / n if self.size_average else total
+
+
+class TimeDistributedMaskCriterion(Criterion):
+    """Time-distributed criterion skipping padded targets
+    (nn/TimeDistributedMaskCriterion.scala). Supported for ClassNLL inner."""
+
+    def __init__(self, critrn, padding_value=0, name=None):
+        super().__init__(name=name)
+        self.critrn = critrn
+        self.padding_value = padding_value
+
+    def loss(self, output, target):
+        inner = ClassNLLCriterion(
+            size_average=True, padding_value=self.padding_value,
+            log_prob_as_input=getattr(self.critrn, "log_prob_as_input", True))
+        return inner.loss(output.reshape(-1, output.shape[-1]),
+                          target.reshape(-1))
+
+
+class TransformerCriterion(Criterion):
+    """Apply transformations to input/target before an inner criterion
+    (nn/TransformerCriterion.scala)."""
+
+    def __init__(self, criterion, input_transformer=None,
+                 target_transformer=None, name=None):
+        super().__init__(name=name)
+        self.criterion = criterion
+        self.input_transformer = input_transformer
+        self.target_transformer = target_transformer
+
+    def loss(self, output, target):
+        if self.input_transformer is not None:
+            t = self.input_transformer
+            t.ensure_initialized()  # respects weights loaded onto the module
+            output, _ = t.run(t._params, output, state=t._state)
+        if self.target_transformer is not None:
+            t = self.target_transformer
+            t.ensure_initialized()
+            target, _ = t.run(t._params, target, state=t._state)
+        return self.criterion.loss(output, target)

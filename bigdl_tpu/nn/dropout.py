@@ -1,0 +1,128 @@
+"""Stochastic regularization layers.
+
+Reference files: nn/Dropout.scala, GaussianDropout.scala, GaussianNoise.scala,
+GaussianSampler.scala, SpatialDropout1D/2D/3D.scala.
+
+RNG keys are derived per-module from the ctx key (fold_in on the module uid),
+so a single key passed to the train step drives every stochastic layer
+deterministically — reproducible and jit-stable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .module import Module
+from ..utils.table import as_list
+
+
+class Dropout(Module):
+    """Inverted dropout, scaling by 1/(1-p) at train time when scale=True
+    (nn/Dropout.scala)."""
+
+    def __init__(self, init_p=0.5, inplace=False, scale=True, name=None):
+        super().__init__(name=name)
+        self.p = init_p
+        self.scale = scale
+
+    def set_p(self, p):
+        self.p = p
+        return self
+
+    def apply(self, params, x, ctx):
+        if not ctx.training or self.p <= 0.0:
+            return x
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(ctx.rng(self), keep, x.shape)
+        y = jnp.where(mask, x, 0.0)
+        return y / keep if self.scale else y
+
+
+class GaussianDropout(Module):
+    """Multiplicative N(1, p/(1-p)) noise at train time (nn/GaussianDropout.scala)."""
+
+    def __init__(self, rate, name=None):
+        super().__init__(name=name)
+        self.rate = rate
+
+    def apply(self, params, x, ctx):
+        if not ctx.training:
+            return x
+        stddev = jnp.sqrt(self.rate / (1.0 - self.rate))
+        noise = 1.0 + stddev * jax.random.normal(ctx.rng(self), x.shape, x.dtype)
+        return x * noise
+
+
+class GaussianNoise(Module):
+    """Additive N(0, stddev) noise at train time (nn/GaussianNoise.scala)."""
+
+    def __init__(self, stddev, name=None):
+        super().__init__(name=name)
+        self.stddev = stddev
+
+    def apply(self, params, x, ctx):
+        if not ctx.training:
+            return x
+        return x + self.stddev * jax.random.normal(ctx.rng(self), x.shape, x.dtype)
+
+
+class GaussianSampler(Module):
+    """Sample from N(mean, exp(logvar)) given a table {mean, logvar}
+    (nn/GaussianSampler.scala — the VAE reparameterization trick)."""
+
+    def apply(self, params, x, ctx):
+        mean, log_var = as_list(x)
+        eps = jax.random.normal(ctx.rng(self), mean.shape, mean.dtype)
+        return mean + jnp.exp(0.5 * log_var) * eps
+
+
+class SpatialDropout1D(Module):
+    """Drop whole channels of (B, T, C) (nn/SpatialDropout1D.scala)."""
+
+    def __init__(self, init_p=0.5, name=None):
+        super().__init__(name=name)
+        self.p = init_p
+
+    def apply(self, params, x, ctx):
+        if not ctx.training or self.p <= 0.0:
+            return x
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(ctx.rng(self), keep,
+                                    (x.shape[0], 1, x.shape[2]))
+        return jnp.where(mask, x, 0.0)
+
+
+class SpatialDropout2D(Module):
+    """Drop whole feature maps of NCHW/NHWC input (nn/SpatialDropout2D.scala)."""
+
+    def __init__(self, init_p=0.5, format="NCHW", name=None):
+        super().__init__(name=name)
+        self.p = init_p
+        self.format = format
+
+    def apply(self, params, x, ctx):
+        if not ctx.training or self.p <= 0.0:
+            return x
+        keep = 1.0 - self.p
+        shape = ((x.shape[0], x.shape[1], 1, 1) if self.format == "NCHW"
+                 else (x.shape[0], 1, 1, x.shape[3]))
+        mask = jax.random.bernoulli(ctx.rng(self), keep, shape)
+        return jnp.where(mask, x, 0.0)
+
+
+class SpatialDropout3D(Module):
+    """nn/SpatialDropout3D.scala for NCDHW/NDHWC input."""
+
+    def __init__(self, init_p=0.5, format="NCDHW", name=None):
+        super().__init__(name=name)
+        self.p = init_p
+        self.format = format
+
+    def apply(self, params, x, ctx):
+        if not ctx.training or self.p <= 0.0:
+            return x
+        keep = 1.0 - self.p
+        shape = ((x.shape[0], x.shape[1], 1, 1, 1) if self.format == "NCDHW"
+                 else (x.shape[0], 1, 1, 1, x.shape[4]))
+        mask = jax.random.bernoulli(ctx.rng(self), keep, shape)
+        return jnp.where(mask, x, 0.0)

@@ -1,0 +1,180 @@
+"""Elementwise math and small parameterized utility layers.
+
+Reference files: nn/Abs.scala, AddConstant.scala, MulConstant.scala, Exp.scala,
+Log.scala, Sqrt.scala, Square.scala, Power.scala, Highway.scala, Scale.scala,
+L1Penalty.scala, ActivityRegularization.scala, NegativeEntropyPenalty.scala,
+nn/tf/Log1p.scala.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .module import Module
+from ..utils.table import as_list
+
+
+class Abs(Module):
+    def apply(self, params, x, ctx):
+        return jnp.abs(x)
+
+
+class AddConstant(Module):
+    def __init__(self, constant_scalar, inplace=False, name=None):
+        super().__init__(name=name)
+        self.constant = constant_scalar
+
+    def apply(self, params, x, ctx):
+        return x + self.constant
+
+
+class MulConstant(Module):
+    def __init__(self, scalar, inplace=False, name=None):
+        super().__init__(name=name)
+        self.scalar = scalar
+
+    def apply(self, params, x, ctx):
+        return x * self.scalar
+
+
+class Exp(Module):
+    def apply(self, params, x, ctx):
+        return jnp.exp(x)
+
+
+class Log(Module):
+    def apply(self, params, x, ctx):
+        return jnp.log(x)
+
+
+class Log1p(Module):
+    def apply(self, params, x, ctx):
+        return jnp.log1p(x)
+
+
+class Sqrt(Module):
+    def apply(self, params, x, ctx):
+        return jnp.sqrt(x)
+
+
+class Square(Module):
+    def apply(self, params, x, ctx):
+        return x * x
+
+
+class Power(Module):
+    """(shift + scale * x)^power (nn/Power.scala)."""
+
+    def __init__(self, power, scale=1.0, shift=0.0, name=None):
+        super().__init__(name=name)
+        self.power = power
+        self.scale = scale
+        self.shift = shift
+
+    def apply(self, params, x, ctx):
+        return (self.shift + self.scale * x) ** self.power
+
+
+class Highway(Module):
+    """Highway network layer: t*g(Wx) + (1-t)*x (nn/Highway.scala)."""
+
+    def __init__(self, size, with_bias=True, activation=None,
+                 w_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(name=name)
+        from .linear import Linear
+        from .activation import Tanh
+        self.size = size
+        self.gate = Linear(size, size, with_bias=with_bias,
+                           w_regularizer=w_regularizer,
+                           b_regularizer=b_regularizer,
+                           name=f"{self.name}_gate")
+        self.transform = Linear(size, size, with_bias=with_bias,
+                                w_regularizer=w_regularizer,
+                                b_regularizer=b_regularizer,
+                                name=f"{self.name}_transform")
+        self.activation = activation or Tanh(name=f"{self.name}_act")
+
+    def children(self):
+        return [self.gate, self.transform, self.activation]
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        p = {}
+        p.update(self.gate.init(k1))
+        p.update(self.transform.init(k2))
+        return p
+
+    def apply(self, params, x, ctx):
+        t = jax.nn.sigmoid(self.gate.apply(params, x, ctx))
+        h = self.activation.apply(params, self.transform.apply(params, x, ctx),
+                                  ctx)
+        return t * h + (1.0 - t) * x
+
+
+class Scale(Module):
+    """CMul then CAdd with broadcastable size (nn/Scale.scala)."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name=name)
+        from .linear import CMul, CAdd
+        self.cmul = CMul(size, name=f"{self.name}_mul")
+        self.cadd = CAdd(size, name=f"{self.name}_add")
+
+    def children(self):
+        return [self.cmul, self.cadd]
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        p = {}
+        p.update(self.cmul.init(k1))
+        p.update(self.cadd.init(k2))
+        return p
+
+    def apply(self, params, x, ctx):
+        return self.cadd.apply(params, self.cmul.apply(params, x, ctx), ctx)
+
+
+class L1Penalty(Module):
+    """Identity forward; adds l1weight * |x| to the loss via ctx side losses
+    (nn/L1Penalty.scala — reference adds the penalty in the backward pass;
+    here it's an explicit side loss consumed by the Optimizer)."""
+
+    def __init__(self, l1weight, size_average=False, provide_output=True,
+                 name=None):
+        super().__init__(name=name)
+        self.l1weight = l1weight
+        self.size_average = size_average
+
+    def apply(self, params, x, ctx):
+        pen = jnp.sum(jnp.abs(x))
+        if self.size_average:
+            pen = pen / x.size
+        ctx.add_loss(self.l1weight * pen)
+        return x
+
+
+class ActivityRegularization(Module):
+    """l1/l2 activity penalty as a side loss (nn/ActivityRegularization.scala)."""
+
+    def __init__(self, l1=0.0, l2=0.0, name=None):
+        super().__init__(name=name)
+        self.l1 = l1
+        self.l2 = l2
+
+    def apply(self, params, x, ctx):
+        pen = self.l1 * jnp.sum(jnp.abs(x)) + self.l2 * jnp.sum(x * x)
+        ctx.add_loss(pen)
+        return x
+
+
+class NegativeEntropyPenalty(Module):
+    """Penalize -H(p) to encourage exploration (nn/NegativeEntropyPenalty.scala)."""
+
+    def __init__(self, beta=0.01, name=None):
+        super().__init__(name=name)
+        self.beta = beta
+
+    def apply(self, params, x, ctx):
+        ent = -jnp.sum(x * jnp.log(jnp.maximum(x, 1e-8)))
+        ctx.add_loss(-self.beta * ent)
+        return x

@@ -1,0 +1,134 @@
+"""Graph container (≙ nn/Graph.scala, StaticGraph.scala, Input.scala,
+utils/DirectedGraph.scala).
+
+Usage mirrors the reference:
+
+    inp = Input()
+    fc1 = Linear(10, 20).inputs(inp)
+    out = ReLU().inputs(fc1)
+    model = Graph(inp, out)
+
+``Module.inputs(*nodes)`` wraps the module in a :class:`Node` and records the
+edges.  ``Graph.apply`` evaluates nodes in topological order at trace time —
+XLA sees one static graph (the reference's DynamicGraph scheduler is
+unnecessary: control flow inside jit must be static anyway, and
+``lax.cond``-style dynamic routing is exposed via nn.ops instead).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+
+from .module import Module
+from ..utils.table import Table, as_list
+
+
+class Node:
+    def __init__(self, module: Optional[Module], prev_nodes: List["Node"]):
+        self.module = module
+        self.prev_nodes = list(prev_nodes)
+
+    @property
+    def name(self):
+        return self.module.name if self.module else "input"
+
+    def __repr__(self):
+        return f"Node({self.name})"
+
+
+def Input(name=None):
+    """Placeholder node (nn/Input.scala)."""
+    return Node(None, [])
+
+
+def _inputs(self, *nodes):
+    flat = []
+    for n in nodes:
+        if isinstance(n, (list, tuple)):
+            flat.extend(n)
+        else:
+            flat.append(n)
+    return Node(self, flat)
+
+
+# attach to Module so every layer supports the reference's `.inputs(...)` API
+Module.inputs = _inputs
+
+
+class Graph(Module):
+    """Static DAG of modules (nn/StaticGraph.scala)."""
+
+    def __init__(self, input, output, name=None):
+        super().__init__(name=name)
+        self.input_nodes = input if isinstance(input, (list, tuple)) else [input]
+        self.output_nodes = output if isinstance(output, (list, tuple)) else [output]
+        self._topo = self._topsort()
+
+    def _topsort(self):
+        order, seen, visiting = [], set(), set()
+
+        def visit(n):
+            if id(n) in seen:
+                return
+            if id(n) in visiting:
+                raise ValueError("Graph contains a cycle")
+            visiting.add(id(n))
+            for p in n.prev_nodes:
+                visit(p)
+            visiting.discard(id(n))
+            seen.add(id(n))
+            order.append(n)
+
+        for out in self.output_nodes:
+            visit(out)
+        return order
+
+    def children(self):
+        return [n.module for n in self._topo if n.module is not None]
+
+    def init(self, rng):
+        params = {}
+        for i, m in enumerate(self.children()):
+            params.update(m.init(jax.random.fold_in(rng, i)))
+        return params
+
+    def initial_state(self):
+        state = {}
+        for m in self.children():
+            state.update(m.initial_state())
+        return state
+
+    def apply(self, params, x, ctx):
+        xs = as_list(x)
+        if len(xs) != len(self.input_nodes):
+            if len(self.input_nodes) == 1:
+                xs = [x]
+            else:
+                raise ValueError(
+                    f"Graph expects {len(self.input_nodes)} inputs, got {len(xs)}")
+        values = {}
+        for node, v in zip(self.input_nodes, xs):
+            values[id(node)] = v
+        for node in self._topo:
+            if id(node) in values:
+                continue
+            if node.module is None:
+                raise ValueError("unbound Input node")
+            ins = [values[id(p)] for p in node.prev_nodes]
+            arg = ins[0] if len(ins) == 1 else Table(*ins)
+            values[id(node)] = node.module.apply(params, arg, ctx)
+        outs = [values[id(n)] for n in self.output_nodes]
+        return outs[0] if len(outs) == 1 else Table(*outs)
+
+    def node(self, name):
+        for n in self._topo:
+            if n.module is not None and n.module.name == name:
+                return n
+        raise KeyError(name)
+
+
+# DynamicGraph in the reference executes nodes lazily with a scheduler
+# (nn/DynamicGraph.scala) to support data-dependent control ops.  Under XLA
+# all control flow is compiled, so DynamicGraph is the same static evaluation.
+DynamicGraph = Graph

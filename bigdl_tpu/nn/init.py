@@ -1,0 +1,102 @@
+"""Weight initialization methods (≙ nn/InitializationMethod.scala).
+
+Each init method is a callable ``(rng, shape, fan_in, fan_out) -> array``.
+Layers consult ``module.weight_init`` / ``module.bias_init`` overrides set via
+``set_init_method`` and otherwise use their reference default.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class InitializationMethod:
+    def __call__(self, rng, shape, fan_in, fan_out):
+        raise NotImplementedError
+
+
+class Zeros(InitializationMethod):
+    def __call__(self, rng, shape, fan_in, fan_out):
+        return jnp.zeros(shape, jnp.float32)
+
+
+class Ones(InitializationMethod):
+    def __call__(self, rng, shape, fan_in, fan_out):
+        return jnp.ones(shape, jnp.float32)
+
+
+class ConstInit(InitializationMethod):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, rng, shape, fan_in, fan_out):
+        return jnp.full(shape, self.value, jnp.float32)
+
+
+class RandomUniform(InitializationMethod):
+    """U(lower, upper); parameterless variant uses +/- 1/sqrt(fan_in)."""
+
+    def __init__(self, lower=None, upper=None):
+        self.lower, self.upper = lower, upper
+
+    def __call__(self, rng, shape, fan_in, fan_out):
+        if self.lower is None:
+            bound = 1.0 / np.sqrt(max(fan_in, 1))
+            lo, hi = -bound, bound
+        else:
+            lo, hi = self.lower, self.upper
+        return jax.random.uniform(rng, shape, jnp.float32, lo, hi)
+
+
+class RandomNormal(InitializationMethod):
+    def __init__(self, mean=0.0, stdv=1.0):
+        self.mean, self.stdv = mean, stdv
+
+    def __call__(self, rng, shape, fan_in, fan_out):
+        return self.mean + self.stdv * jax.random.normal(rng, shape, jnp.float32)
+
+
+class Xavier(InitializationMethod):
+    """Glorot uniform: U(+/- sqrt(6/(fan_in+fan_out))) — reference default
+    for Linear/SpatialConvolution (InitializationMethod.scala:138)."""
+
+    def __call__(self, rng, shape, fan_in, fan_out):
+        bound = np.sqrt(6.0 / max(fan_in + fan_out, 1))
+        return jax.random.uniform(rng, shape, jnp.float32, -bound, bound)
+
+
+class MsraFiller(InitializationMethod):
+    """Kaiming/He init (InitializationMethod.scala:182)."""
+
+    def __init__(self, var_in_count=True):
+        self.var_in_count = var_in_count
+
+    def __call__(self, rng, shape, fan_in, fan_out):
+        n = fan_in if self.var_in_count else fan_out
+        std = np.sqrt(2.0 / max(n, 1))
+        return std * jax.random.normal(rng, shape, jnp.float32)
+
+
+class BilinearFiller(InitializationMethod):
+    """Bilinear upsampling weights for transposed conv (InitializationMethod.scala:215).
+
+    Expects shape (..., kh, kw); fills each kh x kw slice with the bilinear kernel.
+    """
+
+    def __call__(self, rng, shape, fan_in, fan_out):
+        kh, kw = shape[-2], shape[-1]
+        f_h, f_w = np.ceil(kh / 2.0), np.ceil(kw / 2.0)
+        c_h, c_w = (2 * f_h - 1 - f_h % 2) / (2.0 * f_h), (2 * f_w - 1 - f_w % 2) / (2.0 * f_w)
+        ys = np.arange(kh)[:, None]
+        xs = np.arange(kw)[None, :]
+        kern = (1 - np.abs(ys / f_h - c_h)) * (1 - np.abs(xs / f_w - c_w))
+        out = np.broadcast_to(kern, shape).astype(np.float32)
+        return jnp.asarray(out)
+
+
+def init_tensor(module, rng, shape, fan_in, fan_out, default, kind="weight"):
+    """Pick the override (if set via set_init_method) or the layer default."""
+    override = module.weight_init if kind == "weight" else module.bias_init
+    method = override if override is not None else default
+    return method(rng, shape, fan_in, fan_out)

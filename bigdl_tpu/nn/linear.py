@@ -1,0 +1,228 @@
+"""Dense / parameterized elementwise layers.
+
+Reference files: nn/Linear.scala, Bilinear.scala, CMul.scala, CAdd.scala,
+Add.scala, Mul.scala, Cosine.scala, Euclidean.scala, LookupTable.scala.
+
+All matmuls go through jnp.dot / einsum so XLA tiles them onto the MXU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .module import Module
+from .init import Xavier, RandomUniform, Zeros, init_tensor
+from ..utils.table import as_list
+
+
+class Linear(Module):
+    """y = x @ W^T + b; weight shape (out, in) as in nn/Linear.scala."""
+
+    def __init__(self, input_size, output_size, with_bias=True,
+                 w_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        w = init_tensor(self, k1, (self.output_size, self.input_size),
+                        self.input_size, self.output_size, Xavier())
+        p = {"weight": w}
+        if self.with_bias:
+            p["bias"] = init_tensor(self, k2, (self.output_size,),
+                                    self.input_size, self.output_size,
+                                    Zeros(), kind="bias")
+        return {self.name: p}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        y = jnp.dot(x, p["weight"].T.astype(x.dtype))
+        if self.with_bias:
+            y = y + p["bias"].astype(x.dtype)
+        return y
+
+
+class Bilinear(Module):
+    """y_k = x1 @ W_k @ x2 + b_k over a table input {x1, x2} (nn/Bilinear.scala)."""
+
+    def __init__(self, input_size1, input_size2, output_size, bias_res=True,
+                 w_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.input_size1 = input_size1
+        self.input_size2 = input_size2
+        self.output_size = output_size
+        self.bias_res = bias_res
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in = self.input_size1 * self.input_size2
+        w = init_tensor(self, k1,
+                        (self.output_size, self.input_size1, self.input_size2),
+                        fan_in, self.output_size, RandomUniform())
+        p = {"weight": w}
+        if self.bias_res:
+            p["bias"] = init_tensor(self, k2, (self.output_size,),
+                                    fan_in, self.output_size,
+                                    RandomUniform(), kind="bias")
+        return {self.name: p}
+
+    def apply(self, params, x, ctx):
+        x1, x2 = as_list(x)
+        p = self.own(params)
+        w = p["weight"].astype(x1.dtype)
+        y = jnp.einsum("bi,oij,bj->bo", x1, w, x2)
+        if self.bias_res:
+            y = y + p["bias"].astype(x1.dtype)
+        return y
+
+
+class CMul(Module):
+    """Componentwise multiply by a learned tensor, broadcasting (nn/CMul.scala)."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+
+    def init(self, rng):
+        n = int(np.prod(self.size))
+        w = init_tensor(self, rng, self.size, n, n, RandomUniform())
+        return {self.name: {"weight": w}}
+
+    def apply(self, params, x, ctx):
+        w = self.own(params)["weight"].astype(x.dtype)
+        return x * w
+
+
+class CAdd(Module):
+    """Componentwise add of a learned tensor, broadcasting (nn/CAdd.scala)."""
+
+    def __init__(self, size, b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        n = int(np.prod(self.size))
+        b = init_tensor(self, rng, self.size, n, n, RandomUniform(), kind="bias")
+        return {self.name: {"bias": b}}
+
+    def apply(self, params, x, ctx):
+        return x + self.own(params)["bias"].astype(x.dtype)
+
+
+class Add(Module):
+    """Learned per-feature bias vector (nn/Add.scala)."""
+
+    def __init__(self, input_size, name=None):
+        super().__init__(name=name)
+        self.input_size = input_size
+
+    def init(self, rng):
+        b = init_tensor(self, rng, (self.input_size,), self.input_size,
+                        self.input_size, RandomUniform(), kind="bias")
+        return {self.name: {"bias": b}}
+
+    def apply(self, params, x, ctx):
+        return x + self.own(params)["bias"].astype(x.dtype)
+
+
+class Mul(Module):
+    """Single learned scalar gain (nn/Mul.scala)."""
+
+    def init(self, rng):
+        w = init_tensor(self, rng, (1,), 1, 1, RandomUniform())
+        return {self.name: {"weight": w}}
+
+    def apply(self, params, x, ctx):
+        return x * self.own(params)["weight"].astype(x.dtype)
+
+
+class Cosine(Module):
+    """Cosine similarity of the input with each of `output_size` learned
+    weight rows (nn/Cosine.scala)."""
+
+    def __init__(self, input_size, output_size, name=None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.output_size = output_size
+
+    def init(self, rng):
+        w = init_tensor(self, rng, (self.output_size, self.input_size),
+                        self.input_size, self.output_size, RandomUniform())
+        return {self.name: {"weight": w}}
+
+    def apply(self, params, x, ctx):
+        w = self.own(params)["weight"].astype(x.dtype)
+        xn = x / jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), 1e-12)
+        wn = w / jnp.maximum(jnp.linalg.norm(w, axis=-1, keepdims=True), 1e-12)
+        return jnp.dot(xn, wn.T)
+
+
+class Euclidean(Module):
+    """Euclidean distance of the input to `output_size` learned centers
+    (nn/Euclidean.scala). Weight shape (in, out) as in the reference."""
+
+    def __init__(self, input_size, output_size, fast_backward=True, name=None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.output_size = output_size
+
+    def init(self, rng):
+        w = init_tensor(self, rng, (self.input_size, self.output_size),
+                        self.input_size, self.output_size, RandomUniform())
+        return {self.name: {"weight": w}}
+
+    def apply(self, params, x, ctx):
+        w = self.own(params)["weight"].astype(x.dtype)
+        diff = x[..., :, None] - w[None, :, :]
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-2) + 1e-12)
+
+
+class LookupTable(Module):
+    """Embedding lookup (nn/LookupTable.scala). Indices are 1-based (Torch
+    convention); `padding_value` rows embed to zero when masked.
+
+    On TPU this is a one-gather op; max_norm renormalization is applied
+    functionally to the gathered rows (reference renorms in-place pre-lookup,
+    same result for the looked-up rows).
+    """
+
+    def __init__(self, n_index, n_output, padding_value=0.0,
+                 max_norm=None, norm_type=2.0, w_regularizer=None,
+                 mask_zero=False, name=None):
+        super().__init__(name=name)
+        self.n_index = n_index
+        self.n_output = n_output
+        self.padding_value = padding_value
+        self.max_norm = max_norm
+        self.norm_type = norm_type
+        self.w_regularizer = w_regularizer
+        self.mask_zero = mask_zero
+
+    def init(self, rng):
+        from .init import RandomNormal
+        w = init_tensor(self, rng, (self.n_index, self.n_output),
+                        self.n_index, self.n_output, RandomNormal(0, 1))
+        return {self.name: {"weight": w}}
+
+    def apply(self, params, x, ctx):
+        w = self.own(params)["weight"]
+        idx = x.astype(jnp.int32) - 1  # 1-based -> 0-based
+        idx_c = jnp.clip(idx, 0, self.n_index - 1)
+        out = jnp.take(w, idx_c, axis=0)
+        if self.max_norm is not None:
+            norms = jnp.linalg.norm(out, ord=self.norm_type, axis=-1,
+                                    keepdims=True)
+            scale = jnp.minimum(1.0, self.max_norm / jnp.maximum(norms, 1e-7))
+            out = out * scale
+        if self.mask_zero and self.padding_value is not None:
+            mask = (x.astype(jnp.int32) != int(self.padding_value))
+            out = out * mask[..., None].astype(out.dtype)
+        return out

@@ -1,0 +1,363 @@
+"""Core module abstraction.
+
+Reference: nn/abstractnn/AbstractModule.scala — stateful Torch-style modules
+with hand-written ``updateOutput`` / ``updateGradInput`` / ``accGradParameters``.
+
+TPU-native redesign: every module is a *functional core* plus a *Torch shell*.
+
+Functional core (what XLA sees):
+  - ``init(rng) -> params``: build this module's (and children's) parameters
+    as a flat dict keyed by globally-unique module name -> {'weight': ..., ...}.
+  - ``apply(params, x, ctx) -> y``: pure function of the full flat param dict
+    and the input activity.  Mutable extras (batch-norm running stats, dropout
+    RNG) ride on ``ctx``: persistent state is read from ``ctx.state`` and
+    written to ``ctx.new_state``; per-module RNG keys are derived by folding
+    the module's uid into ``ctx.rng_key``.  Because state flows through the
+    ctx dicts (trace-time python mutation of traced values), the whole model —
+    containers included — stays a pure, jittable function
+    ``(params, state, rng, x) -> (y, new_state)`` via :meth:`run`.
+
+Torch shell (API parity with the reference):
+  - ``forward(x)`` lazily initializes parameters and caches ``self.output``.
+  - ``backward(x, grad_output)`` uses ``jax.vjp`` w.r.t. (params, input),
+    accumulating into ``self.grad_params`` and returning ``grad_input`` —
+    replacing the reference's hand-written backward passes with JAX AD.
+
+There is no hand-scheduled kernel work here: convs/matmuls lower to the MXU
+through ``lax``; XLA fuses the elementwise neighbourhoods.
+"""
+from __future__ import annotations
+
+import itertools
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_uid_counter = itertools.count()
+
+
+def _fresh_uid():
+    return next(_uid_counter)
+
+
+class Ctx:
+    """Per-call context threaded through ``apply``.
+
+    Carries the training flag, the base RNG key, persistent state in/out
+    dicts, and a scratch list for side losses (e.g. ActivityRegularization).
+    """
+
+    __slots__ = ("training", "rng_key", "state", "new_state", "side_losses")
+
+    def __init__(self, state=None, training=False, rng_key=None):
+        self.training = training
+        self.rng_key = rng_key
+        self.state = state or {}
+        self.new_state: Dict[str, Any] = {}
+        self.side_losses = []
+
+    def rng(self, module) -> jax.Array:
+        if self.rng_key is None:
+            raise ValueError(
+                f"{module.name}: this module needs an RNG key in training mode; "
+                "pass rng= to run()/forward()")
+        return jax.random.fold_in(self.rng_key, module._uid % (2 ** 31))
+
+    def get_state(self, module):
+        return self.state.get(module.name)
+
+    def put_state(self, module, value):
+        self.new_state[module.name] = value
+
+    def add_loss(self, value):
+        self.side_losses.append(value)
+
+
+class Module:
+    """Base class of all layers and containers."""
+
+    def __init__(self, name: Optional[str] = None):
+        self._uid = _fresh_uid()
+        self.name = name or f"{type(self).__name__}_{self._uid}"
+        # Torch-shell mutable state
+        self.output = None
+        self.grad_input = None
+        self._params: Optional[Dict[str, Any]] = None
+        self._state: Dict[str, Any] = {}
+        self.grad_params: Optional[Dict[str, Any]] = None
+        self.train_mode = False
+        self._forward_rng = np.random.randint(0, 2 ** 31)
+        # init-method overrides (nn/abstractnn/Initializable.scala)
+        self.weight_init = None
+        self.bias_init = None
+        # per-layer regularizers (optim/Regularizer.scala)
+        self.w_regularizer = None
+        self.b_regularizer = None
+        self.scale_w = 1.0  # gradient scale factors (AbstractModule.setScaleW)
+        self.scale_b = 1.0
+
+    # ------------------------------------------------------------------ #
+    # functional core — subclasses override these two                    #
+    # ------------------------------------------------------------------ #
+    def init(self, rng) -> Dict[str, Any]:
+        """Return the flat params dict for this module (and children)."""
+        return {}
+
+    def apply(self, params: Dict[str, Any], x, ctx: Ctx):
+        """Pure forward. Subclasses must implement."""
+        raise NotImplementedError(type(self).__name__)
+
+    def initial_state(self) -> Dict[str, Any]:
+        """Flat dict of persistent non-trainable state (e.g. BN stats)."""
+        return {}
+
+    # convenience for leaf layers
+    def own(self, params):
+        return params.get(self.name, {})
+
+    # ------------------------------------------------------------------ #
+    # functional entry point                                             #
+    # ------------------------------------------------------------------ #
+    def run(self, params, x, state=None, training=False, rng=None):
+        """(params, x[, state, rng]) -> (y, new_state). Pure; safe under jit."""
+        ctx = Ctx(state=state, training=training, rng_key=rng)
+        y = self.apply(params, x, ctx)
+        out_state = dict(state or {})
+        out_state.update(ctx.new_state)
+        return y, out_state
+
+    def init_params(self, seed: int = 0):
+        """Initialize and return (params, state)."""
+        rng = jax.random.PRNGKey(seed)
+        return self.init(rng), self.initial_state()
+
+    # ------------------------------------------------------------------ #
+    # Torch shell — API parity with the reference AbstractModule         #
+    # ------------------------------------------------------------------ #
+    def ensure_initialized(self, seed: int = 0):
+        if self._params is None:
+            self._params, self._state = self.init_params(seed)
+        return self._params
+
+    @property
+    def parameters_(self):
+        return self.ensure_initialized()
+
+    def forward(self, x, rng=None):
+        self.ensure_initialized()
+        if rng is None:
+            self._forward_rng += 1
+            rng = jax.random.PRNGKey(self._forward_rng)
+        self._last_rng = rng  # backward must replay the same stochastic pass
+        y, new_state = self.run(self._params, x, state=self._state,
+                                training=self.train_mode, rng=rng)
+        if self.train_mode:
+            self._state = new_state
+        self.output = y
+        return y
+
+    def __call__(self, x, rng=None):
+        return self.forward(x, rng=rng)
+
+    def backward(self, x, grad_output, rng=None):
+        """grad_input via jax.vjp; accumulates param grads into grad_params."""
+        self.ensure_initialized()
+        if rng is None:
+            rng = getattr(self, "_last_rng", None)
+            if rng is None:
+                rng = jax.random.PRNGKey(self._forward_rng)
+
+        def f(params, inp):
+            y, _ = self.run(params, inp, state=self._state,
+                            training=self.train_mode, rng=rng)
+            return y
+
+        y, vjp_fn = jax.vjp(f, self._params, x)
+        gparams, ginput = vjp_fn(grad_output)
+        if self.grad_params is None:
+            self.grad_params = gparams
+        else:
+            self.grad_params = jax.tree_util.tree_map(
+                jnp.add, self.grad_params, gparams)
+        self.grad_input = ginput
+        self.output = y
+        return ginput
+
+    def update_output(self, x):
+        return self.forward(x)
+
+    def update_grad_input(self, x, grad_output):
+        return self.backward(x, grad_output)
+
+    def zero_grad_parameters(self):
+        self.grad_params = None
+
+    def get_parameters(self):
+        """Return (params, grad_params) flat dicts (≙ reference getParameters)."""
+        self.ensure_initialized()
+        if self.grad_params is None:
+            self.grad_params = jax.tree_util.tree_map(
+                jnp.zeros_like, self._params)
+        return self._params, self.grad_params
+
+    def set_params(self, params, state=None):
+        self._params = params
+        if state is not None:
+            self._state = state
+        return self
+
+    def training(self):
+        self.train_mode = True
+        for m in self.children():
+            m.training()
+        return self
+
+    def evaluate(self):
+        self.train_mode = False
+        for m in self.children():
+            m.evaluate()
+        return self
+
+    def is_training(self):
+        return self.train_mode
+
+    # ------------------------------------------------------------------ #
+    # structure & introspection                                          #
+    # ------------------------------------------------------------------ #
+    def children(self):
+        return []
+
+    def modules(self):
+        """Depth-first list of this module and all descendants."""
+        out = [self]
+        for c in self.children():
+            out.extend(c.modules())
+        return out
+
+    def named_modules(self):
+        return {m.name: m for m in self.modules()}
+
+    def set_name(self, name):
+        self.name = name
+        return self
+
+    def get_name(self):
+        return self.name
+
+    def set_init_method(self, weight_init=None, bias_init=None):
+        self.weight_init = weight_init
+        self.bias_init = bias_init
+        return self
+
+    def set_scale_w(self, s):
+        self.scale_w = s
+        return self
+
+    def set_scale_b(self, s):
+        self.scale_b = s
+        return self
+
+    def parameter_count(self):
+        params = self.ensure_initialized()
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree_util.tree_leaves(params))
+
+    def get_output_shape(self, input_shape, dtype=jnp.float32):
+        """Shape inference via jax.eval_shape (≙ nn/abstractnn/InferShape.scala)."""
+        params, state = self.init_params(0)
+        if isinstance(input_shape[0], (tuple, list)):
+            x = [jax.ShapeDtypeStruct(tuple(s), dtype) for s in input_shape]
+        else:
+            x = jax.ShapeDtypeStruct(tuple(input_shape), dtype)
+        out = jax.eval_shape(
+            lambda p, i: self.run(p, i, state=state,
+                                  rng=jax.random.PRNGKey(0))[0], params, x)
+        return jax.tree_util.tree_map(lambda s: s.shape, out)
+
+    # regularization support: collect per-layer penalties over a params dict
+    def regularization_loss(self, params):
+        loss = 0.0
+        for m in self.modules():
+            p = params.get(m.name)
+            if not p:
+                continue
+            if m.w_regularizer is not None and "weight" in p:
+                loss = loss + m.w_regularizer(p["weight"])
+            if m.b_regularizer is not None and "bias" in p:
+                loss = loss + m.b_regularizer(p["bias"])
+        return loss
+
+    # ------------------------------------------------------------------ #
+    # persistence (≙ AbstractModule.save / Module.load)                  #
+    # ------------------------------------------------------------------ #
+    def save(self, path, overwrite=True):
+        from ..utils import serializer
+        serializer.save_module(self, path, overwrite=overwrite)
+        return self
+
+    @staticmethod
+    def load(path):
+        from ..utils import serializer
+        return serializer.load_module(path)
+
+    def save_weights(self, path, overwrite=True):
+        params = self.ensure_initialized()
+        with open(path, "wb") as f:
+            pickle.dump((jax.tree_util.tree_map(np.asarray, params),
+                         jax.tree_util.tree_map(np.asarray, self._state)), f)
+        return self
+
+    def load_weights(self, path):
+        with open(path, "rb") as f:
+            params, state = pickle.load(f)
+        self._params = jax.tree_util.tree_map(jnp.asarray, params)
+        self._state = jax.tree_util.tree_map(jnp.asarray, state)
+        return self
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.name})"
+
+    # reference API aliases -------------------------------------------- #
+    def reset(self, seed: int = 0):
+        self._params, self._state = self.init_params(seed)
+        return self
+
+    def clear_state(self):
+        self.output = None
+        self.grad_input = None
+        return self
+
+
+class Criterion:
+    """Base loss (≙ nn/abstractnn/AbstractCriterion.scala).
+
+    Subclasses implement ``loss(output, target) -> scalar``.  ``forward``
+    caches the value; ``backward`` returns d loss / d output via JAX AD,
+    replacing the reference's hand-written updateGradInput.
+    """
+
+    def __init__(self, name: Optional[str] = None):
+        self._uid = _fresh_uid()
+        self.name = name or f"{type(self).__name__}_{self._uid}"
+        self.output = None
+        self.grad_input = None
+
+    def loss(self, output, target):
+        raise NotImplementedError
+
+    def forward(self, output, target):
+        self.output = self.loss(output, target)
+        return self.output
+
+    def __call__(self, output, target):
+        return self.forward(output, target)
+
+    def backward(self, output, target):
+        self.grad_input = jax.grad(lambda o: self.loss(o, target))(output)
+        return self.grad_input
+
+    def __repr__(self):
+        return f"{type(self).__name__}()"

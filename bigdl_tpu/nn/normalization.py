@@ -1,0 +1,298 @@
+"""Normalization layers.
+
+Reference files: nn/BatchNormalization.scala, SpatialBatchNormalization.scala,
+SpatialCrossMapLRN.scala, SpatialWithinChannelLRN.scala,
+SpatialDivisiveNormalization.scala, SpatialSubtractiveNormalization.scala,
+SpatialContrastiveNormalization.scala, Normalize.scala, NormalizeScale.scala.
+
+Batch-norm running stats live in the ctx state dicts (the functional state
+pytree), not in mutable fields — the whole train step stays pure/jittable.
+Under data parallelism the batch statistics are computed per shard exactly
+like the reference's per-partition BN; cross-replica sync-BN is available via
+``sync_axis`` (psum over the mesh axis).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .module import Module
+
+
+class BatchNormalization(Module):
+    """BN over (B, C) or (B, C, ...) with stats on all non-channel dims
+    (nn/BatchNormalization.scala — channel dim is 2nd, i.e. axis 1)."""
+
+    channel_axis = 1
+
+    def __init__(self, n_output, eps=1e-5, momentum=0.1, affine=True,
+                 sync_axis=None, name=None):
+        super().__init__(name=name)
+        self.n_output = n_output
+        self.eps = eps
+        self.momentum = momentum
+        self.affine = affine
+        self.sync_axis = sync_axis
+
+    def init(self, rng):
+        if not self.affine:
+            return {}
+        from .init import init_tensor, Ones, Zeros
+        k1, k2 = jax.random.split(rng)
+        return {self.name: {
+            "weight": init_tensor(self, k1, (self.n_output,), self.n_output,
+                                  self.n_output, Ones()),
+            "bias": init_tensor(self, k2, (self.n_output,), self.n_output,
+                                self.n_output, Zeros(), kind="bias"),
+        }}
+
+    def initial_state(self):
+        return {self.name: {
+            "running_mean": jnp.zeros((self.n_output,), jnp.float32),
+            "running_var": jnp.ones((self.n_output,), jnp.float32),
+        }}
+
+    def apply(self, params, x, ctx):
+        st = ctx.get_state(self)
+        axes = tuple(i for i in range(x.ndim) if i != self.channel_axis)
+        if ctx.training:
+            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
+            var = jnp.var(x.astype(jnp.float32), axis=axes)
+            if self.sync_axis is not None:
+                mean = lax.pmean(mean, self.sync_axis)
+                var = lax.pmean(var, self.sync_axis)
+            m = self.momentum
+            n = x.size // x.shape[self.channel_axis]
+            unbiased = var * n / max(n - 1, 1)
+            ctx.put_state(self, {
+                "running_mean": (1 - m) * st["running_mean"] + m * mean,
+                "running_var": (1 - m) * st["running_var"] + m * unbiased,
+            })
+        else:
+            mean, var = st["running_mean"], st["running_var"]
+        shape = [1] * x.ndim
+        shape[self.channel_axis] = x.shape[self.channel_axis]
+        inv = lax.rsqrt(var + self.eps)
+        scale, shift = inv, -mean * inv
+        if self.affine:
+            p = self.own(params)
+            scale = scale * p["weight"]
+            shift = shift * p["weight"] + p["bias"]
+        return (x * scale.reshape(shape).astype(x.dtype)
+                + shift.reshape(shape).astype(x.dtype))
+
+
+class SpatialBatchNormalization(BatchNormalization):
+    """nn/SpatialBatchNormalization.scala — BN over NCHW, per-channel."""
+
+
+class LayerNormalization(Module):
+    """Per-sample last-dim layer norm (TPU-era addition used by the
+    transformer flagship; reference's keras layer set has no LN)."""
+
+    def __init__(self, hidden_size, eps=1e-5, name=None):
+        super().__init__(name=name)
+        self.hidden_size = hidden_size
+        self.eps = eps
+
+    def init(self, rng):
+        return {self.name: {
+            "weight": jnp.ones((self.hidden_size,), jnp.float32),
+            "bias": jnp.zeros((self.hidden_size,), jnp.float32),
+        }}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + self.eps)
+        return (y * p["weight"] + p["bias"]).astype(x.dtype)
+
+
+class RMSNorm(Module):
+    """RMS norm (TPU-era addition for the transformer flagship)."""
+
+    def __init__(self, hidden_size, eps=1e-6, name=None):
+        super().__init__(name=name)
+        self.hidden_size = hidden_size
+        self.eps = eps
+
+    def init(self, rng):
+        return {self.name: {"weight": jnp.ones((self.hidden_size,), jnp.float32)}}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        xf = x.astype(jnp.float32)
+        y = xf * lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + self.eps)
+        return (y * p["weight"]).astype(x.dtype)
+
+
+class SpatialCrossMapLRN(Module):
+    """Across-channel local response normalization (nn/SpatialCrossMapLRN.scala):
+    y = x / (k + alpha/size * sum_{nearby channels} x^2)^beta.
+
+    Implemented as a reduce_window over the channel dim (no loops).
+    """
+
+    def __init__(self, size=5, alpha=1.0, beta=0.75, k=1.0, format="NCHW",
+                 name=None):
+        super().__init__(name=name)
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+        self.format = format
+
+    def apply(self, params, x, ctx):
+        c_ax = 1 if self.format == "NCHW" else 3
+        sq = x * x
+        window = [1] * x.ndim
+        window[c_ax] = self.size
+        lo = (self.size - 1) // 2
+        hi = self.size - 1 - lo
+        pads = [(0, 0)] * x.ndim
+        pads[c_ax] = (lo, hi)
+        s = lax.reduce_window(sq, 0.0, lax.add, tuple(window),
+                              (1,) * x.ndim, pads)
+        denom = (self.k + self.alpha / self.size * s) ** self.beta
+        return x / denom
+
+
+class SpatialWithinChannelLRN(Module):
+    """Within-channel LRN over a spatial window (nn/SpatialWithinChannelLRN.scala)."""
+
+    def __init__(self, size=5, alpha=1.0, beta=0.75, name=None):
+        super().__init__(name=name)
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+
+    def apply(self, params, x, ctx):
+        lo = (self.size - 1) // 2
+        hi = self.size - 1 - lo
+        s = lax.reduce_window(
+            x * x, 0.0, lax.add, (1, 1, self.size, self.size), (1, 1, 1, 1),
+            [(0, 0), (0, 0), (lo, hi), (lo, hi)])
+        denom = (1.0 + self.alpha / (self.size * self.size) * s) ** self.beta
+        return x / denom
+
+
+def _gaussian_kernel(size):
+    """The reference uses a provided or default gaussian kernel for the
+    *Normalization layers; default here is a normalized 2D gaussian."""
+    ax = np.arange(size) - (size - 1) / 2.0
+    sigma = size / 4.0
+    k1 = np.exp(-(ax ** 2) / (2 * sigma ** 2))
+    k2 = np.outer(k1, k1)
+    return jnp.asarray((k2 / k2.sum()).astype(np.float32))
+
+
+class SpatialSubtractiveNormalization(Module):
+    """Subtract a weighted local mean (nn/SpatialSubtractiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane=1, kernel=None, name=None):
+        super().__init__(name=name)
+        self.n_input_plane = n_input_plane
+        self.kernel = kernel if kernel is not None else _gaussian_kernel(9)
+
+    def _local_mean(self, x):
+        k = jnp.asarray(self.kernel, x.dtype)
+        if k.ndim == 1:
+            k = jnp.outer(k, k) / jnp.sum(k) ** 2
+        else:
+            k = k / jnp.sum(k)
+        kh, kw = k.shape
+        w = jnp.broadcast_to(k, (self.n_input_plane, 1, kh, kw))
+        pads = [((kh - 1) // 2, kh - 1 - (kh - 1) // 2),
+                ((kw - 1) // 2, kw - 1 - (kw - 1) // 2)]
+        mean = lax.conv_general_dilated(
+            x, w, (1, 1), pads,
+            feature_group_count=self.n_input_plane,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        mean = jnp.mean(mean, axis=1, keepdims=True)
+        # edge coefficient correction (reference divides by conv of ones)
+        ones = jnp.ones_like(x[:1, :1])
+        coef = lax.conv_general_dilated(
+            ones, jnp.broadcast_to(k, (1, 1, kh, kw)), (1, 1), pads,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return mean / coef
+
+    def apply(self, params, x, ctx):
+        return x - self._local_mean(x)
+
+
+class SpatialDivisiveNormalization(Module):
+    """Divide by local std estimate (nn/SpatialDivisiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane=1, kernel=None, threshold=1e-4,
+                 thresval=1e-4, name=None):
+        super().__init__(name=name)
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel,
+                                                   name=f"{self.name}_sub")
+        self.threshold = threshold
+        self.thresval = thresval
+
+    def apply(self, params, x, ctx):
+        local_sd = jnp.sqrt(jnp.maximum(self.sub._local_mean(x * x), 0.0))
+        mean_sd = jnp.mean(local_sd, axis=(1, 2, 3), keepdims=True)
+        denom = jnp.maximum(local_sd, mean_sd)
+        denom = jnp.where(denom > self.threshold, denom, self.thresval)
+        return x / denom
+
+
+class SpatialContrastiveNormalization(Module):
+    """Subtractive then divisive normalization
+    (nn/SpatialContrastiveNormalization.scala)."""
+
+    def __init__(self, n_input_plane=1, kernel=None, threshold=1e-4,
+                 thresval=1e-4, name=None):
+        super().__init__(name=name)
+        self.sub = SpatialSubtractiveNormalization(n_input_plane, kernel,
+                                                   name=f"{self.name}_s")
+        self.div = SpatialDivisiveNormalization(n_input_plane, kernel,
+                                                threshold, thresval,
+                                                name=f"{self.name}_d")
+
+    def apply(self, params, x, ctx):
+        return self.div.apply(params, self.sub.apply(params, x, ctx), ctx)
+
+
+class Normalize(Module):
+    """Lp-normalize over the feature dim (nn/Normalize.scala)."""
+
+    def __init__(self, p=2.0, eps=1e-10, name=None):
+        super().__init__(name=name)
+        self.p = p
+        self.eps = eps
+
+    def apply(self, params, x, ctx):
+        if np.isinf(self.p):
+            norm = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+        else:
+            norm = jnp.sum(jnp.abs(x) ** self.p, axis=1,
+                           keepdims=True) ** (1.0 / self.p)
+        return x / (norm + self.eps)
+
+
+class NormalizeScale(Module):
+    """L2-normalize then scale by a learned per-channel weight
+    (nn/NormalizeScale.scala, used by SSD)."""
+
+    def __init__(self, p=2.0, eps=1e-10, scale=1.0, size=None,
+                 w_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.norm = Normalize(p, eps, name=f"{self.name}_n")
+        self.scale = scale
+        self.size = tuple(size) if size is not None else None
+        self.w_regularizer = w_regularizer
+
+    def init(self, rng):
+        size = self.size or (1,)
+        return {self.name: {"weight": jnp.full(size, self.scale, jnp.float32)}}
+
+    def apply(self, params, x, ctx):
+        y = self.norm.apply(params, x, ctx)
+        return y * self.own(params)["weight"].astype(x.dtype)

@@ -1,0 +1,261 @@
+"""Pooling / resampling layers.
+
+Reference files: nn/SpatialMaxPooling.scala, SpatialAveragePooling.scala,
+VolumetricMaxPooling.scala, VolumetricAveragePooling.scala,
+TemporalMaxPooling.scala, UpSampling1D/2D/3D.scala, ResizeBilinear.scala.
+
+All pooling lowers to ``lax.reduce_window`` (vectorized on VPU); no
+hand-written index bookkeeping as in the reference NNPrimitive code.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from .module import Module
+
+
+def _pool_pads(in_size, k, s, pad, ceil_mode):
+    """Reference pooling geometry: out = floor_or_ceil((in + 2p - k)/s) + 1.
+
+    Returns (lo, hi) padding so reduce_window matches, padding with the
+    reduction identity (handled by caller via init value).
+    """
+    if pad == -1:  # SAME, reference keras-style
+        out = -(-in_size // s)
+        total = max(0, (out - 1) * s + k - in_size)
+        return total // 2, total - total // 2
+    if ceil_mode:
+        out = int(np.ceil((in_size + 2 * pad - k) / s)) + 1
+        # torch rule: last window must start inside the (padded) input
+        if (out - 1) * s >= in_size + pad:
+            out -= 1
+    else:
+        out = int(np.floor((in_size + 2 * pad - k) / s)) + 1
+    hi = max(0, (out - 1) * s + k - in_size - pad)
+    return pad, hi
+
+
+class SpatialMaxPooling(Module):
+    """nn/SpatialMaxPooling.scala; pad=-1 means SAME."""
+
+    def __init__(self, kw, kh, dw=None, dh=None, pad_w=0, pad_h=0,
+                 format="NCHW", ceil_mode=False, name=None):
+        super().__init__(name=name)
+        self.kernel = (kh, kw)
+        self.stride = (dh or kh, dw or kw)
+        self.pad = (pad_h, pad_w)
+        self.format = format
+        self.ceil_mode = ceil_mode
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def floor(self):
+        self.ceil_mode = False
+        return self
+
+    def apply(self, params, x, ctx):
+        nchw = self.format == "NCHW"
+        hs = x.shape[2:4] if nchw else x.shape[1:3]
+        pads = [_pool_pads(hs[i], self.kernel[i], self.stride[i], self.pad[i],
+                           self.ceil_mode) for i in range(2)]
+        if nchw:
+            window = (1, 1) + self.kernel
+            strides = (1, 1) + self.stride
+            padding = [(0, 0), (0, 0)] + pads
+        else:
+            window = (1,) + self.kernel + (1,)
+            strides = (1,) + self.stride + (1,)
+            padding = [(0, 0)] + pads + [(0, 0)]
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, padding)
+
+
+class SpatialAveragePooling(Module):
+    """nn/SpatialAveragePooling.scala. count_include_pad matches torch
+    semantics; global_pooling pools the whole plane."""
+
+    def __init__(self, kw, kh, dw=1, dh=1, pad_w=0, pad_h=0,
+                 global_pooling=False, ceil_mode=False,
+                 count_include_pad=True, divide=True, format="NCHW",
+                 name=None):
+        super().__init__(name=name)
+        self.kernel = (kh, kw)
+        self.stride = (dh, dw)
+        self.pad = (pad_h, pad_w)
+        self.global_pooling = global_pooling
+        self.ceil_mode = ceil_mode
+        self.count_include_pad = count_include_pad
+        self.divide = divide
+        self.format = format
+
+    def ceil(self):
+        self.ceil_mode = True
+        return self
+
+    def apply(self, params, x, ctx):
+        nchw = self.format == "NCHW"
+        hs = x.shape[2:4] if nchw else x.shape[1:3]
+        kernel = tuple(hs) if self.global_pooling else self.kernel
+        stride = (1, 1) if self.global_pooling else self.stride
+        pads = [(0, 0), (0, 0)] if self.global_pooling else \
+            [_pool_pads(hs[i], kernel[i], stride[i], self.pad[i],
+                        self.ceil_mode) for i in range(2)]
+        if nchw:
+            window = (1, 1) + tuple(kernel)
+            strides = (1, 1) + tuple(stride)
+            padding = [(0, 0), (0, 0)] + pads
+        else:
+            window = (1,) + tuple(kernel) + (1,)
+            strides = (1,) + tuple(stride) + (1,)
+            padding = [(0, 0)] + pads + [(0, 0)]
+        s = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if not self.divide:
+            return s
+        if self.count_include_pad:
+            return s / float(np.prod(kernel))
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return s / counts
+
+
+class VolumetricMaxPooling(Module):
+    """nn/VolumetricMaxPooling.scala over (B, C, D, H, W)."""
+
+    def __init__(self, k_t, k_w, k_h, d_t=None, d_w=None, d_h=None,
+                 pad_t=0, pad_w=0, pad_h=0, name=None):
+        super().__init__(name=name)
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t or k_t, d_h or k_h, d_w or k_w)
+        self.pad = (pad_t, pad_h, pad_w)
+
+    def apply(self, params, x, ctx):
+        pads = [_pool_pads(x.shape[2 + i], self.kernel[i], self.stride[i],
+                           self.pad[i], False) for i in range(3)]
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, 1) + self.kernel, (1, 1) + self.stride,
+            [(0, 0), (0, 0)] + pads)
+
+
+class VolumetricAveragePooling(Module):
+    """nn/VolumetricAveragePooling.scala."""
+
+    def __init__(self, k_t, k_w, k_h, d_t=None, d_w=None, d_h=None,
+                 pad_t=0, pad_w=0, pad_h=0, count_include_pad=True,
+                 ceil_mode=False, name=None):
+        super().__init__(name=name)
+        self.kernel = (k_t, k_h, k_w)
+        self.stride = (d_t or k_t, d_h or k_h, d_w or k_w)
+        self.pad = (pad_t, pad_h, pad_w)
+        self.count_include_pad = count_include_pad
+        self.ceil_mode = ceil_mode
+
+    def apply(self, params, x, ctx):
+        pads = [_pool_pads(x.shape[2 + i], self.kernel[i], self.stride[i],
+                           self.pad[i], self.ceil_mode) for i in range(3)]
+        s = lax.reduce_window(
+            x, 0.0, lax.add, (1, 1) + self.kernel, (1, 1) + self.stride,
+            [(0, 0), (0, 0)] + pads)
+        if self.count_include_pad:
+            return s / float(np.prod(self.kernel))
+        counts = lax.reduce_window(
+            jnp.ones_like(x), 0.0, lax.add, (1, 1) + self.kernel,
+            (1, 1) + self.stride, [(0, 0), (0, 0)] + pads)
+        return s / counts
+
+
+class TemporalMaxPooling(Module):
+    """nn/TemporalMaxPooling.scala over (B, T, C)."""
+
+    def __init__(self, k_w, d_w=None, name=None):
+        super().__init__(name=name)
+        self.k_w = k_w
+        self.d_w = d_w or k_w
+
+    def apply(self, params, x, ctx):
+        return lax.reduce_window(
+            x, -jnp.inf, lax.max, (1, self.k_w, 1), (1, self.d_w, 1),
+            [(0, 0), (0, 0), (0, 0)])
+
+
+class UpSampling1D(Module):
+    """Repeat each timestep `length` times (nn/UpSampling1D.scala); (B,T,C)."""
+
+    def __init__(self, length, name=None):
+        super().__init__(name=name)
+        self.length = length
+
+    def apply(self, params, x, ctx):
+        return jnp.repeat(x, self.length, axis=1)
+
+
+class UpSampling2D(Module):
+    """Nearest-neighbour upsample (nn/UpSampling2D.scala), NCHW."""
+
+    def __init__(self, size, format="NCHW", name=None):
+        super().__init__(name=name)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.format = format
+
+    def apply(self, params, x, ctx):
+        h_ax, w_ax = (2, 3) if self.format == "NCHW" else (1, 2)
+        x = jnp.repeat(x, self.size[0], axis=h_ax)
+        return jnp.repeat(x, self.size[1], axis=w_ax)
+
+
+class UpSampling3D(Module):
+    """nn/UpSampling3D.scala, NCDHW."""
+
+    def __init__(self, size, name=None):
+        super().__init__(name=name)
+        self.size = (size, size, size) if isinstance(size, int) else tuple(size)
+
+    def apply(self, params, x, ctx):
+        for i, s in enumerate(self.size):
+            x = jnp.repeat(x, s, axis=2 + i)
+        return x
+
+
+class ResizeBilinear(Module):
+    """Bilinear resize (nn/ResizeBilinear.scala), NCHW or NHWC input."""
+
+    def __init__(self, output_height, output_width, align_corners=False,
+                 data_format="NCHW", name=None):
+        super().__init__(name=name)
+        self.out_hw = (output_height, output_width)
+        self.align_corners = align_corners
+        self.format = data_format
+
+    def apply(self, params, x, ctx):
+        import jax.image
+        nchw = self.format == "NCHW"
+        if nchw:
+            shape = x.shape[:2] + self.out_hw
+        else:
+            shape = (x.shape[0],) + self.out_hw + (x.shape[3],)
+        # jax.image.resize implements half-pixel-centers (align_corners=False)
+        if not self.align_corners:
+            return jax.image.resize(x, shape, method="bilinear")
+        h_ax, w_ax = (2, 3) if nchw else (1, 2)
+        in_h, in_w = x.shape[h_ax], x.shape[w_ax]
+        oh, ow = self.out_hw
+        ys = jnp.linspace(0, in_h - 1, oh)
+        xs = jnp.linspace(0, in_w - 1, ow)
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, in_h - 1)
+        y1 = jnp.clip(y0 + 1, 0, in_h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, in_w - 1)
+        x1 = jnp.clip(x0 + 1, 0, in_w - 1)
+        wy = (ys - y0).reshape(-1, 1)
+        wx = (xs - x0).reshape(1, -1)
+        def gather(yi, xi):
+            g = jnp.take(x, yi, axis=h_ax)
+            return jnp.take(g, xi, axis=w_ax)
+        if nchw:
+            wy_b, wx_b = wy[None, None], wx[None, None]
+        else:
+            wy_b, wx_b = wy[None, :, :, None], wx[None, :, :, None]
+        top = gather(y0, x0) * (1 - wx_b) + gather(y0, x1) * wx_b
+        bot = gather(y1, x0) * (1 - wx_b) + gather(y1, x1) * wx_b
+        return top * (1 - wy_b) + bot * wy_b

@@ -1,0 +1,433 @@
+"""Recurrent layers.
+
+Reference files: nn/Cell.scala, RNN.scala (RnnCell), LSTM.scala,
+LSTMPeephole.scala, GRU.scala, ConvLSTMPeephole.scala, ConvLSTMPeephole3D.scala,
+MultiRNNCell.scala, Recurrent.scala, BiRecurrent.scala, RecurrentDecoder.scala,
+TimeDistributed.scala.
+
+TPU-first: the reference unrolls timesteps in a Scala while-loop over cloned
+cells; here ``Recurrent`` is one ``lax.scan`` over a single compiled cell step
+— one trace, weights shared by construction, full XLA fusion across the gate
+matmuls (which are batched into single MXU calls per step).
+
+Input layout is (B, T, ...) batch-first, matching the reference default.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .module import Module
+from .init import Xavier, Zeros, init_tensor
+from ..utils.table import Table, as_list
+
+
+class Cell(Module):
+    """Base RNN cell: step(params, x_t, hidden, ctx) -> (out_t, new_hidden);
+    ``zero_hidden(batch, dtype)`` builds the initial state pytree."""
+
+    def step(self, params, x, hidden, ctx):
+        raise NotImplementedError
+
+    def zero_hidden(self, batch_size, dtype=jnp.float32):
+        raise NotImplementedError
+
+    # a cell can be applied directly to a table {x, hidden} like the reference
+    def apply(self, params, x, ctx):
+        xs = as_list(x)
+        out, new_h = self.step(params, xs[0], xs[1], ctx)
+        return Table(out, new_h)
+
+
+def _gate_params(module, rng, input_size, hidden_size, n_gates):
+    """Fused gate weights: one (in+hid, n_gates*hid) matmul per step."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    wi = init_tensor(module, k1, (input_size, n_gates * hidden_size),
+                     input_size, n_gates * hidden_size, Xavier())
+    wh = init_tensor(module, k2, (hidden_size, n_gates * hidden_size),
+                     hidden_size, n_gates * hidden_size, Xavier())
+    b = init_tensor(module, k3, (n_gates * hidden_size,), input_size,
+                    n_gates * hidden_size, Zeros(), kind="bias")
+    return {"weight_i": wi, "weight_h": wh, "bias": b}
+
+
+class RnnCell(Cell):
+    """Vanilla RNN cell: h' = act(W_i x + W_h h + b) (nn/RNN.scala)."""
+
+    def __init__(self, input_size, hidden_size, activation=None,
+                 isInputWithBias=True, w_regularizer=None, u_regularizer=None,
+                 b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation  # Module or None -> tanh
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        return {self.name: _gate_params(self, rng, self.input_size,
+                                        self.hidden_size, 1)}
+
+    def zero_hidden(self, batch_size, dtype=jnp.float32):
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+    def _act(self, v, params, ctx):
+        if self.activation is None:
+            return jnp.tanh(v)
+        return self.activation.apply(params, v, ctx)
+
+    def step(self, params, x, h, ctx):
+        p = self.own(params)
+        z = (x @ p["weight_i"].astype(x.dtype)
+             + h @ p["weight_h"].astype(x.dtype)
+             + p["bias"].astype(x.dtype))
+        h2 = self._act(z, params, ctx)
+        return h2, h2
+
+
+class LSTM(Cell):
+    """Standard LSTM cell (nn/LSTM.scala). Gate order i, f, g(cell), o.
+    Hidden state is a Table {h, c}; output is h."""
+
+    def __init__(self, input_size, hidden_size, p=0.0, activation=None,
+                 inner_activation=None, w_regularizer=None, u_regularizer=None,
+                 b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.dropout_p = p
+        self.activation = activation
+        self.inner_activation = inner_activation
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        return {self.name: _gate_params(self, rng, self.input_size,
+                                        self.hidden_size, 4)}
+
+    def zero_hidden(self, batch_size, dtype=jnp.float32):
+        return Table(jnp.zeros((batch_size, self.hidden_size), dtype),
+                     jnp.zeros((batch_size, self.hidden_size), dtype))
+
+    def step(self, params, x, hidden, ctx):
+        h, c = as_list(hidden)
+        p = self.own(params)
+        z = (x @ p["weight_i"].astype(x.dtype)
+             + h @ p["weight_h"].astype(x.dtype)
+             + p["bias"].astype(x.dtype))
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        inner = jax.nn.sigmoid if self.inner_activation is None else \
+            (lambda v: self.inner_activation.apply(params, v, ctx))
+        act = jnp.tanh if self.activation is None else \
+            (lambda v: self.activation.apply(params, v, ctx))
+        i, f, o = inner(i), inner(f), inner(o)
+        g = act(g)
+        c2 = f * c + i * g
+        h2 = o * act(c2)
+        return h2, Table(h2, c2)
+
+
+class LSTMPeephole(Cell):
+    """LSTM with peephole connections from c into the gates
+    (nn/LSTMPeephole.scala)."""
+
+    def __init__(self, input_size, hidden_size, p=0.0, w_regularizer=None,
+                 u_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        base = _gate_params(self, k1, self.input_size, self.hidden_size, 4)
+        ph = 0.1 * jax.random.normal(k2, (3, self.hidden_size), jnp.float32)
+        base["peephole"] = ph
+        return {self.name: base}
+
+    def zero_hidden(self, batch_size, dtype=jnp.float32):
+        return Table(jnp.zeros((batch_size, self.hidden_size), dtype),
+                     jnp.zeros((batch_size, self.hidden_size), dtype))
+
+    def step(self, params, x, hidden, ctx):
+        h, c = as_list(hidden)
+        p = self.own(params)
+        z = (x @ p["weight_i"].astype(x.dtype)
+             + h @ p["weight_h"].astype(x.dtype)
+             + p["bias"].astype(x.dtype))
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        ph = p["peephole"].astype(x.dtype)
+        i = jax.nn.sigmoid(i + ph[0] * c)
+        f = jax.nn.sigmoid(f + ph[1] * c)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        o = jax.nn.sigmoid(o + ph[2] * c2)
+        h2 = o * jnp.tanh(c2)
+        return h2, Table(h2, c2)
+
+
+class GRU(Cell):
+    """GRU cell (nn/GRU.scala). Gate order r(reset), z(update), n(new)."""
+
+    def __init__(self, input_size, hidden_size, p=0.0, w_regularizer=None,
+                 u_regularizer=None, b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        gates = _gate_params(self, k1, self.input_size, self.hidden_size, 2)
+        newg = _gate_params(self, k2, self.input_size, self.hidden_size, 1)
+        return {self.name: {"gates": gates, "new": newg}}
+
+    def zero_hidden(self, batch_size, dtype=jnp.float32):
+        return jnp.zeros((batch_size, self.hidden_size), dtype)
+
+    def step(self, params, x, h, ctx):
+        p = self.own(params)
+        g = p["gates"]
+        z2 = (x @ g["weight_i"].astype(x.dtype)
+              + h @ g["weight_h"].astype(x.dtype)
+              + g["bias"].astype(x.dtype))
+        r, z = jnp.split(jax.nn.sigmoid(z2), 2, axis=-1)
+        n = p["new"]
+        nh = jnp.tanh(x @ n["weight_i"].astype(x.dtype)
+                      + (r * h) @ n["weight_h"].astype(x.dtype)
+                      + n["bias"].astype(x.dtype))
+        h2 = (1.0 - z) * nh + z * h
+        return h2, h2
+
+
+class ConvLSTMPeephole(Cell):
+    """Convolutional LSTM with peepholes over NCHW maps
+    (nn/ConvLSTMPeephole.scala)."""
+
+    def __init__(self, input_size, output_size, kernel_i, kernel_c,
+                 stride=1, padding=-1, activation=None, inner_activation=None,
+                 w_regularizer=None, u_regularizer=None, b_regularizer=None,
+                 c_regularizer=None, with_peephole=True, name=None):
+        super().__init__(name=name)
+        from .conv import SpatialConvolution
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_peephole = with_peephole
+        self.conv_i = SpatialConvolution(
+            input_size, 4 * output_size, kernel_i, kernel_i, stride, stride,
+            padding, padding, name=f"{self.name}_ci")
+        # hidden conv must preserve spatial shape: stride 1, SAME padding
+        self.conv_h = SpatialConvolution(
+            output_size, 4 * output_size, kernel_c, kernel_c, 1, 1,
+            -1, -1, with_bias=False, name=f"{self.name}_ch")
+
+    def children(self):
+        return [self.conv_i, self.conv_h]
+
+    def init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        p = {}
+        p.update(self.conv_i.init(k1))
+        p.update(self.conv_h.init(k2))
+        if self.with_peephole:
+            p[self.name] = {"peephole": 0.1 * jax.random.normal(
+                k3, (3, self.output_size), jnp.float32)}
+        return p
+
+    def zero_hidden(self, batch_size, dtype=jnp.float32, spatial=None):
+        if spatial is None:
+            raise ValueError("ConvLSTMPeephole needs spatial dims for hidden")
+        shape = (batch_size, self.output_size) + tuple(spatial)
+        return Table(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    def step(self, params, x, hidden, ctx):
+        h, c = as_list(hidden)
+        z = (self.conv_i.apply(params, x, ctx)
+             + self.conv_h.apply(params, h, ctx))
+        i, f, g, o = jnp.split(z, 4, axis=1)
+        if self.with_peephole:
+            ph = self.own(params)["peephole"].astype(x.dtype)
+            i = i + ph[0][None, :, None, None] * c
+            f = f + ph[1][None, :, None, None] * c
+        i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        if self.with_peephole:
+            o = o + ph[2][None, :, None, None] * c2
+        o = jax.nn.sigmoid(o)
+        h2 = o * jnp.tanh(c2)
+        return h2, Table(h2, c2)
+
+
+class MultiRNNCell(Cell):
+    """Stack of cells applied at each timestep (nn/MultiRNNCell.scala)."""
+
+    def __init__(self, cells, name=None):
+        super().__init__(name=name)
+        self.cells = list(cells)
+
+    def children(self):
+        return list(self.cells)
+
+    def init(self, rng):
+        p = {}
+        for i, c in enumerate(self.cells):
+            p.update(c.init(jax.random.fold_in(rng, i)))
+        return p
+
+    def zero_hidden(self, batch_size, dtype=jnp.float32):
+        return Table(*[c.zero_hidden(batch_size, dtype) for c in self.cells])
+
+    def step(self, params, x, hidden, ctx):
+        hs = as_list(hidden)
+        new_hs = []
+        out = x
+        for cell, h in zip(self.cells, hs):
+            out, nh = cell.step(params, out, h, ctx)
+            new_hs.append(nh)
+        return out, Table(*new_hs)
+
+
+class Recurrent(Module):
+    """Run a cell over the time dim of (B, T, ...) input via lax.scan
+    (nn/Recurrent.scala)."""
+
+    def __init__(self, cell=None, name=None):
+        super().__init__(name=name)
+        self.cell = cell
+
+    def add(self, cell):
+        self.cell = cell
+        return self
+
+    def children(self):
+        return [self.cell] if self.cell is not None else []
+
+    def init(self, rng):
+        return self.cell.init(rng)
+
+    def initial_state(self):
+        return self.cell.initial_state()
+
+    def _initial_hidden(self, x):
+        if hasattr(self.cell, "zero_hidden"):
+            try:
+                return self.cell.zero_hidden(x.shape[0], x.dtype)
+            except (ValueError, TypeError):
+                return self.cell.zero_hidden(x.shape[0], x.dtype,
+                                             spatial=x.shape[3:])
+        raise ValueError("cell must define zero_hidden")
+
+    def apply(self, params, x, ctx):
+        hidden0 = self._initial_hidden(x)
+        xs_t = jnp.swapaxes(x, 0, 1)  # (T, B, ...)
+
+        def body(h, x_t):
+            out, h2 = self.cell.step(params, x_t, h, ctx)
+            return h2, out
+
+        _, outs = lax.scan(body, hidden0, xs_t)
+        return jnp.swapaxes(outs, 0, 1)
+
+
+class BiRecurrent(Module):
+    """Bidirectional recurrence; merge defaults to elementwise add
+    (nn/BiRecurrent.scala:65 — CAddTable)."""
+
+    def __init__(self, merge=None, cell=None, name=None):
+        super().__init__(name=name)
+        self.merge = merge
+        self.fwd_cell = cell
+        self.bwd_cell = None
+
+    def add(self, cell):
+        import copy
+        self.fwd_cell = cell
+        return self
+
+    def children(self):
+        return [c for c in (self.fwd_cell, self.bwd_cell, self.merge) if c]
+
+    def _ensure_bwd(self):
+        if self.bwd_cell is None:
+            import copy
+            self.bwd_cell = copy.deepcopy(self.fwd_cell)
+            self.bwd_cell.name = f"{self.fwd_cell.name}_bwd"
+            # children of deep-copied cells need distinct names too
+            for m in self.bwd_cell.modules()[1:]:
+                m.name = f"{m.name}_bwd"
+
+    def init(self, rng):
+        self._ensure_bwd()
+        k1, k2, k3 = jax.random.split(rng, 3)
+        p = {}
+        p.update(self.fwd_cell.init(k1))
+        p.update(self.bwd_cell.init(k2))
+        if self.merge is not None:
+            p.update(self.merge.init(k3))
+        return p
+
+    def apply(self, params, x, ctx):
+        self._ensure_bwd()
+        fwd = Recurrent(self.fwd_cell, name=f"{self.name}_f")
+        bwd = Recurrent(self.bwd_cell, name=f"{self.name}_b")
+        yf = fwd.apply(params, x, ctx)
+        yb = jnp.flip(bwd.apply(params, jnp.flip(x, axis=1), ctx), axis=1)
+        if self.merge is None:
+            return yf + yb
+        return self.merge.apply(params, Table(yf, yb), ctx)
+
+
+class RecurrentDecoder(Module):
+    """Decoder: feeds its own output back as the next input for seq_length
+    steps (nn/RecurrentDecoder.scala). Input is the first-step input (B, ...)."""
+
+    def __init__(self, seq_length, cell=None, name=None):
+        super().__init__(name=name)
+        self.seq_length = seq_length
+        self.cell = cell
+
+    def add(self, cell):
+        self.cell = cell
+        return self
+
+    def children(self):
+        return [self.cell] if self.cell is not None else []
+
+    def init(self, rng):
+        return self.cell.init(rng)
+
+    def apply(self, params, x, ctx):
+        hidden0 = self.cell.zero_hidden(x.shape[0], x.dtype)
+
+        def body(carry, _):
+            inp, h = carry
+            out, h2 = self.cell.step(params, inp, h, ctx)
+            return (out, h2), out
+
+        _, outs = lax.scan(body, (x, hidden0), None, length=self.seq_length)
+        return jnp.swapaxes(outs, 0, 1)
+
+
+class TimeDistributed(Module):
+    """Apply a module independently at each timestep of (B, T, ...)
+    (nn/TimeDistributed.scala). Implemented by folding time into batch —
+    one big MXU call instead of T small ones."""
+
+    def __init__(self, layer, name=None):
+        super().__init__(name=name)
+        self.layer = layer
+
+    def children(self):
+        return [self.layer]
+
+    def init(self, rng):
+        return self.layer.init(rng)
+
+    def initial_state(self):
+        return self.layer.initial_state()
+
+    def apply(self, params, x, ctx):
+        b, t = x.shape[0], x.shape[1]
+        flat = x.reshape((b * t,) + x.shape[2:])
+        y = self.layer.apply(params, flat, ctx)
+        return y.reshape((b, t) + y.shape[1:])

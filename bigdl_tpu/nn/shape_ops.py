@@ -1,0 +1,443 @@
+"""Shape / indexing manipulation layers.
+
+Reference files: nn/Reshape.scala, View.scala, Squeeze.scala, Unsqueeze.scala,
+Transpose.scala, Select.scala, Narrow.scala, Replicate.scala, Padding.scala,
+SpatialZeroPadding.scala, Cropping2D.scala, Cropping3D.scala, Contiguous.scala,
+InferReshape.scala, Index.scala, Tile.scala, Pack.scala, Reverse.scala,
+Masking.scala, Sum.scala, Mean.scala (in keras), Max.scala, Min.scala,
+Negative.scala, GradientReversal.scala.
+
+Dimension arguments are 1-based like the reference (Torch convention);
+batch dim is dim 0 and is implicitly preserved where the reference does so.
+All are pure metadata/gather ops that XLA folds into surrounding kernels.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .module import Module
+from ..utils.table import as_list, Table
+
+
+def _axis(dim, ndim, batch_offset=0):
+    """1-based (possibly negative) reference dim -> 0-based axis."""
+    if dim < 0:
+        return ndim + dim
+    return dim - 1 + batch_offset
+
+
+class Reshape(Module):
+    """Reshape non-batch dims to `size` (nn/Reshape.scala). With
+    batch_mode=False and matching element count, reshapes the whole tensor."""
+
+    def __init__(self, size, batch_mode=None, name=None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, x, ctx):
+        n = int(np.prod(self.size))
+        batch = self.batch_mode
+        if batch is None:
+            # batched iff the per-sample tail (dims after the leading batch
+            # dim) matches the target element count — robust for batch size 1,
+            # where x.size == n is ambiguous
+            batch = ((x.ndim > 1 and int(np.prod(x.shape[1:])) == n)
+                     or (x.size != n and x.size % n == 0))
+        if batch:
+            return x.reshape((x.shape[0],) + self.size)
+        return x.reshape(self.size)
+
+
+class View(Module):
+    """nn/View.scala — reshape keeping batch dim; -1 wildcard supported."""
+
+    def __init__(self, *sizes, name=None):
+        super().__init__(name=name)
+        if len(sizes) == 1 and isinstance(sizes[0], (tuple, list)):
+            sizes = tuple(sizes[0])
+        self.sizes = tuple(sizes)
+        self.num_input_dims = 0
+
+    def set_num_input_dims(self, n):
+        self.num_input_dims = n
+        return self
+
+    def apply(self, params, x, ctx):
+        total = int(np.prod([s for s in self.sizes if s != -1]))
+        if x.size % total == 0 and x.size != total and -1 not in self.sizes:
+            return x.reshape((x.shape[0],) + self.sizes)
+        return x.reshape(self.sizes if -1 in self.sizes
+                         else (x.shape[0],) + self.sizes)
+
+
+class InferReshape(Module):
+    """Reshape with -1 (infer) and 0 (copy input dim) entries
+    (nn/InferReshape.scala)."""
+
+    def __init__(self, size, batch_mode=False, name=None):
+        super().__init__(name=name)
+        self.size = tuple(size)
+        self.batch_mode = batch_mode
+
+    def apply(self, params, x, ctx):
+        in_shape = x.shape[1:] if self.batch_mode else x.shape
+        out = []
+        for i, s in enumerate(self.size):
+            if s == 0:
+                out.append(in_shape[i])
+            else:
+                out.append(s)
+        if self.batch_mode:
+            return x.reshape((x.shape[0],) + tuple(out))
+        return x.reshape(tuple(out))
+
+
+class Squeeze(Module):
+    """nn/Squeeze.scala; dim is 1-based, None squeezes all singleton dims."""
+
+    def __init__(self, dim=None, num_input_dims=0, batch_mode=False, name=None):
+        super().__init__(name=name)
+        self.dim = dim
+        self.batch_mode = batch_mode
+
+    def apply(self, params, x, ctx):
+        if self.dim is None:
+            return jnp.squeeze(x)
+        dims = self.dim if isinstance(self.dim, (tuple, list)) else (self.dim,)
+        axes = tuple(_axis(d, x.ndim, 1 if self.batch_mode else 0)
+                     for d in dims)
+        return jnp.squeeze(x, axis=axes)
+
+
+class Unsqueeze(Module):
+    """nn/Unsqueeze.scala; pos is 1-based."""
+
+    def __init__(self, pos, num_input_dims=0, name=None):
+        super().__init__(name=name)
+        self.pos = pos
+
+    def apply(self, params, x, ctx):
+        return jnp.expand_dims(x, axis=self.pos - 1 + 1)  # batch offset
+
+
+class Transpose(Module):
+    """Swap listed (1-based) dim pairs in order (nn/Transpose.scala).
+    Per the reference's batch use, pairs address non-batch dims."""
+
+    def __init__(self, permutations, name=None):
+        super().__init__(name=name)
+        self.permutations = [tuple(p) for p in permutations]
+
+    def apply(self, params, x, ctx):
+        perm = list(range(x.ndim))
+        for d1, d2 in self.permutations:
+            a1, a2 = _axis(d1, x.ndim, 1), _axis(d2, x.ndim, 1)
+            perm[a1], perm[a2] = perm[a2], perm[a1]
+        return jnp.transpose(x, perm)
+
+
+class Select(Module):
+    """Select index `index` along dim (both 1-based; negative supported)
+    (nn/Select.scala)."""
+
+    def __init__(self, dim, index, name=None):
+        super().__init__(name=name)
+        self.dim = dim
+        self.index = index
+
+    def apply(self, params, x, ctx):
+        ax = _axis(self.dim, x.ndim)
+        idx = self.index - 1 if self.index > 0 else x.shape[ax] + self.index
+        return jnp.take(x, idx, axis=ax)
+
+
+class Narrow(Module):
+    """Slice `length` elements from 1-based `offset` along dim (nn/Narrow.scala);
+    negative length counts from the end."""
+
+    def __init__(self, dimension, offset, length=1, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+        self.offset = offset
+        self.length = length
+
+    def apply(self, params, x, ctx):
+        ax = _axis(self.dimension, x.ndim)
+        size = x.shape[ax]
+        start = self.offset - 1 if self.offset > 0 else size + self.offset
+        length = self.length if self.length > 0 else size - start + self.length + 1
+        return jax.lax.slice_in_dim(x, start, start + length, axis=ax)
+
+
+class Replicate(Module):
+    """Insert a new dim of size nFeatures at `dim` by replication
+    (nn/Replicate.scala)."""
+
+    def __init__(self, n_features, dim=1, n_dim=float("inf"), name=None):
+        super().__init__(name=name)
+        self.n_features = n_features
+        self.dim = dim
+
+    def apply(self, params, x, ctx):
+        y = jnp.expand_dims(x, axis=self.dim)
+        return jnp.repeat(y, self.n_features, axis=self.dim)
+
+
+class Padding(Module):
+    """Pad `pad` entries (negative = before, positive = after) along dim with
+    `value` (nn/Padding.scala); dim is 1-based over non-batch dims when
+    n_input_dim < input rank."""
+
+    def __init__(self, dim, pad, n_input_dim, value=0.0, n_index=1, name=None):
+        super().__init__(name=name)
+        self.dim = dim
+        self.pad = pad
+        self.n_input_dim = n_input_dim
+        self.value = value
+
+    def apply(self, params, x, ctx):
+        offset = 1 if x.ndim > self.n_input_dim else 0
+        ax = self.dim - 1 + offset
+        pads = [(0, 0)] * x.ndim
+        pads[ax] = (-self.pad, 0) if self.pad < 0 else (0, self.pad)
+        return jnp.pad(x, pads, constant_values=self.value)
+
+
+class SpatialZeroPadding(Module):
+    """Zero-pad H/W of NCHW input (nn/SpatialZeroPadding.scala); negative
+    padding crops."""
+
+    def __init__(self, pad_left, pad_right=None, pad_top=None, pad_bottom=None,
+                 name=None):
+        super().__init__(name=name)
+        if pad_right is None:
+            pad_right = pad_top = pad_bottom = pad_left
+        self.pads = (pad_left, pad_right, pad_top, pad_bottom)
+
+    def apply(self, params, x, ctx):
+        l, r, t, b = self.pads
+        if min(self.pads) < 0:
+            h, w = x.shape[2], x.shape[3]
+            x = x[:, :, max(0, -t):h - max(0, -b), max(0, -l):w - max(0, -r)]
+            l, r, t, b = [max(0, v) for v in (l, r, t, b)]
+        return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
+
+
+class Cropping2D(Module):
+    """Crop H/W (nn/Cropping2D.scala)."""
+
+    def __init__(self, height_crop, width_crop, format="NCHW", name=None):
+        super().__init__(name=name)
+        self.height_crop = tuple(height_crop)
+        self.width_crop = tuple(width_crop)
+        self.format = format
+
+    def apply(self, params, x, ctx):
+        (t, b), (l, r) = self.height_crop, self.width_crop
+        h_ax, w_ax = (2, 3) if self.format == "NCHW" else (1, 2)
+        sl = [slice(None)] * x.ndim
+        sl[h_ax] = slice(t, x.shape[h_ax] - b)
+        sl[w_ax] = slice(l, x.shape[w_ax] - r)
+        return x[tuple(sl)]
+
+
+class Cropping3D(Module):
+    """nn/Cropping3D.scala for NCDHW ('channel_first') or NDHWC."""
+
+    def __init__(self, dim1_crop, dim2_crop, dim3_crop, format="channel_first",
+                 name=None):
+        super().__init__(name=name)
+        self.crops = (tuple(dim1_crop), tuple(dim2_crop), tuple(dim3_crop))
+        self.format = format
+
+    def apply(self, params, x, ctx):
+        axes = (2, 3, 4) if self.format == "channel_first" else (1, 2, 3)
+        sl = [slice(None)] * x.ndim
+        for ax, (lo, hi) in zip(axes, self.crops):
+            sl[ax] = slice(lo, x.shape[ax] - hi)
+        return x[tuple(sl)]
+
+
+class Contiguous(Module):
+    """nn/Contiguous.scala — identity on TPU (XLA manages layout)."""
+
+    def apply(self, params, x, ctx):
+        return x
+
+
+class Index(Module):
+    """Table input {tensor, 1-based indices}; gathers along dim (nn/Index.scala)."""
+
+    def __init__(self, dimension, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+
+    def apply(self, params, x, ctx):
+        t, idx = as_list(x)
+        return jnp.take(t, idx.astype(jnp.int32) - 1,
+                        axis=_axis(self.dimension, t.ndim))
+
+
+class Tile(Module):
+    """Repeat `copies` times along dim (nn/Tile.scala)."""
+
+    def __init__(self, dim=1, copies=2, name=None):
+        super().__init__(name=name)
+        self.dim = dim
+        self.copies = copies
+
+    def apply(self, params, x, ctx):
+        reps = [1] * x.ndim
+        reps[_axis(self.dim, x.ndim)] = self.copies
+        return jnp.tile(x, reps)
+
+
+class Pack(Module):
+    """Stack a table of tensors along a new (1-based) dim (nn/Pack.scala)."""
+
+    def __init__(self, dimension, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+
+    def apply(self, params, x, ctx):
+        return jnp.stack(as_list(x), axis=self.dimension - 1)
+
+
+class Reverse(Module):
+    """Reverse along dim (nn/Reverse.scala)."""
+
+    def __init__(self, dimension=1, is_inplace=False, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+
+    def apply(self, params, x, ctx):
+        return jnp.flip(x, axis=self.dimension - 1)
+
+
+class Masking(Module):
+    """Zero out timesteps equal to mask_value (keras-style Masking, present in
+    reference keras layer set)."""
+
+    def __init__(self, mask_value=0.0, name=None):
+        super().__init__(name=name)
+        self.mask_value = mask_value
+
+    def apply(self, params, x, ctx):
+        keep = jnp.any(x != self.mask_value, axis=-1, keepdims=True)
+        return x * keep.astype(x.dtype)
+
+
+class Sum(Module):
+    """Sum along dim, optional mean/squeeze (nn/Sum.scala)."""
+
+    def __init__(self, dimension=1, n_input_dims=-1, size_average=False,
+                 squeeze=True, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+        self.size_average = size_average
+        self.squeeze = squeeze
+
+    def apply(self, params, x, ctx):
+        ax = _axis(self.dimension, x.ndim)
+        y = jnp.mean(x, axis=ax, keepdims=not self.squeeze) if self.size_average \
+            else jnp.sum(x, axis=ax, keepdims=not self.squeeze)
+        return y
+
+
+class Max(Module):
+    """Max along dim (nn/Max.scala); returns values only (reference returns
+    values; indices variant is in ops)."""
+
+    def __init__(self, dim=1, num_input_dims=0, name=None):
+        super().__init__(name=name)
+        self.dim = dim
+
+    def apply(self, params, x, ctx):
+        return jnp.max(x, axis=_axis(self.dim, x.ndim))
+
+
+class Min(Module):
+    """nn/Min.scala"""
+
+    def __init__(self, dim=1, num_input_dims=0, name=None):
+        super().__init__(name=name)
+        self.dim = dim
+
+    def apply(self, params, x, ctx):
+        return jnp.min(x, axis=_axis(self.dim, x.ndim))
+
+
+class Mean(Module):
+    """Mean along 1-based dim (nn/Mean.scala)."""
+
+    def __init__(self, dimension=1, n_input_dims=-1, squeeze=True, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+        self.squeeze = squeeze
+
+    def apply(self, params, x, ctx):
+        return jnp.mean(x, axis=_axis(self.dimension, x.ndim),
+                        keepdims=not self.squeeze)
+
+
+class Negative(Module):
+    """nn/Negative.scala"""
+
+    def apply(self, params, x, ctx):
+        return -x
+
+
+class GradientReversal(Module):
+    """Identity forward, -lambda * grad backward (nn/GradientReversal.scala)."""
+
+    def __init__(self, the_lambda=1.0, name=None):
+        super().__init__(name=name)
+        self.the_lambda = the_lambda
+
+    def apply(self, params, x, ctx):
+        lam = self.the_lambda
+
+        @jax.custom_vjp
+        def rev(v):
+            return v
+
+        def fwd(v):
+            return v, None
+
+        def bwd(_, g):
+            return (jax.tree_util.tree_map(lambda t: -lam * t, g),)
+
+        rev.defvjp(fwd, bwd)
+        return rev(x)
+
+
+class SplitAndSelect(Module):
+    """Split along dim into n parts, return the index-th (nn/tf/SplitAndSelect.scala)."""
+
+    def __init__(self, dimension, index, num_split, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+        self.index = index
+        self.num_split = num_split
+
+    def apply(self, params, x, ctx):
+        parts = jnp.split(x, self.num_split, axis=_axis(self.dimension, x.ndim))
+        return parts[self.index - 1]
+
+
+class StrideSlice(Module):
+    """Strided slice, specs = list of (dim, start, stop, step) 1-based
+    (nn/tf/StrideSlice.scala)."""
+
+    def __init__(self, specs, name=None):
+        super().__init__(name=name)
+        self.specs = specs
+
+    def apply(self, params, x, ctx):
+        sl = [slice(None)] * x.ndim
+        for dim, start, stop, step in self.specs:
+            sl[dim - 1] = slice(start - 1, stop - 1, step)
+        return x[tuple(sl)]

@@ -1,0 +1,260 @@
+"""Table (multi-activity) arithmetic and routing layers.
+
+Reference files: nn/CAddTable.scala, CSubTable.scala, CMulTable.scala,
+CDivTable.scala, CMaxTable.scala, CMinTable.scala, CAveTable.scala,
+JoinTable.scala, SplitTable.scala, NarrowTable.scala, SelectTable.scala,
+FlattenTable.scala, MixtureTable.scala, DotProduct.scala, MM.scala, MV.scala,
+CosineDistance.scala, PairwiseDistance.scala, CrossProduct.scala,
+BifurcateSplitTable.scala, DotProductCriterion lives in criterion.py.
+"""
+from __future__ import annotations
+
+from functools import reduce
+
+import jax
+import jax.numpy as jnp
+
+from .module import Module
+from ..utils.table import Table, as_list
+
+
+class CAddTable(Module):
+    def __init__(self, inplace=False, name=None):
+        super().__init__(name=name)
+
+    def apply(self, params, x, ctx):
+        return reduce(jnp.add, as_list(x))
+
+
+class CSubTable(Module):
+    def apply(self, params, x, ctx):
+        a, b = as_list(x)
+        return a - b
+
+
+class CMulTable(Module):
+    def apply(self, params, x, ctx):
+        return reduce(jnp.multiply, as_list(x))
+
+
+class CDivTable(Module):
+    def apply(self, params, x, ctx):
+        a, b = as_list(x)
+        return a / b
+
+
+class CMaxTable(Module):
+    def apply(self, params, x, ctx):
+        return reduce(jnp.maximum, as_list(x))
+
+
+class CMinTable(Module):
+    def apply(self, params, x, ctx):
+        return reduce(jnp.minimum, as_list(x))
+
+
+class CAveTable(Module):
+    def __init__(self, inplace=False, name=None):
+        super().__init__(name=name)
+
+    def apply(self, params, x, ctx):
+        xs = as_list(x)
+        return reduce(jnp.add, xs) / float(len(xs))
+
+
+class JoinTable(Module):
+    """Concat table elements along 1-based `dimension`; n_input_dims allows
+    batch offset like the reference (nn/JoinTable.scala)."""
+
+    def __init__(self, dimension, n_input_dims=-1, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def apply(self, params, x, ctx):
+        xs = as_list(x)
+        offset = 1 if (self.n_input_dims > 0
+                       and xs[0].ndim > self.n_input_dims) else 0
+        return jnp.concatenate(xs, axis=self.dimension - 1 + offset)
+
+
+class SplitTable(Module):
+    """Split a tensor along `dimension` into a table of slices
+    (nn/SplitTable.scala)."""
+
+    def __init__(self, dimension, n_input_dims=-1, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+        self.n_input_dims = n_input_dims
+
+    def apply(self, params, x, ctx):
+        offset = 1 if (self.n_input_dims > 0
+                       and x.ndim > self.n_input_dims) else 0
+        ax = (self.dimension - 1 + offset) if self.dimension > 0 \
+            else x.ndim + self.dimension
+        n = x.shape[ax]
+        return Table(*[jnp.take(x, i, axis=ax) for i in range(n)])
+
+
+class BifurcateSplitTable(Module):
+    """Split into two halves along dim (nn/BifurcateSplitTable.scala)."""
+
+    def __init__(self, dimension, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+
+    def apply(self, params, x, ctx):
+        ax = self.dimension - 1
+        half = x.shape[ax] // 2
+        a = jax.lax.slice_in_dim(x, 0, half, axis=ax)
+        b = jax.lax.slice_in_dim(x, half, x.shape[ax], axis=ax)
+        return Table(a, b)
+
+
+class NarrowTable(Module):
+    """Table slice [offset, offset+length) with 1-based offset
+    (nn/NarrowTable.scala)."""
+
+    def __init__(self, offset, length=1, name=None):
+        super().__init__(name=name)
+        self.offset = offset
+        self.length = length
+
+    def apply(self, params, x, ctx):
+        xs = as_list(x)
+        length = self.length if self.length > 0 else \
+            len(xs) - self.offset + 1 + self.length + 1
+        return Table(*xs[self.offset - 1:self.offset - 1 + length])
+
+
+class SelectTable(Module):
+    """Select the i-th (1-based) table element (nn/SelectTable.scala)."""
+
+    def __init__(self, index, name=None):
+        super().__init__(name=name)
+        self.index = index
+
+    def apply(self, params, x, ctx):
+        xs = as_list(x)
+        i = self.index if self.index > 0 else len(xs) + self.index + 1
+        return xs[i - 1]
+
+
+class FlattenTable(Module):
+    """Flatten nested tables into one flat table (nn/FlattenTable.scala)."""
+
+    def apply(self, params, x, ctx):
+        out = []
+
+        def rec(v):
+            if isinstance(v, (Table, list, tuple)):
+                for e in as_list(v):
+                    rec(e)
+            else:
+                out.append(v)
+
+        rec(x)
+        return Table(*out)
+
+
+class MixtureTable(Module):
+    """Mixture-of-experts blend: input {gater (B,E), experts table}
+    (nn/MixtureTable.scala)."""
+
+    def __init__(self, dim=None, name=None):
+        super().__init__(name=name)
+        self.dim = dim
+
+    def apply(self, params, x, ctx):
+        gater, experts = as_list(x)
+        experts = as_list(experts)
+        stacked = jnp.stack(experts, axis=1)  # (B, E, ...)
+        g = gater.reshape(gater.shape + (1,) * (stacked.ndim - gater.ndim))
+        return jnp.sum(stacked * g, axis=1)
+
+
+class DotProduct(Module):
+    """Row-wise dot product of two inputs (nn/DotProduct.scala)."""
+
+    def apply(self, params, x, ctx):
+        a, b = as_list(x)
+        return jnp.sum(a * b, axis=-1)
+
+
+class MM(Module):
+    """Batched matrix-matrix product with optional transposes (nn/MM.scala)."""
+
+    def __init__(self, trans_a=False, trans_b=False, name=None):
+        super().__init__(name=name)
+        self.trans_a = trans_a
+        self.trans_b = trans_b
+
+    def apply(self, params, x, ctx):
+        a, b = as_list(x)
+        if self.trans_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.trans_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+
+class MV(Module):
+    """Batched matrix-vector product (nn/MV.scala)."""
+
+    def __init__(self, trans=False, name=None):
+        super().__init__(name=name)
+        self.trans = trans
+
+    def apply(self, params, x, ctx):
+        m, v = as_list(x)
+        if self.trans:
+            m = jnp.swapaxes(m, -1, -2)
+        return jnp.einsum("...ij,...j->...i", m, v)
+
+
+class CosineDistance(Module):
+    """Cosine similarity of two row batches (nn/CosineDistance.scala)."""
+
+    def apply(self, params, x, ctx):
+        a, b = as_list(x)
+        an = jnp.maximum(jnp.linalg.norm(a, axis=-1), 1e-12)
+        bn = jnp.maximum(jnp.linalg.norm(b, axis=-1), 1e-12)
+        return jnp.sum(a * b, axis=-1) / (an * bn)
+
+
+class PairwiseDistance(Module):
+    """Lp distance between paired rows (nn/PairwiseDistance.scala)."""
+
+    def __init__(self, norm=2, name=None):
+        super().__init__(name=name)
+        self.norm = norm
+
+    def apply(self, params, x, ctx):
+        a, b = as_list(x)
+        d = jnp.abs(a - b) ** self.norm
+        return jnp.sum(d, axis=-1) ** (1.0 / self.norm)
+
+
+class CrossProduct(Module):
+    """Pairwise dot products between all pairs of table elements
+    (nn/CrossProduct.scala)."""
+
+    def __init__(self, num_tensor=0, embedding_size=0, name=None):
+        super().__init__(name=name)
+
+    def apply(self, params, x, ctx):
+        xs = as_list(x)
+        outs = []
+        for i in range(len(xs)):
+            for j in range(i + 1, len(xs)):
+                outs.append(jnp.sum(xs[i] * xs[j], axis=-1, keepdims=True))
+        return jnp.concatenate(outs, axis=-1)
+
+
+class DenseToSparse(Module):
+    """nn/DenseToSparse.scala — on TPU sparse activities are represented
+    densely (XLA has no sparse tensors); this is a tagged identity so graphs
+    importing it still run."""
+
+    def apply(self, params, x, ctx):
+        return x

@@ -1,0 +1,17 @@
+"""bigdl_tpu.optim — training methods & drivers (≙ com.intel.analytics.bigdl.optim)."""
+from .optim_method import (OptimMethod, SGD, Adam, AdamW, Adagrad, Adadelta,
+                           Adamax, RMSprop, Ftrl, LBFGS)
+from .lr_schedule import (LearningRateSchedule, Default, Step, MultiStep,
+                          Exponential, NaturalExp, Poly, Warmup,
+                          SequentialSchedule, EpochDecay, EpochStep, Plateau)
+from .regularizer import (Regularizer, L1Regularizer, L2Regularizer,
+                          L1L2Regularizer)
+from .trigger import Trigger
+from .validation import (ValidationMethod, ValidationResult, AccuracyResult,
+                         LossResult, ContiguousResult, Top1Accuracy,
+                         Top5Accuracy, Loss, MAE, HitRatio, NDCG,
+                         TreeNNAccuracy)
+from .optimizer import (Optimizer, LocalOptimizer, Metrics, TrainingState,
+                        make_train_step, make_eval_step)
+from .predictor import (Predictor, LocalPredictor, Evaluator,
+                        PredictionService)

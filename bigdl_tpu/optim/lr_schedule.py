@@ -1,0 +1,188 @@
+"""Learning-rate schedules (≙ optim/SGD.scala LearningRateSchedule objects:
+Default, Step, MultiStep, Exponential, Poly, Plateau, Warmup,
+NaturalExp, Regime/EpochSchedule, EpochDecay, EpochStep).
+
+Each schedule maps (method, step) -> lr where `step` may be a traced int32 —
+schedules must stay jnp-expressible so they compile into the train step.
+Plateau (metric-driven) is host-side by nature and exposed via
+``on_epoch_end``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class LearningRateSchedule:
+    def rate(self, method, step):
+        raise NotImplementedError
+
+
+class Default(LearningRateSchedule):
+    def rate(self, method, step):
+        return method.lr
+
+
+class Step(LearningRateSchedule):
+    """lr * gamma^(floor(step / step_size)) (optim/SGD.scala Step)."""
+
+    def __init__(self, step_size, gamma):
+        self.step_size = step_size
+        self.gamma = gamma
+
+    def rate(self, method, step):
+        return method.lr * self.gamma ** jnp.floor(step / self.step_size)
+
+
+class MultiStep(LearningRateSchedule):
+    """Decay by gamma at each listed step (optim/SGD.scala MultiStep)."""
+
+    def __init__(self, step_sizes, gamma):
+        self.step_sizes = list(step_sizes)
+        self.gamma = gamma
+
+    def rate(self, method, step):
+        n = sum(jnp.where(step >= s, 1, 0) for s in self.step_sizes)
+        return method.lr * self.gamma ** n
+
+
+class Exponential(LearningRateSchedule):
+    """lr * decay_rate^(step/decay_step) (optim/SGD.scala Exponential)."""
+
+    def __init__(self, decay_step, decay_rate, staircase=False):
+        self.decay_step = decay_step
+        self.decay_rate = decay_rate
+        self.staircase = staircase
+
+    def rate(self, method, step):
+        e = step / self.decay_step
+        if self.staircase:
+            e = jnp.floor(e)
+        return method.lr * self.decay_rate ** e
+
+
+class NaturalExp(LearningRateSchedule):
+    def __init__(self, decay_step, gamma):
+        self.decay_step = decay_step
+        self.gamma = gamma
+
+    def rate(self, method, step):
+        return method.lr * jnp.exp(-self.gamma * jnp.floor(step / self.decay_step))
+
+
+class Poly(LearningRateSchedule):
+    """lr * (1 - step/max_iteration)^power (optim/SGD.scala Poly)."""
+
+    def __init__(self, power, max_iteration):
+        self.power = power
+        self.max_iteration = max_iteration
+
+    def rate(self, method, step):
+        frac = jnp.minimum(step / self.max_iteration, 1.0)
+        return method.lr * (1.0 - frac) ** self.power
+
+
+class Warmup(LearningRateSchedule):
+    """Linear warmup by delta per step for warmup_iteration steps, then
+    delegates (optim/SGD.scala Warmup + SequentialSchedule)."""
+
+    def __init__(self, delta):
+        self.delta = delta
+
+    def rate(self, method, step):
+        return method.lr + self.delta * step
+
+
+class SequentialSchedule(LearningRateSchedule):
+    """Chain schedules, each active for its `max_iteration` steps
+    (optim/SGD.scala SequentialSchedule)."""
+
+    def __init__(self, iteration_per_epoch=1):
+        self.schedules = []
+        self.cutoffs = []
+        self.iteration_per_epoch = iteration_per_epoch
+
+    def add(self, schedule, max_iteration):
+        start = self.cutoffs[-1] if self.cutoffs else 0
+        self.schedules.append(schedule)
+        self.cutoffs.append(start + max_iteration)
+        return self
+
+    def rate(self, method, step):
+        rate = self.schedules[-1].rate(
+            method, step - (self.cutoffs[-2] if len(self.cutoffs) > 1 else 0))
+        starts = [0] + self.cutoffs[:-1]
+        for sched, start, end in zip(reversed(self.schedules[:-1]),
+                                     reversed(starts[:-1]),
+                                     reversed(self.cutoffs[:-1])):
+            local = sched.rate(method, step - start)
+            rate = jnp.where(step < end, local, rate)
+        return rate
+
+
+class EpochDecay(LearningRateSchedule):
+    """lr * 0.1^decay(epoch) with a user decay function — host-side epoch
+    input (optim/SGD.scala EpochDecay)."""
+
+    def __init__(self, decay_fn, iteration_per_epoch):
+        self.decay_fn = decay_fn
+        self.iteration_per_epoch = iteration_per_epoch
+
+    def rate(self, method, step):
+        # approximate epoch from step; exact when set_epoch is called
+        epoch = step // self.iteration_per_epoch
+        return method.lr * 0.1 ** self.decay_fn(epoch)
+
+
+class EpochStep(LearningRateSchedule):
+    """lr * gamma^(epoch/step_size) (optim/SGD.scala EpochStep)."""
+
+    def __init__(self, step_size, gamma, iteration_per_epoch=1):
+        self.step_size = step_size
+        self.gamma = gamma
+        self.iteration_per_epoch = iteration_per_epoch
+
+    def rate(self, method, step):
+        epoch = step // self.iteration_per_epoch
+        return method.lr * self.gamma ** (epoch // self.step_size)
+
+
+class Plateau(LearningRateSchedule):
+    """Reduce LR when a monitored metric plateaus (optim/SGD.scala Plateau).
+    Metric-driven, so updated host-side via on_epoch_end(metric)."""
+
+    def __init__(self, monitor="score", factor=0.1, patience=10, mode="min",
+                 epsilon=1e-4, cooldown=0, min_lr=0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.mode = mode
+        self.epsilon = epsilon
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.current_factor = 1.0
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _improved(self, metric):
+        if self.best is None:
+            return True
+        if self.mode == "min":
+            return metric < self.best - self.epsilon
+        return metric > self.best + self.epsilon
+
+    def on_epoch_end(self, metric):
+        if self._improved(metric):
+            self.best = metric
+            self.wait = 0
+        elif self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.current_factor *= self.factor
+                self.wait = 0
+                self.cooldown_counter = self.cooldown
+
+    def rate(self, method, step):
+        return jnp.maximum(method.lr * self.current_factor, self.min_lr)

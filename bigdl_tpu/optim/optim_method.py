@@ -1,0 +1,412 @@
+"""Optimization methods (≙ optim/OptimMethod.scala, SGD.scala, Adam.scala,
+Adagrad.scala, Adadelta.scala, Adamax.scala, RMSprop.scala, Ftrl.scala,
+LBFGS.scala).
+
+TPU-first contract: each method is pure —
+
+    state = method.init_state(params)
+    new_params, new_state = method.update(grads, params, state)
+
+Both calls are pytree→pytree with no host syncs, so the whole
+(fwd + bwd + update) train step jit-compiles into a single XLA program and
+the update fuses with the gradient all-reduce.  The stateful reference API
+(``optimize(feval, x)``) is provided on top for parity with LocalOptimizer-
+style usage and the LBFGS line-search path.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .lr_schedule import Default, LearningRateSchedule
+
+
+def _tmap(f, *trees):
+    return jax.tree_util.tree_map(f, *trees)
+
+
+class OptimMethod:
+    """Base class. Subclasses define init_state / update."""
+
+    def __init__(self):
+        self.nevals = 0
+
+    def init_state(self, params) -> Dict[str, Any]:
+        return {"step": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, params, state):
+        raise NotImplementedError
+
+    def get_learning_rate(self, state) -> float:
+        return 0.0
+
+    # -- reference-style stateful interface ----------------------------- #
+    def optimize(self, feval: Callable, x):
+        """Single step of `feval` returning (loss, grad) at x — the reference
+        OptimMethod.optimize signature used by LocalOptimizer."""
+        if not hasattr(self, "_ref_state") or self._ref_state is None:
+            self._ref_state = self.init_state(x)
+        loss, grad = feval(x)
+        new_x, self._ref_state = self.update(grad, x, self._ref_state)
+        self.nevals += 1
+        return new_x, [loss]
+
+    def clear_history(self):
+        self._ref_state = None
+        return self
+
+    def state_dict(self):
+        return getattr(self, "_ref_state", None)
+
+
+class SGD(OptimMethod):
+    """SGD with learning-rate schedules, momentum (+ nesterov), dampening,
+    weight decay, per-step LR decay (optim/SGD.scala)."""
+
+    def __init__(self, learning_rate=1e-3, learning_rate_decay=0.0,
+                 weight_decay=0.0, momentum=0.0, dampening=None,
+                 nesterov=False, learning_rate_schedule: Optional[LearningRateSchedule] = None,
+                 learning_rates=None, weight_decays=None):
+        super().__init__()
+        self.lr = learning_rate
+        self.lr_decay = learning_rate_decay
+        self.weight_decay = weight_decay
+        self.momentum = momentum
+        self.dampening = momentum if dampening is None else dampening
+        self.nesterov = nesterov
+        self.schedule = learning_rate_schedule or Default()
+        if nesterov and (momentum <= 0 or self.dampening != 0):
+            raise ValueError(
+                "Nesterov momentum requires momentum > 0 and dampening = 0")
+
+    def init_state(self, params):
+        st = {"step": jnp.zeros((), jnp.int32)}
+        if self.momentum > 0:
+            st["velocity"] = _tmap(jnp.zeros_like, params)
+        return st
+
+    def current_lr(self, step):
+        """Positive learning rate at `step` (0-based), after schedule."""
+        base = self.schedule.rate(self, step)
+        return base / (1.0 + step * self.lr_decay)
+
+    def get_learning_rate(self, state):
+        return self.current_lr(state["step"])
+
+    def update(self, grads, params, state):
+        step = state["step"]
+        clr = self.current_lr(step)
+        if self.weight_decay > 0:
+            grads = _tmap(lambda g, p: g + self.weight_decay * p, grads, params)
+        new_state = {"step": step + 1}
+        if self.momentum > 0:
+            vel = _tmap(
+                lambda v, g: self.momentum * v + (1.0 - self.dampening) * g,
+                state["velocity"], grads)
+            new_state["velocity"] = vel
+            if self.nesterov:
+                grads = _tmap(lambda g, v: g + self.momentum * v, grads, vel)
+            else:
+                grads = vel
+        new_params = _tmap(lambda p, g: p - clr * g.astype(p.dtype),
+                           params, grads)
+        return new_params, new_state
+
+
+class Adam(OptimMethod):
+    """optim/Adam.scala."""
+
+    def __init__(self, learning_rate=1e-3, learning_rate_decay=0.0,
+                 beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 learning_rate_schedule=None):
+        super().__init__()
+        self.lr = learning_rate
+        self.lr_decay = learning_rate_decay
+        self.beta1, self.beta2, self.eps = beta1, beta2, epsilon
+        self.schedule = learning_rate_schedule or Default()
+
+    def init_state(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "m": _tmap(jnp.zeros_like, params),
+                "v": _tmap(jnp.zeros_like, params)}
+
+    def get_learning_rate(self, state):
+        step = state["step"]
+        return self.schedule.rate(self, step) / (1.0 + step * self.lr_decay)
+
+    def update(self, grads, params, state):
+        step = state["step"]
+        t = step + 1
+        clr = self.schedule.rate(self, step) / (1.0 + step * self.lr_decay)
+        m = _tmap(lambda m_, g: self.beta1 * m_ + (1 - self.beta1) * g,
+                  state["m"], grads)
+        v = _tmap(lambda v_, g: self.beta2 * v_ + (1 - self.beta2) * g * g,
+                  state["v"], grads)
+        bc1 = 1.0 - self.beta1 ** t.astype(jnp.float32)
+        bc2 = 1.0 - self.beta2 ** t.astype(jnp.float32)
+        new_params = _tmap(
+            lambda p, m_, v_: p - (clr * (m_ / bc1)
+                                   / (jnp.sqrt(v_ / bc2) + self.eps)).astype(p.dtype),
+            params, m, v)
+        return new_params, {"step": t, "m": m, "v": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay Adam (TPU-era extra for the transformer flagship)."""
+
+    def __init__(self, learning_rate=1e-3, weight_decay=0.01, **kw):
+        super().__init__(learning_rate=learning_rate, **kw)
+        self.weight_decay = weight_decay
+
+    def update(self, grads, params, state):
+        clr = self.get_learning_rate(state)
+        new_params, new_state = super().update(grads, params, state)
+        new_params = _tmap(
+            lambda np_, p: np_ - clr * self.weight_decay * p, new_params, params)
+        return new_params, new_state
+
+
+class Adagrad(OptimMethod):
+    """optim/Adagrad.scala."""
+
+    def __init__(self, learning_rate=1e-3, learning_rate_decay=0.0,
+                 weight_decay=0.0):
+        super().__init__()
+        self.lr = learning_rate
+        self.lr_decay = learning_rate_decay
+        self.weight_decay = weight_decay
+
+    def init_state(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "accum": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state):
+        step = state["step"]
+        clr = self.lr / (1.0 + step * self.lr_decay)
+        if self.weight_decay > 0:
+            grads = _tmap(lambda g, p: g + self.weight_decay * p, grads, params)
+        accum = _tmap(lambda a, g: a + g * g, state["accum"], grads)
+        new_params = _tmap(
+            lambda p, g, a: p - clr * g / (jnp.sqrt(a) + 1e-10),
+            params, grads, accum)
+        return new_params, {"step": step + 1, "accum": accum}
+
+
+class Adadelta(OptimMethod):
+    """optim/Adadelta.scala (decayRate rho, epsilon)."""
+
+    def __init__(self, decay_rate=0.9, epsilon=1e-10):
+        super().__init__()
+        self.rho = decay_rate
+        self.eps = epsilon
+
+    def init_state(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "accum_g": _tmap(jnp.zeros_like, params),
+                "accum_dx": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state):
+        ag = _tmap(lambda a, g: self.rho * a + (1 - self.rho) * g * g,
+                   state["accum_g"], grads)
+        dx = _tmap(
+            lambda g, a, ad: -g * jnp.sqrt(ad + self.eps) / jnp.sqrt(a + self.eps),
+            grads, ag, state["accum_dx"])
+        adx = _tmap(lambda a, d: self.rho * a + (1 - self.rho) * d * d,
+                    state["accum_dx"], dx)
+        new_params = _tmap(jnp.add, params, dx)
+        return new_params, {"step": state["step"] + 1,
+                            "accum_g": ag, "accum_dx": adx}
+
+
+class Adamax(OptimMethod):
+    """optim/Adamax.scala."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-38):
+        super().__init__()
+        self.lr = learning_rate
+        self.beta1, self.beta2, self.eps = beta1, beta2, epsilon
+
+    def init_state(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "m": _tmap(jnp.zeros_like, params),
+                "u": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state):
+        t = state["step"] + 1
+        m = _tmap(lambda m_, g: self.beta1 * m_ + (1 - self.beta1) * g,
+                  state["m"], grads)
+        u = _tmap(lambda u_, g: jnp.maximum(self.beta2 * u_,
+                                            jnp.abs(g) + self.eps),
+                  state["u"], grads)
+        bc = 1.0 - self.beta1 ** t.astype(jnp.float32)
+        new_params = _tmap(lambda p, m_, u_: p - (self.lr / bc) * m_ / u_,
+                           params, m, u)
+        return new_params, {"step": t, "m": m, "u": u}
+
+
+class RMSprop(OptimMethod):
+    """optim/RMSprop.scala."""
+
+    def __init__(self, learning_rate=1e-2, learning_rate_decay=0.0,
+                 decay_rate=0.99, epsilon=1e-8):
+        super().__init__()
+        self.lr = learning_rate
+        self.lr_decay = learning_rate_decay
+        self.rho = decay_rate
+        self.eps = epsilon
+
+    def init_state(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "accum": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state):
+        step = state["step"]
+        clr = self.lr / (1.0 + step * self.lr_decay)
+        accum = _tmap(lambda a, g: self.rho * a + (1 - self.rho) * g * g,
+                      state["accum"], grads)
+        new_params = _tmap(
+            lambda p, g, a: p - clr * g / (jnp.sqrt(a) + self.eps),
+            params, grads, accum)
+        return new_params, {"step": step + 1, "accum": accum}
+
+
+class Ftrl(OptimMethod):
+    """FTRL-proximal (optim/Ftrl.scala)."""
+
+    def __init__(self, learning_rate=1e-3, learning_rate_power=-0.5,
+                 initial_accumulator_value=0.1, l1_regularization_strength=0.0,
+                 l2_regularization_strength=0.0,
+                 l2_shrinkage_regularization_strength=0.0):
+        super().__init__()
+        self.lr = learning_rate
+        self.lr_power = learning_rate_power
+        self.init_accum = initial_accumulator_value
+        self.l1 = l1_regularization_strength
+        self.l2 = l2_regularization_strength
+        self.l2_shrinkage = l2_shrinkage_regularization_strength
+
+    def init_state(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "accum": _tmap(lambda p: jnp.full_like(p, self.init_accum),
+                               params),
+                "linear": _tmap(jnp.zeros_like, params)}
+
+    def update(self, grads, params, state):
+        def upd(p, g, a, l):
+            gs = g + 2 * self.l2_shrinkage * p
+            a2 = a + g * g
+            sigma = (a2 ** (-self.lr_power) - a ** (-self.lr_power)) / self.lr
+            l2_ = l + gs - sigma * p
+            quad = a2 ** (-self.lr_power) / self.lr + 2 * self.l2
+            pre = jnp.clip(l2_, -self.l1, self.l1) - l2_
+            p2 = jnp.where(jnp.abs(l2_) > self.l1, pre / quad, 0.0)
+            return p2, a2, l2_
+
+        flat_p, tree = jax.tree_util.tree_flatten(params)
+        flat_g = jax.tree_util.tree_leaves(grads)
+        flat_a = jax.tree_util.tree_leaves(state["accum"])
+        flat_l = jax.tree_util.tree_leaves(state["linear"])
+        outs = [upd(p, g, a, l) for p, g, a, l in
+                zip(flat_p, flat_g, flat_a, flat_l)]
+        new_params = jax.tree_util.tree_unflatten(tree, [o[0] for o in outs])
+        accum = jax.tree_util.tree_unflatten(tree, [o[1] for o in outs])
+        linear = jax.tree_util.tree_unflatten(tree, [o[2] for o in outs])
+        return new_params, {"step": state["step"] + 1, "accum": accum,
+                            "linear": linear}
+
+
+class LBFGS(OptimMethod):
+    """L-BFGS with optional line search (optim/LBFGS.scala).
+
+    Host-driven (history management is inherently sequential); the inner
+    feval is still jitted by the caller.  Uses the stateful optimize()
+    interface only, like the reference (DistriOptimizer never uses LBFGS
+    on partitions > 1).
+    """
+
+    def __init__(self, max_iter=20, max_eval=None, tolerance_fun=1e-5,
+                 tolerance_x=1e-9, n_correction=100, learning_rate=1.0,
+                 line_search=False):
+        super().__init__()
+        self.max_iter = max_iter
+        self.max_eval = max_eval or int(max_iter * 1.25)
+        self.tol_fun = tolerance_fun
+        self.tol_x = tolerance_x
+        self.m = n_correction
+        self.lr = learning_rate
+
+    def optimize(self, feval, x):
+        flat, tree = jax.tree_util.tree_flatten(x)
+        shapes = [p.shape for p in flat]
+        sizes = [p.size for p in flat]
+
+        def pack(leaves):
+            return jnp.concatenate([jnp.ravel(l) for l in leaves])
+
+        def unpack(vec):
+            out, off = [], 0
+            for s, n in zip(shapes, sizes):
+                out.append(vec[off:off + n].reshape(s))
+                off += n
+            return jax.tree_util.tree_unflatten(tree, out)
+
+        def f(vec):
+            loss, grad = feval(unpack(vec))
+            return loss, pack(jax.tree_util.tree_leaves(grad))
+
+        xv = pack(flat)
+        loss, g = f(xv)
+        losses = [float(loss)]
+        s_hist, y_hist, rho_hist = [], [], []
+        prev_g = g
+        d = -g
+        for it in range(self.max_iter):
+            # two-loop recursion
+            q = -g
+            alphas = []
+            for s, y, rho in zip(reversed(s_hist), reversed(y_hist),
+                                 reversed(rho_hist)):
+                a = rho * jnp.dot(s, q)
+                alphas.append(a)
+                q = q - a * y
+            if y_hist:
+                gamma = (jnp.dot(s_hist[-1], y_hist[-1])
+                         / jnp.maximum(jnp.dot(y_hist[-1], y_hist[-1]), 1e-10))
+                q = q * gamma
+            for (s, y, rho), a in zip(zip(s_hist, y_hist, rho_hist),
+                                      reversed(alphas)):
+                b = rho * jnp.dot(y, q)
+                q = q + (a - b) * s
+            d = q
+            # Armijo backtracking line search (≙ LineSearch.scala lswolfe's
+            # sufficient-decrease half): guarantees monotone descent, so the
+            # raw -g first step can't oscillate on stiff quadratics.
+            gd = float(jnp.dot(g, d))
+            t = self.lr
+            loss_new, g_new = f(xv + t * d)
+            while (float(loss_new) > float(loss) + 1e-4 * t * gd
+                   and t > 1e-10):
+                t *= 0.5
+                loss_new, g_new = f(xv + t * d)
+            x_new = xv + t * d
+            s = x_new - xv
+            y = g_new - g
+            ys = jnp.dot(y, s)
+            if float(ys) > 1e-10:
+                if len(s_hist) >= self.m:
+                    s_hist.pop(0)
+                    y_hist.pop(0)
+                    rho_hist.pop(0)
+                s_hist.append(s)
+                y_hist.append(y)
+                rho_hist.append(1.0 / ys)
+            delta = abs(float(loss_new) - float(loss))
+            xv, g, loss = x_new, g_new, loss_new
+            losses.append(float(loss))
+            self.nevals += 1
+            if delta < self.tol_fun or float(jnp.max(jnp.abs(t * d))) < self.tol_x:
+                break
+        return unpack(xv), losses

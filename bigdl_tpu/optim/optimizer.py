@@ -1,0 +1,431 @@
+"""Training driver (≙ optim/Optimizer.scala, LocalOptimizer.scala).
+
+The reference LocalOptimizer splits each MiniBatch across Engine threads,
+runs per-clone fwd/bwd, sums gradients, then applies the OptimMethod.  On
+TPU the whole thing is ONE jitted XLA program per iteration:
+
+    (params, opt_state, model_state, x, y, rng)
+        -> fwd -> loss -> bwd (AD) -> optimizer update
+
+with buffers donated (in-place HBM update, no copies) and optional bf16
+compute (master weights stay fp32; layers cast weights to the input dtype,
+so feeding bf16 inputs runs matmuls/convs on the MXU in bf16).
+
+Host-side, the Optimizer drives epochs/iterations, fires Triggers for
+validation / checkpoint / summaries, and supports checkpoint-resume — the
+failure-recovery analogue of DistriOptimizer's retry-from-cache
+(DistriOptimizer.scala optimize() retry loop).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.module import Ctx, Module
+from ..data.dataset import DataSet
+from ..data.minibatch import MiniBatch
+from .optim_method import OptimMethod, SGD
+from .trigger import Trigger
+from .validation import ValidationMethod
+
+
+@dataclass
+class TrainingState:
+    epoch: int = 1
+    iteration: int = 0
+    loss: Optional[float] = None
+    score: Optional[float] = None
+    epoch_finished: bool = False
+
+
+class Metrics:
+    """Per-iteration timing/throughput (≙ optim/Metrics.scala)."""
+
+    def __init__(self):
+        self.values: Dict[str, List[float]] = {}
+
+    def add(self, key, value):
+        self.values.setdefault(key, []).append(value)
+
+    def mean(self, key):
+        v = self.values.get(key, [])
+        return sum(v) / len(v) if v else 0.0
+
+    def summary(self):
+        return {k: self.mean(k) for k in self.values}
+
+
+def make_train_step(model: Module, criterion, optim_method: OptimMethod,
+                    mixed_precision=False, extra_loss_fn=None):
+    """Build the pure fused train step; caller jits (and shard_maps) it."""
+
+    def step(params, opt_state, model_state, x, y, rng):
+        if mixed_precision:
+            x = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, x)
+
+        def loss_fn(p):
+            ctx = Ctx(state=model_state, training=True, rng_key=rng)
+            out = model.apply(p, x, ctx)
+            out32 = jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32)
+                if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating)
+                else a, out)
+            loss = criterion.loss(out32, y)
+            for sl in ctx.side_losses:
+                loss = loss + sl
+            loss = loss + model.regularization_loss(p)
+            if extra_loss_fn is not None:
+                loss = loss + extra_loss_fn(p)
+            return loss, ctx.new_state
+
+        (loss, state_updates), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params, new_opt_state = optim_method.update(grads, params,
+                                                        opt_state)
+        merged = dict(model_state)
+        merged.update(state_updates)
+        return new_params, new_opt_state, merged, loss
+
+    return step
+
+
+def make_eval_step(model: Module):
+    def step(params, model_state, x):
+        ctx = Ctx(state=model_state, training=False, rng_key=None)
+        return model.apply(params, x, ctx)
+    return step
+
+
+class Optimizer:
+    """Base training driver; factory returns Local or Distri optimizer
+    (≙ optim/Optimizer.scala apply)."""
+
+    def __init__(self, model: Module, training_set, criterion,
+                 batch_size: Optional[int] = None, seed: int = 0):
+        if isinstance(training_set, tuple):
+            x, y = training_set
+            if batch_size is None:
+                raise ValueError("batch_size required for array data")
+            training_set = DataSet.minibatch_arrays(x, y, batch_size)
+        self.model = model
+        self.dataset: DataSet = training_set
+        self.criterion = criterion
+        self.optim_method: OptimMethod = SGD()
+        self.end_when: Trigger = Trigger.max_epoch(1)
+        self.seed = seed
+        # validation
+        self.val_trigger: Optional[Trigger] = None
+        self.val_dataset: Optional[DataSet] = None
+        self.val_methods: Optional[List[ValidationMethod]] = None
+        # checkpoint
+        self.checkpoint_path: Optional[str] = None
+        self.checkpoint_trigger: Optional[Trigger] = None
+        # summaries
+        self.train_summary = None
+        self.val_summary = None
+        self.metrics = Metrics()
+        self.state = TrainingState()
+        self.mixed_precision = False
+        self._grad_clip_norm = None
+        self._grad_clip_const = None
+
+    # -- fluent config, reference API ----------------------------------- #
+    def set_optim_method(self, method):
+        self.optim_method = method
+        return self
+
+    def set_end_when(self, trigger):
+        self.end_when = trigger
+        return self
+
+    def set_validation(self, trigger, dataset, methods, batch_size=None):
+        self.val_trigger = trigger
+        if isinstance(dataset, tuple):
+            x, y = dataset
+            dataset = DataSet.minibatch_arrays(x, y, batch_size or 128,
+                                               shuffle=False, drop_last=False)
+        self.val_dataset = dataset
+        self.val_methods = list(methods)
+        return self
+
+    def set_checkpoint(self, path, trigger=None):
+        self.checkpoint_path = path
+        self.checkpoint_trigger = trigger or Trigger.every_epoch()
+        os.makedirs(path, exist_ok=True)
+        return self
+
+    def set_train_summary(self, summary):
+        self.train_summary = summary
+        return self
+
+    def set_val_summary(self, summary):
+        self.val_summary = summary
+        return self
+
+    def set_mixed_precision(self, enabled=True):
+        self.mixed_precision = enabled
+        return self
+
+    def set_gradient_clipping_by_l2_norm(self, clip_norm):
+        self._grad_clip_norm = clip_norm
+        return self
+
+    def set_constant_gradient_clipping(self, min_v, max_v):
+        self._grad_clip_const = (min_v, max_v)
+        return self
+
+    # -- checkpointing (≙ Optimizer.saveCheckpoint / resume) ------------- #
+    def save_checkpoint(self, params, opt_state, model_state, tag=None):
+        if self.checkpoint_path is None:
+            return
+        tag = tag or f"iter_{self.state.iteration}"
+        path = os.path.join(self.checkpoint_path, f"checkpoint_{tag}.bin")
+        host = jax.tree_util.tree_map(np.asarray,
+                                      (params, opt_state, model_state))
+        meta = {"epoch": self.state.epoch, "iteration": self.state.iteration}
+        with open(path, "wb") as f:
+            pickle.dump({"state": host, "meta": meta}, f)
+        latest = os.path.join(self.checkpoint_path, "latest")
+        with open(latest, "w") as f:
+            f.write(path)
+
+    def load_checkpoint(self):
+        latest = os.path.join(self.checkpoint_path, "latest")
+        if not os.path.exists(latest):
+            return None
+        with open(latest) as f:
+            path = f.read().strip()
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        self.state.epoch = blob["meta"]["epoch"]
+        self.state.iteration = blob["meta"]["iteration"]
+        return jax.tree_util.tree_map(jnp.asarray, blob["state"])
+
+    # -- validation ------------------------------------------------------ #
+    def _validate(self, params, model_state):
+        if self.val_dataset is None or not self.val_methods:
+            return None
+        # jit once per optimizer: rebuilding the closure each call would
+        # recompile the full eval program at every validation trigger
+        if not hasattr(self, "_eval_step") or self._eval_step is None:
+            self._eval_step = jax.jit(make_eval_step(self.model))
+        eval_step = self._eval_step
+        results = [None] * len(self.val_methods)
+        for mb in self.val_dataset.data(train=False):
+            x, y = _mb_to_arrays(mb)
+            out = eval_step(params, model_state, x)
+            for i, method in enumerate(self.val_methods):
+                r = method(out, y)
+                results[i] = r if results[i] is None else results[i] + r
+        named = list(zip(self.val_methods, results))
+        for method, res in named:
+            print(f"  [validation] {method}: {res}")
+            if self.val_summary is not None and res is not None:
+                v, _ = res.result()
+                self.val_summary.add_scalar(method.name, v,
+                                            self.state.iteration)
+        if named and named[0][1] is not None:
+            self.state.score = named[0][1].result()[0]
+        return named
+
+    # -- hooks overridden by DistriOptimizer ----------------------------- #
+    def _wrap_optim(self, params):
+        """Apply gradient-clipping wrapper around the user's OptimMethod."""
+        optim = self.optim_method
+        if self._grad_clip_norm or self._grad_clip_const:
+            optim = _ClippedOptim(optim, self._grad_clip_norm,
+                                  self._grad_clip_const)
+        return optim
+
+    def _make_step_builder(self, params_template, optim):
+        def build_step():
+            return jax.jit(
+                make_train_step(self.model, self.criterion, optim,
+                                self.mixed_precision),
+                donate_argnums=(0, 1, 2))
+        return build_step
+
+    def _layout_params(self, params):
+        """Place initial params on devices (FSDP shards them)."""
+        return params
+
+    def _place_batch(self, x, y):
+        return x, y
+
+    def _params_for_eval(self, params):
+        return params
+
+    def _banner_suffix(self):
+        return ""
+
+    # -- main loop (shared by Local and Distri optimizers) --------------- #
+    def optimize(self) -> Module:
+        params, model_state = self.model.init_params(self.seed)
+        if self.model._params is not None:
+            params, model_state = self.model._params, self.model._state
+        optim = self._wrap_optim(params)
+        build_step = self._make_step_builder(params, optim)
+        params = self._layout_params(params)
+        opt_state = optim.init_state(params)
+        if self.checkpoint_path:
+            restored = self.load_checkpoint()
+            if restored is not None:
+                params, opt_state, model_state = restored
+
+        step_fn = build_step()
+        rng = jax.random.PRNGKey(self.seed + 13)
+
+        stop = False
+        while not stop:
+            self.state.epoch_finished = False
+            epoch_start = time.time()
+            n_seen = 0
+            data_t = time.time()
+            for mb in self.dataset.data(train=True):
+                wait = time.time() - data_t
+                x, y = _mb_to_arrays(mb)
+                x, y = self._place_batch(x, y)
+                rng, sub = jax.random.split(rng)
+                t0 = time.time()
+                params, opt_state, model_state, loss = step_fn(
+                    params, opt_state, model_state, x, y, sub)
+                # keep `loss` on device: float()ing here would sync the host
+                # with the accelerator every step and stall the input pipeline
+                dispatch = time.time() - t0
+                self.state.iteration += 1
+                self.state.loss = loss
+                n_seen += mb.size()
+                self.metrics.add("data wait time", wait)
+                self.metrics.add("dispatch time", dispatch)
+                if self.train_summary is not None:
+                    self.train_summary.add_scalar("Loss", float(loss),
+                                                  self.state.iteration)
+                    lr = self.optim_method.get_learning_rate(opt_state)
+                    self.train_summary.add_scalar(
+                        "LearningRate", float(lr), self.state.iteration)
+                if self._fire_mid_epoch(params, opt_state, model_state):
+                    stop = True
+                    break
+                data_t = time.time()
+            else:
+                self.state.epoch_finished = True
+                self.state.loss = float(self.state.loss)
+                dur = time.time() - epoch_start
+                thru = n_seen / max(dur, 1e-9)
+                self.metrics.add("throughput", thru)
+                if self.train_summary is not None:
+                    self.train_summary.add_scalar("Throughput", thru,
+                                                  self.state.iteration)
+                print(f"[epoch {self.state.epoch}] loss={self.state.loss:.4f} "
+                      f"({n_seen} samples in {dur:.1f}s, {thru:.1f}/s"
+                      f"{self._banner_suffix()})")
+                if self.val_trigger is not None and self.val_trigger(self.state):
+                    self._validate(self._params_for_eval(params), model_state)
+                if (self.checkpoint_trigger is not None
+                        and self.checkpoint_trigger(self.state)):
+                    self.save_checkpoint(params, opt_state, model_state,
+                                         tag=f"epoch_{self.state.epoch}")
+                # metric-driven schedules (Plateau): factor changes are host
+                # state baked into the trace, so a change forces a re-jit
+                sched = getattr(self.optim_method, "schedule", None)
+                if sched is not None and hasattr(sched, "on_epoch_end"):
+                    before = sched.current_factor
+                    metric = self.state.score if self.state.score is not None \
+                        else self.state.loss
+                    if metric is not None:
+                        sched.on_epoch_end(float(metric))
+                    if sched.current_factor != before:
+                        step_fn = build_step()
+                self.state.epoch += 1
+                if self.end_when(self.state):
+                    stop = True
+
+        self.model.set_params(self._params_for_eval(params), model_state)
+        return self.model
+
+    def _fire_mid_epoch(self, params, opt_state, model_state) -> bool:
+        """iteration-level triggers; returns True if training should end."""
+        st = self.state
+        if self.val_trigger is not None and not isinstance(
+                self.val_trigger, type(Trigger.every_epoch())) \
+                and self.val_trigger(st):
+            self._validate(self._params_for_eval(params), model_state)
+        if (self.checkpoint_trigger is not None
+                and not isinstance(self.checkpoint_trigger,
+                                   type(Trigger.every_epoch()))
+                and self.checkpoint_trigger(st)):
+            self.save_checkpoint(params, opt_state, model_state)
+        return (not isinstance(self.end_when, type(Trigger.max_epoch(1)))
+                and self.end_when(st))
+
+
+class LocalOptimizer(Optimizer):
+    """Single-chip training (≙ optim/LocalOptimizer.scala). The reference's
+    multi-threaded subbatching is replaced by one fused XLA step."""
+
+
+class _ClippedOptim(OptimMethod):
+    """Gradient clipping wrapper (≙ Optimizer.setGradientClipping*).
+
+    `sum_axis` is set when gradients are sharded across a mesh axis (FSDP):
+    the local sum of squares is psum'ed so every shard clips by the GLOBAL
+    L2 norm, matching the replicated-gradient semantics.
+    """
+
+    def __init__(self, inner, clip_norm=None, clip_const=None, sum_axis=None,
+                 sharded_mask=None):
+        super().__init__()
+        self.inner = inner
+        self.clip_norm = clip_norm
+        self.clip_const = clip_const
+        self.sum_axis = sum_axis
+        # bool pytree: which grad leaves are dim-0 shards (summed via psum)
+        # vs fully replicated (counted once)
+        self.sharded_mask = sharded_mask
+
+    def init_state(self, params):
+        return self.inner.init_state(params)
+
+    def get_learning_rate(self, state):
+        return self.inner.get_learning_rate(state)
+
+    def update(self, grads, params, state):
+        if self.clip_const is not None:
+            lo, hi = self.clip_const
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.clip(g, lo, hi), grads)
+        if self.clip_norm is not None:
+            if self.sum_axis is not None and self.sharded_mask is not None:
+                leaves = jax.tree_util.tree_leaves(grads)
+                mask = jax.tree_util.tree_leaves(self.sharded_mask)
+                sq_sh = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                            for g, m in zip(leaves, mask) if m) + 0.0
+                sq_rep = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                             for g, m in zip(leaves, mask) if not m) + 0.0
+                sq = jax.lax.psum(sq_sh, self.sum_axis) + sq_rep
+            else:
+                sq = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                         for g in jax.tree_util.tree_leaves(grads))
+                if self.sum_axis is not None:
+                    sq = jax.lax.psum(sq, self.sum_axis)
+            total = jnp.sqrt(sq)
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(total, 1e-12))
+            grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+        return self.inner.update(grads, params, state)
+
+
+def _mb_to_arrays(mb):
+    if isinstance(mb, MiniBatch):
+        return mb.get_input(), mb.get_target()
+    if isinstance(mb, tuple) and len(mb) == 2:
+        return mb
+    raise TypeError(f"unsupported batch type {type(mb)}")

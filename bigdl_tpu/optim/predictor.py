@@ -1,0 +1,105 @@
+"""Inference drivers (≙ optim/Predictor.scala, LocalPredictor.scala,
+Evaluator.scala, PredictionService.scala).
+
+One jitted batched forward; class prediction adds argmax (+1, labels are
+1-based like the reference).  Evaluator streams ValidationMethods over a
+dataset and merges results, the same reduce the reference does over RDD
+partitions.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.module import Ctx, Module
+from ..data.dataset import DataSet
+from ..data.minibatch import MiniBatch, Sample, samples_to_minibatch
+from .optimizer import make_eval_step, _mb_to_arrays
+from .validation import ValidationMethod
+
+
+class Predictor:
+    def __init__(self, model: Module, batch_size: int = 128):
+        self.model = model
+        self.batch_size = batch_size
+        self._step = jax.jit(make_eval_step(model))
+
+    def _params(self):
+        self.model.ensure_initialized()
+        return self.model._params, self.model._state
+
+    def predict(self, data):
+        """data: array, list of Samples, or DataSet -> stacked outputs."""
+        params, state = self._params()
+        outs = []
+        for x in _iter_inputs(data, self.batch_size):
+            outs.append(np.asarray(self._step(params, state, x)))
+        return np.concatenate(outs, axis=0)
+
+    def predict_class(self, data):
+        scores = self.predict(data)
+        if scores.ndim == 1 or scores.shape[-1] == 1:
+            return (scores.reshape(-1) > 0.5).astype(np.int32) + 1
+        return np.argmax(scores, axis=-1) + 1
+
+
+LocalPredictor = Predictor
+
+
+class Evaluator:
+    """≙ optim/Evaluator.scala: model.evaluate(dataset, methods)."""
+
+    def __init__(self, model: Module, batch_size: int = 128):
+        self.model = model
+        self.batch_size = batch_size
+        self._step = jax.jit(make_eval_step(model))
+
+    def test(self, dataset, methods: Sequence[ValidationMethod]):
+        self.model.ensure_initialized()
+        params, state = self.model._params, self.model._state
+        results = [None] * len(methods)
+        if isinstance(dataset, tuple):
+            x, y = dataset
+            dataset = DataSet.minibatch_arrays(x, y, self.batch_size,
+                                               shuffle=False, drop_last=False)
+        for mb in dataset.data(train=False):
+            x, y = _mb_to_arrays(mb)
+            out = self._step(params, state, x)
+            for i, m in enumerate(methods):
+                r = m(out, y)
+                results[i] = r if results[i] is None else results[i] + r
+        return list(zip(methods, results))
+
+
+class PredictionService:
+    """Thread-safe serving wrapper (≙ optim/PredictionService.scala).  The
+    reference pools module clones; jitted applies are already reentrant, so
+    this just guards the host-side state with a lock."""
+
+    def __init__(self, model: Module, num_threads: int = 1):
+        import threading
+        self.predictor = Predictor(model)
+        self._lock = threading.Lock()
+
+    def predict(self, x):
+        with self._lock:
+            return self.predictor.predict(x)
+
+
+def _iter_inputs(data, batch_size):
+    if isinstance(data, np.ndarray) or isinstance(data, jnp.ndarray):
+        for i in range(0, data.shape[0], batch_size):
+            yield data[i:i + batch_size]
+    elif isinstance(data, DataSet):
+        for mb in data.data(train=False):
+            x, _ = _mb_to_arrays(mb)
+            yield x
+    elif isinstance(data, (list, tuple)) and data and isinstance(data[0], Sample):
+        for i in range(0, len(data), batch_size):
+            mb = samples_to_minibatch(list(data[i:i + batch_size]))
+            yield mb.get_input()
+    else:
+        raise TypeError(f"unsupported predict input {type(data)}")

@@ -1,0 +1,40 @@
+"""Per-layer weight regularizers (≙ optim/Regularizer.scala: L1Regularizer,
+L2Regularizer, L1L2Regularizer).
+
+In the reference these add penalty gradients inside accGradParameters; here
+they are pure penalty functions summed into the training loss by the
+Optimizer (Module.regularization_loss), so the gradient contribution is
+identical but comes from AD.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class Regularizer:
+    def __call__(self, param):
+        raise NotImplementedError
+
+
+class L1L2Regularizer(Regularizer):
+    def __init__(self, l1=0.0, l2=0.0):
+        self.l1 = l1
+        self.l2 = l2
+
+    def __call__(self, param):
+        loss = 0.0
+        if self.l1:
+            loss = loss + self.l1 * jnp.sum(jnp.abs(param))
+        if self.l2:
+            loss = loss + 0.5 * self.l2 * jnp.sum(param * param)
+        return loss
+
+
+class L1Regularizer(L1L2Regularizer):
+    def __init__(self, l1):
+        super().__init__(l1=l1, l2=0.0)
+
+
+class L2Regularizer(L1L2Regularizer):
+    def __init__(self, l2):
+        super().__init__(l1=0.0, l2=l2)

@@ -1,0 +1,205 @@
+"""Validation metrics (≙ optim/ValidationMethod.scala, EvaluateMethods.scala:
+Top1Accuracy, Top5Accuracy, Loss, MAE, HitRatio, NDCG, TreeNNAccuracy).
+
+Each method maps (output, target) -> ValidationResult; results merge across
+batches/shards with `+` exactly like the reference's `ValidationResult.+`.
+The per-batch computation is pure jnp and is jitted by the evaluator; labels
+are 1-based like the reference.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.table import as_list
+
+
+class ValidationResult:
+    def result(self):
+        """(value, count)"""
+        raise NotImplementedError
+
+    def __add__(self, other):
+        raise NotImplementedError
+
+
+class AccuracyResult(ValidationResult):
+    def __init__(self, correct, count):
+        self.correct = int(correct)
+        self.count = int(count)
+
+    def result(self):
+        return (self.correct / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return AccuracyResult(self.correct + other.correct,
+                              self.count + other.count)
+
+    def __repr__(self):
+        v, n = self.result()
+        return f"Accuracy({self.correct}/{n} = {v:.4f})"
+
+    def __eq__(self, other):
+        return (self.correct, self.count) == (other.correct, other.count)
+
+
+class LossResult(ValidationResult):
+    def __init__(self, loss, count):
+        self.loss = float(loss)
+        self.count = int(count)
+
+    def result(self):
+        return (self.loss / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return LossResult(self.loss + other.loss, self.count + other.count)
+
+    def __repr__(self):
+        v, n = self.result()
+        return f"Loss({v:.4f}, count={n})"
+
+
+class ContiguousResult(ValidationResult):
+    """Scalar sum / count result used by MAE, HitRatio, NDCG."""
+
+    def __init__(self, total, count, name="result"):
+        self.total = float(total)
+        self.count = int(count)
+        self._name = name
+
+    def result(self):
+        return (self.total / max(self.count, 1), self.count)
+
+    def __add__(self, other):
+        return ContiguousResult(self.total + other.total,
+                                self.count + other.count, self._name)
+
+    def __repr__(self):
+        v, n = self.result()
+        return f"{self._name}({v:.4f}, count={n})"
+
+
+class ValidationMethod:
+    name = "ValidationMethod"
+
+    def __call__(self, output, target) -> ValidationResult:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return self.name
+
+
+def _class_target(target):
+    t = jnp.asarray(target)
+    if t.ndim >= 2 and t.shape[-1] > 1:
+        # one-hot / probability targets
+        return jnp.argmax(t, axis=-1) + 1
+    return t.reshape(-1).astype(jnp.int32)
+
+
+class Top1Accuracy(ValidationMethod):
+    """optim/ValidationMethod.scala Top1Accuracy — output (B, C) scores,
+    1-based integer targets (or (B,) binary score with threshold as in
+    EvaluateMethods.calcAccuracy)."""
+
+    name = "Top1Accuracy"
+
+    def __call__(self, output, target):
+        output = jnp.asarray(output)
+        t = _class_target(target)
+        if output.ndim == 1 or output.shape[-1] == 1:
+            pred = (output.reshape(-1) > 0.5).astype(jnp.int32) + 1
+        else:
+            pred = jnp.argmax(output.reshape(-1, output.shape[-1]), axis=-1) + 1
+        correct = jnp.sum(pred == t)
+        return AccuracyResult(int(correct), int(t.shape[0]))
+
+
+class Top5Accuracy(ValidationMethod):
+    name = "Top5Accuracy"
+
+    def __call__(self, output, target):
+        output = jnp.asarray(output).reshape(-1, jnp.asarray(output).shape[-1])
+        t = _class_target(target)
+        k = min(5, output.shape[-1])
+        topk = jnp.argsort(-output, axis=-1)[:, :k] + 1
+        correct = jnp.sum(jnp.any(topk == t[:, None], axis=-1))
+        return AccuracyResult(int(correct), int(t.shape[0]))
+
+
+class Loss(ValidationMethod):
+    """Average criterion loss (optim/ValidationMethod.scala Loss)."""
+
+    name = "Loss"
+
+    def __init__(self, criterion=None):
+        from ..nn.criterion import ClassNLLCriterion
+        self.criterion = criterion or ClassNLLCriterion()
+
+    def __call__(self, output, target):
+        l = self.criterion.loss(output, target)
+        n = jnp.asarray(output).shape[0] if hasattr(output, "shape") else 1
+        return LossResult(float(l) * n, n)
+
+
+class MAE(ValidationMethod):
+    """Mean absolute error (optim/ValidationMethod.scala MAE)."""
+
+    name = "MAE"
+
+    def __call__(self, output, target):
+        err = jnp.mean(jnp.abs(jnp.asarray(output) - jnp.asarray(target)))
+        n = jnp.asarray(output).shape[0]
+        return ContiguousResult(float(err) * n, n, "MAE")
+
+
+class HitRatio(ValidationMethod):
+    """HR@k for recommendation (optim/ValidationMethod.scala HitRatio):
+    output is (B,) positive score among negNum negatives per row."""
+
+    name = "HitRatio"
+
+    def __init__(self, k=10, neg_num=100):
+        self.k = k
+        self.neg_num = neg_num
+
+    def __call__(self, output, target):
+        o = jnp.asarray(output).reshape(-1, self.neg_num + 1)
+        # first column is the positive item; hit if its rank < k
+        pos = o[:, 0:1]
+        rank = jnp.sum(o[:, 1:] > pos, axis=-1) + 1
+        hits = jnp.sum(rank <= self.k)
+        return ContiguousResult(float(hits), o.shape[0], "HitRatio")
+
+
+class NDCG(ValidationMethod):
+    """NDCG@k (optim/ValidationMethod.scala NDCG)."""
+
+    name = "NDCG"
+
+    def __init__(self, k=10, neg_num=100):
+        self.k = k
+        self.neg_num = neg_num
+
+    def __call__(self, output, target):
+        o = jnp.asarray(output).reshape(-1, self.neg_num + 1)
+        pos = o[:, 0:1]
+        rank = jnp.sum(o[:, 1:] > pos, axis=-1) + 1
+        gain = jnp.where(rank <= self.k, 1.0 / jnp.log2(rank + 1.0), 0.0)
+        return ContiguousResult(float(jnp.sum(gain)), o.shape[0], "NDCG")
+
+
+class TreeNNAccuracy(ValidationMethod):
+    """Accuracy on the first (root) prediction of a tree-structured output
+    (optim/ValidationMethod.scala TreeNNAccuracy)."""
+
+    name = "TreeNNAccuracy"
+
+    def __call__(self, output, target):
+        o = jnp.asarray(output)
+        o = o[:, 0, :] if o.ndim == 3 else o
+        t = jnp.asarray(target)
+        t = t[:, 0] if t.ndim >= 2 else t
+        pred = jnp.argmax(o, axis=-1) + 1
+        correct = jnp.sum(pred == t.reshape(-1).astype(jnp.int32))
+        return AccuracyResult(int(correct), int(o.shape[0]))

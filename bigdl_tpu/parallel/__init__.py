@@ -1,0 +1,6 @@
+"""bigdl_tpu.parallel — mesh engine & collectives
+(≙ utils/Engine.scala + parameters/ package)."""
+from .mesh import (create_mesh, get_mesh, set_mesh, data_sharding,
+                   replicated, shard_batch, init_distributed)
+from .allreduce import (allreduce_gradients, reduce_scatter_gradients,
+                        allgather_params)

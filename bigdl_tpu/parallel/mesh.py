@@ -1,0 +1,104 @@
+"""Device-mesh engine (≙ utils/Engine.scala + the Spark cluster runtime).
+
+The reference Engine manages executor/core topology for Spark;
+here the topology is a `jax.sharding.Mesh` over TPU chips.  Axes:
+
+  dp    data parallel        (gradient psum — the DistriOptimizer all-reduce)
+  fsdp  sharded data parallel (reduce_scatter + all_gather, ≙ the reference's
+                               *partitioned* AllReduceParameter parameter server)
+  tp    tensor parallel      (megatron-style sharded matmuls)
+  sp    sequence parallel    (ring attention over ICI)
+  pp    pipeline parallel    (microbatched ppermute stages)
+
+Axis order puts dp outermost so its collectives ride DCN across hosts while
+tp/sp stay on intra-slice ICI (the usual pod layout).  On a single host the
+mesh spans the local chips; `virtual_devices(n)` gives an n-device CPU mesh
+for tests (xla_force_host_platform_device_count).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+_current_mesh: Optional[Mesh] = None
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Multi-host init (≙ Spark cluster bring-up). On a TPU pod slice the
+    arguments are auto-detected from the environment."""
+    if num_processes is not None and num_processes > 1:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+
+
+def create_mesh(axes: Optional[Dict[str, int]] = None,
+                devices=None) -> Mesh:
+    """Build a Mesh from {axis_name: size}; -1 sizes one axis from the
+    remaining device count."""
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(axes or {"dp": len(devices)})
+    known = 1
+    wild = None
+    for k, v in axes.items():
+        if v == -1:
+            wild = k
+        else:
+            known *= v
+    if wild is not None:
+        axes[wild] = len(devices) // known
+    total = int(np.prod(list(axes.values())))
+    if total > len(devices):
+        raise ValueError(f"mesh {axes} needs {total} devices, "
+                         f"have {len(devices)}")
+    arr = np.asarray(devices[:total]).reshape(tuple(axes.values()))
+    mesh = Mesh(arr, tuple(axes.keys()))
+    global _current_mesh
+    _current_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Mesh:
+    global _current_mesh
+    if _current_mesh is None:
+        _current_mesh = create_mesh()
+    return _current_mesh
+
+
+def set_mesh(mesh: Mesh):
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def data_sharding(mesh: Mesh, batch_axes: Sequence[str] = ("dp",)):
+    """NamedSharding placing the leading batch dim over the given axes."""
+    axes = [a for a in batch_axes if a in mesh.axis_names]
+    return NamedSharding(mesh, P(tuple(axes) if len(axes) > 1 else axes[0]))
+
+
+def replicated(mesh: Mesh):
+    return NamedSharding(mesh, P())
+
+
+def virtual_devices(n: int = 8):
+    """For tests: require n virtual CPU devices (set via XLA_FLAGS before
+    jax import — see tests/conftest.py)."""
+    devs = jax.devices()
+    if len(devs) < n:
+        raise RuntimeError(
+            f"need {n} devices; set XLA_FLAGS=--xla_force_host_platform_"
+            f"device_count={n} and JAX_PLATFORMS=cpu before importing jax")
+    return devs[:n]
+
+
+def shard_batch(mesh: Mesh, batch, batch_axes: Sequence[str] = ("dp",)):
+    """Device-put a host batch with its leading dim sharded over dp axes —
+    the analogue of one Spark partition landing on each executor."""
+    sharding = data_sharding(mesh, batch_axes)
+    return jax.tree_util.tree_map(
+        lambda a: jax.device_put(a, sharding), batch)

@@ -1,0 +1,2 @@
+"""bigdl_tpu.utils — shared utilities (≙ com.intel.analytics.bigdl.utils)."""
+from .table import Table, T, as_list

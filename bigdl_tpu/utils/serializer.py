@@ -1,0 +1,64 @@
+"""Module persistence (≙ utils/serializer/ModuleSerializer.scala + utils/File.scala).
+
+The reference serializes module topology + weights to a protobuf container.
+Here the topology is plain Python (module classes are importable), so
+save_module pickles the module object with all device arrays converted to
+host numpy; load_module restores and re-uploads lazily on first use.
+A versioned header guards format drift.
+"""
+from __future__ import annotations
+
+import pickle
+
+import jax
+import numpy as np
+
+MAGIC = b"BIGDLTPU"
+VERSION = 1
+
+
+def _to_host(tree):
+    return jax.tree_util.tree_map(np.asarray, tree)
+
+
+def _to_device(tree):
+    import jax.numpy as jnp
+    return jax.tree_util.tree_map(jnp.asarray, tree)
+
+
+def save_module(module, path, overwrite=True):
+    import os
+    if os.path.exists(path) and not overwrite:
+        raise FileExistsError(path)
+    params = module._params
+    state = module._state
+    # detach device arrays before pickling the object graph
+    module._params, module._state = None, {}
+    try:
+        blob = {
+            "module": module,
+            "params": None if params is None else _to_host(params),
+            "state": _to_host(state or {}),
+        }
+        with open(path, "wb") as f:
+            f.write(MAGIC)
+            f.write(VERSION.to_bytes(2, "little"))
+            pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
+    finally:
+        module._params, module._state = params, state
+
+
+def load_module(path):
+    with open(path, "rb") as f:
+        magic = f.read(len(MAGIC))
+        if magic != MAGIC:
+            raise ValueError(f"{path}: not a bigdl_tpu module file")
+        version = int.from_bytes(f.read(2), "little")
+        if version > VERSION:
+            raise ValueError(f"{path}: unsupported version {version}")
+        blob = pickle.load(f)
+    module = blob["module"]
+    if blob["params"] is not None:
+        module._params = _to_device(blob["params"])
+    module._state = _to_device(blob["state"])
+    return module

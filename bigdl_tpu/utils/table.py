@@ -1,0 +1,89 @@
+"""Table activity type.
+
+The reference framework's ``Activity`` is either a Tensor or a ``Table``
+(com.intel.analytics.bigdl.utils.Table), a 1-indexed heterogeneous container
+threaded through multi-input/multi-output layers (utils/Table.scala).
+
+On TPU we represent activities as JAX pytrees.  ``Table`` is a thin list
+wrapper registered as a pytree node so it can flow through ``jit``/``grad``
+unchanged, while keeping the reference's 1-based indexing convention for
+API parity (``table[1]`` is the first element).
+"""
+from __future__ import annotations
+
+import jax
+
+
+class Table:
+    """1-indexed heterogeneous activity container (pytree)."""
+
+    def __init__(self, *elements):
+        if len(elements) == 1 and isinstance(elements[0], (list, tuple)):
+            elements = tuple(elements[0])
+        self._elems = list(elements)
+
+    # -- 1-based indexing, matching the reference Table --------------------
+    def __getitem__(self, i):
+        if isinstance(i, int):
+            if i < 1 or i > len(self._elems):
+                raise IndexError(f"Table index {i} out of range 1..{len(self._elems)}")
+            return self._elems[i - 1]
+        raise TypeError("Table indices are 1-based ints")
+
+    def __setitem__(self, i, v):
+        if not isinstance(i, int) or i < 1:
+            raise TypeError("Table indices are 1-based ints")
+        while len(self._elems) < i:
+            self._elems.append(None)
+        self._elems[i - 1] = v
+
+    def insert(self, v):
+        self._elems.append(v)
+        return self
+
+    def __len__(self):
+        return len(self._elems)
+
+    def length(self):
+        return len(self._elems)
+
+    def __iter__(self):
+        return iter(self._elems)
+
+    def to_list(self):
+        return list(self._elems)
+
+    def __repr__(self):
+        return f"Table({', '.join(repr(e) for e in self._elems)})"
+
+    def __eq__(self, other):
+        if isinstance(other, Table):
+            return self._elems == other._elems
+        if isinstance(other, (list, tuple)):
+            return self._elems == list(other)
+        return NotImplemented
+
+
+def _table_flatten(t):
+    return tuple(t._elems), None
+
+
+def _table_unflatten(aux, children):
+    return Table(*children)
+
+
+jax.tree_util.register_pytree_node(Table, _table_flatten, _table_unflatten)
+
+
+def T(*elements):
+    """Constructor alias matching the reference's ``T()`` helper."""
+    return Table(*elements)
+
+
+def as_list(activity):
+    """Normalize an activity (Table | list | tuple | array) to a python list."""
+    if isinstance(activity, Table):
+        return activity.to_list()
+    if isinstance(activity, (list, tuple)):
+        return list(activity)
+    return [activity]

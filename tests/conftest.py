@@ -1,0 +1,24 @@
+"""Test env: force a virtual 8-device CPU mesh.
+
+Multi-chip sharding is validated on virtual CPU devices
+(xla_force_host_platform_device_count=8); the driver dry-runs the real TPU
+path separately.  The environment ships an 'axon' TPU PJRT plugin that is
+force-registered via sitecustomize (jax is already imported with
+JAX_PLATFORMS=axon by the time conftest runs) and its client init opens a
+network tunnel — retarget jax to CPU and drop the axon backend factory so
+tests never touch the tunnel.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass

@@ -1,0 +1,52 @@
+"""Finite-difference gradient checker (≙ test GradientChecker.scala)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def check_gradients(module, x, seed=0, eps=1e-3, rtol=2e-2, atol=1e-3,
+                    n_probe=6):
+    """Compare jax.vjp grads of sum(module(x)) against central differences
+    on a few random coordinates of input and params."""
+    params, state = module.init_params(seed)
+    rng = jax.random.PRNGKey(seed + 1)
+
+    def f(p, inp):
+        y, _ = module.run(p, inp, state=state, training=False, rng=rng)
+        return jnp.sum(y)
+
+    g_params, g_x = jax.grad(f, argnums=(0, 1))(params, x)
+    rnd = np.random.RandomState(seed)
+
+    # probe input coords (single-tensor inputs only)
+    xf = None if isinstance(x, (list, tuple)) else np.asarray(x, dtype=np.float64)
+    for _ in range(0 if xf is None else n_probe):
+        idx = tuple(rnd.randint(0, s) for s in xf.shape)
+        xp, xm = xf.copy(), xf.copy()
+        xp[idx] += eps
+        xm[idx] -= eps
+        fd = (float(f(params, jnp.asarray(xp, x.dtype)))
+              - float(f(params, jnp.asarray(xm, x.dtype)))) / (2 * eps)
+        an = float(np.asarray(g_x)[idx])
+        assert abs(fd - an) <= atol + rtol * max(abs(fd), abs(an)), \
+            f"input grad mismatch at {idx}: fd={fd} vs ad={an}"
+
+    # probe param coords
+    leaves, tree = jax.tree_util.tree_flatten(params)
+    g_leaves = jax.tree_util.tree_leaves(g_params)
+    for li, (leaf, gleaf) in enumerate(zip(leaves, g_leaves)):
+        lf = np.asarray(leaf, dtype=np.float64)
+        if lf.size == 0:
+            continue
+        idx = tuple(rnd.randint(0, s) for s in lf.shape)
+        lp, lm = lf.copy(), lf.copy()
+        lp[idx] += eps
+        lm[idx] -= eps
+        pp = jax.tree_util.tree_unflatten(
+            tree, leaves[:li] + [jnp.asarray(lp, leaf.dtype)] + leaves[li + 1:])
+        pm = jax.tree_util.tree_unflatten(
+            tree, leaves[:li] + [jnp.asarray(lm, leaf.dtype)] + leaves[li + 1:])
+        fd = (float(f(pp, x)) - float(f(pm, x))) / (2 * eps)
+        an = float(np.asarray(gleaf)[idx])
+        assert abs(fd - an) <= atol + rtol * max(abs(fd), abs(an)), \
+            f"param grad mismatch leaf {li} at {idx}: fd={fd} vs ad={an}"

@@ -1,0 +1,139 @@
+"""Criterion numerics vs NumPy references (≙ nn/*CriterionSpec.scala)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import T
+
+
+def test_class_nll():
+    logp = jnp.log(jnp.asarray([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]]))
+    target = jnp.asarray([1, 2])  # 1-based
+    c = nn.ClassNLLCriterion()
+    expected = -(np.log(0.7) + np.log(0.8)) / 2
+    assert abs(float(c.forward(logp, target)) - expected) < 1e-4
+    g = c.backward(logp, target)
+    assert g.shape == logp.shape
+
+
+def test_cross_entropy_equals_logsoftmax_nll():
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 5))
+    t = jnp.asarray([1, 3, 5, 2])
+    ce = nn.CrossEntropyCriterion()
+    nll = nn.ClassNLLCriterion()
+    assert abs(float(ce.forward(x, t))
+               - float(nll.forward(jax.nn.log_softmax(x, -1), t))) < 1e-4
+
+
+def test_mse():
+    c = nn.MSECriterion()
+    a, b = jnp.asarray([[1., 2.]]), jnp.asarray([[0., 0.]])
+    assert abs(float(c.forward(a, b)) - 2.5) < 1e-5
+    c2 = nn.MSECriterion(size_average=False)
+    assert abs(float(c2.forward(a, b)) - 5.0) < 1e-5
+
+
+def test_abs_criterion():
+    c = nn.AbsCriterion()
+    assert abs(float(c.forward(jnp.asarray([1., -2.]),
+                               jnp.asarray([0., 0.]))) - 1.5) < 1e-5
+
+
+def test_bce():
+    c = nn.BCECriterion()
+    o = jnp.asarray([0.9, 0.1])
+    t = jnp.asarray([1.0, 0.0])
+    expected = -np.mean([np.log(0.9), np.log(0.9)])
+    assert abs(float(c.forward(o, t)) - expected) < 1e-4
+
+
+def test_smooth_l1():
+    c = nn.SmoothL1Criterion()
+    o = jnp.asarray([0.5, 3.0])
+    t = jnp.asarray([0.0, 0.0])
+    expected = (0.5 * 0.25 + 2.5) / 2
+    assert abs(float(c.forward(o, t)) - expected) < 1e-5
+
+
+def test_margin():
+    c = nn.MarginCriterion()
+    o = jnp.asarray([0.5, -0.2])
+    t = jnp.asarray([1.0, -1.0])
+    expected = ((1 - 0.5) + (1 - 0.2)) / 2
+    assert abs(float(c.forward(o, t)) - expected) < 1e-5
+
+
+def test_kld_vae():
+    c = nn.KLDCriterion()
+    mean = jnp.zeros((2, 3))
+    logvar = jnp.zeros((2, 3))
+    assert abs(float(c.forward(T(mean, logvar), None))) < 1e-5
+
+
+def test_dist_kl_div():
+    c = nn.DistKLDivCriterion()
+    t = jnp.asarray([[0.5, 0.5]])
+    logp = jnp.log(jnp.asarray([[0.5, 0.5]]))
+    assert abs(float(c.forward(logp, t))) < 1e-5
+
+
+def test_parallel_criterion():
+    pc = nn.ParallelCriterion()
+    pc.add(nn.MSECriterion(), 0.5).add(nn.ClassNLLCriterion(), 1.0)
+    out = T(jnp.asarray([[1.0]]), jnp.log(jnp.asarray([[0.6, 0.4]])))
+    tgt = T(jnp.asarray([[0.0]]), jnp.asarray([1]))
+    expected = 0.5 * 1.0 + (-np.log(0.6))
+    assert abs(float(pc.forward(out, tgt)) - expected) < 1e-4
+
+
+def test_multi_criterion():
+    mc = nn.MultiCriterion()
+    mc.add(nn.MSECriterion()).add(nn.AbsCriterion(), 2.0)
+    o, t = jnp.asarray([2.0]), jnp.asarray([0.0])
+    assert abs(float(mc.forward(o, t)) - (4.0 + 2 * 2.0)) < 1e-5
+
+
+def test_time_distributed_criterion():
+    c = nn.TimeDistributedCriterion(nn.MSECriterion(), size_average=True)
+    o = jnp.ones((2, 3, 4))
+    t = jnp.zeros((2, 3, 4))
+    assert abs(float(c.forward(o, t)) - 1.0) < 1e-5
+
+
+def test_multi_margin():
+    c = nn.MultiMarginCriterion()
+    o = jnp.asarray([[0.1, 0.2, 0.7]])
+    t = jnp.asarray([3])
+    expected = (max(0, 1 - 0.7 + 0.1) + max(0, 1 - 0.7 + 0.2)) / 3
+    assert abs(float(c.forward(o, t)) - expected) < 1e-4
+
+
+def test_cosine_embedding():
+    c = nn.CosineEmbeddingCriterion()
+    x1 = jnp.asarray([[1.0, 0.0]])
+    x2 = jnp.asarray([[1.0, 0.0]])
+    assert abs(float(c.forward(T(x1, x2), jnp.asarray([1.0])))) < 1e-5
+
+
+def test_criterion_grads_match_fd():
+    rng = jax.random.PRNGKey(1)
+    for crit, o, t in [
+        (nn.MSECriterion(), jax.random.normal(rng, (3, 4)),
+         jnp.zeros((3, 4))),
+        (nn.CrossEntropyCriterion(), jax.random.normal(rng, (3, 4)),
+         jnp.asarray([1, 2, 4])),
+        (nn.SmoothL1Criterion(), jax.random.normal(rng, (3, 4)),
+         jnp.zeros((3, 4))),
+    ]:
+        g = crit.backward(o, t)
+        eps = 1e-3
+        on = np.asarray(o, np.float64)
+        idx = (1, 2)
+        op, om = on.copy(), on.copy()
+        op[idx] += eps
+        om[idx] -= eps
+        fd = (float(crit.loss(jnp.asarray(op, jnp.float32), t))
+              - float(crit.loss(jnp.asarray(om, jnp.float32), t))) / (2 * eps)
+        assert abs(fd - float(np.asarray(g)[idx])) < 5e-3

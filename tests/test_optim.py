@@ -1,0 +1,113 @@
+"""OptimMethod convergence on a quadratic (≙ optim/*Spec.scala tests on
+rosenbrock/quadratic) + schedule/trigger behavior."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu import optim
+from bigdl_tpu.optim.trigger import Trigger
+from bigdl_tpu.optim.optimizer import TrainingState
+
+
+def quadratic(x):
+    # min at x = [1, 2]
+    target = jnp.asarray([1.0, 2.0])
+    loss = jnp.sum((x["w"] - target) ** 2)
+    return loss, {"w": 2 * (x["w"] - target)}
+
+
+@pytest.mark.parametrize("method,steps,tol", [
+    (optim.SGD(learning_rate=0.1), 200, 1e-3),
+    (optim.SGD(learning_rate=0.05, momentum=0.9), 200, 1e-3),
+    (optim.SGD(learning_rate=0.05, momentum=0.9, nesterov=True,
+               dampening=0.0), 200, 1e-3),
+    (optim.Adam(learning_rate=0.1), 400, 1e-2),
+    (optim.AdamW(learning_rate=0.1, weight_decay=0.0), 400, 1e-2),
+    (optim.Adagrad(learning_rate=0.5), 500, 1e-2),
+    (optim.Adadelta(decay_rate=0.9, epsilon=1e-4), 1500, 0.3),
+    (optim.Adamax(learning_rate=0.2), 500, 1e-2),
+    (optim.RMSprop(learning_rate=0.05), 500, 1e-2),
+    (optim.Ftrl(learning_rate=0.5), 800, 0.05),
+])
+def test_converges_on_quadratic(method, steps, tol):
+    params = {"w": jnp.zeros(2)}
+    state = method.init_state(params)
+    for _ in range(steps):
+        _, g = quadratic(params)
+        params, state = method.update(g, params, state)
+    err = float(jnp.max(jnp.abs(params["w"] - jnp.asarray([1.0, 2.0]))))
+    assert err < tol, f"{type(method).__name__}: err={err}"
+
+
+def test_lbfgs_quadratic():
+    m = optim.LBFGS(max_iter=30)
+    x, losses = m.optimize(quadratic, {"w": jnp.zeros(2)})
+    assert float(jnp.max(jnp.abs(x["w"] - jnp.asarray([1.0, 2.0])))) < 1e-4
+    assert losses[-1] < losses[0]
+
+
+def test_sgd_schedules():
+    m = optim.SGD(learning_rate=1.0,
+                  learning_rate_schedule=optim.Step(10, 0.5))
+    assert abs(float(m.current_lr(0)) - 1.0) < 1e-6
+    assert abs(float(m.current_lr(10)) - 0.5) < 1e-6
+    assert abs(float(m.current_lr(25)) - 0.25) < 1e-6
+
+    m2 = optim.SGD(learning_rate=1.0,
+                   learning_rate_schedule=optim.MultiStep([5, 8], 0.1))
+    assert abs(float(m2.current_lr(4)) - 1.0) < 1e-6
+    assert abs(float(m2.current_lr(6)) - 0.1) < 1e-6
+    assert abs(float(m2.current_lr(9)) - 0.01) < 1e-7
+
+    m3 = optim.SGD(learning_rate=1.0,
+                   learning_rate_schedule=optim.Poly(2.0, 100))
+    assert abs(float(m3.current_lr(50)) - 0.25) < 1e-6
+
+
+def test_warmup_sequential_schedule():
+    sched = optim.SequentialSchedule()
+    sched.add(optim.Warmup(0.1), 5).add(optim.Default(), 100)
+    m = optim.SGD(learning_rate=1.0, learning_rate_schedule=sched)
+    assert abs(float(m.current_lr(0)) - 1.0) < 1e-6
+    assert abs(float(m.current_lr(3)) - 1.3) < 1e-6
+    assert abs(float(m.current_lr(10)) - 1.0) < 1e-6
+
+
+def test_triggers():
+    st = TrainingState(epoch=3, iteration=50, loss=0.1, score=0.9,
+                       epoch_finished=True)
+    assert Trigger.max_epoch(2)(st)
+    assert not Trigger.max_epoch(5)(st)
+    assert Trigger.max_iteration(50)(st)
+    assert Trigger.several_iteration(25)(st)
+    assert not Trigger.several_iteration(7)(st)
+    assert Trigger.min_loss(0.2)(st)
+    assert Trigger.max_score(0.8)(st)
+    assert Trigger.and_(Trigger.max_epoch(2), Trigger.min_loss(0.2))(st)
+    assert Trigger.or_(Trigger.max_epoch(10), Trigger.min_loss(0.2))(st)
+    ee = Trigger.every_epoch()
+    assert ee(st)
+    assert not ee(st)  # fires once per epoch
+
+
+def test_validation_methods():
+    out = jnp.asarray([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    tgt = jnp.asarray([2, 1, 1])
+    r = optim.Top1Accuracy()(out, tgt)
+    assert r.result()[0] == pytest.approx(2 / 3)
+    merged = r + r
+    assert merged.result() == (pytest.approx(2 / 3), 6)
+
+    out5 = jax.nn.one_hot(jnp.asarray([0, 1, 2]), 6)
+    r5 = optim.Top5Accuracy()(out5, jnp.asarray([6, 2, 3]))
+    assert r5.result()[0] == pytest.approx(2 / 3)
+
+    mae = optim.MAE()(jnp.ones((4, 2)), jnp.zeros((4, 2)))
+    assert mae.result()[0] == pytest.approx(1.0)
+
+
+def test_regularizers():
+    w = jnp.asarray([1.0, -2.0])
+    assert abs(float(optim.L1Regularizer(0.1)(w)) - 0.3) < 1e-6
+    assert abs(float(optim.L2Regularizer(0.1)(w)) - 0.25) < 1e-6

@@ -1,0 +1,75 @@
+"""Persistence round-trips (≙ serializer *SerializerSpec.scala tests)."""
+import numpy as np
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils.table import T
+
+
+def test_module_save_load_roundtrip(tmp_path):
+    m = nn.Sequential(nn.Linear(6, 4), nn.BatchNormalization(4), nn.ReLU(),
+                      nn.Linear(4, 2))
+    x = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+    y1 = np.asarray(m.forward(x))
+    path = str(tmp_path / "model.bigdl")
+    m.save(path)
+    m2 = nn.Module.load(path)
+    y2 = np.asarray(m2.forward(x))
+    np.testing.assert_allclose(y1, y2, rtol=1e-6)
+
+
+def test_save_load_preserves_bn_state(tmp_path):
+    m = nn.Sequential(nn.Linear(4, 4), nn.BatchNormalization(4))
+    m.training()
+    x = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+    m.forward(x)
+    path = str(tmp_path / "bn.bigdl")
+    m.save(path)
+    m2 = nn.Module.load(path)
+    bn_name = [mm.name for mm in m.modules()
+               if isinstance(mm, nn.BatchNormalization)][0]
+    np.testing.assert_allclose(
+        np.asarray(m._state[bn_name]["running_mean"]),
+        np.asarray(m2._state[bn_name]["running_mean"]))
+
+
+def test_bad_magic_rejected(tmp_path):
+    p = tmp_path / "junk.bin"
+    p.write_bytes(b"NOTAMODEL")
+    import pytest
+    with pytest.raises(ValueError):
+        nn.Module.load(str(p))
+
+
+def test_weights_roundtrip(tmp_path):
+    m = nn.Linear(5, 3)
+    m.forward(np.ones((1, 5), np.float32))
+    path = str(tmp_path / "w.bin")
+    m.save_weights(path)
+    m2 = nn.Linear(5, 3)
+    m2.load_weights(path)
+    np.testing.assert_allclose(np.asarray(m._params[m.name]["weight"]),
+                               np.asarray(m2._params[m.name]["weight"]))
+
+
+def test_cell_apply_table():
+    cell = nn.LSTM(4, 5)
+    h = cell.zero_hidden(2)
+    out = cell.forward(T(jnp.ones((2, 4)), h))
+    assert out[1].shape == (2, 5)
+
+
+def test_pair_criterion_target_forms():
+    c = nn.L1HingeEmbeddingCriterion(margin=5.0)
+    x = T(jnp.ones((2,)), jnp.zeros((2,)))
+    # similar pair: loss = L1 distance
+    assert abs(float(c.forward(x, jnp.asarray(1.0))) - 2.0) < 1e-5
+    # dissimilar: margin - d
+    assert abs(float(c.forward(x, jnp.asarray(-1.0))) - 3.0) < 1e-5
+    # list-wrapped target
+    assert abs(float(c.forward(x, [jnp.asarray(-1.0)])) - 3.0) < 1e-5
+
+    mr = nn.MarginRankingCriterion()
+    o = T(jnp.asarray([0.5]), jnp.asarray([0.3]))
+    v = float(mr.forward(o, jnp.asarray([1.0])))
+    assert abs(v - max(0, -(0.5 - 0.3) + 1.0)) < 1e-5

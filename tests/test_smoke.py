@@ -1,0 +1,57 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def test_lenet_forward_shape():
+    from bigdl_tpu.models import lenet
+    model = lenet.build(10)
+    params, state = model.init_params(0)
+    x = jnp.ones((4, 28, 28))
+    y, _ = model.run(params, x, state=state)
+    assert y.shape == (4, 10)
+    np.testing.assert_allclose(np.exp(np.asarray(y)).sum(-1), 1.0, rtol=1e-4)
+
+
+def test_lenet_graph_matches_sequential_shapes():
+    from bigdl_tpu.models import lenet
+    g = lenet.build_graph(10)
+    params, state = g.init_params(0)
+    x = jnp.ones((2, 28, 28))
+    y, _ = g.run(params, x, state=state)
+    assert y.shape == (2, 10)
+
+
+def test_torch_shell_forward_backward():
+    from bigdl_tpu import nn
+    m = nn.Sequential(nn.Linear(8, 4), nn.ReLU(), nn.Linear(4, 2))
+    x = jnp.ones((3, 8))
+    y = m.forward(x)
+    assert y.shape == (3, 2)
+    gi = m.backward(x, jnp.ones_like(y))
+    assert gi.shape == x.shape
+    assert m.grad_params is not None
+
+
+def test_lenet_batch_size_one():
+    # Reshape batch inference must keep the batch dim when B=1
+    from bigdl_tpu.models import lenet
+    model = lenet.build(10)
+    params, state = model.init_params(0)
+    y, _ = model.run(params, jnp.ones((1, 1, 28, 28)), state=state)
+    assert y.shape == (1, 10)
+    y2, _ = model.run(params, jnp.ones((1, 28, 28)), state=state)
+    assert y2.shape == (1, 10)
+
+
+def test_grouped_full_convolution():
+    from bigdl_tpu import nn
+    m = nn.SpatialFullConvolution(4, 6, 3, 3, 2, 2, 1, 1, n_group=2)
+    params, state = m.init_params(0)
+    y, _ = m.run(params, jnp.ones((2, 4, 5, 5)), state=state)
+    assert y.shape == (2, 6, 9, 9)
+    m3 = nn.VolumetricFullConvolution(4, 6, 3, 3, 3, 2, 2, 2, 1, 1, 1,
+                                      n_group=2)
+    p3, s3 = m3.init_params(0)
+    y3, _ = m3.run(p3, jnp.ones((1, 4, 5, 5, 5)), state=s3)
+    assert y3.shape == (1, 6, 9, 9, 9)
