@@ -1,2 +1,2 @@
 """bigdl_tpu.models — model zoo (≙ com.intel.analytics.bigdl.models)."""
-from . import lenet, resnet
+from . import autoencoder, inception, lenet, resnet, rnn, vgg

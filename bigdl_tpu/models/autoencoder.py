@@ -1,0 +1,27 @@
+"""MNIST autoencoder (≙ models/autoencoder/Autoencoder.scala).
+
+Reshape → Linear → ReLU → Linear → Sigmoid; two MXU matmuls, trained with
+MSECriterion against the flattened input.
+"""
+from __future__ import annotations
+
+from ..nn import Sequential, Reshape, Linear, ReLU, Sigmoid
+
+ROW_N = 28
+COL_N = 28
+FEATURE_SIZE = ROW_N * COL_N
+
+
+def autoencoder(class_num=32, feature_size=FEATURE_SIZE):
+    """Autoencoder.apply (Autoencoder.scala:28); class_num is the bottleneck
+    width (the reference trains with 32)."""
+    return Sequential(
+        Reshape((feature_size,)),
+        Linear(feature_size, class_num),
+        ReLU(),
+        Linear(class_num, feature_size),
+        Sigmoid())
+
+
+def build(class_num=32, feature_size=FEATURE_SIZE):
+    return autoencoder(class_num, feature_size)

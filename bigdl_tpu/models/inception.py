@@ -1,0 +1,357 @@
+"""GoogLeNet Inception v1 / v2 (≙ models/inception/Inception_v1.scala,
+Inception_v2.scala).
+
+Same topology tables as the reference; built from bigdl_tpu.nn layers whose
+convs lower straight to the MXU (lax.conv_general_dilated, NCHW/OIHW).  The
+aux-classifier variants concatenate the three LogSoftMax heads on the class
+dim exactly like the reference's Concat(2) split1/split2 trick, so
+ClassNLLCriterion-per-head training drivers can slice them back out.
+"""
+from __future__ import annotations
+
+from ..nn import (Sequential, Concat, SpatialConvolution,
+                  SpatialBatchNormalization, SpatialMaxPooling,
+                  SpatialAveragePooling, SpatialCrossMapLRN, ReLU, Dropout,
+                  Linear, LogSoftMax, View, Xavier, Zeros)
+
+
+def _conv(ni, no, kw, kh, sw=1, sh=1, pw=0, ph=0, name=None, bias=True):
+    c = SpatialConvolution(ni, no, kw, kh, sw, sh, pw, ph, with_bias=bias,
+                           name=name)
+    c.set_init_method(Xavier(), Zeros())
+    return c
+
+
+def inception_layer_v1(input_size, config, name_prefix=""):
+    """Inception_Layer_v1.apply (Inception_v1.scala:27): four parallel towers
+    concatenated on the channel dim: 1x1 / 1x1→3x3 / 1x1→5x5 / pool→1x1."""
+    concat = Concat(2)
+    concat.add(Sequential(
+        _conv(input_size, config[0][0], 1, 1, name=name_prefix + "1x1"),
+        ReLU(name=name_prefix + "relu_1x1")))
+    concat.add(Sequential(
+        _conv(input_size, config[1][0], 1, 1, name=name_prefix + "3x3_reduce"),
+        ReLU(name=name_prefix + "relu_3x3_reduce"),
+        _conv(config[1][0], config[1][1], 3, 3, 1, 1, 1, 1,
+              name=name_prefix + "3x3"),
+        ReLU(name=name_prefix + "relu_3x3")))
+    concat.add(Sequential(
+        _conv(input_size, config[2][0], 1, 1, name=name_prefix + "5x5_reduce"),
+        ReLU(name=name_prefix + "relu_5x5_reduce"),
+        _conv(config[2][0], config[2][1], 5, 5, 1, 1, 2, 2,
+              name=name_prefix + "5x5"),
+        ReLU(name=name_prefix + "relu_5x5")))
+    concat.add(Sequential(
+        SpatialMaxPooling(3, 3, 1, 1, 1, 1, name=name_prefix + "pool").ceil(),
+        _conv(input_size, config[3][0], 1, 1, name=name_prefix + "pool_proj"),
+        ReLU(name=name_prefix + "relu_pool_proj")))
+    return concat.set_name(name_prefix + "output")
+
+
+def _stem_v1():
+    # NB: the reference's `SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, 1,
+    # false)` 10th arg is propagateBack, not withBias — conv1 keeps its bias.
+    return [
+        _conv(3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2"),
+        ReLU(name="conv1/relu_7x7"),
+        SpatialMaxPooling(3, 3, 2, 2, name="pool1/3x3_s2").ceil(),
+        SpatialCrossMapLRN(5, 0.0001, 0.75, name="pool1/norm1"),
+        _conv(64, 64, 1, 1, name="conv2/3x3_reduce"),
+        ReLU(name="conv2/relu_3x3_reduce"),
+        _conv(64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3"),
+        ReLU(name="conv2/relu_3x3"),
+        SpatialCrossMapLRN(5, 0.0001, 0.75, name="conv2/norm2"),
+        SpatialMaxPooling(3, 3, 2, 2, name="pool2/3x3_s2").ceil(),
+    ]
+
+
+def inception_v1_no_aux_classifier(class_num, has_dropout=True):
+    """Inception_v1_NoAuxClassifier (Inception_v1.scala:103)."""
+    model = Sequential()
+    for m in _stem_v1():
+        model.add(m)
+    model.add(inception_layer_v1(
+        192, [[64], [96, 128], [16, 32], [32]], "inception_3a/"))
+    model.add(inception_layer_v1(
+        256, [[128], [128, 192], [32, 96], [64]], "inception_3b/"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2, name="pool3/3x3_s2").ceil())
+    model.add(inception_layer_v1(
+        480, [[192], [96, 208], [16, 48], [64]], "inception_4a/"))
+    model.add(inception_layer_v1(
+        512, [[160], [112, 224], [24, 64], [64]], "inception_4b/"))
+    model.add(inception_layer_v1(
+        512, [[128], [128, 256], [24, 64], [64]], "inception_4c/"))
+    model.add(inception_layer_v1(
+        512, [[112], [144, 288], [32, 64], [64]], "inception_4d/"))
+    model.add(inception_layer_v1(
+        528, [[256], [160, 320], [32, 128], [128]], "inception_4e/"))
+    model.add(SpatialMaxPooling(3, 3, 2, 2, name="pool4/3x3_s2").ceil())
+    model.add(inception_layer_v1(
+        832, [[256], [160, 320], [32, 128], [128]], "inception_5a/"))
+    model.add(inception_layer_v1(
+        832, [[384], [192, 384], [48, 128], [128]], "inception_5b/"))
+    model.add(SpatialAveragePooling(7, 7, 1, 1, name="pool5/7x7_s1"))
+    if has_dropout:
+        model.add(Dropout(0.4, name="pool5/drop_7x7_s1"))
+    model.add(View(1024).set_num_input_dims(3))
+    model.add(Linear(1024, class_num, name="loss3/classifier")
+              .set_init_method(Xavier(), Zeros()))
+    model.add(LogSoftMax(name="loss3/loss3"))
+    return model
+
+
+def inception_v1(class_num, has_dropout=True):
+    """Inception_v1 with the two aux classifiers (Inception_v1.scala:190).
+
+    Output is (N, 3*class_num): [loss3 | loss2 | loss1] heads concatenated on
+    the class dim, mirroring the reference's nested Concat(2) wiring.
+    """
+    feature1 = Sequential()
+    for m in _stem_v1():
+        feature1.add(m)
+    feature1.add(inception_layer_v1(
+        192, [[64], [96, 128], [16, 32], [32]], "inception_3a/"))
+    feature1.add(inception_layer_v1(
+        256, [[128], [128, 192], [32, 96], [64]], "inception_3b/"))
+    feature1.add(SpatialMaxPooling(3, 3, 2, 2, name="pool3/3x3_s2").ceil())
+    feature1.add(inception_layer_v1(
+        480, [[192], [96, 208], [16, 48], [64]], "inception_4a/"))
+
+    output1 = Sequential(
+        SpatialAveragePooling(5, 5, 3, 3, name="loss1/ave_pool").ceil(),
+        _conv(512, 128, 1, 1, name="loss1/conv"),
+        ReLU(name="loss1/relu_conv"),
+        View(128 * 4 * 4).set_num_input_dims(3),
+        Linear(128 * 4 * 4, 1024, name="loss1/fc"),
+        ReLU(name="loss1/relu_fc"))
+    if has_dropout:
+        output1.add(Dropout(0.7, name="loss1/drop_fc"))
+    output1.add(Linear(1024, class_num, name="loss1/classifier"))
+    output1.add(LogSoftMax(name="loss1/loss"))
+
+    feature2 = Sequential(
+        inception_layer_v1(512, [[160], [112, 224], [24, 64], [64]],
+                           "inception_4b/"),
+        inception_layer_v1(512, [[128], [128, 256], [24, 64], [64]],
+                           "inception_4c/"),
+        inception_layer_v1(512, [[112], [144, 288], [32, 64], [64]],
+                           "inception_4d/"))
+
+    output2 = Sequential(
+        SpatialAveragePooling(5, 5, 3, 3, name="loss2/ave_pool"),
+        _conv(528, 128, 1, 1, name="loss2/conv"),
+        ReLU(name="loss2/relu_conv"),
+        View(128 * 4 * 4).set_num_input_dims(3),
+        Linear(128 * 4 * 4, 1024, name="loss2/fc"),
+        ReLU(name="loss2/relu_fc"))
+    if has_dropout:
+        output2.add(Dropout(0.7, name="loss2/drop_fc"))
+    output2.add(Linear(1024, class_num, name="loss2/classifier"))
+    output2.add(LogSoftMax(name="loss2/loss"))
+
+    output3 = Sequential(
+        inception_layer_v1(528, [[256], [160, 320], [32, 128], [128]],
+                           "inception_4e/"),
+        SpatialMaxPooling(3, 3, 2, 2, name="pool4/3x3_s2").ceil(),
+        inception_layer_v1(832, [[256], [160, 320], [32, 128], [128]],
+                           "inception_5a/"),
+        inception_layer_v1(832, [[384], [192, 384], [48, 128], [128]],
+                           "inception_5b/"),
+        SpatialAveragePooling(7, 7, 1, 1, name="pool5/7x7_s1"))
+    if has_dropout:
+        output3.add(Dropout(0.4, name="pool5/drop_7x7_s1"))
+    output3.add(View(1024).set_num_input_dims(3))
+    output3.add(Linear(1024, class_num, name="loss3/classifier")
+                .set_init_method(Xavier(), Zeros()))
+    output3.add(LogSoftMax(name="loss3/loss3"))
+
+    split2 = Concat(2, name="split2")
+    split2.add(output3)
+    split2.add(output2)
+    main_branch = Sequential(feature2, split2)
+    split1 = Concat(2, name="split1")
+    split1.add(main_branch)
+    split1.add(output1)
+    return Sequential(feature1, split1)
+
+
+def inception_layer_v2(input_size, config, name_prefix=""):
+    """Inception_Layer_v2.apply (Inception_v2.scala:28): BN towers; tower 2
+    may be strided (config[1][0]==0 → stride-2 reduction block); tower 4 pool
+    type is config[3][0] in {"avg","max"} with optional projection."""
+    concat = Concat(2)
+    if config[0][0] != 0:
+        concat.add(Sequential(
+            _conv(input_size, config[0][0], 1, 1, name=name_prefix + "1x1"),
+            SpatialBatchNormalization(config[0][0], 1e-3,
+                                      name=name_prefix + "1x1/bn"),
+            ReLU(name=name_prefix + "1x1/bn/sc/relu")))
+
+    conv3 = Sequential(
+        _conv(input_size, config[1][0], 1, 1,
+              name=name_prefix + "3x3_reduce"),
+        SpatialBatchNormalization(config[1][0], 1e-3,
+                                  name=name_prefix + "3x3_reduce/bn"),
+        ReLU(name=name_prefix + "3x3_reduce/bn/sc/relu"))
+    if config[0][0] == 0:  # reduction block: stride-2 3x3
+        conv3.add(_conv(config[1][0], config[1][1], 3, 3, 2, 2, 1, 1,
+                        name=name_prefix + "3x3"))
+    else:
+        conv3.add(_conv(config[1][0], config[1][1], 3, 3, 1, 1, 1, 1,
+                        name=name_prefix + "3x3"))
+    conv3.add(SpatialBatchNormalization(config[1][1], 1e-3,
+                                        name=name_prefix + "3x3/bn"))
+    conv3.add(ReLU(name=name_prefix + "3x3/bn/sc/relu"))
+    concat.add(conv3)
+
+    conv3xx = Sequential(
+        _conv(input_size, config[2][0], 1, 1,
+              name=name_prefix + "double3x3_reduce"),
+        SpatialBatchNormalization(config[2][0], 1e-3,
+                                  name=name_prefix + "double3x3_reduce/bn"),
+        ReLU(name=name_prefix + "double3x3_reduce/bn/sc/relu"),
+        _conv(config[2][0], config[2][1], 3, 3, 1, 1, 1, 1,
+              name=name_prefix + "double3x3a"),
+        SpatialBatchNormalization(config[2][1], 1e-3,
+                                  name=name_prefix + "double3x3a/bn"),
+        ReLU(name=name_prefix + "double3x3a/bn/sc/relu"))
+    if config[0][0] == 0:
+        conv3xx.add(_conv(config[2][1], config[2][1], 3, 3, 2, 2, 1, 1,
+                          name=name_prefix + "double3x3b"))
+    else:
+        conv3xx.add(_conv(config[2][1], config[2][1], 3, 3, 1, 1, 1, 1,
+                          name=name_prefix + "double3x3b"))
+    conv3xx.add(SpatialBatchNormalization(config[2][1], 1e-3,
+                                          name=name_prefix + "double3x3b/bn"))
+    conv3xx.add(ReLU(name=name_prefix + "double3x3b/bn/sc/relu"))
+    concat.add(conv3xx)
+
+    pool = Sequential()
+    kind = config[3][0]
+    if kind == "max":
+        if config[0][0] != 0:
+            pool.add(SpatialMaxPooling(3, 3, 1, 1, 1, 1,
+                                       name=name_prefix + "pool").ceil())
+        else:
+            pool.add(SpatialMaxPooling(3, 3, 2, 2,
+                                       name=name_prefix + "pool").ceil())
+    elif kind == "avg":
+        pool.add(SpatialAveragePooling(3, 3, 1, 1, 1, 1,
+                                       name=name_prefix + "pool").ceil())
+    else:
+        raise ValueError(f"unknown pooling kind {kind!r}")
+    if config[3][1] != 0:
+        pool.add(_conv(input_size, config[3][1], 1, 1,
+                       name=name_prefix + "pool_proj"))
+        pool.add(SpatialBatchNormalization(config[3][1], 1e-3,
+                                           name=name_prefix + "pool_proj/bn"))
+        pool.add(ReLU(name=name_prefix + "pool_proj/bn/sc/relu"))
+    concat.add(pool)
+    return concat.set_name(name_prefix + "output")
+
+
+def _stem_v2():
+    return [
+        _conv(3, 64, 7, 7, 2, 2, 3, 3, name="conv1/7x7_s2"),
+        SpatialBatchNormalization(64, 1e-3, name="conv1/7x7_s2/bn"),
+        ReLU(name="conv1/7x7_s2/bn/sc/relu"),
+        SpatialMaxPooling(3, 3, 2, 2, name="pool1/3x3_s2").ceil(),
+        _conv(64, 64, 1, 1, name="conv2/3x3_reduce"),
+        SpatialBatchNormalization(64, 1e-3, name="conv2/3x3_reduce/bn"),
+        ReLU(name="conv2/3x3_reduce/bn/sc/relu"),
+        _conv(64, 192, 3, 3, 1, 1, 1, 1, name="conv2/3x3"),
+        SpatialBatchNormalization(192, 1e-3, name="conv2/3x3/bn"),
+        ReLU(name="conv2/3x3/bn/sc/relu"),
+        SpatialMaxPooling(3, 3, 2, 2, name="pool2/3x3_s2").ceil(),
+    ]
+
+
+_V2_BLOCKS = [
+    (192, [[64], [64, 64], [64, 96], ["avg", 32]], "inception_3a/"),
+    (256, [[64], [64, 96], [64, 96], ["avg", 64]], "inception_3b/"),
+    (320, [[0], [128, 160], [64, 96], ["max", 0]], "inception_3c/"),
+    (576, [[224], [64, 96], [96, 128], ["avg", 128]], "inception_4a/"),
+    (576, [[192], [96, 128], [96, 128], ["avg", 128]], "inception_4b/"),
+    (576, [[160], [128, 160], [128, 160], ["avg", 96]], "inception_4c/"),
+    (576, [[96], [128, 192], [160, 192], ["avg", 96]], "inception_4d/"),
+    (576, [[0], [128, 192], [192, 256], ["max", 0]], "inception_4e/"),
+    (1024, [[352], [192, 320], [160, 224], ["avg", 128]], "inception_5a/"),
+    (1024, [[352], [192, 320], [192, 224], ["max", 128]], "inception_5b/"),
+]
+
+
+def inception_v2_no_aux_classifier(class_num):
+    """Inception_v2_NoAuxClassifier (Inception_v2.scala:186)."""
+    model = Sequential()
+    for m in _stem_v2():
+        model.add(m)
+    for size, cfg, prefix in _V2_BLOCKS:
+        model.add(inception_layer_v2(size, cfg, prefix))
+    model.add(SpatialAveragePooling(7, 7, 1, 1, name="pool5/7x7_s1").ceil())
+    model.add(View(1024).set_num_input_dims(3))
+    model.add(Linear(1024, class_num, name="loss3/classifier"))
+    model.add(LogSoftMax(name="loss3/loss"))
+    return model
+
+
+def inception_v2(class_num):
+    """Inception_v2 with aux classifiers (Inception_v2.scala:276); output is
+    (N, 3*class_num) = [loss3 | loss2 | loss1] like inception_v1."""
+    feature1 = Sequential()
+    for m in _stem_v2():
+        feature1.add(m)
+    for size, cfg, prefix in _V2_BLOCKS[:3]:
+        feature1.add(inception_layer_v2(size, cfg, prefix))
+
+    output1 = Sequential(
+        SpatialAveragePooling(5, 5, 3, 3, name="loss1/ave_pool").ceil(),
+        _conv(576, 128, 1, 1, name="loss1/conv"),
+        SpatialBatchNormalization(128, 1e-3, name="loss1/conv/bn"),
+        ReLU(name="loss1/conv/bn/sc/relu"),
+        View(128 * 4 * 4).set_num_input_dims(3),
+        Linear(128 * 4 * 4, 1024, name="loss1/fc"),
+        ReLU(name="loss1/fc/bn/sc/relu"),
+        Linear(1024, class_num, name="loss1/classifier"),
+        LogSoftMax(name="loss1/loss"))
+
+    feature2 = Sequential()
+    for size, cfg, prefix in _V2_BLOCKS[3:8]:
+        feature2.add(inception_layer_v2(size, cfg, prefix))
+
+    output2 = Sequential(
+        SpatialAveragePooling(5, 5, 3, 3, name="loss2/ave_pool").ceil(),
+        _conv(1024, 128, 1, 1, name="loss2/conv"),
+        SpatialBatchNormalization(128, 1e-3, name="loss2/conv/bn"),
+        ReLU(name="loss2/conv/bn/sc/relu"),
+        View(128 * 2 * 2).set_num_input_dims(3),
+        Linear(128 * 2 * 2, 1024, name="loss2/fc"),
+        ReLU(name="loss2/fc/bn/sc/relu"),
+        Linear(1024, class_num, name="loss2/classifier"),
+        LogSoftMax(name="loss2/loss"))
+
+    output3 = Sequential()
+    for size, cfg, prefix in _V2_BLOCKS[8:]:
+        output3.add(inception_layer_v2(size, cfg, prefix))
+    output3.add(SpatialAveragePooling(7, 7, 1, 1, name="pool5/7x7_s1").ceil())
+    output3.add(View(1024).set_num_input_dims(3))
+    output3.add(Linear(1024, class_num, name="loss3/classifier"))
+    output3.add(LogSoftMax(name="loss3/loss"))
+
+    split2 = Concat(2, name="split2")
+    split2.add(output3)
+    split2.add(output2)
+    main_branch = Sequential(feature2, split2)
+    split1 = Concat(2, name="split1")
+    split1.add(main_branch)
+    split1.add(output1)
+    return Sequential(feature1, split1)
+
+
+def build(class_num=1000, version="v1", aux=False, has_dropout=True):
+    if version == "v1":
+        return (inception_v1(class_num, has_dropout) if aux
+                else inception_v1_no_aux_classifier(class_num, has_dropout))
+    if version == "v2":
+        return (inception_v2(class_num) if aux
+                else inception_v2_no_aux_classifier(class_num))
+    raise ValueError(f"unknown inception version {version!r}")

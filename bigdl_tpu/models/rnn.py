@@ -1,0 +1,30 @@
+"""SimpleRNN language model (≙ models/rnn/SimpleRNN.scala).
+
+Recurrent(RnnCell(tanh)) + TimeDistributed(Linear): the recurrence compiles
+to a single lax.scan step (no per-timestep Python), the time-distributed
+projection is one batched matmul on the MXU.
+"""
+from __future__ import annotations
+
+from ..nn import (Sequential, Recurrent, RnnCell, Tanh, TimeDistributed,
+                  Linear, LogSoftMax)
+
+
+def simple_rnn(input_size, hidden_size, output_size, with_softmax=False):
+    """SimpleRNN.apply (SimpleRNN.scala:24).
+
+    The reference returns raw logits (trained with TimeDistributedCriterion(
+    CrossEntropyCriterion) in rnn/Train.scala); with_softmax=True appends a
+    TimeDistributed(LogSoftMax) for ClassNLLCriterion-style training.
+    """
+    model = Sequential(
+        Recurrent(RnnCell(input_size, hidden_size, Tanh())),
+        TimeDistributed(Linear(hidden_size, output_size)))
+    if with_softmax:
+        model.add(TimeDistributed(LogSoftMax()))
+    return model
+
+
+def build(input_size=4001, hidden_size=40, output_size=4001,
+          with_softmax=False):
+    return simple_rnn(input_size, hidden_size, output_size, with_softmax)

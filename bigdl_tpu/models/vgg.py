@@ -1,0 +1,114 @@
+"""VGG (≙ models/vgg/VggForCifar10.scala) plus standard ImageNet VGG-16/19.
+
+conv-BN-ReLU stacks; every conv is one MXU-bound lax conv via
+nn.SpatialConvolution.  The CIFAR variant follows the reference exactly
+(BN after each conv, dropout schedule, 512-unit classifier head).
+"""
+from __future__ import annotations
+
+from ..nn import (Sequential, SpatialConvolution, SpatialBatchNormalization,
+                  BatchNormalization, ReLU, Dropout, SpatialMaxPooling,
+                  Linear, LogSoftMax, View)
+
+
+def vgg_for_cifar10(class_num=10, has_dropout=True):
+    """VggForCifar10.apply (VggForCifar10.scala:27)."""
+    model = Sequential()
+
+    def conv_bn_relu(ni, no):
+        model.add(SpatialConvolution(ni, no, 3, 3, 1, 1, 1, 1))
+        model.add(SpatialBatchNormalization(no, 1e-3))
+        model.add(ReLU())
+
+    conv_bn_relu(3, 64)
+    if has_dropout:
+        model.add(Dropout(0.3))
+    conv_bn_relu(64, 64)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(64, 128)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(128, 128)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(128, 256)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(256, 256)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(256, 256)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(256, 512)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(512, 512)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(512, 512)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+
+    conv_bn_relu(512, 512)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(512, 512)
+    if has_dropout:
+        model.add(Dropout(0.4))
+    conv_bn_relu(512, 512)
+    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+    model.add(View(512))
+
+    classifier = Sequential()
+    if has_dropout:
+        classifier.add(Dropout(0.5))
+    classifier.add(Linear(512, 512))
+    classifier.add(BatchNormalization(512))
+    classifier.add(ReLU())
+    if has_dropout:
+        classifier.add(Dropout(0.5))
+    classifier.add(Linear(512, class_num))
+    classifier.add(LogSoftMax())
+    model.add(classifier)
+    return model
+
+
+_VGG_CFG = {
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+         512, 512, 512, "M", 512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+def vgg_imagenet(class_num=1000, depth=16, has_dropout=True):
+    """Standard VGG-16/19 (224x224 input) for the ImageNet zoo."""
+    cfg = _VGG_CFG[depth]
+    model = Sequential()
+    ni = 3
+    for v in cfg:
+        if v == "M":
+            model.add(SpatialMaxPooling(2, 2, 2, 2))
+        else:
+            model.add(SpatialConvolution(ni, v, 3, 3, 1, 1, 1, 1))
+            model.add(ReLU())
+            ni = v
+    model.add(View(512 * 7 * 7))
+    model.add(Linear(512 * 7 * 7, 4096))
+    model.add(ReLU())
+    if has_dropout:
+        model.add(Dropout(0.5))
+    model.add(Linear(4096, 4096))
+    model.add(ReLU())
+    if has_dropout:
+        model.add(Dropout(0.5))
+    model.add(Linear(4096, class_num))
+    model.add(LogSoftMax())
+    return model
+
+
+def build(class_num=10, dataset="cifar10", depth=16, has_dropout=True):
+    if dataset == "cifar10":
+        return vgg_for_cifar10(class_num, has_dropout)
+    return vgg_imagenet(class_num, depth, has_dropout)

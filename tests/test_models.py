@@ -1,0 +1,108 @@
+"""Model zoo (≙ reference models/*Spec.scala: topology builds, forward shape,
+and a training step runs). Heavy ImageNet models are shape-checked via
+jax.eval_shape (no FLOPs); small models run real forward/train steps."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.optim import LocalOptimizer, Trigger, Adam
+
+
+class TestInception:
+    def test_v1_no_aux_shape(self):
+        from bigdl_tpu.models import inception
+        m = inception.build(1000, version="v1", aux=False)
+        assert m.get_output_shape((2, 3, 224, 224)) == (2, 1000)
+
+    def test_v1_aux_shape(self):
+        from bigdl_tpu.models import inception
+        m = inception.build(1000, version="v1", aux=True)
+        # three LogSoftMax heads concatenated on the class dim
+        assert m.get_output_shape((2, 3, 224, 224)) == (2, 3000)
+
+    def test_v2_no_aux_shape(self):
+        from bigdl_tpu.models import inception
+        m = inception.build(1000, version="v2", aux=False)
+        assert m.get_output_shape((2, 3, 224, 224)) == (2, 1000)
+
+    def test_v2_aux_shape(self):
+        from bigdl_tpu.models import inception
+        m = inception.build(1000, version="v2", aux=True)
+        assert m.get_output_shape((2, 3, 224, 224)) == (2, 3000)
+
+    def test_v1_small_forward(self):
+        # real numerics on a thin stand-in block
+        from bigdl_tpu.models.inception import inception_layer_v1
+        m = inception_layer_v1(8, [[4], [4, 8], [2, 4], [4]], "t/")
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 8, 16, 16),
+                        jnp.float32)
+        y = m.forward(x)
+        assert y.shape == (2, 4 + 8 + 4 + 4, 16, 16)
+        assert bool(jnp.all(jnp.isfinite(y)))
+
+
+class TestVgg:
+    def test_cifar_shape_and_forward(self):
+        from bigdl_tpu.models import vgg
+        m = vgg.build(10, dataset="cifar10")
+        assert m.get_output_shape((2, 3, 32, 32)) == (2, 10)
+        m.evaluate()
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 3, 32, 32),
+                        jnp.float32)
+        y = m.forward(x)
+        assert y.shape == (2, 10)
+        # LogSoftMax output: rows are log-probabilities
+        assert np.allclose(np.exp(np.asarray(y)).sum(1), 1.0, atol=1e-4)
+
+    @pytest.mark.parametrize("depth", [16, 19])
+    def test_imagenet_shape(self, depth):
+        from bigdl_tpu.models import vgg
+        m = vgg.build(1000, dataset="imagenet", depth=depth)
+        assert m.get_output_shape((1, 3, 224, 224)) == (1, 1000)
+
+
+class TestSimpleRNN:
+    def test_forward_shape(self):
+        from bigdl_tpu.models import rnn
+        m = rnn.build(input_size=10, hidden_size=8, output_size=10,
+                      with_softmax=True)
+        x = jnp.asarray(np.random.RandomState(0).rand(3, 5, 10), jnp.float32)
+        y = m.forward(x)
+        assert y.shape == (3, 5, 10)
+        assert np.allclose(np.exp(np.asarray(y)).sum(-1), 1.0, atol=1e-4)
+
+    def test_trains(self):
+        from bigdl_tpu.models import rnn
+        # learn to echo a one-hot input sequence
+        rs = np.random.RandomState(0)
+        ids = rs.randint(0, 6, (64, 4))
+        x = np.eye(6, dtype=np.float32)[ids]
+        y = (ids + 1).astype(np.float32)  # 1-based labels per timestep
+        m = rnn.build(input_size=6, hidden_size=16, output_size=6,
+                      with_softmax=True)
+        opt = (LocalOptimizer(m, (x, y),
+                              nn.TimeDistributedCriterion(
+                                  nn.ClassNLLCriterion()),
+                              batch_size=32)
+               .set_optim_method(Adam(learning_rate=2e-2))
+               .set_end_when(Trigger.max_epoch(80)))
+        opt.optimize()
+        assert opt.state.loss < 0.1
+
+
+class TestAutoencoder:
+    def test_reconstructs(self):
+        from bigdl_tpu.models import autoencoder
+        rs = np.random.RandomState(0)
+        # low-rank structured data is compressible through the bottleneck
+        basis = rs.rand(4, 784).astype(np.float32)
+        codes = rs.rand(128, 4).astype(np.float32)
+        x = (codes @ basis) / 4.0
+        m = autoencoder.build(class_num=32)
+        opt = (LocalOptimizer(m, (x.reshape(128, 28, 28), x),
+                              nn.MSECriterion(), batch_size=32)
+               .set_optim_method(Adam(learning_rate=1e-2))
+               .set_end_when(Trigger.max_epoch(40)))
+        opt.optimize()
+        assert opt.state.loss < 0.01
