@@ -7,7 +7,7 @@ from .containers import (Container, Sequential, Concat, ConcatTable,
                          ParallelTable, MapTable, Bottle, Identity, Echo)
 from .graph import Graph, DynamicGraph, Input, Node
 from .linear import (Linear, Bilinear, CMul, CAdd, Add, Mul, Cosine,
-                     Euclidean, LookupTable)
+                     Euclidean, LookupTable, Maxout)
 from .activation import (ReLU, ReLU6, Tanh, Sigmoid, ELU, LeakyReLU, PReLU,
                          RReLU, SReLU, SoftMax, SoftMin, LogSoftMax,
                          LogSigmoid, SoftPlus, SoftSign, HardTanh, Clamp,
@@ -17,7 +17,8 @@ from .conv import (SpatialConvolution, SpatialShareConvolution,
                    SpatialDilatedConvolution, SpatialFullConvolution,
                    SpatialSeparableConvolution, TemporalConvolution,
                    VolumetricConvolution, VolumetricFullConvolution,
-                   LocallyConnected1D, LocallyConnected2D)
+                   LocallyConnected1D, LocallyConnected2D,
+                   SpatialConvolutionMap)
 from .pooling import (SpatialMaxPooling, SpatialAveragePooling,
                       VolumetricMaxPooling, VolumetricAveragePooling,
                       TemporalMaxPooling, UpSampling1D, UpSampling2D,
@@ -46,10 +47,15 @@ from .table_ops import (CAddTable, CSubTable, CMulTable, CDivTable,
                         SplitTable, BifurcateSplitTable, NarrowTable,
                         SelectTable, FlattenTable, MixtureTable, DotProduct,
                         MM, MV, CosineDistance, PairwiseDistance,
-                        CrossProduct, DenseToSparse)
+                        CrossProduct, DenseToSparse, MaskedSelect)
 from .recurrent import (Cell, RnnCell, LSTM, LSTMPeephole, GRU,
-                        ConvLSTMPeephole, MultiRNNCell, Recurrent,
-                        BiRecurrent, RecurrentDecoder, TimeDistributed)
+                        ConvLSTMPeephole, ConvLSTMPeephole3D, MultiRNNCell,
+                        Recurrent, BiRecurrent, RecurrentDecoder,
+                        TimeDistributed)
+from .sparse import SparseLinear, LookupTableSparse, SparseJoinTable
+from .tree import TreeLSTM, BinaryTreeLSTM
+from .detection import (Anchor, PriorBox, Nms, Proposal, RoiPooling,
+                        DetectionOutputSSD, DetectionOutputFrcnn)
 from .criterion import (ClassNLLCriterion, CrossEntropyCriterion,
                         CategoricalCrossEntropy, SoftmaxWithCriterion,
                         MSECriterion, AbsCriterion, BCECriterion,

@@ -1,0 +1,427 @@
+"""Object-detection ops (≙ nn/Anchor.scala, PriorBox.scala, Nms.scala,
+Proposal.scala, RoiPooling.scala, DetectionOutputSSD.scala,
+DetectionOutputFrcnn.scala).
+
+Box decode / prior generation / RoI pooling are jittable jnp (static
+shapes, mask-based bins — TPU-friendly).  Greedy NMS and the final
+detection assembly are inference-time host post-processing with
+data-dependent output sizes, exactly as in the reference (which runs them
+on the JVM driver); they run in numpy on host.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from .module import Module
+from ..utils.table import Table, as_list
+
+
+# --------------------------------------------------------------------- #
+# prior / anchor generation                                             #
+# --------------------------------------------------------------------- #
+class PriorBox(Module):
+    """SSD prior boxes for one feature map (nn/PriorBox.scala:44).
+
+    forward(feature (N, C, H, W)) → (1, 2, H*W*numPriors*4): row 0 the
+    normalized [xmin,ymin,xmax,ymax] priors (caffe order: per cell, per
+    min_size: min box, sqrt(min*max) box, aspect-ratio boxes), row 1 the
+    variances.  Computed with numpy at trace time (all-static geometry),
+    returned as a jnp constant.
+    """
+
+    def __init__(self, min_sizes, max_sizes=None, aspect_ratios=None,
+                 is_flip=True, is_clip=False, variances=None, offset=0.5,
+                 img_h=0, img_w=0, img_size=0, step_h=0.0, step_w=0.0,
+                 step=0.0, name=None):
+        super().__init__(name=name)
+        self.min_sizes = list(min_sizes)
+        self.max_sizes = list(max_sizes) if max_sizes else []
+        ars = [1.0]
+        for ar in (aspect_ratios or []):
+            if not any(abs(ar - a) < 1e-6 for a in ars):
+                ars.append(float(ar))
+                if is_flip:
+                    ars.append(1.0 / float(ar))
+        self.aspect_ratios = ars
+        self.is_clip = is_clip
+        self.variances = list(variances) if variances else [0.1]
+        if len(self.variances) not in (1, 4):
+            raise ValueError("must provide 1 or 4 variances")
+        self.offset = offset
+        if img_h and img_w:
+            self.img_h, self.img_w = img_h, img_w
+        else:
+            self.img_h = self.img_w = img_size
+        if step_h and step_w:
+            self.step_h, self.step_w = step_h, step_w
+        else:
+            self.step_h = self.step_w = step
+        self.num_priors = (len(self.aspect_ratios) * len(self.min_sizes)
+                           + len(self.max_sizes))
+
+    def _priors(self, layer_h, layer_w, img_h, img_w):
+        step_h = self.step_h or img_h / layer_h
+        step_w = self.step_w or img_w / layer_w
+        boxes = []
+        for h in range(layer_h):
+            for w in range(layer_w):
+                cx = (w + self.offset) * step_w
+                cy = (h + self.offset) * step_h
+                for i, mn in enumerate(self.min_sizes):
+                    bw = bh = mn
+                    boxes.append((cx, cy, bw, bh))
+                    if self.max_sizes:
+                        mx = self.max_sizes[i]
+                        s = float(np.sqrt(mn * mx))
+                        boxes.append((cx, cy, s, s))
+                    for ar in self.aspect_ratios:
+                        if abs(ar - 1.0) < 1e-6:
+                            continue
+                        r = float(np.sqrt(ar))
+                        boxes.append((cx, cy, bw * r, bh / r))
+        b = np.asarray(boxes, np.float32)
+        out = np.stack([(b[:, 0] - b[:, 2] / 2) / img_w,
+                        (b[:, 1] - b[:, 3] / 2) / img_h,
+                        (b[:, 0] + b[:, 2] / 2) / img_w,
+                        (b[:, 1] + b[:, 3] / 2) / img_h], axis=1)
+        if self.is_clip:
+            out = np.clip(out, 0.0, 1.0)
+        return out.reshape(-1)
+
+    def apply(self, params, x, ctx):
+        feat = as_list(x)[0] if isinstance(x, (Table, list, tuple)) else x
+        layer_h, layer_w = int(feat.shape[2]), int(feat.shape[3])
+        img_h = self.img_h or layer_h
+        img_w = self.img_w or layer_w
+        priors = self._priors(layer_h, layer_w, img_h, img_w)
+        var = np.tile(np.asarray(
+            self.variances if len(self.variances) == 4
+            else self.variances * 4, np.float32), priors.size // 4)
+        out = np.stack([priors, var])[None]
+        return jnp.asarray(out)
+
+
+class Anchor:
+    """RPN anchor generation (nn/Anchor.scala:29).  Not a Module in the
+    reference either — a geometry utility used by Proposal."""
+
+    def __init__(self, ratios, scales, base_size=16):
+        self.ratios = np.asarray(ratios, np.float32)
+        self.scales = np.asarray(scales, np.float32)
+        self.base_size = base_size
+        self.num = len(self.ratios) * len(self.scales)
+
+    def base_anchors(self):
+        """(A, 4) anchors centered on the (base_size-1)/2 reference box."""
+        base = np.array([0, 0, self.base_size - 1, self.base_size - 1],
+                        np.float32)
+        w, h = base[2] - base[0] + 1, base[3] - base[1] + 1
+        cx, cy = base[0] + 0.5 * (w - 1), base[1] + 0.5 * (h - 1)
+        out = []
+        size = w * h
+        for r in self.ratios:
+            ws = np.round(np.sqrt(size / r))
+            hs = np.round(ws * r)
+            for s in self.scales:
+                W, H = ws * s, hs * s
+                out.append([cx - 0.5 * (W - 1), cy - 0.5 * (H - 1),
+                            cx + 0.5 * (W - 1), cy + 0.5 * (H - 1)])
+        return np.asarray(out, np.float32)
+
+    def generate_anchors(self, map_w, map_h, feat_stride=16.0):
+        """All shifted anchors, shape (A*map_h*map_w, 4)."""
+        base = self.base_anchors()
+        sx = np.arange(map_w, dtype=np.float32) * feat_stride
+        sy = np.arange(map_h, dtype=np.float32) * feat_stride
+        gx, gy = np.meshgrid(sx, sy)
+        shifts = np.stack([gx.ravel(), gy.ravel(),
+                           gx.ravel(), gy.ravel()], axis=1)
+        return (shifts[:, None, :] + base[None]).reshape(-1, 4)
+
+
+# --------------------------------------------------------------------- #
+# box decode + NMS                                                      #
+# --------------------------------------------------------------------- #
+def bbox_transform_inv(boxes, deltas):
+    """Apply (dx,dy,dw,dh) regression deltas to [x1,y1,x2,y2] boxes."""
+    boxes = jnp.asarray(boxes)
+    widths = boxes[:, 2] - boxes[:, 0] + 1.0
+    heights = boxes[:, 3] - boxes[:, 1] + 1.0
+    cx = boxes[:, 0] + 0.5 * widths
+    cy = boxes[:, 1] + 0.5 * heights
+    dx, dy, dw, dh = deltas[:, 0], deltas[:, 1], deltas[:, 2], deltas[:, 3]
+    pcx = dx * widths + cx
+    pcy = dy * heights + cy
+    pw = jnp.exp(dw) * widths
+    ph = jnp.exp(dh) * heights
+    return jnp.stack([pcx - 0.5 * pw, pcy - 0.5 * ph,
+                      pcx + 0.5 * pw, pcy + 0.5 * ph], axis=1)
+
+
+def clip_boxes(boxes, im_h, im_w):
+    return jnp.stack([jnp.clip(boxes[:, 0], 0, im_w - 1.0),
+                      jnp.clip(boxes[:, 1], 0, im_h - 1.0),
+                      jnp.clip(boxes[:, 2], 0, im_w - 1.0),
+                      jnp.clip(boxes[:, 3], 0, im_h - 1.0)], axis=1)
+
+
+class Nms:
+    """Greedy IoU NMS (nn/Nms.scala).  Host-side numpy, like the
+    reference's JVM loop — called from inference post-processing only."""
+
+    def nms(self, scores, boxes, thresh, max_num=-1, normalized=False):
+        scores = np.asarray(scores)
+        boxes = np.asarray(boxes)
+        offset = 0.0 if normalized else 1.0
+        x1, y1, x2, y2 = boxes.T
+        areas = (x2 - x1 + offset) * (y2 - y1 + offset)
+        order = scores.argsort()[::-1]
+        keep = []
+        while order.size:
+            i = order[0]
+            keep.append(int(i))
+            if 0 < max_num <= len(keep):
+                break
+            xx1 = np.maximum(x1[i], x1[order[1:]])
+            yy1 = np.maximum(y1[i], y1[order[1:]])
+            xx2 = np.minimum(x2[i], x2[order[1:]])
+            yy2 = np.minimum(y2[i], y2[order[1:]])
+            w = np.maximum(0.0, xx2 - xx1 + offset)
+            h = np.maximum(0.0, yy2 - yy1 + offset)
+            inter = w * h
+            iou = inter / (areas[i] + areas[order[1:]] - inter)
+            order = order[1:][iou <= thresh]
+        return keep
+
+
+class Proposal(Module):
+    """RPN proposal layer (nn/Proposal.scala:37).
+
+    forward(Table(cls_scores (1, 2A, H, W), bbox_deltas (1, 4A, H, W),
+    im_info [h, w, scale...])) → (postNmsTopN', 5) rows of
+    [0, x1, y1, x2, y2].  Decode is jnp; ranking + NMS host-side.
+    """
+
+    def __init__(self, pre_nms_topn, post_nms_topn, ratios, scales,
+                 rpn_min_size=16, feat_stride=16, nms_thresh=0.7, name=None):
+        super().__init__(name=name)
+        self.pre_nms_topn = pre_nms_topn
+        self.post_nms_topn = post_nms_topn
+        self.anchor = Anchor(ratios, scales)
+        self.rpn_min_size = rpn_min_size
+        self.feat_stride = feat_stride
+        self.nms_thresh = nms_thresh
+        self._nms = Nms()
+
+    def apply(self, params, x, ctx):
+        scores_map, deltas_map, im_info = as_list(x)[:3]
+        im_info = np.asarray(im_info).reshape(-1)
+        A = self.anchor.num
+        H, W = int(scores_map.shape[2]), int(scores_map.shape[3])
+        anchors = self.anchor.generate_anchors(W, H, self.feat_stride)
+        # scores: second A channels are the "object" scores (caffe order)
+        scores = np.asarray(scores_map)[0, A:].transpose(1, 2, 0).reshape(-1)
+        deltas = np.asarray(deltas_map)[0].reshape(A, 4, H, W) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        proposals = np.asarray(clip_boxes(
+            bbox_transform_inv(anchors, jnp.asarray(deltas)),
+            float(im_info[0]), float(im_info[1])))
+        min_size = self.rpn_min_size * (im_info[2] if im_info.size > 2
+                                        else 1.0)
+        ws = proposals[:, 2] - proposals[:, 0] + 1
+        hs = proposals[:, 3] - proposals[:, 1] + 1
+        valid = np.where((ws >= min_size) & (hs >= min_size))[0]
+        proposals, scores = proposals[valid], scores[valid]
+        order = scores.argsort()[::-1][:self.pre_nms_topn]
+        proposals, scores = proposals[order], scores[order]
+        keep = self._nms.nms(scores, proposals, self.nms_thresh,
+                             max_num=self.post_nms_topn)
+        out = np.zeros((len(keep), 5), np.float32)
+        out[:, 1:] = proposals[keep]
+        return jnp.asarray(out)
+
+
+class RoiPooling(Module):
+    """RoI max pooling (nn/RoiPooling.scala:45).
+
+    forward(Table(features (B, C, H, W), rois (N, 5) [batch_ix, x1, y1, x2,
+    y2])) → (N, C, pooled_h, pooled_w).  Mask-based bin max — static
+    shapes, vectorized over rois and bins, fully jittable.
+    """
+
+    def __init__(self, pooled_w, pooled_h, spatial_scale=1.0, name=None):
+        super().__init__(name=name)
+        self.pooled_w = pooled_w
+        self.pooled_h = pooled_h
+        self.spatial_scale = spatial_scale
+
+    def apply(self, params, x, ctx):
+        feats, rois = as_list(x)[:2]
+        B, C, H, W = feats.shape
+        rois = jnp.asarray(rois)
+        batch_ix = rois[:, 0].astype(jnp.int32)
+        boxes = jnp.round(rois[:, 1:] * self.spatial_scale)
+        x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+        roi_w = jnp.maximum(x2 - x1 + 1.0, 1.0)
+        roi_h = jnp.maximum(y2 - y1 + 1.0, 1.0)
+        bin_w = roi_w / self.pooled_w
+        bin_h = roi_h / self.pooled_h
+        rows = jnp.arange(H, dtype=jnp.float32)
+        cols = jnp.arange(W, dtype=jnp.float32)
+
+        ph = jnp.arange(self.pooled_h, dtype=jnp.float32)
+        pw = jnp.arange(self.pooled_w, dtype=jnp.float32)
+        # bin edges per roi per bin: (N, ph) / (N, pw)
+        hstart = jnp.floor(ph[None] * bin_h[:, None]) + y1[:, None]
+        hend = jnp.ceil((ph[None] + 1) * bin_h[:, None]) + y1[:, None]
+        wstart = jnp.floor(pw[None] * bin_w[:, None]) + x1[:, None]
+        wend = jnp.ceil((pw[None] + 1) * bin_w[:, None]) + x1[:, None]
+        # membership masks: (N, ph, H), (N, pw, W)
+        rmask = ((rows[None, None] >= jnp.clip(hstart, 0, H)[..., None])
+                 & (rows[None, None] < jnp.clip(hend, 0, H)[..., None]))
+        cmask = ((cols[None, None] >= jnp.clip(wstart, 0, W)[..., None])
+                 & (cols[None, None] < jnp.clip(wend, 0, W)[..., None]))
+        roi_feats = feats[batch_ix]                      # (N, C, H, W)
+        neg = jnp.finfo(feats.dtype).min
+        # max is separable: reduce H with rmask, then W with cmask — peak
+        # memory (N, C, ph, H, W) → (N, C, ph, W), never the joint
+        # (..., ph, pw, H, W) product
+        vals_h = jnp.where(rmask[:, None, :, :, None],
+                           roi_feats[:, :, None], neg)   # (N,C,ph,H,W)
+        red_h = jnp.max(vals_h, axis=3)                  # (N,C,ph,W)
+        vals_w = jnp.where(cmask[:, None, None, :, :],
+                           red_h[:, :, :, None], neg)    # (N,C,ph,pw,W)
+        out = jnp.max(vals_w, axis=4)                    # (N,C,ph,pw)
+        # empty bins pool to 0 (reference memsets to 0)
+        empty = ~(jnp.any(rmask, axis=2)[:, :, None]
+                  & jnp.any(cmask, axis=2)[:, None, :])  # (N,ph,pw)
+        return jnp.where(empty[:, None], 0.0, out)
+
+
+class DetectionOutputSSD(Module):
+    """SSD detection assembly (nn/DetectionOutputSSD.scala:47): decode locs
+    against priors, per-class score filter + NMS, keep top-k.  Host-side
+    post-processing (variable-length output), like the reference.
+
+    forward(Table(loc (N, nPriors*4), conf (N, nPriors*nClasses),
+    priors (1, 2, nPriors*4))) → (N, keep) rows
+    [batch_ix, class, score, x1, y1, x2, y2] as a single (M, 7) array.
+    """
+
+    def __init__(self, n_classes=21, share_location=True, bg_label=0,
+                 nms_thresh=0.45, nms_topk=400, keep_top_k=200,
+                 conf_thresh=0.01, variance_encoded_in_target=False,
+                 name=None):
+        super().__init__(name=name)
+        self.n_classes = n_classes
+        self.share_location = share_location
+        self.bg_label = bg_label
+        self.nms_thresh = nms_thresh
+        self.nms_topk = nms_topk
+        self.keep_top_k = keep_top_k
+        self.conf_thresh = conf_thresh
+        self.variance_encoded = variance_encoded_in_target
+        self._nms = Nms()
+
+    def _decode(self, loc, priors, variances):
+        pcx = (priors[:, 0] + priors[:, 2]) / 2
+        pcy = (priors[:, 1] + priors[:, 3]) / 2
+        pw = priors[:, 2] - priors[:, 0]
+        ph = priors[:, 3] - priors[:, 1]
+        if self.variance_encoded:
+            variances = np.ones_like(variances)
+        cx = variances[:, 0] * loc[:, 0] * pw + pcx
+        cy = variances[:, 1] * loc[:, 1] * ph + pcy
+        w = np.exp(variances[:, 2] * loc[:, 2]) * pw
+        h = np.exp(variances[:, 3] * loc[:, 3]) * ph
+        return np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                        axis=1)
+
+    def apply(self, params, x, ctx):
+        loc, conf, priors = as_list(x)[:3]
+        loc = np.asarray(loc)
+        conf = np.asarray(conf)
+        priors = np.asarray(priors)
+        n = loc.shape[0]
+        prior_boxes = priors[0, 0].reshape(-1, 4)
+        prior_vars = priors[0, 1].reshape(-1, 4)
+        n_priors = prior_boxes.shape[0]
+        results = []
+        for b in range(n):
+            if self.share_location:
+                decoded_all = self._decode(loc[b].reshape(n_priors, 4),
+                                           prior_boxes, prior_vars)
+            else:
+                per_class = loc[b].reshape(n_priors, self.n_classes, 4)
+            scores = conf[b].reshape(n_priors, self.n_classes)
+            cand = []
+            for c in range(self.n_classes):
+                if c == self.bg_label:
+                    continue
+                decoded = (decoded_all if self.share_location
+                           else self._decode(per_class[:, c], prior_boxes,
+                                             prior_vars))
+                cs = scores[:, c]
+                sel = np.where(cs > self.conf_thresh)[0]
+                if not sel.size:
+                    continue
+                order = cs[sel].argsort()[::-1][:self.nms_topk]
+                sel = sel[order]
+                keep = self._nms.nms(cs[sel], decoded[sel], self.nms_thresh,
+                                     normalized=True)
+                for k in keep:
+                    i = sel[k]
+                    cand.append([b, c, cs[i], *decoded[i]])
+            cand.sort(key=lambda r: -r[2])
+            results.extend(cand[:self.keep_top_k])
+        if not results:
+            return jnp.zeros((0, 7), jnp.float32)
+        return jnp.asarray(np.asarray(results, np.float32))
+
+
+class DetectionOutputFrcnn(Module):
+    """Faster-RCNN detection assembly (nn/DetectionOutputFrcnn.scala:43):
+    per-class bbox regression decode + NMS over RoIs.
+
+    forward(Table(rois (R, 5), cls_prob (R, nClasses),
+    bbox_pred (R, nClasses*4), im_info)) → (M, 7) rows
+    [0, class, score, x1, y1, x2, y2].
+    """
+
+    def __init__(self, n_classes=21, bbox_vote=False, nms_thresh=0.3,
+                 max_per_image=100, thresh=0.05, name=None):
+        super().__init__(name=name)
+        self.n_classes = n_classes
+        self.nms_thresh = nms_thresh
+        self.max_per_image = max_per_image
+        self.thresh = thresh
+        self._nms = Nms()
+
+    def apply(self, params, x, ctx):
+        rois, cls_prob, bbox_pred, im_info = as_list(x)[:4]
+        rois = np.asarray(rois)
+        scores = np.asarray(cls_prob)
+        deltas = np.asarray(bbox_pred)
+        im_info = np.asarray(im_info).reshape(-1)
+        boxes = rois[:, 1:5]
+        results = []
+        for c in range(1, self.n_classes):
+            cls_deltas = deltas[:, c * 4:(c + 1) * 4]
+            pred = np.asarray(clip_boxes(
+                bbox_transform_inv(jnp.asarray(boxes),
+                                   jnp.asarray(cls_deltas)),
+                float(im_info[0]), float(im_info[1])))
+            cs = scores[:, c]
+            sel = np.where(cs > self.thresh)[0]
+            if not sel.size:
+                continue
+            keep = self._nms.nms(cs[sel], pred[sel], self.nms_thresh)
+            for k in keep:
+                i = sel[k]
+                results.append([0, c, cs[i], *pred[i]])
+        results.sort(key=lambda r: -r[2])
+        results = results[:self.max_per_image]
+        if not results:
+            return jnp.zeros((0, 7), jnp.float32)
+        return jnp.asarray(np.asarray(results, np.float32))

@@ -226,3 +226,41 @@ class LookupTable(Module):
             mask = (x.astype(jnp.int32) != int(self.padding_value))
             out = out * mask[..., None].astype(out.dtype)
         return out
+
+
+class Maxout(Module):
+    """Maxout unit (nn/Maxout.scala:46): Linear(in, out*m) → reshape
+    (m, out) → max over m.  One MXU matmul + a reduce that XLA fuses."""
+
+    def __init__(self, input_size, output_size, maxout_number,
+                 with_bias=True, w_regularizer=None, b_regularizer=None,
+                 name=None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.maxout_number = maxout_number
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        fan_in, fan_out = self.input_size, self.output_size
+        w = init_tensor(self, k1,
+                        (self.input_size,
+                         self.output_size * self.maxout_number),
+                        fan_in, fan_out, Xavier())
+        p = {"weight": w}
+        if self.with_bias:
+            p["bias"] = init_tensor(
+                self, k2, (self.output_size * self.maxout_number,),
+                fan_in, fan_out, Zeros(), kind="bias")
+        return {self.name: p}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        y = x @ p["weight"].astype(x.dtype)
+        if self.with_bias:
+            y = y + p["bias"].astype(x.dtype)
+        y = y.reshape(y.shape[:-1] + (self.maxout_number, self.output_size))
+        return jnp.max(y, axis=-2)

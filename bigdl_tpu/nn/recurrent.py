@@ -23,6 +23,13 @@ from .init import Xavier, Zeros, init_tensor
 from ..utils.table import Table, as_list
 
 
+def _conv_out_size(in_size, k, stride, pad):
+    """Spatial size after a conv; pad == -1 means SAME (ceil(in/stride))."""
+    if pad == -1:
+        return -(-in_size // stride)
+    return (in_size + 2 * pad - k) // stride + 1
+
+
 class Cell(Module):
     """Base RNN cell: step(params, x_t, hidden, ctx) -> (out_t, new_hidden);
     ``zero_hidden(batch, dtype)`` builds the initial state pytree."""
@@ -236,7 +243,12 @@ class ConvLSTMPeephole(Cell):
     def zero_hidden(self, batch_size, dtype=jnp.float32, spatial=None):
         if spatial is None:
             raise ValueError("ConvLSTMPeephole needs spatial dims for hidden")
-        shape = (batch_size, self.output_size) + tuple(spatial)
+        # hidden lives at conv_i's OUTPUT resolution (stride may downsample)
+        out_spatial = tuple(
+            _conv_out_size(s, k, st, p) for s, k, st, p in zip(
+                spatial, self.conv_i.kernel, self.conv_i.stride,
+                self.conv_i.pad))
+        shape = (batch_size, self.output_size) + out_spatial
         return Table(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
 
     def step(self, params, x, hidden, ctx):
@@ -431,3 +443,65 @@ class TimeDistributed(Module):
         flat = x.reshape((b * t,) + x.shape[2:])
         y = self.layer.apply(params, flat, ctx)
         return y.reshape((b, t) + y.shape[1:])
+
+
+class ConvLSTMPeephole3D(Cell):
+    """Volumetric convolutional LSTM with peepholes over NCDHW maps
+    (nn/ConvLSTMPeephole3D.scala); the 3D sibling of ConvLSTMPeephole,
+    built on VolumetricConvolution (one fused 4x-gate conv per stream)."""
+
+    def __init__(self, input_size, output_size, kernel_i, kernel_c,
+                 stride=1, padding=-1, with_peephole=True, name=None):
+        super().__init__(name=name)
+        from .conv import VolumetricConvolution
+        self.input_size = input_size
+        self.output_size = output_size
+        self.with_peephole = with_peephole
+        self.conv_i = VolumetricConvolution(
+            input_size, 4 * output_size, kernel_i, kernel_i, kernel_i,
+            stride, stride, stride, padding, padding, padding,
+            name=f"{self.name}_ci")
+        self.conv_h = VolumetricConvolution(
+            output_size, 4 * output_size, kernel_c, kernel_c, kernel_c,
+            1, 1, 1, -1, -1, -1, with_bias=False, name=f"{self.name}_ch")
+
+    def children(self):
+        return [self.conv_i, self.conv_h]
+
+    def init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        p = {}
+        p.update(self.conv_i.init(k1))
+        p.update(self.conv_h.init(k2))
+        if self.with_peephole:
+            p[self.name] = {"peephole": 0.1 * jax.random.normal(
+                k3, (3, self.output_size), jnp.float32)}
+        return p
+
+    def zero_hidden(self, batch_size, dtype=jnp.float32, spatial=None):
+        if spatial is None:
+            raise ValueError("ConvLSTMPeephole3D needs spatial dims")
+        out_spatial = tuple(
+            _conv_out_size(s, k, st, p) for s, k, st, p in zip(
+                spatial, self.conv_i.kernel, self.conv_i.stride,
+                self.conv_i.pad))
+        shape = (batch_size, self.output_size) + out_spatial
+        return Table(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    def step(self, params, x, hidden, ctx):
+        h, c = as_list(hidden)
+        z = (self.conv_i.apply(params, x, ctx)
+             + self.conv_h.apply(params, h, ctx))
+        i, f, g, o = jnp.split(z, 4, axis=1)
+        if self.with_peephole:
+            ph = self.own(params)["peephole"].astype(x.dtype)
+            i = i + ph[0][None, :, None, None, None] * c
+            f = f + ph[1][None, :, None, None, None] * c
+        i, f = jax.nn.sigmoid(i), jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        c2 = f * c + i * g
+        if self.with_peephole:
+            o = o + ph[2][None, :, None, None, None] * c2
+        o = jax.nn.sigmoid(o)
+        h2 = o * jnp.tanh(c2)
+        return h2, Table(h2, c2)

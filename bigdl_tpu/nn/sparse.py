@@ -1,0 +1,145 @@
+"""Sparse-input layers (≙ nn/SparseLinear.scala, LookupTableSparse.scala,
+SparseJoinTable.scala).
+
+XLA has no sparse tensor type, so sparse activities are
+:class:`bigdl_tpu.tensor.SparseTensor` COO pytrees; every op here lowers to
+gathers + ``segment_sum``, which vectorize cleanly on TPU for a fixed nnz.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .module import Module
+from .init import Xavier, Zeros, init_tensor
+from ..tensor import SparseTensor, sparse_dense_matmul
+from ..utils.table import Table, as_list
+
+
+class SparseLinear(Module):
+    """Linear over a 2-D SparseTensor input (nn/SparseLinear.scala:44).
+
+    backward_start/backward_length mirror the reference's restricted
+    grad-input window (1-based column range); gradients w.r.t. the sparse
+    input are only defined for that dense sub-range.
+    """
+
+    def __init__(self, input_size, output_size, backward_start=-1,
+                 backward_length=-1, with_bias=True, w_regularizer=None,
+                 b_regularizer=None, name=None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.output_size = output_size
+        self.backward_start = backward_start
+        self.backward_length = backward_length
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        p = {"weight": init_tensor(self, k1,
+                                   (self.input_size, self.output_size),
+                                   self.input_size, self.output_size,
+                                   Xavier())}
+        if self.with_bias:
+            p["bias"] = init_tensor(self, k2, (self.output_size,),
+                                    self.input_size, self.output_size,
+                                    Zeros(), kind="bias")
+        return {self.name: p}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        if not isinstance(x, SparseTensor):
+            raise TypeError("SparseLinear input must be a SparseTensor")
+        y = sparse_dense_matmul(x, p["weight"])
+        if self.with_bias:
+            y = y + p["bias"]
+        return y
+
+
+class LookupTableSparse(Module):
+    """Embedding-bag over sparse ids (nn/LookupTableSparse.scala:44).
+
+    Input: a 2-D SparseTensor of ids (batch, maxlen), or Table(ids, weights)
+    with matching sparsity.  Ids are 1-based.  combiner ∈ {sum, mean, sqrtn};
+    max_norm l2-renormalizes each embedding before combining.  One gather +
+    one segment_sum per batch — the TPU shape of the reference's per-row
+    loop.
+    """
+
+    def __init__(self, n_index, n_output, combiner="sum", max_norm=-1.0,
+                 w_regularizer=None, name=None):
+        super().__init__(name=name)
+        if combiner not in ("sum", "mean", "sqrtn"):
+            raise ValueError(f"combiner must be sum|mean|sqrtn: {combiner}")
+        self.n_index = n_index
+        self.n_output = n_output
+        self.combiner = combiner
+        self.max_norm = max_norm
+        self.w_regularizer = w_regularizer
+
+    def init(self, rng):
+        w = init_tensor(self, rng, (self.n_index, self.n_output),
+                        self.n_index, self.n_output, Xavier())
+        return {self.name: {"weight": w}}
+
+    def apply(self, params, x, ctx):
+        w = self.own(params)["weight"]
+        if isinstance(x, (Table, list, tuple)):
+            ids_sp, weights_sp = as_list(x)[:2]
+            weights = weights_sp.values
+        else:
+            ids_sp, weights = x, None
+        if not isinstance(ids_sp, SparseTensor):
+            raise TypeError("LookupTableSparse input must be a SparseTensor")
+        n_rows = ids_sp.shape[0]
+        rows = ids_sp.row_ids()
+        ids = ids_sp.values.astype(jnp.int32) - 1  # 1-based ids
+        emb = jnp.take(w, jnp.clip(ids, 0, self.n_index - 1), axis=0)
+        if self.max_norm > 0:
+            norms = jnp.linalg.norm(emb, axis=-1, keepdims=True)
+            emb = emb * jnp.minimum(1.0, self.max_norm
+                                    / jnp.maximum(norms, 1e-7))
+        wts = weights if weights is not None else jnp.ones_like(
+            emb[..., 0])
+        summed = jax.ops.segment_sum(emb * wts[:, None], rows,
+                                     num_segments=n_rows)
+        if self.combiner == "sum":
+            return summed
+        denom = jax.ops.segment_sum(
+            wts if weights is not None else jnp.ones_like(wts),
+            rows, num_segments=n_rows)
+        if self.combiner == "mean":
+            return summed / jnp.maximum(denom, 1e-7)[:, None]
+        # sqrtn: divide by sqrt of sum of squared weights
+        denom2 = jax.ops.segment_sum(wts * wts, rows, num_segments=n_rows)
+        return summed / jnp.sqrt(jnp.maximum(denom2, 1e-7))[:, None]
+
+
+class SparseJoinTable(Module):
+    """Concatenate 2-D SparseTensors along `dimension` (1-based)
+    (nn/SparseJoinTable.scala); only dim 2 (columns) is meaningful for
+    batched sparse activities, matching the reference."""
+
+    def __init__(self, dimension=2, name=None):
+        super().__init__(name=name)
+        self.dimension = dimension
+
+    def apply(self, params, x, ctx):
+        xs = as_list(x)
+        if self.dimension != 2:
+            raise ValueError("SparseJoinTable supports dimension=2")
+        n_rows = xs[0].shape[0]
+        col_off = 0
+        idx_parts, val_parts = [], []
+        for sp in xs:
+            if sp.shape[0] != n_rows:
+                raise ValueError("row counts must match")
+            shifted = sp.indices.at[1].add(col_off)
+            idx_parts.append(shifted)
+            val_parts.append(sp.values)
+            col_off += sp.shape[1]
+        return SparseTensor(jnp.concatenate(idx_parts, axis=1),
+                            jnp.concatenate(val_parts),
+                            (n_rows, col_off))

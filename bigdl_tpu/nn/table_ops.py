@@ -258,3 +258,17 @@ class DenseToSparse(Module):
 
     def apply(self, params, x, ctx):
         return x
+
+
+class MaskedSelect(Module):
+    """nn/MaskedSelect.scala — select elements of input[0] where the byte
+    mask input[1] is nonzero.  The output length is data-dependent, so this
+    op cannot live under jit (XLA needs static shapes); it executes eagerly
+    on host, like the reference's driver-side use."""
+
+    def apply(self, params, x, ctx):
+        import numpy as np
+        tensor, mask = as_list(x)[:2]
+        t = np.asarray(tensor)
+        m = np.asarray(mask).astype(bool)
+        return jnp.asarray(t[m])

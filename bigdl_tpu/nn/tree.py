@@ -1,0 +1,127 @@
+"""Tree-structured LSTMs (≙ nn/TreeLSTM.scala, BinaryTreeLSTM.scala).
+
+The reference walks the tree recursively on the JVM (BinaryTreeLSTM.scala:
+recursiveForward), which cannot compile to a single XLA graph.  Here the
+tree is encoded as index tensors and the whole composition runs as ONE
+``lax.scan`` over nodes in topological (children-first) order, reading and
+writing a (maxNodes, hidden) state buffer with dynamic gathers — fixed
+shapes, no host round-trips, batched over B via vmap inside the scan body.
+
+Tree encoding (per batch element):
+  ``tree``: (nNodes, 3) int32 — [left_child, right_child, leaf_word_index],
+  1-based, 0 = absent.  Internal nodes have children; leaves have a word
+  index into the embedding sequence.  Nodes must be ordered so children
+  precede parents (standard post-order numbering); the root is the last
+  node with any entry.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .module import Module
+from .init import Xavier, Zeros, init_tensor
+from ..utils.table import as_list
+
+
+class TreeLSTM(Module):
+    """Base for tree-composed LSTMs (nn/TreeLSTM.scala:30): holds sizes and
+    the (embeddings, tree) Table input convention."""
+
+    def __init__(self, input_size, hidden_size, name=None):
+        super().__init__(name=name)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+
+
+class BinaryTreeLSTM(TreeLSTM):
+    """Constituency (binary) Tree-LSTM (nn/BinaryTreeLSTM.scala:44,
+    after Tai et al. 2015 eq. 9-14).
+
+    Input: Table(embeddings (B, seqLen, inputSize), tree (B, nNodes, 3)).
+    Output: (B, nNodes, hiddenSize) hidden state per node (zeros for absent
+    nodes), root last — callers select the root with Select/Index like the
+    reference's TreeNNAccuracy harness.
+    """
+
+    def __init__(self, input_size, hidden_size, gate_output=True, name=None):
+        super().__init__(input_size, hidden_size, name=name)
+        self.gate_output = gate_output
+
+    def init(self, rng):
+        ks = jax.random.split(rng, 6)
+        H, D = self.hidden_size, self.input_size
+        def mat(k, shape, fi, fo):
+            return init_tensor(self, k, shape, fi, fo, Xavier())
+        p = {
+            # leaf transform: i, o, u gates from word embedding
+            "leaf_w": mat(ks[0], (D, 3 * H), D, 3 * H),
+            "leaf_b": jnp.zeros((3 * H,), jnp.float32),
+            # composer: i, lf, rf, u, o gates from (h_l, h_r)
+            "comp_wl": mat(ks[1], (H, 5 * H), H, 5 * H),
+            "comp_wr": mat(ks[2], (H, 5 * H), H, 5 * H),
+            "comp_b": jnp.zeros((5 * H,), jnp.float32),
+        }
+        return {self.name: p}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        emb, tree = as_list(x)[:2]
+        tree = tree.astype(jnp.int32)
+        B, n_nodes = tree.shape[0], tree.shape[1]
+        H = self.hidden_size
+
+        def leaf(word_vec):
+            z = word_vec @ p["leaf_w"] + p["leaf_b"]
+            i, o, u = jnp.split(z, 3, axis=-1)
+            c = jax.nn.sigmoid(i) * jnp.tanh(u)
+            o = jax.nn.sigmoid(o) if self.gate_output else jnp.ones_like(o)
+            return o * jnp.tanh(c), c
+
+        def compose(hl, cl, hr, cr):
+            z = hl @ p["comp_wl"] + hr @ p["comp_wr"] + p["comp_b"]
+            i, lf, rf, u, o = jnp.split(z, 5, axis=-1)
+            c = (jax.nn.sigmoid(i) * jnp.tanh(u)
+                 + jax.nn.sigmoid(lf) * cl + jax.nn.sigmoid(rf) * cr)
+            o = jax.nn.sigmoid(o) if self.gate_output else jnp.ones_like(o)
+            return o * jnp.tanh(c), c
+
+        # state buffers indexed 1..nNodes (slot 0 = absent child → zeros)
+        h_buf = jnp.zeros((B, n_nodes + 1, H), emb.dtype)
+        c_buf = jnp.zeros((B, n_nodes + 1, H), emb.dtype)
+
+        def body(bufs, node_ix):
+            h_buf, c_buf = bufs
+            node = tree[:, node_ix]               # (B, 3)
+            left, right, word = node[:, 0], node[:, 1], node[:, 2]
+            is_leaf = (word > 0) & (left == 0)
+            is_absent = (word == 0) & (left == 0) & (right == 0)
+            wv = jnp.take_along_axis(
+                emb, jnp.maximum(word - 1, 0)[:, None, None], axis=1)[:, 0]
+            lh, lc = leaf(wv)
+            hl = jnp.take_along_axis(h_buf, left[:, None, None], axis=1)[:, 0]
+            cl = jnp.take_along_axis(c_buf, left[:, None, None], axis=1)[:, 0]
+            hr = jnp.take_along_axis(h_buf, right[:, None, None],
+                                     axis=1)[:, 0]
+            cr = jnp.take_along_axis(c_buf, right[:, None, None],
+                                     axis=1)[:, 0]
+            ch, cc = compose(hl, cl, hr, cr)
+            h = jnp.where(is_leaf[:, None], lh, ch)
+            c = jnp.where(is_leaf[:, None], lc, cc)
+            h = jnp.where(is_absent[:, None], 0.0, h)
+            c = jnp.where(is_absent[:, None], 0.0, c)
+            slot = jnp.full((B,), node_ix + 1)
+            h_buf = _scatter_rows(h_buf, slot, h)
+            c_buf = _scatter_rows(c_buf, slot, c)
+            return (h_buf, c_buf), None
+
+        (h_buf, _), _ = lax.scan(body, (h_buf, c_buf),
+                                 jnp.arange(n_nodes))
+        return h_buf[:, 1:]
+
+
+def _scatter_rows(buf, slots, rows):
+    """buf[b, slots[b]] = rows[b] for each batch element."""
+    b_idx = jnp.arange(buf.shape[0])
+    return buf.at[b_idx, slots].set(rows)

@@ -1,0 +1,130 @@
+"""bigdl_tpu.tensor — tensor utilities (≙ com.intel.analytics.bigdl.tensor).
+
+The reference implements DenseTensor/SparseTensor/QuantizedTensor with MKL
+BLAS (tensor/DenseTensor.scala, SparseTensor.scala, QuantizedTensor.scala).
+On TPU the dense tensor IS ``jax.numpy.ndarray`` — XLA owns layout and
+kernels — so this package provides:
+
+- torch-style view helpers (narrow/select/index_select) used by layers and
+  the t7/caffe importers;
+- :class:`SparseTensor` — a COO (indices, values, shape) pytree.  XLA has no
+  native sparse representation; ops on it lower to gathers +
+  ``segment_sum`` which map well onto TPU (vectorized, static shapes given
+  a fixed nnz);
+- int8 quantization helpers backing ``bigdl_tpu.quantized``.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------------- #
+# torch-style helpers (tensor/DenseTensor.scala narrow/select/index)    #
+# --------------------------------------------------------------------- #
+def narrow(x, dim: int, index: int, size: int):
+    """1-based narrow: slice `size` elements starting at `index` along dim."""
+    return jax.lax.slice_in_dim(x, index - 1, index - 1 + size, axis=dim - 1)
+
+
+def select(x, dim: int, index: int):
+    """1-based select: index along dim, dropping that dim."""
+    return jnp.take(x, index - 1, axis=dim - 1)
+
+
+def index_select(x, dim: int, indices):
+    """1-based index_select along dim."""
+    idx = jnp.asarray(indices, jnp.int32) - 1
+    return jnp.take(x, idx, axis=dim - 1)
+
+
+# --------------------------------------------------------------------- #
+# sparse (tensor/SparseTensor.scala)                                    #
+# --------------------------------------------------------------------- #
+@jax.tree_util.register_pytree_node_class
+class SparseTensor:
+    """COO sparse tensor: ``indices`` (ndim, nnz) int32, ``values`` (nnz,),
+    dense ``shape``.  Registered as a pytree so it can flow through jit."""
+
+    def __init__(self, indices, values, shape: Tuple[int, ...]):
+        self.indices = jnp.asarray(indices, jnp.int32)
+        self.values = jnp.asarray(values)
+        self.shape = tuple(int(s) for s in shape)
+
+    # pytree protocol
+    def tree_flatten(self):
+        return (self.indices, self.values), self.shape
+
+    @classmethod
+    def tree_unflatten(cls, shape, children):
+        obj = cls.__new__(cls)
+        obj.indices, obj.values = children
+        obj.shape = shape
+        return obj
+
+    @property
+    def nnz(self):
+        return self.values.shape[0]
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    @classmethod
+    def from_dense(cls, dense):
+        """Host-side conversion (data-dependent nnz ⇒ not jittable)."""
+        dense = np.asarray(dense)
+        idx = np.nonzero(dense)
+        return cls(np.stack(idx).astype(np.int32), dense[idx], dense.shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.shape, self.values.dtype)
+        return out.at[tuple(self.indices)].add(self.values)
+
+    def row_ids(self):
+        """Flattened leading-dims index per nnz (segment ids for combiners)."""
+        if self.ndim == 1:
+            return jnp.zeros((self.nnz,), jnp.int32)
+        strides = np.concatenate(
+            [np.cumprod(self.shape[1:-1][::-1])[::-1], [1]]).astype(np.int32)
+        lead = self.indices[:-1]
+        return jnp.sum(lead * jnp.asarray(strides)[:, None], axis=0)
+
+    def __repr__(self):
+        return (f"SparseTensor(shape={self.shape}, nnz={int(self.nnz)}, "
+                f"dtype={self.values.dtype})")
+
+
+def sparse_dense_matmul(sp: SparseTensor, dense):
+    """(N, D)-sparse @ (D, K)-dense via gather + segment_sum (MXU-free but
+    bandwidth-optimal for high sparsity; SparseLinear's core)."""
+    if sp.ndim != 2:
+        raise ValueError("sparse_dense_matmul needs a 2-D SparseTensor")
+    rows, cols = sp.indices
+    contrib = sp.values[:, None] * jnp.take(dense, cols, axis=0)
+    return jax.ops.segment_sum(contrib, rows, num_segments=sp.shape[0])
+
+
+# --------------------------------------------------------------------- #
+# int8 quantization (tensor/QuantizedTensor.scala)                      #
+# --------------------------------------------------------------------- #
+def quantize_symmetric(x, axis=None):
+    """Symmetric per-tensor (axis=None) or per-axis int8 quantization.
+    Returns (q_int8, scale) with x ≈ q * scale."""
+    amax = jnp.max(jnp.abs(x)) if axis is None else jnp.max(
+        jnp.abs(x), axis=tuple(i for i in range(x.ndim) if i != axis),
+        keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize(q, scale):
+    return q.astype(jnp.float32) * scale

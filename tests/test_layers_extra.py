@@ -1,0 +1,256 @@
+"""Sparse / tree / detection / maxout layers (≙ reference SparseLinearSpec,
+LookupTableSparseSpec, BinaryTreeLSTMSpec, PriorBoxSpec, NmsSpec,
+RoiPoolingSpec, MaxoutSpec etc.) — numeric checks against NumPy references."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.tensor import SparseTensor, sparse_dense_matmul
+from bigdl_tpu.utils.table import T
+
+
+class TestSparseTensor:
+    def test_round_trip(self):
+        d = np.array([[0, 1.5, 0], [2.0, 0, 3.0]], np.float32)
+        sp = SparseTensor.from_dense(d)
+        assert sp.nnz == 3
+        np.testing.assert_allclose(np.asarray(sp.to_dense()), d)
+
+    def test_matmul_matches_dense(self):
+        rs = np.random.RandomState(0)
+        d = rs.rand(4, 6).astype(np.float32) * (rs.rand(4, 6) > 0.5)
+        w = rs.rand(6, 3).astype(np.float32)
+        sp = SparseTensor.from_dense(d)
+        np.testing.assert_allclose(np.asarray(sparse_dense_matmul(sp, w)),
+                                   d @ w, rtol=1e-5)
+
+
+class TestSparseLayers:
+    def test_sparse_linear_matches_linear(self):
+        rs = np.random.RandomState(0)
+        d = (rs.rand(5, 8) * (rs.rand(5, 8) > 0.6)).astype(np.float32)
+        sl = nn.SparseLinear(8, 4)
+        params, _ = sl.init_params(0)
+        y = sl.run(params, SparseTensor.from_dense(d))[0]
+        w = params[sl.name]["weight"]
+        b = params[sl.name]["bias"]
+        np.testing.assert_allclose(np.asarray(y), d @ np.asarray(w)
+                                   + np.asarray(b), rtol=1e-5)
+
+    def test_lookup_table_sparse_combiners(self):
+        # batch of 2 bags: ids {1,3} and {2}; 1-based
+        ids = SparseTensor(np.array([[0, 0, 1], [0, 1, 0]]),
+                           np.array([1.0, 3.0, 2.0], np.float32),
+                           (2, 2))
+        for combiner in ("sum", "mean", "sqrtn"):
+            lt = nn.LookupTableSparse(5, 4, combiner=combiner)
+            params, _ = lt.init_params(0)
+            w = np.asarray(params[lt.name]["weight"])
+            y = np.asarray(lt.run(params, ids)[0])
+            bag0 = w[0] + w[2]
+            bag1 = w[1]
+            if combiner == "mean":
+                bag0, bag1 = bag0 / 2, bag1 / 1
+            elif combiner == "sqrtn":
+                bag0, bag1 = bag0 / np.sqrt(2), bag1 / np.sqrt(1)
+            np.testing.assert_allclose(y[0], bag0, rtol=1e-5)
+            np.testing.assert_allclose(y[1], bag1, rtol=1e-5)
+
+    def test_sparse_join_table(self):
+        a = SparseTensor.from_dense(np.array([[1, 0], [0, 2]], np.float32))
+        b = SparseTensor.from_dense(np.array([[0, 3], [4, 0]], np.float32))
+        j = nn.SparseJoinTable(2)
+        out = j.run({}, T(a, b))[0]
+        np.testing.assert_allclose(
+            np.asarray(out.to_dense()),
+            [[1, 0, 0, 3], [0, 2, 4, 0]])
+
+
+class TestBinaryTreeLSTM:
+    def test_shapes_and_determinism(self):
+        # 2 leaves + root: nodes [leaf(w1), leaf(w2), internal(1,2)]
+        tree = np.array([[[0, 0, 1], [0, 0, 2], [1, 2, 0]]], np.float32)
+        emb = np.random.RandomState(0).rand(1, 2, 6).astype(np.float32)
+        m = nn.BinaryTreeLSTM(6, 8)
+        params, _ = m.init_params(0)
+        y = m.run(params, T(jnp.asarray(emb), jnp.asarray(tree)))[0]
+        assert y.shape == (1, 3, 8)
+        # all three nodes populated, and jit agrees with eager
+        assert float(jnp.abs(y).sum()) > 0
+        y2 = jax.jit(lambda p, x: m.run(p, x)[0])(
+            params, T(jnp.asarray(emb), jnp.asarray(tree)))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(y2), rtol=1e-5)
+
+    def test_root_depends_on_children(self):
+        tree = np.array([[[0, 0, 1], [0, 0, 2], [1, 2, 0]]], np.float32)
+        rs = np.random.RandomState(0)
+        emb1 = rs.rand(1, 2, 6).astype(np.float32)
+        emb2 = emb1.copy()
+        emb2[0, 1] += 1.0  # perturb leaf 2
+        m = nn.BinaryTreeLSTM(6, 8)
+        params, _ = m.init_params(0)
+        r1 = m.run(params, T(jnp.asarray(emb1), jnp.asarray(tree)))[0][0, 2]
+        r2 = m.run(params, T(jnp.asarray(emb2), jnp.asarray(tree)))[0][0, 2]
+        assert float(jnp.abs(r1 - r2).sum()) > 1e-4
+
+
+class TestDetection:
+    def test_prior_box_geometry(self):
+        pb = nn.PriorBox(min_sizes=[30.0], max_sizes=[60.0],
+                         aspect_ratios=[2.0], img_size=300, step=8.0)
+        x = jnp.zeros((1, 8, 4, 4))
+        out = pb.run({}, x)[0]
+        # 4 priors per cell (min, sqrt(min*max), ar=2, ar=1/2) over 16 cells
+        assert out.shape == (1, 2, 16 * 4 * 4)
+        priors = np.asarray(out)[0, 0].reshape(-1, 4)
+        # first prior at cell (0,0): square 30x30 centered at (4,4)/300
+        np.testing.assert_allclose(
+            priors[0], [(4 - 15) / 300., (4 - 15) / 300.,
+                        (4 + 15) / 300., (4 + 15) / 300.], atol=1e-6)
+
+    def test_nms_suppresses_overlap(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = nn.Nms().nms(scores, boxes, thresh=0.5)
+        assert keep == [0, 2]
+
+    def test_anchor_count(self):
+        a = nn.Anchor(ratios=[0.5, 1.0, 2.0], scales=[8.0, 16.0, 32.0])
+        anchors = a.generate_anchors(3, 2, feat_stride=16)
+        assert anchors.shape == (9 * 6, 4)
+
+    def test_roi_pooling(self):
+        # feature map = column index; pooling 2x2 over the full image
+        feat = np.tile(np.arange(8, dtype=np.float32), (1, 1, 8, 1))
+        rois = np.array([[0, 0, 0, 7, 7]], np.float32)
+        rp = nn.RoiPooling(2, 2, spatial_scale=1.0)
+        y = rp.run({}, T(jnp.asarray(feat), jnp.asarray(rois)))[0]
+        assert y.shape == (1, 1, 2, 2)
+        np.testing.assert_allclose(np.asarray(y)[0, 0],
+                                   [[3, 7], [3, 7]])
+
+    def test_roi_pooling_jit(self):
+        feat = jnp.asarray(np.random.RandomState(0).rand(2, 3, 8, 8),
+                           jnp.float32)
+        rois = jnp.asarray([[0, 1, 1, 6, 6], [1, 0, 0, 3, 3]], jnp.float32)
+        rp = nn.RoiPooling(3, 3)
+        f = jax.jit(lambda a, b: rp.run({}, T(a, b))[0])
+        y = f(feat, rois)
+        assert y.shape == (2, 3, 3, 3)
+
+    def test_detection_output_ssd(self):
+        # one prior, one confident class → one detection row
+        priors = np.zeros((1, 2, 4), np.float32)
+        priors[0, 0] = [0.1, 0.1, 0.4, 0.4]
+        priors[0, 1] = 0.1
+        loc = np.zeros((1, 4), np.float32)
+        conf = np.array([[0.05, 0.95]], np.float32)
+        det = nn.DetectionOutputSSD(n_classes=2, conf_thresh=0.5)
+        out = det.run({}, T(jnp.asarray(loc), jnp.asarray(conf),
+                            jnp.asarray(priors)))[0]
+        out = np.asarray(out)
+        assert out.shape == (1, 7)
+        assert out[0, 1] == 1 and out[0, 2] > 0.9
+        np.testing.assert_allclose(out[0, 3:], [0.1, 0.1, 0.4, 0.4],
+                                   atol=1e-5)
+
+    def test_proposal_runs(self):
+        rs = np.random.RandomState(0)
+        A = 9
+        scores = rs.rand(1, 2 * A, 4, 4).astype(np.float32)
+        deltas = (rs.rand(1, 4 * A, 4, 4).astype(np.float32) - 0.5) * 0.1
+        im_info = np.array([64.0, 64.0, 1.0], np.float32)
+        prop = nn.Proposal(pre_nms_topn=50, post_nms_topn=10,
+                           ratios=[0.5, 1.0, 2.0], scales=[4.0, 8.0, 16.0],
+                           rpn_min_size=4)
+        out = np.asarray(prop.run({}, T(jnp.asarray(scores),
+                                        jnp.asarray(deltas),
+                                        jnp.asarray(im_info)))[0])
+        assert out.ndim == 2 and out.shape[1] == 5 and out.shape[0] <= 10
+        assert (out[:, 1:] >= 0).all() and (out[:, [1, 3]] <= 64).all()
+
+
+class TestMaxoutAndFriends:
+    def test_maxout_matches_numpy(self):
+        m = nn.Maxout(6, 4, 3)
+        params, _ = m.init_params(0)
+        x = np.random.RandomState(0).rand(2, 6).astype(np.float32)
+        y = m.run(params, jnp.asarray(x))[0]
+        w = np.asarray(params[m.name]["weight"])
+        b = np.asarray(params[m.name]["bias"])
+        ref = (x @ w + b).reshape(2, 3, 4).max(axis=1)
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5)
+
+    def test_masked_select(self):
+        t = jnp.asarray([[1.0, 2.0], [3.0, 4.0]])
+        mask = jnp.asarray([[1, 0], [0, 1]])
+        y = nn.MaskedSelect().run({}, T(t, mask))[0]
+        np.testing.assert_allclose(np.asarray(y), [1.0, 4.0])
+
+    def test_spatial_convolution_map_respects_table(self):
+        # one-to-one table: each output plane sees only its input plane
+        conn = nn.SpatialConvolutionMap.one_to_one(2)
+        m = nn.SpatialConvolutionMap(conn, 3, 3, pad_w=1, pad_h=1)
+        params, _ = m.init_params(0)
+        x = np.zeros((1, 2, 5, 5), np.float32)
+        x[0, 0] = 1.0  # only plane 0 active
+        y = np.asarray(m.run(params, jnp.asarray(x))[0])
+        b = np.asarray(params[m.name]["bias"])
+        # plane 1 output must be exactly its bias (no cross connection)
+        np.testing.assert_allclose(y[0, 1], b[1], atol=1e-6)
+        assert np.abs(y[0, 0] - b[0]).max() > 1e-3
+
+    def test_conv_lstm_3d(self):
+        cell = nn.ConvLSTMPeephole3D(2, 3, 3, 3)
+        rec = nn.Recurrent(cell)
+        params, _ = rec.init_params(0)
+        x = jnp.asarray(np.random.RandomState(0).rand(2, 4, 2, 4, 4, 4),
+                        jnp.float32)
+        y = rec.run(params, x)[0]
+        assert y.shape == (2, 4, 3, 4, 4, 4)
+
+
+class TestReviewRegressions:
+    def test_prior_box_table_input(self):
+        pb = nn.PriorBox(min_sizes=[30.0], img_size=300, step=8.0)
+        out = pb.run({}, T(jnp.zeros((1, 8, 4, 4))))[0]
+        assert out.shape[0:2] == (1, 2)
+
+    def test_conv_lstm_2d_strided(self):
+        rec = nn.Recurrent(nn.ConvLSTMPeephole(2, 3, 3, 3, stride=2))
+        params, _ = rec.init_params(0)
+        x = jnp.asarray(np.random.RandomState(0).rand(1, 2, 2, 8, 8),
+                        jnp.float32)
+        y = rec.run(params, x)[0]
+        assert y.shape == (1, 2, 3, 4, 4)
+
+    def test_conv_lstm_3d_strided(self):
+        rec = nn.Recurrent(nn.ConvLSTMPeephole3D(2, 3, 3, 3, stride=2))
+        params, _ = rec.init_params(0)
+        x = jnp.asarray(np.random.RandomState(0).rand(1, 2, 2, 4, 4, 4),
+                        jnp.float32)
+        y = rec.run(params, x)[0]
+        assert y.shape == (1, 2, 3, 2, 2, 2)
+
+    def test_spatial_convolution_map_explicit_planes(self):
+        conn = nn.SpatialConvolutionMap.random_table(8, 2, 2, seed=0)
+        m = nn.SpatialConvolutionMap(conn, 3, 3, pad_w=1, pad_h=1,
+                                     n_input_plane=8, n_output_plane=2)
+        params, _ = m.init_params(0)
+        x = jnp.asarray(np.random.RandomState(0).rand(1, 8, 5, 5),
+                        jnp.float32)
+        assert m.run(params, x)[0].shape == (1, 2, 5, 5)
+
+    def test_detection_output_ssd_unshared_loc(self):
+        priors = np.zeros((1, 2, 4), np.float32)
+        priors[0, 0] = [0.1, 0.1, 0.4, 0.4]
+        priors[0, 1] = 0.1
+        loc = np.zeros((1, 2 * 4), np.float32)  # per-class loc
+        conf = np.array([[0.05, 0.95]], np.float32)
+        det = nn.DetectionOutputSSD(n_classes=2, conf_thresh=0.5,
+                                    share_location=False)
+        out = np.asarray(det.run({}, T(jnp.asarray(loc), jnp.asarray(conf),
+                                       jnp.asarray(priors)))[0])
+        assert out.shape == (1, 7)
