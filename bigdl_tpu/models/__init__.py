@@ -1,2 +1,2 @@
 """bigdl_tpu.models — model zoo (≙ com.intel.analytics.bigdl.models)."""
-from . import autoencoder, inception, lenet, resnet, rnn, vgg
+from . import autoencoder, inception, lenet, resnet, rnn, transformer, vgg

@@ -1,0 +1,313 @@
+"""TransformerLM — the long-context flagship model.
+
+The reference's sequence models stop at unrolled RNNs
+(models/rnn/SimpleRNN.scala, nn/Recurrent.scala); this decoder-only
+transformer is the TPU-era flagship that exercises every parallel axis:
+
+  dp    batch sharded over data parallel
+  fsdp  parameters/optimizer state sharded (see parallel/spmd.py)
+  tp    megatron-style sharded attention heads + MLP hidden dim
+  sp    sequence sharded, exact attention via the ppermute ring
+        (parallel/ring_attention.py)
+
+TPU-first design decisions:
+  * The model is written as a *global-array* program: matmuls carry
+    ``PartitionSpec`` hints (each parallel-aware module exposes ``pspec``)
+    and the GSPMD partitioner inserts the tp collectives; only the ring
+    attention is a manual ``shard_map`` island (parallel/spmd.py wires it).
+  * RoPE positions, causal masks etc. use global indices, so the same code
+    is correct sharded or not.
+  * bf16 activations / fp32 params by default; per-block ``jax.checkpoint``
+    (rematerialisation) trades MXU FLOPs for HBM when ``remat=True``.
+  * head_dim defaults to 128 = one MXU tile, so flash attention's Pallas
+    kernel runs full-width.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ..nn.module import Module, Ctx
+from ..nn.normalization import RMSNorm
+from ..ops.flash_attention import flash_attention
+from ..nn import init as init_lib
+
+
+@dataclasses.dataclass
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 2048
+    max_len: int = 2048
+    dropout: float = 0.0
+    rope_theta: float = 10000.0
+    dtype: str = "float32"          # activation/compute dtype
+    remat: bool = False             # per-block rematerialisation
+    use_ring_attention: bool = False  # sp-sharded seq (needs mesh w/ 'sp')
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self):
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def apply_rope(x, positions, theta: float = 10000.0):
+    """Rotary position embedding. x: (B, H, S, D), positions: (S,) global."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (S, D/2)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x1 * sin + x2 * cos
+    # re-interleave
+    y = jnp.stack([y1, y2], axis=-1).reshape(x.shape)
+    return y.astype(x.dtype)
+
+
+class TokenEmbedding(Module):
+    """0-based token embedding, vocab-sharded over tp (P('tp', None))."""
+
+    def __init__(self, vocab_size, d_model, name=None):
+        super().__init__(name=name)
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.pspec = {"weight": P("tp", None)}
+
+    def init(self, rng):
+        w = jax.random.normal(rng, (self.vocab_size, self.d_model),
+                              jnp.float32) * (self.d_model ** -0.5)
+        return {self.name: {"weight": w}}
+
+    def apply(self, params, x, ctx):
+        w = self.own(params)["weight"]
+        return jnp.take(w, x.astype(jnp.int32), axis=0)
+
+
+class MultiHeadAttention(Module):
+    """Causal self-attention with RoPE + flash attention.
+
+    tp layout (megatron): wq/wk/wv column-sharded on the head dim
+    (P(None, 'tp')), wo row-sharded (P('tp', None)) — under GSPMD the
+    partitioner emits exactly one psum after wo.  When
+    ``cfg.use_ring_attention`` the spmd trainer swaps the attention core
+    for the sp ring (see parallel/spmd.py: _RING_HOOK).
+    """
+
+    def __init__(self, cfg: TransformerConfig, name=None):
+        super().__init__(name=name)
+        self.cfg = cfg
+        self.pspec = {"wq": P(None, "tp"), "wk": P(None, "tp"),
+                      "wv": P(None, "tp"), "wo": P("tp", None)}
+        # the spmd trainer injects a mesh-aware attention fn here
+        self.attention_fn = None
+
+    def init(self, rng):
+        cfg = self.cfg
+        ks = jax.random.split(rng, 4)
+        scale = cfg.d_model ** -0.5
+        mk = lambda k: jax.random.normal(
+            k, (cfg.d_model, cfg.d_model), jnp.float32) * scale
+        return {self.name: {"wq": mk(ks[0]), "wk": mk(ks[1]),
+                            "wv": mk(ks[2]), "wo": mk(ks[3])}}
+
+    def apply(self, params, x, ctx):
+        cfg = self.cfg
+        p = self.own(params)
+        b, s, _ = x.shape
+        dt = x.dtype
+
+        def proj(w):
+            y = jnp.dot(x, w.astype(dt))
+            y = y.reshape(b, s, cfg.n_heads, cfg.head_dim)
+            return jnp.transpose(y, (0, 2, 1, 3))        # (B, H, S, Dh)
+
+        q, k, v = proj(p["wq"]), proj(p["wk"]), proj(p["wv"])
+        positions = jnp.arange(s)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+        if self.attention_fn is not None:
+            o = self.attention_fn(q, k, v)
+        else:
+            o = flash_attention(q, k, v, causal=True)
+        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, s, cfg.d_model)
+        return jnp.dot(o, p["wo"].astype(dt))
+
+
+class SwiGLU(Module):
+    """Gated MLP: (silu(x w1) * x w3) w2 — two column-sharded matmuls in,
+    one row-sharded out; XLA fuses the gate elementwise into the matmul
+    epilogue, so the MXU sees three big GEMMs and HBM sees no extra trip."""
+
+    def __init__(self, cfg: TransformerConfig, name=None):
+        super().__init__(name=name)
+        self.cfg = cfg
+        self.pspec = {"w1": P(None, "tp"), "w3": P(None, "tp"),
+                      "w2": P("tp", None)}
+
+    def init(self, rng):
+        cfg = self.cfg
+        k1, k2, k3 = jax.random.split(rng, 3)
+        s_in = cfg.d_model ** -0.5
+        s_out = cfg.d_ff ** -0.5
+        return {self.name: {
+            "w1": jax.random.normal(k1, (cfg.d_model, cfg.d_ff)) * s_in,
+            "w3": jax.random.normal(k3, (cfg.d_model, cfg.d_ff)) * s_in,
+            "w2": jax.random.normal(k2, (cfg.d_ff, cfg.d_model)) * s_out,
+        }}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        dt = x.dtype
+        h = jax.nn.silu(jnp.dot(x, p["w1"].astype(dt))) \
+            * jnp.dot(x, p["w3"].astype(dt))
+        return jnp.dot(h, p["w2"].astype(dt))
+
+
+class TransformerBlock(Module):
+    def __init__(self, cfg: TransformerConfig, name=None):
+        super().__init__(name=name)
+        self.cfg = cfg
+        self.norm1 = RMSNorm(cfg.d_model, name=f"{self.name}.norm1")
+        self.attn = MultiHeadAttention(cfg, name=f"{self.name}.attn")
+        self.norm2 = RMSNorm(cfg.d_model, name=f"{self.name}.norm2")
+        self.mlp = SwiGLU(cfg, name=f"{self.name}.mlp")
+
+    def children(self):
+        return [self.norm1, self.attn, self.norm2, self.mlp]
+
+    def init(self, rng):
+        out = {}
+        for i, c in enumerate(self.children()):
+            out.update(c.init(jax.random.fold_in(rng, i)))
+        return out
+
+    def apply(self, params, x, ctx):
+        h = x + self._drop(self.attn.apply(
+            params, self.norm1.apply(params, x, ctx), ctx), ctx)
+        return h + self._drop(self.mlp.apply(
+            params, self.norm2.apply(params, h, ctx), ctx), ctx)
+
+    def _drop(self, x, ctx):
+        rate = self.cfg.dropout
+        if not ctx.training or rate <= 0.0:
+            return x
+        keep = 1.0 - rate
+        mask = jax.random.bernoulli(ctx.rng(self), keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+class LMHead(Module):
+    """Final projection to vocab logits, vocab-sharded over tp."""
+
+    def __init__(self, cfg: TransformerConfig, name=None):
+        super().__init__(name=name)
+        self.cfg = cfg
+        self.pspec = {"weight": P(None, "tp")}
+
+    def init(self, rng):
+        cfg = self.cfg
+        w = jax.random.normal(rng, (cfg.d_model, cfg.vocab_size),
+                              jnp.float32) * (cfg.d_model ** -0.5)
+        return {self.name: {"weight": w}}
+
+    def apply(self, params, x, ctx):
+        return jnp.dot(x, self.own(params)["weight"].astype(x.dtype))
+
+
+class TransformerLM(Module):
+    """Decoder-only causal LM. tokens (B, S) int -> logits (B, S, V)."""
+
+    def __init__(self, cfg: TransformerConfig, name=None):
+        super().__init__(name=name)
+        self.cfg = cfg
+        self.embed = TokenEmbedding(cfg.vocab_size, cfg.d_model,
+                                    name=f"{self.name}.embed")
+        self.blocks = [TransformerBlock(cfg, name=f"{self.name}.block{i}")
+                       for i in range(cfg.n_layers)]
+        self.final_norm = RMSNorm(cfg.d_model, name=f"{self.name}.final_norm")
+        self.head = None if cfg.tie_embeddings else \
+            LMHead(cfg, name=f"{self.name}.head")
+
+    def children(self):
+        out = [self.embed] + self.blocks + [self.final_norm]
+        if self.head is not None:
+            out.append(self.head)
+        return out
+
+    def init(self, rng):
+        out = {}
+        for i, c in enumerate(self.children()):
+            out.update(c.init(jax.random.fold_in(rng, i)))
+        return out
+
+    def apply(self, params, x, ctx):
+        cfg = self.cfg
+        h = self.embed.apply(params, x, ctx)
+        h = h.astype(jnp.dtype(cfg.dtype))
+
+        for blk in self.blocks:
+            if cfg.remat:
+                def f(p, hh, rng_key, _blk=blk):
+                    inner = Ctx(state={}, training=ctx.training,
+                                rng_key=rng_key)
+                    return _blk.apply(p, hh, inner)
+                h = jax.checkpoint(f)(params, h, ctx.rng_key)
+            else:
+                h = blk.apply(params, h, ctx)
+
+        h = self.final_norm.apply(params, h, ctx)
+        if self.head is not None:
+            logits = self.head.apply(params, h, ctx)
+        else:
+            w = params[self.embed.name]["weight"]        # (V, D) tied
+            logits = jnp.dot(h, w.T.astype(h.dtype))
+        return logits.astype(jnp.float32)
+
+    # ------------------------------------------------------------------ #
+    def param_pspecs(self, params):
+        """PartitionSpec pytree matching ``params``; modules declare their
+        tp layout via ``pspec``, everything else is replicated (the fsdp
+        dimension is layered on top by parallel/spmd.py)."""
+        specs = {}
+        by_name = {m.name: m for m in self.modules()}
+        for mod_name, sub in params.items():
+            mod = by_name.get(mod_name)
+            ps = getattr(mod, "pspec", {}) if mod is not None else {}
+            specs[mod_name] = {k: ps.get(k, P()) for k in sub}
+        return specs
+
+
+def lm_cross_entropy(logits, targets, ignore_index: int = -1):
+    """Mean token cross-entropy. logits (B, S, V) fp32, targets (B, S) int."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.clip(targets, 0, logits.shape[-1] - 1)
+    gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+    nll = lse - gold
+    mask = (targets != ignore_index).astype(jnp.float32)
+    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+PRESETS = {
+    "tiny": dict(vocab_size=256, d_model=128, n_heads=2, n_layers=2,
+                 d_ff=256, max_len=256),
+    "base": dict(vocab_size=32000, d_model=768, n_heads=6, n_layers=12,
+                 d_ff=3072, max_len=2048),  # head_dim 128 = one MXU tile
+    "long8k": dict(vocab_size=32000, d_model=1024, n_heads=8, n_layers=16,
+                   d_ff=4096, max_len=8192, remat=True,
+                   use_ring_attention=True, dtype="bfloat16"),
+}
+
+
+def build(preset: str = "base", **overrides) -> TransformerLM:
+    cfg = TransformerConfig(**{**PRESETS[preset], **overrides})
+    return TransformerLM(cfg)

@@ -1,0 +1,10 @@
+"""Hand-tuned TPU kernels (Pallas) and their XLA fallbacks.
+
+The reference gets its hot-loop speed from Intel MKL primitives
+(spark/dl ... tensor/TensorNumeric + the mkl native wrappers); on TPU the
+equivalent role is played by Pallas kernels feeding the MXU, with pure-XLA
+blockwise fallbacks so every op also runs (and is differentiable) on CPU.
+"""
+from .flash_attention import flash_attention, attention_reference
+
+__all__ = ["flash_attention", "attention_reference"]

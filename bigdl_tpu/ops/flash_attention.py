@@ -1,0 +1,299 @@
+"""Flash attention: Pallas TPU kernel + blockwise-XLA fallback.
+
+The reference framework has no fused attention (its RNN era predates it);
+this kernel is the core primitive of our long-context flagship
+(models/transformer.py) and of ring attention (parallel/ring_attention.py).
+
+Design:
+  * forward — Pallas kernel on TPU: grid over (batch*heads, q blocks),
+    online-softmax ``fori_loop`` over key blocks held in VMEM; scores and
+    accumulators in fp32 on the MXU, inputs may be bf16.
+  * forward fallback — same blockwise math as a ``lax.scan`` over key
+    blocks (O(seq * block) memory); used on CPU and for shapes the kernel
+    does not tile.
+  * backward — blockwise ``lax.scan`` recomputation from the saved
+    (q, k, v, out, lse) residuals: flash-style O(seq * block) memory, no
+    materialised (seq, seq) attention matrix; XLA fuses the elementwise
+    neighbourhood of each block matmul.
+
+Both paths share masking logic: a key is attended iff
+``k_pos < kv_len  and  (not causal or q_pos >= k_pos)`` where the position
+vectors are *global* token indices — ring attention passes shifted
+positions for its rotating key/value chunks.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+try:  # Pallas is TPU-only at runtime; import lazily-guarded for CPU tests
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+# Finite "minus infinity": keeps exp()/max() NaN-free for fully-masked rows
+# (the same trick as jax.nn and the original flash kernels).
+DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
+
+
+class _Config(NamedTuple):
+    causal: bool
+    sm_scale: float
+    block_q: int
+    block_k: int
+    use_pallas: bool
+
+
+# --------------------------------------------------------------------- #
+# reference (quadratic) — used by tests and tiny shapes                 #
+# --------------------------------------------------------------------- #
+def attention_reference(q, k, v, causal: bool = False,
+                        sm_scale: Optional[float] = None):
+    """Naive softmax(q k^T) v with optional causal mask. (B, H, S, D)."""
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask, s, DEFAULT_MASK_VALUE)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)
+
+
+# --------------------------------------------------------------------- #
+# shared blockwise math                                                 #
+# --------------------------------------------------------------------- #
+def _mask(q_pos, k_pos, kv_len, causal):
+    """(Sq, Sk) bool attend-mask from global positions."""
+    valid = (k_pos < kv_len)[None, :]
+    if causal:
+        valid = valid & (q_pos[:, None] >= k_pos[None, :])
+    return valid
+
+
+def chunk_merge(q, k_chunk, v_chunk, acc, m, l, q_pos, k_pos, kv_len,
+                sm_scale, causal):
+    """Merge one key/value chunk into the online-softmax accumulators.
+
+    q: (..., Sq, D); k_chunk/v_chunk: (..., Sk, D); acc: (..., Sq, D) fp32;
+    m, l: (..., Sq) fp32 running max / normaliser. Returns updated
+    (acc, m, l). This is the single primitive both the scan fallback and
+    ring attention are built from.
+    """
+    s = jnp.einsum("...qd,...kd->...qk", q.astype(jnp.float32),
+                   k_chunk.astype(jnp.float32)) * sm_scale
+    s = jnp.where(_mask(q_pos, k_pos, kv_len, causal), s, DEFAULT_MASK_VALUE)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = corr * l + p.sum(axis=-1)
+    acc_new = corr[..., None] * acc + jnp.einsum(
+        "...qk,...kd->...qd", p, v_chunk.astype(jnp.float32))
+    return acc_new, m_new, l_new
+
+
+def finalize(acc, m, l):
+    """(out, lse) from final accumulators; fully-masked rows yield 0."""
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    out = acc / safe_l[..., None]
+    lse = m + jnp.log(safe_l)
+    return out, lse
+
+
+def _fwd_blockwise(q, k, v, cfg: _Config):
+    """lax.scan over key blocks. (B, H, S, D) -> (out, lse)."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bk = min(cfg.block_k, sk)
+    n_blocks = -(-sk // bk)
+    pad = n_blocks * bk - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    # (n_blocks, B, H, bk, D) so scan walks the leading axis
+    kb = jnp.moveaxis(k.reshape(b, h, n_blocks, bk, d), 2, 0)
+    vb = jnp.moveaxis(v.reshape(b, h, n_blocks, bk, d), 2, 0)
+    q_pos = jnp.arange(sq)
+
+    def step(carry, blk):
+        acc, m, l = carry
+        k_c, v_c, j = blk
+        k_pos = j * bk + jnp.arange(bk)
+        acc, m, l = chunk_merge(q, k_c, v_c, acc, m, l, q_pos, k_pos,
+                                sk, cfg.sm_scale, cfg.causal)
+        return (acc, m, l), None
+
+    init = (jnp.zeros((b, h, sq, d), jnp.float32),
+            jnp.full((b, h, sq), DEFAULT_MASK_VALUE, jnp.float32),
+            jnp.zeros((b, h, sq), jnp.float32))
+    (acc, m, l), _ = lax.scan(step, init, (kb, vb, jnp.arange(n_blocks)))
+    out, lse = finalize(acc, m, l)
+    return out.astype(q.dtype), lse
+
+
+# --------------------------------------------------------------------- #
+# Pallas forward kernel                                                 #
+# --------------------------------------------------------------------- #
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                sm_scale, causal, block_q, block_k, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
+    d = q.shape[-1]
+    n_kb = seq_k // block_k
+    if causal:
+        # blocks strictly above the diagonal contribute nothing
+        hi = lax.div(qi * block_q + block_q - 1, block_k) + 1
+        hi = jnp.minimum(hi, n_kb)
+    else:
+        hi = n_kb
+
+    def body(j, carry):
+        acc, m, l = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            qp = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kp = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qp >= kp, s, DEFAULT_MASK_VALUE)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        corr = jnp.exp(m - m_new)
+        l_new = corr * l + p.sum(axis=-1)
+        acc_new = corr[:, None] * acc + jnp.dot(
+            p, vb, preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    init = (jnp.zeros((block_q, d), jnp.float32),
+            jnp.full((block_q,), DEFAULT_MASK_VALUE, jnp.float32),
+            jnp.zeros((block_q,), jnp.float32))
+    acc, m, l = lax.fori_loop(0, hi, body, init)
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(safe_l)).astype(lse_ref.dtype)
+
+
+def _fwd_pallas(q, k, v, cfg: _Config):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = cfg.block_q, cfg.block_k
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    grid = (b * h, sq // bq)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=cfg.sm_scale, causal=cfg.causal,
+        block_q=bq, block_k=bk, seq_k=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+    )(qf, kf, vf)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+def _pallas_ok(q, k, cfg: _Config) -> bool:
+    if not (cfg.use_pallas and _HAS_PALLAS):
+        return False
+    sq, d = q.shape[2], q.shape[3]
+    sk = k.shape[2]
+    return (sq % cfg.block_q == 0 and sk % cfg.block_k == 0
+            and d % 128 == 0 and jax.default_backend() == "tpu")
+
+
+# --------------------------------------------------------------------- #
+# custom VJP                                                            #
+# --------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _flash(cfg: _Config, q, k, v):
+    out, _ = _flash_fwd(cfg, q, k, v)
+    return out
+
+
+def _flash_fwd(cfg, q, k, v):
+    if _pallas_ok(q, k, cfg):
+        out, lse = _fwd_pallas(q, k, v, cfg)
+    else:
+        out, lse = _fwd_blockwise(q, k, v, cfg)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(cfg, res, do):
+    q, k, v, out, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bk = min(cfg.block_k, sk)
+    n_blocks = -(-sk // bk)
+    pad = n_blocks * bk - sk
+    kp_ = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else k
+    vp_ = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0))) if pad else v
+    kb = jnp.moveaxis(kp_.reshape(b, h, n_blocks, bk, d), 2, 0)
+    vb = jnp.moveaxis(vp_.reshape(b, h, n_blocks, bk, d), 2, 0)
+
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = (dof * out.astype(jnp.float32)).sum(-1)       # (B,H,Sq)
+    q_pos = jnp.arange(sq)
+
+    def step(dq, blk):
+        k_c, v_c, j = blk
+        k_pos = j * bk + jnp.arange(bk)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_c.astype(jnp.float32)
+                       ) * cfg.sm_scale
+        msk = _mask(q_pos, k_pos, sk, cfg.causal)
+        p = jnp.where(msk, jnp.exp(s - lse[..., None]), 0.0)
+        dv_j = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, v_c.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * cfg.sm_scale
+        dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_c.astype(jnp.float32))
+        dk_j = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    dq, (dk_b, dv_b) = lax.scan(step, dq0, (kb, vb, jnp.arange(n_blocks)))
+    dk = jnp.moveaxis(dk_b, 0, 2).reshape(b, h, n_blocks * bk, d)[:, :, :sk]
+    dv = jnp.moveaxis(dv_b, 0, 2).reshape(b, h, n_blocks * bk, d)[:, :, :sk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    sm_scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128,
+                    use_pallas: bool = True):
+    """Fused attention. q, k, v: (batch, heads, seq, head_dim).
+
+    Pallas kernel on TPU (falls back to a blockwise lax.scan elsewhere);
+    memory-efficient blockwise backward either way.
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    cfg = _Config(bool(causal), float(sm_scale), int(block_q), int(block_k),
+                  bool(use_pallas))
+    return _flash(cfg, q, k, v)

@@ -36,19 +36,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:
-    from jax import shard_map as _shard_map_fn
-
-    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
-        return _shard_map_fn(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=check_rep)
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map as _shard_map_fn
-
-    def shard_map(f, mesh, in_specs, out_specs, check_rep=False):
-        return _shard_map_fn(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_rep=check_rep)
-
+from ..parallel._compat import shard_map
 from ..nn.module import Ctx
 from ..parallel import mesh as mesh_lib
 from ..parallel.allreduce import (allreduce_gradients,

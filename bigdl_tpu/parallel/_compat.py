@@ -1,0 +1,17 @@
+"""Version-compat shim: `jax.shard_map` (new, check_vma) vs
+`jax.experimental.shard_map` (old, check_rep). One copy, imported by every
+explicit-SPMD module."""
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map_fn
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep: bool = False):
+        return _shard_map_fn(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_rep)
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_fn
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep: bool = False):
+        return _shard_map_fn(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_rep=check_rep)

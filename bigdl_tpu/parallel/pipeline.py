@@ -1,0 +1,104 @@
+"""Pipeline parallelism over the ``pp`` mesh axis.
+
+GSPMD does not partition by *layer*; pipelining is inherently a manual
+schedule, so this is a shard_map program: each pp rank holds one stage's
+parameters (stacked layer params sharded on their leading axis), and a
+``lax.scan`` runs the GPipe schedule — microbatches enter stage 0, flow
+stage-to-stage via ``lax.ppermute`` (one ICI hop per tick), and leave from
+the last stage.  With M microbatches and S stages the scan runs M + S - 1
+ticks; every tick all stages compute concurrently (the bubble is the usual
+(S-1)/(M+S-1)).
+
+AD: ppermute transposes to the reverse rotation and the scan transposes to
+the reverse schedule, so ``jax.grad`` through :func:`pipeline_run` is the
+standard 1F1B-equivalent backward pipeline — no hand-written backward.
+
+The reference has nothing comparable (Spark tasks parallelise over *data*
+only); this is part of going beyond its scale (SURVEY §2 #30).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ._compat import shard_map as _shard_map
+
+
+def pipeline_run(stage_fn: Callable, stage_params, microbatches,
+                 axis_name: str = "pp"):
+    """Run the GPipe schedule. Call inside shard_map.
+
+    stage_fn: (params_of_my_stage, x) -> y   (x, y same shape)
+    stage_params: this rank's stage parameters (device-varying pytree)
+    microbatches: (M, mb, ...) — the full microbatched input, replicated;
+                  only stage 0 reads it.
+    Returns (M, mb, ...) outputs, valid on the *last* stage (zeros
+    elsewhere); reduce with e.g. ``masked_loss`` below.
+    """
+    n_stages = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    mb_shape = microbatches.shape[1:]
+    # shift-down (no wraparound): stage i -> i+1; stage 0 receives zeros
+    perm = [(i, i + 1) for i in range(n_stages - 1)]
+    is_first = (idx == 0)
+    is_last = (idx == n_stages - 1)
+
+    def tick(carry, t):
+        state, outputs = carry
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        feed = lax.dynamic_index_in_dim(microbatches, mb_idx, 0,
+                                        keepdims=False)
+        x = jnp.where(is_first, feed, state)
+        y = stage_fn(stage_params, x)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_micro - 1)
+        write = is_last & (t >= n_stages - 1)
+        cur = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(write, y, cur), out_idx, 0)
+        state = lax.ppermute(y, axis_name, perm)
+        return (state, outputs), None
+
+    init = (jnp.zeros(mb_shape, microbatches.dtype),
+            jnp.zeros((n_micro,) + mb_shape, microbatches.dtype))
+    (_, outputs), _ = lax.scan(tick, init, jnp.arange(n_micro + n_stages - 1))
+    return outputs
+
+
+def last_stage_mask(axis_name: str = "pp"):
+    """1.0 on the last pp rank, 0.0 elsewhere — multiply the loss by this
+    and psum over pp so earlier stages contribute zero."""
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    return (idx == n - 1).astype(jnp.float32)
+
+
+def pipelined(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
+              axis_name: str = "pp"):
+    """Wrap a stage function into a global-array pipelined forward.
+
+    Returns ``f(stacked_params, x)`` where stacked_params leaves have a
+    leading n_stages axis (sharded over pp) and x is (batch, ...);
+    the result is the full-model output (batch, ...), replicated.
+    """
+    def global_fn(stacked_params, x):
+        def local(params_stack, xs):
+            # my slice of the stacked layer params: leading dim 1 -> squeeze
+            my = jax.tree_util.tree_map(lambda p: p[0], params_stack)
+            mbs = xs.reshape((n_microbatches, -1) + xs.shape[1:])
+            outs = pipeline_run(stage_fn, my, mbs, axis_name)
+            outs = outs.reshape(xs.shape)
+            # broadcast the last stage's result to every rank
+            outs = lax.psum(outs * last_stage_mask(axis_name), axis_name)
+            return outs
+
+        in_specs = (jax.tree_util.tree_map(lambda _: P(axis_name),
+                                           stacked_params), P())
+        return _shard_map(local, mesh, in_specs, P())(stacked_params, x)
+
+    return global_fn
