@@ -1,0 +1,31 @@
+"""bigdl_tpu.keras — Keras-style API (≙ nn/keras, Keras 1.2.2 surface).
+
+    from bigdl_tpu.keras import Sequential, Dense, Convolution2D, ...
+    model = Sequential()
+    model.add(Convolution2D(32, 3, 3, activation="relu",
+                            input_shape=(1, 28, 28)))
+    ...
+    model.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(x, y, batch_size=128, nb_epoch=5)
+"""
+from .layers import (
+    KerasLayer, Dense, Activation, Dropout, Flatten, Reshape, Permute,
+    RepeatVector, Masking, Highway, MaxoutDense, Embedding,
+    GaussianDropout, GaussianNoise, SpatialDropout1D, SpatialDropout2D,
+    SpatialDropout3D, BatchNormalization,
+    LeakyReLU, ELU, ThresholdedReLU, SReLU, SoftMax,
+    Convolution1D, Convolution2D, Convolution3D,
+    AtrousConvolution1D, AtrousConvolution2D, Deconvolution2D,
+    SeparableConvolution2D, LocallyConnected1D, LocallyConnected2D,
+    MaxPooling1D, MaxPooling2D, MaxPooling3D,
+    AveragePooling1D, AveragePooling2D, AveragePooling3D,
+    GlobalAveragePooling1D, GlobalAveragePooling2D, GlobalAveragePooling3D,
+    GlobalMaxPooling1D, GlobalMaxPooling2D, GlobalMaxPooling3D,
+    ZeroPadding1D, ZeroPadding2D, ZeroPadding3D,
+    Cropping1D, Cropping2D, Cropping3D,
+    UpSampling1D, UpSampling2D, UpSampling3D,
+    SimpleRNN, LSTM, GRU, ConvLSTM2D, Bidirectional, TimeDistributed,
+    Merge,
+)
+from .topology import Sequential, Model, Input, KerasModel

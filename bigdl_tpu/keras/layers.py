@@ -1,0 +1,1004 @@
+"""Keras-style layer API (≙ nn/keras/*.scala, Keras 1.2.2 semantics).
+
+Every Keras layer is a thin *shape-inferring* wrapper: construction records
+hyper-parameters; ``build(input_shape)`` instantiates the underlying
+``bigdl_tpu.nn`` module once the input shape is known (Sequential/Model
+propagate shapes; standalone ``forward`` builds from the actual input).
+Compute therefore always lowers through the same jnp/lax ops as the core
+library — there is no second kernel path.
+
+Conventions (matching the reference nn/keras/KerasLayer.scala):
+  * ``input_shape`` excludes the batch dimension.
+  * conv/pooling layers are channels-first ("th" dim ordering).
+  * ``border_mode``: "valid" or "same".
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.module import Module, Ctx
+from .. import nn as N
+
+
+def _act_module(name, size_hint=None):
+    """Activation by Keras name -> nn module."""
+    if name is None or name == "linear":
+        return N.Identity()
+    table = {
+        "relu": N.ReLU, "tanh": N.Tanh, "sigmoid": N.Sigmoid,
+        "softmax": N.SoftMax, "softplus": N.SoftPlus,
+        "softsign": N.SoftSign, "hard_sigmoid": N.HardSigmoid,
+        "gelu": N.GELU, "silu": N.SiLU, "elu": N.ELU,
+        "log_softmax": N.LogSoftMax,
+    }
+    if name not in table:
+        raise ValueError(f"unknown activation {name!r}")
+    return table[name]()
+
+
+class KerasLayer(Module):
+    """Base: records config, builds the inner nn module lazily."""
+
+    def __init__(self, input_shape=None, name=None):
+        super().__init__(name=name)
+        self.input_shape = tuple(input_shape) if input_shape else None
+        self.inner: Optional[Module] = None
+        self._built_shape = None
+
+    # subclasses implement: inner module from the *full* (batch incl.) shape
+    def _build(self, input_shape) -> Module:
+        raise NotImplementedError(type(self).__name__)
+
+    def build(self, input_shape):
+        shape = tuple(input_shape)
+        if self.inner is None or self._built_shape != shape:
+            self.inner = self._build(shape)
+            self._built_shape = shape
+        return self.inner
+
+    def ensure_built(self):
+        if self.inner is None:
+            if self.input_shape is None:
+                raise ValueError(
+                    f"{self.name}: first layer needs input_shape=")
+            self.build((None,) + self.input_shape)
+        return self.inner
+
+    def children(self):
+        return [self.inner] if self.inner is not None else []
+
+    def init(self, rng):
+        return self.ensure_built().init(rng)
+
+    def initial_state(self):
+        return self.ensure_built().initial_state()
+
+    def apply(self, params, x, ctx):
+        return self.ensure_built().apply(params, x, ctx)
+
+    def forward(self, x, rng=None):
+        if self.inner is None and self.input_shape is None:
+            shape = x[0].shape if isinstance(x, (list, tuple)) else x.shape
+            self.build(shape)
+        return super().forward(x, rng=rng)
+
+    def compute_output_shape(self, input_shape):
+        """input_shape includes batch (None allowed); returns same style."""
+        self.build(tuple(input_shape))
+        batch = input_shape[0]
+        concrete = (2 if batch is None else batch,) + tuple(input_shape[1:])
+        out = self.inner.get_output_shape(concrete)
+        if isinstance(out, tuple) and out and isinstance(out[0], int):
+            return (batch,) + tuple(out[1:])
+        return jax.tree_util.tree_map(
+            lambda s: (batch,) + tuple(s[1:]), out)
+
+
+class _Wrap(KerasLayer):
+    """KerasLayer over an already-constructed nn module (shape-independent)."""
+
+    def __init__(self, factory, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self._factory = factory
+
+    def _build(self, input_shape):
+        return self._factory(input_shape)
+
+
+# ===================================================================== #
+# core                                                                  #
+# ===================================================================== #
+class Dense(KerasLayer):
+    """≙ nn/keras/Dense.scala."""
+
+    def __init__(self, output_dim, activation=None, with_bias=True,
+                 w_regularizer=None, b_regularizer=None,
+                 input_shape=None, input_dim=None, name=None):
+        if input_dim is not None and input_shape is None:
+            input_shape = (input_dim,)
+        super().__init__(input_shape=input_shape, name=name)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def _build(self, input_shape):
+        lin = N.Linear(input_shape[-1], self.output_dim,
+                       with_bias=self.with_bias,
+                       w_regularizer=self.w_regularizer,
+                       b_regularizer=self.b_regularizer)
+        if self.activation is None:
+            return lin
+        return N.Sequential().add(lin).add(_act_module(self.activation))
+
+
+class Activation(KerasLayer):
+    def __init__(self, activation, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.activation = activation
+
+    def _build(self, input_shape):
+        return _act_module(self.activation)
+
+
+class Dropout(KerasLayer):
+    def __init__(self, p, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.p = p
+
+    def _build(self, input_shape):
+        return N.Dropout(init_p=self.p)
+
+
+class Flatten(KerasLayer):
+    def _build(self, input_shape):
+        n = int(np.prod(input_shape[1:]))
+        return N.Reshape((n,))
+
+
+class Reshape(KerasLayer):
+    def __init__(self, target_shape, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.target_shape = tuple(target_shape)
+
+    def _build(self, input_shape):
+        return N.Reshape(self.target_shape)
+
+
+class Permute(KerasLayer):
+    """dims are 1-based over non-batch axes (keras semantics)."""
+
+    def __init__(self, dims, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dims = tuple(dims)
+
+    def _build(self, input_shape):
+        swaps = []
+        cur = list(range(len(self.dims)))
+        tgt = [d - 1 for d in self.dims]
+        for i in range(len(tgt)):
+            j = cur.index(tgt[i])
+            if i != j:
+                swaps.append((i + 1, j + 1))  # 1-based, batch excluded
+                cur[i], cur[j] = cur[j], cur[i]
+        return N.Transpose(swaps)
+
+
+class RepeatVector(KerasLayer):
+    def __init__(self, n, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.n = n
+
+    def _build(self, input_shape):
+        return N.Replicate(self.n, dim=1)
+
+
+class Masking(KerasLayer):
+    def __init__(self, mask_value=0.0, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.mask_value = mask_value
+
+    def _build(self, input_shape):
+        return N.Masking(mask_value=self.mask_value)
+
+
+class Highway(KerasLayer):
+    def __init__(self, activation="tanh", with_bias=True,
+                 w_regularizer=None, b_regularizer=None,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.activation = activation
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def _build(self, input_shape):
+        return N.Highway(input_shape[-1], with_bias=self.with_bias,
+                         activation=_act_module(self.activation),
+                         w_regularizer=self.w_regularizer,
+                         b_regularizer=self.b_regularizer)
+
+
+class MaxoutDense(KerasLayer):
+    def __init__(self, output_dim, nb_feature=4, with_bias=True,
+                 w_regularizer=None, b_regularizer=None,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.output_dim = output_dim
+        self.nb_feature = nb_feature
+
+    def _build(self, input_shape):
+        return N.Maxout(input_shape[-1], self.output_dim, self.nb_feature)
+
+
+class Embedding(KerasLayer):
+    """≙ nn/keras/Embedding.scala — 0-based indices, unlike nn.LookupTable."""
+
+    def __init__(self, input_dim, output_dim, w_regularizer=None,
+                 input_shape=None, input_length=None, name=None):
+        if input_length is not None and input_shape is None:
+            input_shape = (input_length,)
+        super().__init__(input_shape=input_shape, name=name)
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.w_regularizer = w_regularizer
+
+    def _build(self, input_shape):
+        lut = N.LookupTable(self.input_dim, self.output_dim,
+                            w_regularizer=self.w_regularizer)
+        return N.Sequential().add(N.AddConstant(1.0)).add(lut)
+
+
+class GaussianDropout(KerasLayer):
+    def __init__(self, p, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.p = p
+
+    def _build(self, input_shape):
+        return N.GaussianDropout(rate=self.p)
+
+
+class GaussianNoise(KerasLayer):
+    def __init__(self, sigma, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.sigma = sigma
+
+    def _build(self, input_shape):
+        return N.GaussianNoise(stddev=self.sigma)
+
+
+class SpatialDropout1D(KerasLayer):
+    def __init__(self, p=0.5, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.p = p
+
+    def _build(self, input_shape):
+        return N.SpatialDropout1D(init_p=self.p)
+
+
+class SpatialDropout2D(KerasLayer):
+    def __init__(self, p=0.5, dim_ordering="th", input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.p = p
+
+    def _build(self, input_shape):
+        return N.SpatialDropout2D(init_p=self.p)
+
+
+class SpatialDropout3D(KerasLayer):
+    def __init__(self, p=0.5, dim_ordering="th", input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.p = p
+
+    def _build(self, input_shape):
+        return N.SpatialDropout3D(init_p=self.p)
+
+
+class BatchNormalization(KerasLayer):
+    def __init__(self, epsilon=1e-3, momentum=0.99, beta_init="zero",
+                 gamma_init="one", dim_ordering="th",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.epsilon = epsilon
+        self.momentum = momentum
+
+    def _build(self, input_shape):
+        n = input_shape[1]
+        if len(input_shape) == 4:
+            return N.SpatialBatchNormalization(
+                n, eps=self.epsilon, momentum=1.0 - self.momentum)
+        return N.BatchNormalization(
+            n, eps=self.epsilon, momentum=1.0 - self.momentum)
+
+
+# ===================================================================== #
+# advanced activations                                                  #
+# ===================================================================== #
+class LeakyReLU(KerasLayer):
+    def __init__(self, alpha=0.3, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.alpha = alpha
+
+    def _build(self, input_shape):
+        return N.LeakyReLU(negval=self.alpha) \
+            if _has_kw(N.LeakyReLU, "negval") else N.LeakyReLU(self.alpha)
+
+
+class ELU(KerasLayer):
+    def __init__(self, alpha=1.0, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.alpha = alpha
+
+    def _build(self, input_shape):
+        return N.ELU(self.alpha)
+
+
+class ThresholdedReLU(KerasLayer):
+    def __init__(self, theta=1.0, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.theta = theta
+
+    def _build(self, input_shape):
+        return N.Threshold(self.theta, 0.0)
+
+
+class SReLU(KerasLayer):
+    def __init__(self, input_shape=None, shared_axes=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.shared_axes = shared_axes
+
+    def _build(self, input_shape):
+        return N.SReLU(input_shape[1:], shared_axes=self.shared_axes)
+
+
+class SoftMax(KerasLayer):
+    def _build(self, input_shape):
+        return N.SoftMax()
+
+
+def _has_kw(cls, kw):
+    import inspect
+    try:
+        return kw in inspect.signature(cls.__init__).parameters
+    except (TypeError, ValueError):
+        return False
+
+
+# ===================================================================== #
+# convolution                                                           #
+# ===================================================================== #
+def _same_pad(border_mode):
+    if border_mode not in ("valid", "same"):
+        raise ValueError(f"border_mode must be valid|same, got {border_mode}")
+    return -1 if border_mode == "same" else 0
+
+
+class Convolution1D(KerasLayer):
+    """(B, steps, dim) channels-last 1D conv (≙ keras/Convolution1D.scala)."""
+
+    def __init__(self, nb_filter, filter_length, activation=None,
+                 border_mode="valid", subsample_length=1,
+                 w_regularizer=None, b_regularizer=None, bias=True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample_length = subsample_length
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.bias = bias
+
+    def _build(self, input_shape):
+        if self.border_mode == "same":
+            raise ValueError("Convolution1D supports border_mode='valid' "
+                             "(reference parity)")
+        conv = N.TemporalConvolution(
+            input_shape[-1], self.nb_filter, self.filter_length,
+            stride_w=self.subsample_length,
+            w_regularizer=self.w_regularizer,
+            b_regularizer=self.b_regularizer)
+        if self.activation is None:
+            return conv
+        return N.Sequential().add(conv).add(_act_module(self.activation))
+
+
+class Convolution2D(KerasLayer):
+    """(B, C, H, W) channels-first (≙ keras/Convolution2D.scala)."""
+
+    def __init__(self, nb_filter, nb_row, nb_col, activation=None,
+                 border_mode="valid", subsample=(1, 1), dim_ordering="th",
+                 w_regularizer=None, b_regularizer=None, bias=True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = subsample
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.bias = bias
+
+    def _build(self, input_shape):
+        pad = _same_pad(self.border_mode)
+        conv = N.SpatialConvolution(
+            input_shape[1], self.nb_filter, self.nb_col, self.nb_row,
+            stride_w=self.subsample[1], stride_h=self.subsample[0],
+            pad_w=pad, pad_h=pad, with_bias=self.bias,
+            w_regularizer=self.w_regularizer,
+            b_regularizer=self.b_regularizer)
+        if self.activation is None:
+            return conv
+        return N.Sequential().add(conv).add(_act_module(self.activation))
+
+
+class Convolution3D(KerasLayer):
+    def __init__(self, nb_filter, kernel_dim1, kernel_dim2, kernel_dim3,
+                 activation=None, border_mode="valid", subsample=(1, 1, 1),
+                 dim_ordering="th", w_regularizer=None, b_regularizer=None,
+                 bias=True, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.args = (nb_filter, kernel_dim1, kernel_dim2, kernel_dim3)
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = subsample
+        self.bias = bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def _build(self, input_shape):
+        nb, k1, k2, k3 = self.args
+        pad = _same_pad(self.border_mode)
+        conv = N.VolumetricConvolution(
+            input_shape[1], nb, k1, k3, k2,
+            d_t=self.subsample[0], d_w=self.subsample[2],
+            d_h=self.subsample[1], pad_t=pad, pad_w=pad, pad_h=pad,
+            with_bias=self.bias, w_regularizer=self.w_regularizer,
+            b_regularizer=self.b_regularizer)
+        if self.activation is None:
+            return conv
+        return N.Sequential().add(conv).add(_act_module(self.activation))
+
+
+class AtrousConvolution1D(KerasLayer):
+    """Dilated 1D conv via a (1, W) dilated 2D conv on (B, C, 1, steps)."""
+
+    def __init__(self, nb_filter, filter_length, activation=None,
+                 subsample_length=1, atrous_rate=1,
+                 w_regularizer=None, b_regularizer=None,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.subsample_length = subsample_length
+        self.atrous_rate = atrous_rate
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def _build(self, input_shape):
+        dim = input_shape[-1]
+        # steps ride the H axis of a (B, dim, steps, 1) image
+        conv = N.SpatialDilatedConvolution(
+            dim, self.nb_filter, 1, self.filter_length,
+            dw=1, dh=self.subsample_length,
+            dilation_w=1, dilation_h=self.atrous_rate,
+            w_regularizer=self.w_regularizer,
+            b_regularizer=self.b_regularizer)
+        seq = (N.Sequential()
+               .add(N.Transpose([(1, 2)]))       # (B, dim, steps)
+               .add(N.Unsqueeze(3))              # (B, dim, steps, 1)
+               .add(conv)
+               .add(N.Squeeze(4))                # (B, nb, steps')
+               .add(N.Transpose([(1, 2)])))      # (B, steps', nb)
+        if self.activation is not None:
+            seq.add(_act_module(self.activation))
+        return seq
+
+
+class AtrousConvolution2D(KerasLayer):
+    def __init__(self, nb_filter, nb_row, nb_col, activation=None,
+                 subsample=(1, 1), atrous_rate=(1, 1), dim_ordering="th",
+                 w_regularizer=None, b_regularizer=None,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activation
+        self.subsample = subsample
+        self.atrous_rate = atrous_rate
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+
+    def _build(self, input_shape):
+        conv = N.SpatialDilatedConvolution(
+            input_shape[1], self.nb_filter, self.nb_col, self.nb_row,
+            dw=self.subsample[1], dh=self.subsample[0],
+            dilation_w=self.atrous_rate[1], dilation_h=self.atrous_rate[0],
+            w_regularizer=self.w_regularizer,
+            b_regularizer=self.b_regularizer)
+        if self.activation is None:
+            return conv
+        return N.Sequential().add(conv).add(_act_module(self.activation))
+
+
+class Deconvolution2D(KerasLayer):
+    def __init__(self, nb_filter, nb_row, nb_col, activation=None,
+                 subsample=(1, 1), dim_ordering="th",
+                 w_regularizer=None, b_regularizer=None, bias=True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activation
+        self.subsample = subsample
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
+        self.bias = bias
+
+    def _build(self, input_shape):
+        conv = N.SpatialFullConvolution(
+            input_shape[1], self.nb_filter, self.nb_col, self.nb_row,
+            dw=self.subsample[1], dh=self.subsample[0],
+            no_bias=not self.bias,
+            w_regularizer=self.w_regularizer,
+            b_regularizer=self.b_regularizer)
+        if self.activation is None:
+            return conv
+        return N.Sequential().add(conv).add(_act_module(self.activation))
+
+
+class SeparableConvolution2D(KerasLayer):
+    def __init__(self, nb_filter, nb_row, nb_col, activation=None,
+                 border_mode="valid", subsample=(1, 1), depth_multiplier=1,
+                 dim_ordering="th", depthwise_regularizer=None,
+                 pointwise_regularizer=None, b_regularizer=None, bias=True,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = subsample
+        self.depth_multiplier = depth_multiplier
+        self.bias = bias
+
+    def _build(self, input_shape):
+        pad = _same_pad(self.border_mode)
+        conv = N.SpatialSeparableConvolution(
+            input_shape[1], self.nb_filter, self.depth_multiplier,
+            self.nb_col, self.nb_row, sw=self.subsample[1],
+            sh=self.subsample[0], pw=pad, ph=pad, with_bias=self.bias)
+        if self.activation is None:
+            return conv
+        return N.Sequential().add(conv).add(_act_module(self.activation))
+
+
+class LocallyConnected1D(KerasLayer):
+    def __init__(self, nb_filter, filter_length, activation=None,
+                 subsample_length=1, w_regularizer=None, b_regularizer=None,
+                 bias=True, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = nb_filter
+        self.filter_length = filter_length
+        self.activation = activation
+        self.subsample_length = subsample_length
+
+    def _build(self, input_shape):
+        conv = N.LocallyConnected1D(
+            input_shape[1], input_shape[2], self.nb_filter,
+            self.filter_length, stride_w=self.subsample_length)
+        if self.activation is None:
+            return conv
+        return N.Sequential().add(conv).add(_act_module(self.activation))
+
+
+class LocallyConnected2D(KerasLayer):
+    def __init__(self, nb_filter, nb_row, nb_col, activation=None,
+                 border_mode="valid", subsample=(1, 1), dim_ordering="th",
+                 bias=True, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = nb_filter
+        self.nb_row = nb_row
+        self.nb_col = nb_col
+        self.activation = activation
+        self.border_mode = border_mode
+        self.subsample = subsample
+
+    def _build(self, input_shape):
+        pad = _same_pad(self.border_mode)
+        conv = N.LocallyConnected2D(
+            input_shape[1], input_shape[3], input_shape[2], self.nb_filter,
+            self.nb_col, self.nb_row, stride_w=self.subsample[1],
+            stride_h=self.subsample[0], pad_w=pad, pad_h=pad)
+        if self.activation is None:
+            return conv
+        return N.Sequential().add(conv).add(_act_module(self.activation))
+
+
+# ===================================================================== #
+# pooling                                                               #
+# ===================================================================== #
+class MaxPooling1D(KerasLayer):
+    def __init__(self, pool_length=2, stride=None, border_mode="valid",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.pool_length = pool_length
+        self.stride = stride or pool_length
+
+    def _build(self, input_shape):
+        return N.TemporalMaxPooling(self.pool_length, self.stride)
+
+
+class MaxPooling2D(KerasLayer):
+    def __init__(self, pool_size=(2, 2), strides=None, border_mode="valid",
+                 dim_ordering="th", input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.pool_size = pool_size
+        self.strides = strides or pool_size
+        self.border_mode = border_mode
+
+    def _build(self, input_shape):
+        pad = _same_pad(self.border_mode)
+        return N.SpatialMaxPooling(
+            self.pool_size[1], self.pool_size[0],
+            dw=self.strides[1], dh=self.strides[0], pad_w=pad, pad_h=pad)
+
+
+class MaxPooling3D(KerasLayer):
+    def __init__(self, pool_size=(2, 2, 2), strides=None,
+                 border_mode="valid", dim_ordering="th",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.pool_size = pool_size
+        self.strides = strides or pool_size
+
+    def _build(self, input_shape):
+        p, s = self.pool_size, self.strides
+        return N.VolumetricMaxPooling(p[0], p[2], p[1], s[0], s[2], s[1])
+
+
+class AveragePooling1D(KerasLayer):
+    def __init__(self, pool_length=2, stride=None, border_mode="valid",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.pool_length = pool_length
+        self.stride = stride or pool_length
+
+    def _build(self, input_shape):
+        # (B, steps, dim) -> (B, dim, steps, 1) -> pool H -> back
+        pool = N.SpatialAveragePooling(1, self.pool_length,
+                                       dw=1, dh=self.stride)
+        return (N.Sequential()
+                .add(N.Transpose([(1, 2)])).add(N.Unsqueeze(3))
+                .add(pool)
+                .add(N.Squeeze(4)).add(N.Transpose([(1, 2)])))
+
+
+class AveragePooling2D(KerasLayer):
+    def __init__(self, pool_size=(2, 2), strides=None, border_mode="valid",
+                 dim_ordering="th", input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.pool_size = pool_size
+        self.strides = strides or pool_size
+        self.border_mode = border_mode
+
+    def _build(self, input_shape):
+        pad = _same_pad(self.border_mode)
+        return N.SpatialAveragePooling(
+            self.pool_size[1], self.pool_size[0],
+            dw=self.strides[1], dh=self.strides[0], pad_w=pad, pad_h=pad)
+
+
+class AveragePooling3D(KerasLayer):
+    def __init__(self, pool_size=(2, 2, 2), strides=None,
+                 border_mode="valid", dim_ordering="th",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.pool_size = pool_size
+        self.strides = strides or pool_size
+
+    def _build(self, input_shape):
+        p, s = self.pool_size, self.strides
+        return N.VolumetricAveragePooling(p[0], p[2], p[1], s[0], s[2], s[1])
+
+
+class _GlobalPool(KerasLayer):
+    _mean = True
+
+    def _build(self, input_shape):
+        nd = len(input_shape)
+        axes = list(range(2, nd))          # all spatial dims (ch-first)
+        op = N.Mean if self._mean else N.Max
+        seq = N.Sequential()
+        for ax in reversed(axes):          # reduce innermost first
+            seq.add(op(dimension=ax + 1) if _has_kw(op, "dimension")
+                    else op(ax + 1))
+        return seq
+
+
+class GlobalAveragePooling2D(_GlobalPool):
+    _mean = True
+
+
+class GlobalMaxPooling2D(_GlobalPool):
+    _mean = False
+
+
+class GlobalAveragePooling3D(_GlobalPool):
+    _mean = True
+
+
+class GlobalMaxPooling3D(_GlobalPool):
+    _mean = False
+
+
+class GlobalAveragePooling1D(KerasLayer):
+    def _build(self, input_shape):
+        return N.Mean(2)  # (B, steps, dim) -> mean over steps
+
+
+class GlobalMaxPooling1D(KerasLayer):
+    def _build(self, input_shape):
+        return N.Max(2)
+
+
+# ===================================================================== #
+# padding / cropping / upsampling                                       #
+# ===================================================================== #
+class ZeroPadding1D(KerasLayer):
+    def __init__(self, padding=1, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.padding = padding
+
+    def _build(self, input_shape):
+        p = self.padding
+        left, right = (p, p) if isinstance(p, int) else p
+        seq = N.Sequential()
+        seq.add(N.Padding(2, -left, 3))
+        seq.add(N.Padding(2, right, 3))
+        return seq
+
+
+class ZeroPadding2D(KerasLayer):
+    def __init__(self, padding=(1, 1), dim_ordering="th",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.padding = padding
+
+    def _build(self, input_shape):
+        ph, pw = self.padding
+        return N.SpatialZeroPadding(pw, pw, ph, ph)
+
+
+class ZeroPadding3D(KerasLayer):
+    def __init__(self, padding=(1, 1, 1), dim_ordering="th",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.padding = padding
+
+    def _build(self, input_shape):
+        p1, p2, p3 = self.padding
+        seq = N.Sequential()
+        # dims are 1-based over non-batch axes (C=1, D1=2, D2=3, D3=4)
+        for dim, p in ((2, p1), (3, p2), (4, p3)):
+            seq.add(N.Padding(dim, -p, 4))
+            seq.add(N.Padding(dim, p, 4))
+        return seq
+
+
+class Cropping1D(KerasLayer):
+    def __init__(self, cropping=(1, 1), input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.cropping = cropping
+
+    def _build(self, input_shape):
+        a, b = self.cropping
+        steps = input_shape[1]
+        return N.Narrow(2, a + 1, steps - a - b)
+
+
+class Cropping2D(KerasLayer):
+    def __init__(self, cropping=((0, 0), (0, 0)), dim_ordering="th",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.cropping = cropping
+
+    def _build(self, input_shape):
+        return N.Cropping2D(list(self.cropping[0]), list(self.cropping[1]))
+
+
+class Cropping3D(KerasLayer):
+    def __init__(self, cropping=((1, 1), (1, 1), (1, 1)),
+                 dim_ordering="th", input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.cropping = cropping
+
+    def _build(self, input_shape):
+        c = self.cropping
+        return N.Cropping3D(list(c[0]), list(c[1]), list(c[2]))
+
+
+class UpSampling1D(KerasLayer):
+    def __init__(self, length=2, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.length = length
+
+    def _build(self, input_shape):
+        return N.UpSampling1D(self.length)
+
+
+class UpSampling2D(KerasLayer):
+    def __init__(self, size=(2, 2), dim_ordering="th",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.size = size
+
+    def _build(self, input_shape):
+        return N.UpSampling2D(self.size)
+
+
+class UpSampling3D(KerasLayer):
+    def __init__(self, size=(2, 2, 2), dim_ordering="th",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.size = size
+
+    def _build(self, input_shape):
+        return N.UpSampling3D(self.size)
+
+
+# ===================================================================== #
+# recurrent                                                             #
+# ===================================================================== #
+class _KerasRecurrent(KerasLayer):
+    def __init__(self, output_dim, activation="tanh",
+                 inner_activation="hard_sigmoid", return_sequences=False,
+                 go_backwards=False, w_regularizer=None, u_regularizer=None,
+                 b_regularizer=None, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.output_dim = output_dim
+        self.activation = activation
+        self.inner_activation = inner_activation
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+        self.w_regularizer = w_regularizer
+        self.u_regularizer = u_regularizer
+        self.b_regularizer = b_regularizer
+
+    def _cell(self, input_dim):
+        raise NotImplementedError
+
+    def _build(self, input_shape):
+        seq = N.Sequential()
+        if self.go_backwards:
+            seq.add(N.Reverse(2))
+        seq.add(N.Recurrent().add(self._cell(input_shape[-1])))
+        if not self.return_sequences:
+            seq.add(N.Select(2, -1))
+        return seq
+
+
+class SimpleRNN(_KerasRecurrent):
+    def _cell(self, input_dim):
+        return N.RnnCell(input_dim, self.output_dim,
+                         activation=_act_module(self.activation),
+                         w_regularizer=self.w_regularizer,
+                         u_regularizer=self.u_regularizer,
+                         b_regularizer=self.b_regularizer) \
+            if _has_kw(N.RnnCell, "u_regularizer") else \
+            N.RnnCell(input_dim, self.output_dim,
+                      activation=_act_module(self.activation))
+
+
+class LSTM(_KerasRecurrent):
+    def _cell(self, input_dim):
+        return N.LSTM(input_dim, self.output_dim)
+
+
+class GRU(_KerasRecurrent):
+    def _cell(self, input_dim):
+        return N.GRU(input_dim, self.output_dim)
+
+
+class ConvLSTM2D(KerasLayer):
+    def __init__(self, nb_filter, nb_kernel, return_sequences=False,
+                 go_backwards=False, border_mode="same",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.nb_filter = nb_filter
+        self.nb_kernel = nb_kernel
+        self.return_sequences = return_sequences
+        self.go_backwards = go_backwards
+
+    def _build(self, input_shape):
+        cell = N.ConvLSTMPeephole(
+            input_shape[2], self.nb_filter, self.nb_kernel, self.nb_kernel)
+        seq = N.Sequential()
+        if self.go_backwards:
+            seq.add(N.Reverse(2))
+        seq.add(N.Recurrent().add(cell))
+        if not self.return_sequences:
+            seq.add(N.Select(2, -1))
+        return seq
+
+
+class Bidirectional(KerasLayer):
+    """Wraps a keras recurrent layer; merge_mode concat|sum|mul|ave|max."""
+
+    def __init__(self, layer: _KerasRecurrent, merge_mode="concat",
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.layer = layer
+        self.merge_mode = merge_mode
+
+    def _build(self, input_shape):
+        merges = {"concat": lambda: N.JoinTable(2, 2),
+                  "sum": N.CAddTable, "mul": N.CMulTable,
+                  "max": N.CMaxTable, "ave": N.CAveTable}
+        rec = N.BiRecurrent(merge=merges[self.merge_mode]())
+        rec.add(self.layer._cell(input_shape[-1]))
+        seq = N.Sequential().add(rec)
+        if not self.layer.return_sequences:
+            seq.add(N.Select(2, -1))
+        return seq
+
+
+class TimeDistributed(KerasLayer):
+    def __init__(self, layer: KerasLayer, input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.layer = layer
+
+    def _build(self, input_shape):
+        inner = self.layer.build((input_shape[0],) + tuple(input_shape[2:]))
+        return N.TimeDistributed(inner)
+
+
+# ===================================================================== #
+# merge                                                                 #
+# ===================================================================== #
+class Merge(KerasLayer):
+    """Merge a table of inputs (≙ keras/Merge.scala). Used on Table input
+    or with `layers=` inside Sequential."""
+
+    def __init__(self, layers=None, mode="sum", concat_axis=-1,
+                 input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.layers = layers
+        self.mode = mode
+        self.concat_axis = concat_axis
+
+    def _build(self, input_shape):
+        mode = self.mode
+        if mode == "concat":
+            # input_shape here is the shape of ONE branch; 1-based join dim
+            nd = len(input_shape)
+            merge = N.JoinTable(nd if self.concat_axis == -1 else
+                                self.concat_axis + 1)
+        else:
+            table = {"sum": N.CAddTable, "mul": N.CMulTable,
+                     "max": N.CMaxTable, "ave": N.CAveTable,
+                     "dot": N.DotProduct, "cosine": N.CosineDistance}
+            merge = table[mode]()
+        if self.layers:
+            par = N.ParallelTable()
+            for l in self.layers:
+                par.add(l.ensure_built() if isinstance(l, KerasLayer) else l)
+            return N.Sequential().add(par).add(merge)
+        return merge
+
+
+def merge(inputs, mode="sum", concat_axis=-1, name=None):
+    m = Merge(mode=mode, concat_axis=concat_axis, name=name)
+    return m(inputs) if callable(getattr(m, "__call__", None)) else m

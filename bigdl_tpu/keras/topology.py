@@ -1,0 +1,225 @@
+"""Keras topology: Sequential and functional Model with
+compile / fit / evaluate / predict (≙ nn/keras/Topology.scala +
+pyspark/bigdl/nn/keras/topology.py).
+
+Training delegates to the native optimizers: LocalOptimizer on one chip,
+DistriOptimizer over a mesh when ``mesh=`` is given to :meth:`fit` — the
+Keras front end adds no second training path, just string-to-object
+resolution (loss/optimizer/metric names).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.module import Module
+from .. import nn as N
+from ..nn import graph as graph_lib
+from .layers import KerasLayer
+from .. import optim as O
+
+
+def _resolve_loss(loss):
+    if isinstance(loss, str):
+        table = {
+            "categorical_crossentropy": N.CategoricalCrossEntropy,
+            "sparse_categorical_crossentropy": N.ClassNLLCriterion,
+            "mse": N.MSECriterion, "mean_squared_error": N.MSECriterion,
+            "mae": N.AbsCriterion, "mean_absolute_error": N.AbsCriterion,
+            "binary_crossentropy": N.BCECriterion,
+            "hinge": N.MarginCriterion,
+            "kld": N.DistKLDivCriterion,
+            "kullback_leibler_divergence": N.KullbackLeiblerDivergenceCriterion,
+            "poisson": N.PoissonCriterion,
+            "cosine_proximity": N.CosineProximityCriterion,
+            "mean_absolute_percentage_error": N.MeanAbsolutePercentageCriterion,
+            "mape": N.MeanAbsolutePercentageCriterion,
+            "mean_squared_logarithmic_error": N.MeanSquaredLogarithmicCriterion,
+            "msle": N.MeanSquaredLogarithmicCriterion,
+        }
+        return table[loss]()
+    return loss
+
+
+def _resolve_optim(optimizer):
+    if isinstance(optimizer, str):
+        table = {"sgd": lambda: O.SGD(learning_rate=0.01),
+                 "adam": O.Adam, "adagrad": O.Adagrad,
+                 "adadelta": O.Adadelta, "adamax": O.Adamax,
+                 "rmsprop": O.RMSprop}
+        return table[optimizer.lower()]()
+    return optimizer
+
+
+def _resolve_metric(m):
+    if isinstance(m, str):
+        table = {"accuracy": O.Top1Accuracy, "acc": O.Top1Accuracy,
+                 "top1": O.Top1Accuracy, "top5": O.Top5Accuracy,
+                 "loss": O.Loss, "mae": O.MAE}
+        return table[m.lower()]()
+    return m
+
+
+class KerasModel(Module):
+    """Shared compile/fit/evaluate/predict for Sequential and Model."""
+
+    def __init__(self, name=None):
+        super().__init__(name=name)
+        self.loss = None
+        self.optim_method = None
+        self.metrics: List = []
+
+    def compile(self, optimizer, loss, metrics=None):
+        self.optim_method = _resolve_optim(optimizer)
+        self.loss = _resolve_loss(loss)
+        self.metrics = [_resolve_metric(m) for m in (metrics or [])]
+        return self
+
+    def fit(self, x, y=None, batch_size=32, nb_epoch=10,
+            validation_data=None, mesh=None, distributed=False):
+        if self.loss is None:
+            raise RuntimeError("call compile() before fit()")
+        data = x if y is None else (np.asarray(x), np.asarray(y))
+        if distributed or mesh is not None:
+            from ..optim.distri_optimizer import DistriOptimizer
+            from ..parallel import mesh as mesh_lib
+            opt = DistriOptimizer(self, data, self.loss,
+                                  batch_size=batch_size,
+                                  mesh=mesh or mesh_lib.get_mesh())
+        else:
+            opt = O.LocalOptimizer(self, data, self.loss,
+                                   batch_size=batch_size)
+        opt.set_optim_method(self.optim_method)
+        opt.set_end_when(O.Trigger.max_epoch(nb_epoch))
+        if validation_data is not None and self.metrics:
+            vx, vy = validation_data
+            opt.set_validation(O.Trigger.every_epoch(),
+                               (np.asarray(vx), np.asarray(vy)),
+                               self.metrics, batch_size=batch_size)
+        opt.optimize()
+        return self
+
+    def evaluate(self, x, y, batch_size=32):
+        methods = self.metrics or [O.Top1Accuracy()]
+        if O.Loss not in [type(m) for m in methods] and self.loss is not None:
+            methods = methods + [O.Loss(self.loss)]
+        return O.Evaluator(self).test((np.asarray(x), np.asarray(y)), methods)
+
+    def predict(self, x, batch_size=32):
+        return O.Predictor(self, batch_size=batch_size).predict(np.asarray(x))
+
+    def predict_classes(self, x, batch_size=32, zero_based_label=True):
+        cls = O.Predictor(self, batch_size=batch_size).predict_class(
+            np.asarray(x))
+        return cls - 1 if zero_based_label else cls
+
+
+class Sequential(KerasModel):
+    """Linear stack of Keras layers (≙ keras/Topology.scala Sequential)."""
+
+    def __init__(self, name=None):
+        super().__init__(name=name)
+        self.layer_list: List[Module] = []
+        self._out_shape = None
+
+    def add(self, layer):
+        if not self.layer_list and isinstance(layer, KerasLayer) \
+                and layer.input_shape is None and layer.inner is None:
+            raise ValueError("first layer needs input_shape=")
+        if isinstance(layer, KerasLayer):
+            in_shape = self._out_shape
+            if in_shape is None:
+                in_shape = (None,) + tuple(layer.input_shape)
+            self._out_shape = layer.compute_output_shape(in_shape)
+        else:
+            # raw nn module: propagate shape via eval_shape if possible
+            if self._out_shape is not None:
+                concrete = (2,) + tuple(self._out_shape[1:])
+                try:
+                    out = layer.get_output_shape(concrete)
+                    self._out_shape = (None,) + tuple(out[1:])
+                except Exception:
+                    self._out_shape = None
+        self.layer_list.append(layer)
+        return self
+
+    @property
+    def output_shape(self):
+        return self._out_shape
+
+    def children(self):
+        return list(self.layer_list)
+
+    def init(self, rng):
+        p = {}
+        for i, l in enumerate(self.layer_list):
+            p.update(l.init(jax.random.fold_in(rng, i)))
+        return p
+
+    def initial_state(self):
+        s = {}
+        for l in self.layer_list:
+            s.update(l.initial_state())
+        return s
+
+    def apply(self, params, x, ctx):
+        for l in self.layer_list:
+            x = l.apply(params, x, ctx)
+        return x
+
+
+class Model(KerasModel):
+    """Functional graph model: ``Model(input=[nodes], output=node)``
+    (≙ keras/Topology.scala Model). Build nodes with :func:`Input` and by
+    calling layers on nodes."""
+
+    def __init__(self, input, output, name=None):
+        super().__init__(name=name)
+        self.graph = N.Graph(input, output)
+
+    def children(self):
+        return [self.graph]
+
+    def init(self, rng):
+        return self.graph.init(rng)
+
+    def initial_state(self):
+        return self.graph.initial_state()
+
+    def apply(self, params, x, ctx):
+        return self.graph.apply(params, x, ctx)
+
+
+def Input(shape=None, name=None):
+    """Graph input node; shape excludes batch (keras convention)."""
+    node = graph_lib.Input(name=name)
+    node.keras_shape = (None,) + tuple(shape) if shape else None
+    return node
+
+
+def _keras_call(self, x, rng=None):
+    """Calling a Keras layer on a graph Node builds it (from the node's
+    keras_shape when known) and wires a graph edge."""
+    if isinstance(x, graph_lib.Node) or (
+            isinstance(x, (list, tuple))
+            and x and isinstance(x[0], graph_lib.Node)):
+        nodes = [x] if isinstance(x, graph_lib.Node) else list(x)
+        shape = getattr(nodes[0], "keras_shape", None)
+        if shape is not None and self.inner is None:
+            self.build(shape)
+        elif self.inner is None and self.input_shape is not None:
+            self.build((None,) + tuple(self.input_shape))
+        node = graph_lib.Node(self, nodes)
+        if shape is not None:
+            try:
+                node.keras_shape = self.compute_output_shape(shape)
+            except Exception:
+                node.keras_shape = None
+        return node
+    return Module.__call__(self, x, rng=rng)
+
+
+KerasLayer.__call__ = _keras_call

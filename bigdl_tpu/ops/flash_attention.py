@@ -128,10 +128,20 @@ def _fwd_blockwise(q, k, v, cfg: _Config):
     def step(carry, blk):
         acc, m, l = carry
         k_c, v_c, j = blk
-        k_pos = j * bk + jnp.arange(bk)
-        acc, m, l = chunk_merge(q, k_c, v_c, acc, m, l, q_pos, k_pos,
-                                sk, cfg.sm_scale, cfg.causal)
-        return (acc, m, l), None
+
+        def merge(carry):
+            acc, m, l = carry
+            k_pos = j * bk + jnp.arange(bk)
+            return chunk_merge(q, k_c, v_c, acc, m, l, q_pos, k_pos,
+                               sk, cfg.sm_scale, cfg.causal)
+
+        if cfg.causal:
+            # skip blocks entirely beyond the causal horizon (matters for
+            # cross/decode attention where seq_k > seq_q)
+            carry = lax.cond(j * bk > sq - 1, lambda c: c, merge, carry)
+        else:
+            carry = merge(carry)
+        return carry, None
 
     init = (jnp.zeros((b, h, sq, d), jnp.float32),
             jnp.full((b, h, sq), DEFAULT_MASK_VALUE, jnp.float32),

@@ -38,7 +38,7 @@ def pipeline_run(stage_fn: Callable, stage_params, microbatches,
     microbatches: (M, mb, ...) — the full microbatched input, replicated;
                   only stage 0 reads it.
     Returns (M, mb, ...) outputs, valid on the *last* stage (zeros
-    elsewhere); reduce with e.g. ``masked_loss`` below.
+    elsewhere); weight per-stage reductions with :func:`last_stage_mask`.
     """
     n_stages = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)

@@ -150,6 +150,10 @@ class SpmdTrainer:
     def step(self, tokens, targets):
         if self._step_fn is None:
             self.init()
+        # jit traces lazily on first call: re-assert this trainer's ring
+        # hooks so interleaved trainers on one model can't bake a foreign
+        # mesh into our compiled step (compiled programs are unaffected)
+        self.attach()
         sh = self._batch_sharding()
         tokens = jax.device_put(jnp.asarray(tokens), sh)
         targets = jax.device_put(jnp.asarray(targets), sh)

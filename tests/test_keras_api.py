@@ -1,0 +1,138 @@
+"""Keras API tests (≙ reference keras1 test specs): shape inference,
+build-on-first-use, Sequential/Model training, layer coverage."""
+import numpy as np
+import pytest
+
+import bigdl_tpu.keras as K
+
+
+def _shapes(layer, in_shape):
+    return layer.compute_output_shape((None,) + tuple(in_shape))
+
+
+def test_dense_shape_and_forward():
+    d = K.Dense(8, activation="relu", input_shape=(4,))
+    assert _shapes(d, (4,)) == (None, 8)
+    y = d(np.random.randn(3, 4).astype(np.float32))
+    assert y.shape == (3, 8)
+    assert float(y.min()) >= 0.0
+
+
+def test_sequential_mnist_style_train():
+    m = K.Sequential()
+    m.add(K.Convolution2D(4, 3, 3, activation="relu", input_shape=(1, 12, 12)))
+    m.add(K.MaxPooling2D())
+    m.add(K.Flatten())
+    m.add(K.Dense(16, activation="relu"))
+    m.add(K.Dropout(0.1))
+    m.add(K.Dense(5, activation="log_softmax"))
+    assert m.output_shape == (None, 5)
+    rng = np.random.RandomState(0)
+    y = rng.randint(1, 6, 64).astype(np.float32)
+    x = (rng.randn(64, 1, 12, 12) * 0.1
+         + y[:, None, None, None] / 5.0).astype(np.float32)
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x, y, batch_size=16, nb_epoch=2)
+    res = m.evaluate(x, y)
+    assert res[0][1].result()[0] > 0.2
+    preds = m.predict(x[:8])
+    assert preds.shape == (8, 5)
+    cls = m.predict_classes(x[:8])
+    assert cls.min() >= 0 and cls.max() <= 4
+
+
+def test_functional_model_two_branches():
+    i1 = K.Input(shape=(6,))
+    i2 = K.Input(shape=(6,))
+    h1 = K.Dense(4)(i1)
+    h2 = K.Dense(4)(i2)
+    out = K.Merge(mode="sum")([h1, h2])
+    model = K.Model(input=[i1, i2], output=out)
+    from bigdl_tpu.utils.table import T
+    x1 = np.random.randn(2, 6).astype(np.float32)
+    x2 = np.random.randn(2, 6).astype(np.float32)
+    y = model(T(x1, x2))
+    assert y.shape == (2, 4)
+
+
+@pytest.mark.parametrize("layer,in_shape,out_shape", [
+    (K.Flatten(), (3, 4, 5), (60,)),
+    (K.Reshape((2, 6)), (3, 4), (2, 6)),
+    (K.Permute((2, 1)), (3, 4), (4, 3)),
+    (K.RepeatVector(5), (7,), (5, 7)),
+    (K.MaxPooling2D(), (2, 8, 8), (2, 4, 4)),
+    (K.AveragePooling2D(), (2, 8, 8), (2, 4, 4)),
+    (K.MaxPooling1D(2), (8, 3), (4, 3)),
+    (K.AveragePooling1D(2), (8, 3), (4, 3)),
+    (K.MaxPooling3D(), (2, 4, 4, 4), (2, 2, 2, 2)),
+    (K.AveragePooling3D(), (2, 4, 4, 4), (2, 2, 2, 2)),
+    (K.GlobalAveragePooling1D(), (8, 3), (3,)),
+    (K.GlobalMaxPooling1D(), (8, 3), (3,)),
+    (K.GlobalAveragePooling2D(), (2, 4, 6), (2,)),
+    (K.GlobalMaxPooling2D(), (2, 4, 6), (2,)),
+    (K.ZeroPadding1D(2), (5, 3), (9, 3)),
+    (K.ZeroPadding2D((1, 2)), (2, 4, 4), (2, 6, 8)),
+    (K.ZeroPadding3D((1, 1, 1)), (2, 3, 3, 3), (2, 5, 5, 5)),
+    (K.Cropping1D((1, 2)), (8, 3), (5, 3)),
+    (K.Cropping2D(((1, 1), (2, 2))), (2, 6, 8), (2, 4, 4)),
+    (K.UpSampling1D(2), (4, 3), (8, 3)),
+    (K.UpSampling2D((2, 2)), (2, 3, 3), (2, 6, 6)),
+    (K.UpSampling3D((2, 2, 2)), (2, 2, 2, 2), (2, 4, 4, 4)),
+    (K.Convolution1D(4, 3), (10, 6), (8, 4)),
+    (K.Convolution2D(4, 3, 3), (2, 8, 8), (4, 6, 6)),
+    (K.Convolution2D(4, 3, 3, border_mode="same"), (2, 8, 8), (4, 8, 8)),
+    (K.Convolution3D(4, 2, 2, 2), (2, 4, 4, 4), (4, 3, 3, 3)),
+    (K.AtrousConvolution2D(4, 3, 3, atrous_rate=(2, 2)), (2, 9, 9),
+     (4, 5, 5)),
+    (K.Deconvolution2D(4, 3, 3, subsample=(2, 2)), (2, 4, 4), (4, 9, 9)),
+    (K.SeparableConvolution2D(4, 3, 3), (2, 6, 6), (4, 4, 4)),
+    (K.LocallyConnected1D(4, 3), (8, 5), (6, 4)),
+    (K.LocallyConnected2D(4, 3, 3), (2, 6, 6), (4, 4, 4)),
+    (K.Embedding(20, 8), (5,), (5, 8)),
+    (K.Highway(), (6,), (6,)),
+    (K.MaxoutDense(7), (5,), (7,)),
+    (K.Masking(), (4, 5), (4, 5)),
+    (K.LeakyReLU(), (4,), (4,)),
+    (K.ELU(), (4,), (4,)),
+    (K.ThresholdedReLU(), (4,), (4,)),
+    (K.SoftMax(), (4,), (4,)),
+    (K.GaussianDropout(0.2), (4,), (4,)),
+    (K.GaussianNoise(0.2), (4,), (4,)),
+    (K.SpatialDropout1D(0.2), (4, 5), (4, 5)),
+    (K.SpatialDropout2D(0.2), (2, 4, 4), (2, 4, 4)),
+    (K.BatchNormalization(), (3, 4, 4), (3, 4, 4)),
+])
+def test_layer_output_shapes(layer, in_shape, out_shape):
+    got = _shapes(layer, in_shape)
+    assert tuple(got[1:]) == tuple(out_shape), \
+        f"{type(layer).__name__}: {got} != (None, {out_shape})"
+
+
+@pytest.mark.parametrize("cls", [K.SimpleRNN, K.LSTM, K.GRU])
+def test_recurrent_layers(cls):
+    rnn = cls(6, input_shape=(5, 3))
+    assert _shapes(rnn, (5, 3)) == (None, 6)
+    rnn_seq = cls(6, return_sequences=True, input_shape=(5, 3))
+    assert _shapes(rnn_seq, (5, 3)) == (None, 5, 6)
+    x = np.random.randn(2, 5, 3).astype(np.float32)
+    assert rnn(x).shape == (2, 6)
+
+
+def test_bidirectional():
+    bi = K.Bidirectional(K.LSTM(4, return_sequences=True),
+                         merge_mode="concat", input_shape=(5, 3))
+    x = np.random.randn(2, 5, 3).astype(np.float32)
+    assert bi(x).shape == (2, 5, 8)
+
+
+def test_timedistributed():
+    td = K.TimeDistributed(K.Dense(4), input_shape=(5, 3))
+    x = np.random.randn(2, 5, 3).astype(np.float32)
+    assert td(x).shape == (2, 5, 4)
+
+
+def test_convlstm2d():
+    layer = K.ConvLSTM2D(4, 3, input_shape=(5, 2, 6, 6))
+    x = np.random.randn(2, 5, 2, 6, 6).astype(np.float32)
+    assert layer(x).shape == (2, 4, 6, 6)
