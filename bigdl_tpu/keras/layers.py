@@ -53,11 +53,19 @@ class KerasLayer(Module):
     def _build(self, input_shape) -> Module:
         raise NotImplementedError(type(self).__name__)
 
+    @staticmethod
+    def _shape_key(shape):
+        """Batch-agnostic build key: the inner module never depends on the
+        batch dim, so (None, 4) and (3, 4) must map to the SAME build —
+        rebuilding would orphan already-initialized params."""
+        return (None,) + tuple(shape)[1:]
+
     def build(self, input_shape):
         shape = tuple(input_shape)
-        if self.inner is None or self._built_shape != shape:
+        key = self._shape_key(shape)
+        if self.inner is None or self._built_shape != key:
             self.inner = self._build(shape)
-            self._built_shape = shape
+            self._built_shape = key
         return self.inner
 
     def ensure_built(self):
@@ -231,9 +239,15 @@ class MaxoutDense(KerasLayer):
         super().__init__(input_shape=input_shape, name=name)
         self.output_dim = output_dim
         self.nb_feature = nb_feature
+        self.with_bias = with_bias
+        self.w_regularizer = w_regularizer
+        self.b_regularizer = b_regularizer
 
     def _build(self, input_shape):
-        return N.Maxout(input_shape[-1], self.output_dim, self.nb_feature)
+        return N.Maxout(input_shape[-1], self.output_dim, self.nb_feature,
+                        with_bias=self.with_bias,
+                        w_regularizer=self.w_regularizer,
+                        b_regularizer=self.b_regularizer)
 
 
 class Embedding(KerasLayer):
@@ -573,13 +587,19 @@ class SeparableConvolution2D(KerasLayer):
         self.subsample = subsample
         self.depth_multiplier = depth_multiplier
         self.bias = bias
+        self.depthwise_regularizer = depthwise_regularizer
+        self.pointwise_regularizer = pointwise_regularizer
+        self.b_regularizer = b_regularizer
 
     def _build(self, input_shape):
         pad = _same_pad(self.border_mode)
         conv = N.SpatialSeparableConvolution(
             input_shape[1], self.nb_filter, self.depth_multiplier,
             self.nb_col, self.nb_row, sw=self.subsample[1],
-            sh=self.subsample[0], pw=pad, ph=pad, with_bias=self.bias)
+            sh=self.subsample[0], pw=pad, ph=pad, with_bias=self.bias,
+            w_regularizer=self.depthwise_regularizer,
+            p_regularizer=self.pointwise_regularizer,
+            b_regularizer=self.b_regularizer)
         if self.activation is None:
             return conv
         return N.Sequential().add(conv).add(_act_module(self.activation))
@@ -1000,5 +1020,4 @@ class Merge(KerasLayer):
 
 
 def merge(inputs, mode="sum", concat_axis=-1, name=None):
-    m = Merge(mode=mode, concat_axis=concat_axis, name=name)
-    return m(inputs) if callable(getattr(m, "__call__", None)) else m
+    return Merge(mode=mode, concat_axis=concat_axis, name=name)(inputs)

@@ -26,11 +26,16 @@ def _resolve_loss(loss):
     if isinstance(loss, str):
         table = {
             "categorical_crossentropy": N.CategoricalCrossEntropy,
-            "sparse_categorical_crossentropy": N.ClassNLLCriterion,
+            # keras models emit probabilities (softmax activation), so NLL
+            # must log() them (≙ keras/optimization.py ClassNLLCriterion(
+            # logProbAsInput=False))
+            "sparse_categorical_crossentropy": lambda: N.ClassNLLCriterion(
+                log_prob_as_input=False),
             "mse": N.MSECriterion, "mean_squared_error": N.MSECriterion,
             "mae": N.AbsCriterion, "mean_absolute_error": N.AbsCriterion,
             "binary_crossentropy": N.BCECriterion,
             "hinge": N.MarginCriterion,
+            "squared_hinge": lambda: N.MarginCriterion(squared=True),
             "kld": N.DistKLDivCriterion,
             "kullback_leibler_divergence": N.KullbackLeiblerDivergenceCriterion,
             "poisson": N.PoissonCriterion,
@@ -132,7 +137,14 @@ class Sequential(KerasModel):
         if isinstance(layer, KerasLayer):
             in_shape = self._out_shape
             if in_shape is None:
-                in_shape = (None,) + tuple(layer.input_shape)
+                if layer.input_shape is not None:
+                    in_shape = (None,) + tuple(layer.input_shape)
+                elif layer._built_shape is not None:
+                    in_shape = layer._built_shape  # standalone-built earlier
+                else:
+                    raise ValueError(
+                        f"{layer.name}: input shape unknown; pass "
+                        "input_shape= to this layer")
             self._out_shape = layer.compute_output_shape(in_shape)
         else:
             # raw nn module: propagate shape via eval_shape if possible

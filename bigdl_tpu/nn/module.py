@@ -43,6 +43,49 @@ def _fresh_uid():
     return next(_uid_counter)
 
 
+def migrate_legacy_names(tree, module):
+    """Rename dict keys written before auto-names were zero-padded
+    ('Linear_12' -> 'Linear_00000012') wherever the padded form matches one
+    of `module`'s expected param/state names.  Cheap no-op when every key is
+    already in the current format."""
+    import re
+
+    def has_legacy(t):
+        if isinstance(t, dict):
+            return any(re.fullmatch(r".*_\d{1,7}", k) or has_legacy(v)
+                       for k, v in t.items())
+        if isinstance(t, (list, tuple)):
+            return any(has_legacy(v) for v in t)
+        return False
+
+    if not has_legacy(tree):
+        return tree
+
+    expected = set()
+
+    def collect(t):
+        if isinstance(t, dict):
+            expected.update(t.keys())
+            for v in t.values():
+                collect(v)
+    collect(jax.eval_shape(module.init, jax.random.PRNGKey(0)))
+    collect(module.initial_state())
+
+    def pad(k):
+        m = re.fullmatch(r"(.*_)(\d{1,7})", k)
+        return f"{m.group(1)}{int(m.group(2)):08d}" if m else k
+
+    def migrate(t):
+        if isinstance(t, dict):
+            return {k if k in expected or pad(k) not in expected
+                    else pad(k): migrate(v) for k, v in t.items()}
+        if isinstance(t, (list, tuple)):
+            return type(t)(migrate(v) for v in t)
+        return t
+
+    return migrate(tree)
+
+
 class Ctx:
     """Per-call context threaded through ``apply``.
 
@@ -81,7 +124,9 @@ class Module:
 
     def __init__(self, name: Optional[str] = None):
         self._uid = _fresh_uid()
-        self.name = name or f"{type(self).__name__}_{self._uid}"
+        # zero-pad so lexicographic dict-key order (JAX pytree flatten order)
+        # matches creation order even across uid digit-count boundaries
+        self.name = name or f"{type(self).__name__}_{self._uid:08d}"
         # Torch-shell mutable state
         self.output = None
         self.grad_input = None
@@ -313,6 +358,7 @@ class Module:
     def load_weights(self, path):
         with open(path, "rb") as f:
             params, state = pickle.load(f)
+        params, state = migrate_legacy_names((params, state), self)
         self._params = jax.tree_util.tree_map(jnp.asarray, params)
         self._state = jax.tree_util.tree_map(jnp.asarray, state)
         return self
@@ -341,7 +387,9 @@ class Criterion:
 
     def __init__(self, name: Optional[str] = None):
         self._uid = _fresh_uid()
-        self.name = name or f"{type(self).__name__}_{self._uid}"
+        # zero-pad so lexicographic dict-key order (JAX pytree flatten order)
+        # matches creation order even across uid digit-count boundaries
+        self.name = name or f"{type(self).__name__}_{self._uid:08d}"
         self.output = None
         self.grad_input = None
 

@@ -28,7 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..nn.module import Ctx, Module
+from ..nn.module import Ctx, Module, migrate_legacy_names
 from ..data.dataset import DataSet
 from ..data.minibatch import MiniBatch
 from .optim_method import OptimMethod, SGD
@@ -208,7 +208,8 @@ class Optimizer:
             blob = pickle.load(f)
         self.state.epoch = blob["meta"]["epoch"]
         self.state.iteration = blob["meta"]["iteration"]
-        return jax.tree_util.tree_map(jnp.asarray, blob["state"])
+        restored = migrate_legacy_names(blob["state"], self.model)
+        return jax.tree_util.tree_map(jnp.asarray, restored)
 
     # -- validation ------------------------------------------------------ #
     def _validate(self, params, model_state):

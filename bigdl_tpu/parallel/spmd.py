@@ -112,19 +112,25 @@ class SpmdTrainer:
         if not self.ring:
             return self
         fn = partial(ring_attention_shmap, mesh=self.mesh, causal=True)
-        self._saved_hooks = [blk.attn.attention_fn
-                             for blk in self.model.blocks]
         for blk in self.model.blocks:
+            cur = blk.attn.attention_fn
+            # stash the model's TRUE original on the module itself; never
+            # stash another trainer's ring hook (interleaved trainers would
+            # otherwise "restore" a foreign mesh's ring fn on detach)
+            if not (isinstance(cur, partial)
+                    and cur.func is ring_attention_shmap):
+                blk.attn._pre_ring_attention_fn = cur
             blk.attn.attention_fn = fn
+        self._attached = True
         return self
 
     def detach(self):
-        """Restore the attention hooks captured by :meth:`attach`."""
-        saved = getattr(self, "_saved_hooks", None)
-        if saved is not None:
-            for blk, fn in zip(self.model.blocks, saved):
-                blk.attn.attention_fn = fn
-            self._saved_hooks = None
+        """Restore the model's original attention hooks (pre any ring)."""
+        if getattr(self, "_attached", False):
+            for blk in self.model.blocks:
+                if hasattr(blk.attn, "_pre_ring_attention_fn"):
+                    blk.attn.attention_fn = blk.attn._pre_ring_attention_fn
+            self._attached = False
         return self
 
     def init(self):

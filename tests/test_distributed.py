@@ -126,3 +126,21 @@ def test_allreduce_primitives():
     x2 = jnp.ones((8, 16))
     out2 = jax.jit(wrap(g, P("dp"), P()))(x2)
     np.testing.assert_allclose(np.asarray(out2), 8.0)
+
+
+def test_param_tree_order_stable_across_uid_digit_boundary():
+    """Auto-names are zero-padded so lexicographic pytree key order matches
+    creation order even when a model's uids straddle 9->10, 99->100, ...;
+    without this, two identical models built at different global-counter
+    values flatten their leaves in different orders."""
+    for _ in range(120):  # burn uids well past a digit boundary
+        nn.Identity()
+    m1 = make_model(0)
+    for _ in range(37):
+        nn.Identity()
+    m2 = make_model(0)
+    l1 = jax.tree_util.tree_leaves(m1._params)
+    l2 = jax.tree_util.tree_leaves(m2._params)
+    assert [a.shape for a in l1] == [b.shape for b in l2]
+    for a, b in zip(l1, l2):
+        np.testing.assert_allclose(a, b)

@@ -25,7 +25,7 @@ def test_sequential_mnist_style_train():
     m.add(K.Flatten())
     m.add(K.Dense(16, activation="relu"))
     m.add(K.Dropout(0.1))
-    m.add(K.Dense(5, activation="log_softmax"))
+    m.add(K.Dense(5, activation="softmax"))
     assert m.output_shape == (None, 5)
     rng = np.random.RandomState(0)
     y = rng.randint(1, 6, 64).astype(np.float32)
@@ -136,3 +136,52 @@ def test_convlstm2d():
     layer = K.ConvLSTM2D(4, 3, input_shape=(5, 2, 6, 6))
     x = np.random.randn(2, 5, 2, 6, 6).astype(np.float32)
     assert layer(x).shape == (2, 4, 6, 6)
+
+
+def test_build_survives_shape_recheck():
+    """compute_output_shape with batch=None after a concrete-batch forward
+    must NOT rebuild the inner module (would orphan initialized params)."""
+    d = K.Dense(8)
+    x = np.random.randn(3, 4).astype(np.float32)
+    y1 = d.forward(x)
+    inner = d.inner
+    assert d.compute_output_shape((None, 4)) == (None, 8)
+    assert d.inner is inner
+    y2 = d.forward(x)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+
+
+def test_maxout_dense_respects_config():
+    m = K.MaxoutDense(7, with_bias=False, input_shape=(5,))
+    m.ensure_built()
+    leaves = {k for k in m.inner.init(__import__("jax").random.PRNGKey(0))
+              [m.inner.name]}
+    assert "bias" not in leaves
+
+
+def test_sequential_add_clear_error_when_shape_lost():
+    s = K.Sequential().add(K.Dense(4, input_shape=(3,)))
+    s._out_shape = None  # simulate a raw module that broke propagation
+    with pytest.raises(ValueError, match="input shape unknown"):
+        s.add(K.Dense(5))
+
+
+def test_sparse_categorical_crossentropy_positive_and_trains():
+    """keras models output probabilities; the loss must be -log(p) (positive),
+    ≙ reference keras/optimization.py: ClassNLLCriterion(logProbAsInput=False)."""
+    rs = np.random.RandomState(0)
+    x = rs.randn(256, 10).astype(np.float32)
+    w = rs.randn(10, 3).astype(np.float32)
+    yy = (np.argmax(x @ w, axis=1) + 1).astype(np.float32)
+    m = (K.Sequential()
+         .add(K.Dense(16, activation="relu", input_shape=(10,)))
+         .add(K.Dense(3, activation="softmax")))
+    m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x, yy, batch_size=32, nb_epoch=25)
+    from bigdl_tpu.optim import Top1Accuracy
+    res = m.evaluate(x, yy, batch_size=64)
+    loss_val = dict((type(k).__name__, v) for k, v in
+                    [(mth, r.result()[0]) for mth, r in res])
+    assert loss_val["Loss"] > 0
+    assert loss_val["Top1Accuracy"] > 0.6

@@ -73,3 +73,43 @@ def test_pair_criterion_target_forms():
     o = T(jnp.asarray([0.5]), jnp.asarray([0.3]))
     v = float(mr.forward(o, jnp.asarray([1.0])))
     assert abs(v - max(0, -(0.5 - 0.3) + 1.0)) < 1e-5
+
+
+def test_checkpoint_resume_migrates_unpadded_names(tmp_path):
+    """Checkpoints saved before zero-padded auto-names must still resume."""
+    import pickle, re
+    import jax
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 6).astype(np.float32)
+    y = rs.randn(64, 1).astype(np.float32)
+    model = nn.Sequential(nn.Linear(6, 4), nn.Tanh(), nn.Linear(4, 1))
+    opt = (LocalOptimizer(model, (x, y), nn.MSECriterion(), batch_size=32)
+           .set_optim_method(SGD(learning_rate=0.01))
+           .set_end_when(Trigger.max_epoch(1))
+           .set_checkpoint(str(tmp_path)))
+    opt.optimize()
+    # rewrite the checkpoint with legacy (unpadded) key names
+    with open(str(tmp_path / "latest")) as f:
+        path = f.read().strip()
+    with open(path, "rb") as f:
+        blob = pickle.load(f)
+
+    def unpad(tree):
+        if isinstance(tree, dict):
+            return {re.sub(r"_0+(\d)", r"_\1", k): unpad(v)
+                    for k, v in tree.items()}
+        if isinstance(tree, (list, tuple)):
+            return type(tree)(unpad(v) for v in tree)
+        return tree
+    blob["state"] = unpad(blob["state"])
+    with open(path, "wb") as f:
+        pickle.dump(blob, f)
+    opt2 = (LocalOptimizer(model, (x, y), nn.MSECriterion(), batch_size=32)
+            .set_optim_method(SGD(learning_rate=0.01))
+            .set_end_when(Trigger.max_epoch(2))
+            .set_checkpoint(str(tmp_path)))
+    m2 = opt2.optimize()  # resumes from migrated checkpoint, trains epoch 2
+    assert m2._params is not None
+    assert all(re.fullmatch(r".*_\d{8}", k) for k in m2._params)
