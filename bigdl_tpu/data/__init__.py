@@ -4,3 +4,24 @@ from .dataset import (DataSet, LocalArrayDataSet, ArrayMiniBatchDataSet,
                       DistributedDataSet, TransformedDataSet, Transformer,
                       ChainedTransformer, SampleToMiniBatch,
                       FunctionTransformer)
+from .image import (LabeledBGRImage, LabeledGreyImage, BytesToBGRImg,
+                    BytesToGreyImg, LocalImgReader, local_image_paths,
+                    BGRImgCropper, GreyImgCropper, BGRImgRdmCropper, HFlip,
+                    BGRImgNormalizer, BGRImgPixelNormalizer,
+                    GreyImgNormalizer, ColorJitter, Lighting, BGRImgToSample,
+                    GreyImgToSample, BGRImgToBatch, GreyImgToBatch)
+from .imageframe import (ImageFeature, ImageFrame, FeatureTransformer,
+                         ChainedFeatureTransformer, PipelineStep, Resize,
+                         AspectScale, RandomResize, CenterCrop, RandomCrop,
+                         FixedCrop, RandomCropper, RandomAlterAspect, Expand,
+                         Filler, HFlipVision, RandomTransformer, Brightness,
+                         Contrast, Saturation, Hue, ColorJitterVision,
+                         ChannelNormalize, ChannelScaledNormalizer,
+                         PixelNormalizer, ChannelOrder, MatToTensor,
+                         ImageFrameToSample)
+from .text import (LabeledSentence, SentenceSplitter, SentenceTokenizer,
+                   SentenceBiPadding, Dictionary, TextToLabeledSentence,
+                   LabeledSentenceToSample, read_localfile, sentences_split,
+                   sentences_bipadding, sentence_tokenizer,
+                   SENTENCE_START, SENTENCE_END)
+from . import mnist, cifar, news20, movielens

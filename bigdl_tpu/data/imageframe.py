@@ -1,0 +1,602 @@
+"""ImageFrame / ImageFeature vision pipeline (≙ transform/vision/image/:
+ImageFeature.scala, ImageFrame.scala, FeatureTransformer.scala +
+augmentation/*.scala: Resize, Brightness, Contrast, Saturation, Hue,
+ChannelNormalize, ChannelScaledNormalizer, ChannelOrder, Crop (Center/
+Random/Fixed), Expand, Filler, HFlip, PixelNormalizer, RandomCropper,
+RandomResize, RandomTransformer, ColorJitter).
+
+The reference wraps OpenCV Mats; here an ImageFeature carries an HWC
+float32 numpy image (BGR, [0,255]) plus metadata, all transforms are pure
+numpy on the host, and `to_sample`/`to_batch` hand contiguous CHW arrays to
+the TPU feed.  No OpenCV dependency: resize/hue run on numpy (PIL assists
+file decoding only).
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from .dataset import DataSet, Transformer as _IterTransformer
+from .minibatch import MiniBatch, Sample
+
+
+class ImageFeature(dict):
+    """Keyed feature store for one image (≙ ImageFeature.scala)."""
+
+    IMAGE = "floats"          # HWC float32 BGR
+    BYTES = "bytes"
+    URI = "uri"
+    LABEL = "label"
+    ORIGINAL_SIZE = "originalSize"
+    SAMPLE = "sample"
+    PREDICT = "predict"
+    BOUNDING_BOX = "boundingBox"
+
+    def __init__(self, image=None, label=None, uri=None, **kw):
+        super().__init__(**kw)
+        if image is not None:
+            self[self.IMAGE] = np.asarray(image, np.float32)
+            self[self.ORIGINAL_SIZE] = tuple(self[self.IMAGE].shape)
+        if label is not None:
+            self[self.LABEL] = label
+        if uri is not None:
+            self[self.URI] = uri
+
+    @property
+    def image(self) -> np.ndarray:
+        return self[self.IMAGE]
+
+    @image.setter
+    def image(self, v):
+        self[self.IMAGE] = np.asarray(v, np.float32)
+
+    @property
+    def label(self):
+        return self.get(self.LABEL)
+
+    def get_size(self):
+        return tuple(self[self.IMAGE].shape)
+
+    def width(self):
+        return self[self.IMAGE].shape[1]
+
+    def height(self):
+        return self[self.IMAGE].shape[0]
+
+
+class FeatureTransformer:
+    """Per-feature transform, composable with ``>>``
+    (≙ FeatureTransformer.scala; `transform` ≙ transformMat)."""
+
+    def transform(self, feature: ImageFeature) -> ImageFeature:
+        raise NotImplementedError(type(self).__name__)
+
+    def __call__(self, frame_or_feature):
+        if isinstance(frame_or_feature, ImageFeature):
+            return self.transform(frame_or_feature)
+        return frame_or_feature.transform(self)
+
+    def __rshift__(self, other: "FeatureTransformer") -> "FeatureTransformer":
+        return ChainedFeatureTransformer(self, other)
+
+    def apply_iter(self, it):
+        for f in it:
+            yield self.transform(f)
+
+
+class ChainedFeatureTransformer(FeatureTransformer):
+    def __init__(self, *stages):
+        self.stages = list(stages)
+
+    def transform(self, feature):
+        for s in self.stages:
+            feature = s.transform(feature)
+        return feature
+
+
+class PipelineStep(FeatureTransformer):
+    """Wrap a plain fn(HWC array) -> HWC array."""
+
+    def __init__(self, fn: Callable[[np.ndarray], np.ndarray]):
+        self.fn = fn
+
+    def transform(self, feature):
+        feature.image = self.fn(feature.image)
+        return feature
+
+
+# --------------------------------------------------------------------- #
+# ImageFrame                                                            #
+# --------------------------------------------------------------------- #
+class ImageFrame:
+    """Collection of ImageFeatures (≙ ImageFrame.scala LocalImageFrame;
+    the distributed variant shards by dp rank via DistributedDataSet)."""
+
+    def __init__(self, features: Iterable[ImageFeature]):
+        self.features: List[ImageFeature] = list(features)
+
+    # constructors (≙ ImageFrame.read / ImageFrame.array)
+    @staticmethod
+    def read(path: str, scale_to: Optional[int] = None) -> "ImageFrame":
+        from PIL import Image
+        paths = []
+        if os.path.isdir(path):
+            for f in sorted(os.listdir(path)):
+                if f.lower().endswith((".jpg", ".jpeg", ".png", ".bmp")):
+                    paths.append(os.path.join(path, f))
+        else:
+            paths = [path]
+        feats = []
+        for p in paths:
+            img = Image.open(p).convert("RGB")
+            if scale_to:
+                img = img.resize((scale_to, scale_to), Image.BILINEAR)
+            arr = np.asarray(img)[..., ::-1].astype(np.float32)
+            feats.append(ImageFeature(arr, uri=p))
+        return ImageFrame(feats)
+
+    @staticmethod
+    def array(images: Sequence[np.ndarray], labels=None) -> "ImageFrame":
+        labels = labels if labels is not None else [None] * len(images)
+        return ImageFrame(ImageFeature(im, label=lb)
+                          for im, lb in zip(images, labels))
+
+    def transform(self, transformer: FeatureTransformer) -> "ImageFrame":
+        self.features = [transformer.transform(f) for f in self.features]
+        return self
+
+    __rshift__ = transform
+
+    def __len__(self):
+        return len(self.features)
+
+    def __iter__(self):
+        return iter(self.features)
+
+    def to_samples(self) -> List[Sample]:
+        return [f[ImageFeature.SAMPLE] for f in self.features]
+
+    def to_dataset(self, batch_size: int, shuffle: bool = True) -> DataSet:
+        from .dataset import SampleToMiniBatch
+        return (DataSet.array(self.to_samples(), shuffle=shuffle)
+                .transform(SampleToMiniBatch(batch_size)))
+
+
+# --------------------------------------------------------------------- #
+# geometry                                                              #
+# --------------------------------------------------------------------- #
+def _resize_bilinear(img: np.ndarray, out_h: int, out_w: int) -> np.ndarray:
+    """Pure-numpy separable bilinear resize (align_corners=False, the
+    OpenCV INTER_LINEAR convention the reference uses)."""
+    h, w = img.shape[:2]
+    if (h, w) == (out_h, out_w):
+        return img
+    ys = (np.arange(out_h, dtype=np.float32) + 0.5) * (h / out_h) - 0.5
+    xs = (np.arange(out_w, dtype=np.float32) + 0.5) * (w / out_w) - 0.5
+    y0 = np.clip(np.floor(ys).astype(np.int64), 0, h - 1)
+    x0 = np.clip(np.floor(xs).astype(np.int64), 0, w - 1)
+    y1 = np.clip(y0 + 1, 0, h - 1)
+    x1 = np.clip(x0 + 1, 0, w - 1)
+    wy = np.clip(ys - y0, 0.0, 1.0).astype(np.float32)
+    wx = np.clip(xs - x0, 0.0, 1.0).astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx)[None, :, None] \
+        + img[y0][:, x1] * wx[None, :, None]
+    bot = img[y1][:, x0] * (1 - wx)[None, :, None] \
+        + img[y1][:, x1] * wx[None, :, None]
+    return top * (1 - wy)[:, None, None] + bot * wy[:, None, None]
+
+
+class Resize(FeatureTransformer):
+    """≙ augmentation/Resize.scala."""
+
+    def __init__(self, resize_h: int, resize_w: int):
+        self.h, self.w = resize_h, resize_w
+
+    def transform(self, feature):
+        img = feature.image
+        squeeze = img.ndim == 2
+        if squeeze:
+            img = img[..., None]
+        img = _resize_bilinear(img, self.h, self.w)
+        feature.image = img[..., 0] if squeeze else img
+        return feature
+
+
+class AspectScale(FeatureTransformer):
+    """Resize the short edge to `min_size`, keeping aspect ratio and capping
+    the long edge (≙ augmentation/Resize.scala AspectScale)."""
+
+    def __init__(self, min_size: int, max_size: int = 1000):
+        self.min_size, self.max_size = min_size, max_size
+
+    def transform(self, feature):
+        h, w = feature.image.shape[:2]
+        short, long = min(h, w), max(h, w)
+        scale = min(self.min_size / short, self.max_size / long)
+        feature.image = _resize_bilinear(
+            feature.image, int(round(h * scale)), int(round(w * scale)))
+        return feature
+
+
+class RandomResize(FeatureTransformer):
+    """Resize to a size drawn from [min_size, max_size]
+    (≙ augmentation/RandomResize.scala)."""
+
+    def __init__(self, min_size: int, max_size: int, seed: int = 0):
+        self.min_size, self.max_size = min_size, max_size
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature):
+        s = int(self._rng.randint(self.min_size, self.max_size + 1))
+        feature.image = _resize_bilinear(feature.image, s, s)
+        return feature
+
+
+class CenterCrop(FeatureTransformer):
+    """≙ augmentation/Crop.scala CenterCrop."""
+
+    def __init__(self, crop_width: int, crop_height: int):
+        self.cw, self.ch = crop_width, crop_height
+
+    def transform(self, feature):
+        h, w = feature.image.shape[:2]
+        y0, x0 = (h - self.ch) // 2, (w - self.cw) // 2
+        feature.image = feature.image[y0:y0 + self.ch, x0:x0 + self.cw]
+        return feature
+
+
+class RandomCrop(FeatureTransformer):
+    """≙ augmentation/Crop.scala RandomCrop."""
+
+    def __init__(self, crop_width: int, crop_height: int, seed: int = 0):
+        self.cw, self.ch = crop_width, crop_height
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature):
+        h, w = feature.image.shape[:2]
+        y0 = int(self._rng.randint(0, h - self.ch + 1))
+        x0 = int(self._rng.randint(0, w - self.cw + 1))
+        feature.image = feature.image[y0:y0 + self.ch, x0:x0 + self.cw]
+        return feature
+
+
+class FixedCrop(FeatureTransformer):
+    """Crop a fixed box; normalized coords if in [0,1]
+    (≙ augmentation/Crop.scala FixedCrop)."""
+
+    def __init__(self, x1: float, y1: float, x2: float, y2: float,
+                 normalized: bool = False):
+        self.box = (x1, y1, x2, y2)
+        self.normalized = normalized
+
+    def transform(self, feature):
+        h, w = feature.image.shape[:2]
+        x1, y1, x2, y2 = self.box
+        if self.normalized:
+            x1, x2 = x1 * w, x2 * w
+            y1, y2 = y1 * h, y2 * h
+        feature.image = feature.image[int(y1):int(y2), int(x1):int(x2)]
+        return feature
+
+
+class RandomCropper(FeatureTransformer):
+    """Random crop + optional random flip, the ResNet ImageNet train recipe
+    (≙ augmentation/RandomCropper.scala)."""
+
+    def __init__(self, crop_width: int, crop_height: int, mirror: bool = True,
+                 crop_mode: str = "random", channels: int = 3, seed: int = 0):
+        self.cw, self.ch = crop_width, crop_height
+        self.mirror = mirror
+        self.crop_mode = crop_mode
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature):
+        h, w = feature.image.shape[:2]
+        if self.crop_mode == "center":
+            y0, x0 = (h - self.ch) // 2, (w - self.cw) // 2
+        else:
+            y0 = int(self._rng.randint(0, h - self.ch + 1))
+            x0 = int(self._rng.randint(0, w - self.cw + 1))
+        img = feature.image[y0:y0 + self.ch, x0:x0 + self.cw]
+        if self.mirror and self._rng.uniform() < 0.5:
+            img = img[:, ::-1]
+        feature.image = np.ascontiguousarray(img)
+        return feature
+
+
+class RandomAlterAspect(FeatureTransformer):
+    """Random scale+aspect-ratio crop resized to a fixed size, the Inception
+    training crop (≙ augmentation/RandomAlterAspect.scala)."""
+
+    def __init__(self, min_area_ratio: float = 0.08,
+                 max_area_ratio: float = 1.0, min_aspect_ratio: float = 0.75,
+                 target_size: int = 224, seed: int = 0):
+        self.min_area = min_area_ratio
+        self.max_area = max_area_ratio
+        self.min_aspect = min_aspect_ratio
+        self.target = target_size
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature):
+        h, w = feature.image.shape[:2]
+        area = h * w
+        for _ in range(10):
+            t_area = area * self._rng.uniform(self.min_area, self.max_area)
+            ar = self._rng.uniform(self.min_aspect, 1.0 / self.min_aspect)
+            cw = int(round(np.sqrt(t_area * ar)))
+            ch = int(round(np.sqrt(t_area / ar)))
+            if cw <= w and ch <= h:
+                y0 = int(self._rng.randint(0, h - ch + 1))
+                x0 = int(self._rng.randint(0, w - cw + 1))
+                crop = feature.image[y0:y0 + ch, x0:x0 + cw]
+                feature.image = _resize_bilinear(crop, self.target,
+                                                 self.target)
+                return feature
+        feature.image = _resize_bilinear(feature.image, self.target,
+                                         self.target)
+        return feature
+
+
+class Expand(FeatureTransformer):
+    """Place the image on a larger mean-filled canvas (SSD-style zoom-out;
+    ≙ augmentation/Expand.scala)."""
+
+    def __init__(self, means: Sequence[float] = (123.0, 117.0, 104.0),
+                 max_expand_ratio: float = 4.0, seed: int = 0):
+        self.means = np.asarray(means, np.float32)
+        self.max_ratio = max_expand_ratio
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature):
+        ratio = self._rng.uniform(1.0, self.max_ratio)
+        h, w, c = feature.image.shape
+        nh, nw = int(h * ratio), int(w * ratio)
+        y0 = int(self._rng.randint(0, nh - h + 1))
+        x0 = int(self._rng.randint(0, nw - w + 1))
+        canvas = np.tile(self.means[None, None, :], (nh, nw, 1))
+        canvas[y0:y0 + h, x0:x0 + w] = feature.image
+        feature.image = canvas.astype(np.float32)
+        return feature
+
+
+class Filler(FeatureTransformer):
+    """Fill a (normalized-coord) sub-rectangle with a constant
+    (≙ augmentation/Filler.scala)."""
+
+    def __init__(self, start_x: float, start_y: float, end_x: float,
+                 end_y: float, value: float = 255.0):
+        self.box = (start_x, start_y, end_x, end_y)
+        self.value = value
+
+    def transform(self, feature):
+        h, w = feature.image.shape[:2]
+        x1, y1, x2, y2 = self.box
+        feature.image[int(y1 * h):int(y2 * h), int(x1 * w):int(x2 * w)] = \
+            self.value
+        return feature
+
+
+class HFlipVision(FeatureTransformer):
+    """Unconditional horizontal flip (≙ augmentation/HFlip.scala; wrap in
+    RandomTransformer for the probabilistic version)."""
+
+    def transform(self, feature):
+        feature.image = np.ascontiguousarray(feature.image[:, ::-1])
+        return feature
+
+
+class RandomTransformer(FeatureTransformer):
+    """Apply `inner` with probability p (≙ augmentation/RandomTransformer.scala)."""
+
+    def __init__(self, inner: FeatureTransformer, prob: float, seed: int = 0):
+        self.inner = inner
+        self.prob = prob
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature):
+        if self._rng.uniform() < self.prob:
+            feature = self.inner.transform(feature)
+        return feature
+
+
+# --------------------------------------------------------------------- #
+# photometric                                                           #
+# --------------------------------------------------------------------- #
+class Brightness(FeatureTransformer):
+    """Add a uniform delta in [delta_low, delta_high]
+    (≙ augmentation/Brightness.scala)."""
+
+    def __init__(self, delta_low: float = -32.0, delta_high: float = 32.0,
+                 seed: int = 0):
+        self.low, self.high = delta_low, delta_high
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature):
+        feature.image = feature.image + \
+            float(self._rng.uniform(self.low, self.high))
+        return feature
+
+
+class Contrast(FeatureTransformer):
+    """Scale by a uniform factor (≙ augmentation/Contrast.scala)."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5,
+                 seed: int = 0):
+        self.low, self.high = delta_low, delta_high
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature):
+        feature.image = feature.image * \
+            float(self._rng.uniform(self.low, self.high))
+        return feature
+
+
+class Saturation(FeatureTransformer):
+    """Blend with greyscale by a uniform factor
+    (≙ augmentation/Saturation.scala)."""
+
+    def __init__(self, delta_low: float = 0.5, delta_high: float = 1.5,
+                 seed: int = 0):
+        self.low, self.high = delta_low, delta_high
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature):
+        img = feature.image
+        grey = (img[..., 0] * 0.299 + img[..., 1] * 0.587
+                + img[..., 2] * 0.114)[..., None]
+        alpha = float(self._rng.uniform(self.low, self.high))
+        feature.image = img * alpha + grey * (1.0 - alpha)
+        return feature
+
+
+class Hue(FeatureTransformer):
+    """Rotate hue by a uniform delta in degrees (≙ augmentation/Hue.scala;
+    HSV roundtrip done in numpy instead of OpenCV)."""
+
+    def __init__(self, delta_low: float = -18.0, delta_high: float = 18.0,
+                 seed: int = 0):
+        self.low, self.high = delta_low, delta_high
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature):
+        img = np.clip(feature.image, 0, 255) / 255.0  # BGR
+        b, g, r = img[..., 0], img[..., 1], img[..., 2]
+        mx = img.max(-1)
+        mn = img.min(-1)
+        diff = mx - mn + 1e-12
+        h = np.zeros_like(mx)
+        rmax = mx == r
+        gmax = (mx == g) & ~rmax
+        bmax = ~(rmax | gmax)
+        h[rmax] = (60 * (g - b) / diff)[rmax] % 360
+        h[gmax] = (60 * (b - r) / diff + 120)[gmax]
+        h[bmax] = (60 * (r - g) / diff + 240)[bmax]
+        s = np.where(mx > 0, diff / (mx + 1e-12), 0.0)
+        v = mx
+        h = (h + float(self._rng.uniform(self.low, self.high))) % 360
+        c = v * s
+        hp = h / 60.0
+        x = c * (1 - np.abs(hp % 2 - 1))
+        z = np.zeros_like(c)
+        conds = [hp < 1, hp < 2, hp < 3, hp < 4, hp < 5, hp >= 5]
+        rgb = np.select(
+            [cnd[..., None] for cnd in conds],
+            [np.stack([c, x, z], -1), np.stack([x, c, z], -1),
+             np.stack([z, c, x], -1), np.stack([z, x, c], -1),
+             np.stack([x, z, c], -1), np.stack([c, z, x], -1)])
+        rgb = rgb + (v - c)[..., None]
+        feature.image = (rgb[..., ::-1] * 255.0).astype(np.float32)
+        return feature
+
+
+class ColorJitterVision(FeatureTransformer):
+    """Random-order brightness/contrast/saturation(/hue)
+    (≙ augmentation/ColorJitter.scala)."""
+
+    def __init__(self, brightness_prob=0.5, brightness_delta=32.0,
+                 contrast_prob=0.5, contrast_lower=0.5, contrast_upper=1.5,
+                 saturation_prob=0.5, saturation_lower=0.5,
+                 saturation_upper=1.5, hue_prob=0.5, hue_delta=18.0,
+                 seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self._rng = rng
+        self.ops = [
+            RandomTransformer(Brightness(-brightness_delta, brightness_delta,
+                                         seed), brightness_prob, seed),
+            RandomTransformer(Contrast(contrast_lower, contrast_upper, seed),
+                              contrast_prob, seed),
+            RandomTransformer(Saturation(saturation_lower, saturation_upper,
+                                         seed), saturation_prob, seed),
+            RandomTransformer(Hue(-hue_delta, hue_delta, seed), hue_prob,
+                              seed),
+        ]
+
+    def transform(self, feature):
+        order = np.arange(len(self.ops))
+        self._rng.shuffle(order)
+        for i in order:
+            feature = self.ops[i].transform(feature)
+        return feature
+
+
+# --------------------------------------------------------------------- #
+# normalize / layout                                                    #
+# --------------------------------------------------------------------- #
+class ChannelNormalize(FeatureTransformer):
+    """(img - mean) / std per channel (≙ augmentation/ChannelNormalize.scala)."""
+
+    def __init__(self, mean_b: float, mean_g: float, mean_r: float,
+                 std_b: float = 1.0, std_g: float = 1.0, std_r: float = 1.0):
+        self.mean = np.asarray([mean_b, mean_g, mean_r], np.float32)
+        self.std = np.asarray([std_b, std_g, std_r], np.float32)
+
+    def transform(self, feature):
+        feature.image = (feature.image - self.mean) / self.std
+        return feature
+
+
+class ChannelScaledNormalizer(FeatureTransformer):
+    """Per-channel mean subtraction + global scale
+    (≙ augmentation/ChannelScaledNormalizer.scala)."""
+
+    def __init__(self, mean_b: float, mean_g: float, mean_r: float,
+                 scale: float):
+        self.mean = np.asarray([mean_b, mean_g, mean_r], np.float32)
+        self.scale = scale
+
+    def transform(self, feature):
+        feature.image = (feature.image - self.mean) * self.scale
+        return feature
+
+
+class PixelNormalizer(FeatureTransformer):
+    """Subtract a whole mean image (≙ augmentation/PixelNormalizer.scala)."""
+
+    def __init__(self, means: np.ndarray):
+        self.means = np.asarray(means, np.float32)
+
+    def transform(self, feature):
+        feature.image = feature.image - self.means
+        return feature
+
+
+class ChannelOrder(FeatureTransformer):
+    """Swap BGR <-> RGB (≙ augmentation/ChannelOrder.scala)."""
+
+    def transform(self, feature):
+        feature.image = np.ascontiguousarray(feature.image[..., ::-1])
+        return feature
+
+
+class MatToTensor(FeatureTransformer):
+    """HWC -> CHW contiguous 'tensor' layout (≙ opencv MatToTensor.scala)."""
+
+    def __init__(self, to_rgb: bool = False):
+        self.to_rgb = to_rgb
+
+    def transform(self, feature):
+        img = feature.image
+        if self.to_rgb:
+            img = img[..., ::-1]
+        feature.image = np.ascontiguousarray(np.transpose(img, (2, 0, 1)))
+        return feature
+
+
+class ImageFrameToSample(FeatureTransformer):
+    """Attach Sample(chw, label) to each feature
+    (≙ ImageFeatureToSample / convertor in Convertor.scala)."""
+
+    def __init__(self, target_keys: Sequence[str] = ("label",)):
+        self.target_keys = target_keys
+
+    def transform(self, feature):
+        img = feature.image
+        chw = img if img.ndim == 3 and img.shape[0] in (1, 3) \
+            else np.transpose(img, (2, 0, 1))
+        label = feature.get(ImageFeature.LABEL)
+        feature[ImageFeature.SAMPLE] = Sample(
+            np.ascontiguousarray(chw, np.float32),
+            None if label is None else np.float32(label))
+        return feature

@@ -1,0 +1,41 @@
+"""MovieLens-1M loader (≙ pyspark/bigdl/dataset/movielens.py).
+
+Reads ml-1m/ratings.dat ("uid::mid::rating::timestamp") from a local dir;
+synthesizes a deterministic rating matrix sample when absent (zero egress).
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def _synthetic(n_users=200, n_movies=120, n_ratings=4000, seed=0):
+    rng = np.random.RandomState(seed)
+    uid = rng.randint(1, n_users + 1, n_ratings)
+    mid = rng.randint(1, n_movies + 1, n_ratings)
+    # structured ratings: users like movies whose id mod 5 matches theirs
+    base = 3.0 + ((uid % 5) == (mid % 5)) * 1.5 - ((uid % 7) == 0) * 1.0
+    rating = np.clip(np.round(base + rng.randn(n_ratings) * 0.5), 1, 5)
+    ts = rng.randint(9e8, 1e9, n_ratings)
+    return np.stack([uid, mid, rating.astype(np.int64), ts], 1)
+
+
+def read_data_sets(data_dir):
+    """Returns int array [N, 4] of (userid, movieid, rating, timestamp)."""
+    rating_file = os.path.join(data_dir, "ml-1m", "ratings.dat")
+    if not os.path.exists(rating_file):
+        return _synthetic()
+    rows = []
+    with open(rating_file) as f:
+        for line in f:
+            rows.append([int(float(v)) for v in line.strip().split("::")])
+    return np.asarray(rows, np.int64)
+
+
+def get_id_pairs(data_dir):
+    return read_data_sets(data_dir)[:, 0:2]
+
+
+def get_id_ratings(data_dir):
+    return read_data_sets(data_dir)[:, 0:3]

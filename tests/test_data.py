@@ -1,0 +1,255 @@
+"""Data pipeline tests (≙ spark/dl/src/test dataset/*Spec.scala:
+BGRImgNormalizerSpec, BGRImgCropperSpec, HFlipSpec, ColorJitterSpec,
+LightingSpec, transformers; text DictionarySpec, SentenceSpec; loaders)."""
+import numpy as np
+import pytest
+
+from bigdl_tpu import data as D
+from bigdl_tpu.data import image as I
+from bigdl_tpu.data import imageframe as V
+from bigdl_tpu.data import text as T
+
+
+def _imgs(n=4, h=10, w=12, seed=0):
+    rng = np.random.RandomState(seed)
+    return [I.LabeledBGRImage(rng.rand(h, w, 3) * 255, label=i + 1)
+            for i in range(n)]
+
+
+# --------------------------------------------------------------------- #
+# image transformers                                                    #
+# --------------------------------------------------------------------- #
+def test_bgr_cropper_center_and_random():
+    out = list(I.BGRImgCropper(8, 6, "center")(_imgs()))
+    assert all(im.data.shape == (6, 8, 3) for im in out)
+    src = _imgs(1, 10, 12)[0]
+    center = I.BGRImgCropper(8, 6, "center")([src.copy()])
+    expect = src.data[2:8, 2:10]
+    np.testing.assert_allclose(next(iter(center)).data, expect)
+    out = list(I.BGRImgCropper(8, 6, "random")(_imgs()))
+    assert all(im.data.shape == (6, 8, 3) for im in out)
+
+
+def test_rdm_cropper_pads_then_crops():
+    out = list(I.BGRImgRdmCropper(12, 10, padding=4)(_imgs()))
+    assert all(im.data.shape == (10, 12, 3) for im in out)
+
+
+def test_hflip_deterministic_seed():
+    src = _imgs(1)[0]
+    flipped = next(iter(I.HFlip(threshold=1.1)([src.copy()])))
+    np.testing.assert_allclose(flipped.data, src.data[:, ::-1])
+    same = next(iter(I.HFlip(threshold=-0.1)([src.copy()])))
+    np.testing.assert_allclose(same.data, src.data)
+
+
+def test_normalizer_stats():
+    imgs = _imgs(8)
+    norm = I.BGRImgNormalizer.from_dataset(imgs)
+    out = np.concatenate([im.data.reshape(-1, 3)
+                          for im in norm(_imgs(8))])
+    np.testing.assert_allclose(out.mean(0), 0.0, atol=1e-3)
+    np.testing.assert_allclose(out.std(0), 1.0, atol=1e-2)
+
+
+def test_grey_pipeline_to_batch():
+    rng = np.random.RandomState(0)
+    greys = [(rng.rand(28, 28) * 255, float(i % 10 + 1)) for i in range(10)]
+    pipeline = (I.BytesToGreyImg()
+                >> I.GreyImgNormalizer(128.0, 64.0)
+                >> I.GreyImgToBatch(4))
+    batches = list(pipeline(greys))
+    assert batches[0].get_input().shape == (4, 1, 28, 28)
+    assert batches[-1].get_input().shape == (2, 1, 28, 28)  # no drop
+    assert batches[0].get_target().shape == (4,)
+
+
+def test_color_jitter_and_lighting_shapes():
+    out = list((I.ColorJitter() >> I.Lighting())(_imgs()))
+    assert all(im.data.shape == (10, 12, 3) for im in out)
+    # lighting adds a constant per image; jitter preserves shape
+    src = _imgs(1)[0]
+    lit = next(iter(I.Lighting(seed=3)([src.copy()])))
+    delta = lit.data - src.data
+    assert np.allclose(delta.std(axis=(0, 1)), 0.0, atol=1e-5)
+
+
+def test_bgr_to_sample_rgb_transpose():
+    src = _imgs(1)[0]
+    s = next(iter(I.BGRImgToSample(to_rgb=True)([src.copy()])))
+    assert s.feature().shape == (3, 10, 12)
+    np.testing.assert_allclose(s.feature()[0], src.data[..., 2])  # R first
+
+
+def test_full_train_pipeline_feeds_optimizer():
+    """End-to-end: raw uint8 -> augment -> batch -> one LeNet-ish step."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+    from bigdl_tpu.data.dataset import DataSet
+
+    rng = np.random.RandomState(0)
+    raws = [((rng.rand(28, 28) * 255).astype(np.uint8), float(i % 5 + 1))
+            for i in range(32)]
+    ds = (DataSet.array(raws, shuffle=True)
+          >> I.BytesToGreyImg()
+          >> I.GreyImgNormalizer(128.0, 64.0)
+          >> I.GreyImgToBatch(8))
+    model = nn.Sequential(nn.Reshape((784,)), nn.Linear(784, 5),
+                          nn.LogSoftMax())
+    opt = (LocalOptimizer(model, ds, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(learning_rate=0.01))
+           .set_end_when(Trigger.max_epoch(1)))
+    m = opt.optimize()
+    assert m._params is not None
+
+
+# --------------------------------------------------------------------- #
+# ImageFrame / vision                                                   #
+# --------------------------------------------------------------------- #
+def test_imageframe_pipeline():
+    rng = np.random.RandomState(0)
+    images = [rng.rand(20, 24, 3).astype(np.float32) * 255 for _ in range(5)]
+    frame = V.ImageFrame.array(images, labels=[1, 2, 3, 4, 5])
+    pipe = (V.Resize(16, 16) >> V.CenterCrop(12, 12)
+            >> V.ChannelNormalize(110, 110, 110, 60, 60, 60)
+            >> V.MatToTensor() >> V.ImageFrameToSample())
+    frame.transform(pipe)
+    samples = frame.to_samples()
+    assert len(samples) == 5
+    assert samples[0].feature().shape == (3, 12, 12)
+    ds = frame.to_dataset(batch_size=2, shuffle=False)
+    mb = next(iter(ds.data(train=False)))
+    assert mb.get_input().shape == (2, 3, 12, 12)
+
+
+def test_resize_bilinear_matches_identity_and_mean():
+    img = np.arange(16, dtype=np.float32).reshape(4, 4, 1)
+    out = V._resize_bilinear(img, 4, 4)
+    np.testing.assert_allclose(out, img)
+    up = V._resize_bilinear(img, 8, 8)
+    assert up.shape == (8, 8, 1)
+    np.testing.assert_allclose(up.mean(), img.mean(), atol=0.5)
+
+
+def test_hue_identity_when_zero_delta():
+    rng = np.random.RandomState(0)
+    f = V.ImageFeature(rng.rand(6, 6, 3).astype(np.float32) * 255)
+    before = f.image.copy()
+    V.Hue(0.0, 0.0).transform(f)
+    np.testing.assert_allclose(f.image, before, atol=1.0)
+
+
+def test_channel_order_and_expand():
+    rng = np.random.RandomState(0)
+    f = V.ImageFeature(rng.rand(6, 6, 3).astype(np.float32))
+    before = f.image.copy()
+    V.ChannelOrder().transform(f)
+    np.testing.assert_allclose(f.image, before[..., ::-1])
+    f2 = V.ImageFeature(np.ones((4, 4, 3), np.float32))
+    V.Expand(means=(7, 7, 7), max_expand_ratio=2.0).transform(f2)
+    assert f2.image.shape[0] >= 4 and f2.image.shape[2] == 3
+
+
+def test_random_alter_aspect_fixed_output():
+    rng = np.random.RandomState(0)
+    f = V.ImageFeature(rng.rand(40, 30, 3).astype(np.float32))
+    V.RandomAlterAspect(target_size=24).transform(f)
+    assert f.image.shape == (24, 24, 3)
+
+
+# --------------------------------------------------------------------- #
+# text                                                                  #
+# --------------------------------------------------------------------- #
+def test_tokenize_and_bipadding():
+    toks = list(T.SentenceTokenizer()(["Hello World, again!"]))[0]
+    assert toks == ["hello", "world", ",", "again", "!"]
+    padded = list(T.SentenceBiPadding()([toks]))[0]
+    assert padded[0] == T.SENTENCE_START and padded[-1] == T.SENTENCE_END
+
+
+def test_dictionary_topk_and_oov():
+    sents = [["a", "b", "a", "c"], ["a", "b", "d"]]
+    d = T.Dictionary(sents, vocab_size=2)
+    assert d.get_vocab_size() == 2
+    assert d.get_index("a") == 0          # most frequent
+    assert d.get_index("zzz") == 2        # OOV -> vocab_size
+    assert d.get_discard_size() == 2      # c, d discarded
+    assert set(d.discard_vocab()) == {"c", "d"}
+
+
+def test_dictionary_save_load(tmp_path):
+    d = T.Dictionary([["x", "y", "x"]], vocab_size=2)
+    d.save(str(tmp_path))
+    d2 = T.Dictionary.load(str(tmp_path))
+    assert d2.word2index() == d.word2index()
+
+
+def test_lm_pipeline_to_samples():
+    corpus = ["the cat sat on the mat. the dog ran away."]
+    pipe = (T.SentenceSplitter() >> T.SentenceTokenizer()
+            >> T.SentenceBiPadding())
+    sents = list(pipe(corpus))
+    d = T.Dictionary(sents)
+    samples = list((T.TextToLabeledSentence(d)
+                    >> T.LabeledSentenceToSample(
+                        vocab_length=d.get_vocab_size() + 1,
+                        fixed_data_length=8, fixed_label_length=8))(sents))
+    assert samples[0].feature().shape == (8, d.get_vocab_size() + 1)
+    assert samples[0].label().shape == (8,)
+    assert samples[0].label().min() >= 1.0  # 1-based targets
+
+
+# --------------------------------------------------------------------- #
+# loaders (synthetic fallback in this zero-egress env)                  #
+# --------------------------------------------------------------------- #
+def test_mnist_loader_synthetic():
+    from bigdl_tpu.data import mnist
+    x, y = mnist.read_data_sets("/nonexistent", "train")
+    assert x.shape[1:] == (28, 28, 1) and x.dtype == np.uint8
+    assert y.min() >= 0 and y.max() <= 9
+    x2, _ = mnist.read_data_sets("/nonexistent", "train")
+    np.testing.assert_array_equal(x, x2)  # deterministic
+
+
+def test_cifar_loader_synthetic():
+    from bigdl_tpu.data import cifar
+    x, y = cifar.read_data_sets("/nonexistent", "test")
+    assert x.shape[1:] == (3, 32, 32)
+    assert y.max() <= 9
+
+
+def test_news20_and_glove_synthetic():
+    from bigdl_tpu.data import news20
+    texts = news20.get_news20("/nonexistent")
+    labels = {lb for _, lb in texts}
+    assert labels == set(range(1, 21))
+    w2v = news20.get_glove_w2v("/nonexistent", dim=50)
+    assert next(iter(w2v.values())).shape == (50,)
+
+
+def test_movielens_synthetic():
+    from bigdl_tpu.data import movielens
+    arr = movielens.read_data_sets("/nonexistent")
+    assert arr.shape[1] == 4
+    pairs = movielens.get_id_pairs("/nonexistent")
+    assert pairs.shape[1] == 2
+    ratings = movielens.get_id_ratings("/nonexistent")
+    assert ratings[:, 2].min() >= 1 and ratings[:, 2].max() <= 5
+
+
+def test_mnist_idx_roundtrip(tmp_path):
+    """Write real idx .gz files and read them back."""
+    import gzip, struct
+    from bigdl_tpu.data import mnist
+    rng = np.random.RandomState(0)
+    imgs = (rng.rand(5, 28, 28) * 255).astype(np.uint8)
+    labs = rng.randint(0, 10, 5).astype(np.uint8)
+    with gzip.open(tmp_path / "train-images-idx3-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 5, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(tmp_path / "train-labels-idx1-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">II", 2049, 5))
+        f.write(labs.tobytes())
+    x, y = mnist.read_data_sets(str(tmp_path), "train")
+    np.testing.assert_array_equal(x[..., 0], imgs)
+    np.testing.assert_array_equal(y, labs)
