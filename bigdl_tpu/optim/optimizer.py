@@ -238,6 +238,29 @@ class Optimizer:
             self.state.score = named[0][1].result()[0]
         return named
 
+    def _write_train_summary(self, params, opt_state):
+        """Per-iteration scalars + trigger-gated Parameters histograms
+        (≙ DistriOptimizer saveSummary; histograms pull params to host so
+        they are gated by an explicit trigger)."""
+        ts = self.train_summary
+        it = self.state.iteration
+
+        def fires(tag):
+            trig = getattr(ts, "get_summary_trigger", lambda _t: None)(tag)
+            return trig is None or trig(self.state)
+
+        if fires("Loss"):
+            ts.add_scalar("Loss", float(self.state.loss), it)
+        if fires("LearningRate"):
+            lr = self.optim_method.get_learning_rate(opt_state)
+            ts.add_scalar("LearningRate", float(lr), it)
+        ptrig = getattr(ts, "get_summary_trigger", lambda _t: None)(
+            "Parameters")
+        if ptrig is not None and ptrig(self.state):
+            for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+                name = "/".join(str(getattr(p, "key", p)) for p in path)
+                ts.add_histogram(name, np.asarray(leaf), it)
+
     # -- hooks overridden by DistriOptimizer ----------------------------- #
     def _wrap_optim(self, params):
         """Apply gradient-clipping wrapper around the user's OptimMethod."""
@@ -308,11 +331,7 @@ class Optimizer:
                 self.metrics.add("data wait time", wait)
                 self.metrics.add("dispatch time", dispatch)
                 if self.train_summary is not None:
-                    self.train_summary.add_scalar("Loss", float(loss),
-                                                  self.state.iteration)
-                    lr = self.optim_method.get_learning_rate(opt_state)
-                    self.train_summary.add_scalar(
-                        "LearningRate", float(lr), self.state.iteration)
+                    self._write_train_summary(params, opt_state)
                 if self._fire_mid_epoch(params, opt_state, model_state):
                     stop = True
                     break

@@ -1,0 +1,108 @@
+"""TensorBoard event-file writer/reader (≙ visualization/tensorboard/
+FileWriter.scala, EventWriter.scala; record framing from TFRecordWriter).
+
+Record layout (TFRecord): u64 length | masked-crc32c(length) | payload |
+masked-crc32c(payload).  First record carries file_version "brain.Event:2".
+"""
+from __future__ import annotations
+
+import os
+import socket
+import struct
+import threading
+import time
+from typing import List, Tuple
+
+import numpy as np
+
+from . import proto
+from .crc32c import masked_crc32c
+
+
+class EventWriter:
+    """Append-only tfevents file in `log_dir`
+    (≙ tensorboard/EventWriter.scala; the async queue becomes a lock —
+    writes are host-side and tiny next to a TPU step)."""
+
+    def __init__(self, log_dir: str, flush_secs: float = 10.0):
+        os.makedirs(log_dir, exist_ok=True)
+        fname = (f"events.out.tfevents.{int(time.time())}"
+                 f".{socket.gethostname()}")
+        self.path = os.path.join(log_dir, fname)
+        self._f = open(self.path, "ab")
+        self._lock = threading.Lock()
+        self._last_flush = time.time()
+        self.flush_secs = flush_secs
+        self._write(proto.event(time.time(), 0,
+                                file_version="brain.Event:2"))
+
+    def _write(self, payload: bytes):
+        header = struct.pack("<Q", len(payload))
+        rec = (header + struct.pack("<I", masked_crc32c(header))
+               + payload + struct.pack("<I", masked_crc32c(payload)))
+        with self._lock:
+            self._f.write(rec)
+            if time.time() - self._last_flush > self.flush_secs:
+                self._f.flush()
+                self._last_flush = time.time()
+
+    def add_scalar(self, tag: str, value: float, step: int):
+        self._write(proto.event(
+            time.time(), step,
+            summary_values=[proto.summary_value_scalar(tag, float(value))]))
+        return self
+
+    def add_histogram(self, tag: str, values, step: int, bins: int = 30):
+        arr = np.asarray(values, np.float64).reshape(-1)
+        if arr.size == 0:
+            return self
+        counts, edges = np.histogram(arr, bins=bins)
+        histo = proto.histogram_proto(
+            float(arr.min()), float(arr.max()), float(arr.size),
+            float(arr.sum()), float((arr ** 2).sum()),
+            edges[1:], counts)
+        self._write(proto.event(
+            time.time(), step,
+            summary_values=[proto.summary_value_histo(tag, histo)]))
+        return self
+
+    def flush(self):
+        with self._lock:
+            self._f.flush()
+        return self
+
+    def close(self):
+        with self._lock:
+            self._f.flush()
+            self._f.close()
+
+
+def read_events(log_dir: str) -> List[bytes]:
+    """All event payloads from every tfevents file in a dir, in file order."""
+    payloads = []
+    for fname in sorted(os.listdir(log_dir)):
+        if "tfevents" not in fname:
+            continue
+        with open(os.path.join(log_dir, fname), "rb") as f:
+            data = f.read()
+        i = 0
+        while i + 12 <= len(data):
+            (length,) = struct.unpack("<Q", data[i:i + 8])
+            payload = data[i + 12:i + 12 + length]
+            if len(payload) < length:
+                break  # truncated tail record
+            payloads.append(payload)
+            i += 12 + length + 4
+    return payloads
+
+
+def read_scalar(log_dir: str, tag: str) -> List[Tuple[int, float, float]]:
+    """[(step, value, wall_time)] for one tag
+    (≙ Summary.readScalar's triple)."""
+    out = []
+    for payload in read_events(log_dir):
+        wall, step, scalars = proto.decode_scalar_event(payload)
+        for t, v in scalars:
+            if t == tag:
+                out.append((step, v, wall))
+    return out

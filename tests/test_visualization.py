@@ -1,0 +1,122 @@
+"""Visualization tests (≙ visualization/*Spec.scala + tensorboard
+FileWriterSpec): crc32c vectors, event-file round trip, Train/Validation
+summary integration with the optimizer."""
+import os
+import struct
+
+import numpy as np
+
+from bigdl_tpu.visualization import (TrainSummary, ValidationSummary,
+                                     crc32c, masked_crc32c)
+from bigdl_tpu.visualization.crc32c import unmask
+from bigdl_tpu.visualization import event_writer, proto
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors
+    assert crc32c(b"") == 0
+    assert crc32c(b"123456789") == 0xE3069283
+    assert crc32c(bytes(32)) == 0x8A9136AA
+    assert crc32c(bytes([0xFF] * 32)) == 0x62A8AB43
+
+
+def test_mask_roundtrip():
+    for data in (b"", b"abc", b"123456789"):
+        assert unmask(masked_crc32c(data)) == crc32c(data)
+
+
+def test_event_file_structure(tmp_path):
+    w = event_writer.EventWriter(str(tmp_path))
+    w.add_scalar("Loss", 1.5, 1)
+    w.add_scalar("Loss", 1.0, 2)
+    w.close()
+    # first record decodes as the file_version header with valid crcs
+    files = [f for f in os.listdir(tmp_path) if "tfevents" in f]
+    assert len(files) == 1
+    with open(tmp_path / files[0], "rb") as f:
+        raw = f.read()
+    (length,) = struct.unpack("<Q", raw[:8])
+    (len_crc,) = struct.unpack("<I", raw[8:12])
+    assert len_crc == masked_crc32c(raw[:8])
+    payload = raw[12:12 + length]
+    (pay_crc,) = struct.unpack("<I", raw[12 + length:16 + length])
+    assert pay_crc == masked_crc32c(payload)
+    assert b"brain.Event:2" in payload
+
+
+def test_read_scalar_roundtrip(tmp_path):
+    w = event_writer.EventWriter(str(tmp_path))
+    for i in range(5):
+        w.add_scalar("Loss", 5.0 - i, i + 1)
+    w.add_scalar("Other", 42.0, 1)
+    w.close()
+    rows = event_writer.read_scalar(str(tmp_path), "Loss")
+    assert [r[0] for r in rows] == [1, 2, 3, 4, 5]
+    np.testing.assert_allclose([r[1] for r in rows], [5, 4, 3, 2, 1])
+
+
+def test_histogram_event_written(tmp_path):
+    w = event_writer.EventWriter(str(tmp_path))
+    w.add_histogram("weights", np.random.RandomState(0).randn(100), 1)
+    w.close()
+    payloads = event_writer.read_events(str(tmp_path))
+    assert len(payloads) == 2  # version header + histogram
+    # histogram event has a summary (field 5) but no simple_value scalars
+    _, _, scalars = proto.decode_scalar_event(payloads[1])
+    assert scalars == []
+
+
+def test_train_and_validation_summary_with_optimizer(tmp_path):
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger, Top1Accuracy
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 8).astype(np.float32)
+    w = rs.randn(8, 3).astype(np.float32)
+    y = (np.argmax(x @ w, 1) + 1).astype(np.float32)
+    model = nn.Sequential(nn.Linear(8, 3), nn.LogSoftMax())
+    train_sum = TrainSummary(str(tmp_path), "app")
+    train_sum.set_summary_trigger("Parameters", Trigger.every_epoch())
+    val_sum = ValidationSummary(str(tmp_path), "app")
+    opt = (LocalOptimizer(model, (x, y), nn.ClassNLLCriterion(), batch_size=16)
+           .set_optim_method(SGD(learning_rate=0.1))
+           .set_end_when(Trigger.max_epoch(3))
+           .set_validation(Trigger.every_epoch(), (x, y), [Top1Accuracy()])
+           .set_train_summary(train_sum)
+           .set_val_summary(val_sum))
+    opt.optimize()
+    losses = train_sum.read_scalar("Loss")
+    assert len(losses) == 12  # 4 iters x 3 epochs
+    assert losses[-1][1] < losses[0][1]  # training decreased loss
+    lrs = train_sum.read_scalar("LearningRate")
+    assert len(lrs) == 12
+    thru = train_sum.read_scalar("Throughput")
+    assert len(thru) == 3
+    acc = val_sum.read_scalar("Top1Accuracy")
+    assert len(acc) == 3
+    assert acc[-1][1] > 0.5
+    # Parameters histograms were written on epoch boundaries
+    payloads = event_writer.read_events(train_sum.folder)
+    assert len(payloads) > 27  # header + 24 scalars + 3 throughput + histos
+    train_sum.close()
+    val_sum.close()
+
+
+def test_summary_trigger_gating(tmp_path):
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 4).astype(np.float32)
+    y = rs.randn(64, 1).astype(np.float32)
+    ts = TrainSummary(str(tmp_path), "gated")
+    ts.set_summary_trigger("Loss", Trigger.several_iteration(2))
+    model = nn.Sequential(nn.Linear(4, 1))
+    opt = (LocalOptimizer(model, (x, y), nn.MSECriterion(), batch_size=16)
+           .set_optim_method(SGD(learning_rate=0.01))
+           .set_end_when(Trigger.max_epoch(1))
+           .set_train_summary(ts))
+    opt.optimize()
+    assert len(ts.read_scalar("Loss")) == 2       # iters 2 and 4 only
+    assert len(ts.read_scalar("LearningRate")) == 4
+    ts.close()
