@@ -75,3 +75,4 @@ from .criterion import (ClassNLLCriterion, CrossEntropyCriterion,
                         PGCriterion, MultiCriterion, ParallelCriterion,
                         TimeDistributedCriterion, TimeDistributedMaskCriterion,
                         TransformerCriterion)
+from . import ops

@@ -1,0 +1,656 @@
+"""TF-op shim modules (≙ nn/ops/*.scala + nn/tf/*.scala).
+
+The reference implements each TensorFlow op as an `Operation` (a forward-
+only Module) so imported TF graphs can execute on the BigDL runtime.  Here
+every op is a stateless Module whose `apply` is one or two jnp/lax calls —
+under jit the whole imported graph fuses into a single XLA program, so
+these shims add zero dispatch overhead on TPU.
+
+Multi-input ops take a Table/list input (like the reference's Table
+activities).  Comparison/logical ops return bool arrays; Cast handles
+dtype conversion.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .module import Module
+from ..utils.table import as_list
+
+
+class Operation(Module):
+    """Forward-only op (≙ nn/ops/Operation.scala): backward is an error in
+    the reference; under JAX most of these are differentiable anyway."""
+
+
+def _pair(x):
+    xs = as_list(x)
+    return xs[0], xs[1]
+
+
+# --------------------------------------------------------------------- #
+# math                                                                  #
+# --------------------------------------------------------------------- #
+class Add(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return a + b
+
+
+class Subtract(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return a - b
+
+
+class Multiply(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return a * b
+
+
+class RealDiv(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return a / b
+
+
+class FloorDiv(Operation):
+    """≙ nn/ops/FloorDiv.scala."""
+
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return jnp.floor_divide(a, b)
+
+
+class TruncateDiv(Operation):
+    """≙ nn/ops/TruncateDiv.scala (C-style division, rounds toward 0)."""
+
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return jnp.trunc(a / b).astype(a.dtype)
+
+
+class Mod(Operation):
+    """≙ nn/ops/Mod.scala (truncated, sign follows dividend)."""
+
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return a - jnp.trunc(a / b) * b
+
+
+class FloorMod(Operation):
+    """≙ nn/ops/FloorMod.scala (sign follows divisor)."""
+
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return jnp.mod(a, b)
+
+
+class Maximum(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return jnp.maximum(a, b)
+
+
+class Minimum(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return jnp.minimum(a, b)
+
+
+class Pow(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return jnp.power(a, b)
+
+
+class SquaredDifference(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return (a - b) ** 2
+
+
+class Inv(Operation):
+    def apply(self, params, x, ctx):
+        return 1.0 / x
+
+
+class Sign(Operation):
+    def apply(self, params, x, ctx):
+        return jnp.sign(x)
+
+
+class Rint(Operation):
+    """Round to nearest even (≙ nn/ops/Rint.scala)."""
+
+    def apply(self, params, x, ctx):
+        return jnp.rint(x)
+
+
+class Round(Operation):
+    """Round half away from zero (≙ nn/ops/Round.scala)."""
+
+    def apply(self, params, x, ctx):
+        return jnp.trunc(x + jnp.sign(x) * 0.5)
+
+
+class Ceil(Operation):
+    def apply(self, params, x, ctx):
+        return jnp.ceil(x)
+
+
+class Floor(Operation):
+    def apply(self, params, x, ctx):
+        return jnp.floor(x)
+
+
+class Exp(Operation):
+    def apply(self, params, x, ctx):
+        return jnp.exp(x)
+
+
+class Expm1(Operation):
+    def apply(self, params, x, ctx):
+        return jnp.expm1(x)
+
+
+class Erf(Operation):
+    def apply(self, params, x, ctx):
+        return jax.scipy.special.erf(x)
+
+
+class Erfc(Operation):
+    def apply(self, params, x, ctx):
+        return jax.scipy.special.erfc(x)
+
+
+class Lgamma(Operation):
+    def apply(self, params, x, ctx):
+        return jax.scipy.special.gammaln(x)
+
+
+class Digamma(Operation):
+    def apply(self, params, x, ctx):
+        return jax.scipy.special.digamma(x)
+
+
+class IsFinite(Operation):
+    def apply(self, params, x, ctx):
+        return jnp.isfinite(x)
+
+
+class IsInf(Operation):
+    def apply(self, params, x, ctx):
+        return jnp.isinf(x)
+
+
+class IsNan(Operation):
+    def apply(self, params, x, ctx):
+        return jnp.isnan(x)
+
+
+class L2Loss(Operation):
+    """sum(x^2)/2 (≙ nn/ops/L2Loss.scala)."""
+
+    def apply(self, params, x, ctx):
+        return jnp.sum(x.astype(jnp.float32) ** 2) / 2
+
+
+class BatchMatMul(Operation):
+    """≙ nn/ops/BatchMatMul.scala; adj flags transpose the last two dims."""
+
+    def __init__(self, adj_x=False, adj_y=False, name=None):
+        super().__init__(name=name)
+        self.adj_x, self.adj_y = adj_x, adj_y
+
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        if self.adj_x:
+            a = jnp.swapaxes(a, -1, -2)
+        if self.adj_y:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+
+
+# --------------------------------------------------------------------- #
+# reductions                                                            #
+# --------------------------------------------------------------------- #
+class Sum(Operation):
+    """≙ nn/ops/Sum.scala: input (tensor, reduction_indices)."""
+
+    def __init__(self, keep_dims=False, name=None):
+        super().__init__(name=name)
+        self.keep_dims = keep_dims
+
+    def apply(self, params, x, ctx):
+        t, idx = _pair(x)
+        axes = tuple(int(i) for i in jnp.atleast_1d(jnp.asarray(idx)))
+        return jnp.sum(t, axis=axes, keepdims=self.keep_dims)
+
+
+class Prod(Operation):
+    def __init__(self, axis=0, keep_dims=False, name=None):
+        super().__init__(name=name)
+        self.axis, self.keep_dims = axis, keep_dims
+
+    def apply(self, params, x, ctx):
+        return jnp.prod(x, axis=self.axis, keepdims=self.keep_dims)
+
+
+class Max(Operation):
+    """≙ nn/ops/Max.scala: (tensor, axis) pair input."""
+
+    def __init__(self, keep_dims=False, name=None):
+        super().__init__(name=name)
+        self.keep_dims = keep_dims
+
+    def apply(self, params, x, ctx):
+        t, axis = _pair(x)
+        return jnp.max(t, axis=int(axis), keepdims=self.keep_dims)
+
+
+class All(Operation):
+    def __init__(self, keep_dims=False, name=None):
+        super().__init__(name=name)
+        self.keep_dims = keep_dims
+
+    def apply(self, params, x, ctx):
+        t, idx = _pair(x)
+        axes = tuple(int(i) for i in jnp.atleast_1d(jnp.asarray(idx)))
+        return jnp.all(t.astype(bool), axis=axes, keepdims=self.keep_dims)
+
+
+class Any(Operation):
+    def __init__(self, keep_dims=False, name=None):
+        super().__init__(name=name)
+        self.keep_dims = keep_dims
+
+    def apply(self, params, x, ctx):
+        t, idx = _pair(x)
+        axes = tuple(int(i) for i in jnp.atleast_1d(jnp.asarray(idx)))
+        return jnp.any(t.astype(bool), axis=axes, keepdims=self.keep_dims)
+
+
+class ArgMax(Operation):
+    """≙ nn/ops/ArgMax.scala: (tensor, dimension) input, 0-based output."""
+
+    def apply(self, params, x, ctx):
+        t, axis = _pair(x)
+        return jnp.argmax(t, axis=int(axis))
+
+
+class SegmentSum(Operation):
+    """≙ nn/ops/SegmentSum.scala: (data, segment_ids) with sorted ids."""
+
+    def __init__(self, num_segments=None, name=None):
+        super().__init__(name=name)
+        self.num_segments = num_segments
+
+    def apply(self, params, x, ctx):
+        data, ids = _pair(x)
+        n = self.num_segments
+        if n is None:
+            raise ValueError(
+                f"{self.name}: num_segments must be static under jit")
+        return jax.ops.segment_sum(data, ids.astype(jnp.int32),
+                                   num_segments=n)
+
+
+# --------------------------------------------------------------------- #
+# comparisons / logical                                                 #
+# --------------------------------------------------------------------- #
+class Equal(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return a == b
+
+
+class NotEqual(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return a != b
+
+
+class Greater(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return a > b
+
+
+class GreaterEqual(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return a >= b
+
+
+class Less(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return a < b
+
+
+class LessEqual(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return a <= b
+
+
+class ApproximateEqual(Operation):
+    def __init__(self, tolerance=1e-5, name=None):
+        super().__init__(name=name)
+        self.tolerance = tolerance
+
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return jnp.abs(a - b) < self.tolerance
+
+
+class LogicalAnd(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return jnp.logical_and(a, b)
+
+
+class LogicalOr(Operation):
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return jnp.logical_or(a, b)
+
+
+class LogicalNot(Operation):
+    def apply(self, params, x, ctx):
+        return jnp.logical_not(x)
+
+
+# --------------------------------------------------------------------- #
+# shape / indexing                                                      #
+# --------------------------------------------------------------------- #
+class Cast(Operation):
+    """≙ nn/ops/Cast.scala."""
+
+    def __init__(self, dtype=jnp.float32, name=None):
+        super().__init__(name=name)
+        self.dtype = jnp.dtype(dtype)
+
+    def apply(self, params, x, ctx):
+        return x.astype(self.dtype)
+
+
+class Shape(Operation):
+    """≙ nn/tf/Shape.scala (static under jit)."""
+
+    def apply(self, params, x, ctx):
+        return jnp.asarray(x.shape, jnp.int32)
+
+
+class Rank(Operation):
+    def apply(self, params, x, ctx):
+        return jnp.asarray(x.ndim, jnp.int32)
+
+
+class Gather(Operation):
+    """≙ nn/ops/Gather.scala: (params_tensor, indices) along `axis`."""
+
+    def __init__(self, axis=0, name=None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def apply(self, params, x, ctx):
+        t, idx = _pair(x)
+        return jnp.take(t, idx.astype(jnp.int32), axis=self.axis)
+
+
+class OneHot(Operation):
+    """≙ nn/ops/OneHot.scala."""
+
+    def __init__(self, depth, on_value=1.0, off_value=0.0, axis=-1,
+                 name=None):
+        super().__init__(name=name)
+        self.depth = depth
+        self.on_value, self.off_value = on_value, off_value
+        self.axis = axis
+
+    def apply(self, params, x, ctx):
+        oh = jax.nn.one_hot(x.astype(jnp.int32), self.depth, axis=self.axis)
+        return oh * (self.on_value - self.off_value) + self.off_value
+
+
+class Select(Operation):
+    """≙ nn/ops/Select.scala: (condition, then, else)."""
+
+    def apply(self, params, x, ctx):
+        c, t, e = as_list(x)
+        return jnp.where(c.astype(bool), t, e)
+
+
+class Slice(Operation):
+    """≙ nn/ops/Slice.scala: static begin/size."""
+
+    def __init__(self, begin, size, name=None):
+        super().__init__(name=name)
+        self.begin, self.size = tuple(begin), tuple(size)
+
+    def apply(self, params, x, ctx):
+        size = tuple(x.shape[i] - b if s == -1 else s
+                     for i, (b, s) in enumerate(zip(self.begin, self.size)))
+        return lax.slice(x, self.begin,
+                         tuple(b + s for b, s in zip(self.begin, size)))
+
+
+class StrideSlice(Operation):
+    """≙ nn/tf/StrideSlice.scala: list of (dim, start, stop, step)."""
+
+    def __init__(self, specs, name=None):
+        super().__init__(name=name)
+        self.specs = specs
+
+    def apply(self, params, x, ctx):
+        idx = [slice(None)] * x.ndim
+        for dim, start, stop, step in self.specs:
+            idx[dim] = slice(start, stop, step)
+        return x[tuple(idx)]
+
+
+class Tile(Operation):
+    """≙ nn/ops/Tile.scala: (tensor, multiples)."""
+
+    def apply(self, params, x, ctx):
+        t, mult = _pair(x)
+        reps = tuple(int(m) for m in jnp.atleast_1d(jnp.asarray(mult)))
+        return jnp.tile(t, reps)
+
+
+class Pad(Operation):
+    """≙ nn/ops/Pad.scala: (tensor, paddings [n,2])."""
+
+    def __init__(self, mode="CONSTANT", constant_value=0.0, name=None):
+        super().__init__(name=name)
+        self.mode = mode.lower()
+        self.constant_value = constant_value
+
+    def apply(self, params, x, ctx):
+        t, pads = _pair(x)
+        import numpy as np
+        pad_width = [(int(a), int(b)) for a, b in np.asarray(pads)]
+        if self.mode == "constant":
+            return jnp.pad(t, pad_width,
+                           constant_values=self.constant_value)
+        return jnp.pad(t, pad_width, mode=self.mode)
+
+
+class RangeOps(Operation):
+    """≙ nn/ops/RangeOps.scala: static (start, limit, delta)."""
+
+    def __init__(self, start, limit, delta=1, name=None):
+        super().__init__(name=name)
+        self.start, self.limit, self.delta = start, limit, delta
+
+    def apply(self, params, x, ctx):
+        return jnp.arange(self.start, self.limit, self.delta)
+
+
+class ExpandDims(Operation):
+    def __init__(self, axis=0, name=None):
+        super().__init__(name=name)
+        self.axis = axis
+
+    def apply(self, params, x, ctx):
+        return jnp.expand_dims(x, self.axis)
+
+
+class TopK(Operation):
+    """≙ nn/ops/TopK.scala: returns (values, indices) table."""
+
+    def __init__(self, k, sorted=True, name=None):
+        super().__init__(name=name)
+        self.k = k
+
+    def apply(self, params, x, ctx):
+        values, indices = lax.top_k(x, self.k)
+        return [values, indices]
+
+
+class InTopK(Operation):
+    """≙ nn/ops/InTopK.scala: (predictions [N,C], targets [N])."""
+
+    def __init__(self, k, name=None):
+        super().__init__(name=name)
+        self.k = k
+
+    def apply(self, params, x, ctx):
+        pred, tgt = _pair(x)
+        _, top = lax.top_k(pred, self.k)
+        return jnp.any(top == tgt.astype(top.dtype)[:, None], axis=-1)
+
+
+# --------------------------------------------------------------------- #
+# nn-flavored                                                           #
+# --------------------------------------------------------------------- #
+class BiasAdd(Operation):
+    """≙ nn/tf/BiasAdd.scala: (value, bias) broadcast over last dim."""
+
+    def apply(self, params, x, ctx):
+        v, b = _pair(x)
+        return v + b
+
+
+class CrossEntropy(Operation):
+    """Softmax cross entropy per row: (logits, one-hot labels)
+    (≙ nn/ops/CrossEntropy.scala)."""
+
+    def apply(self, params, x, ctx):
+        logits, labels = _pair(x)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(labels * logp, axis=-1)
+
+
+class ResizeBilinear(Operation):
+    """≙ nn/ops/ResizeBilinear.scala (NHWC)."""
+
+    def __init__(self, out_height, out_width, align_corners=False,
+                 name=None):
+        super().__init__(name=name)
+        self.out = (out_height, out_width)
+        self.align_corners = align_corners
+
+    def apply(self, params, x, ctx):
+        n, h, w, c = x.shape
+        method = "bilinear"
+        return jax.image.resize(x, (n,) + self.out + (c,), method)
+
+
+class RandomUniform(Operation):
+    """≙ nn/ops/RandomUniform.scala."""
+
+    def __init__(self, minval=0.0, maxval=1.0, name=None):
+        super().__init__(name=name)
+        self.minval, self.maxval = minval, maxval
+
+    def apply(self, params, x, ctx):
+        shape = tuple(int(s) for s in jnp.atleast_1d(jnp.asarray(x)))
+        return jax.random.uniform(ctx.rng(self), shape,
+                                  minval=self.minval, maxval=self.maxval)
+
+
+class TruncatedNormal(Operation):
+    """≙ nn/ops/TruncatedNormal.scala."""
+
+    def __init__(self, mean=0.0, stddev=1.0, name=None):
+        super().__init__(name=name)
+        self.mean, self.stddev = mean, stddev
+
+    def apply(self, params, x, ctx):
+        shape = tuple(int(s) for s in jnp.atleast_1d(jnp.asarray(x)))
+        z = jax.random.truncated_normal(ctx.rng(self), -2.0, 2.0, shape)
+        return z * self.stddev + self.mean
+
+
+class Assert(Operation):
+    """≙ nn/tf/Assert.scala: passthrough (XLA has no host asserts; checks
+    belong outside jit)."""
+
+    def apply(self, params, x, ctx):
+        xs = as_list(x)
+        return xs[-1] if len(xs) > 1 else xs[0]
+
+
+class NoOp(Operation):
+    """≙ nn/tf/NoOp.scala."""
+
+    def apply(self, params, x, ctx):
+        return x
+
+
+# --------------------------------------------------------------------- #
+# feature-column ops                                                    #
+# --------------------------------------------------------------------- #
+class BucketizedCol(Operation):
+    """Bucketize by boundaries (≙ nn/ops/BucketizedCol.scala)."""
+
+    def __init__(self, boundaries, name=None):
+        super().__init__(name=name)
+        self.boundaries = jnp.asarray(boundaries, jnp.float32)
+
+    def apply(self, params, x, ctx):
+        return jnp.searchsorted(self.boundaries, x, side="right") \
+            .astype(jnp.int32)
+
+
+class Kv2Tensor(Operation):
+    """'k1:v1,k2:v2' strings -> dense rows (host-side op; ≙
+    nn/ops/Kv2Tensor.scala)."""
+
+    def __init__(self, kv_delimiter=",", item_delimiter=":", dim=0,
+                 name=None):
+        super().__init__(name=name)
+        self.kv_delimiter = kv_delimiter
+        self.item_delimiter = item_delimiter
+        self.dim = dim
+
+    def apply(self, params, x, ctx):
+        import numpy as np
+        rows = []
+        for s in x:
+            row = np.zeros(self.dim, np.float32)
+            for kv in str(s).split(self.kv_delimiter):
+                k, v = kv.split(self.item_delimiter)
+                row[int(k)] = float(v)
+            rows.append(row)
+        return jnp.asarray(np.stack(rows))
+
+
+class MkString(Operation):
+    """Join a row of values to a string (host-side; ≙ nn/ops/MkString.scala)."""
+
+    def __init__(self, str_delimiter=",", name=None):
+        super().__init__(name=name)
+        self.delim = str_delimiter
+
+    def apply(self, params, x, ctx):
+        import numpy as np
+        arr = np.asarray(x)
+        return [self.delim.join(str(v) for v in row)
+                for row in arr.reshape(arr.shape[0], -1)]

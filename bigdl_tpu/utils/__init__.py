@@ -1,2 +1,3 @@
 """bigdl_tpu.utils — shared utilities (≙ com.intel.analytics.bigdl.utils)."""
 from .table import Table, T, as_list
+from .crc32c import crc32c, masked_crc32c

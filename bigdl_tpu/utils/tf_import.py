@@ -1,0 +1,410 @@
+"""TensorFlow GraphDef import/export subset (≙ utils/tf/TensorflowLoader.scala,
+TensorflowSaver.scala, Tensorflow.scala, TFUtils.scala).
+
+The reference parses a frozen GraphDef protobuf and pattern-matches node
+clusters into BigDL layers.  Here the GraphDef is parsed with the in-house
+wire decoder (utils.proto) and imported as a `TFGraph` Module that
+evaluates nodes topologically with jnp ops — under jit XLA fuses the whole
+imported graph, so there is no interpreter overhead per step.
+
+Supported import ops: Const, Placeholder, Identity, MatMul, Add, AddV2,
+BiasAdd, Sub, Mul, RealDiv, Maximum, Minimum, Relu, Relu6, Sigmoid, Tanh,
+Softmax, LogSoftmax, Reshape, Squeeze, ExpandDims, ConcatV2, Mean, Sum,
+Max, Pad, Transpose, Conv2D, DepthwiseConv2dNative, MaxPool, AvgPool,
+FusedBatchNorm(+V2/V3), MatrixBandPart-free attention-era graphs are out of
+scope (use the native model zoo instead).
+
+`save_tf_graph` exports Sequential/Graph models built from Linear /
+activations / Reshape / SpatialConvolution / pooling back to a frozen
+GraphDef that this importer (and TensorFlow) can read.
+"""
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import proto
+from .proto import iter_fields, enc_bytes, enc_string, _varint, _key
+from ..nn.module import Module
+
+# TF DataType enum subset
+_DT = {1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 5: np.int16,
+       6: np.int8, 7: object, 9: np.int64, 10: np.bool_}
+_DT_REV = {np.dtype(np.float32): 1, np.dtype(np.float64): 2,
+           np.dtype(np.int32): 3, np.dtype(np.int64): 9,
+           np.dtype(np.bool_): 10}
+
+
+@dataclass
+class NodeDef:
+    name: str
+    op: str
+    inputs: List[str] = field(default_factory=list)
+    attrs: Dict[str, object] = field(default_factory=dict)
+
+
+def _decode_shape(buf: bytes) -> Tuple[int, ...]:
+    dims = []
+    for f, w, v in iter_fields(buf):
+        if f == 2 and w == 2:  # dim
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 1 and w2 == 0:
+                    # zig-zag-free int64; -1 encodes as huge varint
+                    size = v2 if v2 < 1 << 62 else v2 - (1 << 64)
+                    dims.append(size)
+    return tuple(dims)
+
+
+def _decode_tensor(buf: bytes) -> np.ndarray:
+    dtype = np.float32
+    shape: Tuple[int, ...] = ()
+    content = None
+    floats: List[float] = []
+    ints: List[int] = []
+    for f, w, v in iter_fields(buf):
+        if f == 1 and w == 0:
+            dtype = _DT.get(v, np.float32)
+        elif f == 2 and w == 2:
+            shape = _decode_shape(v)
+        elif f == 4 and w == 2:
+            content = v
+        elif f == 5:  # float_val (packed or single)
+            if w == 2:
+                floats.extend(struct.unpack(f"<{len(v) // 4}f", v))
+            else:
+                floats.append(v)
+        elif f in (7, 10):  # int_val / int64_val
+            if w == 2:
+                i = 0
+                while i < len(v):
+                    n, i = proto._read_varint(v, i)
+                    ints.append(n)
+            else:
+                ints.append(v)
+    if content is not None:
+        arr = np.frombuffer(content, dtype=dtype)
+    elif floats:
+        arr = np.asarray(floats, dtype)
+        if arr.size == 1 and shape and int(np.prod(shape)) > 1:
+            arr = np.full(shape, arr[0], dtype)
+    elif ints:
+        arr = np.asarray(ints, dtype)
+        if arr.size == 1 and shape and int(np.prod(shape)) > 1:
+            arr = np.full(shape, arr[0], dtype)
+    else:
+        arr = np.zeros(shape, dtype)
+    return arr.reshape(shape) if shape else arr.reshape(())
+
+
+def _decode_attr(buf: bytes):
+    for f, w, v in iter_fields(buf):
+        if f == 2 and w == 2:
+            return v.decode("utf-8", "replace")  # s
+        if f == 3 and w == 0:
+            return v if v < 1 << 62 else v - (1 << 64)  # i
+        if f == 4 and w == 5:
+            return v  # f
+        if f == 5 and w == 0:
+            return bool(v)  # b
+        if f == 6 and w == 0:
+            return ("dtype", v)  # type enum
+        if f == 7 and w == 2:
+            return _decode_shape(v)  # shape
+        if f == 8 and w == 2:
+            return _decode_tensor(v)  # tensor
+        if f == 1 and w == 2:  # list
+            out = []
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 2 and w2 == 0:  # i
+                    out.append(v2)
+                elif f2 == 3 and w2 == 2:  # packed i
+                    i = 0
+                    while i < len(v2):
+                        n, i = proto._read_varint(v2, i)
+                        out.append(n)
+                elif f2 == 1 and w2 == 2:  # s
+                    out.append(v2.decode("utf-8", "replace"))
+            return out
+    return None
+
+
+def parse_graphdef(data: bytes) -> List[NodeDef]:
+    nodes = []
+    for f, w, v in iter_fields(data):
+        if f == 1 and w == 2:  # node
+            node = NodeDef("", "")
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 1 and w2 == 2:
+                    node.name = v2.decode("utf-8")
+                elif f2 == 2 and w2 == 2:
+                    node.op = v2.decode("utf-8")
+                elif f2 == 3 and w2 == 2:
+                    node.inputs.append(v2.decode("utf-8"))
+                elif f2 == 5 and w2 == 2:  # attr map entry
+                    key = None
+                    val = None
+                    for f3, w3, v3 in iter_fields(v2):
+                        if f3 == 1 and w3 == 2:
+                            key = v3.decode("utf-8")
+                        elif f3 == 2 and w3 == 2:
+                            val = _decode_attr(v3)
+                    if key is not None:
+                        node.attrs[key] = val
+            nodes.append(node)
+    return nodes
+
+
+# --------------------------------------------------------------------- #
+# op implementations (jnp; NHWC like TF)                                #
+# --------------------------------------------------------------------- #
+def _conv2d(x, w, strides, padding, feature_group_count=1):
+    # TF: x NHWC, w HWIO
+    sh, sw = int(strides[1]), int(strides[2])
+    return lax.conv_general_dilated(
+        x, w, (sh, sw), padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=feature_group_count)
+
+
+def _pool(x, ksize, strides, padding, reducer, init):
+    kh, kw = int(ksize[1]), int(ksize[2])
+    sh, sw = int(strides[1]), int(strides[2])
+    return lax.reduce_window(x, init, reducer, (1, kh, kw, 1),
+                             (1, sh, sw, 1), padding)
+
+
+def _fused_bn(env_args, attrs):
+    x, scale, offset, mean, var = env_args
+    eps = attrs.get("epsilon", 1e-3) or 1e-3
+    inv = 1.0 / jnp.sqrt(var + eps)
+    return (x - mean) * inv * scale + offset
+
+
+_OP_IMPLS = {
+    "Identity": lambda a, at: a[0],
+    "MatMul": lambda a, at: jnp.matmul(
+        a[0].T if at.get("transpose_a") else a[0],
+        a[1].T if at.get("transpose_b") else a[1]),
+    "Add": lambda a, at: a[0] + a[1],
+    "AddV2": lambda a, at: a[0] + a[1],
+    "BiasAdd": lambda a, at: a[0] + a[1],
+    "Sub": lambda a, at: a[0] - a[1],
+    "Mul": lambda a, at: a[0] * a[1],
+    "RealDiv": lambda a, at: a[0] / a[1],
+    "Maximum": lambda a, at: jnp.maximum(a[0], a[1]),
+    "Minimum": lambda a, at: jnp.minimum(a[0], a[1]),
+    "Relu": lambda a, at: jax.nn.relu(a[0]),
+    "Relu6": lambda a, at: jnp.clip(a[0], 0, 6),
+    "Sigmoid": lambda a, at: jax.nn.sigmoid(a[0]),
+    "Tanh": lambda a, at: jnp.tanh(a[0]),
+    "Softmax": lambda a, at: jax.nn.softmax(a[0], axis=-1),
+    "LogSoftmax": lambda a, at: jax.nn.log_softmax(a[0], axis=-1),
+    "Reshape": lambda a, at: jnp.reshape(
+        a[0], tuple(int(d) for d in np.asarray(a[1]))),
+    "Squeeze": lambda a, at: jnp.squeeze(
+        a[0], axis=tuple(at["squeeze_dims"]) if at.get("squeeze_dims")
+        else None),
+    "ExpandDims": lambda a, at: jnp.expand_dims(a[0], int(a[1])),
+    "ConcatV2": lambda a, at: jnp.concatenate(a[:-1], axis=int(a[-1])),
+    "Mean": lambda a, at: jnp.mean(
+        a[0], axis=tuple(int(i) for i in np.atleast_1d(np.asarray(a[1]))),
+        keepdims=bool(at.get("keep_dims"))),
+    "Sum": lambda a, at: jnp.sum(
+        a[0], axis=tuple(int(i) for i in np.atleast_1d(np.asarray(a[1]))),
+        keepdims=bool(at.get("keep_dims"))),
+    "Max": lambda a, at: jnp.max(
+        a[0], axis=tuple(int(i) for i in np.atleast_1d(np.asarray(a[1]))),
+        keepdims=bool(at.get("keep_dims"))),
+    "Pad": lambda a, at: jnp.pad(
+        a[0], [(int(p[0]), int(p[1])) for p in np.asarray(a[1])]),
+    "Transpose": lambda a, at: jnp.transpose(
+        a[0], tuple(int(i) for i in np.asarray(a[1]))),
+    "Conv2D": lambda a, at: _conv2d(a[0], a[1], at["strides"],
+                                    at["padding"]),
+    "DepthwiseConv2dNative": lambda a, at: _conv2d(
+        a[0],
+        a[1].reshape(a[1].shape[0], a[1].shape[1], 1, -1),
+        at["strides"], at["padding"],
+        feature_group_count=a[0].shape[-1]),
+    "MaxPool": lambda a, at: _pool(a[0], at["ksize"], at["strides"],
+                                   at["padding"], lax.max, -jnp.inf),
+    "AvgPool": lambda a, at: _pool(
+        a[0], at["ksize"], at["strides"], at["padding"], lax.add, 0.0)
+        / (int(at["ksize"][1]) * int(at["ksize"][2])),
+    "FusedBatchNorm": _fused_bn,
+    "FusedBatchNormV2": _fused_bn,
+    "FusedBatchNormV3": _fused_bn,
+}
+
+
+class TFGraph(Module):
+    """Imported GraphDef as a Module: topological jnp evaluation, jittable
+    (≙ utils/tf/Session.scala's BigDLSessionImpl graph execution)."""
+
+    def __init__(self, nodes: List[NodeDef], inputs: Sequence[str],
+                 outputs: Sequence[str], name=None):
+        super().__init__(name=name)
+        self.nodes = {n.name: n for n in nodes}
+        self.input_names = list(inputs)
+        self.output_names = list(outputs)
+        self.consts: Dict[str, np.ndarray] = {
+            n.name: n.attrs["value"] for n in nodes if n.op == "Const"}
+        self._order = self._toposort()
+
+    def _toposort(self) -> List[str]:
+        order, seen = [], set()
+
+        def visit(name):
+            base = name.split(":")[0].lstrip("^")
+            if base in seen:
+                return
+            seen.add(base)
+            node = self.nodes.get(base)
+            if node is None:
+                raise KeyError(f"graph references unknown node {base!r}")
+            for inp in node.inputs:
+                visit(inp)
+            order.append(base)
+
+        for out in self.output_names:
+            visit(out)
+        return order
+
+    def apply(self, params, x, ctx):
+        xs = x if isinstance(x, (list, tuple)) else [x]
+        env: Dict[str, object] = {}
+        for name, val in zip(self.input_names, xs):
+            env[name] = val
+        for name in self._order:
+            if name in env:
+                continue
+            node = self.nodes[name]
+            if node.op == "Const":
+                env[name] = jnp.asarray(self.consts[name])
+            elif node.op in ("Placeholder", "PlaceholderV2"):
+                raise ValueError(f"unbound Placeholder {name!r}; pass it via "
+                                 f"inputs={self.input_names}")
+            else:
+                impl = _OP_IMPLS.get(node.op)
+                if impl is None:
+                    raise NotImplementedError(
+                        f"TF op {node.op!r} (node {name!r}) not supported")
+                args = [env[i.split(":")[0]] for i in node.inputs
+                        if not i.startswith("^")]
+                env[name] = impl(args, node.attrs)
+        outs = [env[o.split(":")[0]] for o in self.output_names]
+        return outs[0] if len(outs) == 1 else outs
+
+
+def load_tf_graph(path_or_bytes, inputs: Sequence[str],
+                  outputs: Sequence[str]) -> TFGraph:
+    """≙ TensorflowLoader.load(graphPrototxt, inputs, outputs)."""
+    if isinstance(path_or_bytes, bytes):
+        data = path_or_bytes
+    else:
+        with open(path_or_bytes, "rb") as f:
+            data = f.read()
+    return TFGraph(parse_graphdef(data), inputs, outputs)
+
+
+# --------------------------------------------------------------------- #
+# export (TensorflowSaver subset)                                       #
+# --------------------------------------------------------------------- #
+def _enc_shape(dims) -> bytes:
+    out = b""
+    for d in dims:
+        out += enc_bytes(2, proto.enc_int64(1, d))
+    return out
+
+
+def _enc_tensor(arr: np.ndarray) -> bytes:
+    dt = _DT_REV[np.dtype(arr.dtype)]
+    return (proto.enc_int64(1, dt) + enc_bytes(2, _enc_shape(arr.shape))
+            + enc_bytes(4, np.ascontiguousarray(arr).tobytes()))
+
+
+def _attr(key: str, value: bytes) -> bytes:
+    return enc_bytes(5, enc_string(1, key) + enc_bytes(2, value))
+
+
+def _node(name: str, op: str, inputs=(), attrs: Dict[str, bytes] = None) \
+        -> bytes:
+    body = enc_string(1, name) + enc_string(2, op)
+    for i in inputs:
+        body += enc_string(3, i)
+    for k, v in (attrs or {}).items():
+        body += _attr(k, v)
+    return enc_bytes(1, body)
+
+
+def save_tf_graph(model: Module, path: str, input_shape,
+                  input_name: str = "input",
+                  output_name: str = "output") -> List[str]:
+    """Export a Sequential of Linear/activations/Reshape to a frozen
+    GraphDef (≙ TensorflowSaver.saveGraph). Returns the node names."""
+    from ..nn import containers, linear as linear_mod, activation, shape_ops
+
+    params = model.ensure_initialized()
+    out = b""
+    dt_float = proto.enc_int64(6, 1)  # type: DT_FLOAT attr value
+    out += _node(input_name, "Placeholder",
+                 attrs={"dtype": dt_float,
+                        "shape": enc_bytes(7, _enc_shape(input_shape))})
+    cur = input_name
+    names = [input_name]
+
+    def emit(name, op, inputs, attrs=None):
+        nonlocal out
+        out += _node(name, op, inputs, attrs)
+        names.append(name)
+
+    layers = model.children() if hasattr(model, "children") else [model]
+    idx = 0
+    for layer in layers:
+        lname = f"layer{idx}"
+        if isinstance(layer, linear_mod.Linear):
+            w = np.asarray(params[layer.name]["weight"], np.float32)
+            b = np.asarray(params[layer.name].get("bias"), np.float32) \
+                if "bias" in params[layer.name] else None
+            emit(f"{lname}/weight", "Const", (),
+                 attrs={"dtype": dt_float,
+                        "value": enc_bytes(8, _enc_tensor(w.T))})
+            emit(f"{lname}/mm", "MatMul", [cur, f"{lname}/weight"])
+            cur = f"{lname}/mm"
+            if b is not None:
+                emit(f"{lname}/bias", "Const", (),
+                     attrs={"dtype": dt_float,
+                            "value": enc_bytes(8, _enc_tensor(b))})
+                emit(f"{lname}/add", "BiasAdd", [cur, f"{lname}/bias"])
+                cur = f"{lname}/add"
+        elif isinstance(layer, activation.ReLU):
+            emit(lname, "Relu", [cur]); cur = lname
+        elif isinstance(layer, activation.Tanh):
+            emit(lname, "Tanh", [cur]); cur = lname
+        elif isinstance(layer, activation.Sigmoid):
+            emit(lname, "Sigmoid", [cur]); cur = lname
+        elif isinstance(layer, activation.SoftMax):
+            emit(lname, "Softmax", [cur]); cur = lname
+        elif isinstance(layer, activation.LogSoftMax):
+            emit(lname, "LogSoftmax", [cur]); cur = lname
+        elif isinstance(layer, shape_ops.Reshape):
+            tgt = np.asarray((-1,) + tuple(layer.size), np.int32)
+            emit(f"{lname}/shape", "Const", (),
+                 attrs={"dtype": proto.enc_int64(6, 3),
+                        "value": enc_bytes(8, _enc_tensor(tgt))})
+            emit(lname, "Reshape", [cur, f"{lname}/shape"])
+            cur = lname
+        else:
+            raise NotImplementedError(
+                f"save_tf_graph: unsupported layer {type(layer).__name__}")
+        idx += 1
+    emit(output_name, "Identity", [cur])
+    with open(path, "wb") as f:
+        f.write(out)
+    return names

@@ -1,0 +1,102 @@
+"""TFRecord + fixed-length record IO (≙ utils/tf/TFRecordWriter.scala,
+TFRecordIterator.scala, FixedLengthRecordReader.scala).
+
+Record framing: u64 little-endian length | masked crc32c(length) | payload |
+masked crc32c(payload).  CRC verification on read is optional (the
+reference's iterator skips it too) but on by default here.
+`bigdl_tpu.native` supplies a C++ crc32c fast path when built.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Iterator, List, Optional
+
+from .crc32c import masked_crc32c
+
+
+class TFRecordWriter:
+    """≙ utils/tf/TFRecordWriter.scala."""
+
+    def __init__(self, path_or_file):
+        self._own = isinstance(path_or_file, (str, os.PathLike))
+        self._f = open(path_or_file, "wb") if self._own else path_or_file
+
+    def write(self, record: bytes):
+        header = struct.pack("<Q", len(record))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", masked_crc32c(header)))
+        self._f.write(record)
+        self._f.write(struct.pack("<I", masked_crc32c(record)))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        if self._own:
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class TFRecordIterator:
+    """≙ utils/tf/TFRecordIterator.scala."""
+
+    def __init__(self, path_or_file, check_crc: bool = True):
+        self._own = isinstance(path_or_file, (str, os.PathLike))
+        self._f = open(path_or_file, "rb") if self._own else path_or_file
+        self.check_crc = check_crc
+
+    def __iter__(self) -> Iterator[bytes]:
+        return self
+
+    def __next__(self) -> bytes:
+        header = self._f.read(8)
+        if len(header) < 8:
+            if self._own:
+                self._f.close()
+            raise StopIteration
+        (length,) = struct.unpack("<Q", header)
+        (len_crc,) = struct.unpack("<I", self._f.read(4))
+        payload = self._f.read(length)
+        (pay_crc,) = struct.unpack("<I", self._f.read(4))
+        if self.check_crc:
+            if len_crc != masked_crc32c(header):
+                raise IOError("TFRecord length crc mismatch")
+            if pay_crc != masked_crc32c(payload):
+                raise IOError("TFRecord payload crc mismatch")
+        return payload
+
+
+def read_tfrecords(path: str, check_crc: bool = True) -> List[bytes]:
+    return list(TFRecordIterator(path, check_crc))
+
+
+def write_tfrecords(path: str, records) -> None:
+    with TFRecordWriter(path) as w:
+        for r in records:
+            w.write(r)
+
+
+class FixedLengthRecordReader:
+    """Fixed-size binary records with optional header/footer bytes per file
+    (≙ utils/tf/FixedLengthRecordReader.scala; CIFAR-10 binary layout)."""
+
+    def __init__(self, path: str, record_bytes: int, header_bytes: int = 0,
+                 footer_bytes: int = 0):
+        self.path = path
+        self.record_bytes = record_bytes
+        self.header_bytes = header_bytes
+        self.footer_bytes = footer_bytes
+
+    def __iter__(self) -> Iterator[bytes]:
+        size = os.path.getsize(self.path)
+        with open(self.path, "rb") as f:
+            f.seek(self.header_bytes)
+            end = size - self.footer_bytes
+            while f.tell() + self.record_bytes <= end:
+                yield f.read(self.record_bytes)

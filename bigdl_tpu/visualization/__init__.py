@@ -13,7 +13,7 @@ import os
 from typing import Dict, List, Optional, Tuple
 
 from .event_writer import EventWriter, read_scalar
-from .crc32c import crc32c, masked_crc32c
+from ..utils.crc32c import crc32c, masked_crc32c
 
 
 class Summary:

@@ -15,8 +15,8 @@ from typing import List, Tuple
 
 import numpy as np
 
-from . import proto
-from .crc32c import masked_crc32c
+from ..utils import proto
+from ..utils.crc32c import masked_crc32c
 
 
 class EventWriter:

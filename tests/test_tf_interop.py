@@ -1,0 +1,192 @@
+"""TF interop tests (≙ utils/tf/*Spec.scala: TFRecordIteratorSpec,
+TensorflowLoaderSpec subset, TensorflowSaverSpec subset) + nn.ops shims
+(≙ nn/ops/*Spec.scala)."""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn import ops
+from bigdl_tpu.utils import tfrecord, tf_import
+from bigdl_tpu.utils.table import T
+
+
+def _run(mod, x):
+    mod.ensure_initialized()
+    return np.asarray(mod.forward(x))
+
+
+# --------------------------------------------------------------------- #
+# nn.ops shims                                                          #
+# --------------------------------------------------------------------- #
+def test_math_ops():
+    a = np.array([3.0, -7.0, 5.0], np.float32)
+    b = np.array([2.0, 2.0, -2.0], np.float32)
+    np.testing.assert_allclose(_run(ops.Add(), T(a, b)), a + b)
+    np.testing.assert_allclose(_run(ops.FloorDiv(), T(a, b)), [1, -4, -3])
+    np.testing.assert_allclose(_run(ops.TruncateDiv(), T(a, b)), [1, -3, -2])
+    np.testing.assert_allclose(_run(ops.Mod(), T(a, b)), [1, -1, 1])
+    np.testing.assert_allclose(_run(ops.FloorMod(), T(a, b)), [1, 1, -1])
+    np.testing.assert_allclose(_run(ops.SquaredDifference(), T(a, b)),
+                               (a - b) ** 2)
+    np.testing.assert_allclose(_run(ops.Round(), np.array([0.5, -0.5, 1.4])),
+                               [1.0, -1.0, 1.0])
+    np.testing.assert_allclose(_run(ops.Rint(), np.array([0.5, 1.5, 2.5])),
+                               [0.0, 2.0, 2.0])
+
+
+def test_comparison_and_logical_ops():
+    a = np.array([1.0, 2.0, 3.0], np.float32)
+    b = np.array([2.0, 2.0, 2.0], np.float32)
+    assert _run(ops.Greater(), T(a, b)).tolist() == [False, False, True]
+    assert _run(ops.LessEqual(), T(a, b)).tolist() == [True, True, False]
+    assert _run(ops.ApproximateEqual(0.5), T(a, b)).tolist() == \
+        [False, True, False]
+    t = np.array([True, False]); f = np.array([True, True])
+    assert _run(ops.LogicalAnd(), T(t, f)).tolist() == [True, False]
+    assert _run(ops.LogicalNot(), t).tolist() == [False, True]
+
+
+def test_reduction_and_indexing_ops():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    np.testing.assert_allclose(
+        _run(ops.Sum(), T(x, np.array([1]))), x.sum(1))
+    assert _run(ops.ArgMax(), T(x, np.int32(1))).tolist() == [3, 3, 3]
+    np.testing.assert_allclose(
+        _run(ops.Gather(axis=0), T(x, np.array([2, 0]))), x[[2, 0]])
+    oh = _run(ops.OneHot(depth=4, on_value=5.0, off_value=-1.0),
+              np.array([1, 3]))
+    assert oh.shape == (2, 4) and oh[0, 1] == 5.0 and oh[0, 0] == -1.0
+    sel = _run(ops.Select(), T(np.array([True, False]),
+                               np.array([1.0, 2.0]), np.array([9.0, 8.0])))
+    np.testing.assert_allclose(sel, [1.0, 8.0])
+    vals, idx = ops.TopK(2).forward(x)
+    np.testing.assert_allclose(np.asarray(vals), [[3, 2], [7, 6], [11, 10]])
+    intop = _run(ops.InTopK(1), T(x, np.array([3, 3, 0])))
+    assert intop.tolist() == [True, True, False]
+
+
+def test_segment_sum_and_l2loss():
+    data = np.arange(8, dtype=np.float32).reshape(4, 2)
+    ids = np.array([0, 0, 1, 1])
+    out = _run(ops.SegmentSum(num_segments=2), T(data, ids))
+    np.testing.assert_allclose(out, [[2, 4], [10, 12]])
+    np.testing.assert_allclose(_run(ops.L2Loss(), data),
+                               (data ** 2).sum() / 2)
+
+
+def test_shape_ops():
+    x = np.zeros((2, 3, 4), np.float32)
+    assert _run(ops.Shape(), x).tolist() == [2, 3, 4]
+    assert _run(ops.Rank(), x) == 3
+    assert _run(ops.Cast(np.int32), np.array([1.7])).dtype == np.int32
+    tiled = _run(ops.Tile(), T(np.ones((2, 2), np.float32),
+                               np.array([2, 1])))
+    assert tiled.shape == (4, 2)
+    sl = _run(ops.Slice(begin=(0, 1), size=(2, 2)),
+              np.arange(12, dtype=np.float32).reshape(3, 4))
+    np.testing.assert_allclose(sl, [[1, 2], [5, 6]])
+    ss = _run(ops.StrideSlice([(1, 0, 4, 2)]),
+              np.arange(12, dtype=np.float32).reshape(3, 4))
+    assert ss.shape == (3, 2)
+    bl = _run(ops.ResizeBilinear(4, 4),
+              np.arange(8, dtype=np.float32).reshape(1, 2, 2, 2))
+    assert bl.shape == (1, 4, 4, 2)
+    bc = _run(ops.BucketizedCol([0.0, 10.0, 100.0]),
+              np.array([[-1.0, 5.0], [50.0, 300.0]], np.float32))
+    assert bc.tolist() == [[0, 1], [2, 3]]
+
+
+# --------------------------------------------------------------------- #
+# TFRecord                                                              #
+# --------------------------------------------------------------------- #
+def test_tfrecord_roundtrip(tmp_path):
+    path = str(tmp_path / "data.tfrecord")
+    records = [b"hello", b"", b"x" * 1000, np.arange(10).tobytes()]
+    tfrecord.write_tfrecords(path, records)
+    back = tfrecord.read_tfrecords(path)
+    assert back == records
+
+
+def test_tfrecord_crc_detects_corruption(tmp_path):
+    path = str(tmp_path / "bad.tfrecord")
+    tfrecord.write_tfrecords(path, [b"payload-bytes"])
+    raw = bytearray(open(path, "rb").read())
+    raw[14] ^= 0xFF  # flip a payload byte
+    open(path, "wb").write(bytes(raw))
+    with pytest.raises(IOError):
+        tfrecord.read_tfrecords(path)
+    assert tfrecord.read_tfrecords(path, check_crc=False)
+
+
+def test_fixed_length_record_reader(tmp_path):
+    path = str(tmp_path / "records.bin")
+    with open(path, "wb") as f:
+        f.write(b"HDR")
+        for i in range(5):
+            f.write(bytes([i]) * 4)
+        f.write(b"FOOTER")
+    recs = list(tfrecord.FixedLengthRecordReader(path, 4, header_bytes=3,
+                                                 footer_bytes=6))
+    assert recs == [bytes([i]) * 4 for i in range(5)]
+
+
+# --------------------------------------------------------------------- #
+# GraphDef export -> import roundtrip                                   #
+# --------------------------------------------------------------------- #
+def test_graphdef_roundtrip_matches_native(tmp_path):
+    model = nn.Sequential(nn.Linear(6, 10), nn.ReLU(),
+                          nn.Linear(10, 4), nn.SoftMax())
+    model.reset(0)
+    x = np.random.RandomState(0).randn(5, 6).astype(np.float32)
+    want = np.asarray(model.forward(x))
+    path = str(tmp_path / "model.pb")
+    tf_import.save_tf_graph(model, path, input_shape=(-1, 6))
+    g = tf_import.load_tf_graph(path, inputs=["input"], outputs=["output"])
+    got = np.asarray(g.forward(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_graphdef_import_conv_pool():
+    """Hand-build a GraphDef with Conv2D+MaxPool and check vs lax."""
+    import jax
+    from jax import lax
+    from bigdl_tpu.utils import proto
+    from bigdl_tpu.utils.tf_import import (_node, _enc_tensor, parse_graphdef,
+                                           TFGraph)
+    rs = np.random.RandomState(0)
+    w = rs.randn(3, 3, 2, 4).astype(np.float32)
+    dt_float = proto.enc_int64(6, 1)
+
+    def attr_list_i(vals):
+        body = b"".join(proto.enc_int64(2, v) for v in vals)
+        return proto.enc_bytes(1, body)
+
+    graph = b""
+    graph += _node("x", "Placeholder", attrs={"dtype": dt_float})
+    graph += _node("w", "Const",
+                   attrs={"dtype": dt_float,
+                          "value": proto.enc_bytes(8, _enc_tensor(w))})
+    graph += _node("conv", "Conv2D", ["x", "w"],
+                   attrs={"strides": attr_list_i([1, 1, 1, 1]),
+                          "padding": proto.enc_bytes(2, b"SAME")})
+    graph += _node("pool", "MaxPool", ["conv"],
+                   attrs={"ksize": attr_list_i([1, 2, 2, 1]),
+                          "strides": attr_list_i([1, 2, 2, 1]),
+                          "padding": proto.enc_bytes(2, b"VALID")})
+    g = TFGraph(parse_graphdef(graph), ["x"], ["pool"])
+    x = rs.randn(1, 8, 8, 2).astype(np.float32)
+    got = np.asarray(g.forward(x))
+    conv = lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    want = lax.reduce_window(conv, -np.inf, lax.max, (1, 2, 2, 1),
+                             (1, 2, 2, 1), "VALID")
+    np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_graphdef_unknown_op_raises():
+    from bigdl_tpu.utils.tf_import import _node, parse_graphdef, TFGraph
+    graph = _node("x", "Placeholder") + _node("y", "NotARealOp", ["x"])
+    g = TFGraph(parse_graphdef(graph), ["x"], ["y"])
+    with pytest.raises(NotImplementedError):
+        g.forward(np.ones(3, np.float32))

@@ -8,8 +8,9 @@ import numpy as np
 
 from bigdl_tpu.visualization import (TrainSummary, ValidationSummary,
                                      crc32c, masked_crc32c)
-from bigdl_tpu.visualization.crc32c import unmask
-from bigdl_tpu.visualization import event_writer, proto
+from bigdl_tpu.utils.crc32c import unmask
+from bigdl_tpu.visualization import event_writer
+from bigdl_tpu.utils import proto
 
 
 def test_crc32c_known_vectors():
