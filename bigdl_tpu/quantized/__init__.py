@@ -1,0 +1,172 @@
+"""Post-training int8 quantization (≙ nn/quantized/: Linear.scala,
+SpatialConvolution.scala, SpatialDilatedConvolution.scala, Quantizer.scala,
+Quantizable.scala, Quantization.scala).
+
+The reference quantizes weights offline (per-output-channel symmetric
+min/max) and activations at runtime, running int8 GEMMs in MKL.  TPU-first
+design: int8 weights with per-channel fp32 scales; activations quantized
+per-tensor inside the jitted graph; `lax.dot_general`/`conv` with
+`preferred_element_type=int32` lowers onto the MXU's int8 path (2x the
+bf16 MACs on v5e).  Quantized modules are inference-only, like the
+reference (`QuantizedModule` has no backward).
+
+`quantize(model)` rewrites a model tree in place of the reference's
+`Quantizer.quantize` graph rewrite: containers are walked recursively and
+every Linear / SpatialConvolution with initialized weights is swapped for
+its quantized twin carrying frozen int8 weights.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..nn.module import Module
+from ..nn import containers as containers_mod
+from ..nn import linear as linear_mod
+from ..nn import conv as conv_mod
+
+
+def quantize_weights_symmetric(w: np.ndarray, axis: int = 0):
+    """Per-output-channel symmetric int8 (≙ quantized/Utils.scala min/max
+    thresholds; symmetric, so zero-point free — friendlier to the MXU)."""
+    w = np.asarray(w, np.float32)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    absmax = np.maximum(np.abs(w).max(axis=reduce_axes, keepdims=True),
+                        1e-8)
+    scale = absmax / 127.0
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def _quantize_activations(x, absmax=None):
+    """Per-tensor symmetric int8, computed in-graph (runtime quantization,
+    ≙ quantized Linear.scala updateOutput's input quantization)."""
+    if absmax is None:
+        absmax = jnp.maximum(jnp.max(jnp.abs(x)), 1e-8)
+    scale = absmax / 127.0
+    q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+class QuantizedModule(Module):
+    """Inference-only base (≙ nn/quantized/QuantizedModule.scala)."""
+
+    def init(self, rng):
+        return {}
+
+    def backward(self, *a, **k):
+        raise RuntimeError(
+            f"{type(self).__name__} is inference-only (quantized)")
+
+
+class QuantizedLinear(QuantizedModule):
+    """int8 x int8 -> int32 GEMM with fp32 rescale
+    (≙ nn/quantized/Linear.scala)."""
+
+    def __init__(self, weight, bias=None, name=None):
+        super().__init__(name=name)
+        qw, wscale = quantize_weights_symmetric(np.asarray(weight), axis=0)
+        self.qweight = jnp.asarray(qw)               # (out, in) int8
+        self.wscale = jnp.asarray(wscale.reshape(-1))  # (out,)
+        self.bias = None if bias is None else jnp.asarray(bias, jnp.float32)
+
+    @staticmethod
+    def from_float(layer: linear_mod.Linear, params=None) -> "QuantizedLinear":
+        p = params if params is not None \
+            else layer.ensure_initialized()[layer.name]
+        return QuantizedLinear(p["weight"], p.get("bias"),
+                               name=f"{layer.name}_q")
+
+    def apply(self, params, x, ctx):
+        qx, xscale = _quantize_activations(x)
+        acc = lax.dot_general(
+            qx, self.qweight,
+            (((qx.ndim - 1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (xscale * self.wscale)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+class QuantizedSpatialConvolution(QuantizedModule):
+    """int8 conv with int32 accumulation (≙ nn/quantized/
+    SpatialConvolution.scala). NCHW like the float layer."""
+
+    def __init__(self, weight, bias=None, stride=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), n_group=1, name=None):
+        super().__init__(name=name)
+        # float layer stores OIHW
+        qw, wscale = quantize_weights_symmetric(np.asarray(weight), axis=0)
+        self.qweight = jnp.asarray(qw)
+        self.wscale = jnp.asarray(wscale.reshape(1, -1, 1, 1))
+        self.bias = None if bias is None else jnp.asarray(bias, jnp.float32)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.n_group = n_group
+
+    @staticmethod
+    def from_float(layer, params=None) -> "QuantizedSpatialConvolution":
+        p = params if params is not None \
+            else layer.ensure_initialized()[layer.name]
+        return QuantizedSpatialConvolution(
+            np.asarray(p["weight"]), p.get("bias"), stride=layer.stride,
+            padding=layer.pad, n_group=getattr(layer, "n_group", 1),
+            name=f"{layer.name}_q")
+
+    def apply(self, params, x, ctx):
+        qx, xscale = _quantize_activations(x)
+        ph, pw = self.padding
+        pad = "SAME" if (ph == -1 or pw == -1) else ((ph, ph), (pw, pw))
+        acc = lax.conv_general_dilated(
+            qx.astype(jnp.int8), self.qweight,
+            window_strides=self.stride,
+            padding=pad,
+            rhs_dilation=self.dilation,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=self.n_group,
+            preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (xscale * self.wscale)
+        if self.bias is not None:
+            out = out + self.bias.reshape(1, -1, 1, 1)
+        return out
+
+
+_QUANTIZABLE = {}
+
+
+def _register_defaults():
+    _QUANTIZABLE[linear_mod.Linear] = QuantizedLinear.from_float
+    _QUANTIZABLE[conv_mod.SpatialConvolution] = \
+        QuantizedSpatialConvolution.from_float
+
+
+_register_defaults()
+
+
+def quantize(model: Module) -> Module:
+    """Deep-copy `model` with every quantizable layer replaced
+    (≙ nn/quantized/Quantizer.scala quantize).  The trained weights live in
+    the CONTAINER's flat params tree (children do not own them), so the
+    tree is threaded down and sliced by child name."""
+    params = model.ensure_initialized()
+    return _rewrite(model, params)
+
+
+def _rewrite(module: Module, params) -> Module:
+    fn = _QUANTIZABLE.get(type(module))
+    if fn is not None:
+        return fn(module, params.get(module.name))
+    if isinstance(module, containers_mod.Container):
+        clone = copy.copy(module)
+        clone._children = [_rewrite(c, params) for c in module.children()]
+        # drop cached float params: quantized children own frozen weights
+        clone._params, clone._state = clone.init_params(0)
+        return clone
+    return module

@@ -1,0 +1,72 @@
+"""Quantization tests (≙ nn/quantized *Spec.scala: quantized output close
+to float output; Quantizer graph rewrite)."""
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.quantized import (QuantizedLinear, QuantizedSpatialConvolution,
+                                 quantize, quantize_weights_symmetric)
+
+
+def test_weight_quantization_roundtrip():
+    rs = np.random.RandomState(0)
+    w = rs.randn(8, 16).astype(np.float32)
+    q, scale = quantize_weights_symmetric(w, axis=0)
+    assert q.dtype == np.int8 and np.abs(q).max() <= 127
+    err = np.abs(q.astype(np.float32) * scale - w).max()
+    assert err <= np.abs(w).max() / 127.0 + 1e-6  # within one step
+
+
+def test_quantized_linear_close_to_float():
+    rs = np.random.RandomState(0)
+    lin = nn.Linear(32, 16)
+    lin.reset(0)
+    x = rs.randn(8, 32).astype(np.float32)
+    want = np.asarray(lin.forward(x))
+    qlin = QuantizedLinear.from_float(lin)
+    got = np.asarray(qlin.forward(x))
+    # int8 symmetric: ~1% relative error on random gaussians
+    rel = np.abs(got - want).max() / np.abs(want).max()
+    assert rel < 0.05, rel
+
+
+def test_quantized_conv_close_to_float():
+    rs = np.random.RandomState(0)
+    conv = nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1)
+    conv.reset(0)
+    x = rs.randn(2, 3, 12, 12).astype(np.float32)
+    want = np.asarray(conv.forward(x))
+    qconv = QuantizedSpatialConvolution.from_float(conv)
+    got = np.asarray(qconv.forward(x))
+    rel = np.abs(got - want).max() / np.abs(want).max()
+    assert rel < 0.05, rel
+
+
+def test_quantize_model_rewrite_and_predict():
+    rs = np.random.RandomState(0)
+    model = nn.Sequential(
+        nn.SpatialConvolution(1, 4, 3, 3, 1, 1, 1, 1), nn.ReLU(),
+        nn.Reshape((4 * 8 * 8,)), nn.Linear(256, 10), nn.LogSoftMax())
+    model.reset(0)
+    x = rs.randn(4, 1, 8, 8).astype(np.float32)
+    want = np.asarray(model.forward(x))
+    qmodel = quantize(model)
+    kinds = [type(c).__name__ for c in qmodel.children()]
+    assert kinds[0] == "QuantizedSpatialConvolution"
+    assert kinds[3] == "QuantizedLinear"
+    got = np.asarray(qmodel.forward(x))
+    # logits land on the same ordering for most rows
+    agree = (got.argmax(1) == want.argmax(1)).mean()
+    assert agree >= 0.75
+    # original model untouched
+    assert type(model.children()[0]).__name__ == "SpatialConvolution"
+
+
+def test_quantized_backward_refuses():
+    lin = nn.Linear(4, 2)
+    lin.reset(0)
+    q = QuantizedLinear.from_float(lin)
+    x = np.ones((1, 4), np.float32)
+    q.forward(x)
+    with pytest.raises(RuntimeError):
+        q.backward(x, np.ones((1, 2), np.float32))
