@@ -1,0 +1,439 @@
+"""Caffe model import/export (≙ utils/caffe/: CaffeLoader.scala,
+CaffePersister.scala, Converter.scala, LayerConverter.scala,
+V1LayerConverter.scala).
+
+`load_caffe(prototxt, caffemodel)` parses the deploy prototxt (pure-python
+text parser) to build a bigdl_tpu `nn` graph and fills weights from the
+binary caffemodel (parsed with utils.proto's wire decoder — no protoc
+dependency).  `save_caffe(model, ...)` persists a Sequential subset back to
+prototxt + caffemodel that this loader round-trips.
+
+Supported layer types: Input, Convolution, InnerProduct, Pooling (MAX/AVE),
+ReLU, Sigmoid, TanH, Softmax(WithLoss), LRN, Dropout, Concat, Eltwise,
+Flatten, Reshape, BatchNorm(+Scale), Scale.
+"""
+from __future__ import annotations
+
+import re
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import proto
+from .proto import iter_fields
+from .. import nn
+
+
+# --------------------------------------------------------------------- #
+# prototxt text parser                                                  #
+# --------------------------------------------------------------------- #
+_TOKEN = re.compile(r'("(?:[^"\\]|\\.)*")|([{}:])|([^\s{}:]+)')
+
+
+def _tokenize(text: str):
+    for line in text.splitlines():
+        line = line.split("#", 1)[0]
+        for m in _TOKEN.finditer(line):
+            yield m.group(0)
+
+
+class PrototxtMessage(dict):
+    """Repeated fields accumulate into lists."""
+
+    def add(self, key, value):
+        if key in self:
+            cur = self[key]
+            if isinstance(cur, list):
+                cur.append(value)
+            else:
+                self[key] = [cur, value]
+        else:
+            self[key] = value
+
+    def get_list(self, key):
+        v = self.get(key)
+        if v is None:
+            return []
+        return v if isinstance(v, list) else [v]
+
+
+def parse_prototxt(text: str) -> PrototxtMessage:
+    tokens = list(_tokenize(text))
+    pos = 0
+
+    def parse_value(tok):
+        if tok.startswith('"'):
+            return tok[1:-1]
+        if tok in ("true", "false"):
+            return tok == "true"
+        try:
+            return int(tok)
+        except ValueError:
+            try:
+                return float(tok)
+            except ValueError:
+                return tok  # enum
+
+    def parse_block():
+        nonlocal pos
+        msg = PrototxtMessage()
+        while pos < len(tokens):
+            tok = tokens[pos]
+            if tok == "}":
+                pos += 1
+                return msg
+            key = tok
+            pos += 1
+            if pos < len(tokens) and tokens[pos] == ":":
+                pos += 1
+                msg.add(key, parse_value(tokens[pos]))
+                pos += 1
+            elif pos < len(tokens) and tokens[pos] == "{":
+                pos += 1
+                msg.add(key, parse_block())
+            else:
+                raise ValueError(f"prototxt parse error near {key!r}")
+        return msg
+
+    return parse_block()
+
+
+# --------------------------------------------------------------------- #
+# caffemodel binary parser (weights)                                    #
+# --------------------------------------------------------------------- #
+def _decode_blob(buf: bytes) -> np.ndarray:
+    shape: Tuple[int, ...] = ()
+    data: List[float] = []
+    legacy = {}
+    for f, w, v in iter_fields(buf):
+        if f == 7 and w == 2:  # shape: BlobShape{dim=1 packed int64}
+            dims = []
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 1 and w2 == 2:
+                    i = 0
+                    while i < len(v2):
+                        n, i = proto._read_varint(v2, i)
+                        dims.append(n)
+                elif f2 == 1 and w2 == 0:
+                    dims.append(v2)
+            shape = tuple(dims)
+        elif f == 5:  # data (packed float)
+            if w == 2:
+                data.append(np.frombuffer(v, np.float32))
+            else:
+                data.append(np.asarray([v], np.float32))
+        elif f in (1, 2, 3, 4) and w == 0:  # legacy num/channels/h/w
+            legacy[f] = v
+    arr = (np.concatenate([np.atleast_1d(d) for d in data])
+           if data else np.zeros(0, np.float32)).astype(np.float32)
+    if not shape and legacy:
+        shape = tuple(legacy.get(i, 1) for i in (1, 2, 3, 4))
+    if shape and arr.size == int(np.prod(shape)):
+        arr = arr.reshape(shape)
+    return arr
+
+
+def parse_caffemodel(data: bytes) -> Dict[str, List[np.ndarray]]:
+    """layer name -> blobs (weights, bias, ...); merges V1 `layers` (field 2)
+    and V2 `layer` (field 100)."""
+    blobs: Dict[str, List[np.ndarray]] = {}
+    for f, w, v in iter_fields(data):
+        if f == 100 and w == 2:  # LayerParameter
+            name = None
+            layer_blobs = []
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 1 and w2 == 2:
+                    name = v2.decode("utf-8")
+                elif f2 == 7 and w2 == 2:
+                    layer_blobs.append(_decode_blob(v2))
+            if name and layer_blobs:
+                blobs[name] = layer_blobs
+        elif f == 2 and w == 2:  # V1LayerParameter
+            name = None
+            layer_blobs = []
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 4 and w2 == 2:
+                    name = v2.decode("utf-8")
+                elif f2 == 6 and w2 == 2:
+                    layer_blobs.append(_decode_blob(v2))
+            if name and layer_blobs:
+                blobs[name] = layer_blobs
+    return blobs
+
+
+# --------------------------------------------------------------------- #
+# layer conversion (≙ LayerConverter.scala)                             #
+# --------------------------------------------------------------------- #
+def _ks(param, base, h_key, w_key):
+    """kernel/stride/pad resolution: *_h/*_w override the repeated field."""
+    h = param.get(h_key)
+    w = param.get(w_key)
+    if h is not None or w is not None:
+        return int(h or 0), int(w or 0)
+    vals = param.get_list(base) if isinstance(param, PrototxtMessage) else []
+    if not vals:
+        vals = [param.get(base)] if param.get(base) is not None else []
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return int(vals[0]), int(vals[0])
+    return int(vals[0]), int(vals[1])
+
+
+def _convert_layer(ltype: str, lp: PrototxtMessage, in_channels: int):
+    """Returns (module, out_channels) or None for pass-through."""
+    t = ltype.lower()
+    if t == "convolution":
+        cp = lp.get("convolution_param", PrototxtMessage())
+        nout = int(cp.get("num_output"))
+        kh, kw = _ks(cp, "kernel_size", "kernel_h", "kernel_w")
+        sh, sw = _ks(cp, "stride", "stride_h", "stride_w") or (1, 1)
+        ph, pw = _ks(cp, "pad", "pad_h", "pad_w") or (0, 0)
+        group = int(cp.get("group", 1))
+        bias = bool(cp.get("bias_term", True))
+        mod = nn.SpatialConvolution(in_channels, nout, kw, kh, sw, sh,
+                                    pw, ph, n_group=group, with_bias=bias)
+        return mod, nout
+    if t == "innerproduct" or t == "inner_product":
+        ip = lp.get("inner_product_param", PrototxtMessage())
+        nout = int(ip.get("num_output"))
+        bias = bool(ip.get("bias_term", True))
+        return nn.Linear(in_channels, nout, with_bias=bias), nout
+    if t == "pooling":
+        pp = lp.get("pooling_param", PrototxtMessage())
+        kh, kw = _ks(pp, "kernel_size", "kernel_h", "kernel_w") or (2, 2)
+        sh, sw = _ks(pp, "stride", "stride_h", "stride_w") or (kh, kw)
+        ph, pw = _ks(pp, "pad", "pad_h", "pad_w") or (0, 0)
+        pool = str(pp.get("pool", "MAX")).upper()
+        if pool in ("MAX", "0"):
+            mod = nn.SpatialMaxPooling(kw, kh, sw, sh, pw, ph).ceil()
+        else:
+            mod = nn.SpatialAveragePooling(kw, kh, sw, sh, pw, ph,
+                                           count_include_pad=False).ceil()
+        return mod, in_channels
+    if t == "relu":
+        return nn.ReLU(), in_channels
+    if t == "sigmoid":
+        return nn.Sigmoid(), in_channels
+    if t == "tanh":
+        return nn.Tanh(), in_channels
+    if t in ("softmax", "softmaxwithloss"):
+        return nn.SoftMax(), in_channels
+    if t == "lrn":
+        lrn = lp.get("lrn_param", PrototxtMessage())
+        return nn.SpatialCrossMapLRN(
+            int(lrn.get("local_size", 5)), float(lrn.get("alpha", 1.0)),
+            float(lrn.get("beta", 0.75)), float(lrn.get("k", 1.0))), \
+            in_channels
+    if t == "dropout":
+        dp = lp.get("dropout_param", PrototxtMessage())
+        return nn.Dropout(float(dp.get("dropout_ratio", 0.5))), in_channels
+    if t == "batchnorm":
+        bp = lp.get("batch_norm_param", PrototxtMessage())
+        return nn.SpatialBatchNormalization(
+            in_channels, eps=float(bp.get("eps", 1e-5)),
+            affine=False), in_channels
+    if t == "scale":
+        return nn.CMul((1, in_channels, 1, 1)), in_channels
+    raise ValueError(f"unsupported caffe layer type {ltype!r}")
+
+
+from ..nn.module import Module as _Module
+
+
+class CaffeFlatten(_Module):
+    """Caffe's implicit flatten before InnerProduct: (N, ...) -> (N, -1)."""
+
+    def apply(self, params, x, ctx):
+        return x.reshape(x.shape[0], -1)
+
+
+def _convert(ltype, lp, in_ch):
+    if ltype.lower() == "flatten":
+        return CaffeFlatten(), None
+    return _convert_layer(ltype, lp, in_ch)
+
+
+# --------------------------------------------------------------------- #
+# loader                                                                #
+# --------------------------------------------------------------------- #
+class CaffeLoader:
+    """≙ utils/caffe/CaffeLoader.scala (sequential deploy nets)."""
+
+    def __init__(self, prototxt_path: str, model_path: Optional[str] = None,
+                 match_all: bool = True):
+        with open(prototxt_path) as f:
+            self.net = parse_prototxt(f.read())
+        self.blobs: Dict[str, List[np.ndarray]] = {}
+        if model_path:
+            with open(model_path, "rb") as f:
+                self.blobs = parse_caffemodel(f.read())
+        self.match_all = match_all
+
+    def _input_shape(self):
+        # input_shape { dim: ... } or layer type Input
+        ish = self.net.get("input_shape")
+        if ish is not None:
+            if isinstance(ish, list):
+                ish = ish[0]
+            return [int(d) for d in ish.get_list("dim")]
+        if "input_dim" in self.net:
+            return [int(d) for d in self.net.get_list("input_dim")]
+        for lp in self.net.get_list("layer"):
+            if str(lp.get("type", "")).lower() == "input":
+                shp = lp.get("input_param", PrototxtMessage()).get("shape")
+                if isinstance(shp, list):
+                    shp = shp[0]
+                if shp is not None:
+                    return [int(d) for d in shp.get_list("dim")]
+        return None
+
+    def create_module(self):
+        """Build a Sequential following the prototxt layer order, loading
+        weights by layer name (≙ CaffeLoader.createCaffeModel)."""
+        shape = self._input_shape()
+        in_ch = shape[1] if shape and len(shape) >= 2 else None
+        spatial = shape[2:] if shape and len(shape) == 4 else None
+        model = nn.Sequential()
+        weight_assign = []
+        for lp in self.net.get_list("layer") + self.net.get_list("layers"):
+            ltype = str(lp.get("type", ""))
+            if ltype.lower() in ("input", "data"):
+                continue
+            name = lp.get("name", f"layer{len(model)}")
+            if ltype.lower() in ("innerproduct", "inner_product") \
+                    and spatial is not None:
+                # caffe flattens implicitly before IP layers
+                model.add(CaffeFlatten())
+                in_ch = in_ch * int(np.prod(spatial))
+                spatial = None
+            mod, out_ch = _convert(ltype, lp, in_ch)
+            mod.set_name(name)
+            model.add(mod)
+            if out_ch is not None:
+                in_ch = out_ch
+            if spatial is not None and hasattr(mod, "kernel"):
+                kh, kw = mod.kernel
+                sh, sw = mod.stride
+                ph, pw = mod.pad if hasattr(mod, "pad") else (0, 0)
+                spatial = [
+                    (spatial[0] + 2 * ph - kh) // sh + 1,
+                    (spatial[1] + 2 * pw - kw) // sw + 1]
+            weight_assign.append((name, mod))
+        params, state = model.init_params(0)
+        for name, mod in weight_assign:
+            if name not in self.blobs:
+                continue
+            blobs = self.blobs[name]
+            p = dict(params.get(mod.name, {}))
+            if "weight" in p and len(blobs) >= 1:
+                w = blobs[0].reshape(np.shape(p["weight"]))
+                p["weight"] = w.astype(np.float32)
+            if "bias" in p and len(blobs) >= 2:
+                p["bias"] = blobs[1].reshape(np.shape(p["bias"])) \
+                    .astype(np.float32)
+            params[mod.name] = p
+        model.set_params(params, state)
+        return model
+
+    @staticmethod
+    def load(prototxt_path: str, model_path: Optional[str] = None):
+        return CaffeLoader(prototxt_path, model_path).create_module()
+
+
+def load_caffe(prototxt_path: str, model_path: Optional[str] = None):
+    """≙ Module.loadCaffeModel."""
+    return CaffeLoader.load(prototxt_path, model_path)
+
+
+# --------------------------------------------------------------------- #
+# persister                                                             #
+# --------------------------------------------------------------------- #
+def _blob_bytes(arr: np.ndarray) -> bytes:
+    shape_body = b""
+    for d in arr.shape:
+        shape_body += proto.enc_int64(1, d)
+    return (proto.enc_bytes(7, shape_body)
+            + proto.enc_bytes(5, np.ascontiguousarray(
+                arr, np.float32).tobytes()))
+
+
+def save_caffe(model, prototxt_path: str, model_path: str,
+               input_shape=None):
+    """Persist a Sequential subset (≙ utils/caffe/CaffePersister.scala):
+    writes a deploy prototxt and a V2 caffemodel with the weights."""
+    params = model.ensure_initialized()
+    lines = ['name: "bigdl_tpu"']
+    if input_shape is not None:
+        dims = "\n".join(f"  dim: {d}" for d in input_shape)
+        lines.append(f"input: \"data\"\ninput_shape {{\n{dims}\n}}")
+    body = b""
+    for mod in model.children():
+        name = mod.name
+        p = params.get(name, {})
+        lp = proto.enc_string(1, name)
+        if isinstance(mod, nn.SpatialConvolution):
+            kh, kw = mod.kernel
+            sh, sw = mod.stride
+            ph, pw = mod.pad
+            lp += proto.enc_string(2, "Convolution")
+            cp = proto.enc_int64(1, mod.n_output_plane)
+            cp += proto.enc_int64(4, kh) if kh == kw else (
+                proto.enc_int64(11, kh) + proto.enc_int64(12, kw))
+            cp += proto.enc_int64(6, sh) if sh == sw else (
+                proto.enc_int64(13, sh) + proto.enc_int64(14, sw))
+            cp += proto.enc_int64(3, max(ph, 0))
+            cp += proto.enc_int64(5, mod.n_group)
+            lp += proto.enc_bytes(106, cp)
+            lines.append(
+                f'layer {{ name: "{name}" type: "Convolution" '
+                f'convolution_param {{ num_output: {mod.n_output_plane} '
+                f'kernel_h: {kh} kernel_w: {kw} stride_h: {sh} '
+                f'stride_w: {sw} pad_h: {max(ph,0)} pad_w: {max(pw,0)} '
+                f'group: {mod.n_group} '
+                f'bias_term: {"true" if mod.with_bias else "false"} }} }}')
+            lp += proto.enc_bytes(7, _blob_bytes(np.asarray(p["weight"])))
+            if mod.with_bias:
+                lp += proto.enc_bytes(7, _blob_bytes(np.asarray(p["bias"])))
+        elif isinstance(mod, nn.Linear):
+            lp += proto.enc_string(2, "InnerProduct")
+            nout = np.asarray(p["weight"]).shape[0]
+            lp += proto.enc_bytes(117, proto.enc_int64(1, nout))
+            lines.append(
+                f'layer {{ name: "{name}" type: "InnerProduct" '
+                f'inner_product_param {{ num_output: {nout} }} }}')
+            lp += proto.enc_bytes(7, _blob_bytes(np.asarray(p["weight"])))
+            if "bias" in p:
+                lp += proto.enc_bytes(7, _blob_bytes(np.asarray(p["bias"])))
+        elif isinstance(mod, nn.ReLU):
+            lp += proto.enc_string(2, "ReLU")
+            lines.append(f'layer {{ name: "{name}" type: "ReLU" }}')
+        elif isinstance(mod, nn.Sigmoid):
+            lp += proto.enc_string(2, "Sigmoid")
+            lines.append(f'layer {{ name: "{name}" type: "Sigmoid" }}')
+        elif isinstance(mod, nn.Tanh):
+            lp += proto.enc_string(2, "TanH")
+            lines.append(f'layer {{ name: "{name}" type: "TanH" }}')
+        elif isinstance(mod, nn.SoftMax):
+            lp += proto.enc_string(2, "Softmax")
+            lines.append(f'layer {{ name: "{name}" type: "Softmax" }}')
+        elif isinstance(mod, CaffeFlatten):
+            lp += proto.enc_string(2, "Flatten")
+            lines.append(f'layer {{ name: "{name}" type: "Flatten" }}')
+        elif isinstance(mod, nn.SpatialMaxPooling):
+            kh, kw = mod.kernel
+            sh, sw = mod.stride
+            lp += proto.enc_string(2, "Pooling")
+            lines.append(
+                f'layer {{ name: "{name}" type: "Pooling" pooling_param '
+                f'{{ pool: MAX kernel_h: {kh} kernel_w: {kw} '
+                f'stride_h: {sh} stride_w: {sw} }} }}')
+        else:
+            raise ValueError(
+                f"save_caffe: unsupported layer {type(mod).__name__}")
+        body += proto.enc_bytes(100, lp)
+    with open(prototxt_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    with open(model_path, "wb") as f:
+        f.write(proto.enc_string(1, "bigdl_tpu") + body)

@@ -1,0 +1,243 @@
+"""Torch7 .t7 serialization (≙ utils/TorchFile.scala).
+
+Binary little-endian format: each value is (type_tag:int32, payload).
+Tags: 0 nil, 1 number (f64), 2 string, 3 table, 4 torch object (class name
++ payload), 5 boolean, 6/7 functions (unsupported).  Tables and torch
+objects are reference-counted by an index so shared objects round-trip.
+
+Tensors map to numpy: torch.FloatTensor/DoubleTensor/LongTensor/ByteTensor
+<-> float32/float64/int64/uint8 arrays (contiguous on write).  Tables with
+dense 1..n integer keys load as lists, otherwise dicts.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, BinaryIO, Dict
+
+import numpy as np
+
+TYPE_NIL = 0
+TYPE_NUMBER = 1
+TYPE_STRING = 2
+TYPE_TABLE = 3
+TYPE_TORCH = 4
+TYPE_BOOLEAN = 5
+
+_TENSOR_CLASSES = {
+    "torch.FloatTensor": np.float32,
+    "torch.DoubleTensor": np.float64,
+    "torch.LongTensor": np.int64,
+    "torch.IntTensor": np.int32,
+    "torch.ByteTensor": np.uint8,
+}
+_STORAGE_CLASSES = {
+    "torch.FloatStorage": np.float32,
+    "torch.DoubleStorage": np.float64,
+    "torch.LongStorage": np.int64,
+    "torch.IntStorage": np.int32,
+    "torch.ByteStorage": np.uint8,
+}
+_DTYPE_TO_TENSOR = {np.dtype(np.float32): "torch.FloatTensor",
+                    np.dtype(np.float64): "torch.DoubleTensor",
+                    np.dtype(np.int64): "torch.LongTensor",
+                    np.dtype(np.int32): "torch.IntTensor",
+                    np.dtype(np.uint8): "torch.ByteTensor"}
+_TENSOR_TO_STORAGE = {"torch.FloatTensor": "torch.FloatStorage",
+                      "torch.DoubleTensor": "torch.DoubleStorage",
+                      "torch.LongTensor": "torch.LongStorage",
+                      "torch.IntTensor": "torch.IntStorage",
+                      "torch.ByteTensor": "torch.ByteStorage"}
+
+
+class _Reader:
+    def __init__(self, f: BinaryIO):
+        self.f = f
+        self.memo: Dict[int, Any] = {}
+
+    def i32(self):
+        return struct.unpack("<i", self.f.read(4))[0]
+
+    def i64(self):
+        return struct.unpack("<q", self.f.read(8))[0]
+
+    def f64(self):
+        return struct.unpack("<d", self.f.read(8))[0]
+
+    def string(self):
+        n = self.i32()
+        return self.f.read(n).decode("utf-8", "replace")
+
+    def read(self):
+        tag = self.i32()
+        if tag == TYPE_NIL:
+            return None
+        if tag == TYPE_NUMBER:
+            v = self.f64()
+            return int(v) if v == int(v) else v
+        if tag == TYPE_STRING:
+            return self.string()
+        if tag == TYPE_BOOLEAN:
+            return self.i32() == 1
+        if tag == TYPE_TABLE:
+            return self._table()
+        if tag == TYPE_TORCH:
+            return self._torch()
+        raise ValueError(f"unsupported t7 type tag {tag}")
+
+    def _table(self):
+        index = self.i32()
+        if index in self.memo:
+            return self.memo[index]
+        out: Dict[Any, Any] = {}
+        self.memo[index] = out
+        n = self.i32()
+        for _ in range(n):
+            k = self.read()
+            v = self.read()
+            out[k] = v
+        # dense 1..n integer keys -> list
+        if out and all(isinstance(k, int) for k in out) \
+                and sorted(out) == list(range(1, len(out) + 1)):
+            lst = [out[i] for i in range(1, len(out) + 1)]
+            self.memo[index] = lst
+            return lst
+        return out
+
+    def _torch(self):
+        index = self.i32()
+        if index in self.memo:
+            return self.memo[index]
+        version = self.string()  # e.g. "V 1"
+        if not version.startswith("V"):
+            # older files: the 'version' IS the class name
+            cls = version
+        else:
+            cls = self.string()
+        if cls in _TENSOR_CLASSES:
+            t = self._tensor(cls)
+            self.memo[index] = t
+            return t
+        if cls in _STORAGE_CLASSES:
+            s = self._storage(cls)
+            self.memo[index] = s
+            return s
+        # generic torch object: payload is a table (module fields)
+        obj = {"__torch_class__": cls}
+        self.memo[index] = obj
+        payload = self.read()
+        if isinstance(payload, dict):
+            obj.update(payload)
+        else:
+            obj["__payload__"] = payload
+        return obj
+
+    def _tensor(self, cls):
+        nd = self.i32()
+        sizes = [self.i64() for _ in range(nd)]
+        strides = [self.i64() for _ in range(nd)]
+        offset = self.i64() - 1  # 1-based
+        storage = self.read()
+        if storage is None:
+            return np.zeros(sizes, _TENSOR_CLASSES[cls])
+        itemsize = storage.dtype.itemsize
+        return np.lib.stride_tricks.as_strided(
+            storage[offset:], shape=sizes,
+            strides=[s * itemsize for s in strides]).copy()
+
+    def _storage(self, cls):
+        n = self.i64()
+        dtype = _STORAGE_CLASSES[cls]
+        return np.frombuffer(self.f.read(n * np.dtype(dtype).itemsize),
+                             dtype=dtype).copy()
+
+
+class _Writer:
+    def __init__(self, f: BinaryIO):
+        self.f = f
+        self._next_index = 1
+
+    def i32(self, v):
+        self.f.write(struct.pack("<i", v))
+
+    def i64(self, v):
+        self.f.write(struct.pack("<q", v))
+
+    def f64(self, v):
+        self.f.write(struct.pack("<d", v))
+
+    def string(self, s: str):
+        b = s.encode("utf-8")
+        self.i32(len(b))
+        self.f.write(b)
+
+    def _index(self):
+        i = self._next_index
+        self._next_index += 1
+        return i
+
+    def write(self, obj):
+        if obj is None:
+            self.i32(TYPE_NIL)
+        elif isinstance(obj, bool):
+            self.i32(TYPE_BOOLEAN)
+            self.i32(1 if obj else 0)
+        elif isinstance(obj, (int, float)):
+            self.i32(TYPE_NUMBER)
+            self.f64(float(obj))
+        elif isinstance(obj, str):
+            self.i32(TYPE_STRING)
+            self.string(obj)
+        elif isinstance(obj, np.ndarray):
+            self._tensor(obj)
+        elif isinstance(obj, (list, tuple)):
+            self.write({i + 1: v for i, v in enumerate(obj)})
+        elif isinstance(obj, dict):
+            self.i32(TYPE_TABLE)
+            self.i32(self._index())
+            self.i32(len(obj))
+            for k, v in obj.items():
+                self.write(k)
+                self.write(v)
+        else:
+            raise TypeError(f"cannot write {type(obj).__name__} to .t7")
+
+    def _tensor(self, arr: np.ndarray):
+        arr = np.ascontiguousarray(arr)
+        cls = _DTYPE_TO_TENSOR.get(arr.dtype)
+        if cls is None:
+            arr = arr.astype(np.float32)
+            cls = "torch.FloatTensor"
+        self.i32(TYPE_TORCH)
+        self.i32(self._index())
+        self.string("V 1")
+        self.string(cls)
+        self.i32(arr.ndim)
+        for s in arr.shape:
+            self.i64(s)
+        stride = 1
+        strides = []
+        for s in reversed(arr.shape):
+            strides.append(stride)
+            stride *= s
+        for s in reversed(strides):
+            self.i64(s)
+        self.i64(1)  # storage offset (1-based)
+        # storage
+        self.i32(TYPE_TORCH)
+        self.i32(self._index())
+        self.string("V 1")
+        self.string(_TENSOR_TO_STORAGE[cls])
+        self.i64(arr.size)
+        self.f.write(arr.tobytes())
+
+
+def load(path: str):
+    """≙ TorchFile.load."""
+    with open(path, "rb") as f:
+        return _Reader(f).read()
+
+
+def save(obj, path: str):
+    """≙ TorchFile.save."""
+    with open(path, "wb") as f:
+        _Writer(f).write(obj)

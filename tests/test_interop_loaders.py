@@ -1,0 +1,136 @@
+"""Caffe + Torch .t7 loader tests (≙ utils/caffe/*Spec.scala,
+TorchFileSpec.scala)."""
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.utils import caffe as C
+from bigdl_tpu.utils import torchfile as T7
+
+
+# --------------------------------------------------------------------- #
+# torchfile                                                             #
+# --------------------------------------------------------------------- #
+def test_t7_scalar_roundtrip(tmp_path):
+    path = str(tmp_path / "x.t7")
+    for obj in (None, 42, 3.25, "hello", True, False):
+        T7.save(obj, path)
+        assert T7.load(path) == obj
+
+
+def test_t7_tensor_roundtrip(tmp_path):
+    path = str(tmp_path / "t.t7")
+    rs = np.random.RandomState(0)
+    for arr in (rs.randn(5).astype(np.float32),
+                rs.randn(3, 4).astype(np.float64),
+                rs.randint(0, 100, (2, 3, 4)).astype(np.int64),
+                (rs.rand(4, 4) * 255).astype(np.uint8)):
+        T7.save(arr, path)
+        back = T7.load(path)
+        assert back.dtype == arr.dtype
+        np.testing.assert_array_equal(back, arr)
+
+
+def test_t7_table_roundtrip(tmp_path):
+    path = str(tmp_path / "tbl.t7")
+    obj = {"weight": np.ones((2, 2), np.float32), "bias": np.zeros(2, np.float32),
+           "nested": {"lr": 0.1, "name": "sgd"},
+           "list": [1, 2, 3]}
+    T7.save(obj, path)
+    back = T7.load(path)
+    np.testing.assert_array_equal(back["weight"], obj["weight"])
+    assert back["nested"]["name"] == "sgd"
+    assert back["list"] == [1, 2, 3]
+
+
+def test_t7_known_binary_layout(tmp_path):
+    """A number serializes as (tag=1:int32, value:float64) little-endian."""
+    import struct
+    path = str(tmp_path / "n.t7")
+    T7.save(7.5, path)
+    raw = open(path, "rb").read()
+    assert raw == struct.pack("<id", 1, 7.5)
+
+
+# --------------------------------------------------------------------- #
+# prototxt parsing                                                      #
+# --------------------------------------------------------------------- #
+PROTOTXT = """
+name: "TinyNet"
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 8 dim: 8 }
+layer {
+  name: "conv1"
+  type: "Convolution"
+  bottom: "data"
+  top: "conv1"
+  convolution_param { num_output: 4 kernel_size: 3 pad: 1 stride: 1 }
+}
+layer { name: "relu1" type: "ReLU" bottom: "conv1" top: "conv1" }
+layer {
+  name: "pool1"
+  type: "Pooling"
+  pooling_param { pool: MAX kernel_size: 2 stride: 2 }
+}
+layer {
+  name: "ip1"
+  type: "InnerProduct"
+  inner_product_param { num_output: 10 }
+}
+layer { name: "prob" type: "Softmax" }
+"""
+
+
+def test_parse_prototxt():
+    net = C.parse_prototxt(PROTOTXT)
+    assert net["name"] == "TinyNet"
+    layers = net.get_list("layer")
+    assert [l["name"] for l in layers] == \
+        ["conv1", "relu1", "pool1", "ip1", "prob"]
+    assert layers[0]["convolution_param"]["num_output"] == 4
+    assert layers[2]["pooling_param"]["pool"] == "MAX"
+
+
+def test_caffe_load_structure_and_forward(tmp_path):
+    proto_path = str(tmp_path / "deploy.prototxt")
+    open(proto_path, "w").write(PROTOTXT)
+    model = C.load_caffe(proto_path)
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    y = np.asarray(model.forward(x))
+    assert y.shape == (2, 10)
+    np.testing.assert_allclose(y.sum(1), 1.0, rtol=1e-5)  # softmax rows
+
+
+def test_caffe_roundtrip_weights(tmp_path):
+    """save_caffe -> load_caffe preserves numerics."""
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+        nn.ReLU(),
+        C.CaffeFlatten(),
+        nn.Linear(4 * 8 * 8, 10),
+        nn.SoftMax())
+    # caffe layer names must be stable for the weight match (and set
+    # before reset: params are keyed by module name)
+    for i, m in enumerate(model.children()):
+        m.set_name(f"l{i}")
+    model.reset(0)
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    want = np.asarray(model.forward(x))
+    pt, cm = str(tmp_path / "d.prototxt"), str(tmp_path / "d.caffemodel")
+    C.save_caffe(model, pt, cm, input_shape=(1, 3, 8, 8))
+    back = C.load_caffe(pt, cm)
+    got = np.asarray(back.forward(x))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_caffemodel_blob_parse():
+    """Hand-encode a V2 caffemodel layer and parse the blobs back."""
+    from bigdl_tpu.utils import proto
+    w = np.arange(12, dtype=np.float32).reshape(3, 4)
+    blob = (proto.enc_bytes(7, proto.enc_int64(1, 3) + proto.enc_int64(1, 4))
+            + proto.enc_bytes(5, w.tobytes()))
+    layer = (proto.enc_string(1, "fc") + proto.enc_string(2, "InnerProduct")
+             + proto.enc_bytes(7, blob))
+    net = proto.enc_bytes(100, layer)
+    blobs = C.parse_caffemodel(net)
+    np.testing.assert_array_equal(blobs["fc"][0], w)
