@@ -1,0 +1,219 @@
+"""ML-pipeline integration (≙ dlframes/: DLEstimator.scala,
+DLClassifier.scala, DLImageReader.scala, DLImageTransformer.scala +
+pyspark/bigdl/dlframes/dl_classifier.py).
+
+The reference plugs BigDL into Spark-ML Pipelines (fit on a DataFrame of
+feature/label columns, transform adds a prediction column).  There is no
+Spark in a TPU pod, so the same estimator/model/transformer semantics are
+exposed sklearn-style over numpy arrays / lists of dicts ("rows"):
+
+    est = DLEstimator(model, criterion, [13], [1]).set_max_epoch(10)
+    dl_model = est.fit(rows)                 # rows: (x, y) or list of dicts
+    out_rows = dl_model.transform(rows)      # adds 'prediction'
+
+DLClassifier adds argmax class prediction, DLImageReader loads image
+folders into rows, DLImageTransformer applies a vision FeatureTransformer
+per row — the same pipeline stages, minus the JVM.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..nn.module import Module
+from .. import optim as O
+from ..data.imageframe import ImageFeature, FeatureTransformer
+
+
+Rows = Union[Sequence[Dict], tuple]
+
+
+def _rows_to_arrays(data, features_col, label_col=None):
+    if isinstance(data, tuple):
+        x, y = data if len(data) == 2 else (data[0], None)
+        return np.asarray(x), None if y is None else np.asarray(y)
+    xs = [np.asarray(r[features_col]) for r in data]
+    ys = None
+    if label_col is not None and data and label_col in data[0]:
+        ys = np.asarray([r[label_col] for r in data], np.float32)
+    return np.stack(xs), ys
+
+
+class _Params:
+    """Shared fluent params (≙ dl_classifier.py Has* mixins)."""
+
+    def __init__(self):
+        self.batch_size = 1
+        self.max_epoch = 50
+        self.learning_rate = 1e-3
+        self.features_col = "features"
+        self.label_col = "label"
+        self.prediction_col = "prediction"
+
+    def set_batch_size(self, v):
+        self.batch_size = v
+        return self
+
+    def get_batch_size(self):
+        return self.batch_size
+
+    def set_max_epoch(self, v):
+        self.max_epoch = v
+        return self
+
+    def get_max_epoch(self):
+        return self.max_epoch
+
+    def set_learning_rate(self, v):
+        self.learning_rate = v
+        return self
+
+    def get_learning_rate(self):
+        return self.learning_rate
+
+    def set_features_col(self, v):
+        self.features_col = v
+        return self
+
+    def set_label_col(self, v):
+        self.label_col = v
+        return self
+
+    def set_prediction_col(self, v):
+        self.prediction_col = v
+        return self
+
+
+class DLEstimator(_Params):
+    """Fit a model+criterion over (features, label) rows
+    (≙ dlframes/DLEstimator.scala)."""
+
+    def __init__(self, model: Module, criterion, feature_size: Sequence[int],
+                 label_size: Sequence[int], optim_method=None, mesh=None):
+        super().__init__()
+        self.model = model
+        self.criterion = criterion
+        self.feature_size = tuple(feature_size)
+        self.label_size = tuple(label_size)
+        self.optim_method = optim_method
+        self.mesh = mesh
+
+    def fit(self, data) -> "DLModel":
+        x, y = _rows_to_arrays(data, self.features_col, self.label_col)
+        x = x.reshape((-1,) + self.feature_size).astype(np.float32)
+        if y is None:
+            raise ValueError(f"fit needs a {self.label_col!r} column")
+        y = np.asarray(y, np.float32).reshape((-1,) + self.label_size)
+        method = self.optim_method or O.Adam(
+            learning_rate=self.learning_rate)
+        if self.mesh is not None:
+            from ..optim.distri_optimizer import DistriOptimizer
+            opt = DistriOptimizer(self.model, (x, y), self.criterion,
+                                  batch_size=self.batch_size, mesh=self.mesh)
+        else:
+            opt = O.LocalOptimizer(self.model, (x, y), self.criterion,
+                                   batch_size=self.batch_size)
+        opt.set_optim_method(method) \
+           .set_end_when(O.Trigger.max_epoch(self.max_epoch))
+        model = opt.optimize()
+        return self._wrap_model(model)
+
+    def _wrap_model(self, model):
+        m = DLModel(model, self.feature_size)
+        m.batch_size = self.batch_size
+        m.features_col = self.features_col
+        m.prediction_col = self.prediction_col
+        return m
+
+
+class DLModel(_Params):
+    """Transform rows by adding a prediction column
+    (≙ dlframes/DLEstimator.scala DLModel)."""
+
+    def __init__(self, model: Module, feature_size: Sequence[int]):
+        super().__init__()
+        self.model = model
+        self.feature_size = tuple(feature_size)
+
+    def set_feature_size(self, v):
+        self.feature_size = tuple(v)
+        return self
+
+    def get_feature_size(self):
+        return self.feature_size
+
+    def _predict(self, x: np.ndarray) -> np.ndarray:
+        x = x.reshape((-1,) + self.feature_size).astype(np.float32)
+        return O.Predictor(self.model, batch_size=self.batch_size) \
+            .predict(x)
+
+    def transform(self, data):
+        if isinstance(data, tuple) or isinstance(data, np.ndarray):
+            x = data[0] if isinstance(data, tuple) else data
+            return self._predict(np.asarray(x))
+        x, _ = _rows_to_arrays(data, self.features_col)
+        preds = self._predict(x)
+        out = []
+        for r, p in zip(data, np.asarray(preds)):
+            r2 = dict(r)
+            r2[self.prediction_col] = p
+            out.append(r2)
+        return out
+
+
+class DLClassifier(DLEstimator):
+    """DLEstimator with scalar class labels and argmax predictions
+    (≙ dlframes/DLClassifier.scala)."""
+
+    def __init__(self, model: Module, criterion, feature_size,
+                 optim_method=None, mesh=None):
+        super().__init__(model, criterion, feature_size, (),
+                         optim_method=optim_method, mesh=mesh)
+
+    def _wrap_model(self, model):
+        m = DLClassifierModel(model, self.feature_size)
+        m.batch_size = self.batch_size
+        m.features_col = self.features_col
+        m.prediction_col = self.prediction_col
+        return m
+
+
+class DLClassifierModel(DLModel):
+    """≙ dlframes/DLClassifier.scala DLClassifierModel: prediction is the
+    1-based argmax class, like the reference's ClassNLL convention."""
+
+    def _predict(self, x):
+        x = x.reshape((-1,) + self.feature_size).astype(np.float32)
+        return O.Predictor(self.model, batch_size=self.batch_size) \
+            .predict_class(x)
+
+
+class DLImageReader:
+    """Read an image folder into rows of ImageFeatures
+    (≙ dlframes/DLImageReader.scala readImages)."""
+
+    @staticmethod
+    def read_images(path: str, scale_to: Optional[int] = None) -> List[Dict]:
+        from ..data.imageframe import ImageFrame
+        frame = ImageFrame.read(path, scale_to=scale_to)
+        return [{"image": f, "uri": f.get(ImageFeature.URI)}
+                for f in frame]
+
+
+class DLImageTransformer:
+    """Apply a vision FeatureTransformer to the 'image' column
+    (≙ dlframes/DLImageTransformer.scala)."""
+
+    def __init__(self, transformer: FeatureTransformer):
+        self.transformer = transformer
+
+    def transform(self, rows: List[Dict], input_col="image",
+                  output_col="output") -> List[Dict]:
+        out = []
+        for r in rows:
+            r2 = dict(r)
+            r2[output_col] = self.transformer.transform(r[input_col])
+            out.append(r2)
+        return out

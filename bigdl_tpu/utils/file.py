@@ -1,0 +1,29 @@
+"""Object persistence (≙ utils/File.scala save/load).
+
+The reference serializes to local/HDFS paths via java serialization; ours
+pickles with device arrays converted to host numpy first (a checkpoint must
+never capture live device buffers)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+
+def save(obj, path: str, is_overwrite: bool = True):
+    if os.path.exists(path) and not is_overwrite:
+        raise FileExistsError(path)
+    host = jax.tree_util.tree_map(
+        lambda v: np.asarray(v) if isinstance(v, jax.Array) else v, obj,
+        is_leaf=lambda v: isinstance(v, jax.Array))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
+    os.replace(tmp, path)  # atomic: no torn checkpoints on crash
+
+
+def load(path: str):
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,138 @@
+"""Directed graph (≙ utils/DirectedGraph.scala, Node.scala, Edge.scala).
+
+Backs the nn Graph container's topology queries; also usable standalone.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Callable, Iterator, List, Optional
+
+
+class Edge:
+    """≙ utils/Edge.scala — optional from-index for multi-output nodes."""
+
+    def __init__(self, from_index: Optional[int] = None):
+        self.from_index = from_index
+
+    def new_instance(self):
+        return Edge(self.from_index)
+
+
+class Node:
+    """≙ utils/Node.scala — element holder with prev/next edge lists."""
+
+    def __init__(self, element: Any = None):
+        self.element = element
+        self.prevs: List[tuple] = []   # (node, edge)
+        self.nexts: List[tuple] = []
+
+    def add(self, node: "Node", edge: Optional[Edge] = None) -> "Node":
+        """self -> node."""
+        e = edge or Edge()
+        self.nexts.append((node, e))
+        node.prevs.append((self, e))
+        return node
+
+    def delete(self, node: "Node", edge: Optional[Edge] = None) -> "Node":
+        self.nexts = [(n, e) for n, e in self.nexts
+                      if not (n is node and (edge is None or e is edge))]
+        node.prevs = [(n, e) for n, e in node.prevs
+                      if not (n is self and (edge is None or e is edge))]
+        return self
+
+    def prev_nodes(self) -> List["Node"]:
+        return [n for n, _ in self.prevs]
+
+    def next_nodes(self) -> List["Node"]:
+        return [n for n, _ in self.nexts]
+
+    def remove_prev_edges(self):
+        for n, e in list(self.prevs):
+            n.nexts = [(m, ee) for m, ee in n.nexts if ee is not e]
+        self.prevs = []
+        return self
+
+    def __repr__(self):
+        return f"Node({self.element!r})"
+
+
+class DirectedGraph:
+    """≙ utils/DirectedGraph.scala — rooted graph with BFS/DFS/topo-sort.
+
+    `reverse=True` means edges point child->parent (the reference uses this
+    for backward graphs)."""
+
+    def __init__(self, source: Node, reverse: bool = False):
+        self.source = source
+        self.reverse = reverse
+
+    def _outgoing(self, node: Node) -> List[Node]:
+        return node.prev_nodes() if self.reverse else node.next_nodes()
+
+    def _incoming(self, node: Node) -> List[Node]:
+        return node.next_nodes() if self.reverse else node.prev_nodes()
+
+    def size(self) -> int:
+        return sum(1 for _ in self.bfs())
+
+    def edges(self) -> int:
+        return sum(len(self._outgoing(n)) for n in self.bfs())
+
+    def bfs(self) -> Iterator[Node]:
+        seen = {id(self.source)}
+        q = deque([self.source])
+        while q:
+            n = q.popleft()
+            yield n
+            for m in self._outgoing(n):
+                if id(m) not in seen:
+                    seen.add(id(m))
+                    q.append(m)
+
+    def dfs(self) -> Iterator[Node]:
+        seen = set()
+        stack = [self.source]
+        while stack:
+            n = stack.pop()
+            if id(n) in seen:
+                continue
+            seen.add(id(n))
+            yield n
+            for m in self._outgoing(n):
+                stack.append(m)
+
+    def topology_sort(self) -> List[Node]:
+        """Source-first order; raises on cycles (≙ topologySort)."""
+        nodes = list(self.bfs())
+        node_set = {id(n) for n in nodes}
+        indegree = {id(n): sum(1 for p in self._incoming(n)
+                               if id(p) in node_set)
+                    for n in nodes}
+        ready = deque(n for n in nodes if indegree[id(n)] == 0)
+        out = []
+        while ready:
+            n = ready.popleft()
+            out.append(n)
+            for m in self._outgoing(n):
+                if id(m) in indegree:
+                    indegree[id(m)] -= 1
+                    if indegree[id(m)] == 0:
+                        ready.append(m)
+        if len(out) != len(nodes):
+            raise ValueError("graph contains a cycle")
+        return out
+
+    def clone_graph(self, reverse_edge: bool = False) -> "DirectedGraph":
+        mapping = {}
+        for n in self.bfs():
+            mapping[id(n)] = Node(n.element)
+        for n in self.bfs():
+            for m, e in n.nexts:
+                if id(m) in mapping:
+                    if reverse_edge:
+                        mapping[id(m)].add(mapping[id(n)], e.new_instance())
+                    else:
+                        mapping[id(n)].add(mapping[id(m)], e.new_instance())
+        return DirectedGraph(mapping[id(self.source)],
+                             reverse=self.reverse != reverse_edge
+                             if reverse_edge else self.reverse)

@@ -1,0 +1,67 @@
+"""Random generator (≙ utils/RandomGenerator.scala RNG).
+
+The reference keeps a global mersenne-twister RNG with distribution
+helpers; host-side code (data augmentation, init fallbacks) uses this.
+Device-side randomness stays with jax.random keys — this is the HOST rng.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+
+class RandomGenerator:
+    def __init__(self, seed: int = 1):
+        self._rng = np.random.RandomState(seed)
+        self._seed = seed
+
+    def set_seed(self, seed: int):
+        self._seed = seed
+        self._rng = np.random.RandomState(seed)
+        return self
+
+    def get_seed(self) -> int:
+        return self._seed
+
+    def uniform(self, a: float = 0.0, b: float = 1.0, size=None):
+        return self._rng.uniform(a, b, size)
+
+    def normal(self, mean: float = 0.0, stdv: float = 1.0, size=None):
+        return self._rng.normal(mean, stdv, size)
+
+    def exponential(self, lam: float = 1.0, size=None):
+        return self._rng.exponential(1.0 / lam, size)
+
+    def cauchy(self, median: float = 0.0, sigma: float = 1.0, size=None):
+        return median + sigma * np.tan(
+            np.pi * (self._rng.uniform(size=size) - 0.5))
+
+    def log_normal(self, mean: float = 1.0, stdv: float = 2.0, size=None):
+        return self._rng.lognormal(mean, stdv, size)
+
+    def geometric(self, p: float = 0.5, size=None):
+        return self._rng.geometric(p, size)
+
+    def bernoulli(self, p: float = 0.5, size=None):
+        return (self._rng.uniform(size=size) < p).astype(np.float64)
+
+    def random(self, size=None):
+        return self._rng.randint(0, 2 ** 31 - 1, size)
+
+    def permutation(self, n: int):
+        return self._rng.permutation(n)
+
+    def shuffle(self, arr):
+        self._rng.shuffle(arr)
+        return arr
+
+
+_local = threading.local()
+
+
+def RNG() -> RandomGenerator:
+    """Thread-local global generator (≙ RandomGenerator.RNG)."""
+    if not hasattr(_local, "rng"):
+        _local.rng = RandomGenerator()
+    return _local.rng

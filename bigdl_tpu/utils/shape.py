@@ -1,0 +1,72 @@
+"""Shape types (≙ utils/Shape.scala: SingleShape, MultiShape)."""
+from __future__ import annotations
+
+from typing import List, Sequence, Union
+
+
+class Shape:
+    @staticmethod
+    def of(*dims):
+        if len(dims) == 1 and isinstance(dims[0], (list, tuple)):
+            inner = dims[0]
+            if inner and isinstance(inner[0], (Shape, list, tuple)):
+                return MultiShape([Shape.of(s) if not isinstance(s, Shape)
+                                   else s for s in inner])
+            return SingleShape(list(inner))
+        return SingleShape(list(dims))
+
+    def to_single(self) -> "SingleShape":
+        raise NotImplementedError
+
+    def to_multi(self) -> List["Shape"]:
+        raise NotImplementedError
+
+
+class SingleShape(Shape):
+    def __init__(self, dims: Sequence[int]):
+        self._dims = list(dims)
+
+    def to_single(self):
+        return self
+
+    def to_multi(self):
+        return [self]
+
+    def to_tuple(self):
+        return tuple(self._dims)
+
+    def __getitem__(self, i):
+        return self._dims[i]
+
+    def __len__(self):
+        return len(self._dims)
+
+    def __eq__(self, other):
+        return isinstance(other, SingleShape) and other._dims == self._dims \
+            or isinstance(other, (list, tuple)) and list(other) == self._dims
+
+    def __repr__(self):
+        return f"SingleShape({self._dims})"
+
+
+class MultiShape(Shape):
+    def __init__(self, shapes: Sequence[Shape]):
+        self._shapes = list(shapes)
+
+    def to_single(self):
+        raise ValueError("MultiShape holds several shapes")
+
+    def to_multi(self):
+        return list(self._shapes)
+
+    def __getitem__(self, i):
+        return self._shapes[i]
+
+    def __len__(self):
+        return len(self._shapes)
+
+    def __eq__(self, other):
+        return isinstance(other, MultiShape) and other._shapes == self._shapes
+
+    def __repr__(self):
+        return f"MultiShape({self._shapes})"

@@ -1,0 +1,156 @@
+"""frames (DLEstimator/DLClassifier) + utils (Engine, DirectedGraph, Shape,
+RandomGenerator, File) tests (≙ dlframes *Spec.scala, utils *Spec.scala)."""
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.frames import (DLEstimator, DLClassifier, DLModel,
+                              DLImageTransformer)
+from bigdl_tpu.utils import engine, file as file_util
+from bigdl_tpu.utils.graph import Node, Edge, DirectedGraph
+from bigdl_tpu.utils.shape import Shape, SingleShape, MultiShape
+from bigdl_tpu.utils.random_generator import RandomGenerator, RNG
+
+
+# --------------------------------------------------------------------- #
+# frames                                                                #
+# --------------------------------------------------------------------- #
+def _regression_rows(n=128, d=6, seed=0):
+    rs = np.random.RandomState(seed)
+    x = rs.randn(n, d).astype(np.float32)
+    w = rs.randn(d, 1).astype(np.float32)
+    y = x @ w
+    return [{"features": x[i], "label": y[i]} for i in range(n)], x, y
+
+
+def test_dl_estimator_fit_transform():
+    rows, x, y = _regression_rows()
+    model = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 1))
+    est = (DLEstimator(model, nn.MSECriterion(), [6], [1])
+           .set_batch_size(32).set_max_epoch(30).set_learning_rate(0.01))
+    dlm = est.fit(rows)
+    out = dlm.transform(rows)
+    assert "prediction" in out[0]
+    preds = np.stack([r["prediction"] for r in out])
+    resid = np.abs(preds.reshape(-1) - y.reshape(-1)).mean()
+    assert resid < 0.5 * np.abs(y).mean()
+
+
+def test_dl_classifier_fit_predict_classes():
+    rs = np.random.RandomState(0)
+    x = rs.randn(192, 8).astype(np.float32)
+    w = rs.randn(8, 3).astype(np.float32)
+    y = (np.argmax(x @ w, 1) + 1).astype(np.float32)  # 1-based
+    rows = [{"features": x[i], "label": y[i]} for i in range(len(x))]
+    model = nn.Sequential(nn.Linear(8, 3), nn.LogSoftMax())
+    clf = (DLClassifier(model, nn.ClassNLLCriterion(), [8])
+           .set_batch_size(32).set_max_epoch(30).set_learning_rate(0.05))
+    m = clf.fit(rows)
+    out = m.transform(rows)
+    preds = np.asarray([r["prediction"] for r in out])
+    assert preds.min() >= 1 and preds.max() <= 3
+    assert (preds == y).mean() > 0.8
+
+
+def test_dl_image_transformer():
+    from bigdl_tpu.data.imageframe import ImageFeature, Resize
+    rows = [{"image": ImageFeature(np.ones((8, 10, 3), np.float32))}]
+    out = DLImageTransformer(Resize(4, 4)).transform(rows)
+    assert out[0]["output"].image.shape == (4, 4, 3)
+
+
+# --------------------------------------------------------------------- #
+# utils.engine                                                          #
+# --------------------------------------------------------------------- #
+def test_engine_init_and_pool():
+    engine.init(core_number=4)
+    assert engine.is_initialized()
+    assert engine.core_number() == 4
+    assert engine.device_count() >= 8  # virtual CPU mesh in conftest
+    results = engine.invoke([lambda i=i: i * i for i in range(5)])
+    assert results == [0, 1, 4, 9, 16]
+
+
+# --------------------------------------------------------------------- #
+# utils.graph                                                           #
+# --------------------------------------------------------------------- #
+def _diamond():
+    a, b, c, d = Node("a"), Node("b"), Node("c"), Node("d")
+    a.add(b); a.add(c); b.add(d); c.add(d)
+    return a, b, c, d
+
+
+def test_directed_graph_traversals():
+    a, b, c, d = _diamond()
+    g = DirectedGraph(a)
+    assert g.size() == 4
+    assert g.edges() == 4
+    names = [n.element for n in g.bfs()]
+    assert names[0] == "a" and set(names) == {"a", "b", "c", "d"}
+    topo = [n.element for n in g.topology_sort()]
+    assert topo.index("a") < topo.index("b") < topo.index("d")
+    assert topo.index("a") < topo.index("c") < topo.index("d")
+
+
+def test_directed_graph_cycle_raises():
+    a, b = Node("a"), Node("b")
+    a.add(b); b.add(a)
+    with pytest.raises(ValueError):
+        DirectedGraph(a).topology_sort()
+
+
+def test_directed_graph_reverse_and_clone():
+    a, b, c, d = _diamond()
+    g = DirectedGraph(d, reverse=True)
+    assert g.size() == 4  # reaches everything following prev edges
+    clone = DirectedGraph(a).clone_graph()
+    assert clone.size() == 4
+    assert clone.source is not a
+    # edits to the clone don't touch the original
+    clone.source.nexts.clear()
+    assert DirectedGraph(a).size() == 4
+
+
+def test_node_delete():
+    a, b, c, d = _diamond()
+    a.delete(b)
+    assert DirectedGraph(a).size() == 3  # a, c, d
+
+
+# --------------------------------------------------------------------- #
+# utils.shape / random / file                                           #
+# --------------------------------------------------------------------- #
+def test_shapes():
+    s = Shape.of(2, 3, 4)
+    assert isinstance(s, SingleShape)
+    assert s.to_tuple() == (2, 3, 4)
+    assert s == [2, 3, 4]
+    m = Shape.of([(2, 3), (4,)])
+    assert isinstance(m, MultiShape)
+    assert len(m.to_multi()) == 2
+    with pytest.raises(ValueError):
+        m.to_single()
+
+
+def test_random_generator():
+    g = RandomGenerator(7)
+    u = g.uniform(0, 1, 1000)
+    assert 0 <= u.min() and u.max() <= 1
+    b = g.bernoulli(0.3, 10000)
+    assert abs(b.mean() - 0.3) < 0.03
+    g2 = RandomGenerator(7)
+    np.testing.assert_array_equal(RandomGenerator(3).permutation(10),
+                                  RandomGenerator(3).permutation(10))
+    assert RNG() is RNG()  # thread-local singleton
+
+
+def test_file_save_load_with_device_arrays(tmp_path):
+    import jax.numpy as jnp
+    path = str(tmp_path / "obj.bin")
+    obj = {"params": jnp.ones((3, 3)), "step": 7, "name": "m"}
+    file_util.save(obj, path)
+    back = file_util.load(path)
+    assert isinstance(back["params"], np.ndarray)  # detached from device
+    np.testing.assert_allclose(back["params"], 1.0)
+    with pytest.raises(FileExistsError):
+        file_util.save(obj, path, is_overwrite=False)
