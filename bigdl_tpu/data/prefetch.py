@@ -1,0 +1,96 @@
+"""Pipeline prefetch (≙ the reference's MTLabeledBGRImgToBatch + Engine
+thread-pool overlap of IO/augmentation with compute).
+
+`PrefetchedDataSet` wraps any DataSet and materializes up to `depth`
+batches ahead on a background thread, so host augmentation overlaps the
+TPU step.  `FileRecordDataSet` streams fixed-length records through the
+C++ native prefetcher (bigdl_tpu.native) when built.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from .dataset import DataSet
+from .minibatch import MiniBatch
+
+_END = object()
+
+
+class PrefetchedDataSet(DataSet):
+    def __init__(self, base: DataSet, depth: int = 2):
+        self.base = base
+        self.depth = depth
+
+    def size(self):
+        return self.base.size()
+
+    def shuffle(self):
+        self.base.shuffle()
+        return self
+
+    def batches_per_epoch(self):
+        return getattr(self.base, "batches_per_epoch", lambda: None)()
+
+    def data(self, train=True):
+        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        error = []
+
+        def producer():
+            try:
+                for item in self.base.data(train):
+                    q.put(item)
+            except BaseException as e:  # surfaced on the consumer side
+                error.append(e)
+            finally:
+                q.put(_END)
+
+        t = threading.Thread(target=producer, daemon=True,
+                             name="bigdl-prefetch")
+        t.start()
+        while True:
+            item = q.get()
+            if item is _END:
+                if error:
+                    raise error[0]
+                return
+            yield item
+
+
+class FileRecordDataSet(DataSet):
+    """Fixed-length records from shard files via the native prefetcher;
+    `decode(record_bytes) -> Sample|MiniBatch|array` runs on the consumer
+    thread (≙ LocalSeqFileToBytes + BytesToBGRImg head of the reference
+    ImageNet pipeline)."""
+
+    def __init__(self, paths: Sequence[str], record_bytes: int,
+                 decode: Callable[[bytes], object],
+                 header_bytes: int = 0, capacity: int = 64,
+                 n_workers: int = 2):
+        self.paths = list(paths)
+        self.record_bytes = record_bytes
+        self.decode = decode
+        self.header_bytes = header_bytes
+        self.capacity = capacity
+        self.n_workers = n_workers
+        import os
+        self._n = sum(
+            max(0, (os.path.getsize(p) - header_bytes) // record_bytes)
+            for p in self.paths)
+
+    def size(self):
+        return self._n
+
+    def data(self, train=True):
+        from ..native import NativePrefetcher
+        pf = NativePrefetcher(self.paths, self.record_bytes,
+                              self.header_bytes, self.capacity,
+                              self.n_workers, loop=False)
+        try:
+            for rec in pf:
+                yield self.decode(rec)
+        finally:
+            pf.close()

@@ -6,8 +6,8 @@ import struct
 
 import numpy as np
 
-from bigdl_tpu.visualization import (TrainSummary, ValidationSummary,
-                                     crc32c, masked_crc32c)
+from bigdl_tpu.visualization import TrainSummary, ValidationSummary
+from bigdl_tpu.utils.crc32c import crc32c, masked_crc32c
 from bigdl_tpu.utils.crc32c import unmask
 from bigdl_tpu.visualization import event_writer
 from bigdl_tpu.utils import proto
