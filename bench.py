@@ -6,6 +6,14 @@ Baseline: published BigDL ResNet-50 throughput on a dual-socket Xeon node
 is ~57 img/s (BigDL whitepaper-era numbers, fp32 MKL); vs_baseline is
 ours / 57.
 
+Timing methodology: the device is reached through a network tunnel whose
+round-trip latency (70-250 ms) dwarfs a single step and whose
+block_until_ready does not reliably await remote completion, so K train
+steps run inside ONE jitted lax.scan (params threaded through the loop so
+nothing can be hoisted) and the wall time of that single call — minus the
+separately measured round-trip latency — is divided by K.  A host
+transfer of the summed losses is the synchronization point.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 import json
@@ -14,12 +22,23 @@ import time
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 
 BASELINE_IMG_PER_SEC = 57.0  # reference Xeon-node ResNet-50 throughput
-BATCH = 32
-WARMUP = 3
-ITERS = 10
+BATCH = 256
+K = 20        # train steps fused into one device call
+TRIALS = 3
+
+
+def _roundtrip_latency():
+    ones = jnp.ones(4)
+    lat = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(jnp.sum(ones))
+        lat.append(time.perf_counter() - t0)
+    return float(np.median(lat))
 
 
 def main():
@@ -34,28 +53,33 @@ def main():
 
     params, state = model.init_params(0)
     opt_state = method.init_state(params)
-    step = jax.jit(
-        make_train_step(model, criterion, method, mixed_precision=True),
-        donate_argnums=(0, 1, 2))
+    step = make_train_step(model, criterion, method, mixed_precision=True)
+
+    @jax.jit
+    def many_steps(params, opt_state, state, x, y, key):
+        def body(carry, i):
+            p, o, s = carry
+            p, o, s, loss = step(p, o, s, x, y, jax.random.fold_in(key, i))
+            return (p, o, s), loss
+        return lax.scan(body, (params, opt_state, state), jnp.arange(K))
 
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(BATCH, 3, 224, 224).astype(np.float32))
     y = jnp.asarray(rng.randint(1, 1001, BATCH).astype(np.float32))
     key = jax.random.PRNGKey(0)
 
-    for _ in range(WARMUP):
-        params, opt_state, state, loss = step(params, opt_state, state, x, y,
-                                              key)
-    jax.block_until_ready(loss)
+    carry, losses = many_steps(params, opt_state, state, x, y, key)  # compile
+    float(jnp.sum(losses))
+    lat = _roundtrip_latency()
 
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        params, opt_state, state, loss = step(params, opt_state, state, x, y,
-                                              key)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
+    per_step = []
+    for _ in range(TRIALS):
+        t0 = time.perf_counter()
+        carry, losses = many_steps(*carry, x, y, key)
+        float(jnp.sum(losses))  # host transfer = true sync
+        per_step.append((time.perf_counter() - t0 - lat) / K)
 
-    img_per_sec = BATCH * ITERS / dt
+    img_per_sec = BATCH / float(np.median(per_step))
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_per_sec, 2),

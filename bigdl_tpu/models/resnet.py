@@ -24,9 +24,16 @@ class ShortcutType:
 
 
 class _Builder:
-    def __init__(self, shortcut_type=ShortcutType.B):
+    def __init__(self, shortcut_type=ShortcutType.B, format="NCHW"):
         self.i_channels = 0
         self.shortcut_type = shortcut_type
+        self.format = format
+
+    def conv(self, *a, **kw):
+        return SpatialConvolution(*a, format=self.format, **kw)
+
+    def bn(self, n):
+        return SpatialBatchNormalization(n, format=self.format)
 
     def shortcut(self, n_input, n_output, stride):
         use_conv = (self.shortcut_type == ShortcutType.C
@@ -34,29 +41,32 @@ class _Builder:
                         and n_input != n_output))
         if use_conv:
             return Sequential(
-                SpatialConvolution(n_input, n_output, 1, 1, stride, stride,
-                                   with_bias=False),
-                SpatialBatchNormalization(n_output))
+                self.conv(n_input, n_output, 1, 1, stride, stride,
+                          with_bias=False),
+                self.bn(n_output))
         if n_input != n_output:
             # type A: strided identity + zero pad channels
             from ..nn import Padding
             return Sequential(
-                SpatialAveragePooling(1, 1, stride, stride),
-                Padding(1, n_output - n_input, 3))
+                SpatialAveragePooling(1, 1, stride, stride,
+                                      format=self.format),
+                Padding(1, n_output - n_input,
+                        3 if self.format == "NCHW" else 4))
         if stride != 1:
-            return SpatialAveragePooling(1, 1, stride, stride)
+            return SpatialAveragePooling(1, 1, stride, stride,
+                                         format=self.format)
         return Identity()
 
     def basic_block(self, n, stride):
         n_input = self.i_channels
         self.i_channels = n
         main = Sequential(
-            SpatialConvolution(n_input, n, 3, 3, stride, stride, 1, 1,
-                               with_bias=False),
-            SpatialBatchNormalization(n),
+            self.conv(n_input, n, 3, 3, stride, stride, 1, 1,
+                      with_bias=False),
+            self.bn(n),
             ReLU(),
-            SpatialConvolution(n, n, 3, 3, 1, 1, 1, 1, with_bias=False),
-            SpatialBatchNormalization(n))
+            self.conv(n, n, 3, 3, 1, 1, 1, 1, with_bias=False),
+            self.bn(n))
         return Sequential(
             ConcatTable(main, self.shortcut(n_input, n, stride)),
             CAddTable(),
@@ -66,15 +76,14 @@ class _Builder:
         n_input = self.i_channels
         self.i_channels = n * 4
         main = Sequential(
-            SpatialConvolution(n_input, n, 1, 1, 1, 1, with_bias=False),
-            SpatialBatchNormalization(n),
+            self.conv(n_input, n, 1, 1, 1, 1, with_bias=False),
+            self.bn(n),
             ReLU(),
-            SpatialConvolution(n, n, 3, 3, stride, stride, 1, 1,
-                               with_bias=False),
-            SpatialBatchNormalization(n),
+            self.conv(n, n, 3, 3, stride, stride, 1, 1, with_bias=False),
+            self.bn(n),
             ReLU(),
-            SpatialConvolution(n, n * 4, 1, 1, 1, 1, with_bias=False),
-            SpatialBatchNormalization(n * 4))
+            self.conv(n, n * 4, 1, 1, 1, 1, with_bias=False),
+            self.bn(n * 4))
         return Sequential(
             ConcatTable(main, self.shortcut(n_input, n * 4, stride)),
             CAddTable(),
@@ -99,9 +108,11 @@ _IMAGENET_CFG = {
 
 
 def build(class_num=1000, depth=50, shortcut_type=ShortcutType.B,
-          dataset="imagenet", with_logsoftmax=True):
-    """≙ ResNet.apply (ResNet.scala:240)."""
-    b = _Builder(shortcut_type)
+          dataset="imagenet", with_logsoftmax=True, format="NCHW"):
+    """≙ ResNet.apply (ResNet.scala:240).  format='NHWC' builds the
+    TPU-preferred channels-last variant (identical math; feed NHWC
+    inputs)."""
+    b = _Builder(shortcut_type, format=format)
     model = Sequential(name=f"ResNet{depth}_{dataset}")
     if dataset == "imagenet":
         cfg = _IMAGENET_CFG[depth]
@@ -109,31 +120,32 @@ def build(class_num=1000, depth=50, shortcut_type=ShortcutType.B,
         block = b.bottleneck if kind == "bottleneck" else b.basic_block
         b.i_channels = 64
         (model
-         .add(SpatialConvolution(3, 64, 7, 7, 2, 2, 3, 3, with_bias=False,
-                                 name="conv1"))
-         .add(SpatialBatchNormalization(64))
+         .add(b.conv(3, 64, 7, 7, 2, 2, 3, 3, with_bias=False,
+                     name="conv1" if format == "NCHW" else None))
+         .add(b.bn(64))
          .add(ReLU())
-         .add(SpatialMaxPooling(3, 3, 2, 2, 1, 1))
+         .add(SpatialMaxPooling(3, 3, 2, 2, 1, 1, format=format))
          .add(b.layer(block, 64, c1))
          .add(b.layer(block, 128, c2, 2))
          .add(b.layer(block, 256, c3, 2))
          .add(b.layer(block, 512, c4, 2))
-         .add(SpatialAveragePooling(7, 7, 1, 1))
+         .add(SpatialAveragePooling(7, 7, 1, 1, format=format))
          .add(View(n_features))
-         .add(Linear(n_features, class_num, name="fc1000")))
+         .add(Linear(n_features, class_num,
+                     name="fc1000" if format == "NCHW" else None)))
     elif dataset == "cifar10":
         if (depth - 2) % 6 != 0:
             raise ValueError("CIFAR-10 ResNet depth must be 6n+2")
         n = (depth - 2) // 6
         b.i_channels = 16
         (model
-         .add(SpatialConvolution(3, 16, 3, 3, 1, 1, 1, 1, with_bias=False))
-         .add(SpatialBatchNormalization(16))
+         .add(b.conv(3, 16, 3, 3, 1, 1, 1, 1, with_bias=False))
+         .add(b.bn(16))
          .add(ReLU())
          .add(b.layer(b.basic_block, 16, n))
          .add(b.layer(b.basic_block, 32, n, 2))
          .add(b.layer(b.basic_block, 64, n, 2))
-         .add(SpatialAveragePooling(8, 8, 1, 1))
+         .add(SpatialAveragePooling(8, 8, 1, 1, format=format))
          .add(View(64))
          .add(Linear(64, class_num)))
     else:

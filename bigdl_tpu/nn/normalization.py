@@ -21,6 +21,65 @@ from jax import lax
 from .module import Module
 
 
+from functools import partial as _partial
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(x, gamma, beta, channel_axis, eps):
+    """Training-mode BN core with a hand-fused backward.
+
+    Autodiff of the mean/var formulation sweeps the activations ~5 times in
+    the backward; the classic closed-form BN gradient needs 2 (one fused
+    reduction pass for dbeta/dgamma, one elementwise pass for dx).  BN is
+    HBM-bound, so passes are the whole cost on TPU.
+    Returns (y, mean, var); mean/var feed running stats only (their
+    cotangents are treated as zero — running stats are aux state, never
+    differentiated)."""
+    y, mean, var, _ = _bn_train_fwd_impl(x, gamma, beta, channel_axis, eps)
+    return y, mean, var
+
+
+def _bn_train_fwd_impl(x, gamma, beta, channel_axis, eps):
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+    m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
+    var = jnp.maximum(m2 - jnp.square(mean), 0.0)
+    inv = lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    scale = (gamma * inv).reshape(shape).astype(x.dtype)
+    shift = (beta - gamma * mean * inv).reshape(shape).astype(x.dtype)
+    y = x * scale + shift
+    return y, mean, var, (x, gamma, mean, inv)
+
+
+def _bn_train_fwd(x, gamma, beta, channel_axis, eps):
+    y, mean, var, res = _bn_train_fwd_impl(x, gamma, beta, channel_axis, eps)
+    return (y, mean, var), res
+
+
+def _bn_train_bwd(channel_axis, eps, res, cts):
+    dy, _dmean, _dvar = cts  # mean/var cotangents: aux-only, zero
+    x, gamma, mean, inv = res
+    axes = tuple(i for i in range(x.ndim) if i != channel_axis)
+    n = x.size // x.shape[channel_axis]
+    shape = [1] * x.ndim
+    shape[channel_axis] = x.shape[channel_axis]
+    mean_b = mean.reshape(shape)
+    inv_b = inv.reshape(shape)
+    dy32 = dy.astype(jnp.float32)
+    xhat = (x.astype(jnp.float32) - mean_b) * inv_b
+    dbeta = jnp.sum(dy32, axis=axes)
+    dgamma = jnp.sum(dy32 * xhat, axis=axes)
+    coef = (gamma * inv).reshape(shape)
+    dx = coef * (dy32 - (dbeta.reshape(shape)
+                         + xhat * dgamma.reshape(shape)) / n)
+    return dx.astype(x.dtype), dgamma, dbeta
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
 class BatchNormalization(Module):
     """BN over (B, C) or (B, C, ...) with stats on all non-channel dims
     (nn/BatchNormalization.scala — channel dim is 2nd, i.e. axis 1)."""
@@ -57,19 +116,28 @@ class BatchNormalization(Module):
     def apply(self, params, x, ctx):
         st = ctx.get_state(self)
         axes = tuple(i for i in range(x.ndim) if i != self.channel_axis)
+        if ctx.training and self.sync_axis is None:
+            # fast path: custom-vjp BN (2-pass hand-fused backward)
+            if self.affine:
+                p = self.own(params)
+                gamma = p["weight"].astype(jnp.float32)
+                beta = p["bias"].astype(jnp.float32)
+            else:
+                gamma = jnp.ones((x.shape[self.channel_axis],), jnp.float32)
+                beta = jnp.zeros((x.shape[self.channel_axis],), jnp.float32)
+            y, mean, var = _bn_train(x, gamma, beta, self.channel_axis,
+                                     self.eps)
+            self._update_running(ctx, st, mean, var, x)
+            return y
         if ctx.training:
-            mean = jnp.mean(x.astype(jnp.float32), axis=axes)
-            var = jnp.var(x.astype(jnp.float32), axis=axes)
-            if self.sync_axis is not None:
-                mean = lax.pmean(mean, self.sync_axis)
-                var = lax.pmean(var, self.sync_axis)
-            m = self.momentum
-            n = x.size // x.shape[self.channel_axis]
-            unbiased = var * n / max(n - 1, 1)
-            ctx.put_state(self, {
-                "running_mean": (1 - m) * st["running_mean"] + m * mean,
-                "running_var": (1 - m) * st["running_var"] + m * unbiased,
-            })
+            # sync BN: stats pmean'ed over the mesh axis; autodiff backward
+            # (the collective must appear in the grad graph too)
+            mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+            m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
+            var = jnp.maximum(m2 - jnp.square(mean), 0.0)
+            mean = lax.pmean(mean, self.sync_axis)
+            var = lax.pmean(var, self.sync_axis)
+            self._update_running(ctx, st, mean, var, x)
         else:
             mean, var = st["running_mean"], st["running_var"]
         shape = [1] * x.ndim
@@ -83,9 +151,28 @@ class BatchNormalization(Module):
         return (x * scale.reshape(shape).astype(x.dtype)
                 + shift.reshape(shape).astype(x.dtype))
 
+    def _update_running(self, ctx, st, mean, var, x):
+        m = self.momentum
+        n = x.size // x.shape[self.channel_axis]
+        unbiased = var * n / max(n - 1, 1)
+        ctx.put_state(self, {
+            "running_mean": (1 - m) * st["running_mean"]
+            + m * lax.stop_gradient(mean),
+            "running_var": (1 - m) * st["running_var"]
+            + m * lax.stop_gradient(unbiased),
+        })
+
 
 class SpatialBatchNormalization(BatchNormalization):
-    """nn/SpatialBatchNormalization.scala — BN over NCHW, per-channel."""
+    """nn/SpatialBatchNormalization.scala — BN over NCHW (or NHWC with
+    format='NHWC'), per-channel."""
+
+    def __init__(self, n_output, eps=1e-5, momentum=0.1, affine=True,
+                 sync_axis=None, format="NCHW", name=None):
+        super().__init__(n_output, eps=eps, momentum=momentum, affine=affine,
+                         sync_axis=sync_axis, name=name)
+        if format == "NHWC":
+            self.channel_axis = 3
 
 
 class LayerNormalization(Module):
