@@ -76,3 +76,9 @@ from .criterion import (ClassNLLCriterion, CrossEntropyCriterion,
                         TimeDistributedCriterion, TimeDistributedMaskCriterion,
                         TransformerCriterion)
 from . import ops
+# reference-name aliases (≙ nn/StaticGraph.scala, DynamicContainer.scala,
+# RNN.scala, InitializationMethod.scala): same concepts, bigdl_tpu names
+from .graph import Graph as StaticGraph
+from .containers import Container as DynamicContainer
+from .recurrent import RnnCell as RNN
+from .init import InitializationMethod

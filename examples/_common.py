@@ -1,0 +1,20 @@
+"""Shared example plumbing: CPU-safe jax setup + argument helper.
+
+Run any example with `python examples/<name>.py [--epochs N] [--batch N]`.
+On a machine with a TPU attached the examples use it; set
+JAX_PLATFORMS=cpu to force CPU.
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def parse_args(**defaults):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=defaults.get("epochs", 2))
+    p.add_argument("--batch", type=int, default=defaults.get("batch", 64))
+    p.add_argument("--data-dir", default=defaults.get("data_dir", "/tmp/data"))
+    p.add_argument("--lr", type=float, default=defaults.get("lr", 1e-3))
+    return p.parse_args()

@@ -1,0 +1,27 @@
+"""Train the MNIST autoencoder (≙ models/autoencoder/Train.scala:
+784 -> 32 -> 784 with MSE against the input)."""
+import numpy as np
+
+from _common import parse_args
+from bigdl_tpu import nn
+from bigdl_tpu.data import mnist
+from bigdl_tpu.models import autoencoder
+from bigdl_tpu.optim import LocalOptimizer, Adam, Trigger
+
+
+def main():
+    args = parse_args(epochs=3, batch=128, lr=1e-3)
+    (xtr, _), _ = mnist.load_data(args.data_dir)
+    x = xtr.astype(np.float32).reshape(len(xtr), -1) / 255.0
+
+    model = autoencoder.build(class_num=32)
+    opt = (LocalOptimizer(model, (x, x), nn.MSECriterion(),
+                          batch_size=args.batch)
+           .set_optim_method(Adam(learning_rate=args.lr))
+           .set_end_when(Trigger.max_epoch(args.epochs)))
+    opt.optimize()
+    print("final loss:", opt.state.loss)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Distributed sync-SGD over a device mesh (≙ models/resnet/
+TrainImageNet.scala on a Spark cluster -> DistriOptimizer on a Mesh).
+
+Runs on however many devices are visible; to try multi-chip semantics on a
+CPU-only machine:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/distributed_resnet.py
+"""
+import numpy as np
+import jax
+
+from _common import parse_args
+from bigdl_tpu import nn
+from bigdl_tpu.models import resnet
+from bigdl_tpu.optim import SGD, Trigger
+from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+from bigdl_tpu.parallel import mesh as mesh_lib
+
+
+def main():
+    args = parse_args(epochs=2, batch=None, lr=0.1)
+    n = len(jax.devices())
+    mesh = mesh_lib.create_mesh({"dp": n})
+    batch = args.batch or 32 * n
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(batch * 4, 3, 32, 32).astype(np.float32)
+    y = rs.randint(1, 11, batch * 4).astype(np.float32)
+
+    model = resnet.build(class_num=10, depth=20, dataset="cifar10")
+    opt = (DistriOptimizer(model, (x, y), nn.ClassNLLCriterion(),
+                           batch_size=batch, mesh=mesh,
+                           fsdp=True,        # params sharded (ZeRO-3-ish)
+                           compress="bf16")  # ≙ FP16CompressedTensor
+           .set_optim_method(SGD(learning_rate=args.lr, momentum=0.9))
+           .set_end_when(Trigger.max_epoch(args.epochs)))
+    opt.optimize()
+    print("metrics:", opt.metrics.summary())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""ML-pipeline style training (≙ pyspark dlframes example: DLClassifier
+fit on rows, transform adds predictions)."""
+import numpy as np
+
+from _common import parse_args
+from bigdl_tpu import nn
+from bigdl_tpu.frames import DLClassifier
+
+
+def main():
+    args = parse_args(epochs=20, batch=32, lr=0.05)
+    rs = np.random.RandomState(0)
+    x = rs.randn(256, 10).astype(np.float32)
+    w = rs.randn(10, 4).astype(np.float32)
+    y = (np.argmax(x @ w, 1) + 1).astype(np.float32)
+    rows = [{"features": x[i], "label": y[i]} for i in range(len(x))]
+
+    model = nn.Sequential(nn.Linear(10, 4), nn.LogSoftMax())
+    clf = (DLClassifier(model, nn.ClassNLLCriterion(), [10])
+           .set_batch_size(args.batch)
+           .set_max_epoch(args.epochs)
+           .set_learning_rate(args.lr))
+    fitted = clf.fit(rows)
+    out = fitted.transform(rows)
+    acc = np.mean([r["prediction"] == r["label"] for r in out])
+    print("train accuracy:", acc)
+
+
+if __name__ == "__main__":
+    main()

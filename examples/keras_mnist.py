@@ -1,0 +1,35 @@
+"""Keras-style MNIST CNN (≙ pyspark/bigdl/examples/lenet/lenet.py using
+the bigdl keras API)."""
+import numpy as np
+
+from _common import parse_args
+import bigdl_tpu.keras as K
+from bigdl_tpu.data import mnist
+
+
+def main():
+    args = parse_args(epochs=2, batch=128)
+    (xtr, ytr), (xte, yte) = mnist.load_data(args.data_dir)
+    xtr = (xtr.astype(np.float32).transpose(0, 3, 1, 2) / 255.0)
+    xte = (xte.astype(np.float32).transpose(0, 3, 1, 2) / 255.0)
+    ytr, yte = (ytr + 1).astype(np.float32), (yte + 1).astype(np.float32)
+
+    model = (K.Sequential()
+             .add(K.Convolution2D(16, 5, 5, activation="relu",
+                                  input_shape=(1, 28, 28)))
+             .add(K.MaxPooling2D())
+             .add(K.Convolution2D(32, 5, 5, activation="relu"))
+             .add(K.MaxPooling2D())
+             .add(K.Flatten())
+             .add(K.Dense(100, activation="relu"))
+             .add(K.Dense(10, activation="softmax")))
+    model.compile(optimizer="adam",
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+    model.fit(xtr, ytr, batch_size=args.batch, nb_epoch=args.epochs)
+    for method, result in model.evaluate(xte, yte):
+        print(type(method).__name__, result)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Train LeNet-5 on MNIST (≙ models/lenet/Train.scala +
+pyspark/bigdl/models/lenet/lenet5.py).
+
+Uses the real MNIST idx files if present under --data-dir, else the
+deterministic synthetic fallback.
+"""
+import numpy as np
+
+from _common import parse_args
+from bigdl_tpu import nn
+from bigdl_tpu.data import mnist
+from bigdl_tpu.models import lenet
+from bigdl_tpu.optim import (LocalOptimizer, SGD, Trigger, Top1Accuracy,
+                             Loss)
+from bigdl_tpu.optim.predictor import Evaluator
+
+
+def preprocess(x, y, mean, std):
+    x = (x.astype(np.float32).transpose(0, 3, 1, 2) - mean) / std
+    return x, (y + 1).astype(np.float32)  # 1-based labels
+
+
+def main():
+    args = parse_args(epochs=3, batch=128, lr=0.05)
+    (xtr, ytr), (xte, yte) = mnist.load_data(args.data_dir)
+    xtr, ytr = preprocess(xtr, ytr, mnist.TRAIN_MEAN, mnist.TRAIN_STD)
+    xte, yte = preprocess(xte, yte, mnist.TEST_MEAN, mnist.TEST_STD)
+
+    model = lenet.build(class_num=10)
+    opt = (LocalOptimizer(model, (xtr, ytr), nn.ClassNLLCriterion(),
+                          batch_size=args.batch)
+           .set_optim_method(SGD(learning_rate=args.lr, momentum=0.9))
+           .set_end_when(Trigger.max_epoch(args.epochs))
+           .set_validation(Trigger.every_epoch(), (xte, yte),
+                           [Top1Accuracy(), Loss(nn.ClassNLLCriterion())]))
+    model = opt.optimize()
+    res = Evaluator(model).test((xte, yte), [Top1Accuracy()])
+    print("final:", res[0][1])
+
+
+if __name__ == "__main__":
+    main()

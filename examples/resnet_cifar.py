@@ -1,0 +1,50 @@
+"""Train ResNet-20 on CIFAR-10 with the reference's augmentation recipe
+(≙ models/resnet/TrainCIFAR10.scala: pad-4 random crop + hflip +
+per-channel normalize, SGD momentum with a multi-step schedule).
+"""
+import numpy as np
+
+from _common import parse_args
+from bigdl_tpu import nn
+from bigdl_tpu.data import cifar
+from bigdl_tpu.data.dataset import DataSet
+from bigdl_tpu.data.image import (BytesToBGRImg, BGRImgNormalizer,
+                                  BGRImgRdmCropper, HFlip, BGRImgToBatch)
+from bigdl_tpu.models import resnet
+from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger, Top1Accuracy
+from bigdl_tpu.optim.predictor import Evaluator
+
+
+def main():
+    args = parse_args(epochs=2, batch=128, lr=0.1)
+    (xtr, ytr), (xte, yte) = cifar.load_data(args.data_dir)
+
+    # train pipeline: uint8 RGB CHW -> HWC BGR imgs -> augment -> batch
+    raws = [(np.transpose(x, (1, 2, 0))[..., ::-1].astype(np.float32),
+             float(y + 1)) for x, y in zip(xtr, ytr)]
+    train_ds = (DataSet.array(raws)
+                >> BytesToBGRImg()
+                >> BGRImgNormalizer(cifar.TRAIN_MEAN, cifar.TRAIN_STD)
+                >> BGRImgRdmCropper(32, 32, padding=4)
+                >> HFlip(0.5)
+                >> BGRImgToBatch(args.batch, to_rgb=True, drop_last=True))
+
+    xte_n = ((xte.astype(np.float32)
+              - np.asarray(cifar.TRAIN_MEAN)[::-1, None, None])
+             / np.asarray(cifar.TRAIN_STD)[::-1, None, None])
+    yte_1 = (yte + 1).astype(np.float32)
+
+    model = resnet.build(class_num=10, depth=20, dataset="cifar10")
+    opt = (LocalOptimizer(model, train_ds, nn.ClassNLLCriterion())
+           .set_optim_method(SGD(learning_rate=args.lr, momentum=0.9,
+                                 dampening=0.0, weight_decay=1e-4,
+                                 nesterov=True))
+           .set_end_when(Trigger.max_epoch(args.epochs)))
+    model = opt.optimize()
+    res = Evaluator(model, batch_size=256).test((xte_n, yte_1),
+                                               [Top1Accuracy()])
+    print("test:", res[0][1])
+
+
+if __name__ == "__main__":
+    main()

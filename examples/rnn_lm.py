@@ -1,0 +1,45 @@
+"""Character/word-level RNN language model (≙ models/rnn/Train.scala +
+pyspark rnn example: tokenize -> dictionary -> one-hot -> SimpleRNN ->
+next-word prediction)."""
+import numpy as np
+
+from _common import parse_args
+from bigdl_tpu import nn
+from bigdl_tpu.data import text as T
+from bigdl_tpu.data.dataset import DataSet, SampleToMiniBatch
+from bigdl_tpu.models import rnn
+from bigdl_tpu.optim import LocalOptimizer, Adagrad, Trigger
+
+CORPUS = ("the quick brown fox jumps over the lazy dog. "
+          "the cat sat on the mat. the dog ran after the cat. "
+          "a fox and a dog met a cat on the mat. ") * 8
+SEQ = 12
+
+
+def main():
+    args = parse_args(epochs=8, batch=16, lr=0.1)
+    pipe = (T.SentenceSplitter() >> T.SentenceTokenizer()
+            >> T.SentenceBiPadding())
+    sents = list(pipe([CORPUS]))
+    vocab = T.Dictionary(sents)
+    n_words = vocab.get_vocab_size() + 1  # +1 OOV bucket
+
+    samples = list((T.TextToLabeledSentence(vocab)
+                    >> T.LabeledSentenceToSample(
+                        vocab_length=n_words, fixed_data_length=SEQ,
+                        fixed_label_length=SEQ))(sents))
+    ds = DataSet.array(samples).transform(SampleToMiniBatch(args.batch))
+
+    model = rnn.build(input_size=n_words, hidden_size=40,
+                      output_size=n_words, with_softmax=True)
+    opt = (LocalOptimizer(model, ds,
+                          nn.TimeDistributedCriterion(
+                              nn.ClassNLLCriterion(), size_average=True))
+           .set_optim_method(Adagrad(learning_rate=args.lr))
+           .set_end_when(Trigger.max_epoch(args.epochs)))
+    opt.optimize()
+    print("final loss:", opt.state.loss)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Text classification on 20-Newsgroups with GloVe embeddings
+(≙ pyspark/bigdl/models/textclassifier/textclassifier.py: tokenize,
+embed with pretrained vectors, CNN or LSTM encoder, 20-way softmax).
+"""
+import numpy as np
+
+from _common import parse_args
+from bigdl_tpu import nn
+from bigdl_tpu.data import news20
+from bigdl_tpu.data.text import SentenceTokenizer
+from bigdl_tpu.optim import LocalOptimizer, Adam, Trigger, Top1Accuracy
+from bigdl_tpu.optim.predictor import Evaluator
+
+EMB_DIM = 50
+SEQ_LEN = 64
+
+
+def vectorize(texts, w2v):
+    tok = SentenceTokenizer()
+    xs, ys = [], []
+    zero = np.zeros(EMB_DIM, np.float32)
+    for text, label in texts:
+        words = tok.tokenize(text)[:SEQ_LEN]
+        vecs = [w2v.get(w, zero) for w in words]
+        vecs += [zero] * (SEQ_LEN - len(vecs))
+        xs.append(np.stack(vecs))
+        ys.append(label)
+    return (np.asarray(xs, np.float32),  # (N, SEQ, EMB)
+            np.asarray(ys, np.float32))
+
+
+def build_cnn(class_num):
+    """Temporal CNN encoder (≙ textclassifier's build_model cnn branch)."""
+    return nn.Sequential(
+        nn.TemporalConvolution(EMB_DIM, 128, 5),
+        nn.ReLU(),
+        nn.TemporalMaxPooling(SEQ_LEN - 5 + 1),
+        nn.Reshape((128,)),
+        nn.Linear(128, 100), nn.ReLU(),
+        nn.Linear(100, class_num), nn.LogSoftMax())
+
+
+def main():
+    args = parse_args(epochs=10, batch=32, lr=1e-3)
+    texts = news20.get_news20(args.data_dir)
+    w2v = news20.get_glove_w2v(args.data_dir, dim=EMB_DIM)
+    x, y = vectorize(texts, w2v)
+    idx = np.random.RandomState(0).permutation(len(x))
+    split = int(len(x) * 0.8)
+    tr, te = idx[:split], idx[split:]
+
+    model = build_cnn(news20.CLASS_NUM)
+    opt = (LocalOptimizer(model, (x[tr], y[tr]), nn.ClassNLLCriterion(),
+                          batch_size=args.batch)
+           .set_optim_method(Adam(learning_rate=args.lr))
+           .set_end_when(Trigger.max_epoch(args.epochs)))
+    model = opt.optimize()
+    res = Evaluator(model).test((x[te], y[te]), [Top1Accuracy()])
+    print("test:", res[0][1])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""TransformerLM flagship: dp x fsdp x tp x sp SPMD training with ring
+attention for long context (the beyond-reference-scale path; the
+reference's distributed ceiling was Spark data parallel).
+
+On a CPU box: XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+    JAX_PLATFORMS=cpu python examples/transformer_spmd.py
+"""
+import numpy as np
+import jax
+
+from _common import parse_args
+from bigdl_tpu.models import transformer as T
+from bigdl_tpu.optim import AdamW
+from bigdl_tpu.parallel import mesh as mesh_lib
+from bigdl_tpu.parallel.spmd import SpmdTrainer
+
+
+def main():
+    args = parse_args(epochs=1, lr=3e-4)
+    n = len(jax.devices())
+    if n % 8 == 0:
+        axes = {"dp": n // 8, "fsdp": 2, "tp": 2, "sp": 2}
+    elif n % 4 == 0:
+        axes = {"dp": n // 4, "tp": 2, "sp": 2}
+    else:
+        axes = {"dp": n}
+    mesh = mesh_lib.create_mesh(axes)
+    print("mesh:", dict(mesh.shape))
+
+    model = T.build("tiny", use_ring_attention=axes.get("sp", 1) > 1,
+                    remat=True)
+    trainer = SpmdTrainer(model, AdamW(learning_rate=args.lr), mesh=mesh,
+                          fsdp="fsdp" in axes, min_fsdp_size=1).init()
+
+    rs = np.random.RandomState(0)
+    bsz = 2 * axes.get("dp", 1) * axes.get("fsdp", 1)
+    seq = 64 * axes.get("sp", 1)
+
+    def batches():
+        while True:
+            tok = rs.randint(0, 256, (bsz, seq + 1))
+            yield tok[:, :-1], tok[:, 1:]
+
+    losses = trainer.fit(batches(), steps=10, log_every=2)
+    print(f"loss {losses[0]:.3f} -> {losses[-1]:.3f}")
+
+
+if __name__ == "__main__":
+    main()
