@@ -46,7 +46,11 @@ class TrainingState:
 
 
 class Metrics:
-    """Per-iteration timing/throughput (≙ optim/Metrics.scala)."""
+    """Per-iteration timing/throughput (≙ optim/Metrics.scala: the
+    reference tracks data-fetch / compute / aggregate timers per
+    iteration).  `trace()` additionally captures an XLA device profile
+    viewable in TensorBoard / Perfetto (the TPU analogue of the
+    reference's driver-side metric dump)."""
 
     def __init__(self):
         self.values: Dict[str, List[float]] = {}
@@ -60,6 +64,17 @@ class Metrics:
 
     def summary(self):
         return {k: self.mean(k) for k in self.values}
+
+    @staticmethod
+    def trace(log_dir):
+        """Context manager: profile device execution into `log_dir`
+        (jax.profiler trace; open with TensorBoard's profile plugin)."""
+        return jax.profiler.trace(log_dir)
+
+    @staticmethod
+    def annotation(name):
+        """Label a host-side region so it shows up on the trace timeline."""
+        return jax.profiler.TraceAnnotation(name)
 
 
 def make_train_step(model: Module, criterion, optim_method: OptimMethod,
