@@ -152,6 +152,10 @@ class Optimizer:
         self.mixed_precision = False
         self._grad_clip_norm = None
         self._grad_clip_const = None
+        # failure recovery (≙ DistriOptimizer.scala optimize() retry loop:
+        # failed iterations restart from the cached model state)
+        self.max_retries = 0
+        self._retry_cache = None
 
     # -- fluent config, reference API ----------------------------------- #
     def set_optim_method(self, method):
@@ -188,6 +192,12 @@ class Optimizer:
 
     def set_mixed_precision(self, enabled=True):
         self.mixed_precision = enabled
+        return self
+
+    def set_auto_retry(self, max_retries):
+        """Retry a failed epoch from the last end-of-epoch state snapshot
+        (≙ DistriOptimizer's retryNum/cache recovery)."""
+        self.max_retries = max_retries
         return self
 
     def set_gradient_clipping_by_l2_norm(self, clip_norm):
@@ -324,68 +334,99 @@ class Optimizer:
         rng = jax.random.PRNGKey(self.seed + 13)
 
         stop = False
+        retries = 0
         while not stop:
-            self.state.epoch_finished = False
-            epoch_start = time.time()
-            n_seen = 0
-            data_t = time.time()
-            for mb in self.dataset.data(train=True):
-                wait = time.time() - data_t
-                x, y = _mb_to_arrays(mb)
-                x, y = self._place_batch(x, y)
-                rng, sub = jax.random.split(rng)
-                t0 = time.time()
-                params, opt_state, model_state, loss = step_fn(
-                    params, opt_state, model_state, x, y, sub)
-                # keep `loss` on device: float()ing here would sync the host
-                # with the accelerator every step and stall the input pipeline
-                dispatch = time.time() - t0
-                self.state.iteration += 1
-                self.state.loss = loss
-                n_seen += mb.size()
-                self.metrics.add("data wait time", wait)
-                self.metrics.add("dispatch time", dispatch)
-                if self.train_summary is not None:
-                    self._write_train_summary(params, opt_state)
-                if self._fire_mid_epoch(params, opt_state, model_state):
-                    stop = True
-                    break
-                data_t = time.time()
-            else:
-                self.state.epoch_finished = True
-                self.state.loss = float(self.state.loss)
-                dur = time.time() - epoch_start
-                thru = n_seen / max(dur, 1e-9)
-                self.metrics.add("throughput", thru)
-                if self.train_summary is not None:
-                    self.train_summary.add_scalar("Throughput", thru,
-                                                  self.state.iteration)
-                print(f"[epoch {self.state.epoch}] loss={self.state.loss:.4f} "
-                      f"({n_seen} samples in {dur:.1f}s, {thru:.1f}/s"
-                      f"{self._banner_suffix()})")
-                if self.val_trigger is not None and self.val_trigger(self.state):
-                    self._validate(self._params_for_eval(params), model_state)
-                if (self.checkpoint_trigger is not None
-                        and self.checkpoint_trigger(self.state)):
-                    self.save_checkpoint(params, opt_state, model_state,
-                                         tag=f"epoch_{self.state.epoch}")
-                # metric-driven schedules (Plateau): factor changes are host
-                # state baked into the trace, so a change forces a re-jit
-                sched = getattr(self.optim_method, "schedule", None)
-                if sched is not None and hasattr(sched, "on_epoch_end"):
-                    before = sched.current_factor
-                    metric = self.state.score if self.state.score is not None \
-                        else self.state.loss
-                    if metric is not None:
-                        sched.on_epoch_end(float(metric))
-                    if sched.current_factor != before:
-                        step_fn = build_step()
-                self.state.epoch += 1
-                if self.end_when(self.state):
-                    stop = True
+            if self.max_retries:
+                # end-of-epoch snapshot for failure recovery (host copies:
+                # device buffers may be donated/invalid after a fault)
+                self._retry_cache = (
+                    jax.tree_util.tree_map(np.asarray,
+                                           (params, opt_state, model_state)),
+                    self.state.epoch, self.state.iteration, rng)
+            try:
+                params, opt_state, model_state, rng, step_fn, stop = \
+                    self._run_epoch(params, opt_state, model_state, rng,
+                                    step_fn, build_step)
+            except Exception as e:
+                if retries >= self.max_retries or self._retry_cache is None:
+                    raise
+                retries += 1
+                print(f"[retry {retries}/{self.max_retries}] epoch "
+                      f"{self.state.epoch} failed ({e!r}); restoring "
+                      "cached state")
+                host, epoch, iteration, rng = self._retry_cache
+                params, opt_state, model_state = jax.tree_util.tree_map(
+                    jnp.asarray, host)
+                self.state.epoch = epoch
+                self.state.iteration = iteration
 
         self.model.set_params(self._params_for_eval(params), model_state)
         return self.model
+
+    def _run_epoch(self, params, opt_state, model_state, rng, step_fn,
+                   build_step):
+        """One epoch of the shared loop; returns updated carry + stop."""
+        stop = False
+        self.state.epoch_finished = False
+        epoch_start = time.time()
+        n_seen = 0
+        data_t = time.time()
+        for mb in self.dataset.data(train=True):
+            wait = time.time() - data_t
+            x, y = _mb_to_arrays(mb)
+            x, y = self._place_batch(x, y)
+            rng, sub = jax.random.split(rng)
+            t0 = time.time()
+            params, opt_state, model_state, loss = step_fn(
+                params, opt_state, model_state, x, y, sub)
+            # keep `loss` on device: float()ing here would sync the host
+            # with the accelerator every step and stall the input pipeline
+            dispatch = time.time() - t0
+            self.state.iteration += 1
+            self.state.loss = loss
+            n_seen += mb.size()
+            self.metrics.add("data wait time", wait)
+            self.metrics.add("dispatch time", dispatch)
+            if self.train_summary is not None:
+                self._write_train_summary(params, opt_state)
+            if self._fire_mid_epoch(params, opt_state, model_state):
+                stop = True
+                break
+            data_t = time.time()
+        else:
+            self.state.epoch_finished = True
+            self.state.loss = float(self.state.loss)
+            dur = time.time() - epoch_start
+            thru = n_seen / max(dur, 1e-9)
+            self.metrics.add("throughput", thru)
+            if self.train_summary is not None:
+                self.train_summary.add_scalar("Throughput", thru,
+                                              self.state.iteration)
+            print(f"[epoch {self.state.epoch}] loss={self.state.loss:.4f} "
+                  f"({n_seen} samples in {dur:.1f}s, {thru:.1f}/s"
+                  f"{self._banner_suffix()})")
+            if self.val_trigger is not None and self.val_trigger(self.state):
+                self._validate(self._params_for_eval(params), model_state)
+            if (self.checkpoint_trigger is not None
+                    and self.checkpoint_trigger(self.state)):
+                self.save_checkpoint(params, opt_state, model_state,
+                                     tag=f"epoch_{self.state.epoch}")
+            # metric-driven schedules (Plateau): factor changes are host
+            # state baked into the trace, so a change forces a re-jit
+            sched = getattr(self.optim_method, "schedule", None)
+            if sched is not None and hasattr(sched, "on_epoch_end"):
+                before = sched.current_factor
+                metric = self.state.score if self.state.score is not None \
+                    else self.state.loss
+                if metric is not None:
+                    sched.on_epoch_end(float(metric))
+                if sched.current_factor != before:
+                    step_fn = build_step()
+            self.state.epoch += 1
+            if self.end_when(self.state):
+                stop = True
+
+        return params, opt_state, model_state, rng, step_fn, stop
 
     def _fire_mid_epoch(self, params, opt_state, model_state) -> bool:
         """iteration-level triggers; returns True if training should end."""

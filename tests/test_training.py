@@ -91,3 +91,53 @@ def test_regularization_affects_loss():
     params, _ = m1.init_params(0)
     reg = m1.regularization_loss(params)
     assert float(reg) > 0
+
+
+def test_auto_retry_recovers_from_transient_failure():
+    """≙ DistriOptimizer retry-from-cache: a data pipeline fault mid-epoch
+    restores the last epoch snapshot and training completes."""
+    import numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.data.dataset import DataSet
+    from bigdl_tpu.data.minibatch import MiniBatch
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(64, 5).astype(np.float32)
+    y = rs.randn(64, 1).astype(np.float32)
+
+    class Flaky(DataSet):
+        def __init__(self):
+            self.epoch_calls = 0
+
+        def size(self):
+            return 64
+
+        def data(self, train=True):
+            self.epoch_calls += 1
+            for i in range(4):
+                if self.epoch_calls == 2 and i == 2:
+                    raise RuntimeError("simulated data fault")
+                sel = slice(i * 16, (i + 1) * 16)
+                yield MiniBatch(x[sel], y[sel])
+
+    ds = Flaky()
+    model = nn.Sequential(nn.Linear(5, 1))
+    opt = (LocalOptimizer(model, ds, nn.MSECriterion())
+           .set_optim_method(SGD(learning_rate=0.01))
+           .set_end_when(Trigger.max_epoch(3))
+           .set_auto_retry(2))
+    m = opt.optimize()
+    assert m._params is not None
+    assert ds.epoch_calls == 4  # 3 epochs + 1 retried
+    assert opt.state.epoch == 4  # completed all three epochs
+
+    # without retry, the same fault propagates
+    ds2 = Flaky()
+    opt2 = (LocalOptimizer(nn.Sequential(nn.Linear(5, 1)), ds2,
+                           nn.MSECriterion())
+            .set_optim_method(SGD(learning_rate=0.01))
+            .set_end_when(Trigger.max_epoch(3)))
+    import pytest
+    with pytest.raises(RuntimeError, match="simulated data fault"):
+        opt2.optimize()
