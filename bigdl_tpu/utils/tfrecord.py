@@ -100,3 +100,83 @@ class FixedLengthRecordReader:
             end = size - self.footer_bytes
             while f.tell() + self.record_bytes <= end:
                 yield f.read(self.record_bytes)
+
+
+# --------------------------------------------------------------------- #
+# tf.Example records (≙ nn/tf/ParsingOps.scala ParseExample)            #
+# --------------------------------------------------------------------- #
+def make_example(features: dict) -> bytes:
+    """Encode {name: bytes|str|list[int]|list[float]|ndarray} as a
+    serialized tf.Example."""
+    import numpy as np
+    from . import proto
+
+    def feature_bytes(value) -> bytes:
+        if isinstance(value, (bytes, str)):
+            v = value.encode() if isinstance(value, str) else value
+            return proto.enc_bytes(1, proto.enc_bytes(1, v))  # BytesList
+        arr = np.asarray(value)
+        if np.issubdtype(arr.dtype, np.floating):
+            payload = b"".join(proto.enc_float(1, float(x))
+                               for x in arr.reshape(-1))
+            return proto.enc_bytes(2, payload)               # FloatList
+        payload = b"".join(proto.enc_int64(1, int(x))
+                           for x in arr.reshape(-1))
+        return proto.enc_bytes(3, payload)                   # Int64List
+
+    entries = b""
+    for name, value in features.items():
+        entry = (proto.enc_string(1, name)
+                 + proto.enc_bytes(2, feature_bytes(value)))
+        entries += proto.enc_bytes(1, entry)                 # map entry
+    return proto.enc_bytes(1, entries)                       # Features
+
+
+def parse_example(record: bytes) -> dict:
+    """Decode a serialized tf.Example into {name: list|bytes}."""
+    import numpy as np
+    from . import proto
+    from .proto import iter_fields, _read_varint
+
+    out = {}
+    for f, w, v in iter_fields(record):
+        if f != 1 or w != 2:
+            continue
+        for f2, w2, v2 in iter_fields(v):          # Features.feature map
+            if f2 != 1 or w2 != 2:
+                continue
+            name = None
+            value = None
+            for f3, w3, v3 in iter_fields(v2):
+                if f3 == 1 and w3 == 2:
+                    name = v3.decode("utf-8")
+                elif f3 == 2 and w3 == 2:          # Feature
+                    for f4, w4, v4 in iter_fields(v3):
+                        if f4 == 1 and w4 == 2:    # BytesList
+                            vals = [b for f5, w5, b in iter_fields(v4)
+                                    if f5 == 1 and w5 == 2]
+                            value = vals[0] if len(vals) == 1 else vals
+                        elif f4 == 2 and w4 == 2:  # FloatList
+                            floats = []
+                            for f5, w5, v5 in iter_fields(v4):
+                                if f5 == 1 and w5 == 5:
+                                    floats.append(v5)
+                                elif f5 == 1 and w5 == 2:  # packed
+                                    import struct as _s
+                                    floats.extend(_s.unpack(
+                                        f"<{len(v5) // 4}f", v5))
+                            value = np.asarray(floats, np.float32)
+                        elif f4 == 3 and w4 == 2:  # Int64List
+                            ints = []
+                            for f5, w5, v5 in iter_fields(v4):
+                                if f5 == 1 and w5 == 0:
+                                    ints.append(v5)
+                                elif f5 == 1 and w5 == 2:  # packed
+                                    i = 0
+                                    while i < len(v5):
+                                        n, i = _read_varint(v5, i)
+                                        ints.append(n)
+                            value = np.asarray(ints, np.int64)
+            if name is not None:
+                out[name] = value
+    return out

@@ -190,3 +190,18 @@ def test_graphdef_unknown_op_raises():
     g = TFGraph(parse_graphdef(graph), ["x"], ["y"])
     with pytest.raises(NotImplementedError):
         g.forward(np.ones(3, np.float32))
+
+
+def test_tf_example_roundtrip(tmp_path):
+    """make_example -> TFRecord file -> parse_example (≙ ParsingOps)."""
+    rs = np.random.RandomState(0)
+    feats = {"image": rs.bytes(64),
+             "label": [3],
+             "weights": rs.rand(5).astype(np.float32)}
+    rec = tfrecord.make_example(feats)
+    path = str(tmp_path / "ex.tfrecord")
+    tfrecord.write_tfrecords(path, [rec])
+    back = tfrecord.parse_example(tfrecord.read_tfrecords(path)[0])
+    assert back["image"] == feats["image"]
+    assert back["label"].tolist() == [3]
+    np.testing.assert_allclose(back["weights"], feats["weights"], rtol=1e-6)
