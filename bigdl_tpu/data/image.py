@@ -419,3 +419,70 @@ class GreyImgToBatch(Transformer):
         x = np.stack([b.data for b in buf])[:, None, :, :]
         y = np.asarray([b.label for b in buf], np.float32)
         return MiniBatch(np.ascontiguousarray(x), y)
+
+
+# --------------------------------------------------------------------- #
+# Hadoop SequenceFile interop (the reference's ImageNet storage format) #
+# --------------------------------------------------------------------- #
+class BGRImgToLocalSeqFile(Transformer):
+    """Write images into numbered .seq shards, `block_size` per file,
+    yielding each file name (≙ image/BGRImgToLocalSeqFile.scala: key =
+    Text(label) [or "name\\nlabel"], value = Text(int32BE width, int32BE
+    height, BGR uint8 bytes))."""
+
+    def __init__(self, block_size: int, base_file_name: str,
+                 has_name: bool = False):
+        self.block_size = block_size
+        self.base = base_file_name
+        self.has_name = has_name
+        self._index = 0
+
+    def apply_iter(self, it):
+        import struct
+        from ..utils.seqfile import SequenceFileWriter
+        it = iter(it)
+        done = False
+        while not done:
+            fname = f"{self.base}_{self._index}.seq"
+            with SequenceFileWriter(fname) as w:
+                count = 0
+                while count < self.block_size:
+                    try:
+                        item = next(it)
+                    except StopIteration:
+                        done = True
+                        break
+                    if isinstance(item, tuple):
+                        img, name = item
+                    else:
+                        img, name = item, ""
+                    header = struct.pack(">ii", img.width, img.height)
+                    payload = header + np.clip(img.data, 0, 255) \
+                        .astype(np.uint8).tobytes()
+                    key = (f"{name}\n{int(img.label)}" if self.has_name
+                           else f"{int(img.label)}").encode()
+                    w.append(key, payload)
+                    count += 1
+            if count:
+                self._index += 1
+                yield fname
+            elif done:
+                import os
+                os.remove(fname)
+
+
+class LocalSeqFileToBytes(Transformer):
+    """File names -> (HWC uint8 BGR array, label) pairs feeding
+    BytesToBGRImg (≙ image/LocalSeqFileToBytes.scala)."""
+
+    def apply_iter(self, it):
+        import struct
+        from ..utils.seqfile import SequenceFileReader
+        for fname in it:
+            for key, value in SequenceFileReader(fname):
+                w, h = struct.unpack(">ii", value[:8])
+                arr = np.frombuffer(value[8:8 + w * h * 3], np.uint8) \
+                    .reshape(h, w, 3)
+                text = key.decode()
+                label = float(text.split("\n")[-1])
+                yield arr, label

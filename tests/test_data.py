@@ -253,3 +253,45 @@ def test_mnist_idx_roundtrip(tmp_path):
     x, y = mnist.read_data_sets(str(tmp_path), "train")
     np.testing.assert_array_equal(x[..., 0], imgs)
     np.testing.assert_array_equal(y, labs)
+
+
+def test_seqfile_roundtrip(tmp_path):
+    """Hadoop SequenceFile write/read (≙ BGRImgToLocalSeqFile +
+    LocalSeqFileToBytes): images survive the full shard round trip."""
+    from bigdl_tpu.utils.seqfile import (SequenceFileWriter,
+                                         SequenceFileReader, SEQ_MAGIC)
+    rng = np.random.RandomState(0)
+    imgs = [I.LabeledBGRImage((rng.rand(6, 5, 3) * 255), label=i + 1)
+            for i in range(7)]
+    base = str(tmp_path / "shard")
+    files = list(I.BGRImgToLocalSeqFile(3, base)(imgs))
+    assert len(files) == 3  # 3+3+1
+    raw = open(files[0], "rb").read()
+    assert raw[:3] == SEQ_MAGIC and raw[3] == 6
+    back = list((I.LocalSeqFileToBytes() >> I.BytesToBGRImg())(files))
+    assert len(back) == 7
+    assert [b.label for b in back] == [i + 1.0 for i in range(7)]
+    np.testing.assert_allclose(
+        back[0].data, np.clip(imgs[0].data, 0, 255).astype(np.uint8),
+        atol=1.0)
+
+
+def test_seqfile_sync_markers(tmp_path):
+    """Records spanning multiple sync intervals still parse."""
+    from bigdl_tpu.utils.seqfile import (SequenceFileWriter,
+                                         read_seq_pairs)
+    path = str(tmp_path / "big.seq")
+    with SequenceFileWriter(path) as w:
+        for i in range(50):
+            w.append(str(i).encode(), bytes([i % 256]) * 300)
+    pairs = read_seq_pairs(path)
+    assert len(pairs) == 50
+    assert pairs[49][0] == b"49" and len(pairs[49][1]) == 300
+
+
+def test_seqfile_vint():
+    from bigdl_tpu.utils.seqfile import write_vint, read_vint
+    for v in (0, 1, -1, 127, -112, 128, 255, 10000, -10000, 2**31, -2**31):
+        buf = write_vint(v)
+        got, pos = read_vint(buf, 0)
+        assert got == v and pos == len(buf), v
