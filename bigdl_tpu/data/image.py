@@ -337,7 +337,7 @@ class Lighting(Transformer):
 
     def apply_iter(self, it):
         for img in it:
-            alpha = self._rng.uniform(0, self.alphastd, 3).astype(np.float32)
+            alpha = self._rng.normal(0, self.alphastd, 3).astype(np.float32)
             rgb = (self.eigvec * alpha[None, :] * self.eigval[None, :]).sum(1)
             img.data = img.data + rgb[::-1][None, None, :]  # BGR order
             yield img

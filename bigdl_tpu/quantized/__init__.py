@@ -99,12 +99,15 @@ class QuantizedSpatialConvolution(QuantizedModule):
     SpatialConvolution.scala). NCHW like the float layer."""
 
     def __init__(self, weight, bias=None, stride=(1, 1), padding=(0, 0),
-                 dilation=(1, 1), n_group=1, name=None):
+                 dilation=(1, 1), n_group=1, format="NCHW", name=None):
         super().__init__(name=name)
-        # float layer stores OIHW
+        # float layer stores OIHW in both formats (only the activation
+        # layout differs — see nn/conv.py SpatialConvolution.apply)
         qw, wscale = quantize_weights_symmetric(np.asarray(weight), axis=0)
         self.qweight = jnp.asarray(qw)
-        self.wscale = jnp.asarray(wscale.reshape(1, -1, 1, 1))
+        self.format = format
+        self._cshape = (1, -1, 1, 1) if format == "NCHW" else (1, 1, 1, -1)
+        self.wscale = jnp.asarray(wscale.reshape(self._cshape))
         self.bias = None if bias is None else jnp.asarray(bias, jnp.float32)
         self.stride = stride
         self.padding = padding
@@ -118,23 +121,26 @@ class QuantizedSpatialConvolution(QuantizedModule):
         return QuantizedSpatialConvolution(
             np.asarray(p["weight"]), p.get("bias"), stride=layer.stride,
             padding=layer.pad, n_group=getattr(layer, "n_group", 1),
+            format=getattr(layer, "format", "NCHW"),
             name=f"{layer.name}_q")
 
     def apply(self, params, x, ctx):
         qx, xscale = _quantize_activations(x)
         ph, pw = self.padding
         pad = "SAME" if (ph == -1 or pw == -1) else ((ph, ph), (pw, pw))
+        dn = ("NCHW", "OIHW", "NCHW") if self.format == "NCHW" \
+            else ("NHWC", "OIHW", "NHWC")
         acc = lax.conv_general_dilated(
             qx.astype(jnp.int8), self.qweight,
             window_strides=self.stride,
             padding=pad,
             rhs_dilation=self.dilation,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            dimension_numbers=dn,
             feature_group_count=self.n_group,
             preferred_element_type=jnp.int32)
         out = acc.astype(jnp.float32) * (xscale * self.wscale)
         if self.bias is not None:
-            out = out + self.bias.reshape(1, -1, 1, 1)
+            out = out + self.bias.reshape(self._cshape)
         return out
 
 
@@ -153,20 +159,36 @@ _register_defaults()
 def quantize(model: Module) -> Module:
     """Deep-copy `model` with every quantizable layer replaced
     (≙ nn/quantized/Quantizer.scala quantize).  The trained weights live in
-    the CONTAINER's flat params tree (children do not own them), so the
-    tree is threaded down and sliced by child name."""
+    the model's flat params tree keyed by module name, so the tree is
+    threaded down and sliced by child name.  Non-quantized children KEEP
+    their trained params and state (the reference Quantizer preserves
+    them too): only the entries of replaced children are dropped from the
+    carried tree — the quantized twins own frozen int8 weights instead."""
     params = model.ensure_initialized()
-    return _rewrite(model, params)
+    state = dict(model._state or {})
+    replaced: list = []
+    new_model = _rewrite(model, params, replaced)
+    if isinstance(new_model, containers_mod.Container):
+        dropped = set(replaced)
+        new_model._params = {k: v for k, v in params.items()
+                             if k not in dropped}
+        new_model._state = {k: v for k, v in state.items()
+                            if k not in dropped}
+    return new_model
 
 
-def _rewrite(module: Module, params) -> Module:
+def _rewrite(module: Module, params, replaced) -> Module:
     fn = _QUANTIZABLE.get(type(module))
     if fn is not None:
+        replaced.append(module.name)
         return fn(module, params.get(module.name))
     if isinstance(module, containers_mod.Container):
         clone = copy.copy(module)
-        clone._children = [_rewrite(c, params) for c in module.children()]
-        # drop cached float params: quantized children own frozen weights
-        clone._params, clone._state = clone.init_params(0)
+        clone._children = [_rewrite(c, params, replaced)
+                           for c in module.children()]
+        # the top-level clone gets the carried trained tree in quantize();
+        # intermediate clones must not cache stale float params
+        clone._params = None
+        clone._state = {}
         return clone
     return module

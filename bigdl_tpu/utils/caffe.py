@@ -235,7 +235,13 @@ def _convert_layer(ltype: str, lp: PrototxtMessage, in_channels: int):
             in_channels, eps=float(bp.get("eps", 1e-5)),
             affine=False), in_channels
     if t == "scale":
-        return nn.CMul((1, in_channels, 1, 1)), in_channels
+        sp = lp.get("scale_param", PrototxtMessage())
+        if bool(sp.get("bias_term", False)):
+            mod = nn.Sequential(nn.CMul((1, in_channels, 1, 1)),
+                                nn.CAdd((1, in_channels, 1, 1)))
+        else:
+            mod = nn.CMul((1, in_channels, 1, 1))
+        return mod, in_channels
     raise ValueError(f"unsupported caffe layer type {ltype!r}")
 
 
@@ -325,17 +331,50 @@ class CaffeLoader:
         for name, mod in weight_assign:
             if name not in self.blobs:
                 continue
-            blobs = self.blobs[name]
-            p = dict(params.get(mod.name, {}))
-            if "weight" in p and len(blobs) >= 1:
-                w = blobs[0].reshape(np.shape(p["weight"]))
-                p["weight"] = w.astype(np.float32)
-            if "bias" in p and len(blobs) >= 2:
-                p["bias"] = blobs[1].reshape(np.shape(p["bias"])) \
-                    .astype(np.float32)
-            params[mod.name] = p
+            self._assign_blobs(mod, self.blobs[name], params, state)
         model.set_params(params, state)
         return model
+
+    @staticmethod
+    def _assign_blobs(mod, blobs, params, state):
+        """Fill one converted module from a caffe layer's blobs
+        (≙ CaffeLoader.copyParameter).  BatchNorm stores accumulated
+        (mean_sum, var_sum, scale_factor) — the running stats are
+        blobs[0..1] / scale_factor and live in the module STATE, not
+        params.  Scale stores (gamma[, beta]) -> CMul weight / CAdd bias."""
+        if isinstance(mod, nn.BatchNormalization):
+            sf = float(blobs[2].reshape(-1)[0]) if len(blobs) >= 3 else 1.0
+            factor = 0.0 if sf == 0.0 else 1.0 / sf
+            st = dict(state.get(mod.name, {}))
+            if len(blobs) >= 1:
+                st["running_mean"] = (blobs[0].reshape(-1) * factor) \
+                    .astype(np.float32)
+            if len(blobs) >= 2:
+                st["running_var"] = (blobs[1].reshape(-1) * factor) \
+                    .astype(np.float32)
+            state[mod.name] = st
+            if mod.affine and len(blobs) >= 5:
+                params[mod.name] = {
+                    "weight": blobs[3].reshape(-1).astype(np.float32),
+                    "bias": blobs[4].reshape(-1).astype(np.float32)}
+            return
+        if isinstance(mod, nn.Sequential):  # Scale with bias_term
+            cmul, cadd = mod.children()
+            if len(blobs) >= 1:
+                params[cmul.name] = {"weight": blobs[0].reshape(
+                    np.shape(params[cmul.name]["weight"])).astype(np.float32)}
+            if len(blobs) >= 2:
+                params[cadd.name] = {"bias": blobs[1].reshape(
+                    np.shape(params[cadd.name]["bias"])).astype(np.float32)}
+            return
+        p = dict(params.get(mod.name, {}))
+        if "weight" in p and len(blobs) >= 1:
+            p["weight"] = blobs[0].reshape(np.shape(p["weight"])) \
+                .astype(np.float32)
+        if "bias" in p and len(blobs) >= 2:
+            p["bias"] = blobs[1].reshape(np.shape(p["bias"])) \
+                .astype(np.float32)
+        params[mod.name] = p
 
     @staticmethod
     def load(prototxt_path: str, model_path: Optional[str] = None):

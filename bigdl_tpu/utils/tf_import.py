@@ -179,6 +179,18 @@ def _pool(x, ksize, strides, padding, reducer, init):
                              (1, sh, sw, 1), padding)
 
 
+def _avg_pool(x, ksize, strides, padding):
+    """TF AvgPool: with SAME padding the average divides by the number of
+    IN-BOUNDS window elements at each position, not the full kernel area."""
+    summed = _pool(x, ksize, strides, padding, lax.add, 0.0)
+    if str(padding).upper() == "SAME":
+        # counts depend only on the spatial shape: one (1, H, W, 1) pass
+        ones = jnp.ones((1,) + x.shape[1:3] + (1,), x.dtype)
+        counts = _pool(ones, ksize, strides, padding, lax.add, 0.0)
+        return summed / counts
+    return summed / (int(ksize[1]) * int(ksize[2]))
+
+
 def _fused_bn(env_args, attrs):
     x, scale, offset, mean, var = env_args
     eps = attrs.get("epsilon", 1e-3) or 1e-3
@@ -234,9 +246,8 @@ _OP_IMPLS = {
         feature_group_count=a[0].shape[-1]),
     "MaxPool": lambda a, at: _pool(a[0], at["ksize"], at["strides"],
                                    at["padding"], lax.max, -jnp.inf),
-    "AvgPool": lambda a, at: _pool(
-        a[0], at["ksize"], at["strides"], at["padding"], lax.add, 0.0)
-        / (int(at["ksize"][1]) * int(at["ksize"][2])),
+    "AvgPool": lambda a, at: _avg_pool(a[0], at["ksize"], at["strides"],
+                                       at["padding"]),
     "FusedBatchNorm": _fused_bn,
     "FusedBatchNormV2": _fused_bn,
     "FusedBatchNormV3": _fused_bn,

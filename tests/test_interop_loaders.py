@@ -134,3 +134,37 @@ def test_caffemodel_blob_parse():
     net = proto.enc_bytes(100, layer)
     blobs = C.parse_caffemodel(net)
     np.testing.assert_array_equal(blobs["fc"][0], w)
+
+
+BN_PROTOTXT = """
+name: "BNNet"
+input: "data"
+input_shape { dim: 1 dim: 3 dim: 4 dim: 4 }
+layer { name: "bn" type: "BatchNorm" batch_norm_param { eps: 0.001 } }
+layer { name: "sc" type: "Scale" scale_param { bias_term: true } }
+"""
+
+
+def test_caffe_batchnorm_scale_blobs_loaded(tmp_path):
+    """Regression: BatchNorm running stats (blobs/scale_factor) and Scale
+    gamma/beta must be loaded from the caffemodel (they were dropped)."""
+    proto_path = str(tmp_path / "bn.prototxt")
+    open(proto_path, "w").write(BN_PROTOTXT)
+    rs = np.random.RandomState(0)
+    mean = rs.randn(3).astype(np.float32)
+    var = (rs.rand(3) + 0.5).astype(np.float32)
+    sf = 4.0  # caffe stores accumulated sums + a scale factor
+    gamma = (rs.rand(3) + 0.5).astype(np.float32)
+    beta = rs.randn(3).astype(np.float32)
+
+    loader = C.CaffeLoader(proto_path)
+    loader.blobs = {
+        "bn": [mean * sf, var * sf, np.array([sf], np.float32)],
+        "sc": [gamma, beta]}
+    model = loader.create_module().evaluate()
+    x = rs.randn(2, 3, 4, 4).astype(np.float32)
+    got = np.asarray(model.forward(x))
+    inv = 1.0 / np.sqrt(var + 1e-3)
+    want = (x - mean[None, :, None, None]) * inv[None, :, None, None]
+    want = want * gamma[None, :, None, None] + beta[None, :, None, None]
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

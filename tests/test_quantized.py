@@ -70,3 +70,55 @@ def test_quantized_backward_refuses():
     q.forward(x)
     with pytest.raises(RuntimeError):
         q.backward(x, np.ones((1, 2), np.float32))
+
+
+def test_quantize_preserves_trained_bn_and_state():
+    """Regression: quantize() must carry trained params/state of
+    NON-quantized children through (BN gamma/beta + running stats were
+    silently re-initialized before)."""
+    rs = np.random.RandomState(1)
+    model = nn.Sequential(
+        nn.SpatialConvolution(1, 4, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(4), nn.ReLU(),
+        nn.Reshape((4 * 8 * 8,)), nn.Linear(256, 10))
+    model.reset(0)
+    bn = model.children()[1]
+    # fake a "trained" BN: non-default affine params and running stats
+    params = dict(model.ensure_initialized())
+    params[bn.name] = {
+        "weight": rs.rand(4).astype(np.float32) + 0.5,
+        "bias": rs.randn(4).astype(np.float32)}
+    state = dict(model._state)
+    state[bn.name] = {
+        "running_mean": rs.randn(4).astype(np.float32),
+        "running_var": rs.rand(4).astype(np.float32) + 0.5}
+    model.set_params(params, state)
+    model.evaluate()
+    x = rs.randn(4, 1, 8, 8).astype(np.float32)
+    want = np.asarray(model.forward(x))
+    qmodel = quantize(model).evaluate()
+    # BN entries survived into the quantized model's carried tree
+    np.testing.assert_array_equal(
+        np.asarray(qmodel._params[bn.name]["weight"]),
+        np.asarray(params[bn.name]["weight"]))
+    np.testing.assert_array_equal(
+        np.asarray(qmodel._state[bn.name]["running_mean"]),
+        np.asarray(state[bn.name]["running_mean"]))
+    got = np.asarray(qmodel.forward(x))
+    rel = np.abs(got - want).max() / np.abs(want).max()
+    assert rel < 0.1, rel
+
+
+def test_quantized_conv_nhwc_matches_float():
+    """Regression: NHWC float convs must quantize with NHWC dimension
+    numbers (was hardwired NCHW)."""
+    rs = np.random.RandomState(0)
+    conv = nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1, format="NHWC")
+    conv.reset(0)
+    x = rs.randn(2, 12, 12, 3).astype(np.float32)
+    want = np.asarray(conv.forward(x))
+    qconv = QuantizedSpatialConvolution.from_float(conv)
+    got = np.asarray(qconv.forward(x))
+    assert got.shape == want.shape
+    rel = np.abs(got - want).max() / np.abs(want).max()
+    assert rel < 0.05, rel

@@ -205,3 +205,26 @@ def test_tf_example_roundtrip(tmp_path):
     assert back["image"] == feats["image"]
     assert back["label"].tolist() == [3]
     np.testing.assert_allclose(back["weights"], feats["weights"], rtol=1e-6)
+
+
+def test_graphdef_avgpool_same_border_counts():
+    """Regression: TF AvgPool with SAME padding averages only in-bounds
+    elements at the borders (was dividing by the full kernel area)."""
+    from bigdl_tpu.utils import proto
+    from bigdl_tpu.utils.tf_import import _node, parse_graphdef, TFGraph
+
+    def attr_list_i(vals):
+        return proto.enc_bytes(1, b"".join(proto.enc_int64(2, v)
+                                           for v in vals))
+
+    dt_float = proto.enc_int64(6, 1)
+    graph = _node("x", "Placeholder", attrs={"dtype": dt_float})
+    graph += _node("pool", "AvgPool", ["x"],
+                   attrs={"ksize": attr_list_i([1, 3, 3, 1]),
+                          "strides": attr_list_i([1, 1, 1, 1]),
+                          "padding": proto.enc_bytes(2, b"SAME")})
+    g = TFGraph(parse_graphdef(graph), ["x"], ["pool"])
+    x = np.ones((1, 4, 4, 1), np.float32)
+    got = np.asarray(g.forward(x))
+    # averaging ones must give exactly ones everywhere, incl. corners
+    np.testing.assert_allclose(got, np.ones_like(got), rtol=1e-6)
