@@ -29,3 +29,5 @@ from .layers import (
     Merge,
 )
 from .topology import Sequential, Model, Input, KerasModel
+from .converter import (DefinitionLoader, WeightLoader, load_keras,
+                        KerasConversionError)

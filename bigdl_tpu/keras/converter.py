@@ -1,0 +1,484 @@
+"""Keras 1.2.2 model-file converter (≙ pyspark/bigdl/keras/converter.py:
+DefinitionLoader / WeightLoader / WeightsConverter).
+
+The reference converts a *live* keras 1.2.2 model object (it requires the old
+keras installed and drives ``klayer.get_weights()``).  Here the JSON model
+definition is parsed directly — no keras dependency — and weights are read
+straight out of the HDF5 file in the keras-1.x layout (root attr
+``layer_names``, per-layer groups with attr ``weight_names``), so files
+written by ``model.to_json()`` + ``model.save_weights()`` load without the
+original framework.
+
+Only ``dim_ordering="th"`` (channels-first) definitions are supported, like
+the reference converter (which rejects ``tf`` ordering for most layers).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from . import layers as L
+from . import topology as T
+from .. import nn as N
+
+
+class KerasConversionError(ValueError):
+    pass
+
+
+def _unsupported(what):
+    raise KerasConversionError(f"unsupported keras construct: {what}")
+
+
+def _th(cfg, who):
+    if cfg.get("dim_ordering", "th") != "th":
+        _unsupported(f"{who} with dim_ordering="
+                     f"'{cfg.get('dim_ordering')}' (use 'th')")
+
+
+def _input_shape(cfg):
+    bis = cfg.get("batch_input_shape")
+    return tuple(bis[1:]) if bis else None
+
+
+def _act(cfg):
+    a = cfg.get("activation", "linear")
+    return None if a == "linear" else a
+
+
+# --------------------------------------------------------------------- #
+# per-class definition builders: keras-1.2.2 config dict -> our layer   #
+# --------------------------------------------------------------------- #
+def _dense(cfg):
+    return L.Dense(cfg["output_dim"], activation=_act(cfg),
+                   with_bias=cfg.get("bias", True),
+                   input_shape=_input_shape(cfg), name=cfg.get("name"))
+
+
+def _activation(cfg):
+    return L.Activation(cfg["activation"], input_shape=_input_shape(cfg),
+                        name=cfg.get("name"))
+
+
+def _convolution2d(cfg):
+    _th(cfg, "Convolution2D")
+    sub = tuple(cfg.get("subsample", (1, 1)))
+    return L.Convolution2D(cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"],
+                           activation=_act(cfg),
+                           border_mode=cfg.get("border_mode", "valid"),
+                           subsample=sub, bias=cfg.get("bias", True),
+                           input_shape=_input_shape(cfg),
+                           name=cfg.get("name"))
+
+
+def _convolution1d(cfg):
+    return L.Convolution1D(cfg["nb_filter"], cfg["filter_length"],
+                           activation=_act(cfg),
+                           border_mode=cfg.get("border_mode", "valid"),
+                           subsample_length=cfg.get("subsample_length", 1),
+                           bias=cfg.get("bias", True),
+                           input_shape=_input_shape(cfg),
+                           name=cfg.get("name"))
+
+
+def _maxpooling2d(cfg):
+    _th(cfg, "MaxPooling2D")
+    return L.MaxPooling2D(tuple(cfg.get("pool_size", (2, 2))),
+                          strides=tuple(cfg["strides"]) if cfg.get("strides")
+                          else None,
+                          border_mode=cfg.get("border_mode", "valid"),
+                          input_shape=_input_shape(cfg),
+                          name=cfg.get("name"))
+
+
+def _averagepooling2d(cfg):
+    _th(cfg, "AveragePooling2D")
+    return L.AveragePooling2D(tuple(cfg.get("pool_size", (2, 2))),
+                              strides=tuple(cfg["strides"])
+                              if cfg.get("strides") else None,
+                              border_mode=cfg.get("border_mode", "valid"),
+                              input_shape=_input_shape(cfg),
+                              name=cfg.get("name"))
+
+
+def _maxpooling1d(cfg):
+    return L.MaxPooling1D(cfg.get("pool_length", 2),
+                          stride=cfg.get("stride"),
+                          input_shape=_input_shape(cfg),
+                          name=cfg.get("name"))
+
+
+def _averagepooling1d(cfg):
+    return L.AveragePooling1D(cfg.get("pool_length", 2),
+                              stride=cfg.get("stride"),
+                              input_shape=_input_shape(cfg),
+                              name=cfg.get("name"))
+
+
+def _embedding(cfg):
+    return L.Embedding(cfg["input_dim"], cfg["output_dim"],
+                       input_shape=_input_shape(cfg)
+                       or ((cfg["input_length"],)
+                           if cfg.get("input_length") else None),
+                       name=cfg.get("name"))
+
+
+def _batchnormalization(cfg):
+    if cfg.get("mode", 0) != 0:
+        _unsupported(f"BatchNormalization mode={cfg['mode']}")
+    if cfg.get("axis", 1) != 1:
+        _unsupported(f"BatchNormalization axis={cfg['axis']} (use 1)")
+    return L.BatchNormalization(epsilon=cfg.get("epsilon", 1e-3),
+                                momentum=cfg.get("momentum", 0.99),
+                                input_shape=_input_shape(cfg),
+                                name=cfg.get("name"))
+
+
+def _recurrent(cls):
+    def build(cfg):
+        if cfg.get("stateful"):
+            _unsupported("stateful recurrent layers")
+        return cls(cfg["output_dim"], activation=cfg.get("activation", "tanh"),
+                   inner_activation=cfg.get("inner_activation",
+                                            "hard_sigmoid"),
+                   return_sequences=cfg.get("return_sequences", False),
+                   go_backwards=cfg.get("go_backwards", False),
+                   input_shape=_input_shape(cfg)
+                   or ((cfg["input_length"], cfg["input_dim"])
+                       if cfg.get("input_length") and cfg.get("input_dim")
+                       else None),
+                   name=cfg.get("name"))
+    return build
+
+
+def _timedistributed(cfg):
+    inner_spec = cfg["layer"]
+    inner = _builder(inner_spec["class_name"])(inner_spec["config"])
+    return L.TimeDistributed(inner, input_shape=_input_shape(cfg),
+                             name=cfg.get("name"))
+
+
+def _bidirectional(cfg):
+    inner_spec = cfg["layer"]
+    inner = _builder(inner_spec["class_name"])(inner_spec["config"])
+    return L.Bidirectional(inner, merge_mode=cfg.get("merge_mode", "concat"),
+                           input_shape=_input_shape(cfg),
+                           name=cfg.get("name"))
+
+
+def _merge(cfg):
+    mode = cfg.get("mode", "sum")
+    if not isinstance(mode, str):
+        _unsupported("Merge with a lambda mode")
+    return L.Merge(mode=mode, concat_axis=cfg.get("concat_axis", -1),
+                   name=cfg.get("name"))
+
+
+def _simple(cls, *fields, defaults=None):
+    """Builder for layers whose config keys match our ctor kwargs 1:1."""
+    defaults = defaults or {}
+
+    def build(cfg):
+        kw = {}
+        for f in fields:
+            if f in cfg:
+                v = cfg[f]
+                kw[f] = tuple(v) if isinstance(v, list) else v
+            elif f in defaults:
+                kw[f] = defaults[f]
+        return cls(input_shape=_input_shape(cfg), name=cfg.get("name"), **kw)
+    return build
+
+
+_BUILDERS = {
+    "Dense": _dense,
+    "Activation": _activation,
+    "Dropout": _simple(L.Dropout, "p"),
+    "SpatialDropout1D": _simple(L.SpatialDropout1D, "p"),
+    "SpatialDropout2D": _simple(L.SpatialDropout2D, "p"),
+    "SpatialDropout3D": _simple(L.SpatialDropout3D, "p"),
+    "GaussianDropout": _simple(L.GaussianDropout, "p"),
+    "GaussianNoise": _simple(L.GaussianNoise, "sigma"),
+    "Flatten": _simple(L.Flatten),
+    "Reshape": _simple(L.Reshape, "target_shape"),
+    "Permute": _simple(L.Permute, "dims"),
+    "RepeatVector": _simple(L.RepeatVector, "n"),
+    "Masking": _simple(L.Masking, "mask_value"),
+    "Highway": lambda cfg: L.Highway(activation=_act(cfg),
+                                     with_bias=cfg.get("bias", True),
+                                     input_shape=_input_shape(cfg),
+                                     name=cfg.get("name")),
+    "MaxoutDense": lambda cfg: L.MaxoutDense(cfg["output_dim"],
+                                             nb_feature=cfg.get("nb_feature",
+                                                                4),
+                                             input_shape=_input_shape(cfg),
+                                             name=cfg.get("name")),
+    "Embedding": _embedding,
+    "BatchNormalization": _batchnormalization,
+    "LeakyReLU": _simple(L.LeakyReLU, "alpha"),
+    "ELU": _simple(L.ELU, "alpha"),
+    "ThresholdedReLU": _simple(L.ThresholdedReLU, "theta"),
+    "SReLU": _simple(L.SReLU),
+    "Convolution1D": _convolution1d,
+    "Convolution2D": _convolution2d,
+    "MaxPooling1D": _maxpooling1d,
+    "MaxPooling2D": _maxpooling2d,
+    "AveragePooling1D": _averagepooling1d,
+    "AveragePooling2D": _averagepooling2d,
+    "GlobalAveragePooling1D": _simple(L.GlobalAveragePooling1D),
+    "GlobalMaxPooling1D": _simple(L.GlobalMaxPooling1D),
+    "GlobalAveragePooling2D": _simple(L.GlobalAveragePooling2D),
+    "GlobalMaxPooling2D": _simple(L.GlobalMaxPooling2D),
+    "ZeroPadding1D": _simple(L.ZeroPadding1D, "padding"),
+    "ZeroPadding2D": _simple(L.ZeroPadding2D, "padding"),
+    "Cropping1D": _simple(L.Cropping1D, "cropping"),
+    "Cropping2D": _simple(L.Cropping2D, "cropping"),
+    "UpSampling1D": _simple(L.UpSampling1D, "length"),
+    "UpSampling2D": _simple(L.UpSampling2D, "size"),
+    "SimpleRNN": _recurrent(L.SimpleRNN),
+    "LSTM": _recurrent(L.LSTM),
+    "GRU": _recurrent(L.GRU),
+    "TimeDistributed": _timedistributed,
+    "TimeDistributedDense": None,  # filled below
+    "Bidirectional": _bidirectional,
+    "Merge": _merge,
+}
+_BUILDERS["TimeDistributedDense"] = lambda cfg: L.TimeDistributed(
+    _dense(cfg), input_shape=_input_shape(cfg), name=cfg.get("name"))
+
+
+def _builder(class_name):
+    b = _BUILDERS.get(class_name)
+    if b is None:
+        _unsupported(f"layer class {class_name}")
+    return b
+
+
+class DefinitionLoader:
+    """Build a bigdl_tpu.keras model from a keras-1.2.2 JSON definition
+    (≙ converter.py DefinitionLoader, minus the live-keras dependency)."""
+
+    @classmethod
+    def from_json_path(cls, path):
+        with open(path) as f:
+            return cls.from_json_str(f.read())
+
+    @classmethod
+    def from_json_str(cls, json_str):
+        spec = json.loads(json_str)
+        kind = spec.get("class_name")
+        if kind == "Sequential":
+            return cls._sequential(spec["config"])
+        if kind in ("Model", "Functional"):
+            return cls._graph(spec["config"])
+        _unsupported(f"top-level class {kind}")
+
+    @classmethod
+    def _sequential(cls, layer_specs):
+        model = T.Sequential()
+        for spec in layer_specs:
+            model.add(_builder(spec["class_name"])(spec["config"]))
+        return model
+
+    @classmethod
+    def _graph(cls, cfg):
+        nodes = {}          # layer name -> graph node
+        specs = {l["name"]: l for l in cfg["layers"]}
+
+        def build_node(name):
+            if name in nodes:
+                return nodes[name]
+            spec = specs[name]
+            if spec["class_name"] == "InputLayer":
+                shp = spec["config"].get("batch_input_shape")
+                nodes[name] = T.Input(shape=tuple(shp[1:]) if shp else None,
+                                      name=name)
+                return nodes[name]
+            in_names = [inb[0] for node in spec["inbound_nodes"]
+                        for inb in node]
+            ins = [build_node(n) for n in in_names]
+            layer = _builder(spec["class_name"])(spec["config"])
+            nodes[name] = layer(ins[0] if len(ins) == 1 else ins)
+            return nodes[name]
+
+        for lname in specs:
+            build_node(lname)
+        ins = [nodes[il[0]] for il in cfg["input_layers"]]
+        outs = [nodes[ol[0]] for ol in cfg["output_layers"]]
+        return T.Model(ins if len(ins) > 1 else ins[0],
+                       outs if len(outs) > 1 else outs[0])
+
+
+# --------------------------------------------------------------------- #
+# weight loading                                                        #
+# --------------------------------------------------------------------- #
+def _dec(s):
+    return s.decode() if isinstance(s, bytes) else s
+
+
+def read_keras_hdf5(path):
+    """Return [(layer_name, [arrays...])] in file order from a keras-1.x
+    HDF5 weight file (also accepts full-model files w/ 'model_weights')."""
+    import h5py
+    out = []
+    with h5py.File(path, "r") as f:
+        g = f["model_weights"] if "model_weights" in f else f
+        layer_names = [_dec(n) for n in g.attrs["layer_names"]]
+        for ln in layer_names:
+            lg = g[ln]
+            wnames = [_dec(n) for n in lg.attrs.get("weight_names", [])]
+            if wnames:
+                out.append((ln, [np.asarray(lg[w]) for w in wnames]))
+    return out
+
+
+def _find(module, cls):
+    return [m for m in module.modules() if isinstance(m, cls)]
+
+
+def _set(params, mod, **arrs):
+    import jax.numpy as jnp
+    entry = dict(params.get(mod.name, {}))
+    for k, v in arrs.items():
+        if k in entry and tuple(entry[k].shape) != tuple(v.shape):
+            raise KerasConversionError(
+                f"{mod.name}.{k}: file weight shape {v.shape} != model "
+                f"shape {tuple(entry[k].shape)}")
+        entry[k] = jnp.asarray(v)
+    params[mod.name] = entry
+
+
+def _gates_lstm(ws):
+    """keras1 LSTM weight order: [W_i,U_i,b_i, W_c,U_c,b_c, W_f,U_f,b_f,
+    W_o,U_o,b_o]; ours is fused (in,4H) with gate order i,f,g,o."""
+    Wi, Ui, bi, Wc, Uc, bc, Wf, Uf, bf, Wo, Uo, bo = ws
+    return (np.concatenate([Wi, Wf, Wc, Wo], 1),
+            np.concatenate([Ui, Uf, Uc, Uo], 1),
+            np.concatenate([bi, bf, bc, bo], 0))
+
+
+def _load_cell(cell, ws, params):
+    if isinstance(cell, N.LSTM):
+        wi, wh, b = _gates_lstm(ws)
+        _set(params, cell, weight_i=wi, weight_h=wh, bias=b)
+    elif isinstance(cell, N.GRU):
+        # keras1 GRU order: [W_z,U_z,b_z, W_r,U_r,b_r, W_h,U_h,b_h];
+        # ours: fused gates (r,z) + separate candidate
+        Wz, Uz, bz, Wr, Ur, br, Wh, Uh, bh = ws
+        entry = dict(params.get(cell.name, {}))
+        gates = dict(entry.get("gates", {}))
+        newg = dict(entry.get("new", {}))
+        import jax.numpy as jnp
+        gates.update(weight_i=jnp.asarray(np.concatenate([Wr, Wz], 1)),
+                     weight_h=jnp.asarray(np.concatenate([Ur, Uz], 1)),
+                     bias=jnp.asarray(np.concatenate([br, bz], 0)))
+        newg.update(weight_i=jnp.asarray(Wh), weight_h=jnp.asarray(Uh),
+                    bias=jnp.asarray(bh))
+        entry["gates"], entry["new"] = gates, newg
+        params[cell.name] = entry
+    elif isinstance(cell, N.RnnCell):
+        W, U, b = ws
+        _set(params, cell, weight_i=W, weight_h=U, bias=b)
+    else:
+        raise KerasConversionError(f"no weight adapter for cell {cell}")
+
+
+def _load_layer_weights(klayer, ws, params, state):
+    """Route one keras layer's weight list into our module's params/state."""
+    if isinstance(klayer, L.TimeDistributed):
+        klayer.ensure_built()
+        inner = klayer.layer
+        return _load_layer_weights(inner, ws, params, state)
+    if isinstance(klayer, L.Bidirectional):
+        klayer.ensure_built()
+        cells = _find(klayer, N.Cell)
+        half = len(ws) // 2
+        _load_cell(cells[0], ws[:half], params)
+        _load_cell(cells[1], ws[half:], params)
+        return
+    if isinstance(klayer, (L.SimpleRNN, L.LSTM, L.GRU)):
+        klayer.ensure_built()
+        cell = _find(klayer, N.Cell)[0]
+        return _load_cell(cell, ws, params)
+    klayer.ensure_built()
+    if isinstance(klayer, (L.Dense, L.Highway)):
+        lins = _find(klayer, N.Linear)
+        if isinstance(klayer, L.Dense):
+            W = ws[0]
+            _set(params, lins[0], weight=W.T,
+                 **({"bias": ws[1]} if len(ws) > 1 else {}))
+        else:  # Highway: keras order [W, W_gate(carry), b, b_gate]
+            _unsupported("Highway hdf5 weights")  # rarely serialized; explicit
+        return
+    if isinstance(klayer, L.Embedding):
+        lk = _find(klayer, N.LookupTable)[0]
+        _set(params, lk, weight=ws[0])
+        return
+    if isinstance(klayer, (L.Convolution2D,)):
+        conv = _find(klayer, N.SpatialConvolution)[0]
+        _set(params, conv, weight=ws[0],
+             **({"bias": ws[1]} if len(ws) > 1 else {}))
+        return
+    if isinstance(klayer, L.Convolution1D):
+        conv = _find(klayer, N.TemporalConvolution)[0]
+        # keras1 conv1d weight: (filter_length, 1, input_dim, nb_filter)
+        W = np.transpose(ws[0][:, 0], (2, 1, 0))
+        _set(params, conv, weight=W,
+             **({"bias": ws[1]} if len(ws) > 1 else {}))
+        return
+    if isinstance(klayer, L.BatchNormalization):
+        bn = _find(klayer, N.BatchNormalization)[0]
+        gamma, beta, mean, var = ws
+        _set(params, bn, weight=gamma, bias=beta)
+        import jax.numpy as jnp
+        state[bn.name] = {"running_mean": jnp.asarray(mean),
+                          "running_var": jnp.asarray(var)}
+        return
+    raise KerasConversionError(
+        f"no weight adapter for layer {type(klayer).__name__}")
+
+
+class WeightLoader:
+    """≙ converter.py WeightLoader.load_weights_from_hdf5/json: route a
+    keras-1.x HDF5 weight file into a DefinitionLoader-built model."""
+
+    @staticmethod
+    def load_weights_from_hdf5(bmodel, hdf5_path, by_name=True):
+        entries = read_keras_hdf5(hdf5_path)
+        bmodel.ensure_initialized()
+        params = dict(bmodel._params)
+        state = dict(bmodel._state or {})
+        klayers = {m.name: m for m in bmodel.modules()
+                   if isinstance(m, L.KerasLayer)}
+        ordered = [m for m in bmodel.modules()
+                   if isinstance(m, L.KerasLayer) and _owns_weights(m)]
+        for i, (lname, ws) in enumerate(entries):
+            if by_name and lname in klayers:
+                target = klayers[lname]
+            elif i < len(ordered):
+                target = ordered[i]
+            else:
+                raise KerasConversionError(
+                    f"hdf5 layer {lname!r} has no counterpart in the model")
+            _load_layer_weights(target, ws, params, state)
+        bmodel.set_params(params, state)
+        return bmodel
+
+
+def _owns_weights(klayer):
+    return isinstance(klayer, (L.Dense, L.Highway, L.MaxoutDense,
+                               L.Embedding, L.BatchNormalization,
+                               L.Convolution1D, L.Convolution2D,
+                               L.Convolution3D, L.SimpleRNN, L.LSTM, L.GRU,
+                               L.Bidirectional, L.TimeDistributed))
+
+
+def load_keras(json_path=None, hdf5_path=None, by_name=True):
+    """≙ pyspark bigdl.nn.layer.Model.load_keras(json_path, hdf5_path)."""
+    if json_path is None:
+        raise ValueError("json_path is required (definition)")
+    model = DefinitionLoader.from_json_path(json_path)
+    if hdf5_path:
+        WeightLoader.load_weights_from_hdf5(model, hdf5_path, by_name=by_name)
+    return model

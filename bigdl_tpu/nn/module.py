@@ -28,8 +28,9 @@ through ``lax``; XLA fuses the elementwise neighbourhoods.
 """
 from __future__ import annotations
 
+import functools
+import inspect
 import itertools
-import pickle
 from typing import Any, Dict, Optional
 
 import jax
@@ -41,6 +42,54 @@ _uid_counter = itertools.count()
 
 def _fresh_uid():
     return next(_uid_counter)
+
+
+def _capture_config(cls):
+    """Wrap ``cls.__init__`` so constructing an instance records the bound
+    constructor arguments on ``self._serde`` (outermost class wins).
+
+    This is what makes module serialization *topology-as-data* (≙ the
+    reference's utils/serializer/ModuleSerializer.scala SerializeContext,
+    which persists each layer as class name + attribute protobuf): a saved
+    model is "class + config + children", re-buildable by calling the
+    constructor — never a pickle of the live object graph.
+    """
+    orig = cls.__init__
+    if getattr(orig, "_captures_config", False):
+        return
+    try:
+        sig = inspect.signature(orig)
+    except (ValueError, TypeError):  # C-level or exotic signature
+        return
+    varargs = next((p.name for p in sig.parameters.values()
+                    if p.kind is p.VAR_POSITIONAL), None)
+
+    @functools.wraps(orig)
+    def __init__(self, *args, **kwargs):
+        if not hasattr(self, "_serde"):
+            rec = {"class": type(self), "varargs": varargs, "config": None}
+            self._serde = rec
+            try:
+                bound = sig.bind(self, *args, **kwargs)
+                bound.apply_defaults()
+                cfg = {}
+                for pname, p in sig.parameters.items():
+                    if pname == "self" or pname not in bound.arguments:
+                        continue
+                    v = bound.arguments[pname]
+                    if p.kind is p.VAR_POSITIONAL:
+                        cfg[pname] = list(v)
+                    elif p.kind is p.VAR_KEYWORD:
+                        cfg.update(v)
+                    else:
+                        cfg[pname] = v
+                rec["config"] = cfg
+            except TypeError:
+                pass
+        orig(self, *args, **kwargs)
+
+    __init__._captures_config = True
+    cls.__init__ = __init__
 
 
 def migrate_legacy_names(tree, module):
@@ -121,6 +170,11 @@ class Ctx:
 
 class Module:
     """Base class of all layers and containers."""
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if "__init__" in cls.__dict__:
+            _capture_config(cls)
 
     def __init__(self, name: Optional[str] = None):
         self._uid = _fresh_uid()
@@ -349,15 +403,14 @@ class Module:
         return serializer.load_module(path)
 
     def save_weights(self, path, overwrite=True):
-        params = self.ensure_initialized()
-        with open(path, "wb") as f:
-            pickle.dump((jax.tree_util.tree_map(np.asarray, params),
-                         jax.tree_util.tree_map(np.asarray, self._state)), f)
+        from ..utils import serializer
+        self.ensure_initialized()
+        serializer.save_weights_file(self, path)
         return self
 
     def load_weights(self, path):
-        with open(path, "rb") as f:
-            params, state = pickle.load(f)
+        from ..utils import serializer
+        params, state = serializer.load_weights_file(path)
         params, state = migrate_legacy_names((params, state), self)
         self._params = jax.tree_util.tree_map(jnp.asarray, params)
         self._state = jax.tree_util.tree_map(jnp.asarray, state)
@@ -377,6 +430,11 @@ class Module:
         return self
 
 
+# classes that don't define their own __init__ fall through to the base
+# ctor; wrap it too so every instance gets its ctor config captured
+_capture_config(Module)
+
+
 class Criterion:
     """Base loss (≙ nn/abstractnn/AbstractCriterion.scala).
 
@@ -384,6 +442,11 @@ class Criterion:
     caches the value; ``backward`` returns d loss / d output via JAX AD,
     replacing the reference's hand-written updateGradInput.
     """
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if "__init__" in cls.__dict__:
+            _capture_config(cls)
 
     def __init__(self, name: Optional[str] = None):
         self._uid = _fresh_uid()
@@ -409,3 +472,6 @@ class Criterion:
 
     def __repr__(self):
         return f"{type(self).__name__}()"
+
+
+_capture_config(Criterion)

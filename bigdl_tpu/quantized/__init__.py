@@ -125,9 +125,16 @@ class QuantizedSpatialConvolution(QuantizedModule):
             name=f"{layer.name}_q")
 
     def apply(self, params, x, ctx):
+        from ..nn.conv import _same_pad
         qx, xscale = _quantize_activations(x)
-        ph, pw = self.padding
-        pad = "SAME" if (ph == -1 or pw == -1) else ((ph, ph), (pw, pw))
+        spatial = x.shape[2:4] if self.format == "NCHW" else x.shape[1:3]
+        ksize = self.qweight.shape[2:4]
+        # per-axis: -1 selects SAME on that axis only (mirrors the float
+        # layer's SpatialConvolution._padding)
+        pad = tuple(
+            _same_pad(spatial[i], self.stride[i], ksize[i], self.dilation[i])
+            if p == -1 else (p, p)
+            for i, p in enumerate(self.padding))
         dn = ("NCHW", "OIHW", "NCHW") if self.format == "NCHW" \
             else ("NHWC", "OIHW", "NHWC")
         acc = lax.conv_general_dilated(

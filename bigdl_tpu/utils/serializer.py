@@ -1,20 +1,51 @@
 """Module persistence (≙ utils/serializer/ModuleSerializer.scala + utils/File.scala).
 
-The reference serializes module topology + weights to a protobuf container.
-Here the topology is plain Python (module classes are importable), so
-save_module pickles the module object with all device arrays converted to
-host numpy; load_module restores and re-uploads lazily on first use.
-A versioned header guards format drift.
+The reference persists modules as a versioned protobuf container: per layer a
+``BigDLModule`` message holding class name, attributes, and child modules,
+assembled by per-class converters (ModuleSerializer.scala SerializeContext /
+DataConverter.scala).  The TPU rebuild does the same thing as *data, not
+pickle*: a saved model is a zip archive holding
+
+- ``manifest.json``   — format tag + version,
+- ``topology.json``   — a flat object table: every distinct Module appears
+  once as ``{class, module, name, config, children?, graph?, attrs?}``; config
+  values use a small tagged JSON encoding (tuples, dtypes, array refs,
+  module refs by table index — preserving shared submodules),
+- ``arrays/a*.npy``   — every ndarray (params, state, config constants) as a
+  plain .npy entry.
+
+Loading rebuilds each module by calling its constructor with the decoded
+config (captured automatically at construction time — see
+``nn.module._capture_config``), so no live object graph is ever unpickled:
+only classes inside the ``bigdl_tpu`` package (or explicitly registered ones)
+are instantiated, and the zip CRC catches truncation/corruption.  The old
+round-1 pickle format is still readable (``MAGIC``/version 1).
 """
 from __future__ import annotations
 
-import pickle
+import io
+import json
+import zipfile
 
 import jax
 import numpy as np
 
-MAGIC = b"BIGDLTPU"
-VERSION = 1
+MAGIC = b"BIGDLTPU"          # legacy round-1 pickle container
+VERSION = 2
+_FORMAT = "bigdl_tpu.module"
+
+# classes outside bigdl_tpu.* that load_module may instantiate
+_CLASS_REGISTRY = {}
+
+
+def register_class(cls):
+    """Allow a user-defined Module/helper class to be (de)serialized."""
+    _CLASS_REGISTRY[f"{cls.__module__}:{cls.__qualname__}"] = cls
+    return cls
+
+
+class SerializationError(ValueError):
+    pass
 
 
 def _to_host(tree):
@@ -26,36 +57,379 @@ def _to_device(tree):
     return jax.tree_util.tree_map(jnp.asarray, tree)
 
 
+def _is_array(v):
+    return isinstance(v, (np.ndarray, np.generic)) or (
+        hasattr(v, "__array__") and hasattr(v, "dtype") and hasattr(v, "shape")
+        and not np.isscalar(v))
+
+
+def _is_dtype(v):
+    if isinstance(v, np.dtype):
+        return True
+    try:
+        return isinstance(v, type) and issubclass(v, np.generic)
+    except TypeError:
+        return False
+
+
+# --------------------------------------------------------------------- #
+# encoding                                                              #
+# --------------------------------------------------------------------- #
+class _Encoder:
+    def __init__(self):
+        self.nodes = []            # module table entries (JSON dicts)
+        self.index = {}            # id(module) -> table index
+        self.arrays = {}           # "arrays/aN.npy" -> np.ndarray
+
+    def array_ref(self, v):
+        key = f"arrays/a{len(self.arrays)}.npy"
+        self.arrays[key] = np.asarray(v)
+        return {"$a": key}
+
+    def value(self, v, where=""):
+        from ..nn.module import Module, Criterion
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return v
+        if isinstance(v, Module):
+            return {"$m": self.module(v)}
+        if _is_dtype(v):
+            return {"$dtype": np.dtype(v).name}
+        if _is_array(v):
+            return self.array_ref(v)
+        if isinstance(v, tuple):
+            return {"$t": [self.value(e, where) for e in v]}
+        if isinstance(v, list):
+            return [self.value(e, where) for e in v]
+        if isinstance(v, dict):
+            bad = [k for k in v if not isinstance(k, str)]
+            if bad:
+                raise SerializationError(
+                    f"{where}: dict key {bad[0]!r} is not a string")
+            return {"$dict": {k: self.value(e, where) for k, e in v.items()}}
+        if inspect_isfunction(v):
+            raise SerializationError(
+                f"{where}: cannot serialize function {v!r}; use a registered "
+                "class with a no-arg or captured-config constructor instead")
+        # helper object (Criterion, Regularizer, init method, LR schedule…):
+        # persist as class + captured ctor config, or attribute dict
+        return {"$obj": self.object(v, where)}
+
+    def object(self, v, where):
+        cls = type(v)
+        entry = {"module": cls.__module__, "class": cls.__qualname__}
+        serde = getattr(v, "_serde", None)
+        if serde is not None and serde.get("config") is not None:
+            cfg = dict(serde["config"])
+            if "name" in cfg and getattr(v, "name", None) is not None:
+                cfg["name"] = v.name
+            entry["config"] = {k: self.value(x, f"{where}.{k}")
+                               for k, x in cfg.items()}
+            if serde.get("varargs"):
+                entry["varargs"] = serde["varargs"]
+        else:
+            state = {k: x for k, x in vars(v).items()
+                     if k not in ("output", "grad_input", "_serde")
+                     and not callable(x)}
+            entry["state"] = {k: self.value(x, f"{where}.{k}")
+                              for k, x in state.items()}
+        return entry
+
+    def module(self, m):
+        from ..nn.containers import Container
+        from ..nn.graph import Graph
+        if id(m) in self.index:
+            return self.index[id(m)]
+        idx = len(self.nodes)
+        self.index[id(m)] = idx
+        entry = {}
+        self.nodes.append(entry)   # reserve slot (cycles via children refs)
+        cls = type(m)
+        entry["module"] = cls.__module__
+        entry["class"] = cls.__qualname__
+        entry["name"] = m.name
+
+        serde = getattr(m, "_serde", None)
+        cfg = dict(serde["config"]) if serde and serde.get("config") is not None \
+            else None
+        if cfg is None and not isinstance(m, Graph):
+            # layers with kwargs-only or unbindable ctors: last resort refusal
+            # (better a loud save-time error than a silent bad load)
+            raise SerializationError(
+                f"{m.name} ({cls.__qualname__}): constructor args were not "
+                "captured; give the class an inspectable __init__")
+        if isinstance(m, Graph):
+            entry["graph"] = self.graph(m)
+        else:
+            if "name" in cfg:
+                cfg["name"] = m.name
+            entry["config"] = {k: self.value(v, f"{m.name}.{k}")
+                               for k, v in cfg.items()}
+            if serde.get("varargs"):
+                entry["varargs"] = serde["varargs"]
+        if isinstance(m, Container):
+            entry["children"] = [self.module(c) for c in m.children()]
+        attrs = {}
+        for k in ("weight_init", "bias_init", "w_regularizer",
+                  "b_regularizer"):
+            if getattr(m, k, None) is not None:
+                attrs[k] = self.value(getattr(m, k), f"{m.name}.{k}")
+        for k in ("scale_w", "scale_b"):
+            if getattr(m, k, 1.0) != 1.0:
+                attrs[k] = getattr(m, k)
+        if attrs:
+            entry["attrs"] = attrs
+        return idx
+
+    def graph(self, g):
+        """Node DAG of a Graph container: modules by table ref + edges."""
+        gnodes = list(g._topo)
+        gidx = {id(n): i for i, n in enumerate(gnodes)}
+        return {
+            "nodes": [{"m": None if n.module is None else self.module(n.module),
+                       "prev": [gidx[id(p)] for p in n.prev_nodes]}
+                      for n in gnodes],
+            "inputs": [gidx[id(n)] for n in g.input_nodes],
+            "outputs": [gidx[id(n)] for n in g.output_nodes],
+        }
+
+
+def inspect_isfunction(v):
+    import types
+    return isinstance(v, (types.FunctionType, types.LambdaType,
+                          types.BuiltinFunctionType, types.MethodType))
+
+
+# --------------------------------------------------------------------- #
+# decoding                                                              #
+# --------------------------------------------------------------------- #
+class _Decoder:
+    def __init__(self, topo, read_array):
+        self.nodes = topo["nodes"]
+        self.read_array = read_array
+        self.built = {}
+
+    def resolve_class(self, modname, qualname):
+        key = f"{modname}:{qualname}"
+        if key in _CLASS_REGISTRY:
+            return _CLASS_REGISTRY[key]
+        if not (modname.startswith("bigdl_tpu.") or modname == "bigdl_tpu"):
+            raise SerializationError(
+                f"refusing to import {key!r}: only bigdl_tpu classes and "
+                "serializer.register_class'd classes are loadable")
+        import importlib
+        mod = importlib.import_module(modname)
+        obj = mod
+        for part in qualname.split("."):
+            obj = getattr(obj, part)
+        return obj
+
+    def value(self, v):
+        if v is None or isinstance(v, (bool, int, float, str)):
+            return v
+        if isinstance(v, list):
+            return [self.value(e) for e in v]
+        if isinstance(v, dict):
+            if "$m" in v:
+                return self.module(v["$m"])
+            if "$a" in v:
+                return self.read_array(v["$a"])
+            if "$t" in v:
+                return tuple(self.value(e) for e in v["$t"])
+            if "$dtype" in v:
+                return np.dtype(v["$dtype"]).type
+            if "$dict" in v:
+                return {k: self.value(e) for k, e in v["$dict"].items()}
+            if "$obj" in v:
+                return self.object(v["$obj"])
+        raise SerializationError(f"undecodable value {v!r}")
+
+    def construct(self, cls, entry):
+        cfg = {k: self.value(v) for k, v in entry.get("config", {}).items()}
+        varargs = entry.get("varargs")
+        if varargs and varargs in cfg:
+            import inspect
+            pos, va = [], cfg.pop(varargs)
+            for p in inspect.signature(cls.__init__).parameters.values():
+                if p.name == "self":
+                    continue
+                if p.kind is p.VAR_POSITIONAL:
+                    break
+                if p.name in cfg:
+                    pos.append(cfg.pop(p.name))
+            return cls(*pos, *va, **cfg)
+        return cls(**cfg)
+
+    def object(self, entry):
+        cls = self.resolve_class(entry["module"], entry["class"])
+        if "config" in entry:
+            return self.construct(cls, entry)
+        obj = cls.__new__(cls)
+        for k, v in entry.get("state", {}).items():
+            setattr(obj, k, self.value(v))
+        return obj
+
+    def module(self, idx):
+        if idx in self.built:
+            return self.built[idx]
+        entry = self.nodes[idx]
+        cls = self.resolve_class(entry["module"], entry["class"])
+        if "graph" in entry:
+            m = self.graph(cls, entry["graph"])
+        else:
+            m = self.construct(cls, entry)
+        if m.name != entry["name"]:
+            m.set_name(entry["name"])
+        self.built[idx] = m
+        if "children" in entry:
+            m._children = [self.module(i) for i in entry["children"]]
+        for k, v in entry.get("attrs", {}).items():
+            setattr(m, k, self.value(v) if isinstance(v, (dict, list)) else v)
+        return m
+
+    def graph(self, cls, g):
+        from ..nn.graph import Node
+        nodes = []
+        for spec in g["nodes"]:
+            mod = None if spec["m"] is None else self.module(spec["m"])
+            nodes.append(Node(mod, [nodes[i] for i in spec["prev"]]))
+        return cls([nodes[i] for i in g["inputs"]],
+                   [nodes[i] for i in g["outputs"]])
+
+
+# --------------------------------------------------------------------- #
+# public API                                                            #
+# --------------------------------------------------------------------- #
 def save_module(module, path, overwrite=True):
     import os
     if os.path.exists(path) and not overwrite:
         raise FileExistsError(path)
-    params = module._params
-    state = module._state
-    # detach device arrays before pickling the object graph
-    module._params, module._state = None, {}
-    try:
-        blob = {
-            "module": module,
-            "params": None if params is None else _to_host(params),
-            "state": _to_host(state or {}),
-        }
-        with open(path, "wb") as f:
-            f.write(MAGIC)
-            f.write(VERSION.to_bytes(2, "little"))
-            pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
-    finally:
-        module._params, module._state = params, state
+    enc = _Encoder()
+    root = enc.module(module)
+    topo = {
+        "root": root,
+        "nodes": enc.nodes,
+        "params": None if module._params is None
+        else enc.value(_to_host(module._params), "params"),
+        "state": enc.value(_to_host(module._state or {}), "state"),
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("manifest.json",
+                   json.dumps({"format": _FORMAT, "version": VERSION}))
+        z.writestr("topology.json", json.dumps(topo))
+        for key, arr in enc.arrays.items():
+            buf = io.BytesIO()
+            np.save(buf, arr, allow_pickle=False)
+            z.writestr(key, buf.getvalue())
 
 
 def load_module(path):
     with open(path, "rb") as f:
-        magic = f.read(len(MAGIC))
-        if magic != MAGIC:
-            raise ValueError(f"{path}: not a bigdl_tpu module file")
+        head = f.read(len(MAGIC))
+    if head == MAGIC:
+        return _load_module_v1(path)
+    try:
+        with zipfile.ZipFile(path) as z:
+            # header parsing: malformed JSON / missing entries => bad file
+            try:
+                manifest = json.loads(z.read("manifest.json"))
+                if manifest.get("format") != _FORMAT:
+                    raise SerializationError(
+                        f"{path}: not a bigdl_tpu module file")
+                if manifest.get("version", 0) > VERSION:
+                    raise SerializationError(
+                        f"{path}: unsupported version {manifest['version']}")
+                topo = json.loads(z.read("topology.json"))
+                root = topo["root"]
+            except (json.JSONDecodeError, KeyError) as e:
+                raise SerializationError(
+                    f"{path}: malformed module file "
+                    f"({type(e).__name__}: {e})") from e
+
+            def read_array(key):
+                import jax.numpy as jnp
+                buf = io.BytesIO(z.read(key))   # zip CRC checked here
+                return jnp.asarray(np.load(buf, allow_pickle=False))
+
+            # reconstruction: constructor errors propagate untouched so a
+            # user's module bug isn't misreported as file corruption
+            dec = _Decoder(topo, read_array)
+            module = dec.module(root)
+            if topo.get("params") is not None:
+                module._params = dec.value(topo["params"])
+            module._state = dec.value(topo.get("state", {}))
+            return module
+    except zipfile.BadZipFile as e:
+        raise SerializationError(
+            f"{path}: corrupt or truncated module file ({e})") from e
+
+
+def save_weights_file(module, path):
+    """Params+state only (no topology), same tagged-JSON + .npy zip format."""
+    enc = _Encoder()
+    payload = {
+        "params": None if module._params is None
+        else enc.value(_to_host(module._params), "params"),
+        "state": enc.value(_to_host(module._state or {}), "state"),
+    }
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("manifest.json",
+                   json.dumps({"format": _FORMAT + ".weights",
+                               "version": VERSION}))
+        z.writestr("weights.json", json.dumps(payload))
+        for key, arr in enc.arrays.items():
+            buf = io.BytesIO()
+            np.save(buf, arr, allow_pickle=False)
+            z.writestr(key, buf.getvalue())
+
+
+def load_weights_file(path):
+    """Return (params, state) written by save_weights_file (or the legacy
+    pickle pair written by round-1 Module.save_weights — recognized by the
+    pickle protocol-2+ marker only; anything else is rejected rather than
+    blindly unpickled)."""
+    if not zipfile.is_zipfile(path):
+        with open(path, "rb") as f:
+            head = f.read(2)
+        if len(head) == 2 and head[0] == 0x80 and 2 <= head[1] <= 5:
+            import pickle
+            with open(path, "rb") as f:
+                try:
+                    return pickle.load(f)     # legacy round-1 format
+                except Exception as e:
+                    raise SerializationError(
+                        f"{path}: broken legacy weights pickle ({e})") from e
+        raise SerializationError(
+            f"{path}: not a bigdl_tpu weights file (neither v2 zip nor "
+            "legacy pickle)")
+    try:
+        with zipfile.ZipFile(path) as z:
+            payload = json.loads(z.read("weights.json"))
+
+            def read_array(key):
+                import jax.numpy as jnp
+                buf = io.BytesIO(z.read(key))
+                return jnp.asarray(np.load(buf, allow_pickle=False))
+
+            dec = _Decoder({"nodes": []}, read_array)
+            return dec.value(payload["params"]), dec.value(payload["state"])
+    except (zipfile.BadZipFile, json.JSONDecodeError, KeyError) as e:
+        raise SerializationError(
+            f"{path}: corrupt or truncated weights file ({e})") from e
+
+
+def _load_module_v1(path):
+    """Legacy round-1 container: versioned header + pickle payload.
+
+    Kept one release for migration.  Only load files you wrote yourself —
+    pickle executes arbitrary code by design, which is exactly why v2
+    replaced it.
+    """
+    import pickle
+    with open(path, "rb") as f:
+        f.read(len(MAGIC))
         version = int.from_bytes(f.read(2), "little")
-        if version > VERSION:
-            raise ValueError(f"{path}: unsupported version {version}")
+        if version != 1:
+            raise SerializationError(f"{path}: unsupported legacy version")
         blob = pickle.load(f)
     module = blob["module"]
     if blob["params"] is not None:
@@ -89,7 +463,6 @@ def load_pytree(path, template=None):
 def save_module_orbax(module, path):
     """Params+state as an orbax checkpoint; topology goes alongside as
     JSON (≙ serializer's protobuf topology + weights split)."""
-    import json
     import os
     module.ensure_initialized()
     save_pytree({"params": module._params, "state": module._state or {}},
@@ -101,7 +474,6 @@ def save_module_orbax(module, path):
 def load_module_orbax(module, path):
     """Restore weights saved by save_module_orbax into a compatible module
     instance (topology must match; names are validated)."""
-    import json
     import os
     with open(os.path.join(path, "topology.json")) as f:
         topo = json.load(f)

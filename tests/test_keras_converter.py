@@ -1,0 +1,266 @@
+"""Keras 1.2.2 model-file converter tests (≙ the reference's
+pyspark/test load_keras flow over converter.py DefinitionLoader/WeightLoader).
+
+JSON fixtures are written in the keras-1.2.2 schema by hand; HDF5 weight
+files are written in the keras-1.x layout with h5py; forward numerics are
+verified against torch (independent of both keras and our layer code paths).
+"""
+import json
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from bigdl_tpu.keras import (DefinitionLoader, WeightLoader,
+                             KerasConversionError, load_keras)
+
+
+def _klayer(class_name, **config):
+    return {"class_name": class_name, "config": config}
+
+
+def _sequential_json(*layers):
+    return json.dumps({"class_name": "Sequential",
+                       "keras_version": "1.2.2",
+                       "config": list(layers)})
+
+
+def _write_weights(path, entries):
+    """entries: [(layer_name, [(weight_name, array), ...])]."""
+    with h5py.File(path, "w") as f:
+        f.attrs["layer_names"] = np.array(
+            [e[0].encode() for e in entries], dtype="S64")
+        for lname, ws in entries:
+            g = f.create_group(lname)
+            g.attrs["weight_names"] = np.array(
+                [w[0].encode() for w in ws], dtype="S64")
+            for wname, arr in ws:
+                g.create_dataset(wname, data=arr)
+
+
+def test_lenet_json_hdf5_forward_matches_torch(tmp_path):
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+
+    rng = np.random.RandomState(0)
+    W1 = rng.randn(6, 1, 5, 5).astype(np.float32) * 0.1
+    b1 = rng.randn(6).astype(np.float32) * 0.1
+    W2 = rng.randn(16, 6, 5, 5).astype(np.float32) * 0.1
+    b2 = rng.randn(16).astype(np.float32) * 0.1
+    WD = rng.randn(256, 10).astype(np.float32) * 0.1   # keras layout (in,out)
+    bD = rng.randn(10).astype(np.float32) * 0.1
+
+    jpath = tmp_path / "lenet.json"
+    jpath.write_text(_sequential_json(
+        _klayer("Convolution2D", name="conv1", nb_filter=6, nb_row=5,
+                nb_col=5, activation="relu", border_mode="valid",
+                subsample=[1, 1], dim_ordering="th", bias=True,
+                batch_input_shape=[None, 1, 28, 28]),
+        _klayer("MaxPooling2D", name="pool1", pool_size=[2, 2],
+                strides=[2, 2], border_mode="valid", dim_ordering="th"),
+        _klayer("Convolution2D", name="conv2", nb_filter=16, nb_row=5,
+                nb_col=5, activation="relu", border_mode="valid",
+                subsample=[1, 1], dim_ordering="th", bias=True),
+        _klayer("MaxPooling2D", name="pool2", pool_size=[2, 2],
+                strides=[2, 2], border_mode="valid", dim_ordering="th"),
+        _klayer("Flatten", name="flatten"),
+        _klayer("Dense", name="fc", output_dim=10, activation="softmax",
+                bias=True),
+    ))
+    wpath = tmp_path / "lenet.h5"
+    _write_weights(str(wpath), [
+        ("conv1", [("conv1_W", W1), ("conv1_b", b1)]),
+        ("conv2", [("conv2_W", W2), ("conv2_b", b2)]),
+        ("fc", [("fc_W", WD), ("fc_b", bD)]),
+    ])
+
+    model = load_keras(str(jpath), str(wpath))
+    x = rng.randn(3, 1, 28, 28).astype(np.float32)
+    y = np.asarray(model.predict(x))
+
+    # torch ground truth
+    t = torch.from_numpy(x)
+    t = F.relu(F.conv2d(t, torch.from_numpy(W1), torch.from_numpy(b1)))
+    t = F.max_pool2d(t, 2, 2)
+    t = F.relu(F.conv2d(t, torch.from_numpy(W2), torch.from_numpy(b2)))
+    t = F.max_pool2d(t, 2, 2)
+    t = t.flatten(1)
+    t = t @ torch.from_numpy(WD) + torch.from_numpy(bD)
+    t = F.softmax(t, dim=1)
+    np.testing.assert_allclose(y, t.numpy(), rtol=2e-4, atol=2e-5)
+
+
+def test_dense_bn_model_with_running_stats(tmp_path):
+    rng = np.random.RandomState(1)
+    W = rng.randn(8, 4).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    gamma = rng.rand(4).astype(np.float32) + 0.5
+    beta = rng.randn(4).astype(np.float32)
+    mean = rng.randn(4).astype(np.float32)
+    var = rng.rand(4).astype(np.float32) + 0.5
+
+    jpath = tmp_path / "m.json"
+    jpath.write_text(_sequential_json(
+        _klayer("Dense", name="d1", output_dim=4, activation="linear",
+                bias=True, batch_input_shape=[None, 8]),
+        _klayer("BatchNormalization", name="bn", epsilon=1e-3, mode=0,
+                axis=1, momentum=0.99),
+    ))
+    wpath = tmp_path / "m.h5"
+    _write_weights(str(wpath), [
+        ("d1", [("d1_W", W), ("d1_b", b)]),
+        ("bn", [("bn_gamma", gamma), ("bn_beta", beta),
+                ("bn_running_mean", mean), ("bn_running_std", var)]),
+    ])
+    model = load_keras(str(jpath), str(wpath))
+    x = rng.randn(5, 8).astype(np.float32)
+    y = np.asarray(model.predict(x))
+    ref = (x @ W + b - mean) / np.sqrt(var + 1e-3) * gamma + beta
+    np.testing.assert_allclose(y, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_lstm_weights_match_manual_step(tmp_path):
+    rng = np.random.RandomState(2)
+    D, H, T = 3, 4, 5
+
+    def mk(shape):
+        return rng.randn(*shape).astype(np.float32) * 0.3
+
+    # keras1 LSTM weight order: W_i,U_i,b_i, W_c,U_c,b_c, W_f,U_f,b_f, W_o,U_o,b_o
+    names = ["W_i", "U_i", "b_i", "W_c", "U_c", "b_c",
+             "W_f", "U_f", "b_f", "W_o", "U_o", "b_o"]
+    ws = {}
+    for n in names:
+        ws[n] = mk((D, H)) if n.startswith("W") else (
+            mk((H, H)) if n.startswith("U") else mk((H,)))
+
+    jpath = tmp_path / "lstm.json"
+    jpath.write_text(_sequential_json(
+        _klayer("LSTM", name="lstm", output_dim=H, activation="tanh",
+                inner_activation="sigmoid", return_sequences=True,
+                batch_input_shape=[None, T, D]),
+    ))
+    wpath = tmp_path / "lstm.h5"
+    _write_weights(str(wpath), [
+        ("lstm", [("lstm_" + n, ws[n]) for n in names]),
+    ])
+    model = load_keras(str(jpath), str(wpath))
+    x = rng.randn(2, T, D).astype(np.float32)
+    y = np.asarray(model.predict(x))
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    h = np.zeros((2, H), np.float32)
+    c = np.zeros((2, H), np.float32)
+    outs = []
+    for t in range(T):
+        xt = x[:, t]
+        i = sig(xt @ ws["W_i"] + h @ ws["U_i"] + ws["b_i"])
+        f = sig(xt @ ws["W_f"] + h @ ws["U_f"] + ws["b_f"])
+        g = np.tanh(xt @ ws["W_c"] + h @ ws["U_c"] + ws["b_c"])
+        o = sig(xt @ ws["W_o"] + h @ ws["U_o"] + ws["b_o"])
+        c = f * c + i * g
+        h = o * np.tanh(c)
+        outs.append(h)
+    ref = np.stack(outs, 1)
+    np.testing.assert_allclose(y, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_functional_model_json(tmp_path):
+    rng = np.random.RandomState(3)
+    W1 = rng.randn(6, 8).astype(np.float32)
+    b1 = rng.randn(8).astype(np.float32)
+    W2 = rng.randn(6, 8).astype(np.float32)
+    b2 = rng.randn(8).astype(np.float32)
+
+    spec = {
+        "class_name": "Model",
+        "config": {
+            "name": "m",
+            "layers": [
+                {"class_name": "InputLayer", "name": "in1",
+                 "config": {"batch_input_shape": [None, 6], "name": "in1"},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "name": "a",
+                 "config": {"output_dim": 8, "activation": "relu",
+                            "bias": True, "name": "a"},
+                 "inbound_nodes": [[["in1", 0, 0]]]},
+                {"class_name": "Dense", "name": "b",
+                 "config": {"output_dim": 8, "activation": "relu",
+                            "bias": True, "name": "b"},
+                 "inbound_nodes": [[["in1", 0, 0]]]},
+                {"class_name": "Merge", "name": "add",
+                 "config": {"mode": "sum", "name": "add"},
+                 "inbound_nodes": [[["a", 0, 0], ["b", 0, 0]]]},
+            ],
+            "input_layers": [["in1", 0, 0]],
+            "output_layers": [["add", 0, 0]],
+        },
+    }
+    jpath = tmp_path / "f.json"
+    jpath.write_text(json.dumps(spec))
+    wpath = tmp_path / "f.h5"
+    _write_weights(str(wpath), [
+        ("a", [("a_W", W1), ("a_b", b1)]),
+        ("b", [("b_W", W2), ("b_b", b2)]),
+    ])
+    model = load_keras(str(jpath), str(wpath))
+    x = rng.randn(4, 6).astype(np.float32)
+    y = np.asarray(model.predict(x))
+    ref = (np.maximum(x @ W1 + b1, 0) + np.maximum(x @ W2 + b2, 0))
+    np.testing.assert_allclose(y, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_unsupported_layer_raises(tmp_path):
+    jpath = tmp_path / "bad.json"
+    jpath.write_text(_sequential_json(_klayer("FancyCustomLayer", name="x")))
+    with pytest.raises(KerasConversionError, match="FancyCustomLayer"):
+        DefinitionLoader.from_json_path(str(jpath))
+
+
+def test_tf_dim_ordering_rejected(tmp_path):
+    jpath = tmp_path / "tf.json"
+    jpath.write_text(_sequential_json(
+        _klayer("Convolution2D", name="c", nb_filter=2, nb_row=3, nb_col=3,
+                dim_ordering="tf", batch_input_shape=[None, 8, 8, 3])))
+    with pytest.raises(KerasConversionError, match="dim_ordering"):
+        DefinitionLoader.from_json_path(str(jpath))
+
+
+def test_embedding_gru_sequential(tmp_path):
+    rng = np.random.RandomState(4)
+    V, D, H, T = 10, 3, 4, 6
+    E = rng.randn(V, D).astype(np.float32)
+    names = ["W_z", "U_z", "b_z", "W_r", "U_r", "b_r", "W_h", "U_h", "b_h"]
+    ws = {n: (rng.randn(D, H) if n.startswith("W") else
+              rng.randn(H, H) if n.startswith("U") else
+              rng.randn(H)).astype(np.float32) * 0.3 for n in names}
+    jpath = tmp_path / "g.json"
+    jpath.write_text(_sequential_json(
+        _klayer("Embedding", name="emb", input_dim=V, output_dim=D,
+                input_length=T, batch_input_shape=[None, T]),
+        _klayer("GRU", name="gru", output_dim=H, activation="tanh",
+                inner_activation="sigmoid", return_sequences=False),
+    ))
+    wpath = tmp_path / "g.h5"
+    _write_weights(str(wpath), [
+        ("emb", [("emb_W", E)]),
+        ("gru", [("gru_" + n, ws[n]) for n in names]),
+    ])
+    model = load_keras(str(jpath), str(wpath))
+    ids = rng.randint(0, V, size=(2, T)).astype(np.float32)
+    y = np.asarray(model.predict(ids))
+
+    def sig(v):
+        return 1.0 / (1.0 + np.exp(-v))
+
+    h = np.zeros((2, H), np.float32)
+    for t in range(T):
+        xt = E[ids[:, t].astype(int)]
+        z = sig(xt @ ws["W_z"] + h @ ws["U_z"] + ws["b_z"])
+        r = sig(xt @ ws["W_r"] + h @ ws["U_r"] + ws["b_r"])
+        hh = np.tanh(xt @ ws["W_h"] + (r * h) @ ws["U_h"] + ws["b_h"])
+        h = (1 - z) * hh + z * h
+    np.testing.assert_allclose(y, h, rtol=2e-4, atol=2e-5)

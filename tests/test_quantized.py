@@ -122,3 +122,18 @@ def test_quantized_conv_nhwc_matches_float():
     assert got.shape == want.shape
     rel = np.abs(got - want).max() / np.abs(want).max()
     assert rel < 0.05, rel
+
+
+def test_quantized_conv_mixed_same_explicit_padding():
+    """Regression: pad_h=-1 (SAME) combined with explicit pad_w must pad
+    per-axis like the float layer, not force SAME on both axes."""
+    rs = np.random.RandomState(1)
+    conv = nn.SpatialConvolution(3, 8, 3, 3, 2, 2, -1, 0)
+    conv.reset(0)
+    x = rs.randn(2, 3, 11, 11).astype(np.float32)
+    want = np.asarray(conv.forward(x))
+    qconv = QuantizedSpatialConvolution.from_float(conv)
+    got = np.asarray(qconv.forward(x))
+    assert got.shape == want.shape
+    rel = np.abs(got - want).max() / np.abs(want).max()
+    assert rel < 0.05, rel
