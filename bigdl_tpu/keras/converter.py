@@ -171,8 +171,25 @@ def _merge(cfg):
     mode = cfg.get("mode", "sum")
     if not isinstance(mode, str):
         _unsupported("Merge with a lambda mode")
-    return L.Merge(mode=mode, concat_axis=cfg.get("concat_axis", -1),
-                   name=cfg.get("name"))
+    branches = None
+    if cfg.get("layers"):
+        # Merge-at-the-head-of-a-Sequential: each entry is a full nested
+        # model definition (the branch towers)
+        branches = [DefinitionLoader.from_json_str(json.dumps(spec))
+                    if spec.get("class_name") in ("Sequential", "Model",
+                                                  "Functional")
+                    else _builder(spec["class_name"])(spec["config"])
+                    for spec in cfg["layers"]]
+    in_shape = None
+    if branches is not None:
+        # branch towers carry their own input shapes; the Merge layer's
+        # build shape is one branch's output (used only for the concat dim)
+        out = getattr(branches[0], "output_shape", None)
+        if out is not None:
+            in_shape = tuple(out[1:])
+    return L.Merge(layers=branches, mode=mode,
+                   concat_axis=cfg.get("concat_axis", -1),
+                   input_shape=in_shape, name=cfg.get("name"))
 
 
 def _simple(cls, *fields, defaults=None):
@@ -295,6 +312,12 @@ class DefinitionLoader:
                 nodes[name] = T.Input(shape=tuple(shp[1:]) if shp else None,
                                       name=name)
                 return nodes[name]
+            if len(spec.get("inbound_nodes", [])) > 1:
+                # one layer applied at several call sites shares weights
+                # across sites — not representable here (the reference
+                # converter rejects this too: __check_is_share_weights)
+                _unsupported(f"layer {name!r} applied at multiple call "
+                             "sites (shared weights)")
             in_names = [inb[0] for node in spec["inbound_nodes"]
                         for inb in node]
             ins = [build_node(n) for n in in_names]
@@ -454,7 +477,13 @@ class WeightLoader:
         ordered = [m for m in bmodel.modules()
                    if isinstance(m, L.KerasLayer) and _owns_weights(m)]
         for i, (lname, ws) in enumerate(entries):
-            if by_name and lname in klayers:
+            if by_name:
+                if lname not in klayers:
+                    # silently falling back to positional assignment here
+                    # would overwrite some other layer's weights
+                    raise KerasConversionError(
+                        f"hdf5 layer {lname!r} not found in the model; "
+                        "rename it to match or load with by_name=False")
                 target = klayers[lname]
             elif i < len(ordered):
                 target = ordered[i]

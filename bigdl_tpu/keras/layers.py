@@ -79,6 +79,15 @@ class KerasLayer(Module):
     def children(self):
         return [self.inner] if self.inner is not None else []
 
+    # serde: the built inner module (with its already-initialized param
+    # names) must be persisted and re-attached — rebuilding it from config
+    # would mint fresh auto-names and orphan the saved params
+    _serde_extra_attrs = ("_built_shape",)
+
+    def _serde_restore_children(self, children):
+        if children and children[0] is not None:
+            self.inner = children[0]
+
     def init(self, rng):
         return self.ensure_built().init(rng)
 
@@ -1017,6 +1026,42 @@ class Merge(KerasLayer):
                 par.add(l.ensure_built() if isinstance(l, KerasLayer) else l)
             return N.Sequential().add(par).add(merge)
         return merge
+
+    # -- branch-tower (layers=) support: the layer's input is a TABLE of
+    #    branch inputs, so the single-tensor KerasLayer shape machinery
+    #    must be bypassed -------------------------------------------------
+    def _branch_out_shapes(self):
+        outs = []
+        for l in self.layers:
+            shp = getattr(l, "output_shape", None)
+            if shp is not None:
+                outs.append(tuple(shp))
+            elif isinstance(l, KerasLayer) and l.input_shape is not None:
+                outs.append(tuple(l.compute_output_shape(
+                    (None,) + tuple(l.input_shape))))
+            else:
+                raise ValueError(
+                    f"{self.name}: branch {getattr(l, 'name', l)} has no "
+                    "inferable output shape")
+        return outs
+
+    def ensure_built(self):
+        if self.inner is None and self.layers:
+            self.build(self._branch_out_shapes()[0])
+        return super().ensure_built()
+
+    def compute_output_shape(self, input_shape=None):
+        if not self.layers:
+            return super().compute_output_shape(input_shape)
+        self.ensure_built()
+        outs = self._branch_out_shapes()
+        if self.mode == "concat":
+            ax = self.concat_axis if self.concat_axis != -1 \
+                else len(outs[0]) - 1
+            base = list(outs[0])
+            base[ax] = sum(o[ax] for o in outs)
+            return tuple(base)
+        return outs[0]
 
 
 def merge(inputs, mode="sum", concat_axis=-1, name=None):

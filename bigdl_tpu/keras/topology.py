@@ -165,6 +165,11 @@ class Sequential(KerasModel):
     def children(self):
         return list(self.layer_list)
 
+    _serde_extra_attrs = ("_out_shape",)
+
+    def _serde_restore_children(self, children):
+        self.layer_list = [c for c in children if c is not None]
+
     def init(self, rng):
         p = {}
         for i, l in enumerate(self.layer_list):
@@ -194,6 +199,18 @@ class Model(KerasModel):
 
     def children(self):
         return [self.graph]
+
+    # serde: the ctor signature (graph Nodes) can't be replayed from
+    # config; rebuild around the persisted child Graph instead
+    def _serde_config(self):
+        return {"name": self.name}
+
+    @classmethod
+    def _serde_build(cls, config, children):
+        m = cls.__new__(cls)
+        KerasModel.__init__(m, name=config.get("name"))
+        m.graph = children[0]
+        return m
 
     def init(self, rng):
         return self.graph.init(rng)

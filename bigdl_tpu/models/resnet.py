@@ -24,16 +24,19 @@ class ShortcutType:
 
 
 class _Builder:
-    def __init__(self, shortcut_type=ShortcutType.B, format="NCHW"):
+    def __init__(self, shortcut_type=ShortcutType.B, format="NCHW",
+                 sync_bn_axis=None):
         self.i_channels = 0
         self.shortcut_type = shortcut_type
         self.format = format
+        self.sync_bn_axis = sync_bn_axis
 
     def conv(self, *a, **kw):
         return SpatialConvolution(*a, format=self.format, **kw)
 
     def bn(self, n):
-        return SpatialBatchNormalization(n, format=self.format)
+        return SpatialBatchNormalization(n, format=self.format,
+                                         sync_axis=self.sync_bn_axis)
 
     def shortcut(self, n_input, n_output, stride):
         use_conv = (self.shortcut_type == ShortcutType.C
@@ -108,11 +111,14 @@ _IMAGENET_CFG = {
 
 
 def build(class_num=1000, depth=50, shortcut_type=ShortcutType.B,
-          dataset="imagenet", with_logsoftmax=True, format="NCHW"):
+          dataset="imagenet", with_logsoftmax=True, format="NCHW",
+          sync_bn_axis=None):
     """≙ ResNet.apply (ResNet.scala:240).  format='NHWC' builds the
     TPU-preferred channels-last variant (identical math; feed NHWC
-    inputs)."""
-    b = _Builder(shortcut_type, format=format)
+    inputs).  sync_bn_axis='dp' makes every BN compute cross-replica
+    batch statistics over that mesh axis (sync BN — exact parity with
+    single-chip full-batch stats under data parallelism)."""
+    b = _Builder(shortcut_type, format=format, sync_bn_axis=sync_bn_axis)
     model = Sequential(name=f"ResNet{depth}_{dataset}")
     if dataset == "imagenet":
         cfg = _IMAGENET_CFG[depth]

@@ -25,6 +25,9 @@ class Container(Module):
     def children(self):
         return list(self._children)
 
+    def _serde_restore_children(self, children):
+        self._children = [c for c in children if c is not None]
+
     def __len__(self):
         return len(self._children)
 

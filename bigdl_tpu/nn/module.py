@@ -329,6 +329,38 @@ class Module:
     def children(self):
         return []
 
+    # -- serde hooks (utils/serializer.py v2 format) -------------------- #
+    # extra instance attributes to persist alongside the ctor config
+    _serde_extra_attrs = ()
+
+    def _serde_children(self):
+        """Children to persist (None entries allowed as placeholders)."""
+        return self.children()
+
+    def _serde_restore_children(self, children):
+        """Re-attach deserialized children after config reconstruction.
+
+        Default: no-op — right for leaf modules and for modules whose
+        constructor deterministically rebuilds its children from the
+        replayed config (their persisted children list is then redundant).
+        Classes that accept children post-construction (``add``/attribute
+        assignment) must override this, or a reloaded model silently loses
+        the added children.
+        """
+
+    def _serde_config(self):
+        """Ctor config to persist; None = 'not reconstructible from
+        config' (the class must then override ``_serde_build``)."""
+        serde = getattr(self, "_serde", None)
+        return dict(serde["config"]) if serde and serde.get("config") \
+            is not None else None
+
+    @classmethod
+    def _serde_build(cls, config, children):
+        """Construct from decoded config+children when plain ctor replay
+        can't work.  Return None to use ctor replay (the default)."""
+        return None
+
     def modules(self):
         """Depth-first list of this module and all descendants."""
         out = [self]

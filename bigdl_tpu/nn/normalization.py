@@ -130,13 +130,16 @@ class BatchNormalization(Module):
             self._update_running(ctx, st, mean, var, x)
             return y
         if ctx.training:
-            # sync BN: stats pmean'ed over the mesh axis; autodiff backward
-            # (the collective must appear in the grad graph too)
+            # sync BN: pmean the RAW moments (mean, E[x^2]) over the mesh
+            # axis, then form the variance — pmean'ing per-shard variances
+            # would drop the variance of the shard means and understate the
+            # global variance.  Autodiff backward (the collective must
+            # appear in the grad graph too).
             mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
             m2 = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=axes)
-            var = jnp.maximum(m2 - jnp.square(mean), 0.0)
             mean = lax.pmean(mean, self.sync_axis)
-            var = lax.pmean(var, self.sync_axis)
+            m2 = lax.pmean(m2, self.sync_axis)
+            var = jnp.maximum(m2 - jnp.square(mean), 0.0)
             self._update_running(ctx, st, mean, var, x)
         else:
             mean, var = st["running_mean"], st["running_var"]
@@ -154,7 +157,10 @@ class BatchNormalization(Module):
     def _update_running(self, ctx, st, mean, var, x):
         m = self.momentum
         n = x.size // x.shape[self.channel_axis]
-        unbiased = var * n / max(n - 1, 1)
+        if self.sync_axis is not None and ctx.training:
+            n = n * lax.psum(1, self.sync_axis)  # global batch count
+        unbiased = var * n / max(n - 1, 1) if isinstance(n, int) \
+            else var * n / jnp.maximum(n - 1, 1)
         ctx.put_state(self, {
             "running_mean": (1 - m) * st["running_mean"]
             + m * lax.stop_gradient(mean),

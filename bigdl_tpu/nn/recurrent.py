@@ -314,6 +314,10 @@ class Recurrent(Module):
     def children(self):
         return [self.cell] if self.cell is not None else []
 
+    def _serde_restore_children(self, children):
+        if children and children[0] is not None:
+            self.cell = children[0]
+
     def init(self, rng):
         return self.cell.init(rng)
 
@@ -358,6 +362,13 @@ class BiRecurrent(Module):
 
     def children(self):
         return [c for c in (self.fwd_cell, self.bwd_cell, self.merge) if c]
+
+    def _serde_children(self):
+        # fixed-position slots (None placeholders) so restore is unambiguous
+        return [self.fwd_cell, self.bwd_cell, self.merge]
+
+    def _serde_restore_children(self, children):
+        self.fwd_cell, self.bwd_cell, self.merge = children
 
     def _ensure_bwd(self):
         if self.bwd_cell is None:
@@ -404,6 +415,10 @@ class RecurrentDecoder(Module):
 
     def children(self):
         return [self.cell] if self.cell is not None else []
+
+    def _serde_restore_children(self, children):
+        if children and children[0] is not None:
+            self.cell = children[0]
 
     def init(self, rng):
         return self.cell.init(rng)

@@ -135,7 +135,7 @@ class _Encoder:
         return entry
 
     def module(self, m):
-        from ..nn.containers import Container
+        from ..nn.module import Module
         from ..nn.graph import Graph
         if id(m) in self.index:
             return self.index[id(m)]
@@ -148,26 +148,41 @@ class _Encoder:
         entry["class"] = cls.__qualname__
         entry["name"] = m.name
 
-        serde = getattr(m, "_serde", None)
-        cfg = dict(serde["config"]) if serde and serde.get("config") is not None \
-            else None
-        if cfg is None and not isinstance(m, Graph):
+        custom_build = (cls._serde_build.__func__
+                        is not Module._serde_build.__func__)
+        cfg = m._serde_config()
+        if cfg is None and not (isinstance(m, Graph) or custom_build):
             # layers with kwargs-only or unbindable ctors: last resort refusal
             # (better a loud save-time error than a silent bad load)
             raise SerializationError(
                 f"{m.name} ({cls.__qualname__}): constructor args were not "
-                "captured; give the class an inspectable __init__")
+                "captured; give the class an inspectable __init__ or a "
+                "_serde_build classmethod")
         if isinstance(m, Graph):
             entry["graph"] = self.graph(m)
         else:
-            if "name" in cfg:
-                cfg["name"] = m.name
-            entry["config"] = {k: self.value(v, f"{m.name}.{k}")
-                               for k, v in cfg.items()}
-            if serde.get("varargs"):
-                entry["varargs"] = serde["varargs"]
-        if isinstance(m, Container):
-            entry["children"] = [self.module(c) for c in m.children()]
+            if cfg is not None:
+                if "name" in cfg:
+                    cfg["name"] = m.name
+                entry["config"] = {k: self.value(v, f"{m.name}.{k}")
+                                   for k, v in cfg.items()}
+                serde = getattr(m, "_serde", None)
+                if serde and serde.get("varargs"):
+                    entry["varargs"] = serde["varargs"]
+            # persist children only when the class re-attaches them on load
+            # (default restore is a no-op: ctor replay rebuilds its children)
+            restores = (cls._serde_restore_children
+                        is not Module._serde_restore_children)
+            if restores or custom_build:
+                kids = m._serde_children()
+                if any(c is not None for c in kids):
+                    entry["children"] = [None if c is None else self.module(c)
+                                         for c in kids]
+            extra = {}
+            for k in cls._serde_extra_attrs:
+                extra[k] = self.value(getattr(m, k, None), f"{m.name}.{k}")
+            if extra:
+                entry["extra"] = extra
         attrs = {}
         for k in ("weight_init", "bias_init", "w_regularizer",
                   "b_regularizer"):
@@ -269,22 +284,37 @@ class _Decoder:
         return obj
 
     def module(self, idx):
+        from ..nn.module import Module
         if idx in self.built:
             return self.built[idx]
         entry = self.nodes[idx]
         cls = self.resolve_class(entry["module"], entry["class"])
+        custom_build = (cls._serde_build.__func__
+                        is not Module._serde_build.__func__) \
+            if hasattr(cls, "_serde_build") else False
         if "graph" in entry:
             m = self.graph(cls, entry["graph"])
+        elif custom_build:
+            children = self._children_of(entry)
+            cfg = {k: self.value(v)
+                   for k, v in entry.get("config", {}).items()}
+            m = cls._serde_build(cfg, children)
         else:
             m = self.construct(cls, entry)
         if m.name != entry["name"]:
             m.set_name(entry["name"])
         self.built[idx] = m
-        if "children" in entry:
-            m._children = [self.module(i) for i in entry["children"]]
+        if not custom_build and "children" in entry:
+            m._serde_restore_children(self._children_of(entry))
+        for k, v in entry.get("extra", {}).items():
+            setattr(m, k, self.value(v))
         for k, v in entry.get("attrs", {}).items():
             setattr(m, k, self.value(v) if isinstance(v, (dict, list)) else v)
         return m
+
+    def _children_of(self, entry):
+        return [None if i is None else self.module(i)
+                for i in entry.get("children", [])]
 
     def graph(self, cls, g):
         from ..nn.graph import Node

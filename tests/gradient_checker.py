@@ -19,7 +19,9 @@ def check_gradients(module, x, seed=0, eps=1e-3, rtol=2e-2, atol=1e-3,
     rnd = np.random.RandomState(seed)
 
     # probe input coords (single-tensor inputs only)
-    xf = None if isinstance(x, (list, tuple)) else np.asarray(x, dtype=np.float64)
+    from bigdl_tpu.utils.table import Table
+    xf = None if isinstance(x, (list, tuple, Table)) \
+        else np.asarray(x, dtype=np.float64)
     for _ in range(0 if xf is None else n_probe):
         idx = tuple(rnd.randint(0, s) for s in xf.shape)
         xp, xm = xf.copy(), xf.copy()

@@ -33,9 +33,12 @@ def test_sequential_mnist_style_train():
          + y[:, None, None, None] / 5.0).astype(np.float32)
     m.compile(optimizer="adam", loss="sparse_categorical_crossentropy",
               metrics=["accuracy"])
-    m.fit(x, y, batch_size=16, nb_epoch=2)
+    # enough Adam steps to separate clearly from chance (0.2 for 5
+    # classes) — init depends on auto-name uids, so marginal thresholds
+    # are test-order-flaky
+    m.fit(x, y, batch_size=16, nb_epoch=30)
     res = m.evaluate(x, y)
-    assert res[0][1].result()[0] > 0.2
+    assert res[0][1].result()[0] > 0.4
     preds = m.predict(x[:8])
     assert preds.shape == (8, 5)
     cls = m.predict_classes(x[:8])

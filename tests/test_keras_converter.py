@@ -264,3 +264,74 @@ def test_embedding_gru_sequential(tmp_path):
         hh = np.tanh(xt @ ws["W_h"] + (r * h) @ ws["U_h"] + ws["b_h"])
         h = (1 - z) * hh + z * h
     np.testing.assert_allclose(y, h, rtol=2e-4, atol=2e-5)
+
+
+def test_merge_with_embedded_branches(tmp_path):
+    """Merge(layers=[...]) at the head of a Sequential: branch towers must
+    be built, not silently dropped."""
+    rng = np.random.RandomState(5)
+    W1 = rng.randn(6, 4).astype(np.float32)
+    b1 = rng.randn(4).astype(np.float32)
+    W2 = rng.randn(6, 4).astype(np.float32)
+    b2 = rng.randn(4).astype(np.float32)
+    branch = lambda nm: {"class_name": "Sequential", "config": [
+        _klayer("Dense", name=nm, output_dim=4, activation="linear",
+                bias=True, batch_input_shape=[None, 6])]}
+    jpath = tmp_path / "m.json"
+    jpath.write_text(_sequential_json(
+        {"class_name": "Merge",
+         "config": {"name": "mrg", "mode": "sum", "concat_axis": -1,
+                    "layers": [branch("br1"), branch("br2")]}}))
+    model = DefinitionLoader.from_json_path(str(jpath))
+    wpath = tmp_path / "m.h5"
+    _write_weights(str(wpath), [
+        ("br1", [("br1_W", W1), ("br1_b", b1)]),
+        ("br2", [("br2_W", W2), ("br2_b", b2)]),
+    ])
+    WeightLoader.load_weights_from_hdf5(model, str(wpath))
+    from bigdl_tpu.utils.table import T
+    import jax.numpy as jnp
+    x = rng.randn(3, 6).astype(np.float32)
+    y = np.asarray(model.forward(T(jnp.asarray(x), jnp.asarray(x))))
+    ref = (x @ W1 + b1) + (x @ W2 + b2)
+    np.testing.assert_allclose(y, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_by_name_mismatch_raises(tmp_path):
+    rng = np.random.RandomState(6)
+    jpath = tmp_path / "m.json"
+    jpath.write_text(_sequential_json(
+        _klayer("Dense", name="fc_new", output_dim=2, activation="linear",
+                bias=True, batch_input_shape=[None, 3])))
+    wpath = tmp_path / "m.h5"
+    _write_weights(str(wpath), [
+        ("fc", [("fc_W", rng.randn(3, 2).astype(np.float32)),
+                ("fc_b", rng.randn(2).astype(np.float32))])])
+    with pytest.raises(KerasConversionError, match="fc"):
+        load_keras(str(jpath), str(wpath))
+
+
+def test_shared_layer_multiple_call_sites_rejected(tmp_path):
+    spec = {
+        "class_name": "Model",
+        "config": {
+            "name": "m",
+            "layers": [
+                {"class_name": "InputLayer", "name": "i1",
+                 "config": {"batch_input_shape": [None, 4], "name": "i1"},
+                 "inbound_nodes": []},
+                {"class_name": "InputLayer", "name": "i2",
+                 "config": {"batch_input_shape": [None, 4], "name": "i2"},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "name": "shared",
+                 "config": {"output_dim": 4, "bias": True, "name": "shared"},
+                 "inbound_nodes": [[["i1", 0, 0]], [["i2", 0, 0]]]},
+            ],
+            "input_layers": [["i1", 0, 0], ["i2", 0, 0]],
+            "output_layers": [["shared", 0, 0]],
+        },
+    }
+    jpath = tmp_path / "s.json"
+    jpath.write_text(json.dumps(spec))
+    with pytest.raises(KerasConversionError, match="call sites"):
+        DefinitionLoader.from_json_path(str(jpath))

@@ -128,11 +128,11 @@ def test_corrupted_bytes_fail_cleanly(tmp_path):
     bad.write_bytes(bytes(data))
     try:
         m2 = load_module(str(bad))
-        # if the CRC region survived the flips, the load must still produce
-        # a structurally valid module
-        assert isinstance(m2, nn.Module)
-    except (SerializationError, ValueError, Exception):
-        pass  # clean python exception, never a segfault/hang
+    except (SerializationError, ValueError, OSError, KeyError):
+        return  # clean python exception is the expected outcome
+    # if the CRC region survived the flips, the load must still produce
+    # a structurally valid module
+    assert isinstance(m2, nn.Module)
 
 
 def test_legacy_v1_pickle_still_loads(tmp_path):
@@ -184,3 +184,51 @@ def test_concat_dimension_config_roundtrip(tmp_path):
     x = np.random.RandomState(5).randn(2, 4).astype(np.float32)
     m2 = _roundtrip(m, x, tmp_path)
     assert m2.dimension == 2
+
+
+def test_keras_sequential_roundtrip(tmp_path):
+    """Regression: keras models keep children outside Container._children
+    (layer_list / KerasLayer.inner) — a reloaded model must not collapse
+    to an identity."""
+    from bigdl_tpu import keras as K
+    m = K.Sequential()
+    m.add(K.Dense(4, activation="relu", input_shape=(8,)))
+    m.add(K.Dense(2))
+    x = np.random.RandomState(6).randn(3, 8).astype(np.float32)
+    y1 = np.asarray(m.forward(x))
+    assert y1.shape == (3, 2)
+    path = str(tmp_path / "k.bigdl")
+    m.save(path)
+    m2 = nn.Module.load(path)
+    y2 = np.asarray(m2.forward(x))
+    np.testing.assert_allclose(y1, y2, rtol=1e-6)
+    assert len(m2.children()) == 2
+
+
+def test_keras_functional_model_roundtrip(tmp_path):
+    from bigdl_tpu import keras as K
+    inp = K.Input(shape=(6,))
+    h = K.Dense(8, activation="relu")(inp)
+    out = K.Dense(3)(h)
+    m = K.Model(inp, out)
+    x = np.random.RandomState(7).randn(4, 6).astype(np.float32)
+    y1 = np.asarray(m.forward(x))
+    path = str(tmp_path / "kf.bigdl")
+    m.save(path)
+    m2 = nn.Module.load(path)
+    y2 = np.asarray(m2.forward(x))
+    np.testing.assert_allclose(y1, y2, rtol=1e-6)
+
+
+def test_birecurrent_add_roundtrip(tmp_path):
+    m = nn.BiRecurrent(merge=nn.CAddTable())
+    m.add(nn.LSTM(4, 6))
+    x = np.random.RandomState(8).randn(2, 5, 4).astype(np.float32)
+    _roundtrip(m, x, tmp_path)
+
+
+def test_recurrent_add_roundtrip(tmp_path):
+    m = nn.Recurrent()
+    m.add(nn.GRU(4, 6))
+    x = np.random.RandomState(9).randn(2, 5, 4).astype(np.float32)
+    _roundtrip(m, x, tmp_path)
