@@ -1061,6 +1061,9 @@ class Merge(KerasLayer):
             base = list(outs[0])
             base[ax] = sum(o[ax] for o in outs)
             return tuple(base)
+        if self.mode in ("dot", "cosine"):
+            # reducing modes: row-wise scalar per sample
+            return (outs[0][0],)
         return outs[0]
 
 

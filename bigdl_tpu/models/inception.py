@@ -355,3 +355,100 @@ def build(class_num=1000, version="v1", aux=False, has_dropout=True):
         return (inception_v2(class_num) if aux
                 else inception_v2_no_aux_classifier(class_num))
     raise ValueError(f"unknown inception version {version!r}")
+
+
+# --------------------------------------------------------------------- #
+# BVLC GoogLeNet deploy prototxt (for the Caffe loader path)            #
+# --------------------------------------------------------------------- #
+def _pt_conv(name, bottom, top, nout, k, stride=1, pad=0):
+    return (f'layer {{ name: "{name}" type: "Convolution" '
+            f'bottom: "{bottom}" top: "{top}" convolution_param {{ '
+            f'num_output: {nout} kernel_size: {k} stride: {stride} '
+            f'pad: {pad} }} }}\n'
+            f'layer {{ name: "{name}/relu" type: "ReLU" '
+            f'bottom: "{top}" top: "{top}" }}')
+
+
+def _pt_pool(name, bottom, top, k, stride, pool="MAX", pad=0):
+    return (f'layer {{ name: "{name}" type: "Pooling" '
+            f'bottom: "{bottom}" top: "{top}" pooling_param {{ '
+            f'pool: {pool} kernel_size: {k} stride: {stride} '
+            f'pad: {pad} }} }}')
+
+
+def _pt_inception(name, bottom, c1, r3, c3, r5, c5, pp):
+    """One GoogLeNet inception module: 1x1 / 3x3 / 5x5 / pool-proj concat."""
+    p = []
+    p.append(_pt_conv(f"{name}/1x1", bottom, f"{name}/1x1", c1, 1))
+    p.append(_pt_conv(f"{name}/3x3_reduce", bottom, f"{name}/3x3_reduce",
+                      r3, 1))
+    p.append(_pt_conv(f"{name}/3x3", f"{name}/3x3_reduce", f"{name}/3x3",
+                      c3, 3, pad=1))
+    p.append(_pt_conv(f"{name}/5x5_reduce", bottom, f"{name}/5x5_reduce",
+                      r5, 1))
+    p.append(_pt_conv(f"{name}/5x5", f"{name}/5x5_reduce", f"{name}/5x5",
+                      c5, 5, pad=2))
+    p.append(_pt_pool(f"{name}/pool", bottom, f"{name}/pool", 3, 1, pad=1))
+    p.append(_pt_conv(f"{name}/pool_proj", f"{name}/pool",
+                      f"{name}/pool_proj", pp, 1))
+    p.append(f'layer {{ name: "{name}/output" type: "Concat" '
+             f'bottom: "{name}/1x1" bottom: "{name}/3x3" '
+             f'bottom: "{name}/5x5" bottom: "{name}/pool_proj" '
+             f'top: "{name}/output" }}')
+    return "\n".join(p)
+
+
+def googlenet_v1_deploy_prototxt(class_num=1000, batch=1):
+    """The standard BVLC GoogLeNet (Inception-v1) deploy definition, as a
+    prototxt string for utils/caffe.CaffeLoader — exercises the DAG loader
+    path end-to-end (≙ the reference example/loadmodel Inception flow)."""
+    L = [f'name: "GoogleNet"',
+         'input: "data"',
+         f'input_shape {{\n  dim: {batch}\n  dim: 3\n  dim: 224\n'
+         '  dim: 224\n}',
+         _pt_conv("conv1/7x7_s2", "data", "conv1/7x7_s2", 64, 7, 2, 3),
+         _pt_pool("pool1/3x3_s2", "conv1/7x7_s2", "pool1/3x3_s2", 3, 2),
+         'layer { name: "pool1/norm1" type: "LRN" bottom: "pool1/3x3_s2" '
+         'top: "pool1/norm1" lrn_param { local_size: 5 alpha: 0.0001 '
+         'beta: 0.75 } }',
+         _pt_conv("conv2/3x3_reduce", "pool1/norm1", "conv2/3x3_reduce",
+                  64, 1),
+         _pt_conv("conv2/3x3", "conv2/3x3_reduce", "conv2/3x3", 192, 3,
+                  pad=1),
+         'layer { name: "conv2/norm2" type: "LRN" bottom: "conv2/3x3" '
+         'top: "conv2/norm2" lrn_param { local_size: 5 alpha: 0.0001 '
+         'beta: 0.75 } }',
+         _pt_pool("pool2/3x3_s2", "conv2/norm2", "pool2/3x3_s2", 3, 2),
+         _pt_inception("inception_3a", "pool2/3x3_s2", 64, 96, 128, 16,
+                       32, 32),
+         _pt_inception("inception_3b", "inception_3a/output", 128, 128,
+                       192, 32, 96, 64),
+         _pt_pool("pool3/3x3_s2", "inception_3b/output", "pool3/3x3_s2",
+                  3, 2),
+         _pt_inception("inception_4a", "pool3/3x3_s2", 192, 96, 208, 16,
+                       48, 64),
+         _pt_inception("inception_4b", "inception_4a/output", 160, 112,
+                       224, 24, 64, 64),
+         _pt_inception("inception_4c", "inception_4b/output", 128, 128,
+                       256, 24, 64, 64),
+         _pt_inception("inception_4d", "inception_4c/output", 112, 144,
+                       288, 32, 64, 64),
+         _pt_inception("inception_4e", "inception_4d/output", 256, 160,
+                       320, 32, 128, 128),
+         _pt_pool("pool4/3x3_s2", "inception_4e/output", "pool4/3x3_s2",
+                  3, 2),
+         _pt_inception("inception_5a", "pool4/3x3_s2", 256, 160, 320, 32,
+                       128, 128),
+         _pt_inception("inception_5b", "inception_5a/output", 384, 192,
+                       384, 48, 128, 128),
+         _pt_pool("pool5/7x7_s1", "inception_5b/output", "pool5/7x7_s1",
+                  7, 1, pool="AVE"),
+         'layer { name: "pool5/drop_7x7_s1" type: "Dropout" '
+         'bottom: "pool5/7x7_s1" top: "pool5/7x7_s1" '
+         'dropout_param { dropout_ratio: 0.4 } }',
+         f'layer {{ name: "loss3/classifier" type: "InnerProduct" '
+         f'bottom: "pool5/7x7_s1" top: "loss3/classifier" '
+         f'inner_product_param {{ num_output: {class_num} }} }}',
+         'layer { name: "prob" type: "Softmax" bottom: "loss3/classifier" '
+         'top: "prob" }']
+    return "\n".join(L)

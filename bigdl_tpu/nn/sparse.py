@@ -12,7 +12,8 @@ import jax.numpy as jnp
 
 from .module import Module
 from .init import Xavier, Zeros, init_tensor
-from ..tensor import SparseTensor, sparse_dense_matmul
+from ..tensor import (SparseTensor, sparse_dense_matmul, embedding_bag,
+                      sparse_concat)
 from ..utils.table import Table, as_list
 
 
@@ -93,28 +94,8 @@ class LookupTableSparse(Module):
             ids_sp, weights = x, None
         if not isinstance(ids_sp, SparseTensor):
             raise TypeError("LookupTableSparse input must be a SparseTensor")
-        n_rows = ids_sp.shape[0]
-        rows = ids_sp.row_ids()
-        ids = ids_sp.values.astype(jnp.int32) - 1  # 1-based ids
-        emb = jnp.take(w, jnp.clip(ids, 0, self.n_index - 1), axis=0)
-        if self.max_norm > 0:
-            norms = jnp.linalg.norm(emb, axis=-1, keepdims=True)
-            emb = emb * jnp.minimum(1.0, self.max_norm
-                                    / jnp.maximum(norms, 1e-7))
-        wts = weights if weights is not None else jnp.ones_like(
-            emb[..., 0])
-        summed = jax.ops.segment_sum(emb * wts[:, None], rows,
-                                     num_segments=n_rows)
-        if self.combiner == "sum":
-            return summed
-        denom = jax.ops.segment_sum(
-            wts if weights is not None else jnp.ones_like(wts),
-            rows, num_segments=n_rows)
-        if self.combiner == "mean":
-            return summed / jnp.maximum(denom, 1e-7)[:, None]
-        # sqrtn: divide by sqrt of sum of squared weights
-        denom2 = jax.ops.segment_sum(wts * wts, rows, num_segments=n_rows)
-        return summed / jnp.sqrt(jnp.maximum(denom2, 1e-7))[:, None]
+        return embedding_bag(w, ids_sp, per_id_weights=weights,
+                             combiner=self.combiner, max_norm=self.max_norm)
 
 
 class SparseJoinTable(Module):
@@ -127,19 +108,4 @@ class SparseJoinTable(Module):
         self.dimension = dimension
 
     def apply(self, params, x, ctx):
-        xs = as_list(x)
-        if self.dimension != 2:
-            raise ValueError("SparseJoinTable supports dimension=2")
-        n_rows = xs[0].shape[0]
-        col_off = 0
-        idx_parts, val_parts = [], []
-        for sp in xs:
-            if sp.shape[0] != n_rows:
-                raise ValueError("row counts must match")
-            shifted = sp.indices.at[1].add(col_off)
-            idx_parts.append(shifted)
-            val_parts.append(sp.values)
-            col_off += sp.shape[1]
-        return SparseTensor(jnp.concatenate(idx_parts, axis=1),
-                            jnp.concatenate(val_parts),
-                            (n_rows, col_off))
+        return sparse_concat(as_list(x), dim=self.dimension)

@@ -41,6 +41,69 @@ def index_select(x, dim: int, indices):
     return jnp.take(x, idx, axis=dim - 1)
 
 
+def index_add(x, dim: int, indices, source):
+    """1-based index_add: x[..., indices[i], ...] += source[..., i, ...]
+    (tensor/DenseTensor.scala indexAdd). Duplicate indices accumulate."""
+    idx = jnp.asarray(indices, jnp.int32) - 1
+    sl = [slice(None)] * x.ndim
+    sl[dim - 1] = idx
+    return x.at[tuple(sl)].add(source)
+
+
+def index_copy(x, dim: int, indices, source):
+    """1-based index_copy: x[..., indices[i], ...] = source[..., i, ...]."""
+    idx = jnp.asarray(indices, jnp.int32) - 1
+    sl = [slice(None)] * x.ndim
+    sl[dim - 1] = idx
+    return x.at[tuple(sl)].set(source)
+
+
+def index_fill(x, dim: int, indices, value):
+    """1-based index_fill along dim with a scalar."""
+    idx = jnp.asarray(indices, jnp.int32) - 1
+    sl = [slice(None)] * x.ndim
+    sl[dim - 1] = idx
+    return x.at[tuple(sl)].set(value)
+
+
+def _dim_index(index, dim_axis, ndim):
+    """Build advanced-index grids that address x[i0,..,index[i0,..],..]."""
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in index.shape],
+                         indexing="ij")
+    return tuple(index if a == dim_axis else grids[a] for a in range(ndim))
+
+
+def gather(x, dim: int, index):
+    """torch-style 1-based gather: out[i][j] = x[index[i][j]][j] for dim=1
+    (tensor/DenseTensor.scala gather)."""
+    idx = jnp.asarray(index, jnp.int32) - 1
+    return x[_dim_index(idx, dim - 1, x.ndim)]
+
+
+def scatter(x, dim: int, index, src):
+    """torch-style 1-based scatter: out[index[i][j]][j] = src[i][j] for
+    dim=1 (tensor/DenseTensor.scala scatter)."""
+    idx = jnp.asarray(index, jnp.int32) - 1
+    return x.at[_dim_index(idx, dim - 1, x.ndim)].set(jnp.asarray(src))
+
+
+def scatter_add(x, dim: int, index, src):
+    """torch-style 1-based scatter-add (duplicates accumulate)."""
+    idx = jnp.asarray(index, jnp.int32) - 1
+    return x.at[_dim_index(idx, dim - 1, x.ndim)].add(jnp.asarray(src))
+
+
+def masked_fill(x, mask, value):
+    """x where mask is 0, value where mask is nonzero."""
+    return jnp.where(jnp.asarray(mask).astype(bool), value, x)
+
+
+def masked_select(x, mask):
+    """Host-side masked select (data-dependent size ⇒ not jittable)."""
+    xh, mh = np.asarray(x), np.asarray(mask).astype(bool)
+    return jnp.asarray(xh[mh])
+
+
 # --------------------------------------------------------------------- #
 # sparse (tensor/SparseTensor.scala)                                    #
 # --------------------------------------------------------------------- #
@@ -112,6 +175,54 @@ def sparse_dense_matmul(sp: SparseTensor, dense):
     return jax.ops.segment_sum(contrib, rows, num_segments=sp.shape[0])
 
 
+def embedding_bag(weight, ids_sp: SparseTensor, per_id_weights=None,
+                  combiner="sum", max_norm=-1.0):
+    """Combine embedding rows per sparse-row bag: one gather + one
+    segment_sum (nn/LookupTableSparse.scala's per-row loop, TPU shape).
+
+    ``ids_sp.values`` are 1-based embedding ids; combiner ∈ sum|mean|sqrtn;
+    ``max_norm > 0`` l2-clips each embedding before combining.
+    """
+    if combiner not in ("sum", "mean", "sqrtn"):
+        raise ValueError(f"combiner must be sum|mean|sqrtn: {combiner}")
+    n_rows = ids_sp.shape[0]
+    rows = ids_sp.row_ids()
+    ids = ids_sp.values.astype(jnp.int32) - 1
+    emb = jnp.take(weight, jnp.clip(ids, 0, weight.shape[0] - 1), axis=0)
+    if max_norm > 0:
+        norms = jnp.linalg.norm(emb, axis=-1, keepdims=True)
+        emb = emb * jnp.minimum(1.0, max_norm / jnp.maximum(norms, 1e-7))
+    wts = per_id_weights if per_id_weights is not None \
+        else jnp.ones_like(emb[..., 0])
+    summed = jax.ops.segment_sum(emb * wts[:, None], rows,
+                                 num_segments=n_rows)
+    if combiner == "sum":
+        return summed
+    if combiner == "mean":
+        denom = jax.ops.segment_sum(wts, rows, num_segments=n_rows)
+        return summed / jnp.maximum(denom, 1e-7)[:, None]
+    denom2 = jax.ops.segment_sum(wts * wts, rows, num_segments=n_rows)
+    return summed / jnp.sqrt(jnp.maximum(denom2, 1e-7))[:, None]
+
+
+def sparse_concat(tensors, dim: int = 2):
+    """Concatenate 2-D SparseTensors along columns (1-based dim=2)
+    (tensor/SparseTensor.scala concat)."""
+    if dim != 2:
+        raise ValueError("sparse_concat supports dim=2 (columns)")
+    n_rows = tensors[0].shape[0]
+    col_off = 0
+    idx_parts, val_parts = [], []
+    for sp in tensors:
+        if sp.shape[0] != n_rows:
+            raise ValueError("row counts must match")
+        idx_parts.append(sp.indices.at[1].add(col_off))
+        val_parts.append(sp.values)
+        col_off += sp.shape[1]
+    return SparseTensor(jnp.concatenate(idx_parts, axis=1),
+                        jnp.concatenate(val_parts), (n_rows, col_off))
+
+
 # --------------------------------------------------------------------- #
 # int8 quantization (tensor/QuantizedTensor.scala)                      #
 # --------------------------------------------------------------------- #
@@ -128,3 +239,44 @@ def quantize_symmetric(x, axis=None):
 
 def dequantize(q, scale):
     return q.astype(jnp.float32) * scale
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """int8 values + fp32 scale, x ≈ q * scale (tensor/QuantizedTensor.scala).
+    A pytree, so it flows through jit; ``axis`` records the per-axis
+    quantization dim (None = per-tensor)."""
+
+    def __init__(self, q, scale, axis=None):
+        self.q = jnp.asarray(q, jnp.int8)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        self.axis = axis
+
+    @classmethod
+    def quantize(cls, x, axis=None):
+        q, scale = quantize_symmetric(x, axis=axis)
+        return cls(q, scale, axis=axis)
+
+    def dequantize(self):
+        return dequantize(self.q, self.scale)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    def tree_flatten(self):
+        return (self.q, self.scale), self.axis
+
+    @classmethod
+    def tree_unflatten(cls, axis, children):
+        obj = cls.__new__(cls)
+        obj.q, obj.scale = children
+        obj.axis = axis
+        return obj
+
+    def __repr__(self):
+        return f"QuantizedTensor(shape={self.q.shape}, axis={self.axis})"

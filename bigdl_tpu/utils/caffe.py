@@ -295,7 +295,142 @@ class CaffeLoader:
                     return [int(d) for d in shp.get_list("dim")]
         return None
 
+    def _layer_list(self):
+        return self.net.get_list("layer") + self.net.get_list("layers")
+
+    def _is_chain(self):
+        """True when bottom/top wiring is absent or a pure chain — the
+        Sequential fast path; anything else (multi-bottom Concat/Eltwise,
+        fan-out) builds a Graph like the reference CaffeLoader DAG."""
+        prev_top = None
+        for lp in self._layer_list():
+            if str(lp.get("type", "")).lower() in ("input", "data"):
+                prev_top = lp.get_list("top")[0] if lp.get_list("top") \
+                    else prev_top
+                continue
+            bottoms = lp.get_list("bottom")
+            tops = lp.get_list("top")
+            if len(bottoms) > 1 or len(tops) > 1:
+                return False
+            if bottoms and prev_top is not None and bottoms[0] != prev_top:
+                return False
+            if tops:
+                prev_top = tops[0]
+        return True
+
     def create_module(self):
+        if not self._is_chain():
+            return self._create_graph()
+        return self._create_sequential()
+
+    def _create_graph(self):
+        """DAG deploy nets (GoogLeNet-style): blobs are wired by bottom/top
+        names into an nn.Graph (≙ CaffeLoader.scala's directed graph)."""
+        from ..nn.graph import Graph, Input, Node
+
+        shape = self._input_shape()
+        in_name = str(self.net.get("input", "data"))
+        for lp in self._layer_list():
+            if str(lp.get("type", "")).lower() in ("input", "data") \
+                    and lp.get_list("top"):
+                in_name = lp.get_list("top")[0]
+        # blob name -> (node, channels, spatial)
+        input_node = Input()
+        blobs_env = {in_name: (input_node,
+                               shape[1] if shape and len(shape) >= 2 else None,
+                               tuple(shape[2:]) if shape and len(shape) == 4
+                               else None)}
+        weight_assign = []
+        for lp in self._layer_list():
+            ltype = str(lp.get("type", ""))
+            t = ltype.lower()
+            if t in ("input", "data"):
+                continue
+            name = lp.get("name", f"layer{len(weight_assign)}")
+            bottoms = lp.get_list("bottom")
+            tops = lp.get_list("top") or [name]
+            ins = [blobs_env[b] for b in bottoms]
+            if t == "concat":
+                cp = lp.get("concat_param", PrototxtMessage())
+                axis = int(cp.get("axis", cp.get("concat_dim", 1)))
+                mod = nn.JoinTable(axis + 1)
+                out_ch = sum(c for _, c, _ in ins) if axis == 1 else ins[0][1]
+                spatial = ins[0][2]
+            elif t == "eltwise":
+                ep = lp.get("eltwise_param", PrototxtMessage())
+                op = str(ep.get("operation", "SUM")).upper()
+                mod = {"SUM": nn.CAddTable, "1": nn.CAddTable,
+                       "PROD": nn.CMulTable, "0": nn.CMulTable,
+                       "MAX": nn.CMaxTable, "2": nn.CMaxTable}[op]()
+                out_ch, spatial = ins[0][1], ins[0][2]
+            elif t == "split":
+                for top in tops:
+                    blobs_env[top] = ins[0]
+                continue
+            else:
+                in_ch, spatial = ins[0][1], ins[0][2]
+                if t in ("innerproduct", "inner_product") \
+                        and spatial is not None:
+                    flat = CaffeFlatten()
+                    node = Node(flat, [ins[0][0]])
+                    ins = [(node, in_ch * int(np.prod(spatial)), None)]
+                    in_ch, spatial = ins[0][1], None
+                mod, out_ch = _convert(ltype, lp, in_ch)
+                if out_ch is None:
+                    out_ch = in_ch
+                if spatial is not None and hasattr(mod, "kernel"):
+                    kh, kw = mod.kernel
+                    sh, sw = mod.stride
+                    ph, pw = mod.pad if hasattr(mod, "pad") else (0, 0)
+                    ceil = bool(getattr(mod, "ceil_mode", False))
+
+                    def _osz(i, k, s, p):
+                        num = i + 2 * p - k
+                        return (-(-num // s) if ceil else num // s) + 1
+                    spatial = (_osz(spatial[0], kh, sh, ph),
+                               _osz(spatial[1], kw, sw, pw))
+            mod.set_name(name)
+            node = Node(mod, [n for n, _, _ in ins])
+            out_entry = (node, out_ch, spatial)
+            for top in tops:
+                blobs_env[top] = out_entry
+            weight_assign.append((name, mod))
+
+        # outputs: blobs produced but never consumed
+        consumed = set()
+        for lp in self._layer_list():
+            for b in lp.get_list("bottom"):
+                consumed.add(b)
+        # in-place layers overwrite their blob entry, so take the final
+        # mapping's unconsumed tops (preserving prototxt order)
+        out_nodes, seen = [], set()
+        for blob, (node, _, _) in blobs_env.items():
+            if blob not in consumed and node.module is not None \
+                    and id(node) not in seen:
+                out_nodes.append(node)
+                seen.add(id(node))
+        if not out_nodes:
+            # every blob was consumed (net ends with an in-place layer,
+            # top == bottom): the last layer's node is the output
+            last = weight_assign[-1][1] if weight_assign else None
+            for node, _, _ in blobs_env.values():
+                if node.module is last and last is not None:
+                    out_nodes = [node]
+                    break
+            if not out_nodes:
+                raise ValueError(
+                    "could not determine the DAG output blob (all blobs "
+                    "consumed and no final layer found)")
+        model = Graph([input_node],
+                      out_nodes if len(out_nodes) > 1 else [out_nodes[-1]])
+        params, state = model.init_params(0)
+        for name, mod in weight_assign:
+            if name in self.blobs:
+                self._assign_blobs(mod, self.blobs[name], params, state)
+        model.set_params(params, state)
+        return model
+
+    def _create_sequential(self):
         """Build a Sequential following the prototxt layer order, loading
         weights by layer name (≙ CaffeLoader.createCaffeModel)."""
         shape = self._input_shape()

@@ -299,6 +299,8 @@ class _Decoder:
             cfg = {k: self.value(v)
                    for k, v in entry.get("config", {}).items()}
             m = cls._serde_build(cfg, children)
+            if m is None:           # documented fallback: ctor replay
+                m = self.construct(cls, entry)
         else:
             m = self.construct(cls, entry)
         if m.name != entry["name"]:

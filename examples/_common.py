@@ -10,6 +10,19 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the environment force-registers a TPU PJRT plugin via sitecustomize
+    # (jax already imported with JAX_PLATFORMS=axon); retarget to CPU and
+    # drop the plugin factory so CPU runs never touch the TPU tunnel
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
 
 def parse_args(**defaults):
     p = argparse.ArgumentParser()

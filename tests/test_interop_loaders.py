@@ -168,3 +168,81 @@ def test_caffe_batchnorm_scale_blobs_loaded(tmp_path):
     want = (x - mean[None, :, None, None]) * inv[None, :, None, None]
     want = want * gamma[None, :, None, None] + beta[None, :, None, None]
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_caffe_dag_loader_inception_style(tmp_path):
+    """DAG deploy nets (bottom/top wiring, Concat + Eltwise, in-place ReLU)
+    build an nn.Graph and load weights by name (≙ CaffeLoader's DAG)."""
+    import numpy as np
+    from bigdl_tpu.utils import proto
+    from bigdl_tpu.utils.caffe import load_caffe, _blob_bytes
+
+    pt = """
+name: "dagnet"
+input: "data"
+input_shape { dim: 1 dim: 2 dim: 4 dim: 4 }
+layer { name: "c1" type: "Convolution" bottom: "data" top: "c1"
+  convolution_param { num_output: 3 kernel_size: 1 } }
+layer { name: "c1/relu" type: "ReLU" bottom: "c1" top: "c1" }
+layer { name: "ba" type: "Convolution" bottom: "c1" top: "ba"
+  convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "bb" type: "Convolution" bottom: "c1" top: "bb"
+  convolution_param { num_output: 2 kernel_size: 1 } }
+layer { name: "cat" type: "Concat" bottom: "ba" bottom: "bb" top: "cat" }
+layer { name: "sum" type: "Eltwise" bottom: "cat" bottom: "cat" top: "sum"
+  eltwise_param { operation: SUM } }
+"""
+    ppath = tmp_path / "dag.prototxt"
+    ppath.write_text(pt)
+
+    rng = np.random.RandomState(0)
+    weights = {
+        "c1": [rng.randn(3, 2, 1, 1).astype(np.float32),
+               rng.randn(3).astype(np.float32)],
+        "ba": [rng.randn(2, 3, 1, 1).astype(np.float32),
+               rng.randn(2).astype(np.float32)],
+        "bb": [rng.randn(2, 3, 1, 1).astype(np.float32),
+               rng.randn(2).astype(np.float32)],
+    }
+    body = b""
+    for name, blobs in weights.items():
+        lp = proto.enc_string(1, name)
+        for b in blobs:
+            lp += proto.enc_bytes(7, _blob_bytes(b))
+        body += proto.enc_bytes(100, lp)
+    mpath = tmp_path / "dag.caffemodel"
+    mpath.write_bytes(body)
+
+    model = load_caffe(str(ppath), str(mpath))
+    x = rng.randn(1, 2, 4, 4).astype(np.float32)
+    y = np.asarray(model.forward(x))
+
+    h = np.maximum(
+        np.einsum("oihw,bihw->bohw", weights["c1"][0],
+                  x) + weights["c1"][1][None, :, None, None], 0.0)
+    ba = np.einsum("oi,bihw->bohw", weights["ba"][0][:, :, 0, 0], h) \
+        + weights["ba"][1][None, :, None, None]
+    bb = np.einsum("oi,bihw->bohw", weights["bb"][0][:, :, 0, 0], h) \
+        + weights["bb"][1][None, :, None, None]
+    want = 2 * np.concatenate([ba, bb], axis=1)
+    np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-5)
+
+
+def test_caffe_googlenet_deploy_loads():
+    """The full BVLC GoogLeNet deploy definition builds through the DAG
+    loader and produces (B, classes) probabilities."""
+    import numpy as np
+    from bigdl_tpu.models.inception import googlenet_v1_deploy_prototxt
+    from bigdl_tpu.utils.caffe import parse_prototxt, CaffeLoader
+    import tempfile, os
+
+    pt = googlenet_v1_deploy_prototxt(class_num=12)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "g.prototxt")
+        with open(p, "w") as f:
+            f.write(pt)
+        model = CaffeLoader(p).create_module()
+    x = np.random.RandomState(0).randn(1, 3, 224, 224).astype(np.float32)
+    y = np.asarray(model.forward(x))
+    assert y.shape == (1, 12)
+    np.testing.assert_allclose(y.sum(axis=1), 1.0, rtol=1e-4)

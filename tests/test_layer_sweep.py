@@ -286,7 +286,7 @@ SPECS = {
     # graph / infra (covered in dedicated tests) ----------------------- #
     "Graph": (None,), "StaticGraph": (None,), "DynamicGraph": (None,),
     "DynamicContainer": (None,), "Container": (None,), "Module": (None,),
-    "Node": (None,), "Echo": (lambda: nn.Echo(), lambda: R(3, 5), "f"),
+    "Node": (None,),
     # detection (forward-only, realistic box shapes) ------------------- #
     "PriorBox": (lambda: nn.PriorBox([1.0], img_size=32),
                  lambda: R(1, 4, 4, 4), "f"),
