@@ -52,6 +52,9 @@ class TransformerConfig:
     remat: bool = False             # per-block rematerialisation
     use_ring_attention: bool = False  # sp-sharded seq (needs mesh w/ 'sp')
     tie_embeddings: bool = False
+    moe_experts: int = 0            # >0: SwitchFFN experts ('ep'-sharded)
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
 
     @property
     def head_dim(self):
@@ -179,7 +182,14 @@ class TransformerBlock(Module):
         self.norm1 = RMSNorm(cfg.d_model, name=f"{self.name}.norm1")
         self.attn = MultiHeadAttention(cfg, name=f"{self.name}.attn")
         self.norm2 = RMSNorm(cfg.d_model, name=f"{self.name}.norm2")
-        self.mlp = SwiGLU(cfg, name=f"{self.name}.mlp")
+        if cfg.moe_experts > 0:
+            from ..nn.moe import SwitchFFN
+            self.mlp = SwitchFFN(cfg.d_model, cfg.d_ff, cfg.moe_experts,
+                                 top_k=cfg.moe_top_k,
+                                 capacity_factor=cfg.moe_capacity_factor,
+                                 name=f"{self.name}.moe")
+        else:
+            self.mlp = SwiGLU(cfg, name=f"{self.name}.mlp")
 
     def children(self):
         return [self.norm1, self.attn, self.norm2, self.mlp]

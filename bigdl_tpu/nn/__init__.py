@@ -54,6 +54,7 @@ from .recurrent import (Cell, RnnCell, LSTM, LSTMPeephole, GRU,
                         TimeDistributed)
 from .sparse import SparseLinear, LookupTableSparse, SparseJoinTable
 from .tree import TreeLSTM, BinaryTreeLSTM
+from .moe import SwitchFFN
 from .detection import (Anchor, PriorBox, Nms, Proposal, RoiPooling,
                         DetectionOutputSSD, DetectionOutputFrcnn)
 from .criterion import (ClassNLLCriterion, CrossEntropyCriterion,

@@ -1,0 +1,119 @@
+"""Mixture-of-Experts FFN with expert parallelism (TPU-era addition; the
+reference has no MoE — this extends the transformer flagship the way
+GShard/Switch-Transformer do, mapped to the 'ep' mesh axis).
+
+TPU-first design: routing is ONE softmax + top-k, dispatch/combine are
+dense one-hot einsums over a fixed capacity per expert (static shapes; no
+sorting, no ragged tensors), and the expert FFN is a single batched
+einsum over the leading expert dim.  Under GSPMD the expert dim is
+sharded over the 'ep' mesh axis (and d_ff over 'tp'), so the partitioner
+lowers dispatch/combine to all-to-alls over ICI and each chip runs only
+its local experts.
+
+Load-balancing auxiliary loss (Switch Transformer eq. 4) rides on
+``ctx.add_loss`` so every training driver that sums side losses
+(make_train_step, DistriOptimizer, SpmdTrainer) picks it up.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .module import Module
+
+
+class SwitchFFN(Module):
+    """Top-k routed SwiGLU experts with fixed capacity.
+
+    Input (B, S, d_model) -> output (B, S, d_model).  ``n_experts`` is
+    sharded over the 'ep' mesh axis when present (pspec below);
+    ``capacity_factor`` bounds tokens per expert at
+    ceil(top_k * tokens / n_experts * capacity_factor) — overflow tokens
+    are dropped (their combine weight is zero), underflow slots compute
+    zeros, exactly as in Switch Transformer.
+    """
+
+    def __init__(self, d_model, d_ff, n_experts, top_k=1,
+                 capacity_factor=1.25, aux_loss_weight=1e-2,
+                 router_noise=0.0, name=None):
+        super().__init__(name=name)
+        if top_k not in (1, 2):
+            raise ValueError("top_k must be 1 or 2")
+        self.d_model = d_model
+        self.d_ff = d_ff
+        self.n_experts = n_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.aux_loss_weight = aux_loss_weight
+        self.router_noise = router_noise
+        self.pspec = {"router": P(None, None),
+                      "w1": P("ep", None, "tp"),
+                      "w3": P("ep", None, "tp"),
+                      "w2": P("ep", "tp", None)}
+
+    def init(self, rng):
+        k0, k1, k2, k3 = jax.random.split(rng, 4)
+        E, D, F = self.n_experts, self.d_model, self.d_ff
+        s_in, s_out = D ** -0.5, F ** -0.5
+        return {self.name: {
+            "router": jax.random.normal(k0, (D, E), jnp.float32) * s_in,
+            "w1": jax.random.normal(k1, (E, D, F), jnp.float32) * s_in,
+            "w3": jax.random.normal(k3, (E, D, F), jnp.float32) * s_in,
+            "w2": jax.random.normal(k2, (E, F, D), jnp.float32) * s_out,
+        }}
+
+    def _capacity(self, n_tokens):
+        cap = int(self.top_k * n_tokens / self.n_experts
+                  * self.capacity_factor + 0.999)
+        return max(cap, 1)
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        dt = x.dtype
+        B, S, D = x.shape
+        E = self.n_experts
+        N = B * S
+        C = self._capacity(N)
+        xt = x.reshape(N, D)
+
+        # ---- routing (fp32 for a stable softmax) --------------------- #
+        logits = jnp.dot(xt.astype(jnp.float32), p["router"])
+        if ctx.training and self.router_noise > 0.0:
+            logits = logits + self.router_noise * jax.random.normal(
+                ctx.rng(self), logits.shape)
+        probs = jax.nn.softmax(logits, axis=-1)            # (N, E)
+
+        gates = jnp.zeros((N, E), jnp.float32)
+        masked = probs
+        for _ in range(self.top_k):
+            idx = jnp.argmax(masked, axis=-1)
+            onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)
+            gates = gates + onehot * probs
+            masked = masked * (1.0 - onehot)
+        sel = gates > 0.0                                   # (N, E) bool
+
+        # ---- capacity assignment: position of each token in its expert #
+        pos = jnp.cumsum(sel.astype(jnp.int32), axis=0) - 1  # (N, E)
+        keep = sel & (pos < C)
+        # dispatch/combine tensors (N, E, C): one-hot over capacity slots
+        slot = jax.nn.one_hot(jnp.where(keep, pos, -1), C,
+                              dtype=jnp.float32)            # (N, E, C)
+        combine = slot * gates[..., None]                   # weights in slots
+
+        # ---- expert computation (batched over E) --------------------- #
+        expert_in = jnp.einsum("nec,nd->ecd", slot.astype(dt), xt)
+        h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", expert_in,
+                                   p["w1"].astype(dt))) \
+            * jnp.einsum("ecd,edf->ecf", expert_in, p["w3"].astype(dt))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, p["w2"].astype(dt))
+        out = jnp.einsum("nec,ecd->nd", combine.astype(dt), expert_out)
+
+        # ---- load-balancing aux loss (Switch eq. 4) ------------------ #
+        if ctx.training and self.aux_loss_weight > 0.0:
+            frac_tokens = jnp.mean(sel.astype(jnp.float32), axis=0)
+            frac_probs = jnp.mean(probs, axis=0)
+            aux = E * jnp.sum(frac_tokens * frac_probs) / self.top_k
+            ctx.add_loss(self.aux_loss_weight * aux.astype(jnp.float32))
+
+        return out.reshape(B, S, D)

@@ -144,8 +144,13 @@ class SpmdTrainer:
 
         def step(params, opt_state, tokens, targets, rng):
             def loss_fn(p):
-                logits, _ = model.run(p, tokens, training=True, rng=rng)
-                return lm_cross_entropy(logits, targets)
+                from ..nn.module import Ctx
+                ctx = Ctx(state={}, training=True, rng_key=rng)
+                logits = model.apply(p, tokens, ctx)
+                loss = lm_cross_entropy(logits, targets)
+                for sl in ctx.side_losses:   # e.g. MoE load-balancing aux
+                    loss = loss + sl
+                return loss
             loss, grads = jax.value_and_grad(loss_fn)(params)
             new_params, new_opt = optim.update(grads, params, opt_state)
             return new_params, new_opt, loss

@@ -276,6 +276,9 @@ SPECS = {
     "BinaryTreeLSTM": (None,),   # tree-structured input; test_layers_extra
     # embedding-ish / misc -------------------------------------------- #
     "Highway": (lambda: nn.Highway(5), lambda: R(3, 5)),
+    "SwitchFFN": (lambda: nn.SwitchFFN(6, 8, 2, capacity_factor=8.0,
+                                       aux_loss_weight=0.0),
+                  lambda: R(2, 4, 6)),
     "ActivityRegularization": (lambda: nn.ActivityRegularization(0.1, 0.1),
                                lambda: R(3, 5)),
     "L1Penalty": (lambda: nn.L1Penalty(0.1), lambda: R(3, 5)),

@@ -1,0 +1,129 @@
+"""SwitchFFN mixture-of-experts tests: routing math vs a dense reference,
+capacity drop behavior, aux loss plumbing, and ep-sharded parity on the
+virtual CPU mesh."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import Ctx
+
+
+def _dense_reference(p, x, top_k):
+    """Straight per-token computation: route, run top-k experts, combine."""
+    N, D = x.shape
+    E = p["router"].shape[1]
+    logits = x @ p["router"]
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs = probs / probs.sum(-1, keepdims=True)
+    out = np.zeros_like(x)
+    for n in range(N):
+        order = np.argsort(-probs[n])[:top_k]
+        for e in order:
+            h = (x[n] @ p["w1"][e])
+            h = h / (1 + np.exp(-h)) * (x[n] @ p["w3"][e])
+            out[n] += probs[n, e] * (h @ p["w2"][e])
+    return out
+
+
+def test_switch_ffn_matches_dense_reference():
+    rng = np.random.RandomState(0)
+    B, S, D, F, E = 2, 6, 8, 16, 4
+    m = nn.SwitchFFN(D, F, E, top_k=2, capacity_factor=8.0,
+                     aux_loss_weight=0.0)
+    params, _ = m.init_params(0)
+    x = rng.randn(B, S, D).astype(np.float32) * 0.5
+    y = np.asarray(m.run(params, jnp.asarray(x))[0])
+    p = {k: np.asarray(v) for k, v in params[m.name].items()}
+    want = _dense_reference(p, x.reshape(-1, D), top_k=2).reshape(B, S, D)
+    np.testing.assert_allclose(y, want, rtol=2e-4, atol=2e-5)
+
+
+def test_capacity_drops_overflow_tokens():
+    rng = np.random.RandomState(1)
+    D, F, E = 4, 8, 2
+    # capacity_factor tiny: at most 1 slot per expert
+    m = nn.SwitchFFN(D, F, E, top_k=1, capacity_factor=0.01,
+                     aux_loss_weight=0.0)
+    params, _ = m.init_params(0)
+    x = jnp.asarray(rng.randn(1, 8, D).astype(np.float32))
+    y = np.asarray(m.run(params, x)[0])
+    # at most 2 tokens (1 per expert) can have nonzero output
+    nonzero = (np.abs(y[0]).sum(-1) > 1e-7).sum()
+    assert nonzero <= 2, nonzero
+
+
+def test_aux_loss_flows_through_ctx():
+    rng = np.random.RandomState(2)
+    m = nn.SwitchFFN(4, 8, 2, top_k=1, aux_loss_weight=0.1)
+    params, _ = m.init_params(0)
+    ctx = Ctx(state={}, training=True, rng_key=jax.random.PRNGKey(0))
+    m.apply(params, jnp.asarray(rng.randn(1, 4, 4), jnp.float32), ctx)
+    assert len(ctx.side_losses) == 1
+    aux = float(ctx.side_losses[0])
+    assert aux >= 0.1 * 0.999  # Switch aux is >= 1 at perfect balance
+
+    # eval mode: no aux loss
+    ctx2 = Ctx(state={}, training=False)
+    m.apply(params, jnp.asarray(rng.randn(1, 4, 4), jnp.float32), ctx2)
+    assert not ctx2.side_losses
+
+
+def test_moe_transformer_ep_sharded_matches_dp_only():
+    """MoE transformer on a dp×ep(×tp) mesh must track the dp-only
+    trajectory — the ep partitioning is layout, not math."""
+    from bigdl_tpu.models.transformer import TransformerLM, TransformerConfig
+    from bigdl_tpu.parallel import mesh as mesh_lib
+    from bigdl_tpu.parallel.spmd import SpmdTrainer
+    from bigdl_tpu.optim import SGD
+
+    rng = np.random.RandomState(3)
+    tokens = rng.randint(0, 64, (4, 16)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=1).astype(np.int32)
+
+    def make_model():
+        cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
+                                n_heads=4, d_ff=32, max_len=16,
+                                dropout=0.0, moe_experts=4, moe_top_k=2)
+        return TransformerLM(cfg)
+
+    losses = []
+    for axes in ({"dp": 4}, {"dp": 2, "ep": 2, "tp": 2}):
+        mesh = mesh_lib.create_mesh(axes)
+        tr = SpmdTrainer(make_model(), SGD(learning_rate=0.1), mesh=mesh,
+                         fsdp=False, seed=7)
+        l0 = float(tr.step(tokens, targets))
+        l1 = float(tr.step(tokens, targets))
+        losses.append((l0, l1))
+        tr.detach()
+
+    (a0, a1), (b0, b1) = losses
+    assert abs(a0 - b0) < 1e-4, (a0, b0)
+    assert abs(a1 - b1) < 1e-4, (a1, b1)
+
+
+def test_moe_aux_loss_included_in_spmd_loss():
+    """SpmdTrainer's loss must include the Switch aux term (≥ CE alone)."""
+    from bigdl_tpu.models.transformer import (TransformerLM,
+                                              TransformerConfig,
+                                              lm_cross_entropy)
+    from bigdl_tpu.parallel import mesh as mesh_lib
+    from bigdl_tpu.parallel.spmd import SpmdTrainer
+    from bigdl_tpu.optim import SGD
+
+    rng = np.random.RandomState(4)
+    tokens = rng.randint(0, 64, (2, 16)).astype(np.int32)
+    targets = np.roll(tokens, -1, axis=1).astype(np.int32)
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=1,
+                            n_heads=4, d_ff=32, max_len=16, dropout=0.0,
+                            moe_experts=4, moe_top_k=1)
+    model = TransformerLM(cfg)
+    mesh = mesh_lib.create_mesh({"dp": 2})
+    tr = SpmdTrainer(model, SGD(learning_rate=0.0), mesh=mesh, fsdp=False,
+                     seed=5)
+    total = float(tr.step(tokens, targets))
+    # lr=0 step leaves params untouched: recompute CE alone to compare
+    logits, _ = model.run(tr.params, jnp.asarray(tokens), training=False)
+    ce = float(lm_cross_entropy(logits, jnp.asarray(targets)))
+    assert total > ce + 1e-4, (total, ce)
+    tr.detach()
