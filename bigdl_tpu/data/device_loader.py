@@ -1,0 +1,81 @@
+"""Background host→device staging (≙ the reference Engine's prefetching
+data pipeline: dataset/DataSet.scala iterators feed a thread pool so the
+compute thread never blocks on IO/conversion).
+
+On TPU the equivalent stall is host staging: numpy conversion +
+``jax.device_put`` of the next minibatch serialize with the device
+dispatch when done inline.  :class:`DeviceLoader` runs the producer
+iterator (conversion + placement included) on a background thread with a
+bounded queue, so batch N+1 stages into HBM while step N executes —
+classic double buffering for ``depth=2``.
+
+Used by ``Optimizer.set_prefetch(depth)``; composable with the native
+record prefetcher (bigdl_tpu.native.NativePrefetcher) for the file->host
+half of the pipeline.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+
+class _End:
+    pass
+
+
+class _Raise:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+class DeviceLoader:
+    """Iterate ``source`` on a background thread, ``depth`` items ahead.
+
+    The producer thread runs everything the source generator does —
+    decode, augment, device_put (jax dispatch is thread-safe) — and
+    exceptions re-raise at the consumer's next pull.  Early consumer exit
+    (break / GC) signals the producer to stop instead of deadlocking on
+    the bounded queue.
+    """
+
+    def __init__(self, source, depth: int = 2):
+        if depth < 1:
+            raise ValueError("depth must be >= 1")
+        self.source = source
+        self.depth = depth
+
+    def __iter__(self):
+        q: queue.Queue = queue.Queue(self.depth)
+        stop = threading.Event()
+
+        def fill():
+            try:
+                for item in self.source:
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
+                q.put(_End())
+            except BaseException as e:  # re-raised on the consumer side
+                try:
+                    q.put(_Raise(e), timeout=1.0)
+                except queue.Full:
+                    pass
+
+        t = threading.Thread(target=fill, daemon=True,
+                             name="bigdl-tpu-device-loader")
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if isinstance(item, _End):
+                    return
+                if isinstance(item, _Raise):
+                    raise item.exc
+                yield item
+        finally:
+            stop.set()

@@ -155,6 +155,7 @@ class Optimizer:
         # failure recovery (≙ DistriOptimizer.scala optimize() retry loop:
         # failed iterations restart from the cached model state)
         self.max_retries = 0
+        self.prefetch_depth = 0
         self._retry_cache = None
 
     # -- fluent config, reference API ----------------------------------- #
@@ -192,6 +193,13 @@ class Optimizer:
 
     def set_mixed_precision(self, enabled=True):
         self.mixed_precision = enabled
+        return self
+
+    def set_prefetch(self, depth=2):
+        """Stage minibatches to the device from a background thread,
+        `depth` batches ahead (double buffering at the default; ≙ the
+        reference Engine's prefetching iterators)."""
+        self.prefetch_depth = depth
         return self
 
     def set_auto_retry(self, max_retries):
@@ -370,11 +378,20 @@ class Optimizer:
         self.state.epoch_finished = False
         epoch_start = time.time()
         n_seen = 0
+
+        def staged():
+            for mb in self.dataset.data(train=True):
+                x, y = _mb_to_arrays(mb)
+                yield mb.size(), *self._place_batch(x, y)
+
+        batches = staged()
+        if self.prefetch_depth:
+            from ..data.device_loader import DeviceLoader
+            batches = iter(DeviceLoader(batches, self.prefetch_depth))
+
         data_t = time.time()
-        for mb in self.dataset.data(train=True):
+        for size, x, y in batches:
             wait = time.time() - data_t
-            x, y = _mb_to_arrays(mb)
-            x, y = self._place_batch(x, y)
             rng, sub = jax.random.split(rng)
             t0 = time.time()
             params, opt_state, model_state, loss = step_fn(
@@ -384,7 +401,7 @@ class Optimizer:
             dispatch = time.time() - t0
             self.state.iteration += 1
             self.state.loss = loss
-            n_seen += mb.size()
+            n_seen += size
             self.metrics.add("data wait time", wait)
             self.metrics.add("dispatch time", dispatch)
             if self.train_summary is not None:

@@ -1,0 +1,180 @@
+"""Criterion numerics vs torch ground truth (≙ the reference's
+per-criterion Spec files, which validate against Torch7).  Each case
+checks the loss VALUE and the input GRADIENT against torch.nn losses,
+minding the 1-based label convention on our side."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from bigdl_tpu import nn
+
+
+def _t(a):
+    return torch.from_numpy(np.asarray(a)).clone().requires_grad_(
+        np.issubdtype(np.asarray(a).dtype, np.floating))
+
+
+def _parity(crit, tloss, out, target, t_out=None, t_target=None,
+            rtol=1e-4, atol=1e-5):
+    got = float(crit.forward(jnp.asarray(out), jnp.asarray(target)))
+    grad = np.asarray(crit.backward(jnp.asarray(out), jnp.asarray(target)))
+
+    to = _t(out if t_out is None else t_out)
+    tt = t_target if t_target is not None else torch.from_numpy(
+        np.asarray(target))
+    want = tloss(to, tt)
+    want.backward()
+    np.testing.assert_allclose(got, float(want.detach()), rtol=rtol,
+                               atol=atol)
+    np.testing.assert_allclose(grad, to.grad.numpy(), rtol=rtol, atol=atol)
+
+
+RNG = np.random.RandomState(0)
+
+
+def test_abs_criterion():
+    out = RNG.randn(4, 5).astype(np.float32)
+    tgt = RNG.randn(4, 5).astype(np.float32)
+    _parity(nn.AbsCriterion(), torch.nn.L1Loss(), out, tgt)
+
+
+def test_mse_criterion():
+    out = RNG.randn(4, 5).astype(np.float32)
+    tgt = RNG.randn(4, 5).astype(np.float32)
+    _parity(nn.MSECriterion(), torch.nn.MSELoss(), out, tgt)
+
+
+def test_bce_criterion():
+    out = RNG.rand(4, 5).astype(np.float32) * 0.9 + 0.05
+    tgt = (RNG.rand(4, 5) > 0.5).astype(np.float32)
+    _parity(nn.BCECriterion(), torch.nn.BCELoss(), out, tgt)
+
+
+def test_class_nll_criterion():
+    logp = np.log(np.clip(RNG.dirichlet(np.ones(6), 4), 1e-6, 1)) \
+        .astype(np.float32)
+    y1 = RNG.randint(1, 7, 4).astype(np.float32)      # ours 1-based
+    crit = nn.ClassNLLCriterion()
+    got = float(crit.forward(jnp.asarray(logp), jnp.asarray(y1)))
+    grad = np.asarray(crit.backward(jnp.asarray(logp), jnp.asarray(y1)))
+    to = _t(logp)
+    want = torch.nn.NLLLoss()(to, torch.from_numpy((y1 - 1).astype(np.int64)))
+    want.backward()
+    np.testing.assert_allclose(got, float(want), rtol=1e-4)
+    np.testing.assert_allclose(grad, to.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+
+def test_cross_entropy_criterion():
+    logits = RNG.randn(5, 7).astype(np.float32)
+    y1 = RNG.randint(1, 8, 5).astype(np.float32)
+    crit = nn.CrossEntropyCriterion()
+    got = float(crit.forward(jnp.asarray(logits), jnp.asarray(y1)))
+    grad = np.asarray(crit.backward(jnp.asarray(logits), jnp.asarray(y1)))
+    to = _t(logits)
+    want = torch.nn.CrossEntropyLoss()(
+        to, torch.from_numpy((y1 - 1).astype(np.int64)))
+    want.backward()
+    np.testing.assert_allclose(got, float(want), rtol=1e-4)
+    np.testing.assert_allclose(grad, to.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+
+def test_smooth_l1_criterion():
+    out = RNG.randn(4, 5).astype(np.float32)
+    tgt = RNG.randn(4, 5).astype(np.float32)
+    _parity(nn.SmoothL1Criterion(), torch.nn.SmoothL1Loss(), out, tgt)
+
+
+def test_dist_kl_div_criterion():
+    logp = np.log(np.clip(RNG.dirichlet(np.ones(5), 4), 1e-6, 1)) \
+        .astype(np.float32)
+    tgt = RNG.dirichlet(np.ones(5), 4).astype(np.float32)
+    _parity(nn.DistKLDivCriterion(),
+            torch.nn.KLDivLoss(reduction="batchmean"), logp, tgt,
+            rtol=1e-3)
+
+
+def test_soft_margin_criterion():
+    out = RNG.randn(4, 5).astype(np.float32)
+    tgt = np.where(RNG.rand(4, 5) > 0.5, 1.0, -1.0).astype(np.float32)
+    _parity(nn.SoftMarginCriterion(), torch.nn.SoftMarginLoss(), out, tgt)
+
+
+def test_hinge_embedding_criterion():
+    out = RNG.rand(6).astype(np.float32) * 2
+    tgt = np.where(RNG.rand(6) > 0.5, 1.0, -1.0).astype(np.float32)
+    _parity(nn.HingeEmbeddingCriterion(margin=1.0),
+            torch.nn.HingeEmbeddingLoss(margin=1.0), out, tgt)
+
+
+def test_multi_margin_criterion():
+    out = RNG.randn(4, 6).astype(np.float32)
+    y1 = RNG.randint(1, 7, 4).astype(np.float32)
+    crit = nn.MultiMarginCriterion()
+    got = float(crit.forward(jnp.asarray(out), jnp.asarray(y1)))
+    grad = np.asarray(crit.backward(jnp.asarray(out), jnp.asarray(y1)))
+    to = _t(out)
+    want = torch.nn.MultiMarginLoss()(
+        to, torch.from_numpy((y1 - 1).astype(np.int64)))
+    want.backward()
+    np.testing.assert_allclose(got, float(want), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(grad, to.grad.numpy(), rtol=1e-4, atol=1e-6)
+
+
+def test_multi_label_soft_margin_criterion():
+    out = RNG.randn(4, 6).astype(np.float32)
+    tgt = (RNG.rand(4, 6) > 0.5).astype(np.float32)
+    _parity(nn.MultiLabelSoftMarginCriterion(),
+            torch.nn.MultiLabelSoftMarginLoss(), out, tgt, rtol=1e-3)
+
+
+def test_margin_ranking_criterion_scalar():
+    a = RNG.randn(5).astype(np.float32)
+    b = RNG.randn(5).astype(np.float32)
+    y = np.where(RNG.rand(5) > 0.5, 1.0, -1.0).astype(np.float32)
+    from bigdl_tpu.utils.table import T
+    crit = nn.MarginRankingCriterion(margin=0.5)
+    got = float(crit.forward(T(jnp.asarray(a), jnp.asarray(b)),
+                             jnp.asarray(y)))
+    ta, tb = _t(a), _t(b)
+    want = torch.nn.MarginRankingLoss(margin=0.5)(
+        ta, tb, torch.from_numpy(y))
+    np.testing.assert_allclose(got, float(want), rtol=1e-4)
+
+
+def test_cosine_embedding_criterion():
+    a = RNG.randn(4, 6).astype(np.float32)
+    b = RNG.randn(4, 6).astype(np.float32)
+    y = np.where(RNG.rand(4) > 0.5, 1.0, -1.0).astype(np.float32)
+    from bigdl_tpu.utils.table import T
+    crit = nn.CosineEmbeddingCriterion(margin=0.2)
+    got = float(crit.forward(T(jnp.asarray(a), jnp.asarray(b)),
+                             jnp.asarray(y)))
+    want = torch.nn.CosineEmbeddingLoss(margin=0.2)(
+        torch.from_numpy(a), torch.from_numpy(b), torch.from_numpy(y))
+    np.testing.assert_allclose(got, float(want), rtol=1e-4)
+
+
+def test_poisson_criterion():
+    out = (RNG.rand(4, 5).astype(np.float32) + 0.2)
+    tgt = RNG.poisson(2.0, (4, 5)).astype(np.float32)
+    _parity(nn.PoissonCriterion(),
+            torch.nn.PoissonNLLLoss(log_input=False, full=False),
+            out, tgt, rtol=1e-3)
+
+
+def test_multi_label_margin_criterion():
+    out = RNG.randn(3, 5).astype(np.float32)
+    # ours: 1-based label lists padded with 0; torch: 0-based padded with -1
+    tgt1 = np.array([[2, 4, 0, 0, 0], [1, 0, 0, 0, 0], [3, 5, 1, 0, 0]],
+                    np.float32)
+    crit = nn.MultiLabelMarginCriterion()
+    got = float(crit.forward(jnp.asarray(out), jnp.asarray(tgt1)))
+    grad = np.asarray(crit.backward(jnp.asarray(out), jnp.asarray(tgt1)))
+    to = _t(out)
+    ttgt = torch.from_numpy((tgt1 - 1).astype(np.int64))
+    want = torch.nn.MultiLabelMarginLoss()(to, ttgt)
+    want.backward()
+    np.testing.assert_allclose(got, float(want), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(grad, to.grad.numpy(), rtol=1e-4, atol=1e-6)

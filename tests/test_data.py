@@ -295,3 +295,62 @@ def test_seqfile_vint():
         buf = write_vint(v)
         got, pos = read_vint(buf, 0)
         assert got == v and pos == len(buf), v
+
+
+class TestDeviceLoader:
+    def test_order_and_completeness(self):
+        from bigdl_tpu.data.device_loader import DeviceLoader
+        got = list(DeviceLoader(iter(range(57)), depth=3))
+        assert got == list(range(57))
+
+    def test_exception_propagates(self):
+        from bigdl_tpu.data.device_loader import DeviceLoader
+        import pytest
+
+        def boom():
+            yield 1
+            raise RuntimeError("producer failed")
+
+        it = iter(DeviceLoader(boom(), depth=2))
+        assert next(it) == 1
+        with pytest.raises(RuntimeError, match="producer failed"):
+            list(it)
+
+    def test_early_break_does_not_hang(self):
+        from bigdl_tpu.data.device_loader import DeviceLoader
+        import itertools
+        import threading
+        before = threading.active_count()
+        for i, v in enumerate(DeviceLoader(itertools.count(), depth=2)):
+            if i >= 5:
+                break
+        import time
+        time.sleep(0.4)  # producer notices the stop event
+        assert threading.active_count() <= before + 1
+
+    def test_training_with_prefetch_matches_without(self):
+        import numpy as np
+        import jax
+        from bigdl_tpu import nn
+        from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+        x = np.random.RandomState(0).randn(128, 6).astype(np.float32)
+        w = np.random.RandomState(1).randn(6, 1).astype(np.float32)
+        y = (x @ w).astype(np.float32)
+
+        def train(prefetch):
+            m = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 1))
+            m.reset(3)
+            opt = (LocalOptimizer(m, (x, y), nn.MSECriterion(),
+                                  batch_size=32)
+                   .set_optim_method(SGD(learning_rate=0.05))
+                   .set_end_when(Trigger.max_epoch(3)))
+            if prefetch:
+                opt.set_prefetch(2)
+            opt.optimize()
+            return [np.asarray(l) for l in
+                    jax.tree_util.tree_leaves(
+                        jax.tree_util.tree_map(np.asarray, m._params))]
+
+        for a, b in zip(train(False), train(True)):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
