@@ -82,6 +82,95 @@ def _convolution1d(cfg):
                            name=cfg.get("name"))
 
 
+def _convolution3d(cfg):
+    _th(cfg, "Convolution3D")
+    return L.Convolution3D(cfg["nb_filter"], cfg["kernel_dim1"],
+                           cfg["kernel_dim2"], cfg["kernel_dim3"],
+                           activation=_act(cfg),
+                           border_mode=cfg.get("border_mode", "valid"),
+                           subsample=tuple(cfg.get("subsample", (1, 1, 1))),
+                           bias=cfg.get("bias", True),
+                           input_shape=_input_shape(cfg),
+                           name=cfg.get("name"))
+
+
+def _atrousconvolution1d(cfg):
+    return L.AtrousConvolution1D(
+        cfg["nb_filter"], cfg["filter_length"], activation=_act(cfg),
+        subsample_length=cfg.get("subsample_length", 1),
+        atrous_rate=cfg.get("atrous_rate", 1),
+        input_shape=_input_shape(cfg), name=cfg.get("name"))
+
+
+def _atrousconvolution2d(cfg):
+    _th(cfg, "AtrousConvolution2D")
+    rate = cfg.get("atrous_rate", (1, 1))
+    rate = tuple(rate) if isinstance(rate, (list, tuple)) else (rate, rate)
+    return L.AtrousConvolution2D(
+        cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"],
+        activation=_act(cfg), subsample=tuple(cfg.get("subsample", (1, 1))),
+        atrous_rate=rate, input_shape=_input_shape(cfg),
+        name=cfg.get("name"))
+
+
+def _deconvolution2d(cfg):
+    _th(cfg, "Deconvolution2D")
+    return L.Deconvolution2D(
+        cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"],
+        activation=_act(cfg), subsample=tuple(cfg.get("subsample", (1, 1))),
+        bias=cfg.get("bias", True), input_shape=_input_shape(cfg),
+        name=cfg.get("name"))
+
+
+def _separableconvolution2d(cfg):
+    _th(cfg, "SeparableConvolution2D")
+    return L.SeparableConvolution2D(
+        cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"],
+        activation=_act(cfg), border_mode=cfg.get("border_mode", "valid"),
+        subsample=tuple(cfg.get("subsample", (1, 1))),
+        depth_multiplier=cfg.get("depth_multiplier", 1),
+        bias=cfg.get("bias", True), input_shape=_input_shape(cfg),
+        name=cfg.get("name"))
+
+
+def _locallyconnected1d(cfg):
+    return L.LocallyConnected1D(
+        cfg["nb_filter"], cfg["filter_length"], activation=_act(cfg),
+        subsample_length=cfg.get("subsample_length", 1),
+        input_shape=_input_shape(cfg), name=cfg.get("name"))
+
+
+def _locallyconnected2d(cfg):
+    _th(cfg, "LocallyConnected2D")
+    return L.LocallyConnected2D(
+        cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"],
+        activation=_act(cfg), border_mode=cfg.get("border_mode", "valid"),
+        subsample=tuple(cfg.get("subsample", (1, 1))),
+        input_shape=_input_shape(cfg), name=cfg.get("name"))
+
+
+def _convlstm2d(cfg):
+    _th(cfg, "ConvLSTM2D")
+    if cfg.get("nb_row") != cfg.get("nb_col"):
+        _unsupported("ConvLSTM2D with non-square kernel")
+    return L.ConvLSTM2D(cfg["nb_filter"], cfg["nb_row"],
+                        return_sequences=cfg.get("return_sequences", False),
+                        go_backwards=cfg.get("go_backwards", False),
+                        border_mode=cfg.get("border_mode", "same"),
+                        input_shape=_input_shape(cfg),
+                        name=cfg.get("name"))
+
+
+def _pool3d(cls):
+    def build(cfg):
+        _th(cfg, cls.__name__)
+        return cls(tuple(cfg.get("pool_size", (2, 2, 2))),
+                   strides=tuple(cfg["strides"]) if cfg.get("strides")
+                   else None, input_shape=_input_shape(cfg),
+                   name=cfg.get("name"))
+    return build
+
+
 def _maxpooling2d(cfg):
     _th(cfg, "MaxPooling2D")
     return L.MaxPooling2D(tuple(cfg.get("pool_size", (2, 2))),
@@ -239,20 +328,35 @@ _BUILDERS = {
     "SReLU": _simple(L.SReLU),
     "Convolution1D": _convolution1d,
     "Convolution2D": _convolution2d,
+    "Convolution3D": _convolution3d,
+    "AtrousConvolution1D": _atrousconvolution1d,
+    "AtrousConvolution2D": _atrousconvolution2d,
+    "Deconvolution2D": _deconvolution2d,
+    "SeparableConvolution2D": _separableconvolution2d,
+    "LocallyConnected1D": _locallyconnected1d,
+    "LocallyConnected2D": _locallyconnected2d,
+    "ConvLSTM2D": _convlstm2d,
     "MaxPooling1D": _maxpooling1d,
     "MaxPooling2D": _maxpooling2d,
+    "MaxPooling3D": _pool3d(L.MaxPooling3D),
     "AveragePooling1D": _averagepooling1d,
     "AveragePooling2D": _averagepooling2d,
+    "AveragePooling3D": _pool3d(L.AveragePooling3D),
     "GlobalAveragePooling1D": _simple(L.GlobalAveragePooling1D),
     "GlobalMaxPooling1D": _simple(L.GlobalMaxPooling1D),
     "GlobalAveragePooling2D": _simple(L.GlobalAveragePooling2D),
     "GlobalMaxPooling2D": _simple(L.GlobalMaxPooling2D),
+    "GlobalAveragePooling3D": _simple(L.GlobalAveragePooling3D),
+    "GlobalMaxPooling3D": _simple(L.GlobalMaxPooling3D),
     "ZeroPadding1D": _simple(L.ZeroPadding1D, "padding"),
     "ZeroPadding2D": _simple(L.ZeroPadding2D, "padding"),
+    "ZeroPadding3D": _simple(L.ZeroPadding3D, "padding"),
     "Cropping1D": _simple(L.Cropping1D, "cropping"),
     "Cropping2D": _simple(L.Cropping2D, "cropping"),
+    "Cropping3D": _simple(L.Cropping3D, "cropping"),
     "UpSampling1D": _simple(L.UpSampling1D, "length"),
     "UpSampling2D": _simple(L.UpSampling2D, "size"),
+    "UpSampling3D": _simple(L.UpSampling3D, "size"),
     "SimpleRNN": _recurrent(L.SimpleRNN),
     "LSTM": _recurrent(L.LSTM),
     "GRU": _recurrent(L.GRU),
@@ -450,6 +554,32 @@ def _load_layer_weights(klayer, ws, params, state):
         _set(params, conv, weight=W,
              **({"bias": ws[1]} if len(ws) > 1 else {}))
         return
+    if isinstance(klayer, L.Convolution3D):
+        conv = _find(klayer, N.VolumetricConvolution)[0]
+        # keras1 th conv3d weight: (nb_filter, stack, k1, k2, k3) = ours
+        _set(params, conv, weight=ws[0],
+             **({"bias": ws[1]} if len(ws) > 1 else {}))
+        return
+    if isinstance(klayer, L.AtrousConvolution2D):
+        conv = _find(klayer, N.SpatialDilatedConvolution)[0]
+        _set(params, conv, weight=ws[0],
+             **({"bias": ws[1]} if len(ws) > 1 else {}))
+        return
+    if isinstance(klayer, L.AtrousConvolution1D):
+        conv = _find(klayer, N.SpatialDilatedConvolution)[0]
+        # keras1 weight (filter_length, 1, input_dim, nb_filter)
+        # -> ours OIHW with kernel (filter_length, 1)
+        W = np.transpose(ws[0], (3, 2, 0, 1))
+        _set(params, conv, weight=W,
+             **({"bias": ws[1]} if len(ws) > 1 else {}))
+        return
+    if isinstance(klayer, L.Deconvolution2D):
+        conv = _find(klayer, N.SpatialFullConvolution)[0]
+        # keras1 th deconv weight (nb_filter, stack, r, c) -> ours (in, out, r, c)
+        W = np.transpose(ws[0], (1, 0, 2, 3))
+        _set(params, conv, weight=W,
+             **({"bias": ws[1]} if len(ws) > 1 else {}))
+        return
     if isinstance(klayer, L.BatchNormalization):
         bn = _find(klayer, N.BatchNormalization)[0]
         gamma, beta, mean, var = ws
@@ -499,7 +629,11 @@ def _owns_weights(klayer):
     return isinstance(klayer, (L.Dense, L.Highway, L.MaxoutDense,
                                L.Embedding, L.BatchNormalization,
                                L.Convolution1D, L.Convolution2D,
-                               L.Convolution3D, L.SimpleRNN, L.LSTM, L.GRU,
+                               L.Convolution3D, L.AtrousConvolution1D,
+                               L.AtrousConvolution2D, L.Deconvolution2D,
+                               L.SeparableConvolution2D,
+                               L.LocallyConnected1D, L.LocallyConnected2D,
+                               L.SimpleRNN, L.LSTM, L.GRU,
                                L.Bidirectional, L.TimeDistributed))
 
 

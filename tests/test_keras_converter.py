@@ -335,3 +335,62 @@ def test_shared_layer_multiple_call_sites_rejected(tmp_path):
     jpath.write_text(json.dumps(spec))
     with pytest.raises(KerasConversionError, match="call sites"):
         DefinitionLoader.from_json_path(str(jpath))
+
+
+def test_conv3d_atrous_deconv_weights_match_torch(tmp_path):
+    torch = pytest.importorskip("torch")
+    import torch.nn.functional as F
+    rng = np.random.RandomState(7)
+
+    # --- Convolution3D ------------------------------------------------ #
+    W3 = rng.randn(4, 2, 3, 3, 3).astype(np.float32) * 0.2
+    b3 = rng.randn(4).astype(np.float32) * 0.2
+    j3 = tmp_path / "c3.json"
+    j3.write_text(_sequential_json(
+        _klayer("Convolution3D", name="c3", nb_filter=4, kernel_dim1=3,
+                kernel_dim2=3, kernel_dim3=3, dim_ordering="th", bias=True,
+                batch_input_shape=[None, 2, 6, 6, 6])))
+    w3 = tmp_path / "c3.h5"
+    _write_weights(str(w3), [("c3", [("c3_W", W3), ("c3_b", b3)])])
+    m = load_keras(str(j3), str(w3))
+    x = rng.randn(2, 2, 6, 6, 6).astype(np.float32)
+    got = np.asarray(m.predict(x))
+    want = F.conv3d(torch.from_numpy(x), torch.from_numpy(W3),
+                    torch.from_numpy(b3)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    # --- AtrousConvolution2D ------------------------------------------ #
+    Wa = rng.randn(3, 2, 3, 3).astype(np.float32) * 0.2
+    ba = rng.randn(3).astype(np.float32) * 0.2
+    ja = tmp_path / "a2.json"
+    ja.write_text(_sequential_json(
+        _klayer("AtrousConvolution2D", name="a2", nb_filter=3, nb_row=3,
+                nb_col=3, atrous_rate=[2, 2], dim_ordering="th",
+                batch_input_shape=[None, 2, 10, 10])))
+    wa = tmp_path / "a2.h5"
+    _write_weights(str(wa), [("a2", [("a2_W", Wa), ("a2_b", ba)])])
+    m = load_keras(str(ja), str(wa))
+    x = rng.randn(2, 2, 10, 10).astype(np.float32)
+    got = np.asarray(m.predict(x))
+    want = F.conv2d(torch.from_numpy(x), torch.from_numpy(Wa),
+                    torch.from_numpy(ba), dilation=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    # --- Deconvolution2D ---------------------------------------------- #
+    Wd = rng.randn(5, 2, 3, 3).astype(np.float32) * 0.2  # (nb_filter, stack, r, c)
+    bd = rng.randn(5).astype(np.float32) * 0.2
+    jd = tmp_path / "d2.json"
+    jd.write_text(_sequential_json(
+        _klayer("Deconvolution2D", name="d2", nb_filter=5, nb_row=3,
+                nb_col=3, subsample=[2, 2], dim_ordering="th", bias=True,
+                batch_input_shape=[None, 2, 5, 5])))
+    wd = tmp_path / "d2.h5"
+    _write_weights(str(wd), [("d2", [("d2_W", Wd), ("d2_b", bd)])])
+    m = load_keras(str(jd), str(wd))
+    x = rng.randn(2, 2, 5, 5).astype(np.float32)
+    got = np.asarray(m.predict(x))
+    # torch conv_transpose2d weight layout: (in, out, r, c)
+    want = F.conv_transpose2d(torch.from_numpy(x),
+                              torch.from_numpy(np.transpose(Wd, (1, 0, 2, 3))),
+                              torch.from_numpy(bd), stride=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
