@@ -27,6 +27,7 @@ from jax import lax
 
 from ..nn.module import Module
 from ..nn import containers as containers_mod
+from ..nn import graph as graph_mod
 from ..nn import linear as linear_mod
 from ..nn import conv as conv_mod
 
@@ -175,7 +176,7 @@ def quantize(model: Module) -> Module:
     state = dict(model._state or {})
     replaced: list = []
     new_model = _rewrite(model, params, replaced)
-    if isinstance(new_model, containers_mod.Container):
+    if isinstance(new_model, (containers_mod.Container, graph_mod.Graph)):
         dropped = set(replaced)
         new_model._params = {k: v for k, v in params.items()
                              if k not in dropped}
@@ -195,6 +196,21 @@ def _rewrite(module: Module, params, replaced) -> Module:
                            for c in module.children()]
         # the top-level clone gets the carried trained tree in quantize();
         # intermediate clones must not cache stale float params
+        clone._params = None
+        clone._state = {}
+        return clone
+    if isinstance(module, graph_mod.Graph):
+        # rebuild the node DAG with rewritten modules (same wiring)
+        mapping = {}
+        for node in module._topo:
+            new_mod = None if node.module is None \
+                else _rewrite(node.module, params, replaced)
+            mapping[id(node)] = graph_mod.Node(
+                new_mod, [mapping[id(p)] for p in node.prev_nodes])
+        clone = copy.copy(module)
+        clone.input_nodes = [mapping[id(n)] for n in module.input_nodes]
+        clone.output_nodes = [mapping[id(n)] for n in module.output_nodes]
+        clone._topo = clone._topsort()
         clone._params = None
         clone._state = {}
         return clone

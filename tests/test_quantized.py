@@ -137,3 +137,32 @@ def test_quantized_conv_mixed_same_explicit_padding():
     assert got.shape == want.shape
     rel = np.abs(got - want).max() / np.abs(want).max()
     assert rel < 0.05, rel
+
+
+def test_quantize_graph_dag_model():
+    """Graph models (e.g. Caffe-loaded DAG nets) must quantize too, not
+    silently pass through unchanged."""
+    from bigdl_tpu.nn.graph import Graph, Input
+    from bigdl_tpu.quantized import quantize
+
+    rs = np.random.RandomState(0)
+    inp = Input()
+    c1 = nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1).inputs(inp)
+    r1 = nn.ReLU().inputs(c1)
+    br_a = nn.SpatialConvolution(8, 4, 1, 1).inputs(r1)
+    br_b = nn.SpatialConvolution(8, 4, 1, 1).inputs(r1)
+    cat = nn.JoinTable(2).inputs([br_a, br_b])
+    g = Graph(inp, cat)
+    x = rs.randn(2, 3, 8, 8).astype(np.float32)
+    want = np.asarray(g.forward(x))
+
+    q = quantize(g)
+    q_types = [type(m).__name__ for m in q.modules()]
+    assert "QuantizedSpatialConvolution" in q_types, q_types
+    assert not any(isinstance(m, nn.SpatialConvolution)
+                   and type(m) is nn.SpatialConvolution
+                   for m in q.modules() if m is not q)
+    got = np.asarray(q.forward(x))
+    assert got.shape == want.shape
+    rel = np.abs(got - want).max() / np.abs(want).max()
+    assert rel < 0.1, rel
