@@ -654,3 +654,331 @@ class MkString(Operation):
         arr = np.asarray(x)
         return [self.delim.join(str(v) for v in row)
                 for row in arr.reshape(arr.shape[0], -1)]
+
+
+class CategoricalColHashBucket(Operation):
+    """Feature strings -> hashed bucket id rows (≙
+    nn/ops/CategoricalColHashBucket.scala).  Host-side (string input);
+    multi-value cells split on `str_delimiter`.  Returns a
+    tensor.SparseTensor when is_sparse else a dense padded id matrix."""
+
+    def __init__(self, hash_bucket_size, str_delimiter=",", is_sparse=True,
+                 name=None):
+        super().__init__(name=name)
+        self.hash_bucket_size = hash_bucket_size
+        self.str_delimiter = str_delimiter
+        self.is_sparse = is_sparse
+
+    def _bucket(self, s):
+        import zlib
+        return zlib.crc32(str(s).encode()) % self.hash_bucket_size
+
+    def apply(self, params, x, ctx):
+        import numpy as np
+        rows = [[self._bucket(v) for v in str(s).split(self.str_delimiter)]
+                for s in x]
+        width = max(len(r) for r in rows)
+        if self.is_sparse:
+            from ..tensor import SparseTensor
+            idx, vals = [], []
+            for i, r in enumerate(rows):
+                for j, v in enumerate(r):
+                    idx.append((i, j))
+                    vals.append(v)
+            return SparseTensor(np.asarray(idx, np.int32).T,
+                                np.asarray(vals, np.int32),
+                                (len(rows), width))
+        out = np.zeros((len(rows), width), np.int32)
+        for i, r in enumerate(rows):
+            out[i, :len(r)] = r
+        return jnp.asarray(out)
+
+
+class CategoricalColVocaList(Operation):
+    """Feature strings -> vocabulary ids (≙ nn/ops/CategoricalColVocaList
+    .scala).  Out-of-vocabulary values map to `len(vocab) + hash % num_oov`
+    when num_oov_buckets > 0, else to `default_value`."""
+
+    def __init__(self, vocab_list, str_delimiter=",", is_sparse=True,
+                 num_oov_buckets=0, default_value=-1, name=None):
+        super().__init__(name=name)
+        self.vocab = {v: i for i, v in enumerate(vocab_list)}
+        self.str_delimiter = str_delimiter
+        self.is_sparse = is_sparse
+        self.num_oov_buckets = num_oov_buckets
+        self.default_value = default_value
+
+    def _lookup(self, s):
+        import zlib
+        if s in self.vocab:
+            return self.vocab[s]
+        if self.num_oov_buckets > 0:
+            return len(self.vocab) + (zlib.crc32(s.encode())
+                                      % self.num_oov_buckets)
+        return self.default_value
+
+    def apply(self, params, x, ctx):
+        import numpy as np
+        rows = [[self._lookup(v) for v in str(s).split(self.str_delimiter)]
+                for s in x]
+        width = max(len(r) for r in rows)
+        if self.is_sparse:
+            from ..tensor import SparseTensor
+            idx, vals = [], []
+            for i, r in enumerate(rows):
+                for j, v in enumerate(r):
+                    idx.append((i, j))
+                    vals.append(v)
+            return SparseTensor(np.asarray(idx, np.int32).T,
+                                np.asarray(vals, np.int32),
+                                (len(rows), width))
+        out = np.full((len(rows), width), self.default_value, np.int32)
+        for i, r in enumerate(rows):
+            out[i, :len(r)] = r
+        return jnp.asarray(out)
+
+
+class CrossCol(Operation):
+    """Cross of categorical string columns: hash(cartesian product) %
+    hash_bucket_size (≙ nn/ops/CrossCol.scala).  Input: Table of
+    equal-length string lists; output SparseTensor of bucket ids."""
+
+    def __init__(self, hash_bucket_size, str_delimiter=",", name=None):
+        super().__init__(name=name)
+        self.hash_bucket_size = hash_bucket_size
+        self.str_delimiter = str_delimiter
+
+    def apply(self, params, x, ctx):
+        import itertools
+        import zlib
+        import numpy as np
+        cols = [list(c) for c in as_list(x)]
+        n = len(cols[0])
+        idx, vals = [], []
+        width = 1
+        for i in range(n):
+            cells = [str(c[i]).split(self.str_delimiter) for c in cols]
+            crossed = [zlib.crc32("_X_".join(combo).encode())
+                       % self.hash_bucket_size
+                       for combo in itertools.product(*cells)]
+            width = max(width, len(crossed))
+            for j, v in enumerate(crossed):
+                idx.append((i, j))
+                vals.append(v)
+        from ..tensor import SparseTensor
+        return SparseTensor(np.asarray(idx, np.int32).T,
+                            np.asarray(vals, np.int32), (n, width))
+
+
+class IndicatorCol(Operation):
+    """Categorical id SparseTensor -> multi-hot dense indicator matrix
+    (≙ nn/ops/IndicatorCol.scala)."""
+
+    def __init__(self, feature_num, is_count=True, name=None):
+        super().__init__(name=name)
+        self.feature_num = feature_num
+        self.is_count = is_count
+
+    def apply(self, params, x, ctx):
+        import numpy as np
+        from ..tensor import SparseTensor
+        if isinstance(x, SparseTensor):
+            rows = np.asarray(x.indices[0])
+            ids = np.asarray(x.values).astype(np.int64)
+            n = x.shape[0]
+        else:
+            arr = np.asarray(x).astype(np.int64)
+            rows = np.repeat(np.arange(arr.shape[0]), arr.shape[1])
+            ids = arr.reshape(-1)
+            n = arr.shape[0]
+        out = np.zeros((n, self.feature_num), np.float32)
+        for r, i in zip(rows, ids):
+            if 0 <= i < self.feature_num:
+                if self.is_count:
+                    out[r, i] += 1.0
+                else:
+                    out[r, i] = 1.0
+        return jnp.asarray(out)
+
+
+class Substr(Operation):
+    """Substring of a scalar string: Table(str, pos, len) -> str
+    (≙ nn/ops/Substr.scala)."""
+
+    def apply(self, params, x, ctx):
+        data, pos, length = as_list(x)[:3]
+        p, n = int(pos), int(length)
+        return str(data)[p:p + n]
+
+
+class Compare(Operation):
+    """Abstract elementwise comparison base (≙ nn/ops/Compare.scala);
+    concrete subclasses: Greater/GreaterEqual/Less/LessEqual/Equal/
+    NotEqual above."""
+
+    def compare(self, a, b):
+        raise NotImplementedError
+
+    def apply(self, params, x, ctx):
+        a, b = _pair(x)
+        return self.compare(a, b)
+
+
+class DepthwiseConv2D(Operation):
+    """Runtime-filter depthwise conv: Table(input, filter) -> output
+    (≙ nn/ops/DepthwiseConv2D.scala).  filter is HWIO-style
+    (kh, kw, in_channels, channel_multiplier); data_format NHWC or NCHW."""
+
+    def __init__(self, stride_w=1, stride_h=1, pad_w=0, pad_h=0,
+                 data_format="NHWC", name=None):
+        super().__init__(name=name)
+        self.stride = (stride_h, stride_w)
+        self.pad = (pad_h, pad_w)
+        self.data_format = data_format
+
+    def apply(self, params, x, ctx):
+        inp, filt = _pair(x)
+        kh, kw, cin, mult = filt.shape
+        # OIHW with feature_group_count=cin: (cin*mult, 1, kh, kw)
+        w = jnp.transpose(filt, (2, 3, 0, 1)).reshape(cin * mult, 1, kh, kw)
+        dn = ("NHWC", "OIHW", "NHWC") if self.data_format == "NHWC" \
+            else ("NCHW", "OIHW", "NCHW")
+        pads = [(self.pad[0], self.pad[0]), (self.pad[1], self.pad[1])] \
+            if self.pad != (-1, -1) else "SAME"
+        return jax.lax.conv_general_dilated(
+            inp, w.astype(inp.dtype), window_strides=self.stride,
+            padding=pads, feature_group_count=cin, dimension_numbers=dn)
+
+
+class Dilation2D(Operation):
+    """Grayscale morphological dilation (max-sum correlation):
+    Table(input NHWC, filter (kh, kw, depth)) -> NHWC
+    (≙ nn/ops/Dilation2D.scala)."""
+
+    def __init__(self, strides=(1, 1, 1, 1), rates=(1, 1, 1, 1),
+                 padding="VALID", name=None):
+        super().__init__(name=name)
+        self.strides = strides
+        self.rates = rates
+        self.padding = padding.upper()
+
+    def apply(self, params, x, ctx):
+        inp, filt = _pair(x)
+        kh, kw, depth = filt.shape
+        rh, rw = self.rates[1], self.rates[2]
+        sh, sw = self.strides[1], self.strides[2]
+        eff_kh, eff_kw = (kh - 1) * rh + 1, (kw - 1) * rw + 1
+        b, h, w_, d = inp.shape
+        if self.padding == "SAME":
+            out_h = -(-h // sh)
+            out_w = -(-w_ // sw)
+            pad_h = max(0, (out_h - 1) * sh + eff_kh - h)
+            pad_w = max(0, (out_w - 1) * sw + eff_kw - w_)
+            pads = ((pad_h // 2, pad_h - pad_h // 2),
+                    (pad_w // 2, pad_w - pad_w // 2))
+        else:
+            out_h = (h - eff_kh) // sh + 1
+            out_w = (w_ - eff_kw) // sw + 1
+            pads = ((0, 0), (0, 0))
+        neg = jnp.asarray(-jnp.inf, inp.dtype)
+        xp = jnp.pad(inp, ((0, 0), pads[0], pads[1], (0, 0)),
+                     constant_values=neg)
+        # max over kernel taps of (patch + filter tap) — small kernel loop
+        # unrolled at trace time (static), each tap a strided slice
+        out = None
+        for i in range(kh):
+            for j in range(kw):
+                patch = jax.lax.slice(
+                    xp, (0, i * rh, j * rw, 0),
+                    (b, i * rh + (out_h - 1) * sh + 1,
+                     j * rw + (out_w - 1) * sw + 1, d),
+                    (1, sh, sw, 1))
+                cand = patch + filt[i, j]
+                out = cand if out is None else jnp.maximum(out, cand)
+        return out
+
+
+class ModuleToOperation(Operation):
+    """Adapt any Module to the forward-only Operation interface
+    (≙ nn/ops/ModuleToOperation.scala)."""
+
+    def __init__(self, module, name=None):
+        super().__init__(name=name)
+        self.module = module
+
+    def children(self):
+        return [self.module]
+
+    def _serde_restore_children(self, children):
+        if children and children[0] is not None:
+            self.module = children[0]
+
+    def init(self, rng):
+        return self.module.init(rng)
+
+    def initial_state(self):
+        return self.module.initial_state()
+
+    def apply(self, params, x, ctx):
+        return self.module.apply(params, x, ctx)
+
+
+class TensorOp(Operation):
+    """Chainable closure op over tensors (≙ nn/ops/TensorOp.scala):
+    ``TensorOp.identity().abs().sqrt()`` composes transformations; apply
+    runs them left-to-right."""
+
+    def __init__(self, fns=None, name=None):
+        super().__init__(name=name)
+        self._fns = list(fns or [])
+
+    @classmethod
+    def identity(cls):
+        return cls()
+
+    def _chain(self, f):
+        return TensorOp(self._fns + [f])
+
+    def abs(self):
+        return self._chain(jnp.abs)
+
+    def sqrt(self):
+        return self._chain(jnp.sqrt)
+
+    def square(self):
+        return self._chain(jnp.square)
+
+    def exp(self):
+        return self._chain(jnp.exp)
+
+    def log(self):
+        return self._chain(jnp.log)
+
+    def negative(self):
+        return self._chain(jnp.negative)
+
+    def sigmoid(self):
+        return self._chain(jax.nn.sigmoid)
+
+    def tanh(self):
+        return self._chain(jnp.tanh)
+
+    def add(self, v):
+        return self._chain(lambda x: x + v)
+
+    def sub(self, v):
+        return self._chain(lambda x: x - v)
+
+    def mul(self, v):
+        return self._chain(lambda x: x * v)
+
+    def div(self, v):
+        return self._chain(lambda x: x / v)
+
+    def pow(self, v):
+        return self._chain(lambda x: x ** v)
+
+    def apply(self, params, x, ctx):
+        for f in self._fns:
+            x = f(x)
+        return x

@@ -228,3 +228,86 @@ def test_graphdef_avgpool_same_border_counts():
     got = np.asarray(g.forward(x))
     # averaging ones must give exactly ones everywhere, incl. corners
     np.testing.assert_allclose(got, np.ones_like(got), rtol=1e-6)
+
+
+class TestFeatureColumnOps:
+    """The remaining nn/ops feature-column + runtime-filter ops
+    (≙ CategoricalColHashBucket/VocaList, CrossCol, IndicatorCol, Substr,
+    DepthwiseConv2D, Dilation2D, TensorOp, ModuleToOperation Specs)."""
+
+    def test_categorical_hash_bucket(self):
+        from bigdl_tpu.nn import ops
+        h = ops.CategoricalColHashBucket(10, is_sparse=False)
+        out = np.asarray(h.forward(["a,b", "c"]))
+        assert out.shape == (2, 2)
+        assert (out >= 0).all() and (out < 10).all()
+        sp = ops.CategoricalColHashBucket(10, is_sparse=True).forward(["a,b", "c"])
+        from bigdl_tpu.tensor import SparseTensor
+        assert isinstance(sp, SparseTensor) and sp.nnz == 3
+
+    def test_categorical_voca_list(self):
+        from bigdl_tpu.nn import ops
+        v = ops.CategoricalColVocaList(["a", "b", "c"], is_sparse=False,
+                                       num_oov_buckets=2)
+        out = np.asarray(v.forward(["a,b", "z"]))
+        assert out[0, 0] == 0 and out[0, 1] == 1
+        assert 3 <= out[1, 0] < 5  # oov bucket
+
+    def test_cross_col_and_indicator(self):
+        from bigdl_tpu.nn import ops
+        from bigdl_tpu.utils.table import T
+        sp = ops.CrossCol(16).forward(T(["a,b", "c"], ["x", "y"]))
+        assert sp.shape[0] == 2 and int(sp.nnz) == 3
+        ind = np.asarray(ops.IndicatorCol(5).forward(
+            jnp.asarray([[1, 2], [4, 4]])))
+        np.testing.assert_allclose(ind, [[0, 1, 1, 0, 0], [0, 0, 0, 0, 2]])
+
+    def test_substr(self):
+        from bigdl_tpu.nn import ops
+        from bigdl_tpu.utils.table import T
+        assert ops.Substr().forward(T("hello world", 6, 5)) == "world"
+
+    def test_depthwise_conv2d_matches_torch(self):
+        import pytest
+        torch = pytest.importorskip("torch")
+        import torch.nn.functional as F
+        from bigdl_tpu.nn import ops
+        from bigdl_tpu.utils.table import T
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 5, 5, 3).astype(np.float32)
+        f = rng.randn(3, 3, 3, 2).astype(np.float32)
+        got = np.asarray(ops.DepthwiseConv2D(data_format="NHWC").forward(
+            T(jnp.asarray(x), jnp.asarray(f))))
+        tw = torch.from_numpy(
+            np.transpose(f, (2, 3, 0, 1)).reshape(6, 1, 3, 3).copy())
+        want = F.conv2d(torch.from_numpy(np.transpose(x, (0, 3, 1, 2))),
+                        tw, groups=3).numpy()
+        np.testing.assert_allclose(got, np.transpose(want, (0, 2, 3, 1)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_dilation2d_matches_manual(self):
+        from bigdl_tpu.nn import ops
+        from bigdl_tpu.utils.table import T
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 6, 6, 2).astype(np.float32)
+        f = rng.randn(3, 3, 2).astype(np.float32)
+        got = np.asarray(ops.Dilation2D().forward(
+            T(jnp.asarray(x), jnp.asarray(f))))
+        want = np.zeros((1, 4, 4, 2), np.float32)
+        for oh in range(4):
+            for ow in range(4):
+                for c in range(2):
+                    want[0, oh, ow, c] = max(
+                        x[0, oh + i, ow + j, c] + f[i, j, c]
+                        for i in range(3) for j in range(3))
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_tensor_op_chain_and_module_to_operation(self):
+        from bigdl_tpu.nn import ops
+        from bigdl_tpu import nn
+        t = ops.TensorOp.identity().abs().sqrt().mul(2.0)
+        np.testing.assert_allclose(
+            np.asarray(t.forward(jnp.asarray([-4.0, 9.0]))), [4.0, 6.0])
+        m = ops.ModuleToOperation(nn.Linear(3, 2))
+        y = m.forward(np.ones((1, 3), np.float32))
+        assert np.asarray(y).shape == (1, 2)
