@@ -982,3 +982,38 @@ class TensorOp(Operation):
         for f in self._fns:
             x = f(x)
         return x
+
+
+# --------------------------------------------------------------------- #
+# nn/tf shims with standalone value (the reference's remaining nn/tf/*
+# classes — TensorArray*, Conv*Backprop*, *Grad — are TF-importer
+# plumbing for hand-written backward graphs; JAX AD subsumes them)      #
+# --------------------------------------------------------------------- #
+class Const(Operation):
+    """Emit a constant regardless of input (≙ nn/tf/ArrayOps.scala Const)."""
+
+    def __init__(self, value, name=None):
+        super().__init__(name=name)
+        self.value = jnp.asarray(value)
+
+    def apply(self, params, x, ctx):
+        return self.value
+
+
+class Fill(Operation):
+    """Table(shape, scalar) -> filled tensor (≙ ArrayOps.scala Fill)."""
+
+    def apply(self, params, x, ctx):
+        shape, value = _pair(x)
+        import numpy as np
+        dims = tuple(int(d) for d in np.asarray(shape).reshape(-1))
+        return jnp.full(dims, value)
+
+
+class InvertPermutation(Operation):
+    """y[x[i]] = i (≙ ArrayOps.scala InvertPermutation)."""
+
+    def apply(self, params, x, ctx):
+        x = x.astype(jnp.int32)
+        return jnp.zeros_like(x).at[x].set(jnp.arange(x.shape[0],
+                                                      dtype=jnp.int32))

@@ -102,3 +102,174 @@ def pipelined(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
         return _shard_map(local, mesh, in_specs, P())(stacked_params, x)
 
     return global_fn
+
+
+# --------------------------------------------------------------------- #
+# transformer pipeline trainer                                          #
+# --------------------------------------------------------------------- #
+class PipelineLMTrainer:
+    """GPipe training for TransformerLM over a 'pp' mesh axis (x optional
+    'dp'): each pp rank owns n_layers/n_stages blocks (params stacked on a
+    leading layer axis, sharded over pp); microbatches flow through
+    pipeline_run's ppermute schedule; embedding feeds stage 0 and the LM
+    head + loss run on the last stage (loss is masked+psum'd, so AD routes
+    every gradient to the stage that owns it).
+
+    The optimizer update happens on the global (sharded) arrays outside
+    the shard_map — GSPMD keeps the pp layout for block params/moments.
+    """
+
+    def __init__(self, model, optim, mesh, n_microbatches=4, seed=0):
+        cfg = model.cfg
+        if cfg.dropout:
+            raise ValueError("PipelineLMTrainer requires dropout=0.0")
+        if "pp" not in mesh.axis_names:
+            raise ValueError("mesh needs a 'pp' axis")
+        self.model = model
+        self.optim = optim
+        self.mesh = mesh
+        self.n_micro = n_microbatches
+        self.seed = seed
+        self.n_stages = mesh.shape["pp"]
+        if cfg.n_layers % self.n_stages:
+            raise ValueError(
+                f"n_layers={cfg.n_layers} must divide by pp={self.n_stages}")
+        self.template = model.blocks[0]
+        self._block_names = [b.name for b in model.blocks]
+        self.params = None
+        self.opt_state = None
+        self._step_fn = None
+        self._step_count = 0
+
+    # -- param plumbing ------------------------------------------------ #
+    def _rename(self, tree, src, dst):
+        return {k.replace(src, dst): {kk: vv for kk, vv in v.items()}
+                for k, v in tree.items()}
+
+    def _split(self, params):
+        """model params -> (rest, blocks-stacked-on-leading-layer-axis)."""
+        block_prefixes = tuple(n + "." for n in self._block_names)
+        rest = {k: v for k, v in params.items()
+                if not k.startswith(block_prefixes)}
+        per_block = []
+        for name in self._block_names:
+            sub = {k: v for k, v in params.items()
+                   if k.startswith(name + ".")}
+            per_block.append(self._rename(sub, name, self.template.name))
+        stacked = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack(leaves), *per_block)
+        return rest, stacked
+
+    def merge(self):
+        """Back to the model's flat params dict (host-side convenience)."""
+        rest, stacked = self.params["rest"], self.params["blocks"]
+        out = dict(rest)
+        for i, name in enumerate(self._block_names):
+            sub = jax.tree_util.tree_map(lambda l: l[i], stacked)
+            out.update(self._rename(sub, self.template.name, name))
+        return out
+
+    # -- setup --------------------------------------------------------- #
+    def init(self):
+        from jax.sharding import NamedSharding
+        model_params = self.model.init(jax.random.PRNGKey(self.seed))
+        rest, blocks = self._split(model_params)
+        put = lambda t, spec: jax.tree_util.tree_map(
+            lambda l: jax.device_put(l, NamedSharding(self.mesh, spec)), t)
+        self.params = {"rest": put(rest, P()), "blocks": put(blocks, P("pp"))}
+        self.opt_state = jax.jit(self.optim.init_state)(self.params)
+        self._build()
+        return self
+
+    def _build(self):
+        from ..models.transformer import lm_cross_entropy
+        from ..nn.module import Ctx
+        model, template, optim = self.model, self.template, self.optim
+        cfg = model.cfg
+        n_micro, mesh = self.n_micro, self.mesh
+        has_dp = "dp" in mesh.axis_names
+
+        def local(rest, blocks_stage, tokens, targets):
+            def loss_fn(rest, blocks_stage):
+                ctx = Ctx(state={}, training=True, rng_key=None)
+                h = model.embed.apply(rest, tokens, ctx)
+                h = h.astype(jnp.dtype(cfg.dtype))
+                mbs = h.reshape((n_micro, -1) + h.shape[1:])
+
+                def stage_fn(stage_params, x):
+                    def body(hh, blk):
+                        c = Ctx(state={}, training=True, rng_key=None)
+                        return template.apply(blk, hh, c), None
+                    out, _ = lax.scan(body, x, stage_params)
+                    return out
+
+                outs = pipeline_run(stage_fn, blocks_stage, mbs, "pp")
+                h_out = outs.reshape(h.shape)
+                ctx2 = Ctx(state={}, training=True, rng_key=None)
+                h_out = model.final_norm.apply(rest, h_out, ctx2)
+                logits = model.head.apply(rest, h_out, ctx2) \
+                    if model.head is not None else \
+                    h_out @ rest[model.embed.name]["weight"].T
+                loss = lm_cross_entropy(logits, targets)
+                # differentiate the LOCAL masked contribution — putting a
+                # psum inside the differentiated function would make every
+                # rank seed a cotangent through it and scale all gradients
+                # by n_stages; the value is psum'd after the grad call
+                return loss * last_stage_mask("pp")
+
+            loss, (g_rest, g_blocks) = jax.value_and_grad(
+                loss_fn, argnums=(0, 1))(rest, blocks_stage)
+            loss = lax.psum(loss, "pp")
+            if has_dp:
+                loss = lax.pmean(loss, "dp")
+            # rest grads live on different ranks (embed on stage 0, final
+            # norm + head on the last stage, zeros elsewhere): psum over
+            # pp combines the disjoint contributions into the replicated
+            # global gradient; block grads stay sharded per-stage
+            g_rest = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, "pp"), g_rest)
+            if has_dp:
+                g_rest, g_blocks = jax.tree_util.tree_map(
+                    lambda g: lax.pmean(g, "dp"), (g_rest, g_blocks))
+            return loss, (g_rest, g_blocks)
+
+        rest_specs = jax.tree_util.tree_map(lambda _: P(),
+                                            self.params["rest"])
+        blk_specs = jax.tree_util.tree_map(lambda _: P("pp"),
+                                           self.params["blocks"])
+        tok_spec = P("dp") if has_dp else P()
+        mapped = _shard_map(
+            local, mesh,
+            (rest_specs, blk_specs, tok_spec, tok_spec),
+            (P(), (rest_specs, blk_specs)))
+
+        def step(params, opt_state, tokens, targets):
+            loss, (g_rest, g_blocks) = mapped(
+                params["rest"], params["blocks"], tokens, targets)
+            grads = {"rest": g_rest, "blocks": g_blocks}
+            new_params, new_opt = optim.update(grads, params, opt_state)
+            return new_params, new_opt, loss
+
+        self._step_fn = jax.jit(step, donate_argnums=(0, 1))
+
+    # -- API ----------------------------------------------------------- #
+    def step(self, tokens, targets):
+        if self._step_fn is None:
+            self.init()
+        from jax.sharding import NamedSharding
+        n_dp = self.mesh.shape.get("dp", 1)
+        batch = jnp.asarray(tokens).shape[0]
+        if batch % n_dp:
+            raise ValueError(f"batch {batch} must divide by dp={n_dp}")
+        if (batch // n_dp) % self.n_micro:
+            raise ValueError(
+                f"per-dp-shard batch {batch // n_dp} must divide by "
+                f"n_microbatches={self.n_micro}")
+        spec = P("dp") if "dp" in self.mesh.axis_names else P()
+        sh = NamedSharding(self.mesh, spec)
+        tokens = jax.device_put(jnp.asarray(tokens), sh)
+        targets = jax.device_put(jnp.asarray(targets), sh)
+        self.params, self.opt_state, loss = self._step_fn(
+            self.params, self.opt_state, tokens, targets)
+        self._step_count += 1
+        return loss

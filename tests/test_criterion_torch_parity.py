@@ -62,7 +62,7 @@ def test_class_nll_criterion():
     to = _t(logp)
     want = torch.nn.NLLLoss()(to, torch.from_numpy((y1 - 1).astype(np.int64)))
     want.backward()
-    np.testing.assert_allclose(got, float(want), rtol=1e-4)
+    np.testing.assert_allclose(got, float(want.detach()), rtol=1e-4)
     np.testing.assert_allclose(grad, to.grad.numpy(), rtol=1e-4, atol=1e-6)
 
 
@@ -76,7 +76,7 @@ def test_cross_entropy_criterion():
     want = torch.nn.CrossEntropyLoss()(
         to, torch.from_numpy((y1 - 1).astype(np.int64)))
     want.backward()
-    np.testing.assert_allclose(got, float(want), rtol=1e-4)
+    np.testing.assert_allclose(got, float(want.detach()), rtol=1e-4)
     np.testing.assert_allclose(grad, to.grad.numpy(), rtol=1e-4, atol=1e-6)
 
 
@@ -118,7 +118,7 @@ def test_multi_margin_criterion():
     want = torch.nn.MultiMarginLoss()(
         to, torch.from_numpy((y1 - 1).astype(np.int64)))
     want.backward()
-    np.testing.assert_allclose(got, float(want), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(got, float(want.detach()), rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(grad, to.grad.numpy(), rtol=1e-4, atol=1e-6)
 
 
@@ -140,7 +140,7 @@ def test_margin_ranking_criterion_scalar():
     ta, tb = _t(a), _t(b)
     want = torch.nn.MarginRankingLoss(margin=0.5)(
         ta, tb, torch.from_numpy(y))
-    np.testing.assert_allclose(got, float(want), rtol=1e-4)
+    np.testing.assert_allclose(got, float(want.detach()), rtol=1e-4)
 
 
 def test_cosine_embedding_criterion():
@@ -153,7 +153,7 @@ def test_cosine_embedding_criterion():
                              jnp.asarray(y)))
     want = torch.nn.CosineEmbeddingLoss(margin=0.2)(
         torch.from_numpy(a), torch.from_numpy(b), torch.from_numpy(y))
-    np.testing.assert_allclose(got, float(want), rtol=1e-4)
+    np.testing.assert_allclose(got, float(want.detach()), rtol=1e-4)
 
 
 def test_poisson_criterion():
@@ -176,5 +176,5 @@ def test_multi_label_margin_criterion():
     ttgt = torch.from_numpy((tgt1 - 1).astype(np.int64))
     want = torch.nn.MultiLabelMarginLoss()(to, ttgt)
     want.backward()
-    np.testing.assert_allclose(got, float(want), rtol=1e-4, atol=1e-6)
+    np.testing.assert_allclose(got, float(want.detach()), rtol=1e-4, atol=1e-6)
     np.testing.assert_allclose(grad, to.grad.numpy(), rtol=1e-4, atol=1e-6)

@@ -311,3 +311,14 @@ class TestFeatureColumnOps:
         m = ops.ModuleToOperation(nn.Linear(3, 2))
         y = m.forward(np.ones((1, 3), np.float32))
         assert np.asarray(y).shape == (1, 2)
+
+    def test_const_fill_invert_permutation(self):
+        from bigdl_tpu.nn import ops
+        from bigdl_tpu.utils.table import T
+        c = ops.Const(np.asarray([1.0, 2.0]))
+        np.testing.assert_allclose(
+            np.asarray(c.forward(jnp.zeros(7))), [1.0, 2.0])
+        f = ops.Fill().forward(T(jnp.asarray([2, 3]), jnp.asarray(5.0)))
+        np.testing.assert_allclose(np.asarray(f), np.full((2, 3), 5.0))
+        inv = ops.InvertPermutation().forward(jnp.asarray([2, 0, 1, 3]))
+        np.testing.assert_allclose(np.asarray(inv), [1, 2, 0, 3])
