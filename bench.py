@@ -258,7 +258,35 @@ CONFIGS = {
 }
 
 
+def _device_liveness_probe(timeout_s=180):
+    """The axon TPU tunnel can wedge so that device ops hang forever
+    (not fail).  Probe with a tiny op under a watchdog so a dead tunnel
+    turns into a fast non-zero exit instead of an infinite hang."""
+    import threading
+
+    done = threading.Event()
+    err = []
+
+    def probe():
+        try:
+            float(jnp.sum(jnp.ones(4)))
+            done.set()
+        except Exception as e:
+            err.append(e)
+            done.set()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    if not done.wait(timeout_s) or err:
+        print(f"# device liveness probe failed "
+              f"({err[0] if err else f'no response in {timeout_s}s'}); "
+              "backend unreachable", file=sys.stderr, flush=True)
+        import os
+        os._exit(2)
+
+
 def main():
+    _device_liveness_probe()
     names = sys.argv[1:] or list(CONFIGS)
     unknown = [n for n in names if n not in CONFIGS]
     if unknown:
