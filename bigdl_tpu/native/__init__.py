@@ -60,6 +60,12 @@ def load() -> Optional[ctypes.CDLL]:
         lib.pf_buffered.restype = ctypes.c_uint64
         lib.pf_buffered.argtypes = [ctypes.c_void_p]
         lib.pf_destroy.argtypes = [ctypes.c_void_p]
+        lib.ip_prepare_batch.restype = ctypes.c_int
+        lib.ip_prepare_batch.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_int]
         _lib = lib
         return _lib
 
@@ -157,3 +163,51 @@ class NativePrefetcher:
             self.close()
         except Exception:
             pass
+
+
+def prepare_image_batch(images, crop_h, crop_w, offsets=None, flips=None,
+                        mean=(0.0, 0.0, 0.0), std=(1.0, 1.0, 1.0),
+                        n_threads=4):
+    """One-pass batched crop + flip + normalize + HWC->CHW
+    (≙ the chained BGRImgCropper/HFlip/BGRImgNormalizer/BGRImgToBatch hot
+    loop, without the intermediate materializations).
+
+    images: (N, H, W, C) uint8; offsets: (N, 2) int32 crop (y, x) or None
+    (top-left); flips: (N,) bool/uint8 or None.  Returns
+    (N, C, crop_h, crop_w) float32.  Falls back to numpy when the native
+    library is unavailable — same numerics either way.
+    """
+    import numpy as np
+    images = np.ascontiguousarray(images, np.uint8)
+    n, in_h, in_w, c = images.shape
+    mean_a = np.ascontiguousarray(mean, np.float32)
+    std_a = np.ascontiguousarray(std, np.float32)
+    if mean_a.size != c or std_a.size != c:
+        raise ValueError(f"mean/std must have {c} entries")
+    offs_a = None if offsets is None else \
+        np.ascontiguousarray(offsets, np.int32)
+    flips_a = None if flips is None else \
+        np.ascontiguousarray(flips, np.uint8)
+    lib = load()
+    if lib is not None:
+        out = np.empty((n, c, crop_h, crop_w), np.float32)
+        rc = lib.ip_prepare_batch(
+            images.ctypes.data, n, in_h, in_w, c,
+            offs_a.ctypes.data if offs_a is not None else None,
+            flips_a.ctypes.data if flips_a is not None else None,
+            mean_a.ctypes.data, std_a.ctypes.data, crop_h, crop_w,
+            out.ctypes.data, n_threads)
+        if rc != 0:
+            raise ValueError("ip_prepare_batch: bad arguments")
+        return out
+    # numpy fallback (same semantics)
+    out = np.empty((n, c, crop_h, crop_w), np.float32)
+    inv = np.where(std_a != 0, 1.0 / std_a, 1.0)
+    for i in range(n):
+        oy, ox = (offs_a[i] if offs_a is not None else (0, 0))
+        patch = images[i, oy:oy + crop_h, ox:ox + crop_w].astype(np.float32)
+        if flips_a is not None and flips_a[i]:
+            patch = patch[:, ::-1]
+        patch = (patch - mean_a) * inv
+        out[i] = np.transpose(patch, (2, 0, 1))
+    return out

@@ -135,3 +135,35 @@ def test_file_record_dataset_feeds_training(tmp_path):
            .set_end_when(Trigger.max_epoch(2)))
     m = opt.optimize()
     assert m._params is not None
+
+
+def test_prepare_image_batch_matches_numpy_reference():
+    """Native one-pass crop+flip+normalize+CHW == per-step numpy chain."""
+    from bigdl_tpu import native
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (8, 40, 40, 3), dtype=np.uint8)
+    offs = rng.randint(0, 8, (8, 2)).astype(np.int32)
+    flips = (rng.rand(8) > 0.5).astype(np.uint8)
+    mean = (125.0, 122.0, 114.0)
+    std = (58.0, 57.0, 57.0)
+    out = native.prepare_image_batch(imgs, 32, 32, offs, flips, mean, std)
+    assert out.shape == (8, 3, 32, 32)
+    want = np.empty_like(out)
+    for i in range(8):
+        oy, ox = offs[i]
+        p = imgs[i, oy:oy + 32, ox:ox + 32].astype(np.float32)
+        if flips[i]:
+            p = p[:, ::-1]
+        p = (p - np.asarray(mean, np.float32)) / np.asarray(std, np.float32)
+        want[i] = p.transpose(2, 0, 1)
+    np.testing.assert_allclose(out, want, atol=1e-5)
+
+
+def test_prepare_image_batch_defaults_and_errors():
+    from bigdl_tpu import native
+    import pytest
+    imgs = np.zeros((2, 8, 8, 3), np.uint8)
+    out = native.prepare_image_batch(imgs, 8, 8)
+    assert out.shape == (2, 3, 8, 8)
+    with pytest.raises(ValueError):
+        native.prepare_image_batch(imgs, 8, 8, mean=(0.0,), std=(1.0,))
