@@ -354,3 +354,47 @@ class TestDeviceLoader:
 
         for a, b in zip(train(False), train(True)):
             np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+class TestDeviceAugment:
+    def test_jit_random_crop_flip_normalize(self):
+        import jax
+        import jax.numpy as jnp
+        from bigdl_tpu.data.device_augment import DeviceAugment
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randint(0, 256, (4, 40, 40, 3), dtype=np.uint8))
+        aug = DeviceAugment(crop=(32, 32), flip=True,
+                            mean=(120.0, 120.0, 120.0),
+                            std=(60.0, 60.0, 60.0))
+        f = jax.jit(lambda xx, k: aug(xx, k, training=True))
+        out = f(x, jax.random.PRNGKey(0))
+        assert out.shape == (4, 3, 32, 32)
+        assert out.dtype == jnp.float32
+        # different keys -> different crops (stochastic)
+        out2 = f(x, jax.random.PRNGKey(1))
+        assert not np.allclose(np.asarray(out), np.asarray(out2))
+        # same key -> deterministic
+        out3 = f(x, jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(out3))
+
+    def test_eval_center_crop_matches_numpy(self):
+        import jax.numpy as jnp
+        from bigdl_tpu.data.device_augment import DeviceAugment
+        rng = np.random.RandomState(1)
+        x = rng.randint(0, 256, (2, 36, 36, 3), dtype=np.uint8)
+        aug = DeviceAugment(crop=(32, 32), mean=(10.0, 20.0, 30.0),
+                            std=(2.0, 4.0, 8.0))
+        out = np.asarray(aug(jnp.asarray(x), training=False))
+        want = x[:, 2:34, 2:34].astype(np.float32)
+        want = (want - np.asarray([10.0, 20.0, 30.0], np.float32)) \
+            / np.asarray([2.0, 4.0, 8.0], np.float32)
+        np.testing.assert_allclose(out, want.transpose(0, 3, 1, 2),
+                                   rtol=1e-6)
+
+    def test_bf16_output_for_mxu(self):
+        import jax.numpy as jnp
+        from bigdl_tpu.data.device_augment import DeviceAugment
+        x = jnp.zeros((2, 8, 8, 3), jnp.uint8)
+        aug = DeviceAugment(dtype=jnp.bfloat16, out_format="NHWC")
+        out = aug(x, training=False)
+        assert out.dtype == jnp.bfloat16 and out.shape == (2, 8, 8, 3)
