@@ -213,3 +213,74 @@ def test_lstm_gru_shapes_and_torch_cell_parity():
                        else out[1])
     np.testing.assert_allclose(got_h, th.detach().numpy(), rtol=1e-4,
                                atol=1e-4)
+
+
+def test_volumetric_conv_matches_torch_conv3d():
+    rng = np.random.RandomState(10)
+    x = rng.randn(2, 2, 6, 7, 8).astype(np.float32)
+    W = rng.randn(3, 2, 3, 3, 3).astype(np.float32) * 0.2
+    b = rng.randn(3).astype(np.float32) * 0.2
+    m = nn.VolumetricConvolution(2, 3, 3, 3, 3, 1, 1, 1)
+    got = run_layer(m, x, {m.name: {"weight": W, "bias": b}})
+    want = F.conv3d(torch.from_numpy(x), torch.from_numpy(W),
+                    torch.from_numpy(b)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_volumetric_pools_match_torch():
+    rng = np.random.RandomState(11)
+    x = rng.randn(1, 2, 6, 6, 6).astype(np.float32)
+    m = nn.VolumetricMaxPooling(2, 2, 2, 2, 2, 2)
+    got = run_layer(m, x)
+    want = F.max_pool3d(torch.from_numpy(x), 2, 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+    a = nn.VolumetricAveragePooling(2, 2, 2, 2, 2, 2)
+    got = run_layer(a, x)
+    want = F.avg_pool3d(torch.from_numpy(x), 2, 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+def test_temporal_conv_matches_torch_conv1d():
+    rng = np.random.RandomState(12)
+    x = rng.randn(2, 9, 5).astype(np.float32)      # (B, T, C)
+    W = rng.randn(4, 5, 3).astype(np.float32) * 0.3
+    b = rng.randn(4).astype(np.float32) * 0.3
+    m = nn.TemporalConvolution(5, 4, 3)
+    got = run_layer(m, x, {m.name: {"weight": W, "bias": b}})
+    want = F.conv1d(torch.from_numpy(x.transpose(0, 2, 1)),
+                    torch.from_numpy(W), torch.from_numpy(b)).numpy()
+    np.testing.assert_allclose(got, want.transpose(0, 2, 1),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dilated_conv_matches_torch():
+    rng = np.random.RandomState(13)
+    x = rng.randn(2, 3, 10, 10).astype(np.float32)
+    W = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.2
+    b = rng.randn(4).astype(np.float32) * 0.2
+    m = nn.SpatialDilatedConvolution(3, 4, 3, 3, 1, 1, 1, 1, 2, 2)
+    got = run_layer(m, x, {m.name: {"weight": W, "bias": b}})
+    want = F.conv2d(torch.from_numpy(x), torch.from_numpy(W),
+                    torch.from_numpy(b), padding=1, dilation=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_lookup_table_matches_torch_embedding():
+    rng = np.random.RandomState(14)
+    W = rng.randn(10, 6).astype(np.float32)
+    ids1 = np.array([[1, 5], [9, 2]], np.float32)   # ours 1-based
+    m = nn.LookupTable(10, 6)
+    got = run_layer(m, ids1, {m.name: {"weight": W}})
+    want = F.embedding(torch.from_numpy((ids1 - 1).astype(np.int64)),
+                       torch.from_numpy(W)).numpy()
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
+
+
+def test_upsampling_matches_torch():
+    rng = np.random.RandomState(15)
+    x = rng.randn(2, 3, 5, 5).astype(np.float32)
+    m = nn.UpSampling2D((2, 2))
+    got = run_layer(m, x)
+    want = F.interpolate(torch.from_numpy(x), scale_factor=2,
+                         mode="nearest").numpy()
+    np.testing.assert_allclose(got, want, rtol=RTOL, atol=ATOL)
