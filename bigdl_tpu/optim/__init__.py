@@ -1,6 +1,6 @@
 """bigdl_tpu.optim — training methods & drivers (≙ com.intel.analytics.bigdl.optim)."""
 from .optim_method import (OptimMethod, SGD, Adam, AdamW, Adagrad, Adadelta,
-                           Adamax, RMSprop, Ftrl, LBFGS)
+                           Adamax, RMSprop, Ftrl, LBFGS, LARS, LAMB)
 from .lr_schedule import (LearningRateSchedule, Default, Step, MultiStep,
                           Exponential, NaturalExp, Poly, Warmup,
                           SequentialSchedule, EpochDecay, EpochStep, Plateau)

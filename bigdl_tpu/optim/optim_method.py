@@ -410,3 +410,98 @@ class LBFGS(OptimMethod):
             if delta < self.tol_fun or float(jnp.max(jnp.abs(t * d))) < self.tol_x:
                 break
         return unpack(xv), losses
+
+
+def _norm(x):
+    return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+
+
+class LARS(OptimMethod):
+    """Layer-wise Adaptive Rate Scaling (You et al. 2017) — large-batch SGD
+    where each parameter tensor's step is scaled by trust *
+    ||w|| / (||g|| + wd*||w||).  TPU-era addition: the reference caps out
+    at batch ~2k/node; LARS is what makes batch 8k+ ResNet converge on
+    pods."""
+
+    def __init__(self, learning_rate=1e-1, momentum=0.9, weight_decay=1e-4,
+                 trust_coefficient=1e-3, epsilon=1e-9,
+                 learning_rate_schedule=None):
+        super().__init__()
+        self.lr = learning_rate
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+        self.trust = trust_coefficient
+        self.eps = epsilon
+        self.schedule = learning_rate_schedule or Default()
+
+    def init_state(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "velocity": _tmap(jnp.zeros_like, params)}
+
+    def get_learning_rate(self, state):
+        return self.schedule.rate(self, state["step"])
+
+    def update(self, grads, params, state):
+        step = state["step"]
+        clr = self.schedule.rate(self, step)
+
+        def new_velocity(p, g, v):
+            g = g.astype(jnp.float32)
+            pf = p.astype(jnp.float32)
+            wn, gn = _norm(pf), _norm(g)
+            g = g + self.weight_decay * pf
+            ratio = jnp.where(
+                (wn > 0) & (gn > 0),
+                self.trust * wn / (gn + self.weight_decay * wn + self.eps),
+                1.0)
+            return self.momentum * v + clr * ratio * g
+
+        vel = _tmap(new_velocity, params, grads, state["velocity"])
+        new_params = _tmap(lambda p, v: (p.astype(jnp.float32) - v)
+                           .astype(p.dtype), params, vel)
+        return new_params, {"step": step + 1, "velocity": vel}
+
+
+class LAMB(OptimMethod):
+    """Layer-wise adaptive Adam (You et al. 2019) — the large-batch
+    optimizer for transformer pretraining (BERT in 76 min); per-tensor
+    trust ratio on top of bias-corrected Adam + decoupled weight decay."""
+
+    def __init__(self, learning_rate=1e-3, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, weight_decay=0.01,
+                 learning_rate_schedule=None):
+        super().__init__()
+        self.lr = learning_rate
+        self.beta1, self.beta2, self.eps = beta1, beta2, epsilon
+        self.weight_decay = weight_decay
+        self.schedule = learning_rate_schedule or Default()
+
+    def init_state(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "m": _tmap(jnp.zeros_like, params),
+                "v": _tmap(jnp.zeros_like, params)}
+
+    def get_learning_rate(self, state):
+        return self.schedule.rate(self, state["step"])
+
+    def update(self, grads, params, state):
+        step = state["step"]
+        t = step + 1
+        clr = self.schedule.rate(self, step)
+        m = _tmap(lambda m_, g: self.beta1 * m_ + (1 - self.beta1) * g,
+                  state["m"], grads)
+        v = _tmap(lambda v_, g: self.beta2 * v_ + (1 - self.beta2) * g * g,
+                  state["v"], grads)
+        bc1 = 1.0 - self.beta1 ** t.astype(jnp.float32)
+        bc2 = 1.0 - self.beta2 ** t.astype(jnp.float32)
+
+        def upd(p, m_, v_):
+            pf = p.astype(jnp.float32)
+            u = (m_ / bc1) / (jnp.sqrt(v_ / bc2) + self.eps) \
+                + self.weight_decay * pf
+            wn, un = _norm(pf), _norm(u)
+            ratio = jnp.where((wn > 0) & (un > 0), wn / un, 1.0)
+            return (pf - clr * ratio * u).astype(p.dtype)
+
+        new_params = _tmap(upd, params, m, v)
+        return new_params, {"step": t, "m": m, "v": v}
