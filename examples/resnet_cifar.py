@@ -39,7 +39,10 @@ def main():
            .set_optim_method(SGD(learning_rate=args.lr, momentum=0.9,
                                  dampening=0.0, weight_decay=1e-4,
                                  nesterov=True))
-           .set_end_when(Trigger.max_epoch(args.epochs)))
+           .set_end_when(Trigger.max_epoch(args.epochs))
+           # stage batches to the device from a background thread while
+           # the previous step runs (double buffering)
+           .set_prefetch(2))
     model = opt.optimize()
     res = Evaluator(model, batch_size=256).test((xte_n, yte_1),
                                                [Top1Accuracy()])
