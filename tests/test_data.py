@@ -398,3 +398,46 @@ class TestDeviceAugment:
         aug = DeviceAugment(dtype=jnp.bfloat16, out_format="NHWC")
         out = aug(x, training=False)
         assert out.dtype == jnp.bfloat16 and out.shape == (2, 8, 8, 3)
+
+
+def test_vision_transformer_sweep():
+    """Every ImageFrame vision transformer runs on a synthetic image and
+    produces a sane HWC float image (≙ transform/vision *Spec coverage)."""
+    rng = np.random.RandomState(0)
+
+    def feat():
+        return V.ImageFeature(rng.rand(24, 20, 3).astype(np.float32) * 255,
+                              label=1.0)
+
+    cases = [
+        V.Resize(16, 16),
+        V.AspectScale(16, max_size=40),
+        V.RandomResize(12, 20),
+        V.CenterCrop(12, 12),
+        V.RandomCrop(12, 12),
+        V.FixedCrop(0.1, 0.1, 0.8, 0.8, normalized=True),
+        V.RandomCropper(12, 12, True, "Random"),
+        V.RandomAlterAspect(0.3, 1.2, 0.8, 16),
+        V.Expand(max_expand_ratio=1.5),
+        V.Filler(0.0, 0.0, 0.4, 0.4, value=128),
+        V.HFlipVision(),
+        V.RandomTransformer(V.HFlipVision(), 0.5),
+        V.Brightness(-10, 10),
+        V.Contrast(0.8, 1.2),
+        V.Saturation(0.8, 1.2),
+        V.Hue(-10, 10),
+        V.ColorJitterVision(),
+        V.ChannelNormalize(110, 110, 110, 60, 60, 60),
+        V.ChannelScaledNormalizer(110, 110, 110, 1.0 / 255),
+        V.PixelNormalizer(np.full((24, 20, 3), 100.0, np.float32)),
+        V.ChannelOrder(),
+    ]
+    for tr in cases:
+        f = tr(feat())
+        img = f.image
+        assert img.ndim == 3 and img.shape[-1] == 3, type(tr).__name__
+        assert np.isfinite(img).all(), type(tr).__name__
+
+    # tensor conversion last (changes layout)
+    f = V.MatToTensor()(V.Resize(16, 16)(feat()))
+    assert f["floats"].shape == (3, 16, 16)
