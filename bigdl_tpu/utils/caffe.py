@@ -207,10 +207,12 @@ def _convert_layer(ltype: str, lp: PrototxtMessage, in_channels: int):
         ph, pw = _ks(pp, "pad", "pad_h", "pad_w") or (0, 0)
         pool = str(pp.get("pool", "MAX")).upper()
         if pool in ("MAX", "0"):
-            mod = nn.SpatialMaxPooling(kw, kh, sw, sh, pw, ph).ceil()
+            mod = nn.SpatialMaxPooling(kw, kh, sw, sh, pw, ph,
+                                       ceil_mode=True)
         else:
             mod = nn.SpatialAveragePooling(kw, kh, sw, sh, pw, ph,
-                                           count_include_pad=False).ceil()
+                                           count_include_pad=False,
+                                           ceil_mode=True)
         return mod, in_channels
     if t == "relu":
         return nn.ReLU(), in_channels

@@ -232,3 +232,33 @@ def test_recurrent_add_roundtrip(tmp_path):
     m.add(nn.GRU(4, 6))
     x = np.random.RandomState(9).randn(2, 5, 4).astype(np.float32)
     _roundtrip(m, x, tmp_path)
+
+
+def test_post_ctor_ceil_mode_survives(tmp_path):
+    """.ceil() is a post-constructor mutation — ctor replay alone would
+    silently load floor-mode pooling (caught by GoogLeNet round-trip)."""
+    m = nn.Sequential(nn.SpatialMaxPooling(3, 3, 2, 2).ceil())
+    x = np.random.RandomState(0).randn(1, 2, 8, 8).astype(np.float32)
+    y1 = np.asarray(m.forward(x))
+    assert y1.shape[-1] == 4   # ceil mode: ceil((8-3)/2)+1 = 4 (floor: 3)
+    path = str(tmp_path / "p.bigdl")
+    m.save(path)
+    m2 = nn.Module.load(path)
+    y2 = np.asarray(m2.forward(x))
+    assert y2.shape == y1.shape
+    np.testing.assert_allclose(y1, y2)
+
+
+def test_caffe_googlenet_serde_roundtrip(tmp_path):
+    from bigdl_tpu.models.inception import googlenet_v1_deploy_prototxt
+    from bigdl_tpu.utils.caffe import load_caffe
+    p = tmp_path / "g.prototxt"
+    p.write_text(googlenet_v1_deploy_prototxt(class_num=12))
+    m = load_caffe(str(p))
+    x = np.random.RandomState(0).rand(1, 3, 224, 224).astype(np.float32)
+    y1 = np.asarray(m.forward(x))
+    path = str(tmp_path / "g.bigdl")
+    m.save(path)
+    m2 = nn.Module.load(path)
+    y2 = np.asarray(m2.forward(x))
+    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
