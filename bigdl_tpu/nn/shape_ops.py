@@ -61,6 +61,8 @@ class View(Module):
         self.sizes = tuple(sizes)
         self.num_input_dims = 0
 
+    _serde_extra_attrs = ("num_input_dims",)
+
     def set_num_input_dims(self, n):
         self.num_input_dims = n
         return self

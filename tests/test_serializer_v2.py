@@ -262,3 +262,15 @@ def test_caffe_googlenet_serde_roundtrip(tmp_path):
     m2 = nn.Module.load(path)
     y2 = np.asarray(m2.forward(x))
     np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
+
+
+def test_post_ctor_setters_survive(tmp_path):
+    m = nn.Sequential(nn.Dropout(0.3).set_p(0.7),
+                      nn.View(4).set_num_input_dims(1))
+    path = str(tmp_path / "s.bigdl")
+    m.ensure_initialized()
+    m.save(path)
+    m2 = nn.Module.load(path)
+    drop, view = m2.children()
+    assert drop.p == 0.7
+    assert view.num_input_dims == 1
