@@ -11,26 +11,31 @@ from ..nn import (Sequential, SpatialConvolution, SpatialBatchNormalization,
                   Linear, LogSoftMax, View)
 
 
-def vgg_for_cifar10(class_num=10, has_dropout=True):
-    """VggForCifar10.apply (VggForCifar10.scala:27)."""
+def vgg_for_cifar10(class_num=10, has_dropout=True, format="NCHW"):
+    """VggForCifar10.apply (VggForCifar10.scala:27).  format='NHWC' builds
+    the TPU-preferred layout (convs tile straight onto the MXU)."""
     model = Sequential()
 
     def conv_bn_relu(ni, no):
-        model.add(SpatialConvolution(ni, no, 3, 3, 1, 1, 1, 1))
-        model.add(SpatialBatchNormalization(no, 1e-3))
+        model.add(SpatialConvolution(ni, no, 3, 3, 1, 1, 1, 1,
+                                     format=format))
+        model.add(SpatialBatchNormalization(no, 1e-3, format=format))
         model.add(ReLU())
+
+    def pool():
+        model.add(SpatialMaxPooling(2, 2, 2, 2, format=format).ceil())
 
     conv_bn_relu(3, 64)
     if has_dropout:
         model.add(Dropout(0.3))
     conv_bn_relu(64, 64)
-    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+    pool()
 
     conv_bn_relu(64, 128)
     if has_dropout:
         model.add(Dropout(0.4))
     conv_bn_relu(128, 128)
-    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+    pool()
 
     conv_bn_relu(128, 256)
     if has_dropout:
@@ -39,7 +44,7 @@ def vgg_for_cifar10(class_num=10, has_dropout=True):
     if has_dropout:
         model.add(Dropout(0.4))
     conv_bn_relu(256, 256)
-    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+    pool()
 
     conv_bn_relu(256, 512)
     if has_dropout:
@@ -48,7 +53,7 @@ def vgg_for_cifar10(class_num=10, has_dropout=True):
     if has_dropout:
         model.add(Dropout(0.4))
     conv_bn_relu(512, 512)
-    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+    pool()
 
     conv_bn_relu(512, 512)
     if has_dropout:
@@ -57,7 +62,7 @@ def vgg_for_cifar10(class_num=10, has_dropout=True):
     if has_dropout:
         model.add(Dropout(0.4))
     conv_bn_relu(512, 512)
-    model.add(SpatialMaxPooling(2, 2, 2, 2).ceil())
+    pool()
     model.add(View(512))
 
     classifier = Sequential()
@@ -82,16 +87,18 @@ _VGG_CFG = {
 }
 
 
-def vgg_imagenet(class_num=1000, depth=16, has_dropout=True):
+def vgg_imagenet(class_num=1000, depth=16, has_dropout=True,
+                 format="NCHW"):
     """Standard VGG-16/19 (224x224 input) for the ImageNet zoo."""
     cfg = _VGG_CFG[depth]
     model = Sequential()
     ni = 3
     for v in cfg:
         if v == "M":
-            model.add(SpatialMaxPooling(2, 2, 2, 2))
+            model.add(SpatialMaxPooling(2, 2, 2, 2, format=format))
         else:
-            model.add(SpatialConvolution(ni, v, 3, 3, 1, 1, 1, 1))
+            model.add(SpatialConvolution(ni, v, 3, 3, 1, 1, 1, 1,
+                                         format=format))
             model.add(ReLU())
             ni = v
     model.add(View(512 * 7 * 7))
@@ -108,7 +115,8 @@ def vgg_imagenet(class_num=1000, depth=16, has_dropout=True):
     return model
 
 
-def build(class_num=10, dataset="cifar10", depth=16, has_dropout=True):
+def build(class_num=10, dataset="cifar10", depth=16, has_dropout=True,
+          format="NCHW"):
     if dataset == "cifar10":
-        return vgg_for_cifar10(class_num, has_dropout)
-    return vgg_imagenet(class_num, depth, has_dropout)
+        return vgg_for_cifar10(class_num, has_dropout, format=format)
+    return vgg_imagenet(class_num, depth, has_dropout, format=format)
