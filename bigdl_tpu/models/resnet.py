@@ -127,7 +127,7 @@ def build(class_num=1000, depth=50, shortcut_type=ShortcutType.B,
         b.i_channels = 64
         (model
          .add(b.conv(3, 64, 7, 7, 2, 2, 3, 3, with_bias=False,
-                     name="conv1" if format == "NCHW" else None))
+                     name="conv1"))
          .add(b.bn(64))
          .add(ReLU())
          .add(SpatialMaxPooling(3, 3, 2, 2, 1, 1, format=format))
@@ -138,7 +138,7 @@ def build(class_num=1000, depth=50, shortcut_type=ShortcutType.B,
          .add(SpatialAveragePooling(7, 7, 1, 1, format=format))
          .add(View(n_features))
          .add(Linear(n_features, class_num,
-                     name="fc1000" if format == "NCHW" else None)))
+                     name="fc1000")))
     elif dataset == "cifar10":
         if (depth - 2) % 6 != 0:
             raise ValueError("CIFAR-10 ResNet depth must be 6n+2")

@@ -8,7 +8,7 @@ from __future__ import annotations
 
 from ..nn import (Sequential, SpatialConvolution, SpatialBatchNormalization,
                   BatchNormalization, ReLU, Dropout, SpatialMaxPooling,
-                  Linear, LogSoftMax, View)
+                  Linear, LogSoftMax, Transpose, View)
 
 
 def vgg_for_cifar10(class_num=10, has_dropout=True, format="NCHW"):
@@ -101,6 +101,10 @@ def vgg_imagenet(class_num=1000, depth=16, has_dropout=True,
                                          format=format))
             model.add(ReLU())
             ni = v
+    if format == "NHWC":
+        # flatten in (c, h, w) order so classifier weights are
+        # interchangeable with the NCHW build (View is layout-blind)
+        model.add(Transpose([(1, 3), (2, 3)]))
     model.add(View(512 * 7 * 7))
     model.add(Linear(512 * 7 * 7, 4096))
     model.add(ReLU())

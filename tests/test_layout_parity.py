@@ -1,0 +1,99 @@
+"""NHWC vs NCHW layout parity for the zoo models.
+
+The TPU-preferred NHWC layout (bench.py, __graft_entry__.entry) must be a
+pure layout change: identical params (conv weights are stored OIHW either
+way), identical numerics.  Guards the 2.7x NHWC fast path against layout
+bugs (≙ reference DataFormat tests, nn/abstractnn/DataFormat.scala).
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.models import resnet, vgg
+from bigdl_tpu.optim import SGD
+from bigdl_tpu.optim.optimizer import make_train_step
+
+
+def _pair(builder):
+    """Same-weight model pair.  Auto-named layers draw from a global uid
+    counter, so two builds in one process get different key names; the
+    NHWC params/state are rebuilt from the NCHW leaves by tree order."""
+    m_nchw = builder("NCHW")
+    m_nhwc = builder("NHWC")
+    params, state = m_nchw.init_params(0)
+    params2, state2 = m_nhwc.init_params(0)
+
+    def rekey(src, dst):
+        leaves, _ = jax.tree_util.tree_flatten(src)
+        dst_leaves, treedef = jax.tree_util.tree_flatten(dst)
+        assert len(leaves) == len(dst_leaves)
+        assert all(a.shape == b.shape
+                   for a, b in zip(leaves, dst_leaves))
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    return (m_nchw, m_nhwc, params, state,
+            rekey(params, params2), rekey(state, state2))
+
+
+BUILDERS = {
+    "resnet20_cifar": (lambda f: resnet.build(class_num=10, depth=20,
+                                              dataset="cifar10", format=f),
+                       (4, 3, 32, 32)),
+    "resnet50_imagenet": (lambda f: resnet.build(class_num=21, depth=50,
+                                                 dataset="imagenet",
+                                                 format=f),
+                          (1, 3, 224, 224)),
+    "vgg16_cifar": (lambda f: vgg.build(class_num=10, dataset="cifar10",
+                                        format=f, has_dropout=False),
+                    (4, 3, 32, 32)),
+    "vgg16_imagenet": (lambda f: vgg.build(class_num=13, dataset="imagenet",
+                                           format=f, has_dropout=False),
+                       (2, 3, 224, 224)),
+}
+
+
+@pytest.mark.parametrize("name", list(BUILDERS))
+def test_forward_layout_parity(name):
+    builder, shape = BUILDERS[name]
+    m_nchw, m_nhwc, params, state, params_h, state_h = _pair(builder)
+    if "imagenet" in name:
+        # untrained 1000-way LogSoftMax output is near-uniform (spread
+        # ~1e-2), which would hide even a full feature permutation —
+        # compare the pre-softmax logits instead
+        m_nchw = nn.Sequential(*m_nchw.children()[:-1])
+        m_nhwc = nn.Sequential(*m_nhwc.children()[:-1])
+    x = np.random.RandomState(0).randn(*shape).astype(np.float32)
+    y1, _ = m_nchw.run(params, jnp.asarray(x), state=state, training=False)
+    y2, _ = m_nhwc.run(params_h, jnp.asarray(x.transpose(0, 2, 3, 1)),
+                       state=state_h, training=False)
+    y1, y2 = np.asarray(y1), np.asarray(y2)
+    # normalize by the output spread: layout changes only reorder fp32
+    # reductions, so the relative disagreement must be tiny; a layout bug
+    # (e.g. a permuted flatten) disagrees at ~100% of the spread
+    spread = max(float(y1.std()), 1e-6)
+    rel = float(np.abs(y1 - y2).max()) / spread
+    assert rel < 5e-3, f"layout mismatch: max|Δ|/spread = {rel:.4f}"
+
+
+def test_train_step_layout_parity():
+    builder, shape = BUILDERS["resnet20_cifar"]
+    m_nchw, m_nhwc, params, state, params_h, state_h = _pair(builder)
+    rs = np.random.RandomState(1)
+    x = rs.randn(*shape).astype(np.float32)
+    y = rs.randint(1, 11, shape[0]).astype(np.float32)
+    outs = []
+    for m, xin, p0, s0 in ((m_nchw, x, params, state),
+                           (m_nhwc, x.transpose(0, 2, 3, 1),
+                            params_h, state_h)):
+        method = SGD(learning_rate=0.1, momentum=0.9)
+        step = make_train_step(m, nn.ClassNLLCriterion(), method,
+                               mixed_precision=False)
+        p, o, s, loss = step(p0, method.init_state(p0), s0,
+                             jnp.asarray(xin), jnp.asarray(y),
+                             jax.random.PRNGKey(0))
+        outs.append((float(loss), np.asarray(
+            jax.tree_util.tree_leaves(p)[0], np.float32)))
+    assert abs(outs[0][0] - outs[1][0]) < 1e-4
+    np.testing.assert_allclose(outs[0][1], outs[1][1], rtol=1e-3, atol=1e-4)
