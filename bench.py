@@ -177,7 +177,7 @@ def bench_transformer():
     from bigdl_tpu.models.transformer import (TransformerLM,
                                               TransformerConfig,
                                               lm_cross_entropy)
-    from bigdl_tpu.ops import flash_attention as fa
+    from bigdl_tpu.ops import flash_attention_mod as fa
     from bigdl_tpu.optim import SGD
 
     on_tpu = jax.default_backend() == "tpu"

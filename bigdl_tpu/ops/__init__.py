@@ -5,6 +5,10 @@ The reference gets its hot-loop speed from Intel MKL primitives
 equivalent role is played by Pallas kernels feeding the MXU, with pure-XLA
 blockwise fallbacks so every op also runs (and is differentiable) on CPU.
 """
+# keep a non-shadowed module alias: the next line rebinds the package
+# attribute `flash_attention` to the *function*, so consumers that need
+# module internals (_Config, _pallas_ok, _INTERPRET) import this alias
+from . import flash_attention as flash_attention_mod  # noqa: F401
 from .flash_attention import flash_attention, attention_reference
 
-__all__ = ["flash_attention", "attention_reference"]
+__all__ = ["flash_attention", "attention_reference", "flash_attention_mod"]

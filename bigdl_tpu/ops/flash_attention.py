@@ -42,6 +42,10 @@ except Exception:  # pragma: no cover
 # (the same trick as jax.nn and the original flash kernels).
 DEFAULT_MASK_VALUE = -0.7 * float(np.finfo(np.float32).max)
 
+# Test hook: when True, Pallas kernels run in interpret mode so the TPU
+# code path itself (not the XLA fallback) is exercised on CPU.
+_INTERPRET = False
+
 
 class _Config(NamedTuple):
     causal: bool
@@ -152,20 +156,30 @@ def _fwd_blockwise(q, k, v, cfg: _Config):
 
 
 # --------------------------------------------------------------------- #
-# Pallas forward kernel                                                 #
+# Pallas kernels                                                        #
 # --------------------------------------------------------------------- #
+def _block_causal_mask(qi, j, block_q, block_k):
+    """(block_q, block_k) bool mask for q block `qi` vs kv block `j`."""
+    qp = qi * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kp = j * block_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return qp >= kp
+
+
+def _causal_hi(qi, block_q, block_k, n_kb):
+    """First kv-block index past the causal horizon of q block `qi`."""
+    hi = lax.div(qi * block_q + block_q - 1, block_k) + 1
+    return jnp.minimum(hi, n_kb)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                 sm_scale, causal, block_q, block_k, seq_k):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
     d = q.shape[-1]
     n_kb = seq_k // block_k
-    if causal:
-        # blocks strictly above the diagonal contribute nothing
-        hi = lax.div(qi * block_q + block_q - 1, block_k) + 1
-        hi = jnp.minimum(hi, n_kb)
-    else:
-        hi = n_kb
+    hi = _causal_hi(qi, block_q, block_k, n_kb) if causal else n_kb
 
     def body(j, carry):
         acc, m, l = carry
@@ -173,11 +187,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, kb.T, preferred_element_type=jnp.float32)  # (bq, bk)
         if causal:
-            qp = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            kp = j * block_k + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(qp >= kp, s, DEFAULT_MASK_VALUE)
+            s = jnp.where(_block_causal_mask(qi, j, block_q, block_k),
+                          s, DEFAULT_MASK_VALUE)
         m_new = jnp.maximum(m, s.max(axis=-1))
         p = jnp.exp(s - m_new[:, None])
         corr = jnp.exp(m - m_new)
@@ -222,8 +233,141 @@ def _fwd_pallas(q, k, v, cfg: _Config):
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
         ],
+        interpret=_INTERPRET,
     )(qf, kf, vf)
     return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+# --------------------------------------------------------------------- #
+# Pallas backward kernels                                               #
+# --------------------------------------------------------------------- #
+def _bwd_kernel_dkv(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, sm_scale, causal, block_q, block_k,
+                    seq_q):
+    """One (batch*head, kv-block) program: accumulate dk/dv over q blocks.
+
+    Flash-attention backward recomputes p = exp(s - lse) per block from the
+    saved lse — no (seq, seq) matrix is ever materialised.
+    """
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                      # (bk, d)
+    v = v_ref[0].astype(jnp.float32)
+    n_qb = seq_q // block_q
+    # under causality, q blocks strictly before this kv block see none of it
+    lo = lax.div(ki * block_k, block_q) if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lseb = lse_ref[0, pl.ds(i * block_q, block_q)]
+        deltab = delta_ref[0, pl.ds(i * block_q, block_q)]
+        s = jnp.dot(qb, k.T, preferred_element_type=jnp.float32) * sm_scale
+        p = jnp.exp(s - lseb[:, None])                    # (bq, bk)
+        if causal:
+            p = jnp.where(_block_causal_mask(i, ki, block_q, block_k),
+                          p, 0.0)
+        dv = dv + jnp.dot(p.T, dob, preferred_element_type=jnp.float32)
+        dp = jnp.dot(dob, v.T, preferred_element_type=jnp.float32)
+        ds_ = p * (dp - deltab[:, None]) * sm_scale
+        dk = dk + jnp.dot(ds_.T, qb, preferred_element_type=jnp.float32)
+        return dk, dv
+
+    d = k.shape[-1]
+    init = (jnp.zeros((block_k, d), jnp.float32),
+            jnp.zeros((block_k, d), jnp.float32))
+    dk, dv = lax.fori_loop(lo, n_qb, body, init)
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_kernel_dq(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, *, sm_scale, causal, block_q, block_k, seq_k):
+    """One (batch*head, q-block) program: accumulate dq over kv blocks."""
+    qi = pl.program_id(1)
+    qb = q_ref[0].astype(jnp.float32)                     # (bq, d)
+    dob = do_ref[0].astype(jnp.float32)
+    lseb = lse_ref[0]
+    deltab = delta_ref[0]
+    n_kb = seq_k // block_k
+    hi = _causal_hi(qi, block_q, block_k, n_kb) if causal else n_kb
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * sm_scale
+        p = jnp.exp(s - lseb[:, None])
+        if causal:
+            p = jnp.where(_block_causal_mask(qi, j, block_q, block_k),
+                          p, 0.0)
+        dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
+        ds_ = p * (dp - deltab[:, None]) * sm_scale
+        return dq + jnp.dot(ds_, kb, preferred_element_type=jnp.float32)
+
+    d = qb.shape[-1]
+    dq = lax.fori_loop(0, hi, body, jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, out, lse, do, cfg: _Config):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk = cfg.block_q, cfg.block_k
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+    dof = do.reshape(b * h, sq, d)
+    lsef = lse.reshape(b * h, sq)
+    # delta_i = sum_d do_i * out_i; tiny elementwise reduce, leave it to XLA
+    delta = (do.astype(jnp.float32) * out.astype(jnp.float32)
+             ).sum(-1).reshape(b * h, sq)
+
+    kv_kernel = functools.partial(
+        _bwd_kernel_dkv, sm_scale=cfg.sm_scale, causal=cfg.causal,
+        block_q=bq, block_k=bk, seq_q=sq)
+    dk, dv = pl.pallas_call(
+        kv_kernel,
+        grid=(b * h, sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, sq, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, sq), lambda bh, j: (bh, 0)),
+            pl.BlockSpec((1, sq), lambda bh, j: (bh, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        interpret=_INTERPRET,
+    )(qf, kf, vf, dof, lsef, delta)
+
+    q_kernel = functools.partial(
+        _bwd_kernel_dq, sm_scale=cfg.sm_scale, causal=cfg.causal,
+        block_q=bq, block_k=bk, seq_k=sk)
+    dq = pl.pallas_call(
+        q_kernel,
+        grid=(b * h, sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),
+        ],
+        out_specs=[pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)],
+        interpret=_INTERPRET,
+    )(qf, kf, vf, dof, lsef, delta)[0]
+
+    return (dq.reshape(b, h, sq, d), dk.reshape(b, h, sk, d),
+            dv.reshape(b, h, sk, d))
 
 
 def _pallas_ok(q, k, cfg: _Config) -> bool:
@@ -232,7 +376,8 @@ def _pallas_ok(q, k, cfg: _Config) -> bool:
     sq, d = q.shape[2], q.shape[3]
     sk = k.shape[2]
     return (sq % cfg.block_q == 0 and sk % cfg.block_k == 0
-            and d % 128 == 0 and jax.default_backend() == "tpu")
+            and d % 128 == 0
+            and (jax.default_backend() == "tpu" or _INTERPRET))
 
 
 # --------------------------------------------------------------------- #
@@ -254,6 +399,8 @@ def _flash_fwd(cfg, q, k, v):
 
 def _flash_bwd(cfg, res, do):
     q, k, v, out, lse = res
+    if _pallas_ok(q, k, cfg):
+        return _bwd_pallas(q, k, v, out, lse, do, cfg)
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bk = min(cfg.block_k, sk)
