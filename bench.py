@@ -97,13 +97,20 @@ def _train_throughput(model, batch_shape, class_num, batch, k,
     return batch / sec
 
 
-def _report(metric, value, unit, baseline):
-    print(json.dumps({
+_HEADLINE = {}   # resnet50 line, withheld until exit (driver parses LAST line)
+
+
+def _report(metric, value, unit, baseline, defer=False):
+    line = {
         "metric": metric,
         "value": round(value, 2),
         "unit": unit,
         "vs_baseline": round(value / baseline, 3) if baseline else None,
-    }), flush=True)
+    }
+    if defer:
+        _HEADLINE.update(line)
+    else:
+        print(json.dumps(line), flush=True)
 
 
 # --------------------------------------------------------------------- #
@@ -248,7 +255,7 @@ def bench_resnet50():
     batch = 256
     ips = _train_throughput(model, (batch, 224, 224, 3), 1000, batch, k=20)
     _report("resnet50_train_images_per_sec_per_chip", ips, "images/sec",
-            57.0)
+            57.0, defer=True)
 
 
 CONFIGS = {
@@ -257,7 +264,7 @@ CONFIGS = {
     "lstm": bench_lstm,
     "inception": bench_inception,
     "transformer": bench_transformer,
-    "resnet50": bench_resnet50,   # headline: keep LAST
+    "resnet50": bench_resnet50,   # headline: runs first, prints last
 }
 
 
@@ -288,25 +295,61 @@ def _device_liveness_probe(timeout_s=180):
         os._exit(2)
 
 
+def _flush_headline_and_exit(rc):
+    import os
+    if _HEADLINE:
+        print(json.dumps(_HEADLINE), flush=True)
+        os._exit(0)
+    os._exit(rc)
+
+
+def _deadline_watchdog(seconds):
+    """The tunnel can wedge mid-run (ops hang forever, not fail).  If the
+    wall-clock budget expires, emit the already-measured headline (if any)
+    as the final line and exit, instead of hanging until the driver's
+    timeout eats the whole round's bench."""
+    import threading
+
+    def watch():
+        time.sleep(seconds)
+        print(f"# bench deadline ({seconds:.0f}s) expired; "
+              "emitting headline and exiting", file=sys.stderr, flush=True)
+        _flush_headline_and_exit(3)
+
+    threading.Thread(target=watch, daemon=True).start()
+
+
 def main():
+    import os
     _device_liveness_probe()
+    _deadline_watchdog(float(os.environ.get("BENCH_DEADLINE_S", 2700)))
     names = sys.argv[1:] or list(CONFIGS)
     unknown = [n for n in names if n not in CONFIGS]
     if unknown:
         print(f"# unknown bench config(s) {unknown}; "
               f"choose from {list(CONFIGS)}", file=sys.stderr, flush=True)
         names = [n for n in names if n in CONFIGS] or list(CONFIGS)
-    # headline prints last so the driver's final-line parse sees it
-    names = sorted(set(names), key=lambda n: (n == "resnet50",
+    # headline runs FIRST (most important number, least exposure to a
+    # mid-run tunnel wedge); its JSON line is deferred and printed last
+    names = sorted(set(names), key=lambda n: (n != "resnet50",
                                               list(CONFIGS).index(n)))
-    for name in names:
-        try:
-            CONFIGS[name]()
-        except Exception as e:      # one config must not sink the headline
-            if name == "resnet50":
-                raise
-            print(f"# bench {name} failed: {type(e).__name__}: {e}",
-                  file=sys.stderr, flush=True)
+    headline_err = None
+    try:
+        for name in names:
+            try:
+                CONFIGS[name]()
+            except Exception as e:  # one config must not sink the others
+                if name == "resnet50":
+                    headline_err = e
+                print(f"# bench {name} failed: {type(e).__name__}: {e}",
+                      file=sys.stderr, flush=True)
+    finally:
+        # the headline, once measured, must never be lost — not even to a
+        # KeyboardInterrupt/SystemExit in a later config
+        if _HEADLINE:
+            print(json.dumps(_HEADLINE), flush=True)
+    if headline_err is not None:
+        raise headline_err
 
 
 if __name__ == "__main__":
