@@ -218,6 +218,7 @@ class Optimizer:
 
     # -- checkpointing (≙ Optimizer.saveCheckpoint / resume) ------------- #
     def save_checkpoint(self, params, opt_state, model_state, tag=None):
+        from ..utils.serializer import save_state_file
         if self.checkpoint_path is None:
             return
         tag = tag or f"iter_{self.state.iteration}"
@@ -225,20 +226,24 @@ class Optimizer:
         host = jax.tree_util.tree_map(np.asarray,
                                       (params, opt_state, model_state))
         meta = {"epoch": self.state.epoch, "iteration": self.state.iteration}
-        with open(path, "wb") as f:
-            pickle.dump({"state": host, "meta": meta}, f)
+        save_state_file({"state": host, "meta": meta}, path)
         latest = os.path.join(self.checkpoint_path, "latest")
         with open(latest, "w") as f:
             f.write(path)
 
     def load_checkpoint(self):
+        import zipfile
+        from ..utils.serializer import load_state_file
         latest = os.path.join(self.checkpoint_path, "latest")
         if not os.path.exists(latest):
             return None
         with open(latest) as f:
             path = f.read().strip()
-        with open(path, "rb") as f:
-            blob = pickle.load(f)
+        if zipfile.is_zipfile(path):
+            blob = load_state_file(path)
+        else:  # legacy round-1/2 pickle checkpoint (own files only)
+            with open(path, "rb") as f:
+                blob = pickle.load(f)
         self.state.epoch = blob["meta"]["epoch"]
         self.state.iteration = blob["meta"]["iteration"]
         restored = migrate_legacy_names(blob["state"], self.model)

@@ -1,29 +1,50 @@
 """Object persistence (≙ utils/File.scala save/load).
 
-The reference serializes to local/HDFS paths via java serialization; ours
-pickles with device arrays converted to host numpy first (a checkpoint must
-never capture live device buffers)."""
+The reference serializes arbitrary objects to local/HDFS paths via java
+serialization.  Ours writes the tagged-JSON + .npy zip state format
+(utils/serializer.save_state_file — no pickle, stable across class
+refactors) whenever the object is expressible in it, and falls back to
+pickle only for arbitrary Python objects the format cannot hold.  Device
+arrays are converted to host numpy first (a checkpoint must never capture
+live device buffers); writes are atomic (no torn files on crash).
+"""
 from __future__ import annotations
 
 import os
 import pickle
+import zipfile
 
 import jax
 import numpy as np
 
 
 def save(obj, path: str, is_overwrite: bool = True):
+    from .serializer import SerializationError, save_state_file
     if os.path.exists(path) and not is_overwrite:
         raise FileExistsError(path)
     host = jax.tree_util.tree_map(
         lambda v: np.asarray(v) if isinstance(v, jax.Array) else v, obj,
         is_leaf=lambda v: isinstance(v, jax.Array))
     tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
-    os.replace(tmp, path)  # atomic: no torn checkpoints on crash
+    try:
+        try:
+            save_state_file(host, tmp)
+        except SerializationError:
+            # object the format cannot hold -> pickle fallback
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            with open(tmp, "wb") as f:
+                pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
+    except BaseException:
+        if os.path.exists(tmp):   # no torn .tmp litter on failure
+            os.remove(tmp)
+        raise
+    os.replace(tmp, path)
 
 
 def load(path: str):
-    with open(path, "rb") as f:
+    from .serializer import load_state_file
+    if zipfile.is_zipfile(path):
+        return load_state_file(path)
+    with open(path, "rb") as f:  # legacy / arbitrary-object fallback
         return pickle.load(f)

@@ -49,7 +49,10 @@ class SerializationError(ValueError):
 
 
 def _to_host(tree):
-    return jax.tree_util.tree_map(np.asarray, tree)
+    # convert only array-like leaves; str/int/float/bool pass through (a
+    # blanket np.asarray would turn strings into U-dtype arrays)
+    return jax.tree_util.tree_map(
+        lambda v: np.asarray(v) if _is_array(v) else v, tree)
 
 
 def _to_device(tree):
@@ -81,21 +84,29 @@ class _Encoder:
         self.index = {}            # id(module) -> table index
         self.arrays = {}           # "arrays/aN.npy" -> np.ndarray
 
-    def array_ref(self, v):
+    def array_ref(self, v, where=""):
+        arr = np.asarray(v)
+        if arr.dtype.kind not in "biufc":   # matches what jnp can restore
+            raise SerializationError(
+                f"{where}: array dtype {arr.dtype} is not serializable "
+                "(numeric/bool arrays only)")
         key = f"arrays/a{len(self.arrays)}.npy"
-        self.arrays[key] = np.asarray(v)
+        self.arrays[key] = arr
         return {"$a": key}
 
     def value(self, v, where=""):
         from ..nn.module import Module, Criterion
         if v is None or isinstance(v, (bool, int, float, str)):
             return v
+        if isinstance(v, (bytes, bytearray, set, frozenset, complex)):
+            raise SerializationError(
+                f"{where}: {type(v).__name__} values are not serializable")
         if isinstance(v, Module):
             return {"$m": self.module(v)}
         if _is_dtype(v):
             return {"$dtype": np.dtype(v).name}
         if _is_array(v):
-            return self.array_ref(v)
+            return self.array_ref(v, where)
         if isinstance(v, tuple):
             return {"$t": [self.value(e, where) for e in v]}
         if isinstance(v, list):
@@ -116,6 +127,15 @@ class _Encoder:
 
     def object(self, v, where):
         cls = type(v)
+        key = f"{cls.__module__}:{cls.__qualname__}"
+        # mirror _Decoder.resolve_class at ENCODE time: a file that cannot
+        # be loaded back must not be writable in the first place
+        if not (key in _CLASS_REGISTRY
+                or cls.__module__ == "bigdl_tpu"
+                or cls.__module__.startswith("bigdl_tpu.")):
+            raise SerializationError(
+                f"{where}: cannot serialize {key!r}; only bigdl_tpu classes "
+                "and serializer.register_class'd classes are loadable")
         entry = {"module": cls.__module__, "class": cls.__qualname__}
         serde = getattr(v, "_serde", None)
         if serde is not None and serde.get("config") is not None:
@@ -127,7 +147,12 @@ class _Encoder:
             if serde.get("varargs"):
                 entry["varargs"] = serde["varargs"]
         else:
-            state = {k: x for k, x in vars(v).items()
+            try:
+                attrs = vars(v)
+            except TypeError:
+                raise SerializationError(
+                    f"{where}: {type(v).__name__} has no inspectable state")
+            state = {k: x for k, x in attrs.items()
                      if k not in ("output", "grad_input", "_serde")
                      and not callable(x)}
             entry["state"] = {k: self.value(x, f"{where}.{k}")
@@ -344,14 +369,7 @@ def save_module(module, path, overwrite=True):
         else enc.value(_to_host(module._params), "params"),
         "state": enc.value(_to_host(module._state or {}), "state"),
     }
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr("manifest.json",
-                   json.dumps({"format": _FORMAT, "version": VERSION}))
-        z.writestr("topology.json", json.dumps(topo))
-        for key, arr in enc.arrays.items():
-            buf = io.BytesIO()
-            np.save(buf, arr, allow_pickle=False)
-            z.writestr(key, buf.getvalue())
+    _write_payload_zip(path, _FORMAT, "topology.json", topo, enc.arrays)
 
 
 def load_module(path):
@@ -395,6 +413,53 @@ def load_module(path):
             f"{path}: corrupt or truncated module file ({e})") from e
 
 
+def _write_payload_zip(path, fmt, payload_name, payload, arrays):
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("manifest.json",
+                   json.dumps({"format": fmt, "version": VERSION}))
+        z.writestr(payload_name, json.dumps(payload))
+        for key, arr in arrays.items():
+            buf = io.BytesIO()
+            np.save(buf, arr, allow_pickle=False)
+            z.writestr(key, buf.getvalue())
+
+
+def _read_payload_zip(path, fmt, payload_name, desc):
+    """Manifest-checked zip read shared by weights/state loaders; every
+    corruption mode surfaces as SerializationError."""
+    if not zipfile.is_zipfile(path):
+        raise SerializationError(f"{path}: not a bigdl_tpu {desc} file")
+    try:
+        with zipfile.ZipFile(path) as z:
+            manifest = json.loads(z.read("manifest.json"))
+            if manifest.get("format") != fmt:
+                raise SerializationError(
+                    f"{path}: manifest says {manifest.get('format')!r}, "
+                    f"expected a {desc} file")
+            payload = json.loads(z.read(payload_name))
+            blobs = {k: z.read(k) for k in z.namelist()
+                     if k.startswith("arrays/")}
+    except (zipfile.BadZipFile, json.JSONDecodeError, KeyError) as e:
+        raise SerializationError(
+            f"{path}: corrupt or truncated {desc} file ({e})") from e
+
+    def read_array(key):
+        import jax.numpy as jnp
+        return jnp.asarray(np.load(io.BytesIO(blobs[key]),
+                                   allow_pickle=False))
+
+    def decode(fn):
+        try:
+            return fn(_Decoder({"nodes": []}, read_array))
+        except (KeyError, IndexError, TypeError, ValueError) as e:
+            if isinstance(e, SerializationError):
+                raise
+            raise SerializationError(
+                f"{path}: corrupt {desc} payload ({e})") from e
+
+    return payload, decode
+
+
 def save_weights_file(module, path):
     """Params+state only (no topology), same tagged-JSON + .npy zip format."""
     enc = _Encoder()
@@ -403,15 +468,33 @@ def save_weights_file(module, path):
         else enc.value(_to_host(module._params), "params"),
         "state": enc.value(_to_host(module._state or {}), "state"),
     }
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr("manifest.json",
-                   json.dumps({"format": _FORMAT + ".weights",
-                               "version": VERSION}))
-        z.writestr("weights.json", json.dumps(payload))
-        for key, arr in enc.arrays.items():
-            buf = io.BytesIO()
-            np.save(buf, arr, allow_pickle=False)
-            z.writestr(key, buf.getvalue())
+    _write_payload_zip(path, _FORMAT + ".weights", "weights.json", payload,
+                       enc.arrays)
+
+
+def save_state_file(tree, path):
+    """Arbitrary training-state pytree (dicts/tuples/lists/arrays/scalars
+    plus registered helper objects) as a tagged-JSON + .npy zip — the
+    no-pickle counterpart of the reference's OptimMethod/state snapshots
+    (optim/OptimMethod.scala save).  Raises SerializationError for values
+    the format cannot hold (so callers can fall back) BEFORE any bytes are
+    written."""
+    enc = _Encoder()
+    payload = enc.value(_to_host(tree), "state")
+    if enc.nodes:
+        raise SerializationError(
+            "state tree contains Module instances; save them with "
+            "save_module / Module.save instead")
+    _write_payload_zip(path, _FORMAT + ".state", "state.json", payload,
+                       enc.arrays)
+
+
+def load_state_file(path):
+    """Inverse of save_state_file; raises SerializationError on corrupt,
+    truncated, or non-state files instead of unpickling anything."""
+    payload, decode = _read_payload_zip(path, _FORMAT + ".state",
+                                        "state.json", "state")
+    return decode(lambda dec: dec.value(payload))
 
 
 def load_weights_file(path):
@@ -433,20 +516,10 @@ def load_weights_file(path):
         raise SerializationError(
             f"{path}: not a bigdl_tpu weights file (neither v2 zip nor "
             "legacy pickle)")
-    try:
-        with zipfile.ZipFile(path) as z:
-            payload = json.loads(z.read("weights.json"))
-
-            def read_array(key):
-                import jax.numpy as jnp
-                buf = io.BytesIO(z.read(key))
-                return jnp.asarray(np.load(buf, allow_pickle=False))
-
-            dec = _Decoder({"nodes": []}, read_array)
-            return dec.value(payload["params"]), dec.value(payload["state"])
-    except (zipfile.BadZipFile, json.JSONDecodeError, KeyError) as e:
-        raise SerializationError(
-            f"{path}: corrupt or truncated weights file ({e})") from e
+    payload, decode = _read_payload_zip(path, _FORMAT + ".weights",
+                                        "weights.json", "weights")
+    return decode(lambda dec: (dec.value(payload["params"]),
+                               dec.value(payload["state"])))
 
 
 def _load_module_v1(path):

@@ -90,11 +90,15 @@ def test_checkpoint_resume_migrates_unpadded_names(tmp_path):
            .set_end_when(Trigger.max_epoch(1))
            .set_checkpoint(str(tmp_path)))
     opt.optimize()
-    # rewrite the checkpoint with legacy (unpadded) key names
+    # rewrite the checkpoint as a legacy round-1 artifact: pickle format
+    # AND unpadded key names (exercises both the legacy-pickle read
+    # branch and name migration)
+    import jax as _jax
+    from bigdl_tpu.utils.serializer import load_state_file
     with open(str(tmp_path / "latest")) as f:
         path = f.read().strip()
-    with open(path, "rb") as f:
-        blob = pickle.load(f)
+    blob = load_state_file(path)
+    blob["state"] = _jax.tree_util.tree_map(np.asarray, blob["state"])
 
     def unpad(tree):
         if isinstance(tree, dict):

@@ -274,3 +274,149 @@ def test_post_ctor_setters_survive(tmp_path):
     drop, view = m2.children()
     assert drop.p == 0.7
     assert view.num_input_dims == 1
+
+
+def test_state_file_roundtrip_and_no_pickle(tmp_path):
+    """Training-state checkpoints (optimizer save_checkpoint) use the
+    tagged-JSON + .npy zip, not pickle, and round-trip tuples/dicts/
+    scalars/arrays exactly."""
+    import zipfile
+    from bigdl_tpu.utils.serializer import save_state_file, load_state_file
+    tree = {"state": ({"w": np.arange(6.0).reshape(2, 3)},
+                      (np.float32(3.5), 7),
+                      {"momentum": np.ones(4, np.float32)}),
+            "meta": {"epoch": 2, "iteration": 40}}
+    p = str(tmp_path / "ckpt.bin")
+    save_state_file(tree, p)
+    assert zipfile.is_zipfile(p)
+    got = load_state_file(p)
+    assert got["meta"] == {"epoch": 2, "iteration": 40}
+    assert isinstance(got["state"], tuple) and len(got["state"]) == 3
+    np.testing.assert_array_equal(np.asarray(got["state"][0]["w"]),
+                                  tree["state"][0]["w"])
+    np.testing.assert_array_equal(np.asarray(got["state"][2]["momentum"]),
+                                  tree["state"][2]["momentum"])
+
+
+def test_state_file_rejects_corruption(tmp_path):
+    from bigdl_tpu.utils.serializer import (SerializationError,
+                                            save_state_file,
+                                            load_state_file)
+    p = str(tmp_path / "ckpt.bin")
+    save_state_file({"a": np.ones(3)}, p)
+    blob = open(p, "rb").read()
+    with open(p, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    with pytest.raises(SerializationError):
+        load_state_file(p)
+    with open(p, "wb") as f:
+        f.write(b"not a zip at all")
+    with pytest.raises(SerializationError):
+        load_state_file(p)
+
+
+def test_optimizer_checkpoint_is_zip(tmp_path):
+    """End-to-end: LocalOptimizer.set_checkpoint writes the no-pickle
+    format and resumes from it."""
+    import zipfile
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 5).astype(np.float32)
+    y = rs.randn(32, 1).astype(np.float32)
+    model = nn.Sequential(nn.Linear(5, 3), nn.Tanh(), nn.Linear(3, 1))
+    opt = (LocalOptimizer(model, (x, y), nn.MSECriterion(), batch_size=16)
+           .set_optim_method(SGD(learning_rate=0.01))
+           .set_end_when(Trigger.max_epoch(1))
+           .set_checkpoint(str(tmp_path)))
+    opt.optimize()
+    path = open(str(tmp_path / "latest")).read().strip()
+    assert zipfile.is_zipfile(path), "checkpoint must not be a pickle"
+    opt2 = (LocalOptimizer(model, (x, y), nn.MSECriterion(), batch_size=16)
+            .set_optim_method(SGD(learning_rate=0.01))
+            .set_end_when(Trigger.max_epoch(2))
+            .set_checkpoint(str(tmp_path)))
+    m2 = opt2.optimize()
+    assert opt2.state.epoch >= 2 and m2._params is not None
+
+
+def test_file_utils_prefer_state_format(tmp_path):
+    import zipfile
+    from bigdl_tpu.utils import file as F
+    p = str(tmp_path / "obj.bin")
+    F.save({"a": np.arange(3.0), "b": (1, "x")}, p)
+    assert zipfile.is_zipfile(p)
+    got = F.load(p)
+    np.testing.assert_array_equal(np.asarray(got["a"]), np.arange(3.0))
+    assert got["b"] == (1, "x")
+
+    # int-keyed dict: not expressible in the state format -> pickle fallback
+    p2 = str(tmp_path / "weird.bin")
+    F.save({"w": {1: "one", 2: "two"}}, p2)
+    assert not zipfile.is_zipfile(p2)
+    assert F.load(p2)["w"] == {1: "one", 2: "two"}
+
+
+def test_state_file_refuses_modules(tmp_path):
+    """A pytree holding a Module must fail at SAVE time (not produce an
+    unloadable file); file.save then round-trips it via the fallback."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.utils.serializer import SerializationError, save_state_file
+    from bigdl_tpu.utils import file as F
+    p = str(tmp_path / "m.bin")
+    with pytest.raises(SerializationError):
+        save_state_file({"m": nn.Linear(2, 2)}, p)
+    assert not (tmp_path / "m.bin").exists()
+    F.save({"m": nn.Linear(2, 2)}, p)     # pickle fallback
+    assert isinstance(F.load(p)["m"], nn.Linear)
+
+
+@pytest.mark.parametrize("value", [b"\x00\x01", {3, 4}, complex(1, 2),
+                                   np.array([{"a": 1}], dtype=object)])
+def test_state_file_refuses_unholdable_values(tmp_path, value):
+    """bytes/sets/complex/object-arrays: SerializationError at save time,
+    nothing written, file.save falls back to pickle and round-trips."""
+    from bigdl_tpu.utils.serializer import SerializationError, save_state_file
+    from bigdl_tpu.utils import file as F
+    p = str(tmp_path / "v.bin")
+    with pytest.raises(SerializationError):
+        save_state_file({"v": value}, p)
+    assert not (tmp_path / "v.bin").exists()
+    F.save({"v": value}, p)
+    got = F.load(p)["v"]
+    if isinstance(value, np.ndarray):
+        assert got[0] == value[0]
+    else:
+        assert got == value
+
+
+def test_state_file_refuses_foreign_classes(tmp_path):
+    """Unregistered non-bigdl_tpu classes are rejected when WRITING (the
+    decoder would refuse them anyway; save-succeeds/load-fails is worse)."""
+    from bigdl_tpu.utils.serializer import SerializationError, save_state_file
+
+    class Foreign:
+        def __init__(self):
+            self.x = 1
+
+    with pytest.raises(SerializationError):
+        save_state_file({"f": Foreign()}, str(tmp_path / "f.bin"))
+    assert not (tmp_path / "f.bin").exists()
+
+
+def test_state_file_bad_payload_is_serialization_error(tmp_path):
+    """Valid zip with a corrupt payload (dangling $m/$a refs, bad $dtype)
+    must raise SerializationError, not IndexError/TypeError."""
+    import json, zipfile
+    from bigdl_tpu.utils.serializer import (SerializationError,
+                                            load_state_file, _FORMAT,
+                                            VERSION)
+    for payload in ({"$m": 0}, {"$a": "arrays/missing.npy"},
+                    {"$dtype": "no_such_dtype"}):
+        p = str(tmp_path / "bad.bin")
+        with zipfile.ZipFile(p, "w") as z:
+            z.writestr("manifest.json", json.dumps(
+                {"format": _FORMAT + ".state", "version": VERSION}))
+            z.writestr("state.json", json.dumps(payload))
+        with pytest.raises(SerializationError):
+            load_state_file(p)
