@@ -218,36 +218,48 @@ class Optimizer:
 
     # -- checkpointing (≙ Optimizer.saveCheckpoint / resume) ------------- #
     def save_checkpoint(self, params, opt_state, model_state, tag=None):
-        from ..utils.serializer import save_state_file
+        from ..utils.serializer import (SerializationError, _to_host,
+                                        save_state_file)
         if self.checkpoint_path is None:
             return
         tag = tag or f"iter_{self.state.iteration}"
         path = os.path.join(self.checkpoint_path, f"checkpoint_{tag}.bin")
-        host = jax.tree_util.tree_map(np.asarray,
-                                      (params, opt_state, model_state))
+        host = _to_host((params, opt_state, model_state))
         meta = {"epoch": self.state.epoch, "iteration": self.state.iteration}
-        save_state_file({"state": host, "meta": meta}, path)
+        try:
+            save_state_file({"state": host, "meta": meta}, path)
+        except SerializationError:
+            # exotic leaves in a custom OptimMethod's state: a checkpoint
+            # trigger must never kill the run — fall back to pickle (which
+            # load_checkpoint still reads)
+            with open(path, "wb") as f:
+                pickle.dump({"state": host, "meta": meta}, f)
         latest = os.path.join(self.checkpoint_path, "latest")
         with open(latest, "w") as f:
             f.write(path)
 
     def load_checkpoint(self):
-        import zipfile
         from ..utils.serializer import load_state_file
         latest = os.path.join(self.checkpoint_path, "latest")
         if not os.path.exists(latest):
             return None
         with open(latest) as f:
             path = f.read().strip()
-        if zipfile.is_zipfile(path):
+        with open(path, "rb") as f:
+            head = f.read(2)
+        if head == b"PK":   # magic-byte routing, same rationale as file.load
             blob = load_state_file(path)
-        else:  # legacy round-1/2 pickle checkpoint (own files only)
+        else:  # legacy round-1/2 (or fallback) pickle checkpoint
             with open(path, "rb") as f:
                 blob = pickle.load(f)
         self.state.epoch = blob["meta"]["epoch"]
         self.state.iteration = blob["meta"]["iteration"]
         restored = migrate_legacy_names(blob["state"], self.model)
-        return jax.tree_util.tree_map(jnp.asarray, restored)
+        return jax.tree_util.tree_map(
+            lambda v: jnp.asarray(v) if isinstance(v, (np.ndarray,
+                                                       np.generic,
+                                                       jax.Array))
+            else v, restored)
 
     # -- validation ------------------------------------------------------ #
     def _validate(self, params, model_state):

@@ -44,7 +44,15 @@ def save(obj, path: str, is_overwrite: bool = True):
 
 def load(path: str):
     from .serializer import load_state_file
-    if zipfile.is_zipfile(path):
-        return load_state_file(path)
+    # route by leading magic bytes, not zipfile.is_zipfile content
+    # sniffing: a PICKLED payload that embeds zip bytes would satisfy
+    # is_zipfile (it scans for the end-of-central-directory record), but
+    # a real state file always starts with the zip local-header magic and
+    # a pickle always starts with \x80
+    with open(path, "rb") as f:
+        head = f.read(2)
+    if head == b"PK":
+        from .serializer import _to_host
+        return _to_host(load_state_file(path))  # detached host arrays
     with open(path, "rb") as f:  # legacy / arbitrary-object fallback
         return pickle.load(f)

@@ -276,7 +276,11 @@ class _Decoder:
             if "$t" in v:
                 return tuple(self.value(e) for e in v["$t"])
             if "$dtype" in v:
-                return np.dtype(v["$dtype"]).type
+                try:
+                    return np.dtype(v["$dtype"]).type
+                except TypeError as e:
+                    raise SerializationError(
+                        f"bad $dtype tag {v['$dtype']!r}") from e
             if "$dict" in v:
                 return {k: self.value(e) for k, e in v["$dict"].items()}
             if "$obj" in v:
@@ -312,6 +316,9 @@ class _Decoder:
         from ..nn.module import Module
         if idx in self.built:
             return self.built[idx]
+        if not isinstance(idx, int) or not 0 <= idx < len(self.nodes):
+            raise SerializationError(f"dangling module reference {idx!r} "
+                                     f"(file has {len(self.nodes)} nodes)")
         entry = self.nodes[idx]
         cls = self.resolve_class(entry["module"], entry["class"])
         custom_build = (cls._serde_build.__func__
@@ -424,40 +431,47 @@ def _write_payload_zip(path, fmt, payload_name, payload, arrays):
             z.writestr(key, buf.getvalue())
 
 
-def _read_payload_zip(path, fmt, payload_name, desc):
-    """Manifest-checked zip read shared by weights/state loaders; every
-    corruption mode surfaces as SerializationError."""
+def _read_payload_zip(path, fmt, payload_name, desc, build):
+    """Manifest-checked zip read shared by weights/state loaders.
+
+    ``build(payload, read_array)`` runs inside the open-zip context so
+    arrays stream on demand (no checkpoint-sized blob dict).  Structural
+    corruption (bad zip/json/manifest, dangling refs, broken arrays)
+    surfaces as SerializationError; errors raised by reconstructed user
+    classes propagate untouched, mirroring load_module's contract.
+    """
     if not zipfile.is_zipfile(path):
         raise SerializationError(f"{path}: not a bigdl_tpu {desc} file")
     try:
-        with zipfile.ZipFile(path) as z:
+        z = zipfile.ZipFile(path)
+    except zipfile.BadZipFile as e:
+        raise SerializationError(
+            f"{path}: corrupt or truncated {desc} file ({e})") from e
+    with z:
+        try:
             manifest = json.loads(z.read("manifest.json"))
             if manifest.get("format") != fmt:
                 raise SerializationError(
                     f"{path}: manifest says {manifest.get('format')!r}, "
                     f"expected a {desc} file")
+            if manifest.get("version", 0) > VERSION:
+                raise SerializationError(
+                    f"{path}: unsupported version {manifest['version']}")
             payload = json.loads(z.read(payload_name))
-            blobs = {k: z.read(k) for k in z.namelist()
-                     if k.startswith("arrays/")}
-    except (zipfile.BadZipFile, json.JSONDecodeError, KeyError) as e:
-        raise SerializationError(
-            f"{path}: corrupt or truncated {desc} file ({e})") from e
-
-    def read_array(key):
-        import jax.numpy as jnp
-        return jnp.asarray(np.load(io.BytesIO(blobs[key]),
-                                   allow_pickle=False))
-
-    def decode(fn):
-        try:
-            return fn(_Decoder({"nodes": []}, read_array))
-        except (KeyError, IndexError, TypeError, ValueError) as e:
-            if isinstance(e, SerializationError):
-                raise
+        except (zipfile.BadZipFile, json.JSONDecodeError, KeyError) as e:
             raise SerializationError(
-                f"{path}: corrupt {desc} payload ({e})") from e
+                f"{path}: corrupt or truncated {desc} file ({e})") from e
 
-    return payload, decode
+        def read_array(key):
+            import jax.numpy as jnp
+            try:  # zip CRC + npy header are both checked here
+                return jnp.asarray(np.load(io.BytesIO(z.read(key)),
+                                           allow_pickle=False))
+            except Exception as e:
+                raise SerializationError(
+                    f"{path}: broken array {key!r} ({e})") from e
+
+        return build(payload, read_array)
 
 
 def save_weights_file(module, path):
@@ -492,9 +506,9 @@ def save_state_file(tree, path):
 def load_state_file(path):
     """Inverse of save_state_file; raises SerializationError on corrupt,
     truncated, or non-state files instead of unpickling anything."""
-    payload, decode = _read_payload_zip(path, _FORMAT + ".state",
-                                        "state.json", "state")
-    return decode(lambda dec: dec.value(payload))
+    return _read_payload_zip(
+        path, _FORMAT + ".state", "state.json", "state",
+        lambda payload, ra: _Decoder({"nodes": []}, ra).value(payload))
 
 
 def load_weights_file(path):
@@ -516,10 +530,12 @@ def load_weights_file(path):
         raise SerializationError(
             f"{path}: not a bigdl_tpu weights file (neither v2 zip nor "
             "legacy pickle)")
-    payload, decode = _read_payload_zip(path, _FORMAT + ".weights",
-                                        "weights.json", "weights")
-    return decode(lambda dec: (dec.value(payload["params"]),
-                               dec.value(payload["state"])))
+    def build(payload, read_array):
+        dec = _Decoder({"nodes": []}, read_array)
+        return (dec.value(payload.get("params")),
+                dec.value(payload.get("state")))
+    return _read_payload_zip(path, _FORMAT + ".weights", "weights.json",
+                             "weights", build)
 
 
 def _load_module_v1(path):

@@ -20,7 +20,6 @@ from bigdl_tpu.models.transformer import (TransformerLM,        # noqa: E402
                                           TransformerConfig,
                                           lm_cross_entropy)
 from bigdl_tpu.optim import SGD                                 # noqa: E402
-from bigdl_tpu.ops import flash_attention_mod as fa             # noqa: E402
 
 
 def lat():
@@ -33,7 +32,7 @@ def lat():
     return float(np.median(ls))
 
 
-def measure(B, T, block_q=128, block_k=128, n_layers=8, d_model=1024,
+def measure(B, T, n_layers=8, d_model=1024,
             n_heads=8, d_ff=4096, k=5, trials=3, remat=False):
     cfg = TransformerConfig(vocab_size=32000, d_model=d_model,
                             n_heads=n_heads, n_layers=n_layers, d_ff=d_ff,

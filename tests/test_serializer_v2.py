@@ -420,3 +420,84 @@ def test_state_file_bad_payload_is_serialization_error(tmp_path):
             z.writestr("state.json", json.dumps(payload))
         with pytest.raises(SerializationError):
             load_state_file(p)
+
+
+def test_checkpoint_with_exotic_state_leaf_survives(tmp_path):
+    """State leaves the zip format cannot hold (e.g. bytes injected by a
+    custom OptimMethod outside the jitted path) must still checkpoint via
+    the pickle fallback instead of killing the run, and load back."""
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(32, 4).astype(np.float32)
+    y = rs.randn(32, 1).astype(np.float32)
+    m = nn.Sequential(nn.Linear(4, 1))
+    opt = (LocalOptimizer(m, (x, y), nn.MSECriterion(), batch_size=16)
+           .set_optim_method(SGD(learning_rate=0.01))
+           .set_end_when(Trigger.max_epoch(1))
+           .set_checkpoint(str(tmp_path)))
+    opt.optimize()
+    params, state = m._params, m._state or {}
+    exotic_opt_state = {"inner": opt.optim_method.init_state(params),
+                        "blob": b"\x00raw"}
+    opt.save_checkpoint(params, exotic_opt_state, state)  # must not raise
+    restored = opt.load_checkpoint()
+    assert restored is not None
+    assert restored[1]["blob"] == b"\x00raw"
+
+
+def test_file_load_pickle_with_embedded_zip_bytes(tmp_path):
+    """A pickled payload that embeds zip-archive bytes must route to the
+    pickle reader, not be misdetected as a state file."""
+    import io, zipfile
+    from bigdl_tpu.utils import file as F
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as z:
+        z.writestr("inner.txt", "hello")
+    p = str(tmp_path / "z.bin")
+    F.save({"v": buf.getvalue()}, p)      # bytes -> pickle fallback
+    assert F.load(p)["v"] == buf.getvalue()
+
+
+def test_state_file_future_version_rejected(tmp_path):
+    import json, zipfile
+    from bigdl_tpu.utils.serializer import (SerializationError,
+                                            load_state_file, _FORMAT,
+                                            VERSION)
+    p = str(tmp_path / "future.bin")
+    with zipfile.ZipFile(p, "w") as z:
+        z.writestr("manifest.json", json.dumps(
+            {"format": _FORMAT + ".state", "version": VERSION + 1}))
+        z.writestr("state.json", json.dumps({"a": 1}))
+    with pytest.raises(SerializationError, match="unsupported version"):
+        load_state_file(p)
+
+
+def test_state_file_constructor_errors_propagate(tmp_path):
+    """Errors raised by a registered class's __init__ must surface as-is,
+    not be masked as file corruption."""
+    from bigdl_tpu.utils.serializer import (register_class, save_state_file,
+                                            load_state_file)
+
+    class Picky:
+        def __init__(self, n):
+            if n > 5:
+                raise RuntimeError("n too big")
+            self.n = n
+    register_class(Picky)
+    try:
+        p = str(tmp_path / "picky.bin")
+        obj = Picky(3)
+        obj._serde = {"config": {"n": 3}}
+        save_state_file({"o": obj}, p)
+        assert load_state_file(p)["o"].n == 3
+        obj2 = Picky(4)
+        obj2._serde = {"config": {"n": 99}}   # will raise at construct
+        p2 = str(tmp_path / "picky2.bin")
+        save_state_file({"o": obj2}, p2)
+        with pytest.raises(RuntimeError, match="n too big"):
+            load_state_file(p2)
+    finally:
+        from bigdl_tpu.utils.serializer import _CLASS_REGISTRY
+        _CLASS_REGISTRY.pop(f"{Picky.__module__}:{Picky.__qualname__}", None)
