@@ -179,6 +179,11 @@ class DistriOptimizer(Optimizer):
         return optim
 
     def _make_step_builder(self, params_template, optim):
+        if self._grad_accum > 1:
+            raise NotImplementedError(
+                "gradient accumulation is not supported by DistriOptimizer "
+                "yet; scale batch via the dp axis instead")
+
         def build_step():
             step_fn, shardable = self._build_step(params_template, optim)
             self._shardable = shardable

@@ -287,6 +287,19 @@ class _Decoder:
                 return self.object(v["$obj"])
         raise SerializationError(f"undecodable value {v!r}")
 
+    @staticmethod
+    def _user_code(fn, *a, **kw):
+        """Run reconstructed-class code (ctor/setattr); mark its errors so
+        loaders re-raise them untouched instead of as file corruption."""
+        try:
+            return fn(*a, **kw)
+        except Exception as e:
+            try:
+                e._bigdl_user_error = True
+            except Exception:
+                pass
+            raise
+
     def construct(self, cls, entry):
         cfg = {k: self.value(v) for k, v in entry.get("config", {}).items()}
         varargs = entry.get("varargs")
@@ -300,8 +313,8 @@ class _Decoder:
                     break
                 if p.name in cfg:
                     pos.append(cfg.pop(p.name))
-            return cls(*pos, *va, **cfg)
-        return cls(**cfg)
+            return self._user_code(cls, *pos, *va, **cfg)
+        return self._user_code(cls, **cfg)
 
     def object(self, entry):
         cls = self.resolve_class(entry["module"], entry["class"])
@@ -309,7 +322,8 @@ class _Decoder:
             return self.construct(cls, entry)
         obj = cls.__new__(cls)
         for k, v in entry.get("state", {}).items():
-            setattr(obj, k, self.value(v))
+            decoded = self.value(v)
+            self._user_code(setattr, obj, k, decoded)
         return obj
 
     def module(self, idx):
@@ -471,7 +485,19 @@ def _read_payload_zip(path, fmt, payload_name, desc, build):
                 raise SerializationError(
                     f"{path}: broken array {key!r} ({e})") from e
 
-        return build(payload, read_array)
+        try:
+            return build(payload, read_array)
+        except SerializationError:
+            raise
+        except Exception as e:
+            # structural decode failures become SerializationError with
+            # the file path; exceptions raised by reconstructed user
+            # classes (marked at the raise site) propagate untouched
+            if getattr(e, "_bigdl_user_error", False):
+                raise
+            raise SerializationError(
+                f"{path}: corrupt {desc} payload "
+                f"({type(e).__name__}: {e})") from e
 
 
 def save_weights_file(module, path):
@@ -531,9 +557,11 @@ def load_weights_file(path):
             f"{path}: not a bigdl_tpu weights file (neither v2 zip nor "
             "legacy pickle)")
     def build(payload, read_array):
+        if "params" not in payload or "state" not in payload:
+            raise SerializationError(
+                f"{path}: weights payload is missing params/state")
         dec = _Decoder({"nodes": []}, read_array)
-        return (dec.value(payload.get("params")),
-                dec.value(payload.get("state")))
+        return dec.value(payload["params"]), dec.value(payload["state"])
     return _read_payload_zip(path, _FORMAT + ".weights", "weights.json",
                              "weights", build)
 

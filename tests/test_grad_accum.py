@@ -1,0 +1,119 @@
+"""Gradient accumulation (make_accum_train_step /
+LocalOptimizer.set_gradient_accumulation)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+from bigdl_tpu.optim.optimizer import make_accum_train_step, make_train_step
+
+
+def _data(n=32, din=6):
+    rs = np.random.RandomState(0)
+    x = rs.randn(n, din).astype(np.float32)
+    y = rs.randn(n, 1).astype(np.float32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def test_accum_matches_full_batch_exactly():
+    """Without batch-dependent state (no BN), mean-of-microbatch-means
+    equals the full-batch gradient, so one accumulated step must match
+    one plain step to float tolerance."""
+    model = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 1))
+    crit = nn.MSECriterion()
+    method = SGD(learning_rate=0.1, momentum=0.9)
+    params, state = model.init_params(0)
+    x, y = _data()
+    rng = jax.random.PRNGKey(0)
+
+    p1, o1, s1, l1 = make_train_step(model, crit, method)(
+        params, method.init_state(params), state, x, y, rng)
+    p4, o4, s4, l4 = make_accum_train_step(model, crit, method, 4)(
+        params, method.init_state(params), state, x, y, rng)
+
+    assert abs(float(l1) - float(l4)) < 1e-5
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p4)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_accum_with_regularizer_matches():
+    from bigdl_tpu.optim.regularizer import L2Regularizer
+    model = nn.Sequential(
+        nn.Linear(6, 8, w_regularizer=L2Regularizer(1e-2)), nn.Tanh(),
+        nn.Linear(8, 1))
+    crit = nn.MSECriterion()
+    method = SGD(learning_rate=0.1)
+    params, state = model.init_params(0)
+    x, y = _data()
+    rng = jax.random.PRNGKey(0)
+    p1 = make_train_step(model, crit, method)(
+        params, method.init_state(params), state, x, y, rng)[0]
+    p2 = make_accum_train_step(model, crit, method, 2)(
+        params, method.init_state(params), state, x, y, rng)[0]
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_accum_threads_bn_state():
+    """BN running stats must advance once per microbatch (same semantics
+    as the reference's sequential subbatch loop)."""
+    model = nn.Sequential(nn.Linear(6, 8), nn.BatchNormalization(8))
+    crit = nn.MSECriterion()
+    method = SGD(learning_rate=0.0)   # isolate the state update
+    params, state = model.init_params(0)
+    x, _ = _data()
+    y = jnp.zeros((32, 8), jnp.float32)
+    step = make_accum_train_step(model, crit, method, 4)
+    _, _, s_after, _ = step(params, method.init_state(params), state, x, y,
+                            jax.random.PRNGKey(0))
+    leaves0 = jax.tree_util.tree_leaves(state)
+    leaves1 = jax.tree_util.tree_leaves(s_after)
+    assert any(not np.allclose(np.asarray(a), np.asarray(b))
+               for a, b in zip(leaves0, leaves1)), "BN state must move"
+
+
+def test_accum_via_local_optimizer_trains():
+    model = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 1))
+    x, y = _data(64)
+    opt = (LocalOptimizer(model, (np.asarray(x), np.asarray(y)),
+                          nn.MSECriterion(), batch_size=32)
+           .set_optim_method(SGD(learning_rate=0.05))
+           .set_gradient_accumulation(4)
+           .set_end_when(Trigger.max_epoch(5)))
+    opt.optimize()
+    out = model.forward(np.asarray(x))
+    final = float(np.mean((np.asarray(out) - np.asarray(y)) ** 2))
+    assert final < 1.0
+
+
+def test_accum_batch_divisibility_error():
+    model = nn.Sequential(nn.Linear(6, 1))
+    crit = nn.MSECriterion()
+    method = SGD(learning_rate=0.1)
+    params, state = model.init_params(0)
+    x, y = _data(30)    # 30 % 4 != 0
+    with pytest.raises(ValueError, match="divisible"):
+        make_accum_train_step(model, crit, method, 4)(
+            params, method.init_state(params), state, x, y,
+            jax.random.PRNGKey(0))
+
+
+def test_accum_rejected_on_distri():
+    from bigdl_tpu.parallel import mesh as mesh_lib
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+    mesh = mesh_lib.create_mesh({"dp": 8})
+    model = nn.Sequential(nn.Linear(6, 1))
+    x, y = _data(64)
+    opt = (DistriOptimizer(model, (np.asarray(x), np.asarray(y)),
+                           nn.MSECriterion(), batch_size=64, mesh=mesh)
+           .set_optim_method(SGD(learning_rate=0.05))
+           .set_gradient_accumulation(2)
+           .set_end_when(Trigger.max_iteration(1)))
+    with pytest.raises(NotImplementedError):
+        opt.optimize()
