@@ -41,7 +41,8 @@ from ..nn.module import Ctx
 from ..parallel import mesh as mesh_lib
 from ..parallel.allreduce import (allreduce_gradients,
                                   reduce_scatter_gradients, allgather_params)
-from .optimizer import Optimizer, _mb_to_arrays, _ClippedOptim
+from .optimizer import (Optimizer, _mb_to_arrays, _ClippedOptim,
+                        make_accum_grads)
 from .trigger import Trigger
 
 
@@ -64,6 +65,8 @@ class DistriOptimizer(Optimizer):
         compress = self.compress
         n_dp = self.mesh.shape["dp"]
 
+        n_accum = self._grad_accum
+
         def local_loss(p, model_state, x, y, rng):
             if mixed:
                 x = jax.tree_util.tree_map(
@@ -81,11 +84,17 @@ class DistriOptimizer(Optimizer):
             loss = loss + model.regularization_loss(p)
             return loss, ctx.new_state
 
+        # per-shard gradient accumulation: each shard scans its own
+        # microbatches BEFORE the psum, so collective traffic is one op
+        # regardless of n_accum (reg term stays inside local_loss: counted
+        # n times then divided by n, i.e. added once)
+        local_grads = make_accum_grads(local_loss, n_accum)
+
         if not self.fsdp:
             def step(params, opt_state, model_state, x, y, rng):
                 rng = jax.random.fold_in(rng, lax.axis_index("dp"))
-                (loss, upd), grads = jax.value_and_grad(
-                    local_loss, has_aux=True)(params, model_state, x, y, rng)
+                (loss, upd), grads = local_grads(params, model_state,
+                                                 x, y, rng)
                 grads = allreduce_gradients(grads, "dp", compress=compress)
                 new_params, new_opt = optim.update(grads, params, opt_state)
                 merged = dict(model_state)
@@ -119,8 +128,7 @@ class DistriOptimizer(Optimizer):
         def step(params_sh, opt_state, model_state, x, y, rng):
             rng = jax.random.fold_in(rng, lax.axis_index("dp"))
             full = gather(params_sh)
-            (loss, upd), grads = jax.value_and_grad(
-                local_loss, has_aux=True)(full, model_state, x, y, rng)
+            (loss, upd), grads = local_grads(full, model_state, x, y, rng)
             g_sh = scatter_grads(grads)
             new_params_sh, new_opt = optim.update(g_sh, params_sh, opt_state)
             merged = dict(model_state)
@@ -179,11 +187,6 @@ class DistriOptimizer(Optimizer):
         return optim
 
     def _make_step_builder(self, params_template, optim):
-        if self._grad_accum > 1:
-            raise NotImplementedError(
-                "gradient accumulation is not supported by DistriOptimizer "
-                "yet; scale batch via the dp axis instead")
-
         def build_step():
             step_fn, shardable = self._build_step(params_template, optim)
             self._shardable = shardable

@@ -114,6 +114,54 @@ def make_train_step(model: Module, criterion, optim_method: OptimMethod,
     return step
 
 
+def make_accum_grads(loss_fn, n_accum: int):
+    """Microbatch gradient accumulation shared by Local and Distri steps.
+
+    ``loss_fn(params, model_state, x, y, rng) -> (loss, new_state)``.
+    Returns ``grads_fn(params, model_state, x, y, rng) ->
+    ((mean_loss, merged_state), mean_grads)`` that scans ``n_accum``
+    microbatches (BN state threaded in order, per-microbatch RNG via
+    fold_in); ``n_accum < 2`` degenerates to one value_and_grad.
+    """
+    if n_accum < 2:
+        def direct(params, model_state, x, y, rng):
+            return jax.value_and_grad(loss_fn, has_aux=True)(
+                params, model_state, x, y, rng)
+        return direct
+
+    def grads_fn(params, model_state, x, y, rng):
+        def split(a):
+            b = a.shape[0]
+            if b % n_accum:
+                raise ValueError(
+                    f"batch {b} not divisible by n_accum={n_accum}")
+            return a.reshape((n_accum, b // n_accum) + a.shape[1:])
+
+        xs = jax.tree_util.tree_map(split, x)
+        ys = jax.tree_util.tree_map(split, y)
+
+        def body(carry, mb):
+            g_acc, loss_acc, mstate, i = carry
+            xi, yi = mb
+            (loss, upd), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(
+                    params, mstate, xi, yi, jax.random.fold_in(rng, i))
+            merged = dict(mstate)
+            merged.update(upd)
+            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
+            return (g_acc, loss_acc + loss, merged, i + 1), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (g_sum, loss_sum, merged, _), _ = lax.scan(
+            body, (zeros, jnp.float32(0), dict(model_state),
+                   jnp.int32(0)), (xs, ys))
+        grads = jax.tree_util.tree_map(lambda g: g / n_accum, g_sum)
+        return (loss_sum / n_accum, merged), grads
+
+    return grads_fn
+
+
 def make_accum_train_step(model: Module, criterion,
                           optim_method: OptimMethod, n_accum: int,
                           mixed_precision=False, extra_loss_fn=None):
@@ -147,35 +195,11 @@ def make_accum_train_step(model: Module, criterion,
             loss = loss + extra_loss_fn(params)
         return loss, ctx.new_state
 
+    grads_fn = make_accum_grads(micro_loss, n_accum)
+
     def step(params, opt_state, model_state, x, y, rng):
-        def split(a):
-            b = a.shape[0]
-            if b % n_accum:
-                raise ValueError(
-                    f"batch {b} not divisible by n_accum={n_accum}")
-            return a.reshape((n_accum, b // n_accum) + a.shape[1:])
-
-        xs = jax.tree_util.tree_map(split, x)
-        ys = jax.tree_util.tree_map(split, y)
-
-        def body(carry, mb):
-            g_acc, loss_acc, mstate, i = carry
-            xi, yi = mb
-            (loss, state_updates), grads = jax.value_and_grad(
-                micro_loss, has_aux=True)(
-                    params, mstate, xi, yi, jax.random.fold_in(rng, i))
-            merged = dict(mstate)
-            merged.update(state_updates)
-            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
-            return (g_acc, loss_acc + loss, merged, i + 1), None
-
-        zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        (g_sum, loss_sum, merged, _), _ = lax.scan(
-            body, (zeros, jnp.float32(0), dict(model_state),
-                   jnp.int32(0)),
-            (xs, ys))
-        grads = jax.tree_util.tree_map(lambda g: g / n_accum, g_sum)
+        (mean_loss, merged), grads = grads_fn(params, model_state, x, y,
+                                              rng)
         # regularization is batch-independent: add its loss and gradient
         # once (a regularizer-free model contributes zeros, which XLA
         # folds away); keeps the reported loss identical to the
@@ -185,8 +209,7 @@ def make_accum_train_step(model: Module, criterion,
         grads = jax.tree_util.tree_map(jnp.add, grads, reg_grads)
         new_params, new_opt_state = optim_method.update(grads, params,
                                                         opt_state)
-        return (new_params, new_opt_state, merged,
-                loss_sum / n_accum + reg_loss)
+        return new_params, new_opt_state, merged, mean_loss + reg_loss
 
     return step
 

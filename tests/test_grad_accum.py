@@ -104,16 +104,30 @@ def test_accum_batch_divisibility_error():
             jax.random.PRNGKey(0))
 
 
-def test_accum_rejected_on_distri():
+@pytest.mark.parametrize("fsdp", [False, True])
+def test_accum_on_distri_matches_plain(fsdp):
+    """Per-shard accumulation then psum must equal the plain distributed
+    step (no BN, equal microbatches)."""
     from bigdl_tpu.parallel import mesh as mesh_lib
     from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
     mesh = mesh_lib.create_mesh({"dp": 8})
-    model = nn.Sequential(nn.Linear(6, 1))
     x, y = _data(64)
-    opt = (DistriOptimizer(model, (np.asarray(x), np.asarray(y)),
-                           nn.MSECriterion(), batch_size=64, mesh=mesh)
-           .set_optim_method(SGD(learning_rate=0.05))
-           .set_gradient_accumulation(2)
-           .set_end_when(Trigger.max_iteration(1)))
-    with pytest.raises(NotImplementedError):
+    results = []
+    for n_accum in (1, 2):
+        model = nn.Sequential(nn.Linear(6, 8), nn.Tanh(), nn.Linear(8, 1))
+        params, state = model.init_params(0)
+        model.set_params(params, state)
+        opt = (DistriOptimizer(model, (np.asarray(x), np.asarray(y)),
+                               nn.MSECriterion(), batch_size=64, mesh=mesh,
+                               fsdp=fsdp)
+               .set_optim_method(SGD(learning_rate=0.05))
+               .set_gradient_accumulation(n_accum)
+               .set_end_when(Trigger.max_iteration(2)))
         opt.optimize()
+        results.append((opt.state.loss,
+                        [np.asarray(v) for v in
+                         jax.tree_util.tree_leaves(model._params)]))
+    (l1, p1), (l2, p2) = results
+    assert abs(float(l1) - float(l2)) < 1e-5
+    for a, b in zip(p1, p2):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
