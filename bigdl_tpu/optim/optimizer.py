@@ -134,7 +134,9 @@ def make_accum_grads(loss_fn, n_accum: int):
             b = a.shape[0]
             if b % n_accum:
                 raise ValueError(
-                    f"batch {b} not divisible by n_accum={n_accum}")
+                    f"(per-shard) batch {b} not divisible by "
+                    f"n_accum={n_accum}; on a mesh the global batch is "
+                    "first split over dp shards")
             return a.reshape((n_accum, b // n_accum) + a.shape[1:])
 
         xs = jax.tree_util.tree_map(split, x)

@@ -1,0 +1,87 @@
+"""Hand-computed numerics for the local-normalization long tail
+(≙ reference SpatialSubtractiveNormalizationSpec.scala,
+SpatialDivisiveNormalizationSpec.scala, SpatialWithinChannelLRNSpec.scala:
+per-layer numeric forward checks).  Expected values are independent numpy
+re-implementations with explicit loops — no shared code with the layer."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+
+
+def _np_local_mean(x, k):
+    """conv(x, k/sum(k)) per channel then channel-mean, edge-corrected by
+    conv of ones — explicit python loops."""
+    k = k / k.sum()
+    kh, kw = k.shape
+    n, c, h, w = x.shape
+    lo_h, hi_h = (kh - 1) // 2, kh - 1 - (kh - 1) // 2
+    lo_w, hi_w = (kw - 1) // 2, kw - 1 - (kw - 1) // 2
+    xp = np.pad(x, ((0, 0), (0, 0), (lo_h, hi_h), (lo_w, hi_w)))
+    onesp = np.pad(np.ones((h, w)), ((lo_h, hi_h), (lo_w, hi_w)))
+    mean = np.zeros((n, 1, h, w))
+    coef = np.zeros((h, w))
+    for i in range(h):
+        for j in range(w):
+            coef[i, j] = (onesp[i:i + kh, j:j + kw] * k).sum()
+            for b in range(n):
+                acc = 0.0
+                for ch in range(c):
+                    acc += (xp[b, ch, i:i + kh, j:j + kw] * k).sum()
+                mean[b, 0, i, j] = acc / c
+    return mean / coef
+
+
+@pytest.fixture
+def x():
+    return np.random.RandomState(0).randn(2, 3, 6, 6).astype(np.float32)
+
+
+def test_subtractive_normalization_numerics(x):
+    k = np.ones((3, 3), np.float32)
+    layer = nn.SpatialSubtractiveNormalization(3, kernel=jnp.asarray(k))
+    got = np.asarray(layer.forward(x))
+    want = x - _np_local_mean(x, k)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_divisive_normalization_numerics(x):
+    k = np.ones((3, 3), np.float32)
+    layer = nn.SpatialDivisiveNormalization(3, kernel=jnp.asarray(k))
+    got = np.asarray(layer.forward(x))
+    local_sd = np.sqrt(np.maximum(_np_local_mean(x * x, k), 0.0))
+    mean_sd = local_sd.mean(axis=(1, 2, 3), keepdims=True)
+    denom = np.maximum(local_sd, mean_sd)
+    denom = np.where(denom > 1e-4, denom, 1e-4)
+    want = x / denom
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_within_channel_lrn_numerics(x):
+    size, alpha, beta = 3, 2.0, 0.75
+    layer = nn.SpatialWithinChannelLRN(size, alpha, beta)
+    got = np.asarray(layer.forward(x))
+    n, c, h, w = x.shape
+    lo = (size - 1) // 2
+    xp = np.pad(x, ((0, 0), (0, 0), (lo, size - 1 - lo),
+                    (lo, size - 1 - lo)))
+    want = np.zeros_like(x)
+    for b in range(n):
+        for ch in range(c):
+            for i in range(h):
+                for j in range(w):
+                    s = (xp[b, ch, i:i + size, j:j + size] ** 2).sum()
+                    want[b, ch, i, j] = x[b, ch, i, j] / (
+                        1.0 + alpha / (size * size) * s) ** beta
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_subtractive_zero_mean_property(x):
+    """On a constant input, the subtractive layer must return ~zeros
+    everywhere INCLUDING edges (the edge-coefficient correction)."""
+    const = np.full((1, 3, 8, 8), 3.7, np.float32)
+    layer = nn.SpatialSubtractiveNormalization(
+        3, kernel=jnp.asarray(np.ones((5, 5), np.float32)))
+    out = np.asarray(layer.forward(const))
+    np.testing.assert_allclose(out, 0.0, atol=1e-5)
