@@ -114,14 +114,22 @@ def make_train_step(model: Module, criterion, optim_method: OptimMethod,
     return step
 
 
-def make_accum_grads(loss_fn, n_accum: int):
-    """Microbatch gradient accumulation shared by Local and Distri steps.
+def make_accum_grads(loss_fn, n_accum: int, weight_fn=None):
+    """Microbatch gradient accumulation shared by Local/Distri/Spmd steps.
 
     ``loss_fn(params, model_state, x, y, rng) -> (loss, new_state)``.
     Returns ``grads_fn(params, model_state, x, y, rng) ->
     ((mean_loss, merged_state), mean_grads)`` that scans ``n_accum``
     microbatches (BN state threaded in order, per-microbatch RNG via
     fold_in); ``n_accum < 2`` degenerates to one value_and_grad.
+
+    ``weight_fn(x, y) -> scalar`` weights each microbatch's loss/grads
+    (final result divided by the total weight).  Needed when ``loss_fn``
+    is a *masked* mean — e.g. token cross-entropy with padding, where the
+    valid-token count varies per microbatch and equal weighting would
+    silently optimize a different objective.  Default: equal weights
+    (exact for per-sample-mean criteria, since microbatches are equal
+    sized).
     """
     if n_accum < 2:
         def direct(params, model_state, x, y, rng):
@@ -137,29 +145,38 @@ def make_accum_grads(loss_fn, n_accum: int):
                     f"(per-shard) batch {b} not divisible by "
                     f"n_accum={n_accum}; on a mesh the global batch is "
                     "first split over dp shards")
-            return a.reshape((n_accum, b // n_accum) + a.shape[1:])
+            # strided split (microbatch i = rows {j*n+i}): dim 0 of each
+            # microbatch keeps the original batch-dim sharding, so under
+            # GSPMD no cross-device resharding is inserted per scan step
+            a2 = a.reshape((b // n_accum, n_accum) + a.shape[1:])
+            return jnp.moveaxis(a2, 1, 0)
 
         xs = jax.tree_util.tree_map(split, x)
         ys = jax.tree_util.tree_map(split, y)
 
         def body(carry, mb):
-            g_acc, loss_acc, mstate, i = carry
+            g_acc, loss_acc, w_acc, mstate, i = carry
             xi, yi = mb
+            w = (jnp.float32(1.0) if weight_fn is None
+                 else weight_fn(xi, yi).astype(jnp.float32))
             (loss, upd), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(
                     params, mstate, xi, yi, jax.random.fold_in(rng, i))
             merged = dict(mstate)
             merged.update(upd)
-            g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
-            return (g_acc, loss_acc + loss, merged, i + 1), None
+            g_acc = jax.tree_util.tree_map(
+                lambda a, g: a + w * g, g_acc, grads)
+            return (g_acc, loss_acc + w * loss, w_acc + w, merged,
+                    i + 1), None
 
         zeros = jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        (g_sum, loss_sum, merged, _), _ = lax.scan(
-            body, (zeros, jnp.float32(0), dict(model_state),
-                   jnp.int32(0)), (xs, ys))
-        grads = jax.tree_util.tree_map(lambda g: g / n_accum, g_sum)
-        return (loss_sum / n_accum, merged), grads
+        (g_sum, loss_sum, w_sum, merged, _), _ = lax.scan(
+            body, (zeros, jnp.float32(0), jnp.float32(0),
+                   dict(model_state), jnp.int32(0)), (xs, ys))
+        w_sum = jnp.maximum(w_sum, 1e-8)
+        grads = jax.tree_util.tree_map(lambda g: g / w_sum, g_sum)
+        return (loss_sum / w_sum, merged), grads
 
     return grads_fn
 

@@ -32,6 +32,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import mesh as mesh_lib
 from .ring_attention import ring_attention_shmap
 from ..models.transformer import TransformerLM, lm_cross_entropy
+from ..optim.optimizer import make_accum_grads
 
 
 def _filter_spec(spec: P, mesh: Mesh) -> P:
@@ -65,7 +66,7 @@ class SpmdTrainer:
     def __init__(self, model: TransformerLM, optim, mesh: Optional[Mesh] = None,
                  fsdp: bool = True, seed: int = 0,
                  ring_attention: Optional[bool] = None,
-                 min_fsdp_size: int = 2 ** 16):
+                 min_fsdp_size: int = 2 ** 16, grad_accum: int = 1):
         self.model = model
         self.optim = optim
         self.mesh = mesh or mesh_lib.get_mesh()
@@ -80,6 +81,7 @@ class SpmdTrainer:
         self._batch_axes = tuple(a for a in ("dp", "fsdp")
                                  if a in self.mesh.axis_names)
         self._seq_axis = "sp" if "sp" in self.mesh.axis_names else None
+        self.grad_accum = int(grad_accum)
         self.params = None
         self.opt_state = None
         self._step_fn = None
@@ -142,16 +144,26 @@ class SpmdTrainer:
         self.opt_state = jax.jit(self.optim.init_state)(self.params)
         model, optim = self.model, self.optim
 
+        n_accum = self.grad_accum
+
+        def loss_fn(p, tokens, targets, rng):
+            from ..nn.module import Ctx
+            ctx = Ctx(state={}, training=True, rng_key=rng)
+            logits = model.apply(p, tokens, ctx)
+            loss = lm_cross_entropy(logits, targets)
+            for sl in ctx.side_losses:   # e.g. MoE load-balancing aux
+                loss = loss + sl
+            return loss
+
+        # lm_cross_entropy is a MASKED token mean, so microbatches are
+        # weighted by their valid-token count (equal weighting would
+        # misweight padded batches — see make_accum_grads)
+        grads_fn = make_accum_grads(
+            lambda p, s, t, y, r: (loss_fn(p, t, y, r), s), n_accum,
+            weight_fn=lambda t, y: (y != -1).sum())
+
         def step(params, opt_state, tokens, targets, rng):
-            def loss_fn(p):
-                from ..nn.module import Ctx
-                ctx = Ctx(state={}, training=True, rng_key=rng)
-                logits = model.apply(p, tokens, ctx)
-                loss = lm_cross_entropy(logits, targets)
-                for sl in ctx.side_losses:   # e.g. MoE load-balancing aux
-                    loss = loss + sl
-                return loss
-            loss, grads = jax.value_and_grad(loss_fn)(params)
+            (loss, _), grads = grads_fn(params, {}, tokens, targets, rng)
             new_params, new_opt = optim.update(grads, params, opt_state)
             return new_params, new_opt, loss
 
