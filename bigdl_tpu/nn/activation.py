@@ -20,6 +20,7 @@ from .init import init_tensor, ConstInit
 
 
 class ReLU(Module):
+    """max(x, 0) (nn/ReLU.scala)."""
     def __init__(self, ip=False, name=None):
         super().__init__(name=name)
 
@@ -28,21 +29,25 @@ class ReLU(Module):
 
 
 class ReLU6(Module):
+    """min(max(x, 0), 6) (nn/ReLU6.scala)."""
     def apply(self, params, x, ctx):
         return jnp.clip(x, 0, 6)
 
 
 class Tanh(Module):
+    """tanh(x) (nn/Tanh.scala)."""
     def apply(self, params, x, ctx):
         return jnp.tanh(x)
 
 
 class Sigmoid(Module):
+    """1 / (1 + exp(-x)) (nn/Sigmoid.scala)."""
     def apply(self, params, x, ctx):
         return jax.nn.sigmoid(x)
 
 
 class ELU(Module):
+    """x if x > 0 else alpha*(exp(x)-1) (nn/ELU.scala)."""
     def __init__(self, alpha=1.0, inplace=False, name=None):
         super().__init__(name=name)
         self.alpha = alpha
@@ -52,6 +57,7 @@ class ELU(Module):
 
 
 class LeakyReLU(Module):
+    """x if x >= 0 else negval*x (nn/LeakyReLU.scala)."""
     def __init__(self, negval=0.01, inplace=False, name=None):
         super().__init__(name=name)
         self.negval = negval
@@ -143,21 +149,25 @@ class SoftMax(Module):
 
 
 class SoftMin(Module):
+    """softmax(-x) (nn/SoftMin.scala)."""
     def apply(self, params, x, ctx):
         return jax.nn.softmax(-x, axis=-1)
 
 
 class LogSoftMax(Module):
+    """log softmax over the last dim (nn/LogSoftMax.scala); feeds ClassNLLCriterion."""
     def apply(self, params, x, ctx):
         return jax.nn.log_softmax(x, axis=-1)
 
 
 class LogSigmoid(Module):
+    """log(1 / (1 + exp(-x))) (nn/LogSigmoid.scala)."""
     def apply(self, params, x, ctx):
         return jax.nn.log_sigmoid(x)
 
 
 class SoftPlus(Module):
+    """log(1 + exp(beta*x))/beta (nn/SoftPlus.scala)."""
     def __init__(self, beta=1.0, name=None):
         super().__init__(name=name)
         self.beta = beta
@@ -167,11 +177,13 @@ class SoftPlus(Module):
 
 
 class SoftSign(Module):
+    """x / (1 + |x|) (nn/SoftSign.scala)."""
     def apply(self, params, x, ctx):
         return x / (1.0 + jnp.abs(x))
 
 
 class HardTanh(Module):
+    """clip(x, min_value, max_value) (nn/HardTanh.scala)."""
     def __init__(self, min_value=-1.0, max_value=1.0, inplace=False, name=None):
         super().__init__(name=name)
         self.min_value, self.max_value = min_value, max_value
@@ -196,6 +208,7 @@ class HardSigmoid(Module):
 
 
 class HardShrink(Module):
+    """x where |x| > lambda else 0 (nn/HardShrink.scala)."""
     def __init__(self, lambd=0.5, name=None):
         super().__init__(name=name)
         self.lambd = lambd
@@ -205,6 +218,7 @@ class HardShrink(Module):
 
 
 class SoftShrink(Module):
+    """x -+ lambda outside [-lambda, lambda], else 0 (nn/SoftShrink.scala)."""
     def __init__(self, lambd=0.5, name=None):
         super().__init__(name=name)
         self.lambd = lambd
@@ -215,6 +229,7 @@ class SoftShrink(Module):
 
 
 class TanhShrink(Module):
+    """x - tanh(x) (nn/TanhShrink.scala)."""
     def apply(self, params, x, ctx):
         return x - jnp.tanh(x)
 
@@ -249,5 +264,6 @@ class GELU(Module):
 
 
 class SiLU(Module):
+    """x * sigmoid(x) — TPU-era extra (used by modern FFN blocks)."""
     def apply(self, params, x, ctx):
         return jax.nn.silu(x)

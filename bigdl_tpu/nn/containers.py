@@ -14,6 +14,8 @@ from .module import Module
 
 
 class Container(Module):
+    """Base of all multi-child modules (nn/Container.scala): owns a
+    children list, aggregates their params/state, forwards by composition."""
     def __init__(self, *mods, name=None):
         super().__init__(name=name)
         self._children = list(mods)

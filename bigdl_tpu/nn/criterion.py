@@ -119,6 +119,7 @@ class SoftmaxWithCriterion(Criterion):
 
 
 class MSECriterion(Criterion):
+    """mean (input - target)^2 (nn/MSECriterion.scala)."""
     def __init__(self, size_average=True, name=None):
         super().__init__(name=name)
         self.size_average = size_average
@@ -128,6 +129,7 @@ class MSECriterion(Criterion):
 
 
 class AbsCriterion(Criterion):
+    """mean |input - target| (nn/AbsCriterion.scala)."""
     def __init__(self, size_average=True, name=None):
         super().__init__(name=name)
         self.size_average = size_average
@@ -154,6 +156,7 @@ class BCECriterion(Criterion):
 
 
 class SmoothL1Criterion(Criterion):
+    """Huber loss: 0.5 d^2 if |d|<1 else |d|-0.5 (nn/SmoothL1Criterion.scala)."""
     def __init__(self, size_average=True, name=None):
         super().__init__(name=name)
         self.size_average = size_average
@@ -348,12 +351,14 @@ class PoissonCriterion(Criterion):
 
 
 class MeanAbsolutePercentageCriterion(Criterion):
+    """mean |(target - input) / clip(|target|)| * 100 (nn/MeanAbsolutePercentageCriterion.scala)."""
     def loss(self, output, target):
         diff = jnp.abs(target - output) / jnp.clip(jnp.abs(target), 1e-7, None)
         return 100.0 * jnp.mean(diff)
 
 
 class MeanSquaredLogarithmicCriterion(Criterion):
+    """mean (log(target+1) - log(input+1))^2 (nn/MeanSquaredLogarithmicCriterion.scala)."""
     def loss(self, output, target):
         a = jnp.log(jnp.clip(output, 1e-7, None) + 1.0)
         b = jnp.log(jnp.clip(target, 1e-7, None) + 1.0)

@@ -15,11 +15,13 @@ from ..utils.table import as_list
 
 
 class Abs(Module):
+    """|x| (nn/Abs.scala)."""
     def apply(self, params, x, ctx):
         return jnp.abs(x)
 
 
 class AddConstant(Module):
+    """x + constant_scalar (nn/AddConstant.scala)."""
     def __init__(self, constant_scalar, inplace=False, name=None):
         super().__init__(name=name)
         self.constant = constant_scalar
@@ -29,6 +31,7 @@ class AddConstant(Module):
 
 
 class MulConstant(Module):
+    """x * constant_scalar (nn/MulConstant.scala)."""
     def __init__(self, scalar, inplace=False, name=None):
         super().__init__(name=name)
         self.scalar = scalar
@@ -38,26 +41,31 @@ class MulConstant(Module):
 
 
 class Exp(Module):
+    """exp(x) (nn/Exp.scala)."""
     def apply(self, params, x, ctx):
         return jnp.exp(x)
 
 
 class Log(Module):
+    """log(x) (nn/Log.scala)."""
     def apply(self, params, x, ctx):
         return jnp.log(x)
 
 
 class Log1p(Module):
+    """log(1 + x) (nn/Log1p.scala)."""
     def apply(self, params, x, ctx):
         return jnp.log1p(x)
 
 
 class Sqrt(Module):
+    """sqrt(x) (nn/Sqrt.scala)."""
     def apply(self, params, x, ctx):
         return jnp.sqrt(x)
 
 
 class Square(Module):
+    """x^2 (nn/Square.scala)."""
     def apply(self, params, x, ctx):
         return x * x
 

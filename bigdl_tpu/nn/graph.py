@@ -25,6 +25,8 @@ from ..utils.table import Table, as_list
 
 
 class Node:
+    """DAG node ref produced by ``module.inputs(...)`` (utils/Node.scala);
+    Graph topo-sorts these at trace time."""
     def __init__(self, module: Optional[Module], prev_nodes: List["Node"]):
         self.module = module
         self.prev_nodes = list(prev_nodes)

@@ -12,21 +12,25 @@ import numpy as np
 
 
 class InitializationMethod:
+    """Base of weight/bias initializers (nn/InitializationMethod.scala)."""
     def __call__(self, rng, shape, fan_in, fan_out):
         raise NotImplementedError
 
 
 class Zeros(InitializationMethod):
+    """Fill with zeros (nn/InitializationMethod.scala Zeros)."""
     def __call__(self, rng, shape, fan_in, fan_out):
         return jnp.zeros(shape, jnp.float32)
 
 
 class Ones(InitializationMethod):
+    """Fill with ones (nn/InitializationMethod.scala Ones)."""
     def __call__(self, rng, shape, fan_in, fan_out):
         return jnp.ones(shape, jnp.float32)
 
 
 class ConstInit(InitializationMethod):
+    """Fill with a constant value (nn/InitializationMethod.scala ConstInitMethod)."""
     def __init__(self, value):
         self.value = value
 
@@ -50,6 +54,7 @@ class RandomUniform(InitializationMethod):
 
 
 class RandomNormal(InitializationMethod):
+    """N(mean, stdv) init (nn/InitializationMethod.scala RandomNormal)."""
     def __init__(self, mean=0.0, stdv=1.0):
         self.mean, self.stdv = mean, stdv
 

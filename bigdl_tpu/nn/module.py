@@ -468,6 +468,8 @@ _capture_config(Module)
 
 
 class Criterion:
+    """Base of all losses (nn/abstractnn/AbstractCriterion.scala):
+    ``loss(output, target)`` pure fn + Torch-style forward/backward shell."""
     """Base loss (≙ nn/abstractnn/AbstractCriterion.scala).
 
     Subclasses implement ``loss(output, target) -> scalar``.  ``forward``

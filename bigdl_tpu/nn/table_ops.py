@@ -19,6 +19,7 @@ from ..utils.table import Table, as_list
 
 
 class CAddTable(Module):
+    """Elementwise sum of a table of tensors (nn/CAddTable.scala)."""
     def __init__(self, inplace=False, name=None):
         super().__init__(name=name)
 
@@ -27,33 +28,39 @@ class CAddTable(Module):
 
 
 class CSubTable(Module):
+    """table[0] - table[1] (nn/CSubTable.scala)."""
     def apply(self, params, x, ctx):
         a, b = as_list(x)
         return a - b
 
 
 class CMulTable(Module):
+    """Elementwise product of a table of tensors (nn/CMulTable.scala)."""
     def apply(self, params, x, ctx):
         return reduce(jnp.multiply, as_list(x))
 
 
 class CDivTable(Module):
+    """table[0] / table[1] (nn/CDivTable.scala)."""
     def apply(self, params, x, ctx):
         a, b = as_list(x)
         return a / b
 
 
 class CMaxTable(Module):
+    """Elementwise max over a table of tensors (nn/CMaxTable.scala)."""
     def apply(self, params, x, ctx):
         return reduce(jnp.maximum, as_list(x))
 
 
 class CMinTable(Module):
+    """Elementwise min over a table of tensors (nn/CMinTable.scala)."""
     def apply(self, params, x, ctx):
         return reduce(jnp.minimum, as_list(x))
 
 
 class CAveTable(Module):
+    """Elementwise mean over a table of tensors (nn/CAveTable.scala)."""
     def __init__(self, inplace=False, name=None):
         super().__init__(name=name)
 
