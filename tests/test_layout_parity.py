@@ -97,3 +97,17 @@ def test_train_step_layout_parity():
             jax.tree_util.tree_leaves(p)[0], np.float32)))
     assert abs(outs[0][0] - outs[1][0]) < 1e-4
     np.testing.assert_allclose(outs[0][1], outs[1][1], rtol=1e-3, atol=1e-4)
+
+
+def test_nhwc_model_serde_roundtrip(tmp_path):
+    """format='NHWC' must survive save/load (a silently-dropped format
+    attr would rebuild an NCHW model that crashes or mis-computes)."""
+    m = vgg.build(class_num=10, dataset="cifar10", format="NHWC",
+                  has_dropout=False)
+    x = np.random.RandomState(0).randn(2, 32, 32, 3).astype(np.float32)
+    y1 = np.asarray(m.forward(x))
+    path = str(tmp_path / "vgg_nhwc.bigdl")
+    m.save(path)
+    m2 = nn.Module.load(path)
+    y2 = np.asarray(m2.forward(x))
+    np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)

@@ -501,3 +501,67 @@ def test_state_file_constructor_errors_propagate(tmp_path):
     finally:
         from bigdl_tpu.utils.serializer import _CLASS_REGISTRY
         _CLASS_REGISTRY.pop(f"{Picky.__module__}:{Picky.__qualname__}", None)
+
+
+def test_state_file_random_pytree_property(tmp_path):
+    """Property: random nested pytrees of supported leaves round-trip
+    exactly through save_state_file/load_state_file."""
+    from bigdl_tpu.utils.serializer import save_state_file, load_state_file
+    rs = np.random.RandomState(0)
+
+    def rand_leaf():
+        r = rs.rand()
+        if r < 0.3:
+            # jax-native dtypes only: the loader returns jnp arrays, so
+            # f64 would legitimately come back as f32 (no x64 mode)
+            return rs.randn(*rs.randint(1, 4, rs.randint(1, 3))).astype(
+                [np.float32, np.int32][rs.randint(2)])
+        if r < 0.5:
+            return float(rs.randn())
+        if r < 0.65:
+            return int(rs.randint(-10, 10))
+        if r < 0.8:
+            return bool(rs.rand() < 0.5)
+        if r < 0.9:
+            return "s" + str(rs.randint(100))
+        return None
+
+    def rand_tree(depth=0):
+        if depth >= 3 or rs.rand() < 0.3:
+            return rand_leaf()
+        r = rs.rand()
+        n = rs.randint(1, 4)
+        if r < 0.5:
+            return {f"k{i}": rand_tree(depth + 1) for i in range(n)}
+        if r < 0.8:
+            return tuple(rand_tree(depth + 1) for _ in range(n))
+        return [rand_tree(depth + 1) for _ in range(n)]
+
+    def eq(a, b):
+        if isinstance(a, dict):
+            assert isinstance(b, dict) and a.keys() == b.keys()
+            for k in a:
+                eq(a[k], b[k])
+        elif isinstance(a, tuple):
+            assert isinstance(b, tuple) and len(a) == len(b)
+            for x, y in zip(a, b):
+                eq(x, y)
+        elif isinstance(a, list):
+            assert isinstance(b, list) and len(a) == len(b)
+            for x, y in zip(a, b):
+                eq(x, y)
+        elif isinstance(a, np.ndarray):
+            got = np.asarray(b)
+            assert got.dtype == a.dtype, (got.dtype, a.dtype)
+            np.testing.assert_array_equal(got, a)
+        else:
+            # scalar type fidelity matters: bool->int or int->float drift
+            # through the tagged encoding must fail here
+            assert type(b) is type(a), (type(a), type(b), a, b)
+            assert a == b, (a, b)
+
+    for trial in range(10):
+        tree = {"root": rand_tree()}
+        p = str(tmp_path / f"t{trial}.bin")
+        save_state_file(tree, p)
+        eq(tree, load_state_file(p))
