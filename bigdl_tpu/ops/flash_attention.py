@@ -106,6 +106,42 @@ def chunk_merge(q, k_chunk, v_chunk, acc, m, l, q_pos, k_pos, kv_len,
     return acc_new, m_new, l_new
 
 
+def chunk_merge_blockwise(q, k_chunk, v_chunk, acc, m, l, q_pos, k_pos,
+                          kv_len, sm_scale, causal, block_k=1024):
+    """chunk_merge with the kv chunk processed in ``block_k`` sub-blocks:
+    same online-softmax result, but peak score memory is
+    (..., Sq, block_k) instead of (..., Sq, Sk) — the memory lever for
+    ring attention over long local chunks."""
+    sk = k_chunk.shape[-2]
+    if sk <= block_k:
+        return chunk_merge(q, k_chunk, v_chunk, acc, m, l, q_pos, k_pos,
+                           kv_len, sm_scale, causal)
+    nb = -(-sk // block_k)
+    pad = nb * block_k - sk
+    if pad:   # pad keys out past kv_len so the position mask drops them
+        widths = [(0, 0)] * (k_chunk.ndim - 2) + [(0, pad), (0, 0)]
+        k_chunk = jnp.pad(k_chunk, widths)
+        v_chunk = jnp.pad(v_chunk, widths)
+        k_pos = jnp.concatenate(
+            [k_pos, jnp.full((pad,), kv_len, k_pos.dtype)])
+    kb = jnp.moveaxis(
+        k_chunk.reshape(k_chunk.shape[:-2] + (nb, block_k)
+                        + k_chunk.shape[-1:]), -3, 0)
+    vb = jnp.moveaxis(
+        v_chunk.reshape(v_chunk.shape[:-2] + (nb, block_k)
+                        + v_chunk.shape[-1:]), -3, 0)
+    kp = k_pos.reshape(nb, block_k)
+
+    def step(carry, blk):
+        acc, m, l = carry
+        k_b, v_b, kp_b = blk
+        return chunk_merge(q, k_b, v_b, acc, m, l, q_pos, kp_b, kv_len,
+                           sm_scale, causal), None
+
+    (acc, m, l), _ = lax.scan(step, (acc, m, l), (kb, vb, kp))
+    return acc, m, l
+
+
 def finalize(acc, m, l):
     """(out, lse) from final accumulators; fully-masked rows yield 0."""
     safe_l = jnp.where(l == 0.0, 1.0, l)

@@ -28,16 +28,21 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.flash_attention import chunk_merge, finalize, DEFAULT_MASK_VALUE
+from ..ops.flash_attention import (chunk_merge, chunk_merge_blockwise,
+                                   finalize, DEFAULT_MASK_VALUE)
 from ._compat import shard_map as _shard_map
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
-                   sm_scale: Optional[float] = None):
+                   sm_scale: Optional[float] = None,
+                   block_k: Optional[int] = 1024):
     """Exact attention with seq sharded over ``axis_name``.
 
     q, k, v: (batch, heads, seq_local, head_dim) — the local shard.
     Returns the local shard of the attention output, same shape as q.
+    ``block_k`` caps the held chunk's score-matrix width (flash-style
+    sub-blocking) so memory stays O(s_local * block_k) at long context;
+    ``None`` merges each chunk in one piece.
     """
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
@@ -48,12 +53,28 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     q_pos = idx * s_local + jnp.arange(s_local)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
+    def merge(k_c, v_c, acc, m, l, k_pos):
+        if block_k is None:
+            return chunk_merge(q, k_c, v_c, acc, m, l, q_pos, k_pos,
+                               s_total, sm_scale, causal)
+        return chunk_merge_blockwise(q, k_c, v_c, acc, m, l, q_pos, k_pos,
+                                     s_total, sm_scale, causal,
+                                     block_k=block_k)
+
     def step(carry, t):
         k_c, v_c, acc, m, l = carry
         src = (idx - t) % sp                 # origin rank of the held chunk
         k_pos = src * s_local + jnp.arange(s_local)
-        acc, m, l = chunk_merge(q, k_c, v_c, acc, m, l, q_pos, k_pos,
-                                s_total, sm_scale, causal)
+        if causal:
+            # a chunk strictly in this rank's future contributes nothing;
+            # skip its FLOPs entirely (per-device scalar cond)
+            acc, m, l = lax.cond(
+                src > idx,
+                lambda a, mm, ll: (a, mm, ll),
+                lambda a, mm, ll: merge(k_c, v_c, a, mm, ll, k_pos),
+                acc, m, l)
+        else:
+            acc, m, l = merge(k_c, v_c, acc, m, l, k_pos)
         # rotate while (in a real schedule, overlapping) the next compute;
         # after sp hops k/v are home again, which keeps AD symmetric.
         k_c = lax.ppermute(k_c, axis_name, perm)
@@ -73,7 +94,8 @@ def ring_attention_shmap(q, k, v, mesh: Mesh, causal: bool = False,
                          sm_scale: Optional[float] = None,
                          batch_axis: Optional[str] = "dp",
                          head_axis: Optional[str] = "tp",
-                         seq_axis: str = "sp"):
+                         seq_axis: str = "sp",
+                         block_k: Optional[int] = 1024):
     """shard_map wrapper: (B, H, S, D) global arrays, batch over ``dp``,
     heads over ``tp``, sequence over ``sp``.  Heads are embarrassingly
     parallel, so tensor parallelism needs no collective here; only the
@@ -89,5 +111,5 @@ def ring_attention_shmap(q, k, v, mesh: Mesh, causal: bool = False,
 
     spec = P(ax(batch_axis), ax(head_axis), ax(seq_axis), None)
     fn = partial(ring_attention, axis_name=seq_axis, causal=causal,
-                 sm_scale=sm_scale)
+                 sm_scale=sm_scale, block_k=block_k)
     return _shard_map(fn, mesh, (spec, spec, spec), spec)(q, k, v)

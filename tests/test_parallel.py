@@ -138,3 +138,42 @@ def test_lm_cross_entropy_ignore_index():
     targets = jnp.array([[1, 2, -1, -1]])
     loss = T.lm_cross_entropy(logits, targets)
     np.testing.assert_allclose(float(loss), np.log(8), rtol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_blockwise_chunks_match(causal):
+    """Sub-blocked chunk merging (block_k < s_local) and the causal
+    future-chunk skip must stay exact vs full attention, incl. grads."""
+    from bigdl_tpu.parallel import mesh as mesh_lib
+    mesh = mesh_lib.create_mesh({"sp": 8})
+    rs = np.random.RandomState(3)
+    q, k, v = (jnp.asarray(rs.randn(1, 2, 64, 16).astype(np.float32) * 0.3)
+               for _ in range(3))
+    out = jax.jit(lambda q, k, v: ring_attention_shmap(
+        q, k, v, mesh, causal=causal, block_k=4))(q, k, v)
+    want = attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+    g1 = jax.grad(lambda q: jnp.sum(ring_attention_shmap(
+        q, k, v, mesh, causal=causal, block_k=4) ** 2))(q)
+    g2 = jax.grad(lambda q: jnp.sum(
+        attention_reference(q, k, v, causal=causal)
+        .astype(jnp.float32) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_ring_attention_blockwise_non_divisible_chunk():
+    """s_local not divisible by block_k: padding (not full-width fallback)
+    keeps numerics exact."""
+    from bigdl_tpu.parallel import mesh as mesh_lib
+    mesh = mesh_lib.create_mesh({"sp": 4})
+    rs = np.random.RandomState(5)
+    # s_local = 20, block_k = 8 -> 3 blocks with 4 padded keys
+    q, k, v = (jnp.asarray(rs.randn(1, 2, 80, 16).astype(np.float32) * 0.3)
+               for _ in range(3))
+    out = jax.jit(lambda q, k, v: ring_attention_shmap(
+        q, k, v, mesh, causal=True, block_k=8))(q, k, v)
+    want = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
