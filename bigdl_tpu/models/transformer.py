@@ -30,11 +30,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..nn.module import Module, Ctx
 from ..nn.normalization import RMSNorm
-from ..ops.flash_attention import flash_attention
+from ..ops.flash_attention import (flash_attention, DEFAULT_MASK_VALUE,
+                                   _mask as _attn_mask)
 from ..nn import init as init_lib
 
 
@@ -144,6 +146,44 @@ class MultiHeadAttention(Module):
         o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, s, cfg.d_model)
         return jnp.dot(o, p["wo"].astype(dt))
 
+    def apply_cached(self, params, x, cache, start):
+        """Incremental attention for generation: project the ``s`` new
+        positions (global offsets ``start + arange(s)``), write their k/v
+        into the static-length cache (``lax.dynamic_update_slice`` — the
+        compiled program is position-independent), and attend q against
+        the whole cache under a global causal mask.  One code path covers
+        prompt prefill (s = prompt length) and decode (s = 1)."""
+        cfg = self.cfg
+        p = self.own(params)
+        b, s, _ = x.shape
+        dt = x.dtype
+
+        def proj(w):
+            y = jnp.dot(x, w.astype(dt))
+            y = y.reshape(b, s, cfg.n_heads, cfg.head_dim)
+            return jnp.transpose(y, (0, 2, 1, 3))        # (B, H, s, Dh)
+
+        positions = start + jnp.arange(s)
+        q = apply_rope(proj(p["wq"]), positions, cfg.rope_theta)
+        k = apply_rope(proj(p["wk"]), positions, cfg.rope_theta)
+        v = proj(p["wv"])
+        ck = lax.dynamic_update_slice(cache["k"], k.astype(cache["k"].dtype),
+                                      (0, 0, start, 0))
+        cv = lax.dynamic_update_slice(cache["v"], v.astype(cache["v"].dtype),
+                                      (0, 0, start, 0))
+        k_pos = jnp.arange(ck.shape[2])
+        s_ = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        ck.astype(jnp.float32)) / np.sqrt(cfg.head_dim)
+        # same mask primitive as the kernels; kv_len = start + s also
+        # masks unwritten cache slots explicitly
+        mask = _attn_mask(positions, k_pos, start + s, True)
+        s_ = jnp.where(mask[None, None], s_, DEFAULT_MASK_VALUE)
+        w_ = jax.nn.softmax(s_, axis=-1)
+        o = jnp.einsum("bhqk,bhkd->bhqd", w_,
+                       cv.astype(jnp.float32)).astype(dt)
+        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, s, cfg.d_model)
+        return jnp.dot(o, p["wo"].astype(dt)), {"k": ck, "v": cv}
+
 
 class SwiGLU(Module):
     """Gated MLP: (silu(x w1) * x w3) w2 — two column-sharded matmuls in,
@@ -205,6 +245,13 @@ class TransformerBlock(Module):
             params, self.norm1.apply(params, x, ctx), ctx), ctx)
         return h + self._drop(self.mlp.apply(
             params, self.norm2.apply(params, h, ctx), ctx), ctx)
+
+    def apply_cached(self, params, x, ctx, cache, start):
+        a, cache = self.attn.apply_cached(
+            params, self.norm1.apply(params, x, ctx), cache, start)
+        h = x + a
+        return h + self.mlp.apply(params, self.norm2.apply(params, h, ctx),
+                                  ctx), cache
 
     def _drop(self, x, ctx):
         rate = self.cfg.dropout
@@ -281,6 +328,102 @@ class TransformerLM(Module):
             w = params[self.embed.name]["weight"]        # (V, D) tied
             logits = jnp.dot(h, w.T.astype(h.dtype))
         return logits.astype(jnp.float32)
+
+    # -- generation (kv cache) ----------------------------------------- #
+    def init_cache(self, batch: int, dtype=None):
+        """Static-length kv cache, one entry per block, keyed by the
+        attention module's name (so caches survive pytree transforms)."""
+        cfg = self.cfg
+        dt = jnp.dtype(dtype or cfg.dtype)
+        shape = (batch, cfg.n_heads, cfg.max_len, cfg.head_dim)
+        return {blk.attn.name: {"k": jnp.zeros(shape, dt),
+                                "v": jnp.zeros(shape, dt)}
+                for blk in self.blocks}
+
+    def apply_with_cache(self, params, tokens, cache, start):
+        """logits for ``tokens`` (B, s) written at global offset ``start``
+        into ``cache``; returns (logits fp32 (B, s, V), new cache)."""
+        cfg = self.cfg
+        ctx = Ctx(state={}, training=False, rng_key=None)
+        h = self.embed.apply(params, tokens, ctx).astype(jnp.dtype(cfg.dtype))
+        new_cache = {}
+        for blk in self.blocks:
+            h, new_cache[blk.attn.name] = blk.apply_cached(
+                params, h, ctx, cache[blk.attn.name], start)
+        h = self.final_norm.apply(params, h, ctx)
+        if self.head is not None:
+            logits = self.head.apply(params, h, ctx)
+        else:
+            w = params[self.embed.name]["weight"]
+            logits = jnp.dot(h, w.T.astype(h.dtype))
+        return logits.astype(jnp.float32), new_cache
+
+    def generate(self, params, prompt, max_new_tokens: int,
+                 temperature: float = 0.0, rng=None):
+        """Autoregressive decode with a kv cache: ONE compiled prefill
+        (prompt length) + ONE compiled ``lax.scan`` of single-token steps
+        (static shapes throughout, so repeated calls with equal prompt
+        length/batch reuse both programs).  temperature 0 = greedy, else
+        softmax sampling with ``rng``.  Returns (B, prompt+new) tokens.
+
+        ≙ the reference's RecurrentDecoder generation loop
+        (nn/RecurrentDecoder.scala) rebuilt for attention models.
+        """
+        cfg = self.cfg
+        prompt = jnp.asarray(prompt, jnp.int32)
+        b, s0 = prompt.shape
+        if max_new_tokens < 1:
+            return prompt
+        if s0 + max_new_tokens > cfg.max_len:
+            raise ValueError(
+                f"prompt({s0}) + max_new_tokens({max_new_tokens}) exceeds "
+                f"max_len={cfg.max_len}")
+        if temperature > 0.0 and rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        def select(logits_last, key):
+            if temperature <= 0.0:
+                return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+            return jax.random.categorical(
+                key, logits_last / temperature, axis=-1).astype(jnp.int32)
+
+        memo = getattr(self, "_gen_fns", None)
+        if memo is None:
+            memo = self._gen_fns = {}
+        memo_key = (b, s0, int(max_new_tokens), float(temperature))
+        if memo_key in memo:
+            return memo[memo_key](params, prompt, rng)
+
+        @jax.jit
+        def run(params, prompt, rng):
+            cache = self.init_cache(b)
+            logits, cache = self.apply_with_cache(params, prompt, cache, 0)
+            key0, key = (jax.random.split(rng) if rng is not None
+                         else (None, None))
+            tok = select(logits[:, -1], key0)
+
+            def step(carry, i):
+                tok, cache, key = carry
+                # `tok` is the token AT position s0+i: write it there and
+                # sample position s0+i+1's token
+                lg, cache = self.apply_with_cache(
+                    params, tok[:, None], cache, s0 + i)
+                if key is not None:
+                    key, sub = jax.random.split(key)
+                else:
+                    sub = None
+                nxt = select(lg[:, -1], sub)
+                return (nxt, cache, key), tok
+
+            (last, _, _), toks = lax.scan(
+                step, (tok, cache, key), jnp.arange(max_new_tokens - 1))
+            out = jnp.moveaxis(toks, 0, 1)               # (B, new-1)
+            return jnp.concatenate([prompt, out, last[:, None]], axis=1)
+
+        memo[memo_key] = run
+        if len(memo) > 8:   # bound compiled-program retention
+            memo.pop(next(iter(memo)))
+        return run(params, prompt, rng)
 
     # ------------------------------------------------------------------ #
     def param_pspecs(self, params):
