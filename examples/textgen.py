@@ -1,0 +1,70 @@
+"""Train a tiny TransformerLM on a synthetic pattern, then generate with
+the kv cache (greedy + sampled).
+
+Demonstrates the inference path (models/transformer.py: generate) the way
+the reference's rnn example demonstrates RecurrentDecoder generation.
+
+    python examples/textgen.py [--epochs N]
+"""
+import numpy as np
+import jax
+
+from _common import parse_args
+from bigdl_tpu.models import transformer as T
+from bigdl_tpu.optim import Adam
+
+
+def make_data(n, seq, vocab, rs):
+    """Deterministic pattern: token[i+1] = (token[i] * 3 + 7) % vocab."""
+    x0 = rs.randint(0, vocab, (n, 1))
+    toks = [x0]
+    for _ in range(seq):
+        toks.append((toks[-1] * 3 + 7) % vocab)
+    return np.concatenate(toks, axis=1)
+
+
+def main():
+    args = parse_args(epochs=30, batch=32, lr=3e-3)
+    vocab, seq = 64, 24
+    rs = np.random.RandomState(0)
+    data = make_data(args.batch, seq, vocab, rs)
+
+    model = T.TransformerLM(T.TransformerConfig(
+        vocab_size=vocab, d_model=64, n_heads=2, n_layers=2, d_ff=128,
+        max_len=64, dropout=0.0))
+    params = model.init(jax.random.PRNGKey(0))
+    method = Adam(learning_rate=args.lr)
+    opt_state = method.init_state(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens):
+        def loss_fn(p):
+            logits, _ = model.run(p, tokens[:, :-1], training=True,
+                                  rng=jax.random.PRNGKey(0))
+            return T.lm_cross_entropy(logits, tokens[:, 1:])
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        params, opt_state = method.update(grads, params, opt_state)
+        return params, opt_state, loss
+
+    for epoch in range(args.epochs):
+        params, opt_state, loss = step(params, opt_state, data)
+        if (epoch + 1) % 10 == 0:
+            print(f"epoch {epoch + 1}: loss={float(loss):.4f}")
+
+    prompt = data[:2, :4]
+    out = np.asarray(model.generate(params, prompt, max_new_tokens=10))
+    want = data[:2, 4:14]
+    acc = float((out[:, 4:] == want).mean())
+    print("prompt:   ", prompt[0].tolist())
+    print("generated:", out[0, 4:].tolist())
+    print("expected: ", want[0].tolist())
+    print(f"pattern accuracy: {acc:.2f}")
+    sampled = np.asarray(model.generate(params, prompt, max_new_tokens=10,
+                                        temperature=0.7,
+                                        rng=jax.random.PRNGKey(1)))
+    print("sampled:  ", sampled[0, 4:].tolist())
+    assert acc > 0.6, "model failed to learn the synthetic pattern"
+
+
+if __name__ == "__main__":
+    main()

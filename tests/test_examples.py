@@ -29,6 +29,7 @@ CASES = [
     ("loadmodel.py", [], 420),
     ("distributed_resnet.py", ["--epochs", "1", "--batch", "32"], 600),
     ("transformer_spmd.py", ["--epochs", "1", "--batch", "8"], 600),
+    ("textgen.py", ["--epochs", "30"], 300),
 ]
 
 
