@@ -231,6 +231,27 @@ def bench_transformer():
         p, o = method.update(grads, p, o)
         return (p, o), loss
 
+    # decode throughput through the kv cache (serving path)
+    try:
+        prompt = tokens[:, :128]
+        n_new = 128
+        out = model.generate(params, prompt, n_new)      # compile
+        np.asarray(out)
+        lat = _roundtrip_latency()
+        per = []
+        for _ in range(TRIALS):
+            t0 = time.perf_counter()
+            np.asarray(model.generate(params, prompt, n_new))
+            per.append(time.perf_counter() - t0 - lat)
+        dec_s = float(np.median(per))
+        print(json.dumps({
+            "metric": "transformer_lm_decode_tokens_per_sec",
+            "value": round(B * n_new / dec_s, 2), "unit": "tokens/sec",
+            "vs_baseline": None}), flush=True)
+    except Exception as e:
+        print(f"# decode bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr, flush=True)
+
     sec = _time_scanned(scan_step, (params, opt_state), (tokens, targets),
                         5)
     tok_s = B * T / sec

@@ -359,7 +359,9 @@ class TransformerLM(Module):
         return logits.astype(jnp.float32), new_cache
 
     def generate(self, params, prompt, max_new_tokens: int,
-                 temperature: float = 0.0, rng=None):
+                 temperature: float = 0.0, rng=None,
+                 top_k: Optional[int] = None,
+                 top_p: Optional[float] = None):
         """Autoregressive decode with a kv cache: ONE compiled prefill
         (prompt length) + ONE compiled ``lax.scan`` of single-token steps
         (static shapes throughout, so repeated calls with equal prompt
@@ -372,6 +374,8 @@ class TransformerLM(Module):
         cfg = self.cfg
         prompt = jnp.asarray(prompt, jnp.int32)
         b, s0 = prompt.shape
+        if top_k is not None and top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {top_k}")
         if max_new_tokens < 1:
             return prompt
         if s0 + max_new_tokens > cfg.max_len:
@@ -384,13 +388,28 @@ class TransformerLM(Module):
         def select(logits_last, key):
             if temperature <= 0.0:
                 return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
-            return jax.random.categorical(
-                key, logits_last / temperature, axis=-1).astype(jnp.int32)
+            lg = logits_last / temperature
+            if top_k is not None and top_k < lg.shape[-1]:
+                kth = lax.top_k(lg, top_k)[0][..., -1:]
+                lg = jnp.where(lg < kth, -jnp.inf, lg)
+            if top_p is not None and 0.0 < top_p < 1.0:
+                # nucleus: keep the smallest prefix of the sorted probs
+                # whose mass reaches top_p (the top token always survives)
+                srt = jnp.sort(lg, axis=-1)[..., ::-1]
+                probs = jax.nn.softmax(srt, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = cum - probs < top_p
+                cutoff = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1,
+                                 keepdims=True)
+                lg = jnp.where(lg < cutoff, -jnp.inf, lg)
+            return jax.random.categorical(key, lg, axis=-1).astype(
+                jnp.int32)
 
         memo = getattr(self, "_gen_fns", None)
         if memo is None:
             memo = self._gen_fns = {}
-        memo_key = (b, s0, int(max_new_tokens), float(temperature))
+        memo_key = (b, s0, int(max_new_tokens), float(temperature),
+                    top_k, top_p)
         if memo_key in memo:
             return memo[memo_key](params, prompt, rng)
 

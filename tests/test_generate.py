@@ -132,3 +132,27 @@ def test_generate_zero_new_tokens(model_and_params):
     prompt = np.random.RandomState(5).randint(0, 256, (2, 5))
     got = np.asarray(model.generate(params, prompt, 0))
     np.testing.assert_array_equal(got, prompt)
+
+
+def test_topk_topp_sampling(model_and_params):
+    """top_k=1 must equal greedy; top_p near 0 must also collapse to the
+    argmax token; both produce valid shapes with temperature > 0."""
+    model, params = model_and_params
+    prompt = np.random.RandomState(6).randint(0, 256, (2, 5))
+    greedy = np.asarray(model.generate(params, prompt, 6))
+    k1 = np.asarray(model.generate(params, prompt, 6, temperature=1.0,
+                                   rng=jax.random.PRNGKey(0), top_k=1))
+    np.testing.assert_array_equal(greedy, k1)
+    p0 = np.asarray(model.generate(params, prompt, 6, temperature=1.0,
+                                   rng=jax.random.PRNGKey(0), top_p=1e-6))
+    np.testing.assert_array_equal(greedy, p0)
+    k8 = np.asarray(model.generate(params, prompt, 6, temperature=1.0,
+                                   rng=jax.random.PRNGKey(0), top_k=8))
+    assert k8.shape == (2, 11)
+
+
+def test_topk_validation(model_and_params):
+    model, params = model_and_params
+    prompt = np.zeros((1, 4), np.int32)
+    with pytest.raises(ValueError, match="top_k"):
+        model.generate(params, prompt, 2, temperature=1.0, top_k=0)
