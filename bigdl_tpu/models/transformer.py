@@ -444,6 +444,130 @@ class TransformerLM(Module):
             memo.pop(next(iter(memo)))
         return run(params, prompt, rng)
 
+    def generate_beam(self, params, prompt, max_new_tokens: int,
+                      beam_size: int = 4, eos_id: Optional[int] = None,
+                      length_penalty: float = 0.0):
+        """Beam-search decode with the kv cache.
+
+        Keeps ``beam_size`` hypotheses per sequence: the cache runs at
+        batch B*beam and is gathered along the beam dim after each step's
+        top-k over (beam x vocab) continuations.  Beams that emit
+        ``eos_id`` freeze (score stops accumulating, eos repeats).
+        Returns (tokens (B, s0+new), scores (B,)) of the best hypothesis;
+        scores are summed token log-probs / (length ** length_penalty).
+        """
+        cfg = self.cfg
+        prompt = jnp.asarray(prompt, jnp.int32)
+        b, s0 = prompt.shape
+        if not 1 <= beam_size <= cfg.vocab_size:
+            raise ValueError(f"beam_size must be in [1, vocab_size], "
+                             f"got {beam_size}")
+        if max_new_tokens < 1:
+            return prompt, jnp.zeros((b,), jnp.float32)
+        if s0 + max_new_tokens > cfg.max_len:
+            raise ValueError(
+                f"prompt({s0}) + max_new_tokens({max_new_tokens}) exceeds "
+                f"max_len={cfg.max_len}")
+        K = int(beam_size)
+        memo = getattr(self, "_gen_fns", None)
+        if memo is None:
+            memo = self._gen_fns = {}
+        memo_key = ("beam", b, s0, int(max_new_tokens), K, eos_id,
+                    float(length_penalty))
+        if memo_key in memo:
+            return memo[memo_key](params, prompt)
+
+        @jax.jit
+        def run(params, prompt):
+            cache = self.init_cache(b)
+            logits, cache = self.apply_with_cache(params, prompt, cache, 0)
+            logp0 = jax.nn.log_softmax(logits[:, -1], axis=-1)   # (B, V)
+            V = logp0.shape[-1]
+            scores, tok0 = lax.top_k(logp0, K)                   # (B, K)
+            # tile the prompt-filled cache across beams: (B*K, H, L, Dh)
+            cache = jax.tree_util.tree_map(
+                lambda c: jnp.repeat(c, K, axis=0), cache)
+            tok = tok0.reshape(b * K).astype(jnp.int32)
+            alive = (tok0 != eos_id) if eos_id is not None else None
+            lengths = jnp.ones((b, K), jnp.float32)   # tok0 counts as 1
+
+            def step(carry, i):
+                tok, scores, cache, alive, lengths = carry
+                # `tok` occupies position s0+i: write it there, then score
+                # position s0+i+1 candidates
+                lg, cache = self.apply_with_cache(
+                    params, tok[:, None], cache, s0 + i)
+                logp = jax.nn.log_softmax(lg[:, 0], axis=-1)     # (B*K, V)
+                logp = logp.reshape(b, K, V)
+                if alive is not None:
+                    # finished beams: only "emit eos again at score 0"
+                    frozen = jnp.full((V,), -jnp.inf
+                                      ).at[eos_id].set(0.0)
+                    logp = jnp.where(alive[..., None], logp,
+                                     frozen[None, None, :])
+                total = scores[..., None] + logp                 # (B,K,V)
+                flat_scores, flat_idx = lax.top_k(
+                    total.reshape(b, K * V), K)                  # (B, K)
+                src_beam = flat_idx // V                         # (B, K)
+                new_tok = (flat_idx % V).astype(jnp.int32)
+                # reindex caches and alive to the surviving beams
+                gather_rows = (jnp.arange(b)[:, None] * K
+                               + src_beam).reshape(b * K)
+                cache = jax.tree_util.tree_map(
+                    lambda c: jnp.take(c, gather_rows, axis=0), cache)
+                lengths = jnp.take_along_axis(lengths, src_beam, axis=1)
+                if alive is not None:
+                    parent_alive = jnp.take_along_axis(alive, src_beam,
+                                                       axis=1)
+                    # frozen beams' repeated eos does not count as length
+                    lengths = lengths + parent_alive.astype(jnp.float32)
+                    alive = parent_alive & (new_tok != eos_id)
+                else:
+                    lengths = lengths + 1.0
+                tok = new_tok.reshape(b * K)
+                return ((tok, flat_scores, cache, alive, lengths),
+                        (new_tok, src_beam))
+
+            carry = (tok, scores, cache, alive, lengths)
+            carry, (toks, srcs) = lax.scan(
+                step, carry, jnp.arange(max_new_tokens - 1))
+            _, scores, _, _, lengths = carry
+            # backtrack: follow src_beam pointers from the best final beam
+            norm = scores
+            if length_penalty:
+                norm = scores / (jnp.maximum(lengths, 1.0)
+                                 ** length_penalty)
+            best = jnp.argmax(norm, axis=-1)                     # (B,)
+
+            def backtrack(beam, toks, srcs):
+                # toks/srcs: (steps, B, K); walk backwards per batch row
+                def back(carry, sr_tk):
+                    beam = carry
+                    sr, tk = sr_tk
+                    t = jnp.take_along_axis(tk, beam[:, None],
+                                            axis=1)[:, 0]
+                    beam = jnp.take_along_axis(sr, beam[:, None],
+                                               axis=1)[:, 0]
+                    return beam, t
+
+                beam, rev = lax.scan(back, beam, (srcs, toks),
+                                     reverse=True)
+                return beam, rev
+
+            first_beam, rev = backtrack(best, toks, srcs)
+            first_tok = jnp.take_along_axis(tok0, first_beam[:, None],
+                                            axis=1)
+            seq = jnp.concatenate(
+                [prompt, first_tok, jnp.moveaxis(rev, 0, 1)], axis=1)
+            best_score = jnp.take_along_axis(norm, best[:, None],
+                                             axis=1)[:, 0]
+            return seq, best_score
+
+        memo[memo_key] = run
+        if len(memo) > 8:
+            memo.pop(next(iter(memo)))
+        return run(params, prompt)
+
     # ------------------------------------------------------------------ #
     def param_pspecs(self, params):
         """PartitionSpec pytree matching ``params``; modules declare their

@@ -156,3 +156,56 @@ def test_topk_validation(model_and_params):
     prompt = np.zeros((1, 4), np.int32)
     with pytest.raises(ValueError, match="top_k"):
         model.generate(params, prompt, 2, temperature=1.0, top_k=0)
+
+
+def test_beam_search_beats_or_matches_greedy(model_and_params):
+    """The best beam's sequence log-prob (scored by the full forward)
+    must be >= the greedy sequence's — beam search can only widen the
+    search."""
+    model, params = model_and_params
+    prompt = jnp.asarray(
+        np.random.RandomState(7).randint(0, 256, (2, 5)), jnp.int32)
+    n_new = 6
+
+    def seq_logprob(tokens):
+        logits, _ = model.run(params, tokens[:, :-1], training=False)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tgt = tokens[:, 1:]
+        per = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+        return np.asarray(per[:, -n_new:].sum(axis=1))
+
+    greedy = model.generate(params, prompt, n_new)
+    beam, scores = model.generate_beam(params, prompt, n_new, beam_size=4)
+    assert beam.shape == (2, 11)
+    lp_greedy = seq_logprob(jnp.asarray(greedy))
+    lp_beam = seq_logprob(jnp.asarray(beam))
+    assert np.all(lp_beam >= lp_greedy - 1e-3), (lp_beam, lp_greedy)
+    # returned scores must equal the independently-computed log-probs
+    np.testing.assert_allclose(np.asarray(scores), lp_beam, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_beam_search_eos_freezes(model_and_params):
+    """Once a beam emits eos, it must keep emitting eos at zero cost."""
+    model, params = model_and_params
+    prompt = jnp.asarray(
+        np.random.RandomState(8).randint(0, 256, (1, 4)), jnp.int32)
+    # pick the untrained model's own first greedy token as "eos" so the
+    # best beam hits it immediately
+    first = int(np.asarray(model.generate(params, prompt, 1))[0, -1])
+    seq, scores = model.generate_beam(params, prompt, 8, beam_size=3,
+                                      eos_id=first)
+    seq = np.asarray(seq)[0]
+    eos_positions = np.where(seq[4:] == first)[0]
+    # eos IS the best first token (it was the greedy pick, and frozen
+    # beams continue at zero cost), so it must appear...
+    assert len(eos_positions) > 0
+    # ...and everything after the first eos is eos
+    assert np.all(seq[4 + eos_positions[0]:] == first)
+
+
+def test_beam_size_one_is_valid(model_and_params):
+    model, params = model_and_params
+    prompt = np.random.RandomState(9).randint(0, 256, (2, 5))
+    seq, scores = model.generate_beam(params, prompt, 5, beam_size=1)
+    assert seq.shape == (2, 10) and scores.shape == (2,)
