@@ -169,8 +169,10 @@ def make_accum_grads(loss_fn, n_accum: int, weight_fn=None):
             return (g_acc, loss_acc + w * loss, w_acc + w, merged,
                     i + 1), None
 
+        # zeros_like (vs jnp.zeros(shape)) lets GSPMD propagate the
+        # operand's sharding into the gradient carry
         zeros = jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
         (g_sum, loss_sum, w_sum, merged, _), _ = lax.scan(
             body, (zeros, jnp.float32(0), jnp.float32(0),
                    dict(model_state), jnp.int32(0)), (xs, ys))
