@@ -187,6 +187,87 @@ class SpmdTrainer:
         self._step_count += 1
         return loss
 
+    # -- checkpointing --------------------------------------------------- #
+    def save_checkpoint(self, path: str):
+        """Write params + optimizer state + step counter as an orbax
+        checkpoint directory.  Sharded jax Arrays are handed to orbax
+        directly (``to_host=False``) so fsdp state is written shard-wise
+        without materialising an unsharded host copy; any orbax
+        StandardCheckpointer can read it.  ≙ Optimizer.setCheckpoint for
+        the compiler-partitioned flagship path."""
+        import json
+        import os
+        from ..utils.serializer import save_pytree
+        if self.params is None:
+            raise ValueError("trainer not initialized; call init() first")
+        save_pytree({"params": self.params, "opt_state": self.opt_state},
+                    os.path.join(path, "state"), to_host=False)
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump({"step": self._step_count, "seed": self.seed,
+                       "root": self.model.name}, f)
+
+    def _rekey_root(self, tree, old_root, new_root):
+        """Auto-named modules draw from a process-global uid counter, so a
+        fresh trainer's param keys differ from the saved ones ONLY in the
+        model-root prefix; rewrite it key-by-key (never by flatten
+        order, which could silently permute same-shape leaves)."""
+        if isinstance(tree, dict):
+            out = {}
+            for k, v in tree.items():
+                if k == old_root:
+                    k = new_root
+                elif k.startswith(old_root + "."):
+                    k = new_root + k[len(old_root):]
+                out[k] = self._rekey_root(v, old_root, new_root)
+            return out
+        return tree
+
+    def load_checkpoint(self, path: str):
+        """Restore a save_checkpoint directory into this trainer: arrays
+        come back on device with this trainer's shardings, and the step
+        counter AND seed resume, so the data-order/dropout RNG stream
+        continues exactly as in the uninterrupted run."""
+        import json
+        import os
+        from ..utils.serializer import load_pytree
+        if self.params is None:
+            self.init()
+        with open(os.path.join(path, "meta.json")) as f:
+            meta = json.load(f)
+        raw = load_pytree(os.path.join(path, "state"))
+        raw = self._rekey_root(raw, meta.get("root", self.model.name),
+                               self.model.name)
+        template = {"params": self.params, "opt_state": self.opt_state}
+        if (jax.tree_util.tree_structure(raw)
+                != jax.tree_util.tree_structure(template)):
+            raise ValueError(
+                f"{path}: checkpoint tree does not match this trainer's "
+                "model (after root-name normalisation)")
+
+        def check(v, t, where):
+            if tuple(np.shape(v)) != tuple(np.shape(t)) or \
+                    np.asarray(v).dtype != np.asarray(t).dtype:
+                raise ValueError(
+                    f"{path}: leaf {jax.tree_util.keystr(where)} is "
+                    f"{np.shape(v)}/{np.asarray(v).dtype}, model expects "
+                    f"{np.shape(t)}/{np.asarray(t).dtype}")
+            return v
+
+        raw = jax.tree_util.tree_map_with_path(
+            lambda w, v, t: check(v, t, w), raw, template)
+        shardings = self._param_shardings(self.params)
+        self.params = jax.tree_util.tree_map(
+            jax.device_put, raw["params"], shardings)
+        # opt-state leaves stay UNCOMMITTED (plain jnp.asarray): at init
+        # they come out of jit the same way, and the next step call's jit
+        # dispatch places them against the params' shardings without the
+        # committed-device conflicts an explicit device_put would cause
+        self.opt_state = jax.tree_util.tree_map(
+            lambda v: jnp.asarray(np.asarray(v)), raw["opt_state"])
+        self._step_count = meta["step"]
+        self.seed = meta.get("seed", self.seed)
+        return self
+
     def fit(self, batches, steps: Optional[int] = None, log_every: int = 0):
         losses = []
         t0 = time.time()

@@ -591,12 +591,17 @@ def _load_module_v1(path):
 # orbax-compatible checkpoints (≙ the reference's HDFS checkpoint dir   #
 # interop story: checkpoints readable by the ecosystem's standard tool) #
 # --------------------------------------------------------------------- #
-def save_pytree(tree, path):
-    """Write a pytree checkpoint readable by any orbax StandardCheckpointer."""
+def save_pytree(tree, path, to_host=True):
+    """Write a pytree checkpoint readable by any orbax StandardCheckpointer.
+
+    ``to_host=False`` hands jax Arrays to orbax directly — sharded (fsdp)
+    state is then written shard-by-shard without ever materialising an
+    unsharded host copy."""
     import os
     import orbax.checkpoint as ocp
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.abspath(path), _to_host(tree), force=True)
+    ckptr.save(os.path.abspath(path), _to_host(tree) if to_host else tree,
+               force=True)
     ckptr.wait_until_finished()
 
 
