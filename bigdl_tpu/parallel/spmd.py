@@ -200,11 +200,19 @@ class SpmdTrainer:
         from ..utils.serializer import save_pytree
         if self.params is None:
             raise ValueError("trainer not initialized; call init() first")
+        # step-tagged snapshot + atomic 'latest' pointer (same crash-safe
+        # pattern as Optimizer.save_checkpoint): a job killed mid-save
+        # never destroys the previous snapshot
+        tag_dir = os.path.join(path, f"step_{self._step_count}")
         save_pytree({"params": self.params, "opt_state": self.opt_state},
-                    os.path.join(path, "state"), to_host=False)
-        with open(os.path.join(path, "meta.json"), "w") as f:
+                    os.path.join(tag_dir, "state"), to_host=False)
+        with open(os.path.join(tag_dir, "meta.json"), "w") as f:
             json.dump({"step": self._step_count, "seed": self.seed,
                        "root": self.model.name}, f)
+        tmp = os.path.join(path, "latest.tmp")
+        with open(tmp, "w") as f:
+            f.write(tag_dir)
+        os.replace(tmp, os.path.join(path, "latest"))
 
     def _rekey_root(self, tree, old_root, new_root):
         """Auto-named modules draw from a process-global uid counter, so a
@@ -232,9 +240,18 @@ class SpmdTrainer:
         from ..utils.serializer import load_pytree
         if self.params is None:
             self.init()
-        with open(os.path.join(path, "meta.json")) as f:
+        latest = os.path.join(path, "latest")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                root = f.read().strip()
+        elif os.path.exists(os.path.join(path, "meta.json")):
+            root = path     # direct snapshot directory
+        else:
+            raise FileNotFoundError(
+                f"{path}: no 'latest' pointer or snapshot found")
+        with open(os.path.join(root, "meta.json")) as f:
             meta = json.load(f)
-        raw = load_pytree(os.path.join(path, "state"))
+        raw = load_pytree(os.path.join(root, "state"))
         raw = self._rekey_root(raw, meta.get("root", self.model.name),
                                self.model.name)
         template = {"params": self.params, "opt_state": self.opt_state}
@@ -268,8 +285,17 @@ class SpmdTrainer:
         self.seed = meta.get("seed", self.seed)
         return self
 
+    def set_checkpoint(self, path: str, every_steps: int = 1000):
+        """Checkpoint every ``every_steps`` steps during fit()
+        (≙ Optimizer.setCheckpoint with a several_iteration trigger)."""
+        if every_steps < 1:
+            raise ValueError("every_steps must be >= 1")
+        self._ckpt = (path, int(every_steps))
+        return self
+
     def fit(self, batches, steps: Optional[int] = None, log_every: int = 0):
         losses = []
+        ckpt = getattr(self, "_ckpt", None)
         t0 = time.time()
         for i, (tokens, targets) in enumerate(batches):
             if steps is not None and i >= steps:
@@ -278,5 +304,7 @@ class SpmdTrainer:
             if log_every and (i + 1) % log_every == 0:
                 print(f"step {i + 1}: loss={float(loss):.4f} "
                       f"({(i + 1) / (time.time() - t0):.2f} it/s)")
+            if ckpt and self._step_count % ckpt[1] == 0:
+                self.save_checkpoint(ckpt[0])
             losses.append(loss)
         return [float(l) for l in losses]

@@ -216,3 +216,26 @@ def test_spmd_trainer_checkpoint_resume(tmp_path):
     resumed = [float(tr2.step(t[:, :-1], t[:, 1:])) for t in toks[2:]]
     tr2.detach()
     np.testing.assert_allclose(resumed, base[2:], rtol=1e-5, atol=1e-6)
+
+
+def test_spmd_trainer_fit_checkpoints(tmp_path):
+    from bigdl_tpu.models import transformer as T
+    from bigdl_tpu.parallel import mesh as mesh_lib
+    from bigdl_tpu.parallel.spmd import SpmdTrainer
+    from bigdl_tpu.optim import SGD
+    import json, os
+
+    mesh = mesh_lib.create_mesh({"dp": 8})
+    rs = np.random.RandomState(0)
+    batches = [(t[:, :-1], t[:, 1:]) for t in
+               (rs.randint(0, 256, (8, 33)) for _ in range(3))]
+    tr = (SpmdTrainer(T.build("tiny", dropout=0.0), SGD(learning_rate=0.05),
+                      mesh=mesh, fsdp=False)
+          .set_checkpoint(str(tmp_path / "ck"), every_steps=2))
+    tr.fit(batches)
+    tr.detach()
+    latest = open(str(tmp_path / "ck" / "latest")).read().strip()
+    assert latest.endswith("step_2")    # written at step 2, not 3
+    meta = json.load(open(os.path.join(latest, "meta.json")))
+    assert meta["step"] == 2
+    assert os.path.isdir(os.path.join(latest, "state"))
