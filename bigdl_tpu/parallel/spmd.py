@@ -211,7 +211,7 @@ class SpmdTrainer:
                        "root": self.model.name}, f)
         tmp = os.path.join(path, "latest.tmp")
         with open(tmp, "w") as f:
-            f.write(tag_dir)
+            f.write(os.path.basename(tag_dir))   # relocatable pointer
         os.replace(tmp, os.path.join(path, "latest"))
 
     def _rekey_root(self, tree, old_root, new_root):
@@ -243,7 +243,10 @@ class SpmdTrainer:
         latest = os.path.join(path, "latest")
         if os.path.exists(latest):
             with open(latest) as f:
-                root = f.read().strip()
+                name = f.read().strip()
+            root = os.path.join(path, os.path.basename(name))
+            if not os.path.isdir(root):
+                root = name     # legacy pointer holding a full path
         elif os.path.exists(os.path.join(path, "meta.json")):
             root = path     # direct snapshot directory
         else:
@@ -285,13 +288,42 @@ class SpmdTrainer:
         self.seed = meta.get("seed", self.seed)
         return self
 
-    def set_checkpoint(self, path: str, every_steps: int = 1000):
-        """Checkpoint every ``every_steps`` steps during fit()
+    def set_checkpoint(self, path: str, every_steps: int = 1000,
+                       keep: int = 3):
+        """Checkpoint every ``every_steps`` steps during fit(), retaining
+        the newest ``keep`` snapshots (0 = keep all)
         (≙ Optimizer.setCheckpoint with a several_iteration trigger)."""
         if every_steps < 1:
             raise ValueError("every_steps must be >= 1")
-        self._ckpt = (path, int(every_steps))
+        if keep < 0:
+            raise ValueError("keep must be >= 0")
+        self._ckpt = (path, int(every_steps), int(keep))
         return self
+
+    def _prune_checkpoints(self, path: str, keep: int):
+        import os
+        import re
+        import shutil
+        if keep < 1:
+            return
+        latest = os.path.join(path, "latest")
+        pointed = None
+        if os.path.exists(latest):
+            with open(latest) as f:
+                pointed = os.path.basename(f.read().strip())
+        snaps = []
+        for d in os.listdir(path):
+            m = re.fullmatch(r"step_(\d+)", d)
+            full = os.path.join(path, d)
+            if m and os.path.isdir(full):
+                # rank by mtime, not step number: a run resumed from an
+                # older snapshot must not have its fresh checkpoints
+                # crowded out by stale higher-step dirs of a dead run
+                snaps.append((os.path.getmtime(full), d, full))
+        snaps.sort()
+        for _, name, full in snaps[:-keep]:
+            if name != pointed:  # never delete the snapshot 'latest' names
+                shutil.rmtree(full, ignore_errors=True)
 
     def fit(self, batches, steps: Optional[int] = None, log_every: int = 0):
         losses = []
@@ -306,5 +338,6 @@ class SpmdTrainer:
                       f"({(i + 1) / (time.time() - t0):.2f} it/s)")
             if ckpt and self._step_count % ckpt[1] == 0:
                 self.save_checkpoint(ckpt[0])
+                self._prune_checkpoints(ckpt[0], ckpt[2])
             losses.append(loss)
         return [float(l) for l in losses]

@@ -229,13 +229,18 @@ def test_spmd_trainer_fit_checkpoints(tmp_path):
     rs = np.random.RandomState(0)
     batches = [(t[:, :-1], t[:, 1:]) for t in
                (rs.randint(0, 256, (8, 33)) for _ in range(3))]
+    batches = batches * 2               # 6 steps -> snapshots at 2, 4, 6
     tr = (SpmdTrainer(T.build("tiny", dropout=0.0), SGD(learning_rate=0.05),
                       mesh=mesh, fsdp=False)
-          .set_checkpoint(str(tmp_path / "ck"), every_steps=2))
+          .set_checkpoint(str(tmp_path / "ck"), every_steps=2, keep=2))
     tr.fit(batches)
     tr.detach()
     latest = open(str(tmp_path / "ck" / "latest")).read().strip()
-    assert latest.endswith("step_2")    # written at step 2, not 3
-    meta = json.load(open(os.path.join(latest, "meta.json")))
-    assert meta["step"] == 2
-    assert os.path.isdir(os.path.join(latest, "state"))
+    assert latest == "step_6"          # relocatable basename pointer
+    snap = os.path.join(str(tmp_path / "ck"), latest)
+    meta = json.load(open(os.path.join(snap, "meta.json")))
+    assert meta["step"] == 6
+    assert os.path.isdir(os.path.join(snap, "state"))
+    snaps = sorted(d for d in os.listdir(str(tmp_path / "ck"))
+                   if d.startswith("step_"))
+    assert snaps == ["step_4", "step_6"], snaps   # keep=2 pruned step_2
