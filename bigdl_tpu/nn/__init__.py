@@ -83,3 +83,7 @@ from .graph import Graph as StaticGraph
 from .containers import Container as DynamicContainer
 from .recurrent import RnnCell as RNN
 from .init import InitializationMethod
+# pyspark-API compatibility spellings (bigdl/nn/layer.py: Layer is the
+# module base, Model the functional-graph container)
+from .module import Module as Layer
+from .graph import Graph as Model

@@ -12,6 +12,30 @@ from .validation import (ValidationMethod, ValidationResult, AccuracyResult,
                          Top5Accuracy, Loss, MAE, HitRatio, NDCG,
                          TreeNNAccuracy)
 from .optimizer import (Optimizer, LocalOptimizer, Metrics, TrainingState,
-                        make_train_step, make_eval_step)
+                        make_train_step, make_eval_step,
+                        make_accum_train_step, make_accum_grads)
 from .predictor import (Predictor, LocalPredictor, Evaluator,
                         PredictionService)
+from .distri_optimizer import DistriOptimizer
+
+# pyspark-API compatibility spellings (bigdl/optim/optimizer.py exposes
+# trigger classes and summaries at module level; ours are Trigger
+# constructors and visualization classes)
+BaseOptimizer = Optimizer
+EveryEpoch = Trigger.every_epoch
+SeveralIteration = Trigger.several_iteration
+MaxEpoch = Trigger.max_epoch
+MaxIteration = Trigger.max_iteration
+MaxScore = Trigger.max_score
+MinLoss = Trigger.min_loss
+
+
+def __getattr__(name):
+    # lazy: visualization pulls in the event writer; only pay on use
+    if name in ("TrainSummary", "ValidationSummary"):
+        from .. import visualization
+        return getattr(visualization, name)
+    if name == "ActivityRegularization":
+        from ..nn import ActivityRegularization
+        return ActivityRegularization
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

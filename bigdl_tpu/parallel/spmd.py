@@ -244,9 +244,10 @@ class SpmdTrainer:
         if os.path.exists(latest):
             with open(latest) as f:
                 name = f.read().strip()
-            root = os.path.join(path, os.path.basename(name))
-            if not os.path.isdir(root):
+            if os.path.isabs(name) or os.sep in name:
                 root = name     # legacy pointer holding a full path
+            else:
+                root = os.path.join(path, name)
         elif os.path.exists(os.path.join(path, "meta.json")):
             root = path     # direct snapshot directory
         else:
@@ -319,9 +320,10 @@ class SpmdTrainer:
                 # rank by mtime, not step number: a run resumed from an
                 # older snapshot must not have its fresh checkpoints
                 # crowded out by stale higher-step dirs of a dead run
-                snaps.append((os.path.getmtime(full), d, full))
-        snaps.sort()
-        for _, name, full in snaps[:-keep]:
+                snaps.append((os.path.getmtime(full), int(m.group(1)),
+                              d, full))
+        snaps.sort()   # mtime first; step number breaks coarse-mtime ties
+        for _, _, name, full in snaps[:-keep]:
             if name != pointed:  # never delete the snapshot 'latest' names
                 shutil.rmtree(full, ignore_errors=True)
 

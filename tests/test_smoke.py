@@ -55,3 +55,24 @@ def test_grouped_full_convolution():
     p3, s3 = m3.init_params(0)
     y3, _ = m3.run(p3, jnp.ones((1, 4, 5, 5, 5)), state=s3)
     assert y3.shape == (1, 6, 9, 9, 9)
+
+
+def test_pyspark_compat_aliases():
+    """pyspark-API spellings resolve: nn.Layer/nn.Model, optim trigger
+    classes, Distri/Base optimizer, summaries (bigdl/nn/layer.py,
+    bigdl/optim/optimizer.py module-level names)."""
+    import numpy as np
+    import bigdl_tpu.nn as nn
+    import bigdl_tpu.optim as O
+    assert nn.Layer is nn.Module
+    assert O.BaseOptimizer is O.Optimizer
+    assert O.DistriOptimizer is not None
+    for name in ("EveryEpoch", "SeveralIteration", "MaxEpoch",
+                 "MaxIteration", "MaxScore", "MinLoss"):
+        assert callable(getattr(O, name))
+    assert O.TrainSummary.__name__ == "TrainSummary"
+    assert O.ValidationSummary.__name__ == "ValidationSummary"
+    assert O.ActivityRegularization.__name__ == "ActivityRegularization"
+    inp = nn.Input()
+    m = nn.Model(inp, nn.Linear(3, 2).inputs(inp))
+    assert np.asarray(m.forward(np.ones((2, 3), np.float32))).shape == (2, 2)
