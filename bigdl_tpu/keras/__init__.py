@@ -28,6 +28,6 @@ from .layers import (
     SimpleRNN, LSTM, GRU, ConvLSTM2D, Bidirectional, TimeDistributed,
     Merge,
 )
-from .topology import Sequential, Model, Input, KerasModel
+from .topology import Sequential, Model, Input, InputLayer, KerasModel
 from .converter import (DefinitionLoader, WeightLoader, load_keras,
                         KerasConversionError)

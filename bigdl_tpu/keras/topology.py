@@ -252,3 +252,10 @@ def _keras_call(self, x, rng=None):
 
 
 KerasLayer.__call__ = _keras_call
+
+
+def InputLayer(input_shape=None, name=None):
+    """pyspark-compat spelling of :func:`Input`
+    (bigdl/nn/keras/layer.py InputLayer: entry point into a model;
+    input_shape excludes batch)."""
+    return Input(shape=input_shape, name=name)

@@ -188,3 +188,12 @@ def test_sparse_categorical_crossentropy_positive_and_trains():
                     [(mth, r.result()[0]) for mth, r in res])
     assert loss_val["Loss"] > 0
     assert loss_val["Top1Accuracy"] > 0.6
+
+
+def test_inputlayer_compat_spelling():
+    """pyspark bigdl/nn/keras/layer.py InputLayer(input_shape=...)."""
+    import bigdl_tpu.keras as K
+    inp = K.InputLayer(input_shape=(6,))
+    m = K.Model(inp, K.Dense(2)(inp))
+    out = m.forward(np.ones((3, 6), np.float32))
+    assert np.asarray(out).shape == (3, 2)
