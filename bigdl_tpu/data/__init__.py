@@ -18,7 +18,11 @@ from .imageframe import (ImageFeature, ImageFrame, FeatureTransformer,
                          Contrast, Saturation, Hue, ColorJitterVision,
                          ChannelNormalize, ChannelScaledNormalizer,
                          PixelNormalizer, ChannelOrder, MatToTensor,
-                         ImageFrameToSample)
+                         ImageFrameToSample, RoiNormalize, RoiHFlip,
+                         RoiResize, RoiProject, DetectionCrop,
+                         RandomSampler, RandomAspectScale, BytesToMat,
+                         PixelBytesToMat, MatToFloats, Pipeline,
+                         LocalImageFrame, DistributedImageFrame)
 from .text import (LabeledSentence, SentenceSplitter, SentenceTokenizer,
                    SentenceBiPadding, Dictionary, TextToLabeledSentence,
                    LabeledSentenceToSample, read_localfile, sentences_split,

@@ -600,3 +600,274 @@ class ImageFrameToSample(FeatureTransformer):
             np.ascontiguousarray(chw, np.float32),
             None if label is None else np.float32(label))
         return feature
+
+
+# --------------------------------------------------------------------- #
+# detection (roi) augmentations  ≙ transform/vision/image/label/roi     #
+# --------------------------------------------------------------------- #
+def _rois(feature):
+    return np.asarray(feature[ImageFeature.BOUNDING_BOX], np.float32)
+
+
+def _set_rois(feature, rois, keep=None):
+    feature[ImageFeature.BOUNDING_BOX] = np.asarray(rois, np.float32)
+    if keep is not None:
+        label = feature.get(ImageFeature.LABEL)
+        if isinstance(label, np.ndarray) and label.shape[:1] == keep.shape:
+            feature[ImageFeature.LABEL] = label[keep]
+    return feature
+
+
+class RoiNormalize(FeatureTransformer):
+    """Normalize rois (x1,y1,x2,y2 pixels) to [0, 1]
+    (≙ roi/RoiNormalize.scala)."""
+
+    def transform(self, feature):
+        h, w = feature.image.shape[:2]
+        scale = np.array([w, h, w, h], np.float32)
+        return _set_rois(feature, _rois(feature) / scale)
+
+
+class RoiHFlip(FeatureTransformer):
+    """Horizontally flip rois; pair with HFlip on the image
+    (≙ roi/RoiHFlip.scala)."""
+
+    def __init__(self, normalized=True):
+        self.normalized = normalized
+
+    def transform(self, feature):
+        rois = _rois(feature)
+        width = 1.0 if self.normalized else feature.image.shape[1]
+        flipped = rois.copy()
+        flipped[:, 0] = width - rois[:, 2]
+        flipped[:, 2] = width - rois[:, 0]
+        return _set_rois(feature, flipped)
+
+
+class RoiResize(FeatureTransformer):
+    """Rescale pixel rois after an image resize, using the recorded
+    originalSize -> current size ratio (≙ roi/RoiResize.scala).
+    Normalized rois are resize-invariant, so this is a no-op for them."""
+
+    def __init__(self, normalized=True):
+        self.normalized = normalized
+
+    def transform(self, feature):
+        if self.normalized:
+            return feature
+        oh, ow = feature[ImageFeature.ORIGINAL_SIZE][:2]
+        h, w = feature.image.shape[:2]
+        scale = np.array([w / ow, h / oh, w / ow, h / oh], np.float32)
+        return _set_rois(feature, _rois(feature) * scale)
+
+
+class RoiProject(FeatureTransformer):
+    """Clip normalized rois to the image window [0,1], dropping boxes that
+    fall outside — or whose center is outside when
+    ``need_meet_center_constraint`` (≙ roi/RoiProject.scala)."""
+
+    def __init__(self, need_meet_center_constraint=True):
+        self.need_meet_center_constraint = need_meet_center_constraint
+
+    def transform(self, feature):
+        rois = _rois(feature)
+        if self.need_meet_center_constraint:
+            cx = (rois[:, 0] + rois[:, 2]) / 2
+            cy = (rois[:, 1] + rois[:, 3]) / 2
+            keep = (cx >= 0) & (cx <= 1) & (cy >= 0) & (cy <= 1)
+        else:
+            keep = (rois[:, 2] > 0) & (rois[:, 0] < 1) \
+                & (rois[:, 3] > 0) & (rois[:, 1] < 1)
+        clipped = np.clip(rois[keep], 0.0, 1.0)
+        return _set_rois(feature, clipped, keep=keep)
+
+
+def _project_rois_to_window(rois, x1, y1, x2, y2):
+    """Re-express normalized rois in a normalized crop window's frame."""
+    w, h = max(x2 - x1, 1e-6), max(y2 - y1, 1e-6)
+    out = rois.copy()
+    out[:, 0] = (rois[:, 0] - x1) / w
+    out[:, 2] = (rois[:, 2] - x1) / w
+    out[:, 1] = (rois[:, 1] - y1) / h
+    out[:, 3] = (rois[:, 3] - y1) / h
+    return out
+
+
+class DetectionCrop(FeatureTransformer):
+    """Crop the image to a detection stored at ``roi_key`` ((x1,y1,x2,y2),
+    normalized by default) and project rois into the crop
+    (≙ DetectionCrop.scala)."""
+
+    def __init__(self, roi_key, normalized=True):
+        self.roi_key = roi_key
+        self.normalized = normalized
+
+    def transform(self, feature):
+        h, w = feature.image.shape[:2]
+        roi = np.asarray(feature[self.roi_key], np.float32).reshape(-1)[:4]
+        if not self.normalized:
+            roi = roi / np.array([w, h, w, h], np.float32)
+        x1, y1, x2, y2 = np.clip(roi, 0.0, 1.0)
+        # degenerate/out-of-image detections clamp to a 1px valid window
+        px1 = min(int(x1 * w), w - 1)
+        py1 = min(int(y1 * h), h - 1)
+        px2 = min(max(int(x2 * w), px1 + 1), w)
+        py2 = min(max(int(y2 * h), py1 + 1), h)
+        feature.image = feature.image[py1:py2, px1:px2]
+        if ImageFeature.BOUNDING_BOX in feature:
+            rois = _project_rois_to_window(_rois(feature), x1, y1, x2, y2)
+            _set_rois(feature, rois)
+        return feature
+
+
+class RandomSampler(FeatureTransformer):
+    """SSD training crop sampler (≙ RandomSampler.scala): pick a random
+    min-IoU constraint from {none, .1, .3, .5, .7, .9, full}; sample up to
+    ``max_trials`` crops (scale in [0.3, 1], aspect in [0.5, 2]) until one
+    satisfies it w.r.t. the ground-truth rois; crop, project rois into the
+    window, and drop boxes whose center left the crop."""
+
+    MIN_IOUS = (None, 0.1, 0.3, 0.5, 0.7, 0.9, "all")
+
+    def __init__(self, max_trials=50, seed=None):
+        self.max_trials = max_trials
+        self._rng = np.random.RandomState(seed)
+
+    @staticmethod
+    def _iou(rois, window):
+        x1 = np.maximum(rois[:, 0], window[0])
+        y1 = np.maximum(rois[:, 1], window[1])
+        x2 = np.minimum(rois[:, 2], window[2])
+        y2 = np.minimum(rois[:, 3], window[3])
+        inter = np.clip(x2 - x1, 0, None) * np.clip(y2 - y1, 0, None)
+        area_r = (rois[:, 2] - rois[:, 0]) * (rois[:, 3] - rois[:, 1])
+        area_w = (window[2] - window[0]) * (window[3] - window[1])
+        return inter / np.maximum(area_r + area_w - inter, 1e-12)
+
+    def transform(self, feature):
+        choice = self.MIN_IOUS[self._rng.randint(len(self.MIN_IOUS))]
+        if choice == "all":
+            return feature
+        rois = _rois(feature) if ImageFeature.BOUNDING_BOX in feature \
+            else np.zeros((0, 4), np.float32)
+        for _ in range(self.max_trials):
+            scale = self._rng.uniform(0.3, 1.0)
+            ratio = self._rng.uniform(max(0.5, scale * scale),
+                                      min(2.0, 1.0 / (scale * scale)))
+            cw = scale * np.sqrt(ratio)
+            ch = scale / np.sqrt(ratio)
+            if cw > 1.0 or ch > 1.0:
+                continue
+            cx1 = self._rng.uniform(0, 1.0 - cw)
+            cy1 = self._rng.uniform(0, 1.0 - ch)
+            window = (cx1, cy1, cx1 + cw, cy1 + ch)
+            if choice is not None and len(rois) \
+                    and self._iou(rois, window).max() < choice:
+                continue
+            crop = DetectionCrop("_sampler_roi")
+            feature["_sampler_roi"] = np.array(window, np.float32)
+            feature = crop.transform(feature)
+            del feature["_sampler_roi"]
+            if ImageFeature.BOUNDING_BOX in feature:
+                feature = RoiProject(True).transform(feature)
+            return feature
+        return feature
+
+
+class RandomAspectScale(FeatureTransformer):
+    """Aspect-preserving resize with the shorter side drawn from
+    ``scales``; the longer side is capped at ``max_size`` and both dims
+    rounded down to multiples of ``scale_multiple_of``
+    (≙ RandomAspectScale.scala)."""
+
+    def __init__(self, scales, scale_multiple_of=1, max_size=1000,
+                 seed=None):
+        self.scales = list(scales)
+        self.scale_multiple_of = scale_multiple_of
+        self.max_size = max_size
+        self._rng = np.random.RandomState(seed)
+
+    def transform(self, feature):
+        h, w = feature.image.shape[:2]
+        target = self.scales[self._rng.randint(len(self.scales))]
+        scale = target / min(h, w)
+        if scale * max(h, w) > self.max_size:
+            scale = self.max_size / max(h, w)
+        nh, nw = int(h * scale), int(w * scale)
+        m = self.scale_multiple_of
+        nh, nw = max(nh // m * m, m), max(nw // m * m, m)
+        feature.image = _resize_bilinear(feature.image, nh, nw)
+        return feature
+
+
+# --------------------------------------------------------------------- #
+# byte decoders + pyspark-name aliases                                  #
+# --------------------------------------------------------------------- #
+class BytesToMat(FeatureTransformer):
+    """Decode an encoded image byte string at ``byte_key`` into the float
+    HWC image (≙ BytesToMat.scala; PIL replaces OpenCV)."""
+
+    def __init__(self, byte_key=ImageFeature.BYTES):
+        self.byte_key = byte_key
+
+    def transform(self, feature):
+        import io
+        from PIL import Image
+        img = Image.open(io.BytesIO(feature[self.byte_key])).convert("RGB")
+        arr = np.asarray(img, np.float32)[:, :, ::-1]    # BGR convention
+        feature.image = arr
+        feature[ImageFeature.ORIGINAL_SIZE] = tuple(arr.shape)
+        return feature
+
+
+class PixelBytesToMat(FeatureTransformer):
+    """Raw HWC uint8 pixel bytes -> float image, using the recorded
+    originalSize (≙ PixelBytesToMat.scala)."""
+
+    def __init__(self, byte_key=ImageFeature.BYTES):
+        self.byte_key = byte_key
+
+    def transform(self, feature):
+        shape = feature[ImageFeature.ORIGINAL_SIZE]
+        arr = np.frombuffer(feature[self.byte_key],
+                            np.uint8).reshape(shape)
+        feature.image = arr.astype(np.float32)
+        return feature
+
+
+class MatToFloats(FeatureTransformer):
+    """Ensure the image is a float32 HWC array at ``out_key``; invalid /
+    missing images become zeros of the valid_* dims
+    (≙ MatToFloats.scala)."""
+
+    def __init__(self, valid_height=300, valid_width=300, valid_channel=3,
+                 out_key=ImageFeature.IMAGE):
+        self.valid = (valid_height, valid_width, valid_channel)
+        self.out_key = out_key
+
+    def transform(self, feature):
+        img = feature.get(ImageFeature.IMAGE)
+        if img is None or np.size(img) == 0:
+            img = np.zeros(self.valid, np.float32)
+        feature[self.out_key] = np.asarray(img, np.float32)
+        return feature
+
+
+class Pipeline(ChainedFeatureTransformer):
+    """pyspark spelling: Pipeline([t1, t2, ...])."""
+
+    def __init__(self, transformers):
+        super().__init__(*transformers)
+
+
+# name-compat aliases (pyspark transform/vision/image.py spellings; the
+# *Vision suffix avoids clashing with data.image's batch-pipeline ops)
+HFlip = HFlipVision
+ColorJitter = ColorJitterVision
+PixelNormalize = PixelNormalizer
+LocalImageFrame = ImageFrame
+
+
+class DistributedImageFrame(ImageFrame):
+    """Single-process stand-in for the Spark-RDD variant: same API; on a
+    mesh the DataSet layer shards features by dp rank."""

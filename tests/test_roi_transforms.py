@@ -1,0 +1,157 @@
+"""Detection (roi) vision transforms — hand-computed numerics
+(≙ transform/vision/image/label/roi/*.scala + RandomSampler/DetectionCrop
+specs)."""
+import numpy as np
+import pytest
+
+from bigdl_tpu.data.imageframe import (ImageFeature, RoiNormalize, RoiHFlip,
+                                       RoiResize, RoiProject, DetectionCrop,
+                                       RandomSampler, RandomAspectScale,
+                                       BytesToMat, PixelBytesToMat,
+                                       MatToFloats, Pipeline)
+
+
+def feat(h=10, w=20, rois=None, labels=None):
+    f = ImageFeature(image=np.arange(h * w * 3, dtype=np.float32)
+                     .reshape(h, w, 3))
+    if rois is not None:
+        f[ImageFeature.BOUNDING_BOX] = np.asarray(rois, np.float32)
+    if labels is not None:
+        f[ImageFeature.LABEL] = np.asarray(labels, np.float32)
+    return f
+
+
+def test_roi_normalize():
+    f = feat(rois=[[2.0, 1.0, 10.0, 5.0]])
+    out = RoiNormalize()(f)
+    np.testing.assert_allclose(out[ImageFeature.BOUNDING_BOX],
+                               [[0.1, 0.1, 0.5, 0.5]])
+
+
+def test_roi_hflip_normalized():
+    f = feat(rois=[[0.1, 0.2, 0.4, 0.6]])
+    out = RoiHFlip(normalized=True)(f)
+    np.testing.assert_allclose(out[ImageFeature.BOUNDING_BOX],
+                               [[0.6, 0.2, 0.9, 0.6]], rtol=1e-6)
+
+
+def test_roi_hflip_pixel():
+    f = feat(w=20, rois=[[2.0, 1.0, 10.0, 5.0]])
+    out = RoiHFlip(normalized=False)(f)
+    np.testing.assert_allclose(out[ImageFeature.BOUNDING_BOX],
+                               [[10.0, 1.0, 18.0, 5.0]])
+
+
+def test_roi_resize_pixel():
+    f = feat(h=10, w=20, rois=[[2.0, 1.0, 10.0, 5.0]])
+    f.image = np.zeros((20, 10, 3), np.float32)   # resized 2x h, 0.5x w
+    out = RoiResize(normalized=False)(f)
+    np.testing.assert_allclose(out[ImageFeature.BOUNDING_BOX],
+                               [[1.0, 2.0, 5.0, 10.0]])
+
+
+def test_roi_project_center_constraint_drops_and_labels_follow():
+    f = feat(rois=[[0.2, 0.2, 0.4, 0.4],      # center inside -> kept
+                   [-0.6, -0.6, -0.2, -0.2]],  # center outside -> dropped
+             labels=[1.0, 2.0])
+    out = RoiProject(True)(f)
+    np.testing.assert_allclose(out[ImageFeature.BOUNDING_BOX],
+                               [[0.2, 0.2, 0.4, 0.4]])
+    np.testing.assert_allclose(out[ImageFeature.LABEL], [1.0])
+
+
+def test_roi_project_clips_partials():
+    f = feat(rois=[[-0.1, 0.3, 0.5, 1.2]])    # center inside, clipped
+    out = RoiProject(True)(f)
+    np.testing.assert_allclose(out[ImageFeature.BOUNDING_BOX],
+                               [[0.0, 0.3, 0.5, 1.0]], rtol=1e-6)
+
+
+def test_detection_crop_projects_rois():
+    f = feat(h=10, w=20, rois=[[0.5, 0.5, 0.75, 0.75]])
+    f["det"] = np.array([0.5, 0.0, 1.0, 1.0], np.float32)  # right half
+    out = DetectionCrop("det")(f)
+    assert out.image.shape == (10, 10, 3)
+    np.testing.assert_allclose(out[ImageFeature.BOUNDING_BOX],
+                               [[0.0, 0.5, 0.5, 0.75]], rtol=1e-6)
+
+
+def test_random_sampler_invariants():
+    rois = [[0.3, 0.3, 0.6, 0.6], [0.7, 0.1, 0.9, 0.3]]
+    for seed in range(8):
+        f = feat(h=40, w=40, rois=rois, labels=[1.0, 2.0])
+        out = RandomSampler(seed=seed)(f)
+        b = out[ImageFeature.BOUNDING_BOX]
+        assert b.ndim == 2 and b.shape[1] == 4
+        assert np.all(b >= -1e-6) and np.all(b <= 1 + 1e-6)
+        assert np.all(b[:, 2] >= b[:, 0]) and np.all(b[:, 3] >= b[:, 1])
+        lab = out[ImageFeature.LABEL]
+        assert len(lab) == len(b)        # labels track surviving boxes
+        assert out.image.ndim == 3 and out.image.size > 0
+
+
+def test_random_aspect_scale():
+    f = feat(h=40, w=80)
+    out = RandomAspectScale([20], scale_multiple_of=4, max_size=1000,
+                            seed=0)(f)
+    # shorter side 40 -> 20, so 40x80 -> 20x40 (already multiples of 4)
+    assert out.image.shape == (20, 40, 3)
+
+
+def test_pixel_bytes_to_mat_roundtrip():
+    arr = np.arange(2 * 3 * 3, dtype=np.uint8).reshape(2, 3, 3)
+    f = ImageFeature()
+    f[ImageFeature.ORIGINAL_SIZE] = (2, 3, 3)
+    f[ImageFeature.BYTES] = arr.tobytes()
+    out = PixelBytesToMat()(f)
+    np.testing.assert_array_equal(out.image, arr.astype(np.float32))
+
+
+def test_bytes_to_mat_decodes_png():
+    from PIL import Image
+    import io
+    rgb = np.zeros((4, 5, 3), np.uint8)
+    rgb[..., 0] = 200     # red image
+    buf = io.BytesIO()
+    Image.fromarray(rgb).save(buf, format="PNG")
+    f = ImageFeature()
+    f[ImageFeature.BYTES] = buf.getvalue()
+    out = BytesToMat()(f)
+    assert out.image.shape == (4, 5, 3)
+    # stored BGR: red ends up in channel 2
+    assert float(out.image[..., 2].mean()) == 200.0
+    assert float(out.image[..., 0].mean()) == 0.0
+
+
+def test_mat_to_floats_fallback_and_pipeline():
+    f = ImageFeature()
+    out = Pipeline([MatToFloats(valid_height=5, valid_width=6,
+                                valid_channel=3)])(f)
+    assert out.image.shape == (5, 6, 3)
+    assert out.image.dtype == np.float32
+
+
+def test_detection_crop_degenerate_roi_stays_finite():
+    """A detection entirely outside the image clamps to a 1px window and
+    keeps rois finite (no div-by-zero infs)."""
+    f = feat(h=10, w=20, rois=[[0.1, 0.1, 0.5, 0.5]])
+    f["det"] = np.array([1.2, 0.2, 1.5, 0.6], np.float32)
+    out = DetectionCrop("det")(f)
+    assert out.image.size > 0
+    assert np.all(np.isfinite(out[ImageFeature.BOUNDING_BOX]))
+
+
+def test_new_transforms_exported_from_data_package():
+    import bigdl_tpu.data as D
+    for n in ("RoiNormalize", "RoiHFlip", "RoiResize", "RoiProject",
+              "DetectionCrop", "RandomSampler", "RandomAspectScale",
+              "BytesToMat", "PixelBytesToMat", "MatToFloats", "Pipeline",
+              "LocalImageFrame", "DistributedImageFrame"):
+        assert hasattr(D, n), n
+
+
+def test_mat_to_floats_replaces_empty_image():
+    f = ImageFeature()
+    f[ImageFeature.IMAGE] = np.zeros((0, 0, 3), np.float32)
+    out = MatToFloats(valid_height=5, valid_width=6, valid_channel=3)(f)
+    assert out.image.shape == (5, 6, 3)
