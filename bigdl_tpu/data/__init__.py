@@ -22,7 +22,8 @@ from .imageframe import (ImageFeature, ImageFrame, FeatureTransformer,
                          RoiResize, RoiProject, DetectionCrop,
                          RandomSampler, RandomAspectScale, BytesToMat,
                          PixelBytesToMat, MatToFloats, Pipeline,
-                         LocalImageFrame, DistributedImageFrame)
+                         LocalImageFrame, DistributedImageFrame,
+                         FixExpand, SeqFileFolder)
 from .text import (LabeledSentence, SentenceSplitter, SentenceTokenizer,
                    SentenceBiPadding, Dictionary, TextToLabeledSentence,
                    LabeledSentenceToSample, read_localfile, sentences_split,

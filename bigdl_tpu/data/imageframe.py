@@ -871,3 +871,71 @@ LocalImageFrame = ImageFrame
 class DistributedImageFrame(ImageFrame):
     """Single-process stand-in for the Spark-RDD variant: same API; on a
     mesh the DataSet layer shards features by dp rank."""
+
+
+class FixExpand(FeatureTransformer):
+    """Expand the canvas to (expand_height, expand_width), centering the
+    original image on zeros (≙ FixExpand.scala)."""
+
+    def __init__(self, expand_height, expand_width):
+        self.eh, self.ew = int(expand_height), int(expand_width)
+
+    def transform(self, feature):
+        img = feature.image
+        h, w, c = img.shape
+        if self.eh < h or self.ew < w:
+            raise ValueError(f"FixExpand target ({self.eh},{self.ew}) is "
+                             f"smaller than the image ({h},{w})")
+        out = np.zeros((self.eh, self.ew, c), img.dtype)
+        y0 = (self.eh - h) // 2
+        x0 = (self.ew - w) // 2
+        out[y0:y0 + h, x0:x0 + w] = img
+        feature.image = out
+        return feature
+
+
+class SeqFileFolder:
+    """Read Hadoop SequenceFile image shards into an ImageFrame
+    (≙ SeqFileFolder.scala files_to_image_frame; utils/seqfile.py does
+    the wire format)."""
+
+    @classmethod
+    def files_to_image_frame(cls, url, class_num=None):
+        import glob
+        import math
+        import os
+        from ..utils.seqfile import SequenceFileReader
+        feats = []
+        if os.path.isdir(url):
+            paths = sorted(set(glob.glob(os.path.join(url, "*.seq"))
+                               + glob.glob(os.path.join(url, "part-*"))))
+            if not paths:
+                raise FileNotFoundError(
+                    f"{url}: no *.seq or part-* SequenceFile shards found")
+        else:
+            paths = [url]
+        for p in paths:
+            for key, value in SequenceFileReader(p):
+                f = ImageFeature()
+                f[ImageFeature.URI] = key.decode("utf-8", "replace") \
+                    if isinstance(key, bytes) else str(key)
+                f[ImageFeature.BYTES] = value
+                # reference imagenet shards encode "<label>\n<uri>" keys:
+                # the LEADING token is the label when numeric and finite
+                tokens = f[ImageFeature.URI].replace("\n", " ").split()
+                if tokens:
+                    try:
+                        label = float(tokens[0])
+                        if math.isfinite(label):
+                            if class_num is not None and not \
+                                    1 <= label <= class_num:
+                                raise ValueError(
+                                    f"{p}: label {label} outside "
+                                    f"[1, {class_num}] for key "
+                                    f"{f[ImageFeature.URI]!r}")
+                            f[ImageFeature.LABEL] = label
+                    except ValueError as e:
+                        if "outside" in str(e):
+                            raise
+                feats.append(f)
+        return ImageFrame(feats)

@@ -155,3 +155,53 @@ def test_mat_to_floats_replaces_empty_image():
     f[ImageFeature.IMAGE] = np.zeros((0, 0, 3), np.float32)
     out = MatToFloats(valid_height=5, valid_width=6, valid_channel=3)(f)
     assert out.image.shape == (5, 6, 3)
+
+
+def test_fix_expand_centers():
+    from bigdl_tpu.data.imageframe import FixExpand
+    f = feat(h=4, w=6)
+    img = f.image.copy()
+    out = FixExpand(8, 10)(f)
+    assert out.image.shape == (8, 10, 3)
+    np.testing.assert_array_equal(out.image[2:6, 2:8], img)
+    assert float(out.image[0].sum()) == 0.0
+    with pytest.raises(ValueError, match="smaller"):
+        FixExpand(2, 2)(feat(h=4, w=6))
+
+
+def test_seqfile_folder_to_image_frame(tmp_path):
+    import io
+    from PIL import Image
+    from bigdl_tpu.utils.seqfile import SequenceFileWriter
+    from bigdl_tpu.data.imageframe import (SeqFileFolder, BytesToMat,
+                                           ImageFeature)
+    p = str(tmp_path / "part-0.seq")
+    w = SequenceFileWriter(p)
+    for i in range(3):
+        rgb = np.full((5, 7, 3), 40 * i, np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(rgb).save(buf, format="PNG")
+        w.append(f"{i + 1}\nimg_{i}".encode(), buf.getvalue())
+    w.close()
+    frame = SeqFileFolder.files_to_image_frame(str(tmp_path))
+    assert len(frame.features) == 3
+    frame = frame.transform(BytesToMat())
+    for i, f in enumerate(frame.features):
+        assert f[ImageFeature.LABEL] == i + 1
+        assert f.image.shape == (5, 7, 3)
+
+
+def test_seqfile_folder_errors(tmp_path):
+    from bigdl_tpu.data.imageframe import SeqFileFolder
+    from bigdl_tpu.utils.seqfile import SequenceFileWriter
+    with pytest.raises(FileNotFoundError, match="shards"):
+        SeqFileFolder.files_to_image_frame(str(tmp_path))
+    p = str(tmp_path / "part-00000")       # hadoop naming, no extension
+    w = SequenceFileWriter(p)
+    w.append(b"3\nimg_a", b"\x00")
+    w.close()
+    frame = SeqFileFolder.files_to_image_frame(str(tmp_path))
+    assert len(frame.features) == 1
+    assert frame.features[0]["label"] == 3.0
+    with pytest.raises(ValueError, match="outside"):
+        SeqFileFolder.files_to_image_frame(str(tmp_path), class_num=2)
