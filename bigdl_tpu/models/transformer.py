@@ -79,7 +79,17 @@ def apply_rope(x, positions, theta: float = 10000.0):
 
 
 class TokenEmbedding(Module):
-    """0-based token embedding, vocab-sharded over tp (P('tp', None))."""
+    """0-based token embedding, vocab-sharded over tp (P('tp', None)).
+
+    NOTE: do NOT switch this to the d_model layout (P(None, 'tp'))
+    without re-validating trainer parity.  It silences GSPMD's
+    involuntary-rematerialization warnings for the embedding gradient,
+    but on the virtual CPU mesh the combination {embed d_model-sharded,
+    attn tp-sharded, batch dp x fsdp-sharded} makes the partitioned
+    FORWARD compute a measurably different loss (6.0741 vs 6.0859 on the
+    tiny preset) — a value-changing partitioner interaction, caught by
+    tests/test_parallel.py::test_spmd_trainer_parallel_matches_single.
+    """
 
     def __init__(self, vocab_size, d_model, name=None):
         super().__init__(name=name)

@@ -308,7 +308,8 @@ def _all_exported_modules():
             continue
         obj = getattr(nn, name)
         if isinstance(obj, type) and issubclass(obj, M) \
-                and not issubclass(obj, C):
+                and not issubclass(obj, C) \
+                and obj.__name__ == name:   # skip pyspark-name aliases
             out.append(name)
     return out
 
