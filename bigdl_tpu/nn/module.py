@@ -294,6 +294,20 @@ class Module:
     def zero_grad_parameters(self):
         self.grad_params = None
 
+    def update_parameters(self, learning_rate):
+        """One manual SGD step from the Torch shell's accumulated
+        grad_params; frozen modules stay untouched
+        (≙ Layer.update_parameters)."""
+        if self.grad_params is None:
+            raise ValueError("no accumulated gradients; call backward first")
+        frozen = self.frozen_param_names()
+        self._params = {
+            name: (sub if name in frozen else jax.tree_util.tree_map(
+                lambda p, g: p - learning_rate * g, sub,
+                self.grad_params[name]))
+            for name, sub in self._params.items()}
+        return self
+
     def get_parameters(self):
         """Return (params, grad_params) flat dicts (≙ reference getParameters)."""
         self.ensure_initialized()
@@ -306,6 +320,179 @@ class Module:
         self._params = params
         if state is not None:
             self._state = state
+        return self
+
+    # -- pyspark Layer-method parity (bigdl/nn/layer.py) ---------------- #
+    def get_weights(self):
+        """Flat list of this model's weight arrays, module-traversal order
+        with per-module keys sorted (≙ Layer.get_weights)."""
+        self.ensure_initialized()
+        out = []
+        for m in self.modules():
+            sub = self._params.get(m.name)
+            if sub:
+                for k in sorted(sub):
+                    out.append(np.asarray(sub[k]))
+        return out
+
+    def set_weights(self, weights):
+        """Inverse of :meth:`get_weights`; shapes are validated."""
+        self.ensure_initialized()
+        ws = list(weights)
+        new = dict(self._params)
+        i = 0
+        for m in self.modules():
+            sub = self._params.get(m.name)
+            if not sub:
+                continue
+            cur = {}
+            for k in sorted(sub):
+                if i >= len(ws):
+                    raise ValueError(
+                        f"set_weights: {len(ws)} arrays given, more needed "
+                        f"(stopped at {m.name}.{k})")
+                arr = jnp.asarray(ws[i])
+                i += 1
+                if tuple(arr.shape) != tuple(np.shape(sub[k])):
+                    raise ValueError(
+                        f"set_weights: {m.name}.{k} expects "
+                        f"{np.shape(sub[k])}, got {arr.shape}")
+                cur[k] = arr
+            new[m.name] = cur
+        if i != len(ws):
+            raise ValueError(f"set_weights: {len(ws)} arrays given, "
+                             f"only {i} consumed")
+        self._params = new
+        return self
+
+    def parameters(self):
+        """{module_name: {param_name: ndarray}} (≙ Layer.parameters)."""
+        self.ensure_initialized()
+        return {name: {k: np.asarray(v) for k, v in sub.items()}
+                for name, sub in self._params.items()}
+
+    def freeze(self, names=None):
+        """Mark this module — or the named submodules — non-trainable;
+        training drivers zero their gradients (≙ Layer.freeze, the
+        fine-tuning workflow).  Per-layer regularizers are masked with
+        the gradients; an OptimMethod-level ``weight_decay`` still
+        applies to every parameter, so prefer layer regularizers when
+        freezing."""
+        if names is None:
+            for m in self.modules():
+                m._frozen = True
+        else:
+            wanted = set(names)
+            hit = set()
+            for m in self.modules():
+                if m.name in wanted:
+                    hit.add(m.name)
+                    for sub in m.modules():
+                        sub._frozen = True
+            missing = wanted - hit
+            if missing:
+                raise ValueError(f"freeze: no submodule named {missing}")
+        return self
+
+    def unfreeze(self, names=None):
+        """Undo :meth:`freeze` (≙ Layer.unfreeze)."""
+        if names is None:
+            for m in self.modules():
+                m._frozen = False
+        else:
+            for m in self.modules():
+                if m.name in set(names):
+                    for sub in m.modules():
+                        sub._frozen = False
+        return self
+
+    def frozen_param_names(self):
+        """Names of modules whose params must not update."""
+        return {m.name for m in self.modules()
+                if getattr(m, "_frozen", False)}
+
+    def quantize(self):
+        """Post-training int8 rewrite (≙ Layer.quantize)."""
+        from ..quantized import quantize as _q
+        return _q(self)
+
+    def _predictor(self, batch_size):
+        # one long-lived Predictor per batch size: its jitted eval step
+        # must be reused across predict calls, not recompiled each time
+        cache = getattr(self, "_predictors", None)
+        if cache is None:
+            cache = self._predictors = {}
+        if batch_size not in cache:
+            from ..optim.predictor import Predictor
+            cache[batch_size] = Predictor(self, batch_size=batch_size)
+        return cache[batch_size]
+
+    def predict(self, x, batch_size=128):
+        """Batched jitted inference (≙ Layer.predict_local)."""
+        return self._predictor(batch_size).predict(x)
+
+    def predict_class(self, x, batch_size=128):
+        """1-based class predictions (≙ Layer.predict_class)."""
+        return self._predictor(batch_size).predict_class(x)
+
+    def saveModel(self, path, over_write=True):          # noqa: N802
+        """pyspark spelling of :meth:`save`."""
+        return self.save(path, overwrite=over_write)
+
+    def save_caffe(self, prototxt_path, model_path, **kw):
+        """≙ Layer.save_caffe (utils/caffe.save_caffe)."""
+        from ..utils.caffe import save_caffe as _sc
+        return _sc(self, prototxt_path, model_path, **kw)
+
+    def save_tensorflow(self, path, input_shape, **kw):
+        """≙ Layer.save_tensorflow (utils/tf_import.save_tf_graph)."""
+        from ..utils.tf_import import save_tf_graph as _stf
+        return _stf(self, path, input_shape, **kw)
+
+    def set_running_mean(self, mean):
+        """Overwrite this module's BN running mean (≙ Layer.set_running_mean).
+        For a BN layer inside a container, call
+        ``model.set_running_stats(bn_name, mean=...)`` on the model that
+        owns the state instead."""
+        return self._set_running(self.name, "running_mean", mean)
+
+    def set_running_std(self, std):
+        """Overwrite this module's BN running variance
+        (≙ Layer.set_running_std; the reference stores variance).  See
+        :meth:`set_running_mean` for layers inside containers."""
+        return self._set_running(self.name, "running_var", std)
+
+    def set_running_stats(self, module_name, mean=None, std=None):
+        """Overwrite a named submodule's BN running statistics in THIS
+        model's state (the container owns its children's state — calling
+        set_running_mean on the child would touch a private copy)."""
+        if mean is not None:
+            self._set_running(module_name, "running_mean", mean)
+        if std is not None:
+            self._set_running(module_name, "running_var", std)
+        return self
+
+    def _set_running(self, module_name, key, value):
+        if self._state is None and module_name != self.name:
+            raise ValueError(
+                "model state not initialized; run forward/init first")
+        self.ensure_initialized()
+        own = self._state.get(module_name)
+        if not isinstance(own, dict) or key not in own:
+            if module_name == self.name:
+                raise ValueError(
+                    f"{type(self).__name__} has no {key} state (not a "
+                    "batch-normalization layer, or inside a container — "
+                    "use model.set_running_stats(name, ...) there)")
+            raise ValueError(f"no submodule state {module_name!r} with "
+                             f"{key} in this model")
+        value = jnp.asarray(value)
+        if value.shape != own[key].shape:
+            raise ValueError(f"{key} expects shape {own[key].shape}, "
+                             f"got {value.shape}")
+        new_state = dict(self._state)
+        new_state[module_name] = dict(own, **{key: value})
+        self._state = new_state
         return self
 
     def training(self):

@@ -42,7 +42,7 @@ from ..parallel import mesh as mesh_lib
 from ..parallel.allreduce import (allreduce_gradients,
                                   reduce_scatter_gradients, allgather_params)
 from .optimizer import (Optimizer, _mb_to_arrays, _ClippedOptim,
-                        make_accum_grads)
+                        make_accum_grads, mask_frozen_grads)
 from .trigger import Trigger
 
 
@@ -95,6 +95,7 @@ class DistriOptimizer(Optimizer):
                 rng = jax.random.fold_in(rng, lax.axis_index("dp"))
                 (loss, upd), grads = local_grads(params, model_state,
                                                  x, y, rng)
+                grads = mask_frozen_grads(model, grads)
                 grads = allreduce_gradients(grads, "dp", compress=compress)
                 new_params, new_opt = optim.update(grads, params, opt_state)
                 merged = dict(model_state)
@@ -129,6 +130,7 @@ class DistriOptimizer(Optimizer):
             rng = jax.random.fold_in(rng, lax.axis_index("dp"))
             full = gather(params_sh)
             (loss, upd), grads = local_grads(full, model_state, x, y, rng)
+            grads = mask_frozen_grads(model, grads)
             g_sh = scatter_grads(grads)
             new_params_sh, new_opt = optim.update(g_sh, params_sh, opt_state)
             merged = dict(model_state)

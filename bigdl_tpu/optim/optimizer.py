@@ -78,6 +78,17 @@ class Metrics:
         return jax.profiler.TraceAnnotation(name)
 
 
+def mask_frozen_grads(model: Module, grads):
+    """Zero gradients of modules frozen via Module.freeze (evaluated at
+    step-build time, so the compiled program bakes the mask in)."""
+    frozen = model.frozen_param_names()
+    if not frozen:
+        return grads
+    return {name: (jax.tree_util.tree_map(jnp.zeros_like, sub)
+                   if name in frozen else sub)
+            for name, sub in grads.items()}
+
+
 def make_train_step(model: Module, criterion, optim_method: OptimMethod,
                     mixed_precision=False, extra_loss_fn=None):
     """Build the pure fused train step; caller jits (and shard_maps) it."""
@@ -105,6 +116,7 @@ def make_train_step(model: Module, criterion, optim_method: OptimMethod,
 
         (loss, state_updates), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
+        grads = mask_frozen_grads(model, grads)
         new_params, new_opt_state = optim_method.update(grads, params,
                                                         opt_state)
         merged = dict(model_state)
@@ -228,6 +240,7 @@ def make_accum_train_step(model: Module, criterion,
         reg_loss = model.regularization_loss(params)
         reg_grads = jax.grad(model.regularization_loss)(params)
         grads = jax.tree_util.tree_map(jnp.add, grads, reg_grads)
+        grads = mask_frozen_grads(model, grads)
         new_params, new_opt_state = optim_method.update(grads, params,
                                                         opt_state)
         return new_params, new_opt_state, merged, mean_loss + reg_loss

@@ -120,6 +120,11 @@ class PipelineLMTrainer:
     """
 
     def __init__(self, model, optim, mesh, n_microbatches=4, seed=0):
+        if model.frozen_param_names():
+            raise NotImplementedError(
+                "Module.freeze is not supported by PipelineLMTrainer "
+                "(block params are stacked per stage, losing per-module "
+                "identity); unfreeze or use SpmdTrainer")
         cfg = model.cfg
         if cfg.dropout:
             raise ValueError("PipelineLMTrainer requires dropout=0.0")

@@ -162,8 +162,11 @@ class SpmdTrainer:
             lambda p, s, t, y, r: (loss_fn(p, t, y, r), s), n_accum,
             weight_fn=lambda t, y: (y != -1).sum())
 
+        from ..optim.optimizer import mask_frozen_grads
+
         def step(params, opt_state, tokens, targets, rng):
             (loss, _), grads = grads_fn(params, {}, tokens, targets, rng)
+            grads = mask_frozen_grads(model, grads)
             new_params, new_opt = optim.update(grads, params, opt_state)
             return new_params, new_opt, loss
 

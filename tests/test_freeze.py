@@ -1,0 +1,170 @@
+"""Module.freeze/unfreeze + pyspark Layer-method parity
+(≙ bigdl/nn/layer.py: freeze, get/set_weights, parameters,
+update_parameters, quantize, predict)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+
+
+def _model():
+    return nn.Sequential(nn.Linear(6, 8, name="enc"), nn.ReLU(),
+                         nn.Linear(8, 1, name="head"))
+
+
+def _data(n=64):
+    rs = np.random.RandomState(0)
+    return rs.randn(n, 6).astype(np.float32), rs.randn(n, 1).astype(np.float32)
+
+
+def test_freeze_blocks_updates_and_unfreeze_restores():
+    x, y = _data()
+    m = _model()
+    m.ensure_initialized()
+    w_enc0 = np.asarray(m._params["enc"]["weight"]).copy()
+    w_head0 = np.asarray(m._params["head"]["weight"]).copy()
+    m.freeze(["enc"])
+    opt = (LocalOptimizer(m, (x, y), nn.MSECriterion(), batch_size=32)
+           .set_optim_method(SGD(learning_rate=0.1))
+           .set_end_when(Trigger.max_epoch(2)))
+    opt.optimize()
+    np.testing.assert_array_equal(np.asarray(m._params["enc"]["weight"]),
+                                  w_enc0)          # frozen: untouched
+    assert not np.allclose(np.asarray(m._params["head"]["weight"]),
+                           w_head0)                # trainable: moved
+    m.unfreeze()
+    opt2 = (LocalOptimizer(m, (x, y), nn.MSECriterion(), batch_size=32)
+            .set_optim_method(SGD(learning_rate=0.1))
+            .set_end_when(Trigger.max_epoch(3)))
+    opt2.optimize()
+    assert not np.allclose(np.asarray(m._params["enc"]["weight"]), w_enc0)
+
+
+def test_freeze_on_distri():
+    from bigdl_tpu.parallel import mesh as mesh_lib
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+    x, y = _data()
+    m = _model()
+    m.ensure_initialized()
+    w0 = np.asarray(m._params["enc"]["weight"]).copy()
+    m.freeze(["enc"])
+    mesh = mesh_lib.create_mesh({"dp": 8})
+    opt = (DistriOptimizer(m, (x, y), nn.MSECriterion(), batch_size=64,
+                           mesh=mesh)
+           .set_optim_method(SGD(learning_rate=0.1))
+           .set_end_when(Trigger.max_iteration(2)))
+    opt.optimize()
+    np.testing.assert_array_equal(np.asarray(m._params["enc"]["weight"]), w0)
+
+
+def test_freeze_unknown_name_raises():
+    with pytest.raises(ValueError, match="no submodule"):
+        _model().freeze(["nope"])
+
+
+def test_get_set_weights_roundtrip():
+    m = _model()
+    ws = m.get_weights()
+    assert all(isinstance(w, np.ndarray) for w in ws)
+    m2 = _model()
+    m2.set_weights(ws)
+    x, _ = _data(4)
+    np.testing.assert_allclose(np.asarray(m.forward(x)),
+                               np.asarray(m2.forward(x)), rtol=1e-6)
+    with pytest.raises(ValueError, match="expects"):
+        m2.set_weights([np.zeros((2, 2))] * len(ws))
+    with pytest.raises(ValueError, match="consumed|needed"):
+        m2.set_weights(ws + [np.zeros(3)])
+
+
+def test_parameters_and_update_parameters():
+    m = _model()
+    x, y = _data(8)
+    p = m.parameters()
+    assert "enc" in p and "weight" in p["enc"]
+    out = m.forward(x)
+    m.backward(x, np.ones_like(np.asarray(out)))
+    before = np.asarray(m._params["head"]["weight"]).copy()
+    m.update_parameters(0.1)
+    assert not np.allclose(np.asarray(m._params["head"]["weight"]), before)
+
+
+def test_module_quantize_and_predict():
+    m = nn.Sequential(nn.Linear(6, 16), nn.ReLU(), nn.Linear(16, 4),
+                      nn.LogSoftMax())
+    x, _ = _data(8)
+    q = m.quantize()
+    assert np.asarray(q.forward(x)).shape == (8, 4)
+    cls = m.predict_class(x)
+    assert np.asarray(cls).shape == (8,)
+    assert np.all((np.asarray(cls) >= 1) & (np.asarray(cls) <= 4))
+
+
+def test_set_running_mean_std():
+    bn = nn.BatchNormalization(5)
+    bn.set_running_mean(np.ones(5, np.float32))
+    bn.set_running_std(np.full(5, 2.0, np.float32))
+    with pytest.raises(ValueError, match="shape"):
+        bn.set_running_mean(np.ones(3))
+    with pytest.raises(ValueError, match="batch-normalization"):
+        nn.Linear(2, 2).set_running_mean(np.ones(2))
+
+
+def test_freeze_on_spmd_trainer():
+    from bigdl_tpu.models import transformer as T
+    from bigdl_tpu.parallel import mesh as mesh_lib
+    from bigdl_tpu.parallel.spmd import SpmdTrainer
+
+    mesh = mesh_lib.create_mesh({"dp": 8})
+    model = T.build("tiny", dropout=0.0)
+    model.freeze([model.embed.name])
+    tr = SpmdTrainer(model, SGD(learning_rate=0.1), mesh=mesh,
+                     fsdp=False).init()
+    w0 = np.asarray(tr.params[model.embed.name]["weight"]).copy()
+    rs = np.random.RandomState(0)
+    tok = rs.randint(0, 256, (8, 33))
+    tr.step(tok[:, :-1], tok[:, 1:])
+    tr.detach()
+    np.testing.assert_array_equal(
+        np.asarray(tr.params[model.embed.name]["weight"]), w0)
+
+
+def test_freeze_rejected_on_pipeline_trainer():
+    from bigdl_tpu.models import transformer as T
+    from bigdl_tpu.parallel import mesh as mesh_lib
+    from bigdl_tpu.parallel.pipeline import PipelineLMTrainer
+
+    mesh = mesh_lib.create_mesh({"pp": 2})
+    model = T.build("tiny", dropout=0.0)
+    model.freeze([model.embed.name])
+    with pytest.raises(NotImplementedError, match="freeze"):
+        PipelineLMTrainer(model, SGD(learning_rate=0.1), mesh)
+
+
+def test_update_parameters_respects_freeze():
+    m = _model()
+    x, y = _data(8)
+    m.freeze(["enc"])
+    out = m.forward(x)
+    m.backward(x, np.ones_like(np.asarray(out)))
+    enc0 = np.asarray(m._params["enc"]["weight"]).copy()
+    head0 = np.asarray(m._params["head"]["weight"]).copy()
+    m.update_parameters(0.1)
+    np.testing.assert_array_equal(np.asarray(m._params["enc"]["weight"]),
+                                  enc0)
+    assert not np.allclose(np.asarray(m._params["head"]["weight"]), head0)
+
+
+def test_set_running_stats_on_container():
+    m = nn.Sequential(nn.Linear(4, 5), nn.BatchNormalization(5, name="bn"))
+    x = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    m.training(); m.forward(x); m.evaluate()
+    m.set_running_stats("bn", mean=np.zeros(5, np.float32),
+                        std=np.ones(5, np.float32))
+    np.testing.assert_array_equal(np.asarray(m._state["bn"]["running_mean"]),
+                                  np.zeros(5))
+    with pytest.raises(ValueError, match="no submodule state"):
+        m.set_running_stats("nope", mean=np.zeros(5))
