@@ -340,12 +340,16 @@ class TransformerLM(Module):
         return logits.astype(jnp.float32)
 
     # -- generation (kv cache) ----------------------------------------- #
-    def init_cache(self, batch: int, dtype=None):
+    def init_cache(self, batch: int, dtype=None, cache_len=None):
         """Static-length kv cache, one entry per block, keyed by the
-        attention module's name (so caches survive pytree transforms)."""
+        attention module's name (so caches survive pytree transforms).
+        ``cache_len`` defaults to max_len; generate() sizes it to
+        prompt+new so each decode step attends over exactly the tokens
+        that can exist, not the full context window."""
         cfg = self.cfg
         dt = jnp.dtype(dtype or cfg.dtype)
-        shape = (batch, cfg.n_heads, cfg.max_len, cfg.head_dim)
+        shape = (batch, cfg.n_heads, int(cache_len or cfg.max_len),
+                 cfg.head_dim)
         return {blk.attn.name: {"k": jnp.zeros(shape, dt),
                                 "v": jnp.zeros(shape, dt)}
                 for blk in self.blocks}
@@ -425,7 +429,8 @@ class TransformerLM(Module):
 
         @jax.jit
         def run(params, prompt, rng):
-            cache = self.init_cache(b)
+            cache = self.init_cache(
+                b, cache_len=s0 + max_new_tokens)
             logits, cache = self.apply_with_cache(params, prompt, cache, 0)
             key0, key = (jax.random.split(rng) if rng is not None
                          else (None, None))
@@ -489,7 +494,8 @@ class TransformerLM(Module):
 
         @jax.jit
         def run(params, prompt):
-            cache = self.init_cache(b)
+            cache = self.init_cache(
+                b, cache_len=s0 + max_new_tokens)
             logits, cache = self.apply_with_cache(params, prompt, cache, 0)
             logp0 = jax.nn.log_softmax(logits[:, -1], axis=-1)   # (B, V)
             V = logp0.shape[-1]
