@@ -598,15 +598,22 @@ class TransformerLM(Module):
         return specs
 
 
-def lm_cross_entropy(logits, targets, ignore_index: int = -1):
-    """Mean token cross-entropy. logits (B, S, V) fp32, targets (B, S) int."""
+def lm_token_nll(logits, targets, ignore_index: int = -1):
+    """(sum of masked token NLLs, valid-token count) — the shared core of
+    training and evaluation losses."""
     logits = logits.astype(jnp.float32)
     lse = jax.scipy.special.logsumexp(logits, axis=-1)
     tgt = jnp.clip(targets, 0, logits.shape[-1] - 1)
     gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
     nll = lse - gold
     mask = (targets != ignore_index).astype(jnp.float32)
-    return (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return (nll * mask).sum(), mask.sum()
+
+
+def lm_cross_entropy(logits, targets, ignore_index: int = -1):
+    """Mean token cross-entropy. logits (B, S, V) fp32, targets (B, S) int."""
+    total, count = lm_token_nll(logits, targets, ignore_index)
+    return total / jnp.maximum(count, 1.0)
 
 
 PRESETS = {

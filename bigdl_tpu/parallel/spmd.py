@@ -190,6 +190,45 @@ class SpmdTrainer:
         self._step_count += 1
         return loss
 
+    def evaluate(self, batches, steps: Optional[int] = None):
+        """Token-weighted mean cross-entropy and perplexity over
+        ``batches`` of (tokens, targets), computed with the same mesh
+        placement as training (dropout off).  ≙ Evaluator/Loss validation
+        for the flagship path."""
+        import itertools
+        if self.params is None:
+            self.init()
+        self.attach()
+        model = self.model
+        if getattr(self, "_eval_fn", None) is None:
+            from ..models.transformer import lm_token_nll
+
+            def eval_fn(params, tokens, targets):
+                from ..nn.module import Ctx
+                ctx = Ctx(state={}, training=False, rng_key=None)
+                logits = model.apply(params, tokens, ctx)
+                return lm_token_nll(logits, targets)
+            self._eval_fn = jax.jit(eval_fn)
+        sh = self._batch_sharding()
+        if steps is not None:   # islice: never pull an extra batch from a
+            batches = itertools.islice(batches, steps)  # shared iterator
+        sums, counts = [], []
+        for tokens, targets in batches:
+            tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), sh)
+            targets = jax.device_put(jnp.asarray(targets, jnp.int32), sh)
+            s, c = self._eval_fn(self.params, tokens, targets)
+            sums.append(s)      # device values: no per-batch host sync
+            counts.append(c)
+        total = float(sum(sums)) if sums else 0.0
+        count = float(sum(counts)) if counts else 0.0
+        if count == 0:
+            raise ValueError(
+                "evaluate: no valid tokens (empty batches, or every "
+                "target is ignore_index)")
+        loss = total / count
+        return {"loss": loss, "perplexity": float(np.exp(min(loss, 50.0))),
+                "tokens": int(count)}
+
     # -- checkpointing --------------------------------------------------- #
     def save_checkpoint(self, path: str):
         """Write params + optimizer state + step counter as an orbax

@@ -244,3 +244,64 @@ def test_spmd_trainer_fit_checkpoints(tmp_path):
     snaps = sorted(d for d in os.listdir(str(tmp_path / "ck"))
                    if d.startswith("step_"))
     assert snaps == ["step_4", "step_6"], snaps   # keep=2 pruned step_2
+
+
+def test_spmd_trainer_evaluate():
+    """evaluate() returns the exact token-weighted masked cross entropy
+    (cross-checked against lm_cross_entropy on the concatenated data)."""
+    from bigdl_tpu.models import transformer as T
+    from bigdl_tpu.models.transformer import lm_cross_entropy
+    from bigdl_tpu.parallel import mesh as mesh_lib
+    from bigdl_tpu.parallel.spmd import SpmdTrainer
+    from bigdl_tpu.optim import SGD
+
+    mesh = mesh_lib.create_mesh({"dp": 8})
+    model = T.build("tiny", dropout=0.0)
+    tr = SpmdTrainer(model, SGD(learning_rate=0.1), mesh=mesh,
+                     fsdp=False).init()
+    rs = np.random.RandomState(0)
+    batches = []
+    for i in range(3):
+        tok = rs.randint(0, 256, (8, 33))
+        tgt = tok[:, 1:].copy()
+        if i == 1:
+            tgt[:4, 10:] = -1                  # uneven padding
+        batches.append((tok[:, :-1], tgt))
+    res = tr.evaluate(batches)
+    tr.detach()
+
+    # reference: token-weighted mean over all batches at once
+    tot, cnt = 0.0, 0.0
+    for x, y in batches:
+        logits, _ = model.run(tr.params, jnp.asarray(x), training=False)
+        mask = (np.asarray(y) != -1)
+        loss = float(lm_cross_entropy(logits, jnp.asarray(y)))
+        tot += loss * mask.sum()
+        cnt += mask.sum()
+    want = tot / cnt
+    assert abs(res["loss"] - want) < 1e-4, (res["loss"], want)
+    assert res["tokens"] == int(cnt)
+    assert abs(res["perplexity"] - np.exp(res["loss"])) < 1e-2
+
+
+def test_spmd_trainer_evaluate_guards():
+    from bigdl_tpu.models import transformer as T
+    from bigdl_tpu.parallel import mesh as mesh_lib
+    from bigdl_tpu.parallel.spmd import SpmdTrainer
+    from bigdl_tpu.optim import SGD
+
+    mesh = mesh_lib.create_mesh({"dp": 8})
+    tr = SpmdTrainer(T.build("tiny", dropout=0.0), SGD(learning_rate=0.1),
+                     mesh=mesh, fsdp=False).init()
+    with pytest.raises(ValueError, match="no valid tokens"):
+        tr.evaluate([])
+    # steps=N must not pull batch N+1 from a shared iterator
+    rs = np.random.RandomState(0)
+    def gen():
+        for _ in range(3):
+            tok = rs.randint(0, 256, (8, 33))
+            yield tok[:, :-1], tok[:, 1:]
+    g = gen()
+    tr.evaluate(g, steps=2)
+    assert len(list(g)) == 1          # exactly one batch left
+    tr.detach()
