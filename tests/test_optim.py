@@ -175,3 +175,37 @@ def test_lars_trust_ratio_scales_per_tensor():
     step_b = float(jnp.abs(new["b"]["weight"] - 1.0).max())
     # normalized steps should be comparable despite the 1e4 gradient gap
     assert abs(step_a - step_b) / max(step_a, step_b) < 0.01
+
+
+def test_optim_method_save_load(tmp_path):
+    """OptimMethod.save/load (≙ reference OptimMethod persistence):
+    hyperparameters and LR schedules survive, updates match."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu.optim import SGD, Adam, OptimMethod
+    from bigdl_tpu.optim.lr_schedule import Step
+
+    m = SGD(learning_rate=0.05, momentum=0.9, weight_decay=1e-4,
+            learning_rate_schedule=Step(10, 0.5))
+    p = str(tmp_path / "sgd.bin")
+    m.save(p)
+    m2 = OptimMethod.load(p)
+    assert type(m2) is SGD
+    params = {"w": jnp.ones((3,))}
+    grads = {"w": jnp.full((3,), 0.1)}
+    p1, _ = m.update(grads, params, m.init_state(params))
+    p2, _ = m2.update(grads, params, m2.init_state(params))
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]))
+
+    a = Adam(learning_rate=1e-3, beta1=0.8)
+    pa = str(tmp_path / "adam.bin")
+    a.save(pa)
+    a2 = OptimMethod.load(pa)
+    assert type(a2) is Adam and a2.beta1 == 0.8
+    with pytest.raises(FileExistsError):
+        a.save(pa, overwrite=False)
+    with pytest.raises(ValueError, match="not an OptimMethod"):
+        from bigdl_tpu.utils.serializer import save_state_file
+        bad = str(tmp_path / "bad.bin")
+        save_state_file({"other": 1}, bad)
+        OptimMethod.load(bad)
