@@ -317,10 +317,11 @@ def _device_liveness_probe(timeout_s=180):
 
 
 def _flush_headline_and_exit(rc):
+    # print the headline (driver parses the last line) but PRESERVE the
+    # non-zero exit code: a wedged/partial run must not read as clean
     import os
     if _HEADLINE:
         print(json.dumps(_HEADLINE), flush=True)
-        os._exit(0)
     os._exit(rc)
 
 
@@ -342,7 +343,8 @@ def _deadline_watchdog(seconds):
 
 def main():
     import os
-    _device_liveness_probe()
+    _device_liveness_probe(float(os.environ.get("BENCH_PROBE_TIMEOUT_S",
+                                                180)))
     _deadline_watchdog(float(os.environ.get("BENCH_DEADLINE_S", 2700)))
     names = sys.argv[1:] or list(CONFIGS)
     unknown = [n for n in names if n not in CONFIGS]

@@ -323,15 +323,28 @@ class Module:
         return self
 
     # -- pyspark Layer-method parity (bigdl/nn/layer.py) ---------------- #
+    @staticmethod
+    def _weights_order(sub):
+        """Per-module key order for get/set_weights: weight* first, bias*
+        second, the rest alphabetically — matching the reference
+        Layer.get_weights [weight, bias] convention."""
+        def rank(k):
+            if k.startswith("weight"):
+                return (0, k)
+            if k.startswith("bias"):
+                return (1, k)
+            return (2, k)
+        return sorted(sub, key=rank)
+
     def get_weights(self):
         """Flat list of this model's weight arrays, module-traversal order
-        with per-module keys sorted (≙ Layer.get_weights)."""
+        with per-module keys weight-first (≙ Layer.get_weights)."""
         self.ensure_initialized()
         out = []
         for m in self.modules():
             sub = self._params.get(m.name)
             if sub:
-                for k in sorted(sub):
+                for k in self._weights_order(sub):
                     out.append(np.asarray(sub[k]))
         return out
 
@@ -346,7 +359,7 @@ class Module:
             if not sub:
                 continue
             cur = {}
-            for k in sorted(sub):
+            for k in self._weights_order(sub):
                 if i >= len(ws):
                     raise ValueError(
                         f"set_weights: {len(ws)} arrays given, more needed "

@@ -40,10 +40,41 @@ from ..parallel._compat import shard_map
 from ..nn.module import Ctx
 from ..parallel import mesh as mesh_lib
 from ..parallel.allreduce import (allreduce_gradients,
-                                  reduce_scatter_gradients, allgather_params)
+                                  reduce_scatter_gradients, allgather_params,
+                                  shardable_mask_dim0)
 from .optimizer import (Optimizer, _mb_to_arrays, _ClippedOptim,
                         make_accum_grads, mask_frozen_grads)
 from .trigger import Trigger
+
+
+def fsdp_opt_state_specs(params_template, shardable, optim):
+    """PartitionSpecs for an OptimMethod's state under FSDP.
+
+    Optimizer-state moment trees mirror the param tree structure (every
+    OptimMethod stores them as ``{"m": <params-shaped tree>, …}``), so
+    shardings are derived by TREE-PATH correspondence: an opt-state leaf
+    whose path suffix names an existing param (and matches its shape)
+    inherits that param's spec; everything else (step counters, scalars,
+    non-moment buffers) stays replicated.  Matching on (shape, dtype)
+    alone would wrongly dim-0-shard state belonging to a replicated
+    param that happens to share shape+dtype with a sharded one.
+    """
+    opt_state_template = jax.eval_shape(optim.init_state, params_template)
+    p_paths, _ = jax.tree_util.tree_flatten_with_path(params_template)
+    s_flat = jax.tree_util.tree_leaves(shardable)
+    by_path = {tuple(path): (tuple(leaf.shape), bool(s))
+               for (path, leaf), s in zip(p_paths, s_flat)}
+
+    def spec_for_opt_leaf(path, leaf):
+        shape = tuple(getattr(leaf, "shape", ()))
+        for i in range(len(path)):
+            hit = by_path.get(tuple(path[i:]))
+            if hit is not None and hit[0] == shape:
+                return P("dp") if hit[1] else P()
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for_opt_leaf,
+                                            opt_state_template)
 
 
 class DistriOptimizer(Optimizer):
@@ -110,28 +141,14 @@ class DistriOptimizer(Optimizer):
                 donate_argnums=(0, 1, 2)), None
 
         # ---- FSDP: params sharded on dim 0 where divisible -------------- #
-        shardable = jax.tree_util.tree_map(
-            lambda p: p.ndim > 0 and p.shape[0] % n_dp == 0, params_template)
-
-        def gather(p_sharded):
-            return jax.tree_util.tree_map(
-                lambda p, s: lax.all_gather(p, "dp", axis=0, tiled=True)
-                if s else p, p_sharded, shardable)
-
-        def scatter_grads(grads):
-            def rs(g, s):
-                if s:
-                    return lax.psum_scatter(g, "dp", scatter_dimension=0,
-                                            tiled=True) / n_dp
-                return lax.pmean(g, "dp")
-            return jax.tree_util.tree_map(rs, grads, shardable)
+        shardable = shardable_mask_dim0(params_template, n_dp)
 
         def step(params_sh, opt_state, model_state, x, y, rng):
             rng = jax.random.fold_in(rng, lax.axis_index("dp"))
-            full = gather(params_sh)
+            full = allgather_params(params_sh, "dp", mask=shardable)
             (loss, upd), grads = local_grads(full, model_state, x, y, rng)
             grads = mask_frozen_grads(model, grads)
-            g_sh = scatter_grads(grads)
+            g_sh = reduce_scatter_gradients(grads, "dp", mask=shardable)
             new_params_sh, new_opt = optim.update(g_sh, params_sh, opt_state)
             merged = dict(model_state)
             merged.update(upd)
@@ -141,23 +158,7 @@ class DistriOptimizer(Optimizer):
         p_specs = jax.tree_util.tree_map(
             lambda s: P("dp") if s else P(), shardable,
             is_leaf=lambda v: isinstance(v, bool))
-        # Optimizer-state leaves (moments etc.) mirror the param sharding:
-        # any leaf whose global (shape, dtype) matches a shardable param's is
-        # sharded on dim 0; scalars (step counters) stay replicated.
-        opt_state_template = jax.eval_shape(optim.init_state, params_template)
-        sharded_shapes = set()
-        for p, s in zip(jax.tree_util.tree_leaves(params_template),
-                        jax.tree_util.tree_leaves(shardable)):
-            if s:
-                sharded_shapes.add((tuple(p.shape), str(p.dtype)))
-
-        def spec_for_opt_leaf(leaf):
-            if hasattr(leaf, "shape") and \
-                    (tuple(leaf.shape), str(leaf.dtype)) in sharded_shapes:
-                return P("dp")
-            return P()
-
-        o_specs = jax.tree_util.tree_map(spec_for_opt_leaf, opt_state_template)
+        o_specs = fsdp_opt_state_specs(params_template, shardable, optim)
         specs_in = (p_specs, o_specs, P(), P("dp"), P("dp"), P())
         specs_out = (p_specs, o_specs, P(), P())
         return jax.jit(
@@ -178,8 +179,7 @@ class DistriOptimizer(Optimizer):
                 # gradients inside shard_map are dim-0 shards: the L2 norm
                 # must psum shard contributions to be global & consistent
                 n_dp = self.mesh.shape["dp"]
-                mask = jax.tree_util.tree_map(
-                    lambda p: p.ndim > 0 and p.shape[0] % n_dp == 0, params)
+                mask = shardable_mask_dim0(params, n_dp)
                 optim = _ClippedOptim(optim, self._grad_clip_norm,
                                       self._grad_clip_const, sum_axis="dp",
                                       sharded_mask=mask)
@@ -198,14 +198,11 @@ class DistriOptimizer(Optimizer):
     def _layout_params(self, params):
         if not self.fsdp:
             return params
-        n_dp = self.mesh.shape["dp"]
-
-        def shard_put(p):
-            if p.ndim > 0 and p.shape[0] % n_dp == 0:
-                return jax.device_put(p, NamedSharding(self.mesh, P("dp")))
-            return jax.device_put(p, NamedSharding(self.mesh, P()))
-
-        return jax.tree_util.tree_map(shard_put, params)
+        mask = shardable_mask_dim0(params, self.mesh.shape["dp"])
+        return jax.tree_util.tree_map(
+            lambda p, s: jax.device_put(
+                p, NamedSharding(self.mesh, P("dp") if s else P())),
+            params, mask)
 
     def _place_batch(self, x, y):
         sharding = NamedSharding(self.mesh, P("dp"))

@@ -3,7 +3,7 @@
 from .mesh import (create_mesh, get_mesh, set_mesh, data_sharding,
                    replicated, shard_batch, init_distributed)
 from .allreduce import (allreduce_gradients, reduce_scatter_gradients,
-                        allgather_params)
+                        allgather_params, shardable_mask_dim0)
 from .ring_attention import ring_attention, ring_attention_shmap
 from .pipeline import pipeline_run, pipelined
 from .spmd import SpmdTrainer

@@ -46,36 +46,48 @@ def allreduce_gradients(grads, axis_name: str = "dp",
         lambda g, d: g.astype(d), reduced, orig_dtypes)
 
 
-def reduce_scatter_gradients(grads, axis_name: str = "dp", mean: bool = True):
-    """Each shard keeps 1/N of every gradient leaf (scatter dim 0) — the FSDP
-    half of the partitioned parameter server."""
+def reduce_scatter_gradients(grads, axis_name: str = "dp", mean: bool = True,
+                             mask=None):
+    """Each shard keeps 1/N of every sharded gradient leaf (scatter dim 0)
+    — the FSDP half of the partitioned parameter server.  ``mask`` (a
+    params-shaped tree of bools, e.g. from :func:`shardable_mask_dim0`)
+    marks which leaves are dim-0-sharded; without it, any leaf whose
+    dim 0 divides the axis size is scattered.  Unsharded leaves are
+    all-reduced instead.  Call inside shard_map with FULL-shape grads."""
     n = lax.axis_size(axis_name)
 
-    def rs(g):
-        if g.ndim == 0 or g.shape[0] % n != 0:
+    def rs(g, s=None):
+        sharded = (g.ndim > 0 and g.shape[0] % n == 0) if s is None else s
+        if not sharded:
             return lax.pmean(g, axis_name) if mean else lax.psum(g, axis_name)
         out = lax.psum_scatter(g, axis_name, scatter_dimension=0,
                                tiled=True)
         return out / n if mean else out
 
-    return jax.tree_util.tree_map(rs, grads)
+    if mask is None:
+        return jax.tree_util.tree_map(rs, grads)
+    return jax.tree_util.tree_map(rs, grads, mask)
 
 
-def allgather_params(params, axis_name: str = "dp", full_shapes=None):
-    """Rebuild full parameters from dim-0 shards (the getWeights fetch)."""
-    def ag(p, full_shape=None):
-        if p.ndim == 0:
+def allgather_params(params, axis_name: str = "dp", mask=None):
+    """Rebuild full parameters from dim-0 shards (the getWeights fetch).
+    ``mask`` marks which leaves are actually sharded (replicated leaves
+    must NOT be gathered — that would tile N copies); without a mask any
+    non-scalar leaf is gathered."""
+    def ag(p, s=None):
+        if p.ndim == 0 or (s is not None and not s):
             return p
         return lax.all_gather(p, axis_name, axis=0, tiled=True)
 
-    if full_shapes is None:
+    if mask is None:
         return jax.tree_util.tree_map(ag, params)
-    return jax.tree_util.tree_map(ag, params, full_shapes)
+    return jax.tree_util.tree_map(ag, params, mask)
 
 
-def shard_leaf_dim0(tree, n):
-    """Host-side: split each leaf's dim 0 into n shards (leaves whose dim 0
-    is not divisible stay replicated). Used to set up FSDP param layout."""
+def shardable_mask_dim0(tree, n):
+    """Bool mask over ``tree``: True where a leaf's dim 0 is divisible by
+    ``n`` (those leaves get dim-0-sharded for FSDP; the rest stay
+    replicated).  Computed host-side from GLOBAL shapes."""
     def mark(p):
         return p.ndim > 0 and p.shape[0] % n == 0
     return jax.tree_util.tree_map(mark, tree)

@@ -307,13 +307,19 @@ class SpmdTrainer:
                 f"{path}: checkpoint tree does not match this trainer's "
                 "model (after root-name normalisation)")
 
+        def dt(a):
+            # dtype without materializing the leaf: np.asarray on a live
+            # sharded template forces a device-to-host copy (and raises on
+            # non-fully-addressable multi-host arrays)
+            d = getattr(a, "dtype", None)
+            return np.dtype(d) if d is not None else np.asarray(a).dtype
+
         def check(v, t, where):
-            if tuple(np.shape(v)) != tuple(np.shape(t)) or \
-                    np.asarray(v).dtype != np.asarray(t).dtype:
+            if tuple(np.shape(v)) != tuple(np.shape(t)) or dt(v) != dt(t):
                 raise ValueError(
                     f"{path}: leaf {jax.tree_util.keystr(where)} is "
-                    f"{np.shape(v)}/{np.asarray(v).dtype}, model expects "
-                    f"{np.shape(t)}/{np.asarray(t).dtype}")
+                    f"{np.shape(v)}/{dt(v)}, model expects "
+                    f"{np.shape(t)}/{dt(t)}")
             return v
 
         raw = jax.tree_util.tree_map_with_path(

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import io
 import json
+import os
 import zipfile
 
 import jax
@@ -435,14 +436,22 @@ def load_module(path):
 
 
 def _write_payload_zip(path, fmt, payload_name, payload, arrays):
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
-        z.writestr("manifest.json",
-                   json.dumps({"format": fmt, "version": VERSION}))
-        z.writestr(payload_name, json.dumps(payload))
-        for key, arr in arrays.items():
-            buf = io.BytesIO()
-            np.save(buf, arr, allow_pickle=False)
-            z.writestr(key, buf.getvalue())
+    # tmp + os.replace: a crash mid-write must never corrupt a
+    # pre-existing file being overwritten (same contract as utils/file.save)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("manifest.json",
+                       json.dumps({"format": fmt, "version": VERSION}))
+            z.writestr(payload_name, json.dumps(payload))
+            for key, arr in arrays.items():
+                buf = io.BytesIO()
+                np.save(buf, arr, allow_pickle=False)
+                z.writestr(key, buf.getvalue())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
 
 
 def _read_payload_zip(path, fmt, payload_name, desc, build):

@@ -80,6 +80,16 @@ def test_get_set_weights_roundtrip():
         m2.set_weights(ws + [np.zeros(3)])
 
 
+def test_get_weights_weight_first_order():
+    """Reference pyspark Layer.get_weights returns [weight, bias] per
+    module — weight FIRST, not alphabetical (ADVICE r2)."""
+    from bigdl_tpu import nn
+    m = nn.Linear(3, 4)
+    m.ensure_initialized()
+    ws = m.get_weights()
+    assert [w.shape for w in ws] == [(4, 3), (4,)]   # weight then bias
+
+
 def test_parameters_and_update_parameters():
     m = _model()
     x, y = _data(8)
