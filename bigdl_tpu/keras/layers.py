@@ -30,7 +30,10 @@ def _act_module(name, size_hint=None):
         return N.Identity()
     table = {
         "relu": N.ReLU, "tanh": N.Tanh, "sigmoid": N.Sigmoid,
-        "softmax": N.SoftMax, "softplus": N.SoftPlus,
+        # Keras softmax semantics: last-dim, so batched (N, T, C)
+        # sequence outputs normalize per step (nn.SoftMax's default is
+        # the reference's spatial channel-dim convention instead)
+        "softmax": lambda: N.SoftMax(axis=-1), "softplus": N.SoftPlus,
         "softsign": N.SoftSign, "hard_sigmoid": N.HardSigmoid,
         "gelu": N.GELU, "silu": N.SiLU, "elu": N.ELU,
         "log_softmax": N.LogSoftMax,
@@ -381,7 +384,9 @@ class SReLU(KerasLayer):
 
 class SoftMax(KerasLayer):
     def _build(self, input_shape):
-        return N.SoftMax()
+        # Keras semantics: normalize the last dim (nn.SoftMax's default
+        # is the reference's spatial channel-dim convention)
+        return N.SoftMax(axis=-1)
 
 
 def _has_kw(cls, kw):

@@ -79,17 +79,27 @@ def apply_rope(x, positions, theta: float = 10000.0):
 
 
 class TokenEmbedding(Module):
-    """0-based token embedding, vocab-sharded over tp (P('tp', None)).
+    """0-based token embedding, vocab-sharded over tp (P('tp', None))
+    and EXEMPT from fsdp layering (fsdp_exempt) — the weight is
+    replicated over 'fsdp', sharded only over 'tp'.
 
-    NOTE: do NOT switch this to the d_model layout (P(None, 'tp'))
-    without re-validating trainer parity.  It silences GSPMD's
-    involuntary-rematerialization warnings for the embedding gradient,
-    but on the virtual CPU mesh the combination {embed d_model-sharded,
-    attn tp-sharded, batch dp x fsdp-sharded} makes the partitioned
-    FORWARD compute a measurably different loss (6.0741 vs 6.0859 on the
-    tiny preset) — a value-changing partitioner interaction, caught by
-    tests/test_parallel.py::test_spmd_trainer_parallel_matches_single.
+    Root cause (round 3, closing NOTES item 2): when the table is
+    sharded over TWO mesh axes on a 3-axis (dp, fsdp, tp) mesh and the
+    batch is dp×fsdp-sharded, the GSPMD partitioner MISCOMPILES the
+    gather + residual-matmul pattern — `take(w, ids) + take(w, ids) @ wo`
+    alone computes values off by O(1) in fp32 (jax 0.9.0 CPU backend;
+    checked-in repro: tests/test_partitioner_repro.py, which fails with
+    an update-me message if a newer jax fixes it).  This is why the
+    earlier d_model layout P(None,'tp') (which became P('fsdp','tp')
+    under fsdp layering) changed the partitioned forward's loss
+    (6.0741 vs 6.0859 on the tiny preset).  Keeping the table out of
+    fsdp ALSO removes both "Involuntary full rematerialization" GSPMD
+    warnings: the cotangent reshard no longer needs a mesh-axis
+    transpose, and training-step parity is exact
+    (tests/test_parallel.py::test_spmd_trainer_parallel_matches_single).
     """
+
+    fsdp_exempt = True
 
     def __init__(self, vocab_size, d_model, name=None):
         super().__init__(name=name)

@@ -141,17 +141,42 @@ class SReLU(Module):
         return jnp.where(y <= tl, tl + al * (y - tl), y)
 
 
+def _softmax_axis(ndim):
+    """nn/SoftMax.scala:39 updateOutput: 1D/2D normalize the last dim;
+    3D (C,H,W) and 4D (N,C,H,W) normalize the CHANNEL dim per spatial
+    position (stride = H*W)."""
+    if ndim == 3:
+        return 0
+    if ndim == 4:
+        return 1
+    return -1
+
+
 class SoftMax(Module):
-    """Softmax over the last dim for 1D/2D input (nn/SoftMax.scala)."""
+    """Softmax; channel-wise for spatial (3D/4D) input by default
+    (nn/SoftMax.scala).  Pass ``axis`` to override — e.g. the keras
+    softmax activation uses axis=-1 so batched (N, T, C) sequence
+    outputs normalize per step, not reference-3D-style over dim 0."""
+
+    def __init__(self, axis=None, name=None):
+        super().__init__(name=name)
+        self.axis = axis
 
     def apply(self, params, x, ctx):
-        return jax.nn.softmax(x, axis=-1)
+        ax = self.axis if self.axis is not None else _softmax_axis(x.ndim)
+        return jax.nn.softmax(x, axis=ax)
 
 
 class SoftMin(Module):
     """softmax(-x) (nn/SoftMin.scala)."""
+
+    def __init__(self, axis=None, name=None):
+        super().__init__(name=name)
+        self.axis = axis
+
     def apply(self, params, x, ctx):
-        return jax.nn.softmax(-x, axis=-1)
+        ax = self.axis if self.axis is not None else _softmax_axis(x.ndim)
+        return jax.nn.softmax(-x, axis=ax)
 
 
 class LogSoftMax(Module):

@@ -90,12 +90,20 @@ class SpmdTrainer:
     # ------------------------------------------------------------------ #
     def _param_shardings(self, params):
         specs = self.model.param_pspecs(params)
+        by_name = {m.name: m for m in self.model.modules()}
         out = {}
         for mod, sub in params.items():
+            # modules may opt out of fsdp layering (fsdp_exempt=True):
+            # the token embedding must, because layering 'fsdp' onto its
+            # free dim makes the gather+residual pattern miscompile on
+            # the GSPMD partitioner AND costs two involuntary-full-remat
+            # reshards of its cotangent — see TokenEmbedding's note and
+            # tests/test_partitioner_repro.py
+            exempt = getattr(by_name.get(mod), "fsdp_exempt", False)
             out[mod] = {}
             for k, p in sub.items():
                 spec = _filter_spec(specs[mod][k], self.mesh)
-                if self.fsdp:
+                if self.fsdp and not exempt:
                     spec = _add_fsdp(spec, p.shape, self.mesh,
                                      self.min_fsdp_size)
                 out[mod][k] = NamedSharding(self.mesh, spec)

@@ -152,6 +152,22 @@ class QuantizedSpatialConvolution(QuantizedModule):
         return out
 
 
+class QuantizedSpatialDilatedConvolution(QuantizedSpatialConvolution):
+    """int8 dilated conv with int32 accumulation
+    (≙ nn/quantized/SpatialDilatedConvolution.scala:30): same MXU int8
+    path as the plain conv with rhs_dilation set."""
+
+    @staticmethod
+    def from_float(layer, params=None) \
+            -> "QuantizedSpatialDilatedConvolution":
+        p = params if params is not None \
+            else layer.ensure_initialized()[layer.name]
+        return QuantizedSpatialDilatedConvolution(
+            np.asarray(p["weight"]), p.get("bias"), stride=layer.stride,
+            padding=layer.pad, dilation=layer.dilation,
+            name=f"{layer.name}_q")
+
+
 _QUANTIZABLE = {}
 
 
@@ -159,6 +175,8 @@ def _register_defaults():
     _QUANTIZABLE[linear_mod.Linear] = QuantizedLinear.from_float
     _QUANTIZABLE[conv_mod.SpatialConvolution] = \
         QuantizedSpatialConvolution.from_float
+    _QUANTIZABLE[conv_mod.SpatialDilatedConvolution] = \
+        QuantizedSpatialDilatedConvolution.from_float
 
 
 _register_defaults()

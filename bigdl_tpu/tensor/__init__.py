@@ -160,6 +160,77 @@ class SparseTensor:
         lead = self.indices[:-1]
         return jnp.sum(lead * jnp.asarray(strides)[:, None], axis=0)
 
+    # -- the reference SparseTensor's implemented surface ---------------- #
+    # (tensor/SparseTensor.scala: most Tensor methods throw Unsupported-
+    #  Operation there too; the ones below are the ones it actually has)
+    def astype(self, dtype) -> "SparseTensor":
+        return SparseTensor(self.indices, self.values.astype(dtype),
+                            self.shape)
+
+    def apply1(self, fn) -> "SparseTensor":
+        """Elementwise map over STORED values (zeros stay zero), jit-safe
+        (tensor/SparseTensor.scala apply1)."""
+        return SparseTensor(self.indices, fn(self.values), self.shape)
+
+    def __mul__(self, scalar):
+        return SparseTensor(self.indices, self.values * scalar, self.shape)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, scalar):
+        return SparseTensor(self.indices, self.values / scalar, self.shape)
+
+    def __neg__(self):
+        return SparseTensor(self.indices, -self.values, self.shape)
+
+    def abs(self) -> "SparseTensor":
+        return self.apply1(jnp.abs)
+
+    def sum(self):
+        return jnp.sum(self.values)
+
+    def num_nonzero_by_row(self):
+        """nnz count per leading-dim row
+        (tensor/SparseTensor.scala numNonZeroByRow)."""
+        return jax.ops.segment_sum(
+            jnp.ones((self.nnz,), jnp.int32), self.row_ids(),
+            num_segments=int(np.prod(self.shape[:-1])) if self.ndim > 1
+            else 1)
+
+    def transpose(self) -> "SparseTensor":
+        """2-D transpose: swap index rows (host/jit-safe; result indices
+        are no longer row-major sorted)."""
+        if self.ndim != 2:
+            raise ValueError("transpose needs a 2-D SparseTensor")
+        return SparseTensor(self.indices[::-1], self.values,
+                            self.shape[::-1])
+
+    t = transpose
+
+    def narrow(self, dim: int, index: int, size: int) -> "SparseTensor":
+        """1-based narrow along the LEADING dim — the one the reference
+        supports for mini-batch slicing (SparseTensor.scala:306).
+        Host-side (data-dependent nnz)."""
+        if dim != 1:
+            raise ValueError("SparseTensor.narrow supports dim=1 only "
+                             "(like the reference)")
+        lo = index - 1
+        idx = np.asarray(self.indices)
+        vals = np.asarray(self.values)
+        keep = (idx[0] >= lo) & (idx[0] < lo + size)
+        new_idx = idx[:, keep].copy()
+        new_idx[0] -= lo
+        return SparseTensor(new_idx, vals[keep],
+                            (size,) + self.shape[1:])
+
+    def select(self, dim: int, index: int) -> "SparseTensor":
+        """1-based row select dropping the leading dim (host-side)."""
+        if dim != 1 or self.ndim < 2:
+            raise ValueError("SparseTensor.select supports dim=1 on >=2-D")
+        sub = self.narrow(1, index, 1)
+        return SparseTensor(np.asarray(sub.indices)[1:], sub.values,
+                            self.shape[1:])
+
     def __repr__(self):
         return (f"SparseTensor(shape={self.shape}, nnz={int(self.nnz)}, "
                 f"dtype={self.values.dtype})")
@@ -206,21 +277,38 @@ def embedding_bag(weight, ids_sp: SparseTensor, per_id_weights=None,
 
 
 def sparse_concat(tensors, dim: int = 2):
-    """Concatenate 2-D SparseTensors along columns (1-based dim=2)
-    (tensor/SparseTensor.scala concat)."""
-    if dim != 2:
-        raise ValueError("sparse_concat supports dim=2 (columns)")
-    n_rows = tensors[0].shape[0]
-    col_off = 0
-    idx_parts, val_parts = [], []
-    for sp in tensors:
-        if sp.shape[0] != n_rows:
-            raise ValueError("row counts must match")
-        idx_parts.append(sp.indices.at[1].add(col_off))
-        val_parts.append(sp.values)
-        col_off += sp.shape[1]
-    return SparseTensor(jnp.concatenate(idx_parts, axis=1),
-                        jnp.concatenate(val_parts), (n_rows, col_off))
+    """Concatenate 2-D SparseTensors along rows (1-based dim=1) or
+    columns (dim=2) (tensor/SparseTensor.scala concat, both arities)."""
+    if dim == 2:
+        n_rows = tensors[0].shape[0]
+        col_off = 0
+        idx_parts, val_parts = [], []
+        for sp in tensors:
+            if sp.shape[0] != n_rows:
+                raise ValueError("row counts must match")
+            idx_parts.append(sp.indices.at[1].add(col_off))
+            val_parts.append(sp.values)
+            col_off += sp.shape[1]
+        return SparseTensor(jnp.concatenate(idx_parts, axis=1),
+                            jnp.concatenate(val_parts), (n_rows, col_off))
+    if dim == 1:
+        n_cols = tensors[0].shape[1]
+        row_off = 0
+        idx_parts, val_parts = [], []
+        for sp in tensors:
+            if sp.shape[1] != n_cols:
+                raise ValueError("column counts must match")
+            idx_parts.append(sp.indices.at[0].add(row_off))
+            val_parts.append(sp.values)
+            row_off += sp.shape[0]
+        return SparseTensor(jnp.concatenate(idx_parts, axis=1),
+                            jnp.concatenate(val_parts), (row_off, n_cols))
+    raise ValueError("sparse_concat supports dim=1 (rows) or 2 (columns)")
+
+
+def sparse_dense_add(sp: SparseTensor, dense):
+    """dense + sparse -> dense (tensor/DenseTensorMath sparse add path)."""
+    return jnp.asarray(dense).at[tuple(sp.indices)].add(sp.values)
 
 
 # --------------------------------------------------------------------- #

@@ -1,0 +1,560 @@
+"""Reference-format `.bigdl` protobuf model reader/writer.
+
+The reference persists models as a `BigDLModule` protobuf
+(serialization/bigdl.proto; written/read by utils/serializer/
+ModuleSerializer.scala:1, ModuleLoader.scala:48 loadFromFile,
+ModulePersister).  Layout facts this module encodes against:
+
+  * the file is the raw BigDLModule message (no magic/header);
+  * tensor DATA lives once in the top-level attr map under
+    "global_storage" (SerConst.GLOBAL_STORAGE) as a NameAttrList mapping
+    tensorId -> AttrValue(tensorValue) whose TensorStorage carries the
+    inline float data; parameter tensors elsewhere reference the same
+    storage by id (ModuleLoader.scala:119 initTensorStorage);
+  * each module's constructor args are attrs keyed by the Scala
+    parameter name (ModuleSerializable.scala:214 doSerializeModule
+    reflection), e.g. Linear(inputSize, outputSize, withBias);
+  * weights ride `parameters` ([weight, bias] order) with
+    hasParameters=true (ModuleSerializable.scala:364 copyFromBigDL);
+    pre-0.5.0 files use the deprecated weight/bias fields instead
+    (ModuleSerializable.scala:336 copyWeightAndBias) — both are read;
+  * containers recurse through subModules
+    (ModuleSerializable.scala:381 ContainerSerializable).
+
+BatchNorm running stats travel as extraParameter in a separate weight
+stream in some reference versions and are not part of the module file;
+they stay at their init values here.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import proto
+from .proto import iter_fields, enc_bytes, enc_string, enc_int64
+from .. import nn
+
+_NS = "com.intel.analytics.bigdl.nn."
+
+# DataType enum (bigdl.proto)
+_DT_FLOAT, _DT_DOUBLE, _DT_INT32, _DT_INT64, _DT_BOOL = 2, 3, 0, 1, 5
+_DT_TENSOR, _DT_ARRAY = 10, 15
+
+
+# --------------------------------------------------------------------- #
+# wire decoding                                                          #
+# --------------------------------------------------------------------- #
+def _packed_varints(v, wire):
+    if wire == 0:
+        return [v]
+    out, i = [], 0
+    while i < len(v):
+        n, i = proto._read_varint(v, i)
+        out.append(n)
+    return out
+
+
+def _sint(v):
+    return v if v < 1 << 62 else v - (1 << 64)
+
+
+def _decode_storage(buf):
+    """TensorStorage -> (np.ndarray | None, storage_id)."""
+    dtype = np.float32
+    data = None
+    sid = 0
+    for f, w, v in iter_fields(buf):
+        if f == 1 and w == 0:
+            dtype = {_DT_FLOAT: np.float32, _DT_DOUBLE: np.float64,
+                     _DT_INT32: np.int32, _DT_INT64: np.int64,
+                     _DT_BOOL: np.bool_}.get(v, np.float32)
+        elif f == 2:  # float_data (packed fixed32 under proto3)
+            if w == 2:
+                data = np.frombuffer(v, "<f4").astype(np.float32)
+            else:   # unpacked single float (iter_fields decodes fixed32)
+                data = np.concatenate(
+                    [data if data is not None else np.zeros(0, np.float32),
+                     [v]]).astype(np.float32)
+        elif f == 3 and w == 2:  # double_data
+            data = np.frombuffer(v, "<f8")
+        elif f == 6:  # int_data packed varints
+            data = np.asarray(_packed_varints(v, w), np.int32)
+        elif f == 7:  # long_data
+            data = np.asarray([_sint(x) for x in _packed_varints(v, w)],
+                              np.int64)
+        elif f == 9 and w == 0:
+            sid = v
+    if data is not None:
+        data = data.astype(dtype, copy=False)
+    return data, sid
+
+
+def _decode_tensor(buf, storages: Dict[int, np.ndarray]):
+    """BigDLTensor -> np.ndarray (resolving shared storage by id)."""
+    sizes: List[int] = []
+    offset = 0
+    tid = None
+    data = None
+    sid = None
+    is_scalar = False
+    for f, w, v in iter_fields(buf):
+        if f == 2:
+            sizes.extend(_packed_varints(v, w))
+        elif f == 4 and w == 0:
+            offset = v
+        elif f == 7 and w == 0:
+            is_scalar = bool(v)
+        elif f == 8 and w == 2:
+            data, sid = _decode_storage(v)
+        elif f == 9 and w == 0:
+            tid = v
+    if data is None and sid is not None:
+        data = storages.get(sid)
+    if data is None:
+        return None
+    if sid is not None and sid not in storages:
+        storages[sid] = data
+    start = max(offset - 1, 0)   # reference storageOffset is 1-based
+    n = int(np.prod(sizes)) if sizes else 1
+    flat = np.asarray(data).reshape(-1)[start:start + n]
+    if is_scalar or not sizes:
+        return flat.reshape(())
+    return flat.reshape(sizes)
+
+
+def _decode_attr(buf, storages):
+    """AttrValue -> python value (subset used by module files)."""
+    dtype = None
+    raw = {}
+    for f, w, v in iter_fields(buf):
+        raw.setdefault(f, []).append((w, v))
+        if f == 1 and w == 0:
+            dtype = v
+    def first(f):
+        return raw[f][0][1] if f in raw else None
+    if 3 in raw:
+        return _sint(first(3))
+    if 4 in raw:
+        return _sint(first(4))
+    if 5 in raw:
+        return float(first(5))    # iter_fields already decodes fixed32
+    if 6 in raw:
+        return float(first(6))    # ... and fixed64
+    if 7 in raw:
+        return first(7).decode("utf-8")
+    if 8 in raw:
+        return bool(first(8))
+    if 10 in raw:
+        return _decode_tensor(first(10), storages)
+    if 14 in raw:  # NameAttrList
+        return _decode_name_attr_list(first(14), storages)
+    if 15 in raw:  # ArrayValue
+        return _decode_array(first(15), storages)
+    if 16 in raw:  # DataFormat enum
+        return "NHWC" if first(16) == 1 else "NCHW"
+    if dtype is not None and dtype not in (_DT_TENSOR,):
+        return None
+    return None
+
+
+def _decode_array(buf, storages):
+    out = []
+    for f, w, v in iter_fields(buf):
+        if f == 3:
+            out.extend(_sint(x) for x in _packed_varints(v, w))
+        elif f == 4:
+            out.extend(_sint(x) for x in _packed_varints(v, w))
+        elif f == 5 and w == 2:
+            out.extend(np.frombuffer(v, "<f4").tolist())
+        elif f == 6 and w == 2:
+            out.extend(np.frombuffer(v, "<f8").tolist())
+        elif f == 7 and w == 2:
+            out.append(v.decode("utf-8"))
+        elif f == 8:
+            out.extend(bool(x) for x in _packed_varints(v, w))
+        elif f == 10 and w == 2:
+            out.append(_decode_tensor(v, storages))
+    return out
+
+
+def _decode_name_attr_list(buf, storages):
+    name = ""
+    attrs = {}
+    for f, w, v in iter_fields(buf):
+        if f == 1 and w == 2:
+            name = v.decode("utf-8")
+        elif f == 2 and w == 2:
+            k = val = None
+            for f2, w2, v2 in iter_fields(v):
+                if f2 == 1 and w2 == 2:
+                    k = v2.decode("utf-8")
+                elif f2 == 2 and w2 == 2:
+                    val = _decode_attr(v2, storages)
+            if k is not None:
+                attrs[k] = val
+    return {"name": name, "attr": attrs}
+
+
+def _decode_module(buf, storages):
+    m = {"name": "", "type": "", "subs": [], "attr": {}, "params": [],
+         "weight": None, "bias": None, "has_params": False}
+    # two passes: global_storage (attr map) must be registered before
+    # parameter tensors that reference it — attrs can appear after
+    # subModules on the wire, so collect first
+    raw_attrs = []
+    for f, w, v in iter_fields(buf):
+        if f == 1 and w == 2:
+            m["name"] = v.decode("utf-8")
+        elif f == 7 and w == 2:
+            m["type"] = v.decode("utf-8")
+        elif f == 8 and w == 2:
+            raw_attrs.append(v)
+    # attr map: key=1, value=2
+    pending = []
+    for v in raw_attrs:
+        k = raw = None
+        for f2, w2, v2 in iter_fields(v):
+            if f2 == 1 and w2 == 2:
+                k = v2.decode("utf-8")
+            elif f2 == 2 and w2 == 2:
+                raw = v2
+        if k == "global_storage" and raw is not None:
+            m["attr"][k] = _decode_attr(raw, storages)  # registers storages
+        elif k is not None:
+            pending.append((k, raw))
+    for k, raw in pending:
+        m["attr"][k] = _decode_attr(raw, storages) if raw is not None \
+            else None
+    for f, w, v in iter_fields(buf):
+        if f == 2 and w == 2:
+            m["subs"].append(_decode_module(v, storages))
+        elif f == 3 and w == 2:
+            m["weight"] = _decode_tensor(v, storages)
+        elif f == 4 and w == 2:
+            m["bias"] = _decode_tensor(v, storages)
+        elif f == 15 and w == 0:
+            m["has_params"] = bool(v)
+        elif f == 16 and w == 2:
+            m["params"].append(_decode_tensor(v, storages))
+    return m
+
+
+# --------------------------------------------------------------------- #
+# module factory (≙ ModuleSerializer's registered deserializers)         #
+# --------------------------------------------------------------------- #
+def _mk_linear(a):
+    return nn.Linear(int(a["inputSize"]), int(a["outputSize"]),
+                     with_bias=a.get("withBias", True))
+
+
+def _mk_conv(a):
+    return nn.SpatialConvolution(
+        int(a["nInputPlane"]), int(a["nOutputPlane"]),
+        int(a["kernelW"]), int(a["kernelH"]),
+        int(a.get("strideW", 1)), int(a.get("strideH", 1)),
+        int(a.get("padW", 0)), int(a.get("padH", 0)),
+        n_group=int(a.get("nGroup", 1)),
+        with_bias=a.get("withBias", True))
+
+
+def _mk_maxpool(a):
+    return nn.SpatialMaxPooling(
+        int(a["kW"]), int(a["kH"]), int(a.get("dW", 1)), int(a.get("dH", 1)),
+        int(a.get("padW", 0)), int(a.get("padH", 0)))
+
+
+def _mk_avgpool(a):
+    return nn.SpatialAveragePooling(
+        int(a["kW"]), int(a["kH"]), int(a.get("dW", 1)), int(a.get("dH", 1)),
+        int(a.get("padW", 0)), int(a.get("padH", 0)),
+        count_include_pad=a.get("countIncludePad", True))
+
+
+def _mk_bn(a):
+    return nn.SpatialBatchNormalization(
+        int(a["nOutput"]), eps=float(a.get("eps", 1e-5)),
+        momentum=float(a.get("momentum", 0.1)),
+        affine=a.get("affine", True))
+
+
+def _mk_bn1d(a):
+    return nn.BatchNormalization(
+        int(a["nOutput"]), eps=float(a.get("eps", 1e-5)),
+        momentum=float(a.get("momentum", 0.1)),
+        affine=a.get("affine", True))
+
+
+_FACTORY = {
+    "Linear": _mk_linear,
+    "SpatialConvolution": _mk_conv,
+    "SpatialMaxPooling": _mk_maxpool,
+    "SpatialAveragePooling": _mk_avgpool,
+    "SpatialBatchNormalization": _mk_bn,
+    "BatchNormalization": _mk_bn1d,
+    "SpatialCrossMapLRN": lambda a: nn.SpatialCrossMapLRN(
+        int(a.get("size", 5)), float(a.get("alpha", 1.0)),
+        float(a.get("beta", 0.75)), float(a.get("k", 1.0))),
+    "ReLU": lambda a: nn.ReLU(),
+    "Tanh": lambda a: nn.Tanh(),
+    "Sigmoid": lambda a: nn.Sigmoid(),
+    "SoftMax": lambda a: nn.SoftMax(),
+    "LogSoftMax": lambda a: nn.LogSoftMax(),
+    "Identity": lambda a: nn.Identity(),
+    "Dropout": lambda a: nn.Dropout(float(a.get("initP", 0.5))),
+    "Reshape": lambda a: nn.Reshape(
+        [int(s) for s in a.get("size", [])],
+        batch_mode=a.get("batchMode")),
+    "View": lambda a: nn.View([int(s) for s in a.get("sizes", [])]),
+    "JoinTable": lambda a: nn.JoinTable(
+        int(a.get("dimension", 1)), int(a.get("nInputDims", -1))),
+    "CAddTable": lambda a: nn.CAddTable(),
+    "CMulTable": lambda a: nn.CMulTable(),
+    "ELU": lambda a: nn.ELU(float(a.get("alpha", 1.0))),
+    "PReLU": lambda a: nn.PReLU(int(a.get("nOutputPlane", 0))),
+    "Abs": lambda a: nn.Abs(),
+    "Power": lambda a: nn.Power(float(a.get("power", 1.0)),
+                                float(a.get("scale", 1.0)),
+                                float(a.get("shift", 0.0))),
+    "Exp": lambda a: nn.Exp(),
+    "Log": lambda a: nn.Log(),
+    "Sequential": lambda a: nn.Sequential(),
+    "ConcatTable": lambda a: nn.ConcatTable(),
+    "ParallelTable": lambda a: nn.ParallelTable(),
+    "Concat": lambda a: nn.Concat(int(a.get("dimension", 1))),
+}
+
+_CONTAINERS = {"Sequential", "ConcatTable", "ParallelTable", "Concat"}
+
+
+def _short_type(full: str) -> str:
+    return full.rsplit(".", 1)[-1]
+
+
+def _build(tree):
+    t = _short_type(tree["type"])
+    fac = _FACTORY.get(t)
+    if fac is None:
+        raise ValueError(
+            f".bigdl module type {tree['type']!r} is not mapped; "
+            f"supported: {sorted(_FACTORY)}")
+    mod = fac(tree["attr"])
+    if tree["name"]:
+        mod.set_name(tree["name"])
+    if t in _CONTAINERS:
+        for sub in tree["subs"]:
+            mod.add(_build(sub))
+    return mod
+
+
+def _leaf_modules(tree):
+    if _short_type(tree["type"]) in _CONTAINERS:
+        for s in tree["subs"]:
+            yield from _leaf_modules(s)
+    else:
+        yield tree
+
+
+def load_bigdl(path: str):
+    """Read a reference `.bigdl` model file into a bigdl_tpu Module
+    (≙ Module.loadModule / ModuleLoader.loadFromFile)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    storages: Dict[int, np.ndarray] = {}
+    tree = _decode_module(data, storages)
+    model = _build(tree)
+    params, state = model.init_params(0)
+    # pair leaf trees with built leaf modules in traversal order
+    built = [m for m in model.modules() if not m.children()] \
+        if model.children() else [model]
+    leaves = list(_leaf_modules(tree))
+    if len(built) != len(leaves):
+        raise ValueError(".bigdl structure mismatch after build")
+    for sub, mod in zip(leaves, built):
+        arrs = sub["params"] if sub["has_params"] else \
+            [t for t in (sub["weight"], sub["bias"]) if t is not None]
+        if not arrs:
+            continue
+        own = dict(params.get(mod.name, {}))
+        keys = [k for k in nn.Module._weights_order(own)]
+        if len(arrs) > len(keys):
+            raise ValueError(
+                f"{mod.name}: {len(arrs)} serialized parameters, module "
+                f"has {len(keys)}")
+        for k, arr in zip(keys, arrs):
+            want = np.shape(own[k])
+            own[k] = np.asarray(arr, np.float32).reshape(want)
+        params[mod.name] = own
+    model.set_params(params, state)
+    return model
+
+
+# --------------------------------------------------------------------- #
+# writer (≙ ModulePersister.saveToFile with ProtoStorageType)            #
+# --------------------------------------------------------------------- #
+def _enc_storage(arr: np.ndarray, sid: int) -> bytes:
+    body = enc_int64(1, _DT_FLOAT)
+    body += enc_bytes(2, np.ascontiguousarray(arr, "<f4").tobytes())
+    body += enc_int64(9, sid)
+    return body
+
+
+def _enc_tensor_msg(arr: np.ndarray, tid: int, sid: int,
+                    inline: bool) -> bytes:
+    body = enc_int64(1, _DT_FLOAT)
+    sizes = b"".join(enc_int64(2, d) for d in arr.shape)
+    body += sizes
+    body += enc_int64(4, 1)                  # storageOffset (1-based)
+    body += enc_int64(5, arr.ndim)
+    body += enc_int64(6, arr.size)
+    st = _enc_storage(arr, sid) if inline else (
+        enc_int64(1, _DT_FLOAT) + enc_int64(9, sid))
+    body += enc_bytes(8, st)
+    body += enc_int64(9, tid)
+    return body
+
+
+def _attr_entry(key: str, attr_body: bytes) -> bytes:
+    return enc_bytes(8, enc_string(1, key) + enc_bytes(2, attr_body))
+
+
+def _attr_int(v: int) -> bytes:
+    return enc_int64(1, _DT_INT32) + enc_int64(3, v & ((1 << 64) - 1))
+
+
+def _attr_double(v: float) -> bytes:
+    return enc_int64(1, _DT_DOUBLE) + proto.enc_double(6, v)
+
+
+def _attr_bool(v: bool) -> bytes:
+    return enc_int64(1, _DT_BOOL) + enc_int64(8, 1 if v else 0)
+
+
+def _attr_int_array(vals) -> bytes:
+    arr = enc_int64(1, len(list(vals))) + enc_int64(2, _DT_INT32)
+    for v in vals:
+        arr += enc_int64(3, v & ((1 << 64) - 1))
+    return enc_int64(1, _DT_ARRAY) + enc_bytes(15, arr)
+
+
+def _module_attrs(mod) -> Dict[str, bytes]:
+    if isinstance(mod, nn.Linear):
+        return {"inputSize": _attr_int(mod.input_size),
+                "outputSize": _attr_int(mod.output_size),
+                "withBias": _attr_bool(mod.with_bias)}
+    if isinstance(mod, nn.SpatialConvolution):
+        kh, kw = mod.kernel
+        sh, sw = mod.stride
+        ph, pw = mod.pad
+        return {"nInputPlane": _attr_int(mod.n_input_plane),
+                "nOutputPlane": _attr_int(mod.n_output_plane),
+                "kernelW": _attr_int(kw), "kernelH": _attr_int(kh),
+                "strideW": _attr_int(sw), "strideH": _attr_int(sh),
+                "padW": _attr_int(pw), "padH": _attr_int(ph),
+                "nGroup": _attr_int(mod.n_group),
+                "withBias": _attr_bool(mod.with_bias)}
+    if isinstance(mod, (nn.SpatialMaxPooling, nn.SpatialAveragePooling)):
+        kh, kw = mod.kernel
+        sh, sw = mod.stride
+        ph, pw = mod.pad
+        return {"kW": _attr_int(kw), "kH": _attr_int(kh),
+                "dW": _attr_int(sw), "dH": _attr_int(sh),
+                "padW": _attr_int(pw), "padH": _attr_int(ph)}
+    if isinstance(mod, (nn.SpatialBatchNormalization,
+                        nn.BatchNormalization)):
+        return {"nOutput": _attr_int(mod.n_output),
+                "eps": _attr_double(mod.eps),
+                "momentum": _attr_double(mod.momentum),
+                "affine": _attr_bool(mod.affine)}
+    if isinstance(mod, nn.Dropout):
+        return {"initP": _attr_double(mod.p)}
+    if isinstance(mod, nn.Reshape):
+        return {"size": _attr_int_array(mod.size)}
+    if isinstance(mod, nn.JoinTable):
+        return {"dimension": _attr_int(mod.dimension),
+                "nInputDims": _attr_int(mod.n_input_dims)}
+    if isinstance(mod, nn.Concat):
+        return {"dimension": _attr_int(mod.dimension)}
+    if isinstance(mod, nn.SpatialCrossMapLRN):
+        return {"size": _attr_int(mod.size),
+                "alpha": _attr_double(mod.alpha),
+                "beta": _attr_double(mod.beta),
+                "k": _attr_double(mod.k)}
+    if isinstance(mod, nn.PReLU):
+        return {"nOutputPlane": _attr_int(mod.n_output_plane)}
+    if isinstance(mod, nn.ELU):
+        return {"alpha": _attr_double(mod.alpha)}
+    if isinstance(mod, nn.Power):
+        return {"power": _attr_double(mod.power),
+                "scale": _attr_double(mod.scale),
+                "shift": _attr_double(mod.shift)}
+    if isinstance(mod, nn.View):
+        return {"sizes": _attr_int_array(mod.sizes)}
+    return {}
+
+
+_TYPE_NAMES = {}
+for _short, _fac in _FACTORY.items():
+    _TYPE_NAMES[_short] = _NS + _short
+
+
+def _enc_module(mod, params, counter, global_entries,
+                inline_storage=False) -> bytes:
+    cls = type(mod).__name__
+    if cls not in _TYPE_NAMES:
+        raise ValueError(f"save_bigdl: unsupported layer {cls}")
+    body = enc_string(1, mod.name)
+    body += enc_string(7, _TYPE_NAMES[cls])
+    if mod.children():
+        for sub in mod.children():
+            body += enc_bytes(2, _enc_module(sub, params, counter,
+                                             global_entries))
+    else:
+        own = params.get(mod.name, {})
+        keys = nn.Module._weights_order(own)
+        if keys:
+            body += enc_int64(15, 1)   # hasParameters
+            for k in keys:
+                arr = np.asarray(own[k], np.float32)
+                counter[0] += 1
+                tid = counter[0]
+                counter[0] += 1
+                sid = counter[0]
+                # data lives once in global_storage; the parameter slot
+                # references the storage id (ModuleLoader.scala:119)
+                global_entries[str(tid)] = _enc_tensor_msg(
+                    arr, tid, sid, inline=True)
+                body += enc_bytes(16, _enc_tensor_msg(arr, tid, sid,
+                                                      inline=False))
+    for k, v in _module_attrs(mod).items():
+        body += _attr_entry(k, v)
+    return body
+
+
+def save_bigdl(model, path: str):
+    """Write `model` as a reference-format `.bigdl` file
+    (≙ Module.saveModule / ModulePersister.saveToFile)."""
+    params = model.ensure_initialized()
+    counter = [0]
+    global_entries: Dict[str, bytes] = {}
+    body = _enc_module(model, params, counter, global_entries)
+    # top-level global_storage attr: NameAttrList{ name, attr{tid->tensor} }
+    nal = enc_string(1, "global_storage")
+    for tid, tensor_body in global_entries.items():
+        attr_val = enc_int64(1, _DT_TENSOR) + enc_bytes(10, tensor_body)
+        nal += enc_bytes(2, enc_string(1, tid) + enc_bytes(2, attr_val))
+    gs_attr = enc_int64(1, 14) + enc_bytes(14, nal)   # NAME_ATTR_LIST
+    body += _attr_entry("global_storage", gs_attr)
+    # tmp + os.replace: same crash-safety contract as serializer.py's
+    # _write_payload_zip — never corrupt an existing file mid-write
+    tmp = f"{path}.tmp-{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(body)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    return path

@@ -8,9 +8,12 @@ binary caffemodel (parsed with utils.proto's wire decoder — no protoc
 dependency).  `save_caffe(model, ...)` persists a Sequential subset back to
 prototxt + caffemodel that this loader round-trips.
 
-Supported layer types: Input, Convolution, InnerProduct, Pooling (MAX/AVE),
-ReLU, Sigmoid, TanH, Softmax(WithLoss), LRN, Dropout, Concat, Eltwise,
-Flatten, Reshape, BatchNorm(+Scale), Scale.
+Supported layer types: Input, Convolution (incl. dilation), Deconvolution,
+InnerProduct, Pooling (MAX/AVE), ReLU, ELU, PReLU, Sigmoid, TanH,
+Softmax(WithLoss), LRN, Dropout, Concat, Eltwise (incl. SUM coefficients),
+Flatten, Reshape, BatchNorm(+Scale), Scale, Power, Exp, Log, AbsVal,
+Threshold, Tile, Slice, Split, RNN/Recurrent
+(≙ utils/caffe/Converter.scala:632 layer dispatch).
 """
 from __future__ import annotations
 
@@ -181,10 +184,16 @@ def _ks(param, base, h_key, w_key):
     return int(vals[0]), int(vals[1])
 
 
-def _convert_layer(ltype: str, lp: PrototxtMessage, in_channels: int):
-    """Returns (module, out_channels) or None for pass-through."""
+def _convert_layer(ltype: str, lp: PrototxtMessage, in_channels: int,
+                   blobs: Optional[List[np.ndarray]] = None):
+    """Returns (module, out_channels) or None for pass-through.
+
+    ``blobs`` (the layer's caffemodel arrays, when available) resolve
+    shapes the prototxt alone cannot, the way the reference reads them
+    from weight blobs (utils/caffe/LayerConverter.scala:39
+    fromCaffeConvolution nInputPlane, :190 fromCaffePreLU nOutPlane)."""
     t = ltype.lower()
-    if t == "convolution":
+    if t in ("convolution", "deconvolution"):
         cp = lp.get("convolution_param", PrototxtMessage())
         nout = int(cp.get("num_output"))
         kh, kw = _ks(cp, "kernel_size", "kernel_h", "kernel_w")
@@ -192,13 +201,40 @@ def _convert_layer(ltype: str, lp: PrototxtMessage, in_channels: int):
         ph, pw = _ks(cp, "pad", "pad_h", "pad_w") or (0, 0)
         group = int(cp.get("group", 1))
         bias = bool(cp.get("bias_term", True))
-        mod = nn.SpatialConvolution(in_channels, nout, kw, kh, sw, sh,
-                                    pw, ph, n_group=group, with_bias=bias)
+        dil = [int(d) for d in cp.get_list("dilation")]
+        # caffe repeated spatial params are (h, w); one entry = square
+        dh_, dw_ = (1, 1) if not dil else \
+            (dil[0], dil[0]) if len(dil) == 1 else (dil[0], dil[1])
+        if in_channels is None and blobs:
+            # weight blob: (out, in/group, kh, kw) for conv,
+            # (in, out/group, kh, kw) for deconv
+            in_channels = (blobs[0].shape[0] if t == "deconvolution"
+                           else blobs[0].shape[1] * group)
+        if t == "deconvolution":
+            if (dh_, dw_) != (1, 1):
+                raise ValueError("dilated Deconvolution is not supported")
+            mod = nn.SpatialFullConvolution(
+                in_channels, nout, kw, kh, sw, sh, pw, ph,
+                n_group=group, no_bias=not bias)
+        elif (dh_, dw_) != (1, 1):
+            if group != 1:
+                raise ValueError(
+                    "grouped dilated Convolution is not supported "
+                    f"(layer has dilation={(dh_, dw_)}, group={group})")
+            mod = nn.SpatialDilatedConvolution(
+                in_channels, nout, kw, kh, sw, sh, pw, ph,
+                dw_, dh_, with_bias=bias)
+        else:
+            mod = nn.SpatialConvolution(in_channels, nout, kw, kh, sw, sh,
+                                        pw, ph, n_group=group,
+                                        with_bias=bias)
         return mod, nout
     if t == "innerproduct" or t == "inner_product":
         ip = lp.get("inner_product_param", PrototxtMessage())
         nout = int(ip.get("num_output"))
         bias = bool(ip.get("bias_term", True))
+        if in_channels is None and blobs:
+            in_channels = blobs[0].shape[-1]
         return nn.Linear(in_channels, nout, with_bias=bias), nout
     if t == "pooling":
         pp = lp.get("pooling_param", PrototxtMessage())
@@ -221,7 +257,11 @@ def _convert_layer(ltype: str, lp: PrototxtMessage, in_channels: int):
     if t == "tanh":
         return nn.Tanh(), in_channels
     if t in ("softmax", "softmaxwithloss"):
-        return nn.SoftMax(), in_channels
+        # caffe softmax_param.axis defaults to 1 (channels); pass it
+        # explicitly — nn.SoftMax's 3D default (unbatched CHW, axis 0)
+        # would otherwise normalize sequence batches over N
+        sp = lp.get("softmax_param", PrototxtMessage())
+        return nn.SoftMax(axis=int(sp.get("axis", 1))), in_channels
     if t == "lrn":
         lrn = lp.get("lrn_param", PrototxtMessage())
         return nn.SpatialCrossMapLRN(
@@ -244,6 +284,47 @@ def _convert_layer(ltype: str, lp: PrototxtMessage, in_channels: int):
         else:
             mod = nn.CMul((1, in_channels, 1, 1))
         return mod, in_channels
+    if t == "elu":
+        ep = lp.get("elu_param", PrototxtMessage())
+        return nn.ELU(float(ep.get("alpha", 1.0))), in_channels
+    if t == "prelu":
+        n = blobs[0].reshape(-1).shape[0] if blobs else (in_channels or 0)
+        return nn.PReLU(n), in_channels
+    if t == "power":
+        pw = lp.get("power_param", PrototxtMessage())
+        return nn.Power(float(pw.get("power", 1.0)),
+                        float(pw.get("scale", 1.0)),
+                        float(pw.get("shift", 0.0))), in_channels
+    if t == "exp":
+        return nn.Exp(), in_channels
+    if t == "log":
+        return nn.Log(), in_channels
+    if t == "absval":
+        return nn.Abs(), in_channels
+    if t == "threshold":
+        tp = lp.get("threshold_param", PrototxtMessage())
+        return nn.BinaryThreshold(float(tp.get("threshold", 1e-6))), \
+            in_channels
+    if t == "tile":
+        tp = lp.get("tile_param", PrototxtMessage())
+        axis = int(tp.get("axis", 1))
+        tiles = int(tp.get("tiles", 1))
+        # caffe axis is 0-based incl. batch; Tile dims are Torch 1-based
+        return nn.Tile(axis + 1, tiles), in_channels
+    if t == "reshape":
+        rp = lp.get("reshape_param", PrototxtMessage())
+        shp = rp.get("shape", PrototxtMessage())
+        if isinstance(shp, list):
+            shp = shp[0]
+        dims = [int(d) for d in shp.get_list("dim")]
+        return nn.InferReshape(dims), None
+    if t in ("rnn", "recurrent"):
+        # the reference emits a bare (cell-less) Recurrent here
+        # (Converter.scala:200); we wire caffe's recurrent_param num_output
+        # into an actual RnnCell so the imported layer computes
+        rp = lp.get("recurrent_param", PrototxtMessage())
+        nout = int(rp.get("num_output", in_channels or 0))
+        return nn.Recurrent(nn.RnnCell(in_channels, nout)), nout
     raise ValueError(f"unsupported caffe layer type {ltype!r}")
 
 
@@ -257,10 +338,33 @@ class CaffeFlatten(_Module):
         return x.reshape(x.shape[0], -1)
 
 
-def _convert(ltype, lp, in_ch):
+def _convert(ltype, lp, in_ch, blobs=None):
     if ltype.lower() == "flatten":
         return CaffeFlatten(), None
-    return _convert_layer(ltype, lp, in_ch)
+    return _convert_layer(ltype, lp, in_ch, blobs)
+
+
+def _out_spatial(mod, spatial):
+    """Track (h, w) through a converted module for the implicit flatten
+    before InnerProduct."""
+    if spatial is None or not hasattr(mod, "kernel"):
+        return spatial
+    kh, kw = mod.kernel
+    sh, sw = mod.stride
+    ph, pw = mod.pad if hasattr(mod, "pad") else (0, 0)
+    if isinstance(mod, nn.SpatialFullConvolution):
+        ah, aw = mod.adj
+        return ((spatial[0] - 1) * sh - 2 * ph + kh + ah,
+                (spatial[1] - 1) * sw - 2 * pw + kw + aw)
+    ceil = bool(getattr(mod, "ceil_mode", False))
+
+    def _osz(i, k, s, p):
+        num = i + 2 * p - k
+        return (-(-num // s) if ceil else num // s) + 1
+    if isinstance(mod, nn.SpatialDilatedConvolution):
+        dh, dw = mod.dilation
+        kh, kw = dh * (kh - 1) + 1, dw * (kw - 1) + 1
+    return (_osz(spatial[0], kh, sh, ph), _osz(spatial[1], kw, sw, pw))
 
 
 # --------------------------------------------------------------------- #
@@ -332,14 +436,18 @@ class CaffeLoader:
 
         shape = self._input_shape()
         in_name = str(self.net.get("input", "data"))
+        in_ch0 = None
+        if shape and len(shape) >= 2:
+            # rank-3 inputs are (N, T, features) sequences: the feature dim
+            # (what Linear/RNN consume) is last; rank-4 are NCHW images
+            in_ch0 = shape[-1] if len(shape) == 3 else shape[1]
         for lp in self._layer_list():
             if str(lp.get("type", "")).lower() in ("input", "data") \
                     and lp.get_list("top"):
                 in_name = lp.get_list("top")[0]
         # blob name -> (node, channels, spatial)
         input_node = Input()
-        blobs_env = {in_name: (input_node,
-                               shape[1] if shape and len(shape) >= 2 else None,
+        blobs_env = {in_name: (input_node, in_ch0,
                                tuple(shape[2:]) if shape and len(shape) == 4
                                else None)}
         weight_assign = []
@@ -361,10 +469,59 @@ class CaffeLoader:
             elif t == "eltwise":
                 ep = lp.get("eltwise_param", PrototxtMessage())
                 op = str(ep.get("operation", "SUM")).upper()
-                mod = {"SUM": nn.CAddTable, "1": nn.CAddTable,
-                       "PROD": nn.CMulTable, "0": nn.CMulTable,
-                       "MAX": nn.CMaxTable, "2": nn.CMaxTable}[op]()
+                coeffs = [float(c) for c in ep.get_list("coeff")]
+                if op in ("SUM", "1") and coeffs and coeffs != [1.0] * len(coeffs):
+                    if coeffs == [1.0, -1.0]:
+                        mod = nn.CSubTable()
+                    else:
+                        # scale each input by its coefficient, then sum
+                        # (≙ Converter.scala fromCaffeEltwise MulConstant
+                        # composition)
+                        ins = [(Node(nn.MulConstant(c), [n]), ch, sp)
+                               for c, (n, ch, sp) in zip(coeffs, ins)]
+                        mod = nn.CAddTable()
+                else:
+                    mod = {"SUM": nn.CAddTable, "1": nn.CAddTable,
+                           "PROD": nn.CMulTable, "0": nn.CMulTable,
+                           "MAX": nn.CMaxTable, "2": nn.CMaxTable}[op]()
                 out_ch, spatial = ins[0][1], ins[0][2]
+            elif t == "slice":
+                # caffe Slice: chunk the axis across the tops (equal split
+                # or slice_point boundaries), dims kept — per-top Narrow
+                # nodes (the reference's SplitTable mapping drops the axis)
+                sp_ = lp.get("slice_param", PrototxtMessage())
+                axis = int(sp_.get("axis", sp_.get("slice_dim", 1)))
+                points = [int(p) for p in sp_.get_list("slice_point")]
+                in_node, in_ch, spatial = ins[0]
+                if not points:
+                    if axis == 1 and in_ch:
+                        total = in_ch
+                    else:
+                        raise ValueError(
+                            f"Slice {name!r}: need slice_point or known "
+                            "channel count on axis 1")
+                    step = total // len(tops)
+                    points = [step * i for i in range(1, len(tops))]
+                    bounds = [0] + points + [total]
+                else:
+                    bounds = [0] + points + [None]
+                for i, top in enumerate(tops):
+                    start, end = bounds[i], bounds[i + 1]
+                    length = (end - start) if end is not None else -1
+                    nar = nn.Narrow(axis + 1, start + 1, length)
+                    nar.set_name(f"{name}.{i}" if len(tops) > 1 else name)
+                    ch, sp_out = in_ch, spatial
+                    if axis == 1:
+                        # the open-ended last chunk spans in_ch - start
+                        ch = length if length > 0 else (
+                            in_ch - start if in_ch else in_ch)
+                    elif axis in (2, 3) and spatial is not None:
+                        full = spatial[axis - 2]
+                        seg = length if length > 0 else full - start
+                        sp_out = (seg, spatial[1]) if axis == 2 \
+                            else (spatial[0], seg)
+                    blobs_env[top] = (Node(nar, [in_node]), ch, sp_out)
+                continue
             elif t == "split":
                 for top in tops:
                     blobs_env[top] = ins[0]
@@ -377,20 +534,11 @@ class CaffeLoader:
                     node = Node(flat, [ins[0][0]])
                     ins = [(node, in_ch * int(np.prod(spatial)), None)]
                     in_ch, spatial = ins[0][1], None
-                mod, out_ch = _convert(ltype, lp, in_ch)
+                mod, out_ch = _convert(ltype, lp, in_ch,
+                                       self.blobs.get(name))
                 if out_ch is None:
                     out_ch = in_ch
-                if spatial is not None and hasattr(mod, "kernel"):
-                    kh, kw = mod.kernel
-                    sh, sw = mod.stride
-                    ph, pw = mod.pad if hasattr(mod, "pad") else (0, 0)
-                    ceil = bool(getattr(mod, "ceil_mode", False))
-
-                    def _osz(i, k, s, p):
-                        num = i + 2 * p - k
-                        return (-(-num // s) if ceil else num // s) + 1
-                    spatial = (_osz(spatial[0], kh, sh, ph),
-                               _osz(spatial[1], kw, sw, pw))
+                spatial = _out_spatial(mod, spatial)
             mod.set_name(name)
             node = Node(mod, [n for n, _, _ in ins])
             out_entry = (node, out_ch, spatial)
@@ -436,7 +584,10 @@ class CaffeLoader:
         """Build a Sequential following the prototxt layer order, loading
         weights by layer name (≙ CaffeLoader.createCaffeModel)."""
         shape = self._input_shape()
-        in_ch = shape[1] if shape and len(shape) >= 2 else None
+        # rank-3 = (N, T, features) sequences (feature dim last); rank-4 NCHW
+        in_ch = None
+        if shape and len(shape) >= 2:
+            in_ch = shape[-1] if len(shape) == 3 else shape[1]
         spatial = shape[2:] if shape and len(shape) == 4 else None
         model = nn.Sequential()
         weight_assign = []
@@ -451,18 +602,12 @@ class CaffeLoader:
                 model.add(CaffeFlatten())
                 in_ch = in_ch * int(np.prod(spatial))
                 spatial = None
-            mod, out_ch = _convert(ltype, lp, in_ch)
+            mod, out_ch = _convert(ltype, lp, in_ch, self.blobs.get(name))
             mod.set_name(name)
             model.add(mod)
             if out_ch is not None:
                 in_ch = out_ch
-            if spatial is not None and hasattr(mod, "kernel"):
-                kh, kw = mod.kernel
-                sh, sw = mod.stride
-                ph, pw = mod.pad if hasattr(mod, "pad") else (0, 0)
-                spatial = [
-                    (spatial[0] + 2 * ph - kh) // sh + 1,
-                    (spatial[1] + 2 * pw - kw) // sw + 1]
+            spatial = _out_spatial(mod, spatial)
             weight_assign.append((name, mod))
         params, state = model.init_params(0)
         for name, mod in weight_assign:
@@ -494,6 +639,25 @@ class CaffeLoader:
                 params[mod.name] = {
                     "weight": blobs[3].reshape(-1).astype(np.float32),
                     "bias": blobs[4].reshape(-1).astype(np.float32)}
+            return
+        if isinstance(mod, nn.Recurrent):
+            # caffe RNNLayer blobs: W_xh (hid, in), B_h (hid,),
+            # W_hh (hid, hid); our RnnCell computes x @ weight_i with
+            # weight_i (in, hid) — transpose on the way in
+            cell = mod.cell
+            p = dict(params.get(cell.name, {}))
+            if len(blobs) >= 1 and "weight_i" in p:
+                p["weight_i"] = np.ascontiguousarray(
+                    blobs[0].reshape(np.shape(p["weight_i"])[::-1]).T) \
+                    .astype(np.float32)
+            if len(blobs) >= 2 and "bias" in p:
+                p["bias"] = blobs[1].reshape(
+                    np.shape(p["bias"])).astype(np.float32)
+            if len(blobs) >= 3 and "weight_h" in p:
+                p["weight_h"] = np.ascontiguousarray(
+                    blobs[2].reshape(np.shape(p["weight_h"])[::-1]).T) \
+                    .astype(np.float32)
+            params[cell.name] = p
             return
         if isinstance(mod, nn.Sequential):  # Scale with bias_term
             cmul, cadd = mod.children()

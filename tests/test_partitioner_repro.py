@@ -1,0 +1,117 @@
+"""Checked-in repro for the GSPMD partitioner miscompile that forced the
+TokenEmbedding fsdp exemption (VERDICT r2 item 3 / NOTES r2 item 2).
+
+Minimal form, no shard_map, forward only, fp32:
+
+    out = take(w, ids, 0) + take(w, ids, 0) @ wo
+
+on a 3-axis (dp=2, fsdp=2, tp=2) mesh with
+    w   P('fsdp', 'tp')      (table sharded on BOTH dims)
+    wo  P('tp', 'fsdp')
+    ids P(('dp', 'fsdp'), None)
+computes values off by O(0.5) from the unpartitioned result on the
+jax 0.9.0 CPU SPMD partitioner.  The same graph on a 2-axis
+(fsdp, tp) mesh is exact, and the single-axis table layouts are exact —
+the bug needs the doubly-sharded table plus the third mesh axis.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _arrays():
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(256, 128).astype(np.float32) * 0.1)
+    wo = jnp.asarray(rng.randn(128, 128).astype(np.float32) * 0.1)
+    ids = jnp.asarray(rng.randint(0, 256, (4, 64)), jnp.int32)
+    return w, wo, ids
+
+
+def _f(w, wo, ids):
+    h = jnp.take(w, ids, axis=0)
+    return h + h @ wo
+
+
+def _partitioned(mesh, w_spec, wo_spec, ids_spec):
+    w, wo, ids = _arrays()
+    sh = lambda s: NamedSharding(mesh, s)
+    out = jax.jit(_f)(jax.device_put(w, sh(w_spec)),
+                      jax.device_put(wo, sh(wo_spec)),
+                      jax.device_put(ids, sh(ids_spec)))
+    return np.asarray(out), np.asarray(_f(w, wo, ids))
+
+
+def test_gather_residual_doubly_sharded_table_miscompiles():
+    """CANARY: asserts the miscompile is still present.  If this test
+    FAILS (the layouts now agree), the installed jax/XLA fixed the
+    partitioner bug — revisit TokenEmbedding: the fsdp_exempt flag and
+    the vocab-over-tp pinning can then be relaxed (see
+    models/transformer.py TokenEmbedding docstring)."""
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("dp", "fsdp", "tp"))
+    out, ref = _partitioned(mesh, P("fsdp", "tp"), P("tp", "fsdp"),
+                            P(("dp", "fsdp"), None))
+    err = np.abs(out - ref).max()
+    assert err > 1e-2, (
+        f"doubly-sharded-table gather+residual now matches (maxdiff "
+        f"{err:.2e}) on jax {jax.__version__}: the GSPMD miscompile is "
+        "fixed — consider removing TokenEmbedding.fsdp_exempt and "
+        "re-evaluating the d_model embedding layout")
+
+
+def test_gather_residual_other_layouts_also_miscompile():
+    """The bug is NOT specific to the doubly-sharded table: in this
+    minimal graph the single-axis table layouts miscompile too (the
+    partitioner's choice depends on whole-graph propagation, which is
+    why only END-TO-END step parity — tests/test_parallel.py::
+    test_spmd_trainer_parallel_matches_single — can certify a model's
+    layout, and why TokenEmbedding pins the one combination that
+    passes it)."""
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("dp", "fsdp", "tp"))
+    bad = 0
+    for w_spec in (P("tp", None), P(None, "tp")):
+        out, ref = _partitioned(mesh, w_spec, P("tp", "fsdp"),
+                                P(("dp", "fsdp"), None))
+        bad += np.abs(out - ref).max() > 1e-2
+    assert bad, (
+        f"single-axis gather+residual layouts now match on jax "
+        f"{jax.__version__} — partitioner fixed, revisit TokenEmbedding")
+
+
+def test_gather_residual_tp_fsdp_table_exact_in_minimal_graph():
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 2, 2),
+                ("dp", "fsdp", "tp"))
+    out, ref = _partitioned(mesh, P("tp", "fsdp"), P("tp", "fsdp"),
+                            P(("dp", "fsdp"), None))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_gather_residual_two_axis_mesh_exact():
+    mesh = Mesh(np.asarray(jax.devices()[:4]).reshape(2, 2),
+                ("fsdp", "tp"))
+    out, ref = _partitioned(mesh, P("fsdp", "tp"), P("tp", "fsdp"),
+                            P("fsdp", None))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_trainer_embed_sharding_is_fsdp_exempt():
+    """Structural guard: the trainer must not layer fsdp onto the token
+    embedding (that layout triggers the miscompile above AND the two
+    involuntary-full-remat warnings)."""
+    import bigdl_tpu.models.transformer as T
+    from bigdl_tpu.parallel.spmd import SpmdTrainer
+    from bigdl_tpu.parallel import mesh as mesh_lib
+    from bigdl_tpu.optim import SGD
+
+    mesh = mesh_lib.create_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    model = T.build("tiny")
+    tr = SpmdTrainer(model, SGD(learning_rate=0.1), mesh=mesh,
+                     fsdp=True, seed=0, min_fsdp_size=1)
+    params = model.init(jax.random.PRNGKey(0))
+    sh = tr._param_shardings(params)
+    spec = sh[model.embed.name]["weight"].spec
+    assert "fsdp" not in str(spec), spec
+    assert spec == P("tp", None), spec
