@@ -289,31 +289,40 @@ CONFIGS = {
 }
 
 
-def _device_liveness_probe(timeout_s=180):
+def _device_liveness_probe(timeout_s=180, retries=1, retry_wait_s=240):
     """The axon TPU tunnel can wedge so that device ops hang forever
     (not fail).  Probe with a tiny op under a watchdog so a dead tunnel
-    turns into a fast non-zero exit instead of an infinite hang."""
+    turns into a non-zero exit instead of an infinite hang.  A wedged
+    tunnel sometimes recovers after idle time, so failed probes retry
+    after a quiet wait (no device traffic between attempts)."""
     import threading
 
-    done = threading.Event()
-    err = []
+    for attempt in range(retries + 1):
+        done = threading.Event()
+        err = []
 
-    def probe():
-        try:
-            float(jnp.sum(jnp.ones(4)))
-            done.set()
-        except Exception as e:
-            err.append(e)
-            done.set()
+        def probe():
+            try:
+                float(jnp.sum(jnp.ones(4)))
+                done.set()
+            except Exception as e:
+                err.append(e)
+                done.set()
 
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    if not done.wait(timeout_s) or err:
-        print(f"# device liveness probe failed "
-              f"({err[0] if err else f'no response in {timeout_s}s'}); "
-              "backend unreachable", file=sys.stderr, flush=True)
-        import os
-        os._exit(2)
+        t = threading.Thread(target=probe, daemon=True)
+        t.start()
+        if done.wait(timeout_s) and not err:
+            return
+        print(f"# device liveness probe attempt {attempt + 1} failed "
+              f"({err[0] if err else f'no response in {timeout_s}s'})",
+              file=sys.stderr, flush=True)
+        if err:        # immediate error = deterministic failure: fail fast
+            break      # (retry-after-idle only helps the hang/wedge case)
+        if attempt < retries:
+            time.sleep(retry_wait_s)
+    print("# backend unreachable", file=sys.stderr, flush=True)
+    import os
+    os._exit(2)
 
 
 def _flush_headline_and_exit(rc):
@@ -343,9 +352,10 @@ def _deadline_watchdog(seconds):
 
 def main():
     import os
-    _device_liveness_probe(float(os.environ.get("BENCH_PROBE_TIMEOUT_S",
-                                                180)))
     _deadline_watchdog(float(os.environ.get("BENCH_DEADLINE_S", 2700)))
+    _device_liveness_probe(
+        float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 300)),
+        retries=int(os.environ.get("BENCH_PROBE_RETRIES", 1)))
     names = sys.argv[1:] or list(CONFIGS)
     unknown = [n for n in names if n not in CONFIGS]
     if unknown:

@@ -28,8 +28,7 @@ they stay at their init values here.
 from __future__ import annotations
 
 import os
-import struct
-from typing import Dict, List, Optional
+from typing import Dict, List
 
 import numpy as np
 

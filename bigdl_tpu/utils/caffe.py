@@ -471,6 +471,11 @@ class CaffeLoader:
                 op = str(ep.get("operation", "SUM")).upper()
                 coeffs = [float(c) for c in ep.get_list("coeff")]
                 if op in ("SUM", "1") and coeffs and coeffs != [1.0] * len(coeffs):
+                    if len(coeffs) != len(ins):
+                        raise ValueError(
+                            f"Eltwise {name!r}: {len(coeffs)} coeffs for "
+                            f"{len(ins)} bottoms (caffe requires equal "
+                            "counts)")
                     if coeffs == [1.0, -1.0]:
                         mod = nn.CSubTable()
                     else:

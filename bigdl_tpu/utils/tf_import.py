@@ -446,6 +446,11 @@ class TFGraph(Module):
         v = env[base]
         if isinstance(v, _MultiOut):
             return v[int(slot or 0)]
+        if slot and int(slot) != 0:
+            raise NotImplementedError(
+                f"output slot {ref!r}: node {base!r} exposes only its "
+                "primary output here (secondary outputs of this op are "
+                "not implemented)")
         return v
 
     def apply(self, params, x, ctx):
