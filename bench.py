@@ -8,6 +8,7 @@ every BASELINE config:
   vgg16        VGG-16 CIFAR-10 train           img/s   (ref ~180)
   lstm         LSTM seq model train            tok/s   (no published ref)
   inception    Inception-v1 via Caffe loader   img/s   (loader -> XLA path)
+  int8         ResNet-50 int8 inference        img/s   (MXU int8 path)
   transformer  TransformerLM train w/ Pallas   tok/s   (flash attn on TPU)
   resnet50     ResNet-50 ImageNet train        img/s   (headline, ~57 ref)
 
@@ -97,6 +98,20 @@ def _train_throughput(model, batch_shape, class_num, batch, k,
     return batch / sec
 
 
+def _infer_throughput(model, params, state, x, batch, k=10):
+    """Inference images/sec via the scanned-steps protocol (shared by the
+    caffe-inception and int8 configs)."""
+    def scan_step(carry, i, x):
+        # input depends on the carry so XLA cannot hoist the forward out
+        # of the scan (loop-invariant code motion would time 1 inference)
+        xi = x + (carry * 0).astype(x.dtype)
+        out, _ = model.run(params, xi, state=state, training=False)
+        return jnp.sum(out.astype(jnp.float32)), jnp.float32(0)
+
+    sec = _time_scanned(scan_step, jnp.float32(0), (x,), k)
+    return batch / sec
+
+
 _HEADLINE = {}   # resnet50 line, withheld until exit (driver parses LAST line)
 
 
@@ -165,16 +180,8 @@ def bench_inception():
     params, state = model.init_params(0)
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(batch, 3, 224, 224).astype(np.bfloat16))
-
-    def scan_step(carry, i, x):
-        # input depends on the carry so XLA cannot hoist the forward out
-        # of the scan (loop-invariant code motion would time 1 inference)
-        xi = x + (carry * 0).astype(x.dtype)
-        out, _ = model.run(params, xi, state=state, training=False)
-        return jnp.sum(out.astype(jnp.float32)), jnp.float32(0)
-
-    sec = _time_scanned(scan_step, jnp.float32(0), (x,), 10)
-    _report("inception_v1_caffe_infer_images_per_sec", batch / sec,
+    ips = _infer_throughput(model, params, state, x, batch)
+    _report("inception_v1_caffe_infer_images_per_sec", ips,
             "images/sec", None)
 
 
@@ -267,6 +274,26 @@ def bench_transformer():
           flush=True)
 
 
+def bench_int8():
+    """Post-training int8 ResNet-50 inference (≙ the reference's
+    quantized-model serving path, nn/quantized/): int8 weights +
+    runtime-quantized activations through the MXU int8 conv path."""
+    from bigdl_tpu.models import resnet
+    from bigdl_tpu.quantized import quantize
+
+    model = resnet.build(class_num=1000, depth=50, dataset="imagenet",
+                         format="NHWC")
+    model.reset(0)
+    qmodel = quantize(model)
+    batch = 256
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
+    params = qmodel.ensure_initialized()
+    state = qmodel._state or {}
+    ips = _infer_throughput(qmodel, params, state, x, batch)
+    _report("resnet50_int8_infer_images_per_sec", ips, "images/sec", None)
+
+
 def bench_resnet50():
     # NHWC: measured 2.7x over NCHW on v5e (convs tile HWIO onto the MXU
     # without the transpose pairs XLA inserts around NCHW batch-norms)
@@ -284,6 +311,7 @@ CONFIGS = {
     "vgg16": bench_vgg16,
     "lstm": bench_lstm,
     "inception": bench_inception,
+    "int8": bench_int8,
     "transformer": bench_transformer,
     "resnet50": bench_resnet50,   # headline: runs first, prints last
 }

@@ -73,11 +73,26 @@ def _bytes_of(shape_str):
     return total
 
 
+def _group_size(line, default):
+    """Ring size of a collective = its replica-group size, parsed from
+    the HLO attrs.  Forms: `replica_groups={{0,1},{2,3}}` (explicit) and
+    `replica_groups=[G,S]<=[...]` (iota: G groups of S)."""
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]", line)
+    if m:
+        return int(m.group(2))
+    m = re.search(r"replica_groups=\{\{([\d,]+)\}", line)
+    if m:
+        return len(m.group(1).split(","))
+    return default
+
+
 def collective_bytes(hlo_text, n_shards):
     """Per-chip bytes moved over the interconnect per step, from the
     partitioned HLO's collective ops.
 
-    Ring costs per chip for S bytes of result/input:
+    Ring costs per chip for S bytes of result/input over a ring of n
+    (n = the op's replica-group size, NOT the global device count —
+    a tp=2 all-reduce on an 8-chip mesh rides rings of 2):
       all-reduce:      2*S*(n-1)/n   (reduce-scatter + all-gather)
       all-gather:        S*(n-1)/n   (S = full gathered size)
       reduce-scatter:    S*(n-1)/n   (S = full pre-scatter size)
@@ -94,13 +109,14 @@ def collective_bytes(hlo_text, n_shards):
             continue
         shape_str, op = m.group(1), m.group(2)
         size = _bytes_of(shape_str)
-        f = (n_shards - 1) / n_shards
+        n = _group_size(s, n_shards)
+        f = (n - 1) / n if n > 1 else 0.0
         if op == "all-reduce":
             wire = 2 * size * f
         elif op == "all-gather":
             wire = size * f               # result is the full size
         elif op == "reduce-scatter":
-            wire = size * f * n_shards    # result is the 1/n shard
+            wire = size * f * n           # result is the 1/n shard
         else:
             wire = size
         per_op.append((op, size, wire))

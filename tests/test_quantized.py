@@ -214,3 +214,22 @@ def test_quantized_dilated_backward_refuses():
     qc.forward(x)
     with pytest.raises(RuntimeError, match="inference-only"):
         qc.backward(x, np.zeros_like(np.asarray(qc.output)))
+
+
+def test_quantize_resnet_nhwc_close_to_float():
+    """bench.py's int8 config path: NHWC ResNet quantizes whole and stays
+    close to the float net."""
+    from bigdl_tpu.models import resnet
+    from bigdl_tpu.quantized import QuantizedSpatialConvolution
+
+    m = resnet.build(class_num=10, depth=20, dataset="cifar10",
+                     format="NHWC")
+    m.reset(0)
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    y0 = np.asarray(m.forward(x))
+    q = quantize(m)
+    assert any(isinstance(c, QuantizedSpatialConvolution)
+               for c in q.modules())
+    y1 = np.asarray(q.forward(x))
+    rel = np.abs(y1 - y0).max() / max(np.abs(y0).max(), 1e-6)
+    assert rel < 0.05, rel
