@@ -22,9 +22,12 @@ and constant-folded Switch/Merge control flow with dead-branch pruning
 (an untaken is_training branch may contain unsupported ops).
 Attention-era graphs are out of scope (use the native model zoo instead).
 
-`save_tf_graph` exports Sequential/Graph models built from Linear /
-activations / Reshape / SpatialConvolution / pooling back to a frozen
-GraphDef that this importer (and TensorFlow) can read.
+`save_tf_graph` exports Sequential models built from Linear /
+activations / Reshape / View / SpatialConvolution / max+avg pooling /
+BatchNormalization (inference-folded) back to a frozen GraphDef that
+this importer (and TensorFlow) can read; NCHW conv stacks are bracketed
+by a single NHWC transpose pair and explicit pads lower to Pad /
+PadV2(-inf) nodes (round-trip tested in tests/test_tf_interop.py).
 """
 from __future__ import annotations
 
@@ -126,17 +129,23 @@ def _decode_attr(buf: bytes):
             return _decode_shape(v)  # shape
         if f == 8 and w == 2:
             return _decode_tensor(v)  # tensor
-        if f == 1 and w == 2:  # list
+        if f == 1 and w == 2:  # list (AttrValue.ListValue)
             out = []
             for f2, w2, v2 in iter_fields(v):
-                if f2 == 2 and w2 == 0:  # i
-                    out.append(v2)
-                elif f2 == 3 and w2 == 2:  # packed i
-                    i = 0
-                    while i < len(v2):
-                        n, i = proto._read_varint(v2, i)
-                        out.append(n)
-                elif f2 == 1 and w2 == 2:  # s
+                if f2 == 3:              # i (packed by proto3, or single)
+                    if w2 == 2:
+                        i = 0
+                        while i < len(v2):
+                            n, i = proto._read_varint(v2, i)
+                            out.append(n)
+                    else:
+                        out.append(v2)
+                elif f2 == 4:            # f (packed fixed32 or single)
+                    if w2 == 2:
+                        out.extend(np.frombuffer(v2, "<f4").tolist())
+                    else:
+                        out.append(v2)
+                elif f2 == 2 and w2 == 2:  # s
                     out.append(v2.decode("utf-8", "replace"))
             return out
     return None
@@ -552,43 +561,187 @@ def _node(name: str, op: str, inputs=(), attrs: Dict[str, bytes] = None) \
 def save_tf_graph(model: Module, path: str, input_shape,
                   input_name: str = "input",
                   output_name: str = "output") -> List[str]:
-    """Export a Sequential of Linear/activations/Reshape to a frozen
-    GraphDef (≙ TensorflowSaver.saveGraph). Returns the node names."""
-    from ..nn import containers, linear as linear_mod, activation, shape_ops
+    """Export a Sequential to a frozen GraphDef
+    (≙ TensorflowSaver.saveGraph).  Covers Linear, activations, Reshape/
+    View, SpatialConvolution (NCHW models: a single NHWC transpose pair
+    brackets the conv stack, TF-style), max/avg pooling (explicit pads
+    become Pad/PadV2(-inf) nodes + VALID ops), and BatchNormalization
+    (inference form folded to Mul+Add consts).  Returns the node names.
+    """
+    from ..nn import (containers, linear as linear_mod, activation,
+                      shape_ops, conv as conv_mod, pooling as pool_mod,
+                      normalization as norm_mod)
 
     params = model.ensure_initialized()
+    state = model._state or {}
     out = b""
     dt_float = proto.enc_int64(6, 1)  # type: DT_FLOAT attr value
+    dt_int = proto.enc_int64(6, 3)
     out += _node(input_name, "Placeholder",
                  attrs={"dtype": dt_float,
                         "shape": enc_bytes(7, _enc_shape(input_shape))})
     cur = input_name
     names = [input_name]
+    layout = "nchw" if len(tuple(input_shape)) == 4 else "flat"
 
     def emit(name, op, inputs, attrs=None):
+        """Emit an op node with the required real-TF dtype attrs: every
+        float op needs T, Transpose Tperm, Pad(V2) Tpaddings, Reshape
+        Tshape (tf.import_graph_def rejects nodes missing them)."""
         nonlocal out
-        out += _node(name, op, inputs, attrs)
+        at = dict(attrs or {})
+        if op != "Const" and op != "Placeholder":
+            at.setdefault("T", dt_float)
+        if op == "Transpose":
+            at.setdefault("Tperm", dt_int)
+        if op in ("Pad", "PadV2"):
+            at.setdefault("Tpaddings", dt_int)
+        if op == "Reshape":
+            at.setdefault("Tshape", dt_int)
+        out += _node(name, op, inputs, at)
         names.append(name)
+
+    def const(name, arr, dt=None):
+        emit(name, "Const", (),
+             {"dtype": dt or dt_float, "value": enc_bytes(8, _enc_tensor(arr))})
+        return name
+
+    def transpose(name, perm):
+        nonlocal cur
+        const(f"{name}/perm", np.asarray(perm, np.int32), dt_int)
+        emit(name, "Transpose", [cur, f"{name}/perm"])
+        cur = name
+
+    def to_nhwc(lname):
+        nonlocal layout
+        if layout == "nchw":
+            transpose(f"{lname}/to_nhwc", (0, 2, 3, 1))
+            layout = "nhwc"
+
+    def to_nchw(lname):
+        nonlocal layout
+        if layout == "nhwc":
+            transpose(f"{lname}/to_nchw", (0, 3, 1, 2))
+            layout = "nchw"
+
+    def pad_explicit(lname, ph, pw, value=None):
+        """Pad H/W of the NHWC tensor; value None = zeros, else PadV2."""
+        nonlocal cur
+        padv = np.asarray([[0, 0], [ph, ph], [pw, pw], [0, 0]], np.int32)
+        const(f"{lname}/pads", padv, dt_int)
+        if value is None:
+            emit(f"{lname}/pad", "Pad", [cur, f"{lname}/pads"])
+        else:
+            const(f"{lname}/padval", np.asarray(value, np.float32))
+            emit(f"{lname}/pad", "PadV2",
+                 [cur, f"{lname}/pads", f"{lname}/padval"])
+        cur = f"{lname}/pad"
+
+    def spatial_attrs(sh, sw, kh=None, kw=None, padding="VALID"):
+        at = {"strides": _ints_list_attr([1, sh, sw, 1]),
+              "padding": enc_string(2, padding)}
+        if kh is not None:
+            at["ksize"] = _ints_list_attr([1, kh, kw, 1])
+        return at
+
+    def spatial_setup(layer, lname, pad_value=None):
+        """Shared conv/pool geometry: NHWC transition, format/ceil guards,
+        VALID/SAME/explicit-pad resolution.  Returns (kh,kw,sh,sw,padding)
+        after emitting any needed Pad node."""
+        if getattr(layer, "format", "NCHW") != "NCHW":
+            raise NotImplementedError(
+                f"save_tf_graph: {type(layer).__name__} with "
+                f"format={layer.format!r} (exporter assumes NCHW models)")
+        if getattr(layer, "ceil_mode", False):
+            raise NotImplementedError(
+                "save_tf_graph: ceil_mode pooling has no TF equivalent")
+        to_nhwc(lname)
+        kh, kw = layer.kernel
+        sh, sw = layer.stride
+        ph, pw = layer.pad
+        padding = "VALID"
+        if (ph, pw) == (-1, -1):
+            padding = "SAME"
+        elif (ph, pw) != (0, 0):
+            pad_explicit(lname, ph, pw, value=pad_value)
+        return kh, kw, sh, sw, padding
 
     layers = model.children() if hasattr(model, "children") else [model]
     idx = 0
     for layer in layers:
         lname = f"layer{idx}"
         if isinstance(layer, linear_mod.Linear):
+            to_nchw(lname)
             w = np.asarray(params[layer.name]["weight"], np.float32)
             b = np.asarray(params[layer.name].get("bias"), np.float32) \
                 if "bias" in params[layer.name] else None
-            emit(f"{lname}/weight", "Const", (),
-                 attrs={"dtype": dt_float,
-                        "value": enc_bytes(8, _enc_tensor(w.T))})
+            const(f"{lname}/weight", w.T)
             emit(f"{lname}/mm", "MatMul", [cur, f"{lname}/weight"])
             cur = f"{lname}/mm"
             if b is not None:
-                emit(f"{lname}/bias", "Const", (),
-                     attrs={"dtype": dt_float,
-                            "value": enc_bytes(8, _enc_tensor(b))})
+                const(f"{lname}/bias", b)
                 emit(f"{lname}/add", "BiasAdd", [cur, f"{lname}/bias"])
                 cur = f"{lname}/add"
+        elif isinstance(layer, conv_mod.SpatialConvolution):
+            if layer.n_group != 1:
+                raise NotImplementedError(
+                    "save_tf_graph: grouped convolution")
+            kh, kw, sh, sw, padding = spatial_setup(layer, lname)
+            w = np.asarray(params[layer.name]["weight"], np.float32)
+            const(f"{lname}/kernel", w.transpose(2, 3, 1, 0))  # OIHW->HWIO
+            emit(f"{lname}/conv", "Conv2D", [cur, f"{lname}/kernel"],
+                 spatial_attrs(sh, sw, padding=padding))
+            cur = f"{lname}/conv"
+            if layer.with_bias:
+                const(f"{lname}/bias",
+                      np.asarray(params[layer.name]["bias"], np.float32))
+                emit(f"{lname}/badd", "BiasAdd", [cur, f"{lname}/bias"])
+                cur = f"{lname}/badd"
+        elif isinstance(layer, pool_mod.SpatialMaxPooling):
+            # explicit max-pool padding must not beat negative activations
+            kh, kw, sh, sw, padding = spatial_setup(layer, lname,
+                                                    pad_value=-3.4e38)
+            emit(lname, "MaxPool", [cur],
+                 spatial_attrs(sh, sw, kh, kw, padding))
+            cur = lname
+        elif isinstance(layer, pool_mod.SpatialAveragePooling):
+            if layer.pad != (0, 0) and not layer.count_include_pad:
+                raise NotImplementedError(
+                    "save_tf_graph: avg pool with explicit pad and "
+                    "count_include_pad=False")
+            if layer.pad == (-1, -1) and layer.count_include_pad:
+                # TF SAME avg divides by the in-bounds count; ours by the
+                # full kernel area when count_include_pad — values differ
+                raise NotImplementedError(
+                    "save_tf_graph: SAME avg pool with "
+                    "count_include_pad=True does not match TF semantics")
+            kh, kw, sh, sw, padding = spatial_setup(layer, lname)
+            emit(lname, "AvgPool", [cur],
+                 spatial_attrs(sh, sw, kh, kw, padding))
+            cur = lname
+        elif isinstance(layer, (norm_mod.SpatialBatchNormalization,
+                                norm_mod.BatchNormalization)):
+            if layout == "nchw" and isinstance(
+                    layer, norm_mod.SpatialBatchNormalization):
+                to_nhwc(lname)
+            st = state.get(layer.name, {})
+            mean = np.asarray(st.get("running_mean",
+                                     np.zeros(layer.n_output)), np.float32)
+            var = np.asarray(st.get("running_var",
+                                    np.ones(layer.n_output)), np.float32)
+            p = params.get(layer.name, {})
+            gamma = np.asarray(p.get("weight", np.ones(layer.n_output)),
+                               np.float32)
+            beta = np.asarray(p.get("bias", np.zeros(layer.n_output)),
+                              np.float32)
+            # inference BN folded to y = x*k + b (channel-last broadcast)
+            k = gamma / np.sqrt(var + layer.eps)
+            bb = beta - mean * k
+            const(f"{lname}/scale", k.astype(np.float32))
+            emit(f"{lname}/mul", "Mul", [cur, f"{lname}/scale"])
+            const(f"{lname}/shift", bb.astype(np.float32))
+            emit(f"{lname}/addb", "Add", [f"{lname}/mul", f"{lname}/shift"])
+            cur = f"{lname}/addb"
         elif isinstance(layer, activation.ReLU):
             emit(lname, "Relu", [cur]); cur = lname
         elif isinstance(layer, activation.Tanh):
@@ -599,18 +752,30 @@ def save_tf_graph(model: Module, path: str, input_shape,
             emit(lname, "Softmax", [cur]); cur = lname
         elif isinstance(layer, activation.LogSoftMax):
             emit(lname, "LogSoftmax", [cur]); cur = lname
-        elif isinstance(layer, shape_ops.Reshape):
-            tgt = np.asarray((-1,) + tuple(layer.size), np.int32)
-            emit(f"{lname}/shape", "Const", (),
-                 attrs={"dtype": proto.enc_int64(6, 3),
-                        "value": enc_bytes(8, _enc_tensor(tgt))})
+        elif isinstance(layer, (shape_ops.Reshape, shape_ops.View)):
+            to_nchw(lname)   # flatten order must match the NCHW weights
+            size = layer.size if isinstance(layer, shape_ops.Reshape) \
+                else layer.sizes
+            tgt = np.asarray((-1,) + tuple(size), np.int32)
+            const(f"{lname}/shape", tgt, dt_int)
             emit(lname, "Reshape", [cur, f"{lname}/shape"])
             cur = lname
+            # a rank-4 target re-enters NCHW-image land (downstream convs
+            # must transpose again); anything else is flat
+            layout = "nchw" if tgt.size == 4 else "flat"
         else:
             raise NotImplementedError(
                 f"save_tf_graph: unsupported layer {type(layer).__name__}")
         idx += 1
+    to_nchw("final")
     emit(output_name, "Identity", [cur])
     with open(path, "wb") as f:
         f.write(out)
     return names
+
+
+def _ints_list_attr(vals) -> bytes:
+    """AttrValue list(int) for strides/ksize — ListValue.i is field 3,
+    packed (attr_value.proto; field 2 is the strings list)."""
+    payload = b"".join(proto._varint(v) for v in vals)
+    return enc_bytes(1, enc_bytes(3, payload))

@@ -26,10 +26,9 @@ def _const(name, arr):
 
 
 def _ints_attr(vals):
-    body = b""
-    for v in vals:
-        body += proto.enc_int64(2, v)
-    return enc_bytes(1, body)
+    # AttrValue.ListValue.i = field 3, packed (attr_value.proto)
+    payload = b"".join(proto._varint(v) for v in vals)
+    return enc_bytes(1, enc_bytes(3, payload))
 
 
 def _build_graph():
