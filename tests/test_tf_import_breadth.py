@@ -200,3 +200,41 @@ def test_splitv_and_slice():
     a, b = m.forward(x)
     np.testing.assert_allclose(np.asarray(a), x[:, :1])
     np.testing.assert_allclose(np.asarray(b), x[:, 1:3])
+
+
+def test_import_graphdef_exported_by_real_tensorflow():
+    """The strongest importer check: TensorFlow itself builds and
+    serializes a slim-style conv graph (constants folded in), we import
+    the bytes with load_tf_graph and match TF's own session output."""
+    tf = pytest.importorskip("tensorflow")
+    rng = np.random.RandomState(0)
+    w1 = rng.randn(3, 3, 3, 8).astype(np.float32) * 0.3
+    scale = (rng.rand(8) + 0.5).astype(np.float32)
+    offset = rng.randn(8).astype(np.float32) * 0.1
+    mean = rng.randn(8).astype(np.float32) * 0.1
+    var = (rng.rand(8) + 0.5).astype(np.float32)
+    wfc = rng.randn(8, 5).astype(np.float32)
+    x = rng.rand(2, 8, 8, 3).astype(np.float32)
+
+    g = tf.Graph()
+    with g.as_default():
+        inp = tf.compat.v1.placeholder(tf.float32, (2, 8, 8, 3),
+                                       name="input")
+        h = tf.nn.conv2d(inp, tf.constant(w1), strides=[1, 1, 1, 1],
+                         padding="SAME")
+        h = tf.compat.v1.nn.fused_batch_norm(
+            h, tf.constant(scale), tf.constant(offset),
+            tf.constant(mean), tf.constant(var), is_training=False)[0]
+        h = tf.nn.relu(h)
+        h = tf.nn.max_pool2d(h, 2, 2, "VALID")
+        h = tf.pad(h, [[0, 0], [1, 1], [1, 1], [0, 0]])
+        h = tf.reduce_mean(h, axis=[1, 2])
+        h = tf.matmul(h, tf.constant(wfc))
+        out = tf.nn.softmax(h, name="probs")
+    with tf.compat.v1.Session(graph=g) as sess:
+        want = sess.run("probs:0", feed_dict={"input:0": x})
+    data = g.as_graph_def().SerializeToString()
+
+    m = load_tf_graph(data, inputs=["input"], outputs=["probs"])
+    got = np.asarray(m.forward(x))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
