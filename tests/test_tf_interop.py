@@ -420,3 +420,21 @@ def test_exported_graphdef_parses_and_runs_in_real_tensorflow():
         with tf.compat.v1.Session(graph=graph) as sess:
             got = sess.run("output:0", feed_dict={"input:0": x})
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_tfrecord_cross_reads_with_real_tensorflow(tmp_path):
+    """Files written by our TFRecordWriter must parse in real TF (CRC
+    masks and framing), and files TF writes must parse in our reader."""
+    tf = pytest.importorskip("tensorflow")
+
+    payloads = [b"alpha", b"beta-record", b"\x00\x01\x02" * 7]
+    ours = str(tmp_path / "ours.tfrecord")
+    tfrecord.write_tfrecords(ours, payloads)
+    got_tf = [bytes(r.numpy()) for r in tf.data.TFRecordDataset(ours)]
+    assert got_tf == payloads
+
+    theirs = str(tmp_path / "theirs.tfrecord")
+    with tf.io.TFRecordWriter(theirs) as w:
+        for p in payloads:
+            w.write(p)
+    assert tfrecord.read_tfrecords(theirs) == payloads
