@@ -238,3 +238,32 @@ def test_import_graphdef_exported_by_real_tensorflow():
     m = load_tf_graph(data, inputs=["input"], outputs=["probs"])
     got = np.asarray(m.forward(x))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_import_real_tf_cond_switch_merge():
+    """tf.compat.v1 control flow (tf.cond on a constant predicate)
+    serializes to real Switch/Merge nodes; the importer must fold them
+    and prune the untaken branch."""
+    tf = pytest.importorskip("tensorflow")
+    was_v2 = tf.compat.v1.control_flow_v2_enabled()
+    tf.compat.v1.disable_control_flow_v2()   # emit v1 Switch/Merge nodes
+    try:
+        g = tf.Graph()
+        with g.as_default():
+            inp = tf.compat.v1.placeholder(tf.float32, (2, 3), name="input")
+            pred = tf.constant(False)
+            out = tf.cond(pred, lambda: inp * 100.0, lambda: inp + 1.0)
+            out = tf.identity(out, name="out")
+    finally:
+        if was_v2:
+            tf.compat.v1.enable_control_flow_v2()
+    with tf.compat.v1.Session(graph=g) as sess:
+        x = np.random.RandomState(0).rand(2, 3).astype(np.float32)
+        want = sess.run("out:0", feed_dict={"input:0": x})
+    data = g.as_graph_def().SerializeToString()
+    ops = {n.op for n in tf.compat.v1.GraphDef.FromString(data).node}
+    assert "Switch" in ops and "Merge" in ops   # real v1 control flow
+
+    m = load_tf_graph(data, inputs=["input"], outputs=["out"])
+    got = np.asarray(m.forward(x))
+    np.testing.assert_allclose(got, want, rtol=1e-6)
