@@ -40,7 +40,9 @@ _NS = "com.intel.analytics.bigdl.nn."
 
 # DataType enum (bigdl.proto)
 _DT_FLOAT, _DT_DOUBLE, _DT_INT32, _DT_INT64, _DT_BOOL = 2, 3, 0, 1, 5
+_DT_STRING = 4
 _DT_TENSOR, _DT_ARRAY = 10, 15
+_DT_NAME_ATTR_LIST = 14
 
 
 # --------------------------------------------------------------------- #
@@ -199,7 +201,7 @@ def _decode_name_attr_list(buf, storages):
 
 def _decode_module(buf, storages):
     m = {"name": "", "type": "", "subs": [], "attr": {}, "params": [],
-         "weight": None, "bias": None, "has_params": False}
+         "pres": [], "weight": None, "bias": None, "has_params": False}
     # two passes: global_storage (attr map) must be registered before
     # parameter tensors that reference it — attrs can appear after
     # subModules on the wire, so collect first
@@ -234,6 +236,8 @@ def _decode_module(buf, storages):
             m["weight"] = _decode_tensor(v, storages)
         elif f == 4 and w == 2:
             m["bias"] = _decode_tensor(v, storages)
+        elif f == 5 and w == 2:       # preModules (graph wiring)
+            m["pres"].append(v.decode("utf-8"))
         elif f == 15 and w == 0:
             m["has_params"] = bool(v)
         elif f == 16 and w == 2:
@@ -328,17 +332,64 @@ _FACTORY = {
 _CONTAINERS = {"Sequential", "ConcatTable", "ParallelTable", "Concat"}
 
 
+_GRAPHS = {"StaticGraph", "Graph", "DynamicGraph"}
+
+
 def _short_type(full: str) -> str:
     return full.rsplit(".", 1)[-1]
 
 
+def _build_graph(tree):
+    """DAG module (nn/Graph.scala GraphSerializable: subModules carry
+    preModules wiring; inputNames/outputNames attrs name the
+    endpoints)."""
+    from ..nn.graph import Graph as NNGraph, Node
+
+    by_name = {sub["name"]: sub for sub in tree["subs"]}
+    if len(by_name) != len(tree["subs"]):
+        raise ValueError(
+            ".bigdl graph: duplicate node names (shared-module graphs "
+            "are not supported)")
+    nodes = {}
+    visiting = set()
+
+    def node_of(nm):
+        if nm in nodes:
+            return nodes[nm]
+        if nm in visiting:
+            raise ValueError(f".bigdl graph: wiring cycle through {nm!r}")
+        visiting.add(nm)
+        sub = by_name[nm]
+        pres = [node_of(p) for p in sub["pres"] if p in by_name]
+        if _short_type(sub["type"]) == "Input":
+            nodes[nm] = Node(None, [])
+        else:
+            nodes[nm] = Node(_build(sub), pres)
+        visiting.discard(nm)
+        return nodes[nm]
+
+    for sub in tree["subs"]:
+        node_of(sub["name"])
+    in_names = tree["attr"].get("inputNames") or []
+    out_names = tree["attr"].get("outputNames") or []
+    if not in_names or not out_names:
+        raise ValueError(".bigdl graph: missing inputNames/outputNames")
+    g = NNGraph([nodes[n] for n in in_names],
+                [nodes[n] for n in out_names])
+    if tree["name"]:
+        g.set_name(tree["name"])
+    return g
+
+
 def _build(tree):
     t = _short_type(tree["type"])
+    if t in _GRAPHS:
+        return _build_graph(tree)
     fac = _FACTORY.get(t)
     if fac is None:
         raise ValueError(
             f".bigdl module type {tree['type']!r} is not mapped; "
-            f"supported: {sorted(_FACTORY)}")
+            f"supported: {sorted(_FACTORY) + sorted(_GRAPHS)}")
     mod = fac(tree["attr"])
     if tree["name"]:
         mod.set_name(tree["name"])
@@ -349,10 +400,11 @@ def _build(tree):
 
 
 def _leaf_modules(tree):
-    if _short_type(tree["type"]) in _CONTAINERS:
+    t = _short_type(tree["type"])
+    if t in _CONTAINERS or t in _GRAPHS:
         for s in tree["subs"]:
             yield from _leaf_modules(s)
-    else:
+    elif t != "Input":
         yield tree
 
 
@@ -365,27 +417,28 @@ def load_bigdl(path: str):
     tree = _decode_module(data, storages)
     model = _build(tree)
     params, state = model.init_params(0)
-    # pair leaf trees with built leaf modules in traversal order
-    built = [m for m in model.modules() if not m.children()] \
-        if model.children() else [model]
-    leaves = list(_leaf_modules(tree))
-    if len(built) != len(leaves):
-        raise ValueError(".bigdl structure mismatch after build")
-    for sub, mod in zip(leaves, built):
+    # assign by MODULE NAME (params are keyed by it, and _build preserved
+    # every serialized name) — robust to container vs graph traversal order
+    for sub in _leaf_modules(tree):
         arrs = sub["params"] if sub["has_params"] else \
             [t for t in (sub["weight"], sub["bias"]) if t is not None]
         if not arrs:
             continue
-        own = dict(params.get(mod.name, {}))
+        name = sub["name"]
+        if name not in params:
+            raise ValueError(
+                f".bigdl layer {name!r} carries parameters but the built "
+                "model has no params under that name")
+        own = dict(params[name])
         keys = [k for k in nn.Module._weights_order(own)]
         if len(arrs) > len(keys):
             raise ValueError(
-                f"{mod.name}: {len(arrs)} serialized parameters, module "
+                f"{name}: {len(arrs)} serialized parameters, module "
                 f"has {len(keys)}")
         for k, arr in zip(keys, arrs):
             want = np.shape(own[k])
             own[k] = np.asarray(arr, np.float32).reshape(want)
-        params[mod.name] = own
+        params[name] = own
     model.set_params(params, state)
     return model
 
@@ -435,6 +488,14 @@ def _attr_int_array(vals) -> bytes:
     arr = enc_int64(1, len(list(vals))) + enc_int64(2, _DT_INT32)
     for v in vals:
         arr += enc_int64(3, v & ((1 << 64) - 1))
+    return enc_int64(1, _DT_ARRAY) + enc_bytes(15, arr)
+
+
+def _attr_str_array(vals) -> bytes:
+    vals = list(vals)
+    arr = enc_int64(1, len(vals)) + enc_int64(2, _DT_STRING)
+    for v in vals:
+        arr += enc_string(7, v)
     return enc_int64(1, _DT_ARRAY) + enc_bytes(15, arr)
 
 
@@ -499,8 +560,78 @@ for _short, _fac in _FACTORY.items():
     _TYPE_NAMES[_short] = _NS + _short
 
 
-def _enc_module(mod, params, counter, global_entries,
-                inline_storage=False) -> bytes:
+def _enc_graph(mod, params, counter, global_entries) -> bytes:
+    """nn.Graph -> StaticGraph wire form: subModules with preModules
+    wiring, inputNames/outputNames attrs, per-node edges maps
+    (≙ nn/Graph.scala GraphSerializable doSerializeModule)."""
+    body = enc_string(1, mod.name)
+    body += enc_string(7, _NS + "StaticGraph")
+    # every node the file references: the DFS-from-outputs topo PLUS any
+    # declared input node that no output path reaches
+    all_nodes = list(mod._topo)
+    seen_ids = {id(n) for n in all_nodes}
+    for n in mod.input_nodes:
+        if id(n) not in seen_ids:
+            all_nodes.insert(0, n)
+            seen_ids.add(id(n))
+    names_of = {}
+    used_names = set()
+    n_in = 0
+    for node in all_nodes:
+        if node.module is None:
+            nm = f"{mod.name}.input{n_in}"
+            n_in += 1
+        else:
+            nm = node.module.name
+        if nm in used_names:
+            # the wire format keys nodes by module name; one module
+            # instance at two graph positions would collapse on load
+            raise NotImplementedError(
+                f"save_bigdl: module {nm!r} appears at multiple graph "
+                "nodes (shared-module graphs are not supported)")
+        used_names.add(nm)
+        names_of[id(node)] = nm
+    for node in all_nodes:
+        nm = names_of[id(node)]
+        pres = [names_of[id(p)] for p in node.prev_nodes]
+        if node.module is None:
+            sub = enc_string(1, nm) + enc_string(7, _NS + "Input")
+        else:
+            sub = _enc_module(node.module, params, counter,
+                              global_entries)
+        for p in pres:
+            sub += enc_string(5, p)      # preModules
+        body += enc_bytes(2, sub)
+    # per-node edges maps: the reference loader unconditionally reads
+    # "<name>_edges" (Graph.scala prepareLoadModule), so they must exist;
+    # -1 encodes the default Edge() (no tuple index).  Our own loader
+    # wires by preModules and ignores these.
+    for node in all_nodes:
+        nm = names_of[id(node)]
+        inner = enc_string(1, nm)
+        for p in (names_of[id(q)] for q in node.prev_nodes):
+            av = enc_int64(1, _DT_INT32) \
+                + enc_int64(3, (-1) & ((1 << 64) - 1))
+            inner = inner + enc_bytes(2, enc_string(1, p)
+                                      + enc_bytes(2, av))
+        outer = enc_string(1, f"{nm}_edges") + enc_bytes(
+            2, enc_string(1, nm)
+            + enc_bytes(2, enc_int64(1, _DT_NAME_ATTR_LIST)
+                        + enc_bytes(14, inner)))
+        body += _attr_entry(f"{nm}_edges",
+                            enc_int64(1, _DT_NAME_ATTR_LIST)
+                            + enc_bytes(14, outer))
+    body += _attr_entry("inputNames", _attr_str_array(
+        names_of[id(n)] for n in mod.input_nodes))
+    body += _attr_entry("outputNames", _attr_str_array(
+        names_of[id(n)] for n in mod.output_nodes))
+    return body
+
+
+def _enc_module(mod, params, counter, global_entries) -> bytes:
+    from ..nn.graph import Graph as _NNGraph
+    if isinstance(mod, _NNGraph):
+        return _enc_graph(mod, params, counter, global_entries)
     cls = type(mod).__name__
     if cls not in _TYPE_NAMES:
         raise ValueError(f"save_bigdl: unsupported layer {cls}")

@@ -175,3 +175,107 @@ def test_prelu_and_elu_roundtrip():
     m.reset(2)
     x = np.random.RandomState(4).randn(5, 4).astype(np.float32)
     _roundtrip(m, x)
+
+
+def test_graph_dag_roundtrip():
+    """StaticGraph wire form: a skip-connection DAG round-trips with
+    forward parity (subModules + preModules wiring + inputNames/
+    outputNames attrs, ≙ nn/Graph.scala GraphSerializable)."""
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    inp = Input()
+    fc1 = nn.Linear(6, 6).inputs(inp)
+    act = nn.ReLU().inputs(fc1)
+    add = nn.CAddTable().inputs([act, inp])       # skip connection
+    out = nn.Linear(6, 3).inputs(add)
+    m = Graph(inp, out)
+    m.reset(4)
+    x = np.random.RandomState(5).randn(3, 6).astype(np.float32)
+    m2 = _roundtrip(m, x)
+    kinds = [type(c).__name__ for c in m2.modules()]
+    assert "CAddTable" in kinds
+
+
+def test_graph_multi_input_roundtrip():
+    from bigdl_tpu.nn.graph import Graph, Input
+    from bigdl_tpu.utils.table import T
+
+    a, b = Input(), Input()
+    fa = nn.Linear(4, 5).inputs(a)
+    fb = nn.Linear(4, 5).inputs(b)
+    merged = nn.CMulTable().inputs([fa, fb])
+    m = Graph([a, b], merged)
+    m.reset(7)
+    xa = np.random.RandomState(1).randn(2, 4).astype(np.float32)
+    xb = np.random.RandomState(2).randn(2, 4).astype(np.float32)
+    _roundtrip(m, T(xa, xb))
+
+
+def test_graph_shared_module_rejected():
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    inp = Input()
+    shared = nn.Linear(4, 4)
+    m = Graph(inp, shared.inputs(shared.inputs(inp)))
+    m.reset(0)
+    with tempfile.TemporaryDirectory() as d:
+        with pytest.raises(NotImplementedError, match="multiple graph"):
+            save_bigdl(m, os.path.join(d, "s.bigdl"))
+
+
+def test_hand_encoded_graph():
+    """Graph fixture from raw field numbers (independent of the writer):
+    BigDLModule subModules=2, preModules=5; inputNames/outputNames as
+    ArrayValue str (ArrayValue.str field 7, datatype STRING=4)."""
+    rng = np.random.RandomState(0)
+    w = rng.randn(3, 5).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+
+    def tensor(arr, inline=True):
+        body = enc_int64(1, 2)
+        for d in arr.shape:
+            body += enc_int64(2, d)
+        st = enc_int64(1, 2) + enc_bytes(2, arr.astype("<f4").tobytes())
+        body += enc_bytes(8, st)
+        return body
+
+    def attr_entry(key, val):
+        return enc_bytes(8, enc_string(1, key) + enc_bytes(2, val))
+
+    attr_int = lambda v: enc_int64(1, 0) + enc_int64(3, v)
+
+    def str_array(vals):
+        arr = enc_int64(1, len(vals)) + enc_int64(2, 4)   # STRING
+        for v in vals:
+            arr += enc_string(7, v)
+        return enc_int64(1, 15) + enc_bytes(15, arr)      # ARRAY_VALUE
+
+    node_in = enc_string(1, "in0") \
+        + enc_string(7, "com.intel.analytics.bigdl.nn.Input")
+    node_fc = enc_string(1, "fc")
+    node_fc += enc_string(7, "com.intel.analytics.bigdl.nn.Linear")
+    node_fc += attr_entry("inputSize", attr_int(5))
+    node_fc += attr_entry("outputSize", attr_int(3))
+    node_fc += enc_int64(15, 1)
+    node_fc += enc_bytes(16, tensor(w))
+    node_fc += enc_bytes(16, tensor(b))
+    node_fc += enc_string(5, "in0")                       # preModules
+    node_out = enc_string(1, "act")
+    node_out += enc_string(7, "com.intel.analytics.bigdl.nn.Tanh")
+    node_out += enc_string(5, "fc")
+
+    g = enc_string(1, "net")
+    g += enc_string(7, "com.intel.analytics.bigdl.nn.StaticGraph")
+    g += enc_bytes(2, node_in) + enc_bytes(2, node_fc) \
+        + enc_bytes(2, node_out)
+    g += attr_entry("inputNames", str_array(["in0"]))
+    g += attr_entry("outputNames", str_array(["act"]))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "g.bigdl")
+        with open(p, "wb") as f:
+            f.write(g)
+        m = load_bigdl(p)
+    x = np.random.RandomState(6).rand(4, 5).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(m.forward(x)),
+                               np.tanh(x @ w.T + b), rtol=1e-5)
