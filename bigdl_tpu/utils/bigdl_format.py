@@ -323,6 +323,48 @@ _FACTORY = {
                                 float(a.get("shift", 0.0))),
     "Exp": lambda a: nn.Exp(),
     "Log": lambda a: nn.Log(),
+    "HardTanh": lambda a: nn.HardTanh(float(a.get("minValue", -1.0)),
+                                      float(a.get("maxValue", 1.0))),
+    "Clamp": lambda a: nn.Clamp(float(a.get("min", -1.0)),
+                                float(a.get("max", 1.0))),
+    "SoftPlus": lambda a: nn.SoftPlus(float(a.get("beta", 1.0))),
+    "SoftSign": lambda a: nn.SoftSign(),
+    "LeakyReLU": lambda a: nn.LeakyReLU(float(a.get("negval", 0.01))),
+    "ReLU6": lambda a: nn.ReLU6(),
+    "Threshold": lambda a: nn.Threshold(float(a.get("th", 1e-6)),
+                                        float(a.get("v", 0.0))),
+    "MulConstant": lambda a: nn.MulConstant(float(a.get("scalar", 1.0))),
+    "AddConstant": lambda a: nn.AddConstant(
+        float(a.get("constant_scalar", 0.0))),
+    "Squeeze": lambda a: nn.Squeeze(a.get("dim")),
+    "Unsqueeze": lambda a: nn.Unsqueeze(int(a.get("pos", 1))),
+    "Select": lambda a: nn.Select(int(a.get("dimension", a.get("dim", 1))),
+                                  int(a.get("index", 1))),
+    "Narrow": lambda a: nn.Narrow(int(a.get("dimension", 1)),
+                                  int(a.get("offset", 1)),
+                                  int(a.get("length", 1))),
+    "Mean": lambda a: nn.Mean(int(a.get("dimension", 1)),
+                              int(a.get("nInputDims", -1)),
+                              a.get("squeeze", True)),
+    "CMul": lambda a: nn.CMul([int(s) for s in a.get("size", [])]),
+    "CAdd": lambda a: nn.CAdd([int(s) for s in a.get("size", [])]),
+    "Mul": lambda a: nn.Mul(),
+    "Normalize": lambda a: nn.Normalize(float(a.get("p", 2.0)),
+                                        float(a.get("eps", 1e-10))),
+    "GaussianDropout": lambda a: nn.GaussianDropout(
+        float(a.get("rate", 0.5))),
+    "GaussianNoise": lambda a: nn.GaussianNoise(
+        float(a.get("stddev", 1.0))),
+    "SoftMin": lambda a: nn.SoftMin(),
+    "LogSigmoid": lambda a: nn.LogSigmoid(),
+    "HardSigmoid": lambda a: nn.HardSigmoid(),
+    "Echo": lambda a: nn.Echo(),
+    "FlattenTable": lambda a: nn.FlattenTable(),
+    "SelectTable": lambda a: nn.SelectTable(int(a.get("index", 1))),
+    "NarrowTable": lambda a: nn.NarrowTable(int(a.get("offset", 1)),
+                                            int(a.get("length", 1))),
+    "MaskedSelect": lambda a: nn.MaskedSelect(),
+    "Index": lambda a: nn.Index(int(a.get("dimension", 1))),
     "Sequential": lambda a: nn.Sequential(),
     "ConcatTable": lambda a: nn.ConcatTable(),
     "ParallelTable": lambda a: nn.ParallelTable(),
@@ -552,6 +594,58 @@ def _module_attrs(mod) -> Dict[str, bytes]:
                 "shift": _attr_double(mod.shift)}
     if isinstance(mod, nn.View):
         return {"sizes": _attr_int_array(mod.sizes)}
+    if isinstance(mod, nn.Clamp) or isinstance(mod, nn.HardTanh):
+        # Clamp subclasses HardTanh; reference Clamp ctor is (min: Int,
+        # max: Int) while HardTanh takes doubles
+        if type(mod).__name__ == "Clamp":
+            return {"min": _attr_int(int(mod.min_value)),
+                    "max": _attr_int(int(mod.max_value))}
+        return {"minValue": _attr_double(mod.min_value),
+                "maxValue": _attr_double(mod.max_value)}
+    if isinstance(mod, nn.SoftPlus):
+        return {"beta": _attr_double(mod.beta)}
+    if isinstance(mod, nn.LeakyReLU):
+        return {"negval": _attr_double(mod.negval)}
+    if isinstance(mod, nn.Threshold):
+        return {"th": _attr_double(mod.th), "v": _attr_double(mod.v)}
+    if isinstance(mod, nn.MulConstant):
+        return {"scalar": _attr_double(mod.scalar)}
+    if isinstance(mod, nn.AddConstant):
+        return {"constant_scalar": _attr_double(mod.constant)}
+    if isinstance(mod, nn.Squeeze):
+        if isinstance(mod.dim, (tuple, list)) or mod.batch_mode:
+            raise ValueError(
+                "save_bigdl: Squeeze with multiple dims or batch_mode "
+                "has no reference wire form")
+        return {} if mod.dim is None else {"dim": _attr_int(mod.dim)}
+    if isinstance(mod, nn.Unsqueeze):
+        return {"pos": _attr_int(mod.pos)}
+    if isinstance(mod, nn.Select):
+        return {"dimension": _attr_int(mod.dim),
+                "index": _attr_int(mod.index)}
+    if isinstance(mod, nn.Narrow):
+        return {"dimension": _attr_int(mod.dimension),
+                "offset": _attr_int(mod.offset),
+                "length": _attr_int(mod.length)}
+    if isinstance(mod, nn.Mean):
+        return {"dimension": _attr_int(mod.dimension),
+                "nInputDims": _attr_int(getattr(mod, "n_input_dims", -1)),
+                "squeeze": _attr_bool(mod.squeeze)}
+    if isinstance(mod, (nn.CMul, nn.CAdd)):
+        return {"size": _attr_int_array(mod.size)}
+    if isinstance(mod, nn.Normalize):
+        return {"p": _attr_double(mod.p), "eps": _attr_double(mod.eps)}
+    if isinstance(mod, nn.GaussianDropout):
+        return {"rate": _attr_double(mod.rate)}
+    if isinstance(mod, nn.GaussianNoise):
+        return {"stddev": _attr_double(mod.stddev)}
+    if isinstance(mod, nn.SelectTable):
+        return {"index": _attr_int(mod.index)}
+    if isinstance(mod, nn.NarrowTable):
+        return {"offset": _attr_int(mod.offset),
+                "length": _attr_int(mod.length)}
+    if isinstance(mod, nn.Index):
+        return {"dimension": _attr_int(mod.dimension)}
     return {}
 
 

@@ -279,3 +279,38 @@ def test_hand_encoded_graph():
     x = np.random.RandomState(6).rand(4, 5).astype(np.float32)
     np.testing.assert_allclose(np.asarray(m.forward(x)),
                                np.tanh(x @ w.T + b), rtol=1e-5)
+
+
+def test_elementwise_breadth_roundtrip():
+    """The widened factory (activations/constants/shape ops) round-trips
+    with non-default hyperparameters preserved."""
+    m = nn.Sequential(
+        nn.Linear(6, 6),
+        nn.HardTanh(-2.0, 2.0),
+        nn.MulConstant(3.0),
+        nn.AddConstant(0.25),
+        nn.SoftPlus(2.0),
+        nn.LeakyReLU(0.2),
+        nn.Normalize(1.0),
+        nn.Mean(2, squeeze=True))
+    m.reset(9)
+    x = np.random.RandomState(9).randn(3, 6).astype(np.float32)
+    m2 = _roundtrip(m, x)
+    got = {type(c).__name__: c for c in m2.modules()}
+    assert got["HardTanh"].min_value == -2.0
+    assert got["MulConstant"].scalar == 3.0
+    assert got["AddConstant"].constant == 0.25
+    assert got["SoftPlus"].beta == 2.0
+    assert got["LeakyReLU"].negval == 0.2
+    assert got["Normalize"].p == 1.0
+
+
+def test_shape_and_table_ops_roundtrip():
+    m = nn.Sequential(
+        nn.Unsqueeze(1),            # (B, 1, 6)
+        nn.Narrow(3, 2, 4),         # (B, 1, 4)
+        nn.Squeeze(),               # (B, 4)  (drop all size-1 dims)
+        nn.Select(2, 1))            # (B,)
+    m.reset(0)
+    x = np.random.RandomState(3).randn(5, 6).astype(np.float32)
+    _roundtrip(m, x)
