@@ -7,6 +7,9 @@ Demonstrates every import path end-to-end with synthetic inputs:
   2. Keras 1.2.2: JSON definition + HDF5 weights -> predict
   3. Torch7 .t7: tensor round-trip through the torchfile reader
   4. bigdl_tpu native format: save -> load -> identical predictions
+  5. Reference .bigdl protobuf (classic BigDL's own container) round-trip
+  6. Frozen TF GraphDef export -> import round-trip (file also runs in
+     real TensorFlow)
 
 Runs CPU-only in about a minute:
     python examples/loadmodel.py
@@ -96,11 +99,56 @@ def native_format(model, tmp="/tmp/loadmodel_demo"):
           f"({os.path.getsize(path) // 1024} KiB file)")
 
 
+def reference_bigdl_format(tmp="/tmp/loadmodel_demo"):
+    """The reference's own protobuf container: a model written here can
+    be read by classic BigDL's Module.loadModule, and vice versa."""
+    import os
+    from bigdl_tpu.utils.bigdl_format import save_bigdl, load_bigdl
+
+    m = nn.Sequential(
+        nn.SpatialConvolution(1, 6, 5, 5), nn.Tanh(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape((6 * 12 * 12,)),
+        nn.Linear(6 * 12 * 12, 10), nn.LogSoftMax())
+    m.reset(0)
+    path = os.path.join(tmp, "lenetish.bigdl_pb")
+    save_bigdl(m, path)
+    m2 = load_bigdl(path)
+    x = np.random.RandomState(3).rand(2, 1, 28, 28).astype(np.float32)
+    assert np.allclose(np.asarray(m.forward(x)), np.asarray(m2.forward(x)),
+                       rtol=1e-5)
+    print(f"[bigdl-protobuf] reference wire-format round-trip OK "
+          f"({os.path.getsize(path) // 1024} KiB)")
+
+
+def tf_graphdef(tmp="/tmp/loadmodel_demo"):
+    """Frozen-GraphDef export/import: the exported file also parses and
+    runs in real TensorFlow (tested in tests/test_tf_interop.py)."""
+    import os
+    from bigdl_tpu.utils.tf_import import save_tf_graph, load_tf_graph
+
+    m = nn.Sequential(
+        nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1), nn.ReLU(),
+        nn.SpatialMaxPooling(2, 2, 2, 2),
+        nn.Reshape((4 * 8 * 8,)), nn.Linear(4 * 8 * 8, 5), nn.SoftMax())
+    m.reset(1)
+    path = os.path.join(tmp, "convnet.pb")
+    save_tf_graph(m, path, (2, 3, 16, 16))
+    g = load_tf_graph(path, inputs=["input"], outputs=["output"])
+    x = np.random.RandomState(4).rand(2, 3, 16, 16).astype(np.float32)
+    assert np.allclose(np.asarray(m.forward(x)), np.asarray(g.forward(x)),
+                       rtol=2e-4, atol=2e-5)
+    print(f"[tf] GraphDef export -> import round-trip OK "
+          f"({os.path.getsize(path) // 1024} KiB)")
+
+
 def main():
     model = caffe_googlenet()
     keras_model()
     torch_t7()
     native_format(model)
+    reference_bigdl_format()
+    tf_graphdef()
 
 
 if __name__ == "__main__":
