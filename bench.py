@@ -179,7 +179,7 @@ def bench_inception():
     batch = 256
     params, state = model.init_params(0)
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(batch, 3, 224, 224).astype(np.bfloat16))
+    x = jnp.asarray(rng.rand(batch, 3, 224, 224), jnp.bfloat16)
     ips = _infer_throughput(model, params, state, x, batch)
     _report("inception_v1_caffe_infer_images_per_sec", ips,
             "images/sec", None)
@@ -197,9 +197,9 @@ def bench_transformer():
     on_tpu = jax.default_backend() == "tpu"
     # --- Pallas path eligibility + numerics parity ------------------- #
     rng = np.random.RandomState(0)
-    q = jnp.asarray(rng.randn(2, 8, 512, 128).astype(np.bfloat16))
-    k = jnp.asarray(rng.randn(2, 8, 512, 128).astype(np.bfloat16))
-    v = jnp.asarray(rng.randn(2, 8, 512, 128).astype(np.bfloat16))
+    q = jnp.asarray(rng.randn(2, 8, 512, 128), jnp.bfloat16)
+    k = jnp.asarray(rng.randn(2, 8, 512, 128), jnp.bfloat16)
+    v = jnp.asarray(rng.randn(2, 8, 512, 128), jnp.bfloat16)
     cfg = fa._Config(True, float(1 / np.sqrt(128)), 128, 128, True)
     pallas_active = fa._pallas_ok(q, k, cfg)
     if on_tpu:

@@ -1,0 +1,37 @@
+"""Single watchdogged TPU liveness probe: exits 0 (alive) / 2 (wedged).
+
+The axon tunnel wedge manifests as an infinite HANG inside backend init
+or the first device op, so the probe runs in a daemon thread and the
+process exits via os._exit on timeout (a hung thread cannot block exit).
+Usage: python scripts/tpu_probe.py [timeout_s]
+"""
+import os
+import sys
+import threading
+import time
+
+timeout = float(sys.argv[1]) if len(sys.argv) > 1 else 120.0
+ok = threading.Event()
+err = []
+
+
+def probe():
+    try:
+        import jax
+        import jax.numpy as jnp
+        d = jax.devices()
+        float(jnp.sum(jnp.ones(4)))
+        print(f"alive: {d}", flush=True)
+        ok.set()
+    except Exception as e:
+        err.append(e)
+        ok.set()
+
+
+t = threading.Thread(target=probe, daemon=True)
+t.start()
+if ok.wait(timeout) and not err:
+    os._exit(0)
+print(f"wedged ({err[0] if err else f'no response in {timeout:.0f}s'})",
+      flush=True)
+os._exit(2)
