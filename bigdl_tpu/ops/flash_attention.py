@@ -211,6 +211,10 @@ def _causal_hi(qi, block_q, block_k, n_kb):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                 sm_scale, causal, block_q, block_k, seq_k):
+    # m/l/lse are carried as (bq, 1) rather than (bq,): Mosaic tiles the
+    # last two dims onto (sublane, lane), and a trailing singleton keeps
+    # every ref block shape legal on hardware (interpret mode never checks
+    # this — the r2 kernel only failed when first run on a real TPU).
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * sm_scale          # (bq, d)
     d = q.shape[-1]
@@ -225,20 +229,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         if causal:
             s = jnp.where(_block_causal_mask(qi, j, block_q, block_k),
                           s, DEFAULT_MASK_VALUE)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        p = jnp.exp(s - m_new[:, None])
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))     # (bq, 1)
+        p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
-        l_new = corr * l + p.sum(axis=-1)
-        acc_new = corr[:, None] * acc + jnp.dot(
+        l_new = corr * l + p.sum(axis=-1, keepdims=True)
+        acc_new = corr * acc + jnp.dot(
             p, vb, preferred_element_type=jnp.float32)
         return acc_new, m_new, l_new
 
     init = (jnp.zeros((block_q, d), jnp.float32),
-            jnp.full((block_q,), DEFAULT_MASK_VALUE, jnp.float32),
-            jnp.zeros((block_q,), jnp.float32))
+            jnp.full((block_q, 1), DEFAULT_MASK_VALUE, jnp.float32),
+            jnp.zeros((block_q, 1), jnp.float32))
     acc, m, l = lax.fori_loop(0, hi, body, init)
     safe_l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / safe_l[:, None]).astype(o_ref.dtype)
+    o_ref[0] = (acc / safe_l).astype(o_ref.dtype)
     lse_ref[0] = (m + jnp.log(safe_l)).astype(lse_ref.dtype)
 
 
@@ -263,11 +267,12 @@ def _fwd_pallas(q, k, v, cfg: _Config):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),
+            # trailing singleton = lane-legal block (see _fwd_kernel note)
+            pl.BlockSpec((1, bq, 1), lambda bh, i: (bh, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
         ],
         interpret=_INTERPRET,
     )(qf, kf, vf)
@@ -296,16 +301,16 @@ def _bwd_kernel_dkv(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lseb = lse_ref[0, pl.ds(i * block_q, block_q)]
-        deltab = delta_ref[0, pl.ds(i * block_q, block_q)]
+        lseb = lse_ref[0, pl.ds(i * block_q, block_q), :]      # (bq, 1)
+        deltab = delta_ref[0, pl.ds(i * block_q, block_q), :]
         s = jnp.dot(qb, k.T, preferred_element_type=jnp.float32) * sm_scale
-        p = jnp.exp(s - lseb[:, None])                    # (bq, bk)
+        p = jnp.exp(s - lseb)                             # (bq, bk)
         if causal:
             p = jnp.where(_block_causal_mask(i, ki, block_q, block_k),
                           p, 0.0)
         dv = dv + jnp.dot(p.T, dob, preferred_element_type=jnp.float32)
         dp = jnp.dot(dob, v.T, preferred_element_type=jnp.float32)
-        ds_ = p * (dp - deltab[:, None]) * sm_scale
+        ds_ = p * (dp - deltab) * sm_scale
         dk = dk + jnp.dot(ds_.T, qb, preferred_element_type=jnp.float32)
         return dk, dv
 
@@ -323,7 +328,7 @@ def _bwd_kernel_dq(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
     qb = q_ref[0].astype(jnp.float32)                     # (bq, d)
     dob = do_ref[0].astype(jnp.float32)
-    lseb = lse_ref[0]
+    lseb = lse_ref[0]                                     # (bq, 1)
     deltab = delta_ref[0]
     n_kb = seq_k // block_k
     hi = _causal_hi(qi, block_q, block_k, n_kb) if causal else n_kb
@@ -332,12 +337,12 @@ def _bwd_kernel_dq(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
         s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * sm_scale
-        p = jnp.exp(s - lseb[:, None])
+        p = jnp.exp(s - lseb)
         if causal:
             p = jnp.where(_block_causal_mask(qi, j, block_q, block_k),
                           p, 0.0)
         dp = jnp.dot(dob, vb.T, preferred_element_type=jnp.float32)
-        ds_ = p * (dp - deltab[:, None]) * sm_scale
+        ds_ = p * (dp - deltab) * sm_scale
         return dq + jnp.dot(ds_, kb, preferred_element_type=jnp.float32)
 
     d = qb.shape[-1]
@@ -353,10 +358,10 @@ def _bwd_pallas(q, k, v, out, lse, do, cfg: _Config):
     kf = k.reshape(b * h, sk, d)
     vf = v.reshape(b * h, sk, d)
     dof = do.reshape(b * h, sq, d)
-    lsef = lse.reshape(b * h, sq)
+    lsef = lse.reshape(b * h, sq, 1)
     # delta_i = sum_d do_i * out_i; tiny elementwise reduce, leave it to XLA
     delta = (do.astype(jnp.float32) * out.astype(jnp.float32)
-             ).sum(-1).reshape(b * h, sq)
+             ).sum(-1).reshape(b * h, sq, 1)
 
     kv_kernel = functools.partial(
         _bwd_kernel_dkv, sm_scale=cfg.sm_scale, causal=cfg.causal,
@@ -369,8 +374,8 @@ def _bwd_pallas(q, k, v, out, lse, do, cfg: _Config):
             pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
             pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
             pl.BlockSpec((1, sq, d), lambda bh, j: (bh, 0, 0)),
-            pl.BlockSpec((1, sq), lambda bh, j: (bh, 0)),
-            pl.BlockSpec((1, sq), lambda bh, j: (bh, 0)),
+            pl.BlockSpec((1, sq, 1), lambda bh, j: (bh, 0, 0)),
+            pl.BlockSpec((1, sq, 1), lambda bh, j: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda bh, j: (bh, j, 0)),
@@ -394,8 +399,8 @@ def _bwd_pallas(q, k, v, out, lse, do, cfg: _Config):
             pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, sk, d), lambda bh, i: (bh, 0, 0)),
             pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0)),
-            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),
-            pl.BlockSpec((1, bq), lambda bh, i: (bh, i)),
+            pl.BlockSpec((1, bq, 1), lambda bh, i: (bh, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, i: (bh, i, 0)),
         ],
         out_specs=[pl.BlockSpec((1, bq, d), lambda bh, i: (bh, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((b * h, sq, d), q.dtype)],

@@ -134,9 +134,6 @@ def exp_A(batch=256):
     t = timeit_carry(thr, (params, opt_state, state), (x, y))
     print(f"A threaded     : {t*1e3:7.2f} ms  {batch/t:8.0f} img/s",
           flush=True)
-    t = timeit_carry(thr, (params, opt_state, state), (x, y), donate=True)
-    print(f"A thr+donate   : {t*1e3:7.2f} ms  {batch/t:8.0f} img/s",
-          flush=True)
 
     def thr_fixed_key(carry, i, xx, yy):
         p, o, s = carry
@@ -145,6 +142,11 @@ def exp_A(batch=256):
 
     t = timeit_carry(thr_fixed_key, (params, opt_state, state), (x, y))
     print(f"A thr fixed-key: {t*1e3:7.2f} ms  {batch/t:8.0f} img/s",
+          flush=True)
+    # donation invalidates the donated buffers — run LAST, on copies
+    cp = jax.tree_util.tree_map(jnp.copy, (params, opt_state, state))
+    t = timeit_carry(thr, cp, (x, y), donate=True)
+    print(f"A thr+donate   : {t*1e3:7.2f} ms  {batch/t:8.0f} img/s",
           flush=True)
 
 
@@ -259,5 +261,8 @@ if __name__ == "__main__":
     which = sys.argv[1:] or ["A", "B", "C", "D"]
     t0 = time.time()
     for w in which:
-        {"A": exp_A, "B": exp_B, "C": exp_C, "D": exp_D}[w]()
+        try:
+            {"A": exp_A, "B": exp_B, "C": exp_C, "D": exp_D}[w]()
+        except Exception as e:   # one experiment must not sink the rest
+            print(f"# [{w}] FAILED: {type(e).__name__}: {e}", flush=True)
         print(f"# [{w}] done at +{time.time()-t0:.0f}s", flush=True)
