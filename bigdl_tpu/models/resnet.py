@@ -112,22 +112,33 @@ _IMAGENET_CFG = {
 
 def build(class_num=1000, depth=50, shortcut_type=ShortcutType.B,
           dataset="imagenet", with_logsoftmax=True, format="NCHW",
-          sync_bn_axis=None):
+          sync_bn_axis=None, stem="conv"):
     """≙ ResNet.apply (ResNet.scala:240).  format='NHWC' builds the
     TPU-preferred channels-last variant (identical math; feed NHWC
     inputs).  sync_bn_axis='dp' makes every BN compute cross-replica
     batch statistics over that mesh axis (sync BN — exact parity with
-    single-chip full-batch stats under data parallelism)."""
+    single-chip full-batch stats under data parallelism).
+    stem='s2d' (NHWC imagenet only) computes the same 7x7/2 stem conv
+    on a 2x2 space-to-depth input — an exact reparameterization (same
+    parameter tensor, same outputs, checkpoint-compatible) that lifts
+    the MXU lane utilization of the C=3 stem."""
     b = _Builder(shortcut_type, format=format, sync_bn_axis=sync_bn_axis)
     model = Sequential(name=f"ResNet{depth}_{dataset}")
+    if stem not in ("conv", "s2d"):
+        raise ValueError(f"unknown stem {stem!r}")
+    if stem == "s2d" and (format != "NHWC" or dataset != "imagenet"):
+        raise ValueError("stem='s2d' requires format='NHWC' imagenet")
     if dataset == "imagenet":
         cfg = _IMAGENET_CFG[depth]
         (c1, c2, c3, c4), n_features, kind = cfg
         block = b.bottleneck if kind == "bottleneck" else b.basic_block
         b.i_channels = 64
+        from ..nn import SpaceToDepthConvolution
+        stem_cls = (SpaceToDepthConvolution if stem == "s2d"
+                    else SpatialConvolution)
         (model
-         .add(b.conv(3, 64, 7, 7, 2, 2, 3, 3, with_bias=False,
-                     name="conv1"))
+         .add(stem_cls(3, 64, 7, 7, 2, 2, 3, 3, with_bias=False,
+                       format=format, name="conv1"))
          .add(b.bn(64))
          .add(ReLU())
          .add(SpatialMaxPooling(3, 3, 2, 2, 1, 1, format=format))

@@ -14,6 +14,7 @@ from .activation import (ReLU, ReLU6, Tanh, Sigmoid, ELU, LeakyReLU, PReLU,
                          HardSigmoid, HardShrink, SoftShrink, TanhShrink,
                          Threshold, BinaryThreshold, GELU, SiLU)
 from .conv import (SpatialConvolution, SpatialShareConvolution,
+                   SpaceToDepthConvolution,
                    SpatialDilatedConvolution, SpatialFullConvolution,
                    SpatialSeparableConvolution, TemporalConvolution,
                    VolumetricConvolution, VolumetricFullConvolution,

@@ -1,0 +1,121 @@
+"""Round-3 perf experiments, part 4: measure the conv rewrites.
+
+Baseline (pre-rewrite): threaded full step NHWC b256 = 98.98 ms
+(2,586 img/s).  Now in the tree: 1x1/stride-s convs compute as
+slice+dense (always on), and resnet.build(stem='s2d') reparameterizes
+the stem.  Experiments:
+
+  K1 threaded full step, plain stem   (1x1 rewrite active)
+  K2 threaded full step, s2d stem     (both rewrites)
+  K3 K2 + plain-autodiff BN           (is the custom vjp helping?)
+"""
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+
+def _init_with_retry(tries=5, wait=90):
+    for i in range(tries):
+        try:
+            import jax
+            jax.devices()
+            return jax
+        except Exception as e:
+            print(f"# backend init attempt {i + 1} failed: {e}", flush=True)
+            time.sleep(wait)
+    print("# backend unreachable, giving up", flush=True)
+    sys.exit(2)
+
+
+jax = _init_with_retry()
+import jax.numpy as jnp                                    # noqa: E402
+from jax import lax                                        # noqa: E402
+
+from bigdl_tpu import nn                                   # noqa: E402
+from bigdl_tpu.models import resnet                        # noqa: E402
+from bigdl_tpu.optim import SGD                            # noqa: E402
+from bigdl_tpu.optim.optimizer import make_train_step      # noqa: E402
+
+
+def lat():
+    ones = jnp.ones(4)
+    ls = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        float(jnp.sum(ones))
+        ls.append(time.perf_counter() - t0)
+    return float(np.median(ls))
+
+
+def run_full(label, batch=256, stem="conv", k=10):
+    model = resnet.build(class_num=1000, depth=50, dataset="imagenet",
+                         format="NHWC", stem=stem)
+    criterion = nn.ClassNLLCriterion()
+    method = SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
+    params, state = model.init_params(0)
+    opt_state = method.init_state(params)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
+    y = jnp.asarray(rng.randint(1, 1001, batch).astype(np.float32))
+    step = make_train_step(model, criterion, method, mixed_precision=True)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def many(carry, x, y):
+        def body(c, i):
+            p, o, s = c
+            p, o, s, loss = step(p, o, s, x, y, key)
+            return (p, o, s), loss
+        return lax.scan(body, carry, jnp.arange(k))
+
+    carry, losses = many((params, opt_state, state), x, y)
+    float(jnp.sum(losses))
+    l = lat()
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        carry, losses = many(carry, x, y)
+        float(jnp.sum(losses))
+        ts.append((time.perf_counter() - t0 - l) / k)
+    t = float(np.median(ts))
+    print(f"{label}: {t*1e3:7.2f} ms  {batch/t:8.0f} img/s  "
+          f"({batch*12.3e9/t/197e12*100:4.1f}% MFU)", flush=True)
+    return t
+
+
+def exp_K1():
+    run_full("K1 full step, conv stem ")
+
+
+def exp_K2():
+    run_full("K2 full step, s2d stem  ", stem="s2d")
+
+
+def exp_K3():
+    from bigdl_tpu.nn import normalization as nz
+    orig = nz._bn_train
+
+    def plain_bn(x, gamma, beta, channel_axis, eps):
+        y, mean, var, _ = nz._bn_train_fwd_impl(x, gamma, beta,
+                                                channel_axis, eps)
+        return y, mean, var
+
+    nz._bn_train = plain_bn
+    try:
+        run_full("K3 s2d + autodiff BN    ", stem="s2d")
+    finally:
+        nz._bn_train = orig
+
+
+if __name__ == "__main__":
+    which = sys.argv[1:] or ["K1", "K2", "K3"]
+    t0 = time.time()
+    for w in which:
+        try:
+            {"K1": exp_K1, "K2": exp_K2, "K3": exp_K3}[w]()
+        except Exception as e:
+            print(f"# [{w}] FAILED: {type(e).__name__}: {e}", flush=True)
+        print(f"# [{w}] done at +{time.time()-t0:.0f}s", flush=True)

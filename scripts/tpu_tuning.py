@@ -1,5 +1,17 @@
 """ResNet-50 MFU localization + tuning matrix (run on the real TPU).
 
+PROTOCOL WARNING (the r2 lesson): any timing whose scan body does not
+consume EVERY output of the step lets XLA dead-code-eliminate the
+unconsumed work — the original `matrix`/`parts` "full step" here only
+read one updated-param leaf, which deleted most d_weight matmuls and
+the whole optimizer update and inflated ResNet-50 b256 from the true
+~2,600 img/s to a reported 9,260 (which is in fact the FORWARD-ONLY
+rate). Full-step timings now thread (params, opt_state, state) through
+the scan carry, matching bench.py. Localization phases (`parts`,
+`stages`) still use invariant-params timing where DCE is the point
+(e.g. fwd-only) — read them as lower bounds on cost, never as
+throughput claims.
+
 Three phases, each printing one line per measurement:
 
   parts    fwd-only vs fwd+bwd vs full train step  -> where the time goes
@@ -45,7 +57,9 @@ def _mix(x, c):
 
 def timeit(fn, args, k=10, trials=3):
     """fn(c, *args) -> scalar; times k dependency-chained evaluations.
-    Implementations must _mix the carry `c` into their inputs."""
+    Implementations must _mix the carry `c` into their inputs.
+    CAUTION: anything the scalar result doesn't depend on is DCE'd —
+    use timeit_carry for full-train-step throughput claims."""
     @jax.jit
     def many(*a):
         def body(c, i):
@@ -59,6 +73,28 @@ def timeit(fn, args, k=10, trials=3):
     for _ in range(trials):
         t0 = time.perf_counter()
         float(many(*args))
+        ts.append((time.perf_counter() - t0 - l) / k)
+    return float(np.median(ts))
+
+
+def timeit_carry(fn, carry, args, k=10, trials=3):
+    """fn(carry, i, *args) -> (carry, scalar); threads full training
+    state through the scan so no step output is dead (bench.py
+    protocol — the only protocol valid for throughput claims)."""
+    @jax.jit
+    def many(carry, *a):
+        def body(c, i):
+            return fn(c, i, *a)
+        return lax.scan(body, carry, jnp.arange(k))
+
+    carry, losses = many(carry, *args)
+    float(jnp.sum(losses))
+    l = lat()
+    ts = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        carry, losses = many(carry, *args)
+        float(jnp.sum(losses))
         ts.append((time.perf_counter() - t0 - l) / k)
     return float(np.median(ts))
 
@@ -98,18 +134,18 @@ def parts(batch=256):
 
     step = make_train_step(model, criterion, method, mixed_precision=True)
 
-    def full(c, p, o, s, xx, yy):
-        p2, o2, s2, loss = step(p, o, s, _mix(xx, c), yy,
-                                jax.random.PRNGKey(0))
-        return loss + jax.tree_util.tree_leaves(p2)[0].ravel()[0]
+    def full(carry, i, xx, yy):
+        p, o, s = carry
+        p, o, s, loss = step(p, o, s, xx, yy, jax.random.PRNGKey(0))
+        return (p, o, s), loss
 
     t_f = timeit(fwd, (params, state, xb), k=10)
     print(f"fwd only (bf16 in):    {t_f*1e3:7.2f} ms  "
           f"{batch/t_f:8.0f} img/s", flush=True)
     t_fb = timeit(fwdbwd, (params, state, xb, y), k=10)
-    print(f"fwd+bwd:               {t_fb*1e3:7.2f} ms  "
+    print(f"fwd+bwd (leaf-0 only): {t_fb*1e3:7.2f} ms  "
           f"{batch/t_fb:8.0f} img/s", flush=True)
-    t_full = timeit(full, (params, opt_state, state, x, y), k=10)
+    t_full = timeit_carry(full, (params, opt_state, state), (x, y), k=10)
     print(f"full train step:       {t_full*1e3:7.2f} ms  "
           f"{batch/t_full:8.0f} img/s", flush=True)
 
@@ -148,12 +184,14 @@ def matrix():
             step = make_train_step(model, criterion, method,
                                    mixed_precision=True)
 
-            def full(c, p, o, s, xx, yy):
-                p2, o2, s2, loss = step(p, o, s, _mix(xx, c), yy,
-                                        jax.random.PRNGKey(0))
-                return loss + jax.tree_util.tree_leaves(p2)[0].ravel()[0]
+            def full(carry, i, xx, yy):
+                p, o, s = carry
+                p, o, s, loss = step(p, o, s, xx, yy,
+                                     jax.random.PRNGKey(0))
+                return (p, o, s), loss
 
-            t = timeit(full, (params, opt_state, state, x, y), k=10)
+            t = timeit_carry(full, (params, opt_state, state), (x, y),
+                             k=10)
             print(f"{fmt} b{batch}: {t*1e3:7.2f} ms  {batch/t:8.0f} img/s",
                   flush=True)
 

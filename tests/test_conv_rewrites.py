@@ -1,0 +1,141 @@
+"""Exact-math conv rewrites used by the TPU fast path.
+
+1. 1x1 stride-s convs compute as strided-slice + dense 1x1 (conv.py:
+   SpatialConvolution.apply) — identical forward values and gradients
+   to the general strided conv.
+2. SpaceToDepthConvolution — the stem reparameterization (zero-padded
+   kernel regrouped over a 2x2 space-to-depth input) matches the plain
+   SpatialConvolution bit-for-bit in fp32, parameters unchanged.
+
+Both rewrites feed bench.py's ResNet-50 headline, so parity here guards
+the honest-throughput claim.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import Ctx
+
+
+def _ctx(state=None):
+    return Ctx(state=state or {}, training=True,
+               rng_key=jax.random.PRNGKey(0))
+
+
+def _general_conv(x, w, stride, pads, fmt):
+    dn = ("NCHW", "OIHW", "NCHW") if fmt == "NCHW" else ("NHWC", "OIHW",
+                                                         "NHWC")
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=pads, dimension_numbers=dn)
+
+
+@pytest.mark.parametrize("fmt", ["NCHW", "NHWC"])
+@pytest.mark.parametrize("stride,hw", [(2, 14), (2, 15), (3, 17)])
+def test_1x1_strided_conv_matches_general(fmt, stride, hw):
+    rng = np.random.RandomState(0)
+    ci, co = 8, 16
+    conv = nn.SpatialConvolution(ci, co, 1, 1, stride, stride, 0, 0,
+                                 format=fmt)
+    params = conv.init(jax.random.PRNGKey(1))
+    shape = (2, ci, hw, hw) if fmt == "NCHW" else (2, hw, hw, ci)
+    x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+
+    got = conv.apply(params, x, _ctx())
+    w = conv.own(params)["weight"]
+    want = _general_conv(x, w, (stride, stride), [(0, 0), (0, 0)], fmt)
+    b = conv.own(params)["bias"]
+    want = want + (b[None, :, None, None] if fmt == "NCHW"
+                   else b[None, None, None, :])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+    # gradients through the rewrite match the general path
+    def loss_rewrite(p, xx):
+        return jnp.sum(jnp.sin(conv.apply(p, xx, _ctx())))
+
+    def loss_general(p, xx):
+        y = _general_conv(xx, conv.own(p)["weight"], (stride, stride),
+                          [(0, 0), (0, 0)], fmt)
+        bb = conv.own(p)["bias"]
+        y = y + (bb[None, :, None, None] if fmt == "NCHW"
+                 else bb[None, None, None, :])
+        return jnp.sum(jnp.sin(y))
+
+    g1p, g1x = jax.grad(loss_rewrite, argnums=(0, 1))(params, x)
+    g2p, g2x = jax.grad(loss_general, argnums=(0, 1))(params, x)
+    np.testing.assert_allclose(np.asarray(g1x), np.asarray(g2x),
+                               rtol=1e-5, atol=1e-5)
+    for a, b_ in zip(jax.tree_util.tree_leaves(g1p),
+                     jax.tree_util.tree_leaves(g2p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("k,pad,hw", [(7, 3, 32), (7, 3, 31), (3, 1, 16),
+                                      (5, 2, 20),
+                                      # even kernel, odd conv extent: the
+                                      # s2d input needs TRIMMING, not pad
+                                      (2, 0, 15), (4, 1, 13)])
+def test_space_to_depth_conv_matches_plain(k, pad, hw):
+    rng = np.random.RandomState(0)
+    ci, co = 3, 16
+    plain = nn.SpatialConvolution(ci, co, k, k, 2, 2, pad, pad,
+                                  with_bias=True, format="NHWC")
+    s2d = nn.SpaceToDepthConvolution(ci, co, k, k, 2, 2, pad, pad,
+                                     with_bias=True, format="NHWC")
+    params = plain.init(jax.random.PRNGKey(2))
+    # same parameter tensor drives both (checkpoint compatibility)
+    params_s2d = {s2d.name: plain.own(params)}
+    x = jnp.asarray(rng.randn(2, hw, hw, ci).astype(np.float32))
+
+    want = plain.apply(params, x, _ctx())
+    got = s2d.apply(params_s2d, x, _ctx())
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    # gradient parity w.r.t. weights and input
+    def make_loss(mod):
+        def loss(p, xx):
+            return jnp.sum(jnp.sin(mod.apply(p, xx, _ctx())))
+        return loss
+
+    g1p, g1x = jax.grad(make_loss(plain), argnums=(0, 1))(params, x)
+    g2p, g2x = jax.grad(make_loss(s2d), argnums=(0, 1))(params_s2d, x)
+    np.testing.assert_allclose(np.asarray(g1x), np.asarray(g2x),
+                               rtol=1e-5, atol=1e-5)
+    for a, b_ in zip(jax.tree_util.tree_leaves(g1p),
+                     jax.tree_util.tree_leaves(g2p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_s2d_conv_rejects_same_padding():
+    with pytest.raises(ValueError, match="SAME"):
+        nn.SpaceToDepthConvolution(3, 8, 7, 7, 2, 2, -1, -1,
+                                   format="NHWC")
+
+
+def test_resnet_s2d_stem_full_model_parity():
+    from bigdl_tpu.models import resnet
+    m1 = resnet.build(class_num=10, depth=18, dataset="imagenet",
+                      format="NHWC")
+    m2 = resnet.build(class_num=10, depth=18, dataset="imagenet",
+                      format="NHWC", stem="s2d")
+    params, state = m1.init_params(0)
+    params2, state2 = m2.init_params(0)
+    leaves, _ = jax.tree_util.tree_flatten(params)
+    _, treedef = jax.tree_util.tree_flatten(params2)
+    params2 = jax.tree_util.tree_unflatten(treedef, leaves)
+    sleaves, _ = jax.tree_util.tree_flatten(state)
+    _, streedef = jax.tree_util.tree_flatten(state2)
+    state2 = jax.tree_util.tree_unflatten(streedef, sleaves)
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.rand(1, 224, 224, 3).astype(np.float32))
+    y1, _ = m1.run(params, x, state=state, training=False)
+    y2, _ = m2.run(params2, x, state=state2, training=False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-4)
