@@ -295,8 +295,11 @@ def bench_int8():
 
 
 def bench_resnet50():
-    # NHWC: measured 2.7x over NCHW on v5e (convs tile HWIO onto the MXU
-    # without the transpose pairs XLA inserts around NCHW batch-norms)
+    # NHWC is the TPU-native layout (no transpose pairs around NCHW
+    # batch-norms).  Honest full-step throughput is layout-insensitive
+    # here (~2,600 img/s b256 — the step is backward/BN-bound, see
+    # docs/performance.md); the earlier "2.7x NHWC" figure was a
+    # forward-only measurement artifact.
     from bigdl_tpu.models import resnet
     model = resnet.build(class_num=1000, depth=50, dataset="imagenet",
                          format="NHWC")
