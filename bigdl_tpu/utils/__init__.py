@@ -1,3 +1,4 @@
 """bigdl_tpu.utils — shared utilities (≙ com.intel.analytics.bigdl.utils)."""
 from .table import Table, T, as_list
 from . import crc32c  # module (crc32c.crc32c / crc32c.masked_crc32c)
+from . import common  # pyspark bigdl.util.common compat (JTensor, ...)

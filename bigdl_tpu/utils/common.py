@@ -1,0 +1,154 @@
+"""pyspark `bigdl.util.common` compatibility surface.
+
+The reference's util/common.py (pyspark/bigdl/util/common.py:46-460) is
+mostly py4j plumbing (GatewayWrapper, JavaCreator, callBigDlFunc); the
+user-visible names that appear throughout reference example code are
+kept here so ported scripts run unchanged:
+
+- ``JTensor.from_ndarray / sparse / to_ndarray`` (common.py:149) — a
+  host-side tensor envelope.  Here it wraps numpy directly (no JVM
+  wire format); ``sparse`` round-trips through
+  :class:`bigdl_tpu.tensor.SparseTensor`.
+- ``Sample.from_ndarray`` (common.py:290) — re-exported from
+  :mod:`bigdl_tpu.data.minibatch` with the classmethod added.
+- ``EvaluatedResult`` (common.py:115) — named-tuple-style result view.
+- ``init_engine`` / ``init_executor_gateway`` / ``get_node_and_core_number``
+  (common.py:410-425) — engine bootstrap; on TPU this maps onto
+  :mod:`bigdl_tpu.utils.engine` (mesh/threads), and the gateway call is
+  a no-op kept for script compatibility.
+- ``get_dtype``, ``RNG`` (common.py:138, 388).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import engine
+
+__all__ = ["JTensor", "Sample", "EvaluatedResult", "get_dtype",
+           "init_engine", "init_executor_gateway",
+           "get_node_and_core_number", "RNG"]
+
+
+def get_dtype(bigdl_type="float"):
+    """common.py:138 — 'float'/'double' to numpy dtype."""
+    return np.float64 if bigdl_type == "double" else np.float32
+
+
+class JTensor:
+    """Dense or sparse host tensor envelope (common.py:149).
+
+    `storage`/`shape` are numpy arrays exactly as in the reference;
+    `indices` non-None marks a sparse tensor (flattened, zero-based,
+    laid out indices[d * nnz + i] like the reference wire format).
+    """
+
+    def __init__(self, storage, shape, bigdl_type="float", indices=None):
+        self.storage = np.array(storage, dtype=get_dtype(bigdl_type))
+        self.shape = np.array(shape, dtype=np.int32).reshape(-1)
+        self.indices = (None if indices is None
+                        else np.array(indices, dtype=np.int32))
+        self.bigdl_type = bigdl_type
+
+    @classmethod
+    def from_ndarray(cls, a_ndarray, bigdl_type="float"):
+        if a_ndarray is None:
+            return None
+        a_ndarray = np.asarray(a_ndarray)
+        return cls(a_ndarray, a_ndarray.shape or (a_ndarray.size,),
+                   bigdl_type)
+
+    @classmethod
+    def sparse(cls, a_ndarray, i_ndarray, shape, bigdl_type="float"):
+        """common.py:215 — values + (ndim, nnz) indices + dense shape."""
+        if a_ndarray is None:
+            return None
+        a_ndarray = np.asarray(a_ndarray)
+        i_ndarray = np.asarray(i_ndarray)
+        shape = np.asarray(shape)
+        if i_ndarray.size != a_ndarray.size * shape.size:
+            raise ValueError("size of values and indices should match")
+        return cls(a_ndarray, shape, bigdl_type, i_ndarray)
+
+    def to_ndarray(self):
+        if self.indices is not None:
+            raise ValueError("sparse JTensor does not support to_ndarray "
+                             "(reference parity); use to_sparse_tensor()")
+        return self.storage.reshape(tuple(self.shape))
+
+    def to_sparse_tensor(self):
+        """TPU-side extension: view a sparse JTensor as a
+        :class:`bigdl_tpu.tensor.SparseTensor` (BCOO)."""
+        from ..tensor import SparseTensor
+        nnz = self.storage.size
+        idx = self.indices.reshape(len(self.shape), nnz)   # (ndim, nnz)
+        return SparseTensor(idx, self.storage, tuple(int(s)
+                                                     for s in self.shape))
+
+    def __str__(self):
+        kind = "SparseTensor" if self.indices is not None else "DenseTensor"
+        return (f"JTensor: storage: {self.storage}, shape: {self.shape}, "
+                f"{kind}")
+
+    __repr__ = __str__
+
+
+from ..data.minibatch import Sample as _Sample  # noqa: E402
+
+
+class Sample(_Sample):
+    """common.py:290 — adds the classmethod constructors to the data
+    pipeline's Sample."""
+
+    @classmethod
+    def from_ndarray(cls, features, labels, bigdl_type="float"):
+        return cls(features, labels)
+
+    @classmethod
+    def from_jtensor(cls, features, labels, bigdl_type="float"):
+        feats = features if isinstance(features, (list, tuple)) \
+            else [features]
+        labs = labels if isinstance(labels, (list, tuple)) else [labels]
+        return cls([f.to_ndarray() for f in feats],
+                   [l.to_ndarray() if isinstance(l, JTensor)
+                    else np.asarray(l) for l in labs])
+
+
+class EvaluatedResult:
+    """common.py:115 — (result, total_num, method) triple as returned by
+    Evaluator/validate."""
+
+    def __init__(self, result, total_num, method):
+        self.result = result
+        self.total_num = total_num
+        self.method = method
+
+    def __str__(self):
+        return (f"Evaluated result: {self.result}, "
+                f"total_num: {self.total_num}, method: {self.method}")
+
+    __repr__ = __str__
+
+
+def init_engine(bigdl_type="float"):
+    """common.py:410 — engine bootstrap; maps to utils.engine.init()."""
+    if not engine.is_initialized():
+        engine.init()
+
+
+def init_executor_gateway(sc=None, bigdl_type="float"):
+    """common.py:416 — py4j gateway setup; nothing to do without a JVM."""
+
+
+def get_node_and_core_number(bigdl_type="float"):
+    """common.py:421 — (nodes, cores) from the engine."""
+    if not engine.is_initialized():
+        engine.init()
+    return engine.node_number(), engine.core_number()
+
+
+def RNG(bigdl_type="float"):
+    """common.py:388 — the shared host generator (reference semantics:
+    RNG() accesses one global RNG, so RNG().set_seed(s) affects later
+    RNG().uniform(...) calls)."""
+    from .random_generator import RNG as _global_rng
+    return _global_rng()

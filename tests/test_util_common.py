@@ -1,0 +1,81 @@
+"""pyspark bigdl.util.common compat surface (utils/common.py).
+
+Mirrors the doctest behavior in the reference's
+pyspark/bigdl/util/common.py:149-260 (JTensor dense/sparse round trips)
+without a JVM.
+"""
+import numpy as np
+import pytest
+
+from bigdl_tpu.utils.common import (JTensor, Sample, EvaluatedResult,
+                                    get_dtype, init_engine,
+                                    get_node_and_core_number, RNG)
+
+
+def test_jtensor_dense_roundtrip():
+    np.random.seed(123)
+    data = np.random.uniform(0, 1, (2, 3)).astype("float32")
+    t = JTensor.from_ndarray(data)
+    np.testing.assert_allclose(t.storage.reshape(2, 3), data, rtol=1e-6)
+    np.testing.assert_allclose(t.shape, np.array([2, 3]))
+    assert (t.to_ndarray() == data).all()
+    assert JTensor.from_ndarray(None) is None
+
+
+def test_jtensor_scalar_and_dtype():
+    t = JTensor.from_ndarray(np.float64(3.5).reshape(()))
+    assert t.to_ndarray().shape == (1,) or t.to_ndarray().size == 1
+    assert get_dtype("double") == np.float64
+    assert get_dtype("float") == np.float32
+
+
+def test_jtensor_sparse():
+    # the reference's own doctest example (common.py:215)
+    data = np.arange(1, 7).astype("float32")
+    indices = np.arange(1, 7)
+    shape = np.array([10])
+    t = JTensor.sparse(data, indices, shape)
+    np.testing.assert_allclose(t.storage, data)
+    np.testing.assert_allclose(t.indices, indices)
+    with pytest.raises(ValueError):
+        t.to_ndarray()
+    sp = t.to_sparse_tensor()
+    dense = np.asarray(sp.to_dense())
+    expect = np.array([0, 1, 2, 3, 4, 5, 6, 0, 0, 0], np.float32)
+    np.testing.assert_allclose(dense, expect)
+
+    with pytest.raises(ValueError):
+        JTensor.sparse(data, indices[:3], shape)
+
+
+def test_jtensor_sparse_2d():
+    vals = np.array([1, 3, 2, 4], np.float32)
+    idx = np.array([[0, 0, 1, 2], [0, 3, 2, 1]])
+    t = JTensor.sparse(vals, idx, np.array([3, 4]))
+    dense = np.asarray(t.to_sparse_tensor().to_dense())
+    expect = np.array([[1, 0, 0, 3], [0, 0, 2, 0], [0, 4, 0, 0]],
+                      np.float32)
+    np.testing.assert_allclose(dense, expect)
+
+
+def test_sample_constructors():
+    f = np.ones((4, 2), np.float32)
+    l = np.float32(1.0)
+    s = Sample.from_ndarray(f, l)
+    assert s.feature().shape == (4, 2)
+    s2 = Sample.from_jtensor(JTensor.from_ndarray(f),
+                             JTensor.from_ndarray(np.asarray(l)))
+    np.testing.assert_allclose(s2.feature(), f)
+
+
+def test_engine_and_rng():
+    init_engine()
+    nodes, cores = get_node_and_core_number()
+    assert nodes >= 1 and cores >= 1
+    r = RNG()
+    r.set_seed(7)
+    a = r.uniform(0, 1, (3,))
+    r.set_seed(7)
+    b = r.uniform(0, 1, (3,))
+    np.testing.assert_allclose(a, b)
+    assert "Evaluated result" in str(EvaluatedResult(0.5, 10, "Top1"))
