@@ -326,7 +326,9 @@ class TransformerLM(Module):
             out.update(c.init(jax.random.fold_in(rng, i)))
         return out
 
-    def apply(self, params, x, ctx):
+    def apply_trunk(self, params, x, ctx):
+        """Everything up to (and including) the final norm: (B, S) int ->
+        hidden states (B, S, d_model) in cfg.dtype."""
         cfg = self.cfg
         h = self.embed.apply(params, x, ctx)
         h = h.astype(jnp.dtype(cfg.dtype))
@@ -341,13 +343,71 @@ class TransformerLM(Module):
             else:
                 h = blk.apply(params, h, ctx)
 
-        h = self.final_norm.apply(params, h, ctx)
+        return self.final_norm.apply(params, h, ctx)
+
+    def head_logits(self, params, h, ctx):
+        """Vocab projection of trunk hiddens (dtype preserved)."""
         if self.head is not None:
-            logits = self.head.apply(params, h, ctx)
-        else:
-            w = params[self.embed.name]["weight"]        # (V, D) tied
-            logits = jnp.dot(h, w.T.astype(h.dtype))
-        return logits.astype(jnp.float32)
+            return self.head.apply(params, h, ctx)
+        w = params[self.embed.name]["weight"]            # (V, D) tied
+        return jnp.dot(h, w.T.astype(h.dtype))
+
+    def apply(self, params, x, ctx):
+        h = self.apply_trunk(params, x, ctx)
+        return self.head_logits(params, h, ctx).astype(jnp.float32)
+
+    def token_nll(self, params, tokens, targets, *, ignore_index=-1,
+                  loss_chunk=None, training=False, rng=None, ctx=None):
+        """(sum of masked token NLLs, valid-token count), optionally with
+        the head+loss computed per sequence chunk.
+
+        ``loss_chunk=c`` (must divide S) never materializes more than
+        (B, c, V) logits: each chunk's projection and log-sum-exp run
+        under ``jax.checkpoint`` inside a ``lax.scan``, so the backward
+        recomputes chunk logits instead of holding the full (B, S, V)
+        fp32 tensor — the memory wall for long-context vocab losses
+        (S=8k, V=32k is 1 GB per sample in fp32).  Numerics are
+        identical to the unchunked path (same per-token log-sum-exp;
+        only the summation order over chunks differs).
+        """
+        if ctx is None:
+            ctx = Ctx(state={}, training=training, rng_key=rng)
+        h = self.apply_trunk(params, tokens, ctx)
+        S = h.shape[1]
+        if not loss_chunk or loss_chunk >= S:
+            logits = self.head_logits(params, h, ctx).astype(jnp.float32)
+            return lm_token_nll(logits, targets, ignore_index)
+        if S % loss_chunk:
+            raise ValueError(f"loss_chunk {loss_chunk} must divide "
+                             f"sequence length {S}")
+        n = S // loss_chunk
+        B, _, D = h.shape
+        hc = jnp.moveaxis(h.reshape(B, n, loss_chunk, D), 1, 0)
+        tc = jnp.moveaxis(targets.reshape(B, n, loss_chunk), 1, 0)
+        head_ctx = Ctx(state={}, training=ctx.training, rng_key=None)
+
+        @jax.checkpoint
+        def chunk_nll(p, h_c, t_c):
+            logits = self.head_logits(p, h_c, head_ctx) \
+                         .astype(jnp.float32)
+            return lm_token_nll(logits, t_c, ignore_index)
+
+        def body(carry, xs):
+            tot, cnt = chunk_nll(params, *xs)
+            return (carry[0] + tot, carry[1] + cnt), None
+
+        (tot, cnt), _ = lax.scan(
+            body, (jnp.float32(0), jnp.float32(0)), (hc, tc))
+        return tot, cnt
+
+    def loss(self, params, tokens, targets, *, ignore_index=-1,
+             loss_chunk=None, training=False, rng=None, ctx=None):
+        """Mean masked token cross-entropy (see :meth:`token_nll`)."""
+        tot, cnt = self.token_nll(params, tokens, targets,
+                                  ignore_index=ignore_index,
+                                  loss_chunk=loss_chunk, training=training,
+                                  rng=rng, ctx=ctx)
+        return tot / jnp.maximum(cnt, 1.0)
 
     # -- generation (kv cache) ----------------------------------------- #
     def init_cache(self, batch: int, dtype=None, cache_len=None):

@@ -31,7 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_lib
 from .ring_attention import ring_attention_shmap
-from ..models.transformer import TransformerLM, lm_cross_entropy
+from ..models.transformer import TransformerLM
 from ..optim.optimizer import make_accum_grads
 
 
@@ -66,7 +66,8 @@ class SpmdTrainer:
     def __init__(self, model: TransformerLM, optim, mesh: Optional[Mesh] = None,
                  fsdp: bool = True, seed: int = 0,
                  ring_attention: Optional[bool] = None,
-                 min_fsdp_size: int = 2 ** 16, grad_accum: int = 1):
+                 min_fsdp_size: int = 2 ** 16, grad_accum: int = 1,
+                 loss_chunk: Optional[int] = None):
         self.model = model
         self.optim = optim
         self.mesh = mesh or mesh_lib.get_mesh()
@@ -82,6 +83,9 @@ class SpmdTrainer:
                                  if a in self.mesh.axis_names)
         self._seq_axis = "sp" if "sp" in self.mesh.axis_names else None
         self.grad_accum = int(grad_accum)
+        # chunked head+loss: caps logits memory at (B, chunk, V) — see
+        # TransformerLM.token_nll.  None = single full-sequence projection.
+        self.loss_chunk = loss_chunk
         self.params = None
         self.opt_state = None
         self._step_fn = None
@@ -154,16 +158,18 @@ class SpmdTrainer:
 
         n_accum = self.grad_accum
 
+        loss_chunk = self.loss_chunk
+
         def loss_fn(p, tokens, targets, rng):
             from ..nn.module import Ctx
             ctx = Ctx(state={}, training=True, rng_key=rng)
-            logits = model.apply(p, tokens, ctx)
-            loss = lm_cross_entropy(logits, targets)
+            loss = model.loss(p, tokens, targets, loss_chunk=loss_chunk,
+                              ctx=ctx)
             for sl in ctx.side_losses:   # e.g. MoE load-balancing aux
                 loss = loss + sl
             return loss
 
-        # lm_cross_entropy is a MASKED token mean, so microbatches are
+        # model.loss is a MASKED token mean, so microbatches are
         # weighted by their valid-token count (equal weighting would
         # misweight padded batches — see make_accum_grads)
         grads_fn = make_accum_grads(
@@ -209,13 +215,14 @@ class SpmdTrainer:
         self.attach()
         model = self.model
         if getattr(self, "_eval_fn", None) is None:
-            from ..models.transformer import lm_token_nll
+            loss_chunk = self.loss_chunk
 
             def eval_fn(params, tokens, targets):
-                from ..nn.module import Ctx
-                ctx = Ctx(state={}, training=False, rng_key=None)
-                logits = model.apply(params, tokens, ctx)
-                return lm_token_nll(logits, targets)
+                # same chunked head+loss as training: evaluate must not
+                # re-introduce the (B, S, V) logits memory wall
+                return model.token_nll(params, tokens, targets,
+                                       loss_chunk=loss_chunk,
+                                       training=False)
             self._eval_fn = jax.jit(eval_fn)
         sh = self._batch_sharding()
         if steps is not None:   # islice: never pull an extra batch from a
