@@ -29,8 +29,12 @@ def main():
 
     model = T.build("tiny", use_ring_attention=axes.get("sp", 1) > 1,
                     remat=True)
+    # loss_chunk: the long-context memory levers in one place — remat
+    # bounds block activations, ring attention shards the sequence, and
+    # the chunked vocab loss caps logits at (B, chunk, V)
     trainer = SpmdTrainer(model, AdamW(learning_rate=args.lr), mesh=mesh,
-                          fsdp="fsdp" in axes, min_fsdp_size=1).init()
+                          fsdp="fsdp" in axes, min_fsdp_size=1,
+                          loss_chunk=32).init()
 
     rs = np.random.RandomState(0)
     bsz = 2 * axes.get("dp", 1) * axes.get("fsdp", 1)
