@@ -377,28 +377,10 @@ class TransformerLM(Module):
         if not loss_chunk or loss_chunk >= S:
             logits = self.head_logits(params, h, ctx).astype(jnp.float32)
             return lm_token_nll(logits, targets, ignore_index)
-        if S % loss_chunk:
-            raise ValueError(f"loss_chunk {loss_chunk} must divide "
-                             f"sequence length {S}")
-        n = S // loss_chunk
-        B, _, D = h.shape
-        hc = jnp.moveaxis(h.reshape(B, n, loss_chunk, D), 1, 0)
-        tc = jnp.moveaxis(targets.reshape(B, n, loss_chunk), 1, 0)
         head_ctx = Ctx(state={}, training=ctx.training, rng_key=None)
-
-        @jax.checkpoint
-        def chunk_nll(p, h_c, t_c):
-            logits = self.head_logits(p, h_c, head_ctx) \
-                         .astype(jnp.float32)
-            return lm_token_nll(logits, t_c, ignore_index)
-
-        def body(carry, xs):
-            tot, cnt = chunk_nll(params, *xs)
-            return (carry[0] + tot, carry[1] + cnt), None
-
-        (tot, cnt), _ = lax.scan(
-            body, (jnp.float32(0), jnp.float32(0)), (hc, tc))
-        return tot, cnt
+        return chunked_token_nll(
+            lambda h_c: self.head_logits(params, h_c, head_ctx),
+            h, targets, loss_chunk, ignore_index)
 
     def loss(self, params, tokens, targets, *, ignore_index=-1,
              loss_chunk=None, training=False, rng=None, ctx=None):
@@ -666,6 +648,37 @@ class TransformerLM(Module):
             ps = getattr(mod, "pspec", {}) if mod is not None else {}
             specs[mod_name] = {k: ps.get(k, P()) for k in sub}
         return specs
+
+
+def chunked_token_nll(head_fn, h, targets, loss_chunk,
+                      ignore_index: int = -1):
+    """(total masked NLL, valid count) with the vocab projection done per
+    sequence chunk under ``jax.checkpoint`` inside a ``lax.scan``.
+
+    ``head_fn(h_chunk) -> logits_chunk`` closes over the head params;
+    their gradient contributions accumulate through the scan transpose.
+    Peak logits memory is (B, loss_chunk, V).  Shared by
+    :meth:`TransformerLM.token_nll` and the pipeline trainer."""
+    B, S, D = h.shape
+    if S % loss_chunk:
+        raise ValueError(f"loss_chunk {loss_chunk} must divide "
+                         f"sequence length {S}")
+    n = S // loss_chunk
+    hc = jnp.moveaxis(h.reshape(B, n, loss_chunk, D), 1, 0)
+    tc = jnp.moveaxis(targets.reshape(B, n, loss_chunk), 1, 0)
+
+    @jax.checkpoint
+    def chunk_nll(h_c, t_c):
+        logits = head_fn(h_c).astype(jnp.float32)
+        return lm_token_nll(logits, t_c, ignore_index)
+
+    def body(carry, xs):
+        tot, cnt = chunk_nll(*xs)
+        return (carry[0] + tot, carry[1] + cnt), None
+
+    (tot, cnt), _ = lax.scan(
+        body, (jnp.float32(0), jnp.float32(0)), (hc, tc))
+    return tot, cnt
 
 
 def lm_token_nll(logits, targets, ignore_index: int = -1):

@@ -119,7 +119,8 @@ class PipelineLMTrainer:
     the shard_map — GSPMD keeps the pp layout for block params/moments.
     """
 
-    def __init__(self, model, optim, mesh, n_microbatches=4, seed=0):
+    def __init__(self, model, optim, mesh, n_microbatches=4, seed=0,
+                 loss_chunk=None):
         if model.frozen_param_names():
             raise NotImplementedError(
                 "Module.freeze is not supported by PipelineLMTrainer "
@@ -141,6 +142,9 @@ class PipelineLMTrainer:
                 f"n_layers={cfg.n_layers} must divide by pp={self.n_stages}")
         self.template = model.blocks[0]
         self._block_names = [b.name for b in model.blocks]
+        # chunked head+loss on the last stage (same lever as
+        # SpmdTrainer(loss_chunk=...): logits capped at (B, c, V))
+        self.loss_chunk = loss_chunk
         self.params = None
         self.opt_state = None
         self._step_fn = None
@@ -187,12 +191,14 @@ class PipelineLMTrainer:
         return self
 
     def _build(self):
-        from ..models.transformer import lm_cross_entropy
+        from ..models.transformer import (lm_cross_entropy,
+                                          chunked_token_nll)
         from ..nn.module import Ctx
         model, template, optim = self.model, self.template, self.optim
         cfg = model.cfg
         n_micro, mesh = self.n_micro, self.mesh
         has_dp = "dp" in mesh.axis_names
+        loss_chunk = self.loss_chunk
 
         def local(rest, blocks_stage, tokens, targets):
             def loss_fn(rest, blocks_stage):
@@ -212,10 +218,20 @@ class PipelineLMTrainer:
                 h_out = outs.reshape(h.shape)
                 ctx2 = Ctx(state={}, training=True, rng_key=None)
                 h_out = model.final_norm.apply(rest, h_out, ctx2)
-                logits = model.head.apply(rest, h_out, ctx2) \
-                    if model.head is not None else \
-                    h_out @ rest[model.embed.name]["weight"].T
-                loss = lm_cross_entropy(logits, targets)
+
+                def head_fn(h_c):
+                    return (model.head.apply(rest, h_c, ctx2)
+                            if model.head is not None
+                            else h_c @ rest[model.embed.name]["weight"].T)
+
+                # same semantics as TransformerLM.token_nll: a chunk
+                # covering the whole sequence means no chunking
+                if loss_chunk and loss_chunk < h_out.shape[1]:
+                    tot, cnt = chunked_token_nll(head_fn, h_out, targets,
+                                                 loss_chunk)
+                    loss = tot / jnp.maximum(cnt, 1.0)
+                else:
+                    loss = lm_cross_entropy(head_fn(h_out), targets)
                 # differentiate the LOCAL masked contribution — putting a
                 # psum inside the differentiated function would make every
                 # rank seed a cotangent through it and scale all gradients

@@ -64,6 +64,35 @@ def test_chunk_must_divide_seq():
         model.token_nll(params, tokens, targets, loss_chunk=5)
 
 
+def test_pipeline_trainer_loss_chunk_step_parity():
+    """PipelineLMTrainer with loss_chunk equals the unchunked trainer."""
+    from bigdl_tpu.parallel.mesh import create_mesh
+    from bigdl_tpu.parallel.pipeline import PipelineLMTrainer
+    from bigdl_tpu.optim import SGD
+
+    mesh = create_mesh({"dp": 2, "pp": 2})
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_len=32, dropout=0.0)
+    rng = np.random.RandomState(2)
+    tokens = rng.randint(0, 64, (4, 16)).astype(np.int32)
+    targets = rng.randint(0, 64, (4, 16)).astype(np.int32)
+
+    losses, finals = [], []
+    for chunk in (None, 8):
+        model = TransformerLM(cfg)
+        tr = PipelineLMTrainer(model, SGD(learning_rate=0.1), mesh,
+                               n_microbatches=2, seed=0, loss_chunk=chunk)
+        tr.init()
+        for _ in range(2):
+            loss = tr.step(jnp.asarray(tokens), jnp.asarray(targets))
+        losses.append(float(loss))
+        finals.append(jax.tree_util.tree_leaves(tr.merge())[0])
+    assert abs(losses[0] - losses[1]) < 1e-5
+    np.testing.assert_allclose(np.asarray(finals[0]),
+                               np.asarray(finals[1]),
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_spmd_trainer_loss_chunk_step_parity():
     """One SpmdTrainer step with loss_chunk equals one without (the
     chunked projection is exact, so the whole fused step must be)."""
