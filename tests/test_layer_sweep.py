@@ -93,6 +93,10 @@ SPECS = {
                            lambda: R(2, 3, 8, 8)),
     "SpatialShareConvolution": (
         lambda: nn.SpatialShareConvolution(3, 4, 3, 3), lambda: R(2, 3, 8, 8)),
+    "SpaceToDepthConvolution": (
+        lambda: nn.SpaceToDepthConvolution(3, 4, 3, 3, 2, 2, 1, 1,
+                                           format="NHWC"),
+        lambda: R(2, 8, 8, 3)),
     "SpatialDilatedConvolution": (
         lambda: nn.SpatialDilatedConvolution(3, 4, 3, 3, 1, 1, 1, 1, 2, 2),
         lambda: R(2, 3, 9, 9)),
