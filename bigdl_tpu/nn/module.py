@@ -424,10 +424,11 @@ class Module:
         return {m.name for m in self.modules()
                 if getattr(m, "_frozen", False)}
 
-    def quantize(self):
-        """Post-training int8 rewrite (≙ Layer.quantize)."""
+    def quantize(self, calibration_data=None):
+        """Post-training int8 rewrite (≙ Layer.quantize);
+        ``calibration_data`` bakes static activation scales."""
         from ..quantized import quantize as _q
-        return _q(self)
+        return _q(self, calibration_data=calibration_data)
 
     def _predictor(self, batch_size):
         # one long-lived Predictor per batch size: its jitted eval step

@@ -67,24 +67,32 @@ class QuantizedModule(Module):
 
 class QuantizedLinear(QuantizedModule):
     """int8 x int8 -> int32 GEMM with fp32 rescale
-    (≙ nn/quantized/Linear.scala)."""
+    (≙ nn/quantized/Linear.scala).
 
-    def __init__(self, weight, bias=None, name=None):
+    ``act_absmax`` (from :func:`calibrate_activation_absmax`) freezes the
+    activation scale: the runtime per-batch |x| reduction — a serialized
+    full pass over the input before the GEMM can start — disappears, and
+    the round/clip fuses into the producer's epilogue."""
+
+    def __init__(self, weight, bias=None, act_absmax=None, name=None):
         super().__init__(name=name)
         qw, wscale = quantize_weights_symmetric(np.asarray(weight), axis=0)
         self.qweight = jnp.asarray(qw)               # (out, in) int8
         self.wscale = jnp.asarray(wscale.reshape(-1))  # (out,)
         self.bias = None if bias is None else jnp.asarray(bias, jnp.float32)
+        self.act_absmax = None if act_absmax is None else float(act_absmax)
 
     @staticmethod
-    def from_float(layer: linear_mod.Linear, params=None) -> "QuantizedLinear":
+    def from_float(layer: linear_mod.Linear, params=None,
+                   act_absmax=None) -> "QuantizedLinear":
         p = params if params is not None \
             else layer.ensure_initialized()[layer.name]
         return QuantizedLinear(p["weight"], p.get("bias"),
+                               act_absmax=act_absmax,
                                name=f"{layer.name}_q")
 
     def apply(self, params, x, ctx):
-        qx, xscale = _quantize_activations(x)
+        qx, xscale = _quantize_activations(x, self.act_absmax)
         acc = lax.dot_general(
             qx, self.qweight,
             (((qx.ndim - 1,), (1,)), ((), ())),
@@ -100,7 +108,8 @@ class QuantizedSpatialConvolution(QuantizedModule):
     SpatialConvolution.scala). NCHW like the float layer."""
 
     def __init__(self, weight, bias=None, stride=(1, 1), padding=(0, 0),
-                 dilation=(1, 1), n_group=1, format="NCHW", name=None):
+                 dilation=(1, 1), n_group=1, format="NCHW",
+                 act_absmax=None, name=None):
         super().__init__(name=name)
         # float layer stores OIHW in both formats (only the activation
         # layout differs — see nn/conv.py SpatialConvolution.apply)
@@ -114,20 +123,23 @@ class QuantizedSpatialConvolution(QuantizedModule):
         self.padding = padding
         self.dilation = dilation
         self.n_group = n_group
+        self.act_absmax = None if act_absmax is None else float(act_absmax)
 
     @staticmethod
-    def from_float(layer, params=None) -> "QuantizedSpatialConvolution":
+    def from_float(layer, params=None,
+                   act_absmax=None) -> "QuantizedSpatialConvolution":
         p = params if params is not None \
             else layer.ensure_initialized()[layer.name]
         return QuantizedSpatialConvolution(
             np.asarray(p["weight"]), p.get("bias"), stride=layer.stride,
             padding=layer.pad, n_group=getattr(layer, "n_group", 1),
             format=getattr(layer, "format", "NCHW"),
+            act_absmax=act_absmax,
             name=f"{layer.name}_q")
 
     def apply(self, params, x, ctx):
         from ..nn.conv import _same_pad
-        qx, xscale = _quantize_activations(x)
+        qx, xscale = _quantize_activations(x, self.act_absmax)
         spatial = x.shape[2:4] if self.format == "NCHW" else x.shape[1:3]
         ksize = self.qweight.shape[2:4]
         # per-axis: -1 selects SAME on that axis only (mirrors the float
@@ -158,13 +170,14 @@ class QuantizedSpatialDilatedConvolution(QuantizedSpatialConvolution):
     path as the plain conv with rhs_dilation set."""
 
     @staticmethod
-    def from_float(layer, params=None) \
+    def from_float(layer, params=None, act_absmax=None) \
             -> "QuantizedSpatialDilatedConvolution":
         p = params if params is not None \
             else layer.ensure_initialized()[layer.name]
         return QuantizedSpatialDilatedConvolution(
             np.asarray(p["weight"]), p.get("bias"), stride=layer.stride,
             padding=layer.pad, dilation=layer.dilation,
+            act_absmax=act_absmax,
             name=f"{layer.name}_q")
 
 
@@ -182,18 +195,78 @@ def _register_defaults():
 _register_defaults()
 
 
-def quantize(model: Module) -> Module:
+def calibrate_activation_absmax(model: Module, batches, params=None,
+                                state=None):
+    """Per-quantizable-layer input |x| maxima over ``batches`` (a list or
+    iterable of model input arrays), collected in ONE jitted forward per
+    batch via the ctx state side channel.
+
+    Why: runtime activation quantization puts a full-tensor reduction in
+    front of every int8 GEMM/conv — a serialized extra pass over the
+    activations that makes the int8 path HBM-bound.  Static calibrated
+    scales remove it (the standard post-training-quantization recipe;
+    the reference's runtime quantization is the MKL-era equivalent,
+    nn/quantized/Linear.scala updateOutput)."""
+    params = params if params is not None else model.ensure_initialized()
+    state = state if state is not None else dict(model._state or {})
+    targets = [m for m in model.modules() if type(m) in _QUANTIZABLE]
+    origs = []
+    for m in targets:
+        orig = m.apply
+
+        def wrapped(p, x, ctx, _m=m, _orig=orig):
+            cur = jnp.max(jnp.abs(x.astype(jnp.float32)))
+            key = "__calib__" + _m.name
+            prev = ctx.new_state.get(key)
+            ctx.new_state[key] = cur if prev is None \
+                else jnp.maximum(prev, cur)
+            return _orig(p, x, ctx)
+
+        m.apply = wrapped
+        origs.append((m, orig))
+    try:
+        run = jax.jit(lambda p, s, x: model.run(p, x, state=s,
+                                                training=False)[1])
+        out = {}
+        for x in batches:
+            st = run(params, state, jnp.asarray(x))
+            for m in targets:
+                v = st.get("__calib__" + m.name)
+                if v is not None:
+                    # same floor as the runtime path: an all-zero input
+                    # (dead ReLU / gated branch) must not bake scale 0
+                    out[m.name] = max(out.get(m.name, 0.0), float(v),
+                                      1e-8)
+    finally:
+        for m, _ in origs:
+            try:
+                del m.apply          # drop the instance shadow
+            except AttributeError:
+                pass
+    return out
+
+
+def quantize(model: Module, calibration_data=None) -> Module:
     """Deep-copy `model` with every quantizable layer replaced
     (≙ nn/quantized/Quantizer.scala quantize).  The trained weights live in
     the model's flat params tree keyed by module name, so the tree is
     threaded down and sliced by child name.  Non-quantized children KEEP
     their trained params and state (the reference Quantizer preserves
     them too): only the entries of replaced children are dropped from the
-    carried tree — the quantized twins own frozen int8 weights instead."""
+    carried tree — the quantized twins own frozen int8 weights instead.
+
+    ``calibration_data`` (iterable of input batches) bakes static
+    activation scales into the quantized twins via
+    :func:`calibrate_activation_absmax`; without it activations are
+    quantized at runtime per batch (reference behavior)."""
     params = model.ensure_initialized()
     state = dict(model._state or {})
+    absmax = {}
+    if calibration_data is not None:
+        absmax = calibrate_activation_absmax(model, calibration_data,
+                                             params=params, state=state)
     replaced: list = []
-    new_model = _rewrite(model, params, replaced)
+    new_model = _rewrite(model, params, replaced, absmax)
     if isinstance(new_model, (containers_mod.Container, graph_mod.Graph)):
         dropped = set(replaced)
         new_model._params = {k: v for k, v in params.items()
@@ -203,14 +276,16 @@ def quantize(model: Module) -> Module:
     return new_model
 
 
-def _rewrite(module: Module, params, replaced) -> Module:
+def _rewrite(module: Module, params, replaced, absmax=None) -> Module:
+    absmax = absmax or {}
     fn = _QUANTIZABLE.get(type(module))
     if fn is not None:
         replaced.append(module.name)
-        return fn(module, params.get(module.name))
+        return fn(module, params.get(module.name),
+                  act_absmax=absmax.get(module.name))
     if isinstance(module, containers_mod.Container):
         clone = copy.copy(module)
-        clone._children = [_rewrite(c, params, replaced)
+        clone._children = [_rewrite(c, params, replaced, absmax)
                            for c in module.children()]
         # the top-level clone gets the carried trained tree in quantize();
         # intermediate clones must not cache stale float params
@@ -222,7 +297,7 @@ def _rewrite(module: Module, params, replaced) -> Module:
         mapping = {}
         for node in module._topo:
             new_mod = None if node.module is None \
-                else _rewrite(node.module, params, replaced)
+                else _rewrite(node.module, params, replaced, absmax)
             mapping[id(node)] = graph_mod.Node(
                 new_mod, [mapping[id(p)] for p in node.prev_nodes])
         clone = copy.copy(module)

@@ -233,3 +233,76 @@ def test_quantize_resnet_nhwc_close_to_float():
     y1 = np.asarray(q.forward(x))
     rel = np.abs(y1 - y0).max() / max(np.abs(y0).max(), 1e-6)
     assert rel < 0.05, rel
+
+
+def test_calibrated_activation_scales():
+    """quantize(model, calibration_data=...) bakes static activation
+    scales (the TPU-side lever that removes the per-batch |x| reduction
+    before every int8 GEMM; see quantized/__init__.py docstrings)."""
+    from bigdl_tpu.quantized import (quantize, calibrate_activation_absmax,
+                                     QuantizedSpatialConvolution,
+                                     QuantizedLinear)
+
+    m = nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1),
+        nn.ReLU(),
+        nn.SpatialConvolution(8, 4, 1, 1),
+        nn.Reshape((4 * 8 * 8,)),
+        nn.Linear(4 * 8 * 8, 10))
+    m.reset(0)
+    rng = np.random.RandomState(3)
+    calib = [rng.rand(2, 3, 8, 8).astype(np.float32) for _ in range(3)]
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    y_float = np.asarray(m.forward(x))
+
+    absmax = calibrate_activation_absmax(m, calib)
+    assert len(absmax) == 3 and all(v > 0 for v in absmax.values())
+    # the float model is restored (no recorder shadows left behind)
+    assert all("apply" not in mod.__dict__ for mod in m.modules())
+
+    q = quantize(m, calibration_data=calib)
+    qlayers = [c for c in q.modules()
+               if isinstance(c, (QuantizedSpatialConvolution,
+                                 QuantizedLinear))]
+    assert qlayers and all(l.act_absmax is not None for l in qlayers)
+
+    y_q = np.asarray(q.forward(x))
+    rel = np.abs(y_q - y_float).max() / max(np.abs(y_float).max(), 1e-6)
+    assert rel < 0.08, rel
+
+    # static scales: doubling the input magnitude must NOT double the
+    # quantization range (runtime quantization would adapt; calibrated
+    # scales clip instead)
+    q_rt = quantize(m)
+    big = (4.0 * x).astype(np.float32)
+    y_static = np.asarray(q.forward(big))
+    y_runtime = np.asarray(q_rt.forward(big))
+    assert np.abs(y_static - y_runtime).max() > 1e-3
+
+
+def test_calibrated_quantized_serde_roundtrip():
+    import os
+    import tempfile
+    from bigdl_tpu.quantized import quantize
+    from bigdl_tpu.utils.serializer import save_module, load_module
+
+    m = nn.Sequential(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+                      nn.ReLU(),
+                      nn.Reshape((4 * 8 * 8,)),
+                      nn.Linear(4 * 8 * 8, 5))
+    m.reset(0)
+    rng = np.random.RandomState(4)
+    calib = [rng.rand(2, 3, 8, 8).astype(np.float32)]
+    q = quantize(m, calibration_data=calib)
+    x = rng.rand(2, 3, 8, 8).astype(np.float32)
+    y_q = np.asarray(q.forward(x))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "qc.bigdl_tpu")
+        save_module(q, p)
+        q2 = load_module(p)
+    y_q2 = np.asarray(q2.forward(x))
+    np.testing.assert_allclose(y_q2, y_q, rtol=1e-6, atol=1e-6)
+    from bigdl_tpu.quantized import QuantizedLinear
+    l2 = [c for c in q2.modules() if isinstance(c, QuantizedLinear)]
+    assert l2 and l2[0].act_absmax is not None
