@@ -1,0 +1,55 @@
+"""Run the queued TPU measurements, wedge-resiliently.
+
+Each step runs in its OWN subprocess with a hard timeout: a wedged
+compile (the failure mode that ate K2/K3 on 2026-07-31 — 25-minute hang
+then `remote_compile: Connection refused`) kills only that subprocess.
+A timeout aborts the whole queue (a wedged tunnel won't serve the next
+step either, and more traffic prolongs the wedge).
+
+Usage: python scripts/tpu_queue.py            # probe, then run queue
+       python scripts/tpu_queue.py --list     # show the queue
+"""
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+PY = sys.executable
+
+QUEUE = [
+    # (label, argv, timeout_s)
+    ("probe", [PY, os.path.join(HERE, "tpu_probe.py"), "120"], 150),
+    ("K2 s2d stem full step",
+     [PY, os.path.join(HERE, "perf_experiments4.py"), "K2"], 1500),
+    ("K3 autodiff-BN full step",
+     [PY, os.path.join(HERE, "perf_experiments4.py"), "K3"], 1500),
+    ("transformer tuning matrix",
+     [PY, os.path.join(HERE, "transformer_tuning.py"), "matrix"], 2400),
+]
+
+
+def main():
+    if "--list" in sys.argv:
+        for label, argv, t in QUEUE:
+            print(f"{label:30s} timeout={t}s: {' '.join(argv)}")
+        return 0
+    t0 = time.time()
+    for label, argv, timeout in QUEUE:
+        print(f"== {label} (timeout {timeout}s) ==", flush=True)
+        try:
+            proc = subprocess.run(argv, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            print(f"== {label}: TIMED OUT after {timeout}s — tunnel "
+                  "presumed wedged, aborting queue ==", flush=True)
+            return 2
+        if proc.returncode != 0:
+            print(f"== {label}: rc={proc.returncode} — aborting queue "
+                  "(probe failure or wedge) ==", flush=True)
+            return proc.returncode
+        print(f"== {label}: done at +{time.time()-t0:.0f}s ==", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
