@@ -2,7 +2,7 @@
 
 The TPU-preferred NHWC layout (bench.py, __graft_entry__.entry) must be a
 pure layout change: identical params (conv weights are stored OIHW either
-way), identical numerics.  Guards the 2.7x NHWC fast path against layout
+way), identical numerics.  Guards the NHWC fast path against layout
 bugs (≙ reference DataFormat tests, nn/abstractnn/DataFormat.scala).
 """
 import numpy as np
