@@ -1,0 +1,85 @@
+"""Regenerate docs/api.md from the live `bigdl_tpu.nn` registry.
+
+CPU-only; run after adding/removing nn exports:
+
+    PYTHONPATH= JAX_PLATFORMS=cpu python scripts/gen_api_index.py
+
+One row per exported class name, grouped by defining submodule, first
+docstring line as the summary; names bound to the same object as
+another export are annotated as aliases.
+"""
+import inspect
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+from bigdl_tpu import nn                                   # noqa: E402
+
+
+def first_line(obj):
+    doc = inspect.getdoc(obj) or ""
+    line = doc.split("\n", 1)[0].strip()
+    return line.replace("|", "\\|")
+
+
+def main():
+    out_path = os.path.join(os.path.dirname(__file__), os.pardir,
+                            "docs", "api.md")
+    exports = {}
+    for name in sorted(dir(nn)):
+        if name.startswith("_"):
+            continue
+        obj = getattr(nn, name)
+        if not inspect.isclass(obj):
+            continue
+        exports[name] = obj
+
+    # group by defining submodule (strip the package prefix)
+    groups = {}
+    canonical = {}          # id(obj) -> first export name (alias detection)
+    for name, obj in exports.items():
+        mod = obj.__module__
+        short = mod.split("bigdl_tpu.")[-1] if "bigdl_tpu." in mod else mod
+        groups.setdefault(short, []).append(name)
+        canonical.setdefault(id(obj), name)
+
+    lines = [
+        f"# API index: `bigdl_tpu.nn` ({len(exports)} classes)",
+        "",
+        "Generated from the live registry (`scripts/gen_api_index.py`): "
+        "class docstring first lines (reference .scala citations inline); "
+        "same-object aliases are marked as such. One entry per exported "
+        "name.",
+        "",
+    ]
+    for short in sorted(groups):
+        names = sorted(groups[short])
+        lines += [f"\n## `{short.replace('nn.', 'nn.', 1)}` "
+                  f"({len(names)})", "", "| class | summary |", "|---|---|"]
+        for name in names:
+            obj = exports[name]
+            canon = canonical[id(obj)]
+            if canon != name and obj.__name__ != name:
+                summary = f"Alias of `{canon}`."
+            else:
+                summary = first_line(obj) or "(no docstring)"
+            lines.append(f"| `{name}` | {summary} |")
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {os.path.normpath(out_path)}: {len(exports)} classes, "
+          f"{len(groups)} groups")
+
+
+if __name__ == "__main__":
+    main()
