@@ -122,3 +122,27 @@ def test_spmd_trainer_loss_chunk_step_parity():
     assert abs(losses[0] - losses[1]) < 1e-5
     np.testing.assert_allclose(np.asarray(finals[0]), np.asarray(finals[1]),
                                rtol=1e-5, atol=1e-6)
+
+
+def test_spmd_trainer_loss_chunk_with_grad_accum():
+    """loss_chunk composes with gradient accumulation: the microbatched
+    chunked step equals the microbatched unchunked step exactly."""
+    from bigdl_tpu.parallel.mesh import create_mesh
+    from bigdl_tpu.parallel.spmd import SpmdTrainer
+    from bigdl_tpu.optim import SGD
+
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_heads=2,
+                            n_layers=2, d_ff=64, max_len=32, dropout=0.0)
+    mesh = create_mesh({"dp": 2, "fsdp": 2, "tp": 2})
+    rng = np.random.RandomState(5)
+    tok = rng.randint(0, 64, (8, 16)).astype(np.int32)
+    tgt = rng.randint(0, 64, (8, 16)).astype(np.int32)
+
+    losses = []
+    for chunk in (4, None):
+        tr = SpmdTrainer(TransformerLM(cfg), SGD(learning_rate=0.1),
+                         mesh=mesh, grad_accum=2, loss_chunk=chunk,
+                         seed=0).init()
+        losses.append(float(tr.step(jnp.asarray(tok), jnp.asarray(tgt))))
+        tr.detach()
+    assert abs(losses[0] - losses[1]) < 1e-5
