@@ -50,7 +50,7 @@ def lat():
     return float(np.median(ls))
 
 
-def run_full(label, batch=256, stem="conv", k=10):
+def run_full(label, batch=256, stem="conv", k=10, x_bf16=False):
     model = resnet.build(class_num=1000, depth=50, dataset="imagenet",
                          format="NHWC", stem=stem)
     criterion = nn.ClassNLLCriterion()
@@ -59,6 +59,8 @@ def run_full(label, batch=256, stem="conv", k=10):
     opt_state = method.init_state(params)
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
+    if x_bf16:
+        x = x.astype(jnp.bfloat16)
     y = jnp.asarray(rng.randint(1, 1001, batch).astype(np.float32))
     step = make_train_step(model, criterion, method, mixed_precision=True)
     key = jax.random.PRNGKey(0)
@@ -110,12 +112,26 @@ def exp_K3():
         nz._bn_train = orig
 
 
+def exp_K4():
+    run_full("K4 s2d + bf16 input     ", stem="s2d", x_bf16=True)
+
+
+def exp_K5():
+    run_full("K5 conv stem, b128      ", batch=128, k=16)
+
+
+def exp_K6():
+    run_full("K6 s2d stem, b512       ", batch=512, stem="s2d", k=6)
+
+
 if __name__ == "__main__":
     which = sys.argv[1:] or ["K1", "K2", "K3"]
     t0 = time.time()
+    EXPS = {"K1": exp_K1, "K2": exp_K2, "K3": exp_K3,
+            "K4": exp_K4, "K5": exp_K5, "K6": exp_K6}
     for w in which:
         try:
-            {"K1": exp_K1, "K2": exp_K2, "K3": exp_K3}[w]()
+            EXPS[w]()
         except Exception as e:
             print(f"# [{w}] FAILED: {type(e).__name__}: {e}", flush=True)
         print(f"# [{w}] done at +{time.time()-t0:.0f}s", flush=True)

@@ -24,8 +24,13 @@ QUEUE = [
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K2"], 1500),
     ("K3 autodiff-BN full step",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K3"], 1500),
+    ("K4-K6 input dtype / batch variants",
+     [PY, os.path.join(HERE, "perf_experiments4.py"), "K4", "K5", "K6"],
+     2400),
     ("transformer tuning matrix",
      [PY, os.path.join(HERE, "transformer_tuning.py"), "matrix"], 2400),
+    ("MoE bench config (new)",
+     [PY, os.path.join(HERE, os.pardir, "bench.py"), "moe"], 1500),
 ]
 
 
