@@ -1,0 +1,75 @@
+"""Summarize a jax.profiler trace: top ops by device time.
+
+Turns the xplane protobuf that `jax.profiler.trace(dir)` writes (and
+that normally needs TensorBoard's profile plugin to read) into a
+plain table, so an on-TPU profile capture can be analyzed in-terminal:
+
+    python scripts/tpu_tuning.py profile          # writes /tmp/tpu_trace
+    python scripts/trace_summary.py /tmp/tpu_trace [top_n]
+
+CPU-only (parses the .xplane.pb via tensorflow's bundled proto; no
+device access), so it is safe to run while the tunnel is wedged.
+"""
+import collections
+import glob
+import os
+import sys
+
+
+def load_xspace(path):
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+    if os.path.isdir(path):
+        cands = sorted(glob.glob(os.path.join(
+            path, "**", "*.xplane.pb"), recursive=True))
+        if not cands:
+            raise SystemExit(f"no .xplane.pb under {path}")
+        path = cands[-1]
+    xs = xplane_pb2.XSpace()
+    with open(path, "rb") as f:
+        xs.ParseFromString(f.read())
+    return xs, path
+
+
+def summarize(xs, top_n=25):
+    """Per-plane totals of event duration grouped by event name."""
+    out = []
+    for plane in xs.planes:
+        ev_names = dict(plane.event_metadata)
+        totals = collections.Counter()
+        counts = collections.Counter()
+        span_lo, span_hi = None, None
+        for line in plane.lines:
+            for ev in line.events:
+                md = ev_names.get(ev.metadata_id)
+                name = md.name if md else f"#{ev.metadata_id}"
+                totals[name] += ev.duration_ps
+                counts[name] += 1
+                lo = ev.offset_ps
+                hi = ev.offset_ps + ev.duration_ps
+                span_lo = lo if span_lo is None else min(span_lo, lo)
+                span_hi = hi if span_hi is None else max(span_hi, hi)
+        if not totals:
+            continue
+        wall_ms = (span_hi - span_lo) / 1e9 if span_hi else 0.0
+        out.append((plane.name, wall_ms, totals, counts))
+    return out
+
+
+def main():
+    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_trace"
+    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+    xs, src = load_xspace(path)
+    print(f"trace: {src}")
+    for name, wall_ms, totals, counts in summarize(xs, top_n):
+        busy_ms = sum(totals.values()) / 1e9
+        print(f"\n== plane: {name}  (wall {wall_ms:.2f} ms, "
+              f"busy {busy_ms:.2f} ms) ==")
+        for op, ps in totals.most_common(top_n):
+            ms = ps / 1e9
+            pct = 100.0 * ps / max(sum(totals.values()), 1)
+            print(f"  {ms:9.3f} ms {pct:5.1f}%  x{counts[op]:<5d} "
+                  f"{op[:90]}")
+
+
+if __name__ == "__main__":
+    main()
