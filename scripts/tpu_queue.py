@@ -27,6 +27,8 @@ QUEUE = [
     ("K4-K6 input dtype / batch variants",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K4", "K5", "K6"],
      2400),
+    ("resnet50 profile capture -> /tmp/tpu_trace",
+     [PY, os.path.join(HERE, "tpu_tuning.py"), "profile"], 1200),
     ("transformer tuning matrix",
      [PY, os.path.join(HERE, "transformer_tuning.py"), "matrix"], 2400),
     ("MoE bench config (new)",
