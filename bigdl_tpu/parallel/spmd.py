@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import time
 from functools import partial
+from types import SimpleNamespace
 from typing import Optional
 
 import jax
@@ -390,19 +391,57 @@ class SpmdTrainer:
             if name != pointed:  # never delete the snapshot 'latest' names
                 shutil.rmtree(full, ignore_errors=True)
 
-    def fit(self, batches, steps: Optional[int] = None, log_every: int = 0):
+    def set_train_summary(self, summary):
+        """TensorBoard Loss/Throughput scalars (≙
+        Optimizer.set_train_summary, incl. set_summary_trigger gating).
+        Losses are buffered as device values and flushed every
+        ``summary_flush_every`` steps (default 100) and on exit — even
+        on an exception — so summaries add no per-step device->host
+        sync but a crashed run keeps its curve."""
+        self._train_summary = summary
+        return self
+
+    def _flush_summary(self, buffered, tokens_seen, t0):
+        """Write buffered (step, device_loss) pairs; returns []"""
+        summary = self._train_summary
+        trig = getattr(summary, "get_summary_trigger",
+                       lambda _t: None)("Loss")
+        for s, l in buffered:
+            if trig is None or trig(SimpleNamespace(iteration=s)):
+                summary.add_scalar("Loss", float(l), s)
+        if buffered:
+            wall = max(time.time() - t0, 1e-9)
+            summary.add_scalar("Throughput", tokens_seen / wall,
+                               buffered[-1][0])
+        return []
+
+    def fit(self, batches, steps: Optional[int] = None, log_every: int = 0,
+            summary_flush_every: int = 100):
         losses = []
+        buffered = []
+        tokens_seen = 0
         ckpt = getattr(self, "_ckpt", None)
+        summary = getattr(self, "_train_summary", None)
         t0 = time.time()
-        for i, (tokens, targets) in enumerate(batches):
-            if steps is not None and i >= steps:
-                break
-            loss = self.step(tokens, targets)
-            if log_every and (i + 1) % log_every == 0:
-                print(f"step {i + 1}: loss={float(loss):.4f} "
-                      f"({(i + 1) / (time.time() - t0):.2f} it/s)")
-            if ckpt and self._step_count % ckpt[1] == 0:
-                self.save_checkpoint(ckpt[0])
-                self._prune_checkpoints(ckpt[0], ckpt[2])
-            losses.append(loss)
+        try:
+            for i, (tokens, targets) in enumerate(batches):
+                if steps is not None and i >= steps:
+                    break
+                loss = self.step(tokens, targets)
+                if log_every and (i + 1) % log_every == 0:
+                    print(f"step {i + 1}: loss={float(loss):.4f} "
+                          f"({(i + 1) / (time.time() - t0):.2f} it/s)")
+                if ckpt and self._step_count % ckpt[1] == 0:
+                    self.save_checkpoint(ckpt[0])
+                    self._prune_checkpoints(ckpt[0], ckpt[2])
+                losses.append(loss)
+                if summary is not None:
+                    tokens_seen += int(np.prod(np.shape(tokens)))
+                    buffered.append((self._step_count, loss))
+                    if len(buffered) >= summary_flush_every:
+                        buffered = self._flush_summary(buffered,
+                                                       tokens_seen, t0)
+        finally:
+            if summary is not None and buffered:
+                self._flush_summary(buffered, tokens_seen, t0)
         return [float(l) for l in losses]

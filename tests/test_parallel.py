@@ -305,3 +305,63 @@ def test_spmd_trainer_evaluate_guards():
     tr.evaluate(g, steps=2)
     assert len(list(g)) == 1          # exactly one batch left
     tr.detach()
+
+
+def test_spmd_trainer_train_summary(tmp_path):
+    """set_train_summary writes real tfevents Loss per step and a
+    Throughput scalar, without per-step host syncs (≙ TrainSummary on
+    the Local/Distri optimizers)."""
+    from bigdl_tpu.visualization import TrainSummary
+
+    mesh = mesh_lib.create_mesh({"dp": 4, "tp": 2})
+    model = T.build("tiny", dropout=0.0)
+    rng = np.random.RandomState(0)
+
+    def batches():
+        while True:
+            t = rng.randint(0, 256, (4, 17))
+            yield jnp.asarray(t[:, :-1]), jnp.asarray(t[:, 1:])
+
+    tr = (SpmdTrainer(model, SGD(learning_rate=0.1), mesh=mesh)
+          .set_train_summary(TrainSummary(str(tmp_path), "spmd")))
+    tr.init()
+    losses = tr.fit(batches(), steps=3)
+    scal = tr._train_summary.read_scalar("Loss")
+    thr = tr._train_summary.read_scalar("Throughput")
+    assert len(scal) == 3 and len(thr) == 1
+    assert abs(scal[0][1] - losses[0]) < 1e-5
+    assert thr[0][1] > 0
+    tr.detach()
+
+
+def test_spmd_trainer_summary_trigger_and_crash_flush(tmp_path):
+    """Loss writes honor set_summary_trigger, and a mid-fit exception
+    still flushes the already-buffered points (try/finally)."""
+    from bigdl_tpu.visualization import TrainSummary
+    from bigdl_tpu.optim import Trigger
+
+    mesh = mesh_lib.create_mesh({"dp": 4, "tp": 2})
+    model = T.build("tiny", dropout=0.0)
+    rng = np.random.RandomState(0)
+    summ = TrainSummary(str(tmp_path), "spmd2")
+    summ.set_summary_trigger("Loss", Trigger.several_iteration(2))
+
+    def batches(n, then_raise=False):
+        for i in range(n):
+            t = rng.randint(0, 256, (4, 17))
+            yield jnp.asarray(t[:, :-1]), jnp.asarray(t[:, 1:])
+        if then_raise:
+            raise RuntimeError("boom")
+
+    tr = (SpmdTrainer(model, SGD(learning_rate=0.1), mesh=mesh)
+          .set_train_summary(summ))
+    tr.init()
+    tr.fit(batches(4))
+    scal = summ.read_scalar("Loss")
+    assert [s for s, _, _ in scal] == [2, 4]   # gated to every 2nd step
+
+    with pytest.raises(RuntimeError):
+        tr.fit(batches(3, then_raise=True))
+    scal2 = summ.read_scalar("Loss")
+    assert len(scal2) > len(scal)              # crash still flushed
+    tr.detach()
