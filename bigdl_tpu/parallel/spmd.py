@@ -242,8 +242,14 @@ class SpmdTrainer:
                 "evaluate: no valid tokens (empty batches, or every "
                 "target is ignore_index)")
         loss = total / count
-        return {"loss": loss, "perplexity": float(np.exp(min(loss, 50.0))),
-                "tokens": int(count)}
+        res = {"loss": loss, "perplexity": float(np.exp(min(loss, 50.0))),
+               "tokens": int(count)}
+        vs = getattr(self, "_val_summary", None)
+        if vs is not None:
+            vs.add_scalar("Loss", res["loss"], self._step_count)
+            vs.add_scalar("Perplexity", res["perplexity"],
+                          self._step_count)
+        return res
 
     # -- checkpointing --------------------------------------------------- #
     def save_checkpoint(self, path: str):
@@ -390,6 +396,13 @@ class SpmdTrainer:
         for _, _, name, full in snaps[:-keep]:
             if name != pointed:  # never delete the snapshot 'latest' names
                 shutil.rmtree(full, ignore_errors=True)
+
+    def set_val_summary(self, summary):
+        """ValidationSummary target for :meth:`evaluate` results (≙
+        Optimizer.set_val_summary): each evaluate() writes Loss and
+        Perplexity at the current training step."""
+        self._val_summary = summary
+        return self
 
     def set_train_summary(self, summary):
         """TensorBoard Loss/Throughput scalars (≙

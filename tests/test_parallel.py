@@ -365,3 +365,30 @@ def test_spmd_trainer_summary_trigger_and_crash_flush(tmp_path):
     scal2 = summ.read_scalar("Loss")
     assert len(scal2) > len(scal)              # crash still flushed
     tr.detach()
+
+
+def test_spmd_trainer_val_summary(tmp_path):
+    """evaluate() writes Loss/Perplexity to the ValidationSummary at the
+    current training step (≙ Optimizer.set_val_summary)."""
+    from bigdl_tpu.visualization import ValidationSummary
+
+    mesh = mesh_lib.create_mesh({"dp": 4, "tp": 2})
+    model = T.build("tiny", dropout=0.0)
+    rng = np.random.RandomState(0)
+
+    def batches(n):
+        for _ in range(n):
+            t = rng.randint(0, 256, (4, 17))
+            yield jnp.asarray(t[:, :-1]), jnp.asarray(t[:, 1:])
+
+    vs = ValidationSummary(str(tmp_path), "spmdval")
+    tr = (SpmdTrainer(model, SGD(learning_rate=0.1), mesh=mesh)
+          .set_val_summary(vs))
+    tr.init()
+    tr.fit(batches(2))
+    tr.evaluate(batches(2))
+    scal = vs.read_scalar("Loss")
+    ppl = vs.read_scalar("Perplexity")
+    assert len(scal) == 1 and len(ppl) == 1
+    assert scal[0][0] == 2            # tagged at the training step
+    tr.detach()
