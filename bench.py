@@ -194,6 +194,13 @@ def bench_transformer():
     from bigdl_tpu.ops import flash_attention_mod as fa
 
     on_tpu = jax.default_backend() == "tpu"
+
+    def _rel_err(got, want):
+        got = np.asarray(got, np.float32)
+        want = np.asarray(want, np.float32)
+        return float(np.abs(got - want).max()
+                     / max(np.abs(want).max(), 1e-6))
+
     # --- Pallas path eligibility + numerics parity ------------------- #
     rng = np.random.RandomState(0)
     q = jnp.asarray(rng.randn(2, 8, 512, 128), jnp.bfloat16)
@@ -203,14 +210,28 @@ def bench_transformer():
     pallas_active = fa._pallas_ok(q, k, cfg)
     if on_tpu:
         assert pallas_active, "Pallas flash-attention path must be active on TPU"
-        got = np.asarray(fa.flash_attention(q, k, v, causal=True),
-                         np.float32)
-        want = np.asarray(fa.attention_reference(q, k, v, causal=True),
-                          np.float32)
-        err = np.abs(got - want).max() / max(np.abs(want).max(), 1e-6)
+        err = _rel_err(fa.flash_attention(q, k, v, causal=True),
+                       fa.attention_reference(q, k, v, causal=True))
         assert err < 3e-2, f"pallas vs reference mismatch: {err}"
         print(json.dumps({"metric": "flash_attention_pallas_parity",
                           "value": round(float(err), 6), "unit": "rel_err",
+                          "vs_baseline": None}), flush=True)
+
+        # backward kernels: d(sum(attn))/d{q,k,v} Pallas vs reference
+        def s_pallas(q, k, v):
+            return jnp.sum(fa.flash_attention(q, k, v, causal=True)
+                           .astype(jnp.float32))
+
+        def s_ref(q, k, v):
+            return jnp.sum(fa.attention_reference(q, k, v, causal=True)
+                           .astype(jnp.float32))
+
+        gp = jax.grad(s_pallas, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(s_ref, argnums=(0, 1, 2))(q, k, v)
+        gerr = max(_rel_err(a, b) for a, b in zip(gp, gr))
+        assert gerr < 6e-2, f"pallas bwd vs reference mismatch: {gerr}"
+        print(json.dumps({"metric": "flash_attention_pallas_bwd_parity",
+                          "value": round(gerr, 6), "unit": "rel_err",
                           "vs_baseline": None}), flush=True)
 
     mcfg = TransformerConfig(vocab_size=32000, d_model=1024, n_heads=8,
