@@ -112,6 +112,23 @@ def test_space_to_depth_conv_matches_plain(k, pad, hw):
                                    rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("hw", [14, 15])
+def test_1x1_strided_same_padding_matches_general(hw):
+    """pad=-1 (SAME) with k=1 resolves to zero pads, so the slice+dense
+    rewrite applies; values must still match the general conv."""
+    rng = np.random.RandomState(7)
+    conv = nn.SpatialConvolution(6, 4, 1, 1, 2, 2, -1, -1, format="NHWC")
+    params = conv.init(jax.random.PRNGKey(3))
+    x = jnp.asarray(rng.randn(2, hw, hw, 6).astype(np.float32))
+    got = conv.apply(params, x, _ctx())
+    w = conv.own(params)["weight"]
+    want = _general_conv(x, w, (2, 2), [(0, 0), (0, 0)], "NHWC")
+    want = want + conv.own(params)["bias"][None, None, None, :]
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+
+
 def test_s2d_conv_rejects_same_padding():
     with pytest.raises(ValueError, match="SAME"):
         nn.SpaceToDepthConvolution(3, 8, 7, 7, 2, 2, -1, -1,
