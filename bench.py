@@ -440,6 +440,17 @@ def _deadline_watchdog(seconds):
 
 def main():
     import os
+    # persistent compilation cache: repeated bench runs (and the
+    # measurement scripts) reuse compiled programs across processes,
+    # shrinking the window where a mid-compile tunnel wedge can kill
+    # the run.  Harmless no-op if the backend can't serialize.
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ.get("BENCH_COMPILE_CACHE",
+                                         "/tmp/jax_comp_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception:
+        pass
     _deadline_watchdog(float(os.environ.get("BENCH_DEADLINE_S", 2700)))
     _device_liveness_probe(
         float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 300)),

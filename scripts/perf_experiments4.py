@@ -31,6 +31,11 @@ def _init_with_retry(tries=5, wait=90):
 
 
 jax = _init_with_retry()
+try:
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_comp_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+except Exception:
+    pass
 import jax.numpy as jnp                                    # noqa: E402
 from jax import lax                                        # noqa: E402
 
