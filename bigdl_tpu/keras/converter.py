@@ -376,34 +376,285 @@ def _builder(class_name):
     return b
 
 
+# --------------------------------------------------------------------- #
+# Keras 2.x schema (tf.keras / keras>=2 JSON): translated onto the same #
+# wrapper layers.  Conv/pool 2D require data_format='channels_first'    #
+# (the wrappers are channels-first like the reference's keras API);     #
+# 1D layers are (B, T, C) in both schemas.                              #
+# --------------------------------------------------------------------- #
+def _k2_cf(cfg, who):
+    df = cfg.get("data_format", "channels_last")
+    if df != "channels_first":
+        _unsupported(f"{who} with data_format={df!r} (convert the model "
+                     "to channels_first; the channels-first layout is "
+                     "also what the TPU conv wrappers implement)")
+
+
+def _k2_pad(cfg, who):
+    p = cfg.get("padding", "valid")
+    if p not in ("valid", "same"):
+        _unsupported(f"{who} padding={p!r}")
+    return p
+
+
+def _k2_dense(cfg):
+    return L.Dense(cfg["units"], activation=_act(cfg),
+                   with_bias=cfg.get("use_bias", True),
+                   input_shape=_input_shape(cfg), name=cfg.get("name"))
+
+
+def _k2_dropout(cfg):
+    return L.Dropout(cfg["rate"], input_shape=_input_shape(cfg),
+                     name=cfg.get("name"))
+
+
+def _k2_embedding(cfg):
+    if cfg.get("mask_zero"):
+        _unsupported("Embedding mask_zero=True")
+    return L.Embedding(cfg["input_dim"], cfg["output_dim"],
+                       input_shape=_input_shape(cfg), name=cfg.get("name"))
+
+
+def _k2_batchnorm(cfg):
+    if not (cfg.get("center", True) and cfg.get("scale", True)):
+        _unsupported("BatchNormalization without center/scale")
+    return L.BatchNormalization(epsilon=cfg.get("epsilon", 1e-3),
+                                momentum=cfg.get("momentum", 0.99),
+                                input_shape=_input_shape(cfg),
+                                name=cfg.get("name"))
+
+
+def _k2_recurrent(cls, cfg, who):
+    if cfg.get("go_backwards"):
+        _unsupported(f"{who} go_backwards=True")
+    # absent key = pre-2.2 keras whose GRU had no reset_after (classic
+    # form); tf.keras 2.x always writes the key explicitly
+    if who == "GRU" and cfg.get("reset_after", False):
+        _unsupported("GRU reset_after=True (retrain or export with "
+                     "reset_after=False; the classic GRU form is what "
+                     "nn.GRU implements)")
+    if who == "GRU" and (cfg.get("activation", "tanh") != "tanh"
+                         or cfg.get("recurrent_activation",
+                                    "sigmoid") != "sigmoid"):
+        _unsupported("GRU with non-default activations")
+    return cls(cfg["units"], activation=cfg.get("activation", "tanh"),
+               inner_activation=cfg.get("recurrent_activation", "sigmoid"),
+               return_sequences=cfg.get("return_sequences", False),
+               input_shape=_input_shape(cfg), name=cfg.get("name"))
+
+
+def _k2_bidirectional(cfg):
+    inner_spec = cfg["layer"]
+    inner = _k2_builder(inner_spec["class_name"])(inner_spec["config"])
+    return L.Bidirectional(inner, merge_mode=cfg.get("merge_mode",
+                                                     "concat"),
+                           input_shape=_input_shape(cfg),
+                           name=cfg.get("name"))
+
+
+def _one(v, default=1):
+    if v is None:
+        return default
+    return v[0] if isinstance(v, (list, tuple)) else v
+
+
+def _pair(v, default=(1, 1)):
+    if v is None:
+        return default
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+def _k2_conv1d(cfg):
+    k = _one(cfg["kernel_size"])
+    s = _one(cfg.get("strides"))
+    d = _one(cfg.get("dilation_rate"))
+    pad = _k2_pad(cfg, "Conv1D")
+    if pad == "same" and d == 1 and s != 1:
+        _unsupported("Conv1D padding='same' with strides>1")
+    if d > 1:
+        if s != 1:
+            _unsupported("Conv1D dilation with strides")
+        if pad != "valid":
+            _unsupported("dilated Conv1D with padding='same'")
+        if not cfg.get("use_bias", True):
+            _unsupported("dilated Conv1D without bias")
+        return L.AtrousConvolution1D(
+            cfg["filters"], k, activation=_act(cfg),
+            atrous_rate=d,
+            input_shape=_input_shape(cfg), name=cfg.get("name"))
+    return L.Convolution1D(cfg["filters"], k, activation=_act(cfg),
+                           border_mode=pad, subsample_length=s,
+                           bias=cfg.get("use_bias", True),
+                           input_shape=_input_shape(cfg),
+                           name=cfg.get("name"))
+
+
+def _k2_conv2d(cfg):
+    _k2_cf(cfg, "Conv2D")
+    kh, kw = _pair(cfg["kernel_size"])
+    sh, sw = _pair(cfg.get("strides"))
+    if _pair(cfg.get("dilation_rate")) != (1, 1):
+        _unsupported("Conv2D dilation_rate != 1 (use channels_first "
+                     "AtrousConvolution2D semantics via the keras-1 "
+                     "schema)")
+    return L.Convolution2D(cfg["filters"], kh, kw, activation=_act(cfg),
+                           border_mode=_k2_pad(cfg, "Conv2D"),
+                           subsample=(sh, sw),
+                           bias=cfg.get("use_bias", True),
+                           input_shape=_input_shape(cfg),
+                           name=cfg.get("name"))
+
+
+def _k2_pool2d(cls):
+    def build(cfg):
+        _k2_cf(cfg, cls.__name__)
+        ph, pw = _pair(cfg.get("pool_size"), (2, 2))
+        st = _pair(cfg.get("strides"), (ph, pw))
+        return cls(pool_size=(ph, pw), strides=tuple(st),
+                   border_mode=_k2_pad(cfg, cls.__name__),
+                   input_shape=_input_shape(cfg), name=cfg.get("name"))
+    return build
+
+
+def _k2_pool1d(cls):
+    def build(cfg):
+        k = _one(cfg.get("pool_size"), 2)
+        s = _one(cfg.get("strides"), k)
+        return cls(pool_length=k, stride=s,
+                   border_mode=_k2_pad(cfg, cls.__name__),
+                   input_shape=_input_shape(cfg), name=cfg.get("name"))
+    return build
+
+
+def _k2_global2d(cls):
+    def build(cfg):
+        _k2_cf(cfg, cls.__name__)
+        return cls(input_shape=_input_shape(cfg), name=cfg.get("name"))
+    return build
+
+
+def _k2_merge(mode):
+    def build(cfg):
+        kw = {}
+        if mode == "concat":
+            axis = cfg.get("axis", -1)
+            kw["concat_axis"] = axis
+        return L.Merge(mode=mode, input_shape=_input_shape(cfg),
+                       name=cfg.get("name"), **kw)
+    return build
+
+
+_K2_BUILDERS = {
+    "Dense": _k2_dense,
+    "Activation": _activation,
+    "Dropout": _k2_dropout,
+    "Flatten": lambda cfg: L.Flatten(input_shape=_input_shape(cfg),
+                                     name=cfg.get("name")),
+    "Reshape": lambda cfg: L.Reshape(tuple(cfg["target_shape"]),
+                                     input_shape=_input_shape(cfg),
+                                     name=cfg.get("name")),
+    "Embedding": _k2_embedding,
+    "BatchNormalization": _k2_batchnorm,
+    "SimpleRNN": lambda cfg: _k2_recurrent(L.SimpleRNN, cfg, "SimpleRNN"),
+    "LSTM": lambda cfg: _k2_recurrent(L.LSTM, cfg, "LSTM"),
+    "GRU": lambda cfg: _k2_recurrent(L.GRU, cfg, "GRU"),
+    "Bidirectional": _k2_bidirectional,
+    "Conv1D": _k2_conv1d,
+    "Conv2D": _k2_conv2d,
+    "MaxPooling2D": _k2_pool2d(L.MaxPooling2D),
+    "AveragePooling2D": _k2_pool2d(L.AveragePooling2D),
+    "MaxPooling1D": _k2_pool1d(L.MaxPooling1D),
+    "AveragePooling1D": _k2_pool1d(L.AveragePooling1D),
+    "GlobalMaxPooling1D": lambda cfg: L.GlobalMaxPooling1D(
+        input_shape=_input_shape(cfg), name=cfg.get("name")),
+    "GlobalAveragePooling1D": lambda cfg: L.GlobalAveragePooling1D(
+        input_shape=_input_shape(cfg), name=cfg.get("name")),
+    "GlobalMaxPooling2D": _k2_global2d(L.GlobalMaxPooling2D),
+    "GlobalAveragePooling2D": _k2_global2d(L.GlobalAveragePooling2D),
+    "LeakyReLU": lambda cfg: L.LeakyReLU(alpha=cfg.get("alpha", 0.3),
+                                         input_shape=_input_shape(cfg),
+                                         name=cfg.get("name")),
+    "ELU": lambda cfg: L.ELU(alpha=cfg.get("alpha", 1.0),
+                             input_shape=_input_shape(cfg),
+                             name=cfg.get("name")),
+    "Add": _k2_merge("sum"),
+    "Multiply": _k2_merge("mul"),
+    "Average": _k2_merge("ave"),
+    "Maximum": _k2_merge("max"),
+    "Concatenate": _k2_merge("concat"),
+}
+
+
+def _k2_builder(class_name):
+    b = _K2_BUILDERS.get(class_name)
+    if b is None:
+        _unsupported(f"keras-2 layer class {class_name}")
+    return b
+
+
+def _is_keras2(spec):
+    """Keras >=2 JSON: keras_version key, or a Sequential whose config
+    is a dict with a 'layers' list (keras 1 configs are bare lists)."""
+    kv = spec.get("keras_version", "")
+    if kv:
+        return not str(kv).startswith("1")
+    return (spec.get("class_name") == "Sequential"
+            and isinstance(spec.get("config"), dict))
+
+
 class DefinitionLoader:
-    """Build a bigdl_tpu.keras model from a keras-1.2.2 JSON definition
-    (≙ converter.py DefinitionLoader, minus the live-keras dependency)."""
+    """Build a bigdl_tpu.keras model from a keras JSON definition —
+    the keras-1.2.2 schema the reference converts (≙ converter.py
+    DefinitionLoader, minus the live-keras dependency), or the
+    keras-2.x / tf.keras schema (auto-detected)."""
 
     @classmethod
     def from_json_path(cls, path):
         with open(path) as f:
-            return cls.from_json_str(f.read())
+            return cls.from_spec(json.load(f))
 
     @classmethod
     def from_json_str(cls, json_str):
-        spec = json.loads(json_str)
+        return cls.from_spec(json.loads(json_str))
+
+    @classmethod
+    def from_spec(cls, spec):
         kind = spec.get("class_name")
+        builder = _k2_builder if _is_keras2(spec) else _builder
         if kind == "Sequential":
-            return cls._sequential(spec["config"])
+            cfg = spec["config"]
+            layer_specs = cfg["layers"] if isinstance(cfg, dict) else cfg
+            return cls._sequential(layer_specs, builder)
         if kind in ("Model", "Functional"):
-            return cls._graph(spec["config"])
+            return cls._graph(spec["config"], builder)
         _unsupported(f"top-level class {kind}")
 
     @classmethod
-    def _sequential(cls, layer_specs):
+    def _sequential(cls, layer_specs, builder=_builder):
         model = T.Sequential()
+        pending_shape = None
         for spec in layer_specs:
-            model.add(_builder(spec["class_name"])(spec["config"]))
+            if spec["class_name"] == "InputLayer":
+                shp = spec["config"].get("batch_input_shape") \
+                    or spec["config"].get("batch_shape")
+                pending_shape = tuple(shp[1:]) if shp else None
+                continue
+            cfg = spec["config"]
+            own = _input_shape(cfg)
+            # prefer the InputLayer's shape whenever the layer's own is
+            # absent or partial (tf.keras writes [None, None] on inner
+            # layers); a partial own shape (None dims) survives when no
+            # InputLayer preceded — recurrent layers only need the last
+            # dim, matching the keras-1 behavior
+            if pending_shape is not None and (
+                    own is None or any(d is None for d in own)):
+                cfg = dict(cfg, batch_input_shape=(None,) + pending_shape)
+            pending_shape = None
+            model.add(builder(spec["class_name"])(cfg))
         return model
 
     @classmethod
-    def _graph(cls, cfg):
+    def _graph(cls, cfg, builder=_builder):
         nodes = {}          # layer name -> graph node
         specs = {l["name"]: l for l in cfg["layers"]}
 
@@ -412,7 +663,8 @@ class DefinitionLoader:
                 return nodes[name]
             spec = specs[name]
             if spec["class_name"] == "InputLayer":
-                shp = spec["config"].get("batch_input_shape")
+                shp = spec["config"].get("batch_input_shape") \
+                    or spec["config"].get("batch_shape")
                 nodes[name] = T.Input(shape=tuple(shp[1:]) if shp else None,
                                       name=name)
                 return nodes[name]
@@ -425,7 +677,7 @@ class DefinitionLoader:
             in_names = [inb[0] for node in spec["inbound_nodes"]
                         for inb in node]
             ins = [build_node(n) for n in in_names]
-            layer = _builder(spec["class_name"])(spec["config"])
+            layer = builder(spec["class_name"])(spec["config"])
             nodes[name] = layer(ins[0] if len(ins) == 1 else ins)
             return nodes[name]
 
@@ -485,25 +737,31 @@ def _gates_lstm(ws):
             np.concatenate([bi, bf, bc, bo], 0))
 
 
-def _load_cell(cell, ws, params):
+def _set_gru(params, cell, Wz, Uz, bz, Wr, Ur, br, Wh, Uh, bh):
+    """Route per-gate GRU arrays into our fused-(r,z)+candidate params."""
+    import jax.numpy as jnp
+    entry = dict(params.get(cell.name, {}))
+    gates = dict(entry.get("gates", {}))
+    newg = dict(entry.get("new", {}))
+    gates.update(weight_i=jnp.asarray(np.concatenate([Wr, Wz], 1)),
+                 weight_h=jnp.asarray(np.concatenate([Ur, Uz], 1)),
+                 bias=jnp.asarray(np.concatenate([br, bz], 0)))
+    newg.update(weight_i=jnp.asarray(Wh), weight_h=jnp.asarray(Uh),
+                bias=jnp.asarray(bh))
+    entry["gates"], entry["new"] = gates, newg
+    params[cell.name] = entry
+
+
+def _load_cell(cell, ws, params, schema="k1"):
+    if schema == "k2":
+        return _load_cell_k2(cell, ws, params)
     if isinstance(cell, N.LSTM):
         wi, wh, b = _gates_lstm(ws)
         _set(params, cell, weight_i=wi, weight_h=wh, bias=b)
     elif isinstance(cell, N.GRU):
-        # keras1 GRU order: [W_z,U_z,b_z, W_r,U_r,b_r, W_h,U_h,b_h];
-        # ours: fused gates (r,z) + separate candidate
+        # keras1 GRU order: [W_z,U_z,b_z, W_r,U_r,b_r, W_h,U_h,b_h]
         Wz, Uz, bz, Wr, Ur, br, Wh, Uh, bh = ws
-        entry = dict(params.get(cell.name, {}))
-        gates = dict(entry.get("gates", {}))
-        newg = dict(entry.get("new", {}))
-        import jax.numpy as jnp
-        gates.update(weight_i=jnp.asarray(np.concatenate([Wr, Wz], 1)),
-                     weight_h=jnp.asarray(np.concatenate([Ur, Uz], 1)),
-                     bias=jnp.asarray(np.concatenate([br, bz], 0)))
-        newg.update(weight_i=jnp.asarray(Wh), weight_h=jnp.asarray(Uh),
-                    bias=jnp.asarray(bh))
-        entry["gates"], entry["new"] = gates, newg
-        params[cell.name] = entry
+        _set_gru(params, cell, Wz, Uz, bz, Wr, Ur, br, Wh, Uh, bh)
     elif isinstance(cell, N.RnnCell):
         W, U, b = ws
         _set(params, cell, weight_i=W, weight_h=U, bias=b)
@@ -511,24 +769,70 @@ def _load_cell(cell, ws, params):
         raise KerasConversionError(f"no weight adapter for cell {cell}")
 
 
-def _load_layer_weights(klayer, ws, params, state):
+def _load_cell_k2(cell, ws, params):
+    """keras-2 recurrent weights are fused: [kernel, recurrent, bias]."""
+    if isinstance(cell, N.LSTM):
+        # gate order i, f, c, o == our fused i, f, g(cell), o
+        k, r, b = ws
+        _set(params, cell, weight_i=k, weight_h=r, bias=b)
+    elif isinstance(cell, N.GRU):
+        # reset_after=False: kernel thirds are z, r, h
+        k, r, b = ws
+        H = k.shape[1] // 3
+        _set_gru(params, cell,
+                 k[:, :H], r[:, :H], b[:H],
+                 k[:, H:2 * H], r[:, H:2 * H], b[H:2 * H],
+                 k[:, 2 * H:], r[:, 2 * H:], b[2 * H:])
+    elif isinstance(cell, N.RnnCell):
+        k, r, b = ws
+        _set(params, cell, weight_i=k, weight_h=r, bias=b)
+    else:
+        raise KerasConversionError(f"no k2 weight adapter for cell {cell}")
+
+
+def _load_layer_weights(klayer, ws, params, state, schema="k1"):
     """Route one keras layer's weight list into our module's params/state."""
     if isinstance(klayer, L.TimeDistributed):
         klayer.ensure_built()
         inner = klayer.layer
-        return _load_layer_weights(inner, ws, params, state)
+        return _load_layer_weights(inner, ws, params, state, schema)
     if isinstance(klayer, L.Bidirectional):
         klayer.ensure_built()
         cells = _find(klayer, N.Cell)
         half = len(ws) // 2
-        _load_cell(cells[0], ws[:half], params)
-        _load_cell(cells[1], ws[half:], params)
+        _load_cell(cells[0], ws[:half], params, schema)
+        _load_cell(cells[1], ws[half:], params, schema)
         return
     if isinstance(klayer, (L.SimpleRNN, L.LSTM, L.GRU)):
         klayer.ensure_built()
         cell = _find(klayer, N.Cell)[0]
-        return _load_cell(cell, ws, params)
+        return _load_cell(cell, ws, params, schema)
     klayer.ensure_built()
+    if schema == "k2":
+        # layouts that differ from keras 1 in the file
+        if isinstance(klayer, L.Convolution2D):
+            conv = _find(klayer, N.SpatialConvolution)[0]
+            # file kernel is HWIO regardless of data_format -> ours OIHW
+            W = np.transpose(ws[0], (3, 2, 0, 1))
+            _set(params, conv, weight=W,
+                 **({"bias": ws[1]} if len(ws) > 1 else {}))
+            return
+        if isinstance(klayer, L.AtrousConvolution1D):
+            conv = _find(klayer, N.SpatialDilatedConvolution)[0]
+            # file kernel (k, in, out) -> ours OIHW with kernel (k, 1)
+            W = np.transpose(ws[0], (2, 1, 0))[..., None]
+            _set(params, conv, weight=W,
+                 **({"bias": ws[1]} if len(ws) > 1 else {}))
+            return
+        if isinstance(klayer, L.Convolution1D):
+            conv = _find(klayer, N.TemporalConvolution)[0]
+            # file kernel (k, in, out) -> ours (out, in, k)
+            W = np.transpose(ws[0], (2, 1, 0))
+            _set(params, conv, weight=W,
+                 **({"bias": ws[1]} if len(ws) > 1 else {}))
+            return
+        # Dense/Embedding/BatchNormalization file layouts match keras 1:
+        # fall through to the shared adapters below
     if isinstance(klayer, (L.Dense, L.Highway)):
         lins = _find(klayer, N.Linear)
         if isinstance(klayer, L.Dense):
@@ -597,7 +901,8 @@ class WeightLoader:
     keras-1.x HDF5 weight file into a DefinitionLoader-built model."""
 
     @staticmethod
-    def load_weights_from_hdf5(bmodel, hdf5_path, by_name=True):
+    def load_weights_from_hdf5(bmodel, hdf5_path, by_name=True,
+                               schema="k1"):
         entries = read_keras_hdf5(hdf5_path)
         bmodel.ensure_initialized()
         params = dict(bmodel._params)
@@ -620,7 +925,7 @@ class WeightLoader:
             else:
                 raise KerasConversionError(
                     f"hdf5 layer {lname!r} has no counterpart in the model")
-            _load_layer_weights(target, ws, params, state)
+            _load_layer_weights(target, ws, params, state, schema)
         bmodel.set_params(params, state)
         return bmodel
 
@@ -638,10 +943,17 @@ def _owns_weights(klayer):
 
 
 def load_keras(json_path=None, hdf5_path=None, by_name=True):
-    """≙ pyspark bigdl.nn.layer.Model.load_keras(json_path, hdf5_path)."""
+    """≙ pyspark bigdl.nn.layer.Model.load_keras(json_path, hdf5_path).
+
+    Accepts the keras-1.2.2 schema the reference supports AND the
+    keras-2.x / tf.keras schema (auto-detected from the JSON)."""
     if json_path is None:
         raise ValueError("json_path is required (definition)")
-    model = DefinitionLoader.from_json_path(json_path)
+    with open(json_path) as f:
+        spec = json.load(f)
+    schema = "k2" if _is_keras2(spec) else "k1"
+    model = DefinitionLoader.from_spec(spec)
     if hdf5_path:
-        WeightLoader.load_weights_from_hdf5(model, hdf5_path, by_name=by_name)
+        WeightLoader.load_weights_from_hdf5(model, hdf5_path,
+                                            by_name=by_name, schema=schema)
     return model

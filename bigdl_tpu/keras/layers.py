@@ -107,11 +107,28 @@ class KerasLayer(Module):
         return super().forward(x, rng=rng)
 
     def compute_output_shape(self, input_shape):
-        """input_shape includes batch (None allowed); returns same style."""
+        """input_shape includes batch (None allowed); returns same style.
+        Variable NON-batch dims (None, e.g. free sequence length) are
+        probed with two dummy sizes — output dims that track the dummy
+        come back as None."""
         self.build(tuple(input_shape))
         batch = input_shape[0]
-        concrete = (2 if batch is None else batch,) + tuple(input_shape[1:])
-        out = self.inner.get_output_shape(concrete)
+        rest = tuple(input_shape[1:])
+        b = 2 if batch is None else batch
+        if any(d is None for d in rest):
+            c1 = (b,) + tuple(8 if d is None else d for d in rest)
+            c2 = (b,) + tuple(12 if d is None else d for d in rest)
+            o1 = self.inner.get_output_shape(c1)
+            o2 = self.inner.get_output_shape(c2)
+            if isinstance(o1, tuple) and o1 and isinstance(o1[0], int):
+                return (batch,) + tuple(
+                    None if x != y else x
+                    for x, y in zip(o1[1:], o2[1:]))
+            # table outputs with free dims: report the first probe's
+            # shapes (conservative; rare)
+            return jax.tree_util.tree_map(
+                lambda s: (batch,) + tuple(s[1:]), o1)
+        out = self.inner.get_output_shape((b,) + rest)
         if isinstance(out, tuple) and out and isinstance(out[0], int):
             return (batch,) + tuple(out[1:])
         return jax.tree_util.tree_map(
@@ -938,7 +955,14 @@ class SimpleRNN(_KerasRecurrent):
 
 class LSTM(_KerasRecurrent):
     def _cell(self, input_dim):
-        return N.LSTM(input_dim, self.output_dim)
+        # defaults (tanh / sigmoid) match the nn.LSTM cell's built-ins;
+        # only non-default activations need wrapping as modules
+        act = None if self.activation in (None, "tanh") \
+            else _act_module(self.activation)
+        inner = None if self.inner_activation in (None, "sigmoid") \
+            else _act_module(self.inner_activation)
+        return N.LSTM(input_dim, self.output_dim, activation=act,
+                      inner_activation=inner)
 
 
 class GRU(_KerasRecurrent):
@@ -1031,6 +1055,21 @@ class Merge(KerasLayer):
                 par.add(l.ensure_built() if isinstance(l, KerasLayer) else l)
             return N.Sequential().add(par).add(merge)
         return merge
+
+    def compute_output_shape_multi(self, shapes):
+        """Output shape from ALL branch shapes (graph nodes with several
+        inbound edges — the single-shape compute_output_shape only sees
+        one branch, which under-counts concat)."""
+        base = tuple(shapes[0])
+        if self.mode == "concat":
+            nd = len(base)
+            ax = (nd - 1) if self.concat_axis == -1 else self.concat_axis
+            out = list(base)
+            out[ax] = sum(s[ax] for s in shapes)
+            return tuple(out)
+        if self.mode in ("dot", "cosine"):
+            return (base[0], 1)
+        return base                       # sum/mul/max/ave: elementwise
 
     # -- branch-tower (layers=) support: the layer's input is a TABLE of
     #    branch inputs, so the single-tensor KerasLayer shape machinery

@@ -243,8 +243,14 @@ def _keras_call(self, x, rng=None):
             self.build((None,) + tuple(self.input_shape))
         node = graph_lib.Node(self, nodes)
         if shape is not None:
+            shapes = [getattr(n, "keras_shape", None) for n in nodes]
             try:
-                node.keras_shape = self.compute_output_shape(shape)
+                if (len(nodes) > 1 and all(shapes)
+                        and hasattr(self, "compute_output_shape_multi")):
+                    node.keras_shape = \
+                        self.compute_output_shape_multi(shapes)
+                else:
+                    node.keras_shape = self.compute_output_shape(shape)
             except Exception:
                 node.keras_shape = None
         return node

@@ -1,0 +1,211 @@
+"""Keras-2 / tf.keras model loading, cross-validated against REAL
+tf_keras (2.21, installed in this image): tf_keras authors the model,
+saves JSON + HDF5 weights, our converter loads them, and predictions
+must match tf_keras's own.
+
+(The keras-1.2.2 schema — what the reference supports — is covered by
+test_keras_converter.py; this file covers the keras>=2 extension.)
+"""
+import json
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+tfk = pytest.importorskip("tf_keras")
+
+from bigdl_tpu.keras.converter import load_keras, KerasConversionError
+
+
+def _roundtrip(model, x):
+    """Save tf_keras model (json + h5), load with our converter, return
+    (tf_prediction, our_prediction)."""
+    with tempfile.TemporaryDirectory() as d:
+        jp = os.path.join(d, "m.json")
+        hp = os.path.join(d, "m.h5")
+        with open(jp, "w") as f:
+            f.write(model.to_json())
+        model.save_weights(hp)
+        ours = load_keras(jp, hp)
+        want = np.asarray(model.predict(x, verbose=0))
+        got = np.asarray(ours.forward(x))
+    return want, got
+
+
+def test_mlp_dense_bn_dropout():
+    tfk.utils.set_random_seed(0)
+    m = tfk.Sequential([
+        tfk.layers.Input((12,)),
+        tfk.layers.Dense(16, activation="relu"),
+        tfk.layers.BatchNormalization(),
+        tfk.layers.Dropout(0.25),            # inference: identity
+        tfk.layers.Dense(5, activation="softmax"),
+    ])
+    x = np.random.RandomState(0).randn(8, 12).astype(np.float32)
+    m.predict(x, verbose=0)                  # build + init moving stats
+    want, got = _roundtrip(m, x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_rnn_family_and_bidirectional():
+    tfk.utils.set_random_seed(1)
+    m = tfk.Sequential([
+        tfk.layers.Input((10,)),
+        tfk.layers.Embedding(50, 8),
+        tfk.layers.Bidirectional(
+            tfk.layers.LSTM(6, return_sequences=True)),
+        tfk.layers.GRU(5, reset_after=False, return_sequences=True),
+        tfk.layers.SimpleRNN(4),
+        tfk.layers.Dense(3),
+    ])
+    x = np.random.RandomState(1).randint(0, 50, (4, 10)).astype(np.float32)
+    want, got = _roundtrip(m, x)
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+
+
+def test_conv1d_text_model():
+    tfk.utils.set_random_seed(2)
+    m = tfk.Sequential([
+        tfk.layers.Input((16,)),
+        tfk.layers.Embedding(40, 8),
+        tfk.layers.Conv1D(12, 3, activation="relu"),
+        tfk.layers.MaxPooling1D(2),
+        tfk.layers.Conv1D(8, 3, strides=2),
+        tfk.layers.GlobalMaxPooling1D(),
+        tfk.layers.Dense(4, activation="tanh"),
+    ])
+    x = np.random.RandomState(2).randint(0, 40, (4, 16)).astype(np.float32)
+    want, got = _roundtrip(m, x)
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+
+
+def test_functional_model_with_merges():
+    tfk.utils.set_random_seed(3)
+    inp = tfk.layers.Input((9,))
+    a = tfk.layers.Dense(7, activation="relu")(inp)
+    b = tfk.layers.Dense(7, activation="sigmoid")(inp)
+    s = tfk.layers.Add()([a, b])
+    c = tfk.layers.Concatenate()([s, a])
+    out = tfk.layers.Dense(2)(c)
+    m = tfk.Model(inp, out)
+    x = np.random.RandomState(3).randn(5, 9).astype(np.float32)
+    want, got = _roundtrip(m, x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_conv2d_channels_first_config_translation():
+    """tf CPU can't execute channels_first convs, so this checks the
+    config+weight translation against our own NCHW conv numerics."""
+    from bigdl_tpu.keras.converter import (DefinitionLoader, WeightLoader)
+    import h5py
+    import jax.numpy as jnp
+    from jax import lax
+
+    rng = np.random.RandomState(4)
+    spec = {
+        "class_name": "Sequential", "keras_version": "2.15.0",
+        "config": {"name": "cf", "layers": [
+            {"class_name": "InputLayer",
+             "config": {"batch_input_shape": [None, 3, 10, 10]}},
+            {"class_name": "Conv2D", "config": {
+                "name": "c1", "filters": 6, "kernel_size": [3, 3],
+                "strides": [1, 1], "padding": "same",
+                "data_format": "channels_first", "use_bias": True,
+                "activation": "linear"}},
+            {"class_name": "MaxPooling2D", "config": {
+                "name": "p1", "pool_size": [2, 2], "strides": [2, 2],
+                "padding": "valid", "data_format": "channels_first"}},
+        ]},
+    }
+    K = rng.randn(3, 3, 3, 6).astype(np.float32)        # HWIO in file
+    b = rng.randn(6).astype(np.float32)
+    with tempfile.TemporaryDirectory() as d:
+        jp, hp = os.path.join(d, "m.json"), os.path.join(d, "m.h5")
+        with open(jp, "w") as f:
+            json.dump(spec, f)
+        with h5py.File(hp, "w") as f:
+            f.attrs["layer_names"] = [b"c1"]
+            g = f.create_group("c1")
+            g.attrs["weight_names"] = [b"c1/kernel:0", b"c1/bias:0"]
+            g["c1/kernel:0"] = K
+            g["c1/bias:0"] = b
+        model = load_keras(jp, hp)
+        x = rng.randn(2, 3, 10, 10).astype(np.float32)
+        got = np.asarray(model.forward(x))
+
+    # reference numerics: SAME conv NCHW with the HWIO kernel + maxpool
+    w = jnp.asarray(np.transpose(K, (3, 2, 0, 1)))      # OIHW
+    y = lax.conv_general_dilated(jnp.asarray(x), w, (1, 1),
+                                 [(1, 1), (1, 1)],
+                                 dimension_numbers=("NCHW", "OIHW",
+                                                    "NCHW"))
+    y = y + jnp.asarray(b)[None, :, None, None]
+    y = lax.reduce_window(y, -jnp.inf, lax.max, (1, 1, 2, 2),
+                          (1, 1, 2, 2), "VALID")
+    np.testing.assert_allclose(got, np.asarray(y), rtol=1e-5, atol=1e-5)
+
+
+def test_channels_last_conv_rejected_clearly():
+    spec = {
+        "class_name": "Sequential", "keras_version": "2.15.0",
+        "config": {"name": "cl", "layers": [
+            {"class_name": "Conv2D", "config": {
+                "name": "c", "filters": 4, "kernel_size": [3, 3],
+                "batch_input_shape": [None, 8, 8, 3],
+                "data_format": "channels_last"}},
+        ]},
+    }
+    from bigdl_tpu.keras.converter import DefinitionLoader
+    with pytest.raises(KerasConversionError, match="channels_first"):
+        DefinitionLoader.from_json_str(json.dumps(spec))
+
+
+def test_gru_reset_after_rejected_clearly():
+    spec = {
+        "class_name": "Sequential", "keras_version": "2.15.0",
+        "config": {"name": "g", "layers": [
+            {"class_name": "GRU", "config": {
+                "name": "g1", "units": 4, "reset_after": True,
+                "batch_input_shape": [None, 5, 3]}},
+        ]},
+    }
+    from bigdl_tpu.keras.converter import DefinitionLoader
+    with pytest.raises(KerasConversionError, match="reset_after"):
+        DefinitionLoader.from_json_str(json.dumps(spec))
+
+
+def test_variable_length_recurrent_loads():
+    """Partial input shapes ([None, None, d]) must survive: recurrent
+    layers only need the feature dim (review regression repro)."""
+    import numpy as np
+    from bigdl_tpu.keras.converter import DefinitionLoader
+    spec = {
+        "class_name": "Sequential", "keras_version": "2.15.0",
+        "config": {"name": "v", "layers": [
+            {"class_name": "InputLayer",
+             "config": {"batch_input_shape": [None, None, 32]}},
+            {"class_name": "LSTM", "config": {
+                "name": "l", "units": 4, "return_sequences": False}},
+        ]},
+    }
+    m = DefinitionLoader.from_spec(spec)
+    x = np.random.RandomState(0).randn(2, 7, 32).astype(np.float32)
+    assert np.asarray(m.forward(x)).shape == (2, 4)
+
+
+def test_gru_without_reset_after_key_loads():
+    """Pre-2.2 keras GRU configs lack reset_after — classic form."""
+    from bigdl_tpu.keras.converter import DefinitionLoader
+    spec = {
+        "class_name": "Sequential", "keras_version": "2.0.8",
+        "config": {"name": "g", "layers": [
+            {"class_name": "GRU", "config": {
+                "name": "g1", "units": 4,
+                "batch_input_shape": [None, 5, 3]}},
+        ]},
+    }
+    m = DefinitionLoader.from_spec(spec)
+    import numpy as np
+    x = np.random.RandomState(0).randn(2, 5, 3).astype(np.float32)
+    assert np.asarray(m.forward(x)).shape == (2, 4)
