@@ -378,16 +378,19 @@ def _builder(class_name):
 
 # --------------------------------------------------------------------- #
 # Keras 2.x schema (tf.keras / keras>=2 JSON): translated onto the same #
-# wrapper layers.  Conv/pool 2D require data_format='channels_first'    #
-# (the wrappers are channels-first like the reference's keras API);     #
-# 1D layers are (B, T, C) in both schemas.                              #
+# wrapper layers.  Conv/pool/BN 2D honor data_format: channels_last     #
+# builds the TPU-native NHWC nn layers directly, channels_first the     #
+# NCHW ones; 1D layers are (B, T, C) in both schemas.                   #
 # --------------------------------------------------------------------- #
-def _k2_cf(cfg, who):
-    df = cfg.get("data_format", "channels_last")
-    if df != "channels_first":
-        _unsupported(f"{who} with data_format={df!r} (convert the model "
-                     "to channels_first; the channels-first layout is "
-                     "also what the TPU conv wrappers implement)")
+def _k2_order(cfg):
+    """keras-2 data_format -> wrapper dim_ordering.  channels_last maps
+    onto the TPU-native NHWC nn layers; channels_first onto NCHW."""
+    df = cfg.get("data_format") or "channels_last"
+    if df == "channels_last":
+        return "tf"
+    if df == "channels_first":
+        return "th"
+    _unsupported(f"data_format={df!r}")
 
 
 def _k2_pad(cfg, who):
@@ -418,8 +421,13 @@ def _k2_embedding(cfg):
 def _k2_batchnorm(cfg):
     if not (cfg.get("center", True) and cfg.get("scale", True)):
         _unsupported("BatchNormalization without center/scale")
+    ax = cfg.get("axis", -1)
+    ax = ax[0] if isinstance(ax, (list, tuple)) else ax
+    # axis -1/3 = channels-last (4D) or plain feature BN (2D/3D);
+    # axis 1 = channels-first spatial BN
     return L.BatchNormalization(epsilon=cfg.get("epsilon", 1e-3),
                                 momentum=cfg.get("momentum", 0.99),
+                                dim_ordering="th" if ax == 1 else "tf",
                                 input_shape=_input_shape(cfg),
                                 name=cfg.get("name"))
 
@@ -490,7 +498,6 @@ def _k2_conv1d(cfg):
 
 
 def _k2_conv2d(cfg):
-    _k2_cf(cfg, "Conv2D")
     kh, kw = _pair(cfg["kernel_size"])
     sh, sw = _pair(cfg.get("strides"))
     if _pair(cfg.get("dilation_rate")) != (1, 1):
@@ -500,6 +507,7 @@ def _k2_conv2d(cfg):
     return L.Convolution2D(cfg["filters"], kh, kw, activation=_act(cfg),
                            border_mode=_k2_pad(cfg, "Conv2D"),
                            subsample=(sh, sw),
+                           dim_ordering=_k2_order(cfg),
                            bias=cfg.get("use_bias", True),
                            input_shape=_input_shape(cfg),
                            name=cfg.get("name"))
@@ -507,11 +515,11 @@ def _k2_conv2d(cfg):
 
 def _k2_pool2d(cls):
     def build(cfg):
-        _k2_cf(cfg, cls.__name__)
         ph, pw = _pair(cfg.get("pool_size"), (2, 2))
         st = _pair(cfg.get("strides"), (ph, pw))
         return cls(pool_size=(ph, pw), strides=tuple(st),
                    border_mode=_k2_pad(cfg, cls.__name__),
+                   dim_ordering=_k2_order(cfg),
                    input_shape=_input_shape(cfg), name=cfg.get("name"))
     return build
 
@@ -528,8 +536,8 @@ def _k2_pool1d(cls):
 
 def _k2_global2d(cls):
     def build(cfg):
-        _k2_cf(cfg, cls.__name__)
-        return cls(input_shape=_input_shape(cfg), name=cfg.get("name"))
+        return cls(dim_ordering=_k2_order(cfg),
+                   input_shape=_input_shape(cfg), name=cfg.get("name"))
     return build
 
 

@@ -349,12 +349,21 @@ class BatchNormalization(KerasLayer):
         super().__init__(input_shape=input_shape, name=name)
         self.epsilon = epsilon
         self.momentum = momentum
+        self.dim_ordering = dim_ordering
 
     def _build(self, input_shape):
-        n = input_shape[1]
+        tf_order = self.dim_ordering == "tf"
+        if len(input_shape) == 3 and tf_order:
+            # (B, T, C) channels-last: per-feature BN over batch+time
+            return N.TemporalBatchNormalization(
+                input_shape[2], eps=self.epsilon,
+                momentum=1.0 - self.momentum)
+        n = input_shape[3] if tf_order and len(input_shape) == 4 \
+            else input_shape[1]
         if len(input_shape) == 4:
             return N.SpatialBatchNormalization(
-                n, eps=self.epsilon, momentum=1.0 - self.momentum)
+                n, eps=self.epsilon, momentum=1.0 - self.momentum,
+                format="NHWC" if tf_order else "NCHW")
         return N.BatchNormalization(
             n, eps=self.epsilon, momentum=1.0 - self.momentum)
 
@@ -455,7 +464,9 @@ class Convolution1D(KerasLayer):
 
 
 class Convolution2D(KerasLayer):
-    """(B, C, H, W) channels-first (≙ keras/Convolution2D.scala)."""
+    """(B, C, H, W) channels-first (≙ keras/Convolution2D.scala), or
+    channels-last (B, H, W, C) with dim_ordering='tf' — the TPU-native
+    NHWC layout, used by the keras-2/tf.keras converter."""
 
     def __init__(self, nb_filter, nb_row, nb_col, activation=None,
                  border_mode="valid", subsample=(1, 1), dim_ordering="th",
@@ -468,18 +479,22 @@ class Convolution2D(KerasLayer):
         self.activation = activation
         self.border_mode = border_mode
         self.subsample = subsample
+        self.dim_ordering = dim_ordering
         self.w_regularizer = w_regularizer
         self.b_regularizer = b_regularizer
         self.bias = bias
 
     def _build(self, input_shape):
         pad = _same_pad(self.border_mode)
+        tf_order = self.dim_ordering == "tf"
+        in_ch = input_shape[3] if tf_order else input_shape[1]
         conv = N.SpatialConvolution(
-            input_shape[1], self.nb_filter, self.nb_col, self.nb_row,
+            in_ch, self.nb_filter, self.nb_col, self.nb_row,
             stride_w=self.subsample[1], stride_h=self.subsample[0],
             pad_w=pad, pad_h=pad, with_bias=self.bias,
             w_regularizer=self.w_regularizer,
-            b_regularizer=self.b_regularizer)
+            b_regularizer=self.b_regularizer,
+            format="NHWC" if tf_order else "NCHW")
         if self.activation is None:
             return conv
         return N.Sequential().add(conv).add(_act_module(self.activation))
@@ -699,12 +714,14 @@ class MaxPooling2D(KerasLayer):
         self.pool_size = pool_size
         self.strides = strides or pool_size
         self.border_mode = border_mode
+        self.dim_ordering = dim_ordering
 
     def _build(self, input_shape):
         pad = _same_pad(self.border_mode)
         return N.SpatialMaxPooling(
             self.pool_size[1], self.pool_size[0],
-            dw=self.strides[1], dh=self.strides[0], pad_w=pad, pad_h=pad)
+            dw=self.strides[1], dh=self.strides[0], pad_w=pad, pad_h=pad,
+            format="NHWC" if self.dim_ordering == "tf" else "NCHW")
 
 
 class MaxPooling3D(KerasLayer):
@@ -744,12 +761,14 @@ class AveragePooling2D(KerasLayer):
         self.pool_size = pool_size
         self.strides = strides or pool_size
         self.border_mode = border_mode
+        self.dim_ordering = dim_ordering
 
     def _build(self, input_shape):
         pad = _same_pad(self.border_mode)
         return N.SpatialAveragePooling(
             self.pool_size[1], self.pool_size[0],
-            dw=self.strides[1], dh=self.strides[0], pad_w=pad, pad_h=pad)
+            dw=self.strides[1], dh=self.strides[0], pad_w=pad, pad_h=pad,
+            format="NHWC" if self.dim_ordering == "tf" else "NCHW")
 
 
 class AveragePooling3D(KerasLayer):
@@ -767,10 +786,18 @@ class AveragePooling3D(KerasLayer):
 
 class _GlobalPool(KerasLayer):
     _mean = True
+    dim_ordering = "th"
+
+    def __init__(self, dim_ordering="th", input_shape=None, name=None):
+        super().__init__(input_shape=input_shape, name=name)
+        self.dim_ordering = dim_ordering
 
     def _build(self, input_shape):
         nd = len(input_shape)
-        axes = list(range(2, nd))          # all spatial dims (ch-first)
+        if self.dim_ordering == "tf":
+            axes = list(range(1, nd - 1))  # spatial dims (channels-last)
+        else:
+            axes = list(range(2, nd))      # spatial dims (channels-first)
         op = N.Mean if self._mean else N.Max
         seq = N.Sequential()
         for ax in reversed(axes):          # reduce innermost first

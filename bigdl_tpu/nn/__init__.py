@@ -25,6 +25,7 @@ from .pooling import (SpatialMaxPooling, SpatialAveragePooling,
                       TemporalMaxPooling, UpSampling1D, UpSampling2D,
                       UpSampling3D, ResizeBilinear)
 from .normalization import (BatchNormalization, SpatialBatchNormalization,
+                            TemporalBatchNormalization,
                             LayerNormalization, RMSNorm, SpatialCrossMapLRN,
                             SpatialWithinChannelLRN,
                             SpatialSubtractiveNormalization,

@@ -169,6 +169,15 @@ class BatchNormalization(Module):
         })
 
 
+class TemporalBatchNormalization(BatchNormalization):
+    """Per-feature BN over (B, T, C) channels-last sequences (stats over
+    batch and time).  No direct reference twin — the keras-2 converter
+    needs it for Conv1D -> BatchNormalization(axis=-1) stacks; the math
+    is BatchNormalization with the channel axis last."""
+
+    channel_axis = 2
+
+
 class SpatialBatchNormalization(BatchNormalization):
     """nn/SpatialBatchNormalization.scala — BN over NCHW (or NHWC with
     format='NHWC'), per-channel."""

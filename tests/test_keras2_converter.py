@@ -146,19 +146,40 @@ def test_conv2d_channels_first_config_translation():
     np.testing.assert_allclose(got, np.asarray(y), rtol=1e-5, atol=1e-5)
 
 
-def test_channels_last_conv_rejected_clearly():
-    spec = {
-        "class_name": "Sequential", "keras_version": "2.15.0",
-        "config": {"name": "cl", "layers": [
-            {"class_name": "Conv2D", "config": {
-                "name": "c", "filters": 4, "kernel_size": [3, 3],
-                "batch_input_shape": [None, 8, 8, 3],
-                "data_format": "channels_last"}},
-        ]},
-    }
-    from bigdl_tpu.keras.converter import DefinitionLoader
-    with pytest.raises(KerasConversionError, match="channels_first"):
-        DefinitionLoader.from_json_str(json.dumps(spec))
+def test_cnn_channels_last():
+    """Default tf.keras CNN (channels_last == the TPU-native NHWC
+    layout): conv/BN/pools/global-pool, cross-validated against real
+    tf_keras predictions."""
+    tfk.utils.set_random_seed(6)
+    m = tfk.Sequential([
+        tfk.layers.Input((12, 12, 3)),
+        tfk.layers.Conv2D(8, 3, activation="relu", padding="same"),
+        tfk.layers.BatchNormalization(),
+        tfk.layers.MaxPooling2D(2),
+        tfk.layers.Conv2D(6, 3, strides=2, padding="valid"),
+        tfk.layers.GlobalAveragePooling2D(),
+        tfk.layers.Dense(4, activation="softmax"),
+    ])
+    x = np.random.RandomState(6).randn(4, 12, 12, 3).astype(np.float32)
+    m.predict(x, verbose=0)
+    want, got = _roundtrip(m, x)
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+
+
+def test_cnn_channels_last_flatten():
+    """Conv -> Flatten -> Dense: the flatten order must match keras
+    channels_last semantics."""
+    tfk.utils.set_random_seed(7)
+    m = tfk.Sequential([
+        tfk.layers.Input((8, 8, 2)),
+        tfk.layers.Conv2D(5, 3),
+        tfk.layers.AveragePooling2D(2),
+        tfk.layers.Flatten(),
+        tfk.layers.Dense(3),
+    ])
+    x = np.random.RandomState(7).randn(3, 8, 8, 2).astype(np.float32)
+    want, got = _roundtrip(m, x)
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
 
 
 def test_gru_reset_after_rejected_clearly():
@@ -209,3 +230,21 @@ def test_gru_without_reset_after_key_loads():
     import numpy as np
     x = np.random.RandomState(0).randn(2, 5, 3).astype(np.float32)
     assert np.asarray(m.forward(x)).shape == (2, 4)
+
+
+def test_conv1d_batchnorm_stack():
+    """Conv1D -> BatchNormalization(axis=-1) on (B, T, C): per-feature
+    BN over batch+time (review finding repro)."""
+    tfk.utils.set_random_seed(8)
+    m = tfk.Sequential([
+        tfk.layers.Input((14,)),
+        tfk.layers.Embedding(20, 6),
+        tfk.layers.Conv1D(9, 3),
+        tfk.layers.BatchNormalization(),
+        tfk.layers.GlobalAveragePooling1D(),
+        tfk.layers.Dense(2),
+    ])
+    x = np.random.RandomState(8).randint(0, 20, (4, 14)).astype(np.float32)
+    m.predict(x, verbose=0)
+    want, got = _roundtrip(m, x)
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)

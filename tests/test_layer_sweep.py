@@ -137,6 +137,8 @@ SPECS = {
     "BatchNormalization": (lambda: nn.BatchNormalization(5), lambda: R(4, 5)),
     "SpatialBatchNormalization": (
         lambda: nn.SpatialBatchNormalization(3), lambda: R(2, 3, 6, 6)),
+    "TemporalBatchNormalization": (
+        lambda: nn.TemporalBatchNormalization(5), lambda: R(2, 7, 5)),
     "LayerNormalization": (lambda: nn.LayerNormalization(5), lambda: R(3, 5)),
     "RMSNorm": (lambda: nn.RMSNorm(5), lambda: R(3, 5)),
     "SpatialCrossMapLRN": (lambda: nn.SpatialCrossMapLRN(3),
