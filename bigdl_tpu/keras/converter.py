@@ -954,14 +954,39 @@ def load_keras(json_path=None, hdf5_path=None, by_name=True):
     """≙ pyspark bigdl.nn.layer.Model.load_keras(json_path, hdf5_path).
 
     Accepts the keras-1.2.2 schema the reference supports AND the
-    keras-2.x / tf.keras schema (auto-detected from the JSON)."""
+    keras-2.x / tf.keras schema (auto-detected from the JSON).
+    ``json_path=None`` with an ``hdf5_path`` loads a single-file keras
+    model (``model.save('m.h5')``): the definition is read from the
+    file's ``model_config`` attribute."""
     if json_path is None:
-        raise ValueError("json_path is required (definition)")
-    with open(json_path) as f:
-        spec = json.load(f)
+        if not hdf5_path:
+            raise ValueError("need json_path and/or hdf5_path")
+        spec = _model_config_from_hdf5(hdf5_path)
+    else:
+        with open(json_path) as f:
+            spec = json.load(f)
     schema = "k2" if _is_keras2(spec) else "k1"
     model = DefinitionLoader.from_spec(spec)
     if hdf5_path:
         WeightLoader.load_weights_from_hdf5(model, hdf5_path,
                                             by_name=by_name, schema=schema)
     return model
+
+
+def _model_config_from_hdf5(path):
+    """Model definition from a full-model keras HDF5 (the
+    ``model_config`` root attribute written by ``model.save``)."""
+    import h5py
+    with h5py.File(path, "r") as f:
+        cfg = f.attrs.get("model_config")
+        kv = f.attrs.get("keras_version")
+    if cfg is None:
+        raise KerasConversionError(
+            f"{path} has no model_config attribute (weights-only file?) "
+            "— pass the architecture JSON via json_path")
+    spec = json.loads(_dec(cfg))
+    # keras stores the version as a SIBLING root attr, not inside the
+    # config JSON — without it a Functional spec would misdetect as k1
+    if kv is not None and "keras_version" not in spec:
+        spec["keras_version"] = _dec(kv)
+    return spec

@@ -248,3 +248,48 @@ def test_conv1d_batchnorm_stack():
     m.predict(x, verbose=0)
     want, got = _roundtrip(m, x)
     np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+
+
+def test_single_file_h5_model():
+    """model.save('m.h5') single-file loading: config from the
+    model_config attribute, weights from model_weights."""
+    tfk.utils.set_random_seed(10)
+    m = tfk.Sequential([
+        tfk.layers.Input((6,)),
+        tfk.layers.Dense(8, activation="relu"),
+        tfk.layers.Dense(3, activation="softmax"),
+    ])
+    x = np.random.RandomState(10).randn(5, 6).astype(np.float32)
+    with tempfile.TemporaryDirectory() as d:
+        hp = os.path.join(d, "full.h5")
+        m.save(hp)
+        ours = load_keras(hdf5_path=hp)
+        want = np.asarray(m.predict(x, verbose=0))
+        got = np.asarray(ours.forward(x))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_weights_only_h5_without_json_errors():
+    m = tfk.Sequential([tfk.layers.Input((4,)), tfk.layers.Dense(2)])
+    with tempfile.TemporaryDirectory() as d:
+        hp = os.path.join(d, "w.h5")
+        m.save_weights(hp)
+        with pytest.raises(KerasConversionError, match="model_config"):
+            load_keras(hdf5_path=hp)
+
+
+def test_single_file_h5_functional_model():
+    """Functional full-model .h5: keras_version lives in a sibling root
+    attr, not the config JSON (review finding repro)."""
+    tfk.utils.set_random_seed(12)
+    inp = tfk.layers.Input((5,))
+    out = tfk.layers.Dense(2)(tfk.layers.Dense(6, activation="relu")(inp))
+    m = tfk.Model(inp, out)
+    x = np.random.RandomState(12).randn(4, 5).astype(np.float32)
+    with tempfile.TemporaryDirectory() as d:
+        hp = os.path.join(d, "f.h5")
+        m.save(hp)
+        ours = load_keras(hdf5_path=hp)
+        want = np.asarray(m.predict(x, verbose=0))
+        got = np.asarray(ours.forward(x))
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
