@@ -513,6 +513,30 @@ def _k2_conv2d(cfg):
                            name=cfg.get("name"))
 
 
+def _k2_sepconv2d(cfg):
+    kh, kw = _pair(cfg["kernel_size"])
+    sh, sw = _pair(cfg.get("strides"))
+    if _pair(cfg.get("dilation_rate")) != (1, 1):
+        _unsupported("SeparableConv2D dilation_rate != 1")
+    return L.SeparableConvolution2D(
+        cfg["filters"], kh, kw, activation=_act(cfg),
+        border_mode=_k2_pad(cfg, "SeparableConv2D"),
+        subsample=(sh, sw),
+        depth_multiplier=cfg.get("depth_multiplier", 1),
+        dim_ordering=_k2_order(cfg), bias=cfg.get("use_bias", True),
+        input_shape=_input_shape(cfg), name=cfg.get("name"))
+
+
+def _k2_upsampling2d(cfg):
+    if cfg.get("interpolation", "nearest") != "nearest":
+        _unsupported(f"UpSampling2D interpolation="
+                     f"{cfg.get('interpolation')!r} (only 'nearest')")
+    return L.UpSampling2D(size=_pair(cfg.get("size"), (2, 2)),
+                          dim_ordering=_k2_order(cfg),
+                          input_shape=_input_shape(cfg),
+                          name=cfg.get("name"))
+
+
 def _k2_pool2d(cls):
     def build(cfg):
         ph, pw = _pair(cfg.get("pool_size"), (2, 2))
@@ -579,6 +603,8 @@ _K2_BUILDERS = {
         input_shape=_input_shape(cfg), name=cfg.get("name")),
     "GlobalMaxPooling2D": _k2_global2d(L.GlobalMaxPooling2D),
     "GlobalAveragePooling2D": _k2_global2d(L.GlobalAveragePooling2D),
+    "SeparableConv2D": _k2_sepconv2d,
+    "UpSampling2D": _k2_upsampling2d,
     "LeakyReLU": lambda cfg: L.LeakyReLU(alpha=cfg.get("alpha", 0.3),
                                          input_shape=_input_shape(cfg),
                                          name=cfg.get("name")),
@@ -838,6 +864,18 @@ def _load_layer_weights(klayer, ws, params, state, schema="k1"):
             W = np.transpose(ws[0], (2, 1, 0))
             _set(params, conv, weight=W,
                  **({"bias": ws[1]} if len(ws) > 1 else {}))
+            return
+        if isinstance(klayer, L.SeparableConvolution2D):
+            conv = _find(klayer, N.SpatialSeparableConvolution)[0]
+            # depthwise (kh, kw, in, mult) -> grouped OIHW
+            # (in*mult, 1, kh, kw) with input-major channel order
+            dw = np.transpose(ws[0], (2, 3, 0, 1))
+            dw = dw.reshape(dw.shape[0] * dw.shape[1], 1,
+                            dw.shape[2], dw.shape[3])
+            # pointwise (1, 1, in*mult, out) -> (out, in*mult, 1, 1)
+            pw = np.transpose(ws[1], (3, 2, 0, 1))
+            _set(params, conv, depth_weight=dw, point_weight=pw,
+                 **({"bias": ws[2]} if len(ws) > 2 else {}))
             return
         # Dense/Embedding/BatchNormalization file layouts match keras 1:
         # fall through to the shared adapters below

@@ -636,13 +636,17 @@ class SeparableConvolution2D(KerasLayer):
         self.depthwise_regularizer = depthwise_regularizer
         self.pointwise_regularizer = pointwise_regularizer
         self.b_regularizer = b_regularizer
+        self.dim_ordering = dim_ordering
 
     def _build(self, input_shape):
         pad = _same_pad(self.border_mode)
+        tf_order = self.dim_ordering == "tf"
+        in_ch = input_shape[3] if tf_order else input_shape[1]
         conv = N.SpatialSeparableConvolution(
-            input_shape[1], self.nb_filter, self.depth_multiplier,
+            in_ch, self.nb_filter, self.depth_multiplier,
             self.nb_col, self.nb_row, sw=self.subsample[1],
             sh=self.subsample[0], pw=pad, ph=pad, with_bias=self.bias,
+            data_format="NHWC" if tf_order else "NCHW",
             w_regularizer=self.depthwise_regularizer,
             p_regularizer=self.pointwise_regularizer,
             b_regularizer=self.b_regularizer)
@@ -922,9 +926,12 @@ class UpSampling2D(KerasLayer):
                  input_shape=None, name=None):
         super().__init__(input_shape=input_shape, name=name)
         self.size = size
+        self.dim_ordering = dim_ordering
 
     def _build(self, input_shape):
-        return N.UpSampling2D(self.size)
+        return N.UpSampling2D(self.size,
+                              format="NHWC" if self.dim_ordering == "tf"
+                              else "NCHW")
 
 
 class UpSampling3D(KerasLayer):

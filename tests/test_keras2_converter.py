@@ -293,3 +293,19 @@ def test_single_file_h5_functional_model():
         want = np.asarray(m.predict(x, verbose=0))
         got = np.asarray(ours.forward(x))
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+
+def test_separable_conv_and_upsampling_channels_last():
+    tfk.utils.set_random_seed(13)
+    m = tfk.Sequential([
+        tfk.layers.Input((10, 10, 3)),
+        tfk.layers.SeparableConv2D(6, 3, depth_multiplier=2,
+                                   activation="relu"),
+        tfk.layers.UpSampling2D(2),
+        tfk.layers.SeparableConv2D(4, 3, padding="same", strides=2),
+        tfk.layers.GlobalMaxPooling2D(),
+        tfk.layers.Dense(2),
+    ])
+    x = np.random.RandomState(13).randn(3, 10, 10, 3).astype(np.float32)
+    want, got = _roundtrip(m, x)
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
