@@ -118,6 +118,7 @@ def _deconvolution2d(cfg):
     return L.Deconvolution2D(
         cfg["nb_filter"], cfg["nb_row"], cfg["nb_col"],
         activation=_act(cfg), subsample=tuple(cfg.get("subsample", (1, 1))),
+        border_mode=cfg.get("border_mode", "valid"),
         bias=cfg.get("bias", True), input_shape=_input_shape(cfg),
         name=cfg.get("name"))
 
@@ -527,6 +528,22 @@ def _k2_sepconv2d(cfg):
         input_shape=_input_shape(cfg), name=cfg.get("name"))
 
 
+def _k2_conv2dtranspose(cfg):
+    kh, kw = _pair(cfg["kernel_size"])
+    sh, sw = _pair(cfg.get("strides"))
+    if cfg.get("output_padding") is not None:
+        _unsupported("Conv2DTranspose with explicit output_padding")
+    if _pair(cfg.get("dilation_rate")) != (1, 1):
+        _unsupported("Conv2DTranspose dilation_rate != 1")
+    return L.Deconvolution2D(cfg["filters"], kh, kw, activation=_act(cfg),
+                             subsample=(sh, sw),
+                             border_mode=_k2_pad(cfg, "Conv2DTranspose"),
+                             dim_ordering=_k2_order(cfg),
+                             bias=cfg.get("use_bias", True),
+                             input_shape=_input_shape(cfg),
+                             name=cfg.get("name"))
+
+
 def _k2_upsampling2d(cfg):
     if cfg.get("interpolation", "nearest") != "nearest":
         _unsupported(f"UpSampling2D interpolation="
@@ -604,6 +621,7 @@ _K2_BUILDERS = {
     "GlobalMaxPooling2D": _k2_global2d(L.GlobalMaxPooling2D),
     "GlobalAveragePooling2D": _k2_global2d(L.GlobalAveragePooling2D),
     "SeparableConv2D": _k2_sepconv2d,
+    "Conv2DTranspose": _k2_conv2dtranspose,
     "UpSampling2D": _k2_upsampling2d,
     "LeakyReLU": lambda cfg: L.LeakyReLU(alpha=cfg.get("alpha", 0.3),
                                          input_shape=_input_shape(cfg),
@@ -862,6 +880,13 @@ def _load_layer_weights(klayer, ws, params, state, schema="k1"):
             conv = _find(klayer, N.TemporalConvolution)[0]
             # file kernel (k, in, out) -> ours (out, in, k)
             W = np.transpose(ws[0], (2, 1, 0))
+            _set(params, conv, weight=W,
+                 **({"bias": ws[1]} if len(ws) > 1 else {}))
+            return
+        if isinstance(klayer, L.Deconvolution2D):
+            conv = _find(klayer, N.SpatialFullConvolution)[0]
+            # file kernel (kh, kw, out, in) -> ours (in, out, kh, kw)
+            W = np.transpose(ws[0], (3, 2, 0, 1))
             _set(params, conv, weight=W,
                  **({"bias": ws[1]} if len(ws) > 1 else {}))
             return

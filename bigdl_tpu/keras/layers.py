@@ -595,22 +595,39 @@ class Deconvolution2D(KerasLayer):
     def __init__(self, nb_filter, nb_row, nb_col, activation=None,
                  subsample=(1, 1), dim_ordering="th",
                  w_regularizer=None, b_regularizer=None, bias=True,
-                 input_shape=None, name=None):
+                 border_mode="valid", input_shape=None, name=None):
         super().__init__(input_shape=input_shape, name=name)
         self.nb_filter = nb_filter
         self.nb_row = nb_row
         self.nb_col = nb_col
         self.activation = activation
         self.subsample = subsample
+        self.dim_ordering = dim_ordering
+        self.border_mode = border_mode
         self.w_regularizer = w_regularizer
         self.b_regularizer = b_regularizer
         self.bias = bias
 
     def _build(self, input_shape):
+        tf_order = self.dim_ordering == "tf"
+        in_ch = input_shape[3] if tf_order else input_shape[1]
+        sh, sw = self.subsample
+        if self.border_mode == "same":
+            # keras/TF SAME transpose conv: out = in*stride.  Our module
+            # emits (in-1)*s - 2*pad + k + adj, so per dim
+            # pad = max(k-s, 0)//2 and adj = s - k + 2*pad (absorbs the
+            # odd remainder; equals s-k when kernel < stride).
+            ph = max(self.nb_row - sh, 0) // 2
+            pw = max(self.nb_col - sw, 0) // 2
+            ah = sh - self.nb_row + 2 * ph
+            aw = sw - self.nb_col + 2 * pw
+        else:
+            ph = pw = ah = aw = 0
         conv = N.SpatialFullConvolution(
-            input_shape[1], self.nb_filter, self.nb_col, self.nb_row,
-            dw=self.subsample[1], dh=self.subsample[0],
+            in_ch, self.nb_filter, self.nb_col, self.nb_row,
+            dw=sw, dh=sh, pad_w=pw, pad_h=ph, adj_w=aw, adj_h=ah,
             no_bias=not self.bias,
+            format="NHWC" if tf_order else "NCHW",
             w_regularizer=self.w_regularizer,
             b_regularizer=self.b_regularizer)
         if self.activation is None:

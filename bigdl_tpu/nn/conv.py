@@ -241,7 +241,7 @@ class SpatialFullConvolution(Module):
     def __init__(self, n_input_plane, n_output_plane, kw, kh, dw=1, dh=1,
                  pad_w=0, pad_h=0, adj_w=0, adj_h=0, n_group=1,
                  no_bias=False, w_regularizer=None, b_regularizer=None,
-                 name=None):
+                 format="NCHW", name=None):
         super().__init__(name=name)
         self.n_input_plane = n_input_plane
         self.n_output_plane = n_output_plane
@@ -251,6 +251,7 @@ class SpatialFullConvolution(Module):
         self.adj = (adj_h, adj_w)
         self.n_group = n_group
         self.with_bias = not no_bias
+        self.format = format
         self.w_regularizer = w_regularizer
         self.b_regularizer = b_regularizer
 
@@ -288,12 +289,16 @@ class SpatialFullConvolution(Module):
             w = (w.reshape(g, i_g, o_g, kh, kw)
                   .transpose(1, 0, 2, 3, 4)
                   .reshape(i_g, g * o_g, kh, kw))
+        dn = ("NCHW", "IOHW", "NCHW") if self.format == "NCHW" \
+            else ("NHWC", "IOHW", "NHWC")
         y = lax.conv_general_dilated(
             x, w, window_strides=(1, 1), padding=pads,
             lhs_dilation=(sh, sw), feature_group_count=g,
-            dimension_numbers=("NCHW", "IOHW", "NCHW"))
+            dimension_numbers=dn)
         if self.with_bias:
-            y = y + p["bias"].astype(x.dtype)[None, :, None, None]
+            b = p["bias"].astype(x.dtype)
+            y = y + (b[None, :, None, None] if self.format == "NCHW"
+                     else b[None, None, None, :])
         return y
 
 

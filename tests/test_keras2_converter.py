@@ -309,3 +309,32 @@ def test_separable_conv_and_upsampling_channels_last():
     x = np.random.RandomState(13).randn(3, 10, 10, 3).astype(np.float32)
     want, got = _roundtrip(m, x)
     np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+
+
+@pytest.mark.parametrize("pad,stride,k", [("valid", 2, 3), ("same", 2, 3),
+                                          ("same", 2, 4), ("valid", 1, 3)])
+def test_conv2dtranspose_channels_last(pad, stride, k):
+    tfk.utils.set_random_seed(14)
+    m = tfk.Sequential([
+        tfk.layers.Input((6, 6, 3)),
+        tfk.layers.Conv2DTranspose(5, k, strides=stride, padding=pad,
+                                   activation="relu"),
+        tfk.layers.Conv2D(4, 3, padding="same"),
+    ])
+    x = np.random.RandomState(14).randn(2, 6, 6, 3).astype(np.float32)
+    want, got = _roundtrip(m, x)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+
+
+def test_conv2dtranspose_kernel_smaller_than_stride():
+    """SAME transpose conv with kernel < stride (review finding)."""
+    tfk.utils.set_random_seed(15)
+    m = tfk.Sequential([
+        tfk.layers.Input((5, 5, 2)),
+        tfk.layers.Conv2DTranspose(3, 2, strides=3, padding="same"),
+    ])
+    x = np.random.RandomState(15).randn(2, 5, 5, 2).astype(np.float32)
+    want, got = _roundtrip(m, x)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
