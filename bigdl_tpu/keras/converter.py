@@ -350,10 +350,10 @@ _BUILDERS = {
     "GlobalAveragePooling3D": _simple(L.GlobalAveragePooling3D),
     "GlobalMaxPooling3D": _simple(L.GlobalMaxPooling3D),
     "ZeroPadding1D": _simple(L.ZeroPadding1D, "padding"),
-    "ZeroPadding2D": _simple(L.ZeroPadding2D, "padding"),
+    "ZeroPadding2D": _simple(L.ZeroPadding2D, "padding", "dim_ordering"),
     "ZeroPadding3D": _simple(L.ZeroPadding3D, "padding"),
     "Cropping1D": _simple(L.Cropping1D, "cropping"),
-    "Cropping2D": _simple(L.Cropping2D, "cropping"),
+    "Cropping2D": _simple(L.Cropping2D, "cropping", "dim_ordering"),
     "Cropping3D": _simple(L.Cropping3D, "cropping"),
     "UpSampling1D": _simple(L.UpSampling1D, "length"),
     "UpSampling2D": _simple(L.UpSampling2D, "size"),
@@ -544,6 +544,17 @@ def _k2_conv2dtranspose(cfg):
                              name=cfg.get("name"))
 
 
+def _padpair2d(v):
+    """keras-2 2D pad/crop spec -> ((top, bottom), (left, right)).
+    Accepts int, (h, w), or ((t, b), (l, r))."""
+    if isinstance(v, int):
+        return ((v, v), (v, v))
+    a, b = v
+    if isinstance(a, int):
+        return ((a, a), (b, b))
+    return (tuple(a), tuple(b))
+
+
 def _k2_upsampling2d(cfg):
     if cfg.get("interpolation", "nearest") != "nearest":
         _unsupported(f"UpSampling2D interpolation="
@@ -622,6 +633,14 @@ _K2_BUILDERS = {
     "GlobalAveragePooling2D": _k2_global2d(L.GlobalAveragePooling2D),
     "SeparableConv2D": _k2_sepconv2d,
     "Conv2DTranspose": _k2_conv2dtranspose,
+    "ZeroPadding2D": lambda cfg: L.ZeroPadding2D(
+        padding=_padpair2d(cfg.get("padding", 1)),
+        dim_ordering=_k2_order(cfg),
+        input_shape=_input_shape(cfg), name=cfg.get("name")),
+    "Cropping2D": lambda cfg: L.Cropping2D(
+        cropping=_padpair2d(cfg.get("cropping", 0)),
+        dim_ordering=_k2_order(cfg),
+        input_shape=_input_shape(cfg), name=cfg.get("name")),
     "UpSampling2D": _k2_upsampling2d,
     "LeakyReLU": lambda cfg: L.LeakyReLU(alpha=cfg.get("alpha", 0.3),
                                          input_shape=_input_shape(cfg),

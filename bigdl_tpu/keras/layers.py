@@ -875,10 +875,16 @@ class ZeroPadding2D(KerasLayer):
                  input_shape=None, name=None):
         super().__init__(input_shape=input_shape, name=name)
         self.padding = padding
+        self.dim_ordering = dim_ordering
 
     def _build(self, input_shape):
-        ph, pw = self.padding
-        return N.SpatialZeroPadding(pw, pw, ph, ph)
+        fmt = "NHWC" if self.dim_ordering == "tf" else "NCHW"
+        p = self.padding
+        if len(p) == 2 and all(isinstance(v, (list, tuple)) for v in p):
+            (pt, pb), (pl, pr) = p      # keras-2 ((top,bottom),(l,r))
+        else:
+            (pt, pb), (pl, pr) = (p[0], p[0]), (p[1], p[1])
+        return N.SpatialZeroPadding(pl, pr, pt, pb, format=fmt)
 
 
 class ZeroPadding3D(KerasLayer):
@@ -913,9 +919,12 @@ class Cropping2D(KerasLayer):
                  input_shape=None, name=None):
         super().__init__(input_shape=input_shape, name=name)
         self.cropping = cropping
+        self.dim_ordering = dim_ordering
 
     def _build(self, input_shape):
-        return N.Cropping2D(list(self.cropping[0]), list(self.cropping[1]))
+        return N.Cropping2D(list(self.cropping[0]), list(self.cropping[1]),
+                            format="NHWC" if self.dim_ordering == "tf"
+                            else "NCHW")
 
 
 class Cropping3D(KerasLayer):

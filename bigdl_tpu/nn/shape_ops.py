@@ -210,22 +210,30 @@ class Padding(Module):
 
 class SpatialZeroPadding(Module):
     """Zero-pad H/W of NCHW input (nn/SpatialZeroPadding.scala); negative
-    padding crops."""
+    padding crops.  format='NHWC' pads channels-last input."""
 
     def __init__(self, pad_left, pad_right=None, pad_top=None, pad_bottom=None,
-                 name=None):
+                 format="NCHW", name=None):
         super().__init__(name=name)
         if pad_right is None:
             pad_right = pad_top = pad_bottom = pad_left
         self.pads = (pad_left, pad_right, pad_top, pad_bottom)
+        self.format = format
 
     def apply(self, params, x, ctx):
         l, r, t, b = self.pads
+        hax = 2 if self.format == "NCHW" else 1
         if min(self.pads) < 0:
-            h, w = x.shape[2], x.shape[3]
-            x = x[:, :, max(0, -t):h - max(0, -b), max(0, -l):w - max(0, -r)]
+            h, w = x.shape[hax], x.shape[hax + 1]
+            sl = [slice(None)] * x.ndim
+            sl[hax] = slice(max(0, -t), h - max(0, -b))
+            sl[hax + 1] = slice(max(0, -l), w - max(0, -r))
+            x = x[tuple(sl)]
             l, r, t, b = [max(0, v) for v in (l, r, t, b)]
-        return jnp.pad(x, ((0, 0), (0, 0), (t, b), (l, r)))
+        pads = [(0, 0)] * x.ndim
+        pads[hax] = (t, b)
+        pads[hax + 1] = (l, r)
+        return jnp.pad(x, pads)
 
 
 class Cropping2D(Module):

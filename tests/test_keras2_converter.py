@@ -338,3 +338,17 @@ def test_conv2dtranspose_kernel_smaller_than_stride():
     want, got = _roundtrip(m, x)
     assert got.shape == want.shape
     np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+
+
+def test_zeropadding_cropping_channels_last():
+    tfk.utils.set_random_seed(17)
+    m = tfk.Sequential([
+        tfk.layers.Input((8, 8, 3)),
+        tfk.layers.ZeroPadding2D(((1, 2), (0, 3))),
+        tfk.layers.Conv2D(4, 3),
+        tfk.layers.Cropping2D(((1, 0), (2, 1))),
+    ])
+    x = np.random.RandomState(17).randn(2, 8, 8, 3).astype(np.float32)
+    want, got = _roundtrip(m, x)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
