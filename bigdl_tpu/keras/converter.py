@@ -648,6 +648,22 @@ _K2_BUILDERS = {
     "ELU": lambda cfg: L.ELU(alpha=cfg.get("alpha", 1.0),
                              input_shape=_input_shape(cfg),
                              name=cfg.get("name")),
+    "Permute": lambda cfg: L.Permute(tuple(cfg["dims"]),
+                                     input_shape=_input_shape(cfg),
+                                     name=cfg.get("name")),
+    "RepeatVector": lambda cfg: L.RepeatVector(
+        cfg["n"], input_shape=_input_shape(cfg), name=cfg.get("name")),
+    "ThresholdedReLU": lambda cfg: L.ThresholdedReLU(
+        theta=cfg.get("theta", 1.0), input_shape=_input_shape(cfg),
+        name=cfg.get("name")),
+    "GaussianNoise": lambda cfg: L.GaussianNoise(
+        cfg["stddev"], input_shape=_input_shape(cfg),
+        name=cfg.get("name")),
+    "GaussianDropout": lambda cfg: L.GaussianDropout(
+        cfg["rate"], input_shape=_input_shape(cfg), name=cfg.get("name")),
+    "SpatialDropout1D": lambda cfg: L.SpatialDropout1D(
+        cfg.get("rate", 0.5), input_shape=_input_shape(cfg),
+        name=cfg.get("name")),
     "Add": _k2_merge("sum"),
     "Multiply": _k2_merge("mul"),
     "Average": _k2_merge("ave"),

@@ -352,3 +352,22 @@ def test_zeropadding_cropping_channels_last():
     want, got = _roundtrip(m, x)
     assert got.shape == want.shape
     np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+
+
+def test_misc_shape_and_noise_layers():
+    """Permute/RepeatVector/ThresholdedReLU + inference-identity noise
+    layers load and match tf_keras."""
+    tfk.utils.set_random_seed(18)
+    m = tfk.Sequential([
+        tfk.layers.Input((6,)),
+        tfk.layers.Dense(4),
+        tfk.layers.ThresholdedReLU(0.3),
+        tfk.layers.GaussianNoise(0.5),       # inference: identity
+        tfk.layers.RepeatVector(3),
+        tfk.layers.Permute((2, 1)),
+        tfk.layers.Flatten(),
+        tfk.layers.Dense(2),
+    ])
+    x = np.random.RandomState(18).randn(4, 6).astype(np.float32)
+    want, got = _roundtrip(m, x)
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
