@@ -21,9 +21,12 @@ ModulePersister).  Layout facts this module encodes against:
   * containers recurse through subModules
     (ModuleSerializable.scala:381 ContainerSerializable).
 
-BatchNorm running stats travel as extraParameter in a separate weight
-stream in some reference versions and are not part of the module file;
-they stay at their init values here.
+BatchNorm running stats ride the module's attr map as tensor attrs
+``runningMean``/``runningVar`` (+ per-batch ``saveMean``/``saveStd``
+temporaries) — nn/BatchNormalization.scala:323 doLoadModule reads all
+four unconditionally, :346 doSerializeModule writes them.  Both
+directions are handled here: load copies them into the model's BN
+state; save emits them (saveMean/saveStd zeroed, as after resize).
 """
 from __future__ import annotations
 
@@ -481,6 +484,23 @@ def load_bigdl(path: str):
             want = np.shape(own[k])
             own[k] = np.asarray(arr, np.float32).reshape(want)
         params[name] = own
+    # BN running statistics: tensor attrs on the BN module
+    # (nn/BatchNormalization.scala:323 doLoadModule)
+    for sub in _leaf_modules(tree):
+        if _short_type(sub["type"]) not in (
+                "SpatialBatchNormalization", "BatchNormalization"):
+            continue
+        own_st = state.get(sub["name"])
+        if not isinstance(own_st, dict):
+            continue
+        own_st = dict(own_st)
+        for attr_key, st_key in (("runningMean", "running_mean"),
+                                 ("runningVar", "running_var")):
+            val = sub["attr"].get(attr_key)
+            if val is not None and st_key in own_st:
+                own_st[st_key] = np.asarray(val, np.float32).reshape(
+                    np.shape(own_st[st_key]))
+        state[sub["name"]] = own_st
     model.set_params(params, state)
     return model
 
@@ -516,6 +536,24 @@ def _attr_entry(key: str, attr_body: bytes) -> bytes:
 
 def _attr_int(v: int) -> bytes:
     return enc_int64(1, _DT_INT32) + enc_int64(3, v & ((1 << 64) - 1))
+
+
+def _alloc_tensor(arr, counter, global_entries) -> bytes:
+    """Allocate tensor+storage ids, stash inline data in global_storage,
+    return the non-inline (storage-referencing) tensor message."""
+    arr = np.asarray(arr, np.float32)
+    counter[0] += 1
+    tid = counter[0]
+    counter[0] += 1
+    sid = counter[0]
+    global_entries[str(tid)] = _enc_tensor_msg(arr, tid, sid, inline=True)
+    return _enc_tensor_msg(arr, tid, sid, inline=False)
+
+
+def _attr_tensor(arr, counter, global_entries) -> bytes:
+    """Tensor AttrValue; data rides global_storage like parameters do."""
+    return enc_int64(1, _DT_TENSOR) + enc_bytes(
+        10, _alloc_tensor(arr, counter, global_entries))
 
 
 def _attr_double(v: float) -> bytes:
@@ -654,7 +692,7 @@ for _short, _fac in _FACTORY.items():
     _TYPE_NAMES[_short] = _NS + _short
 
 
-def _enc_graph(mod, params, counter, global_entries) -> bytes:
+def _enc_graph(mod, params, state, counter, global_entries) -> bytes:
     """nn.Graph -> StaticGraph wire form: subModules with preModules
     wiring, inputNames/outputNames attrs, per-node edges maps
     (≙ nn/Graph.scala GraphSerializable doSerializeModule)."""
@@ -691,7 +729,7 @@ def _enc_graph(mod, params, counter, global_entries) -> bytes:
         if node.module is None:
             sub = enc_string(1, nm) + enc_string(7, _NS + "Input")
         else:
-            sub = _enc_module(node.module, params, counter,
+            sub = _enc_module(node.module, params, state, counter,
                               global_entries)
         for p in pres:
             sub += enc_string(5, p)      # preModules
@@ -722,10 +760,10 @@ def _enc_graph(mod, params, counter, global_entries) -> bytes:
     return body
 
 
-def _enc_module(mod, params, counter, global_entries) -> bytes:
+def _enc_module(mod, params, state, counter, global_entries) -> bytes:
     from ..nn.graph import Graph as _NNGraph
     if isinstance(mod, _NNGraph):
-        return _enc_graph(mod, params, counter, global_entries)
+        return _enc_graph(mod, params, state, counter, global_entries)
     cls = type(mod).__name__
     if cls not in _TYPE_NAMES:
         raise ValueError(f"save_bigdl: unsupported layer {cls}")
@@ -733,7 +771,7 @@ def _enc_module(mod, params, counter, global_entries) -> bytes:
     body += enc_string(7, _TYPE_NAMES[cls])
     if mod.children():
         for sub in mod.children():
-            body += enc_bytes(2, _enc_module(sub, params, counter,
+            body += enc_bytes(2, _enc_module(sub, params, state, counter,
                                              global_entries))
     else:
         own = params.get(mod.name, {})
@@ -741,19 +779,26 @@ def _enc_module(mod, params, counter, global_entries) -> bytes:
         if keys:
             body += enc_int64(15, 1)   # hasParameters
             for k in keys:
-                arr = np.asarray(own[k], np.float32)
-                counter[0] += 1
-                tid = counter[0]
-                counter[0] += 1
-                sid = counter[0]
                 # data lives once in global_storage; the parameter slot
                 # references the storage id (ModuleLoader.scala:119)
-                global_entries[str(tid)] = _enc_tensor_msg(
-                    arr, tid, sid, inline=True)
-                body += enc_bytes(16, _enc_tensor_msg(arr, tid, sid,
-                                                      inline=False))
+                body += enc_bytes(16, _alloc_tensor(own[k], counter,
+                                                    global_entries))
     for k, v in _module_attrs(mod).items():
         body += _attr_entry(k, v)
+    if isinstance(mod, (nn.SpatialBatchNormalization,
+                        nn.BatchNormalization)) and not mod.children():
+        # nn/BatchNormalization.scala:346 doSerializeModule writes all
+        # four tensor attrs; :323 doLoadModule reads them unconditionally
+        own_st = (state or {}).get(mod.name) or {}
+        rm = np.asarray(own_st.get(
+            "running_mean", np.zeros(mod.n_output)), np.float32)
+        rv = np.asarray(own_st.get(
+            "running_var", np.ones(mod.n_output)), np.float32)
+        for key, arr in (("runningMean", rm), ("runningVar", rv),
+                         ("saveMean", np.zeros_like(rm)),
+                         ("saveStd", np.zeros_like(rm))):
+            body += _attr_entry(
+                key, _attr_tensor(arr, counter, global_entries))
     return body
 
 
@@ -761,9 +806,10 @@ def save_bigdl(model, path: str):
     """Write `model` as a reference-format `.bigdl` file
     (≙ Module.saveModule / ModulePersister.saveToFile)."""
     params = model.ensure_initialized()
+    state = getattr(model, "_state", None) or {}
     counter = [0]
     global_entries: Dict[str, bytes] = {}
-    body = _enc_module(model, params, counter, global_entries)
+    body = _enc_module(model, params, state, counter, global_entries)
     # top-level global_storage attr: NameAttrList{ name, attr{tid->tensor} }
     nal = enc_string(1, "global_storage")
     for tid, tensor_body in global_entries.items():

@@ -314,3 +314,83 @@ def test_shape_and_table_ops_roundtrip():
     m.reset(0)
     x = np.random.RandomState(3).randn(5, 6).astype(np.float32)
     _roundtrip(m, x)
+
+
+def test_bn_running_stats_roundtrip():
+    """Running mean/var ride the BN module's attr map
+    (nn/BatchNormalization.scala:346 doSerializeModule) and must survive
+    save->load so eval-mode inference matches (VERDICT r3 item 2)."""
+    m = nn.Sequential(nn.SpatialConvolution(2, 3, 3, 3, 1, 1, 1, 1),
+                      nn.SpatialBatchNormalization(3), nn.ReLU())
+    m.reset(5)
+    rng = np.random.RandomState(7)
+    m.training()
+    for _ in range(3):   # accumulate non-trivial running stats
+        m.forward(rng.rand(4, 2, 6, 6).astype(np.float32) * 3 + 1)
+    m.evaluate()
+    x = rng.rand(2, 2, 6, 6).astype(np.float32)
+    y0 = np.asarray(m.forward(x))
+
+    bn_name = [c.name for c in m.modules()
+               if type(c).__name__ == "SpatialBatchNormalization"][0]
+    rm0 = np.asarray(m._state[bn_name]["running_mean"])
+    assert np.abs(rm0).max() > 0.1   # stats actually moved off init
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "bn.bigdl")
+        save_bigdl(m, p)
+        m2 = load_bigdl(p)
+    m2.evaluate()
+    np.testing.assert_allclose(np.asarray(m2._state[bn_name]["running_mean"]),
+                               rm0, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(m2.forward(x)), y0,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_hand_encoded_bn_running_stats():
+    """Fixture with raw field numbers: runningMean/runningVar as TENSOR
+    attrs (dataType=10, tensorValue field 10) on the BN module, data
+    inline — independent of the writer."""
+    n = 4
+    gamma = np.ones(n, np.float32)
+    beta = np.zeros(n, np.float32)
+    rmean = np.array([0.5, -1.0, 2.0, 0.0], np.float32)
+    rvar = np.array([1.5, 0.25, 4.0, 1.0], np.float32)
+
+    def tensor(arr):
+        body = enc_int64(1, 2)
+        for d in arr.shape:
+            body += enc_int64(2, d)
+        st = enc_int64(1, 2) + enc_bytes(2, arr.astype("<f4").tobytes())
+        body += enc_bytes(8, st)
+        return body
+
+    def attr_entry(key, val):
+        return enc_bytes(8, enc_string(1, key) + enc_bytes(2, val))
+
+    attr_int = lambda v: enc_int64(1, 0) + enc_int64(3, v)
+    attr_tensor = lambda a: enc_int64(1, 10) + enc_bytes(10, tensor(a))
+
+    mod = enc_string(1, "bn")
+    mod += enc_string(7,
+                      "com.intel.analytics.bigdl.nn.SpatialBatchNormalization")
+    mod += attr_entry("nOutput", attr_int(n))
+    mod += enc_int64(15, 1)
+    mod += enc_bytes(16, tensor(gamma))
+    mod += enc_bytes(16, tensor(beta))
+    mod += attr_entry("runningMean", attr_tensor(rmean))
+    mod += attr_entry("runningVar", attr_tensor(rvar))
+    mod += attr_entry("saveMean", attr_tensor(np.zeros(n, np.float32)))
+    mod += attr_entry("saveStd", attr_tensor(np.zeros(n, np.float32)))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "bn.bigdl")
+        with open(p, "wb") as f:
+            f.write(mod)
+        m = load_bigdl(p)
+    m.evaluate()
+    x = np.random.RandomState(8).rand(2, n, 3, 3).astype(np.float32)
+    want = (x - rmean[None, :, None, None]) / np.sqrt(
+        rvar[None, :, None, None] + m.eps)
+    np.testing.assert_allclose(np.asarray(m.forward(x)), want,
+                               rtol=1e-4, atol=1e-5)
