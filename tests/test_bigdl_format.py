@@ -394,3 +394,270 @@ def test_hand_encoded_bn_running_stats():
         rvar[None, :, None, None] + m.eps)
     np.testing.assert_allclose(np.asarray(m.forward(x)), want,
                                rtol=1e-4, atol=1e-5)
+
+
+def _mod_tensor(arr):
+    body = enc_int64(1, 2)
+    for d in arr.shape:
+        body += enc_int64(2, d)
+    st = enc_int64(1, 2) + enc_bytes(2, arr.astype("<f4").tobytes())
+    body += enc_bytes(8, st)
+    return body
+
+
+def _mod_attr_entry(key, val):
+    return enc_bytes(8, enc_string(1, key) + enc_bytes(2, val))
+
+
+def _attr_i(v):
+    return enc_int64(1, 0) + enc_int64(3, v)
+
+
+def _attr_d(v):
+    return enc_int64(1, 3) + proto.enc_double(6, v)
+
+
+def _attr_mod(mod_bytes):
+    # DataType MODULE is irrelevant to our reader (it keys off field 13)
+    return enc_int64(1, 12) + enc_bytes(13, mod_bytes)
+
+
+def _linear_module(name, w, b=None):
+    m = enc_string(1, name)
+    m += enc_string(7, "com.intel.analytics.bigdl.nn.Linear")
+    m += _mod_attr_entry("inputSize", _attr_i(w.shape[1]))
+    m += _mod_attr_entry("outputSize", _attr_i(w.shape[0]))
+    m += enc_int64(15, 1)
+    m += enc_bytes(16, _mod_tensor(w))
+    if b is not None:
+        m += enc_bytes(16, _mod_tensor(b))
+    return m
+
+
+def test_recurrent_lstm_read():
+    """Recurrent(LSTM) fixture in reference wire layout: topology as a
+    module attr (nn/Recurrent.scala:776 doSerializeModule), the LSTM's
+    input Linear under its preTopology attr (Cell.scala CellSerializer),
+    h2g in the cell's flat params.  Reference gate order [i, g, f, o]
+    (LSTM.scala:134-147) must be re-ordered onto our fused [i, f, g, o]."""
+    rng = np.random.RandomState(11)
+    nin, h = 3, 4
+    w_pre = rng.randn(4 * h, nin).astype(np.float32)
+    b_pre = rng.randn(4 * h).astype(np.float32)
+    w_h2g = rng.randn(4 * h, h).astype(np.float32)
+
+    lstm = enc_string(1, "lstm1")
+    lstm += enc_string(7, "com.intel.analytics.bigdl.nn.LSTM")
+    lstm += _mod_attr_entry("inputSize", _attr_i(nin))
+    lstm += _mod_attr_entry("hiddenSize", _attr_i(h))
+    lstm += _mod_attr_entry("p", _attr_d(0.0))
+    lstm += _mod_attr_entry("preTopology",
+                            _attr_mod(_linear_module("i2g", w_pre, b_pre)))
+    lstm += enc_int64(15, 1)
+    lstm += enc_bytes(16, _mod_tensor(w_h2g))
+
+    rec = enc_string(1, "rec")
+    rec += enc_string(7, "com.intel.analytics.bigdl.nn.Recurrent")
+    rec += _mod_attr_entry("bnorm", enc_int64(1, 5) + enc_int64(8, 0))
+    rec += _mod_attr_entry("topology", _attr_mod(lstm))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "rec.bigdl")
+        with open(p, "wb") as f:
+            f.write(rec)
+        m = load_bigdl(p)
+
+    B, T = 2, 5
+    x = rng.randn(B, T, nin).astype(np.float32)
+    got = np.asarray(m.forward(x))
+
+    # independent numpy reference in the REFERENCE's [i, g, f, o] order
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    hs = np.zeros((B, h), np.float32)
+    cs = np.zeros((B, h), np.float32)
+    want = np.zeros((B, T, h), np.float32)
+    for t in range(T):
+        z = x[:, t] @ w_pre.T + b_pre + hs @ w_h2g.T
+        i, g, f, o = (z[:, :h], z[:, h:2*h], z[:, 2*h:3*h], z[:, 3*h:])
+        i, f, o, g = sig(i), sig(f), sig(o), np.tanh(g)
+        cs = i * g + f * cs
+        hs = o * np.tanh(cs)
+        want[:, t] = hs
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_recurrent_gru_read():
+    """Recurrent(GRU): pre-Linear chunks [r, z, n] (GRU.scala:107,137),
+    hidden Linears h2g (2h, no bias) and the new-gate Linear (h, no
+    bias) ride the cell's flat params."""
+    rng = np.random.RandomState(12)
+    nin, h = 4, 3
+    w_pre = rng.randn(3 * h, nin).astype(np.float32)
+    b_pre = rng.randn(3 * h).astype(np.float32)
+    w_h2g = rng.randn(2 * h, h).astype(np.float32)
+    w_new = rng.randn(h, h).astype(np.float32)
+
+    gru = enc_string(1, "gru1")
+    gru += enc_string(7, "com.intel.analytics.bigdl.nn.GRU")
+    gru += _mod_attr_entry("inputSize", _attr_i(nin))
+    gru += _mod_attr_entry("outputSize", _attr_i(h))
+    gru += _mod_attr_entry("p", _attr_d(0.0))
+    gru += _mod_attr_entry("preTopology",
+                           _attr_mod(_linear_module("i2g", w_pre, b_pre)))
+    gru += enc_int64(15, 1)
+    gru += enc_bytes(16, _mod_tensor(w_h2g))
+    gru += enc_bytes(16, _mod_tensor(w_new))
+
+    rec = enc_string(1, "rec")
+    rec += enc_string(7, "com.intel.analytics.bigdl.nn.Recurrent")
+    rec += _mod_attr_entry("topology", _attr_mod(gru))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "rec.bigdl")
+        with open(p, "wb") as f:
+            f.write(rec)
+        m = load_bigdl(p)
+
+    B, T = 2, 4
+    x = rng.randn(B, T, nin).astype(np.float32)
+    got = np.asarray(m.forward(x))
+
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    hs = np.zeros((B, h), np.float32)
+    want = np.zeros((B, T, h), np.float32)
+    for t in range(T):
+        pre = x[:, t] @ w_pre.T + b_pre
+        rz = pre[:, :2*h] + hs @ w_h2g.T
+        r, z = sig(rz[:, :h]), sig(rz[:, h:])
+        hhat = np.tanh(pre[:, 2*h:] + (r * hs) @ w_new.T)
+        hs = (1.0 - z) * hhat + z * hs
+        want[:, t] = hs
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_recurrent_lstm_dropout_rejected():
+    lstm = enc_string(1, "l")
+    lstm += enc_string(7, "com.intel.analytics.bigdl.nn.LSTM")
+    lstm += _mod_attr_entry("inputSize", _attr_i(2))
+    lstm += _mod_attr_entry("hiddenSize", _attr_i(2))
+    lstm += _mod_attr_entry("p", _attr_d(0.5))
+    rec = enc_string(1, "rec")
+    rec += enc_string(7, "com.intel.analytics.bigdl.nn.Recurrent")
+    rec += _mod_attr_entry("topology", _attr_mod(lstm))
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "rec.bigdl")
+        with open(p, "wb") as f:
+            f.write(rec)
+        with pytest.raises(ValueError, match="dropout"):
+            load_bigdl(p)
+
+
+def test_recurrent_rnncell_read():
+    """RnnCell (nn/RNN.scala): input Linear in preTopology, h2h Linear
+    (weight + its own bias) in the cell params; the two biases sum into
+    our single fused bias.  Non-default ReLU activation passes through."""
+    rng = np.random.RandomState(13)
+    nin, h = 3, 5
+    w_pre = rng.randn(h, nin).astype(np.float32)
+    b_pre = rng.randn(h).astype(np.float32)
+    w_h2h = rng.randn(h, h).astype(np.float32)
+    b_h2h = rng.randn(h).astype(np.float32)
+
+    relu = enc_string(1, "act") \
+        + enc_string(7, "com.intel.analytics.bigdl.nn.ReLU")
+    cell = enc_string(1, "rnn1")
+    cell += enc_string(7, "com.intel.analytics.bigdl.nn.RnnCell")
+    cell += _mod_attr_entry("inputSize", _attr_i(nin))
+    cell += _mod_attr_entry("hiddenSize", _attr_i(h))
+    cell += _mod_attr_entry("activation", _attr_mod(relu))
+    cell += _mod_attr_entry("preTopology",
+                            _attr_mod(_linear_module("i2h", w_pre, b_pre)))
+    cell += enc_int64(15, 1)
+    cell += enc_bytes(16, _mod_tensor(w_h2h))
+    cell += enc_bytes(16, _mod_tensor(b_h2h))
+
+    rec = enc_string(1, "rec")
+    rec += enc_string(7, "com.intel.analytics.bigdl.nn.Recurrent")
+    rec += _mod_attr_entry("topology", _attr_mod(cell))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "rec.bigdl")
+        with open(p, "wb") as f:
+            f.write(rec)
+        m = load_bigdl(p)
+
+    B, T = 3, 4
+    x = rng.randn(B, T, nin).astype(np.float32)
+    got = np.asarray(m.forward(x))
+    hs = np.zeros((B, h), np.float32)
+    want = np.zeros((B, T, h), np.float32)
+    for t in range(T):
+        hs = np.maximum(x[:, t] @ w_pre.T + b_pre + hs @ w_h2h.T + b_h2h,
+                        0.0)
+        want[:, t] = hs
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_recurrent_parameterized_activation_rejected():
+    prelu = enc_string(1, "act") \
+        + enc_string(7, "com.intel.analytics.bigdl.nn.PReLU") \
+        + _mod_attr_entry("nOutputPlane", _attr_i(2))
+    cell = enc_string(1, "r")
+    cell += enc_string(7, "com.intel.analytics.bigdl.nn.RnnCell")
+    cell += _mod_attr_entry("inputSize", _attr_i(2))
+    cell += _mod_attr_entry("hiddenSize", _attr_i(2))
+    cell += _mod_attr_entry("activation", _attr_mod(prelu))
+    rec = enc_string(1, "rec")
+    rec += enc_string(7, "com.intel.analytics.bigdl.nn.Recurrent")
+    rec += _mod_attr_entry("topology", _attr_mod(cell))
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "rec.bigdl")
+        with open(p, "wb") as f:
+            f.write(rec)
+        with pytest.raises(ValueError, match="parameterized activation"):
+            load_bigdl(p)
+
+
+def test_recurrent_lstm_nondefault_activation():
+    """LSTM(activation=Sigmoid) must load with the serialized activation
+    applied (not silently fall back to tanh)."""
+    rng = np.random.RandomState(14)
+    nin, h = 2, 3
+    w_pre = rng.randn(4 * h, nin).astype(np.float32)
+    b_pre = rng.randn(4 * h).astype(np.float32)
+    w_h2g = rng.randn(4 * h, h).astype(np.float32)
+
+    sigm = enc_string(1, "sa") \
+        + enc_string(7, "com.intel.analytics.bigdl.nn.Sigmoid")
+    lstm = enc_string(1, "lstm1")
+    lstm += enc_string(7, "com.intel.analytics.bigdl.nn.LSTM")
+    lstm += _mod_attr_entry("inputSize", _attr_i(nin))
+    lstm += _mod_attr_entry("hiddenSize", _attr_i(h))
+    lstm += _mod_attr_entry("activation", _attr_mod(sigm))
+    lstm += _mod_attr_entry("preTopology",
+                            _attr_mod(_linear_module("i2g", w_pre, b_pre)))
+    lstm += enc_int64(15, 1)
+    lstm += enc_bytes(16, _mod_tensor(w_h2g))
+    rec = enc_string(1, "rec")
+    rec += enc_string(7, "com.intel.analytics.bigdl.nn.Recurrent")
+    rec += _mod_attr_entry("topology", _attr_mod(lstm))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "rec.bigdl")
+        with open(p, "wb") as f:
+            f.write(rec)
+        m = load_bigdl(p)
+    B, T = 2, 3
+    x = rng.randn(B, T, nin).astype(np.float32)
+    got = np.asarray(m.forward(x))
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    hs = np.zeros((B, h), np.float32)
+    cs = np.zeros((B, h), np.float32)
+    want = np.zeros((B, T, h), np.float32)
+    for t in range(T):
+        z = x[:, t] @ w_pre.T + b_pre + hs @ w_h2g.T
+        i, g, f, o = z[:, :h], z[:, h:2*h], z[:, 2*h:3*h], z[:, 3*h:]
+        cs = sig(i) * sig(g) + sig(f) * cs      # activation=Sigmoid
+        hs = sig(o) * sig(cs)
+        want[:, t] = hs
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
