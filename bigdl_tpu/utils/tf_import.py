@@ -238,8 +238,8 @@ def _conv2d_backprop_input(a, at):
 
 
 def _strided_slice(a, at):
-    """Const-indexed subset: begin/end/strides consts + begin/end/
-    shrink_axis masks (the forms real exported graphs contain)."""
+    """Const-indexed subset: begin/end/strides consts + ALL five masks
+    (begin/end/shrink_axis/ellipsis/new_axis — strided_slice op spec)."""
     x = a[0]
     begin = [int(i) for i in np.asarray(a[1])]
     end = [int(i) for i in np.asarray(a[2])]
@@ -248,17 +248,34 @@ def _strided_slice(a, at):
     bm = int(at.get("begin_mask") or 0)
     em = int(at.get("end_mask") or 0)
     sm = int(at.get("shrink_axis_mask") or 0)
-    if at.get("ellipsis_mask") or at.get("new_axis_mask"):
-        raise NotImplementedError("StridedSlice ellipsis/new_axis masks")
+    elm = int(at.get("ellipsis_mask") or 0)
+    nam = int(at.get("new_axis_mask") or 0)
+    if bin(elm).count("1") > 1:
+        raise ValueError("StridedSlice: multiple ellipsis bits")
+    nspec = len(begin)
+    # input dims consumed by the non-ellipsis, non-new-axis spec slots
+    consumed = sum(1 for i in range(nspec)
+                   if not (elm >> i) & 1 and not (nam >> i) & 1)
     idx, shrink = [], []
-    for i in range(len(begin)):
-        if sm & (1 << i):
-            idx.append(slice(begin[i], begin[i] + 1, 1))
-            shrink.append(i)
+    out_dim = 0       # axis in the pre-squeeze result (tracks new axes)
+    for i in range(nspec):
+        if (elm >> i) & 1:
+            fill = x.ndim - consumed
+            idx.extend([slice(None)] * fill)
+            out_dim += fill
+        elif (nam >> i) & 1:
+            idx.append(None)                      # np.newaxis
+            out_dim += 1
+        elif (sm >> i) & 1:
+            b = begin[i]
+            idx.append(slice(b, b + 1 if b != -1 else None, 1))
+            shrink.append(out_dim)
+            out_dim += 1
         else:
             idx.append(slice(None if bm & (1 << i) else begin[i],
                              None if em & (1 << i) else end[i],
                              strides[i]))
+            out_dim += 1
     out = x[tuple(idx)]
     return jnp.squeeze(out, axis=tuple(shrink)) if shrink else out
 
@@ -415,25 +432,163 @@ _OP_IMPLS = {
 }
 
 
+# --------------------------------------------------------------------- #
+# while-loop frames (≙ nn/tf/ControlOps.scala:182-229 Enter/Exit/        #
+# NextIteration/LoopCondition + nn/FrameManager.scala:31 frame           #
+# scheduling).  TF v1 encodes tf.while_loop as a CYCLIC cluster:         #
+#   Enter(frame_name) -> Merge <- NextIteration                          #
+#   Merge -> [cond subgraph] -> LoopCond -> Switch(pred)                 #
+#   Switch:0 -> Exit (loop result), Switch:1 -> [body] -> NextIteration  #
+# The reference interprets these frames at runtime; the TPU-native       #
+# lowering collapses each frame into ONE synthetic _While node executed  #
+# as a `lax.while_loop` (XLA-compiled, no per-iteration dispatch), with  #
+# Exit nodes becoming slot-projections of its final carry state.         #
+# --------------------------------------------------------------------- #
+def _base(ref: str) -> str:
+    return ref.split(":")[0].lstrip("^")
+
+
+def _rewrite_while_frames(nodes: Dict[str, NodeDef]) -> Dict[str, NodeDef]:
+    enters_by_frame: Dict[str, List[str]] = {}
+    for n in nodes.values():
+        if n.op in ("Enter", "RefEnter"):
+            enters_by_frame.setdefault(
+                str(n.attrs.get("frame_name", "")), []).append(n.name)
+    if not enters_by_frame:
+        return nodes
+
+    consumers: Dict[str, List[str]] = {}
+    for n in nodes.values():
+        for i in n.inputs:
+            consumers.setdefault(_base(i), []).append(n.name)
+
+    out = dict(nodes)
+    for frame, enter_names in sorted(enters_by_frame.items()):
+        # frame membership: forward reachability from the Enters,
+        # stopping at Exit (the only legal frame escape)
+        member = set(enter_names)
+        queue = list(enter_names)
+        exits: List[str] = []
+        while queue:
+            for c in consumers.get(queue.pop(), ()):
+                if c in member:
+                    continue
+                cn = nodes[c]
+                if cn.op in ("Exit", "RefExit"):
+                    member.add(c)
+                    exits.append(c)
+                    continue
+                if cn.op in ("Enter", "RefEnter") \
+                        and str(cn.attrs.get("frame_name", "")) != frame:
+                    raise NotImplementedError(
+                        f"nested while frames ({frame!r} feeds "
+                        f"{cn.attrs.get('frame_name')!r}) are not supported")
+                member.add(c)
+                queue.append(c)
+
+        loop_conds = [m for m in member if nodes[m].op == "LoopCond"]
+        if len(loop_conds) != 1:
+            raise NotImplementedError(
+                f"while frame {frame!r}: expected exactly one LoopCond, "
+                f"found {len(loop_conds)}")
+        loop_cond = loop_conds[0]
+
+        merges = sorted(m for m in member if nodes[m].op in ("Merge",
+                                                             "RefMerge"))
+        merge_info = []           # (merge, enter_ref, next_ref, switch|None)
+        switch_of: Dict[str, str] = {}
+        for m in merges:
+            ins = [i for i in nodes[m].inputs if not i.startswith("^")]
+            enter_ref = next((i for i in ins
+                              if nodes[_base(i)].op in ("Enter",
+                                                        "RefEnter")), None)
+            next_ref = next((i for i in ins
+                             if nodes[_base(i)].op == "NextIteration"), None)
+            if enter_ref is None or next_ref is None:
+                raise NotImplementedError(
+                    f"while frame {frame!r}: Merge {m!r} is not an "
+                    "Enter/NextIteration pair")
+            sw = next((c for c in consumers.get(m, ())
+                       if nodes[c].op in ("Switch", "RefSwitch")), None)
+            if sw is not None:
+                pred = [i for i in nodes[sw].inputs
+                        if not i.startswith("^")][1]
+                if _base(pred) != loop_cond:
+                    raise NotImplementedError(
+                        f"while frame {frame!r}: Switch {sw!r} predicate "
+                        "is not the frame's LoopCond (conditionals inside "
+                        "a loop body are not supported)")
+                switch_of[m] = sw
+            merge_info.append((m, enter_ref, next_ref, sw))
+
+        # Exit -> loop-var index (via its Switch)
+        exit_var: Dict[str, int] = {}
+        for e in exits:
+            e_in = _base([i for i in nodes[e].inputs
+                          if not i.startswith("^")][0])
+            idx = next((k for k, (_, _, _, sw) in enumerate(merge_info)
+                        if sw == e_in), None)
+            if idx is None:
+                raise NotImplementedError(
+                    f"while frame {frame!r}: Exit {e!r} does not consume "
+                    "a loop-variable Switch")
+            exit_var[e] = idx
+
+        while_name = f"__while__{frame}"
+        frame_nodes = {m: nodes[m] for m in member}
+        # every ref a frame node reads from OUTSIDE the frame (Enter
+        # sources, plus consts/tensors captured without an Enter) becomes
+        # a data input of the synthetic node, so the outer toposort
+        # schedules them and the frame evaluator can bind them
+        externals: List[str] = []
+        for m in sorted(member):
+            if nodes[m].op in ("Exit", "RefExit"):
+                continue
+            for i in nodes[m].inputs:
+                if not i.startswith("^") and _base(i) not in member \
+                        and i not in externals:
+                    externals.append(i)
+        wnode = NodeDef(while_name, "_While",
+                        inputs=list(externals),
+                        attrs={"_frame": {
+                            "name": frame,
+                            "nodes": frame_nodes,
+                            "externals": externals,
+                            "merge_info": merge_info,
+                            "cond_ref": nodes[loop_cond].inputs[0],
+                        }})
+        for m in member:
+            if m not in exits:
+                del out[m]
+        out[while_name] = wnode
+        for e in exits:
+            out[e] = NodeDef(e, "_WhileOut",
+                             inputs=[f"{while_name}:{exit_var[e]}"])
+    return out
+
+
 class TFGraph(Module):
     """Imported GraphDef as a Module: topological jnp evaluation, jittable
-    (≙ utils/tf/Session.scala's BigDLSessionImpl graph execution)."""
+    (≙ utils/tf/Session.scala's BigDLSessionImpl graph execution).
+    tf.while_loop frames lower to `lax.while_loop` (see
+    `_rewrite_while_frames`)."""
 
     def __init__(self, nodes: List[NodeDef], inputs: Sequence[str],
                  outputs: Sequence[str], name=None):
         super().__init__(name=name)
-        self.nodes = {n.name: n for n in nodes}
+        self.nodes = _rewrite_while_frames({n.name: n for n in nodes})
         self.input_names = list(inputs)
         self.output_names = list(outputs)
         self.consts: Dict[str, np.ndarray] = {
-            n.name: n.attrs["value"] for n in nodes if n.op == "Const"}
+            n.name: n.attrs["value"]
+            for n in self.nodes.values() if n.op == "Const"}
         self._order = self._toposort()
 
     def _toposort(self) -> List[str]:
         order, seen = [], set()
 
         def visit(name):
-            base = name.split(":")[0].lstrip("^")
+            base = _base(name)
             if base in seen:
                 return
             seen.add(base)
@@ -453,6 +608,8 @@ class TFGraph(Module):
         """`node:k` output-slot lookup into a node's env value."""
         base, _, slot = ref.partition(":")
         v = env[base]
+        if v is _DEAD:
+            return _DEAD        # any slot of a dead node is dead
         if isinstance(v, _MultiOut):
             return v[int(slot or 0)]
         if slot and int(slot) != 0:
@@ -495,6 +652,13 @@ class TFGraph(Module):
             if any(v is _DEAD for v in args):
                 env[name] = _DEAD
                 continue
+            if node.op == "_While":
+                env[name] = _MultiOut(
+                    self._run_while(node.attrs["_frame"], args, env))
+                continue
+            if node.op == "_WhileOut":
+                env[name] = args[0]
+                continue
             if node.op in ("Switch", "RefSwitch"):
                 try:
                     pred = bool(np.asarray(args[1]).reshape(()))
@@ -515,6 +679,72 @@ class TFGraph(Module):
         if any(o is _DEAD for o in outs):
             raise ValueError("graph output is on an untaken Switch branch")
         return outs[0] if len(outs) == 1 else outs
+
+    # ------------------------------------------------------------------ #
+    # while-frame execution: one lax.while_loop per frame                 #
+    # ------------------------------------------------------------------ #
+    def _run_while(self, frame, ext_vals, outer_env):
+        fnodes: Dict[str, NodeDef] = frame["nodes"]
+        merge_info = frame["merge_info"]
+        ext_env = dict(zip(frame["externals"], ext_vals))
+
+        def feval(ref, env):
+            b = _base(ref)
+            if b not in env:
+                nd = fnodes.get(b)
+                if nd is None:
+                    # defined outside the frame: bound via the synthetic
+                    # node's inputs (loop constants under while tracing)
+                    if ref in ext_env:
+                        return ext_env[ref]
+                    return TFGraph._resolve(outer_env, ref)
+                if nd.op == "Const":
+                    env[b] = jnp.asarray(nd.attrs["value"])
+                elif nd.op in ("Enter", "RefEnter", "Identity", "LoopCond",
+                               "NextIteration", "StopGradient"):
+                    env[b] = feval(nd.inputs[0], env)
+                elif nd.op in ("Merge", "RefMerge", "Switch", "RefSwitch",
+                               "Exit", "RefExit"):
+                    raise NotImplementedError(
+                        f"while frame {frame['name']!r}: {nd.op} node "
+                        f"{b!r} outside the recognized loop skeleton")
+                else:
+                    args = [feval(i, env) for i in nd.inputs
+                            if not i.startswith("^")]
+                    impl = _OP_IMPLS.get(nd.op)
+                    if impl is None:
+                        raise NotImplementedError(
+                            f"TF op {nd.op!r} (node {b!r}) in while frame "
+                            "not supported")
+                    env[b] = impl(args, nd.attrs)
+            v = env[b]
+            base_name, _, slot = ref.partition(":")
+            if isinstance(v, _MultiOut):
+                return v[int(slot or 0)]
+            return v
+
+        init_env: Dict[str, object] = {}
+        init = tuple(jnp.asarray(feval(enter_ref, init_env))
+                     for _, enter_ref, _, _ in merge_info)
+
+        def cond_fn(state):
+            env: Dict[str, object] = {}
+            for (m, _, _, _), s in zip(merge_info, state):
+                env[m] = s
+            return jnp.reshape(feval(frame["cond_ref"], env), ())
+
+        def body_fn(state):
+            env: Dict[str, object] = {}
+            for (m, _, _, sw), s in zip(merge_info, state):
+                env[m] = s
+                if sw is not None:
+                    # inside the body only the taken (:1) branch is live
+                    env[sw] = _MultiOut((_DEAD, s))
+            return tuple(
+                jnp.asarray(feval(next_ref, env))
+                for _, _, next_ref, _ in merge_info)
+
+        return lax.while_loop(cond_fn, body_fn, init)
 
 
 def load_tf_graph(path_or_bytes, inputs: Sequence[str],

@@ -1,0 +1,61 @@
+"""Worker for tests/test_multiprocess.py: one of N jax.distributed
+processes on CPU (4 local virtual devices each), training the shared
+fixture model with DistriOptimizer over the global dp mesh
+(≙ a Spark executor in optim/DistriOptimizer.scala:118's cluster run).
+
+Usage: python _mp_worker.py <proc_id> <num_procs> <port> <out.npz>
+"""
+import os
+import sys
+
+
+def main():
+    pid, nproc = int(sys.argv[1]), int(sys.argv[2])
+    port, out = sys.argv[3], sys.argv[4]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+    from bigdl_tpu.parallel.mesh import init_distributed, create_mesh
+    init_distributed(f"127.0.0.1:{port}", num_processes=nproc,
+                     process_id=pid)
+    assert jax.process_count() == nproc, jax.process_count()
+    assert jax.local_device_count() == 4, jax.local_device_count()
+    assert jax.device_count() == 4 * nproc, jax.device_count()
+
+    import numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import SGD, Trigger
+    from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
+
+    # identical fixture on every process (deterministic seeds)
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 12).astype(np.float32)
+    w = rng.randn(12, 1).astype(np.float32)
+    y = (x @ w + 0.01 * rng.randn(256, 1)).astype(np.float32)
+    model = nn.Sequential(nn.Linear(12, 8), nn.Tanh(), nn.Linear(8, 1))
+    model.reset(3)
+
+    mesh = create_mesh({"dp": 4 * nproc})
+    opt = (DistriOptimizer(model, (x, y), nn.MSECriterion(), batch_size=64,
+                           mesh=mesh)
+           .set_optim_method(SGD(learning_rate=0.05, momentum=0.9))
+           .set_end_when(Trigger.max_epoch(2)))
+    trained = opt.optimize()
+
+    leaves = [np.asarray(a) for a in jax.tree_util.tree_leaves(
+        jax.tree_util.tree_map(np.asarray, trained._params))]
+    if pid == 0:
+        np.savez(out, *leaves)
+    print(f"proc {pid}: done, {len(leaves)} param leaves", flush=True)
+
+
+if __name__ == "__main__":
+    main()
