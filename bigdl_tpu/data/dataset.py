@@ -116,6 +116,7 @@ class LocalArrayDataSet(DataSet):
     def __init__(self, samples, shuffle=True, seed=0):
         self.samples = list(samples)
         self._shuffle = shuffle
+        self._seed = seed
         self._rng = np.random.RandomState(seed)
 
     def size(self):
@@ -125,10 +126,15 @@ class LocalArrayDataSet(DataSet):
         self._rng.shuffle(self.samples)
         return self
 
-    def data(self, train=True):
+    def data(self, train=True, epoch=None):
         idx = np.arange(len(self.samples))
         if train and self._shuffle:
-            self._rng.shuffle(idx)
+            # epoch-seeded permutation (stateless) enables exact mid-epoch
+            # resume: the same (seed, epoch) always yields the same order
+            rng = self._rng if epoch is None else \
+                np.random.RandomState((self._seed * 1000003 + epoch)
+                                      % (2 ** 31 - 1))
+            rng.shuffle(idx)
         for i in idx:
             yield self.samples[i]
 
@@ -143,6 +149,7 @@ class ArrayMiniBatchDataSet(DataSet):
         self.batch_size = batch_size
         self._shuffle = shuffle
         self.drop_last = drop_last
+        self._seed = seed
         self._rng = np.random.RandomState(seed)
 
     def size(self):
@@ -154,11 +161,14 @@ class ArrayMiniBatchDataSet(DataSet):
             n += 1
         return n
 
-    def data(self, train=True):
+    def data(self, train=True, epoch=None):
         n = self.x.shape[0]
         idx = np.arange(n)
         if train and self._shuffle:
-            self._rng.shuffle(idx)
+            rng = self._rng if epoch is None else \
+                np.random.RandomState((self._seed * 1000003 + epoch)
+                                      % (2 ** 31 - 1))
+            rng.shuffle(idx)
         end = n - (n % self.batch_size) if self.drop_last else n
         for start in range(0, end, self.batch_size):
             sel = idx[start:start + self.batch_size]
@@ -179,8 +189,12 @@ class TransformedDataSet(DataSet):
         self.base.shuffle()
         return self
 
-    def data(self, train=True):
-        return self.transformer.apply_iter(self.base.data(train))
+    def data(self, train=True, epoch=None):
+        try:
+            it = self.base.data(train, epoch=epoch)
+        except TypeError:
+            it = self.base.data(train)
+        return self.transformer.apply_iter(it)
 
     def batches_per_epoch(self):
         if hasattr(self.transformer, "batch_size"):
@@ -216,8 +230,12 @@ class DistributedDataSet(DataSet):
     def batches_per_epoch(self):
         return getattr(self.base, "batches_per_epoch", lambda: None)()
 
-    def data(self, train=True):
-        for mb in self.base.data(train):
+    def data(self, train=True, epoch=None):
+        try:
+            it = self.base.data(train, epoch=epoch)
+        except TypeError:
+            it = self.base.data(train)
+        for mb in it:
             if mb.size() % self.num_shards:
                 # truncate so every shard receives an equal, static shape
                 keep = mb.size() - (mb.size() % self.num_shards)

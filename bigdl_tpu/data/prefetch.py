@@ -35,13 +35,17 @@ class PrefetchedDataSet(DataSet):
     def batches_per_epoch(self):
         return getattr(self.base, "batches_per_epoch", lambda: None)()
 
-    def data(self, train=True):
+    def data(self, train=True, epoch=None):
         q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         error = []
 
         def producer():
             try:
-                for item in self.base.data(train):
+                try:
+                    it = self.base.data(train, epoch=epoch)
+                except TypeError:
+                    it = self.base.data(train)
+                for item in it:
                     q.put(item)
             except BaseException as e:  # surfaced on the consumer side
                 error.append(e)

@@ -680,13 +680,28 @@ def _k2_builder(class_name):
 
 
 def _is_keras2(spec):
-    """Keras >=2 JSON: keras_version key, or a Sequential whose config
-    is a dict with a 'layers' list (keras 1 configs are bare lists)."""
+    """Keras >=2 JSON: keras_version key, a Sequential whose config is
+    a dict with a 'layers' list (keras 1 configs are bare lists), or —
+    for stripped JSONs — any layer config using a keras-2-only key
+    (filters/units/rate replaced keras-1's nb_filter/output_dim/p)."""
     kv = spec.get("keras_version", "")
     if kv:
         return not str(kv).startswith("1")
-    return (spec.get("class_name") == "Sequential"
-            and isinstance(spec.get("config"), dict))
+    if (spec.get("class_name") == "Sequential"
+            and isinstance(spec.get("config"), dict)):
+        return True
+    cfg = spec.get("config")
+    layers = cfg.get("layers", []) if isinstance(cfg, dict) else \
+        (cfg if isinstance(cfg, list) else [])
+    k2_only = {"filters", "units", "rate", "data_format"}
+    k1_only = {"nb_filter", "output_dim", "p", "dim_ordering"}
+    for layer in layers:
+        lc = layer.get("config", {}) if isinstance(layer, dict) else {}
+        if k1_only & set(lc):
+            return False
+        if k2_only & set(lc):
+            return True
+    return False
 
 
 class DefinitionLoader:

@@ -116,8 +116,13 @@ class KerasLayer(Module):
         rest = tuple(input_shape[1:])
         b = 2 if batch is None else batch
         if any(d is None for d in rest):
-            c1 = (b,) + tuple(8 if d is None else d for d in rest)
-            c2 = (b,) + tuple(12 if d is None else d for d in rest)
+            # two LARGE probes whose gap (12) keeps ceil-div results
+            # apart for any realistic stride <= 12 (8/12 collided at
+            # stride >= 12), while both stay divisible by 2/3/4/6/12 so
+            # Reshape((k, -1))-style inference still works (primes would
+            # break it)
+            c1 = (b,) + tuple(120 if d is None else d for d in rest)
+            c2 = (b,) + tuple(132 if d is None else d for d in rest)
             o1 = self.inner.get_output_shape(c1)
             o2 = self.inner.get_output_shape(c2)
             if isinstance(o1, tuple) and o1 and isinstance(o1[0], int):

@@ -660,9 +660,20 @@ def chunked_token_nll(head_fn, h, targets, loss_chunk,
     Peak logits memory is (B, loss_chunk, V).  Shared by
     :meth:`TransformerLM.token_nll` and the pipeline trainer."""
     B, S, D = h.shape
+    # a chunk larger than the sequence would PAD UP and materialize more
+    # logits than the unchunked path — clamp, never grow
+    loss_chunk = min(loss_chunk, S)
     if S % loss_chunk:
-        raise ValueError(f"loss_chunk {loss_chunk} must divide "
-                         f"sequence length {S}")
+        # ragged tail (e.g. an odd-length eval batch): pad h with zeros
+        # and the targets with ignore_index so the tail contributes
+        # nothing, instead of crashing mid-evaluate
+        pad = loss_chunk - (S % loss_chunk)
+        h = jnp.concatenate(
+            [h, jnp.zeros((B, pad, D), h.dtype)], axis=1)
+        targets = jnp.concatenate(
+            [targets,
+             jnp.full((B, pad), ignore_index, targets.dtype)], axis=1)
+        S = S + pad
     n = S // loss_chunk
     hc = jnp.moveaxis(h.reshape(B, n, loss_chunk, D), 1, 0)
     tc = jnp.moveaxis(targets.reshape(B, n, loss_chunk), 1, 0)

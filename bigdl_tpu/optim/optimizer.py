@@ -44,6 +44,7 @@ class TrainingState:
     loss: Optional[float] = None
     score: Optional[float] = None
     epoch_finished: bool = False
+    batch_in_epoch: int = 0      # completed batches within current epoch
 
 
 class Metrics:
@@ -291,6 +292,8 @@ class Optimizer:
         # failure recovery (≙ DistriOptimizer.scala optimize() retry loop:
         # failed iterations restart from the cached model state)
         self.max_retries = 0
+        self._resume_skip = 0        # batches to skip after mid-epoch resume
+        self._resume_rng = None      # loop rng restored from checkpoint
         self.prefetch_depth = 0
         self._retry_cache = None
 
@@ -370,7 +373,14 @@ class Optimizer:
         tag = tag or f"iter_{self.state.iteration}"
         path = os.path.join(self.checkpoint_path, f"checkpoint_{tag}.bin")
         host = _to_host((params, opt_state, model_state))
-        meta = {"epoch": self.state.epoch, "iteration": self.state.iteration}
+        # iterator position + loop rng make mid-epoch resume EXACT: the
+        # epoch-seeded shuffle reproduces the order, batch_in_epoch says
+        # where to skip to, rng reproduces the per-step dropout keys
+        # (≙ DistriOptimizer.scala:878-914's cached-state retry)
+        meta = {"epoch": self.state.epoch, "iteration": self.state.iteration,
+                "batch_in_epoch": self.state.batch_in_epoch,
+                "rng": None if getattr(self, "_loop_rng", None) is None
+                else np.asarray(self._loop_rng).tolist()}
         try:
             save_state_file({"state": host, "meta": meta}, path)
         except SerializationError:
@@ -399,6 +409,11 @@ class Optimizer:
                 blob = pickle.load(f)
         self.state.epoch = blob["meta"]["epoch"]
         self.state.iteration = blob["meta"]["iteration"]
+        self.state.batch_in_epoch = blob["meta"].get("batch_in_epoch", 0)
+        self._resume_skip = self.state.batch_in_epoch
+        rng_saved = blob["meta"].get("rng")
+        self._resume_rng = None if rng_saved is None else \
+            jnp.asarray(np.asarray(rng_saved, np.uint32))
         restored = migrate_legacy_names(blob["state"], self.model)
         return jax.tree_util.tree_map(
             lambda v: jnp.asarray(v) if isinstance(v, (np.ndarray,
@@ -507,6 +522,9 @@ class Optimizer:
 
         step_fn = build_step()
         rng = jax.random.PRNGKey(self.seed + 13)
+        if self._resume_rng is not None:
+            rng = self._resume_rng
+        self._loop_rng = rng
 
         stop = False
         retries = 0
@@ -526,14 +544,32 @@ class Optimizer:
                 if retries >= self.max_retries or self._retry_cache is None:
                     raise
                 retries += 1
-                print(f"[retry {retries}/{self.max_retries}] epoch "
-                      f"{self.state.epoch} failed ({e!r}); restoring "
-                      "cached state")
                 host, epoch, iteration, rng = self._retry_cache
-                params, opt_state, model_state = jax.tree_util.tree_map(
-                    jnp.asarray, host)
-                self.state.epoch = epoch
-                self.state.iteration = iteration
+                # prefer the newest mid-epoch checkpoint over the
+                # epoch-start cache: finer-grained restart point
+                restored = None
+                if self.checkpoint_path:
+                    try:
+                        restored = self.load_checkpoint()
+                    except Exception:
+                        restored = None
+                if restored is not None and self.state.iteration >= iteration:
+                    print(f"[retry {retries}/{self.max_retries}] iteration "
+                          f"{self.state.iteration} failed ({e!r}); resuming "
+                          "from last checkpoint")
+                    params, opt_state, model_state = restored
+                    if self._resume_rng is not None:
+                        rng = self._resume_rng
+                else:
+                    print(f"[retry {retries}/{self.max_retries}] epoch "
+                          f"{self.state.epoch} failed ({e!r}); restoring "
+                          "cached state")
+                    params, opt_state, model_state = jax.tree_util.tree_map(
+                        jnp.asarray, host)
+                    self.state.epoch = epoch
+                    self.state.iteration = iteration
+                    self.state.batch_in_epoch = 0
+                    self._resume_skip = 0
 
         self.model.set_params(self._params_for_eval(params), model_state)
         return self.model
@@ -545,9 +581,19 @@ class Optimizer:
         self.state.epoch_finished = False
         epoch_start = time.time()
         n_seen = 0
+        skip = self._resume_skip
+        self._resume_skip = 0
+        self.state.batch_in_epoch = skip
 
         def staged():
-            for mb in self.dataset.data(train=True):
+            try:
+                it = self.dataset.data(train=True, epoch=self.state.epoch)
+            except TypeError:   # dataset without epoch-seeded shuffling
+                it = self.dataset.data(train=True)
+            for _ in range(skip):      # resume: already-processed batches
+                if next(it, None) is None:
+                    return
+            for mb in it:
                 x, y = _mb_to_arrays(mb)
                 yield mb.size(), *self._place_batch(x, y)
 
@@ -561,12 +607,14 @@ class Optimizer:
             wait = time.time() - data_t
             rng, sub = jax.random.split(rng)
             t0 = time.time()
+            self._loop_rng = rng
             params, opt_state, model_state, loss = step_fn(
                 params, opt_state, model_state, x, y, sub)
             # keep `loss` on device: float()ing here would sync the host
             # with the accelerator every step and stall the input pipeline
             dispatch = time.time() - t0
             self.state.iteration += 1
+            self.state.batch_in_epoch += 1
             self.state.loss = loss
             n_seen += size
             self.metrics.add("data wait time", wait)
@@ -579,6 +627,18 @@ class Optimizer:
             data_t = time.time()
         else:
             self.state.epoch_finished = True
+            if n_seen == 0:
+                if skip == 0:
+                    raise ValueError(
+                        "dataset produced no batches (batch_size larger "
+                        "than the dataset with drop_last, or empty data)")
+                # resumed exactly at an epoch boundary: the epoch's work —
+                # including its validation/checkpoint — already happened
+                # before the crash; just advance
+                self.state.epoch += 1
+                self.state.batch_in_epoch = 0
+                return (params, opt_state, model_state, rng, step_fn,
+                        self.end_when(self.state))
             self.state.loss = float(self.state.loss)
             dur = time.time() - epoch_start
             thru = n_seen / max(dur, 1e-9)
@@ -607,6 +667,7 @@ class Optimizer:
                 if sched.current_factor != before:
                     step_fn = build_step()
             self.state.epoch += 1
+            self.state.batch_in_epoch = 0
             if self.end_when(self.state):
                 stop = True
 

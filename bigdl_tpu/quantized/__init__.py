@@ -206,7 +206,13 @@ def calibrate_activation_absmax(model: Module, batches, params=None,
     activations that makes the int8 path HBM-bound.  Static calibrated
     scales remove it (the standard post-training-quantization recipe;
     the reference's runtime quantization is the MKL-era equivalent,
-    nn/quantized/Linear.scala updateOutput)."""
+    nn/quantized/Linear.scala updateOutput).
+
+    Caveat (standard PTQ): maxima are measured on the FLOAT model's
+    inputs; once upstream layers are quantized the real activations
+    drift slightly, and any runtime value beyond the baked absmax is
+    clipped silently.  A 2% headroom factor is applied to soften this;
+    calibrate with representative data."""
     params = params if params is not None else model.ensure_initialized()
     state = state if state is not None else dict(model._state or {})
     targets = [m for m in model.modules() if type(m) in _QUANTIZABLE]
@@ -234,9 +240,11 @@ def calibrate_activation_absmax(model: Module, batches, params=None,
                 v = st.get("__calib__" + m.name)
                 if v is not None:
                     # same floor as the runtime path: an all-zero input
-                    # (dead ReLU / gated branch) must not bake scale 0
-                    out[m.name] = max(out.get(m.name, 0.0), float(v),
-                                      1e-8)
+                    # (dead ReLU / gated branch) must not bake scale 0.
+                    # 1.02x headroom absorbs small activation drift once
+                    # upstream layers are themselves quantized
+                    out[m.name] = max(out.get(m.name, 0.0),
+                                      1.02 * float(v), 1e-8)
     finally:
         for m, _ in origs:
             try:

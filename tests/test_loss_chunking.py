@@ -58,10 +58,15 @@ def test_chunked_loss_gradient_parity():
                                    rtol=2e-5, atol=2e-6)
 
 
-def test_chunk_must_divide_seq():
+def test_chunk_ragged_tail_pads():
+    """loss_chunk not dividing S pads the tail with ignore_index
+    (ADVICE r3): same NLL as the unchunked path, no crash."""
     model, params, tokens, targets = _setup()
-    with pytest.raises(ValueError, match="divide"):
-        model.token_nll(params, tokens, targets, loss_chunk=5)
+    full = model.token_nll(params, tokens, targets)
+    ragged = model.token_nll(params, tokens, targets, loss_chunk=5)
+    np.testing.assert_allclose(np.asarray(ragged[0]), np.asarray(full[0]),
+                               rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(ragged[1]), np.asarray(full[1]))
 
 
 def test_pipeline_trainer_loss_chunk_step_parity():
