@@ -6,12 +6,25 @@ from __future__ import annotations
 try:
     from jax import shard_map as _shard_map_fn
 
-    def shard_map(f, mesh, in_specs, out_specs, check_rep: bool = False):
+    def shard_map(f, mesh, in_specs, out_specs, check_rep: bool = False,
+                  manual_axes=None):
+        """`manual_axes`: mesh axes handled manually; the rest stay AUTO
+        (GSPMD-partitioned) — how pp composes with tp in the pipeline
+        trainer. None = all axes manual (classic shard_map)."""
+        kw = {}
+        if manual_axes is not None:
+            kw["axis_names"] = frozenset(manual_axes)
         return _shard_map_fn(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_vma=check_rep)
+                             out_specs=out_specs, check_vma=check_rep,
+                             **kw)
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map as _shard_map_fn
 
-    def shard_map(f, mesh, in_specs, out_specs, check_rep: bool = False):
+    def shard_map(f, mesh, in_specs, out_specs, check_rep: bool = False,
+                  manual_axes=None):
+        if manual_axes is not None:
+            raise NotImplementedError(
+                "partial-manual shard_map (auto axes) needs jax>=0.6 "
+                "jax.shard_map(axis_names=...)")
         return _shard_map_fn(f, mesh=mesh, in_specs=in_specs,
                              out_specs=out_specs, check_rep=check_rep)

@@ -179,13 +179,41 @@ class PipelineLMTrainer:
         return out
 
     # -- setup --------------------------------------------------------- #
+    def _has_tp(self):
+        return "tp" in self.mesh.axis_names and self.mesh.shape["tp"] > 1
+
+    def _stacked_placement(self, blocks):
+        """Placement specs for the layer-stacked block params: always
+        P('pp') on the stacking axis; with a tp mesh axis the inner dims
+        additionally take the template module's megatron layout (its
+        ``pspec``) — tensor parallel INSIDE each pipeline stage."""
+        if not self._has_tp():
+            return jax.tree_util.tree_map(lambda _: P("pp"), blocks)
+        from .spmd import _filter_spec     # drop axes absent from mesh
+        by_mod = {m.name: getattr(m, "pspec", {})
+                  for m in self.template.modules()}
+        out = {}
+        for mod_name, sub in blocks.items():
+            ps = by_mod.get(mod_name, {})
+            out[mod_name] = {
+                k: (P("pp", *_filter_spec(ps[k], self.mesh))
+                    if k in ps and ps[k] is not None else P("pp"))
+                for k in sub}
+        return out
+
     def init(self):
         from jax.sharding import NamedSharding
         model_params = self.model.init(jax.random.PRNGKey(self.seed))
         rest, blocks = self._split(model_params)
         put = lambda t, spec: jax.tree_util.tree_map(
             lambda l: jax.device_put(l, NamedSharding(self.mesh, spec)), t)
-        self.params = {"rest": put(rest, P()), "blocks": put(blocks, P("pp"))}
+        blk_place = self._stacked_placement(blocks)
+        self.params = {
+            "rest": put(rest, P()),
+            "blocks": jax.tree_util.tree_map(
+                lambda l, sp: jax.device_put(
+                    l, NamedSharding(self.mesh, sp)), blocks, blk_place,
+                is_leaf=lambda v: not isinstance(v, dict))}
         self.opt_state = jax.jit(self.optim.init_state)(self.params)
         self._build()
         return self
@@ -259,10 +287,18 @@ class PipelineLMTrainer:
         blk_specs = jax.tree_util.tree_map(lambda _: P("pp"),
                                            self.params["blocks"])
         tok_spec = P("dp") if has_dp else P()
+        # with a tp axis present, shard_map is manual over pp/dp ONLY and
+        # tp stays an AUTO axis: XLA partitions each stage's matmuls over
+        # tp (megatron layout from the template pspecs) and inserts the
+        # psums — pp x tp composition without hand-written collectives
+        manual = None
+        if self._has_tp():
+            manual = {"pp"} | ({"dp"} if has_dp else set())
         mapped = _shard_map(
             local, mesh,
             (rest_specs, blk_specs, tok_spec, tok_spec),
-            (P(), (rest_specs, blk_specs)))
+            (P(), (rest_specs, blk_specs)),
+            manual_axes=manual)
 
         def step(params, opt_state, tokens, targets):
             loss, (g_rest, g_blocks) = mapped(
