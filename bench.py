@@ -334,6 +334,7 @@ def bench_int8():
     runtime-quantized activations through the MXU int8 conv path."""
     from bigdl_tpu.models import resnet
     from bigdl_tpu.quantized import quantize
+    from bigdl_tpu.nn.fusion import fold_batchnorm
 
     model = resnet.build(class_num=1000, depth=50, dataset="imagenet",
                          format="NHWC")
@@ -341,9 +342,11 @@ def bench_int8():
     batch = 256
     rng = np.random.RandomState(0)
     x = jnp.asarray(rng.rand(batch, 224, 224, 3).astype(np.float32))
-    # calibrated static activation scales: drops the per-batch |x|
-    # reduction in front of every int8 conv (quantized/__init__.py)
-    qmodel = quantize(model, calibration_data=[x[:32]])
+    # fold BN into conv weights (nn/fusion.py: exact at eval), then
+    # calibrate static activation scales — together they remove both the
+    # per-BN elementwise pass and the per-batch |x| reduction in front
+    # of every int8 conv (quantized/__init__.py)
+    qmodel = quantize(fold_batchnorm(model), calibration_data=[x[:32]])
     params = qmodel.ensure_initialized()
     state = qmodel._state or {}
     ips = _infer_throughput(qmodel, params, state, x, batch)

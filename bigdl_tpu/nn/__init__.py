@@ -89,3 +89,5 @@ from .init import InitializationMethod
 # module base, Model the functional-graph container)
 from .module import Module as Layer
 from .graph import Graph as Model
+
+from .fusion import fold_batchnorm  # noqa: F401,E402

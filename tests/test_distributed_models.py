@@ -1,6 +1,7 @@
 """Distributed parity at model scale (≙ DistriOptimizerSpec.scala with real
 models): conv+BN (ResNet-20 CIFAR) and attention (tiny transformer, tp=2)
 on the virtual 8-device CPU mesh — not just the MLP in test_distributed.py."""
+import pytest
 import numpy as np
 import jax
 
@@ -30,6 +31,7 @@ def state_leaves(model):
                 jax.tree_util.tree_map(np.asarray, model._state))]
 
 
+@pytest.mark.slow
 def test_resnet20_fsdp_matches_dp():
     """FSDP (param/moment sharding + all_gather/psum_scatter) must produce
     the same trajectory as plain dp on a model with conv + BN state."""
@@ -92,6 +94,7 @@ def test_resnet20_syncbn_dp_matches_local_one_step():
         np.testing.assert_allclose(a, b, rtol=1e-3, atol=5e-5)
 
 
+@pytest.mark.slow
 def test_resnet20_syncbn_dp_converges_like_local():
     """Loss-level (not elementwise) agreement over 2 epochs."""
     x, y = cifar_data(n=64, seed=2)

@@ -12,6 +12,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 EXAMPLES_DIR = os.path.join(os.path.dirname(__file__), "..", "examples")
 
 # (script, extra args, timeout_s)

@@ -1,0 +1,111 @@
+"""Conv+BN inference folding (nn/fusion.py): exact eval-mode parity with
+the unfolded model, BN layers removed, nested containers handled."""
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.fusion import fold_batchnorm
+
+
+def _train_stats(model, shape, steps=3, seed=0):
+    rng = np.random.RandomState(seed)
+    model.training()
+    for _ in range(steps):
+        model.forward((rng.rand(*shape) * 2 + 0.5).astype(np.float32))
+    model.evaluate()
+    return model
+
+
+def test_conv_bn_fold_parity():
+    m = nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1),
+        nn.SpatialBatchNormalization(8),
+        nn.ReLU(),
+        nn.SpatialConvolution(8, 4, 3, 3, 2, 2, 1, 1, with_bias=False),
+        nn.SpatialBatchNormalization(4))
+    m.reset(1)
+    _train_stats(m, (4, 3, 8, 8))
+    x = np.random.RandomState(7).rand(2, 3, 8, 8).astype(np.float32)
+    y0 = np.asarray(m.forward(x))
+
+    folded = fold_batchnorm(m)
+    kinds = [type(c).__name__ for c in folded.modules()]
+    assert "SpatialBatchNormalization" not in kinds
+    y1 = np.asarray(folded.forward(x))
+    np.testing.assert_allclose(y1, y0, rtol=1e-4, atol=1e-5)
+    # original model untouched
+    assert "SpatialBatchNormalization" in [
+        type(c).__name__ for c in m.modules()]
+    np.testing.assert_allclose(np.asarray(m.forward(x)), y0, rtol=1e-6)
+
+
+def test_linear_bn_fold_parity():
+    m = nn.Sequential(nn.Linear(6, 10), nn.BatchNormalization(10),
+                      nn.Tanh(), nn.Linear(10, 3, with_bias=False),
+                      nn.BatchNormalization(3))
+    m.reset(2)
+    _train_stats(m, (16, 6))
+    x = np.random.RandomState(3).randn(5, 6).astype(np.float32)
+    y0 = np.asarray(m.forward(x))
+    folded = fold_batchnorm(m)
+    assert "BatchNormalization" not in [
+        type(c).__name__ for c in folded.modules()]
+    np.testing.assert_allclose(np.asarray(folded.forward(x)), y0,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_fold_inside_nested_containers():
+    """ResNet-style block: pairs inside ConcatTable branches fold too."""
+    m = nn.Sequential(
+        nn.ConcatTable(
+            nn.Sequential(
+                nn.SpatialConvolution(4, 4, 3, 3, 1, 1, 1, 1),
+                nn.SpatialBatchNormalization(4), nn.ReLU(),
+                nn.SpatialConvolution(4, 4, 3, 3, 1, 1, 1, 1),
+                nn.SpatialBatchNormalization(4)),
+            nn.Identity()),
+        nn.CAddTable(), nn.ReLU())
+    m.reset(4)
+    _train_stats(m, (4, 4, 6, 6))
+    x = np.random.RandomState(5).rand(2, 4, 6, 6).astype(np.float32)
+    y0 = np.asarray(m.forward(x))
+    folded = fold_batchnorm(m)
+    assert "SpatialBatchNormalization" not in [
+        type(c).__name__ for c in folded.modules()]
+    np.testing.assert_allclose(np.asarray(folded.forward(x)), y0,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_unpaired_bn_left_alone():
+    """BN NOT preceded by conv/linear (first layer, or after ReLU) must
+    survive and still normalize with running stats."""
+    m = nn.Sequential(nn.SpatialBatchNormalization(3),
+                      nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+                      nn.ReLU(),
+                      nn.SpatialBatchNormalization(4))
+    m.reset(6)
+    _train_stats(m, (4, 3, 6, 6))
+    x = np.random.RandomState(8).rand(2, 3, 6, 6).astype(np.float32)
+    y0 = np.asarray(m.forward(x))
+    folded = fold_batchnorm(m)
+    kinds = [type(c).__name__ for c in folded.modules()]
+    assert kinds.count("SpatialBatchNormalization") == 2
+    np.testing.assert_allclose(np.asarray(folded.forward(x)), y0,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_resnet_fold_parity():
+    from bigdl_tpu.models import resnet
+    m = resnet.build(class_num=10, depth=20, dataset="cifar10")
+    m.reset(0)
+    _train_stats(m, (8, 3, 32, 32), steps=2)
+    x = np.random.RandomState(9).rand(4, 3, 32, 32).astype(np.float32)
+    y0 = np.asarray(m.forward(x))
+    folded = fold_batchnorm(m)
+    n_bn0 = sum(1 for c in m.modules()
+                if type(c).__name__ == "SpatialBatchNormalization")
+    n_bn1 = sum(1 for c in folded.modules()
+                if type(c).__name__ == "SpatialBatchNormalization")
+    assert n_bn0 > 0 and n_bn1 < n_bn0
+    np.testing.assert_allclose(np.asarray(folded.forward(x)), y0,
+                               rtol=2e-4, atol=2e-5)

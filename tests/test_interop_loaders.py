@@ -228,6 +228,7 @@ layer { name: "sum" type: "Eltwise" bottom: "cat" bottom: "cat" top: "sum"
     np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_caffe_googlenet_deploy_loads():
     """The full BVLC GoogLeNet deploy definition builds through the DAG
     loader and produces (B, classes) probabilities."""

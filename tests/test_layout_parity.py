@@ -55,6 +55,7 @@ BUILDERS = {
 
 
 @pytest.mark.parametrize("name", list(BUILDERS))
+@pytest.mark.slow
 def test_forward_layout_parity(name):
     builder, shape = BUILDERS[name]
     m_nchw, m_nhwc, params, state, params_h, state_h = _pair(builder)
@@ -77,6 +78,7 @@ def test_forward_layout_parity(name):
     assert rel < 5e-3, f"layout mismatch: max|Δ|/spread = {rel:.4f}"
 
 
+@pytest.mark.slow
 def test_train_step_layout_parity():
     builder, shape = BUILDERS["resnet20_cifar"]
     m_nchw, m_nhwc, params, state, params_h, state_h = _pair(builder)

@@ -69,6 +69,7 @@ def test_chunk_ragged_tail_pads():
     np.testing.assert_allclose(np.asarray(ragged[1]), np.asarray(full[1]))
 
 
+@pytest.mark.slow
 def test_pipeline_trainer_loss_chunk_step_parity():
     """PipelineLMTrainer with loss_chunk equals the unchunked trainer."""
     from bigdl_tpu.parallel.mesh import create_mesh

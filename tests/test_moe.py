@@ -1,6 +1,7 @@
 """SwitchFFN mixture-of-experts tests: routing math vs a dense reference,
 capacity drop behavior, aux loss plumbing, and ep-sharded parity on the
 virtual CPU mesh."""
+import pytest
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -69,6 +70,7 @@ def test_aux_loss_flows_through_ctx():
     assert not ctx2.side_losses
 
 
+@pytest.mark.slow
 def test_moe_transformer_ep_sharded_matches_dp_only():
     """MoE transformer on a dp×ep(×tp) mesh must track the dp-only
     trajectory — the ep partitioning is layout, not math."""
@@ -102,6 +104,7 @@ def test_moe_transformer_ep_sharded_matches_dp_only():
     assert abs(a1 - b1) < 1e-4, (a1, b1)
 
 
+@pytest.mark.slow
 def test_moe_aux_loss_included_in_spmd_loss():
     """SpmdTrainer's loss must include the Switch aux term (≥ CE alone)."""
     from bigdl_tpu.models.transformer import (TransformerLM,

@@ -56,6 +56,7 @@ def test_flash_attention_ragged_seq():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ring_attention_matches_full(causal):
     q, k, v = _qkv()
     mesh = Mesh(np.array(jax.devices()).reshape(2, 2, 2), ("dp", "tp", "sp"))
@@ -88,6 +89,7 @@ def _train(mesh_axes, ring, fsdp, steps=3):
     return [float(tr.step(x, y)) for _ in range(steps)]
 
 
+@pytest.mark.slow
 def test_spmd_trainer_parallel_matches_single():
     single = _train({"dp": 1}, ring=False, fsdp=False)
     dp_tp_sp = _train({"dp": 2, "tp": 2, "sp": 2}, ring=True, fsdp=False)
@@ -97,6 +99,7 @@ def test_spmd_trainer_parallel_matches_single():
     np.testing.assert_allclose(single, dp_fsdp_tp, rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_transformer_remat_matches():
     x, y = _lm_batch()
     m = T.build("tiny")
@@ -108,6 +111,7 @@ def test_transformer_remat_matches():
                                atol=1e-5)
 
 
+@pytest.mark.slow
 def test_pipeline_matches_sequential():
     n_stages, n_micro, b, d = 4, 4, 8, 16
     rng = np.random.RandomState(0)
@@ -141,6 +145,7 @@ def test_lm_cross_entropy_ignore_index():
 
 
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_ring_attention_blockwise_chunks_match(causal):
     """Sub-blocked chunk merging (block_k < s_local) and the causal
     future-chunk skip must stay exact vs full attention, incl. grads."""
@@ -179,6 +184,7 @@ def test_ring_attention_blockwise_non_divisible_chunk():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_spmd_trainer_checkpoint_resume(tmp_path):
     """save_checkpoint/load_checkpoint on the fsdp+tp flagship: a resumed
     trainer must continue exactly like the uninterrupted one (params,
@@ -218,6 +224,7 @@ def test_spmd_trainer_checkpoint_resume(tmp_path):
     np.testing.assert_allclose(resumed, base[2:], rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_spmd_trainer_fit_checkpoints(tmp_path):
     from bigdl_tpu.models import transformer as T
     from bigdl_tpu.parallel import mesh as mesh_lib
@@ -246,6 +253,7 @@ def test_spmd_trainer_fit_checkpoints(tmp_path):
     assert snaps == ["step_4", "step_6"], snaps   # keep=2 pruned step_2
 
 
+@pytest.mark.slow
 def test_spmd_trainer_evaluate():
     """evaluate() returns the exact token-weighted masked cross entropy
     (cross-checked against lm_cross_entropy on the concatenated data)."""

@@ -1,5 +1,6 @@
 """GPipe PipelineLMTrainer: loss/trajectory parity with a single-process
 reference on the virtual CPU mesh (pp=2, and dp×pp)."""
+import pytest
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -38,6 +39,7 @@ def _reference_losses(model, params, tokens, targets, lr, steps):
     return losses
 
 
+@pytest.mark.slow
 def test_pipeline_pp2_matches_single_process():
     tokens, targets = _data(0)
     mesh = mesh_lib.create_mesh({"pp": 2})
@@ -78,6 +80,7 @@ def test_merge_returns_model_params():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow
 def test_pipeline_composes_with_tensor_parallel():
     """dp x pp x tp: shard_map manual over pp/dp with tp as an AUTO axis
     (XLA partitions each stage's matmuls via the template pspecs) must
