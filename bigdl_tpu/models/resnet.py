@@ -25,11 +25,12 @@ class ShortcutType:
 
 class _Builder:
     def __init__(self, shortcut_type=ShortcutType.B, format="NCHW",
-                 sync_bn_axis=None):
+                 sync_bn_axis=None, remat=False):
         self.i_channels = 0
         self.shortcut_type = shortcut_type
         self.format = format
         self.sync_bn_axis = sync_bn_axis
+        self.remat = remat
 
     def conv(self, *a, **kw):
         return SpatialConvolution(*a, format=self.format, **kw)
@@ -95,7 +96,11 @@ class _Builder:
     def layer(self, block, features, count, stride=1):
         s = Sequential()
         for i in range(count):
-            s.add(block(features, stride if i == 0 else 1))
+            blk = block(features, stride if i == 0 else 1)
+            if self.remat:
+                from ..nn import Remat
+                blk = Remat(blk)
+            s.add(blk)
         return s
 
 
@@ -112,7 +117,7 @@ _IMAGENET_CFG = {
 
 def build(class_num=1000, depth=50, shortcut_type=ShortcutType.B,
           dataset="imagenet", with_logsoftmax=True, format="NCHW",
-          sync_bn_axis=None, stem="conv"):
+          sync_bn_axis=None, stem="conv", remat=False):
     """≙ ResNet.apply (ResNet.scala:240).  format='NHWC' builds the
     TPU-preferred channels-last variant (identical math; feed NHWC
     inputs).  sync_bn_axis='dp' makes every BN compute cross-replica
@@ -121,8 +126,11 @@ def build(class_num=1000, depth=50, shortcut_type=ShortcutType.B,
     stem='s2d' (NHWC imagenet only) computes the same 7x7/2 stem conv
     on a 2x2 space-to-depth input — an exact reparameterization (same
     parameter tensor, same outputs, checkpoint-compatible) that lifts
-    the MXU lane utilization of the C=3 stem."""
-    b = _Builder(shortcut_type, format=format, sync_bn_axis=sync_bn_axis)
+    the MXU lane utilization of the C=3 stem.  remat=True wraps every
+    residual block in nn.Remat (jax.checkpoint): activations recompute
+    in the backward, trading FLOPs for the HBM that caps batch size."""
+    b = _Builder(shortcut_type, format=format, sync_bn_axis=sync_bn_axis,
+                 remat=remat)
     model = Sequential(name=f"ResNet{depth}_{dataset}")
     if stem not in ("conv", "s2d"):
         raise ValueError(f"unknown stem {stem!r}")

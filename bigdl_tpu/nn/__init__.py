@@ -4,7 +4,8 @@ from . import init
 from .init import (Zeros, Ones, ConstInit, RandomUniform, RandomNormal,
                    Xavier, MsraFiller, BilinearFiller)
 from .containers import (Container, Sequential, Concat, ConcatTable,
-                         ParallelTable, MapTable, Bottle, Identity, Echo)
+                         ParallelTable, MapTable, Bottle, Identity, Echo,
+                         Remat)
 from .graph import Graph, DynamicGraph, Input, Node
 from .linear import (Linear, Bilinear, CMul, CAdd, Add, Mul, Cosine,
                      Euclidean, LookupTable, Maxout)

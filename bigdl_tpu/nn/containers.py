@@ -139,3 +139,33 @@ class Echo(Module):
             print(f"[{self.name}] shape={getattr(leaf, 'shape', None)} "
                   f"dtype={getattr(leaf, 'dtype', None)}")
         return x
+
+
+class Remat(Container):
+    """Rematerialization wrapper: recompute the child's activations in
+    the backward pass instead of storing them (``jax.checkpoint``) — the
+    HBM-for-FLOPs trade that unlocks larger batch sizes on TPU.  State
+    updates and side losses cross the checkpoint boundary functionally,
+    so BN statistics behave exactly as without the wrapper.
+
+    No reference counterpart (Spark executors recompute nothing); this
+    is the TPU-native memory lever (SURVEY 'HBM bandwidth' design note).
+    """
+
+    def __init__(self, child=None, name=None):
+        super().__init__(*([child] if child is not None else []), name=name)
+
+    def apply(self, params, x, ctx):
+        from .module import Ctx
+        child = self._children[0]
+
+        def f(p, xx):
+            sub = Ctx(state=ctx.state, training=ctx.training,
+                      rng_key=ctx.rng_key)
+            y = child.apply(p, xx, sub)
+            return y, (dict(sub.new_state), tuple(sub.side_losses))
+
+        y, (upd, side) = jax.checkpoint(f)(params, x)
+        ctx.new_state.update(upd)
+        ctx.side_losses.extend(side)
+        return y

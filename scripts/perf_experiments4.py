@@ -55,9 +55,10 @@ def lat():
     return float(np.median(ls))
 
 
-def run_full(label, batch=256, stem="conv", k=10, x_bf16=False):
+def run_full(label, batch=256, stem="conv", k=10, x_bf16=False,
+             remat=False):
     model = resnet.build(class_num=1000, depth=50, dataset="imagenet",
-                         format="NHWC", stem=stem)
+                         format="NHWC", stem=stem, remat=remat)
     criterion = nn.ClassNLLCriterion()
     method = SGD(learning_rate=0.1, momentum=0.9, weight_decay=1e-4)
     params, state = model.init_params(0)
@@ -97,6 +98,19 @@ def exp_K1():
     run_full("K1 full step, conv stem ")
 
 
+def exp_K7():
+    """remat cost at b256 (baseline for K8): blocks recompute in bwd."""
+    run_full("K7 b256 remat           ", remat=True)
+
+
+def exp_K8():
+    """b512 via remat — the batch the non-remat step OOMs at
+    (RESOURCE_EXHAUSTED, artifacts/perf_experiments2_20260731.txt).
+    Larger batch amortizes BN reductions + weight traffic; if img/s
+    beats K1's, flip the bench headline to remat+b512."""
+    run_full("K8 b512 remat           ", batch=512, remat=True)
+
+
 def exp_K2():
     run_full("K2 full step, s2d stem  ", stem="s2d")
 
@@ -132,7 +146,8 @@ def exp_K6():
 if __name__ == "__main__":
     which = sys.argv[1:] or ["K1", "K2", "K3"]
     t0 = time.time()
-    EXPS = {"K1": exp_K1, "K2": exp_K2, "K3": exp_K3,
+    EXPS = {"K1": exp_K1, "K2": exp_K2, "K3": exp_K3, "K7": exp_K7,
+            "K8": exp_K8,
             "K4": exp_K4, "K5": exp_K5, "K6": exp_K6}
     for w in which:
         try:

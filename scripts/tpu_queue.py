@@ -20,6 +20,10 @@ PY = sys.executable
 QUEUE = [
     # (label, argv, timeout_s)
     ("probe", [PY, os.path.join(HERE, "tpu_probe.py"), "120"], 150),
+    # FULL BENCH FIRST in every live window (tunnel discipline / VERDICT
+    # r3 weak-1): the gate artifact before any experiment ladder
+    ("full bench (gate artifact)",
+     [PY, os.path.join(HERE, os.pardir, "bench.py")], 3600),
     ("K2 s2d stem full step",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K2"], 1500),
     ("K3 autodiff-BN full step",
@@ -31,6 +35,8 @@ QUEUE = [
      [PY, os.path.join(HERE, "tpu_tuning.py"), "profile"], 1200),
     ("transformer tuning matrix",
      [PY, os.path.join(HERE, "transformer_tuning.py"), "matrix"], 2400),
+    ("K7/K8 remat b256/b512",
+     [PY, os.path.join(HERE, "perf_experiments4.py"), "K7", "K8"], 2400),
     ("MoE bench config (new)",
      [PY, os.path.join(HERE, os.pardir, "bench.py"), "moe"], 1500),
 ]
