@@ -31,6 +31,7 @@ class _Builder:
         self.format = format
         self.sync_bn_axis = sync_bn_axis
         self.remat = remat
+        self._block_sites = []
 
     def conv(self, *a, **kw):
         return SpatialConvolution(*a, format=self.format, **kw)
@@ -96,11 +97,11 @@ class _Builder:
     def layer(self, block, features, count, stride=1):
         s = Sequential()
         for i in range(count):
-            blk = block(features, stride if i == 0 else 1)
-            if self.remat:
-                from ..nn import Remat
-                blk = Remat(blk)
-            s.add(blk)
+            s.add(block(features, stride if i == 0 else 1))
+            # remat wrapping happens POST-BUILD (build() below) so the
+            # wrappers' uids come after every model module's — auto
+            # names stay identical to a remat=False build
+            self._block_sites.append((s, len(s) - 1))
         return s
 
 
@@ -177,4 +178,8 @@ def build(class_num=1000, depth=50, shortcut_type=ShortcutType.B,
         raise ValueError(f"unknown dataset {dataset}")
     if with_logsoftmax:
         model.add(LogSoftMax())
+    if remat:
+        from ..nn import Remat
+        for seq, i in b._block_sites:
+            seq._children[i] = Remat(seq._children[i])
     return model

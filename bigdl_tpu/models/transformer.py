@@ -308,6 +308,7 @@ class TransformerLM(Module):
         self.cfg = cfg
         self.embed = TokenEmbedding(cfg.vocab_size, cfg.d_model,
                                     name=f"{self.name}.embed")
+        self._remat_blocks = None
         self.blocks = [TransformerBlock(cfg, name=f"{self.name}.block{i}")
                        for i in range(cfg.n_layers)]
         self.final_norm = RMSNorm(cfg.d_model, name=f"{self.name}.final_norm")
@@ -333,15 +334,16 @@ class TransformerLM(Module):
         h = self.embed.apply(params, x, ctx)
         h = h.astype(jnp.dtype(cfg.dtype))
 
-        for blk in self.blocks:
-            if cfg.remat:
-                def f(p, hh, rng_key, _blk=blk):
-                    inner = Ctx(state={}, training=ctx.training,
-                                rng_key=rng_key)
-                    return _blk.apply(p, hh, inner)
-                h = jax.checkpoint(f)(params, h, ctx.rng_key)
-            else:
-                h = blk.apply(params, h, ctx)
+        if cfg.remat and self._remat_blocks is None:
+            # lazily, AFTER the model is fully built, so the wrappers'
+            # uids never shift the model's own auto names; nn.Remat also
+            # threads inner state/side-losses (e.g. MoE aux losses) out
+            # through the checkpoint boundary, which the old hand-rolled
+            # remat silently dropped
+            from ..nn import Remat
+            self._remat_blocks = [Remat(b) for b in self.blocks]
+        for blk in (self._remat_blocks if cfg.remat else self.blocks):
+            h = blk.apply(params, h, ctx)
 
         return self.final_norm.apply(params, h, ctx)
 

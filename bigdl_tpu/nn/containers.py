@@ -148,12 +148,26 @@ class Remat(Container):
     updates and side losses cross the checkpoint boundary functionally,
     so BN statistics behave exactly as without the wrapper.
 
+    Transparent for parameters: ``init``/``initial_state`` delegate to
+    the child with the SAME rng (no extra fold), so a wrapped model
+    yields identical param/state trees to the unwrapped one.  To keep
+    auto-generated module NAMES identical too, wrap AFTER the whole
+    model is constructed (see resnet.build(remat=True)) — a Remat
+    created mid-build would advance the global uid counter and shift
+    every later auto name, breaking checkpoint compatibility.
+
     No reference counterpart (Spark executors recompute nothing); this
     is the TPU-native memory lever (SURVEY 'HBM bandwidth' design note).
     """
 
     def __init__(self, child=None, name=None):
         super().__init__(*([child] if child is not None else []), name=name)
+
+    def init(self, rng):
+        return self._children[0].init(rng)
+
+    def initial_state(self):
+        return self._children[0].initial_state()
 
     def apply(self, params, x, ctx):
         from .module import Ctx

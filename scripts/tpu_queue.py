@@ -22,8 +22,12 @@ QUEUE = [
     ("probe", [PY, os.path.join(HERE, "tpu_probe.py"), "120"], 150),
     # FULL BENCH FIRST in every live window (tunnel discipline / VERDICT
     # r3 weak-1): the gate artifact before any experiment ladder
+    # BENCH_DEADLINE_S matches the 3600s budget: bench's internal
+    # watchdog (default 2700s) exits rc=3 on a slow-but-healthy run,
+    # which would otherwise read as a wedge and abort the whole queue
     ("full bench (gate artifact)",
-     [PY, os.path.join(HERE, os.pardir, "bench.py")], 3600),
+     [PY, os.path.join(HERE, os.pardir, "bench.py")], 3600,
+     {"BENCH_DEADLINE_S": "3400"}),
     ("K2 s2d stem full step",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K2"], 1500),
     ("K3 autodiff-BN full step",
@@ -37,21 +41,25 @@ QUEUE = [
      [PY, os.path.join(HERE, "transformer_tuning.py"), "matrix"], 2400),
     ("K7/K8 remat b256/b512",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K7", "K8"], 2400),
-    ("MoE bench config (new)",
-     [PY, os.path.join(HERE, os.pardir, "bench.py"), "moe"], 1500),
+    # (moe config already runs inside the full bench above)
 ]
 
 
 def main():
     if "--list" in sys.argv:
-        for label, argv, t in QUEUE:
+        for entry in QUEUE:
+            label, argv, t = entry[0], entry[1], entry[2]
             print(f"{label:30s} timeout={t}s: {' '.join(argv)}")
         return 0
     t0 = time.time()
-    for label, argv, timeout in QUEUE:
+    for entry in QUEUE:
+        label, argv, timeout = entry[0], entry[1], entry[2]
+        env = dict(os.environ)
+        if len(entry) > 3:
+            env.update(entry[3])
         print(f"== {label} (timeout {timeout}s) ==", flush=True)
         try:
-            proc = subprocess.run(argv, timeout=timeout)
+            proc = subprocess.run(argv, timeout=timeout, env=env)
         except subprocess.TimeoutExpired:
             print(f"== {label}: TIMED OUT after {timeout}s — tunnel "
                   "presumed wedged, aborting queue ==", flush=True)
