@@ -456,9 +456,54 @@ def _build_recurrent(tree):
     return rec
 
 
-def _assign_cell_weights(params, cell_tree):
+def _birnn_recurrents(birnn):
+    """BiRecurrent's internal Sequential (BiRecurrent.scala:48-66):
+    [input-fanout, ParallelTable[fwd Recurrent, Sequential[Reverse,
+    rev Recurrent, Reverse]], merge] -> (fwd tree, rev tree)."""
+    for sub in birnn.get("subs", []):
+        if _short_type(sub["type"]) == "ParallelTable" \
+                and len(sub["subs"]) == 2:
+            fwd = sub["subs"][0]
+            rev = next((x for x in sub["subs"][1].get("subs", [])
+                        if _short_type(x["type"]) == "Recurrent"), None)
+            if _short_type(fwd["type"]) == "Recurrent" and rev is not None:
+                return fwd, rev
+    raise ValueError(
+        ".bigdl BiRecurrent: unrecognized birnn layout (expected "
+        "ParallelTable of forward Recurrent + Reverse/Recurrent/Reverse)")
+
+
+def _build_birecurrent(tree):
+    a = tree["attr"]
+    if a.get("bnorm"):
+        raise ValueError(
+            ".bigdl BiRecurrent(BatchNormParams) is not supported")
+    if a.get("isSplitInput"):
+        raise ValueError(
+            ".bigdl BiRecurrent(isSplitInput=true) is not supported "
+            "(feature-split bidirectional inputs)")
+    birnn = a.get("birnn")
+    if not isinstance(birnn, dict):
+        raise ValueError(".bigdl BiRecurrent: missing birnn attr")
+    fwd_t, _ = _birnn_recurrents(birnn)
+    subs = birnn.get("subs", [])
+    merge_t = subs[-1] if subs else None
+    merge = None
+    if merge_t is not None and _short_type(merge_t["type"]) not in (
+            "CAddTable",):
+        merge = _build(merge_t)
+    m = nn.BiRecurrent(merge=merge, cell=_build_cell(
+        fwd_t["attr"]["topology"]))
+    if tree["name"]:
+        m.set_name(tree["name"])
+    return m
+
+
+def _assign_cell_weights(params, cell_tree, target=None):
     import jax
     cname, wd = _cell_weights(cell_tree)
+    if target is not None:
+        cname = target
     if cname not in params:
         raise ValueError(
             f".bigdl recurrent cell {cname!r} has no params slot in the "
@@ -611,6 +656,8 @@ def _build(tree):
         return _build_graph(tree)
     if t == "Recurrent":
         return _build_recurrent(tree)
+    if t == "BiRecurrent":
+        return _build_birecurrent(tree)
     if t in _CELL_TYPES:
         return _build_cell(tree)
     fac = _FACTORY.get(t)
@@ -653,6 +700,17 @@ def load_bigdl(path: str):
             # cell weights come from the topology attr's Linear layout,
             # not the Recurrent's own flat parameter list
             _assign_cell_weights(params, sub["attr"]["topology"])
+            continue
+        if st == "BiRecurrent":
+            fwd_t, rev_t = _birnn_recurrents(sub["attr"]["birnn"])
+            _assign_cell_weights(params, fwd_t["attr"]["topology"])
+            # the built model's backward cell is a rename of the forward
+            # one ("<fwd>_bwd", nn/recurrent.py BiRecurrent._ensure_bwd);
+            # the reference's reverse topology has its own name — assign
+            # with the same shape/structure validation as the fwd cell
+            fwd_name = fwd_t["attr"]["topology"]["name"]
+            _assign_cell_weights(params, rev_t["attr"]["topology"],
+                                 target=f"{fwd_name}_bwd")
             continue
         if st in _CELL_TYPES:
             _assign_cell_weights(params, sub)

@@ -98,6 +98,39 @@ def exp_K1():
     run_full("K1 full step, conv stem ")
 
 
+def exp_K9():
+    """BN folding payoff at inference: bf16 fwd img/s, folded vs not
+    (nn/fusion.py removes one HBM-bound elementwise pass per BN)."""
+    from bigdl_tpu.nn.fusion import fold_batchnorm
+
+    def infer(label, m):
+        params, state = m._params, m._state
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(256, 224, 224, 3), jnp.bfloat16)
+
+        @jax.jit
+        def fwd(p, s, xx):
+            y, _ = m.run(p, xx, state=s, training=False)
+            return y
+
+        fwd(params, state, x).block_until_ready()
+        l = lat()
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            fwd(params, state, x).block_until_ready()
+            ts.append(time.perf_counter() - t0 - l)
+        t = float(np.median(ts))
+        print(f"{label}: {t*1e3:7.2f} ms  {256/t:8.0f} img/s", flush=True)
+
+    model = resnet.build(class_num=1000, depth=50, dataset="imagenet",
+                         format="NHWC")
+    model.ensure_initialized()
+    model.evaluate()
+    infer("K9 bf16 infer, BN separate", model)
+    infer("K9 bf16 infer, BN folded  ", fold_batchnorm(model))
+
+
 def exp_K7():
     """remat cost at b256 (baseline for K8): blocks recompute in bwd."""
     run_full("K7 b256 remat           ", remat=True)
@@ -147,7 +180,7 @@ if __name__ == "__main__":
     which = sys.argv[1:] or ["K1", "K2", "K3"]
     t0 = time.time()
     EXPS = {"K1": exp_K1, "K2": exp_K2, "K3": exp_K3, "K7": exp_K7,
-            "K8": exp_K8,
+            "K8": exp_K8, "K9": exp_K9,
             "K4": exp_K4, "K5": exp_K5, "K6": exp_K6}
     for w in which:
         try:

@@ -41,6 +41,8 @@ QUEUE = [
      [PY, os.path.join(HERE, "transformer_tuning.py"), "matrix"], 2400),
     ("K7/K8 remat b256/b512",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K7", "K8"], 2400),
+    ("K9 BN-folded bf16 inference",
+     [PY, os.path.join(HERE, "perf_experiments4.py"), "K9"], 1500),
     # (moe config already runs inside the full bench above)
 ]
 

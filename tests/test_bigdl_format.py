@@ -661,3 +661,89 @@ def test_recurrent_lstm_nondefault_activation():
         hs = sig(o) * sig(cs)
         want[:, t] = hs
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_birecurrent_lstm_read():
+    """BiRecurrent(LSTM) wire layout (nn/BiRecurrent.scala:48-66): the
+    birnn Sequential rides a module attr with forward and
+    Reverse-wrapped backward Recurrents; default merge is CAddTable."""
+    rng = np.random.RandomState(21)
+    nin, h = 3, 4
+
+    def lstm_tree(name, wp, bp, wh):
+        t = enc_string(1, name)
+        t += enc_string(7, "com.intel.analytics.bigdl.nn.LSTM")
+        t += _mod_attr_entry("inputSize", _attr_i(nin))
+        t += _mod_attr_entry("hiddenSize", _attr_i(h))
+        t += _mod_attr_entry("p", _attr_d(0.0))
+        t += _mod_attr_entry(
+            "preTopology", _attr_mod(_linear_module(name + "_i2g", wp, bp)))
+        t += enc_int64(15, 1)
+        t += enc_bytes(16, _mod_tensor(wh))
+        return t
+
+    def recurrent_tree(name, lstm_bytes):
+        r = enc_string(1, name)
+        r += enc_string(7, "com.intel.analytics.bigdl.nn.Recurrent")
+        r += _mod_attr_entry("topology", _attr_mod(lstm_bytes))
+        return r
+
+    wpf = rng.randn(4 * h, nin).astype(np.float32)
+    bpf = rng.randn(4 * h).astype(np.float32)
+    whf = rng.randn(4 * h, h).astype(np.float32)
+    wpb = rng.randn(4 * h, nin).astype(np.float32)
+    bpb = rng.randn(4 * h).astype(np.float32)
+    whb = rng.randn(4 * h, h).astype(np.float32)
+
+    fwd = recurrent_tree("rec_f", lstm_tree("lstm_f", wpf, bpf, whf))
+    rev = recurrent_tree("rec_b", lstm_tree("lstm_b", wpb, bpb, whb))
+
+    reverse1 = enc_string(1, "rev1") \
+        + enc_string(7, "com.intel.analytics.bigdl.nn.Reverse")
+    reverse2 = enc_string(1, "rev2") \
+        + enc_string(7, "com.intel.analytics.bigdl.nn.Reverse")
+    seq_rev = enc_string(1, "seqr") \
+        + enc_string(7, "com.intel.analytics.bigdl.nn.Sequential") \
+        + enc_bytes(2, reverse1) + enc_bytes(2, rev) + enc_bytes(2, reverse2)
+    par = enc_string(1, "par") \
+        + enc_string(7, "com.intel.analytics.bigdl.nn.ParallelTable") \
+        + enc_bytes(2, fwd) + enc_bytes(2, seq_rev)
+    fan = enc_string(1, "fan") \
+        + enc_string(7, "com.intel.analytics.bigdl.nn.ConcatTable")
+    madd = enc_string(1, "madd") \
+        + enc_string(7, "com.intel.analytics.bigdl.nn.CAddTable")
+    birnn = enc_string(1, "birnn") \
+        + enc_string(7, "com.intel.analytics.bigdl.nn.Sequential") \
+        + enc_bytes(2, fan) + enc_bytes(2, par) + enc_bytes(2, madd)
+
+    bi = enc_string(1, "bi")
+    bi += enc_string(7, "com.intel.analytics.bigdl.nn.BiRecurrent")
+    bi += _mod_attr_entry("birnn", _attr_mod(birnn))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "bi.bigdl")
+        with open(p, "wb") as f:
+            f.write(bi)
+        m = load_bigdl(p)
+
+    B, T = 2, 5
+    x = rng.randn(B, T, nin).astype(np.float32)
+    got = np.asarray(m.forward(x))
+
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+
+    def run_lstm(xs, wp, bp, wh):
+        hs = np.zeros((B, h), np.float32)
+        cs = np.zeros((B, h), np.float32)
+        out = np.zeros((B, xs.shape[1], h), np.float32)
+        for t in range(xs.shape[1]):
+            z = xs[:, t] @ wp.T + bp + hs @ wh.T
+            i, g, f, o = (z[:, :h], z[:, h:2*h], z[:, 2*h:3*h], z[:, 3*h:])
+            cs = sig(i) * np.tanh(g) + sig(f) * cs
+            hs = sig(o) * np.tanh(cs)
+            out[:, t] = hs
+        return out
+
+    yf = run_lstm(x, wpf, bpf, whf)
+    yb = run_lstm(x[:, ::-1], wpb, bpb, whb)[:, ::-1]
+    np.testing.assert_allclose(got, yf + yb, rtol=1e-4, atol=1e-5)
