@@ -211,9 +211,100 @@ class DLImageTransformer:
 
     def transform(self, rows: List[Dict], input_col="image",
                   output_col="output") -> List[Dict]:
+        import copy as _copy
         out = []
         for r in rows:
             r2 = dict(r)
-            r2[output_col] = self.transformer.transform(r[input_col])
+            # vision FeatureTransformers mutate the feature in place
+            # (reference semantics); transform a COPY so repeated
+            # pipeline passes (fit, then transform) never re-normalize
+            # the caller's rows
+            r2[output_col] = self.transformer.transform(
+                _copy.deepcopy(r[input_col]))
             out.append(r2)
         return out
+
+
+def _hwc_to_chw(img: np.ndarray) -> np.ndarray:
+    """Shared layout rule (same guards as imageframe.ImageFrameToSample):
+    2D grayscale becomes (1, H, W); already-CHW passes through."""
+    img = np.asarray(img, np.float32)
+    if img.ndim == 2:
+        img = img[None]
+    elif img.ndim == 3 and img.shape[0] not in (1, 3):
+        img = np.transpose(img, (2, 0, 1))
+    return np.ascontiguousarray(img)
+
+
+class ImageFeatureToTensor:
+    """Pipeline stage turning an ImageFeature column into a CHW numpy
+    'features' column ready for DLEstimator/DLClassifier (the bridge the
+    reference gets from DLImageTransformer's internal MatToTensor +
+    ImageFeatureToTensor, dlframes/DLImageTransformer.scala:62)."""
+
+    def __init__(self, input_col="image", output_col="features",
+                 label_col="label"):
+        self.input_col = input_col
+        self.output_col = output_col
+        self.label_col = label_col
+
+    def transform(self, rows: List[Dict]) -> List[Dict]:
+        out = []
+        for r in rows:
+            feat = r[self.input_col]
+            img = feat.image if isinstance(feat, ImageFeature) else feat
+            r2 = dict(r)
+            r2[self.output_col] = _hwc_to_chw(img)
+            if isinstance(feat, ImageFeature) and feat.label is not None \
+                    and self.label_col not in r2:
+                r2[self.label_col] = feat.label
+            out.append(r2)
+        return out
+
+
+class Pipeline:
+    """Ordered stage composition, the Spark-ML Pipeline contract the
+    reference's dlframes plug into (org.apache.spark.ml.Pipeline):
+    ``fit`` walks the stages — a Transformer (has ``transform``) maps the
+    rows through; an Estimator (has ``fit``) is fitted on the current
+    rows and its resulting model transforms them for the stages after
+    it.  The result is a :class:`PipelineModel` of transformers only.
+    """
+
+    def __init__(self, stages: Sequence):
+        self.stages = list(stages)
+
+    def fit(self, rows) -> "PipelineModel":
+        fitted = []
+        cur = rows
+        for i, stage in enumerate(self.stages):
+            if hasattr(stage, "fit"):
+                model = stage.fit(cur)
+                fitted.append(model)
+                last = i == len(self.stages) - 1
+                cur = cur if last else model.transform(cur)
+            elif hasattr(stage, "transform"):
+                fitted.append(stage)
+                cur = stage.transform(cur)
+            else:
+                raise TypeError(
+                    f"pipeline stage {i} ({type(stage).__name__}) has "
+                    "neither fit nor transform")
+        return PipelineModel(fitted)
+
+    def transform(self, rows):
+        raise TypeError("Pipeline must be fit() first; transform lives "
+                        "on the returned PipelineModel")
+
+
+class PipelineModel:
+    """The fitted pipeline: transforms rows through every stage in
+    order (org.apache.spark.ml.PipelineModel.transform)."""
+
+    def __init__(self, stages: Sequence):
+        self.stages = list(stages)
+
+    def transform(self, rows):
+        for stage in self.stages:
+            rows = stage.transform(rows)
+        return rows
