@@ -212,7 +212,17 @@ def _fused_bn(env_args, attrs):
     x, scale, offset, mean, var = env_args
     eps = attrs.get("epsilon", 1e-3) or 1e-3
     inv = 1.0 / jnp.sqrt(var + eps)
-    return (x - mean) * inv * scale + offset
+    y = (x - mean) * inv * scale + offset
+    # inference form: batch_mean/batch_var outputs (slots 1/2) are the
+    # frozen moving stats; slots 3+ (reserved spaces) mirror them — lets
+    # graphs that consume the side outputs import
+    return _MultiOut((y, mean, var, mean, var))
+
+
+def _top_k(a, at):
+    k = int(np.asarray(a[1]).reshape(())) if len(a) > 1 else int(at["k"])
+    vals, idx = lax.top_k(a[0], k)
+    return _MultiOut((vals, idx.astype(jnp.int32)))
 
 
 class _MultiOut(tuple):
@@ -350,6 +360,8 @@ _OP_IMPLS = {
                                    at["padding"], lax.max, -jnp.inf),
     "AvgPool": lambda a, at: _avg_pool(a[0], at["ksize"], at["strides"],
                                        at["padding"]),
+    "TopKV2": _top_k,
+    "TopK": _top_k,
     "FusedBatchNorm": _fused_bn,
     "FusedBatchNormV2": _fused_bn,
     "FusedBatchNormV3": _fused_bn,
