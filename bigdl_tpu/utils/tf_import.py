@@ -14,7 +14,7 @@ LeakyRelu, Softplus, Sigmoid, Tanh, Softmax, LogSoftmax, Reshape, Squeeze,
 ExpandDims, ConcatV2, Mean, Sum, Max, Min, Prod, Pad(V2), MirrorPad,
 Transpose, Conv2D, DepthwiseConv2dNative, Conv2DBackpropInput (deconv),
 MaxPool, AvgPool, FusedBatchNorm(+V2/V3), Fill, Pack/Unpack, Split(V),
-Slice, StridedSlice, Tile, Gather(V2), Range, Shape, Rank, Size, Cast,
+Slice, StridedSlice, Tile, Gather(V2), TopK(V2), Range, Shape, Rank, Size, Cast,
 StopGradient, Neg, Exp, Log, Sqrt, Rsqrt, Square, SquaredDifference, Abs,
 Floor, Ceil, Round, Pow, FloorDiv, FloorMod, ArgMax, ArgMin, ZerosLike,
 OnesLike, comparisons (Greater/Less/Equal/...), logical ops, Select(V2),
@@ -210,13 +210,17 @@ def _avg_pool(x, ksize, strides, padding):
 
 def _fused_bn(env_args, attrs):
     x, scale, offset, mean, var = env_args
+    if attrs.get("is_training"):
+        raise NotImplementedError(
+            "FusedBatchNorm with is_training=true: batch statistics are "
+            "data-dependent; freeze the graph for inference first")
     eps = attrs.get("epsilon", 1e-3) or 1e-3
     inv = 1.0 / jnp.sqrt(var + eps)
     y = (x - mean) * inv * scale + offset
     # inference form: batch_mean/batch_var outputs (slots 1/2) are the
-    # frozen moving stats; slots 3+ (reserved spaces) mirror them — lets
-    # graphs that consume the side outputs import
-    return _MultiOut((y, mean, var, mean, var))
+    # frozen moving stats; slots 3-5 (reserved spaces, V3 has three)
+    # mirror them — lets graphs that consume the side outputs import
+    return _MultiOut((y, mean, var, mean, var, var))
 
 
 def _top_k(a, at):

@@ -228,3 +228,31 @@ def test_topk_and_fused_bn_side_outputs():
     want_v, want_i = [np.asarray(t) for t in f(tf.constant(x0))]
     np.testing.assert_allclose(np.asarray(got_v), want_v, rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(got_i), want_i)
+
+
+def test_fused_bn_side_output_slots():
+    """FusedBatchNormV3 side outputs (:1/:2 = frozen moving stats in the
+    inference form) must resolve; is_training graphs are rejected."""
+    from bigdl_tpu.utils.tf_import import _node, _enc_tensor
+
+    n = 4
+    x0 = np.random.RandomState(4).rand(2, 3, 3, n).astype(np.float32)
+    scale = np.random.RandomState(5).rand(n).astype(np.float32) + 0.5
+    offset = np.zeros(n, np.float32)
+    mean = np.random.RandomState(6).rand(n).astype(np.float32)
+    var = np.random.RandomState(7).rand(n).astype(np.float32) + 0.5
+
+    g = b""
+    g += _node("x", "Placeholder", attrs={"dtype": proto.enc_int64(6, 1)})
+    for nm, arr in (("scale", scale), ("offset", offset),
+                    ("mean", mean), ("var", var)):
+        g += _const(nm, arr)
+    g += _node("bn", "FusedBatchNormV3",
+               ["x", "scale", "offset", "mean", "var"],
+               {"epsilon": proto.enc_float(4, 1e-3)})
+    g += _node("use_mean", "AddV2", ["bn:1", "bn:2"])
+    m = load_tf_graph(g, ["x"], ["bn", "use_mean"])
+    y, mv = m.forward(x0)
+    want = (x0 - mean) / np.sqrt(var + 1e-3) * scale + offset
+    np.testing.assert_allclose(np.asarray(y), want, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(mv), mean + var, rtol=1e-6)
