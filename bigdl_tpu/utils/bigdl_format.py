@@ -524,6 +524,39 @@ _FACTORY = {
     "SpatialAveragePooling": _mk_avgpool,
     "SpatialBatchNormalization": _mk_bn,
     "BatchNormalization": _mk_bn1d,
+    "TimeDistributed": lambda a: (
+        (_ for _ in ()).throw(ValueError(
+            ".bigdl TimeDistributed(maskZero=true) is not supported"))
+        if a.get("maskZero") else nn.TimeDistributed(_build(a["layer"]))),
+    "LookupTable": lambda a: nn.LookupTable(
+        int(a["nIndex"]), int(a["nOutput"]),
+        padding_value=float(a.get("paddingValue", 0.0) or 0.0),
+        # reference reflection always writes maxNorm; its default is
+        # Double.MaxValue == "no renorm" — map to None or every forward
+        # pays a useless per-row norm
+        max_norm=(None if a.get("maxNorm") is None
+                  or float(a["maxNorm"]) >= 1e300 else
+                  float(a["maxNorm"])),
+        norm_type=float(a.get("normType") or 2.0),
+        mask_zero=bool(a.get("maskZero", False))),
+    "SpatialDilatedConvolution": lambda a: nn.SpatialDilatedConvolution(
+        int(a["nInputPlane"]), int(a["nOutputPlane"]),
+        int(a["kW"]), int(a["kH"]),
+        int(a.get("dW", 1)), int(a.get("dH", 1)),
+        int(a.get("padW", 0)), int(a.get("padH", 0)),
+        int(a.get("dilationW", 1)), int(a.get("dilationH", 1))),
+    "TemporalConvolution": lambda a: nn.TemporalConvolution(
+        int(a["inputFrameSize"]), int(a["outputFrameSize"]),
+        int(a["kernelW"]), int(a.get("strideW", 1))),
+    "SpatialZeroPadding": lambda a: nn.SpatialZeroPadding(
+        int(a.get("padLeft", 0)), int(a.get("padRight", 0)),
+        int(a.get("padTop", 0)), int(a.get("padBottom", 0))),
+    "Padding": lambda a: (
+        (_ for _ in ()).throw(ValueError(
+            ".bigdl Padding with nIndex != 1 is not supported"))
+        if int(a.get("nIndex", 1) or 1) != 1 else nn.Padding(
+            int(a["dim"]), int(a["pad"]), int(a.get("nInputDim", 0)),
+            float(a.get("value", 0.0) or 0.0))),
     "SpatialCrossMapLRN": lambda a: nn.SpatialCrossMapLRN(
         int(a.get("size", 5)), float(a.get("alpha", 1.0)),
         float(a.get("beta", 0.75)), float(a.get("k", 1.0))),
@@ -650,6 +683,23 @@ def _build_graph(tree):
     return g
 
 
+def _fix_temporal_conv(mod, arrs):
+    """Reference TemporalConvolution weight is (out, in*kW) with column
+    k*inputFrameSize + i (TemporalConvolution.scala:63 unfold layout);
+    ours is (out, in, kW)."""
+    out = []
+    for a in arrs:
+        a = np.asarray(a, np.float32)
+        if a.ndim == 2:         # the weight; bias passes through
+            a = a.reshape(mod.output_frame_size, mod.kernel_w,
+                          mod.input_frame_size).transpose(0, 2, 1)
+        out.append(a)
+    return out
+
+
+_WEIGHT_FIX = {"TemporalConvolution": _fix_temporal_conv}
+
+
 def _build(tree):
     t = _short_type(tree["type"])
     if t in _GRAPHS:
@@ -694,13 +744,15 @@ def load_bigdl(path: str):
     params, state = model.init_params(0)
     # assign by MODULE NAME (params are keyed by it, and _build preserved
     # every serialized name) — robust to container vs graph traversal order
-    for sub in _leaf_modules(tree):
+    _by_name = {m.name: m for m in model.modules()}
+
+    def assign_leaf(sub):
         st = _short_type(sub["type"])
         if st == "Recurrent":
             # cell weights come from the topology attr's Linear layout,
             # not the Recurrent's own flat parameter list
             _assign_cell_weights(params, sub["attr"]["topology"])
-            continue
+            return
         if st == "BiRecurrent":
             fwd_t, rev_t = _birnn_recurrents(sub["attr"]["birnn"])
             _assign_cell_weights(params, fwd_t["attr"]["topology"])
@@ -711,14 +763,21 @@ def load_bigdl(path: str):
             fwd_name = fwd_t["attr"]["topology"]["name"]
             _assign_cell_weights(params, rev_t["attr"]["topology"],
                                  target=f"{fwd_name}_bwd")
-            continue
+            return
         if st in _CELL_TYPES:
             _assign_cell_weights(params, sub)
-            continue
+            return
+        if st == "TimeDistributed":
+            # the weights belong to the wrapped layer (the "layer"
+            # module attr); the TimeDistributed node's own flat list
+            # mirrors them
+            for inner in _leaf_modules(sub["attr"]["layer"]):
+                assign_leaf(inner)
+            return
         arrs = sub["params"] if sub["has_params"] else \
             [t for t in (sub["weight"], sub["bias"]) if t is not None]
         if not arrs:
-            continue
+            return
         name = sub["name"]
         if name not in params:
             raise ValueError(
@@ -730,13 +789,29 @@ def load_bigdl(path: str):
             raise ValueError(
                 f"{name}: {len(arrs)} serialized parameters, module "
                 f"has {len(keys)}")
+        built = _by_name.get(name)
+        fix = _WEIGHT_FIX.get(type(built).__name__) \
+            if built is not None else None
+        if fix is not None:
+            arrs = fix(built, arrs)
         for k, arr in zip(keys, arrs):
             want = np.shape(own[k])
             own[k] = np.asarray(arr, np.float32).reshape(want)
         params[name] = own
-    # BN running statistics: tensor attrs on the BN module
-    # (nn/BatchNormalization.scala:323 doLoadModule)
+
     for sub in _leaf_modules(tree):
+        assign_leaf(sub)
+    # BN running statistics: tensor attrs on the BN module
+    # (nn/BatchNormalization.scala:323 doLoadModule); descend through
+    # TimeDistributed wrappers — their BN rides the 'layer' attr
+    def _bn_trees(subtree):
+        for leaf in _leaf_modules(subtree):
+            if _short_type(leaf["type"]) == "TimeDistributed":
+                yield from _bn_trees(leaf["attr"]["layer"])
+            else:
+                yield leaf
+
+    for sub in _bn_trees(tree):
         if _short_type(sub["type"]) not in (
                 "SpatialBatchNormalization", "BatchNormalization"):
             continue
@@ -937,9 +1012,17 @@ def _module_attrs(mod) -> Dict[str, bytes]:
     return {}
 
 
+# read-only types: the writer has no ctor-attr emission (and, for
+# TemporalConvolution, no inverse weight reorder; for TimeDistributed,
+# no 'layer'-attr form) — keep save_bigdl's clean unsupported error
+_READ_ONLY = {"TimeDistributed", "LookupTable", "TemporalConvolution",
+              "SpatialDilatedConvolution", "SpatialZeroPadding",
+              "Padding"}
+
 _TYPE_NAMES = {}
 for _short, _fac in _FACTORY.items():
-    _TYPE_NAMES[_short] = _NS + _short
+    if _short not in _READ_ONLY:
+        _TYPE_NAMES[_short] = _NS + _short
 
 
 def _enc_graph(mod, params, state, counter, global_entries) -> bytes:

@@ -747,3 +747,169 @@ def test_birecurrent_lstm_read():
     yf = run_lstm(x, wpf, bpf, whf)
     yb = run_lstm(x[:, ::-1], wpb, bpb, whb)[:, ::-1]
     np.testing.assert_allclose(got, yf + yb, rtol=1e-4, atol=1e-5)
+
+
+def test_lookup_table_and_time_distributed_read():
+    """NLP-shaped fixture: TimeDistributed(Linear) after LookupTable —
+    the wrapped layer's weights ride the 'layer' module attr
+    (TimeDistributed.scala ctor reflection)."""
+    rng = np.random.RandomState(31)
+    n_index, n_out, d = 7, 5, 4
+    emb = rng.randn(n_index, d).astype(np.float32)
+    w = rng.randn(n_out, d).astype(np.float32)
+    b = rng.randn(n_out).astype(np.float32)
+
+    lut = enc_string(1, "emb")
+    lut += enc_string(7, "com.intel.analytics.bigdl.nn.LookupTable")
+    lut += _mod_attr_entry("nIndex", _attr_i(n_index))
+    lut += _mod_attr_entry("nOutput", _attr_i(d))
+    lut += enc_int64(15, 1)
+    lut += enc_bytes(16, _mod_tensor(emb))
+
+    td = enc_string(1, "td")
+    td += enc_string(7, "com.intel.analytics.bigdl.nn.TimeDistributed")
+    td += _mod_attr_entry("layer", _attr_mod(_linear_module("fc", w, b)))
+    td += enc_int64(15, 1)
+    td += enc_bytes(16, _mod_tensor(w))
+    td += enc_bytes(16, _mod_tensor(b))
+
+    seq = enc_string(1, "net")
+    seq += enc_string(7, "com.intel.analytics.bigdl.nn.Sequential")
+    seq += enc_bytes(2, lut) + enc_bytes(2, td)
+
+    with tempfile.TemporaryDirectory() as d2:
+        p = os.path.join(d2, "nlp.bigdl")
+        with open(p, "wb") as f:
+            f.write(seq)
+        m = load_bigdl(p)
+
+    ids = np.array([[1, 3, 7], [2, 5, 1]], np.float32)   # 1-based
+    got = np.asarray(m.forward(ids))
+    want = emb[ids.astype(int) - 1] @ w.T + b
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_temporal_convolution_read_layout():
+    """Reference TemporalConvolution weight is (out, in*kW) with column
+    order k*inputFrameSize + i (unfold layout); our fused layout is
+    (out, in, kW) — the loader must reorder, not just reshape."""
+    rng = np.random.RandomState(32)
+    fin, fout, kw = 3, 2, 2
+    w_ref = rng.randn(fout, fin * kw).astype(np.float32)
+    b = rng.randn(fout).astype(np.float32)
+
+    tc = enc_string(1, "tc")
+    tc += enc_string(7, "com.intel.analytics.bigdl.nn.TemporalConvolution")
+    tc += _mod_attr_entry("inputFrameSize", _attr_i(fin))
+    tc += _mod_attr_entry("outputFrameSize", _attr_i(fout))
+    tc += _mod_attr_entry("kernelW", _attr_i(kw))
+    tc += _mod_attr_entry("strideW", _attr_i(1))
+    tc += enc_int64(15, 1)
+    tc += enc_bytes(16, _mod_tensor(w_ref))
+    tc += enc_bytes(16, _mod_tensor(b))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "tc.bigdl")
+        with open(p, "wb") as f:
+            f.write(tc)
+        m = load_bigdl(p)
+
+    B, T = 2, 5
+    x = rng.randn(B, T, fin).astype(np.float32)
+    got = np.asarray(m.forward(x))
+    # reference math: out[t] = sum_k x[t+k] @ W[:, k*fin:(k+1)*fin].T + b
+    want = np.zeros((B, T - kw + 1, fout), np.float32)
+    for t in range(T - kw + 1):
+        acc = b.copy()[None].repeat(B, 0)
+        for k in range(kw):
+            acc = acc + x[:, t + k] @ w_ref[:, k*fin:(k+1)*fin].T
+        want[:, t] = acc
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_dilated_conv_and_padding_read():
+    rng = np.random.RandomState(33)
+    w = rng.randn(3, 2, 3, 3).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+
+    dc = enc_string(1, "dc")
+    dc += enc_string(7,
+                     "com.intel.analytics.bigdl.nn.SpatialDilatedConvolution")
+    for k, v in (("nInputPlane", 2), ("nOutputPlane", 3), ("kW", 3),
+                 ("kH", 3), ("dW", 1), ("dH", 1), ("padW", 2), ("padH", 2),
+                 ("dilationW", 2), ("dilationH", 2)):
+        dc += _mod_attr_entry(k, _attr_i(v))
+    dc += enc_int64(15, 1)
+    dc += enc_bytes(16, _mod_tensor(w)) + enc_bytes(16, _mod_tensor(b))
+
+    zp = enc_string(1, "zp")
+    zp += enc_string(7, "com.intel.analytics.bigdl.nn.SpatialZeroPadding")
+    for k in ("padLeft", "padRight", "padTop", "padBottom"):
+        zp += _mod_attr_entry(k, _attr_i(1))
+
+    seq = enc_string(1, "net")
+    seq += enc_string(7, "com.intel.analytics.bigdl.nn.Sequential")
+    seq += enc_bytes(2, zp) + enc_bytes(2, dc)
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "dil.bigdl")
+        with open(p, "wb") as f:
+            f.write(seq)
+        m = load_bigdl(p)
+    x = rng.randn(2, 2, 6, 6).astype(np.float32)
+    got = np.asarray(m.forward(x))
+    assert got.shape == (2, 3, 8, 8)
+    kinds = [type(c).__name__ for c in m.modules()]
+    assert "SpatialDilatedConvolution" in kinds
+    assert "SpatialZeroPadding" in kinds
+
+
+def test_read_only_types_rejected_by_writer():
+    """The new read-only mappings must NOT enroll in the writer — it has
+    no attr emission / inverse weight layout for them (review r4)."""
+    m = nn.Sequential(nn.LookupTable(5, 4))
+    m.reset(0)
+    with tempfile.TemporaryDirectory() as d:
+        with pytest.raises(ValueError, match="unsupported layer"):
+            save_bigdl(m, os.path.join(d, "x.bigdl"))
+
+
+def test_time_distributed_bn_running_stats():
+    """BN wrapped in TimeDistributed: running stats ride the wrapped
+    module inside the 'layer' attr and must still load (review r4)."""
+    n = 3
+    rmean = np.array([0.2, -0.4, 1.0], np.float32)
+    rvar = np.array([1.5, 0.5, 2.0], np.float32)
+
+    def tensor(arr):
+        body = enc_int64(1, 2)
+        for d in arr.shape:
+            body += enc_int64(2, d)
+        st = enc_int64(1, 2) + enc_bytes(2, arr.astype("<f4").tobytes())
+        return body + enc_bytes(8, st)
+
+    attr_tensor = lambda a: enc_int64(1, 10) + enc_bytes(10, tensor(a))
+
+    bn = enc_string(1, "bn")
+    bn += enc_string(7, "com.intel.analytics.bigdl.nn.BatchNormalization")
+    bn += _mod_attr_entry("nOutput", _attr_i(n))
+    bn += enc_int64(15, 1)
+    bn += enc_bytes(16, tensor(np.ones(n, np.float32)))
+    bn += enc_bytes(16, tensor(np.zeros(n, np.float32)))
+    bn += _mod_attr_entry("runningMean", attr_tensor(rmean))
+    bn += _mod_attr_entry("runningVar", attr_tensor(rvar))
+
+    td = enc_string(1, "td")
+    td += enc_string(7, "com.intel.analytics.bigdl.nn.TimeDistributed")
+    td += _mod_attr_entry("layer", _attr_mod(bn))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "tdbn.bigdl")
+        with open(p, "wb") as f:
+            f.write(td)
+        m = load_bigdl(p)
+    m.evaluate()
+    x = np.random.RandomState(9).rand(2, 4, n).astype(np.float32)
+    got = np.asarray(m.forward(x))
+    want = (x - rmean) / np.sqrt(rvar + 1e-5)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
