@@ -697,7 +697,20 @@ def _fix_temporal_conv(mod, arrs):
     return out
 
 
+def _unfix_temporal_conv(mod, arrs):
+    """Inverse of :func:`_fix_temporal_conv` for the writer: our
+    (out, in, kW) -> reference (out, in*kW) with column k*fin + i."""
+    out = []
+    for a in arrs:
+        a = np.asarray(a, np.float32)
+        if a.ndim == 3:
+            a = a.transpose(0, 2, 1).reshape(a.shape[0], -1)
+        out.append(a)
+    return out
+
+
 _WEIGHT_FIX = {"TemporalConvolution": _fix_temporal_conv}
+_WEIGHT_UNFIX = {"TemporalConvolution": _unfix_temporal_conv}
 
 
 def _build(tree):
@@ -933,6 +946,47 @@ def _module_attrs(mod) -> Dict[str, bytes]:
                 "eps": _attr_double(mod.eps),
                 "momentum": _attr_double(mod.momentum),
                 "affine": _attr_bool(mod.affine)}
+    if isinstance(mod, nn.LookupTable):
+        return {"nIndex": _attr_int(mod.n_index),
+                "nOutput": _attr_int(mod.n_output),
+                "paddingValue": _attr_double(mod.padding_value or 0.0),
+                # reference "no renorm" sentinel is Double.MaxValue
+                "maxNorm": _attr_double(
+                    1.7976931348623157e308 if mod.max_norm is None
+                    else float(mod.max_norm)),
+                "normType": _attr_double(float(mod.norm_type or 2.0)),
+                "shouldScaleGradByFreq": _attr_bool(False),
+                "maskZero": _attr_bool(bool(getattr(mod, "mask_zero",
+                                                    False)))}
+    if isinstance(mod, nn.SpatialDilatedConvolution):
+        kh, kw = mod.kernel
+        sh, sw = mod.stride
+        ph, pw = mod.pad
+        dh, dw = mod.dilation
+        return {"nInputPlane": _attr_int(mod.n_input_plane),
+                "nOutputPlane": _attr_int(mod.n_output_plane),
+                "kW": _attr_int(kw), "kH": _attr_int(kh),
+                "dW": _attr_int(sw), "dH": _attr_int(sh),
+                "padW": _attr_int(pw), "padH": _attr_int(ph),
+                "dilationW": _attr_int(dw), "dilationH": _attr_int(dh)}
+    if isinstance(mod, nn.TemporalConvolution):
+        return {"inputFrameSize": _attr_int(mod.input_frame_size),
+                "outputFrameSize": _attr_int(mod.output_frame_size),
+                "kernelW": _attr_int(mod.kernel_w),
+                "strideW": _attr_int(mod.stride_w)}
+    if isinstance(mod, nn.SpatialZeroPadding):
+        if getattr(mod, "format", "NCHW") != "NCHW":
+            raise ValueError(
+                "save_bigdl: SpatialZeroPadding(format='NHWC') has no "
+                "reference wire form")
+        pl, pr, pt, pb = mod.pads
+        return {"padLeft": _attr_int(pl), "padRight": _attr_int(pr),
+                "padTop": _attr_int(pt), "padBottom": _attr_int(pb)}
+    if isinstance(mod, nn.Padding):
+        return {"dim": _attr_int(mod.dim), "pad": _attr_int(mod.pad),
+                "nInputDim": _attr_int(mod.n_input_dim),
+                "value": _attr_double(mod.value),
+                "nIndex": _attr_int(1)}
     if isinstance(mod, nn.Dropout):
         return {"initP": _attr_double(mod.p)}
     if isinstance(mod, nn.Reshape):
@@ -1012,17 +1066,9 @@ def _module_attrs(mod) -> Dict[str, bytes]:
     return {}
 
 
-# read-only types: the writer has no ctor-attr emission (and, for
-# TemporalConvolution, no inverse weight reorder; for TimeDistributed,
-# no 'layer'-attr form) — keep save_bigdl's clean unsupported error
-_READ_ONLY = {"TimeDistributed", "LookupTable", "TemporalConvolution",
-              "SpatialDilatedConvolution", "SpatialZeroPadding",
-              "Padding"}
-
 _TYPE_NAMES = {}
 for _short, _fac in _FACTORY.items():
-    if _short not in _READ_ONLY:
-        _TYPE_NAMES[_short] = _NS + _short
+    _TYPE_NAMES[_short] = _NS + _short
 
 
 def _enc_graph(mod, params, state, counter, global_entries) -> bytes:
@@ -1102,6 +1148,23 @@ def _enc_module(mod, params, state, counter, global_entries) -> bytes:
         raise ValueError(f"save_bigdl: unsupported layer {cls}")
     body = enc_string(1, mod.name)
     body += enc_string(7, _TYPE_NAMES[cls])
+    if isinstance(mod, nn.TimeDistributed):
+        # reference form: the wrapped module rides the 'layer' attr
+        # (ctor reflection), NOT subModules; the TD node's flat params
+        # mirror the layer's (TimeDistributed.parameters)
+        inner = params.get(mod.layer.name, {})
+        keys = nn.Module._weights_order(inner)
+        if keys:
+            body += enc_int64(15, 1)
+            for k in keys:
+                body += enc_bytes(16, _alloc_tensor(inner[k], counter,
+                                                    global_entries))
+        layer_bytes = _enc_module(mod.layer, params, state, counter,
+                                  global_entries)
+        body += _attr_entry("layer", enc_int64(1, 12)
+                            + enc_bytes(13, layer_bytes))
+        body += _attr_entry("maskZero", _attr_bool(False))
+        return body
     if mod.children():
         for sub in mod.children():
             body += enc_bytes(2, _enc_module(sub, params, state, counter,
@@ -1111,10 +1174,14 @@ def _enc_module(mod, params, state, counter, global_entries) -> bytes:
         keys = nn.Module._weights_order(own)
         if keys:
             body += enc_int64(15, 1)   # hasParameters
-            for k in keys:
+            arrs = [own[k] for k in keys]
+            unfix = _WEIGHT_UNFIX.get(cls)
+            if unfix is not None:
+                arrs = unfix(mod, arrs)
+            for arr in arrs:
                 # data lives once in global_storage; the parameter slot
                 # references the storage id (ModuleLoader.scala:119)
-                body += enc_bytes(16, _alloc_tensor(own[k], counter,
+                body += enc_bytes(16, _alloc_tensor(arr, counter,
                                                     global_entries))
     for k, v in _module_attrs(mod).items():
         body += _attr_entry(k, v)

@@ -864,14 +864,31 @@ def test_dilated_conv_and_padding_read():
     assert "SpatialZeroPadding" in kinds
 
 
-def test_read_only_types_rejected_by_writer():
-    """The new read-only mappings must NOT enroll in the writer — it has
-    no attr emission / inverse weight layout for them (review r4)."""
-    m = nn.Sequential(nn.LookupTable(5, 4))
-    m.reset(0)
-    with tempfile.TemporaryDirectory() as d:
-        with pytest.raises(ValueError, match="unsupported layer"):
-            save_bigdl(m, os.path.join(d, "x.bigdl"))
+def test_new_types_roundtrip():
+    """Full round-trip for the round-4 reader additions: writer emits
+    ctor attrs + reference weight layouts (temporal conv columns are
+    re-unfolded), reader restores them exactly."""
+    m = nn.Sequential(nn.LookupTable(9, 6),
+                      nn.TemporalConvolution(6, 5, 2),
+                      nn.TimeDistributed(nn.Linear(5, 4)),
+                      nn.Select(2, -1))
+    m.reset(3)
+    ids = (np.random.RandomState(1).randint(0, 9, (3, 7)) + 1) \
+        .astype(np.float32)
+    m2 = _roundtrip(m, ids)
+    kinds = [type(c).__name__ for c in m2.modules()]
+    for k in ("LookupTable", "TemporalConvolution", "TimeDistributed"):
+        assert k in kinds, kinds
+
+
+def test_padding_types_roundtrip():
+    m = nn.Sequential(
+        nn.SpatialZeroPadding(1, 2, 1, 0),
+        nn.SpatialDilatedConvolution(2, 3, 3, 3, 1, 1, 1, 1, 2, 2),
+        nn.Padding(1, 2, 3))
+    m.reset(4)
+    x = np.random.RandomState(2).rand(2, 2, 6, 6).astype(np.float32)
+    _roundtrip(m, x)
 
 
 def test_time_distributed_bn_running_stats():
