@@ -142,10 +142,12 @@ def tf_graphdef(tmp="/tmp/loadmodel_demo"):
           f"({os.path.getsize(path) // 1024} KiB)")
 
 
-def bn_stats_and_recurrent(tmp="/tmp/loadmodel_demo"):
-    """Round-4 fidelity additions: BatchNorm running statistics survive
-    the reference wire format (eval-mode parity), and a reference-layout
-    Recurrent(LSTM) file rebuilds our fused lax.scan cell."""
+def bn_stats_and_while_loop(tmp="/tmp/loadmodel_demo"):
+    """Fidelity additions: BatchNorm running statistics survive the
+    reference wire format (eval-mode parity), and TF v1 while-loop
+    frames import as ONE lax.while_loop. (Reference-layout
+    Recurrent(LSTM)/GRU/BiRecurrent files load too — see
+    tests/test_bigdl_format.py for wire-level fixtures.)"""
     import os
     from bigdl_tpu.utils.bigdl_format import load_bigdl, save_bigdl
 
@@ -203,7 +205,7 @@ def main():
     torch_t7()
     native_format(model)
     reference_bigdl_format()
-    bn_stats_and_recurrent()
+    bn_stats_and_while_loop()
     tf_graphdef()
 
 
