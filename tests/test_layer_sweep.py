@@ -252,6 +252,7 @@ SPECS = {
     "Bottle": (lambda: nn.Bottle(nn.Linear(5, 4), 2), lambda: R(3, 7, 5)),
     "Identity": (lambda: nn.Identity(), lambda: R(3, 5)),
     "Echo": (lambda: nn.Echo(), lambda: R(3, 5), "f"),
+    "Remat": (lambda: nn.Remat(nn.Linear(5, 4)), lambda: R(3, 5)),
     # recurrent -------------------------------------------------------- #
     "Recurrent": (lambda: nn.Recurrent(nn.RnnCell(4, 5)),
                   lambda: R(2, 6, 4)),
