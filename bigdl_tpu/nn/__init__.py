@@ -92,3 +92,4 @@ from .module import Module as Layer
 from .graph import Graph as Model
 
 from .fusion import fold_batchnorm  # noqa: F401,E402
+from .control_flow import WhileLoop, Cond  # noqa: F401,E402

@@ -1,0 +1,148 @@
+"""Data-dependent control flow as modules.
+
+The reference builds these as graph-node clusters interpreted at
+runtime: ``ControlNodes.whileLoop`` wires Enter/Merge/LoopCondition/
+Switch/NextIteration/Exit nodes (nn/tf/ControlOps.scala:296) which
+``FrameManager`` (nn/FrameManager.scala:31) schedules inside a
+``DynamicGraph``; ``ControlNodes.switch``/``merge`` (:245, :261) give
+data-dependent branching.  The TPU-native equivalents compile the whole
+construct into the XLA program instead:
+
+  * :class:`WhileLoop` — ``lax.while_loop`` over a Table of loop vars
+  * :class:`Cond`      — ``lax.cond`` over two branches
+
+(The same lowering the TF importer applies to frame clusters found in
+imported GraphDefs — utils/tf_import.py ``_rewrite_while_frames``.)
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.table import Table, as_list
+from .module import Ctx, Module
+
+__all__ = ["WhileLoop", "Cond"]
+
+
+def _as_tuple(x):
+    return tuple(as_list(x)) if isinstance(x, Table) else (x,)
+
+
+def _pack(vals, like):
+    return Table(*vals) if isinstance(like, Table) or len(vals) > 1 \
+        else vals[0]
+
+
+class WhileLoop(Module):
+    """``while cond(state): state = body(state)`` compiled to ONE
+    ``lax.while_loop`` (≙ ControlNodes.whileLoop + the FrameManager
+    runtime).  ``cond`` maps the loop-state (Table or tensor) to a
+    boolean scalar; ``body`` maps state to the next state with the same
+    shapes/dtypes.  The input activation is the initial state; the
+    output is the final state.
+
+    XLA's while is not reverse-differentiable — use inside inference /
+    non-gradient paths, or under ``lax.stop_gradient`` semantics (the
+    reference's dynamic graphs were likewise inference-oriented).
+    ``cond``/``body`` must be stateless (no BN running stats inside).
+    """
+
+    def __init__(self, cond, body, name=None):
+        super().__init__(name=name)
+        self.cond = cond
+        self.body = body
+
+    def children(self):
+        return [self.cond, self.body]
+
+    def _serde_restore_children(self, children):
+        self.cond, self.body = children
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        p = {}
+        p.update(self.cond.init(k1))
+        p.update(self.body.init(k2))
+        return p
+
+    def initial_state(self):
+        st = {}
+        st.update(self.cond.initial_state())
+        st.update(self.body.initial_state())
+        return st
+
+    def apply(self, params, x, ctx):
+        init = tuple(jnp.asarray(v) for v in _as_tuple(x))
+
+        def sub_ctx():
+            return Ctx(state=ctx.state, training=ctx.training,
+                       rng_key=ctx.rng_key)
+
+        def c(state):
+            out = self.cond.apply(params, _pack(state, x), sub_ctx())
+            return jnp.reshape(out, ())
+
+        def b(state):
+            out = self.body.apply(params, _pack(state, x), sub_ctx())
+            return tuple(jnp.asarray(v) for v in _as_tuple(out))
+
+        final = lax.while_loop(c, b, init)
+        return _pack(final, x)
+
+
+class Cond(Module):
+    """``pred(x) ? true_branch(x) : false_branch(x)`` compiled to
+    ``lax.cond`` — only the taken branch executes (≙ the reference's
+    ControlNodes.switch/merge pair, SwitchOps/MergeOps in
+    nn/tf/ControlOps.scala).  Differentiable; both branches must return
+    matching shapes/dtypes.
+
+    The branches run inside the ``lax.cond`` trace, so training-mode
+    state writes (BN running stats) and side losses raised INSIDE a
+    branch do not propagate out — the two branches' state trees would
+    have to match structurally for a merged carry.  Branch children may
+    still READ persistent state (eval-mode BN works); keep stat-updating
+    training layers outside the branches.  ``pred`` runs outside the
+    cond with the real ctx."""
+
+    def __init__(self, pred, true_branch, false_branch, name=None):
+        super().__init__(name=name)
+        self.pred = pred
+        self.true_branch = true_branch
+        self.false_branch = false_branch
+
+    def children(self):
+        return [self.pred, self.true_branch, self.false_branch]
+
+    def _serde_restore_children(self, children):
+        self.pred, self.true_branch, self.false_branch = children
+
+    def init(self, rng):
+        k1, k2, k3 = jax.random.split(rng, 3)
+        p = {}
+        p.update(self.pred.init(k1))
+        p.update(self.true_branch.init(k2))
+        p.update(self.false_branch.init(k3))
+        return p
+
+    def initial_state(self):
+        st = {}
+        for m in (self.pred, self.true_branch, self.false_branch):
+            st.update(m.initial_state())
+        return st
+
+    def apply(self, params, x, ctx):
+        def sub_ctx():
+            return Ctx(state=ctx.state, training=ctx.training,
+                       rng_key=ctx.rng_key)
+
+        # pred runs OUTSIDE lax.cond: its state writes / side losses
+        # propagate through the real ctx
+        p = jnp.reshape(self.pred.apply(params, x, ctx), ())
+        return lax.cond(
+            p,
+            lambda v: self.true_branch.apply(params, v, sub_ctx()),
+            lambda v: self.false_branch.apply(params, v, sub_ctx()),
+            x)

@@ -52,3 +52,20 @@ def check_gradients(module, x, seed=0, eps=1e-3, rtol=2e-2, atol=1e-3,
         an = float(np.asarray(gleaf)[idx])
         assert abs(fd - an) <= atol + rtol * max(abs(fd), abs(an)), \
             f"param grad mismatch leaf {li} at {idx}: fd={fd} vs ad={an}"
+
+
+class FnModule:
+    """Shared fn->Module wrapper for control-flow tests; defined lazily
+    to avoid importing nn at gradient_checker import time."""
+
+    def __new__(cls, fn, name=None):
+        from bigdl_tpu import nn
+
+        class _Wrapped(nn.Module):
+            def __init__(self):
+                super().__init__(name=name)
+
+            def apply(self, params, x, ctx):
+                return fn(x)
+
+        return _Wrapped()

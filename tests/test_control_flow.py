@@ -1,0 +1,72 @@
+"""nn.WhileLoop / nn.Cond — data-dependent control flow as modules
+(≙ nn/tf/ControlOps.scala ControlNodes.whileLoop/switch/merge +
+FrameManager's DynamicGraph runtime, compiled to lax.while_loop /
+lax.cond)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import Ctx
+from bigdl_tpu.utils.table import T
+from gradient_checker import FnModule
+
+
+def test_while_loop_newton_sqrt():
+    """Table-state loop: Newton iteration until |x^2 - target| small."""
+    step = FnModule(lambda t: T(0.5 * (t[1] + t[2] / t[1]), t[2]))
+    not_done = FnModule(lambda t: jnp.abs(t[1] * t[1] - t[2]) > 1e-5)
+    wl = nn.WhileLoop(not_done, step)
+    out = wl.forward(T(np.float32(1.0), np.float32(9.0)))
+    assert abs(float(out[1]) - 3.0) < 1e-3
+
+
+def test_while_loop_under_jit():
+    wl = nn.WhileLoop(FnModule(lambda x: jnp.sum(x * x) < 100.0),
+                      FnModule(lambda x: x * 2.0))
+    params, state = wl.init_params(0)
+    f = jax.jit(lambda p, a: wl.apply(p, a, Ctx(state=state)))
+    y = np.asarray(f(params, np.ones((4,), np.float32)))
+    assert float((y ** 2).sum()) >= 100.0
+    assert y[0] == 8.0          # 1 -> 2 -> 4 -> 8 (4*64 >= 100)
+
+
+def test_while_loop_with_parameterized_body():
+    """Body with weights: iterate h = tanh(W h) a data-dependent number
+    of times (norm decay threshold)."""
+    body = nn.Sequential(nn.Linear(4, 4, with_bias=False), nn.Tanh())
+    wl = nn.WhileLoop(FnModule(lambda h: jnp.sum(h * h) > 0.5), body)
+    params, state = wl.init_params(2)
+    x = jnp.asarray(np.random.RandomState(0).rand(1, 4).astype(np.float32)
+                    + 1.0)
+    y = wl.apply(params, x, Ctx(state=state))
+    assert float(jnp.sum(y * y)) <= 0.5
+
+
+def test_cond_branches_and_gradient():
+    pred = FnModule(lambda x: jnp.sum(x) > 0)
+    m = nn.Cond(pred, nn.Linear(4, 3, name="cf_tb"),
+                nn.Linear(4, 3, name="cf_fb"))
+    params, st = m.init_params(1)
+
+    for sign, taken, untaken in ((1.0, "cf_tb", "cf_fb"),
+                                 (-1.0, "cf_fb", "cf_tb")):
+        x = jnp.asarray(np.full((2, 4), sign, np.float32))
+        g = jax.grad(lambda p: jnp.sum(
+            m.apply(p, x, Ctx(state=st)) ** 2))(params)
+        assert np.abs(np.asarray(g[taken]["weight"])).sum() > 0
+        assert np.abs(np.asarray(g[untaken]["weight"])).sum() == 0
+
+
+def test_cond_in_sequential():
+    """Composes with ordinary layers inside a Sequential."""
+    pred = FnModule(lambda x: jnp.mean(x) > 0.0)
+    m = nn.Sequential(
+        nn.Linear(5, 4),
+        nn.Cond(pred, FnModule(lambda x: x * 2.0), FnModule(lambda x: -x)),
+        nn.ReLU())
+    m.reset(3)
+    x = np.random.RandomState(1).randn(3, 5).astype(np.float32)
+    y = np.asarray(m.forward(x))
+    assert y.shape == (3, 4) and np.all(y >= 0)

@@ -20,6 +20,9 @@ from bigdl_tpu.utils.table import Table
 from gradient_checker import check_gradients
 
 
+from gradient_checker import FnModule
+
+
 def R(*shape, seed=0, scale=1.0, positive=False):
     rng = np.random.RandomState(hash(shape) % 2**31 + seed)
     a = rng.randn(*shape).astype(np.float32) * scale
@@ -253,6 +256,14 @@ SPECS = {
     "Identity": (lambda: nn.Identity(), lambda: R(3, 5)),
     "Echo": (lambda: nn.Echo(), lambda: R(3, 5), "f"),
     "Remat": (lambda: nn.Remat(nn.Linear(5, 4)), lambda: R(3, 5)),
+    # lax.while_loop is not reverse-differentiable -> forward-only
+    "WhileLoop": (lambda: nn.WhileLoop(
+        FnModule(lambda x: (x * x).sum() < 100.0),
+        FnModule(lambda x: x * 2.0)), lambda: R(3, 5), "f"),
+    "Cond": (lambda: nn.Cond(
+        FnModule(lambda x: x.sum() > 0),
+        FnModule(lambda x: x * 2.0),
+        FnModule(lambda x: -x)), lambda: R(3, 5)),
     # recurrent -------------------------------------------------------- #
     "Recurrent": (lambda: nn.Recurrent(nn.RnnCell(4, 5)),
                   lambda: R(2, 6, 4)),
