@@ -539,6 +539,14 @@ _FACTORY = {
                   float(a["maxNorm"])),
         norm_type=float(a.get("normType") or 2.0),
         mask_zero=bool(a.get("maskZero", False))),
+    "SpatialFullConvolution": lambda a: nn.SpatialFullConvolution(
+        int(a["nInputPlane"]), int(a["nOutputPlane"]),
+        int(a["kW"]), int(a["kH"]),
+        int(a.get("dW", 1)), int(a.get("dH", 1)),
+        int(a.get("padW", 0)), int(a.get("padH", 0)),
+        int(a.get("adjW", 0)), int(a.get("adjH", 0)),
+        n_group=int(a.get("nGroup", 1)),
+        no_bias=bool(a.get("noBias", False))),
     "SpatialDilatedConvolution": lambda a: nn.SpatialDilatedConvolution(
         int(a["nInputPlane"]), int(a["nOutputPlane"]),
         int(a["kW"]), int(a["kH"]),
@@ -958,6 +966,23 @@ def _module_attrs(mod) -> Dict[str, bytes]:
                 "shouldScaleGradByFreq": _attr_bool(False),
                 "maskZero": _attr_bool(bool(getattr(mod, "mask_zero",
                                                     False)))}
+    if isinstance(mod, nn.SpatialFullConvolution):
+        if getattr(mod, "format", "NCHW") != "NCHW":
+            raise ValueError(
+                "save_bigdl: SpatialFullConvolution(format='NHWC') has "
+                "no reference wire form")
+        kh, kw = mod.kernel
+        sh, sw = mod.stride
+        ph, pw = mod.pad
+        ah, aw = mod.adj
+        return {"nInputPlane": _attr_int(mod.n_input_plane),
+                "nOutputPlane": _attr_int(mod.n_output_plane),
+                "kW": _attr_int(kw), "kH": _attr_int(kh),
+                "dW": _attr_int(sw), "dH": _attr_int(sh),
+                "padW": _attr_int(pw), "padH": _attr_int(ph),
+                "adjW": _attr_int(aw), "adjH": _attr_int(ah),
+                "nGroup": _attr_int(mod.n_group),
+                "noBias": _attr_bool(not mod.with_bias)}
     if isinstance(mod, nn.SpatialDilatedConvolution):
         kh, kw = mod.kernel
         sh, sw = mod.stride

@@ -163,11 +163,25 @@ def test_unsupported_type_raises():
 
 
 def test_save_unsupported_layer_raises():
-    m = nn.Sequential(nn.Linear(2, 2), nn.SpatialFullConvolution(2, 2, 3, 3))
+    m = nn.Sequential(nn.Linear(2, 2), nn.RMSNorm(2))
     m.reset(0)
     with tempfile.TemporaryDirectory() as d:
         with pytest.raises(ValueError, match="unsupported layer"):
             save_bigdl(m, os.path.join(d, "x.bigdl"))
+
+
+def test_full_convolution_roundtrip():
+    """Deconv round-trip: reference weight (nGroup, in/g, out/g, kH, kW)
+    flattens to exactly our (in, out/g, kh, kw) order, incl. groups."""
+    m = nn.Sequential(
+        nn.SpatialFullConvolution(4, 6, 3, 3, 2, 2, 1, 1, 1, 1,
+                                  n_group=2))
+    m.reset(6)
+    x = np.random.RandomState(8).rand(2, 4, 5, 5).astype(np.float32)
+    m2 = _roundtrip(m, x)
+    fc = [c for c in m2.modules()
+          if type(c).__name__ == "SpatialFullConvolution"][0]
+    assert fc.n_group == 2 and fc.adj == (1, 1)
 
 
 def test_prelu_and_elu_roundtrip():
