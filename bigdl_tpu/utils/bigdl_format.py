@@ -183,6 +183,8 @@ def _decode_array(buf, storages):
             out.extend(bool(x) for x in _packed_varints(v, w))
         elif f == 10 and w == 2:
             out.append(_decode_tensor(v, storages))
+        elif f == 13 and w == 2:   # Array(BigDLModule)
+            out.append(_decode_module(v, storages))
     return out
 
 
@@ -361,6 +363,12 @@ def _build_cell(tree):
             if isinstance(act_tree, dict) else None
         cell = nn.RnnCell(int(a["inputSize"]), int(a["hiddenSize"]),
                           activation=act)
+    elif t == "MultiRNNCell":
+        cells = a.get("cells") or []
+        if not cells:
+            raise ValueError(
+                ".bigdl MultiRNNCell: missing or empty 'cells' attr")
+        cell = nn.MultiRNNCell([_build_cell(c) for c in cells])
     else:
         raise ValueError(f"unsupported recurrent cell {tree['type']!r}")
     if tree["name"]:
@@ -393,10 +401,24 @@ def _cell_weights(tree):
     if not pre_params:
         raise ValueError(
             f".bigdl {t}: preTopology input Linear weights are missing")
-    own = [np.asarray(p, np.float32) for p in tree["params"]]
     w_pre = np.asarray(pre_params[0], np.float32)
     b_pre = np.asarray(pre_params[1], np.float32) \
         if len(pre_params) > 1 else None
+    # a cell with includePreTopology=true (RecurrentDecoder) carries the
+    # preTopology Linear FIRST in its own flat params (Cell.parameters =
+    # Sequential(pre, cell)) — drop them POSITIONALLY so the shape-driven
+    # hidden-weight scan can't pick the input Linear when input size ==
+    # hidden size (the decoder's feedback case).  Positional, not
+    # value-equality: tied weights (w_h == w_pre by value) must survive.
+    own = [np.asarray(q, np.float32) for q in tree["params"]]
+    n_pre = len(pre_params)
+    if len(own) > n_pre and all(
+            own[i].shape == np.shape(pre_params[i]) for i in range(n_pre)):
+        lead_is_pre = all(
+            np.array_equal(own[i], np.asarray(pre_params[i], np.float32))
+            for i in range(n_pre))
+        if lead_is_pre:
+            own = own[n_pre:]
     if t == "LSTM":
         h = int(a["hiddenSize"])
         w_h = _pick_mat(own, lambda m: m.ndim == 2 and m.shape[0] == 4 * h,
@@ -440,6 +462,17 @@ def _cell_weights(tree):
         return tree["name"], {"weight_i": w_pre.T.copy(),
                               "weight_h": w_h.T.copy(), "bias": bias}
     raise ValueError(f"unsupported recurrent cell {tree['type']!r}")
+
+
+def _build_recurrent_decoder(tree):
+    a = tree["attr"]
+    topo = a.get("topology")
+    if not isinstance(topo, dict):
+        raise ValueError(".bigdl RecurrentDecoder: missing topology attr")
+    dec = nn.RecurrentDecoder(int(a["seqLength"]), _build_cell(topo))
+    if tree["name"]:
+        dec.set_name(tree["name"])
+    return dec
 
 
 def _build_recurrent(tree):
@@ -501,6 +534,13 @@ def _build_birecurrent(tree):
 
 def _assign_cell_weights(params, cell_tree, target=None):
     import jax
+    if _short_type(cell_tree["type"]) == "MultiRNNCell":
+        if target is not None:
+            raise ValueError(
+                ".bigdl BiRecurrent over MultiRNNCell is not supported")
+        for sub in cell_tree["attr"].get("cells") or []:
+            _assign_cell_weights(params, sub)
+        return
     cname, wd = _cell_weights(cell_tree)
     if target is not None:
         cname = target
@@ -727,9 +767,11 @@ def _build(tree):
         return _build_graph(tree)
     if t == "Recurrent":
         return _build_recurrent(tree)
+    if t == "RecurrentDecoder":
+        return _build_recurrent_decoder(tree)
     if t == "BiRecurrent":
         return _build_birecurrent(tree)
-    if t in _CELL_TYPES:
+    if t in _CELL_TYPES or t == "MultiRNNCell":
         return _build_cell(tree)
     fac = _FACTORY.get(t)
     if fac is None:
@@ -769,11 +811,12 @@ def load_bigdl(path: str):
 
     def assign_leaf(sub):
         st = _short_type(sub["type"])
-        if st == "Recurrent":
+        if st in ("Recurrent", "RecurrentDecoder"):
             # cell weights come from the topology attr's Linear layout,
             # not the Recurrent's own flat parameter list
             _assign_cell_weights(params, sub["attr"]["topology"])
             return
+
         if st == "BiRecurrent":
             fwd_t, rev_t = _birnn_recurrents(sub["attr"]["birnn"])
             _assign_cell_weights(params, fwd_t["attr"]["topology"])
@@ -785,7 +828,7 @@ def load_bigdl(path: str):
             _assign_cell_weights(params, rev_t["attr"]["topology"],
                                  target=f"{fwd_name}_bwd")
             return
-        if st in _CELL_TYPES:
+        if st in _CELL_TYPES or st == "MultiRNNCell":
             _assign_cell_weights(params, sub)
             return
         if st == "TimeDistributed":

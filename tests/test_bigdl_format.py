@@ -944,3 +944,122 @@ def test_time_distributed_bn_running_stats():
     got = np.asarray(m.forward(x))
     want = (x - rmean) / np.sqrt(rvar + 1e-5)
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_multi_rnn_cell_stacked_read():
+    """Recurrent(MultiRNNCell([LSTM, LSTM])): cells ride an ArrayValue
+    of modules (MultiRNNCell.scala:205 'cells' attr)."""
+    rng = np.random.RandomState(41)
+    nin, h = 3, 3    # stacked: layer-2 input == layer-1 hidden
+
+    def lstm_bytes(name, wp, bp, wh, isz):
+        t = enc_string(1, name)
+        t += enc_string(7, "com.intel.analytics.bigdl.nn.LSTM")
+        t += _mod_attr_entry("inputSize", _attr_i(isz))
+        t += _mod_attr_entry("hiddenSize", _attr_i(h))
+        t += _mod_attr_entry("p", _attr_d(0.0))
+        t += _mod_attr_entry(
+            "preTopology", _attr_mod(_linear_module(name + "_i", wp, bp)))
+        t += enc_int64(15, 1)
+        t += enc_bytes(16, _mod_tensor(wh))
+        return t
+
+    ws = []
+    for isz in (nin, h):
+        ws.append((rng.randn(4 * h, isz).astype(np.float32),
+                   rng.randn(4 * h).astype(np.float32),
+                   rng.randn(4 * h, h).astype(np.float32)))
+
+    cells_arr = enc_int64(1, 2) + enc_int64(2, 16)   # size, datatype MODULE-ish
+    cells_arr += enc_bytes(13, lstm_bytes("l1", *ws[0], nin))
+    cells_arr += enc_bytes(13, lstm_bytes("l2", *ws[1], h))
+    mrc = enc_string(1, "stack")
+    mrc += enc_string(7, "com.intel.analytics.bigdl.nn.MultiRNNCell")
+    mrc += _mod_attr_entry("cells", enc_int64(1, 15)
+                           + enc_bytes(15, cells_arr))
+
+    rec = enc_string(1, "rec")
+    rec += enc_string(7, "com.intel.analytics.bigdl.nn.Recurrent")
+    rec += _mod_attr_entry("topology", _attr_mod(mrc))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "stack.bigdl")
+        with open(p, "wb") as f:
+            f.write(rec)
+        m = load_bigdl(p)
+
+    B, T = 2, 4
+    x = rng.randn(B, T, nin).astype(np.float32)
+    got = np.asarray(m.forward(x))
+
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+
+    def run(xs, wp, bp, wh):
+        hs = np.zeros((B, h), np.float32)
+        cs = np.zeros((B, h), np.float32)
+        out = np.zeros((B, xs.shape[1], h), np.float32)
+        for t in range(xs.shape[1]):
+            z = xs[:, t] @ wp.T + bp + hs @ wh.T
+            i, g, f, o = (z[:, :h], z[:, h:2*h], z[:, 2*h:3*h], z[:, 3*h:])
+            cs = sig(i) * np.tanh(g) + sig(f) * cs
+            hs = sig(o) * np.tanh(cs)
+            out[:, t] = hs
+        return out
+
+    want = run(run(x, *ws[0]), *ws[1])
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_recurrent_decoder_read():
+    """RecurrentDecoder(seqLength, LSTM) with includePreTopology: the
+    cell's flat params duplicate the preTopology Linear — the loader
+    must not confuse it with the hidden Linear (input == hidden size)."""
+    rng = np.random.RandomState(42)
+    h = 4
+    wp = rng.randn(4 * h, h).astype(np.float32)   # input size == h!
+    bp = rng.randn(4 * h).astype(np.float32)
+    wh = rng.randn(4 * h, h).astype(np.float32)
+
+    lstm = enc_string(1, "dcell")
+    lstm += enc_string(7, "com.intel.analytics.bigdl.nn.LSTM")
+    lstm += _mod_attr_entry("inputSize", _attr_i(h))
+    lstm += _mod_attr_entry("hiddenSize", _attr_i(h))
+    lstm += _mod_attr_entry("p", _attr_d(0.0))
+    lstm += _mod_attr_entry("preTopology",
+                            _attr_mod(_linear_module("i2g", wp, bp)))
+    lstm += enc_int64(15, 1)
+    # includePreTopology=true flat order: [W_pre, b_pre, W_h2g]
+    lstm += enc_bytes(16, _mod_tensor(wp))
+    lstm += enc_bytes(16, _mod_tensor(bp))
+    lstm += enc_bytes(16, _mod_tensor(wh))
+
+    T_steps = 3
+    dec = enc_string(1, "dec")
+    dec += enc_string(7, "com.intel.analytics.bigdl.nn.RecurrentDecoder")
+    dec += _mod_attr_entry("seqLength", _attr_i(T_steps))
+    dec += _mod_attr_entry("topology", _attr_mod(lstm))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "dec.bigdl")
+        with open(p, "wb") as f:
+            f.write(dec)
+        m = load_bigdl(p)
+
+    B = 2
+    x0 = rng.randn(B, h).astype(np.float32)
+    got = np.asarray(m.forward(x0))
+
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    hs = np.zeros((B, h), np.float32)
+    cs = np.zeros((B, h), np.float32)
+    cur = x0
+    outs = []
+    for _ in range(T_steps):
+        z = cur @ wp.T + bp + hs @ wh.T
+        i, g, f, o = (z[:, :h], z[:, h:2*h], z[:, 2*h:3*h], z[:, 3*h:])
+        cs = sig(i) * np.tanh(g) + sig(f) * cs
+        hs = sig(o) * np.tanh(cs)
+        cur = hs
+        outs.append(hs)
+    want = np.stack(outs, axis=1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
