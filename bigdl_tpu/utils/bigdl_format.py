@@ -406,19 +406,29 @@ def _cell_weights(tree):
         if len(pre_params) > 1 else None
     # a cell with includePreTopology=true (RecurrentDecoder) carries the
     # preTopology Linear FIRST in its own flat params (Cell.parameters =
-    # Sequential(pre, cell)) — drop them POSITIONALLY so the shape-driven
+    # Sequential(pre, cell)) — drop them positionally so the shape-driven
     # hidden-weight scan can't pick the input Linear when input size ==
-    # hidden size (the decoder's feedback case).  Positional, not
-    # value-equality: tied weights (w_h == w_pre by value) must survive.
+    # hidden size (the decoder's feedback case).  Keyed on the cell's
+    # serialized includePreTopology attr (CellSerializer writes it); the
+    # lead-match heuristic only kicks in when the attr is absent, so a
+    # plain cell with genuinely tied weights is never mis-dropped.
     own = [np.asarray(q, np.float32) for q in tree["params"]]
     n_pre = len(pre_params)
-    if len(own) > n_pre and all(
-            own[i].shape == np.shape(pre_params[i]) for i in range(n_pre)):
-        lead_is_pre = all(
-            np.array_equal(own[i], np.asarray(pre_params[i], np.float32))
-            for i in range(n_pre))
-        if lead_is_pre:
+    inc = a.get("includePreTopology")
+    lead_matches = (
+        len(own) > n_pre
+        and all(own[i].shape == np.shape(pre_params[i])
+                for i in range(n_pre))
+        and all(np.array_equal(own[i],
+                               np.asarray(pre_params[i], np.float32))
+                for i in range(n_pre)))
+    if inc or (inc is None and lead_matches):
+        if lead_matches:
             own = own[n_pre:]
+        elif inc:
+            raise ValueError(
+                f".bigdl {t}: includePreTopology=true but the flat "
+                "params do not lead with the preTopology weights")
     if t == "LSTM":
         h = int(a["hiddenSize"])
         w_h = _pick_mat(own, lambda m: m.ndim == 2 and m.shape[0] == 4 * h,
