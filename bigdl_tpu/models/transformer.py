@@ -429,7 +429,8 @@ class TransformerLM(Module):
     def generate(self, params, prompt, max_new_tokens: int,
                  temperature: float = 0.0, rng=None,
                  top_k: Optional[int] = None,
-                 top_p: Optional[float] = None):
+                 top_p: Optional[float] = None,
+                 params_transform=None):
         """Autoregressive decode with a kv cache: ONE compiled prefill
         (prompt length) + ONE compiled ``lax.scan`` of single-token steps
         (static shapes throughout, so repeated calls with equal prompt
@@ -438,6 +439,11 @@ class TransformerLM(Module):
 
         ≙ the reference's RecurrentDecoder generation loop
         (nn/RecurrentDecoder.scala) rebuilt for attention models.
+
+        ``params_transform`` maps the params INSIDE the compiled
+        program (e.g. quantized.dequantize_weights for weight-only-int8
+        serving: weights live in HBM as int8; the reconstruct traces
+        into the program where XLA places it).
         """
         cfg = self.cfg
         prompt = jnp.asarray(prompt, jnp.int32)
@@ -477,12 +483,14 @@ class TransformerLM(Module):
         if memo is None:
             memo = self._gen_fns = {}
         memo_key = (b, s0, int(max_new_tokens), float(temperature),
-                    top_k, top_p)
+                    top_k, top_p, id(params_transform))
         if memo_key in memo:
             return memo[memo_key](params, prompt, rng)
 
         @jax.jit
         def run(params, prompt, rng):
+            if params_transform is not None:
+                params = params_transform(params)
             cache = self.init_cache(
                 b, cache_len=s0 + max_new_tokens)
             logits, cache = self.apply_with_cache(params, prompt, cache, 0)

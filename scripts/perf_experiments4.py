@@ -131,6 +131,41 @@ def exp_K9():
     infer("K9 bf16 infer, BN folded  ", fold_batchnorm(model))
 
 
+def exp_K10():
+    """Decode throughput, fp-bf16 vs weight-only int8 params: the
+    weight-streaming HBM lever (docs/performance.md item 7)."""
+    from bigdl_tpu.models import transformer as T
+    from bigdl_tpu.quantized import (dequantize_weights,
+                                     quantize_weights_only)
+
+    model = T.build("small", dropout=0.0)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    prompt = jnp.asarray(rng.randint(0, 1000, (8, 64)), jnp.int32)
+    new = 128
+
+    def measure(label, p, transform=None):
+        kw = dict(max_new_tokens=new, params_transform=transform)
+        model.generate(p, prompt, **kw)  # compile
+        l = lat()
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(model.generate(p, prompt, **kw))
+            ts.append(time.perf_counter() - t0 - l)
+        t = float(np.median(ts))
+        tok = prompt.shape[0] * new
+        print(f"{label}: {t*1e3:8.1f} ms  {tok/t:9.0f} tok/s decode",
+              flush=True)
+
+    measure("K10 decode bf16 weights  ", params)
+    # weights STAY int8 in HBM; dequantize_weights traces inside the
+    # compiled program (generate(params_transform=...))
+    qp = quantize_weights_only(params)
+    measure("K10 decode int8 weights  ", qp,
+            transform=dequantize_weights)
+
+
 def exp_K7():
     """remat cost at b256 (baseline for K8): blocks recompute in bwd."""
     run_full("K7 b256 remat           ", remat=True)
@@ -180,7 +215,7 @@ if __name__ == "__main__":
     which = sys.argv[1:] or ["K1", "K2", "K3"]
     t0 = time.time()
     EXPS = {"K1": exp_K1, "K2": exp_K2, "K3": exp_K3, "K7": exp_K7,
-            "K8": exp_K8, "K9": exp_K9,
+            "K8": exp_K8, "K9": exp_K9, "K10": exp_K10,
             "K4": exp_K4, "K5": exp_K5, "K6": exp_K6}
     for w in which:
         try:

@@ -43,6 +43,8 @@ QUEUE = [
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K7", "K8"], 2400),
     ("K9 BN-folded bf16 inference",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K9"], 1500),
+    ("K10 weight-only int8 decode",
+     [PY, os.path.join(HERE, "perf_experiments4.py"), "K10"], 1500),
     # (moe config already runs inside the full bench above)
 ]
 
