@@ -322,7 +322,10 @@ def _rewrite(module: Module, params, replaced, absmax=None) -> Module:
 # weight-only int8 (LLM serving)                                         #
 # --------------------------------------------------------------------- #
 def _is_wq8(v):
-    return isinstance(v, dict) and v.get("__wq8__") is True
+    # detect by KEY SET, not a marker value: under jit the tree's leaves
+    # (including any marker) become tracers, so value checks would fail
+    # inside a params_transform traced into the serving program
+    return isinstance(v, dict) and set(v) == {"q8", "q8_scale"}
 
 
 def quantize_weights_only(params, min_size=4096):
@@ -351,8 +354,7 @@ def quantize_weights_only(params, min_size=4096):
         # (transformer wq/w1/head): per-OUTPUT-channel means the LAST
         # axis; the keepdims scale broadcasts in the dequant multiply
         q, scale = quantize_weights_symmetric(a, axis=a.ndim - 1)
-        return {"__wq8__": True, "q": jnp.asarray(q),
-                "s": jnp.asarray(scale)}
+        return {"q8": jnp.asarray(q), "q8_scale": jnp.asarray(scale)}
 
     return jax.tree_util.tree_map(leaf, params, is_leaf=_is_wq8)
 
@@ -364,7 +366,7 @@ def dequantize_weights(qparams, dtype=jnp.bfloat16):
     copies in HBM)."""
     def leaf(v):
         if _is_wq8(v):
-            return (v["q"].astype(dtype) * v["s"].astype(dtype))
+            return v["q8"].astype(dtype) * v["q8_scale"].astype(dtype)
         return v
 
     return jax.tree_util.tree_map(leaf, qparams, is_leaf=_is_wq8)
@@ -377,7 +379,7 @@ def quantized_bytes(qparams):
     for leaf in jax.tree_util.tree_leaves(
             qparams, is_leaf=_is_wq8):
         if _is_wq8(leaf):
-            total += leaf["q"].size * 1 + leaf["s"].size * 4
+            total += leaf["q8"].size * 1 + leaf["q8_scale"].size * 4
         else:
             a = np.asarray(leaf)
             total += a.size * a.dtype.itemsize

@@ -354,7 +354,7 @@ def test_weight_only_int8_roundtrip_identity_for_small_leaves():
                     .randn(64, 64).astype(np.float32),
                     "b": np.arange(4, dtype=np.float32)}}
     q = quantize_weights_only(params, min_size=1024)
-    assert isinstance(q["m"]["w"], dict) and "q" in q["m"]["w"]
+    assert isinstance(q["m"]["w"], dict) and "q8" in q["m"]["w"]
     np.testing.assert_array_equal(np.asarray(q["m"]["b"]), params["m"]["b"])
     d = dequantize_weights(q, dtype=jnp.float32)
     err = np.abs(np.asarray(d["m"]["w"]) - params["m"]["w"]).max()
