@@ -27,6 +27,7 @@ import numpy as np
 
 from .containers import Container, Sequential
 from .conv import SpatialConvolution
+from .graph import Graph
 from .linear import Linear
 from .normalization import BatchNormalization
 
@@ -96,7 +97,50 @@ def fold_batchnorm(model):
         {k: dict(v) if isinstance(v, dict) else v for k, v in params.items()})
     new_state = dict(state)
 
+    def fold_graph(g):
+        """Splice conv->BN edges out of a DAG: fold when the BN is the
+        conv's ONLY consumer (otherwise other consumers would see the
+        folded activation)."""
+        consumers = {}
+        node_count = {}      # module identity -> number of graph nodes
+        for n in g._topo:
+            if n.module is not None:
+                node_count[id(n.module)] = node_count.get(
+                    id(n.module), 0) + 1
+            for prev in n.prev_nodes:
+                consumers.setdefault(id(prev), []).append(n)
+        for b in list(g._topo):
+            if b.module is None \
+                    or not isinstance(b.module, BatchNormalization) \
+                    or len(b.prev_nodes) != 1:
+                continue
+            a = b.prev_nodes[0]
+            if a.module is None \
+                    or not _foldable(a.module, b.module, new_params) \
+                    or len(consumers.get(id(a), [])) != 1 \
+                    or any(n is a for n in g.output_nodes):
+                continue
+            # weight sharing: the same module at MULTIPLE graph nodes
+            # (siamese nets) — folding would corrupt the other use sites
+            if node_count.get(id(a.module), 0) != 1 \
+                    or node_count.get(id(b.module), 0) != 1:
+                continue
+            _fold_pair(a.module, b.module, new_params, new_state)
+            new_params.pop(b.module.name, None)
+            new_state.pop(b.module.name, None)
+            for c in consumers.get(id(b), []):
+                c.prev_nodes = [a if prev is b else prev
+                                for prev in c.prev_nodes]
+            g.output_nodes = [a if n is b else n for n in g.output_nodes]
+            consumers[id(a)] = consumers.pop(id(b), [])
+        g._topo = g._topsort()
+
     def walk(container):
+        if isinstance(container, Graph):
+            for child in container.children():
+                walk(child)
+            fold_graph(container)
+            return
         if not isinstance(container, Container):
             return
         for child in container.children():

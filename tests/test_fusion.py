@@ -109,3 +109,75 @@ def test_resnet_fold_parity():
     assert n_bn0 > 0 and n_bn1 < n_bn0
     np.testing.assert_allclose(np.asarray(folded.forward(x)), y0,
                                rtol=2e-4, atol=2e-5)
+
+
+def test_graph_model_fold_parity():
+    """Graph DAGs (caffe-style): conv->BN edges splice out; a conv
+    feeding BOTH a BN and a skip connection must NOT fold (other
+    consumers would see the folded activation)."""
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    inp = Input()
+    c1 = nn.SpatialConvolution(2, 4, 3, 3, 1, 1, 1, 1).inputs(inp)
+    b1 = nn.SpatialBatchNormalization(4).inputs(c1)       # foldable
+    r1 = nn.ReLU().inputs(b1)
+    c2 = nn.SpatialConvolution(4, 4, 3, 3, 1, 1, 1, 1).inputs(r1)
+    # c2's only consumer is b2, so this pair folds too even though b2's
+    # output fans into the skip merge
+    b2 = nn.SpatialBatchNormalization(4).inputs(c2)
+    skip = nn.CAddTable().inputs([b2, r1])
+    out = nn.ReLU().inputs(skip)
+    m = Graph(inp, out)
+    m.reset(7)
+    _train_stats(m, (4, 2, 8, 8))
+    x = np.random.RandomState(11).rand(2, 2, 8, 8).astype(np.float32)
+    y0 = np.asarray(m.forward(x))
+
+    folded = fold_batchnorm(m)
+    kinds = [type(c).__name__ for c in folded.modules()]
+    assert kinds.count("SpatialBatchNormalization") == 0   # both fold
+    np.testing.assert_allclose(np.asarray(folded.forward(x)), y0,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_graph_shared_conv_not_folded():
+    """conv output consumed by BN AND another branch: must not fold."""
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    inp = Input()
+    c1 = nn.SpatialConvolution(2, 4, 3, 3, 1, 1, 1, 1).inputs(inp)
+    b1 = nn.SpatialBatchNormalization(4).inputs(c1)
+    merged = nn.CAddTable().inputs([b1, c1])    # c1 has TWO consumers
+    m = Graph(inp, merged)
+    m.reset(8)
+    _train_stats(m, (4, 2, 6, 6))
+    x = np.random.RandomState(12).rand(2, 2, 6, 6).astype(np.float32)
+    y0 = np.asarray(m.forward(x))
+    folded = fold_batchnorm(m)
+    kinds = [type(c).__name__ for c in folded.modules()]
+    assert kinds.count("SpatialBatchNormalization") == 1
+    np.testing.assert_allclose(np.asarray(folded.forward(x)), y0,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_graph_shared_module_not_folded():
+    """The SAME conv module at two graph nodes (weight sharing): folding
+    would corrupt the second use site — must be skipped."""
+    from bigdl_tpu.nn.graph import Graph, Input
+
+    inp = Input()
+    conv = nn.SpatialConvolution(2, 4, 3, 3, 1, 1, 1, 1)
+    n1 = conv.inputs(inp)
+    b1 = nn.SpatialBatchNormalization(4).inputs(n1)
+    n2 = conv.inputs(inp)                     # shared weights branch
+    merged = nn.CAddTable().inputs([b1, n2])
+    m = Graph(inp, merged)
+    m.reset(9)
+    _train_stats(m, (4, 2, 6, 6))
+    x = np.random.RandomState(13).rand(2, 2, 6, 6).astype(np.float32)
+    y0 = np.asarray(m.forward(x))
+    folded = fold_batchnorm(m)
+    kinds = [type(c).__name__ for c in folded.modules()]
+    assert kinds.count("SpatialBatchNormalization") == 1
+    np.testing.assert_allclose(np.asarray(folded.forward(x)), y0,
+                               rtol=1e-5, atol=1e-6)
