@@ -11,8 +11,10 @@ Usage mirrors the reference:
 ``Module.inputs(*nodes)`` wraps the module in a :class:`Node` and records the
 edges.  ``Graph.apply`` evaluates nodes in topological order at trace time —
 XLA sees one static graph (the reference's DynamicGraph scheduler is
-unnecessary: control flow inside jit must be static anyway, and
-``lax.cond``-style dynamic routing is exposed via nn.ops instead).
+unnecessary: control flow inside jit must be static anyway; DATA-
+dependent loops/branches are first-class via ``nn.WhileLoop`` /
+``nn.Cond`` — nn/control_flow.py — which compile to ``lax.while_loop``
+/ ``lax.cond`` inside the same program).
 """
 from __future__ import annotations
 
