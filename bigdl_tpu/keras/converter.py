@@ -436,18 +436,18 @@ def _k2_batchnorm(cfg):
 def _k2_recurrent(cls, cfg, who):
     if cfg.get("go_backwards"):
         _unsupported(f"{who} go_backwards=True")
-    # absent key = pre-2.2 keras whose GRU had no reset_after (classic
-    # form); tf.keras 2.x always writes the key explicitly
-    if who == "GRU" and cfg.get("reset_after", False):
-        _unsupported("GRU reset_after=True (retrain or export with "
-                     "reset_after=False; the classic GRU form is what "
-                     "nn.GRU implements)")
     if who == "GRU" and (cfg.get("activation", "tanh") != "tanh"
                          or cfg.get("recurrent_activation",
                                     "sigmoid") != "sigmoid"):
         _unsupported("GRU with non-default activations")
+    extra = {}
+    if who == "GRU":
+        # absent key = pre-2.2 keras (classic form); tf.keras 2.x always
+        # writes it — BOTH forms load (nn.GRU(reset_after=...))
+        extra["reset_after"] = bool(cfg.get("reset_after", False))
     return cls(cfg["units"], activation=cfg.get("activation", "tanh"),
                inner_activation=cfg.get("recurrent_activation", "sigmoid"),
+               **extra,
                return_sequences=cfg.get("return_sequences", False),
                input_shape=_input_shape(cfg), name=cfg.get("name"))
 
@@ -839,8 +839,10 @@ def _gates_lstm(ws):
             np.concatenate([bi, bf, bc, bo], 0))
 
 
-def _set_gru(params, cell, Wz, Uz, bz, Wr, Ur, br, Wh, Uh, bh):
-    """Route per-gate GRU arrays into our fused-(r,z)+candidate params."""
+def _set_gru(params, cell, Wz, Uz, bz, Wr, Ur, br, Wh, Uh, bh,
+             bh_z=None, bh_r=None, bh_h=None):
+    """Route per-gate GRU arrays into our fused-(r,z)+candidate params;
+    the bh_* recurrent biases feed the reset_after (v3) form."""
     import jax.numpy as jnp
     entry = dict(params.get(cell.name, {}))
     gates = dict(entry.get("gates", {}))
@@ -850,6 +852,9 @@ def _set_gru(params, cell, Wz, Uz, bz, Wr, Ur, br, Wh, Uh, bh):
                  bias=jnp.asarray(np.concatenate([br, bz], 0)))
     newg.update(weight_i=jnp.asarray(Wh), weight_h=jnp.asarray(Uh),
                 bias=jnp.asarray(bh))
+    if bh_r is not None:
+        gates["bias_h"] = jnp.asarray(np.concatenate([bh_r, bh_z], 0))
+        newg["bias_h"] = jnp.asarray(bh_h)
     entry["gates"], entry["new"] = gates, newg
     params[cell.name] = entry
 
@@ -878,13 +883,32 @@ def _load_cell_k2(cell, ws, params):
         k, r, b = ws
         _set(params, cell, weight_i=k, weight_h=r, bias=b)
     elif isinstance(cell, N.GRU):
-        # reset_after=False: kernel thirds are z, r, h
+        # kernel thirds are z, r, h in both forms; reset_after=True adds
+        # a (2, 3H) bias: row 0 input bias, row 1 recurrent bias
         k, r, b = ws
         H = k.shape[1] // 3
-        _set_gru(params, cell,
-                 k[:, :H], r[:, :H], b[:H],
-                 k[:, H:2 * H], r[:, H:2 * H], b[H:2 * H],
-                 k[:, 2 * H:], r[:, 2 * H:], b[2 * H:])
+        if getattr(cell, "reset_after", False):
+            b = np.asarray(b)
+            if b.ndim != 2 or b.shape[0] != 2:
+                raise KerasConversionError(
+                    f"GRU reset_after expects (2, 3H) bias, got {b.shape}")
+            bi, bh = b[0], b[1]
+            _set_gru(params, cell,
+                     k[:, :H], r[:, :H], bi[:H],
+                     k[:, H:2 * H], r[:, H:2 * H], bi[H:2 * H],
+                     k[:, 2 * H:], r[:, 2 * H:], bi[2 * H:],
+                     bh_z=bh[:H], bh_r=bh[H:2 * H], bh_h=bh[2 * H:])
+        else:
+            b = np.asarray(b)
+            if b.ndim != 1:
+                raise KerasConversionError(
+                    f"GRU bias shape {b.shape}: a (2, 3H) bias is the "
+                    "reset_after form — build the layer with "
+                    "reset_after=True to load these weights")
+            _set_gru(params, cell,
+                     k[:, :H], r[:, :H], b[:H],
+                     k[:, H:2 * H], r[:, H:2 * H], b[H:2 * H],
+                     k[:, 2 * H:], r[:, 2 * H:], b[2 * H:])
     elif isinstance(cell, N.RnnCell):
         k, r, b = ws
         _set(params, cell, weight_i=k, weight_h=r, bias=b)

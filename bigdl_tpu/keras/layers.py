@@ -1031,8 +1031,13 @@ class LSTM(_KerasRecurrent):
 
 
 class GRU(_KerasRecurrent):
+    def __init__(self, *a, reset_after=False, **kw):
+        super().__init__(*a, **kw)
+        self.reset_after = reset_after
+
     def _cell(self, input_dim):
-        return N.GRU(input_dim, self.output_dim)
+        return N.GRU(input_dim, self.output_dim,
+                     reset_after=self.reset_after)
 
 
 class ConvLSTM2D(KerasLayer):

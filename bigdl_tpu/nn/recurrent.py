@@ -174,18 +174,29 @@ class LSTMPeephole(Cell):
 
 
 class GRU(Cell):
-    """GRU cell (nn/GRU.scala). Gate order r(reset), z(update), n(new)."""
+    """GRU cell (nn/GRU.scala). Gate order r(reset), z(update), n(new).
+
+    ``reset_after=True`` is the v3/CuDNN form (tf.keras 2.x default):
+    the reset gate multiplies the candidate's RECURRENT contribution
+    after its matmul (r * (h @ U_h + b_h)) instead of gating h before
+    it, with separate input/recurrent biases.  Classic (reference)
+    form is the default."""
 
     def __init__(self, input_size, hidden_size, p=0.0, w_regularizer=None,
-                 u_regularizer=None, b_regularizer=None, name=None):
+                 u_regularizer=None, b_regularizer=None,
+                 reset_after=False, name=None):
         super().__init__(name=name)
         self.input_size = input_size
         self.hidden_size = hidden_size
+        self.reset_after = reset_after
 
     def init(self, rng):
         k1, k2 = jax.random.split(rng)
         gates = _gate_params(self, k1, self.input_size, self.hidden_size, 2)
         newg = _gate_params(self, k2, self.input_size, self.hidden_size, 1)
+        if self.reset_after:
+            gates["bias_h"] = jnp.zeros_like(gates["bias"])
+            newg["bias_h"] = jnp.zeros_like(newg["bias"])
         return {self.name: {"gates": gates, "new": newg}}
 
     def zero_hidden(self, batch_size, dtype=jnp.float32):
@@ -194,14 +205,22 @@ class GRU(Cell):
     def step(self, params, x, h, ctx):
         p = self.own(params)
         g = p["gates"]
+        n = p["new"]
         z2 = (x @ g["weight_i"].astype(x.dtype)
               + h @ g["weight_h"].astype(x.dtype)
               + g["bias"].astype(x.dtype))
-        r, z = jnp.split(jax.nn.sigmoid(z2), 2, axis=-1)
-        n = p["new"]
-        nh = jnp.tanh(x @ n["weight_i"].astype(x.dtype)
-                      + (r * h) @ n["weight_h"].astype(x.dtype)
-                      + n["bias"].astype(x.dtype))
+        if self.reset_after:
+            z2 = z2 + g["bias_h"].astype(x.dtype)
+            r, z = jnp.split(jax.nn.sigmoid(z2), 2, axis=-1)
+            rec = (h @ n["weight_h"].astype(x.dtype)
+                   + n["bias_h"].astype(x.dtype))
+            nh = jnp.tanh(x @ n["weight_i"].astype(x.dtype)
+                          + n["bias"].astype(x.dtype) + r * rec)
+        else:
+            r, z = jnp.split(jax.nn.sigmoid(z2), 2, axis=-1)
+            nh = jnp.tanh(x @ n["weight_i"].astype(x.dtype)
+                          + (r * h) @ n["weight_h"].astype(x.dtype)
+                          + n["bias"].astype(x.dtype))
         h2 = (1.0 - z) * nh + z * h
         return h2, h2
 

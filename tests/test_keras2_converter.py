@@ -182,18 +182,20 @@ def test_cnn_channels_last_flatten():
     np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
 
 
-def test_gru_reset_after_rejected_clearly():
-    spec = {
-        "class_name": "Sequential", "keras_version": "2.15.0",
-        "config": {"name": "g", "layers": [
-            {"class_name": "GRU", "config": {
-                "name": "g1", "units": 4, "reset_after": True,
-                "batch_input_shape": [None, 5, 3]}},
-        ]},
-    }
-    from bigdl_tpu.keras.converter import DefinitionLoader
-    with pytest.raises(KerasConversionError, match="reset_after"):
-        DefinitionLoader.from_json_str(json.dumps(spec))
+def test_gru_reset_after_cross_validated():
+    """reset_after=True (the tf.keras 2.x DEFAULT) must load with
+    matching predictions — the v3/CuDNN gate form with its (2, 3H)
+    bias."""
+    tfk.utils.set_random_seed(7)
+    m = tfk.Sequential([
+        tfk.layers.Input((6, 5)),
+        tfk.layers.GRU(4, reset_after=True, return_sequences=True),
+        tfk.layers.GRU(3, reset_after=True),
+    ])
+    x = np.random.RandomState(3).randn(2, 6, 5).astype(np.float32)
+    want, got = _roundtrip(m, x)
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+
 
 
 def test_variable_length_recurrent_loads():
