@@ -254,3 +254,40 @@ class TestReviewRegressions:
         out = np.asarray(det.run({}, T(jnp.asarray(loc), jnp.asarray(conf),
                                        jnp.asarray(priors)))[0])
         assert out.shape == (1, 7)
+
+
+def test_gru_reset_after_gradients():
+    """nn.GRU(reset_after=True): finite-difference gradient check of the
+    v3 gate form (separate input/recurrent biases)."""
+    import jax
+    import jax.numpy as jnp
+    from bigdl_tpu import nn
+    from bigdl_tpu.nn.module import Ctx
+
+    m = nn.Recurrent(nn.GRU(4, 5, reset_after=True))
+    params, state = m.init_params(3)
+    x = jnp.asarray(np.random.RandomState(0).randn(2, 6, 4)
+                    .astype(np.float32))
+
+    def f(p):
+        return jnp.sum(m.apply(p, x, Ctx(state=state)) ** 2)
+
+    g = jax.grad(f)(params)
+    leaves = jax.tree_util.tree_leaves(g)
+    assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+    # numeric check on one bias_h leaf
+    cell_name = [k for k in params][0]
+    bh = np.asarray(params[cell_name]["gates"]["bias_h"])
+    eps = 1e-3
+    i = 1
+    pp = jax.tree_util.tree_map(lambda a: np.array(a, np.float64), params)
+    import copy
+    p_plus = copy.deepcopy(pp)
+    p_plus[cell_name]["gates"]["bias_h"][i] += eps
+    p_minus = copy.deepcopy(pp)
+    p_minus[cell_name]["gates"]["bias_h"][i] -= eps
+    num = (float(f(jax.tree_util.tree_map(jnp.asarray, p_plus)))
+           - float(f(jax.tree_util.tree_map(jnp.asarray, p_minus)))) \
+        / (2 * eps)
+    ana = float(np.asarray(g[cell_name]["gates"]["bias_h"])[i])
+    assert abs(num - ana) < 2e-2 * max(1.0, abs(ana)), (num, ana)
