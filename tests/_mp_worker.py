@@ -3,7 +3,7 @@ processes on CPU (4 local virtual devices each), training the shared
 fixture model with DistriOptimizer over the global dp mesh
 (≙ a Spark executor in optim/DistriOptimizer.scala:118's cluster run).
 
-Usage: python _mp_worker.py <proc_id> <num_procs> <port> <out.npz>
+Usage: python _mp_worker.py <proc_id> <num_procs> <port> <out.npz> [fsdp]
 """
 import os
 import sys
@@ -43,9 +43,10 @@ def main():
     model = nn.Sequential(nn.Linear(12, 8), nn.Tanh(), nn.Linear(8, 1))
     model.reset(3)
 
+    fsdp = len(sys.argv) > 5 and sys.argv[5] == "fsdp"
     mesh = create_mesh({"dp": 4 * nproc})
     opt = (DistriOptimizer(model, (x, y), nn.MSECriterion(), batch_size=64,
-                           mesh=mesh)
+                           mesh=mesh, fsdp=fsdp)
            .set_optim_method(SGD(learning_rate=0.05, momentum=0.9))
            .set_end_when(Trigger.max_epoch(2)))
     trained = opt.optimize()

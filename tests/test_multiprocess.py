@@ -32,7 +32,7 @@ def _free_port():
     return port
 
 
-def _single_process_reference():
+def _single_process_reference(fsdp=False):
     """The worker fixture, trained in-process on the 8-device mesh."""
     rng = np.random.RandomState(0)
     x = rng.randn(256, 12).astype(np.float32)
@@ -42,7 +42,7 @@ def _single_process_reference():
     model.reset(3)
     mesh = mesh_lib.create_mesh({"dp": 8})
     opt = (DistriOptimizer(model, (x, y), nn.MSECriterion(), batch_size=64,
-                           mesh=mesh)
+                           mesh=mesh, fsdp=fsdp)
            .set_optim_method(SGD(learning_rate=0.05, momentum=0.9))
            .set_end_when(Trigger.max_epoch(2)))
     trained = opt.optimize()
@@ -50,8 +50,7 @@ def _single_process_reference():
         jax.tree_util.tree_map(np.asarray, trained._params))]
 
 
-@pytest.mark.slow
-def test_two_process_matches_single(tmp_path):
+def _run_two_procs(tmp_path, extra=()):
     port = _free_port()
     out = str(tmp_path / "mp_params.npz")
     env = dict(os.environ)
@@ -62,7 +61,8 @@ def test_two_process_matches_single(tmp_path):
     env["PYTHONPATH"] = repo
 
     procs = [subprocess.Popen(
-        [sys.executable, _WORKER, str(i), "2", str(port), out],
+        [sys.executable, _WORKER, str(i), "2", str(port), out,
+         *extra],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True) for i in range(2)]
     logs = []
@@ -77,10 +77,19 @@ def test_two_process_matches_single(tmp_path):
     for i, (p, o) in enumerate(zip(procs, logs)):
         assert p.returncode == 0, f"proc {i} failed:\n{o[-3000:]}"
     assert os.path.exists(out), logs[0][-2000:]
-
     got = np.load(out)
-    got_leaves = [got[k] for k in got.files]
-    want_leaves = _single_process_reference()
+    return [got[k] for k in got.files]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fsdp", [False, True], ids=["dp", "fsdp"])
+def test_two_process_matches_single(tmp_path, fsdp):
+    """dp: replicated params, psum gradients. fsdp: params/opt-state
+    sharded over the GLOBAL dp axis spanning both OS processes
+    (all_gather/psum_scatter riding the inter-process transport).
+    Either way the trained params must match the in-process dp=8 run."""
+    got_leaves = _run_two_procs(tmp_path, extra=("fsdp",) if fsdp else ())
+    want_leaves = _single_process_reference(fsdp=fsdp)
     assert len(got_leaves) == len(want_leaves)
     for a, b in zip(want_leaves, got_leaves):
         np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
