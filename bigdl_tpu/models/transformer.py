@@ -484,8 +484,14 @@ class TransformerLM(Module):
             memo = self._gen_fns = {}
         memo_key = (b, s0, int(max_new_tokens), float(temperature),
                     top_k, top_p, id(params_transform))
-        if memo_key in memo:
-            return memo[memo_key](params, prompt, rng)
+        hit = memo.get(memo_key)
+        # the memo value holds a strong ref to the transform so its id()
+        # can't be recycled by a new object while the entry lives, and
+        # identity is re-checked on hit anyway (a raw id() match after
+        # garbage collection would hand back a program with the OLD
+        # transform baked in)
+        if hit is not None and hit[0] is params_transform:
+            return hit[1](params, prompt, rng)
 
         @jax.jit
         def run(params, prompt, rng):
@@ -516,7 +522,7 @@ class TransformerLM(Module):
             out = jnp.moveaxis(toks, 0, 1)               # (B, new-1)
             return jnp.concatenate([prompt, out, last[:, None]], axis=1)
 
-        memo[memo_key] = run
+        memo[memo_key] = (params_transform, run)
         if len(memo) > 8:   # bound compiled-program retention
             memo.pop(next(iter(memo)))
         return run(params, prompt, rng)

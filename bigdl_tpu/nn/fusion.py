@@ -97,6 +97,27 @@ def fold_batchnorm(model):
         {k: dict(v) if isinstance(v, dict) else v for k, v in params.items()})
     new_state = dict(state)
 
+    # Weight sharing guard: params are keyed by module NAME, so a module
+    # reused at several sites (same instance, or any name collision)
+    # shares one params slot — folding it once would corrupt every other
+    # use site.  Count occurrences across the WHOLE model up front; both
+    # the Graph and the Sequential paths refuse to fold any pair whose
+    # conv/linear or BN appears more than once.
+    occurrences = {}
+
+    def count(m):
+        if isinstance(m, Graph):
+            for n in m._topo:
+                if n.module is not None:
+                    count(n.module)
+            return
+        occurrences[m.name] = occurrences.get(m.name, 0) + 1
+        if isinstance(m, Container):
+            for c in m.children():
+                count(c)
+
+    count(new_model)
+
     def fold_graph(g):
         """Splice conv->BN edges out of a DAG: fold when the BN is the
         conv's ONLY consumer (otherwise other consumers would see the
@@ -123,7 +144,9 @@ def fold_batchnorm(model):
             # weight sharing: the same module at MULTIPLE graph nodes
             # (siamese nets) — folding would corrupt the other use sites
             if node_count.get(id(a.module), 0) != 1 \
-                    or node_count.get(id(b.module), 0) != 1:
+                    or node_count.get(id(b.module), 0) != 1 \
+                    or occurrences.get(a.module.name, 0) > 1 \
+                    or occurrences.get(b.module.name, 0) > 1:
                 continue
             _fold_pair(a.module, b.module, new_params, new_state)
             new_params.pop(b.module.name, None)
@@ -153,7 +176,9 @@ def fold_batchnorm(model):
         while i < len(kids):
             mod = kids[i]
             nxt = kids[i + 1] if i + 1 < len(kids) else None
-            if nxt is not None and _foldable(mod, nxt, new_params):
+            if nxt is not None and _foldable(mod, nxt, new_params) \
+                    and occurrences.get(mod.name, 0) == 1 \
+                    and occurrences.get(nxt.name, 0) == 1:
                 _fold_pair(mod, nxt, new_params, new_state)
                 new_params.pop(nxt.name, None)
                 new_state.pop(nxt.name, None)

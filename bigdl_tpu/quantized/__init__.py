@@ -330,10 +330,12 @@ def _is_wq8(v):
 
 def quantize_weights_only(params, min_size=4096):
     """Weight-only int8 for big-model serving: every float matrix leaf
-    with >= ``min_size`` elements becomes ``{"__wq8__": True, "q": int8,
-    "s": per-output-channel fp32 scale}``; small leaves (biases, norms)
-    stay float.  Activations are untouched — on TPU the decode phase is
-    weight-STREAMING bound, so halving weight bytes in HBM is the win,
+    with >= ``min_size`` elements becomes ``{"q8": int8, "q8_scale":
+    per-output-channel fp32 scale}`` (the key set ``_is_wq8`` /
+    :func:`dequantize_weights` / :func:`quantized_bytes` detect); small
+    leaves (biases, norms) stay float.  Activations are untouched — on
+    TPU the decode phase is weight-STREAMING bound, so halving weight
+    bytes in HBM is the win,
     and XLA fuses the int8->bf16 upconvert into the consuming matmul's
     operand read.
 

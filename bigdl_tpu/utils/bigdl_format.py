@@ -46,6 +46,7 @@ _DT_FLOAT, _DT_DOUBLE, _DT_INT32, _DT_INT64, _DT_BOOL = 2, 3, 0, 1, 5
 _DT_STRING = 4
 _DT_TENSOR, _DT_ARRAY = 10, 15
 _DT_NAME_ATTR_LIST = 14
+_DT_MODULE = 13   # bigdl.proto DataType.MODULE (12 is INITMETHOD)
 
 
 # --------------------------------------------------------------------- #
@@ -376,6 +377,24 @@ def _build_cell(tree):
     return cell
 
 
+def _hidden_shapes_ok(t, a, own):
+    """Would `own` still satisfy the cell's hidden-weight shape scan?
+    Used to validate the lead-match drop when includePreTopology is
+    absent from the wire (older files)."""
+    mats = [m for m in own if m.ndim == 2]
+    if t == "LSTM":
+        h = int(a["hiddenSize"])
+        return any(m.shape[0] == 4 * h for m in mats)
+    if t == "GRU":
+        h = int(a["outputSize"])
+        return (any(m.shape[0] == 2 * h for m in mats)
+                and any(m.shape == (h, h) for m in mats))
+    if t == "RnnCell":
+        h = int(a["hiddenSize"])
+        return any(m.shape == (h, h) for m in mats)
+    return True
+
+
 def _pick_mat(mats, pred, what, t):
     for m in mats:
         if pred(m):
@@ -409,9 +428,12 @@ def _cell_weights(tree):
     # Sequential(pre, cell)) — drop them positionally so the shape-driven
     # hidden-weight scan can't pick the input Linear when input size ==
     # hidden size (the decoder's feedback case).  Keyed on the cell's
-    # serialized includePreTopology attr (CellSerializer writes it); the
-    # lead-match heuristic only kicks in when the attr is absent, so a
-    # plain cell with genuinely tied weights is never mis-dropped.
+    # serialized includePreTopology attr (CellSerializer writes it).
+    # When the attr is ABSENT (older files) the lead-match heuristic is
+    # only trusted if the remaining params still carry the expected
+    # hidden-weight shapes — a plain cell with genuinely tied input
+    # weights (lead matches by value, but those ARE its hidden weights)
+    # keeps its full list instead of being mis-dropped.
     own = [np.asarray(q, np.float32) for q in tree["params"]]
     n_pre = len(pre_params)
     inc = a.get("includePreTopology")
@@ -422,13 +444,15 @@ def _cell_weights(tree):
         and all(np.array_equal(own[i],
                                np.asarray(pre_params[i], np.float32))
                 for i in range(n_pre)))
-    if inc or (inc is None and lead_matches):
-        if lead_matches:
-            own = own[n_pre:]
-        elif inc:
+    if inc:
+        if not lead_matches:
             raise ValueError(
                 f".bigdl {t}: includePreTopology=true but the flat "
                 "params do not lead with the preTopology weights")
+        own = own[n_pre:]
+    elif inc is None and lead_matches \
+            and _hidden_shapes_ok(t, a, own[n_pre:]):
+        own = own[n_pre:]
     if t == "LSTM":
         h = int(a["hiddenSize"])
         w_h = _pick_mat(own, lambda m: m.ndim == 2 and m.shape[0] == 4 * h,
@@ -1239,7 +1263,7 @@ def _enc_module(mod, params, state, counter, global_entries) -> bytes:
                                                     global_entries))
         layer_bytes = _enc_module(mod.layer, params, state, counter,
                                   global_entries)
-        body += _attr_entry("layer", enc_int64(1, 12)
+        body += _attr_entry("layer", enc_int64(1, _DT_MODULE)
                             + enc_bytes(13, layer_bytes))
         body += _attr_entry("maskZero", _attr_bool(False))
         return body

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Round-5 tunnel watcher: retry the measurement queue until it fully
+# succeeds. Probe cadence ~25 min (established r4 discipline); exactly
+# one TPU-touching process (this loop) at any time.
+LOG=/root/repo/artifacts/tpu_watch_r5.log
+cd /root/repo
+while true; do
+  echo "=== [$(date -u '+%Y-%m-%d %H:%M:%S')] queue attempt ===" >> "$LOG"
+  python scripts/tpu_queue.py >> "$LOG" 2>&1
+  rc=$?
+  echo "=== [$(date -u '+%Y-%m-%d %H:%M:%S')] queue rc=$rc ===" >> "$LOG"
+  if [ $rc -eq 0 ]; then
+    echo "=== WATCHER DONE: full queue green ===" >> "$LOG"
+    break
+  fi
+  sleep 1380
+done

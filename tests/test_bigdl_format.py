@@ -432,8 +432,9 @@ def _attr_d(v):
 
 
 def _attr_mod(mod_bytes):
-    # DataType MODULE is irrelevant to our reader (it keys off field 13)
-    return enc_int64(1, 12) + enc_bytes(13, mod_bytes)
+    # DataType MODULE = 13 (bigdl.proto:112) so fixtures match real
+    # reference files; our reader keys off field 13 regardless.
+    return enc_int64(1, 13) + enc_bytes(13, mod_bytes)
 
 
 def _linear_module(name, w, b=None):
@@ -893,6 +894,43 @@ def test_new_types_roundtrip():
     kinds = [type(c).__name__ for c in m2.modules()]
     for k in ("LookupTable", "TemporalConvolution", "TimeDistributed"):
         assert k in kinds, kinds
+
+
+def test_module_attr_datatype_is_module_13():
+    """save_bigdl must tag module-valued attrs DataType.MODULE = 13
+    (bigdl.proto:112); the reference DataConverter dispatches on
+    dataType, so 12 (INITMETHOD) would route to the wrong converter
+    and the file would fail to load in the reference."""
+    m = nn.Sequential(nn.TimeDistributed(nn.Linear(5, 4)))
+    m.reset(3)
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "td.bigdl")
+        save_bigdl(m, p)
+        with open(p, "rb") as f:
+            buf = f.read()
+
+    found = []
+
+    def walk_module(mod_bytes):
+        for field, wire, val in proto.iter_fields(mod_bytes):
+            if field == 2 and wire == 2:        # subModules
+                walk_module(val)
+            elif field == 8 and wire == 2:      # attr map entry
+                key, attr = None, None
+                for f2, w2, v2 in proto.iter_fields(val):
+                    if f2 == 1 and w2 == 2:
+                        key = v2.decode()
+                    elif f2 == 2 and w2 == 2:
+                        attr = v2
+                if key == "layer" and attr is not None:
+                    dtype = None
+                    for f3, w3, v3 in proto.iter_fields(attr):
+                        if f3 == 1 and w3 == 0:
+                            dtype = v3
+                    found.append(dtype)
+
+    walk_module(buf)
+    assert found == [13], found
 
 
 def test_padding_types_roundtrip():

@@ -111,6 +111,24 @@ def test_resnet_fold_parity():
                                rtol=2e-4, atol=2e-5)
 
 
+def test_sequential_shared_module_not_folded():
+    """The SAME Linear instance at two Sequential sites (weight sharing,
+    one shared params slot keyed by name): folding the lin->BN pair at
+    the first site would corrupt the second — must be skipped."""
+    shared = nn.Linear(6, 6)
+    m = nn.Sequential(shared, nn.BatchNormalization(6), nn.ReLU(),
+                      shared)
+    m.reset(21)
+    _train_stats(m, (8, 6))
+    x = np.random.RandomState(22).randn(4, 6).astype(np.float32)
+    y0 = np.asarray(m.forward(x))
+    folded = fold_batchnorm(m)
+    kinds = [type(c).__name__ for c in folded.modules()]
+    assert kinds.count("BatchNormalization") == 1
+    np.testing.assert_allclose(np.asarray(folded.forward(x)), y0,
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_graph_model_fold_parity():
     """Graph DAGs (caffe-style): conv->BN edges splice out; a conv
     feeding BOTH a BN and a skip connection must NOT fold (other
