@@ -5,10 +5,15 @@ runtime: ``ControlNodes.whileLoop`` wires Enter/Merge/LoopCondition/
 Switch/NextIteration/Exit nodes (nn/tf/ControlOps.scala:296) which
 ``FrameManager`` (nn/FrameManager.scala:31) schedules inside a
 ``DynamicGraph``; ``ControlNodes.switch``/``merge`` (:245, :261) give
-data-dependent branching.  The TPU-native equivalents compile the whole
+data-dependent branching, and ``DynamicGraph.backward``
+(nn/DynamicGraph.scala:62, generateBackward :32) differentiates through
+the control clusters.  The TPU-native equivalents compile the whole
 construct into the XLA program instead:
 
-  * :class:`WhileLoop` — ``lax.while_loop`` over a Table of loop vars
+  * :class:`WhileLoop` — ``lax.while_loop`` over a Table of loop vars;
+    with ``max_iters=N`` it lowers to a bounded ``lax.scan`` with an
+    active-mask carry, which IS reverse-differentiable (the TPU-native
+    answer to DynamicGraph.generateBackward).
   * :class:`Cond`      — ``lax.cond`` over two branches
 
 (The same lowering the TF importer applies to frame clusters found in
@@ -23,7 +28,33 @@ from jax import lax
 from ..utils.table import Table, as_list
 from .module import Ctx, Module
 
-__all__ = ["WhileLoop", "Cond"]
+__all__ = ["WhileLoop", "Cond", "bounded_while"]
+
+
+def bounded_while(cond_fn, body_fn, init, max_iters):
+    """``while cond_fn(state): state = body_fn(state)`` as a bounded
+    ``lax.scan`` with a sticky active mask — the reverse-differentiable
+    lowering shared by :class:`WhileLoop` (``max_iters=``) and the TF
+    importer's trained loops (utils/tf_import.py).  ``state`` is a tuple
+    of arrays; once ``cond_fn`` goes false the state freezes, so the
+    result equals the unbounded loop whenever it terminates within
+    ``max_iters`` (beyond that it is truncated).  All ``max_iters``
+    body iterations are computed (masked) every call."""
+    def step(carry, _):
+        state, active = carry
+        # while semantics: test cond on the CURRENT state, then run the
+        # body only while still active; once inactive the state freezes
+        # (cond re-evaluates false on the frozen state, and `active` is
+        # sticky anyway)
+        active = jnp.logical_and(active, cond_fn(state))
+        new = body_fn(state)
+        state = tuple(jnp.where(active, n, s)
+                      for n, s in zip(new, state))
+        return (state, active), None
+
+    (final, _), _ = lax.scan(step, (tuple(init), jnp.bool_(True)), None,
+                             length=int(max_iters))
+    return final
 
 
 def _as_tuple(x):
@@ -43,16 +74,29 @@ class WhileLoop(Module):
     shapes/dtypes.  The input activation is the initial state; the
     output is the final state.
 
-    XLA's while is not reverse-differentiable — use inside inference /
-    non-gradient paths, or under ``lax.stop_gradient`` semantics (the
-    reference's dynamic graphs were likewise inference-oriented).
+    Two lowerings:
+
+    * ``max_iters=None`` (default): ``lax.while_loop`` — unbounded trip
+      count, but XLA's while is not reverse-differentiable; use inside
+      inference / non-gradient paths.
+    * ``max_iters=N``: a bounded ``lax.scan`` over N steps carrying an
+      active mask — each step freezes the state once ``cond`` goes
+      false, so the result equals the unbounded loop whenever it
+      terminates within N iterations (beyond N it is truncated).  The
+      scan IS reverse-differentiable: gradients flow through exactly
+      the iterations that executed, matching the reference's
+      DynamicGraph backward over control clusters
+      (nn/DynamicGraph.scala:62).  Cost: all N body iterations are
+      always computed (masked), so pick N near the real trip bound.
+
     ``cond``/``body`` must be stateless (no BN running stats inside).
     """
 
-    def __init__(self, cond, body, name=None):
+    def __init__(self, cond, body, max_iters=None, name=None):
         super().__init__(name=name)
         self.cond = cond
         self.body = body
+        self.max_iters = max_iters
 
     def children(self):
         return [self.cond, self.body]
@@ -88,7 +132,10 @@ class WhileLoop(Module):
             out = self.body.apply(params, _pack(state, x), sub_ctx())
             return tuple(jnp.asarray(v) for v in _as_tuple(out))
 
-        final = lax.while_loop(c, b, init)
+        if self.max_iters is None:
+            final = lax.while_loop(c, b, init)
+        else:
+            final = bounded_while(c, b, init, self.max_iters)
         return _pack(final, x)
 
 
@@ -99,13 +146,17 @@ class Cond(Module):
     nn/tf/ControlOps.scala).  Differentiable; both branches must return
     matching shapes/dtypes.
 
-    The branches run inside the ``lax.cond`` trace, so training-mode
-    state writes (BN running stats) and side losses raised INSIDE a
-    branch do not propagate out — the two branches' state trees would
-    have to match structurally for a merged carry.  Branch children may
-    still READ persistent state (eval-mode BN works); keep stat-updating
-    training layers outside the branches.  ``pred`` runs outside the
-    cond with the real ctx."""
+    Training-mode state writes (BN running stats) and side losses
+    raised INSIDE a branch propagate out whenever the two branches'
+    carries can be merged into one ``lax.cond`` output: state writes
+    are unioned (a key only one branch writes falls back to its
+    current persistent value on the other side, so shapes match), and
+    side-loss lists are zero-padded to a common length.  When merging
+    is impossible (e.g. a branch writes state with no current value to
+    fall back on, or side losses of mismatched shapes), those effects
+    are dropped inside the branches — the pre-round-5 behavior — and
+    only the branch output propagates.  ``pred`` runs outside the cond
+    with the real ctx, so its effects always propagate."""
 
     def __init__(self, pred, true_branch, false_branch, name=None):
         super().__init__(name=name)
@@ -134,15 +185,124 @@ class Cond(Module):
         return st
 
     def apply(self, params, x, ctx):
-        def sub_ctx():
-            return Ctx(state=ctx.state, training=ctx.training,
-                       rng_key=ctx.rng_key)
-
         # pred runs OUTSIDE lax.cond: its state writes / side losses
         # propagate through the real ctx
         p = jnp.reshape(self.pred.apply(params, x, ctx), ())
-        return lax.cond(
-            p,
-            lambda v: self.true_branch.apply(params, v, sub_ctx()),
-            lambda v: self.false_branch.apply(params, v, sub_ctx()),
-            x)
+
+        def capture(branch):
+            """Branch fn returning (out, new_state, side_losses)."""
+            def f(v):
+                c = Ctx(state=ctx.state, training=ctx.training,
+                        rng_key=ctx.rng_key)
+                out = branch.apply(params, v, c)
+                return out, dict(c.new_state), tuple(c.side_losses)
+            return f
+
+        f_t = capture(self.true_branch)
+        f_f = capture(self.false_branch)
+        # fallback values come from the EFFECTIVE current state (an
+        # earlier same-named module's write in this forward must not be
+        # clobbered with the pre-forward value)
+        eff_state = {**ctx.state, **ctx.new_state}
+        plan = self._merge_plan(f_t, f_f, x, eff_state, ctx)
+        if plan is None:
+            # unmergeable carries: branch-internal effects are dropped
+            return lax.cond(p, lambda v: f_t(v)[0],
+                            lambda v: f_f(v)[0], x)
+        union, pads = plan
+        tu = jax.tree_util
+
+        def wrap(f):
+            def g(v):
+                out, new_state, losses = f(v)
+                merged = {
+                    k: tu.tree_map(jnp.asarray,
+                                   new_state[k] if k in new_state
+                                   else eff_state[k])
+                    for k in union}
+                losses = tuple(losses) + tuple(
+                    jnp.zeros(shape, dtype)
+                    for shape, dtype in pads[len(losses):])
+                return out, merged, losses
+            return g
+
+        out, new_state, losses = lax.cond(p, wrap(f_t), wrap(f_f), x)
+        ctx.new_state.update(new_state)
+        ctx.side_losses.extend(losses)
+        return out
+
+    def _merge_plan(self, f_t, f_f, x, eff_state, ctx):
+        """(union_keys, loss_pad_shapes) when the two branches' carries
+        can be merged into one lax.cond output, else None.  The decision
+        depends only on branch structure and input/state shapes, so it
+        is cached per (training, rng, input-shape) signature — the two
+        eval_shape traces run once, not on every eager forward."""
+        tu = jax.tree_util
+        cache = getattr(self, "_merge_plan_cache", None)
+        if cache is None:
+            cache = self._merge_plan_cache = {}
+        try:
+            key = (bool(ctx.training), ctx.rng_key is None,
+                   tu.tree_structure(x),
+                   tuple((tuple(jnp.shape(l)), jnp.result_type(l).name)
+                         for l in tu.tree_leaves(x)))
+        except Exception:
+            key = None
+        if key is not None and key in cache:
+            plan = cache[key]
+            # cheap revalidation: every fallback key must still exist
+            if plan is None or all(k in eff_state for k in plan[0]):
+                return plan
+        plan = self._compute_merge_plan(f_t, f_f, x, eff_state)
+        if key is not None:
+            cache[key] = plan
+        return plan
+
+    @staticmethod
+    def _compute_merge_plan(f_t, f_f, x, eff_state):
+        tu = jax.tree_util
+
+        def struct_eq(have, want):
+            """`have` (arrays) matches `want` (ShapeDtypeStructs)?"""
+            try:
+                flags = tu.tree_map(
+                    lambda a, w: jnp.shape(a) == tuple(w.shape)
+                    and jnp.result_type(a) == w.dtype, have, want)
+            except ValueError:          # tree structure mismatch
+                return False
+            return all(tu.tree_leaves(flags))
+
+        try:
+            _, st_t, ls_t = jax.eval_shape(f_t, x)
+            _, st_f, ls_f = jax.eval_shape(f_f, x)
+        except Exception:
+            return None
+        if not (st_t or st_f or ls_t or ls_f):
+            return None          # nothing to merge — skip the overhead
+
+        union = sorted(set(st_t) | set(st_f))
+        for k in union:
+            if k in st_t and k in st_f:
+                # both write: carries must agree shape/dtype-wise
+                ok = tu.tree_structure(st_t[k]) == tu.tree_structure(
+                    st_f[k]) and all(tu.tree_leaves(tu.tree_map(
+                        lambda a, b: a.shape == b.shape
+                        and a.dtype == b.dtype, st_t[k], st_f[k])))
+                if not ok:
+                    return None
+            else:
+                # one-sided write: the other side falls back to the
+                # key's CURRENT effective value, which must exist and
+                # match the writing branch's shapes
+                want = st_t[k] if k in st_t else st_f[k]
+                if k not in eff_state or not struct_eq(eff_state[k],
+                                                       want):
+                    return None
+
+        # side losses pair positionally; the shorter list zero-pads
+        for a, b in zip(ls_t, ls_f):
+            if a.shape != b.shape or a.dtype != b.dtype:
+                return None
+        longer = ls_t if len(ls_t) >= len(ls_f) else ls_f
+        pads = tuple((tuple(s.shape), s.dtype) for s in longer)
+        return union, pads

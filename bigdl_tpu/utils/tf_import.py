@@ -464,122 +464,152 @@ def _base(ref: str) -> str:
     return ref.split(":")[0].lstrip("^")
 
 
-def _rewrite_while_frames(nodes: Dict[str, NodeDef]) -> Dict[str, NodeDef]:
-    enters_by_frame: Dict[str, List[str]] = {}
-    for n in nodes.values():
-        if n.op in ("Enter", "RefEnter"):
-            enters_by_frame.setdefault(
-                str(n.attrs.get("frame_name", "")), []).append(n.name)
-    if not enters_by_frame:
-        return nodes
-
-    consumers: Dict[str, List[str]] = {}
-    for n in nodes.values():
-        for i in n.inputs:
-            consumers.setdefault(_base(i), []).append(n.name)
-
-    out = dict(nodes)
-    for frame, enter_names in sorted(enters_by_frame.items()):
-        # frame membership: forward reachability from the Enters,
-        # stopping at Exit (the only legal frame escape)
-        member = set(enter_names)
-        queue = list(enter_names)
-        exits: List[str] = []
-        while queue:
-            for c in consumers.get(queue.pop(), ()):
-                if c in member:
-                    continue
-                cn = nodes[c]
-                if cn.op in ("Exit", "RefExit"):
-                    member.add(c)
-                    exits.append(c)
-                    continue
-                if cn.op in ("Enter", "RefEnter") \
-                        and str(cn.attrs.get("frame_name", "")) != frame:
-                    raise NotImplementedError(
-                        f"nested while frames ({frame!r} feeds "
-                        f"{cn.attrs.get('frame_name')!r}) are not supported")
-                member.add(c)
-                queue.append(c)
-
-        loop_conds = [m for m in member if nodes[m].op == "LoopCond"]
-        if len(loop_conds) != 1:
-            raise NotImplementedError(
-                f"while frame {frame!r}: expected exactly one LoopCond, "
-                f"found {len(loop_conds)}")
-        loop_cond = loop_conds[0]
-
-        merges = sorted(m for m in member if nodes[m].op in ("Merge",
-                                                             "RefMerge"))
-        merge_info = []           # (merge, enter_ref, next_ref, switch|None)
-        switch_of: Dict[str, str] = {}
-        for m in merges:
-            ins = [i for i in nodes[m].inputs if not i.startswith("^")]
-            enter_ref = next((i for i in ins
-                              if nodes[_base(i)].op in ("Enter",
-                                                        "RefEnter")), None)
-            next_ref = next((i for i in ins
-                             if nodes[_base(i)].op == "NextIteration"), None)
-            if enter_ref is None or next_ref is None:
-                raise NotImplementedError(
-                    f"while frame {frame!r}: Merge {m!r} is not an "
-                    "Enter/NextIteration pair")
-            sw = next((c for c in consumers.get(m, ())
-                       if nodes[c].op in ("Switch", "RefSwitch")), None)
-            if sw is not None:
-                pred = [i for i in nodes[sw].inputs
-                        if not i.startswith("^")][1]
-                if _base(pred) != loop_cond:
-                    raise NotImplementedError(
-                        f"while frame {frame!r}: Switch {sw!r} predicate "
-                        "is not the frame's LoopCond (conditionals inside "
-                        "a loop body are not supported)")
-                switch_of[m] = sw
-            merge_info.append((m, enter_ref, next_ref, sw))
-
-        # Exit -> loop-var index (via its Switch)
-        exit_var: Dict[str, int] = {}
-        for e in exits:
-            e_in = _base([i for i in nodes[e].inputs
-                          if not i.startswith("^")][0])
-            idx = next((k for k, (_, _, _, sw) in enumerate(merge_info)
-                        if sw == e_in), None)
-            if idx is None:
-                raise NotImplementedError(
-                    f"while frame {frame!r}: Exit {e!r} does not consume "
-                    "a loop-variable Switch")
-            exit_var[e] = idx
-
-        while_name = f"__while__{frame}"
-        frame_nodes = {m: nodes[m] for m in member}
-        # every ref a frame node reads from OUTSIDE the frame (Enter
-        # sources, plus consts/tensors captured without an Enter) becomes
-        # a data input of the synthetic node, so the outer toposort
-        # schedules them and the frame evaluator can bind them
-        externals: List[str] = []
-        for m in sorted(member):
-            if nodes[m].op in ("Exit", "RefExit"):
+def _scan_frame(nodes, consumers, frame, enter_names):
+    """Frame membership: forward reachability from the Enters, stopping
+    at Exit (the only legal frame escape).  Returns (member, exits), or
+    None when the frame contains another frame's Enter — i.e. it has a
+    NESTED inner loop that must be rewritten first."""
+    member = set(enter_names)
+    queue = list(enter_names)
+    exits: List[str] = []
+    while queue:
+        for c in consumers.get(queue.pop(), ()):
+            if c in member:
                 continue
-            for i in nodes[m].inputs:
-                if not i.startswith("^") and _base(i) not in member \
-                        and i not in externals:
-                    externals.append(i)
-        wnode = NodeDef(while_name, "_While",
-                        inputs=list(externals),
-                        attrs={"_frame": {
-                            "name": frame,
-                            "nodes": frame_nodes,
-                            "externals": externals,
-                            "merge_info": merge_info,
-                            "cond_ref": nodes[loop_cond].inputs[0],
-                        }})
-        for m in member:
-            if m not in exits:
-                del out[m]
-        out[while_name] = wnode
-        for e in exits:
-            out[e] = NodeDef(e, "_WhileOut",
-                             inputs=[f"{while_name}:{exit_var[e]}"])
+            cn = nodes[c]
+            if cn.op in ("Exit", "RefExit"):
+                member.add(c)
+                exits.append(c)
+                continue
+            if cn.op in ("Enter", "RefEnter") \
+                    and str(cn.attrs.get("frame_name", "")) != frame:
+                return None        # inner frame present: not innermost
+            member.add(c)
+            queue.append(c)
+    return member, exits
+
+
+def _rewrite_one_frame(out, consumers, frame, member, exits):
+    """Collapse one (innermost) frame's nodes into a synthetic `_While`
+    node + `_WhileOut` exit stubs.  Mutates `out`."""
+    nodes = out
+    loop_conds = [m for m in member if nodes[m].op == "LoopCond"]
+    if len(loop_conds) != 1:
+        raise NotImplementedError(
+            f"while frame {frame!r}: expected exactly one LoopCond, "
+            f"found {len(loop_conds)}")
+    loop_cond = loop_conds[0]
+
+    def switch_pred_base(sw):
+        return _base([i for i in nodes[sw].inputs
+                      if not i.startswith("^")][1])
+
+    # loop-variable merges: Enter/NextIteration pairs.  Merges with other
+    # input patterns are tf.cond joins inside the body — left in the
+    # frame body for the evaluator's select lowering.
+    merges = sorted(m for m in member if nodes[m].op in ("Merge",
+                                                         "RefMerge"))
+    merge_info = []           # (merge, enter_ref, next_ref, switch|None)
+    for m in merges:
+        ins = [i for i in nodes[m].inputs if not i.startswith("^")]
+        enter_ref = next((i for i in ins
+                          if nodes[_base(i)].op in ("Enter",
+                                                    "RefEnter")), None)
+        next_ref = next((i for i in ins
+                         if nodes[_base(i)].op == "NextIteration"), None)
+        if enter_ref is None or next_ref is None:
+            continue                    # conditional join, not a loop var
+        # the loop-variable Switch is the consumer switching on the
+        # frame's LoopCond; switches with other predicates are body
+        # conditionals
+        sw = next((c for c in consumers.get(m, ())
+                   if nodes[c].op in ("Switch", "RefSwitch")
+                   and switch_pred_base(c) == loop_cond), None)
+        merge_info.append((m, enter_ref, next_ref, sw))
+
+    # Exit -> loop-var index (via its Switch)
+    exit_var: Dict[str, int] = {}
+    for e in exits:
+        e_in = _base([i for i in nodes[e].inputs
+                      if not i.startswith("^")][0])
+        idx = next((k for k, (_, _, _, sw) in enumerate(merge_info)
+                    if sw == e_in), None)
+        if idx is None:
+            raise NotImplementedError(
+                f"while frame {frame!r}: Exit {e!r} does not consume "
+                "a loop-variable Switch")
+        exit_var[e] = idx
+
+    while_name = f"__while__{frame}"
+    frame_nodes = {m: nodes[m] for m in member}
+    # every ref a frame node reads from OUTSIDE the frame (Enter
+    # sources, plus consts/tensors captured without an Enter) becomes
+    # a data input of the synthetic node, so the outer toposort
+    # schedules them and the frame evaluator can bind them
+    externals: List[str] = []
+    for m in sorted(member):
+        if nodes[m].op in ("Exit", "RefExit"):
+            continue
+        for i in nodes[m].inputs:
+            if not i.startswith("^") and _base(i) not in member \
+                    and i not in externals:
+                externals.append(i)
+    wnode = NodeDef(while_name, "_While",
+                    inputs=list(externals),
+                    attrs={"_frame": {
+                        "name": frame,
+                        "nodes": frame_nodes,
+                        "externals": externals,
+                        "merge_info": merge_info,
+                        "cond_ref": nodes[loop_cond].inputs[0],
+                        "loop_cond": loop_cond,
+                    }})
+    for m in member:
+        if m not in exits:
+            del out[m]
+    out[while_name] = wnode
+    for e in exits:
+        out[e] = NodeDef(e, "_WhileOut",
+                         inputs=[f"{while_name}:{exit_var[e]}"])
+
+
+def _rewrite_while_frames(nodes: Dict[str, NodeDef]) -> Dict[str, NodeDef]:
+    """Collapse TF v1 while frames to synthetic `_While` nodes,
+    innermost-first: a frame whose body contains another frame's Enter
+    nodes (loops-in-loops, ≙ FrameManager.createFrame(parentFrame),
+    nn/FrameManager.scala:40,115-120) waits until the inner frame has
+    been rewritten into an ordinary `_While` node, then collapses around
+    it like any other body op."""
+    out = dict(nodes)
+    # each pass collapses exactly one frame, so the total frame count
+    # (NOT the nesting depth) bounds the passes
+    n_frames = len({str(n.attrs.get("frame_name", ""))
+                    for n in nodes.values()
+                    if n.op in ("Enter", "RefEnter")})
+    for _ in range(n_frames):
+        enters_by_frame: Dict[str, List[str]] = {}
+        for n in out.values():
+            if n.op in ("Enter", "RefEnter"):
+                enters_by_frame.setdefault(
+                    str(n.attrs.get("frame_name", "")), []).append(n.name)
+        if not enters_by_frame:
+            break
+        consumers: Dict[str, List[str]] = {}
+        for n in out.values():
+            for i in n.inputs:
+                consumers.setdefault(_base(i), []).append(n.name)
+        progressed = False
+        for frame, enter_names in sorted(enters_by_frame.items()):
+            info = _scan_frame(out, consumers, frame, enter_names)
+            if info is None:
+                continue                    # has an inner frame: later pass
+            _rewrite_one_frame(out, consumers, frame, *info)
+            progressed = True
+            break                           # node set changed: rescan
+        if not progressed:
+            raise NotImplementedError(
+                "while frames: no innermost frame found "
+                f"(malformed nesting among {sorted(enters_by_frame)})")
     return out
 
 
@@ -590,11 +620,16 @@ class TFGraph(Module):
     `_rewrite_while_frames`)."""
 
     def __init__(self, nodes: List[NodeDef], inputs: Sequence[str],
-                 outputs: Sequence[str], name=None):
+                 outputs: Sequence[str], name=None, while_max_iters=None):
         super().__init__(name=name)
         self.nodes = _rewrite_while_frames({n.name: n for n in nodes})
         self.input_names = list(inputs)
         self.output_names = list(outputs)
+        # bounded-scan lowering for every imported loop: trades "always
+        # run max_iters masked iterations" for reverse-differentiability
+        # (same contract as nn.WhileLoop(max_iters=...) — the TPU-native
+        # DynamicGraph.generateBackward, nn/DynamicGraph.scala:32)
+        self.while_max_iters = while_max_iters
         self.consts: Dict[str, np.ndarray] = {
             n.name: n.attrs["value"]
             for n in self.nodes.values() if n.op == "Const"}
@@ -702,7 +737,33 @@ class TFGraph(Module):
     def _run_while(self, frame, ext_vals, outer_env):
         fnodes: Dict[str, NodeDef] = frame["nodes"]
         merge_info = frame["merge_info"]
+        loop_cond = frame.get("loop_cond")
+        loopvar_merges = {m for m, _, _, _ in merge_info}
         ext_env = dict(zip(frame["externals"], ext_vals))
+
+        def data_inputs(nd):
+            return [i for i in nd.inputs if not i.startswith("^")]
+
+        def branch_slots(ref, visited):
+            """{(pred_ref, slot)} of the body-conditional Switch slots
+            `ref` transitively consumes — the join identity a tf.cond
+            Merge needs.  Stops at loop-var merges and frame borders."""
+            b2 = _base(ref)
+            nd2 = fnodes.get(b2)
+            found = set()
+            if nd2 is None or b2 in loopvar_merges:
+                return found
+            if nd2.op in ("Switch", "RefSwitch"):
+                ins2 = data_inputs(nd2)
+                if _base(ins2[1]) != loop_cond:
+                    found.add((ins2[1], int(ref.partition(":")[2] or 0)))
+                return found
+            if b2 in visited:
+                return found
+            visited.add(b2)
+            for i in data_inputs(nd2):
+                found |= branch_slots(i, visited)
+            return found
 
         def feval(ref, env):
             b = _base(ref)
@@ -719,14 +780,75 @@ class TFGraph(Module):
                 elif nd.op in ("Enter", "RefEnter", "Identity", "LoopCond",
                                "NextIteration", "StopGradient"):
                     env[b] = feval(nd.inputs[0], env)
-                elif nd.op in ("Merge", "RefMerge", "Switch", "RefSwitch",
-                               "Exit", "RefExit"):
+                elif nd.op == "_While":
+                    # an inner (nested) loop already collapsed by the
+                    # innermost-first rewrite: run it like any body op
+                    args = [feval(i, env) for i in data_inputs(nd)]
+                    env[b] = _MultiOut(
+                        self._run_while(nd.attrs["_frame"], args, env))
+                elif nd.op == "_WhileOut":
+                    env[b] = feval(nd.inputs[0], env)
+                elif nd.op in ("Switch", "RefSwitch"):
+                    ins = data_inputs(nd)
+                    if _base(ins[1]) == loop_cond:
+                        # loop-skeleton switch: inside the body only the
+                        # taken (:1) branch is live
+                        env[b] = _MultiOut((_DEAD, feval(ins[0], env)))
+                    else:
+                        # tf.cond inside the body: both branch slots see
+                        # the value; the join Merge selects by predicate
+                        # (XLA-native vectorized conditional)
+                        v = feval(ins[0], env)
+                        env[b] = _MultiOut((v, v))
+                elif nd.op in ("Merge", "RefMerge"):
+                    # non-loop-var merge: the join of a body tf.cond
+                    ins = data_inputs(nd)
+                    if len(ins) != 2:
+                        raise NotImplementedError(
+                            f"while frame {frame['name']!r}: Merge "
+                            f"{b!r} with {len(ins)} inputs is not a "
+                            "recognized conditional join")
+                    sl = [branch_slots(i, set()) for i in ins]
+                    preds = {p for s in sl for p, _ in s}
+                    if len(preds) != 1:
+                        raise NotImplementedError(
+                            f"while frame {frame['name']!r}: conditional "
+                            f"join {b!r} controlled by {len(preds)} "
+                            "predicates; only single-predicate tf.cond "
+                            "bodies are supported")
+
+                    slots = [{s for _, s in sli} for sli in sl]
+                    if any(len(s) > 1 for s in slots):
+                        raise NotImplementedError(
+                            f"while frame {frame['name']!r}: conditional "
+                            f"join {b!r} input consumes both Switch "
+                            "branches")
+                    # per-input identity: {1} = true branch, {0} = false
+                    # branch, {} = constant (takes whatever side is left)
+                    ids = [next(iter(s)) if s else None for s in slots]
+                    if ids == [None, None] or (ids[0] is not None
+                                               and ids[0] == ids[1]):
+                        raise NotImplementedError(
+                            f"while frame {frame['name']!r}: conditional "
+                            f"join {b!r} branches are not a true/false "
+                            f"pair (slots {ids})")
+                    if ids[0] == 1 or ids[1] == 0:
+                        i_true, i_false = 0, 1
+                    else:
+                        i_true, i_false = 1, 0
+                    pv = jnp.reshape(feval(next(iter(preds)), env), ())
+                    vt = jnp.asarray(feval(ins[i_true], env))
+                    vf = jnp.asarray(feval(ins[i_false], env))
+                    env[b] = _MultiOut((
+                        jnp.where(pv, vt, vf),
+                        jnp.where(pv, jnp.asarray(i_true, jnp.int32),
+                                  jnp.asarray(i_false, jnp.int32))))
+                elif nd.op in ("Exit", "RefExit"):
                     raise NotImplementedError(
                         f"while frame {frame['name']!r}: {nd.op} node "
                         f"{b!r} outside the recognized loop skeleton")
                 else:
-                    args = [feval(i, env) for i in nd.inputs
-                            if not i.startswith("^")]
+                    args = [feval(i, env) for i in data_inputs(nd)]
                     impl = _OP_IMPLS.get(nd.op)
                     if impl is None:
                         raise NotImplementedError(
@@ -760,18 +882,29 @@ class TFGraph(Module):
                 jnp.asarray(feval(next_ref, env))
                 for _, _, next_ref, _ in merge_info)
 
-        return lax.while_loop(cond_fn, body_fn, init)
+        if self.while_max_iters is None:
+            return lax.while_loop(cond_fn, body_fn, init)
+        # bounded differentiable lowering, shared with
+        # nn.WhileLoop(max_iters=...)
+        from ..nn.control_flow import bounded_while
+        return bounded_while(cond_fn, body_fn, init, self.while_max_iters)
 
 
 def load_tf_graph(path_or_bytes, inputs: Sequence[str],
-                  outputs: Sequence[str]) -> TFGraph:
-    """≙ TensorflowLoader.load(graphPrototxt, inputs, outputs)."""
+                  outputs: Sequence[str],
+                  while_max_iters=None) -> TFGraph:
+    """≙ TensorflowLoader.load(graphPrototxt, inputs, outputs).
+
+    ``while_max_iters=N`` lowers every imported while frame to a bounded
+    differentiable scan (see :class:`TFGraph`) so the imported graph can
+    TRAIN (≙ utils/tf/Session.scala:634 training over DynamicGraph)."""
     if isinstance(path_or_bytes, bytes):
         data = path_or_bytes
     else:
         with open(path_or_bytes, "rb") as f:
             data = f.read()
-    return TFGraph(parse_graphdef(data), inputs, outputs)
+    return TFGraph(parse_graphdef(data), inputs, outputs,
+                   while_max_iters=while_max_iters)
 
 
 # --------------------------------------------------------------------- #
