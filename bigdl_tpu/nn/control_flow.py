@@ -45,11 +45,17 @@ def bounded_while(cond_fn, body_fn, init, max_iters):
         # while semantics: test cond on the CURRENT state, then run the
         # body only while still active; once inactive the state freezes
         # (cond re-evaluates false on the frozen state, and `active` is
-        # sticky anyway)
+        # sticky anyway).  The freeze is a lax.cond, NOT a jnp.where
+        # over an always-executed body: on the frozen terminal state the
+        # body may compute non-finite values (sqrt of a negative, ...)
+        # and where's untaken branch still leaks 0*NaN=NaN into the VJP;
+        # cond executes (and differentiates) only the taken branch.
         active = jnp.logical_and(active, cond_fn(state))
-        new = body_fn(state)
-        state = tuple(jnp.where(active, n, s)
-                      for n, s in zip(new, state))
+        state = lax.cond(
+            active,
+            lambda s: tuple(jnp.asarray(v) for v in body_fn(s)),
+            lambda s: s,
+            state)
         return (state, active), None
 
     (final, _), _ = lax.scan(step, (tuple(init), jnp.bool_(True)), None,
@@ -253,13 +259,19 @@ class Cond(Module):
             # cheap revalidation: every fallback key must still exist
             if plan is None or all(k in eff_state for k in plan[0]):
                 return plan
-        plan = self._compute_merge_plan(f_t, f_f, x, eff_state)
-        if key is not None:
+        plan, stable = self._compute_merge_plan(f_t, f_f, x, eff_state)
+        # only cache outcomes that depend purely on branch structure +
+        # input signature ("merge" and "no effects at all"); a None from
+        # a transiently incomplete state dict or an eval_shape hiccup
+        # must not permanently disable effect propagation
+        if key is not None and stable:
             cache[key] = plan
         return plan
 
     @staticmethod
     def _compute_merge_plan(f_t, f_f, x, eff_state):
+        """Returns (plan, stable): plan is (union, pads) or None; stable
+        says whether the outcome may be cached for this signature."""
         tu = jax.tree_util
 
         def struct_eq(have, want):
@@ -276,9 +288,9 @@ class Cond(Module):
             _, st_t, ls_t = jax.eval_shape(f_t, x)
             _, st_f, ls_f = jax.eval_shape(f_f, x)
         except Exception:
-            return None
+            return None, False          # transient: retry next call
         if not (st_t or st_f or ls_t or ls_f):
-            return None          # nothing to merge — skip the overhead
+            return None, True    # structurally nothing to merge — cache
 
         union = sorted(set(st_t) | set(st_f))
         for k in union:
@@ -289,20 +301,21 @@ class Cond(Module):
                         lambda a, b: a.shape == b.shape
                         and a.dtype == b.dtype, st_t[k], st_f[k])))
                 if not ok:
-                    return None
+                    return None, True   # structural mismatch — cache
             else:
                 # one-sided write: the other side falls back to the
                 # key's CURRENT effective value, which must exist and
-                # match the writing branch's shapes
+                # match the writing branch's shapes.  State contents
+                # vary call to call, so this outcome is NOT cacheable.
                 want = st_t[k] if k in st_t else st_f[k]
                 if k not in eff_state or not struct_eq(eff_state[k],
                                                        want):
-                    return None
+                    return None, False
 
         # side losses pair positionally; the shorter list zero-pads
         for a, b in zip(ls_t, ls_f):
             if a.shape != b.shape or a.dtype != b.dtype:
-                return None
+                return None, True       # structural mismatch — cache
         longer = ls_t if len(ls_t) >= len(ls_f) else ls_f
         pads = tuple((tuple(s.shape), s.dtype) for s in longer)
-        return union, pads
+        return (union, pads), True

@@ -184,11 +184,16 @@ class GRU(Cell):
 
     def __init__(self, input_size, hidden_size, p=0.0, w_regularizer=None,
                  u_regularizer=None, b_regularizer=None,
-                 reset_after=False, name=None):
+                 reset_after=False, activation=None, inner_activation=None,
+                 name=None):
         super().__init__(name=name)
         self.input_size = input_size
         self.hidden_size = hidden_size
         self.reset_after = reset_after
+        # ≙ nn/GRU.scala:62-72 activation (candidate, default Tanh) /
+        # innerActivation (r+z gates, default Sigmoid)
+        self.activation = activation
+        self.inner_activation = inner_activation
 
     def init(self, rng):
         k1, k2 = jax.random.split(rng)
@@ -206,21 +211,25 @@ class GRU(Cell):
         p = self.own(params)
         g = p["gates"]
         n = p["new"]
+        inner = jax.nn.sigmoid if self.inner_activation is None else \
+            (lambda v: self.inner_activation.apply(params, v, ctx))
+        act = jnp.tanh if self.activation is None else \
+            (lambda v: self.activation.apply(params, v, ctx))
         z2 = (x @ g["weight_i"].astype(x.dtype)
               + h @ g["weight_h"].astype(x.dtype)
               + g["bias"].astype(x.dtype))
         if self.reset_after:
             z2 = z2 + g["bias_h"].astype(x.dtype)
-            r, z = jnp.split(jax.nn.sigmoid(z2), 2, axis=-1)
+            r, z = jnp.split(inner(z2), 2, axis=-1)
             rec = (h @ n["weight_h"].astype(x.dtype)
                    + n["bias_h"].astype(x.dtype))
-            nh = jnp.tanh(x @ n["weight_i"].astype(x.dtype)
-                          + n["bias"].astype(x.dtype) + r * rec)
+            nh = act(x @ n["weight_i"].astype(x.dtype)
+                     + n["bias"].astype(x.dtype) + r * rec)
         else:
-            r, z = jnp.split(jax.nn.sigmoid(z2), 2, axis=-1)
-            nh = jnp.tanh(x @ n["weight_i"].astype(x.dtype)
-                          + (r * h) @ n["weight_h"].astype(x.dtype)
-                          + n["bias"].astype(x.dtype))
+            r, z = jnp.split(inner(z2), 2, axis=-1)
+            nh = act(x @ n["weight_i"].astype(x.dtype)
+                     + (r * h) @ n["weight_h"].astype(x.dtype)
+                     + n["bias"].astype(x.dtype))
         h2 = (1.0 - z) * nh + z * h
         return h2, h2
 
@@ -366,13 +375,20 @@ class Recurrent(Module):
 
 class BiRecurrent(Module):
     """Bidirectional recurrence; merge defaults to elementwise add
-    (nn/BiRecurrent.scala:65 — CAddTable)."""
+    (nn/BiRecurrent.scala:65 — CAddTable).
 
-    def __init__(self, merge=None, cell=None, name=None):
+    ``is_split_input=True`` halves the FEATURE dim instead of duplicating
+    the input: first half to the forward RNN, second half to the backward
+    one (≙ BiRecurrent.scala:50-52 BifurcateSplitTable(featDim)); the
+    cell's input_size must then be half the model feature width."""
+
+    def __init__(self, merge=None, cell=None, is_split_input=False,
+                 name=None):
         super().__init__(name=name)
         self.merge = merge
         self.fwd_cell = cell
         self.bwd_cell = None
+        self.is_split_input = is_split_input
 
     def add(self, cell):
         import copy
@@ -412,8 +428,18 @@ class BiRecurrent(Module):
         self._ensure_bwd()
         fwd = Recurrent(self.fwd_cell, name=f"{self.name}_f")
         bwd = Recurrent(self.bwd_cell, name=f"{self.name}_b")
-        yf = fwd.apply(params, x, ctx)
-        yb = jnp.flip(bwd.apply(params, jnp.flip(x, axis=1), ctx), axis=1)
+        if self.is_split_input:
+            if x.shape[-1] % 2:
+                raise ValueError(
+                    f"{self.name}: is_split_input needs an even feature "
+                    f"dim, got {x.shape[-1]} "
+                    "(≙ BifurcateSplitTable divisibility check)")
+            half = x.shape[-1] // 2
+            xf, xb = x[..., :half], x[..., half:]
+        else:
+            xf = xb = x
+        yf = fwd.apply(params, xf, ctx)
+        yb = jnp.flip(bwd.apply(params, jnp.flip(xb, axis=1), ctx), axis=1)
         if self.merge is None:
             return yf + yb
         return self.merge.apply(params, Table(yf, yb), ctx)

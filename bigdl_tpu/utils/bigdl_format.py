@@ -351,13 +351,11 @@ def _build_cell(tree):
             inner_activation=_cell_activation(
                 a, "innerActivation", "Sigmoid", t))
     elif t == "GRU":
-        # our fused GRU hard-codes tanh/sigmoid; reject anything else
-        for key, dflt in (("activation", "Tanh"),
-                          ("innerActivation", "Sigmoid")):
-            if _cell_activation(a, key, dflt, t) is not None:
-                raise ValueError(
-                    f".bigdl GRU: non-default {key} is not supported")
-        cell = nn.GRU(int(a["inputSize"]), int(a["outputSize"]))
+        cell = nn.GRU(
+            int(a["inputSize"]), int(a["outputSize"]),
+            activation=_cell_activation(a, "activation", "Tanh", t),
+            inner_activation=_cell_activation(
+                a, "innerActivation", "Sigmoid", t))
     elif t == "RnnCell":
         act_tree = a.get("activation")
         act = _build_activation(act_tree, t) \
@@ -543,12 +541,11 @@ def _birnn_recurrents(birnn):
 def _build_birecurrent(tree):
     a = tree["attr"]
     if a.get("bnorm"):
+        # Recurrent(BatchNormParams) runs time-unrolled BN INSIDE the
+        # recurrence (BiRecurrent.scala:46-47) — out of scope, see
+        # docs/interop.md "known .bigdl limitations"
         raise ValueError(
             ".bigdl BiRecurrent(BatchNormParams) is not supported")
-    if a.get("isSplitInput"):
-        raise ValueError(
-            ".bigdl BiRecurrent(isSplitInput=true) is not supported "
-            "(feature-split bidirectional inputs)")
     birnn = a.get("birnn")
     if not isinstance(birnn, dict):
         raise ValueError(".bigdl BiRecurrent: missing birnn attr")
@@ -559,21 +556,40 @@ def _build_birecurrent(tree):
     if merge_t is not None and _short_type(merge_t["type"]) not in (
             "CAddTable",):
         merge = _build(merge_t)
+    # isSplitInput rides the ctor attr when present; older files show it
+    # structurally as a leading BifurcateSplitTable (BiRecurrent.scala:50)
+    split = bool(a.get("isSplitInput")) or any(
+        _short_type(s["type"]) == "BifurcateSplitTable"
+        for s in subs[:1])
     m = nn.BiRecurrent(merge=merge, cell=_build_cell(
-        fwd_t["attr"]["topology"]))
+        fwd_t["attr"]["topology"]), is_split_input=split)
     if tree["name"]:
         m.set_name(tree["name"])
     return m
 
 
-def _assign_cell_weights(params, cell_tree, target=None):
+def _assign_cell_weights(params, cell_tree, target=None,
+                         target_tree=None):
+    """Assign a serialized cell's weights into `params`.  `target`
+    renames the destination slot (BiRecurrent's backward cell is a
+    "<fwd>_bwd" rename of the forward one); for a MultiRNNCell the
+    renames apply per sub-cell, so `target_tree` carries the FORWARD
+    topology whose sub-cell names the built model used."""
     import jax
     if _short_type(cell_tree["type"]) == "MultiRNNCell":
-        if target is not None:
+        subs = cell_tree["attr"].get("cells") or []
+        if target is None:
+            for sub in subs:
+                _assign_cell_weights(params, sub)
+            return
+        fwd_subs = (target_tree or {}).get("attr", {}).get("cells") or []
+        if len(fwd_subs) != len(subs):
             raise ValueError(
-                ".bigdl BiRecurrent over MultiRNNCell is not supported")
-        for sub in cell_tree["attr"].get("cells") or []:
-            _assign_cell_weights(params, sub)
+                ".bigdl BiRecurrent over MultiRNNCell: forward/backward "
+                f"stacks differ ({len(fwd_subs)} vs {len(subs)} cells)")
+        for sub, fsub in zip(subs, fwd_subs):
+            _assign_cell_weights(params, sub,
+                                 target=f"{fsub['name']}_bwd")
         return
     cname, wd = _cell_weights(cell_tree)
     if target is not None:
@@ -860,7 +876,8 @@ def load_bigdl(path: str):
             # with the same shape/structure validation as the fwd cell
             fwd_name = fwd_t["attr"]["topology"]["name"]
             _assign_cell_weights(params, rev_t["attr"]["topology"],
-                                 target=f"{fwd_name}_bwd")
+                                 target=f"{fwd_name}_bwd",
+                                 target_tree=fwd_t["attr"]["topology"])
             return
         if st in _CELL_TYPES or st == "MultiRNNCell":
             _assign_cell_weights(params, sub)

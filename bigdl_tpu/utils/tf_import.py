@@ -837,10 +837,21 @@ class TFGraph(Module):
                     else:
                         i_true, i_false = 1, 0
                     pv = jnp.reshape(feval(next(iter(preds)), env), ())
-                    vt = jnp.asarray(feval(ins[i_true], env))
-                    vf = jnp.asarray(feval(ins[i_false], env))
+                    # genuine lax.cond over LAZY branch closures (not an
+                    # eager both-eval + where): only the taken branch
+                    # executes/differentiates, so a non-finite value on
+                    # the untaken side (sqrt of a negative, ...) cannot
+                    # leak 0*NaN=NaN into the gradients.  Each closure
+                    # evaluates into a COPY of the memo so cond-trace
+                    # tracers never escape into the outer env.
+                    t_ref, f_ref = ins[i_true], ins[i_false]
+                    val = lax.cond(
+                        pv,
+                        lambda _: jnp.asarray(feval(t_ref, dict(env))),
+                        lambda _: jnp.asarray(feval(f_ref, dict(env))),
+                        None)
                     env[b] = _MultiOut((
-                        jnp.where(pv, vt, vf),
+                        val,
                         jnp.where(pv, jnp.asarray(i_true, jnp.int32),
                                   jnp.asarray(i_false, jnp.int32))))
                 elif nd.op in ("Exit", "RefExit"):

@@ -117,6 +117,31 @@ def test_while_loop_scan_trains():
     assert np.isfinite(total) and total > 0
 
 
+def test_while_loop_scan_no_nan_leak_from_frozen_body():
+    """Once the loop freezes, the body would compute sqrt of a negative
+    on the terminal state; the lax.cond freeze must keep both the
+    forward AND the gradient finite (the 0*NaN=NaN where-grad trap)."""
+    # h_{k+1} = sqrt(h_k) - 0.5: from h=1.0 -> 0.5 -> ~0.207 -> negative
+    cond = FnModule(lambda h: h > 0.0)
+    body = FnModule(lambda h: jnp.sqrt(h) - 0.5)
+    wl = nn.WhileLoop(cond, body, max_iters=6)
+    params, st = wl.init_params(0)
+
+    def loss(h0):
+        return wl.apply(params, h0, Ctx(state=st)) ** 2
+
+    h0 = jnp.float32(1.0)
+    y = float(loss(h0))
+    g = float(jax.grad(loss)(h0))
+    assert np.isfinite(y) and np.isfinite(g), (y, g)
+    # parity with the honest python loop
+    h = 1.0
+    while h > 0.0:
+        h = float(np.sqrt(h) - 0.5)
+    np.testing.assert_allclose(
+        float(wl.apply(params, h0, Ctx(state=st))), h, rtol=1e-6)
+
+
 def test_cond_state_propagates():
     """BN running stats written INSIDE the taken branch reach the outer
     ctx (merged lax.cond carry); the untaken branch leaves them at the
