@@ -318,4 +318,9 @@ class Cond(Module):
                 return None, True       # structural mismatch — cache
         longer = ls_t if len(ls_t) >= len(ls_f) else ls_f
         pads = tuple((tuple(s.shape), s.dtype) for s in longer)
-        return (union, pads), True
+        # a union with one-sided-write keys built its fallbacks from the
+        # CURRENT eff_state contents — a later call may carry differently
+        # shaped state for the same input signature, so such plans are
+        # recomputed per call (symmetric with the (None, False) above)
+        one_sided = any(k not in st_t or k not in st_f for k in union)
+        return (union, pads), not one_sided

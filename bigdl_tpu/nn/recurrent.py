@@ -220,13 +220,17 @@ class GRU(Cell):
               + g["bias"].astype(x.dtype))
         if self.reset_after:
             z2 = z2 + g["bias_h"].astype(x.dtype)
-            r, z = jnp.split(inner(z2), 2, axis=-1)
+        # split BEFORE the inner activation: the reference applies it per
+        # h-wide gate after Narrow (GRU.scala buildGates), so an
+        # axis-dependent activation (SoftMax) must not see the 2h concat
+        r_pre, z_pre = jnp.split(z2, 2, axis=-1)
+        r, z = inner(r_pre), inner(z_pre)
+        if self.reset_after:
             rec = (h @ n["weight_h"].astype(x.dtype)
                    + n["bias_h"].astype(x.dtype))
             nh = act(x @ n["weight_i"].astype(x.dtype)
                      + n["bias"].astype(x.dtype) + r * rec)
         else:
-            r, z = jnp.split(inner(z2), 2, axis=-1)
             nh = act(x @ n["weight_i"].astype(x.dtype)
                      + (r * h) @ n["weight_h"].astype(x.dtype)
                      + n["bias"].astype(x.dtype))

@@ -109,11 +109,14 @@ def pipelined(stage_fn: Callable, mesh: Mesh, n_microbatches: int,
 # --------------------------------------------------------------------- #
 class PipelineLMTrainer:
     """GPipe training for TransformerLM over a 'pp' mesh axis (x optional
-    'dp'): each pp rank owns n_layers/n_stages blocks (params stacked on a
-    leading layer axis, sharded over pp); microbatches flow through
-    pipeline_run's ppermute schedule; embedding feeds stage 0 and the LM
-    head + loss run on the last stage (loss is masked+psum'd, so AD routes
-    every gradient to the stage that owns it).
+    'dp', 'tp', 'sp'): each pp rank owns n_layers/n_stages blocks (params
+    stacked on a leading layer axis, sharded over pp); microbatches flow
+    through pipeline_run's ppermute schedule; embedding feeds stage 0 and
+    the LM head + loss run on the last stage (loss is masked+psum'd, so
+    AD routes every gradient to the stage that owns it).  tp and sp are
+    AUTO (GSPMD) axes inside the manual pp/dp shard_map: tensor parallel
+    via the megatron pspecs, sequence parallel by sharding the sequence
+    dim of the token batch.
 
     The optimizer update happens on the global (sharded) arrays outside
     the shard_map — GSPMD keeps the pp layout for block params/moments.
@@ -226,6 +229,7 @@ class PipelineLMTrainer:
         cfg = model.cfg
         n_micro, mesh = self.n_micro, self.mesh
         has_dp = "dp" in mesh.axis_names
+        has_sp = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
         loss_chunk = self.loss_chunk
 
         def local(rest, blocks_stage, tokens, targets):
@@ -286,13 +290,18 @@ class PipelineLMTrainer:
                                             self.params["rest"])
         blk_specs = jax.tree_util.tree_map(lambda _: P("pp"),
                                            self.params["blocks"])
+        # in_specs may only mention MANUAL axes; auto-axis shardings (tp
+        # on the stacked block params, sp on the token sequence dim) ride
+        # on the arrays themselves (device_put in init()/step()) and
+        # GSPMD propagates them
         tok_spec = P("dp") if has_dp else P()
-        # with a tp axis present, shard_map is manual over pp/dp ONLY and
-        # tp stays an AUTO axis: XLA partitions each stage's matmuls over
-        # tp (megatron layout from the template pspecs) and inserts the
-        # psums — pp x tp composition without hand-written collectives
+        # with a tp and/or sp axis present, shard_map is manual over
+        # pp/dp ONLY and tp/sp stay AUTO axes: XLA partitions each
+        # stage's matmuls over tp (megatron layout from the template
+        # pspecs) and the sequence dim over sp, inserting the collectives
+        # — pp x tp / pp x sp composition without hand-written psums
         manual = None
-        if self._has_tp():
+        if self._has_tp() or has_sp:
             manual = {"pp"} | ({"dp"} if has_dp else set())
         mapped = _shard_map(
             local, mesh,
@@ -322,7 +331,21 @@ class PipelineLMTrainer:
             raise ValueError(
                 f"per-dp-shard batch {batch // n_dp} must divide by "
                 f"n_microbatches={self.n_micro}")
-        spec = P("dp") if "dp" in self.mesh.axis_names else P()
+        has_dp = "dp" in self.mesh.axis_names
+        has_sp = ("sp" in self.mesh.axis_names
+                  and self.mesh.shape["sp"] > 1)
+        if has_sp:
+            seq = jnp.asarray(tokens).shape[1]
+            n_sp = self.mesh.shape["sp"]
+            if seq % n_sp:
+                raise ValueError(
+                    f"sequence length {seq} must divide by sp={n_sp}")
+            # sp is an AUTO axis: the sequence sharding rides on the
+            # array (in_specs inside the partial-manual shard_map may
+            # only mention manual axes)
+            spec = P("dp" if has_dp else None, "sp")
+        else:
+            spec = P("dp") if has_dp else P()
         sh = NamedSharding(self.mesh, spec)
         tokens = jax.device_put(jnp.asarray(tokens), sh)
         targets = jax.device_put(jnp.asarray(targets), sh)

@@ -3,8 +3,14 @@ processes on CPU (4 local virtual devices each), training the shared
 fixture model with DistriOptimizer over the global dp mesh
 (≙ a Spark executor in optim/DistriOptimizer.scala:118's cluster run).
 
-Usage: python _mp_worker.py <proc_id> <num_procs> <port> <out.npz> [fsdp]
-"""
+Usage: python _mp_worker.py <proc_id> <num_procs> <port> <out.npz>
+           [fsdp] [ckpt=<dir>] [crash_at=<iter>] [epochs=<n>]
+
+`ckpt=` enables per-process checkpoints (dir/p<pid>) every 2 iterations
+and auto-resume when they already exist; `crash_at=` makes proc 1 die
+UNCLEANLY (os._exit) at that iteration — the fault-injection fixture
+(≙ DistriOptimizer.scala:878-914 drop-and-retry, demonstrated across OS
+processes)."""
 import os
 import sys
 
@@ -43,12 +49,39 @@ def main():
     model = nn.Sequential(nn.Linear(12, 8), nn.Tanh(), nn.Linear(8, 1))
     model.reset(3)
 
-    fsdp = len(sys.argv) > 5 and sys.argv[5] == "fsdp"
+    extra = sys.argv[5:]
+    fsdp = "fsdp" in extra
+    ckpt = next((a.split("=", 1)[1] for a in extra
+                 if a.startswith("ckpt=")), None)
+    crash_at = next((int(a.split("=", 1)[1]) for a in extra
+                     if a.startswith("crash_at=")), None)
+    epochs = next((int(a.split("=", 1)[1]) for a in extra
+                   if a.startswith("epochs=")), 2)
+
     mesh = create_mesh({"dp": 4 * nproc})
+    end = Trigger.max_epoch(epochs)
+    if crash_at is not None and pid == 1:
+        # die UNCLEANLY mid-training: evaluated once per iteration, so
+        # the step at `crash_at` completes and then this worker vanishes
+        # without any shutdown — the peer wedges in its next collective
+        base = end
+
+        class _CrashAt(Trigger):
+            def __call__(self, state):
+                if state.iteration >= crash_at:
+                    print(f"proc {pid}: injecting crash at iteration "
+                          f"{state.iteration}", flush=True)
+                    os._exit(17)
+                return base(state)
+
+        end = _CrashAt()
     opt = (DistriOptimizer(model, (x, y), nn.MSECriterion(), batch_size=64,
                            mesh=mesh, fsdp=fsdp)
            .set_optim_method(SGD(learning_rate=0.05, momentum=0.9))
-           .set_end_when(Trigger.max_epoch(2)))
+           .set_end_when(end))
+    if ckpt:
+        opt.set_checkpoint(os.path.join(ckpt, f"p{pid}"),
+                           trigger=Trigger.several_iteration(2))
     trained = opt.optimize()
 
     leaves = [np.asarray(a) for a in jax.tree_util.tree_leaves(

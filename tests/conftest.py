@@ -22,3 +22,18 @@ try:
     _xb._backend_factories.pop("axon", None)
 except Exception:
     pass
+
+# Persistent compilation cache: recompiles dominate suite wall time on
+# 1 CPU (VERDICT r4 weak-6).  Subprocess tests (multiprocess/dryrun
+# workers) inherit it via JAX_COMPILATION_CACHE_DIR.  min_compile_time 0
+# caches everything — tiny-program cache reads are still much cheaper
+# than XLA runs on this box.
+_cache_dir = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(__file__), os.pardir, ".jax_cache"))
+try:
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+except Exception:
+    pass

@@ -82,6 +82,73 @@ def _run_two_procs(tmp_path, extra=()):
 
 
 @pytest.mark.slow
+def test_worker_death_resume_matches_uninterrupted(tmp_path):
+    """Fault injection end-to-end (≙ DistriOptimizer.scala:878-914
+    drop-and-retry): worker 1 dies UNCLEANLY (os._exit) mid-training,
+    the wedged survivor is killed, the cluster restarts, both workers
+    auto-resume from their newest checkpoints, and the final params
+    match the uninterrupted two-process run exactly."""
+    import time
+
+    port = _free_port()
+    out = str(tmp_path / "resumed.npz")
+    ckpt = str(tmp_path / "ckpt")
+    env = dict(os.environ)
+    env.pop("PYTHONPATH", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
+
+    def spawn(port, extra):
+        return [subprocess.Popen(
+            [sys.executable, _WORKER, str(i), "2", str(port), out, *extra],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for i in range(2)]
+
+    # ---- phase 1: crash run — proc 1 os._exits at iteration 7 -------- #
+    # (4 iters/epoch, 3 epochs = 12 total; checkpoints every 2)
+    procs = spawn(port, (f"ckpt={ckpt}", "crash_at=7", "epochs=3"))
+    try:
+        o1, _ = procs[1].communicate(timeout=420)
+    except subprocess.TimeoutExpired:
+        for q in procs:
+            q.kill()
+        pytest.fail("crashing worker did not die")
+    assert procs[1].returncode == 17, f"proc1:\n{o1[-2000:]}"
+    # the survivor is wedged in a collective whose peer vanished — give
+    # it a moment, then kill it like a job scheduler would
+    time.sleep(3)
+    procs[0].kill()
+    o0, _ = procs[0].communicate()
+    assert not os.path.exists(out), "crashed run must not publish params"
+    assert os.path.exists(os.path.join(ckpt, "p0", "latest")), o0[-2000:]
+    assert os.path.exists(os.path.join(ckpt, "p1", "latest")), o1[-2000:]
+
+    # ---- phase 2: restart the cluster; both workers resume ----------- #
+    procs = spawn(_free_port(), (f"ckpt={ckpt}", "epochs=3"))
+    logs = []
+    for p in procs:
+        try:
+            o, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("resume run timed out")
+        logs.append(o)
+    for i, (p, o) in enumerate(zip(procs, logs)):
+        assert p.returncode == 0, f"resume proc {i} failed:\n{o[-3000:]}"
+    got = np.load(out)
+    got_leaves = [got[k] for k in got.files]
+
+    # ---- uninterrupted reference: plain 2-proc run, same epochs ------ #
+    want_leaves = _run_two_procs(tmp_path, extra=("epochs=3",))
+    assert len(got_leaves) == len(want_leaves)
+    for a, b in zip(want_leaves, got_leaves):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("fsdp", [False, True], ids=["dp", "fsdp"])
 def test_two_process_matches_single(tmp_path, fsdp):
     """dp: replicated params, psum gradients. fsdp: params/opt-state
