@@ -32,6 +32,7 @@ CASES = [
     ("distributed_resnet.py", ["--epochs", "1", "--batch", "32"], 600),
     ("transformer_spmd.py", ["--epochs", "1", "--batch", "8"], 600),
     ("textgen.py", ["--epochs", "30"], 300),
+    ("control_flow.py", ["--epochs", "8"], 300),
 ]
 
 

@@ -50,21 +50,27 @@ def _single_process_reference(fsdp=False):
         jax.tree_util.tree_map(np.asarray, trained._params))]
 
 
-def _run_two_procs(tmp_path, extra=()):
-    port = _free_port()
-    out = str(tmp_path / "mp_params.npz")
+def _worker_env():
     env = dict(os.environ)
     env.pop("PYTHONPATH", None)          # drop the axon sitecustomize
     env["JAX_PLATFORMS"] = "cpu"
     env.pop("XLA_FLAGS", None)           # worker sets its own 4-dev flag
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo
+    return env
 
-    procs = [subprocess.Popen(
-        [sys.executable, _WORKER, str(i), "2", str(port), out,
-         *extra],
+
+def _spawn_workers(port, out, extra=()):
+    env = _worker_env()
+    return [subprocess.Popen(
+        [sys.executable, _WORKER, str(i), "2", str(port), out, *extra],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True) for i in range(2)]
+
+
+def _run_two_procs(tmp_path, extra=()):
+    out = str(tmp_path / "mp_params.npz")
+    procs = _spawn_workers(_free_port(), out, extra)
     logs = []
     for p in procs:
         try:
@@ -90,25 +96,13 @@ def test_worker_death_resume_matches_uninterrupted(tmp_path):
     match the uninterrupted two-process run exactly."""
     import time
 
-    port = _free_port()
     out = str(tmp_path / "resumed.npz")
     ckpt = str(tmp_path / "ckpt")
-    env = dict(os.environ)
-    env.pop("PYTHONPATH", None)
-    env["JAX_PLATFORMS"] = "cpu"
-    env.pop("XLA_FLAGS", None)
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = repo
-
-    def spawn(port, extra):
-        return [subprocess.Popen(
-            [sys.executable, _WORKER, str(i), "2", str(port), out, *extra],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True) for i in range(2)]
 
     # ---- phase 1: crash run — proc 1 os._exits at iteration 7 -------- #
     # (4 iters/epoch, 3 epochs = 12 total; checkpoints every 2)
-    procs = spawn(port, (f"ckpt={ckpt}", "crash_at=7", "epochs=3"))
+    procs = _spawn_workers(_free_port(), out,
+                           (f"ckpt={ckpt}", "crash_at=7", "epochs=3"))
     try:
         o1, _ = procs[1].communicate(timeout=420)
     except subprocess.TimeoutExpired:
@@ -126,7 +120,8 @@ def test_worker_death_resume_matches_uninterrupted(tmp_path):
     assert os.path.exists(os.path.join(ckpt, "p1", "latest")), o1[-2000:]
 
     # ---- phase 2: restart the cluster; both workers resume ----------- #
-    procs = spawn(_free_port(), (f"ckpt={ckpt}", "epochs=3"))
+    procs = _spawn_workers(_free_port(), out,
+                           (f"ckpt={ckpt}", "epochs=3"))
     logs = []
     for p in procs:
         try:
