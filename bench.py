@@ -359,10 +359,26 @@ def bench_resnet50():
     # here (~2,600 img/s b256 — the step is backward/BN-bound, see
     # docs/performance.md); the earlier "2.7x NHWC" figure was a
     # forward-only measurement artifact.
+    #
+    # Env knobs so the scripts/README.md decision rules (flip s2d stem
+    # if K2 wins, remat+b512 if K8 wins) are a one-line change in the
+    # measurement queue, not a code edit mid-live-window:
+    #   BENCH_RESNET_STEM=s2d|conv  BENCH_RESNET_REMAT=1  BENCH_RESNET_BATCH=N
+    import os
     from bigdl_tpu.models import resnet
+    stem = os.environ.get("BENCH_RESNET_STEM", "conv")
+    remat_raw = os.environ.get("BENCH_RESNET_REMAT", "0").lower()
+    if remat_raw in ("1", "true", "yes", "on"):
+        remat = True
+    elif remat_raw in ("0", "false", "no", "off", ""):
+        remat = False
+    else:
+        # a scarce live-TPU window must never silently measure the
+        # wrong config because of a typo'd knob
+        raise ValueError(f"BENCH_RESNET_REMAT={remat_raw!r}: use 1/0")
+    batch = int(os.environ.get("BENCH_RESNET_BATCH", "256"))
     model = resnet.build(class_num=1000, depth=50, dataset="imagenet",
-                         format="NHWC")
-    batch = 256
+                         format="NHWC", stem=stem, remat=remat)
     ips = _train_throughput(model, (batch, 224, 224, 3), 1000, batch, k=20)
     _report("resnet50_train_images_per_sec_per_chip", ips, "images/sec",
             57.0, defer=True)

@@ -515,7 +515,28 @@ class Module:
             m.training()
         return self
 
-    def evaluate(self):
+    def evaluate(self, *args):
+        """No arguments: switch to eval mode (returns self).
+
+        ``evaluate(dataset, batch_size, val_methods)``: benchmark model
+        quality — the pyspark 3-arg form (`bigdl/nn/layer.py
+        Layer.evaluate`); returns ``[(method, result), ...]`` like
+        `optim.Evaluator.test`."""
+        if args:
+            if len(args) != 3:
+                raise TypeError(
+                    "evaluate() takes either no arguments (set eval "
+                    "mode) or (dataset, batch_size, val_methods)")
+            dataset, batch_size, val_methods = args
+            from ..optim.predictor import Evaluator
+            # cache the Evaluator (its jitted eval step) per batch size:
+            # a per-epoch validation loop must not retrace every call
+            cached = getattr(self, "_evaluator_cache", None)
+            if cached is None or cached[0] != batch_size:
+                cached = (batch_size, Evaluator(self,
+                                                batch_size=batch_size))
+                self._evaluator_cache = cached
+            return cached[1].test(dataset, val_methods)
         self.train_mode = False
         for m in self.children():
             m.evaluate()

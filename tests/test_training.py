@@ -141,3 +141,26 @@ def test_auto_retry_recovers_from_transient_failure():
     import pytest
     with pytest.raises(RuntimeError, match="simulated data fault"):
         opt2.optimize()
+
+
+def test_module_evaluate_three_arg_form():
+    """pyspark parity: model.evaluate(dataset, batch_size, val_methods)
+    (bigdl/nn/layer.py Layer.evaluate 3-arg form) scores the model;
+    the 0-arg form still just flips eval mode."""
+    from bigdl_tpu.optim import Top1Accuracy, Loss
+
+    model = nn.Sequential(nn.Linear(6, 4), nn.LogSoftMax())
+    model.reset(0)
+    rng = np.random.RandomState(2)
+    x = rng.randn(40, 6).astype(np.float32)
+    y = (rng.randint(0, 4, 40) + 1).astype(np.float32)
+
+    res = model.evaluate((x, y), 16, [Top1Accuracy(),
+                                      Loss(nn.ClassNLLCriterion())])
+    assert len(res) == 2
+    (m1, r1), (m2, r2) = res
+    acc, n = r1.result()
+    assert n == 40 and 0.0 <= acc <= 1.0
+    assert np.isfinite(r2.result()[0])
+    assert model.evaluate() is model
+    assert not model.is_training()
