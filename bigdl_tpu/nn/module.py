@@ -142,7 +142,8 @@ class Ctx:
     dicts, and a scratch list for side losses (e.g. ActivityRegularization).
     """
 
-    __slots__ = ("training", "rng_key", "state", "new_state", "side_losses")
+    __slots__ = ("training", "rng_key", "state", "new_state",
+                 "side_losses", "step_rng")
 
     def __init__(self, state=None, training=False, rng_key=None):
         self.training = training
@@ -150,6 +151,9 @@ class Ctx:
         self.state = state or {}
         self.new_state: Dict[str, Any] = {}
         self.side_losses = []
+        # per-timestep key a Recurrent scan threads through its carry so
+        # stochastic cells (LSTM/GRU p>0) draw fresh masks each step
+        self.step_rng = None
 
     def rng(self, module) -> jax.Array:
         if self.rng_key is None:

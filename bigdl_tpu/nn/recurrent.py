@@ -34,6 +34,14 @@ class Cell(Module):
     """Base RNN cell: step(params, x_t, hidden, ctx) -> (out_t, new_hidden);
     ``zero_hidden(batch, dtype)`` builds the initial state pytree."""
 
+    def _step_key(self, ctx):
+        """Per-timestep dropout key: Recurrent/RecurrentDecoder thread a
+        fresh key through their scan carry (ctx.step_rng); a direct
+        single-step apply falls back to the per-module key.  fold_in on
+        the uid keeps stacked cells' (MultiRNNCell) masks independent."""
+        key = ctx.step_rng if ctx.step_rng is not None else ctx.rng(self)
+        return jax.random.fold_in(key, self._uid % (2 ** 31))
+
     def step(self, params, x, hidden, ctx):
         raise NotImplementedError
 
@@ -57,6 +65,34 @@ def _gate_params(module, rng, input_size, hidden_size, n_gates):
     b = init_tensor(module, k3, (n_gates * hidden_size,), input_size,
                     n_gates * hidden_size, Zeros(), kind="bias")
     return {"weight_i": wi, "weight_h": wh, "bias": b}
+
+
+def _drop(v, p, key):
+    """Inverted dropout on one projection input (≙ the Dropout module
+    the reference places before each cell Linear when p>0)."""
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, v.shape)
+    return jnp.where(mask, v, 0).astype(v.dtype) / keep
+
+
+def _gate_dropout_matmul(x, h, wi, wh, n_gates, p, key):
+    """Fused-weight equivalent of the reference's per-gate
+    Sequential(Dropout(p), Linear) stacks (LSTM.scala:77-96 i2g/h2g with
+    p>0): each gate's input AND hidden projection sees an INDEPENDENT
+    inverted-dropout mask.  Same FLOPs as the fused matmul — the (B,D)
+    @ (D,G*H) product becomes a (G,B,D) x (D,G,H) einsum."""
+    b_sz, d_in = x.shape
+    h_in = h.shape[1]
+    h_sz = wi.shape[1] // n_gates
+    kx, kh = jax.random.split(key)
+    keep = 1.0 - p
+    mx = jax.random.bernoulli(kx, keep, (n_gates,) + x.shape)
+    mh = jax.random.bernoulli(kh, keep, (n_gates,) + h.shape)
+    xg = (jnp.where(mx, x[None], 0) / keep).astype(x.dtype)
+    hg = (jnp.where(mh, h[None], 0) / keep).astype(x.dtype)
+    zi = jnp.einsum("gbd,dgh->bgh", xg, wi.reshape(d_in, n_gates, h_sz))
+    zh = jnp.einsum("gbd,dgh->bgh", hg, wh.reshape(h_in, n_gates, h_sz))
+    return (zi + zh).reshape(b_sz, n_gates * h_sz)
 
 
 class RnnCell(Cell):
@@ -120,9 +156,15 @@ class LSTM(Cell):
     def step(self, params, x, hidden, ctx):
         h, c = as_list(hidden)
         p = self.own(params)
-        z = (x @ p["weight_i"].astype(x.dtype)
-             + h @ p["weight_h"].astype(x.dtype)
-             + p["bias"].astype(x.dtype))
+        if self.dropout_p and ctx.training:
+            z = _gate_dropout_matmul(
+                x, h, p["weight_i"].astype(x.dtype),
+                p["weight_h"].astype(x.dtype), 4, self.dropout_p,
+                self._step_key(ctx)) + p["bias"].astype(x.dtype)
+        else:
+            z = (x @ p["weight_i"].astype(x.dtype)
+                 + h @ p["weight_h"].astype(x.dtype)
+                 + p["bias"].astype(x.dtype))
         i, f, g, o = jnp.split(z, 4, axis=-1)
         inner = jax.nn.sigmoid if self.inner_activation is None else \
             (lambda v: self.inner_activation.apply(params, v, ctx))
@@ -144,6 +186,7 @@ class LSTMPeephole(Cell):
         super().__init__(name=name)
         self.input_size = input_size
         self.hidden_size = hidden_size
+        self.dropout_p = p
 
     def init(self, rng):
         k1, k2 = jax.random.split(rng)
@@ -159,9 +202,15 @@ class LSTMPeephole(Cell):
     def step(self, params, x, hidden, ctx):
         h, c = as_list(hidden)
         p = self.own(params)
-        z = (x @ p["weight_i"].astype(x.dtype)
-             + h @ p["weight_h"].astype(x.dtype)
-             + p["bias"].astype(x.dtype))
+        if self.dropout_p and ctx.training:
+            z = _gate_dropout_matmul(
+                x, h, p["weight_i"].astype(x.dtype),
+                p["weight_h"].astype(x.dtype), 4, self.dropout_p,
+                self._step_key(ctx)) + p["bias"].astype(x.dtype)
+        else:
+            z = (x @ p["weight_i"].astype(x.dtype)
+                 + h @ p["weight_h"].astype(x.dtype)
+                 + p["bias"].astype(x.dtype))
         i, f, g, o = jnp.split(z, 4, axis=-1)
         ph = p["peephole"].astype(x.dtype)
         i = jax.nn.sigmoid(i + ph[0] * c)
@@ -190,6 +239,7 @@ class GRU(Cell):
         self.input_size = input_size
         self.hidden_size = hidden_size
         self.reset_after = reset_after
+        self.dropout_p = p
         # ≙ nn/GRU.scala:62-72 activation (candidate, default Tanh) /
         # innerActivation (r+z gates, default Sigmoid)
         self.activation = activation
@@ -215,9 +265,17 @@ class GRU(Cell):
             (lambda v: self.inner_activation.apply(params, v, ctx))
         act = jnp.tanh if self.activation is None else \
             (lambda v: self.activation.apply(params, v, ctx))
-        z2 = (x @ g["weight_i"].astype(x.dtype)
-              + h @ g["weight_h"].astype(x.dtype)
-              + g["bias"].astype(x.dtype))
+        drop = self.dropout_p and ctx.training
+        if drop:
+            k_g, k_x, k_h = jax.random.split(self._step_key(ctx), 3)
+            z2 = _gate_dropout_matmul(
+                x, h, g["weight_i"].astype(x.dtype),
+                g["weight_h"].astype(x.dtype), 2, self.dropout_p,
+                k_g) + g["bias"].astype(x.dtype)
+        else:
+            z2 = (x @ g["weight_i"].astype(x.dtype)
+                  + h @ g["weight_h"].astype(x.dtype)
+                  + g["bias"].astype(x.dtype))
         if self.reset_after:
             z2 = z2 + g["bias_h"].astype(x.dtype)
         # split BEFORE the inner activation: the reference applies it per
@@ -225,14 +283,21 @@ class GRU(Cell):
         # axis-dependent activation (SoftMax) must not see the 2h concat
         r_pre, z_pre = jnp.split(z2, 2, axis=-1)
         r, z = inner(r_pre), inner(z_pre)
+        # candidate path: the reference places a Dropout before the
+        # input Linear and before the hidden Linear (GRU.scala p>0)
+        xc = _drop(x, self.dropout_p, k_x) if drop else x
         if self.reset_after:
-            rec = (h @ n["weight_h"].astype(x.dtype)
+            hc = _drop(h, self.dropout_p, k_h) if drop else h
+            rec = (hc @ n["weight_h"].astype(x.dtype)
                    + n["bias_h"].astype(x.dtype))
-            nh = act(x @ n["weight_i"].astype(x.dtype)
+            nh = act(xc @ n["weight_i"].astype(x.dtype)
                      + n["bias"].astype(x.dtype) + r * rec)
         else:
-            nh = act(x @ n["weight_i"].astype(x.dtype)
-                     + (r * h) @ n["weight_h"].astype(x.dtype)
+            rh = r * h
+            if drop:
+                rh = _drop(rh, self.dropout_p, k_h)
+            nh = act(xc @ n["weight_i"].astype(x.dtype)
+                     + rh @ n["weight_h"].astype(x.dtype)
                      + n["bias"].astype(x.dtype))
         h2 = (1.0 - z) * nh + z * h
         return h2, h2
@@ -365,9 +430,30 @@ class Recurrent(Module):
                                              spatial=x.shape[3:])
         raise ValueError("cell must define zero_hidden")
 
+    @staticmethod
+    def _cell_is_stochastic(cell):
+        # modules() includes the cell itself
+        return any(getattr(m, "dropout_p", 0.0) for m in cell.modules())
+
     def apply(self, params, x, ctx):
         hidden0 = self._initial_hidden(x)
         xs_t = jnp.swapaxes(x, 0, 1)  # (T, B, ...)
+
+        if ctx.training and ctx.rng_key is not None \
+                and self._cell_is_stochastic(self.cell):
+            # stochastic cell (p>0): thread a fresh key through the scan
+            # carry so every timestep draws INDEPENDENT dropout masks
+            # (≙ the reference's Dropout re-sampling per forward call)
+            def body(carry, x_t):
+                h, key = carry
+                key, sub = jax.random.split(key)
+                ctx.step_rng = sub
+                out, h2 = self.cell.step(params, x_t, h, ctx)
+                return (h2, key), out
+
+            _, outs = lax.scan(body, (hidden0, ctx.rng(self)), xs_t)
+            ctx.step_rng = None
+            return jnp.swapaxes(outs, 0, 1)
 
         def body(h, x_t):
             out, h2 = self.cell.step(params, x_t, h, ctx)
@@ -428,10 +514,22 @@ class BiRecurrent(Module):
             p.update(self.merge.init(k3))
         return p
 
+    def _runners(self):
+        """Cached Recurrent wrappers: rebuilding them per forward would
+        allocate fresh uids, so a stochastic cell's dropout base key
+        (ctx.rng folds in the uid) would change every call — breaking
+        same-key determinism (and growing the uid counter)."""
+        pair = getattr(self, "_rec_pair", None)
+        if pair is None or pair[0].cell is not self.fwd_cell \
+                or pair[1].cell is not self.bwd_cell:
+            pair = (Recurrent(self.fwd_cell, name=f"{self.name}_f"),
+                    Recurrent(self.bwd_cell, name=f"{self.name}_b"))
+            self._rec_pair = pair
+        return pair
+
     def apply(self, params, x, ctx):
         self._ensure_bwd()
-        fwd = Recurrent(self.fwd_cell, name=f"{self.name}_f")
-        bwd = Recurrent(self.bwd_cell, name=f"{self.name}_b")
+        fwd, bwd = self._runners()
         if self.is_split_input:
             if x.shape[-1] % 2:
                 raise ValueError(
@@ -474,6 +572,20 @@ class RecurrentDecoder(Module):
 
     def apply(self, params, x, ctx):
         hidden0 = self.cell.zero_hidden(x.shape[0], x.dtype)
+
+        if ctx.training and ctx.rng_key is not None \
+                and Recurrent._cell_is_stochastic(self.cell):
+            def body(carry, _):
+                inp, h, key = carry
+                key, sub = jax.random.split(key)
+                ctx.step_rng = sub
+                out, h2 = self.cell.step(params, inp, h, ctx)
+                return (out, h2, key), out
+
+            _, outs = lax.scan(body, (x, hidden0, ctx.rng(self)), None,
+                               length=self.seq_length)
+            ctx.step_rng = None
+            return jnp.swapaxes(outs, 0, 1)
 
         def body(carry, _):
             inp, h = carry

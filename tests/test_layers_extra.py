@@ -4,6 +4,7 @@ RoiPoolingSpec, MaxoutSpec etc.) — numeric checks against NumPy references."""
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from bigdl_tpu import nn
 from bigdl_tpu.tensor import SparseTensor, sparse_dense_matmul
@@ -291,3 +292,134 @@ def test_gru_reset_after_gradients():
         / (2 * eps)
     ana = float(np.asarray(g[cell_name]["gates"]["bias_h"])[i])
     assert abs(num - ana) < 2e-2 * max(1.0, abs(ana)), (num, ana)
+
+
+class TestCellDropout:
+    """LSTM/GRU p>0: per-gate dropout at train time (≙ the reference
+    building Sequential(Dropout(p), Linear) per gate when p>0,
+    LSTM.scala:77-96) — previously a silently-ignored ctor param."""
+
+    @staticmethod
+    def _run(rec, params, st, x, seed, training):
+        import jax
+        from bigdl_tpu.nn.module import Ctx
+        ctx = Ctx(state=st, training=training,
+                  rng_key=jax.random.PRNGKey(seed))
+        return np.asarray(rec.apply(params, x, ctx))
+
+    @pytest.mark.parametrize("cell_fn", [
+        lambda: nn.LSTM(6, 5, p=0.5),
+        lambda: nn.GRU(6, 5, p=0.5),
+        lambda: nn.GRU(6, 5, p=0.5, reset_after=True),
+    ], ids=["lstm", "gru", "gru_reset_after"])
+    def test_dropout_active_in_training_only(self, cell_fn):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 7, 6).astype(np.float32)
+        cell = cell_fn()
+        rec = nn.Recurrent(cell)
+        params, st = rec.init_params(0)
+
+        y_eval = self._run(rec, params, st, x, 1, training=False)
+        y_tr_a = self._run(rec, params, st, x, 1, training=True)
+        y_tr_b = self._run(rec, params, st, x, 2, training=True)
+        y_tr_a2 = self._run(rec, params, st, x, 1, training=True)
+
+        # eval ignores p entirely; training perturbs; different keys ->
+        # different masks; same key -> deterministic
+        assert np.abs(y_tr_a - y_eval).max() > 1e-4
+        assert np.abs(y_tr_a - y_tr_b).max() > 1e-4
+        np.testing.assert_array_equal(y_tr_a, y_tr_a2)
+
+        # p=0 in training mode == eval forward (no stray perturbation)
+        cell.dropout_p = 0.0
+        y0_tr = self._run(rec, params, st, x, 3, training=True)
+        np.testing.assert_allclose(y0_tr, y_eval, rtol=1e-6)
+
+    def test_fresh_step_key_every_timestep(self):
+        """Direct probe of the scan key threading: a cell whose OUTPUT is
+        the ctx.step_rng it saw must observe a DISTINCT key at every
+        timestep (a frozen shared mask would mean repeated keys — the
+        exact regression this guards)."""
+        import jax
+        import jax.numpy as jnp
+        from bigdl_tpu.nn.module import Ctx
+        from bigdl_tpu.nn.recurrent import Cell
+
+        class KeyProbe(Cell):
+            dropout_p = 0.5          # triggers the stochastic threading
+
+            def init(self, rng):
+                return {}
+
+            def zero_hidden(self, batch_size, dtype=jnp.float32):
+                return jnp.zeros((batch_size, 1), dtype)
+
+            def step(self, params, x, h, ctx):
+                assert ctx.step_rng is not None
+                key = ctx.step_rng
+                if jnp.issubdtype(key.dtype, jax.dtypes.prng_key):
+                    key = jax.random.key_data(key)     # typed-key jax
+                key = jnp.asarray(key).reshape(-1)
+                out = jnp.broadcast_to(
+                    key[None].astype(jnp.uint32),
+                    (x.shape[0],) + key.shape)
+                return out, h
+
+        rec = nn.Recurrent(KeyProbe())
+        params, st = rec.init_params(0)
+        x = np.zeros((2, 5, 3), np.float32)
+        ctx = Ctx(state=st, training=True, rng_key=jax.random.PRNGKey(0))
+        keys = np.asarray(rec.apply(params, x, ctx))   # (B, T, key_words)
+        per_t = [tuple(keys[0, t]) for t in range(keys.shape[1])]
+        assert len(set(per_t)) == len(per_t), per_t
+
+    def test_bi_recurrent_dropout_same_key_deterministic(self):
+        """BiRecurrent with a stochastic cell: same rng key -> identical
+        outputs across calls (the Recurrent wrappers are cached, so the
+        dropout base key does not drift with fresh uids)."""
+        import jax
+        from bigdl_tpu.nn.module import Ctx
+
+        bi = nn.BiRecurrent(cell=nn.LSTM(6, 5, p=0.5))
+        params, st = bi.init_params(0)
+        x = np.random.RandomState(5).randn(3, 4, 6).astype(np.float32)
+        ctx1 = Ctx(state=st, training=True, rng_key=jax.random.PRNGKey(1))
+        ctx2 = Ctx(state=st, training=True, rng_key=jax.random.PRNGKey(1))
+        a = np.asarray(bi.apply(params, x, ctx1))
+        b = np.asarray(bi.apply(params, x, ctx2))
+        np.testing.assert_array_equal(a, b)
+
+    def test_lstm_peephole_dropout(self):
+        import jax
+        from bigdl_tpu.nn.module import Ctx
+
+        cell = nn.LSTMPeephole(6, 5, p=0.5)
+        rec = nn.Recurrent(cell)
+        params, st = rec.init_params(0)
+        x = np.random.RandomState(6).randn(3, 4, 6).astype(np.float32)
+        y_ev = np.asarray(rec.apply(params, x, Ctx(state=st)))
+        y_tr = np.asarray(rec.apply(
+            params, x,
+            Ctx(state=st, training=True, rng_key=jax.random.PRNGKey(0))))
+        assert np.abs(y_tr - y_ev).max() > 1e-4
+
+    def test_gradients_flow_through_dropout(self):
+        import jax
+        import jax.numpy as jnp
+        from bigdl_tpu.nn.module import Ctx
+
+        cell = nn.LSTM(5, 4, p=0.3)
+        rec = nn.Recurrent(cell)
+        params, st = rec.init_params(1)
+        x = jnp.asarray(np.random.RandomState(2).randn(3, 6, 5)
+                        .astype(np.float32))
+
+        def loss(p):
+            ctx = Ctx(state=st, training=True,
+                      rng_key=jax.random.PRNGKey(0))
+            return jnp.sum(rec.apply(p, x, ctx) ** 2)
+
+        g = jax.grad(loss)(params)
+        total = sum(float(np.abs(np.asarray(v)).sum())
+                    for sub in g.values() for v in sub.values())
+        assert np.isfinite(total) and total > 0
