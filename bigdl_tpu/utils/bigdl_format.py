@@ -309,13 +309,16 @@ def _mk_bn1d(a):
 _CELL_TYPES = {"LSTM", "GRU", "RnnCell"}
 
 
-def _require_no_dropout(tree):
+def _checked_cell_p(tree):
+    """The cell's dropout p, raising for types whose p>0 wire layout
+    (per-gate Linear graphs) the reader does not rebuild."""
     t = _short_type(tree["type"])
-    p = tree["attr"].get("p") or 0.0
-    if float(p) != 0.0:
+    p = float(tree["attr"].get("p") or 0.0)
+    if p != 0.0 and t not in ("LSTM", "GRU"):
         raise ValueError(
             f".bigdl {t} with dropout p={p} serializes per-gate Linear "
-            "graphs; only the fused p=0 layout is supported")
+            "graphs; only LSTM/GRU read the p>0 layout")
+    return p
 
 
 def _build_activation(tree, where):
@@ -343,16 +346,16 @@ def _cell_activation(a, key, default_type, where):
 def _build_cell(tree):
     t = _short_type(tree["type"])
     a = tree["attr"]
-    _require_no_dropout(tree)
+    cell_p = _checked_cell_p(tree)
     if t == "LSTM":
         cell = nn.LSTM(
-            int(a["inputSize"]), int(a["hiddenSize"]),
+            int(a["inputSize"]), int(a["hiddenSize"]), p=cell_p,
             activation=_cell_activation(a, "activation", "Tanh", t),
             inner_activation=_cell_activation(
                 a, "innerActivation", "Sigmoid", t))
     elif t == "GRU":
         cell = nn.GRU(
-            int(a["inputSize"]), int(a["outputSize"]),
+            int(a["inputSize"]), int(a["outputSize"]), p=cell_p,
             activation=_cell_activation(a, "activation", "Tanh", t),
             inner_activation=_cell_activation(
                 a, "innerActivation", "Sigmoid", t))
@@ -393,6 +396,74 @@ def _hidden_shapes_ok(t, a, own):
     return True
 
 
+def _split_gate_linears(own, what):
+    """Classify a p>0 cell's flat params into (input-Linear (w, b)
+    pairs, hidden-Linear weights): with dropout the reference builds
+    per-gate Sequential(Dropout, Linear) stacks where every
+    input-to-gate Linear carries a bias and every hidden-to-gate Linear
+    is withBias=false (LSTM.scala:88-116, GRU.scala:90-105) — the bias
+    adjacency disambiguates even when inputSize == hiddenSize."""
+    pairs, hmats = [], []
+    i = 0
+    while i < len(own):
+        m = own[i]
+        if m.ndim == 2 and i + 1 < len(own) and own[i + 1].ndim == 1 \
+                and own[i + 1].shape == (m.shape[0],):
+            pairs.append((m, own[i + 1]))
+            i += 2
+        elif m.ndim == 2:
+            hmats.append(m)
+            i += 1
+        else:
+            raise ValueError(
+                f".bigdl {what} (p>0): unexpected rank-{m.ndim} entry "
+                "in the cell's flat params")
+    return pairs, hmats
+
+
+def _cell_weights_dropout(tree, t, a):
+    """p>0 wire layout (no preTopology; per-gate Linears in the cell's
+    own flat params) -> our fused weight dicts."""
+    own = [np.asarray(q, np.float32) for q in tree["params"]]
+    pairs, hmats = _split_gate_linears(own, t)
+    if t == "LSTM":
+        h = int(a["hiddenSize"])
+        if len(pairs) != 4 or len(hmats) != 4 \
+                or any(w.shape[0] != h for w, _ in pairs) \
+                or any(m.shape != (h, h) for m in hmats):
+            raise ValueError(
+                f".bigdl LSTM(p>0): expected 4 biased input Linears + "
+                f"4 hidden mats of width {h}, got "
+                f"{[w.shape for w, _ in pairs]} / "
+                f"{[m.shape for m in hmats]}")
+        # reference per-gate order is [i, g, f, o] (JoinTable of the
+        # buildGates Linears); fused order is [i, f, g, o]
+        perm = (0, 2, 1, 3)
+        w_pre = np.concatenate([pairs[k][0] for k in perm], 0)
+        bias = np.concatenate([pairs[k][1] for k in perm], 0)
+        w_h = np.concatenate([hmats[k] for k in perm], 0)
+        return tree["name"], {"weight_i": w_pre.T.copy(),
+                              "weight_h": w_h.T.copy(), "bias": bias}
+    # GRU: i2g [r, z] + candidate f2g carry biases; h2g [r, z] +
+    # candidate linear2 don't (GRU.scala:90-105, :132-146)
+    h = int(a["outputSize"])
+    if len(pairs) != 3 or len(hmats) != 3 \
+            or any(w.shape[0] != h for w, _ in pairs) \
+            or any(m.shape != (h, h) for m in hmats):
+        raise ValueError(
+            f".bigdl GRU(p>0): expected 3 biased input Linears + 3 "
+            f"hidden mats of width {h}, got "
+            f"{[w.shape for w, _ in pairs]} / {[m.shape for m in hmats]}")
+    (w_r, b_r), (w_z, b_z), (w_n, b_n) = pairs
+    h_r, h_z, h_n = hmats
+    return tree["name"], {
+        "gates": {"weight_i": np.concatenate([w_r, w_z], 0).T.copy(),
+                  "weight_h": np.concatenate([h_r, h_z], 0).T.copy(),
+                  "bias": np.concatenate([b_r, b_z], 0)},
+        "new": {"weight_i": w_n.T.copy(), "weight_h": h_n.T.copy(),
+                "bias": b_n}}
+
+
 def _pick_mat(mats, pred, what, t):
     for m in mats:
         if pred(m):
@@ -412,7 +483,9 @@ def _cell_weights(tree):
     """
     t = _short_type(tree["type"])
     a = tree["attr"]
-    _require_no_dropout(tree)
+    if _checked_cell_p(tree) != 0.0:
+        # dropout form: no preTopology, per-gate Linears in flat params
+        return _cell_weights_dropout(tree, t, a)
     pre = a.get("preTopology")
     pre_params = (pre or {}).get("params") or []
     if not pre_params:

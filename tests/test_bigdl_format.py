@@ -550,21 +550,138 @@ def test_recurrent_gru_read():
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
-def test_recurrent_lstm_dropout_rejected():
-    lstm = enc_string(1, "l")
+def test_recurrent_lstm_dropout_read():
+    """LSTM(p=0.5) wire layout: NO preTopology, per-gate
+    Sequential(Dropout, Linear) stacks in the cell's flat params
+    (LSTM.scala:77-116; biased input Linears, bias-free hidden ones,
+    reference gate order [i,g,f,o]).  Eval-mode numerics must match the
+    fused reconstruction; the loaded cell carries p for training."""
+    rng = np.random.RandomState(16)
+    nin, h = 3, 4
+    wi = [rng.randn(h, nin).astype(np.float32) for _ in range(4)]
+    bi = [rng.randn(h).astype(np.float32) for _ in range(4)]
+    wh = [rng.randn(h, h).astype(np.float32) for _ in range(4)]
+
+    lstm = enc_string(1, "lstm_p")
     lstm += enc_string(7, "com.intel.analytics.bigdl.nn.LSTM")
-    lstm += _mod_attr_entry("inputSize", _attr_i(2))
-    lstm += _mod_attr_entry("hiddenSize", _attr_i(2))
+    lstm += _mod_attr_entry("inputSize", _attr_i(nin))
+    lstm += _mod_attr_entry("hiddenSize", _attr_i(h))
     lstm += _mod_attr_entry("p", _attr_d(0.5))
+    lstm += enc_int64(15, 1)
+    for k in range(4):
+        lstm += enc_bytes(16, _mod_tensor(wi[k]))
+        lstm += enc_bytes(16, _mod_tensor(bi[k]))
+    for k in range(4):
+        lstm += enc_bytes(16, _mod_tensor(wh[k]))
+
     rec = enc_string(1, "rec")
     rec += enc_string(7, "com.intel.analytics.bigdl.nn.Recurrent")
     rec += _mod_attr_entry("topology", _attr_mod(lstm))
+
     with tempfile.TemporaryDirectory() as d:
         p = os.path.join(d, "rec.bigdl")
         with open(p, "wb") as f:
             f.write(rec)
-        with pytest.raises(ValueError, match="dropout"):
+        m = load_bigdl(p)
+
+    cells = [c for c in m.modules() if type(c).__name__ == "LSTM"]
+    assert cells and cells[0].dropout_p == 0.5
+
+    B, T = 2, 4
+    x = rng.randn(B, T, nin).astype(np.float32)
+    m.evaluate()
+    got = np.asarray(m.forward(x))
+
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    w_pre = np.concatenate(wi, 0)          # ref order [i, g, f, o]
+    b_pre = np.concatenate(bi, 0)
+    w_h2g = np.concatenate(wh, 0)
+    hs = np.zeros((B, h), np.float32)
+    cs = np.zeros((B, h), np.float32)
+    want = np.zeros((B, T, h), np.float32)
+    for t in range(T):
+        z = x[:, t] @ w_pre.T + b_pre + hs @ w_h2g.T
+        i, g, f, o = (z[:, :h], z[:, h:2*h], z[:, 2*h:3*h], z[:, 3*h:])
+        cs = sig(i) * np.tanh(g) + sig(f) * cs
+        hs = sig(o) * np.tanh(cs)
+        want[:, t] = hs
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_rnncell_dropout_rejected():
+    """p>0 read support covers LSTM/GRU only; other cell types keep the
+    honest raise (their per-gate graphs are not rebuilt)."""
+    cell = enc_string(1, "r")
+    cell += enc_string(7, "com.intel.analytics.bigdl.nn.RnnCell")
+    cell += _mod_attr_entry("inputSize", _attr_i(2))
+    cell += _mod_attr_entry("hiddenSize", _attr_i(2))
+    cell += _mod_attr_entry("p", _attr_d(0.5))
+    rec = enc_string(1, "rec")
+    rec += enc_string(7, "com.intel.analytics.bigdl.nn.Recurrent")
+    rec += _mod_attr_entry("topology", _attr_mod(cell))
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "rec.bigdl")
+        with open(p, "wb") as f:
+            f.write(rec)
+        with pytest.raises(ValueError, match="p>0 layout"):
             load_bigdl(p)
+
+
+def test_recurrent_gru_dropout_read():
+    """GRU(p=0.3) wire layout (GRU.scala:90-105,132-146): i2g [r,z] +
+    candidate f2g with biases, h2g [r,z] + candidate hidden without."""
+    rng = np.random.RandomState(17)
+    nin, h = 4, 3
+    w_r = rng.randn(h, nin).astype(np.float32)
+    b_r = rng.randn(h).astype(np.float32)
+    w_z = rng.randn(h, nin).astype(np.float32)
+    b_z = rng.randn(h).astype(np.float32)
+    w_n = rng.randn(h, nin).astype(np.float32)
+    b_n = rng.randn(h).astype(np.float32)
+    h_r = rng.randn(h, h).astype(np.float32)
+    h_z = rng.randn(h, h).astype(np.float32)
+    h_n = rng.randn(h, h).astype(np.float32)
+
+    gru = enc_string(1, "gru_p")
+    gru += enc_string(7, "com.intel.analytics.bigdl.nn.GRU")
+    gru += _mod_attr_entry("inputSize", _attr_i(nin))
+    gru += _mod_attr_entry("outputSize", _attr_i(h))
+    gru += _mod_attr_entry("p", _attr_d(0.3))
+    gru += enc_int64(15, 1)
+    # topo interleaving: i2g pairs, then h2g mats, then candidate pair,
+    # then candidate hidden — the bias-adjacency classifier must not
+    # depend on a single global order
+    gru += enc_bytes(16, _mod_tensor(w_r)) + enc_bytes(16, _mod_tensor(b_r))
+    gru += enc_bytes(16, _mod_tensor(w_z)) + enc_bytes(16, _mod_tensor(b_z))
+    gru += enc_bytes(16, _mod_tensor(h_r)) + enc_bytes(16, _mod_tensor(h_z))
+    gru += enc_bytes(16, _mod_tensor(w_n)) + enc_bytes(16, _mod_tensor(b_n))
+    gru += enc_bytes(16, _mod_tensor(h_n))
+
+    rec = enc_string(1, "rec")
+    rec += enc_string(7, "com.intel.analytics.bigdl.nn.Recurrent")
+    rec += _mod_attr_entry("topology", _attr_mod(gru))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "rec.bigdl")
+        with open(p, "wb") as f:
+            f.write(rec)
+        m = load_bigdl(p)
+
+    B, T = 2, 4
+    x = rng.randn(B, T, nin).astype(np.float32)
+    m.evaluate()
+    got = np.asarray(m.forward(x))
+
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    hs = np.zeros((B, h), np.float32)
+    want = np.zeros((B, T, h), np.float32)
+    for t in range(T):
+        r = sig(x[:, t] @ w_r.T + b_r + hs @ h_r.T)
+        z = sig(x[:, t] @ w_z.T + b_z + hs @ h_z.T)
+        hhat = np.tanh(x[:, t] @ w_n.T + b_n + (r * hs) @ h_n.T)
+        hs = (1.0 - z) * hhat + z * hs
+        want[:, t] = hs
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
 def test_recurrent_rnncell_read():
