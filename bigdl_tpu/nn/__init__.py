@@ -54,7 +54,7 @@ from .table_ops import (CAddTable, CSubTable, CMulTable, CDivTable,
 from .recurrent import (Cell, RnnCell, LSTM, LSTMPeephole, GRU,
                         ConvLSTMPeephole, ConvLSTMPeephole3D, MultiRNNCell,
                         Recurrent, BiRecurrent, RecurrentDecoder,
-                        TimeDistributed)
+                        TimeDistributed, BatchNormParams)
 from .sparse import SparseLinear, LookupTableSparse, SparseJoinTable
 from .tree import TreeLSTM, BinaryTreeLSTM
 from .moe import SwitchFFN

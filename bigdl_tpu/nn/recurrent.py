@@ -30,9 +30,49 @@ def _conv_out_size(in_size, k, stride, pad):
     return (in_size + 2 * pad - k) // stride + 1
 
 
+class BatchNormParams:
+    """Recurrent input-projection BatchNorm config (≙ nn/Recurrent.scala:33
+    BatchNormParams + Recurrent.scala:111-119: the cell's input projection
+    is normalized over (batch, time) before entering the recurrence).
+
+    ``init_weight`` / ``init_bias`` seed the affine gamma/beta."""
+
+    def __init__(self, eps=1e-5, momentum=0.1, affine=True,
+                 init_weight=None, init_bias=None):
+        self.eps = float(eps)
+        self.momentum = float(momentum)
+        self.affine = bool(affine)
+        self.init_weight = None if init_weight is None \
+            else jnp.asarray(init_weight, jnp.float32)
+        self.init_bias = None if init_bias is None \
+            else jnp.asarray(init_bias, jnp.float32)
+
+
 class Cell(Module):
     """Base RNN cell: step(params, x_t, hidden, ctx) -> (out_t, new_hidden);
-    ``zero_hidden(batch, dtype)`` builds the initial state pytree."""
+    ``zero_hidden(batch, dtype)`` builds the initial state pytree.
+
+    Cells whose input projection is a plain matmul also expose
+    ``pre_width`` / ``project_input`` / ``step_projected`` so Recurrent can
+    hoist the projection out of the scan — one (B*T, in) @ (in, K) MXU call
+    instead of T small ones — and slot a BatchNorm between projection and
+    recurrence (≙ the reference's Cell.preTopology factoring,
+    Cell.scala:50-58)."""
+
+    #: width of the hoisted input projection, or None if unsupported
+    pre_width = None
+
+    def project_input(self, params, x):
+        """(..., in) -> (..., pre_width): the input half of the gate
+        pre-activations, WITHOUT bias (biases stay in step_projected /
+        the Recurrent-level pre-bias)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no hoistable input projection")
+
+    def step_projected(self, params, xp, hidden, ctx):
+        """step(), but taking the already-projected input ``xp``."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no hoistable input projection")
 
     def _step_key(self, ctx):
         """Per-timestep dropout key: Recurrent/RecurrentDecoder thread a
@@ -120,13 +160,23 @@ class RnnCell(Cell):
             return jnp.tanh(v)
         return self.activation.apply(params, v, ctx)
 
-    def step(self, params, x, h, ctx):
+    @property
+    def pre_width(self):
+        return self.hidden_size
+
+    def project_input(self, params, x):
+        return x @ self.own(params)["weight_i"].astype(x.dtype)
+
+    def step_projected(self, params, xp, h, ctx):
         p = self.own(params)
-        z = (x @ p["weight_i"].astype(x.dtype)
-             + h @ p["weight_h"].astype(x.dtype)
-             + p["bias"].astype(x.dtype))
+        z = (xp + h @ p["weight_h"].astype(xp.dtype)
+             + p["bias"].astype(xp.dtype))
         h2 = self._act(z, params, ctx)
         return h2, h2
+
+    def step(self, params, x, h, ctx):
+        return self.step_projected(
+            params, self.project_input(params, x), h, ctx)
 
 
 class LSTM(Cell):
@@ -153,18 +203,15 @@ class LSTM(Cell):
         return Table(jnp.zeros((batch_size, self.hidden_size), dtype),
                      jnp.zeros((batch_size, self.hidden_size), dtype))
 
-    def step(self, params, x, hidden, ctx):
+    @property
+    def pre_width(self):
+        return 4 * self.hidden_size
+
+    def project_input(self, params, x):
+        return x @ self.own(params)["weight_i"].astype(x.dtype)
+
+    def _from_z(self, params, z, hidden, ctx):
         h, c = as_list(hidden)
-        p = self.own(params)
-        if self.dropout_p and ctx.training:
-            z = _gate_dropout_matmul(
-                x, h, p["weight_i"].astype(x.dtype),
-                p["weight_h"].astype(x.dtype), 4, self.dropout_p,
-                self._step_key(ctx)) + p["bias"].astype(x.dtype)
-        else:
-            z = (x @ p["weight_i"].astype(x.dtype)
-                 + h @ p["weight_h"].astype(x.dtype)
-                 + p["bias"].astype(x.dtype))
         i, f, g, o = jnp.split(z, 4, axis=-1)
         inner = jax.nn.sigmoid if self.inner_activation is None else \
             (lambda v: self.inner_activation.apply(params, v, ctx))
@@ -175,6 +222,25 @@ class LSTM(Cell):
         c2 = f * c + i * g
         h2 = o * act(c2)
         return h2, Table(h2, c2)
+
+    def step_projected(self, params, xp, hidden, ctx):
+        h, _ = as_list(hidden)
+        p = self.own(params)
+        z = (xp + h @ p["weight_h"].astype(xp.dtype)
+             + p["bias"].astype(xp.dtype))
+        return self._from_z(params, z, hidden, ctx)
+
+    def step(self, params, x, hidden, ctx):
+        if self.dropout_p and ctx.training:
+            h, _ = as_list(hidden)
+            p = self.own(params)
+            z = _gate_dropout_matmul(
+                x, h, p["weight_i"].astype(x.dtype),
+                p["weight_h"].astype(x.dtype), 4, self.dropout_p,
+                self._step_key(ctx)) + p["bias"].astype(x.dtype)
+            return self._from_z(params, z, hidden, ctx)
+        return self.step_projected(
+            params, self.project_input(params, x), hidden, ctx)
 
 
 class LSTMPeephole(Cell):
@@ -199,20 +265,18 @@ class LSTMPeephole(Cell):
         return Table(jnp.zeros((batch_size, self.hidden_size), dtype),
                      jnp.zeros((batch_size, self.hidden_size), dtype))
 
-    def step(self, params, x, hidden, ctx):
-        h, c = as_list(hidden)
+    @property
+    def pre_width(self):
+        return 4 * self.hidden_size
+
+    def project_input(self, params, x):
+        return x @ self.own(params)["weight_i"].astype(x.dtype)
+
+    def _from_z(self, params, z, hidden, ctx):
+        _, c = as_list(hidden)
         p = self.own(params)
-        if self.dropout_p and ctx.training:
-            z = _gate_dropout_matmul(
-                x, h, p["weight_i"].astype(x.dtype),
-                p["weight_h"].astype(x.dtype), 4, self.dropout_p,
-                self._step_key(ctx)) + p["bias"].astype(x.dtype)
-        else:
-            z = (x @ p["weight_i"].astype(x.dtype)
-                 + h @ p["weight_h"].astype(x.dtype)
-                 + p["bias"].astype(x.dtype))
         i, f, g, o = jnp.split(z, 4, axis=-1)
-        ph = p["peephole"].astype(x.dtype)
+        ph = p["peephole"].astype(z.dtype)
         i = jax.nn.sigmoid(i + ph[0] * c)
         f = jax.nn.sigmoid(f + ph[1] * c)
         g = jnp.tanh(g)
@@ -220,6 +284,25 @@ class LSTMPeephole(Cell):
         o = jax.nn.sigmoid(o + ph[2] * c2)
         h2 = o * jnp.tanh(c2)
         return h2, Table(h2, c2)
+
+    def step_projected(self, params, xp, hidden, ctx):
+        h, _ = as_list(hidden)
+        p = self.own(params)
+        z = (xp + h @ p["weight_h"].astype(xp.dtype)
+             + p["bias"].astype(xp.dtype))
+        return self._from_z(params, z, hidden, ctx)
+
+    def step(self, params, x, hidden, ctx):
+        if self.dropout_p and ctx.training:
+            h, _ = as_list(hidden)
+            p = self.own(params)
+            z = _gate_dropout_matmul(
+                x, h, p["weight_i"].astype(x.dtype),
+                p["weight_h"].astype(x.dtype), 4, self.dropout_p,
+                self._step_key(ctx)) + p["bias"].astype(x.dtype)
+            return self._from_z(params, z, hidden, ctx)
+        return self.step_projected(
+            params, self.project_input(params, x), hidden, ctx)
 
 
 class GRU(Cell):
@@ -257,50 +340,75 @@ class GRU(Cell):
     def zero_hidden(self, batch_size, dtype=jnp.float32):
         return jnp.zeros((batch_size, self.hidden_size), dtype)
 
-    def step(self, params, x, h, ctx):
+    @property
+    def pre_width(self):
+        return 3 * self.hidden_size
+
+    def project_input(self, params, x):
         p = self.own(params)
-        g = p["gates"]
-        n = p["new"]
+        return jnp.concatenate(
+            [x @ p["gates"]["weight_i"].astype(x.dtype),
+             x @ p["new"]["weight_i"].astype(x.dtype)], axis=-1)
+
+    def _tail(self, params, z2, xn, h, ctx, drop_h=None):
+        """Shared post-projection math: r/z gates from ``z2``, candidate
+        from its input contribution ``xn`` plus the recurrent path on
+        ``h``, blend.  ``drop_h`` (p>0 training only) is the dropout
+        applied to the candidate's recurrent input — h itself for
+        reset_after, r*h for the classic form (GRU.scala p>0 places a
+        Dropout before each cell Linear)."""
+        n = self.own(params)["new"]
         inner = jax.nn.sigmoid if self.inner_activation is None else \
             (lambda v: self.inner_activation.apply(params, v, ctx))
         act = jnp.tanh if self.activation is None else \
             (lambda v: self.activation.apply(params, v, ctx))
-        drop = self.dropout_p and ctx.training
-        if drop:
-            k_g, k_x, k_h = jax.random.split(self._step_key(ctx), 3)
-            z2 = _gate_dropout_matmul(
-                x, h, g["weight_i"].astype(x.dtype),
-                g["weight_h"].astype(x.dtype), 2, self.dropout_p,
-                k_g) + g["bias"].astype(x.dtype)
-        else:
-            z2 = (x @ g["weight_i"].astype(x.dtype)
-                  + h @ g["weight_h"].astype(x.dtype)
-                  + g["bias"].astype(x.dtype))
-        if self.reset_after:
-            z2 = z2 + g["bias_h"].astype(x.dtype)
         # split BEFORE the inner activation: the reference applies it per
         # h-wide gate after Narrow (GRU.scala buildGates), so an
         # axis-dependent activation (SoftMax) must not see the 2h concat
         r_pre, z_pre = jnp.split(z2, 2, axis=-1)
         r, z = inner(r_pre), inner(z_pre)
-        # candidate path: the reference places a Dropout before the
-        # input Linear and before the hidden Linear (GRU.scala p>0)
-        xc = _drop(x, self.dropout_p, k_x) if drop else x
+        dt = z2.dtype
         if self.reset_after:
-            hc = _drop(h, self.dropout_p, k_h) if drop else h
-            rec = (hc @ n["weight_h"].astype(x.dtype)
-                   + n["bias_h"].astype(x.dtype))
-            nh = act(xc @ n["weight_i"].astype(x.dtype)
-                     + n["bias"].astype(x.dtype) + r * rec)
+            hc = drop_h(h) if drop_h is not None else h
+            rec = hc @ n["weight_h"].astype(dt) + n["bias_h"].astype(dt)
+            nh = act(xn + n["bias"].astype(dt) + r * rec)
         else:
             rh = r * h
-            if drop:
-                rh = _drop(rh, self.dropout_p, k_h)
-            nh = act(xc @ n["weight_i"].astype(x.dtype)
-                     + rh @ n["weight_h"].astype(x.dtype)
-                     + n["bias"].astype(x.dtype))
+            if drop_h is not None:
+                rh = drop_h(rh)
+            nh = act(xn + rh @ n["weight_h"].astype(dt)
+                     + n["bias"].astype(dt))
         h2 = (1.0 - z) * nh + z * h
         return h2, h2
+
+    def step_projected(self, params, xp, h, ctx):
+        g = self.own(params)["gates"]
+        hs = self.hidden_size
+        xg, xn = xp[..., :2 * hs], xp[..., 2 * hs:]
+        z2 = (xg + h @ g["weight_h"].astype(xp.dtype)
+              + g["bias"].astype(xp.dtype))
+        if self.reset_after:
+            z2 = z2 + g["bias_h"].astype(xp.dtype)
+        return self._tail(params, z2, xn, h, ctx)
+
+    def step(self, params, x, h, ctx):
+        if not (self.dropout_p and ctx.training):
+            return self.step_projected(
+                params, self.project_input(params, x), h, ctx)
+        p = self.own(params)
+        g = p["gates"]
+        n = p["new"]
+        k_g, k_x, k_h = jax.random.split(self._step_key(ctx), 3)
+        z2 = _gate_dropout_matmul(
+            x, h, g["weight_i"].astype(x.dtype),
+            g["weight_h"].astype(x.dtype), 2, self.dropout_p,
+            k_g) + g["bias"].astype(x.dtype)
+        if self.reset_after:
+            z2 = z2 + g["bias_h"].astype(x.dtype)
+        xc = _drop(x, self.dropout_p, k_x)
+        xn = xc @ n["weight_i"].astype(x.dtype)
+        return self._tail(params, z2, xn, h, ctx,
+                          drop_h=lambda v: _drop(v, self.dropout_p, k_h))
 
 
 class ConvLSTMPeephole(Cell):
@@ -398,28 +506,96 @@ class MultiRNNCell(Cell):
 
 class Recurrent(Module):
     """Run a cell over the time dim of (B, T, ...) input via lax.scan
-    (nn/Recurrent.scala)."""
+    (nn/Recurrent.scala).
 
-    def __init__(self, cell=None, name=None):
+    ``batch_norm_params`` (≙ Recurrent.scala:111-119) hoists the cell's
+    input projection out of the scan and applies BatchNorm over
+    (batch, time) between projection and recurrence — the pre-projection
+    bias lives in a Recurrent-level ``bias_pre`` param (the reference's
+    preTopology Linear bias, applied BEFORE the normalization).
+
+    ``hoist_input=True`` hoists the projection WITHOUT BatchNorm — a
+    TPU-side optimization: one (B*T, in) @ (in, K) MXU matmul replaces T
+    per-step (B, in) matmuls; math is identical (same add order), only
+    fp tiling may differ."""
+
+    def __init__(self, cell=None, batch_norm_params=None, hoist_input=False,
+                 name=None):
         super().__init__(name=name)
         self.cell = cell
+        self.batch_norm_params = batch_norm_params
+        self.hoist_input = bool(hoist_input)
+        self.bn = None
 
     def add(self, cell):
         self.cell = cell
         return self
 
     def children(self):
-        return [self.cell] if self.cell is not None else []
+        if self.cell is None:
+            return []
+        if self.batch_norm_params is not None and self.bn is None:
+            try:
+                self._ensure_bn()
+            except ValueError:
+                pass  # unsupported-cell error surfaces at init/apply
+        out = [self.cell]
+        if self.bn is not None:
+            out.append(self.bn)
+        return out
 
     def _serde_restore_children(self, children):
         if children and children[0] is not None:
             self.cell = children[0]
 
+    def _bn_config(self):
+        bp = self.batch_norm_params
+        if isinstance(bp, dict):
+            bp = BatchNormParams(**bp)
+        return bp
+
+    def _ensure_bn(self):
+        if self.batch_norm_params is None or self.bn is not None:
+            return
+        if self.cell is None or getattr(self.cell, "pre_width", None) is None:
+            # ≙ Recurrent.scala:104-108: BN needs a preTopology projection
+            raise ValueError(
+                f"{type(self.cell).__name__ if self.cell else None} does "
+                "not support BatchNormParams: no hoistable input projection")
+        if self._cell_is_stochastic(self.cell):
+            raise ValueError(
+                "BatchNormParams requires a p == 0 cell (the reference's "
+                "p > 0 cells have no preTopology, Recurrent.scala:104)")
+        from .normalization import TemporalBatchNormalization
+        bp = self._bn_config()
+        self.bn = TemporalBatchNormalization(
+            self.cell.pre_width, eps=bp.eps, momentum=bp.momentum,
+            affine=bp.affine, name=f"{self.name}_bn")
+
     def init(self, rng):
-        return self.cell.init(rng)
+        if self.batch_norm_params is None:
+            return self.cell.init(rng)
+        self._ensure_bn()
+        k1, k2 = jax.random.split(rng)
+        p = self.cell.init(k1)
+        p.update(self.bn.init(k2))
+        bp = self._bn_config()
+        if bp.affine and bp.init_weight is not None:
+            p[self.bn.name]["weight"] = jnp.reshape(
+                bp.init_weight, p[self.bn.name]["weight"].shape)
+        if bp.affine and bp.init_bias is not None:
+            p[self.bn.name]["bias"] = jnp.reshape(
+                bp.init_bias, p[self.bn.name]["bias"].shape)
+        p[self.name] = {"bias_pre": jnp.zeros((self.cell.pre_width,),
+                                              jnp.float32)}
+        return p
 
     def initial_state(self):
-        return self.cell.initial_state()
+        st = dict(self.cell.initial_state())
+        if self.batch_norm_params is not None:
+            self._ensure_bn()
+            st.update(self.bn.initial_state())
+        return st
 
     def _initial_hidden(self, x):
         if hasattr(self.cell, "zero_hidden"):
@@ -437,6 +613,28 @@ class Recurrent(Module):
 
     def apply(self, params, x, ctx):
         hidden0 = self._initial_hidden(x)
+
+        # bn mode ALWAYS hoists (_ensure_bn rejects stochastic cells);
+        # bare hoist_input falls back when it can't (stochastic cell in
+        # training, or a cell with no separable projection)
+        hoist = self.batch_norm_params is not None or (
+            self.hoist_input
+            and getattr(self.cell, "pre_width", None) is not None
+            and not (ctx.training and self._cell_is_stochastic(self.cell)))
+        if hoist:
+            self._ensure_bn()
+            proj = self.cell.project_input(params, x)  # (B, T, K)
+            if self.bn is not None:
+                proj = proj + self.own(params)["bias_pre"].astype(proj.dtype)
+                proj = self.bn.apply(params, proj, ctx)
+
+            def body(h, xp_t):
+                out, h2 = self.cell.step_projected(params, xp_t, h, ctx)
+                return h2, out
+
+            _, outs = lax.scan(body, hidden0, jnp.swapaxes(proj, 0, 1))
+            return jnp.swapaxes(outs, 0, 1)
+
         xs_t = jnp.swapaxes(x, 0, 1)  # (T, B, ...)
 
         if ctx.training and ctx.rng_key is not None \
@@ -473,19 +671,33 @@ class BiRecurrent(Module):
     cell's input_size must then be half the model feature width."""
 
     def __init__(self, merge=None, cell=None, is_split_input=False,
-                 name=None):
+                 batch_norm_params=None, name=None):
         super().__init__(name=name)
         self.merge = merge
         self.fwd_cell = cell
         self.bwd_cell = None
         self.is_split_input = is_split_input
+        # each direction gets its OWN BatchNorm instance, exactly like the
+        # reference's layer/revLayer = Recurrent(batchNormParams) pair
+        # (BiRecurrent.scala:45-46)
+        self.batch_norm_params = batch_norm_params
 
     def add(self, cell):
-        import copy
         self.fwd_cell = cell
+        # drop any derived backward copy of the OLD cell (children()/
+        # modules() may have triggered _ensure_bwd before this add)
+        self.bwd_cell = None
+        self._rec_pair = None
         return self
 
     def children(self):
+        if self.batch_norm_params is not None and self.fwd_cell is not None:
+            # bn mode: the runners OWN params (bias_pre, per-direction BN
+            # gamma/beta) — they must be reachable from modules() or
+            # get_weights/set_weights would silently skip those slots
+            self._ensure_bwd()
+            fwd, bwd = self._runners()
+            return [fwd, bwd] + ([self.merge] if self.merge else [])
         return [c for c in (self.fwd_cell, self.bwd_cell, self.merge) if c]
 
     def _serde_children(self):
@@ -507,12 +719,24 @@ class BiRecurrent(Module):
     def init(self, rng):
         self._ensure_bwd()
         k1, k2, k3 = jax.random.split(rng, 3)
+        fwd, bwd = self._runners()
         p = {}
-        p.update(self.fwd_cell.init(k1))
-        p.update(self.bwd_cell.init(k2))
+        p.update(fwd.init(k1))
+        p.update(bwd.init(k2))
         if self.merge is not None:
             p.update(self.merge.init(k3))
         return p
+
+    def initial_state(self):
+        if self.fwd_cell is None:
+            return {}
+        self._ensure_bwd()
+        fwd, bwd = self._runners()
+        st = dict(fwd.initial_state())
+        st.update(bwd.initial_state())
+        if self.merge is not None:
+            st.update(self.merge.initial_state())
+        return st
 
     def _runners(self):
         """Cached Recurrent wrappers: rebuilding them per forward would
@@ -522,8 +746,11 @@ class BiRecurrent(Module):
         pair = getattr(self, "_rec_pair", None)
         if pair is None or pair[0].cell is not self.fwd_cell \
                 or pair[1].cell is not self.bwd_cell:
-            pair = (Recurrent(self.fwd_cell, name=f"{self.name}_f"),
-                    Recurrent(self.bwd_cell, name=f"{self.name}_b"))
+            bp = self.batch_norm_params
+            pair = (Recurrent(self.fwd_cell, batch_norm_params=bp,
+                              name=f"{self.name}_f"),
+                    Recurrent(self.bwd_cell, batch_norm_params=bp,
+                              name=f"{self.name}_b"))
             self._rec_pair = pair
         return pair
 

@@ -471,7 +471,7 @@ def _pick_mat(mats, pred, what, t):
     raise ValueError(f".bigdl {t}: no {what} weight in cell parameters")
 
 
-def _cell_weights(tree):
+def _cell_weights(tree, split_pre_bias=False):
     """Reference cell wire tree -> (cell_name, our fused weight dict).
 
     The Linear weights live in two places: the input-to-gate Linear
@@ -480,11 +480,23 @@ def _cell_weights(tree):
     in the cell module's own flat parameter list (Cell.parameters() =
     the internal graph's Linears in topo order).  Reference Linear
     weights are (out, in); our fused layout is (in, out).
+
+    ``split_pre_bias=True`` (the Recurrent(BatchNormParams) load path)
+    keeps the preTopology Linear bias OUT of the fused step bias and
+    returns a 4-tuple (name, weights, pre_bias, perm) instead — the
+    pre-bias is applied BEFORE the BatchNorm (Recurrent.scala:119), and
+    ``perm`` re-orders any per-feature vector of the projection (BN
+    gamma/beta/running stats) from the reference's gate order onto our
+    fused one.
     """
     t = _short_type(tree["type"])
     a = tree["attr"]
     if _checked_cell_p(tree) != 0.0:
         # dropout form: no preTopology, per-gate Linears in flat params
+        if split_pre_bias:
+            raise ValueError(
+                f".bigdl {t}: BatchNormParams with p > 0 has no wire "
+                "form (the reference's p > 0 cells have no preTopology)")
         return _cell_weights_dropout(tree, t, a)
     pre = a.get("preTopology")
     pre_params = (pre or {}).get("params") or []
@@ -537,9 +549,12 @@ def _cell_weights(tree):
 
         bias = reorder(b_pre) if b_pre is not None \
             else np.zeros(4 * h, np.float32)
-        return tree["name"], {"weight_i": reorder(w_pre).T.copy(),
-                              "weight_h": reorder(w_h).T.copy(),
-                              "bias": bias}
+        wd = {"weight_i": reorder(w_pre).T.copy(),
+              "weight_h": reorder(w_h).T.copy(), "bias": bias}
+        if split_pre_bias:
+            wd["bias"] = np.zeros(4 * h, np.float32)
+            return tree["name"], wd, bias, reorder
+        return tree["name"], wd
     if t == "GRU":
         h = int(a["outputSize"])
         # pre chunks are [r, z, n] (GRU.scala:107 Narrow + :137 f2g)
@@ -548,29 +563,43 @@ def _cell_weights(tree):
         w_new = _pick_mat(own, lambda m: m.ndim == 2 and m.shape == (h, h),
                           "hidden-to-new", t)
         bias = b_pre if b_pre is not None else np.zeros(3 * h, np.float32)
-        return tree["name"], {
+        wd = {
             "gates": {"weight_i": w_pre[:2 * h].T.copy(),
                       "weight_h": w_h2g.T.copy(), "bias": bias[:2 * h]},
             "new": {"weight_i": w_pre[2 * h:].T.copy(),
                     "weight_h": w_new.T.copy(), "bias": bias[2 * h:]}}
+        if split_pre_bias:
+            # projection order [r, z, n] == our [gates(2h), new(h)] concat
+            wd["gates"]["bias"] = np.zeros(2 * h, np.float32)
+            wd["new"]["bias"] = np.zeros(h, np.float32)
+            return tree["name"], wd, bias, lambda v: v
+        return tree["name"], wd
     if t == "RnnCell":
         h = int(a["hiddenSize"])
         w_h = _pick_mat(own, lambda m: m.ndim == 2 and m.shape == (h, h),
                         "hidden-to-hidden", t)
         # reference has separate input/hidden biases; ours is one sum
         b_h = next((m for m in own if m.ndim == 1 and m.shape == (h,)), None)
+        wd = {"weight_i": w_pre.T.copy(), "weight_h": w_h.T.copy()}
+        if split_pre_bias:
+            wd["bias"] = b_h if b_h is not None else np.zeros(h, np.float32)
+            pre = b_pre if b_pre is not None else np.zeros(h, np.float32)
+            return tree["name"], wd, pre, lambda v: v
         bias = np.zeros(h, np.float32)
         if b_pre is not None:
             bias = bias + b_pre
         if b_h is not None:
             bias = bias + b_h
-        return tree["name"], {"weight_i": w_pre.T.copy(),
-                              "weight_h": w_h.T.copy(), "bias": bias}
+        wd["bias"] = bias
+        return tree["name"], wd
     raise ValueError(f"unsupported recurrent cell {tree['type']!r}")
 
 
 def _build_recurrent_decoder(tree):
     a = tree["attr"]
+    if a.get("bnorm"):
+        raise ValueError(
+            ".bigdl RecurrentDecoder(BatchNormParams) is not supported")
     topo = a.get("topology")
     if not isinstance(topo, dict):
         raise ValueError(".bigdl RecurrentDecoder: missing topology attr")
@@ -580,15 +609,50 @@ def _build_recurrent_decoder(tree):
     return dec
 
 
+def _bn_params_from_attrs(a):
+    """Recurrent/BiRecurrent bnorm attrs -> nn.BatchNormParams
+    (Recurrent.scala:738-768 doLoadModule reads bnormEps/bnormMomentum/
+    bnormAffine; gamma/beta come from the serialized BN module itself,
+    so init_weight/init_bias are not needed here)."""
+    eps = a.get("bnormEps")
+    mom = a.get("bnormMomentum")
+    aff = a.get("bnormAffine")
+    # None-checks, not `or`: momentum=0.0 (frozen running stats) and
+    # affine=False are legitimate serialized values
+    return nn.BatchNormParams(
+        eps=1e-5 if eps is None else float(eps),
+        momentum=0.1 if mom is None else float(mom),
+        affine=True if aff is None else bool(aff))
+
+
+def _recurrent_bn_tree(rec_tree):
+    """Find the BatchNormalization module tree under a bnorm=true
+    Recurrent's preTopology attr (Recurrent.scala:111-119 wraps it as
+    Sequential[TimeDistributed(pre), TimeDistributed(BN)])."""
+    stack = [rec_tree["attr"].get("preTopology")]
+    while stack:
+        t = stack.pop()
+        if not isinstance(t, dict):
+            continue
+        st = _short_type(t["type"])
+        if st in ("BatchNormalization", "SpatialBatchNormalization"):
+            return t
+        inner = t["attr"].get("layer") if st == "TimeDistributed" else None
+        if inner is not None:
+            stack.append(inner)
+        stack.extend(t.get("subs") or [])
+    raise ValueError(
+        ".bigdl Recurrent(bnorm): no BatchNormalization module found "
+        "under the preTopology attr")
+
+
 def _build_recurrent(tree):
     a = tree["attr"]
-    if a.get("bnorm"):
-        raise ValueError(
-            ".bigdl Recurrent(BatchNormParams) is not supported")
     topo = a.get("topology")
     if not isinstance(topo, dict):
         raise ValueError(".bigdl Recurrent: missing topology cell attr")
-    rec = nn.Recurrent(_build_cell(topo))
+    bn = _bn_params_from_attrs(a) if a.get("bnorm") else None
+    rec = nn.Recurrent(_build_cell(topo), batch_norm_params=bn)
     if tree["name"]:
         rec.set_name(tree["name"])
     return rec
@@ -613,12 +677,6 @@ def _birnn_recurrents(birnn):
 
 def _build_birecurrent(tree):
     a = tree["attr"]
-    if a.get("bnorm"):
-        # Recurrent(BatchNormParams) runs time-unrolled BN INSIDE the
-        # recurrence (BiRecurrent.scala:46-47) — out of scope, see
-        # docs/interop.md "known .bigdl limitations"
-        raise ValueError(
-            ".bigdl BiRecurrent(BatchNormParams) is not supported")
     birnn = a.get("birnn")
     if not isinstance(birnn, dict):
         raise ValueError(".bigdl BiRecurrent: missing birnn attr")
@@ -634,8 +692,13 @@ def _build_birecurrent(tree):
     split = bool(a.get("isSplitInput")) or any(
         _short_type(s["type"]) == "BifurcateSplitTable"
         for s in subs[:1])
+    # bnorm: each direction's internal Recurrent carries its own
+    # BatchNorm (BiRecurrent.scala:45-46); config attrs ride the
+    # BiRecurrent node (bnormEps/bnormMomentum, BiRecurrent.scala:178-193)
+    bn = _bn_params_from_attrs(a) if a.get("bnorm") else None
     m = nn.BiRecurrent(merge=merge, cell=_build_cell(
-        fwd_t["attr"]["topology"]), is_split_input=split)
+        fwd_t["attr"]["topology"]), is_split_input=split,
+        batch_norm_params=bn)
     if tree["name"]:
         m.set_name(tree["name"])
     return m
@@ -678,6 +741,60 @@ def _assign_cell_weights(params, cell_tree, target=None,
             f".bigdl cell {cname!r}: weight shapes {got} do not match "
             f"the built cell {want}")
     params[cname] = wd
+
+
+def _assign_recurrent_bn(params, state, rec_tree, rec_slot,
+                         cell_slot=None):
+    """bnorm=true Recurrent tree -> cell weights (preTopology bias split
+    OUT of the fused step bias: it applies BEFORE the BatchNorm,
+    Recurrent.scala:119), the built Recurrent's own ``bias_pre``, and
+    the BN's gamma/beta + running stats — all per-feature vectors of the
+    projection permuted from the reference's gate order onto our fused
+    one.  ``rec_slot`` names the built Recurrent's own params slot
+    (BiRecurrent runners are '<bi>_f'/'<bi>_b'); ``cell_slot`` renames
+    the cell slot (the backward direction's '<fwd>_bwd')."""
+    import jax
+    topo = rec_tree["attr"]["topology"]
+    cname, wd, pre_bias, perm = _cell_weights(topo, split_pre_bias=True)
+    if cell_slot is not None:
+        cname = cell_slot
+    for slot in (cname, rec_slot):
+        if slot not in params:
+            raise ValueError(
+                f".bigdl Recurrent(bnorm): no params slot {slot!r} in "
+                "the built model")
+    want = jax.tree_util.tree_map(np.shape, params[cname])
+    got = jax.tree_util.tree_map(np.shape, wd)
+    if want != got:
+        raise ValueError(
+            f".bigdl cell {cname!r}: weight shapes {got} do not match "
+            f"the built cell {want}")
+    params[cname] = wd
+    own = dict(params[rec_slot])
+    own["bias_pre"] = np.asarray(pre_bias, np.float32).reshape(
+        np.shape(own["bias_pre"]))
+    params[rec_slot] = own
+    bn_tree = _recurrent_bn_tree(rec_tree)
+    bn_slot = f"{rec_slot}_bn"
+    arrs = bn_tree["params"] if bn_tree["has_params"] else \
+        [t for t in (bn_tree["weight"], bn_tree["bias"]) if t is not None]
+    if arrs and bn_slot in params:
+        own_bn = dict(params[bn_slot])
+        keys = nn.Module._weights_order(own_bn)
+        for k, arr in zip(keys, arrs):
+            own_bn[k] = perm(np.asarray(arr, np.float32).reshape(
+                np.shape(own_bn[k])))
+        params[bn_slot] = own_bn
+    st = state.get(bn_slot)
+    if isinstance(st, dict):
+        st = dict(st)
+        for ak, sk in (("runningMean", "running_mean"),
+                       ("runningVar", "running_var")):
+            val = bn_tree["attr"].get(ak)
+            if val is not None and sk in st:
+                st[sk] = perm(np.asarray(val, np.float32).reshape(
+                    np.shape(st[sk])))
+        state[bn_slot] = st
 
 
 _FACTORY = {
@@ -935,6 +1052,10 @@ def load_bigdl(path: str):
     def assign_leaf(sub):
         st = _short_type(sub["type"])
         if st in ("Recurrent", "RecurrentDecoder"):
+            if sub["attr"].get("bnorm") and st == "Recurrent":
+                _assign_recurrent_bn(params, state, sub,
+                                     rec_slot=sub["name"])
+                return
             # cell weights come from the topology attr's Linear layout,
             # not the Recurrent's own flat parameter list
             _assign_cell_weights(params, sub["attr"]["topology"])
@@ -942,12 +1063,22 @@ def load_bigdl(path: str):
 
         if st == "BiRecurrent":
             fwd_t, rev_t = _birnn_recurrents(sub["attr"]["birnn"])
+            fwd_name = fwd_t["attr"]["topology"]["name"]
+            if sub["attr"].get("bnorm"):
+                # per-direction BN: the runners' slots are
+                # '<bi>_f'/'<bi>_b' (nn/recurrent.py BiRecurrent._runners)
+                bi = sub["name"]
+                _assign_recurrent_bn(params, state, fwd_t,
+                                     rec_slot=f"{bi}_f")
+                _assign_recurrent_bn(params, state, rev_t,
+                                     rec_slot=f"{bi}_b",
+                                     cell_slot=f"{fwd_name}_bwd")
+                return
             _assign_cell_weights(params, fwd_t["attr"]["topology"])
             # the built model's backward cell is a rename of the forward
             # one ("<fwd>_bwd", nn/recurrent.py BiRecurrent._ensure_bwd);
             # the reference's reverse topology has its own name — assign
             # with the same shape/structure validation as the fwd cell
-            fwd_name = fwd_t["attr"]["topology"]["name"]
             _assign_cell_weights(params, rev_t["attr"]["topology"],
                                  target=f"{fwd_name}_bwd",
                                  target_tree=fwd_t["attr"]["topology"])

@@ -423,3 +423,197 @@ class TestCellDropout:
         total = sum(float(np.abs(np.asarray(v)).sum())
                     for sub in g.values() for v in sub.values())
         assert np.isfinite(total) and total > 0
+
+
+class TestRecurrentHoistAndBatchNorm:
+    """Hoisted input projection (Recurrent(hoist_input=True): one
+    (B*T, in) MXU matmul instead of T per-step ones) and
+    Recurrent(batch_norm_params=...) ≙ nn/Recurrent.scala:111-119
+    BatchNormParams — TimeDistributed BN between the input projection
+    and the recurrence."""
+
+    def _clone_named(self, make):
+        a, b = make(), make()
+        for m1, m2 in zip(a.modules(), b.modules()):
+            m2.name = m1.name
+        return a, b
+
+    @pytest.mark.parametrize("make_cell", [
+        lambda: nn.RnnCell(5, 4),
+        lambda: nn.LSTM(5, 4),
+        lambda: nn.LSTMPeephole(5, 4),
+        lambda: nn.GRU(5, 4),
+        lambda: nn.GRU(5, 4, reset_after=True),
+    ])
+    def test_hoist_input_matches_scan_projection(self, make_cell):
+        c1, c2 = self._clone_named(make_cell)
+        r1 = nn.Recurrent(c1)
+        r2 = nn.Recurrent(c2, hoist_input=True)
+        p, st = r1.init_params(0)
+        x = np.random.RandomState(0).randn(3, 7, 5).astype(np.float32)
+        y1, _ = r1.run(p, x, state=st)
+        y2, _ = r2.run(p, x, state=st)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_hoist_input_gradient_parity(self):
+        make = lambda: nn.LSTM(4, 3)
+        c1, c2 = self._clone_named(make)
+        r1, r2 = nn.Recurrent(c1), nn.Recurrent(c2, hoist_input=True)
+        p, st = r1.init_params(1)
+        x = np.random.RandomState(1).randn(2, 6, 4).astype(np.float32)
+
+        def loss(rec):
+            def f(q):
+                y, _ = rec.run(q, x, state=st)
+                return jnp.sum(y * y)
+            return jax.grad(f)(p)
+
+        g1, g2 = loss(r1), loss(r2)
+        for a, b in zip(jax.tree_util.tree_leaves(g1),
+                        jax.tree_util.tree_leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_hoist_falls_back_for_stochastic_training(self):
+        """p>0 cell in training: per-step dropout can't hoist; the flag
+        must silently use the scan path (and still work)."""
+        rec = nn.Recurrent(nn.LSTM(4, 3, p=0.4), hoist_input=True)
+        p, st = rec.init_params(0)
+        x = np.random.RandomState(2).randn(2, 5, 4).astype(np.float32)
+        y, _ = rec.run(p, x, state=st, training=True,
+                       rng=jax.random.PRNGKey(0))
+        assert np.asarray(y).shape == (2, 5, 3)
+        assert np.all(np.isfinite(np.asarray(y)))
+
+    def test_batch_norm_params_train_eval(self):
+        """Train mode normalizes the projection with BATCH stats over
+        (B, T) and updates running stats; eval uses the stored stats —
+        numpy-checked against the definition."""
+        rec = nn.Recurrent(nn.RnnCell(3, 2),
+                           batch_norm_params=nn.BatchNormParams())
+        p, st = rec.init_params(0)
+        # distinctive BN affine + pre-bias so the check is not trivial
+        rng = np.random.RandomState(3)
+        p[rec.bn.name]["weight"] = jnp.asarray(
+            (1.0 + 0.2 * rng.randn(rec.cell.pre_width)).astype(np.float32))
+        p[rec.name]["bias_pre"] = jnp.asarray(
+            rng.randn(rec.cell.pre_width).astype(np.float32))
+        x = rng.randn(4, 6, 3).astype(np.float32)
+        y, st2 = rec.run(p, x, state=st, training=True,
+                         rng=jax.random.PRNGKey(0))
+        # numpy reference of the train-mode forward
+        wi = np.asarray(p[rec.cell.name]["weight_i"])
+        wh = np.asarray(p[rec.cell.name]["weight_h"])
+        b = np.asarray(p[rec.cell.name]["bias"])
+        bp = np.asarray(p[rec.name]["bias_pre"])
+        gam = np.asarray(p[rec.bn.name]["weight"])
+        bet = np.asarray(p[rec.bn.name]["bias"])
+        pre = x @ wi + bp
+        mean = pre.mean(axis=(0, 1))
+        var = pre.var(axis=(0, 1))
+        u = gam * (pre - mean) / np.sqrt(var + rec.bn.eps) + bet
+        hs = np.zeros((4, 2), np.float32)
+        want = np.zeros((4, 6, 2), np.float32)
+        for t in range(6):
+            hs = np.tanh(u[:, t] + hs @ wh + b)
+            want[:, t] = hs
+        np.testing.assert_allclose(np.asarray(y), want,
+                                   rtol=1e-4, atol=1e-5)
+        # running stats moved toward the batch moments
+        rm = np.asarray(st2[rec.bn.name]["running_mean"])
+        n = pre.shape[0] * pre.shape[1]
+        np.testing.assert_allclose(rm, 0.1 * mean, rtol=1e-4, atol=1e-5)
+        rv = np.asarray(st2[rec.bn.name]["running_var"])
+        np.testing.assert_allclose(
+            rv, 0.9 * 1.0 + 0.1 * var * n / (n - 1), rtol=1e-4, atol=1e-4)
+        # eval mode consumes the running stats
+        ye, _ = rec.run(p, x, state=st2)
+        ue = gam * (pre - rm) / np.sqrt(rv + rec.bn.eps) + bet
+        hs = np.zeros((4, 2), np.float32)
+        we = np.zeros((4, 6, 2), np.float32)
+        for t in range(6):
+            hs = np.tanh(ue[:, t] + hs @ wh + b)
+            we[:, t] = hs
+        np.testing.assert_allclose(np.asarray(ye), we,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_batch_norm_params_rejects_stochastic_and_conv_cells(self):
+        with pytest.raises(ValueError, match="p == 0"):
+            nn.Recurrent(nn.GRU(4, 3, p=0.2),
+                         batch_norm_params=nn.BatchNormParams()).init_params(0)
+        with pytest.raises(ValueError, match="BatchNormParams"):
+            nn.Recurrent(nn.ConvLSTMPeephole(2, 3, 3, 3),
+                         batch_norm_params=nn.BatchNormParams()).init_params(0)
+
+    def test_birecurrent_batch_norm_directions_independent(self):
+        """Each direction owns a BN instance (BiRecurrent.scala:45-46):
+        perturbing the backward BN's gamma must change the output."""
+        bi = nn.BiRecurrent(cell=nn.LSTM(3, 2),
+                            batch_norm_params=nn.BatchNormParams())
+        p, st = bi.init_params(0)
+        x = np.random.RandomState(5).randn(2, 4, 3).astype(np.float32)
+        y0, _ = bi.run(p, x, state=st, training=True,
+                       rng=jax.random.PRNGKey(0))
+        bn_b = f"{bi.name}_b_bn"
+        assert bn_b in p
+        p2 = dict(p)
+        p2[bn_b] = dict(p[bn_b])
+        p2[bn_b]["weight"] = p[bn_b]["weight"] * 2.0
+        y1, _ = bi.run(p2, x, state=st, training=True,
+                       rng=jax.random.PRNGKey(0))
+        assert float(np.abs(np.asarray(y0) - np.asarray(y1)).max()) > 1e-6
+
+    def test_birecurrent_bn_weights_visible_to_get_set(self):
+        """The runners' own params (bias_pre, per-direction BN
+        gamma/beta) must ride get_weights/set_weights — a transfer that
+        silently skipped them would corrupt loaded bnorm models."""
+        make = lambda: nn.BiRecurrent(cell=nn.RnnCell(4, 3),
+                                      batch_norm_params=nn.BatchNormParams())
+        bi = make()
+        bi.ensure_initialized()
+        n_arrays = sum(len(v) for v in bi._params.values())
+        w = bi.get_weights()
+        assert len(w) == n_arrays
+        bi2 = make()
+        bi2.ensure_initialized()
+        shifted = [a + 0.1 for a in w]
+        bi2.set_weights(shifted)
+        for a, b in zip(shifted, bi2.get_weights()):
+            np.testing.assert_allclose(a, b)
+
+    def test_recurrent_bn_serializer_roundtrip_preserves_momentum_zero(self):
+        """Native serde: Recurrent(bn) forward parity after round trip,
+        and momentum=0.0 (frozen stats) must NOT collapse to a default."""
+        import tempfile, os
+        from bigdl_tpu.utils.serializer import save_module, load_module
+        rec = nn.Sequential(nn.Recurrent(
+            nn.LSTM(4, 3), batch_norm_params=nn.BatchNormParams(momentum=0.0)))
+        x = np.random.RandomState(0).randn(2, 5, 4).astype(np.float32)
+        rec.ensure_initialized()
+        y0 = np.asarray(rec.forward(x))
+        with tempfile.TemporaryDirectory() as d:
+            p = os.path.join(d, "m.bigdl_tpu")
+            save_module(rec, p)
+            m2 = load_module(p)
+        np.testing.assert_allclose(np.asarray(m2.forward(x)), y0,
+                                   rtol=1e-5, atol=1e-6)
+        inner = [m for m in m2.modules() if isinstance(m, nn.Recurrent)][0]
+        assert inner._bn_config().momentum == 0.0
+
+    def test_birecurrent_add_after_introspection_rebuilds_bwd(self):
+        """children()/modules() in bn mode triggers _ensure_bwd; a later
+        add() must DROP the derived backward copy of the old cell, not
+        silently train fwd=new / bwd=old."""
+        bi = nn.BiRecurrent(cell=nn.LSTM(3, 2),
+                            batch_norm_params=nn.BatchNormParams())
+        bi.modules()  # freezes a deepcopy of the LSTM without the fix
+        bi.add(nn.GRU(3, 2))
+        bi.init_params(0)
+        assert type(bi.bwd_cell).__name__ == "GRU"
+        # and the same invariant without bn
+        bi2 = nn.BiRecurrent(cell=nn.LSTM(3, 2))
+        bi2.init_params(0)  # builds bwd
+        bi2.add(nn.GRU(3, 2))
+        bi2.init_params(0)
+        assert type(bi2.bwd_cell).__name__ == "GRU"
