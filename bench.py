@@ -127,6 +127,7 @@ def _report(metric, value, unit, baseline, defer=False):
         _HEADLINE.update(line)
     else:
         print(json.dumps(line), flush=True)
+    _LAST_PROGRESS[0] = time.time()
 
 
 # --------------------------------------------------------------------- #
@@ -457,6 +458,32 @@ def _deadline_watchdog(seconds):
     threading.Thread(target=watch, daemon=True).start()
 
 
+_LAST_PROGRESS = [time.time()]
+
+
+def _stall_watchdog(seconds):
+    """Per-config progress watchdog: the 08:30 r5 run showed a wedged
+    tunnel hanging ONE config (vgg16's compile after lenet's connection
+    refusal) silently for 55 minutes until the deadline fired.  If no
+    config completes within `seconds`, the run is wedged — flush the
+    headline and exit 3 so the retry loop gets the tunnel back sooner.
+    Must exceed the slowest legitimate single config (~5 min for the
+    resnet50 first-compile + measurement); default 900 s."""
+    import threading
+
+    def watch():
+        while True:
+            time.sleep(30)
+            idle = time.time() - _LAST_PROGRESS[0]
+            if idle > seconds:
+                print(f"# bench stalled ({idle:.0f}s without a config "
+                      "completing) — tunnel presumed wedged; emitting "
+                      "headline and exiting", file=sys.stderr, flush=True)
+                _flush_headline_and_exit(3)
+
+    threading.Thread(target=watch, daemon=True).start()
+
+
 def main():
     import os
     # persistent compilation cache: repeated bench runs (and the
@@ -474,6 +501,11 @@ def main():
     _device_liveness_probe(
         float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 300)),
         retries=int(os.environ.get("BENCH_PROBE_RETRIES", 1)))
+    # stall watchdog starts AFTER the probe: the probe has its own
+    # watchdog + deliberate retry-after-idle waits that must not be
+    # mistaken for a mid-run stall (and its rc=2 diagnosis preserved)
+    _LAST_PROGRESS[0] = time.time()
+    _stall_watchdog(float(os.environ.get("BENCH_STALL_S", 900)))
     names = sys.argv[1:] or list(CONFIGS)
     unknown = [n for n in names if n not in CONFIGS]
     if unknown:
@@ -490,6 +522,7 @@ def main():
     headline_err = None
     try:
         for name in names:
+            _LAST_PROGRESS[0] = time.time()
             try:
                 CONFIGS[name]()
             except Exception as e:  # one config must not sink the others

@@ -1,4 +1,4 @@
-"""Run the queued TPU measurements, wedge-resiliently.
+"""Run the queued TPU measurements, wedge-resiliently and STATEFULLY.
 
 Each step runs in its OWN subprocess with a hard timeout: a wedged
 compile (the failure mode that ate K2/K3 on 2026-07-31 — 25-minute hang
@@ -6,58 +6,96 @@ then `remote_compile: Connection refused`) kills only that subprocess.
 A timeout aborts the whole queue (a wedged tunnel won't serve the next
 step either, and more traffic prolongs the wedge).
 
+Steps that COMPLETE are recorded in a sentinel dir and skipped on the
+next attempt, so short live windows make monotonic progress instead of
+re-spending themselves on the same prefix.  The r5 08:30 window proved
+the need: the full bench banked the resnet50 headline + 3 configs, then
+the tunnel wedged — three rounds of live windows have now died inside
+the full bench while the decision-lever experiments (s2d stem, remat
+b512, BN-fold, wq8 decode) never ran.  Order is therefore: cheap
+levers FIRST (they decide the headline config), full bench LAST (it
+re-verifies whatever config the levers picked, and the driver runs
+bench.py again at round end anyway).
+
 Usage: python scripts/tpu_queue.py            # probe, then run queue
        python scripts/tpu_queue.py --list     # show the queue
+       python scripts/tpu_queue.py --reset    # clear completion state
 """
 import os
+import re
 import subprocess
 import sys
 import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 PY = sys.executable
+STATE_DIR = os.path.join(HERE, os.pardir, ".queue_state")
 
 QUEUE = [
-    # (label, argv, timeout_s)
+    # (label, argv, timeout_s[, extra_env]); probe is never sentinel-skipped
     ("probe", [PY, os.path.join(HERE, "tpu_probe.py"), "120"], 150),
-    # FULL BENCH FIRST in every live window (tunnel discipline / VERDICT
-    # r3 weak-1): the gate artifact before any experiment ladder
-    # BENCH_DEADLINE_S matches the 3600s budget: bench's internal
-    # watchdog (default 2700s) exits rc=3 on a slow-but-healthy run,
-    # which would otherwise read as a wedge and abort the whole queue
-    ("full bench (gate artifact)",
-     [PY, os.path.join(HERE, os.pardir, "bench.py")], 3600,
-     {"BENCH_DEADLINE_S": "3400"}),
     ("K2 s2d stem full step",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K2"], 1500),
     ("K3 autodiff-BN full step",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K3"], 1500),
-    ("K4-K6 input dtype / batch variants",
-     [PY, os.path.join(HERE, "perf_experiments4.py"), "K4", "K5", "K6"],
-     2400),
-    ("resnet50 profile capture -> /tmp/tpu_trace",
-     [PY, os.path.join(HERE, "tpu_tuning.py"), "profile"], 1200),
-    ("transformer tuning matrix",
-     [PY, os.path.join(HERE, "transformer_tuning.py"), "matrix"], 2400),
     ("K7/K8 remat b256/b512",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K7", "K8"], 2400),
     ("K9 BN-folded bf16 inference",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K9"], 1500),
     ("K10 weight-only int8 decode",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K10"], 1500),
-    # (moe config already runs inside the full bench above)
+    ("K4-K6 input dtype / batch variants",
+     [PY, os.path.join(HERE, "perf_experiments4.py"), "K4", "K5", "K6"],
+     2400),
+    ("transformer tuning matrix",
+     [PY, os.path.join(HERE, "transformer_tuning.py"), "matrix"], 2400),
+    ("resnet50 profile capture -> /tmp/tpu_trace",
+     [PY, os.path.join(HERE, "tpu_tuning.py"), "profile"], 1200),
+    # full bench LAST: re-verifies the lever-chosen config end to end.
+    # BENCH_DEADLINE_S matches the 3600s budget (the internal default
+    # 2700s watchdog exits rc=3 on a slow-but-healthy run, which would
+    # otherwise read as a wedge); BENCH_STALL_S aborts a wedged config
+    # after 15 min instead of hanging to the deadline.
+    ("full bench (gate artifact)",
+     [PY, os.path.join(HERE, os.pardir, "bench.py")], 3600,
+     {"BENCH_DEADLINE_S": "3400", "BENCH_STALL_S": "900"}),
 ]
+
+
+def _sentinel(entry):
+    """Sentinel path for a queue entry.  Keyed on label + argv + extra
+    env, so editing a step (or re-using a label in a later round)
+    self-invalidates its stale completion state instead of silently
+    skipping the new work."""
+    import hashlib
+    label, argv = entry[0], entry[1]
+    extra = entry[3] if len(entry) > 3 else {}
+    key = repr((argv, sorted(extra.items()))).encode()
+    slug = re.sub(r"[^A-Za-z0-9]+", "_", label).strip("_")
+    return os.path.join(
+        STATE_DIR, f"{slug}.{hashlib.sha256(key).hexdigest()[:10]}.done")
 
 
 def main():
     if "--list" in sys.argv:
         for entry in QUEUE:
             label, argv, t = entry[0], entry[1], entry[2]
-            print(f"{label:30s} timeout={t}s: {' '.join(argv)}")
+            done = " [done]" if os.path.exists(_sentinel(entry)) else ""
+            print(f"{label:38s} timeout={t}s{done}")
         return 0
+    if "--reset" in sys.argv:
+        if os.path.isdir(STATE_DIR):
+            for f in os.listdir(STATE_DIR):
+                os.unlink(os.path.join(STATE_DIR, f))
+        print("queue state cleared")
+        return 0
+    os.makedirs(STATE_DIR, exist_ok=True)
     t0 = time.time()
     for entry in QUEUE:
         label, argv, timeout = entry[0], entry[1], entry[2]
+        if label != "probe" and os.path.exists(_sentinel(entry)):
+            print(f"== {label}: already complete, skipping ==", flush=True)
+            continue
         env = dict(os.environ)
         if len(entry) > 3:
             env.update(entry[3])
@@ -72,6 +110,9 @@ def main():
             print(f"== {label}: rc={proc.returncode} — aborting queue "
                   "(probe failure or wedge) ==", flush=True)
             return proc.returncode
+        if label != "probe":
+            with open(_sentinel(entry), "w") as f:
+                f.write(f"{time.time():.0f}\n")
         print(f"== {label}: done at +{time.time()-t0:.0f}s ==", flush=True)
     return 0
 
