@@ -517,14 +517,21 @@ class Recurrent(Module):
     ``hoist_input=True`` hoists the projection WITHOUT BatchNorm — a
     TPU-side optimization: one (B*T, in) @ (in, K) MXU matmul replaces T
     per-step (B, in) matmuls; math is identical (same add order), only
-    fp tiling may differ."""
+    fp tiling may differ.
+
+    ``mask_zero=True`` (≙ Recurrent.scala:39-49, :265-300): on 3D input,
+    an all-zero (batch, time) row past the batch's minimum sequence
+    length keeps the hidden state unchanged and outputs zero — padded
+    variable-length batches run as one static-shape scan with a select,
+    no host-side lengths needed."""
 
     def __init__(self, cell=None, batch_norm_params=None, hoist_input=False,
-                 name=None):
+                 mask_zero=False, name=None):
         super().__init__(name=name)
         self.cell = cell
         self.batch_norm_params = batch_norm_params
         self.hoist_input = bool(hoist_input)
+        self.mask_zero = bool(mask_zero)
         self.bn = None
 
     def add(self, cell):
@@ -611,8 +618,33 @@ class Recurrent(Module):
         # modules() includes the cell itself
         return any(getattr(m, "dropout_p", 0.0) for m in cell.modules())
 
+    def _mask_seq(self, x):
+        """(keep (B,T) bool, skip (T,B) bool) for mask_zero, else None.
+        ≙ Recurrent.scala:265-270: a row is padding when its |max| is 0,
+        and masking only applies past the batch's minimum length (rows
+        before that are processed normally, zeros included)."""
+        if not self.mask_zero:
+            return None
+        if x.ndim != 3:
+            raise ValueError(
+                f"{self.name}: mask_zero needs 3D (batch, time, dim) "
+                "input (≙ Recurrent.scala:266 require)")
+        keep = jnp.any(x != 0, axis=-1)                       # (B, T)
+        min_len = jnp.min(jnp.sum(keep, axis=1))
+        t_idx = jnp.arange(x.shape[1])
+        skip = (~keep) & (t_idx >= min_len)[None, :]          # (B, T)
+        return keep, jnp.swapaxes(skip, 0, 1)                 # skip: (T, B)
+
+    @staticmethod
+    def _masked(skip_t, out, h2, h):
+        """Frozen state + zero output for skipped rows."""
+        h2 = jax.tree_util.tree_map(
+            lambda new, old: jnp.where(skip_t[:, None], old, new), h2, h)
+        return jnp.where(skip_t[:, None], 0, out), h2
+
     def apply(self, params, x, ctx):
         hidden0 = self._initial_hidden(x)
+        mask = self._mask_seq(x)
 
         # bn mode ALWAYS hoists (_ensure_bn rejects stochastic cells);
         # bare hoist_input falls back when it can't (stochastic cell in
@@ -626,13 +658,28 @@ class Recurrent(Module):
             proj = self.cell.project_input(params, x)  # (B, T, K)
             if self.bn is not None:
                 proj = proj + self.own(params)["bias_pre"].astype(proj.dtype)
+                if mask is not None:
+                    # ≙ TimeDistributed(pre, maskZero) inside Recurrent:
+                    # padded rows enter the BN (and its batch stats) as
+                    # exact zeros (Recurrent.scala:101)
+                    proj = jnp.where(mask[0][..., None], proj, 0)
                 proj = self.bn.apply(params, proj, ctx)
 
-            def body(h, xp_t):
-                out, h2 = self.cell.step_projected(params, xp_t, h, ctx)
-                return h2, out
+            if mask is None:
+                def body(h, xp_t):
+                    out, h2 = self.cell.step_projected(params, xp_t, h, ctx)
+                    return h2, out
 
-            _, outs = lax.scan(body, hidden0, jnp.swapaxes(proj, 0, 1))
+                _, outs = lax.scan(body, hidden0, jnp.swapaxes(proj, 0, 1))
+            else:
+                def body(h, inp):
+                    xp_t, skip_t = inp
+                    out, h2 = self.cell.step_projected(params, xp_t, h, ctx)
+                    out, h2 = self._masked(skip_t, out, h2, h)
+                    return h2, out
+
+                _, outs = lax.scan(body, hidden0,
+                                   (jnp.swapaxes(proj, 0, 1), mask[1]))
             return jnp.swapaxes(outs, 0, 1)
 
         xs_t = jnp.swapaxes(x, 0, 1)  # (T, B, ...)
@@ -642,22 +689,31 @@ class Recurrent(Module):
             # stochastic cell (p>0): thread a fresh key through the scan
             # carry so every timestep draws INDEPENDENT dropout masks
             # (≙ the reference's Dropout re-sampling per forward call)
-            def body(carry, x_t):
+            def body(carry, inp):
+                x_t, skip_t = inp
                 h, key = carry
                 key, sub = jax.random.split(key)
                 ctx.step_rng = sub
                 out, h2 = self.cell.step(params, x_t, h, ctx)
+                if skip_t is not None:
+                    out, h2 = self._masked(skip_t, out, h2, h)
                 return (h2, key), out
 
-            _, outs = lax.scan(body, (hidden0, ctx.rng(self)), xs_t)
+            _, outs = lax.scan(
+                body, (hidden0, ctx.rng(self)),
+                (xs_t, mask[1] if mask is not None else None))
             ctx.step_rng = None
             return jnp.swapaxes(outs, 0, 1)
 
-        def body(h, x_t):
+        def body(h, inp):
+            x_t, skip_t = inp
             out, h2 = self.cell.step(params, x_t, h, ctx)
+            if skip_t is not None:
+                out, h2 = self._masked(skip_t, out, h2, h)
             return h2, out
 
-        _, outs = lax.scan(body, hidden0, xs_t)
+        _, outs = lax.scan(body, hidden0,
+                           (xs_t, mask[1] if mask is not None else None))
         return jnp.swapaxes(outs, 0, 1)
 
 
@@ -826,11 +882,18 @@ class RecurrentDecoder(Module):
 class TimeDistributed(Module):
     """Apply a module independently at each timestep of (B, T, ...)
     (nn/TimeDistributed.scala). Implemented by folding time into batch —
-    one big MXU call instead of T small ones."""
+    one big MXU call instead of T small ones.
 
-    def __init__(self, layer, name=None):
+    ``mask_zero=True`` (≙ TimeDistributed.scala:114-130): output rows
+    whose input (batch, time) row is all-zero are zeroed — the padding
+    half of the reference's maskZero pipeline
+    (LookupTable(maskZero) -> TimeDistributed(maskZero) ->
+    Recurrent(maskZero))."""
+
+    def __init__(self, layer, mask_zero=False, name=None):
         super().__init__(name=name)
         self.layer = layer
+        self.mask_zero = bool(mask_zero)
 
     def children(self):
         return [self.layer]
@@ -845,7 +908,11 @@ class TimeDistributed(Module):
         b, t = x.shape[0], x.shape[1]
         flat = x.reshape((b * t,) + x.shape[2:])
         y = self.layer.apply(params, flat, ctx)
-        return y.reshape((b, t) + y.shape[1:])
+        y = y.reshape((b, t) + y.shape[1:])
+        if self.mask_zero:
+            keep = jnp.any(x != 0, axis=tuple(range(2, x.ndim)))  # (B, T)
+            y = jnp.where(keep.reshape((b, t) + (1,) * (y.ndim - 2)), y, 0)
+        return y
 
 
 class ConvLSTMPeephole3D(Cell):

@@ -652,7 +652,8 @@ def _build_recurrent(tree):
     if not isinstance(topo, dict):
         raise ValueError(".bigdl Recurrent: missing topology cell attr")
     bn = _bn_params_from_attrs(a) if a.get("bnorm") else None
-    rec = nn.Recurrent(_build_cell(topo), batch_norm_params=bn)
+    rec = nn.Recurrent(_build_cell(topo), batch_norm_params=bn,
+                       mask_zero=bool(a.get("maskZero")))
     if tree["name"]:
         rec.set_name(tree["name"])
     return rec
@@ -804,10 +805,8 @@ _FACTORY = {
     "SpatialAveragePooling": _mk_avgpool,
     "SpatialBatchNormalization": _mk_bn,
     "BatchNormalization": _mk_bn1d,
-    "TimeDistributed": lambda a: (
-        (_ for _ in ()).throw(ValueError(
-            ".bigdl TimeDistributed(maskZero=true) is not supported"))
-        if a.get("maskZero") else nn.TimeDistributed(_build(a["layer"]))),
+    "TimeDistributed": lambda a: nn.TimeDistributed(
+        _build(a["layer"]), mask_zero=bool(a.get("maskZero"))),
     "LookupTable": lambda a: nn.LookupTable(
         int(a["nIndex"]), int(a["nOutput"]),
         padding_value=float(a.get("paddingValue", 0.0) or 0.0),
@@ -1486,7 +1485,8 @@ def _enc_module(mod, params, state, counter, global_entries) -> bytes:
                                   global_entries)
         body += _attr_entry("layer", enc_int64(1, _DT_MODULE)
                             + enc_bytes(13, layer_bytes))
-        body += _attr_entry("maskZero", _attr_bool(False))
+        body += _attr_entry("maskZero", _attr_bool(
+            bool(getattr(mod, "mask_zero", False))))
         return body
     if mod.children():
         for sub in mod.children():

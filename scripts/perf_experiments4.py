@@ -207,6 +207,53 @@ def exp_K3():
         nz._bn_train = orig
 
 
+def exp_K11():
+    """LSTM input-projection hoisting (nn/recurrent.py hoist_input):
+    ONE (B*T, D) @ (D, 4H) MXU matmul outside the scan instead of T
+    (B, D) ones inside it — bench_lstm's exact protocol.  If hoisted
+    wins, flip bench_lstm to hoist_input=True."""
+
+    def run(label, hoist):
+        B, T_, D, H, V = 64, 128, 256, 512, 1000
+        model = nn.Sequential(
+            nn.Recurrent(nn.LSTM(D, H), hoist_input=hoist),
+            nn.TimeDistributed(nn.Linear(H, V)))
+        criterion = nn.TimeDistributedCriterion(nn.CrossEntropyCriterion())
+        method = SGD(learning_rate=0.1, momentum=0.9)
+        params, state = model.init_params(0)
+        opt_state = method.init_state(params)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.rand(B, T_, D).astype(np.float32))
+        y = jnp.asarray(rng.randint(1, V + 1, (B, T_)).astype(np.float32))
+        step = make_train_step(model, criterion, method,
+                               mixed_precision=True)
+        key = jax.random.PRNGKey(0)
+        k = 10
+
+        @jax.jit
+        def many(carry, x, y):
+            def body(c, i):
+                p, o, s = c
+                p, o, s, loss = step(p, o, s, x, y, key)
+                return (p, o, s), loss
+            return lax.scan(body, carry, jnp.arange(k))
+
+        carry, losses = many((params, opt_state, state), x, y)
+        float(jnp.sum(losses))
+        l = lat()
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            carry, losses = many(carry, x, y)
+            float(jnp.sum(losses))
+            ts.append((time.perf_counter() - t0 - l) / k)
+        t = float(np.median(ts))
+        print(f"{label}: {t*1e3:7.2f} ms  {B*T_/t:9.0f} tok/s", flush=True)
+
+    run("K11 lstm per-step proj  ", False)
+    run("K11 lstm hoisted proj   ", True)
+
+
 def exp_K4():
     run_full("K4 s2d + bf16 input     ", stem="s2d", x_bf16=True)
 
@@ -224,10 +271,16 @@ if __name__ == "__main__":
     t0 = time.time()
     EXPS = {"K1": exp_K1, "K2": exp_K2, "K3": exp_K3, "K7": exp_K7,
             "K8": exp_K8, "K9": exp_K9, "K10": exp_K10,
-            "K4": exp_K4, "K5": exp_K5, "K6": exp_K6}
+            "K4": exp_K4, "K5": exp_K5, "K6": exp_K6, "K11": exp_K11}
+    failed = []
     for w in which:
         try:
             EXPS[w]()
         except Exception as e:
             print(f"# [{w}] FAILED: {type(e).__name__}: {e}", flush=True)
+            failed.append(w)
         print(f"# [{w}] done at +{time.time()-t0:.0f}s", flush=True)
+    # non-zero exit on any failure: tpu_queue must NOT write a completion
+    # sentinel for a run whose measurement never happened (a swallowed
+    # wedge would otherwise mark the lever 'done' forever)
+    sys.exit(1 if failed else 0)

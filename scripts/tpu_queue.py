@@ -44,6 +44,8 @@ QUEUE = [
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K9"], 1500),
     ("K10 weight-only int8 decode",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K10"], 1500),
+    ("K11 lstm hoisted projection",
+     [PY, os.path.join(HERE, "perf_experiments4.py"), "K11"], 1500),
     ("K4-K6 input dtype / batch variants",
      [PY, os.path.join(HERE, "perf_experiments4.py"), "K4", "K5", "K6"],
      2400),

@@ -81,14 +81,21 @@ def measure(B, T, n_layers=8, d_model=1024,
 
 
 def matrix():
+    ok = 0
     for B, T in ((8, 2048), (16, 2048), (4, 4096), (32, 1024)):
         try:
             tok_s, mfu = measure(B, T)
             print(f"B={B:3d} T={T:5d}: {tok_s:10.0f} tok/s  mfu={mfu:5.1f}%",
                   flush=True)
+            ok += 1
         except Exception as e:
             print(f"B={B:3d} T={T:5d}: failed {type(e).__name__}: {e}",
                   flush=True)
+    # a sweep where NOTHING measured is a wedge, not a result — exit
+    # non-zero so tpu_queue does not sentinel it as complete (per-point
+    # failures like an OOM corner stay best-effort)
+    if ok == 0:
+        sys.exit(1)
 
 
 def blocks():
@@ -96,6 +103,7 @@ def blocks():
     # flash_attention defaults; patch them per point
     import bigdl_tpu.models.transformer as tr
     orig = tr.flash_attention
+    ok = 0
     for bq, bk in ((128, 128), (256, 256), (128, 512), (512, 512),
                    (256, 512)):
         tr.flash_attention = (lambda q, k, v, bq=bq, bk=bk, **kw:
@@ -106,10 +114,13 @@ def blocks():
             tok_s, mfu = measure(8, 2048)
             print(f"bq={bq:3d} bk={bk:3d}: {tok_s:10.0f} tok/s  "
                   f"mfu={mfu:5.1f}%", flush=True)
+            ok += 1
         except Exception as e:
             print(f"bq={bq:3d} bk={bk:3d}: failed {type(e).__name__}: {e}",
                   flush=True)
     tr.flash_attention = orig
+    if ok == 0:
+        sys.exit(1)
 
 
 def profile():

@@ -1620,3 +1620,79 @@ def test_birecurrent_gru_bnorm_read():
     yf = run_gru(x, f)
     yb = run_gru(x[:, ::-1], b)[:, ::-1]
     np.testing.assert_allclose(got, yf + yb, rtol=1e-4, atol=1e-5)
+
+
+def test_recurrent_mask_zero_read():
+    """A maskZero attr on a Recurrent node enables padded-row masking
+    (Recurrent.scala:39-49 semantics).  NOTE: the reference's own
+    serializer never writes this attr (Recurrent.scala doSerializeModule
+    writes only topology/preTopology/bnorm*), so reference-saved files
+    lose the flag even reference-to-reference; this covers the
+    forward-compat read + our own masking numerics.  The
+    TimeDistributed flag below IS reference wire format."""
+    rng = np.random.RandomState(33)
+    nin, h = 3, 4
+    w_pre = rng.randn(4 * h, nin).astype(np.float32)
+    b_pre = rng.randn(4 * h).astype(np.float32)
+    w_h2g = rng.randn(4 * h, h).astype(np.float32)
+
+    lstm = enc_string(1, "lstm1")
+    lstm += enc_string(7, "com.intel.analytics.bigdl.nn.LSTM")
+    lstm += _mod_attr_entry("inputSize", _attr_i(nin))
+    lstm += _mod_attr_entry("hiddenSize", _attr_i(h))
+    lstm += _mod_attr_entry("p", _attr_d(0.0))
+    lstm += _mod_attr_entry("preTopology",
+                            _attr_mod(_linear_module("i2g", w_pre, b_pre)))
+    lstm += enc_int64(15, 1)
+    lstm += enc_bytes(16, _mod_tensor(w_h2g))
+
+    rec = enc_string(1, "rec")
+    rec += enc_string(7, "com.intel.analytics.bigdl.nn.Recurrent")
+    rec += _mod_attr_entry("maskZero", _attr_b(True))
+    rec += _mod_attr_entry("topology", _attr_mod(lstm))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "rec.bigdl")
+        with open(p, "wb") as f:
+            f.write(rec)
+        m = load_bigdl(p)
+    assert m.mask_zero is True
+
+    B, T = 2, 5
+    x = rng.randn(B, T, nin).astype(np.float32)
+    x[1, 3:] = 0.0  # sample 1 padded to length 3
+    got = np.asarray(m.forward(x))
+    assert np.all(got[1, 3:] == 0)
+    # the unpadded sample matches the plain numpy recurrence
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+    hs = np.zeros((1, h), np.float32)
+    cs = np.zeros((1, h), np.float32)
+    for t in range(T):
+        z = x[:1, t] @ w_pre.T + b_pre + hs @ w_h2g.T
+        i, g, f, o = (z[:, :h], z[:, h:2*h], z[:, 2*h:3*h], z[:, 3*h:])
+        cs = sig(i) * np.tanh(g) + sig(f) * cs
+        hs = sig(o) * np.tanh(cs)
+        np.testing.assert_allclose(got[0, t], hs[0], rtol=1e-4, atol=1e-5)
+
+
+def test_time_distributed_mask_zero_read():
+    rng = np.random.RandomState(34)
+    w = rng.randn(4, 3).astype(np.float32)
+    b = rng.randn(4).astype(np.float32)
+    td = enc_string(1, "td")
+    td += enc_string(7, "com.intel.analytics.bigdl.nn.TimeDistributed")
+    td += _mod_attr_entry("layer", _attr_mod(_linear_module("fc", w, b)))
+    td += _mod_attr_entry("maskZero", _attr_b(True))
+
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "td.bigdl")
+        with open(p, "wb") as f:
+            f.write(td)
+        m = load_bigdl(p)
+    assert m.mask_zero is True
+    x = rng.randn(2, 3, 3).astype(np.float32)
+    x[0, 1] = 0.0
+    got = np.asarray(m.forward(x))
+    want = x @ w.T + b
+    want[0, 1] = 0.0
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)

@@ -617,3 +617,124 @@ class TestRecurrentHoistAndBatchNorm:
         bi2.add(nn.GRU(3, 2))
         bi2.init_params(0)
         assert type(bi2.bwd_cell).__name__ == "GRU"
+
+
+class TestMaskZero:
+    """Recurrent(mask_zero=True) / TimeDistributed(mask_zero=True)
+    padded-sequence support (≙ Recurrent.scala:39-49,:265-300 and
+    TimeDistributed.scala:114-130)."""
+
+    def _np_lstm_masked(self, x, wi, wh, b, min_gate=True):
+        sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+        B, T, _ = x.shape
+        H = wh.shape[0]
+        keep = np.any(x != 0, axis=-1)
+        min_len = keep.sum(1).min()
+        hs = np.zeros((B, H), np.float32)
+        cs = np.zeros((B, H), np.float32)
+        out = np.zeros((B, T, H), np.float32)
+        for t in range(T):
+            z = x[:, t] @ wi + hs @ wh + b
+            i, f, g, o = np.split(z, 4, axis=-1)
+            c2 = sig(f) * cs + sig(i) * np.tanh(g)
+            h2 = sig(o) * np.tanh(c2)
+            skip = (~keep[:, t]) & (t >= min_len if min_gate else True)
+            hs = np.where(skip[:, None], hs, h2)
+            cs = np.where(skip[:, None], cs, c2)
+            out[:, t] = np.where(skip[:, None], 0.0, h2)
+        return out
+
+    def test_recurrent_mask_zero_padded_batch(self):
+        rng = np.random.RandomState(7)
+        B, T, D, H = 3, 6, 4, 5
+        x = rng.randn(B, T, D).astype(np.float32)
+        x[1, 3:] = 0.0          # sample 1: length 3 (suffix padding)
+        x[2, 4:] = 0.0          # sample 2: length 4
+        x[0, 1] = 0.0           # EARLY zero row (t < min_len): processed
+        rec = nn.Recurrent(nn.LSTM(D, H), mask_zero=True)
+        p, st = rec.init_params(0)
+        y = np.asarray(rec.run(p, x, state=st)[0])
+        own = p[rec.cell.name]
+        want = self._np_lstm_masked(
+            x, np.asarray(own["weight_i"]), np.asarray(own["weight_h"]),
+            np.asarray(own["bias"]))
+        np.testing.assert_allclose(y, want, rtol=1e-4, atol=1e-5)
+        # padded rows: output exactly zero, and final state matches the
+        # state at each sample's true length
+        assert np.all(y[1, 3:] == 0) and np.all(y[2, 4:] == 0)
+
+    def test_recurrent_mask_zero_state_frozen(self):
+        """Extending padding must not change the last real output."""
+        rng = np.random.RandomState(8)
+        D, H = 3, 4
+        rec = nn.Recurrent(nn.GRU(D, H), mask_zero=True)
+        p, st = rec.init_params(0)
+        base = rng.randn(1, 4, D).astype(np.float32)
+        pad2 = np.concatenate([base, np.zeros((1, 2, D), np.float32)], 1)
+        y4 = np.asarray(rec.run(p, base, state=st)[0])
+        y6 = np.asarray(rec.run(p, pad2, state=st)[0])
+        np.testing.assert_allclose(y6[:, :4], y4, rtol=1e-5, atol=1e-6)
+        assert np.all(y6[:, 4:] == 0)
+
+    def test_recurrent_mask_zero_hoisted_matches(self):
+        rng = np.random.RandomState(9)
+        x = rng.randn(2, 5, 4).astype(np.float32)
+        x[0, 3:] = 0.0
+        c1, c2 = nn.LSTM(4, 3), nn.LSTM(4, 3)
+        c2.name = c1.name
+        r1 = nn.Recurrent(c1, mask_zero=True)
+        r2 = nn.Recurrent(c2, mask_zero=True, hoist_input=True)
+        p, st = r1.init_params(0)
+        y1 = np.asarray(r1.run(p, x, state=st)[0])
+        y2 = np.asarray(r2.run(p, x, state=st)[0])
+        np.testing.assert_allclose(y1, y2, rtol=1e-5, atol=1e-6)
+
+    def test_recurrent_mask_zero_gradients_flow(self):
+        x = np.random.RandomState(10).randn(2, 5, 3).astype(np.float32)
+        x[1, 2:] = 0.0
+        rec = nn.Recurrent(nn.LSTM(3, 4), mask_zero=True)
+        p, st = rec.init_params(0)
+
+        def loss(q):
+            y, _ = rec.run(q, x, state=st)
+            return jnp.sum(y * y)
+
+        g = jax.tree_util.tree_leaves(jax.grad(loss)(p))
+        assert all(bool(jnp.all(jnp.isfinite(l))) for l in g)
+        assert any(float(jnp.abs(l).max()) > 0 for l in g)
+
+    def test_time_distributed_mask_zero(self):
+        rng = np.random.RandomState(11)
+        x = rng.randn(2, 4, 3).astype(np.float32)
+        x[0, 1] = 0.0
+        x[1, 3] = 0.0
+        td = nn.TimeDistributed(nn.Linear(3, 5), mask_zero=True)
+        p, st = td.init_params(0)
+        y = np.asarray(td.run(p, x, state=st)[0])
+        w = np.asarray(p[td.layer.name]["weight"])
+        b = np.asarray(p[td.layer.name]["bias"])
+        want = x @ (w.T if w.shape[0] == 5 else w) + b
+        want[0, 1] = 0.0
+        want[1, 3] = 0.0
+        np.testing.assert_allclose(y, want, rtol=1e-5, atol=1e-6)
+
+    def test_mask_zero_requires_3d(self):
+        rec = nn.Recurrent(nn.ConvLSTMPeephole(2, 3, 3, 3), mask_zero=True)
+        p, st = rec.init_params(0)
+        with pytest.raises(ValueError, match="3D"):
+            rec.run(p, np.zeros((2, 4, 2, 8, 8), np.float32), state=st)
+
+    def test_lookup_recurrent_mask_pipeline(self):
+        """The reference's padded-NLP pipeline end to end:
+        LookupTable(maskZero) zeroes padding-id rows, Recurrent(maskZero)
+        freezes state over them."""
+        m = nn.Sequential(
+            nn.LookupTable(10, 4, mask_zero=True),
+            nn.Recurrent(nn.LSTM(4, 3), mask_zero=True))
+        p, st = m.init_params(0)
+        ids = np.array([[2, 5, 7, 1], [3, 9, 0, 0]], np.float32)
+        y = np.asarray(m.run(p, ids, state=st)[0])
+        assert np.all(y[1, 2:] == 0)
+        y_short = np.asarray(m.run(p, ids[1:, :2], state=st)[0])
+        np.testing.assert_allclose(y[1, :2], y_short[0], rtol=1e-5,
+                                   atol=1e-6)
