@@ -242,7 +242,8 @@ class Module:
     # ------------------------------------------------------------------ #
     def ensure_initialized(self, seed: int = 0):
         if self._params is None:
-            self._params, self._state = self.init_params(seed)
+            self._params, self._state = self.init_params(
+                getattr(self, "_init_seed", seed))
         return self._params
 
     @property
@@ -452,6 +453,105 @@ class Module:
     def predict_class(self, x, batch_size=128):
         """1-based class predictions (≙ Layer.predict_class)."""
         return self._predictor(batch_size).predict_class(x)
+
+    # pyspark layer.py spellings (predict_distributed ≙ mesh-sharded
+    # evaluation — route through DistriOptimizer/Predictor for that)
+    predict_local = predict
+    predict_class_local = predict_class
+
+    def is_with_weights(self):
+        """≙ Layer.is_with_weights: does this module (or any descendant —
+        the reference's parameters() aggregates children) carry weights?"""
+        p = self.ensure_initialized()
+        return any(p.get(m.name) for m in self.modules())
+
+    def set_seed(self, seed=123):
+        """Seed FUTURE lazy parameter init (≙ Layer.set_seed).  Never
+        re-initializes an already-built module — trained or loaded
+        weights must not be silently destroyed; call
+        ``reset(seed)`` explicitly for a fresh init."""
+        self._init_seed = int(seed)
+        return self
+
+    def setWRegularizer(self, w_regularizer):              # noqa: N802
+        """≙ Layer.setWRegularizer."""
+        self.w_regularizer = w_regularizer
+        return self
+
+    def setBRegularizer(self, b_regularizer):              # noqa: N802
+        """≙ Layer.setBRegularizer."""
+        self.b_regularizer = b_regularizer
+        return self
+
+    def _sub_model_to(self, output_layer):
+        """Model that ends at the named submodule — Sequential prefix or
+        Graph re-outputting at that node (predict_image output_layer)."""
+        from .graph import Graph as _Graph
+        if type(self).__name__ == "Sequential":
+            kids = self.children()
+            for i, m in enumerate(kids):
+                if m.name == output_layer:
+                    from .containers import Sequential as _Seq
+                    sub = _Seq(*kids[:i + 1])
+                    return sub
+            raise ValueError(f"no child named {output_layer!r}")
+        if isinstance(self, _Graph):
+            for node in self._topo:
+                if node.module is not None \
+                        and node.module.name == output_layer:
+                    return _Graph(self.input_nodes, [node])
+            raise ValueError(f"no graph node named {output_layer!r}")
+        raise ValueError(
+            "output_layer= needs a Sequential or Graph model")
+
+    def predict_image(self, image_frame, output_layer=None,
+                      share_buffer=False, batch_per_partition=4,
+                      predict_key="predict"):
+        """Predict every image of an ImageFrame, storing each result
+        under ``predict_key`` on its ImageFeature (≙ Layer.predict_image
+        / images/Utils.scala modelPredictImage).  Uses the prepared
+        ``sample`` feature when a to-sample transform ran, else the raw
+        CHW image.  ``share_buffer=True`` skips the defensive copy."""
+        import numpy as np
+        from ..data.imageframe import ImageFeature
+        self.ensure_initialized()
+        model = self
+        if output_layer is not None:
+            # cache sub-models per output layer: each owns a jitted
+            # Predictor that must be reused, not recompiled per call
+            cache = getattr(self, "_sub_models", None)
+            if cache is None:
+                cache = self._sub_models = {}
+            if output_layer not in cache:
+                sub = self._sub_model_to(output_layer)
+                sub._params, sub._state = self._params, self._state
+                cache[output_layer] = sub
+            model = cache[output_layer]
+        feats = list(image_frame)
+        xs = []
+        for f in feats:
+            if ImageFeature.SAMPLE in f:
+                xs.append(np.asarray(f[ImageFeature.SAMPLE].feature()))
+            else:
+                img = np.asarray(f[ImageFeature.IMAGE], np.float32)
+                if img.ndim == 2:          # grayscale HW -> (1, H, W)
+                    img = img[None]
+                else:                      # HWC -> CHW
+                    img = np.transpose(img, (2, 0, 1))
+                xs.append(img)
+        shapes = {x.shape for x in xs}
+        if len(shapes) > 1:
+            raise ValueError(
+                f"predict_image: images have mixed shapes {sorted(shapes)} "
+                "— add a Resize / to-sample transform to the ImageFrame "
+                "first (≙ the reference's transform-before-predict "
+                "pipeline)")
+        preds = np.asarray(model.predict(np.stack(xs),
+                                         batch_size=max(1,
+                                                        batch_per_partition)))
+        for f, p in zip(feats, preds):
+            f[predict_key] = p if share_buffer else np.array(p, copy=True)
+        return image_frame
 
     def saveModel(self, path, over_write=True):          # noqa: N802
         """pyspark spelling of :meth:`save`."""

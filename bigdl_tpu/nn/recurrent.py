@@ -605,6 +605,16 @@ class Recurrent(Module):
         return st
 
     def _initial_hidden(self, x):
+        init = getattr(self, "_user_hidden", None)
+        if init is not None:
+            from jax.core import Tracer
+            if isinstance(x, Tracer):
+                raise ValueError(
+                    f"{self.name}: set_hidden_state is a shell-only API — "
+                    "this forward is being traced (jit); thread the "
+                    "initial hidden state functionally instead, or "
+                    "clear_hidden_state() before compiling")
+            return init
         if hasattr(self.cell, "zero_hidden"):
             try:
                 return self.cell.zero_hidden(x.shape[0], x.dtype)
@@ -612,6 +622,45 @@ class Recurrent(Module):
                 return self.cell.zero_hidden(x.shape[0], x.dtype,
                                              spatial=x.shape[3:])
         raise ValueError("cell must define zero_hidden")
+
+    # -- stateful-decoding shell API (≙ Recurrent.scala:307-324) -------- #
+    def set_hidden_state(self, hidden):
+        """Seed the next SHELL forward's initial hidden state
+        (≙ setHiddenState).  Pass the structure ``get_hidden_state``
+        returns (e.g. Table(h, c) for LSTM).  Shell-only: a traced
+        (jit) apply raises while a seed is set — compiled streaming
+        loops must thread the state functionally, and a jitted program
+        compiled earlier can never see a later seed."""
+        self._user_hidden = hidden
+        self._predictors = {}   # drop jitted predictors compiled seedless
+        return self
+
+    def clear_hidden_state(self):
+        self._user_hidden = None
+        self._predictors = {}
+        return self
+
+    def get_hidden_state(self):
+        """Hidden state at the last timestep of the most recent SHELL
+        forward (≙ getHiddenState; Recurrent.scala:309 requires a
+        forward first).  A traced forward in between invalidates the
+        record — stale state is an error here, never silently reused."""
+        h = getattr(self, "_last_hidden", None)
+        if h is None:
+            raise RuntimeError(
+                "get_hidden_state must be called after a (non-jit) forward")
+        return h
+
+    def _record_hidden(self, h):
+        from jax.core import Tracer
+        if any(isinstance(l, Tracer)
+               for l in jax.tree_util.tree_leaves(h)):
+            # traced forward: the carry cannot escape; also invalidate
+            # any earlier record so a later get_hidden_state cannot
+            # return state from the wrong (pre-jit) forward
+            self._last_hidden = None
+        else:
+            self._last_hidden = h
 
     @staticmethod
     def _cell_is_stochastic(cell):
@@ -670,7 +719,8 @@ class Recurrent(Module):
                     out, h2 = self.cell.step_projected(params, xp_t, h, ctx)
                     return h2, out
 
-                _, outs = lax.scan(body, hidden0, jnp.swapaxes(proj, 0, 1))
+                h_fin, outs = lax.scan(body, hidden0,
+                                       jnp.swapaxes(proj, 0, 1))
             else:
                 def body(h, inp):
                     xp_t, skip_t = inp
@@ -678,8 +728,9 @@ class Recurrent(Module):
                     out, h2 = self._masked(skip_t, out, h2, h)
                     return h2, out
 
-                _, outs = lax.scan(body, hidden0,
-                                   (jnp.swapaxes(proj, 0, 1), mask[1]))
+                h_fin, outs = lax.scan(body, hidden0,
+                                       (jnp.swapaxes(proj, 0, 1), mask[1]))
+            self._record_hidden(h_fin)
             return jnp.swapaxes(outs, 0, 1)
 
         xs_t = jnp.swapaxes(x, 0, 1)  # (T, B, ...)
@@ -699,10 +750,11 @@ class Recurrent(Module):
                     out, h2 = self._masked(skip_t, out, h2, h)
                 return (h2, key), out
 
-            _, outs = lax.scan(
+            carry, outs = lax.scan(
                 body, (hidden0, ctx.rng(self)),
                 (xs_t, mask[1] if mask is not None else None))
             ctx.step_rng = None
+            self._record_hidden(carry[0])
             return jnp.swapaxes(outs, 0, 1)
 
         def body(h, inp):
@@ -712,8 +764,9 @@ class Recurrent(Module):
                 out, h2 = self._masked(skip_t, out, h2, h)
             return h2, out
 
-        _, outs = lax.scan(body, hidden0,
-                           (xs_t, mask[1] if mask is not None else None))
+        h_fin, outs = lax.scan(body, hidden0,
+                               (xs_t, mask[1] if mask is not None else None))
+        self._record_hidden(h_fin)
         return jnp.swapaxes(outs, 0, 1)
 
 
@@ -853,8 +906,23 @@ class RecurrentDecoder(Module):
     def init(self, rng):
         return self.cell.init(rng)
 
+    # stateful-decoding shell API shared with Recurrent (the reference
+    # RecurrentDecoder extends Recurrent, RecurrentDecoder.scala:41)
+    set_hidden_state = Recurrent.set_hidden_state
+    clear_hidden_state = Recurrent.clear_hidden_state
+    get_hidden_state = Recurrent.get_hidden_state
+    _record_hidden = Recurrent._record_hidden
+
     def apply(self, params, x, ctx):
-        hidden0 = self.cell.zero_hidden(x.shape[0], x.dtype)
+        init = getattr(self, "_user_hidden", None)
+        if init is not None:
+            from jax.core import Tracer
+            if isinstance(x, Tracer):
+                raise ValueError(
+                    f"{self.name}: set_hidden_state is a shell-only API — "
+                    "thread the initial hidden functionally under jit")
+        hidden0 = init if init is not None \
+            else self.cell.zero_hidden(x.shape[0], x.dtype)
 
         if ctx.training and ctx.rng_key is not None \
                 and Recurrent._cell_is_stochastic(self.cell):
@@ -865,9 +933,10 @@ class RecurrentDecoder(Module):
                 out, h2 = self.cell.step(params, inp, h, ctx)
                 return (out, h2, key), out
 
-            _, outs = lax.scan(body, (x, hidden0, ctx.rng(self)), None,
-                               length=self.seq_length)
+            carry, outs = lax.scan(body, (x, hidden0, ctx.rng(self)), None,
+                                   length=self.seq_length)
             ctx.step_rng = None
+            self._record_hidden(carry[1])
             return jnp.swapaxes(outs, 0, 1)
 
         def body(carry, _):
@@ -875,7 +944,9 @@ class RecurrentDecoder(Module):
             out, h2 = self.cell.step(params, inp, h, ctx)
             return (out, h2), out
 
-        _, outs = lax.scan(body, (x, hidden0), None, length=self.seq_length)
+        carry, outs = lax.scan(body, (x, hidden0), None,
+                               length=self.seq_length)
+        self._record_hidden(carry[1])
         return jnp.swapaxes(outs, 0, 1)
 
 

@@ -243,3 +243,65 @@ def test_image_feature_to_tensor_grayscale():
     out = ImageFeatureToTensor(label_col="y").transform(rows)
     assert out[0]["features"].shape == (1, 5, 7)
     assert out[0]["y"] == 2.0
+
+
+class TestPredictImage:
+    """Layer.predict_image parity (pyspark layer.py:451 /
+    images/Utils.scala modelPredictImage)."""
+
+    def _model(self):
+        return nn.Sequential(
+            nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1), nn.ReLU(),
+            nn.SpatialAveragePooling(8, 8, 8, 8), nn.Reshape((4,)),
+            nn.Linear(4, 2), nn.SoftMax())
+
+    def test_predict_key_stored_per_feature(self):
+        from bigdl_tpu.data.imageframe import ImageFrame
+        m = self._model()
+        imgs = [np.random.RandomState(i).rand(8, 8, 3).astype(np.float32)
+                for i in range(5)]
+        out = m.predict_image(ImageFrame.array(imgs), batch_per_partition=2)
+        for f in out:
+            assert f["predict"].shape == (2,)
+            np.testing.assert_allclose(f["predict"].sum(), 1.0, rtol=1e-4)
+        # matches direct predict on the CHW stack
+        x = np.stack([np.transpose(i, (2, 0, 1)) for i in imgs])
+        direct = np.asarray(m.predict(x, batch_size=2))
+        np.testing.assert_allclose(
+            np.stack([f["predict"] for f in out]), direct, rtol=1e-5)
+
+    def test_output_layer_intermediate(self):
+        from bigdl_tpu.data.imageframe import ImageFrame
+        m = self._model()
+        imgs = [np.random.RandomState(9).rand(8, 8, 3).astype(np.float32)]
+        out = m.predict_image(ImageFrame.array(imgs),
+                              output_layer=m.children()[0].name,
+                              predict_key="feat")
+        assert out.features[0]["feat"].shape == (4, 8, 8)
+
+    def test_uses_prepared_sample_when_present(self):
+        from bigdl_tpu.data.imageframe import ImageFrame, ImageFeature
+        from bigdl_tpu.data.minibatch import Sample
+        m = self._model()
+        rng = np.random.RandomState(3)
+        img = rng.rand(8, 8, 3).astype(np.float32)
+        prepared = rng.rand(3, 8, 8).astype(np.float32)  # != transpose(img)
+        f = ImageFeature(img)
+        f[ImageFeature.SAMPLE] = Sample(prepared)
+        m.predict_image(ImageFrame([f]))
+        want = np.asarray(m.predict(prepared[None]))[0]
+        np.testing.assert_allclose(f["predict"], want, rtol=1e-5)
+
+    def test_grayscale_and_mixed_shape_handling(self):
+        from bigdl_tpu.data.imageframe import ImageFrame
+        m = nn.Sequential(nn.SpatialConvolution(1, 2, 3, 3, 1, 1, 1, 1),
+                          nn.SpatialAveragePooling(6, 6, 6, 6),
+                          nn.Reshape((2,)))
+        gray = [np.random.RandomState(i).rand(6, 6).astype(np.float32)
+                for i in range(3)]
+        out = m.predict_image(ImageFrame.array(gray))
+        assert out.features[0]["predict"].shape == (2,)
+        mixed = ImageFrame.array([np.zeros((6, 6), np.float32),
+                                  np.zeros((8, 8), np.float32)])
+        with pytest.raises(ValueError, match="mixed shapes"):
+            m.predict_image(mixed)

@@ -738,3 +738,71 @@ class TestMaskZero:
         y_short = np.asarray(m.run(p, ids[1:, :2], state=st)[0])
         np.testing.assert_allclose(y[1, :2], y_short[0], rtol=1e-5,
                                    atol=1e-6)
+
+
+class TestHiddenStateAPI:
+    """get/set_hidden_state (≙ Recurrent.scala:307-324 getHiddenState/
+    setHiddenState; pyspark layer.py:1573) — streaming/truncated-BPTT
+    continuation across forwards."""
+
+    def test_split_sequence_continuation_matches_full(self):
+        rec = nn.Recurrent(nn.LSTM(4, 3))
+        rec.ensure_initialized()
+        x = np.random.RandomState(0).randn(2, 8, 4).astype(np.float32)
+        y_full = np.asarray(rec.forward(x))
+        y1 = np.asarray(rec.forward(x[:, :5]))
+        st = rec.get_hidden_state()
+        rec.set_hidden_state(st)
+        y2 = np.asarray(rec.forward(x[:, 5:]))
+        rec.clear_hidden_state()
+        np.testing.assert_allclose(np.concatenate([y1, y2], 1), y_full,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_get_before_forward_raises(self):
+        rec = nn.Recurrent(nn.GRU(4, 3))
+        with pytest.raises(RuntimeError, match="after"):
+            rec.get_hidden_state()
+
+    def test_lstm_hidden_is_h_c_table(self):
+        rec = nn.Recurrent(nn.LSTM(4, 3))
+        rec.ensure_initialized()
+        rec.forward(np.random.RandomState(1).randn(2, 5, 4)
+                    .astype(np.float32))
+        from bigdl_tpu.utils.table import as_list
+        h, c = as_list(rec.get_hidden_state())
+        assert np.asarray(h).shape == (2, 3)
+        assert np.asarray(c).shape == (2, 3)
+
+    def test_recurrent_decoder_seeded_hidden(self):
+        dec = nn.RecurrentDecoder(3, nn.LSTM(4, 4))
+        dec.ensure_initialized()
+        x0 = np.random.RandomState(2).randn(2, 4).astype(np.float32)
+        y_a = np.asarray(dec.forward(x0))
+        st = dec.get_hidden_state()
+        dec.set_hidden_state(st)
+        y_b = np.asarray(dec.forward(np.asarray(y_a[:, -1])))
+        dec.clear_hidden_state()
+        # seeding with the final state continues the trajectory: feeding
+        # the last output with the carried state != restarting from zeros
+        y_cold = np.asarray(dec.forward(np.asarray(y_a[:, -1])))
+        assert np.abs(y_b - y_cold).max() > 1e-6
+
+    def test_set_hidden_state_rejected_under_jit(self):
+        rec = nn.Recurrent(nn.LSTM(3, 2))
+        rec.ensure_initialized()
+        x = np.random.RandomState(4).randn(2, 4, 3).astype(np.float32)
+        rec.forward(x)
+        rec.set_hidden_state(rec.get_hidden_state())
+        with pytest.raises(ValueError, match="shell-only"):
+            jax.jit(lambda p, xx: rec.run(p, xx)[0])(rec._params, x)
+        rec.clear_hidden_state()
+
+    def test_get_hidden_state_invalidated_by_traced_forward(self):
+        rec = nn.Recurrent(nn.GRU(3, 2))
+        rec.ensure_initialized()
+        x = np.random.RandomState(5).randn(2, 4, 3).astype(np.float32)
+        rec.forward(x)
+        rec.get_hidden_state()  # recorded
+        jax.jit(lambda p, xx: rec.run(p, xx)[0])(rec._params, x)
+        with pytest.raises(RuntimeError, match="after"):
+            rec.get_hidden_state()  # stale record must NOT be returned

@@ -76,3 +76,42 @@ def test_pyspark_compat_aliases():
     inp = nn.Input()
     m = nn.Model(inp, nn.Linear(3, 2).inputs(inp))
     assert np.asarray(m.forward(np.ones((2, 3), np.float32))).shape == (2, 2)
+
+
+def test_layer_shell_api_shims():
+    """pyspark Layer method parity: predict_local/predict_class_local
+    aliases, is_with_weights, set_seed, regularizer setters
+    (≙ pyspark/bigdl/nn/layer.py base Layer)."""
+    import numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim.regularizer import L2Regularizer
+
+    m = nn.Sequential(nn.Linear(4, 3), nn.ReLU())
+    x = np.random.RandomState(0).rand(6, 4).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(m.predict_local(x)),
+                               np.asarray(m.predict(x)))
+    assert m.predict_class_local(x).shape == (6,)
+    assert m.is_with_weights() and not nn.ReLU().is_with_weights()
+
+    a = nn.Linear(5, 2).set_seed(11)
+    b = nn.Linear(5, 2).set_seed(11)
+    b.name = a.name
+    np.testing.assert_allclose(
+        np.asarray(a.ensure_initialized()[a.name]["weight"]),
+        np.asarray(b.ensure_initialized()[b.name]["weight"]))
+
+    lin = nn.Linear(3, 3).setWRegularizer(L2Regularizer(1e-4)) \
+                         .setBRegularizer(L2Regularizer(1e-5))
+    assert lin.w_regularizer is not None and lin.b_regularizer is not None
+
+
+def test_set_seed_preserves_existing_weights():
+    """set_seed must never clobber trained/loaded params (review r5)."""
+    import numpy as np
+    from bigdl_tpu import nn
+    m = nn.Linear(4, 3)
+    m.ensure_initialized()
+    w0 = np.asarray(m._params[m.name]["weight"]).copy()
+    m.set_seed(99)
+    np.testing.assert_allclose(
+        np.asarray(m.ensure_initialized()[m.name]["weight"]), w0)
