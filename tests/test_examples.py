@@ -33,6 +33,7 @@ CASES = [
     ("transformer_spmd.py", ["--epochs", "1", "--batch", "8"], 600),
     ("textgen.py", ["--epochs", "30"], 300),
     ("control_flow.py", ["--epochs", "8"], 300),
+    ("padded_rnn.py", ["--epochs", "6", "--batch", "64"], 300),
 ]
 
 
