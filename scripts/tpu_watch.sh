@@ -1,7 +1,13 @@
 #!/bin/bash
 # Round-5 tunnel watcher: retry the measurement queue until it fully
-# succeeds. Probe cadence ~25 min (established r4 discipline); exactly
-# one TPU-touching process (this loop) at any time.
+# succeeds. Exactly one TPU-touching process (this loop) at any time.
+#
+# Cadence 55 min (raised from 23 at 11:10 UTC): this round's only
+# recovery (08:30) followed the one ~80-min idle gap, while NINE probes
+# at 23-min cadence all found the tunnel wedged — r2's experience
+# ("recovers only after hours of idle") suggests probing too often may
+# itself delay recovery, and a longer quiet window costs little since
+# the queue is stateful.
 LOG=/root/repo/artifacts/tpu_watch_r5.log
 cd /root/repo
 while true; do
@@ -13,5 +19,5 @@ while true; do
     echo "=== WATCHER DONE: full queue green ===" >> "$LOG"
     break
   fi
-  sleep 1380
+  sleep 3300
 done
