@@ -156,6 +156,13 @@ class ImageFrame:
         return iter(self.features)
 
     def to_samples(self) -> List[Sample]:
+        missing = sum(ImageFeature.SAMPLE not in f for f in self.features)
+        if missing:
+            raise ValueError(
+                f"{missing}/{len(self.features)} ImageFeatures have no "
+                "prepared 'sample' — run an ImageFrameToSample (after "
+                "MatToTensor) transform on the frame first, or use "
+                "model.predict_image(frame) which handles raw images")
         return [f[ImageFeature.SAMPLE] for f in self.features]
 
     def to_dataset(self, batch_size: int, shuffle: bool = True) -> DataSet:

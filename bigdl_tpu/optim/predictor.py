@@ -61,6 +61,11 @@ class Evaluator:
         self.model.ensure_initialized()
         params, state = self.model._params, self.model._state
         results = [None] * len(methods)
+        from ..data.imageframe import ImageFrame
+        if isinstance(dataset, ImageFrame):
+            # ≙ the pyspark imageframe flow (examples/imageframe/
+            # inception_validation.py): transformed frame -> evaluate
+            dataset = dataset.to_dataset(self.batch_size, shuffle=False)
         if isinstance(dataset, tuple):
             x, y = dataset
             dataset = DataSet.minibatch_arrays(x, y, self.batch_size,
@@ -90,6 +95,9 @@ class PredictionService:
 
 
 def _iter_inputs(data, batch_size):
+    from ..data.imageframe import ImageFrame
+    if isinstance(data, ImageFrame):
+        data = data.to_dataset(batch_size, shuffle=False)
     if isinstance(data, np.ndarray) or isinstance(data, jnp.ndarray):
         for i in range(0, data.shape[0], batch_size):
             yield data[i:i + batch_size]

@@ -1,0 +1,76 @@
+"""ImageFrame validation flow (≙ pyspark examples/imageframe/
+inception_validation.py): raw images -> vision transform Pipeline
+(Resize / CenterCrop / ChannelNormalize / MatToTensor /
+ImageFrameToSample) -> `model.evaluate(frame, batch, [Top1Accuracy])`
+and `model.predict_image(frame)`.
+
+Synthetic stand-in for the reference's ImageNet sequence files: class 1
+images are bright, class 2 dark; a tiny CNN trained on the transformed
+frame separates them, then the frame-level evaluate/predict APIs run
+exactly like the reference example.
+"""
+import numpy as np
+
+from _common import parse_args
+from bigdl_tpu import nn
+from bigdl_tpu.data.imageframe import (CenterCrop, ChannelNormalize,
+                                       ImageFrame, ImageFrameToSample,
+                                       MatToTensor, Pipeline, Resize)
+from bigdl_tpu.optim import Adam, LocalOptimizer, Top1Accuracy, Trigger
+
+SIZE = 16
+
+
+def make_frame(n, seed):
+    rng = np.random.RandomState(seed)
+    imgs, labels = [], []
+    for _ in range(n):
+        cls = rng.randint(1, 3)
+        base = 180.0 if cls == 1 else 60.0
+        imgs.append((base + 30 * rng.randn(SIZE + 4, SIZE + 4, 3))
+                    .clip(0, 255).astype(np.float32))
+        labels.append(float(cls))
+    return ImageFrame.array(imgs, labels)
+
+
+def transform():
+    # ≙ inception_validation.py's Pipeline (bytes decode elided: the
+    # frame already holds float mats)
+    return Pipeline([
+        Resize(SIZE + 2, SIZE + 2),
+        CenterCrop(SIZE, SIZE),
+        ChannelNormalize(120.0, 120.0, 120.0, 64.0, 64.0, 64.0),
+        MatToTensor(),
+        ImageFrameToSample(target_keys=["label"]),
+    ])
+
+
+def main():
+    args = parse_args(epochs=4, batch=32, lr=2e-3)
+    train = transform()(make_frame(512, seed=0))
+    val = transform()(make_frame(128, seed=1))
+
+    model = nn.Sequential(
+        nn.SpatialConvolution(3, 8, 3, 3, 1, 1, 1, 1), nn.ReLU(),
+        nn.SpatialAveragePooling(SIZE, SIZE, SIZE, SIZE),
+        nn.Reshape((8,)), nn.Linear(8, 2), nn.LogSoftMax())
+
+    opt = (LocalOptimizer(model, train.to_dataset(args.batch),
+                          nn.ClassNLLCriterion(), batch_size=args.batch)
+           .set_optim_method(Adam(learning_rate=args.lr))
+           .set_end_when(Trigger.max_epoch(args.epochs)))
+    model = opt.optimize()
+
+    # the reference flow: evaluate straight on the transformed frame
+    res = model.evaluate(val, args.batch, [Top1Accuracy()])
+    print("top1 accuracy", res[0][1])
+    assert res[0][1].result()[0] > 0.9, res[0][1]
+
+    # per-image predictions stored back onto the frame
+    model.predict_image(val, batch_per_partition=args.batch)
+    p = val.features[0]["predict"]
+    print("first image prediction:", np.asarray(p))
+
+
+if __name__ == "__main__":
+    main()

@@ -34,6 +34,7 @@ CASES = [
     ("textgen.py", ["--epochs", "30"], 300),
     ("control_flow.py", ["--epochs", "8"], 300),
     ("padded_rnn.py", ["--epochs", "6", "--batch", "64"], 300),
+    ("imageframe_validation.py", ["--epochs", "4", "--batch", "32"], 300),
 ]
 
 

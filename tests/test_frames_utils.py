@@ -305,3 +305,27 @@ class TestPredictImage:
                                   np.zeros((8, 8), np.float32)])
         with pytest.raises(ValueError, match="mixed shapes"):
             m.predict_image(mixed)
+
+    def test_frame_evaluate_and_untransformed_error(self):
+        """model.evaluate(frame, batch, methods) ≙ the pyspark
+        imageframe validation flow; an untransformed frame gets an
+        actionable error, not a bare KeyError."""
+        from bigdl_tpu.data.imageframe import (
+            ImageFrame, MatToTensor, ImageFrameToSample, Pipeline)
+        from bigdl_tpu.optim import Top1Accuracy
+        m = nn.Sequential(nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1),
+                          nn.SpatialAveragePooling(6, 6, 6, 6),
+                          nn.Reshape((4,)), nn.Linear(4, 2),
+                          nn.LogSoftMax())
+        rng = np.random.RandomState(0)
+        imgs = [rng.rand(6, 6, 3).astype(np.float32) for _ in range(6)]
+        labels = [1.0, 2.0, 1.0, 2.0, 1.0, 2.0]
+        frame = Pipeline([MatToTensor(),
+                          ImageFrameToSample(target_keys=["label"])])(
+            ImageFrame.array(imgs, labels))
+        res = m.evaluate(frame, 4, [Top1Accuracy()])
+        assert res[0][1].result()[1] == 6  # every sample counted
+        assert np.asarray(m.predict(frame)).shape == (6, 2)
+        raw = ImageFrame.array(imgs, labels)
+        with pytest.raises(ValueError, match="ImageFrameToSample"):
+            m.predict(raw)
