@@ -152,9 +152,21 @@ def bench_lstm():
     Recurrent(LSTM) + TimeDistributed classifier."""
     from bigdl_tpu import nn
 
+    import os
     B, T, D, H, V = 64, 128, 256, 512, 1000
+    # BENCH_LSTM_HOIST=1 hoists the input projection out of the scan
+    # (one (B*T, D) MXU matmul); flip only after K11 proves it wins
+    hoist_raw = os.environ.get("BENCH_LSTM_HOIST", "0").lower()
+    if hoist_raw in ("1", "true", "yes", "on"):
+        hoist = True
+    elif hoist_raw in ("0", "false", "no", "off", ""):
+        hoist = False
+    else:
+        # same rule as BENCH_RESNET_REMAT: a typo'd knob must fail
+        # loudly, never silently measure the wrong config
+        raise ValueError(f"BENCH_LSTM_HOIST={hoist_raw!r}: use 1/0")
     model = nn.Sequential(
-        nn.Recurrent(nn.LSTM(D, H)),
+        nn.Recurrent(nn.LSTM(D, H), hoist_input=hoist),
         nn.TimeDistributed(nn.Linear(H, V)),
     )
     ips = _train_throughput(

@@ -329,3 +329,38 @@ class TestPredictImage:
         raw = ImageFrame.array(imgs, labels)
         with pytest.raises(ValueError, match="ImageFrameToSample"):
             m.predict(raw)
+
+    def test_output_layer_on_graph_model(self):
+        from bigdl_tpu.data.imageframe import ImageFrame
+        inp = nn.Input()
+        c = nn.SpatialConvolution(3, 4, 3, 3, 1, 1, 1, 1,
+                                  name="g_conv").inputs(inp)
+        r = nn.ReLU(name="g_relu").inputs(c)
+        p2 = nn.SpatialAveragePooling(8, 8, 8, 8).inputs(r)
+        f = nn.Reshape((4,)).inputs(p2)
+        o = nn.Linear(4, 2, name="g_fc").inputs(f)
+        g = nn.Graph([inp], [o])
+        imgs = [np.random.RandomState(i).rand(8, 8, 3).astype(np.float32)
+                for i in range(3)]
+        out = g.predict_image(ImageFrame.array(imgs),
+                              output_layer="g_relu", predict_key="feat")
+        assert out.features[0]["feat"].shape == (4, 8, 8)
+        # independent numpy conv+relu: the sub-graph must equal the
+        # REAL intermediate, not merely be self-consistent
+        params = g._params
+        conv = [m for m in g.modules() if m.name == "g_conv"][0]
+        w = np.asarray(params["g_conv"]["weight"])   # (out, in, kh, kw)
+        b = np.asarray(params["g_conv"]["bias"])
+        x0 = np.transpose(imgs[0], (2, 0, 1))        # (3, 8, 8)
+        xp = np.pad(x0, ((0, 0), (1, 1), (1, 1)))
+        want = np.zeros((4, 8, 8), np.float32)
+        for oc in range(4):
+            acc = np.zeros((8, 8), np.float32)
+            for ic in range(3):
+                for kh in range(3):
+                    for kw in range(3):
+                        acc += w[oc, ic, kh, kw] * \
+                            xp[ic, kh:kh + 8, kw:kw + 8]
+            want[oc] = np.maximum(acc + b[oc], 0.0)
+        np.testing.assert_allclose(out.features[0]["feat"], want,
+                                   rtol=1e-4, atol=1e-5)
