@@ -116,6 +116,19 @@ def _infer_throughput(model, params, state, x, batch, k=10):
 _HEADLINE = {}   # resnet50 line, withheld until exit (driver parses LAST line)
 
 
+def _env_bool(name, default="0"):
+    """Parse a 1/0 bench knob; a typo'd value must fail loudly — a
+    scarce live-TPU window must never silently measure the wrong
+    config."""
+    import os
+    raw = os.environ.get(name, default).lower()
+    if raw in ("1", "true", "yes", "on"):
+        return True
+    if raw in ("0", "false", "no", "off", ""):
+        return False
+    raise ValueError(f"{name}={raw!r}: use 1/0")
+
+
 def _report(metric, value, unit, baseline, defer=False):
     line = {
         "metric": metric,
@@ -152,21 +165,12 @@ def bench_lstm():
     Recurrent(LSTM) + TimeDistributed classifier."""
     from bigdl_tpu import nn
 
-    import os
     B, T, D, H, V = 64, 128, 256, 512, 1000
     # BENCH_LSTM_HOIST=1 hoists the input projection out of the scan
     # (one (B*T, D) MXU matmul); flip only after K11 proves it wins
-    hoist_raw = os.environ.get("BENCH_LSTM_HOIST", "0").lower()
-    if hoist_raw in ("1", "true", "yes", "on"):
-        hoist = True
-    elif hoist_raw in ("0", "false", "no", "off", ""):
-        hoist = False
-    else:
-        # same rule as BENCH_RESNET_REMAT: a typo'd knob must fail
-        # loudly, never silently measure the wrong config
-        raise ValueError(f"BENCH_LSTM_HOIST={hoist_raw!r}: use 1/0")
     model = nn.Sequential(
-        nn.Recurrent(nn.LSTM(D, H), hoist_input=hoist),
+        nn.Recurrent(nn.LSTM(D, H),
+                     hoist_input=_env_bool("BENCH_LSTM_HOIST")),
         nn.TimeDistributed(nn.Linear(H, V)),
     )
     ips = _train_throughput(
@@ -380,15 +384,7 @@ def bench_resnet50():
     import os
     from bigdl_tpu.models import resnet
     stem = os.environ.get("BENCH_RESNET_STEM", "conv")
-    remat_raw = os.environ.get("BENCH_RESNET_REMAT", "0").lower()
-    if remat_raw in ("1", "true", "yes", "on"):
-        remat = True
-    elif remat_raw in ("0", "false", "no", "off", ""):
-        remat = False
-    else:
-        # a scarce live-TPU window must never silently measure the
-        # wrong config because of a typo'd knob
-        raise ValueError(f"BENCH_RESNET_REMAT={remat_raw!r}: use 1/0")
+    remat = _env_bool("BENCH_RESNET_REMAT")
     batch = int(os.environ.get("BENCH_RESNET_BATCH", "256"))
     model = resnet.build(class_num=1000, depth=50, dataset="imagenet",
                          format="NHWC", stem=stem, remat=remat)

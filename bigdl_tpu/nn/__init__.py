@@ -1,7 +1,8 @@
 """bigdl_tpu.nn — the layer/criterion library (≙ com.intel.analytics.bigdl.nn)."""
 from .module import Module, Criterion, Ctx
 from . import init
-from .init import (Zeros, Ones, ConstInit, RandomUniform, RandomNormal,
+from .init import (Zeros, Ones, ConstInit, ConstInitMethod,
+                   RandomUniform, RandomNormal,
                    Xavier, MsraFiller, BilinearFiller)
 from .containers import (Container, Sequential, Concat, ConcatTable,
                          ParallelTable, MapTable, Bottle, Identity, Echo,

@@ -38,6 +38,10 @@ class ConstInit(InitializationMethod):
         return jnp.full(shape, self.value, jnp.float32)
 
 
+#: pyspark spelling (bigdl/nn/initialization_method.py ConstInitMethod)
+ConstInitMethod = ConstInit
+
+
 class RandomUniform(InitializationMethod):
     """U(lower, upper); parameterless variant uses +/- 1/sqrt(fan_in)."""
 

@@ -441,3 +441,4 @@ def test_vision_transformer_sweep():
     # tensor conversion last (changes layout)
     f = V.MatToTensor()(V.Resize(16, 16)(feat()))
     assert f["floats"].shape == (3, 16, 16)
+
