@@ -360,3 +360,26 @@ def test_weight_only_int8_roundtrip_identity_for_small_leaves():
     err = np.abs(np.asarray(d["m"]["w"]) - params["m"]["w"]).max()
     scale = np.abs(params["m"]["w"]).max(0) / 127.0
     assert err <= scale.max() * 0.51 + 1e-6
+
+
+def test_calibrated_int8_has_no_runtime_activation_scaling():
+    """The r3 on-device finding was int8 inference SLOWER than bf16
+    forward; diagnosis: per-batch activation |x|-max reductions before
+    every int8 op.  Calibration bakes static scales — the compiled
+    program must contain NO abs ops at all, while the dynamic-scale
+    path keeps them (structural guard for the fix, checkable on CPU)."""
+    import jax
+    rng = np.random.RandomState(0)
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    m.ensure_initialized()
+    calib = [rng.rand(4, 8).astype(np.float32) for _ in range(3)]
+
+    def compiled_abs_count(model):
+        x = np.zeros((4, 8), np.float32)
+        p, s = model._params, model._state
+        f = jax.jit(lambda pp, xx: model.run(pp, xx, state=s,
+                                             training=False)[0])
+        return f.lower(p, x).compile().as_text().count("abs(")
+
+    assert compiled_abs_count(quantize(m, calibration_data=calib)) == 0
+    assert compiled_abs_count(quantize(m)) > 0
