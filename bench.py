@@ -514,11 +514,15 @@ def main():
     # mistaken for a mid-run stall (and its rc=2 diagnosis preserved)
     _LAST_PROGRESS[0] = time.time()
     _stall_watchdog(float(os.environ.get("BENCH_STALL_S", 900)))
+    failed = []
     names = sys.argv[1:] or list(CONFIGS)
     unknown = [n for n in names if n not in CONFIGS]
     if unknown:
         print(f"# unknown bench config(s) {unknown}; "
               f"choose from {list(CONFIGS)}", file=sys.stderr, flush=True)
+        # under BENCH_STRICT a dropped name counts as a failure — the
+        # queue must never sentinel a step whose measurement never ran
+        failed.extend(unknown)
         names = [n for n in names if n in CONFIGS] or list(CONFIGS)
     # headline runs FIRST (most important number, least exposure to a
     # mid-run tunnel wedge), the transformer/Pallas gate SECOND; the
@@ -536,6 +540,7 @@ def main():
             except Exception as e:  # one config must not sink the others
                 if name == "resnet50":
                     headline_err = e
+                failed.append(name)
                 print(f"# bench {name} failed: {type(e).__name__}: {e}",
                       file=sys.stderr, flush=True)
     finally:
@@ -545,6 +550,14 @@ def main():
             print(json.dumps(_HEADLINE), flush=True)
     if headline_err is not None:
         raise headline_err
+    # BENCH_STRICT=1 (the measurement queue's subset runs): any failed
+    # config is a non-zero exit, so the stateful queue never marks an
+    # unmeasured step complete.  The driver's full run stays best-effort
+    # (headline-first) without the knob.
+    if failed and _env_bool("BENCH_STRICT"):
+        print(f"# BENCH_STRICT: {failed} failed — exit 4",
+              file=sys.stderr, flush=True)
+        sys.exit(4)
 
 
 if __name__ == "__main__":
