@@ -1696,3 +1696,88 @@ def test_time_distributed_mask_zero_read():
     want = x @ w.T + b
     want[0, 1] = 0.0
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_birecurrent_bnorm_split_input_custom_activation_compose():
+    """The three r5 reader features in ONE fixture: per-direction
+    BatchNormParams + GRU(activation=Sigmoid) + isSplitInput — exact
+    numerics vs an independent numpy recurrence."""
+    rng = np.random.RandomState(40)
+    nin, h = 4, 3
+    eps = 1e-5
+
+    def gru_tree(name, wp, bp, wh2g, wnew):
+        t = enc_string(1, name)
+        t += enc_string(7, "com.intel.analytics.bigdl.nn.GRU")
+        t += _mod_attr_entry("inputSize", _attr_i(nin))
+        t += _mod_attr_entry("outputSize", _attr_i(h))
+        t += _mod_attr_entry("p", _attr_d(0.0))
+        act = enc_string(1, name + "_act")
+        act += enc_string(7, "com.intel.analytics.bigdl.nn.Sigmoid")
+        t += _mod_attr_entry("activation", _attr_mod(act))
+        t += _mod_attr_entry("preTopology", _attr_mod(
+            _linear_module(name + "_i2g", wp, bp)))
+        t += enc_int64(15, 1)
+        t += enc_bytes(16, _mod_tensor(wh2g))
+        t += enc_bytes(16, _mod_tensor(wnew))
+        return t
+
+    d = {}
+    for tag in ("f", "b"):
+        d[tag] = dict(
+            wp=rng.randn(3 * h, nin).astype(np.float32),
+            bp=rng.randn(3 * h).astype(np.float32),
+            wh2g=rng.randn(2 * h, h).astype(np.float32),
+            wnew=rng.randn(h, h).astype(np.float32),
+            gamma=(1.0 + 0.1 * rng.randn(3 * h)).astype(np.float32),
+            beta=rng.randn(3 * h).astype(np.float32),
+            rmean=rng.randn(3 * h).astype(np.float32),
+            rvar=(0.5 + rng.rand(3 * h)).astype(np.float32))
+
+    def rec_tree(name, tag):
+        dd = d[tag]
+        return _bnorm_recurrent_tree(
+            name, gru_tree(f"gru_{tag}", dd["wp"], dd["bp"], dd["wh2g"],
+                           dd["wnew"]),
+            _linear_module(f"gru_{tag}_i2g", dd["wp"], dd["bp"]),
+            _bn1d_module(f"bn_{tag}", dd["gamma"], dd["beta"],
+                         dd["rmean"], dd["rvar"], eps=eps))
+
+    bi = enc_string(1, "bi")
+    bi += enc_string(7, "com.intel.analytics.bigdl.nn.BiRecurrent")
+    bi += _mod_attr_entry("bnorm", _attr_b(True))
+    bi += _mod_attr_entry("bnormEps", _attr_d(eps))
+    bi += _mod_attr_entry("isSplitInput", _attr_b(True))
+    bi += _mod_attr_entry("birnn", _attr_mod(_birnn_bytes(
+        rec_tree("rec_f", "f"), rec_tree("rec_b", "b"),
+        "BifurcateSplitTable")))
+
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "bi.bigdl")
+        with open(p, "wb") as f2:
+            f2.write(bi)
+        m = load_bigdl(p)
+    m.evaluate()
+
+    B, T = 2, 4
+    x = rng.randn(B, T, 2 * nin).astype(np.float32)
+    got = np.asarray(m.forward(x))
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+
+    def run(xs, dd):
+        hs = np.zeros((B, h), np.float32)
+        out = np.zeros((B, xs.shape[1], h), np.float32)
+        for t in range(xs.shape[1]):
+            pre = xs[:, t] @ dd["wp"].T + dd["bp"]
+            u = dd["gamma"] * (pre - dd["rmean"]) / np.sqrt(
+                dd["rvar"] + eps) + dd["beta"]
+            rz = u[:, :2*h] + hs @ dd["wh2g"].T
+            r, z = sig(rz[:, :h]), sig(rz[:, h:])
+            hhat = sig(u[:, 2*h:] + (r * hs) @ dd["wnew"].T)  # Sigmoid cand
+            hs = (1.0 - z) * hhat + z * hs
+            out[:, t] = hs
+        return out
+
+    yf = run(x[..., :nin], d["f"])
+    yb = run(x[..., nin:][:, ::-1], d["b"])[:, ::-1]
+    np.testing.assert_allclose(got, yf + yb, rtol=1e-4, atol=1e-5)
