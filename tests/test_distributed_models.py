@@ -181,3 +181,41 @@ def test_transformer_sp2_ring_attention_matches_dp_only():
     (a0, a1), (b0, b1) = losses
     assert abs(a0 - b0) < 1e-4, (a0, b0)
     assert abs(a1 - b1) < 1e-4, (a1, b1)
+
+
+def test_masked_lstm_dp_matches_local():
+    """Recurrent(LSTM, mask_zero=True) trains identically under dp=8 and
+    locally when every dp shard holds the same multiset of sequence
+    lengths (mask_zero's min-length gate is per-shard under dp — the
+    reference's per-partition minLength semantics; with equal per-shard
+    length layouts the gates coincide and parity must be exact)."""
+    rng = np.random.RandomState(3)
+    B, T, D, H = 16, 6, 5, 4
+    x = rng.randn(B, T, D).astype(np.float32)
+    # dp=8 over batch 16 -> shards of 2; every shard gets lengths (3, 6)
+    for i in range(0, B, 2):
+        x[i, 3:] = 0.0
+    y = rng.randint(1, 3, B).astype(np.float32)
+
+    def build():
+        m = nn.Sequential(
+            nn.Recurrent(nn.LSTM(D, H), mask_zero=True),
+            nn.Select(2, -1),
+            nn.Linear(H, 2), nn.LogSoftMax())
+        m.reset(7)
+        return m
+
+    m_local = build()
+    (LocalOptimizer(m_local, (x, y), nn.ClassNLLCriterion(), batch_size=B)
+     .set_optim_method(SGD(learning_rate=0.1, momentum=0.9))
+     .set_end_when(Trigger.max_epoch(3))).optimize()
+
+    m_dp = build()
+    mesh = mesh_lib.create_mesh({"dp": 8})
+    (DistriOptimizer(m_dp, (x, y), nn.ClassNLLCriterion(), batch_size=B,
+                     mesh=mesh)
+     .set_optim_method(SGD(learning_rate=0.1, momentum=0.9))
+     .set_end_when(Trigger.max_epoch(3))).optimize()
+
+    for a, b in zip(leaves(m_local), leaves(m_dp)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
