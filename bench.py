@@ -29,13 +29,77 @@ path is eligible on this backend and that its on-device numerics match
 attention_reference (VERDICT r1 item 3).
 """
 import json
+import os
 import sys
+import threading
 import time
+
+def _cpu_fallback_reexec(reason):
+    """Re-exec this bench on the CPU backend.  The driver parses the
+    LAST stdout line as JSON; a dead tunnel used to produce rc=2 and
+    "parsed": null — a CPU smoke number with an explicit backend marker
+    beats no number (BENCH_CPU_FALLBACK=0 restores the hard-fail).
+    Defined before `import jax` because the import watchdog may fire
+    while that import is still hung."""
+    print(json.dumps({"metric": "backend_fallback", "value": 0,
+                      "unit": "event", "vs_baseline": None,
+                      "backend": "cpu", "reason": reason}), flush=True)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CPU_FALLBACK_ACTIVE"] = "1"
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+              env)
+
+
+def _env_bool(name, default="0"):
+    """Parse a 1/0 bench knob; a typo'd value must fail loudly — a
+    scarce live-TPU window must never silently measure the wrong
+    config.  Defined pre-import: the watchdog consults it before
+    `import jax`."""
+    raw = os.environ.get(name, default).lower()
+    if raw in ("1", "true", "yes", "on"):
+        return True
+    if raw in ("0", "false", "no", "off", ""):
+        return False
+    raise ValueError(f"{name}={raw!r}: use 1/0")
+
+
+# ---- import watchdog --------------------------------------------------- #
+# The axon PJRT plugin can hang INSIDE `import jax` (client init opens the
+# network tunnel).  The liveness probe below never runs then, so arm a
+# pre-import watchdog: if the imports don't finish in time, re-exec onto
+# the CPU backend (same fallback the probe uses).  BENCH_CPU_FALLBACK=0
+# restores the hang-until-driver-timeout behavior.
+_IMPORTS_DONE = threading.Event()
+
+
+def _pre_import_watchdog():
+    if os.environ.get("BENCH_CPU_FALLBACK_ACTIVE") == "1":
+        return        # already on the CPU fallback
+    if not _env_bool("BENCH_CPU_FALLBACK", "1"):
+        return
+    timeout = float(os.environ.get("BENCH_IMPORT_TIMEOUT_S", "300"))
+
+    def watch():
+        if _IMPORTS_DONE.wait(timeout):
+            return
+        _cpu_fallback_reexec(
+            f"jax import/backend init hung >{timeout:.0f}s")
+
+    threading.Thread(target=watch, daemon=True).start()
+
+
+_pre_import_watchdog()
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+_IMPORTS_DONE.set()
 
 
 TRIALS = 3
@@ -114,19 +178,6 @@ def _infer_throughput(model, params, state, x, batch, k=10):
 
 
 _HEADLINE = {}   # resnet50 line, withheld until exit (driver parses LAST line)
-
-
-def _env_bool(name, default="0"):
-    """Parse a 1/0 bench knob; a typo'd value must fail loudly — a
-    scarce live-TPU window must never silently measure the wrong
-    config."""
-    import os
-    raw = os.environ.get(name, default).lower()
-    if raw in ("1", "true", "yes", "on"):
-        return True
-    if raw in ("0", "false", "no", "off", ""):
-        return False
-    raise ValueError(f"{name}={raw!r}: use 1/0")
 
 
 def _report(metric, value, unit, baseline, defer=False):
@@ -405,6 +456,26 @@ CONFIGS = {
 }
 
 
+def _cpu_fallback_active():
+    import os
+    return os.environ.get("BENCH_CPU_FALLBACK_ACTIVE") == "1"
+
+
+def _cpu_fallback_main():
+    """Smoke-sized LeNet train throughput on CPU: a real measurement at
+    a size a CPU finishes in seconds, emitted as the final (parseable)
+    line with the backend spelled out so nobody mistakes it for a TPU
+    number."""
+    from bigdl_tpu.models import lenet
+    model = lenet.build(class_num=10)
+    batch = 64
+    ips = _train_throughput(model, (batch, 1, 28, 28), 10, batch, k=3,
+                            mixed=False)
+    print(json.dumps({"metric": "cpu_fallback_lenet_train_images_per_sec",
+                      "value": round(ips, 2), "unit": "images/sec",
+                      "vs_baseline": None, "backend": "cpu"}), flush=True)
+
+
 def _device_liveness_probe(timeout_s=180, retries=1, retry_wait_s=240):
     """The axon TPU tunnel can wedge so that device ops hang forever
     (not fail).  Probe with a tiny op under a watchdog so a dead tunnel
@@ -438,6 +509,8 @@ def _device_liveness_probe(timeout_s=180, retries=1, retry_wait_s=240):
             time.sleep(retry_wait_s)
     print("# backend unreachable", file=sys.stderr, flush=True)
     import os
+    if not _cpu_fallback_active() and _env_bool("BENCH_CPU_FALLBACK", "1"):
+        _cpu_fallback_reexec("tpu backend unreachable")
     os._exit(2)
 
 
@@ -506,6 +579,11 @@ def main():
     except Exception:
         pass
     _deadline_watchdog(float(os.environ.get("BENCH_DEADLINE_S", 2700)))
+    if _cpu_fallback_active():
+        # already re-exec'ed onto CPU after a failed probe: emit the
+        # smoke measurement as the final parseable line and exit clean
+        _cpu_fallback_main()
+        return
     _device_liveness_probe(
         float(os.environ.get("BENCH_PROBE_TIMEOUT_S", 300)),
         retries=int(os.environ.get("BENCH_PROBE_RETRIES", 1)))

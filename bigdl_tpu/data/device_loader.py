@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 
 
 class _End:
@@ -36,27 +37,46 @@ class DeviceLoader:
     exceptions re-raise at the consumer's next pull.  Early consumer exit
     (break / GC) signals the producer to stop instead of deadlocking on
     the bounded queue.
+
+    Telemetry (to ``recorder``, default the process-active one —
+    :func:`bigdl_tpu.observability.get_recorder`): prefetch starvation
+    is invisible from step timings alone, so the consumer's blocked-on-
+    empty-queue time accumulates into the ``dataloader/stall_seconds``
+    counter, queue occupancy after each pull lands in the
+    ``dataloader/queue_depth`` gauge, and producer back-pressure (queue
+    full) into ``dataloader/producer_wait_seconds``.
     """
 
-    def __init__(self, source, depth: int = 2):
+    def __init__(self, source, depth: int = 2, recorder=None):
         if depth < 1:
             raise ValueError("depth must be >= 1")
         self.source = source
         self.depth = depth
+        self.recorder = recorder
 
     def __iter__(self):
+        rec = self.recorder
+        if rec is None:
+            from ..observability import get_recorder
+            rec = get_recorder()
         q: queue.Queue = queue.Queue(self.depth)
         stop = threading.Event()
 
         def fill():
             try:
                 for item in self.source:
+                    blocked = None
                     while not stop.is_set():
                         try:
                             q.put(item, timeout=0.1)
                             break
                         except queue.Full:
+                            if blocked is None:
+                                blocked = time.perf_counter()
                             continue
+                    if blocked is not None:
+                        rec.inc("dataloader/producer_wait_seconds",
+                                time.perf_counter() - blocked)
                     if stop.is_set():
                         return
                 q.put(_End())
@@ -71,11 +91,17 @@ class DeviceLoader:
         t.start()
         try:
             while True:
+                t0 = time.perf_counter()
                 item = q.get()
+                if rec.enabled:
+                    rec.inc("dataloader/stall_seconds",
+                            time.perf_counter() - t0)
+                    rec.gauge("dataloader/queue_depth", q.qsize())
                 if isinstance(item, _End):
                     return
                 if isinstance(item, _Raise):
                     raise item.exc
+                rec.inc("dataloader/batches")
                 yield item
         finally:
             stop.set()

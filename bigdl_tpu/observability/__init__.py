@@ -1,0 +1,43 @@
+"""bigdl_tpu.observability — unified training telemetry.
+
+The reference ships a driver-side ``optim/Metrics.scala`` that times
+data-fetch / compute / aggregate phases every iteration and surfaces
+them in the Spark UI.  This package is that idea grown into framework
+surface for the TPU rebuild:
+
+  * :class:`Recorder` — thread-safe counters, gauges, span timers and
+    per-step histograms, folded into one *step record* per training
+    iteration.  Disabled recorders are near-zero-cost no-ops, so the
+    instrumentation can stay compiled into every hot path.
+  * Pluggable sinks (:mod:`~bigdl_tpu.observability.sinks`): JSONL
+    file, in-memory (tests), and TensorBoard via the existing
+    :class:`~bigdl_tpu.visualization.event_writer.EventWriter`.
+  * Collective-volume accounting
+    (:mod:`~bigdl_tpu.observability.collectives`): bytes-on-wire per
+    step, pre/post compression, from static shapes or partitioned HLO.
+
+Every span is also emitted as a ``jax.profiler.TraceAnnotation`` so the
+host-side phase structure lines up with device events in a TensorBoard /
+Perfetto trace, and ``Recorder.trace_every(n)`` captures an on-demand
+XLA profile without touching training code.
+
+Quick start::
+
+    from bigdl_tpu.observability import Recorder, JsonlSink
+
+    rec = Recorder(sinks=[JsonlSink("/tmp/telemetry.jsonl")])
+    opt.set_telemetry(rec)          # LocalOptimizer / DistriOptimizer
+    ...
+    # python scripts/trace_summary.py steps /tmp/telemetry.jsonl
+"""
+from __future__ import annotations
+
+from .recorder import Recorder, get_recorder, set_recorder, null_recorder
+from .sinks import (InMemorySink, JsonlSink, Sink, TensorBoardSink)
+from . import collectives
+
+__all__ = [
+    "Recorder", "get_recorder", "set_recorder", "null_recorder",
+    "Sink", "InMemorySink", "JsonlSink", "TensorBoardSink",
+    "collectives",
+]

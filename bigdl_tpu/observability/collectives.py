@@ -1,0 +1,177 @@
+"""Collective-volume accounting: bytes on the interconnect per step.
+
+Two complementary estimators:
+
+  * **Static** (`tree_bytes`, `ring_allreduce_bytes`, ...): computed
+    host-side from gradient/parameter shapes — what
+    :mod:`~bigdl_tpu.parallel.allreduce` reports at trace time, with
+    pre/post-compression byte counts (≙ FP16CompressedTensor's halved
+    wire volume in the reference's parameter server).
+  * **Measured** (`hlo_collective_ops`): parsed out of the partitioned
+    HLO of a compiled step, counting the collectives XLA actually
+    inserted (the GSPMD path in :mod:`~bigdl_tpu.parallel.spmd`, where
+    the compiler, not our code, chooses the ops).
+
+Ring costs per chip for S bytes over a ring of n:
+  all-reduce       2*S*(n-1)/n     (reduce-scatter + all-gather)
+  all-gather         S*(n-1)/n     (S = full gathered size)
+  reduce-scatter     S*(n-1)/n     (S = full pre-scatter size)
+  collective-permute S
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "s32": 4,
+                "u32": 4, "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+
+
+# -- static accounting ----------------------------------------------------- #
+def leaf_bytes(leaf, wire_itemsize: Optional[int] = None) -> int:
+    """Bytes of one array leaf; ``wire_itemsize`` overrides the dtype
+    width (compressed-on-the-wire accounting)."""
+    shape = getattr(leaf, "shape", ())
+    n = int(np.prod(shape)) if shape else 1
+    if wire_itemsize is not None:
+        return n * wire_itemsize
+    dt = getattr(leaf, "dtype", None)
+    return n * (np.dtype(dt).itemsize if dt is not None else 4)
+
+
+def tree_bytes(tree, wire_itemsize: Optional[int] = None,
+               mask=None) -> int:
+    """Total bytes of every (float) array leaf in a pytree.  ``mask``
+    (same-structure bool tree) restricts the sum to True leaves."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(tree)
+    if mask is None:
+        sel = leaves
+    else:
+        flags = jax.tree_util.tree_leaves(mask)
+        sel = [l for l, m in zip(leaves, flags) if m]
+    return sum(leaf_bytes(l, wire_itemsize) for l in sel)
+
+
+def ring_allreduce_bytes(total_bytes: int, n: int) -> float:
+    return 2.0 * total_bytes * (n - 1) / n if n > 1 else 0.0
+
+
+def ring_gather_bytes(total_bytes: int, n: int) -> float:
+    """all-gather OR reduce-scatter of a full-size tensor over a ring."""
+    return float(total_bytes) * (n - 1) / n if n > 1 else 0.0
+
+
+def compressed_itemsize(compress: Optional[str]) -> Optional[int]:
+    """Wire bytes/element for an allreduce ``compress=`` mode."""
+    if compress in ("fp16", "float16", "bf16", "bfloat16"):
+        return 2
+    return None
+
+
+# -- measured accounting (partitioned HLO) --------------------------------- #
+def _element_bytes(shape_str: str) -> List[int]:
+    """Bytes of each typed element in an HLO result type — one entry for
+    a plain type like f32[64,3,7,7], several for a tuple."""
+    out = []
+    for dt, dims in _SHAPE_RE.findall(shape_str):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out.append(n * _DTYPE_BYTES[dt])
+    return out
+
+
+def _bytes_of(shape_str: str) -> int:
+    """Total bytes of an HLO result type like f32[64,3,7,7] or a tuple."""
+    return sum(_element_bytes(shape_str))
+
+
+def _group_size(line: str, default: int) -> int:
+    """Ring size of a collective = its replica-group size, parsed from
+    the HLO attrs.  Forms: ``replica_groups={{0,1},{2,3}}`` (explicit)
+    and ``replica_groups=[G,S]<=[...]`` (iota: G groups of S)."""
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]", line)
+    if m:
+        return int(m.group(2))
+    m = re.search(r"replica_groups=\{\{([\d,]+)\}", line)
+    if m:
+        return len(m.group(1).split(","))
+    return default
+
+
+def hlo_collective_ops(hlo_text: str,
+                       n_shards: int) -> List[Tuple[str, int, float]]:
+    """[(op, result_bytes, wire_bytes_per_chip)] for every collective in
+    a partitioned-HLO dump (``compiled.as_text()``)."""
+    per_op = []
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        # result type may be a long tuple containing /*index=N*/ comments
+        m = re.match(r"%?[\w.-]+ = (.*?) (all-reduce|all-gather|"
+                     r"reduce-scatter|collective-permute|all-to-all)"
+                     r"(-start)?\(", s)
+        if not m:
+            continue
+        shape_str, op, is_start = m.group(1), m.group(2), bool(m.group(3))
+        elems = _element_bytes(shape_str)
+        if is_start and len(elems) > 1:
+            # async form: the result tuple carries (operand, result[,
+            # context]) — only the largest element is the payload, the
+            # rest would double-count it (and ignore the matching -done)
+            size = max(elems)
+        else:
+            size = sum(elems)
+        n = _group_size(s, n_shards)
+        f = (n - 1) / n if n > 1 else 0.0
+        if op == "all-reduce":
+            wire = 2 * size * f
+        elif op == "all-gather":
+            wire = size * f               # result is the full size
+        elif op == "reduce-scatter":
+            wire = size * f * n           # result is the 1/n shard
+        else:
+            wire = size
+        per_op.append((op, size, wire))
+    return per_op
+
+
+def hlo_collective_bytes(hlo_text: str, n_shards: int) -> float:
+    """Total wire bytes per chip per step from a partitioned HLO."""
+    return sum(w for _, _, w in hlo_collective_ops(hlo_text, n_shards))
+
+
+# -- trace-time reporting --------------------------------------------------- #
+def account_collective(op: str, raw_bytes: int, wire_bytes: float,
+                       recorder=None):
+    """Report one collective's static volume to the (active) recorder.
+
+    Called at *trace time* from inside jitted step functions — shapes
+    are static there, so the numbers are exact per executed step; the
+    host loop turns the per-step gauges into cumulative counters.
+    Gauges set (per step):
+      ``collective/{op}_bytes``       raw (uncompressed) volume
+      ``collective/{op}_wire_bytes``  on-the-wire (post-compression) volume
+      ``collective/bytes_per_step``   running total of raw volume
+      ``collective/wire_bytes_per_step``  running total of wire volume
+    """
+    if recorder is None:
+        from .recorder import get_recorder
+        recorder = get_recorder()
+    if not recorder.enabled:
+        return
+    recorder.gauge(f"collective/{op}_bytes", float(raw_bytes))
+    recorder.gauge(f"collective/{op}_wire_bytes", float(wire_bytes))
+    recorder.gauge("collective/bytes_per_step",
+                   recorder.gauge_value("collective/bytes_per_step")
+                   + float(raw_bytes))
+    recorder.gauge("collective/wire_bytes_per_step",
+                   recorder.gauge_value("collective/wire_bytes_per_step")
+                   + float(wire_bytes))

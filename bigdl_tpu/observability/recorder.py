@@ -1,0 +1,348 @@
+"""Thread-safe telemetry recorder (≙ optim/Metrics.scala grown up).
+
+One :class:`Recorder` instance aggregates four primitive kinds:
+
+  counters    monotonically increasing totals (bytes reduced, stall
+              seconds, records seen) — ``inc``
+  gauges      last-written values (queue depth, bytes-per-step) —
+              ``gauge``
+  spans       wall-clock timed regions (``with rec.span("data_fetch")``),
+              accumulated per step and mirrored as
+              ``jax.profiler.TraceAnnotation`` so they line up with
+              device events on an XLA trace
+  histograms  per-step value distributions kept as count/min/max/
+              sum/sumsq — ``observe``
+
+``start_step``/``end_step`` bracket one training iteration; ``end_step``
+folds everything recorded since ``start_step`` into a *step record*
+(a plain dict) and hands it to every sink.  A disabled recorder's
+methods return immediately and ``span`` hands back a shared no-op
+context manager, so instrumentation can stay in the hot path
+unconditionally.
+
+``trace_every(n, log_dir)`` captures a full XLA profiler trace of every
+n-th step — the on-demand deep view to the step records' always-on
+shallow view.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class _NullSpan:
+    """Shared no-op context manager for disabled recorders."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_rec", "_name", "_t0", "_ann")
+
+    def __init__(self, rec: "Recorder", name: str):
+        self._rec = rec
+        self._name = name
+        self._ann = None
+
+    def __enter__(self):
+        if self._rec.annotate:
+            import jax
+            self._ann = jax.profiler.TraceAnnotation(self._name)
+            self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self._t0
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+        self._rec._add_span(self._name, dt)
+        return False
+
+
+class Recorder:
+    """Aggregates telemetry and emits one record per training step.
+
+    ``sinks`` is any iterable of objects with ``emit(record: dict)``
+    (see :mod:`~bigdl_tpu.observability.sinks`).  ``annotate`` mirrors
+    spans onto the jax profiler timeline (cheap; only meaningful while
+    a trace is being captured).
+    """
+
+    def __init__(self, sinks=(), enabled: bool = True,
+                 annotate: bool = True):
+        self._lock = threading.Lock()
+        self.sinks = list(sinks)
+        self._enabled = bool(enabled)
+        self.annotate = bool(annotate)
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, float] = {}
+        # pending per-step state, reset by end_step
+        self._spans: Dict[str, float] = {}
+        self._span_counts: Dict[str, int] = {}
+        self._scalars: Dict[str, float] = {}
+        self._hists: Dict[str, List[float]] = {}
+        self._step: Optional[int] = None
+        self._step_t0: Optional[float] = None
+        self._n_records = 0
+        self._trace_cfg = None        # (every_n, log_dir)
+        self._tracing = False
+
+    # -- enable/disable -------------------------------------------------- #
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, on: bool = True):
+        self._enabled = bool(on)
+        return self
+
+    def add_sink(self, sink):
+        self.sinks.append(sink)
+        return self
+
+    # -- primitives ------------------------------------------------------ #
+    def inc(self, name: str, value: float = 1.0) -> float:
+        """Add to a monotonic counter; returns the new total."""
+        if not self._enabled:
+            return 0.0
+        with self._lock:
+            total = self._counters.get(name, 0.0) + value
+            self._counters[name] = total
+            return total
+
+    def gauge(self, name: str, value: float):
+        """Set a last-value gauge."""
+        if not self._enabled:
+            return
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def gauge_value(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._gauges.get(name, default)
+
+    def counter_value(self, name: str, default: float = 0.0) -> float:
+        with self._lock:
+            return self._counters.get(name, default)
+
+    def span_value(self, name: str, default: float = 0.0) -> float:
+        """Accumulated seconds of ``name`` in the *pending* step."""
+        with self._lock:
+            return self._spans.get(name, default)
+
+    def reset_gauges(self, prefix: str = ""):
+        """Drop gauges whose name starts with ``prefix`` (used before a
+        step-function rebuild so trace-time collective accounting does
+        not double-count across recompiles)."""
+        with self._lock:
+            for k in list(self._gauges):
+                if k.startswith(prefix):
+                    del self._gauges[k]
+
+    def scalar(self, name: str, value):
+        """Record a per-step scalar (loss, grad-norm, lr, ...).  Device
+        scalars are accepted and converted at ``end_step``."""
+        if not self._enabled:
+            return
+        with self._lock:
+            self._scalars[name] = value
+
+    def observe(self, name: str, value: float):
+        """Add one observation to the step's histogram for ``name``."""
+        if not self._enabled:
+            return
+        v = float(value)
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                self._hists[name] = [1, v, v, v, v * v]
+            else:
+                h[0] += 1
+                h[1] = min(h[1], v)
+                h[2] = max(h[2], v)
+                h[3] += v
+                h[4] += v * v
+
+    def span(self, name: str):
+        """Context manager timing a region into the current step."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return _Span(self, name)
+
+    def _add_span(self, name: str, dt: float):
+        with self._lock:
+            self._spans[name] = self._spans.get(name, 0.0) + dt
+            self._span_counts[name] = self._span_counts.get(name, 0) + 1
+
+    def add_span(self, name: str, seconds: float):
+        """Record an externally-timed duration as a span."""
+        if not self._enabled:
+            return
+        self._add_span(name, seconds)
+
+    # -- step lifecycle -------------------------------------------------- #
+    def start_step(self, step: Optional[int] = None):
+        if not self._enabled:
+            return
+        with self._lock:
+            self._step = step
+            self._step_t0 = time.perf_counter()
+        self._maybe_start_trace(step)
+
+    def end_step(self, step: Optional[int] = None,
+                 **scalars) -> Optional[Dict[str, Any]]:
+        """Close the current step: fold pending spans/scalars/histograms
+        plus counter and gauge snapshots into one record, emit it to
+        every sink, and reset the per-step state."""
+        if not self._enabled:
+            return None
+        self._maybe_stop_trace()
+        with self._lock:
+            if step is None:
+                step = self._step
+            dur = (time.perf_counter() - self._step_t0
+                   if self._step_t0 is not None else None)
+            pend = dict(self._scalars)
+            pend.update(scalars)
+            rec: Dict[str, Any] = {
+                "type": "step",
+                "step": step,
+                "time": time.time(),
+                "dur": dur,
+                "spans": dict(self._spans),
+                "span_counts": dict(self._span_counts),
+                "scalars": {k: _to_float(v) for k, v in pend.items()},
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+            }
+            recs = rec["scalars"].get("records")
+            if dur and isinstance(recs, (int, float)) and recs > 0:
+                rec["scalars"]["records_per_sec"] = recs / dur
+            if self._hists:
+                rec["hist"] = {
+                    k: {"count": int(h[0]), "min": h[1], "max": h[2],
+                        "mean": h[3] / max(h[0], 1),
+                        "sumsq": h[4]}
+                    for k, h in self._hists.items()}
+            self._spans.clear()
+            self._span_counts.clear()
+            self._scalars.clear()
+            self._hists.clear()
+            self._step = None
+            self._step_t0 = None
+            self._n_records += 1
+            sinks = list(self.sinks)
+        for s in sinks:
+            s.emit(rec)
+        return rec
+
+    def abort_step(self):
+        """Discard the pending step (e.g. the data iterator ran dry after
+        ``start_step``); pending spans/scalars are dropped."""
+        if not self._enabled:
+            return
+        self._maybe_stop_trace()
+        with self._lock:
+            self._spans.clear()
+            self._span_counts.clear()
+            self._scalars.clear()
+            self._hists.clear()
+            self._step = None
+            self._step_t0 = None
+
+    # -- on-demand XLA profiles ------------------------------------------ #
+    def trace_every(self, n_steps: int, log_dir: str):
+        """Capture a ``jax.profiler`` trace of every ``n_steps``-th step
+        into ``log_dir`` (open with TensorBoard's profile plugin or
+        Perfetto).  ``n_steps=0`` disables."""
+        self._trace_cfg = (int(n_steps), log_dir) if n_steps else None
+        return self
+
+    def _maybe_start_trace(self, step):
+        cfg = self._trace_cfg
+        if (cfg is None or self._tracing or step is None
+                or step % cfg[0] != 0):
+            return
+        import jax
+        try:
+            jax.profiler.start_trace(cfg[1])
+            self._tracing = True
+        except Exception:
+            pass        # profiling must never kill training
+
+    def _maybe_stop_trace(self):
+        if not self._tracing:
+            return
+        import jax
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            self._tracing = False
+
+    # -- introspection / teardown ---------------------------------------- #
+    def snapshot(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {"counters": dict(self._counters),
+                    "gauges": dict(self._gauges)}
+
+    def summary(self) -> str:
+        snap = self.snapshot()
+        return json.dumps(snap, sort_keys=True)
+
+    def flush(self):
+        for s in self.sinks:
+            fl = getattr(s, "flush", None)
+            if fl is not None:
+                fl()
+        return self
+
+    def close(self):
+        for s in self.sinks:
+            close = getattr(s, "close", None)
+            if close is not None:
+                close()
+
+
+def _to_float(v):
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return v
+
+
+# -- process-active recorder ---------------------------------------------- #
+# Library internals (DeviceLoader, allreduce accounting) report to the
+# process-active recorder when one wasn't passed explicitly; the default
+# is a disabled instance so un-instrumented runs pay only a bool check.
+_null = Recorder(enabled=False, annotate=False)
+_active = _null
+
+
+def null_recorder() -> Recorder:
+    """The shared always-disabled recorder."""
+    return _null
+
+
+def get_recorder() -> Recorder:
+    """The process-active recorder (a disabled no-op by default)."""
+    return _active
+
+
+def set_recorder(rec: Optional[Recorder]) -> Recorder:
+    """Install ``rec`` as the process-active recorder (``None`` resets
+    to the disabled default).  Returns the previous one."""
+    global _active
+    prev = _active
+    _active = rec if rec is not None else _null
+    return prev

@@ -1,0 +1,147 @@
+"""Pluggable sinks for Recorder step records.
+
+A sink is anything with ``emit(record: dict)`` (and optionally
+``close()``).  Three are provided:
+
+  :class:`JsonlSink`        one JSON object per line — the machine-
+                            readable export ``scripts/trace_summary.py
+                            steps`` renders, and the cheapest thing to
+                            ship off-host
+  :class:`InMemorySink`     keeps records in a list — for tests and
+                            notebook inspection
+  :class:`TensorBoardSink`  forwards span durations and scalars through
+                            the existing tfevents
+                            :class:`~bigdl_tpu.visualization.event_writer.EventWriter`
+                            so telemetry lands next to the Loss curves
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class Sink:
+    """Interface marker; subclasses implement emit/close."""
+
+    def emit(self, record: Dict[str, Any]):
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+
+class InMemorySink(Sink):
+    """Append records to ``self.records`` (thread-safe)."""
+
+    def __init__(self):
+        self.records: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+
+    def emit(self, record):
+        with self._lock:
+            self.records.append(record)
+
+    def steps(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [r for r in self.records if r.get("type") == "step"]
+
+
+class JsonlSink(Sink):
+    """One JSON object per line, flushed every ``flush_every`` records
+    (and on close) so a crashed run keeps its telemetry tail."""
+
+    def __init__(self, path: str, flush_every: int = 20):
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        self.path = path
+        self._f = open(path, "a")
+        self._lock = threading.Lock()
+        self._since_flush = 0
+        self.flush_every = max(int(flush_every), 1)
+
+    def emit(self, record):
+        line = json.dumps(record, default=_json_default)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._since_flush += 1
+            if self._since_flush >= self.flush_every:
+                self._f.flush()
+                self._since_flush = 0
+
+    def flush(self):
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._since_flush = 0
+
+    def close(self):
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
+
+
+class TensorBoardSink(Sink):
+    """Write span durations (milliseconds, under ``telemetry/span_ms/``)
+    and step scalars (under ``telemetry/``) as tfevents scalars.
+
+    Accepts a log dir (an :class:`EventWriter` is created) or any object
+    with ``add_scalar(tag, value, step)`` — e.g. an existing
+    :class:`~bigdl_tpu.visualization.TrainSummary`.
+    """
+
+    def __init__(self, writer_or_dir, prefix: str = "telemetry"):
+        if isinstance(writer_or_dir, str):
+            from ..visualization.event_writer import EventWriter
+            writer_or_dir = EventWriter(writer_or_dir)
+            self._owned = True
+        else:
+            self._owned = False
+        self.writer = writer_or_dir
+        self.prefix = prefix.rstrip("/")
+
+    def emit(self, record):
+        step = record.get("step")
+        if record.get("type") != "step" or step is None:
+            return
+        add = self.writer.add_scalar
+        for name, secs in record.get("spans", {}).items():
+            add(f"{self.prefix}/span_ms/{name}", secs * 1e3, step)
+        for name, v in record.get("scalars", {}).items():
+            if isinstance(v, (int, float)):
+                add(f"{self.prefix}/{name}", float(v), step)
+
+    def flush(self):
+        fl = getattr(self.writer, "flush", None)
+        if fl is not None:
+            fl()
+
+    def close(self):
+        if self._owned:
+            self.writer.close()
+
+
+def _json_default(v):
+    """Last-resort leaf encoder: device scalars and numpy types float()
+    cleanly; anything else degrades to repr instead of killing the run."""
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Parse a JsonlSink file back into records (bad lines skipped)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out

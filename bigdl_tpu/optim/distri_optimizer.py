@@ -43,7 +43,8 @@ from ..parallel.allreduce import (allreduce_gradients,
                                   reduce_scatter_gradients, allgather_params,
                                   shardable_mask_dim0)
 from .optimizer import (Optimizer, _mb_to_arrays, _ClippedOptim,
-                        make_accum_grads, mask_frozen_grads)
+                        health_scalars, make_accum_grads,
+                        mask_frozen_grads)
 from .trigger import Trigger
 
 
@@ -90,7 +91,7 @@ class DistriOptimizer(Optimizer):
         self.fsdp = fsdp
 
     # ------------------------------------------------------------------ #
-    def _build_step(self, params_template, optim):
+    def _build_step(self, params_template, optim, telemetry=False):
         model, criterion = self.model, self.criterion
         mixed = self.mixed_precision
         compress = self.compress
@@ -132,10 +133,15 @@ class DistriOptimizer(Optimizer):
                 merged = dict(model_state)
                 merged.update(upd)
                 merged = lax.pmean(merged, "dp")  # keep BN stats replicated
-                return new_params, new_opt, merged, lax.pmean(loss, "dp")
+                out = (new_params, new_opt, merged, lax.pmean(loss, "dp"))
+                if telemetry:
+                    # grads/params are replicated post-allreduce: norms
+                    # need no extra collective
+                    out += (health_scalars(grads, params, new_params),)
+                return out
 
             specs_in = (P(), P(), P(), P("dp"), P("dp"), P())
-            specs_out = (P(), P(), P(), P())
+            specs_out = (P(), P(), P(), P()) + ((P(),) if telemetry else ())
             return jax.jit(
                 shard_map(step, self.mesh, specs_in, specs_out),
                 donate_argnums=(0, 1, 2)), None
@@ -153,14 +159,21 @@ class DistriOptimizer(Optimizer):
             merged = dict(model_state)
             merged.update(upd)
             merged = lax.pmean(merged, "dp")
-            return new_params_sh, new_opt, merged, lax.pmean(loss, "dp")
+            out = (new_params_sh, new_opt, merged, lax.pmean(loss, "dp"))
+            if telemetry:
+                # shard norms psum'ed to the GLOBAL value on every shard
+                out += (health_scalars(g_sh, params_sh, new_params_sh,
+                                       axis_name="dp",
+                                       sharded_mask=shardable),)
+            return out
 
         p_specs = jax.tree_util.tree_map(
             lambda s: P("dp") if s else P(), shardable,
             is_leaf=lambda v: isinstance(v, bool))
         o_specs = fsdp_opt_state_specs(params_template, shardable, optim)
         specs_in = (p_specs, o_specs, P(), P("dp"), P("dp"), P())
-        specs_out = (p_specs, o_specs, P(), P())
+        specs_out = (p_specs, o_specs, P(), P()) \
+            + ((P(),) if telemetry else ())
         return jax.jit(
             shard_map(step, self.mesh, specs_in, specs_out),
             donate_argnums=(0, 1, 2)), shardable
@@ -190,7 +203,12 @@ class DistriOptimizer(Optimizer):
 
     def _make_step_builder(self, params_template, optim):
         def build_step():
-            step_fn, shardable = self._build_step(params_template, optim)
+            telemetry = self._telemetry_active()
+            self._with_health = telemetry
+            self._seen_sigs.clear()
+            self._rec().reset_gauges("collective/")
+            step_fn, shardable = self._build_step(params_template, optim,
+                                                  telemetry=telemetry)
             self._shardable = shardable
             return step_fn
         return build_step

@@ -32,6 +32,7 @@ from jax import lax
 from ..nn.module import Ctx, Module, migrate_legacy_names
 from ..data.dataset import DataSet
 from ..data.minibatch import MiniBatch
+from ..observability import Recorder, null_recorder, set_recorder
 from .optim_method import OptimMethod, SGD
 from .trigger import Trigger
 from .validation import ValidationMethod
@@ -79,6 +80,38 @@ class Metrics:
         return jax.profiler.TraceAnnotation(name)
 
 
+def _tree_sq(tree, axis_name=None, sharded_mask=None):
+    """Global sum of squares over a pytree's float leaves.  Under FSDP
+    (``axis_name`` + ``sharded_mask``) the dim-0-sharded contributions
+    are psum'ed so every shard sees the GLOBAL value (same semantics as
+    :class:`_ClippedOptim`)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if axis_name is not None and sharded_mask is not None:
+        mask = jax.tree_util.tree_leaves(sharded_mask)
+        sq_sh = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                    for g, m in zip(leaves, mask) if m) + 0.0
+        sq_rep = sum(jnp.sum(g.astype(jnp.float32) ** 2)
+                     for g, m in zip(leaves, mask) if not m) + 0.0
+        return jax.lax.psum(sq_sh, axis_name) + sq_rep
+    return sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in leaves) + 0.0
+
+
+def health_scalars(grads, old_params, new_params, axis_name=None,
+                   sharded_mask=None):
+    """Training-health scalars computed ON DEVICE inside the step (a few
+    reductions — negligible next to the backward): gradient global-norm,
+    post-update parameter norm, update norm, and the update/param ratio
+    (the classic 1e-3-ish learning-rate sanity signal)."""
+    gn = jnp.sqrt(_tree_sq(grads, axis_name, sharded_mask))
+    pn = jnp.sqrt(_tree_sq(new_params, axis_name, sharded_mask))
+    diff = jax.tree_util.tree_map(
+        lambda a, b: a.astype(jnp.float32) - b.astype(jnp.float32),
+        new_params, old_params)
+    un = jnp.sqrt(_tree_sq(diff, axis_name, sharded_mask))
+    return {"grad_norm": gn, "param_norm": pn, "update_norm": un,
+            "update_ratio": un / jnp.maximum(pn, 1e-12)}
+
+
 def mask_frozen_grads(model: Module, grads):
     """Zero gradients of modules frozen via Module.freeze (evaluated at
     step-build time, so the compiled program bakes the mask in)."""
@@ -91,8 +124,12 @@ def mask_frozen_grads(model: Module, grads):
 
 
 def make_train_step(model: Module, criterion, optim_method: OptimMethod,
-                    mixed_precision=False, extra_loss_fn=None):
-    """Build the pure fused train step; caller jits (and shard_maps) it."""
+                    mixed_precision=False, extra_loss_fn=None,
+                    telemetry=False):
+    """Build the pure fused train step; caller jits (and shard_maps) it.
+
+    ``telemetry=True`` appends a dict of training-health device scalars
+    (:func:`health_scalars`) to the return tuple."""
 
     def step(params, opt_state, model_state, x, y, rng):
         if mixed_precision:
@@ -122,6 +159,9 @@ def make_train_step(model: Module, criterion, optim_method: OptimMethod,
                                                         opt_state)
         merged = dict(model_state)
         merged.update(state_updates)
+        if telemetry:
+            return (new_params, new_opt_state, merged, loss,
+                    health_scalars(grads, params, new_params))
         return new_params, new_opt_state, merged, loss
 
     return step
@@ -198,7 +238,8 @@ def make_accum_grads(loss_fn, n_accum: int, weight_fn=None):
 
 def make_accum_train_step(model: Module, criterion,
                           optim_method: OptimMethod, n_accum: int,
-                          mixed_precision=False, extra_loss_fn=None):
+                          mixed_precision=False, extra_loss_fn=None,
+                          telemetry=False):
     """Gradient-accumulation variant of make_train_step: the batch is
     split into ``n_accum`` microbatches, a ``lax.scan`` accumulates the
     mean gradient (and threads BN state through in order), and the
@@ -209,7 +250,8 @@ def make_accum_train_step(model: Module, criterion,
     """
     if n_accum < 2:
         return make_train_step(model, criterion, optim_method,
-                               mixed_precision, extra_loss_fn)
+                               mixed_precision, extra_loss_fn,
+                               telemetry=telemetry)
 
     def micro_loss(params, model_state, x, y, rng):
         if mixed_precision:
@@ -244,6 +286,9 @@ def make_accum_train_step(model: Module, criterion,
         grads = mask_frozen_grads(model, grads)
         new_params, new_opt_state = optim_method.update(grads, params,
                                                         opt_state)
+        if telemetry:
+            return (new_params, new_opt_state, merged, mean_loss + reg_loss,
+                    health_scalars(grads, params, new_params))
         return new_params, new_opt_state, merged, mean_loss + reg_loss
 
     return step
@@ -296,6 +341,11 @@ class Optimizer:
         self._resume_rng = None      # loop rng restored from checkpoint
         self.prefetch_depth = 0
         self._retry_cache = None
+        # telemetry (observability.Recorder); None = zero-cost no-op path
+        self._recorder: Optional[Recorder] = None
+        self._telemetry_health = True
+        self._with_health = False     # does the built step return health?
+        self._seen_sigs = set()       # (shape, dtype) sigs → recompile detect
 
     # -- fluent config, reference API ----------------------------------- #
     def set_optim_method(self, method):
@@ -350,6 +400,41 @@ class Optimizer:
         self.prefetch_depth = depth
         return self
 
+    def set_telemetry(self, recorder: Recorder, health: bool = True):
+        """Attach an observability Recorder: every iteration emits one
+        step record (spans: data_fetch / h2d / train_step, compile
+        detection; scalars: loss, learning rate, records/sec — plus
+        grad/param/update norms when ``health``, computed on device
+        inside the step).  Also installs ``recorder`` as the
+        process-active recorder so DeviceLoader and collective
+        accounting report to it (≙ optim/Metrics.scala, grown into a
+        first-class subsystem)."""
+        self._recorder = recorder
+        self._telemetry_health = bool(health)
+        set_recorder(recorder)
+        return self
+
+    def set_trace_every(self, n_steps: int, log_dir: str):
+        """Capture a jax.profiler trace of every n-th step into
+        ``log_dir`` (TensorBoard profile plugin / Perfetto).  Creates a
+        sink-less Recorder if none is attached yet — trace-only, so no
+        health norms are compiled into the step."""
+        if self._recorder is None:
+            self.set_telemetry(Recorder(), health=False)
+        self._recorder.trace_every(n_steps, log_dir)
+        return self
+
+    def _rec(self) -> Recorder:
+        return self._recorder if self._recorder is not None \
+            else null_recorder()
+
+    def _telemetry_active(self) -> bool:
+        """Should the step being built compute health scalars?  A
+        disabled recorder must compile the plain step — the no-op
+        guarantee covers device work too."""
+        return (self._recorder is not None and self._recorder.enabled
+                and self._telemetry_health)
+
     def set_auto_retry(self, max_retries):
         """Retry a failed epoch from the last end-of-epoch state snapshot
         (≙ DistriOptimizer's retryNum/cache recovery)."""
@@ -366,10 +451,15 @@ class Optimizer:
 
     # -- checkpointing (≙ Optimizer.saveCheckpoint / resume) ------------- #
     def save_checkpoint(self, params, opt_state, model_state, tag=None):
-        from ..utils.serializer import (SerializationError, _to_host,
-                                        save_state_file)
         if self.checkpoint_path is None:
             return
+        with self._rec().span("checkpoint"):
+            self._save_checkpoint_inner(params, opt_state, model_state, tag)
+
+    def _save_checkpoint_inner(self, params, opt_state, model_state,
+                               tag=None):
+        from ..utils.serializer import (SerializationError, _to_host,
+                                        save_state_file)
         tag = tag or f"iter_{self.state.iteration}"
         path = os.path.join(self.checkpoint_path, f"checkpoint_{tag}.bin")
         host = _to_host((params, opt_state, model_state))
@@ -425,6 +515,10 @@ class Optimizer:
     def _validate(self, params, model_state):
         if self.val_dataset is None or not self.val_methods:
             return None
+        with self._rec().span("validation"):
+            return self._validate_inner(params, model_state)
+
+    def _validate_inner(self, params, model_state):
         # jit once per optimizer: rebuilding the closure each call would
         # recompile the full eval program at every validation trigger
         if not hasattr(self, "_eval_step") or self._eval_step is None:
@@ -483,13 +577,21 @@ class Optimizer:
     def _make_step_builder(self, params_template, optim):
         def build_step():
             n_accum = self._grad_accum
+            telemetry = self._telemetry_active()
+            self._with_health = telemetry
+            self._seen_sigs.clear()   # rebuilt fn: first calls re-compile
+            # rebuilds re-trace: clear the trace-time collective gauges
+            # so per-step volume is not double-counted
+            self._rec().reset_gauges("collective/")
             if n_accum > 1:
                 fn = make_accum_train_step(self.model, self.criterion,
                                            optim, n_accum,
-                                           self.mixed_precision)
+                                           self.mixed_precision,
+                                           telemetry=telemetry)
             else:
                 fn = make_train_step(self.model, self.criterion, optim,
-                                     self.mixed_precision)
+                                     self.mixed_precision,
+                                     telemetry=telemetry)
             return jax.jit(fn, donate_argnums=(0, 1, 2))
         return build_step
 
@@ -572,6 +674,7 @@ class Optimizer:
                     self._resume_skip = 0
 
         self.model.set_params(self._params_for_eval(params), model_state)
+        self._rec().flush()
         return self.model
 
     def _run_epoch(self, params, opt_state, model_state, rng, step_fn,
@@ -585,6 +688,8 @@ class Optimizer:
         self._resume_skip = 0
         self.state.batch_in_epoch = skip
 
+        rec = self._rec()
+
         def staged():
             try:
                 it = self.dataset.data(train=True, epoch=self.state.epoch)
@@ -595,23 +700,69 @@ class Optimizer:
                     return
             for mb in it:
                 x, y = _mb_to_arrays(mb)
-                yield mb.size(), *self._place_batch(x, y)
+                # under prefetch this runs on the producer thread: the
+                # h2d span for batch N+1 overlaps step N by design
+                with rec.span("h2d"):
+                    placed = self._place_batch(x, y)
+                yield (mb.size(),) + tuple(placed)
 
         batches = staged()
         if self.prefetch_depth:
             from ..data.device_loader import DeviceLoader
-            batches = iter(DeviceLoader(batches, self.prefetch_depth))
+            batches = iter(DeviceLoader(batches, self.prefetch_depth,
+                                        recorder=self._recorder))
 
-        data_t = time.time()
-        for size, x, y in batches:
-            wait = time.time() - data_t
+        def fetch_timed(src):
+            """Open the step record BEFORE fetching so data-fetch time is
+            inside the step; preserves the for/else epoch-end path."""
+            synchronous = not self.prefetch_depth
+            while True:
+                rec.start_step(self.state.iteration + 1)
+                h2d0 = rec.span_value("h2d") if synchronous else 0.0
+                t0 = time.time()
+                item = next(src, None)
+                wait = time.time() - t0
+                if item is None:
+                    rec.abort_step()
+                    return
+                if synchronous:
+                    # without prefetch, staged()'s h2d span ran inside
+                    # this fetch window: subtract it so the two spans
+                    # stay disjoint in the step-time breakdown
+                    wait = max(0.0, wait - (rec.span_value("h2d") - h2d0))
+                rec.add_span("data_fetch", wait)
+                yield wait, item
+
+        for wait, (size, x, y) in fetch_timed(iter(batches)):
             rng, sub = jax.random.split(rng)
             t0 = time.time()
             self._loop_rng = rng
-            params, opt_state, model_state, loss = step_fn(
-                params, opt_state, model_state, x, y, sub)
+            span_name = "train_step"
+            if rec.enabled:
+                # a signature never dispatched before means XLA compiles
+                # inside this call: label it so trace_summary can split
+                # compile from execute (and count recompiles)
+                sig = tuple(
+                    (tuple(jnp.shape(l)), str(getattr(l, "dtype", "?")))
+                    for l in jax.tree_util.tree_leaves((x, y)))
+                if sig not in self._seen_sigs:
+                    self._seen_sigs.add(sig)
+                    span_name = "train_step_compile"
+                    rec.scalar("recompile", 1.0)
+                    # this call re-traces (e.g. a ragged last batch) and
+                    # the trace-time collective accounting re-runs: reset
+                    # the per-step gauges or volume double-counts forever
+                    rec.reset_gauges("collective/")
+            with rec.span(span_name):
+                out = step_fn(params, opt_state, model_state, x, y, sub)
+            if self._with_health:
+                params, opt_state, model_state, loss, health = out
+            else:
+                params, opt_state, model_state, loss = out
+                health = None
             # keep `loss` on device: float()ing here would sync the host
             # with the accelerator every step and stall the input pipeline
+            # (telemetry syncs it in end_step — the price of a loss curve)
             dispatch = time.time() - t0
             self.state.iteration += 1
             self.state.batch_in_epoch += 1
@@ -621,10 +772,12 @@ class Optimizer:
             self.metrics.add("dispatch time", dispatch)
             if self.train_summary is not None:
                 self._write_train_summary(params, opt_state)
-            if self._fire_mid_epoch(params, opt_state, model_state):
+            fired_stop = self._fire_mid_epoch(params, opt_state, model_state)
+            if rec.enabled:
+                self._emit_step_record(rec, size, loss, opt_state, health)
+            if fired_stop:
                 stop = True
                 break
-            data_t = time.time()
         else:
             self.state.epoch_finished = True
             if n_seen == 0:
@@ -672,6 +825,34 @@ class Optimizer:
                 stop = True
 
         return params, opt_state, model_state, rng, step_fn, stop
+
+    def _emit_step_record(self, rec: Recorder, size, loss, opt_state,
+                          health):
+        """Fold this iteration's telemetry into one step record."""
+        if not rec.sinks:
+            # trace-only recorder: keep the step/trace cadence but skip
+            # the scalars — recording `loss` would host-sync the device
+            # every step for a record nobody consumes
+            rec.end_step(self.state.iteration)
+            return
+        raw = rec.gauge_value("collective/bytes_per_step")
+        if raw:
+            rec.inc("collective/bytes_total", raw)
+        wire = rec.gauge_value("collective/wire_bytes_per_step")
+        if wire:
+            rec.inc("collective/wire_bytes_total", wire)
+        rec.inc("records_total", size)
+        rec.scalar("records", size)
+        rec.scalar("loss", loss)
+        try:
+            rec.scalar("learning_rate", float(
+                self.optim_method.get_learning_rate(opt_state)))
+        except Exception:
+            pass    # custom OptimMethods without a readable lr
+        if health:
+            for k, v in health.items():
+                rec.scalar(k, v)
+        rec.end_step(self.state.iteration)
 
     def _fire_mid_epoch(self, params, opt_state, model_state) -> bool:
         """iteration-level triggers; returns True if training should end."""

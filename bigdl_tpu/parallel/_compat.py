@@ -1,7 +1,20 @@
 """Version-compat shim: `jax.shard_map` (new, check_vma) vs
-`jax.experimental.shard_map` (old, check_rep). One copy, imported by every
-explicit-SPMD module."""
+`jax.experimental.shard_map` (old, check_rep), plus `lax.axis_size`
+(absent before jax 0.5). One copy, imported by every explicit-SPMD
+module."""
 from __future__ import annotations
+
+from jax import lax as _lax
+
+
+def axis_size(axis_name) -> int:
+    """Static size of a mapped mesh axis, callable at trace time inside
+    shard_map/pmap.  `lax.axis_size` where available; on older jax,
+    `lax.psum(1, axis)` — special-cased to return a concrete int."""
+    fn = getattr(_lax, "axis_size", None)
+    if fn is not None:
+        return int(fn(axis_name))
+    return int(_lax.psum(1, axis_name))
 
 try:
     from jax import shard_map as _shard_map_fn
@@ -22,9 +35,11 @@ except ImportError:  # older jax
 
     def shard_map(f, mesh, in_specs, out_specs, check_rep: bool = False,
                   manual_axes=None):
+        kw = {}
         if manual_axes is not None:
-            raise NotImplementedError(
-                "partial-manual shard_map (auto axes) needs jax>=0.6 "
-                "jax.shard_map(axis_names=...)")
+            # old API spells it inside-out: list the AUTO axes instead
+            kw["auto"] = (frozenset(mesh.axis_names)
+                          - frozenset(manual_axes))
         return _shard_map_fn(f, mesh=mesh, in_specs=in_specs,
-                             out_specs=out_specs, check_rep=check_rep)
+                             out_specs=out_specs, check_rep=check_rep,
+                             **kw)

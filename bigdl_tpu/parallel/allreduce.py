@@ -25,6 +25,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..observability import collectives as _acct
+from ._compat import axis_size
+
 
 def _cast(tree, dtype):
     return jax.tree_util.tree_map(
@@ -32,11 +35,32 @@ def _cast(tree, dtype):
         if jnp.issubdtype(g.dtype, jnp.floating) else g, tree)
 
 
+def _axis_size_or_none(axis_name):
+    """Static axis size when called under shard_map/pmap tracing; None
+    outside a binding context (pure-function unit tests)."""
+    try:
+        return axis_size(axis_name)
+    except Exception:
+        return None
+
+
 def allreduce_gradients(grads, axis_name: str = "dp",
                         compress: Optional[str] = None, mean: bool = True):
     """Sum (or mean) gradients across the axis, optionally compressed to
-    16-bit on the wire (≙ FP16CompressedTensor).  Call inside shard_map."""
+    16-bit on the wire (≙ FP16CompressedTensor).  Call inside shard_map.
+
+    Accounts the ring all-reduce volume (raw and on-the-wire bytes) to
+    the active telemetry recorder at trace time — shapes are static
+    here, so the numbers are exact per executed step."""
     orig_dtypes = jax.tree_util.tree_map(lambda g: g.dtype, grads)
+    n = _axis_size_or_none(axis_name)
+    if n is not None:
+        raw = _acct.tree_bytes(grads)
+        wire_item = _acct.compressed_itemsize(compress)
+        wire = _acct.tree_bytes(grads, wire_itemsize=wire_item)
+        _acct.account_collective(
+            "allreduce", _acct.ring_allreduce_bytes(raw, n),
+            _acct.ring_allreduce_bytes(wire, n))
     if compress in ("fp16", "float16"):
         grads = _cast(grads, jnp.float16)
     elif compress in ("bf16", "bfloat16"):
@@ -53,20 +77,36 @@ def reduce_scatter_gradients(grads, axis_name: str = "dp", mean: bool = True,
     params-shaped tree of bools, e.g. from :func:`shardable_mask_dim0`)
     marks which leaves are dim-0-sharded; without it, any leaf whose
     dim 0 divides the axis size is scattered.  Unsharded leaves are
-    all-reduced instead.  Call inside shard_map with FULL-shape grads."""
-    n = lax.axis_size(axis_name)
+    all-reduced instead.  Call inside shard_map with FULL-shape grads.
+
+    Trace-time accounting: scattered leaves ride a reduce-scatter
+    (S*(n-1)/n wire bytes), unscattered ones a full all-reduce."""
+    n = axis_size(axis_name)
+    rs_bytes, ar_bytes = [0], [0]
 
     def rs(g, s=None):
         sharded = (g.ndim > 0 and g.shape[0] % n == 0) if s is None else s
         if not sharded:
+            ar_bytes[0] += _acct.leaf_bytes(g)
             return lax.pmean(g, axis_name) if mean else lax.psum(g, axis_name)
+        rs_bytes[0] += _acct.leaf_bytes(g)
         out = lax.psum_scatter(g, axis_name, scatter_dimension=0,
                                tiled=True)
         return out / n if mean else out
 
     if mask is None:
-        return jax.tree_util.tree_map(rs, grads)
-    return jax.tree_util.tree_map(rs, grads, mask)
+        out = jax.tree_util.tree_map(rs, grads)
+    else:
+        out = jax.tree_util.tree_map(rs, grads, mask)
+    if rs_bytes[0]:
+        _acct.account_collective(
+            "reduce_scatter", _acct.ring_gather_bytes(rs_bytes[0], n),
+            _acct.ring_gather_bytes(rs_bytes[0], n))
+    if ar_bytes[0]:
+        _acct.account_collective(
+            "allreduce", _acct.ring_allreduce_bytes(ar_bytes[0], n),
+            _acct.ring_allreduce_bytes(ar_bytes[0], n))
+    return out
 
 
 def allgather_params(params, axis_name: str = "dp", mask=None):
@@ -74,14 +114,24 @@ def allgather_params(params, axis_name: str = "dp", mask=None):
     ``mask`` marks which leaves are actually sharded (replicated leaves
     must NOT be gathered — that would tile N copies); without a mask any
     non-scalar leaf is gathered."""
+    n = _axis_size_or_none(axis_name)
+    ag_bytes = [0]
+
     def ag(p, s=None):
         if p.ndim == 0 or (s is not None and not s):
             return p
+        ag_bytes[0] += _acct.leaf_bytes(p) * (n or 1)  # full gathered size
         return lax.all_gather(p, axis_name, axis=0, tiled=True)
 
     if mask is None:
-        return jax.tree_util.tree_map(ag, params)
-    return jax.tree_util.tree_map(ag, params, mask)
+        out = jax.tree_util.tree_map(ag, params)
+    else:
+        out = jax.tree_util.tree_map(ag, params, mask)
+    if ag_bytes[0] and n:
+        _acct.account_collective(
+            "allgather", _acct.ring_gather_bytes(ag_bytes[0], n),
+            _acct.ring_gather_bytes(ag_bytes[0], n))
+    return out
 
 
 def shardable_mask_dim0(tree, n):

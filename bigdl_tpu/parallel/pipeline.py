@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ._compat import shard_map as _shard_map
+from ._compat import axis_size, shard_map as _shard_map
 
 
 def pipeline_run(stage_fn: Callable, stage_params, microbatches,
@@ -40,7 +40,7 @@ def pipeline_run(stage_fn: Callable, stage_params, microbatches,
     Returns (M, mb, ...) outputs, valid on the *last* stage (zeros
     elsewhere); weight per-stage reductions with :func:`last_stage_mask`.
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     n_micro = microbatches.shape[0]
     mb_shape = microbatches.shape[1:]
@@ -74,7 +74,7 @@ def last_stage_mask(axis_name: str = "pp"):
     """1.0 on the last pp rank, 0.0 elsewhere — multiply the loss by this
     and psum over pp so earlier stages contribute zero."""
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     return (idx == n - 1).astype(jnp.float32)
 
 

@@ -30,7 +30,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.flash_attention import (chunk_merge, chunk_merge_blockwise,
                                    finalize, DEFAULT_MASK_VALUE)
-from ._compat import shard_map as _shard_map
+from ._compat import axis_size, shard_map as _shard_map
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = False,
@@ -46,7 +46,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     """
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
-    sp = lax.axis_size(axis_name)
+    sp = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
     s_total = sp * s_local

@@ -33,6 +33,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import mesh as mesh_lib
 from .ring_attention import ring_attention_shmap
 from ..models.transformer import TransformerLM
+from ..observability import collectives as _acct
+from ..observability import null_recorder, set_recorder
 from ..optim.optimizer import make_accum_grads
 
 
@@ -91,6 +93,11 @@ class SpmdTrainer:
         self.opt_state = None
         self._step_fn = None
         self._step_count = 0
+        self._recorder = None
+        self._telemetry_health = True
+        self._with_health = False
+        self._hlo_accounted = False
+        self._seen_sigs = set()
 
     # ------------------------------------------------------------------ #
     def _param_shardings(self, params):
@@ -177,16 +184,89 @@ class SpmdTrainer:
             lambda p, s, t, y, r: (loss_fn(p, t, y, r), s), n_accum,
             weight_fn=lambda t, y: (y != -1).sum())
 
-        from ..optim.optimizer import mask_frozen_grads
+        from ..optim.optimizer import health_scalars, mask_frozen_grads
+
+        telemetry = self._telemetry_active()
+        self._with_health = telemetry
+        self._seen_sigs.clear()
 
         def step(params, opt_state, tokens, targets, rng):
             (loss, _), grads = grads_fn(params, {}, tokens, targets, rng)
             grads = mask_frozen_grads(model, grads)
             new_params, new_opt = optim.update(grads, params, opt_state)
+            if telemetry:
+                # global arrays under full-auto jit: the norm reductions
+                # are already global, no explicit collective needed
+                return (new_params, new_opt, loss,
+                        health_scalars(grads, params, new_params))
             return new_params, new_opt, loss
 
         self._step_fn = jax.jit(step, donate_argnums=(0, 1))
         return self
+
+    # -- telemetry ------------------------------------------------------- #
+    def set_telemetry(self, recorder, health: bool = True):
+        """Attach an observability Recorder: each step() emits a step
+        record (spans: h2d / train_step with compile detection; scalars:
+        loss, tokens/sec, plus grad/param/update norms when ``health`` —
+        the health variant changes the compiled program, so set this
+        BEFORE init()/the first step).  Also installs ``recorder`` as
+        the process-active one."""
+        self._recorder = recorder
+        self._telemetry_health = bool(health)
+        set_recorder(recorder)
+        if (self._step_fn is not None
+                and self._with_health != self._telemetry_active()):
+            # re-jit with the new step signature WITHOUT losing training
+            # progress: init() re-randomizes params, so stash and restore
+            params, opt_state = self.params, self.opt_state
+            self._step_fn = None
+            self.init()
+            if params is not None:
+                self.params, self.opt_state = params, opt_state
+        return self
+
+    def _rec(self):
+        return self._recorder if self._recorder is not None \
+            else null_recorder()
+
+    def _telemetry_active(self):
+        """Compile health scalars into the step?  Only for an attached,
+        ENABLED recorder — a disabled one must get the plain program."""
+        return (self._recorder is not None and self._recorder.enabled
+                and self._telemetry_health)
+
+    def account_collectives(self, tokens, targets):
+        """Compile the current step for these shapes and parse the
+        partitioned HLO for the collectives GSPMD actually inserted
+        (the compiler owns the op choice on this path, so static
+        estimates would lie).  Sets ``collective/*`` gauges on the
+        recorder and returns ``{op: wire_bytes}`` + a total.  One extra
+        trace+compile (cache-served if shapes match a prior step)."""
+        if self._step_fn is None:
+            self.init()
+        sh = self._batch_sharding()
+        tokens = jax.device_put(jnp.asarray(tokens), sh)
+        targets = jax.device_put(jnp.asarray(targets), sh)
+        rng = jax.random.PRNGKey(self.seed + 1)
+        lowered = self._step_fn.lower(self.params, self.opt_state,
+                                      tokens, targets, rng)
+        hlo = lowered.compile().as_text()
+        n = int(np.prod(list(self.mesh.shape.values())))
+        ops = _acct.hlo_collective_ops(hlo, n)
+        rec = self._rec()
+        by_op = {}
+        for op, _, wire in ops:
+            by_op[op] = by_op.get(op, 0.0) + wire
+        total = sum(by_op.values())
+        rec.reset_gauges("collective/")
+        for op, wire in by_op.items():
+            rec.gauge(f"collective/{op.replace('-', '_')}_wire_bytes",
+                      wire)
+        rec.gauge("collective/wire_bytes_per_step", total)
+        rec.gauge("collective/bytes_per_step", total)
+        self._hlo_accounted = True
+        return {"ops": by_op, "wire_bytes_per_step": total}
 
     def step(self, tokens, targets):
         if self._step_fn is None:
@@ -195,14 +275,43 @@ class SpmdTrainer:
         # hooks so interleaved trainers on one model can't bake a foreign
         # mesh into our compiled step (compiled programs are unaffected)
         self.attach()
+        rec = self._rec()
+        rec.start_step(self._step_count)
         sh = self._batch_sharding()
-        tokens = jax.device_put(jnp.asarray(tokens), sh)
-        targets = jax.device_put(jnp.asarray(targets), sh)
+        with rec.span("h2d"):
+            tokens = jax.device_put(jnp.asarray(tokens), sh)
+            targets = jax.device_put(jnp.asarray(targets), sh)
         rng = jax.random.fold_in(jax.random.PRNGKey(self.seed + 1),
                                  self._step_count)
-        self.params, self.opt_state, loss = self._step_fn(
-            self.params, self.opt_state, tokens, targets, rng)
+        span_name = "train_step"
+        if rec.enabled:
+            sig = (tuple(tokens.shape), str(tokens.dtype),
+                   tuple(targets.shape), str(targets.dtype))
+            if sig not in self._seen_sigs:
+                self._seen_sigs.add(sig)
+                span_name = "train_step_compile"
+                rec.scalar("recompile", 1.0)
+        with rec.span(span_name):
+            out = self._step_fn(self.params, self.opt_state, tokens,
+                                targets, rng)
+        if self._with_health:
+            self.params, self.opt_state, loss, health = out
+        else:
+            self.params, self.opt_state, loss = out
+            health = None
         self._step_count += 1
+        if rec.enabled:
+            wire = rec.gauge_value("collective/wire_bytes_per_step")
+            if wire:
+                rec.inc("collective/wire_bytes_total", wire)
+            n_tok = int(np.prod(np.shape(tokens)))
+            rec.inc("tokens_total", n_tok)
+            rec.scalar("records", n_tok)   # records/sec == tokens/sec
+            rec.scalar("loss", loss)
+            if health:
+                for k, v in health.items():
+                    rec.scalar(k, v)
+            rec.end_step(self._step_count - 1)
         return loss
 
     def evaluate(self, batches, steps: Optional[int] = None):

@@ -78,7 +78,13 @@ class EventWriter:
 
 
 def read_events(log_dir: str) -> List[bytes]:
-    """All event payloads from every tfevents file in a dir, in file order."""
+    """All event payloads from every tfevents file in a dir, in file order.
+
+    Both masked CRCs (header and payload) are verified per record, and
+    reading a file STOPS at the first corrupt record — a flipped length
+    would otherwise misframe the rest of the file into garbage payloads
+    (the TFRecord framing's whole point; ≙ tensorflow's
+    RecordReader::ReadRecord checksum handling)."""
     payloads = []
     for fname in sorted(os.listdir(log_dir)):
         if "tfevents" not in fname:
@@ -87,10 +93,18 @@ def read_events(log_dir: str) -> List[bytes]:
             data = f.read()
         i = 0
         while i + 12 <= len(data):
-            (length,) = struct.unpack("<Q", data[i:i + 8])
-            payload = data[i + 12:i + 12 + length]
-            if len(payload) < length:
+            header = data[i:i + 8]
+            (length,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", data[i + 8:i + 12])
+            if masked_crc32c(header) != hcrc:
+                break  # corrupt length: nothing after it can be framed
+            if i + 12 + length + 4 > len(data):
                 break  # truncated tail record
+            payload = data[i + 12:i + 12 + length]
+            (pcrc,) = struct.unpack(
+                "<I", data[i + 12 + length:i + 16 + length])
+            if masked_crc32c(payload) != pcrc:
+                break  # corrupt payload
             payloads.append(payload)
             i += 12 + length + 4
     return payloads

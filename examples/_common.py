@@ -30,4 +30,17 @@ def parse_args(**defaults):
     p.add_argument("--batch", type=int, default=defaults.get("batch", 64))
     p.add_argument("--data-dir", default=defaults.get("data_dir", "/tmp/data"))
     p.add_argument("--lr", type=float, default=defaults.get("lr", 1e-3))
+    p.add_argument("--telemetry", default=None, metavar="JSONL",
+                   help="write per-step telemetry records here; render "
+                        "with `python scripts/trace_summary.py steps "
+                        "<file>`")
     return p.parse_args()
+
+
+def make_recorder(args):
+    """A Recorder with a JsonlSink at --telemetry, or None if the flag
+    is unset.  Pass to optimizer.set_telemetry()."""
+    if not getattr(args, "telemetry", None):
+        return None
+    from bigdl_tpu.observability import JsonlSink, Recorder
+    return Recorder(sinks=[JsonlSink(args.telemetry)])

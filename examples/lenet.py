@@ -6,7 +6,7 @@ deterministic synthetic fallback.
 """
 import numpy as np
 
-from _common import parse_args
+from _common import make_recorder, parse_args
 from bigdl_tpu import nn
 from bigdl_tpu.data import mnist
 from bigdl_tpu.models import lenet
@@ -33,7 +33,14 @@ def main():
            .set_end_when(Trigger.max_epoch(args.epochs))
            .set_validation(Trigger.every_epoch(), (xte, yte),
                            [Top1Accuracy(), Loss(nn.ClassNLLCriterion())]))
+    rec = make_recorder(args)
+    if rec is not None:
+        opt.set_telemetry(rec)
     model = opt.optimize()
+    if rec is not None:
+        rec.close()
+        print(f"telemetry: {args.telemetry} "
+              f"(scripts/trace_summary.py steps {args.telemetry})")
     res = Evaluator(model).test((xte, yte), [Top1Accuracy()])
     print("final:", res[0][1])
 

@@ -21,7 +21,6 @@ condition for >=0.95 scaling efficiency with non-overlapped collectives
 """
 import json
 import os
-import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -53,37 +52,10 @@ from bigdl_tpu.optim import SGD
 from bigdl_tpu.optim.distri_optimizer import DistriOptimizer
 from bigdl_tpu.parallel import mesh as mesh_lib
 
-_DTYPE_BYTES = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8, "s32": 4,
-                "u32": 4, "s8": 1, "u8": 1, "pred": 1, "s64": 8, "u64": 8}
-
-_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
-
-
-def _bytes_of(shape_str):
-    """Total bytes of an HLO result type like f32[64,3,7,7] or a tuple."""
-    total = 0
-    for dt, dims in _SHAPE_RE.findall(shape_str):
-        if dt not in _DTYPE_BYTES:
-            continue
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES[dt]
-    return total
-
-
-def _group_size(line, default):
-    """Ring size of a collective = its replica-group size, parsed from
-    the HLO attrs.  Forms: `replica_groups={{0,1},{2,3}}` (explicit) and
-    `replica_groups=[G,S]<=[...]` (iota: G groups of S)."""
-    m = re.search(r"replica_groups=\[(\d+),(\d+)\]", line)
-    if m:
-        return int(m.group(2))
-    m = re.search(r"replica_groups=\{\{([\d,]+)\}", line)
-    if m:
-        return len(m.group(1).split(","))
-    return default
+# one parser, shared with the runtime telemetry (SpmdTrainer's
+# account_collectives) so the test budget and the live numbers can't drift
+from bigdl_tpu.observability.collectives import (
+    hlo_collective_ops as _hlo_collective_ops)
 
 
 def collective_bytes(hlo_text, n_shards):
@@ -98,29 +70,7 @@ def collective_bytes(hlo_text, n_shards):
       reduce-scatter:    S*(n-1)/n   (S = full pre-scatter size)
       collective-permute: S
     """
-    per_op = []
-    for line in hlo_text.splitlines():
-        s = line.strip()
-        # result type may be a long tuple containing /*index=N*/ comments
-        m = re.match(r"%?[\w.-]+ = (.*?) (all-reduce|all-gather|"
-                     r"reduce-scatter|collective-permute|all-to-all)"
-                     r"(?:-start)?\(", s)
-        if not m:
-            continue
-        shape_str, op = m.group(1), m.group(2)
-        size = _bytes_of(shape_str)
-        n = _group_size(s, n_shards)
-        f = (n - 1) / n if n > 1 else 0.0
-        if op == "all-reduce":
-            wire = 2 * size * f
-        elif op == "all-gather":
-            wire = size * f               # result is the full size
-        elif op == "reduce-scatter":
-            wire = size * f * n           # result is the 1/n shard
-        else:
-            wire = size
-        per_op.append((op, size, wire))
-    return per_op
+    return _hlo_collective_ops(hlo_text, n_shards)
 
 
 def build(model_name):

@@ -1,17 +1,29 @@
-"""Summarize a jax.profiler trace: top ops by device time.
+"""Summarize training telemetry: XLA traces and Recorder JSONL files.
 
-Turns the xplane protobuf that `jax.profiler.trace(dir)` writes (and
-that normally needs TensorBoard's profile plugin to read) into a
-plain table, so an on-TPU profile capture can be analyzed in-terminal:
+Two subcommands:
 
-    python scripts/tpu_tuning.py profile          # writes /tmp/tpu_trace
-    python scripts/trace_summary.py /tmp/tpu_trace [top_n]
+  xplane (default)   top ops by device time from the xplane protobuf
+                     that `jax.profiler.trace(dir)` writes (normally
+                     needs TensorBoard's profile plugin):
 
-CPU-only (parses the .xplane.pb via tensorflow's bundled proto; no
-device access), so it is safe to run while the tunnel is wedged.
+        python scripts/tpu_tuning.py profile      # writes /tmp/tpu_trace
+        python scripts/trace_summary.py /tmp/tpu_trace [top_n]
+        python scripts/trace_summary.py xplane /tmp/tpu_trace [top_n]
+
+  steps              step-time breakdown from an observability
+                     JsonlSink telemetry file: per-span mean/total
+                     milliseconds and share of step time, plus scalar
+                     summaries (loss, grad-norm, throughput) and the
+                     dataloader/collective counters:
+
+        python scripts/trace_summary.py steps /tmp/telemetry.jsonl [last_n]
+
+CPU-only (no device access), so it is safe to run while the tunnel is
+wedged.
 """
 import collections
 import glob
+import json
 import os
 import sys
 
@@ -55,9 +67,98 @@ def summarize(xs, top_n=25):
     return out
 
 
-def main():
-    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_trace"
-    top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 25
+def load_steps(path, last_n=None):
+    """Step records from a JsonlSink telemetry file (bad lines skipped)."""
+    steps = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("type") == "step":
+                steps.append(rec)
+    return steps[-last_n:] if last_n else steps
+
+
+def _fmt_bytes(b):
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(b) < 1024 or unit == "GB":
+            return f"{b:.1f} {unit}"
+        b /= 1024.0
+
+
+def summarize_steps(steps, out=print):
+    """Render the step-time breakdown table for a list of step records."""
+    if not steps:
+        out("no step records")
+        return
+    n = len(steps)
+    total_dur = sum(s.get("dur") or 0.0 for s in steps)
+    out(f"steps: {n}   wall {total_dur:.3f} s   "
+        f"mean step {1e3 * total_dur / n:.2f} ms")
+
+    # per-span totals across steps
+    span_tot = collections.Counter()
+    span_cnt = collections.Counter()
+    for s in steps:
+        for k, v in s.get("spans", {}).items():
+            span_tot[k] += v
+            span_cnt[k] += s.get("span_counts", {}).get(k, 1)
+    if span_tot:
+        out("\n== step-time breakdown ==")
+        out(f"  {'span':<22} {'total ms':>10} {'mean ms':>9} "
+            f"{'% step':>7} {'count':>6}")
+        for k, tot in span_tot.most_common():
+            pct = 100.0 * tot / max(total_dur, 1e-12)
+            out(f"  {k:<22} {1e3 * tot:>10.2f} "
+                f"{1e3 * tot / max(span_cnt[k], 1):>9.2f} "
+                f"{pct:>6.1f}% {span_cnt[k]:>6d}")
+        other = total_dur - sum(span_tot.values())
+        if other > 0:
+            out(f"  {'(unattributed)':<22} {1e3 * other:>10.2f} "
+                f"{1e3 * other / n:>9.2f} "
+                f"{100.0 * other / max(total_dur, 1e-12):>6.1f}%")
+
+    # scalar summaries: first/last/mean for the training-health signals
+    keys = []
+    for s in steps:
+        for k in s.get("scalars", {}):
+            if k not in keys:
+                keys.append(k)
+    if keys:
+        out("\n== scalars (first -> last, mean) ==")
+        for k in keys:
+            vals = [s["scalars"][k] for s in steps
+                    if isinstance(s.get("scalars", {}).get(k), (int, float))]
+            if not vals:
+                continue
+            out(f"  {k:<22} {vals[0]:>12.5g} -> {vals[-1]:>12.5g}   "
+                f"mean {sum(vals) / len(vals):>12.5g}")
+
+    last = steps[-1]
+    counters = last.get("counters", {})
+    if counters:
+        out("\n== cumulative counters (at last step) ==")
+        for k in sorted(counters):
+            v = counters[k]
+            shown = _fmt_bytes(v) if "bytes" in k else f"{v:.6g}"
+            out(f"  {k:<34} {shown}")
+    gauges = last.get("gauges", {})
+    if gauges:
+        out("\n== gauges (at last step) ==")
+        for k in sorted(gauges):
+            v = gauges[k]
+            shown = _fmt_bytes(v) if "bytes" in k else f"{v:.6g}"
+            out(f"  {k:<34} {shown}")
+
+
+def main_xplane(argv):
+    path = argv[0] if argv else "/tmp/tpu_trace"
+    top_n = int(argv[1]) if len(argv) > 1 else 25
     xs, src = load_xspace(path)
     print(f"trace: {src}")
     for name, wall_ms, totals, counts in summarize(xs, top_n):
@@ -71,5 +172,28 @@ def main():
                   f"{op[:90]}")
 
 
+def main_steps(argv):
+    if not argv:
+        raise SystemExit("usage: trace_summary.py steps "
+                         "<telemetry.jsonl> [last_n]")
+    last_n = int(argv[1]) if len(argv) > 1 else None
+    steps = load_steps(argv[0], last_n)
+    print(f"telemetry: {argv[0]}")
+    summarize_steps(steps)
+
+
+def main():
+    argv = sys.argv[1:]
+    if argv and argv[0] == "steps":
+        main_steps(argv[1:])
+    elif argv and argv[0] == "xplane":
+        main_xplane(argv[1:])
+    else:           # back-compat: bare path = xplane trace dir
+        main_xplane(argv)
+
+
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BrokenPipeError:     # `... | head` closed the pipe mid-table
+        sys.exit(0)

@@ -102,14 +102,17 @@ def test_space_to_depth_conv_matches_plain(k, pad, hw):
             return jnp.sum(jnp.sin(mod.apply(p, xx, _ctx())))
         return loss
 
+    # 1e-4 abs: the k=7/hw=32 case accumulates ~2e-5 of fp32 reassociation
+    # noise between the two conv lowerings under the suite's 8-virtual-
+    # device CPU backend; a broken rewrite diverges by O(1)
     g1p, g1x = jax.grad(make_loss(plain), argnums=(0, 1))(params, x)
     g2p, g2x = jax.grad(make_loss(s2d), argnums=(0, 1))(params_s2d, x)
     np.testing.assert_allclose(np.asarray(g1x), np.asarray(g2x),
-                               rtol=1e-5, atol=1e-5)
+                               rtol=1e-4, atol=1e-4)
     for a, b_ in zip(jax.tree_util.tree_leaves(g1p),
                      jax.tree_util.tree_leaves(g2p)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
-                                   rtol=1e-5, atol=1e-5)
+                                   rtol=1e-4, atol=1e-4)
 
 
 @pytest.mark.parametrize("hw", [14, 15])

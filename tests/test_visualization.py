@@ -45,6 +45,35 @@ def test_event_file_structure(tmp_path):
     assert b"brain.Event:2" in payload
 
 
+def test_read_events_stops_at_corrupt_payload(tmp_path):
+    """A flipped byte mid-file must truncate the read, not misframe the
+    rest into garbage payloads (read_events verifies both masked CRCs)."""
+    w = event_writer.EventWriter(str(tmp_path))
+    for i in range(5):
+        w.add_scalar("Loss", float(i), i + 1)
+    w.close()
+    fname = [f for f in os.listdir(tmp_path) if "tfevents" in f][0]
+    path = tmp_path / fname
+    raw = bytearray(path.read_bytes())
+    assert len(event_writer.read_events(str(tmp_path))) == 6  # header + 5
+
+    # locate record 3's payload (skip header + 2 scalars) and flip a byte
+    off = 0
+    for _ in range(3):
+        (length,) = struct.unpack("<Q", raw[off:off + 8])
+        off += 12 + length + 4
+    (length,) = struct.unpack("<Q", raw[off:off + 8])
+    raw[off + 12] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    assert len(event_writer.read_events(str(tmp_path))) == 3
+
+    # corrupt the length header instead: nothing after it can be framed
+    raw[off + 12] ^= 0xFF        # restore payload
+    raw[off] ^= 0xFF             # break the length word
+    path.write_bytes(bytes(raw))
+    assert len(event_writer.read_events(str(tmp_path))) == 3
+
+
 def test_read_scalar_roundtrip(tmp_path):
     w = event_writer.EventWriter(str(tmp_path))
     for i in range(5):
