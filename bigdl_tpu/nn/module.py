@@ -523,10 +523,12 @@ class Module:
             if cache is None:
                 cache = self._sub_models = {}
             if output_layer not in cache:
-                sub = self._sub_model_to(output_layer)
-                sub._params, sub._state = self._params, self._state
-                cache[output_layer] = sub
+                cache[output_layer] = self._sub_model_to(output_layer)
             model = cache[output_layer]
+            # re-sync EVERY call, not once at cache fill: set_weights /
+            # load_weights / a training loop replace self._params, and a
+            # one-time snapshot would keep predicting with stale weights
+            model._params, model._state = self._params, self._state
         feats = list(image_frame)
         xs = []
         for f in feats:

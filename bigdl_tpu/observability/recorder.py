@@ -11,7 +11,9 @@ One :class:`Recorder` instance aggregates four primitive kinds:
               ``jax.profiler.TraceAnnotation`` so they line up with
               device events on an XLA trace
   histograms  per-step value distributions kept as count/min/max/
-              sum/sumsq — ``observe``
+              sum/sumsq plus a bounded recent-sample window for
+              p50/p95/p99 quantiles — ``observe``; read back via
+              ``hist_quantiles``/``hist_summary``
 
 ``start_step``/``end_step`` bracket one training iteration; ``end_step``
 folds everything recorded since ``start_step`` into a *step record*
@@ -29,6 +31,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional
 
 
@@ -80,7 +83,7 @@ class Recorder:
     """
 
     def __init__(self, sinks=(), enabled: bool = True,
-                 annotate: bool = True):
+                 annotate: bool = True, hist_sample_cap: int = 2048):
         self._lock = threading.Lock()
         self.sinks = list(sinks)
         self._enabled = bool(enabled)
@@ -92,6 +95,12 @@ class Recorder:
         self._span_counts: Dict[str, int] = {}
         self._scalars: Dict[str, float] = {}
         self._hists: Dict[str, List[float]] = {}
+        # bounded raw-sample window per histogram so percentiles
+        # (p50/p95/p99 — the serving-latency SLO numbers) are available;
+        # the moment/extremum fields above stay exact over ALL samples,
+        # the quantiles cover the most recent `hist_sample_cap`
+        self.hist_sample_cap = int(hist_sample_cap)
+        self._hist_samples: Dict[str, deque] = {}
         self._step: Optional[int] = None
         self._step_t0: Optional[float] = None
         self._n_records = 0
@@ -173,6 +182,40 @@ class Recorder:
                 h[2] = max(h[2], v)
                 h[3] += v
                 h[4] += v * v
+            s = self._hist_samples.get(name)
+            if s is None:
+                s = self._hist_samples[name] = deque(
+                    maxlen=self.hist_sample_cap)
+            s.append(v)
+
+    def hist_quantiles(self, name: str, qs=(50.0, 95.0, 99.0)
+                       ) -> Optional[Dict[str, float]]:
+        """``{"p50": ..., "p95": ..., "p99": ...}`` over the pending
+        histogram's sample window, or None if nothing was observed.
+        Long-running consumers (the serving engine) read this without a
+        step loop; ``end_step`` folds the same numbers into the step
+        record."""
+        with self._lock:
+            s = self._hist_samples.get(name)
+            if not s:
+                return None
+            samples = sorted(s)
+        return {f"p{q:g}": _quantile(samples, q) for q in qs}
+
+    def hist_summary(self, name: str) -> Optional[Dict[str, float]]:
+        """count/min/max/mean plus p50/p95/p99 of the pending histogram."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                return None
+            s = self._hist_samples.get(name)
+            samples = sorted(s) if s else []
+        out = {"count": int(h[0]), "min": h[1], "max": h[2],
+               "mean": h[3] / max(h[0], 1), "sumsq": h[4]}
+        if samples:
+            out.update({f"p{q:g}": _quantile(samples, q)
+                        for q in (50.0, 95.0, 99.0)})
+        return out
 
     def span(self, name: str):
         """Context manager timing a region into the current step."""
@@ -230,15 +273,23 @@ class Recorder:
             if dur and isinstance(recs, (int, float)) and recs > 0:
                 rec["scalars"]["records_per_sec"] = recs / dur
             if self._hists:
-                rec["hist"] = {
-                    k: {"count": int(h[0]), "min": h[1], "max": h[2],
-                        "mean": h[3] / max(h[0], 1),
-                        "sumsq": h[4]}
-                    for k, h in self._hists.items()}
+                rec["hist"] = {}
+                for k, h in self._hists.items():
+                    entry = {"count": int(h[0]), "min": h[1], "max": h[2],
+                             "mean": h[3] / max(h[0], 1),
+                             "sumsq": h[4]}
+                    s = self._hist_samples.get(k)
+                    if s:
+                        samples = sorted(s)
+                        entry.update(
+                            {f"p{q:g}": _quantile(samples, q)
+                             for q in (50.0, 95.0, 99.0)})
+                    rec["hist"][k] = entry
             self._spans.clear()
             self._span_counts.clear()
             self._scalars.clear()
             self._hists.clear()
+            self._hist_samples.clear()
             self._step = None
             self._step_t0 = None
             self._n_records += 1
@@ -258,6 +309,7 @@ class Recorder:
             self._span_counts.clear()
             self._scalars.clear()
             self._hists.clear()
+            self._hist_samples.clear()
             self._step = None
             self._step_t0 = None
 
@@ -319,6 +371,20 @@ def _to_float(v):
         return float(v)
     except (TypeError, ValueError):
         return v
+
+
+def _quantile(sorted_samples: List[float], q: float) -> float:
+    """Linear-interpolated percentile (numpy's default method) over an
+    already-sorted list; kept dependency-free so the recorder never
+    imports numpy on the hot path."""
+    n = len(sorted_samples)
+    if n == 1:
+        return sorted_samples[0]
+    pos = (q / 100.0) * (n - 1)
+    lo = int(pos)
+    hi = min(lo + 1, n - 1)
+    frac = pos - lo
+    return sorted_samples[lo] * (1.0 - frac) + sorted_samples[hi] * frac
 
 
 # -- process-active recorder ---------------------------------------------- #

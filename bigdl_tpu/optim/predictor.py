@@ -80,18 +80,60 @@ class Evaluator:
 
 
 class PredictionService:
-    """Thread-safe serving wrapper (≙ optim/PredictionService.scala).  The
-    reference pools module clones; jitted applies are already reentrant, so
-    this just guards the host-side state with a lock."""
+    """Serving facade (≙ optim/PredictionService.scala), rebased onto
+    :mod:`bigdl_tpu.serving`: concurrent ``predict`` calls coalesce into
+    power-of-two micro-batches behind a bounded, load-shedding queue
+    instead of serializing on a lock.  Weights are read through an
+    atomic registry snapshot, so ``update_weights``/``sync`` mid-traffic
+    is safe (no stale one-time capture, no half-swapped state).
 
-    def __init__(self, model: Module, num_threads: int = 1):
+    ``input_shape`` (one sample's feature shape) enables eager
+    ``warmup()`` — pre-compiling every batch bucket so no live request
+    ever pays an XLA compile.  ``num_threads`` is kept for reference
+    API compatibility (batching replaced the clone pool).
+    """
+
+    def __init__(self, model: Module, num_threads: int = 1, *,
+                 input_shape=None, max_batch: int = 32,
+                 max_delay_ms: float = 2.0, max_queue_rows: int = 256,
+                 recorder=None):
+        from ..serving import ModelRegistry, ServingEngine
+        self.model = model
+        self.registry = ModelRegistry()
+        self.registry.register("default", model, input_shape=input_shape)
+        self.engine = ServingEngine(
+            self.registry, max_batch=max_batch, max_delay_ms=max_delay_ms,
+            max_queue_rows=max_queue_rows, recorder=recorder)
+        if input_shape is not None:
+            self.engine.warmup()
         import threading
-        self.predictor = Predictor(model)
-        self._lock = threading.Lock()
+        self._fallback = None   # non-array inputs (Samples/DataSet/frames)
+        self._fallback_lock = threading.Lock()
 
-    def predict(self, x):
-        with self._lock:
-            return self.predictor.predict(x)
+    def predict(self, x, timeout=None, deadline_ms=None):
+        if not isinstance(x, (np.ndarray, jnp.ndarray)):
+            # Samples / DataSet / ImageFrame keep the classic batched
+            # path; the engine's row-level batching is array-shaped.
+            # The lock preserves the old facade's guarantee: one shared
+            # Predictor, its host-side state never raced
+            with self._fallback_lock:
+                if self._fallback is None:
+                    self._fallback = Predictor(self.model)
+                return self._fallback.predict(x)
+        return self.engine.predict("default", x, timeout=timeout,
+                                   deadline_ms=deadline_ms)
+
+    def submit(self, x, deadline_ms=None):
+        """Async single/batch request -> Future (serving hot path)."""
+        return self.engine.submit("default", x, deadline_ms=deadline_ms)
+
+    def sync_weights(self, version=None):
+        """Republish after the module's weights changed in place
+        (``set_weights``/``load_weights``/training) — atomic hot-swap."""
+        return self.registry.sync_from_model("default", version=version)
+
+    def shutdown(self, drain: bool = True):
+        self.engine.shutdown(drain=drain)
 
 
 def _iter_inputs(data, batch_size):

@@ -318,6 +318,20 @@ def _rewrite(module: Module, params, replaced, absmax=None) -> Module:
     return module
 
 
+def quantize_for_serving(model: Module, calibration_data=None) -> Module:
+    """:func:`quantize` packaged for the serving registry
+    (``bigdl_tpu.serving.ModelRegistry.register(quantize_int8=True)``):
+    the rewritten model comes back eval-mode and initialized, ready to
+    snapshot.  Remember the contract the registry enforces: the int8
+    weights are compile-time constants inside each bucket executable,
+    so updating them means re-quantize + re-register + re-warm, not a
+    hot swap."""
+    q = quantize(model, calibration_data=calibration_data)
+    q.evaluate()
+    q.ensure_initialized()
+    return q
+
+
 # --------------------------------------------------------------------- #
 # weight-only int8 (LLM serving)                                         #
 # --------------------------------------------------------------------- #

@@ -1,0 +1,49 @@
+"""bigdl_tpu.serving — dynamic-batching inference engine.
+
+The reference stack serves through ``optim/PredictionService.scala`` /
+``LocalPredictor.scala`` (a pool of module clones behind a thread-safe
+facade); BigDL 2.0's pitch is "seamless scaling of AI pipelines" to
+production traffic.  This package is that serving path rebuilt for
+TPU/XLA reality, where throughput comes from large static-shape batches
+and an unexpected shape means a multi-second recompile:
+
+  * :class:`BucketLadder` — the fixed power-of-two batch sizes the
+    engine ever compiles; requests pad up to the next bucket.
+  * :class:`BatchingQueue` — bounded FIFO coalescing concurrent
+    requests into micro-batches under a max-latency deadline, shedding
+    (:class:`LoadShedError`) at admission when full.
+  * :class:`ModelRegistry` — named, versioned models with immutable
+    weight :class:`Snapshot`\\ s and atomic hot-swap.
+  * :class:`ServingEngine` — warmup (pre-compile every bucket,
+    optionally through the int8 path), per-request deadline
+    propagation, graceful drain, and full
+    :class:`~bigdl_tpu.observability.Recorder` wiring.
+
+Quick start::
+
+    from bigdl_tpu.serving import ModelRegistry, ServingEngine
+
+    reg = ModelRegistry()
+    reg.register("mnist", model, input_shape=(1, 28, 28))
+    eng = ServingEngine(reg, max_batch=32, max_delay_ms=5.0)
+    eng.warmup()                      # compile all buckets up front
+    y = eng.predict("mnist", x)       # or submit(...) -> Future
+    eng.shutdown(drain=True)
+
+See ``docs/serving.md`` for architecture and tuning, and
+``scripts/serve_bench.py`` for the closed-loop load generator.
+"""
+from __future__ import annotations
+
+from .buckets import BucketLadder
+from .engine import ServingEngine
+from .queue import (BatchingQueue, EngineClosedError, LoadShedError,
+                    Request)
+from .registry import ModelEntry, ModelRegistry, Snapshot
+
+__all__ = [
+    "BucketLadder", "BatchingQueue", "Request",
+    "LoadShedError", "EngineClosedError",
+    "ModelRegistry", "ModelEntry", "Snapshot",
+    "ServingEngine",
+]

@@ -1,0 +1,226 @@
+"""Multi-model registry with immutable weight snapshots and atomic
+hot-swap.
+
+Why snapshots: the Torch-shell modules mutate ``self._params`` in place
+(``set_weights``, ``load_weights``, a training loop), and any serving
+path that captures that dict once then reads it forever serves *stale*
+weights — the exact bug class ``Module.predict_image`` had with its
+one-time sub-model snapshot.  Here the unit of truth is an immutable
+:class:`Snapshot` (params, state, version); readers grab
+``entry.snapshot`` once per micro-batch (a single attribute read —
+atomic under the GIL) and swaps publish a *new* Snapshot only after the
+replacement tree has been validated leaf-by-leaf against the old one.
+A batch therefore runs against exactly one weight version, never a
+half-swapped mix, and a failed swap changes nothing.
+
+Shape/dtype validation on swap is not bureaucracy: the engine's
+compiled executables are keyed by input bucket and assume fixed
+parameter avals — admitting a differently-shaped tree would either
+crash mid-batch or silently trigger the recompile the bucket ladder
+exists to prevent.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..nn.module import Module
+
+
+class Snapshot:
+    """Immutable (params, state, version) triple; swaps replace the
+    whole object, never mutate one."""
+
+    __slots__ = ("params", "state", "version")
+
+    def __init__(self, params, state, version: str):
+        object.__setattr__(self, "params", params)
+        object.__setattr__(self, "state", state)
+        object.__setattr__(self, "version", version)
+
+    def __setattr__(self, *a):
+        raise AttributeError("Snapshot is immutable; publish a new one")
+
+    def __repr__(self):
+        return f"Snapshot(version={self.version!r})"
+
+
+class ModelEntry:
+    """One served model: the module, its live Snapshot, input spec, and
+    the per-bucket compiled-executable cache the engine fills."""
+
+    def __init__(self, name: str, model: Module, snapshot: Snapshot,
+                 input_shape: Optional[Tuple[int, ...]],
+                 dtype, inference_only: bool = False):
+        self.name = name
+        self.model = model
+        self.snapshot = snapshot
+        self.input_shape = input_shape
+        self.dtype = dtype
+        # int8-rewritten modules carry frozen weights as jitted-in
+        # constants, so a weight swap cannot reuse the compiled buckets
+        self.inference_only = inference_only
+        self.compiled: Dict[int, Any] = {}     # bucket -> executable
+        self.warmed = False
+        self.swap_lock = threading.Lock()
+        # auto versions start at v2: v1 is the registration snapshot
+        self._version_counter = itertools.count(2)
+
+    def next_version(self) -> str:
+        return f"v{next(self._version_counter)}"
+
+
+class ModelRegistry:
+    """Named, versioned models behind one serving engine
+    (≙ optim/PredictionService.scala's model pool, grown multi-model)."""
+
+    def __init__(self):
+        self._entries: Dict[str, ModelEntry] = {}
+        self._lock = threading.Lock()
+
+    # -- registration ----------------------------------------------------- #
+    def register(self, name: str, model: Module, *,
+                 input_shape: Optional[Tuple[int, ...]] = None,
+                 dtype=np.float32, version: Optional[str] = None,
+                 quantize_int8: bool = False,
+                 calibration_data=None) -> ModelEntry:
+        """Add ``model`` under ``name``.
+
+        ``input_shape`` is one sample's feature shape (no batch dim);
+        it is required for :meth:`~bigdl_tpu.serving.ServingEngine.warmup`
+        to pre-compile the bucket ladder (without it the first request
+        of each bucket pays — and counts — a recompile).
+
+        ``quantize_int8=True`` routes through
+        :func:`bigdl_tpu.quantized.quantize_for_serving` first; pass
+        ``calibration_data`` (input batches) to bake static activation
+        scales.  Int8 entries are inference-only: hot-swap requires
+        :meth:`swap_model` + re-warm, since the int8 weights are
+        compile-time constants.
+        """
+        inference_only = False
+        if quantize_int8:
+            from ..quantized import quantize_for_serving
+            model = quantize_for_serving(model,
+                                         calibration_data=calibration_data)
+            inference_only = True
+        model.ensure_initialized()
+        entry = ModelEntry(
+            name, model,
+            Snapshot(model._params, dict(model._state or {}),
+                     version or "v1"),
+            None if input_shape is None else tuple(input_shape),
+            np.dtype(dtype), inference_only=inference_only)
+        with self._lock:
+            if name in self._entries:
+                raise ValueError(f"model {name!r} already registered; "
+                                 "use swap_weights/swap_model to update")
+            self._entries[name] = entry
+        return entry
+
+    def unregister(self, name: str) -> ModelEntry:
+        with self._lock:
+            return self._entries.pop(name)
+
+    def get(self, name: str) -> ModelEntry:
+        with self._lock:
+            try:
+                return self._entries[name]
+            except KeyError:
+                raise KeyError(
+                    f"no model {name!r}; registered: "
+                    f"{sorted(self._entries)}") from None
+
+    def names(self):
+        with self._lock:
+            return sorted(self._entries)
+
+    def entries(self):
+        with self._lock:
+            return list(self._entries.values())
+
+    # -- hot swap --------------------------------------------------------- #
+    def swap_weights(self, name: str, params=None, state=None,
+                     version: Optional[str] = None) -> Snapshot:
+        """Atomically publish new weights for ``name``.
+
+        The replacement tree must match the live snapshot leaf-for-leaf
+        in structure, shape, and dtype (validated *before* publishing,
+        so a bad swap leaves the old snapshot serving).  In-flight
+        micro-batches finish on whichever snapshot they grabbed; new
+        batches see the new one — no half-updated state is ever visible.
+        """
+        entry = self.get(name)
+        if entry.inference_only:
+            raise ValueError(
+                f"model {name!r} is int8/inference-only: its weights are "
+                "compiled-in constants; use swap_model() and re-warm")
+        with entry.swap_lock:
+            old = entry.snapshot
+            new_params = old.params if params is None else params
+            new_state = old.state if state is None else state
+            _check_same_avals(f"{name}.params", old.params, new_params)
+            _check_same_avals(f"{name}.state", old.state, new_state)
+            snap = Snapshot(new_params, new_state,
+                            version or entry.next_version())
+            entry.snapshot = snap          # the atomic publish
+            # keep the shell module coherent for non-serving callers
+            entry.model._params = new_params
+            entry.model._state = dict(new_state)
+            return snap
+
+    def sync_from_model(self, name: str,
+                        version: Optional[str] = None) -> Snapshot:
+        """Republish from the module's own ``_params``/``_state`` —
+        the bridge for code that updated weights through the Torch shell
+        (``set_weights``, ``load_weights``, an in-process trainer)."""
+        entry = self.get(name)
+        return self.swap_weights(name, entry.model._params,
+                                 dict(entry.model._state or {}),
+                                 version=version)
+
+    def swap_model(self, name: str, model: Module,
+                   version: Optional[str] = None) -> ModelEntry:
+        """Replace the module itself (new architecture or a fresh int8
+        rewrite).  Invalidates the compiled-bucket cache — call
+        ``engine.warmup(name)`` before taking traffic or the next
+        request per bucket pays a counted recompile."""
+        entry = self.get(name)
+        model.ensure_initialized()
+        with entry.swap_lock:
+            entry.model = model
+            entry.snapshot = Snapshot(model._params,
+                                      dict(model._state or {}),
+                                      version or entry.next_version())
+            entry.compiled = {}
+            entry.warmed = False
+        return entry
+
+
+def _check_same_avals(label: str, old, new):
+    ol = jax.tree_util.tree_flatten(old)
+    nl = jax.tree_util.tree_flatten(new)
+    if ol[1] != nl[1]:
+        raise ValueError(f"swap {label}: tree structure changed "
+                         f"({ol[1]} != {nl[1]})")
+    for i, (a, b) in enumerate(zip(ol[0], nl[0])):
+        sa, da = _aval(a)
+        sb, db = _aval(b)
+        if sa != sb or da != db:
+            raise ValueError(
+                f"swap {label}: leaf {i} changed from {sa}/{da} to "
+                f"{sb}/{db}; compiled executables assume fixed avals")
+
+
+def _aval(x):
+    """(shape, dtype) from metadata only — the OLD snapshot's buffers
+    may already be donated/deleted by a training step, and metadata
+    survives deletion while materializing the values would not."""
+    dt = getattr(x, "dtype", None)
+    if dt is None:
+        dt = np.asarray(x).dtype
+    return np.shape(x), np.dtype(dt)

@@ -282,5 +282,8 @@ if __name__ == "__main__":
         print(f"# [{w}] done at +{time.time()-t0:.0f}s", flush=True)
     # non-zero exit on any failure: tpu_queue must NOT write a completion
     # sentinel for a run whose measurement never happened (a swallowed
-    # wedge would otherwise mark the lever 'done' forever)
-    sys.exit(1 if failed else 0)
+    # wedge would otherwise mark the lever 'done' forever).  rc=4 is
+    # bench.py's "config failed, run completed" convention — distinct
+    # from rc=2 (backend unreachable), so tpu_queue keeps draining the
+    # queue instead of treating the whole window as dead
+    sys.exit(4 if failed else 0)

@@ -1,0 +1,196 @@
+"""Closed-loop load generator for the bigdl_tpu.serving engine.
+
+C client threads each run a closed loop: pick a request size uniformly
+in [1, 17] (deliberately straddling bucket boundaries 1/2/4/8/16/32),
+submit, wait for the result, repeat — the classic closed-loop protocol
+where offered load self-regulates to the engine's service rate and the
+interesting numbers are the latency percentiles and the batch-fill
+ratio, not raw QPS.
+
+Emits ONE machine-parseable JSON summary as the final stdout line
+(same contract as bench.py: the driver parses the LAST line)::
+
+  {"metric": "serve_bench", "backend": "cpu", "requests": 240,
+   "p50_ms": ..., "p95_ms": ..., "p99_ms": ..., "batch_fill": ...,
+   "shed": 0, "recompiles": 0, "throughput_rps": ..., ...}
+
+``--smoke`` is the CI job: a small MLP on the CPU backend, asserting
+the engine's core SLO invariant — ZERO XLA recompiles after warmup —
+and exiting non-zero if it (or any response) is wrong.
+
+``--overload`` shrinks the queue and adds per-request deadlines so the
+shed path is exercised (the summary's ``shed`` goes positive instead
+of latency collapsing).
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def parse_args():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI mode: CPU backend, small load, assert "
+                         "zero recompiles after warmup")
+    ap.add_argument("--overload", action="store_true",
+                    help="tiny queue + tight deadlines to exercise "
+                         "load shedding")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="total requests across all clients "
+                         "(default: 240 smoke, 2000 full)")
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--delay-ms", type=float, default=5.0,
+                    help="micro-batch max wait")
+    ap.add_argument("--queue-rows", type=int, default=1024)
+    ap.add_argument("--deadline-ms", type=float, default=None,
+                    help="per-request SLO deadline")
+    ap.add_argument("--model", choices=("mlp", "lenet"), default="mlp")
+    ap.add_argument("--int8", action="store_true",
+                    help="serve through the quantized int8 path")
+    ap.add_argument("--max-size", type=int, default=17,
+                    help="request sizes drawn from [1, max-size]")
+    return ap.parse_args()
+
+
+ARGS = parse_args()
+if ARGS.smoke:
+    # must happen before jax import; the smoke contract is CPU-only
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np                                         # noqa: E402
+import jax                                                 # noqa: E402
+
+from bigdl_tpu import nn                                   # noqa: E402
+from bigdl_tpu.observability import Recorder               # noqa: E402
+from bigdl_tpu.serving import (LoadShedError,              # noqa: E402
+                               ModelRegistry, ServingEngine)
+
+
+def build_model(kind):
+    if kind == "lenet":
+        from bigdl_tpu.models import lenet
+        return lenet.build(class_num=10), (1, 28, 28)
+    model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(),
+                          nn.Linear(128, 10))
+    return model, (64,)
+
+
+def main():
+    a = ARGS
+    n_requests = a.requests if a.requests is not None \
+        else (240 if a.smoke else 2000)
+    if a.overload:
+        a.queue_rows = min(a.queue_rows, 2 * a.max_batch)
+        if a.deadline_ms is None:
+            a.deadline_ms = 50.0
+
+    model, input_shape = build_model(a.model)
+    model.evaluate()
+    rec = Recorder(annotate=False)
+    reg = ModelRegistry()
+    calib = [np.zeros((4,) + input_shape, np.float32)] if a.int8 else None
+    reg.register("main", model, input_shape=input_shape,
+                 quantize_int8=a.int8, calibration_data=calib)
+    eng = ServingEngine(reg, max_batch=a.max_batch,
+                        max_delay_ms=a.delay_ms,
+                        max_queue_rows=a.queue_rows, recorder=rec)
+
+    t0 = time.perf_counter()
+    eng.warmup()
+    warm_s = time.perf_counter() - t0
+    print(f"# warmup: {rec.counter_value('serving.warmup_compiles'):.0f} "
+          f"bucket compiles in {warm_s:.1f}s "
+          f"(buckets {list(eng.ladder)})", flush=True)
+
+    lock = threading.Lock()
+    latencies, errors = [], []
+    shed = [0]
+    remaining = [n_requests]
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        while True:
+            with lock:
+                if remaining[0] <= 0:
+                    return
+                remaining[0] -= 1
+            n = int(rng.randint(1, a.max_size + 1))
+            x = rng.rand(n, *input_shape).astype(np.float32)
+            t = time.perf_counter()
+            try:
+                y = eng.predict("main", x, timeout=120,
+                                deadline_ms=a.deadline_ms)
+                dt = (time.perf_counter() - t) * 1e3
+                with lock:
+                    latencies.append(dt)
+                if np.shape(y)[0] != n:
+                    with lock:
+                        errors.append(f"shape {np.shape(y)} for n={n}")
+            except LoadShedError:
+                with lock:
+                    shed[0] += 1
+            except Exception as e:
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(a.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t0
+    eng.shutdown(drain=True)
+
+    stats = eng.stats()
+    lat = np.asarray(latencies) if latencies else np.zeros(1)
+    engine_shed = int(stats["shed_queue_full"] + stats["shed_deadline"])
+    summary = {
+        "metric": "serve_bench",
+        "backend": jax.default_backend(),
+        "model": a.model + ("_int8" if a.int8 else ""),
+        "requests": n_requests,
+        "completed": len(latencies),
+        "clients": a.clients,
+        "max_batch": eng.ladder.max_batch,
+        "delay_ms": a.delay_ms,
+        "p50_ms": round(float(np.percentile(lat, 50)), 3),
+        "p95_ms": round(float(np.percentile(lat, 95)), 3),
+        "p99_ms": round(float(np.percentile(lat, 99)), 3),
+        "batch_fill": round(float(stats.get("batch_fill", 0.0)), 4),
+        "shed": engine_shed,
+        "recompiles": int(stats["recompiles"]),
+        "warmup_compiles": int(stats["warmup_compiles"]),
+        "throughput_rps": round(len(latencies) / wall, 2),
+        "throughput_rows_per_sec": round(stats["rows"] / wall, 2),
+        "errors": len(errors),
+        "smoke": bool(a.smoke),
+    }
+    for e in errors[:5]:
+        print(f"# client error: {e}", file=sys.stderr, flush=True)
+    ok = not errors
+    if a.smoke:
+        # the SLO invariant CI pins: after warmup, a mixed-size request
+        # stream compiles NOTHING new
+        if summary["recompiles"] != 0:
+            print(f"# SMOKE FAIL: {summary['recompiles']} recompiles "
+                  "after warmup", file=sys.stderr, flush=True)
+            ok = False
+        if not a.overload and summary["completed"] != n_requests:
+            print(f"# SMOKE FAIL: {summary['completed']}/{n_requests} "
+                  "completed", file=sys.stderr, flush=True)
+            ok = False
+    print(json.dumps(summary), flush=True)
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -72,6 +72,37 @@ def test_histograms_per_step():
     assert "hist" not in rec.end_step()
 
 
+def test_histogram_percentiles():
+    rec = Recorder(annotate=False)
+    for v in range(1, 101):            # 1..100
+        rec.observe("lat", float(v))
+    q = rec.hist_quantiles("lat")
+    # numpy's linear-interpolation convention over 1..100
+    assert abs(q["p50"] - np.percentile(np.arange(1, 101), 50)) < 1e-9
+    assert abs(q["p95"] - np.percentile(np.arange(1, 101), 95)) < 1e-9
+    assert abs(q["p99"] - np.percentile(np.arange(1, 101), 99)) < 1e-9
+    s = rec.hist_summary("lat")
+    assert s["count"] == 100 and s["p50"] == q["p50"]
+    assert rec.hist_quantiles("missing") is None
+    # percentiles fold into the step record and reset with it
+    rec.start_step(0)
+    rec.observe("lat2", 7.0)
+    r = rec.end_step()
+    assert r["hist"]["lat2"]["p99"] == 7.0
+    assert rec.hist_quantiles("lat2") is None
+
+
+def test_histogram_sample_window_is_bounded():
+    rec = Recorder(annotate=False, hist_sample_cap=8)
+    for v in range(100):
+        rec.observe("lat", float(v))
+    # moments stay exact over ALL observations ...
+    s = rec.hist_summary("lat")
+    assert s["count"] == 100 and s["min"] == 0.0 and s["max"] == 99.0
+    # ... while quantiles cover the most recent window only
+    assert rec.hist_quantiles("lat")["p50"] == 95.5
+
+
 def test_disabled_recorder_is_noop_and_cheap():
     rec = Recorder(enabled=False)
     # all primitives are no-ops

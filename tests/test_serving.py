@@ -1,0 +1,414 @@
+"""Serving subsystem (ISSUE 2 tentpole): bucket ladder determinism,
+deadline-driven batching, load shedding, hot-swap atomicity, graceful
+drain, the PredictionService rebase, and the predict_image
+stale-weights regression."""
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.serving import (BatchingQueue, BucketLadder,
+                               EngineClosedError, LoadShedError,
+                               ModelRegistry, Request, ServingEngine)
+
+
+class Scale(Module):
+    """y = weight * x with a single scalar weight — outputs identify the
+    exact weight version a batch ran with (hot-swap atomicity probe)."""
+
+    def init(self, rng):
+        return {self.name: {"weight": jnp.ones(())}}
+
+    def apply(self, params, x, ctx):
+        return x * params[self.name]["weight"]
+
+
+def make_engine(model=None, input_shape=(4,), **kw):
+    reg = ModelRegistry()
+    reg.register("m", model or Scale(), input_shape=input_shape)
+    kw.setdefault("max_batch", 32)
+    kw.setdefault("max_delay_ms", 2.0)
+    return reg, ServingEngine(reg, **kw)
+
+
+# --------------------------------------------------------------------- #
+# bucket ladder                                                         #
+# --------------------------------------------------------------------- #
+def test_bucket_ladder_deterministic_powers_of_two():
+    lad = BucketLadder(32)
+    assert list(lad) == [1, 2, 4, 8, 16, 32]
+    # deterministic smallest-fitting selection, replayable
+    want = {1: 1, 2: 2, 3: 4, 4: 4, 5: 8, 8: 8, 9: 16, 16: 16,
+            17: 32, 32: 32}
+    for n, b in want.items():
+        assert lad.bucket_for(n) == b
+        assert lad.bucket_for(n) == b   # same answer every time
+    with pytest.raises(ValueError):
+        lad.bucket_for(33)
+    with pytest.raises(ValueError):
+        lad.bucket_for(0)
+    assert BucketLadder(20).max_batch == 32   # rounds up
+
+
+# --------------------------------------------------------------------- #
+# batching queue                                                        #
+# --------------------------------------------------------------------- #
+def test_queue_sheds_at_capacity():
+    q = BatchingQueue(max_pending_rows=8, max_delay=0.01)
+    q.put(Request(np.zeros((5, 2)), 5))
+    q.put(Request(np.zeros((3, 2)), 3))
+    with pytest.raises(LoadShedError) as ei:
+        q.put(Request(np.zeros((1, 2)), 1))
+    assert ei.value.reason == "queue_full"
+    assert q.depth() == 8
+
+
+def test_queue_deadline_flush_and_batch_gather():
+    q = BatchingQueue(max_pending_rows=64, max_delay=0.05)
+    q.put(Request(np.zeros((2, 2)), 2))
+    q.put(Request(np.zeros((3, 2)), 3))
+    t0 = time.monotonic()
+    batch = q.get_batch(max_rows=32)
+    waited = time.monotonic() - t0
+    # gathered both, flushed at the delay deadline, not at queue-full
+    assert [r.n for r in batch] == [2, 3]
+    assert 0.02 <= waited < 1.0
+    assert q.depth() == 0
+
+
+def test_queue_flushes_immediately_when_full():
+    q = BatchingQueue(max_pending_rows=64, max_delay=10.0)
+    q.put(Request(np.zeros((4, 2)), 4))
+    t0 = time.monotonic()
+    batch = q.get_batch(max_rows=4)     # already full: no delay wait
+    assert time.monotonic() - t0 < 1.0
+    assert [r.n for r in batch] == [4]
+
+
+def test_queue_close_drains_then_none():
+    q = BatchingQueue(max_pending_rows=64, max_delay=10.0)
+    q.put(Request(np.zeros((2, 2)), 2))
+    q.close()
+    with pytest.raises(EngineClosedError):
+        q.put(Request(np.zeros((1, 2)), 1))
+    assert [r.n for r in q.get_batch(32)] == [2]   # drain, no delay wait
+    assert q.get_batch(32) is None                  # drained -> done
+
+
+def test_queue_dump_for_fast_shutdown():
+    q = BatchingQueue(max_pending_rows=64)
+    reqs = [Request(np.zeros((1, 2)), 1) for _ in range(3)]
+    for r in reqs:
+        q.put(r)
+    assert q.dump() == reqs
+    assert q.depth() == 0
+
+
+# --------------------------------------------------------------------- #
+# engine: the zero-recompile SLO invariant                              #
+# --------------------------------------------------------------------- #
+def test_mixed_sizes_zero_recompiles_after_warmup():
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 3))
+    reg, eng = make_engine(model)
+    try:
+        eng.warmup()
+        assert eng.recorder.counter_value("serving.warmup_compiles") == 6
+        rng = np.random.RandomState(0)
+        futs = []
+        for n in list(range(1, 18)) + [17, 3, 1, 9, 16]:
+            x = rng.rand(n, 4).astype(np.float32)
+            futs.append((x, eng.submit("m", x)))
+        model.ensure_initialized()
+        for x, f in futs:
+            y = f.result(timeout=30)
+            want, _ = model.run(model._params, jnp.asarray(x),
+                                state=model._state)
+            np.testing.assert_allclose(y, np.asarray(want), rtol=1e-5,
+                                       atol=1e-6)
+        # the acceptance criterion: mixed sizes 1..17, ZERO new compiles
+        assert eng.recorder.counter_value("serving.recompiles") == 0
+        assert eng.stats()["batches"] >= 1
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_unwarmed_bucket_counts_as_recompile():
+    reg, eng = make_engine()
+    try:
+        # no warmup: the first request's bucket compile must be COUNTED
+        y = eng.submit("m", np.ones((3, 4), np.float32)).result(30)
+        assert y.shape == (3, 4)
+        assert eng.recorder.counter_value("serving.recompiles") == 1
+        # same bucket again: cached, no new compile
+        eng.submit("m", np.ones((4, 4), np.float32)).result(30)
+        assert eng.recorder.counter_value("serving.recompiles") == 1
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_single_sample_and_split_predict():
+    reg, eng = make_engine(max_batch=8)
+    try:
+        eng.warmup()
+        y = eng.submit("m", np.full(4, 2.0, np.float32)).result(30)
+        assert y.shape == (4,)                    # batch dim stripped
+        np.testing.assert_allclose(y, 2.0)
+        big = eng.predict("m", np.ones((21, 4), np.float32), timeout=30)
+        assert big.shape == (21, 4)               # split across 3 submits
+        assert eng.recorder.counter_value("serving.recompiles") == 0
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_deadline_flush_bounds_lone_request_latency():
+    reg, eng = make_engine(max_delay_ms=30.0)
+    try:
+        eng.warmup()
+        t0 = time.monotonic()
+        eng.submit("m", np.ones((1, 4), np.float32)).result(timeout=30)
+        elapsed = time.monotonic() - t0
+        # a lone request must flush on the delay deadline, NOT wait for
+        # a full bucket that never comes (generous bound for slow CI)
+        assert elapsed < 10.0
+        fill = eng.recorder.hist_summary("serving.batch_fill")
+        assert fill is not None and fill["max"] <= 1.0
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_expired_deadline_is_shed_not_executed():
+    reg, eng = make_engine()
+    try:
+        eng.warmup()
+        f = eng.submit("m", np.ones((2, 4), np.float32), deadline_ms=0.0)
+        time.sleep(0.01)   # guarantee expiry before the batcher pops it
+        with pytest.raises(LoadShedError) as ei:
+            f.result(timeout=30)
+        assert ei.value.reason == "deadline"
+        assert eng.recorder.counter_value("serving.shed_deadline") >= 1
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_queue_full_backpressure_at_engine_level():
+    reg, eng = make_engine(max_queue_rows=8, max_batch=4,
+                           max_delay_ms=1.0)
+    gate = threading.Event()
+    orig = eng._run_batch
+
+    def gated(entry, q, batch):
+        gate.wait(30)
+        orig(entry, q, batch)
+
+    eng._run_batch = gated
+    try:
+        eng.warmup()
+        blocker = eng.submit("m", np.ones((4, 4), np.float32))
+        deadline = time.monotonic() + 10
+        while eng._queues["m"].depth() > 0:     # worker popped it
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        # worker is stalled inside the gate: flood past the 8-row cap
+        shed = 0
+        futs = [blocker]
+        for _ in range(4):
+            try:
+                futs.append(eng.submit("m", np.ones((4, 4), np.float32)))
+            except LoadShedError:
+                shed += 1
+        assert shed == 2    # 8 rows admitted, the last two 4-row shed
+        assert eng.recorder.counter_value("serving.shed_queue_full") \
+            == shed
+        gate.set()
+        for f in futs:
+            f.result(timeout=30)    # admitted requests still complete
+    finally:
+        gate.set()
+        eng.shutdown(drain=True)
+
+
+# --------------------------------------------------------------------- #
+# hot swap                                                              #
+# --------------------------------------------------------------------- #
+def test_hot_swap_atomicity_under_concurrent_requests():
+    reg, eng = make_engine(max_delay_ms=1.0)
+    try:
+        eng.warmup()
+        stop = threading.Event()
+        bad = []
+
+        def client(seed):
+            rng = np.random.RandomState(seed)
+            try:
+                while not stop.is_set():
+                    n = int(rng.randint(1, 6))
+                    y = eng.submit(
+                        "m", np.ones((n, 4), np.float32)).result(30)
+                    vals = set(np.asarray(y).reshape(-1).tolist())
+                    # every element of a response reflects exactly ONE
+                    # weight version — never a half-swapped mix
+                    if len(vals) != 1 or not vals <= {1.0, 2.0}:
+                        bad.append(vals)
+            except Exception as e:
+                bad.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        snap = reg.get("m").snapshot
+        for i in range(20):
+            # np.float32 keeps the leaf strongly typed: the compiled
+            # executables' avals must not change across swaps
+            c = np.float32(2.0 if i % 2 == 0 else 1.0)
+            reg.swap_weights(
+                "m", {list(snap.params)[0]: {"weight": jnp.asarray(c)}})
+            time.sleep(0.005)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        assert not bad, f"mixed-version responses: {bad[:3]}"
+        # swaps never recompiled anything (same avals)
+        assert eng.recorder.counter_value("serving.recompiles") == 0
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_swap_validation_is_atomic():
+    reg, eng = make_engine()
+    entry = reg.get("m")
+    before = entry.snapshot
+    name = list(before.params)[0]
+    with pytest.raises(ValueError):    # shape change rejected
+        reg.swap_weights("m", {name: {"weight": jnp.ones((3,))}})
+    with pytest.raises(ValueError):    # structure change rejected
+        reg.swap_weights("m", {name: {"other": jnp.ones(())}})
+    assert entry.snapshot is before    # failed swap changed NOTHING
+    after = reg.swap_weights("m", {name: {"weight": jnp.asarray(5.0)}})
+    assert entry.snapshot is after and after.version != before.version
+    eng.shutdown(drain=True)
+
+
+def test_registry_multi_model_and_int8_path():
+    reg = ModelRegistry()
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model.ensure_initialized()
+    x = np.random.RandomState(0).rand(4, 4).astype(np.float32)
+    reg.register("float", model, input_shape=(4,))
+    reg.register("int8", model, input_shape=(4,), quantize_int8=True,
+                 calibration_data=[x])
+    assert reg.names() == ["float", "int8"]
+    eng = ServingEngine(reg, max_batch=8, max_delay_ms=1.0)
+    try:
+        eng.warmup()
+        yf = eng.predict("float", x, timeout=30)
+        yq = eng.predict("int8", x, timeout=30)
+        np.testing.assert_allclose(yq, yf, rtol=0.15, atol=0.1)
+        assert eng.recorder.counter_value("serving.recompiles") == 0
+        # int8 weights are baked into the executables: hot swap refuses
+        with pytest.raises(ValueError):
+            reg.swap_weights("int8", reg.get("float").snapshot.params)
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_reregister_under_same_name_serves_new_model():
+    reg, eng = make_engine()
+    try:
+        eng.warmup()
+        y = eng.submit("m", np.ones((2, 4), np.float32)).result(30)
+        np.testing.assert_allclose(y, 1.0)
+        reg.unregister("m")
+        new = Scale()
+        new.ensure_initialized()
+        reg.register("m", new, input_shape=(4,))
+        reg.swap_weights("m", {list(new._params)[0]:
+                               {"weight": jnp.asarray(np.float32(3.0))}})
+        # the batcher re-resolves the entry per batch: the NEW model
+        # (weight 3) answers, not a stale closure over the old entry
+        y2 = eng.submit("m", np.ones((2, 4), np.float32)).result(30)
+        np.testing.assert_allclose(y2, 3.0)
+        # the fresh entry's buckets weren't warmed: compile was COUNTED
+        assert eng.recorder.counter_value("serving.recompiles") == 1
+    finally:
+        eng.shutdown(drain=True)
+
+
+# --------------------------------------------------------------------- #
+# shutdown                                                              #
+# --------------------------------------------------------------------- #
+def test_graceful_drain_completes_queued_work():
+    reg, eng = make_engine(max_delay_ms=100.0)
+    futs = [eng.submit("m", np.ones((2, 4), np.float32))
+            for _ in range(5)]
+    eng.shutdown(drain=True)     # close + drain: no 100 ms lingering
+    for f in futs:
+        assert f.result(timeout=5).shape == (2, 4)
+    with pytest.raises(EngineClosedError):
+        eng.submit("m", np.ones((1, 4), np.float32))
+
+
+def test_fast_shutdown_fails_pending_explicitly():
+    reg, eng = make_engine(max_delay_ms=2000.0)
+    futs = [eng.submit("m", np.ones((1, 4), np.float32))
+            for _ in range(3)]
+    eng.shutdown(drain=False, timeout=10)
+    failed = done = 0
+    for f in futs:
+        try:
+            f.result(timeout=5)
+            done += 1
+        except EngineClosedError:
+            failed += 1
+    # every future resolves promptly — raced ones may have executed,
+    # dumped ones fail with the explicit engine-closed error
+    assert done + failed == 3
+
+
+# --------------------------------------------------------------------- #
+# PredictionService rebase + stale-weights regressions                  #
+# --------------------------------------------------------------------- #
+def test_prediction_service_rebased_on_engine():
+    from bigdl_tpu.optim.predictor import PredictionService
+
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    svc = PredictionService(model, input_shape=(4,), max_delay_ms=1.0)
+    try:
+        assert svc.engine.recorder.counter_value(
+            "serving.warmup_compiles") > 0   # eager warmup ran
+        x = np.random.RandomState(1).rand(5, 4).astype(np.float32)
+        got = svc.predict(x, timeout=30)
+        want, _ = model.run(model._params, jnp.asarray(x),
+                            state=model._state)
+        np.testing.assert_allclose(got, np.asarray(want), rtol=1e-5,
+                                   atol=1e-6)
+        # hot path: weights change + sync republishes atomically
+        ws = [np.zeros_like(w) for w in model.get_weights()]
+        model.set_weights(ws)
+        svc.sync_weights()
+        np.testing.assert_allclose(svc.predict(x, timeout=30), 0.0,
+                                   atol=1e-6)
+    finally:
+        svc.shutdown()
+
+
+def test_predict_image_output_layer_sees_fresh_weights():
+    """Regression (advisor round-5): the cached sub-model took a one-time
+    snapshot of _params, so set_weights left it predicting stale."""
+    from bigdl_tpu.data.imageframe import ImageFeature, ImageFrame
+
+    model = nn.Sequential(nn.Reshape((4,)),
+                          nn.Linear(4, 2).set_name("fc"))
+    model.ensure_initialized()
+    frame = ImageFrame([ImageFeature(image=np.ones((2, 2), np.float32))])
+    model.predict_image(frame, output_layer="fc", batch_per_partition=1)
+    first = np.array(list(frame)[0]["predict"])
+    model.set_weights([np.zeros_like(w) for w in model.get_weights()])
+    model.predict_image(frame, output_layer="fc", batch_per_partition=1)
+    second = np.array(list(frame)[0]["predict"])
+    np.testing.assert_allclose(second, 0.0, atol=1e-6)
+    assert not np.allclose(first, 0.0)   # the old weights weren't zero
