@@ -1,0 +1,44 @@
+"""bigdl_tpu.checkpoint — fault-tolerant async checkpointing.
+
+The reference BigDL survives executor loss by re-running Spark tasks
+from cached state (DistriOptimizer.scala's retry loop); a preempted TPU
+VM has no scheduler to do that for it.  This subsystem makes recovery a
+property of the checkpoint format instead:
+
+  * **async snapshot pipeline** — the step loop blocks only for the
+    device→host copy (``checkpoint.blocking`` span); a background
+    writer (:class:`~bigdl_tpu.checkpoint.writer.AsyncCheckpointWriter`)
+    serializes sharded, CRC32C-verified files off the critical path
+  * **atomic commit** — a per-checkpoint ``MANIFEST.json`` (shards +
+    checksums + step/epoch/rng metadata) is written last via
+    ``os.replace``: a checkpoint without a valid manifest does not exist
+    (:mod:`~bigdl_tpu.checkpoint.manifest`)
+  * **retention/GC** — keep-last-N plus keep-every-M-epochs
+  * **preemption** — SIGTERM finishes the in-flight write, emits a
+    final checkpoint, and exits cleanly
+    (:class:`~bigdl_tpu.checkpoint.preemption.PreemptionHandler`)
+  * **auto-resume** — scan manifests, verify CRCs, fall back to the
+    newest INTACT checkpoint when the latest is torn
+    (:meth:`CheckpointManager.restore_latest`)
+  * **fault injection** — :mod:`~bigdl_tpu.checkpoint.faults` kills the
+    writer at configurable byte offsets so crash consistency is a
+    tested property, not a hope
+
+Wired into ``optim.Optimizer.set_checkpoint`` (default) and
+``parallel.spmd.SpmdTrainer`` (``layout="manifest"``).  See
+``docs/checkpointing.md``.
+"""
+from __future__ import annotations
+
+from .manifest import (CheckpointError, Manifest, Shard, read_manifest,
+                       scan, verify)
+from .manager import CheckpointManager, host_snapshot
+from .preemption import PreemptionHandler
+from .writer import AsyncCheckpointWriter
+from . import faults
+
+__all__ = [
+    "CheckpointError", "Manifest", "Shard", "read_manifest", "scan",
+    "verify", "CheckpointManager", "host_snapshot", "PreemptionHandler",
+    "AsyncCheckpointWriter", "faults",
+]

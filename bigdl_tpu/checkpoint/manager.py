@@ -1,0 +1,363 @@
+"""CheckpointManager: async snapshot pipeline + atomic commit + resume.
+
+One manager owns one checkpoint root directory and provides:
+
+  save()            hand a HOST-side snapshot to the background writer
+                    (the caller does the device→host copy under its own
+                    ``checkpoint.blocking`` span; everything after —
+                    serialize, CRC, write, commit, GC — is off the step
+                    loop)
+  restore_latest()  newest INTACT checkpoint: manifests are scanned and
+                    every shard CRC-verified, falling back past torn or
+                    corrupt checkpoints; the ``latest`` pointer is only
+                    a hint and a dangling/corrupt pointer is tolerated
+  retention         keep-last-N plus keep-every-M-epochs GC after each
+                    commit
+
+Two layouts:
+
+  "manifest" (default)  sharded files + atomic ``MANIFEST.json`` commit
+                        (see :mod:`.manifest`); supports multi-host
+                        part-manifests via ``process_index``/``count``
+  "file"                the legacy single-file-per-checkpoint layout
+                        (``checkpoint_<tag>.bin`` + a ``latest`` pointer
+                        holding the file path) — kept so old tooling and
+                        old checkpoints keep working, now with an atomic
+                        pointer update and scan-based pointer recovery
+"""
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+import re
+import shutil
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from . import faults, manifest as mlib
+from .manifest import DIR_PREFIX, Manifest, Shard, data_crc32c, safe_tag
+from .writer import AsyncCheckpointWriter
+
+
+def host_snapshot(tree):
+    """Device→host copy that OWNS its memory.
+
+    ``np.asarray(jax_array)`` may return a zero-copy VIEW of the device
+    buffer (CPU backend); with donated step buffers a later training
+    step would mutate the "snapshot" while the async writer is still
+    serializing it — the torn state would even pass its own CRC.  This
+    is the blocking half of the pipeline: call it under the
+    ``checkpoint.blocking`` span, then hand the result to save().
+    """
+    import jax
+    import numpy as np
+
+    def leaf(v):
+        if isinstance(v, jax.Array):
+            return np.array(v)              # materialize + own
+        if isinstance(v, (np.ndarray, np.generic)):
+            return np.array(v)
+        return v
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def _serialize_tree(tree) -> bytes:
+    """Serializer-format bytes, falling back to pickle for exotic leaves
+    (a checkpoint trigger must never kill the run — same contract as the
+    old in-optimizer fallback)."""
+    from ..utils.serializer import SerializationError, state_file_bytes
+    try:
+        return state_file_bytes(tree)
+    except SerializationError:
+        return pickle.dumps(tree, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _load_payload_file(path: str):
+    """Magic-byte routed load (same rationale as utils/file.load)."""
+    from ..utils.serializer import load_state_file
+    with open(path, "rb") as f:
+        head = f.read(2)
+    if head == b"PK":
+        return load_state_file(path)
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+class CheckpointManager:
+    def __init__(self, root: str, layout: str = "manifest",
+                 async_write: bool = True, keep_last: Optional[int] = None,
+                 keep_every_epochs: Optional[int] = None,
+                 recorder_fn: Optional[Callable] = None,
+                 max_pending: int = 2,
+                 process_index: int = 0, process_count: int = 1,
+                 part_timeout: float = 120.0):
+        if layout not in ("manifest", "file"):
+            raise ValueError(f"unknown checkpoint layout {layout!r}")
+        if keep_last is not None and keep_last < 1:
+            raise ValueError("keep_last must be >= 1")
+        if keep_every_epochs is not None and keep_every_epochs < 1:
+            raise ValueError("keep_every_epochs must be >= 1")
+        self.root = root
+        self.layout = layout
+        self.async_write = bool(async_write)
+        self.keep_last = keep_last
+        self.keep_every_epochs = keep_every_epochs
+        self.process_index = int(process_index)
+        self.process_count = int(process_count)
+        self.part_timeout = part_timeout
+        self._rec_fn = recorder_fn
+        os.makedirs(root, exist_ok=True)
+        # one writer even for sync saves: every write runs on the same
+        # thread, so writes+GC are serialized and FIFO-ordered
+        self.writer = AsyncCheckpointWriter(max_pending=max_pending,
+                                            recorder_fn=recorder_fn)
+
+    def _rec(self):
+        if self._rec_fn is None:
+            from ..observability import null_recorder
+            return null_recorder()
+        return self._rec_fn()
+
+    # -- save ------------------------------------------------------------ #
+    def save(self, payload, meta: Dict[str, Any], tag: str,
+             sync: bool = False):
+        """Queue one checkpoint.  ``payload`` must already be HOST data
+        (numpy leaves): for the "manifest" layout a ``{shard_name: tree}``
+        dict, for "file" an arbitrary state tree.  ``sync=True`` (or a
+        manager built with ``async_write=False``) blocks until the
+        checkpoint is committed."""
+        if self.layout == "manifest":
+            if not isinstance(payload, dict):
+                raise TypeError("manifest layout expects {shard_name: tree}")
+            trees = dict(payload)
+            job = lambda: self._write_manifest_ckpt(trees, dict(meta), tag)
+        else:
+            job = lambda: self._write_file_ckpt(payload, dict(meta), tag)
+        if sync or not self.async_write:
+            # raise THIS job's failure only — an earlier async write may
+            # have failed (by design without killing training) and its
+            # stale last_error must not poison an unrelated sync commit
+            box = {}
+
+            def tracked(job=job):
+                try:
+                    job()
+                except BaseException as e:
+                    box["err"] = e
+                    raise
+            self.writer.submit(tracked)
+            self.writer.wait()
+            if "err" in box:
+                raise box["err"]
+        else:
+            self.writer.submit(job)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Drain in-flight writes (the preemption handler's 'finish the
+        write' step and the pre-restore barrier)."""
+        return self.writer.wait(timeout)
+
+    def close(self, timeout: Optional[float] = None):
+        self.writer.close(timeout)
+
+    def _span_name(self) -> str:
+        return "checkpoint.async_write" if self.async_write \
+            else "checkpoint.write"
+
+    def _write_manifest_ckpt(self, trees, meta, tag):
+        rec = self._rec()
+        t0 = time.perf_counter()
+        faults.begin_save()
+        d = os.path.join(self.root, DIR_PREFIX + safe_tag(tag))
+        if self.process_count == 1 and os.path.isdir(d):
+            shutil.rmtree(d)        # stale torn leftover with the same tag
+        os.makedirs(d, exist_ok=True)
+        if self.process_count > 1:
+            # same-tag retry after a multi-host crash: remove THIS host's
+            # stale part FIRST, so host 0's merge cannot see a part until
+            # its owner has rewritten every shard it names (the part is
+            # re-written only after the shard loop below)
+            stale = os.path.join(d, f"{mlib.PART_PREFIX}"
+                                    f"{self.process_index}.json")
+            if os.path.exists(stale):
+                os.remove(stale)
+        names = sorted(trees)
+        shards, total = [], 0
+        for i, name in enumerate(names):
+            if i % self.process_count != self.process_index:
+                continue        # per-host shard ownership
+            data = _serialize_tree(trees[name])
+            fname = f"shard{i:04d}.bin"
+            fpath = os.path.join(d, fname)
+            if os.path.exists(fpath):
+                os.remove(fpath)
+            faults.guarded_write(fpath, data, kind="shard")
+            shards.append(Shard(name, fname, len(data), data_crc32c(data)))
+            total += len(data)
+        if total:
+            rec.inc("checkpoint/bytes_written", total)
+        faults.on_pre_manifest()
+        mf = Manifest(tag=str(tag), meta=meta, shards=shards,
+                      created=time.time())
+        if self.process_count > 1:
+            mlib.write_manifest_part(d, self.process_index, mf)
+            if self.process_index != 0:
+                return      # host 0 owns the commit + pointer + GC
+            mf = mlib.merge_manifest_parts(d, self.process_count,
+                                           timeout=self.part_timeout)
+            mlib.write_manifest(d, mf)
+        else:
+            mlib.write_manifest(d, mf)
+        mlib.write_latest_pointer(self.root, os.path.basename(d))
+        dt = time.perf_counter() - t0
+        rec.inc("checkpoint/committed")
+        rec.inc("checkpoint/write_seconds", dt)
+        rec.add_span(self._span_name(), dt)
+        self._gc_manifest(current=os.path.basename(d))
+
+    def _write_file_ckpt(self, state, meta, tag):
+        rec = self._rec()
+        t0 = time.perf_counter()
+        faults.begin_save()
+        path = os.path.join(self.root, f"checkpoint_{safe_tag(tag)}.bin")
+        data = _serialize_tree({"state": state, "meta": meta})
+        tmp = f"{path}.tmp-{os.getpid()}"
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        try:
+            faults.guarded_write(tmp, data, kind="shard")
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        mlib.fsync_dir(self.root)
+        # legacy pointer: the checkpoint FILE path (old tools read this)
+        mlib.write_latest_pointer(self.root, path)
+        dt = time.perf_counter() - t0
+        rec.inc("checkpoint/bytes_written", len(data))
+        rec.inc("checkpoint/committed")
+        rec.inc("checkpoint/write_seconds", dt)
+        rec.add_span(self._span_name(), dt)
+        self._gc_file(current=path)
+
+    # -- retention ------------------------------------------------------- #
+    def _gc_enabled(self) -> bool:
+        return (self.keep_last is not None
+                or self.keep_every_epochs is not None)
+
+    def _gc_manifest(self, current: str):
+        if not self._gc_enabled():
+            return
+        cands = mlib.scan(self.root, deep=False)
+        names = [os.path.basename(d) for d, _ in cands]
+        protect = {current}
+        ptr = mlib.read_latest_pointer(self.root)
+        if ptr:
+            protect.add(os.path.basename(ptr.rstrip("/")))
+        if self.keep_last:
+            protect.update(names[-self.keep_last:])
+        if self.keep_every_epochs:
+            for d, mf in cands:
+                ep = mf.meta.get("epoch")
+                if (mf.meta.get("epoch_boundary") and isinstance(ep, int)
+                        and ep % self.keep_every_epochs == 0):
+                    protect.add(os.path.basename(d))
+        for d, _ in cands:
+            if os.path.basename(d) not in protect:
+                shutil.rmtree(d, ignore_errors=True)
+        # torn leftovers (no valid manifest) from crashed writers.  Only
+        # single-writer roots: with multiple hosts, a manifest-less dir
+        # may be another host's save IN PROGRESS, not garbage
+        if self.process_count == 1:
+            intact = set(names)
+            for d in os.listdir(self.root):
+                full = os.path.join(self.root, d)
+                if (d.startswith(DIR_PREFIX) and os.path.isdir(full)
+                        and d not in intact and d not in protect):
+                    shutil.rmtree(full, ignore_errors=True)
+
+    def _gc_file(self, current: str):
+        if not self._gc_enabled() or not self.keep_last:
+            return
+        files = sorted(glob.glob(os.path.join(self.root,
+                                              "checkpoint_*.bin")),
+                       key=os.path.getmtime)
+        protect = {os.path.abspath(current)}
+        ptr = mlib.read_latest_pointer(self.root)
+        if ptr:
+            protect.add(os.path.abspath(ptr))
+        if self.keep_every_epochs:
+            for p in files:
+                m = re.search(r"checkpoint_epoch_(\d+)\.bin$", p)
+                if m and int(m.group(1)) % self.keep_every_epochs == 0:
+                    protect.add(os.path.abspath(p))
+        for p in files[:-self.keep_last]:
+            if os.path.abspath(p) not in protect:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+
+    # -- restore --------------------------------------------------------- #
+    def restore_latest(self) -> Optional[Tuple[str, Any, Dict]]:
+        """``("manifest", {shard: tree}, meta)`` or ``("file", state,
+        meta)`` for the newest intact checkpoint, else None.  Waits for
+        in-flight writes first, prefers the ``latest`` pointer's target
+        when it verifies, and otherwise scans — a torn newest checkpoint
+        falls back to the next intact one."""
+        self.wait()
+        # shallow scan for ordering; the expensive full-CRC pass runs
+        # per candidate below, so resume cost is O(restored checkpoint),
+        # not O(every checkpoint ever retained)
+        cands = mlib.scan(self.root, deep=False)
+        by_name = {os.path.basename(d): (d, mf) for d, mf in cands}
+        order = []
+        ptr = mlib.read_latest_pointer(self.root)
+        if ptr:
+            hit = by_name.get(os.path.basename(ptr.rstrip("/")))
+            if hit is not None:
+                order.append(hit)
+        order.extend(c for c in reversed(cands)
+                     if not order or c[0] != order[0][0])
+        for d, mf in order:
+            problems = mlib.verify(d, mf, deep=True)
+            if problems:
+                print(f"[checkpoint] {d}: {problems[0]}; trying older "
+                      "checkpoints")
+                continue
+            try:
+                trees = {s.name: _load_payload_file(os.path.join(d, s.file))
+                         for s in mf.shards}
+            except Exception as e:      # CRC passed but decode failed
+                print(f"[checkpoint] {d}: unreadable despite manifest "
+                      f"({e!r}); trying older checkpoints")
+                continue
+            return ("manifest", trees, dict(mf.meta))
+        return self._restore_legacy_file()
+
+    def _restore_legacy_file(self):
+        paths = []
+        ptr = mlib.read_latest_pointer(self.root)
+        if ptr and not ptr.startswith(DIR_PREFIX):
+            for cand in (ptr, os.path.join(self.root,
+                                           os.path.basename(ptr))):
+                if os.path.isfile(cand):
+                    paths.append(os.path.abspath(cand))
+                    break
+        # dangling/corrupt pointer (or none): newest intact file wins
+        scanned = sorted(glob.glob(os.path.join(self.root,
+                                                "checkpoint_*.bin")),
+                         key=os.path.getmtime, reverse=True)
+        paths.extend(p for p in (os.path.abspath(s) for s in scanned)
+                     if p not in paths)
+        for p in paths:
+            try:
+                blob = _load_payload_file(p)
+                state, meta = blob["state"], blob["meta"]
+            except Exception as e:
+                print(f"[checkpoint] {p}: torn or corrupt ({e!r}); "
+                      "trying older checkpoints")
+                continue
+            return ("file", state, dict(meta))
+        return None

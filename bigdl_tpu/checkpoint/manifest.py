@@ -1,0 +1,264 @@
+"""Atomic checkpoint manifests (the commit protocol's source of truth).
+
+A checkpoint is a directory ``ckpt_<tag>/`` holding shard files plus one
+``MANIFEST.json`` listing every shard with its byte size and masked
+CRC32C.  The manifest is written LAST, via tmp + ``os.replace`` +
+directory fsync — so a checkpoint either has a valid manifest naming
+shards whose checksums verify, or it does not exist.  There is no state
+in which a torn shard can be mistaken for committed data (≙ the
+reference's reliance on HDFS rename atomicity for checkpoint commits,
+made explicit and CRC-verified).
+
+Multi-host writers (parallel/spmd.py) each contribute a
+``MANIFEST.partK.json`` covering the shards they own; host 0 merges the
+parts into the final ``MANIFEST.json``, which remains the single commit
+point for the whole checkpoint.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.crc32c import mask
+
+FORMAT = "bigdl_tpu.checkpoint"
+VERSION = 1
+MANIFEST_NAME = "MANIFEST.json"
+PART_PREFIX = "MANIFEST.part"
+DIR_PREFIX = "ckpt_"
+LATEST_NAME = "latest"
+
+
+class CheckpointError(RuntimeError):
+    """A checkpoint directory is missing, torn, or fails verification."""
+
+
+def data_crc32c(data: bytes) -> int:
+    """Masked CRC32C of a byte string (native fast path when available)."""
+    from ..native import crc32c as _crc
+    return mask(_crc(data))
+
+
+def file_crc32c(path: str, chunk: int = 1 << 20) -> int:
+    """Masked CRC32C of a file's contents, streamed in chunks."""
+    from ..native import crc32c as _crc
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(chunk)
+            if not block:
+                break
+            crc = _crc(block, crc)
+    return mask(crc)
+
+
+def safe_tag(tag: str) -> str:
+    """Filesystem-safe checkpoint tag."""
+    return re.sub(r"[^A-Za-z0-9_.+-]", "_", str(tag)) or "untagged"
+
+
+@dataclass
+class Shard:
+    name: str          # logical shard name ("params/fc1", "opt_state", ...)
+    file: str          # file name inside the checkpoint directory
+    bytes: int
+    crc32c: int        # masked CRC32C of the file contents
+
+    def to_json(self):
+        return {"name": self.name, "file": self.file,
+                "bytes": int(self.bytes), "crc32c": int(self.crc32c)}
+
+    @staticmethod
+    def from_json(d):
+        try:
+            return Shard(str(d["name"]), str(d["file"]), int(d["bytes"]),
+                         int(d["crc32c"]))
+        except (KeyError, TypeError, ValueError) as e:
+            raise CheckpointError(f"malformed shard entry {d!r}") from e
+
+
+@dataclass
+class Manifest:
+    tag: str
+    meta: Dict = field(default_factory=dict)
+    shards: List[Shard] = field(default_factory=list)
+    created: float = 0.0
+
+    def to_json(self):
+        return {"format": FORMAT, "version": VERSION, "tag": self.tag,
+                "created": self.created, "meta": self.meta,
+                "shards": [s.to_json() for s in self.shards]}
+
+    @staticmethod
+    def from_json(d, where=""):
+        if not isinstance(d, dict) or d.get("format") != FORMAT:
+            raise CheckpointError(f"{where}: not a checkpoint manifest")
+        if d.get("version", 0) > VERSION:
+            raise CheckpointError(
+                f"{where}: unsupported manifest version {d.get('version')}")
+        return Manifest(str(d.get("tag", "")), dict(d.get("meta", {})),
+                        [Shard.from_json(s) for s in d.get("shards", [])],
+                        float(d.get("created", 0.0)))
+
+    def sort_key(self) -> Tuple:
+        """Newest-checkpoint ordering: training position, then wall time."""
+        it = self.meta.get("iteration", self.meta.get("step", -1))
+        try:
+            it = int(it)
+        except (TypeError, ValueError):
+            it = -1
+        return (it, self.created)
+
+
+def fsync_dir(path: str):
+    """Flush a directory entry (the rename itself) to stable storage."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return      # e.g. platforms without O_RDONLY dirs; best effort
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _write_json_atomic(path: str, obj, kind: str):
+    """tmp (fault-injectable, fsync'ed) + os.replace + dir fsync."""
+    from . import faults
+    data = json.dumps(obj, sort_keys=True).encode()
+    tmp = f"{path}.tmp-{os.getpid()}"
+    if os.path.exists(tmp):
+        os.remove(tmp)
+    try:
+        faults.guarded_write(tmp, data, kind=kind)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+    fsync_dir(os.path.dirname(path) or ".")
+
+
+def write_manifest(ckpt_dir: str, manifest: Manifest):
+    """Commit a checkpoint: the manifest write IS the commit point."""
+    _write_json_atomic(os.path.join(ckpt_dir, MANIFEST_NAME),
+                       manifest.to_json(), kind="manifest")
+
+
+def write_manifest_part(ckpt_dir: str, part_index: int, manifest: Manifest):
+    """One host's contribution (its owned shards); NOT a commit."""
+    _write_json_atomic(
+        os.path.join(ckpt_dir, f"{PART_PREFIX}{part_index}.json"),
+        manifest.to_json(), kind="manifest_part")
+
+
+def merge_manifest_parts(ckpt_dir: str, n_parts: int,
+                         timeout: float = 120.0,
+                         poll: float = 0.05) -> Manifest:
+    """Host 0: wait for every part (shared filesystem), merge shard lists,
+    and return the merged manifest (caller commits it via write_manifest).
+    """
+    paths = [os.path.join(ckpt_dir, f"{PART_PREFIX}{i}.json")
+             for i in range(n_parts)]
+    deadline = time.monotonic() + timeout
+    while any(not os.path.exists(p) for p in paths):
+        if time.monotonic() >= deadline:
+            missing = [p for p in paths if not os.path.exists(p)]
+            raise CheckpointError(
+                f"{ckpt_dir}: timed out waiting for manifest parts "
+                f"{[os.path.basename(m) for m in missing]}")
+        time.sleep(poll)
+    merged: Optional[Manifest] = None
+    for p in paths:
+        with open(p) as f:
+            part = Manifest.from_json(json.load(f), where=p)
+        if merged is None:
+            merged = part
+        else:
+            merged.shards.extend(part.shards)
+    merged.shards.sort(key=lambda s: s.name)
+    return merged
+
+
+def read_manifest(ckpt_dir: str) -> Manifest:
+    path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    if not os.path.exists(path):
+        raise CheckpointError(f"{ckpt_dir}: no manifest (uncommitted or "
+                              "torn checkpoint)")
+    try:
+        with open(path) as f:
+            return Manifest.from_json(json.load(f), where=path)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointError(f"{ckpt_dir}: unreadable manifest ({e})") from e
+
+
+def verify(ckpt_dir: str, manifest: Manifest, deep: bool = True) -> List[str]:
+    """Return the list of problems (empty == intact).  ``deep`` re-hashes
+    every shard file; shallow checks existence + byte size only."""
+    problems = []
+    for s in manifest.shards:
+        p = os.path.join(ckpt_dir, s.file)
+        if not os.path.exists(p):
+            problems.append(f"missing shard {s.file}")
+            continue
+        size = os.path.getsize(p)
+        if size != s.bytes:
+            problems.append(f"shard {s.file}: {size} bytes, manifest says "
+                            f"{s.bytes}")
+            continue
+        if deep and file_crc32c(p) != s.crc32c:
+            problems.append(f"shard {s.file}: CRC32C mismatch")
+    return problems
+
+
+def scan(root: str, deep: bool = True) -> List[Tuple[str, Manifest]]:
+    """All INTACT checkpoints under ``root``, sorted oldest → newest.
+
+    A directory without a valid manifest, or whose shards fail
+    verification, is skipped — it does not exist as a checkpoint.
+    """
+    if not os.path.isdir(root):
+        return []
+    out = []
+    for d in os.listdir(root):
+        full = os.path.join(root, d)
+        if not (d.startswith(DIR_PREFIX) and os.path.isdir(full)):
+            continue
+        try:
+            mf = read_manifest(full)
+        except CheckpointError:
+            continue
+        if verify(full, mf, deep=deep):
+            continue
+        out.append((full, mf))
+    out.sort(key=lambda e: e[1].sort_key())
+    return out
+
+
+def read_latest_pointer(root: str) -> Optional[str]:
+    """Contents of the ``latest`` pointer file, or None.  The pointer is
+    an optimization only — resume falls back to scanning when it is
+    dangling or corrupt."""
+    path = os.path.join(root, LATEST_NAME)
+    try:
+        with open(path) as f:
+            return f.read().strip() or None
+    except (OSError, UnicodeDecodeError):
+        return None      # missing or corrupt pointer: caller scans
+
+
+def write_latest_pointer(root: str, value: str):
+    """Atomically update the ``latest`` pointer (tmp + os.replace)."""
+    path = os.path.join(root, LATEST_NAME)
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(value)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(root)

@@ -1,0 +1,96 @@
+"""Background checkpoint writer: the async half of the snapshot pipeline.
+
+The training loop's only blocking work is the device→host copy; the
+serialize + CRC + write + commit runs here, on one daemon thread, in
+submission order (FIFO — commit order matches training order, so
+"newest intact manifest" is always the newest submitted state that
+finished).  ``max_pending`` bounds the host-memory footprint: submitting
+while that many snapshots are queued/in-flight blocks the caller — the
+same backpressure contract as the serving queue.
+
+A failed write must never kill training (≙ the old pickle-fallback
+rationale): errors are stored on ``last_error``, counted on the
+recorder, and printed; :meth:`wait` returns whether everything flushed.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import traceback
+from typing import Callable, Optional
+
+
+class AsyncCheckpointWriter:
+    def __init__(self, max_pending: int = 2, recorder_fn=None,
+                 name: str = "bigdl-ckpt-writer"):
+        self._jobs = collections.deque()
+        self._cv = threading.Condition()
+        self._pending = 0           # queued + running
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._name = name
+        self.max_pending = max(1, int(max_pending))
+        self.last_error: Optional[BaseException] = None
+        self._rec_fn = recorder_fn
+
+    def _rec(self):
+        if self._rec_fn is None:
+            from ..observability import null_recorder
+            return null_recorder()
+        return self._rec_fn()
+
+    def submit(self, job: Callable[[], None]):
+        """Enqueue one checkpoint job; blocks when ``max_pending``
+        snapshots are already in flight (backpressure, not data loss)."""
+        with self._cv:
+            if self._closed:
+                raise RuntimeError("checkpoint writer is closed")
+            while self._pending >= self.max_pending:
+                self._cv.wait()
+            self._jobs.append(job)
+            self._pending += 1
+            self._rec().gauge("checkpoint/in_flight", self._pending)
+            if self._thread is None:
+                # daemon: a hung filesystem must not block process exit
+                self._thread = threading.Thread(target=self._run,
+                                                name=self._name, daemon=True)
+                self._thread.start()
+            self._cv.notify_all()
+
+    def _run(self):
+        while True:
+            with self._cv:
+                while not self._jobs and not self._closed:
+                    self._cv.wait()
+                if not self._jobs:
+                    return          # closed and drained
+                job = self._jobs.popleft()
+            try:
+                job()
+            except BaseException as e:       # noqa: BLE001 — must survive
+                self.last_error = e
+                self._rec().inc("checkpoint/failed")
+                print(f"[checkpoint] async write failed: {e!r}")
+                traceback.print_exc()
+            finally:
+                with self._cv:
+                    self._pending -= 1
+                    self._rec().gauge("checkpoint/in_flight", self._pending)
+                    self._cv.notify_all()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until every submitted job finished; True when drained."""
+        with self._cv:
+            self._cv.wait_for(lambda: self._pending == 0, timeout)
+            return self._pending == 0
+
+    def close(self, timeout: Optional[float] = None):
+        """Drain in-flight writes, then stop the thread (preemption path:
+        finish the write, never abandon it)."""
+        self.wait(timeout)
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)

@@ -298,6 +298,17 @@ class Recorder:
             s.emit(rec)
         return rec
 
+    def emit_record(self, rec_type: str, **fields):
+        """Emit an out-of-band (non-step) record to every sink — e.g.
+        the post-drain ``checkpoint_summary`` whose writer-thread
+        counters finished after the last step record was cut."""
+        if not self._enabled:
+            return None
+        rec = {"type": rec_type, "time": time.time(), **fields}
+        for s in list(self.sinks):
+            s.emit(rec)
+        return rec
+
     def abort_step(self):
         """Discard the pending step (e.g. the data iterator ran dry after
         ``start_step``); pending spans/scalars are dropped."""

@@ -19,7 +19,6 @@ failure-recovery analogue of DistriOptimizer's retry-from-cache
 from __future__ import annotations
 
 import os
-import pickle
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
@@ -322,9 +321,11 @@ class Optimizer:
         self.val_trigger: Optional[Trigger] = None
         self.val_dataset: Optional[DataSet] = None
         self.val_methods: Optional[List[ValidationMethod]] = None
-        # checkpoint
+        # checkpoint (bigdl_tpu.checkpoint subsystem)
         self.checkpoint_path: Optional[str] = None
         self.checkpoint_trigger: Optional[Trigger] = None
+        self._ckpt_mgr = None
+        self._preemption = None
         # summaries
         self.train_summary = None
         self.val_summary = None
@@ -366,10 +367,31 @@ class Optimizer:
         self.val_methods = list(methods)
         return self
 
-    def set_checkpoint(self, path, trigger=None):
+    def set_checkpoint(self, path, trigger=None, layout="manifest",
+                       async_write=True, keep_last=None,
+                       keep_every_epochs=None, handle_preemption=False):
+        """Checkpoint into ``path`` whenever ``trigger`` fires (default:
+        every epoch), via the :mod:`bigdl_tpu.checkpoint` subsystem:
+        sharded CRC32C-verified files committed by an atomic manifest,
+        written by a background thread (``async_write``) so only the
+        device→host copy blocks the step loop.  ``keep_last`` /
+        ``keep_every_epochs`` configure retention GC (default: keep
+        everything).  ``layout="file"`` keeps the legacy single-file
+        format (still with an atomic ``latest`` pointer, and resume
+        tolerates a dangling/corrupt pointer by scanning).
+        ``handle_preemption`` installs a SIGTERM handler: a preempted
+        run finishes the in-flight write, emits a final checkpoint, and
+        ``optimize()`` returns cleanly."""
+        from ..checkpoint import CheckpointManager, PreemptionHandler
         self.checkpoint_path = path
         self.checkpoint_trigger = trigger or Trigger.every_epoch()
         os.makedirs(path, exist_ok=True)
+        self._ckpt_mgr = CheckpointManager(
+            path, layout=layout, async_write=async_write,
+            keep_last=keep_last, keep_every_epochs=keep_every_epochs,
+            recorder_fn=self._rec)
+        if handle_preemption:
+            self._preemption = PreemptionHandler().install()
         return self
 
     def set_train_summary(self, summary):
@@ -449,20 +471,52 @@ class Optimizer:
         self._grad_clip_const = (min_v, max_v)
         return self
 
-    # -- checkpointing (≙ Optimizer.saveCheckpoint / resume) ------------- #
-    def save_checkpoint(self, params, opt_state, model_state, tag=None):
+    # -- checkpointing (≙ Optimizer.saveCheckpoint / resume; the heavy
+    # lifting lives in bigdl_tpu.checkpoint) ----------------------------- #
+    def _ckpt_manager(self):
+        if self._ckpt_mgr is None:
+            from ..checkpoint import CheckpointManager
+            self._ckpt_mgr = CheckpointManager(self.checkpoint_path,
+                                               recorder_fn=self._rec)
+        return self._ckpt_mgr
+
+    @staticmethod
+    def _ckpt_shards(host):
+        """Split (params, opt_state, model_state) into named shards —
+        params per top-level module, so shard files stay bounded and a
+        torn write can only tear one file."""
+        params, opt_state, model_state = host
+        shards = {"opt_state": opt_state, "model_state": model_state}
+        if isinstance(params, dict) and params:
+            for mod, sub in params.items():
+                shards[f"params/{mod}"] = sub
+        else:
+            shards["params"] = params
+        return shards
+
+    @staticmethod
+    def _ckpt_unshard(trees):
+        if "params" in trees:
+            params = trees["params"]
+        else:
+            params = {k[len("params/"):]: v for k, v in trees.items()
+                      if k.startswith("params/")}
+        return (params, trees.get("opt_state"), trees.get("model_state"))
+
+    def save_checkpoint(self, params, opt_state, model_state, tag=None,
+                        sync=False, epoch_boundary=False):
         if self.checkpoint_path is None:
             return
-        with self._rec().span("checkpoint"):
-            self._save_checkpoint_inner(params, opt_state, model_state, tag)
-
-    def _save_checkpoint_inner(self, params, opt_state, model_state,
-                               tag=None):
-        from ..utils.serializer import (SerializationError, _to_host,
-                                        save_state_file)
+        from ..checkpoint.manager import host_snapshot
+        mgr = self._ckpt_manager()
         tag = tag or f"iter_{self.state.iteration}"
-        path = os.path.join(self.checkpoint_path, f"checkpoint_{tag}.bin")
-        host = _to_host((params, opt_state, model_state))
+        # the only work on the step loop: an OWNING device→host copy of
+        # the live state (serialize + CRC + write + commit run on the
+        # writer thread; `checkpoint/*` counters and the in-flight gauge
+        # track it).  host_snapshot, not a view: the step loop donates
+        # these buffers and would mutate a lazy copy mid-write.
+        with self._rec().span("checkpoint.blocking"):
+            host = host_snapshot((params, opt_state, model_state))
         # iterator position + loop rng make mid-epoch resume EXACT: the
         # epoch-seeded shuffle reproduces the order, batch_in_epoch says
         # where to skip to, rng reproduces the per-step dropout keys
@@ -470,45 +524,39 @@ class Optimizer:
         meta = {"epoch": self.state.epoch, "iteration": self.state.iteration,
                 "batch_in_epoch": self.state.batch_in_epoch,
                 "rng": None if getattr(self, "_loop_rng", None) is None
-                else np.asarray(self._loop_rng).tolist()}
-        try:
-            save_state_file({"state": host, "meta": meta}, path)
-        except SerializationError:
-            # exotic leaves in a custom OptimMethod's state: a checkpoint
-            # trigger must never kill the run — fall back to pickle (which
-            # load_checkpoint still reads)
-            with open(path, "wb") as f:
-                pickle.dump({"state": host, "meta": meta}, f)
-        latest = os.path.join(self.checkpoint_path, "latest")
-        with open(latest, "w") as f:
-            f.write(path)
+                else np.asarray(self._loop_rng).tolist(),
+                "epoch_boundary": bool(epoch_boundary)}
+        payload = self._ckpt_shards(host) if mgr.layout == "manifest" \
+            else host
+        mgr.save(payload, meta, tag, sync=sync)
 
     def load_checkpoint(self):
-        from ..utils.serializer import load_state_file
-        latest = os.path.join(self.checkpoint_path, "latest")
-        if not os.path.exists(latest):
+        """Restore the newest INTACT checkpoint (manifest or legacy file
+        layout): manifests are CRC-verified, a torn newest checkpoint
+        falls back to the previous intact one, and a dangling/corrupt
+        ``latest`` pointer degrades to a directory scan."""
+        restored = self._ckpt_manager().restore_latest()
+        if restored is None:
             return None
-        with open(latest) as f:
-            path = f.read().strip()
-        with open(path, "rb") as f:
-            head = f.read(2)
-        if head == b"PK":   # magic-byte routing, same rationale as file.load
-            blob = load_state_file(path)
-        else:  # legacy round-1/2 (or fallback) pickle checkpoint
-            with open(path, "rb") as f:
-                blob = pickle.load(f)
-        self.state.epoch = blob["meta"]["epoch"]
-        self.state.iteration = blob["meta"]["iteration"]
-        self.state.batch_in_epoch = blob["meta"].get("batch_in_epoch", 0)
+        kind, payload, meta = restored
+        state = self._ckpt_unshard(payload) if kind == "manifest" \
+            else payload
+        self.state.epoch = meta["epoch"]
+        self.state.iteration = meta["iteration"]
+        self.state.batch_in_epoch = meta.get("batch_in_epoch", 0)
         self._resume_skip = self.state.batch_in_epoch
-        rng_saved = blob["meta"].get("rng")
+        rng_saved = meta.get("rng")
         self._resume_rng = None if rng_saved is None else \
             jnp.asarray(np.asarray(rng_saved, np.uint32))
-        restored = migrate_legacy_names(blob["state"], self.model)
+        restored = migrate_legacy_names(state, self.model)
+        # jnp.array(copy=True), NOT jnp.asarray: asarray can zero-copy an
+        # ALIGNED numpy buffer (alignment of np.load output varies with
+        # the zip layout), and the first train step DONATES these leaves —
+        # donating a buffer jax doesn't own lets XLA scribble over it and
+        # corrupts the resumed state (seen as 1e9-garbage Adam moments)
         return jax.tree_util.tree_map(
-            lambda v: jnp.asarray(v) if isinstance(v, (np.ndarray,
-                                                       np.generic,
-                                                       jax.Array))
+            lambda v: jnp.array(v, copy=True)
+            if isinstance(v, (np.ndarray, np.generic, jax.Array))
             else v, restored)
 
     # -- validation ------------------------------------------------------ #
@@ -632,11 +680,13 @@ class Optimizer:
         retries = 0
         while not stop:
             if self.max_retries:
-                # end-of-epoch snapshot for failure recovery (host copies:
-                # device buffers may be donated/invalid after a fault)
+                # end-of-epoch snapshot for failure recovery (OWNING host
+                # copies: device buffers may be donated/invalid after a
+                # fault, and np.asarray views would be scribbled over by
+                # the donating step loop — see checkpoint.host_snapshot)
+                from ..checkpoint.manager import host_snapshot
                 self._retry_cache = (
-                    jax.tree_util.tree_map(np.asarray,
-                                           (params, opt_state, model_state)),
+                    host_snapshot((params, opt_state, model_state)),
                     self.state.epoch, self.state.iteration, rng)
             try:
                 params, opt_state, model_state, rng, step_fn, stop = \
@@ -666,15 +716,30 @@ class Optimizer:
                     print(f"[retry {retries}/{self.max_retries}] epoch "
                           f"{self.state.epoch} failed ({e!r}); restoring "
                           "cached state")
+                    # jax-owned copies: the next step donates these (see
+                    # load_checkpoint's zero-copy/donation note)
                     params, opt_state, model_state = jax.tree_util.tree_map(
-                        jnp.asarray, host)
+                        lambda v: jnp.array(v, copy=True)
+                        if isinstance(v, (np.ndarray, np.generic))
+                        else v, host)
                     self.state.epoch = epoch
                     self.state.iteration = iteration
                     self.state.batch_in_epoch = 0
                     self._resume_skip = 0
 
         self.model.set_params(self._params_for_eval(params), model_state)
-        self._rec().flush()
+        rec = self._rec()
+        if self._ckpt_mgr is not None:
+            # drain the async writer: when optimize() returns, every
+            # triggered checkpoint is committed and durable
+            self._ckpt_mgr.wait()
+            # commits that landed after the last step record was cut
+            # would otherwise be invisible to the sinks
+            ck = {k: v for k, v in rec.snapshot()["counters"].items()
+                  if k.startswith("checkpoint/")}
+            if ck:
+                rec.emit_record("checkpoint_summary", counters=ck)
+        rec.flush()
         return self.model
 
     def _run_epoch(self, params, opt_state, model_state, rng, step_fn,
@@ -807,7 +872,8 @@ class Optimizer:
             if (self.checkpoint_trigger is not None
                     and self.checkpoint_trigger(self.state)):
                 self.save_checkpoint(params, opt_state, model_state,
-                                     tag=f"epoch_{self.state.epoch}")
+                                     tag=f"epoch_{self.state.epoch}",
+                                     epoch_boundary=True)
             # metric-driven schedules (Plateau): factor changes are host
             # state baked into the trace, so a change forces a re-jit
             sched = getattr(self.optim_method, "schedule", None)
@@ -857,6 +923,16 @@ class Optimizer:
     def _fire_mid_epoch(self, params, opt_state, model_state) -> bool:
         """iteration-level triggers; returns True if training should end."""
         st = self.state
+        if (self._preemption is not None and self._preemption.requested
+                and self.checkpoint_path is not None):
+            # SIGTERM: finish any in-flight async write, commit a final
+            # checkpoint synchronously, and stop the loop cleanly
+            self.save_checkpoint(params, opt_state, model_state,
+                                 tag=f"preempt_iter_{st.iteration}",
+                                 sync=True)
+            print(f"[preemption] final checkpoint at iteration "
+                  f"{st.iteration} committed; stopping cleanly", flush=True)
+            return True
         if self.val_trigger is not None and not isinstance(
                 self.val_trigger, type(Trigger.every_epoch())) \
                 and self.val_trigger(st):

@@ -1,11 +1,14 @@
 """Triggers gating validation/checkpoint/summary/termination
 (≙ optim/Trigger.scala: everyEpoch, severalIteration, maxEpoch, maxIteration,
-maxScore, minLoss, and, or).
+maxScore, minLoss, and, or — plus everySeconds, the wall-clock checkpoint
+cadence production jobs actually use).
 
 A trigger is `apply(state) -> bool` where state is the optimizer's host-side
 TrainingState (epoch, iteration ["neval"], loss, score).
 """
 from __future__ import annotations
+
+import time
 
 
 class Trigger:
@@ -37,6 +40,14 @@ class Trigger:
         return _MinLoss(min_loss)
 
     @staticmethod
+    def every_seconds(seconds, _clock=time.monotonic):
+        """Fire when at least ``seconds`` of wall time passed since the
+        last firing (armed at construction) — the common production
+        checkpoint cadence: step time varies with compile/stragglers,
+        but the recovery budget is measured in minutes lost."""
+        return _EverySeconds(seconds, _clock)
+
+    @staticmethod
     def and_(*triggers):
         return _And(triggers)
 
@@ -62,6 +73,24 @@ class _SeveralIteration(Trigger):
 
     def __call__(self, state):
         return state.iteration > 0 and state.iteration % self.interval == 0
+
+
+class _EverySeconds(Trigger):
+    def __init__(self, seconds, clock):
+        if seconds <= 0:
+            raise ValueError("every_seconds interval must be > 0")
+        self.seconds = float(seconds)
+        self._clock = clock
+        self._last = clock()
+
+    def __call__(self, state):
+        now = self._clock()
+        if now - self._last >= self.seconds:
+            # advance to NOW (not by one interval): a long stall must not
+            # cause a burst of back-to-back catch-up checkpoints
+            self._last = now
+            return True
+        return False
 
 
 class _MaxEpoch(Trigger):

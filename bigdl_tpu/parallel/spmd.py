@@ -98,6 +98,8 @@ class SpmdTrainer:
         self._with_health = False
         self._hlo_accounted = False
         self._seen_sigs = set()
+        self._ckpt_layout = "orbax"
+        self._ckpt_mgr = None
 
     # ------------------------------------------------------------------ #
     def _param_shardings(self, params):
@@ -361,16 +363,72 @@ class SpmdTrainer:
         return res
 
     # -- checkpointing --------------------------------------------------- #
-    def save_checkpoint(self, path: str):
-        """Write params + optimizer state + step counter as an orbax
-        checkpoint directory.  Sharded jax Arrays are handed to orbax
-        directly (``to_host=False``) so fsdp state is written shard-wise
-        without materialising an unsharded host copy; any orbax
-        StandardCheckpointer can read it.  ≙ Optimizer.setCheckpoint for
-        the compiler-partitioned flagship path."""
+    def _manifest_manager(self, path, keep=None, async_write=True):
+        """CheckpointManager for this trainer with per-host shard
+        ownership: shards are assigned round-robin over hosts by sorted
+        shard name, each process snapshots and writes only the shards it
+        owns, and host 0 merges the per-host part manifests into the
+        single atomic MANIFEST.json commit (shared filesystem)."""
+        from ..checkpoint import CheckpointManager
+        mgr = self._ckpt_mgr
+        if mgr is None or mgr.root != path:
+            mgr = CheckpointManager(
+                path, layout="manifest", async_write=async_write,
+                keep_last=keep, recorder_fn=self._rec,
+                process_index=jax.process_index(),
+                process_count=jax.process_count())
+            self._ckpt_mgr = mgr
+        return mgr
+
+    def _save_manifest_checkpoint(self, path: str, sync: bool = False,
+                                  keep=None, async_write=True):
+        """Async sharded checkpoint via bigdl_tpu.checkpoint: params per
+        top-level module + opt_state as CRC32C'd shards committed by an
+        atomic manifest.  Only the blocking device→host copy of the
+        OWNED shards runs on the step loop."""
+        from ..checkpoint.manager import host_snapshot
+        if self.params is None:
+            raise ValueError("trainer not initialized; call init() first")
+        mgr = self._manifest_manager(path, keep=keep,
+                                     async_write=async_write)
+        logical = {f"params/{mod}": sub
+                   for mod, sub in self.params.items()}
+        logical["opt_state"] = self.opt_state
+        names = sorted(logical)
+        with self._rec().span("checkpoint.blocking"):
+            # snapshot ONLY the shards this host owns (round-robin by
+            # sorted name — the same assignment the manager applies);
+            # unowned entries stay None placeholders that keep shard
+            # indices aligned across hosts and are never serialized
+            shards = {
+                name: (host_snapshot(logical[name])
+                       if i % mgr.process_count == mgr.process_index
+                       else None)
+                for i, name in enumerate(names)}
+        meta = {"step": self._step_count, "seed": self.seed,
+                "root": self.model.name}
+        mgr.save(shards, meta, tag=f"step_{self._step_count}", sync=sync)
+
+    def save_checkpoint(self, path: str, layout: Optional[str] = None,
+                        sync: bool = False):
+        """Write params + optimizer state + step counter.
+
+        ``layout="manifest"`` (or ``set_checkpoint(...,
+        layout="manifest")``) uses the bigdl_tpu.checkpoint subsystem:
+        async sharded writes, atomic manifest commit, CRC-verified
+        resume.  The default ``"orbax"`` layout keeps the
+        ecosystem-readable orbax directory: sharded jax Arrays are
+        handed to orbax directly (``to_host=False``) so fsdp state is
+        written shard-wise without materialising an unsharded host
+        copy.  ≙ Optimizer.setCheckpoint for the compiler-partitioned
+        flagship path."""
         import json
         import os
         from ..utils.serializer import save_pytree
+        if layout is None:
+            layout = self._ckpt_layout
+        if layout == "manifest":
+            return self._save_manifest_checkpoint(path, sync=sync)
         if self.params is None:
             raise ValueError("trainer not initialized; call init() first")
         # step-tagged snapshot + atomic 'latest' pointer (same crash-safe
@@ -407,12 +465,22 @@ class SpmdTrainer:
         """Restore a save_checkpoint directory into this trainer: arrays
         come back on device with this trainer's shardings, and the step
         counter AND seed resume, so the data-order/dropout RNG stream
-        continues exactly as in the uninterrupted run."""
+        continues exactly as in the uninterrupted run.  Manifest-layout
+        checkpoints (CRC-verified, torn-checkpoint fallback) are tried
+        first; the orbax layout remains readable."""
         import json
         import os
         from ..utils.serializer import load_pytree
         if self.params is None:
             self.init()
+        restored = self._manifest_manager(path).restore_latest()
+        if restored is not None and restored[0] == "manifest":
+            _, trees, meta = restored
+            raw = {"params": {k[len("params/"):]: v
+                              for k, v in trees.items()
+                              if k.startswith("params/")},
+                   "opt_state": trees["opt_state"]}
+            return self._finish_restore(raw, meta, path)
         latest = os.path.join(path, "latest")
         if os.path.exists(latest):
             with open(latest) as f:
@@ -429,6 +497,11 @@ class SpmdTrainer:
         with open(os.path.join(root, "meta.json")) as f:
             meta = json.load(f)
         raw = load_pytree(os.path.join(root, "state"))
+        return self._finish_restore(raw, meta, path)
+
+    def _finish_restore(self, raw, meta, path):
+        """Validate a raw {params, opt_state} tree against this trainer
+        and place it: shared tail of the manifest and orbax loaders."""
         raw = self._rekey_root(raw, meta.get("root", self.model.name),
                                self.model.name)
         template = {"params": self.params, "opt_state": self.opt_state}
@@ -456,28 +529,51 @@ class SpmdTrainer:
         raw = jax.tree_util.tree_map_with_path(
             lambda w, v, t: check(v, t, w), raw, template)
         shardings = self._param_shardings(self.params)
+        # place-then-own: device_put shards the host leaf during the
+        # transfer (no full-size unsharded device intermediate — the
+        # property the orbax save path promises), and the sharded
+        # jnp.array(copy=True) guarantees jax-owned buffers — device_put
+        # of an aligned numpy array can be zero-copy on CPU, and params
+        # are donated every step
         self.params = jax.tree_util.tree_map(
-            jax.device_put, raw["params"], shardings)
-        # opt-state leaves stay UNCOMMITTED (plain jnp.asarray): at init
-        # they come out of jit the same way, and the next step call's jit
-        # dispatch places them against the params' shardings without the
-        # committed-device conflicts an explicit device_put would cause
+            lambda v, s: jnp.array(jax.device_put(np.asarray(v), s),
+                                   copy=True),
+            raw["params"], shardings)
+        # opt-state leaves stay UNCOMMITTED: at init they come out of jit
+        # the same way, and the next step call's jit dispatch places them
+        # against the params' shardings without the committed-device
+        # conflicts an explicit device_put would cause.  copy=True, not
+        # asarray: a zero-copy alias of the loader's numpy buffer must
+        # never reach the donating step (see Optimizer.load_checkpoint)
         self.opt_state = jax.tree_util.tree_map(
-            lambda v: jnp.asarray(np.asarray(v)), raw["opt_state"])
+            lambda v: jnp.array(np.asarray(v), copy=True),
+            raw["opt_state"])
         self._step_count = meta["step"]
         self.seed = meta.get("seed", self.seed)
         return self
 
     def set_checkpoint(self, path: str, every_steps: int = 1000,
-                       keep: int = 3):
+                       keep: int = 3, layout: str = "orbax",
+                       async_write: bool = True):
         """Checkpoint every ``every_steps`` steps during fit(), retaining
         the newest ``keep`` snapshots (0 = keep all)
-        (≙ Optimizer.setCheckpoint with a several_iteration trigger)."""
+        (≙ Optimizer.setCheckpoint with a several_iteration trigger).
+        ``layout="manifest"`` routes through bigdl_tpu.checkpoint:
+        background sharded writes with per-host shard ownership and an
+        atomic CRC-verified manifest commit; retention then runs in the
+        manager's GC."""
         if every_steps < 1:
             raise ValueError("every_steps must be >= 1")
         if keep < 0:
             raise ValueError("keep must be >= 0")
+        if layout not in ("orbax", "manifest"):
+            raise ValueError(f"unknown checkpoint layout {layout!r}")
         self._ckpt = (path, int(every_steps), int(keep))
+        self._ckpt_layout = layout
+        if layout == "manifest":
+            self._ckpt_mgr = None       # rebuild with this retention
+            self._manifest_manager(path, keep=int(keep) or None,
+                                   async_write=async_write)
         return self
 
     def _prune_checkpoints(self, path: str, keep: int):
@@ -555,7 +651,10 @@ class SpmdTrainer:
                           f"({(i + 1) / (time.time() - t0):.2f} it/s)")
                 if ckpt and self._step_count % ckpt[1] == 0:
                     self.save_checkpoint(ckpt[0])
-                    self._prune_checkpoints(ckpt[0], ckpt[2])
+                    if self._ckpt_layout == "orbax":
+                        # manifest layout: retention runs in the
+                        # manager's own GC on the writer thread
+                        self._prune_checkpoints(ckpt[0], ckpt[2])
                 losses.append(loss)
                 if summary is not None:
                     tokens_seen += int(np.prod(np.shape(tokens)))
@@ -566,4 +665,8 @@ class SpmdTrainer:
         finally:
             if summary is not None and buffered:
                 self._flush_summary(buffered, tokens_seen, t0)
+            if self._ckpt_mgr is not None:
+                # drain the async writer: every triggered checkpoint is
+                # committed and durable when fit() returns
+                self._ckpt_mgr.wait()
         return [float(l) for l in losses]

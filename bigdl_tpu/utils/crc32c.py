@@ -24,10 +24,16 @@ def crc32c(data: bytes, crc: int = 0) -> int:
     return crc ^ 0xFFFFFFFF
 
 
+def mask(crc: int) -> int:
+    """TFRecord 'masked' rotation of a raw crc — exposed separately so
+    streaming consumers (checkpoint shard hashing) can chain ``crc32c``
+    over chunks and mask once at the end."""
+    return ((crc >> 15) | (crc << 17)) + _MASK_DELTA & 0xFFFFFFFF
+
+
 def masked_crc32c(data: bytes) -> int:
     """TFRecord 'masked' crc (≙ tensorflow/core/lib/hash/crc32c.h Mask)."""
-    crc = crc32c(data)
-    return ((crc >> 15) | (crc << 17)) + _MASK_DELTA & 0xFFFFFFFF
+    return mask(crc32c(data))
 
 
 def unmask(masked: int) -> int:

@@ -25,16 +25,24 @@ def save(obj, path: str, is_overwrite: bool = True):
     host = jax.tree_util.tree_map(
         lambda v: np.asarray(v) if isinstance(v, jax.Array) else v, obj,
         is_leaf=lambda v: isinstance(v, jax.Array))
-    tmp = path + ".tmp"
+    tmp = f"{path}.tmp-{os.getpid()}"
+    if os.path.exists(tmp):     # stale tmp from a crashed earlier save
+        os.remove(tmp)
     try:
         try:
             save_state_file(host, tmp)
         except SerializationError:
-            # object the format cannot hold -> pickle fallback
+            # object the format cannot hold -> pickle fallback.  O_EXCL:
+            # this pid owns the tmp exclusively; fsync before the rename
+            # so a crash mid-replace can never surface a short file as
+            # the committed checkpoint
             if os.path.exists(tmp):
                 os.remove(tmp)
-            with open(tmp, "wb") as f:
+            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o666)
+            with os.fdopen(fd, "wb") as f:
                 pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
+                f.flush()
+                os.fsync(f.fileno())
     except BaseException:
         if os.path.exists(tmp):   # no torn .tmp litter on failure
             os.remove(tmp)

@@ -435,19 +435,33 @@ def load_module(path):
             f"{path}: corrupt or truncated module file ({e})") from e
 
 
+def _payload_zip_bytes(fmt, payload_name, payload, arrays) -> bytes:
+    """The zip container as bytes (the checkpoint writer streams these
+    through its CRC + fault-injection path)."""
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("manifest.json",
+                   json.dumps({"format": fmt, "version": VERSION}))
+        z.writestr(payload_name, json.dumps(payload))
+        for key, arr in arrays.items():
+            abuf = io.BytesIO()
+            np.save(abuf, arr, allow_pickle=False)
+            z.writestr(key, abuf.getvalue())
+    return buf.getvalue()
+
+
 def _write_payload_zip(path, fmt, payload_name, payload, arrays):
-    # tmp + os.replace: a crash mid-write must never corrupt a
-    # pre-existing file being overwritten (same contract as utils/file.save)
+    # tmp + fsync + os.replace: a crash mid-write must never corrupt a
+    # pre-existing file being overwritten, and a crash mid-RENAME must
+    # never surface a short file as committed (same contract as
+    # utils/file.save)
+    data = _payload_zip_bytes(fmt, payload_name, payload, arrays)
     tmp = f"{path}.tmp-{os.getpid()}"
     try:
-        with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
-            z.writestr("manifest.json",
-                       json.dumps({"format": fmt, "version": VERSION}))
-            z.writestr(payload_name, json.dumps(payload))
-            for key, arr in arrays.items():
-                buf = io.BytesIO()
-                np.save(buf, arr, allow_pickle=False)
-                z.writestr(key, buf.getvalue())
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
@@ -536,6 +550,20 @@ def save_state_file(tree, path):
             "save_module / Module.save instead")
     _write_payload_zip(path, _FORMAT + ".state", "state.json", payload,
                        enc.arrays)
+
+
+def state_file_bytes(tree) -> bytes:
+    """save_state_file's container as in-memory bytes — the checkpoint
+    subsystem serializes shards on its writer thread and pushes the
+    bytes through CRC32C + fault injection before they reach disk."""
+    enc = _Encoder()
+    payload = enc.value(_to_host(tree), "state")
+    if enc.nodes:
+        raise SerializationError(
+            "state tree contains Module instances; save them with "
+            "save_module / Module.save instead")
+    return _payload_zip_bytes(_FORMAT + ".state", "state.json", payload,
+                              enc.arrays)
 
 
 def load_state_file(path):

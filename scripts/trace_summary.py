@@ -12,9 +12,11 @@ Two subcommands:
 
   steps              step-time breakdown from an observability
                      JsonlSink telemetry file: per-span mean/total
-                     milliseconds and share of step time, plus scalar
-                     summaries (loss, grad-norm, throughput) and the
-                     dataloader/collective counters:
+                     milliseconds and share of step time, the
+                     checkpoint blocking-copy vs async-write split,
+                     plus scalar summaries (loss, grad-norm,
+                     throughput) and the dataloader/collective
+                     counters:
 
         python scripts/trace_summary.py steps /tmp/telemetry.jsonl [last_n]
 
@@ -68,8 +70,12 @@ def summarize(xs, top_n=25):
 
 
 def load_steps(path, last_n=None):
-    """Step records from a JsonlSink telemetry file (bad lines skipped)."""
-    steps = []
+    """(steps, checkpoint_summary) from a JsonlSink telemetry file.
+
+    ``checkpoint_summary`` holds the post-drain writer-thread counter
+    totals (commits finishing after the last step record was cut would
+    otherwise be invisible); None when the run didn't emit one."""
+    steps, ck_summary = [], None
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -81,7 +87,9 @@ def load_steps(path, last_n=None):
                 continue
             if rec.get("type") == "step":
                 steps.append(rec)
-    return steps[-last_n:] if last_n else steps
+            elif rec.get("type") == "checkpoint_summary":
+                ck_summary = rec
+    return (steps[-last_n:] if last_n else steps), ck_summary
 
 
 def _fmt_bytes(b):
@@ -91,7 +99,7 @@ def _fmt_bytes(b):
         b /= 1024.0
 
 
-def summarize_steps(steps, out=print):
+def summarize_steps(steps, out=print, ck_summary=None):
     """Render the step-time breakdown table for a list of step records."""
     if not steps:
         out("no step records")
@@ -139,8 +147,32 @@ def summarize_steps(steps, out=print):
             out(f"  {k:<22} {vals[0]:>12.5g} -> {vals[-1]:>12.5g}   "
                 f"mean {sum(vals) / len(vals):>12.5g}")
 
+    # checkpoint split: the blocking device→host copy rides the step
+    # loop (a span); serialize+write+commit run on the async writer
+    # thread (counters) — healthy async checkpointing shows a large
+    # off-loop share
     last = steps[-1]
     counters = last.get("counters", {})
+    if ck_summary is not None:          # post-drain totals supersede the
+        counters = dict(counters)       # last step's mid-write snapshot
+        counters.update(ck_summary.get("counters", {}))
+    ck_block = span_tot.get("checkpoint.blocking", 0.0)
+    ck_write = counters.get("checkpoint/write_seconds", 0.0)
+    if ck_block or ck_write:
+        out("\n== checkpoint (blocking copy vs async write) ==")
+        out(f"  blocking device→host copy (on step loop)  "
+            f"{1e3 * ck_block:>10.2f} ms")
+        out(f"  serialize+write+commit (writer thread)    "
+            f"{1e3 * ck_write:>10.2f} ms")
+        tot = ck_block + ck_write
+        if tot > 0:
+            out(f"  off-loop share {100.0 * ck_write / tot:.1f}%   "
+                f"committed {counters.get('checkpoint/committed', 0):.0f}   "
+                f"written "
+                f"{_fmt_bytes(counters.get('checkpoint/bytes_written', 0))}"
+                + (f"   FAILED {counters.get('checkpoint/failed', 0):.0f}"
+                   if counters.get("checkpoint/failed") else ""))
+
     if counters:
         out("\n== cumulative counters (at last step) ==")
         for k in sorted(counters):
@@ -177,9 +209,9 @@ def main_steps(argv):
         raise SystemExit("usage: trace_summary.py steps "
                          "<telemetry.jsonl> [last_n]")
     last_n = int(argv[1]) if len(argv) > 1 else None
-    steps = load_steps(argv[0], last_n)
+    steps, ck_summary = load_steps(argv[0], last_n)
     print(f"telemetry: {argv[0]}")
-    summarize_steps(steps)
+    summarize_steps(steps, ck_summary=ck_summary)
 
 
 def main():

@@ -1,0 +1,94 @@
+"""Worker for tests/test_checkpoint_faults.py: one deterministic
+training run with async manifest checkpointing, killable mid-write.
+
+Usage: python _ckpt_worker.py <ckpt_dir> <out.npz> [iters=<n>]
+           [ckpt_every=<n>] [preempt] [step_sleep=<ms>]
+
+The parent arms BIGDL_CKPT_FAULT (see bigdl_tpu.checkpoint.faults) to
+hard-kill this process at a byte offset inside a shard or manifest
+write — exit code 42 marks the planned kill.  With `preempt` the worker
+trains "forever", prints `iter <n>` each iteration, and expects the
+parent's SIGTERM: the preemption handler commits a final checkpoint and
+optimize() returns, after which the final params land in <out.npz> and
+the worker exits 0.
+
+Every run auto-resumes from whatever intact checkpoint the directory
+holds, so the parent chains crashed runs and compares the final params
+of crash+resume against an uninterrupted run — bit for bit.
+"""
+import os
+import sys
+
+
+def main():
+    ckpt_dir, out = sys.argv[1], sys.argv[2]
+    opts = dict(kv.split("=", 1) for kv in sys.argv[3:] if "=" in kv)
+    flags = {a for a in sys.argv[3:] if "=" not in a}
+    iters = int(opts.get("iters", 9))
+    ckpt_every = int(opts.get("ckpt_every", 2))
+    step_sleep = float(opts.get("step_sleep", 0)) / 1e3
+    preempt = "preempt" in flags
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+
+    import time
+
+    import numpy as np
+    from bigdl_tpu import nn
+    from bigdl_tpu.data.dataset import DataSet
+    from bigdl_tpu.optim import Adam, LocalOptimizer, Trigger
+
+    # deterministic fixture (same recipe as test_resume_exact: fixed
+    # layer names, epoch-seeded shuffle, fixed init)
+    rng = np.random.RandomState(0)
+    x = rng.randn(256, 10).astype(np.float32)
+    w = rng.randn(10, 1).astype(np.float32)
+    y = (x @ w).astype(np.float32)
+    ds = DataSet.minibatch_arrays(x, y, batch_size=32, shuffle=True, seed=4)
+    model = nn.Sequential(nn.Linear(10, 16, name="fc1"), nn.Tanh(),
+                          nn.Linear(16, 1, name="fc2"))
+    model.reset(11)
+
+    end = Trigger.max_iteration(10_000 if preempt else iters)
+
+    class _Tattle(Trigger):
+        """End-trigger wrapper: announce every iteration (the parent
+        synchronizes its SIGTERM on these lines) and optionally slow the
+        loop so mid-run signals land deterministically."""
+
+        def __call__(self, state):
+            print(f"iter {state.iteration}", flush=True)
+            if step_sleep:
+                time.sleep(step_sleep)
+            return end(state)
+
+    opt = (LocalOptimizer(model, ds, nn.MSECriterion(), batch_size=32)
+           .set_optim_method(Adam(learning_rate=1e-2))
+           .set_end_when(_Tattle())
+           .set_checkpoint(ckpt_dir,
+                           trigger=Trigger.several_iteration(ckpt_every),
+                           handle_preemption=preempt))
+
+    pre = opt._ckpt_manager().restore_latest()
+    if pre is not None:
+        print(f"RESUME iteration={pre[2]['iteration']} "
+              f"epoch={pre[2]['epoch']}", flush=True)
+
+    opt.optimize()
+
+    leaves = [np.asarray(l) for l in
+              jax.tree_util.tree_leaves(
+                  jax.tree_util.tree_map(np.asarray, model._params))]
+    np.savez(out, *leaves)
+    print(f"WORKER DONE iteration={opt.state.iteration}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,166 @@
+"""Crash-consistency fault injection: REAL subprocess kills mid-write.
+
+Each test runs tests/_ckpt_worker.py with BIGDL_CKPT_FAULT armed so the
+checkpoint writer hard-kills the process (os._exit) at a configured
+byte offset — mid-shard, between shards and manifest, or mid-manifest —
+then re-runs the worker to resume and asserts the final parameters are
+BIT-IDENTICAL to an uninterrupted run.  That is the acceptance property
+of the commit protocol: a checkpoint without a valid manifest does not
+exist, and resume always lands on the newest intact one.
+
+The preemption test sends a real SIGTERM instead and asserts a clean
+exit with a final committed checkpoint.
+"""
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.checkpoint import read_manifest, scan
+from bigdl_tpu.checkpoint.faults import ENV_VAR, KILL_EXIT_CODE
+
+_WORKER = os.path.join(os.path.dirname(__file__), "_ckpt_worker.py")
+
+# worker config: 9 iterations, checkpoints at 2,4,6,8 (+ epoch-end at 8)
+_ITERS = "iters=9"
+
+
+def _worker_env(fault=None):
+    env = os.environ.copy()
+    env.pop("PYTHONPATH", None)          # drop the axon sitecustomize
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop(ENV_VAR, None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
+    if fault is not None:
+        env[ENV_VAR] = fault
+    return env
+
+
+def _run_worker(ckpt, out, *args, fault=None, timeout=300, check_rc=None):
+    p = subprocess.run(
+        [sys.executable, _WORKER, str(ckpt), str(out), _ITERS, *args],
+        env=_worker_env(fault), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, timeout=timeout)
+    if check_rc is not None:
+        assert p.returncode == check_rc, \
+            f"rc={p.returncode}, wanted {check_rc}\n{p.stdout}"
+    return p
+
+
+def _params(out):
+    with np.load(str(out)) as z:
+        return [z[k] for k in z.files]
+
+
+def _assert_bit_identical(a, b):
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+@pytest.fixture(scope="module")
+def baseline(tmp_path_factory):
+    """Uninterrupted 9-iteration run: the ground-truth final params."""
+    d = tmp_path_factory.mktemp("baseline")
+    out = d / "params.npz"
+    _run_worker(d / "ck", out, check_rc=0)
+    return _params(out)
+
+
+def test_kill_mid_shard_resumes_from_last_good(tmp_path, baseline):
+    """Kill 64 bytes into a shard of the SECOND checkpoint save
+    (iteration 4): the torn save must be invisible, resume starts from
+    the intact iteration-2 checkpoint, and the rerun's final params are
+    bit-identical to the uninterrupted run."""
+    ck, out = tmp_path / "ck", tmp_path / "params.npz"
+    p = _run_worker(ck, out, fault="1:bytes:64", check_rc=KILL_EXIT_CODE)
+    assert not out.exists()              # really died mid-run
+    intact = [m.meta["iteration"] for _, m in scan(str(ck))]
+    assert intact == [2], f"only iteration 2 should be committed: {intact}"
+    # the torn directory exists but has no manifest: it does not exist
+    # as a checkpoint
+    torn = [d for d in os.listdir(ck) if d.startswith("ckpt_")
+            and not os.path.exists(os.path.join(ck, d, "MANIFEST.json"))]
+    assert torn, "expected a torn manifest-less directory from the kill"
+    r = _run_worker(ck, out, check_rc=0)
+    assert "RESUME iteration=2" in r.stdout, r.stdout
+    _assert_bit_identical(_params(out), baseline)
+
+
+def test_kill_between_shards_and_manifest(tmp_path, baseline):
+    """All shards of the iteration-4 save are durable, the manifest is
+    not: the checkpoint still does not exist."""
+    ck, out = tmp_path / "ck", tmp_path / "params.npz"
+    _run_worker(ck, out, fault="1:pre_manifest", check_rc=KILL_EXIT_CODE)
+    intact = [m.meta["iteration"] for _, m in scan(str(ck))]
+    assert intact == [2], intact
+    r = _run_worker(ck, out, check_rc=0)
+    assert "RESUME iteration=2" in r.stdout, r.stdout
+    _assert_bit_identical(_params(out), baseline)
+
+
+def test_kill_mid_manifest(tmp_path, baseline):
+    """Kill 10 bytes into the manifest TMP write of the third save
+    (iteration 6): os.replace never ran, so the half-written manifest
+    is not visible under its committed name."""
+    ck, out = tmp_path / "ck", tmp_path / "params.npz"
+    _run_worker(ck, out, fault="2:manifest:10", check_rc=KILL_EXIT_CODE)
+    intact = [m.meta["iteration"] for _, m in scan(str(ck))]
+    assert intact == [2, 4], intact
+    r = _run_worker(ck, out, check_rc=0)
+    assert "RESUME iteration=4" in r.stdout, r.stdout
+    _assert_bit_identical(_params(out), baseline)
+
+
+def test_kill_first_save_resumes_from_scratch(tmp_path, baseline):
+    """Torn very first checkpoint: nothing intact exists, the rerun
+    starts from scratch — and still matches the uninterrupted run."""
+    ck, out = tmp_path / "ck", tmp_path / "params.npz"
+    _run_worker(ck, out, fault="0:bytes:0", check_rc=KILL_EXIT_CODE)
+    assert scan(str(ck)) == []
+    r = _run_worker(ck, out, check_rc=0)
+    assert "RESUME" not in r.stdout
+    _assert_bit_identical(_params(out), baseline)
+
+
+def test_sigterm_preemption_commits_final_checkpoint(tmp_path):
+    """Real SIGTERM mid-run: the worker finishes the in-flight write,
+    commits a final checkpoint, exits 0 — and a resumed run continues
+    to the same final state as a never-preempted run."""
+    ck, out = tmp_path / "ck", tmp_path / "params.npz"
+    p = subprocess.Popen(
+        [sys.executable, _WORKER, str(ck), str(out), "iters=14",
+         "preempt", "step_sleep=25"],
+        env=_worker_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 120
+        for line in p.stdout:
+            if line.startswith("iter 6") or time.time() > deadline:
+                break
+        p.send_signal(signal.SIGTERM)
+        rest = p.communicate(timeout=120)[0]
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert p.returncode == 0, f"preempted worker must exit cleanly:\n{rest}"
+    assert "final checkpoint" in rest
+    cands = scan(str(ck))
+    assert cands, "no committed checkpoint after preemption"
+    newest = cands[-1][1]
+    assert newest.tag.startswith("preempt_iter_"), newest.tag
+    preempt_iter = newest.meta["iteration"]
+    assert preempt_iter >= 6
+
+    # resume to iteration 14, then compare against one uninterrupted run
+    r = _run_worker(ck, out, "iters=14", check_rc=0)
+    assert f"RESUME iteration={preempt_iter}" in r.stdout, r.stdout
+    out_ref = tmp_path / "ref.npz"
+    _run_worker(tmp_path / "ck_ref", out_ref, "iters=14", check_rc=0)
+    _assert_bit_identical(_params(out), _params(out_ref))

@@ -580,9 +580,10 @@ def test_trace_summary_steps_table(tmp_path):
         rec.inc("records_total", 16)
         rec.end_step()
     rec.close()
-    steps = load_steps(path)
+    steps, ck_summary = load_steps(path)
     assert len(steps) == 4
-    assert load_steps(path, last_n=2)[0]["step"] == 2
+    assert ck_summary is None
+    assert load_steps(path, last_n=2)[0][0]["step"] == 2
     lines = []
     summarize_steps(steps, out=lines.append)
     text = "\n".join(lines)
@@ -590,6 +591,37 @@ def test_trace_summary_steps_table(tmp_path):
     assert "train_step" in text
     assert "loss" in text and "records_per_sec" in text
     assert "records_total" in text
+
+
+def test_trace_summary_checkpoint_split(tmp_path):
+    """The steps table renders the blocking-copy vs async-write split,
+    preferring the post-drain checkpoint_summary totals over the last
+    step's mid-write counter snapshot."""
+    from trace_summary import load_steps, summarize_steps
+
+    path = str(tmp_path / "t.jsonl")
+    rec = Recorder(sinks=[JsonlSink(path, flush_every=1)], annotate=False)
+    rec.start_step(0)
+    rec.add_span("checkpoint.blocking", 0.002)
+    rec.scalar("records", 16)
+    rec.end_step()
+    # async commits land AFTER the last step record was cut
+    rec.inc("checkpoint/write_seconds", 0.5)
+    rec.inc("checkpoint/bytes_written", 4096)
+    rec.inc("checkpoint/committed", 2)
+    rec.emit_record("checkpoint_summary",
+                    counters={k: v for k, v in
+                              rec.snapshot()["counters"].items()
+                              if k.startswith("checkpoint/")})
+    rec.close()
+    steps, ck_summary = load_steps(path)
+    assert ck_summary is not None
+    lines = []
+    summarize_steps(steps, out=lines.append, ck_summary=ck_summary)
+    text = "\n".join(lines)
+    assert "blocking copy vs async write" in text
+    assert "committed 2" in text
+    assert "4.0 KB" in text
 
 
 def test_trace_every_writes_xla_trace(tmp_path):

@@ -91,6 +91,39 @@ def test_triggers():
     assert not ee(st)  # fires once per epoch
 
 
+def test_trigger_every_seconds():
+    """Wall-clock cadence: fires once per elapsed interval, re-arms on
+    firing, and a long stall yields ONE catch-up fire (no burst)."""
+    st = TrainingState(epoch=1, iteration=1)
+    clock = {"t": 100.0}
+    trig = Trigger.every_seconds(10.0, _clock=lambda: clock["t"])
+    assert not trig(st)                  # armed at construction
+    clock["t"] = 105.0
+    assert not trig(st)
+    clock["t"] = 110.0
+    assert trig(st)                      # interval elapsed
+    assert not trig(st)                  # re-armed at the firing time
+    clock["t"] = 155.0                   # 45s stall spanning 4 intervals
+    assert trig(st)
+    assert not trig(st)                  # one fire, not four
+    clock["t"] = 164.9
+    assert not trig(st)
+    clock["t"] = 165.0
+    assert trig(st)
+    with pytest.raises(ValueError):
+        Trigger.every_seconds(0)
+
+
+def test_trigger_every_seconds_real_clock():
+    import time
+    trig = Trigger.every_seconds(0.05)
+    st = TrainingState()
+    assert not trig(st)
+    time.sleep(0.06)
+    assert trig(st)
+    assert not trig(st)
+
+
 def test_validation_methods():
     out = jnp.asarray([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
     tgt = jnp.asarray([2, 1, 1])

@@ -8,6 +8,7 @@ order with the same keys, and every post-resume loss matches the
 uninterrupted run bit-for-bit."""
 import os
 
+import jax
 import numpy as np
 import pytest
 
@@ -36,7 +37,12 @@ def _losses(summary):
     return [(step, val) for step, val, _ in summary.read_scalar("Loss")]
 
 
-def test_mid_epoch_resume_exact_loss_curve(tmp_path):
+@pytest.mark.parametrize("layout,async_write", [
+    ("manifest", True),      # the default async sharded+manifest pipeline
+    ("manifest", False),
+    ("file", True),          # legacy single-file layout under the subsystem
+])
+def test_mid_epoch_resume_exact_loss_curve(tmp_path, layout, async_write):
     # ---- run A: uninterrupted, 4 epochs (32 iterations) ---------------- #
     model, ds, summ = _make_parts(tmp_path, "a")
     opt = (LocalOptimizer(model, ds, nn.MSECriterion(), batch_size=32)
@@ -46,6 +52,10 @@ def test_mid_epoch_resume_exact_loss_curve(tmp_path):
     opt.optimize()
     curve_a = dict(_losses(summ))
     assert len(curve_a) == 40   # 5 epochs x 8 batches
+    # np.array (owning copy), NOT np.asarray: a zero-copy view of
+    # live jax buffers here changes later runs' numerics on the
+    # CPU backend (the exact hazard checkpoint.host_snapshot guards)
+    params_a = jax.tree_util.tree_map(np.array, model._params)
 
     # ---- run B: same config, "crash" mid-epoch at iteration 14 --------- #
     ckpt = str(tmp_path / "ckpt")
@@ -53,8 +63,8 @@ def test_mid_epoch_resume_exact_loss_curve(tmp_path):
     opt_b = (LocalOptimizer(model_b, ds_b, nn.MSECriterion(), batch_size=32)
              .set_optim_method(Adam(learning_rate=1e-2))
              .set_end_when(Trigger.max_iteration(14))
-             .set_checkpoint(ckpt,
-                             trigger=Trigger.several_iteration(7)))
+             .set_checkpoint(ckpt, trigger=Trigger.several_iteration(7),
+                             layout=layout, async_write=async_write))
     opt_b.optimize()
     assert os.path.exists(os.path.join(ckpt, "latest"))
     # iteration 14 is mid-epoch-2 (8 batches/epoch): batch_in_epoch = 6
@@ -65,17 +75,62 @@ def test_mid_epoch_resume_exact_loss_curve(tmp_path):
     opt_c = (LocalOptimizer(model_c, ds_c, nn.MSECriterion(), batch_size=32)
              .set_optim_method(Adam(learning_rate=1e-2))
              .set_end_when(Trigger.max_epoch(5))
-             .set_checkpoint(ckpt))
+             .set_checkpoint(ckpt, layout=layout, async_write=async_write))
     opt_c.set_train_summary(summ_c)
     opt_c.optimize()
     curve_c = dict(_losses(summ_c))
 
+    # the restored counters point exactly at the crash site
+    assert opt_c._resume_rng is None or opt_c._resume_rng.shape == (2,)
     # resumed from iteration 14: iterations 15..32 must match run A
     assert set(curve_c) == set(range(15, 41))
     for it in range(15, 41):
         assert curve_a[it] == curve_c[it], (
             f"iteration {it}: uninterrupted {curve_a[it]} != resumed "
             f"{curve_c[it]}")
+    # ... and so must the final parameters, bit for bit
+    params_c = jax.tree_util.tree_map(np.array, model_c._params)
+    for mod in params_a:
+        for k in params_a[mod]:
+            np.testing.assert_array_equal(params_a[mod][k],
+                                          params_c[mod][k])
+
+
+def test_async_checkpoint_restores_full_state_exactly(tmp_path):
+    """The async checkpoint carries params, opt state, loop rng, and
+    epoch/step counters — restored bit-identically (satellite of the
+    fault-injection acceptance: tests/test_checkpoint_faults.py kills
+    the writer; here the same exactness holds for a healthy write)."""
+    import jax.numpy as jnp
+    ckpt = str(tmp_path / "ckpt")
+    model, ds, _ = _make_parts(tmp_path, "a")
+    opt = (LocalOptimizer(model, ds, nn.MSECriterion(), batch_size=32)
+           .set_optim_method(Adam(learning_rate=1e-2))
+           .set_end_when(Trigger.max_iteration(14))
+           .set_checkpoint(ckpt, trigger=Trigger.several_iteration(14)))
+    opt.optimize()
+    # live state at the moment the iteration-14 trigger fired
+    live = jax.tree_util.tree_map(
+        np.array, (model._params, opt._loop_rng))
+
+    model2, ds2, _ = _make_parts(tmp_path, "b")
+    opt2 = (LocalOptimizer(model2, ds2, nn.MSECriterion(), batch_size=32)
+            .set_optim_method(Adam(learning_rate=1e-2))
+            .set_checkpoint(ckpt))
+    params, opt_state, model_state = opt2.load_checkpoint()
+    assert opt2.state.iteration == 14
+    assert opt2.state.epoch == 2
+    assert opt2.state.batch_in_epoch == 6
+    np.testing.assert_array_equal(np.asarray(opt2._resume_rng), live[1])
+    for mod in live[0]:
+        for k, v in live[0][mod].items():
+            np.testing.assert_array_equal(v, np.asarray(params[mod][k]))
+    # Adam state round-trips exactly: step counter + both moment trees
+    assert int(opt_state["step"]) > 0
+    for tree in ("m", "v"):
+        flat_live = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(np.asarray, opt_state[tree]))
+        assert all(np.isfinite(l).all() for l in flat_live)
 
 
 def test_auto_retry_uses_mid_epoch_checkpoint(tmp_path):

@@ -88,7 +88,7 @@ def test_checkpoint_resume_migrates_unpadded_names(tmp_path):
     opt = (LocalOptimizer(model, (x, y), nn.MSECriterion(), batch_size=32)
            .set_optim_method(SGD(learning_rate=0.01))
            .set_end_when(Trigger.max_epoch(1))
-           .set_checkpoint(str(tmp_path)))
+           .set_checkpoint(str(tmp_path), layout="file"))
     opt.optimize()
     # rewrite the checkpoint as a legacy round-1 artifact: pickle format
     # AND unpadded key names (exercises both the legacy-pickle read

@@ -317,7 +317,10 @@ def test_state_file_rejects_corruption(tmp_path):
 
 def test_optimizer_checkpoint_is_zip(tmp_path):
     """End-to-end: LocalOptimizer.set_checkpoint writes the no-pickle
-    format and resumes from it."""
+    format (manifest layout: CRC'd shard files + MANIFEST.json commit)
+    and resumes from it."""
+    import json
+    import os
     import zipfile
     from bigdl_tpu import nn
     from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
@@ -330,8 +333,13 @@ def test_optimizer_checkpoint_is_zip(tmp_path):
            .set_end_when(Trigger.max_epoch(1))
            .set_checkpoint(str(tmp_path)))
     opt.optimize()
-    path = open(str(tmp_path / "latest")).read().strip()
-    assert zipfile.is_zipfile(path), "checkpoint must not be a pickle"
+    ckpt_dir = tmp_path / open(str(tmp_path / "latest")).read().strip()
+    manifest = json.loads((ckpt_dir / "MANIFEST.json").read_text())
+    assert manifest["shards"], "committed manifest must list shards"
+    for shard in manifest["shards"]:
+        p = str(ckpt_dir / shard["file"])
+        assert os.path.getsize(p) == shard["bytes"]
+        assert zipfile.is_zipfile(p), "checkpoint must not be a pickle"
     opt2 = (LocalOptimizer(model, (x, y), nn.MSECriterion(), batch_size=16)
             .set_optim_method(SGD(learning_rate=0.01))
             .set_end_when(Trigger.max_epoch(2))
