@@ -50,6 +50,13 @@ class PreemptionHandler:
             print(f"[preemption] signal {signum} received; will write a "
                   "final checkpoint and stop", flush=True)
         self._event.set()
+        # chain a handler we displaced (e.g. the observability flight
+        # recorder installed before us) — it must still see the signal;
+        # default/ignore dispositions are deliberately NOT re-applied,
+        # intercepting them is this handler's whole point
+        prev = self._prev.get(signum)
+        if callable(prev):
+            prev(signum, frame)
 
     @property
     def requested(self) -> bool:

@@ -15,6 +15,14 @@ surface for the TPU rebuild:
   * Collective-volume accounting
     (:mod:`~bigdl_tpu.observability.collectives`): bytes-on-wire per
     step, pre/post compression, from static shapes or partitioned HLO.
+  * Live introspection (:mod:`~bigdl_tpu.observability.http`): a
+    stdlib HTTP daemon serving ``/metrics`` (Prometheus), ``/healthz``
+    and ``/records`` — ``serve_metrics(port)`` on the trainers and the
+    serving engine.
+  * Training health (:mod:`~bigdl_tpu.observability.health`): NaN/Inf
+    and loss-spike sentinels with warn/record/raise/rollback policies,
+    a stall-and-straggler watchdog, and a crash flight recorder that
+    dumps the recent-record ring on unhandled exception / SIGTERM.
 
 Every span is also emitted as a ``jax.profiler.TraceAnnotation`` so the
 host-side phase structure lines up with device events in a TensorBoard /
@@ -33,11 +41,18 @@ Quick start::
 from __future__ import annotations
 
 from .recorder import Recorder, get_recorder, set_recorder, null_recorder
-from .sinks import (InMemorySink, JsonlSink, Sink, TensorBoardSink)
+from .sinks import (InMemorySink, JsonlSink, Sink, TensorBoardSink,
+                    render_prometheus)
+from .http import IntrospectionServer
+from .health import (DivergenceError, FlightRecorder, HealthMonitor,
+                     StallWatchdog)
 from . import collectives
+from . import health
 
 __all__ = [
     "Recorder", "get_recorder", "set_recorder", "null_recorder",
     "Sink", "InMemorySink", "JsonlSink", "TensorBoardSink",
-    "collectives",
+    "render_prometheus", "IntrospectionServer",
+    "DivergenceError", "FlightRecorder", "HealthMonitor", "StallWatchdog",
+    "collectives", "health",
 ]

@@ -1,0 +1,40 @@
+"""bigdl_tpu.observability.health — training-health layer.
+
+The PR-1 Recorder is write-only telemetry: sinks you read after the
+fact.  This package adds the *operate-a-running-job* half (≙ the
+reference BigDL's Spark-UI live metrics and executor health signals):
+
+  * :class:`HealthMonitor` (:mod:`.sentinels`) — numeric-health
+    sentinels over each completed step record: NaN/Inf in loss or
+    gradients, loss-spike (EWMA z-score), gradient-norm explosion.
+    The device-side checks ride the existing jitted step's
+    ``health_scalars`` output (``jnp.isfinite`` reductions folded into
+    the compiled program), so detection adds **no extra host sync**.
+    Policies: ``warn`` / ``record`` / ``raise`` (:class:`DivergenceError`)
+    / ``rollback`` (restore the last committed checkpoint via the PR-3
+    auto-resume path).
+  * :class:`StallWatchdog` (:mod:`.watchdog`) — a daemon thread that
+    flags a step exceeding a rolling p99×k budget, and attributes
+    per-host step-time skew to name the straggler under
+    :class:`~bigdl_tpu.parallel.spmd.SpmdTrainer`.
+  * :class:`FlightRecorder` (:mod:`.flight`) — dumps the Recorder's
+    bounded ring of recent step records + health events atomically to
+    ``flight_<ts>.json`` on unhandled exception, divergence, or
+    SIGTERM, so a dead job leaves its last seconds behind.
+
+The live view over all of this is
+:class:`~bigdl_tpu.observability.http.IntrospectionServer`
+(``/metrics`` ``/healthz`` ``/records``), attachable via
+``serve_metrics(port)`` on ``Optimizer``, ``SpmdTrainer`` and
+``ServingEngine``.
+"""
+from __future__ import annotations
+
+from .sentinels import DivergenceError, HealthMonitor
+from .watchdog import StallWatchdog, attribute_stragglers
+from .flight import FlightRecorder
+
+__all__ = [
+    "DivergenceError", "HealthMonitor", "StallWatchdog",
+    "attribute_stragglers", "FlightRecorder",
+]

@@ -1,0 +1,177 @@
+"""Crash flight recorder: dump the telemetry ring when the job dies.
+
+The Recorder keeps a bounded ring of the last N emitted records
+(``Recorder.recent_records``).  :class:`FlightRecorder` turns that ring
+into a post-mortem artifact: one ``flight_<ts>.json`` written atomically
+(tmp + fsync + ``os.replace`` + dir fsync — the same commit discipline
+as ``utils/file.py`` and the checkpoint manifest) containing the recent
+step records, counter/gauge snapshot, and the trigger reason.
+
+``install()`` chains — never replaces — the process crash paths:
+
+  * ``sys.excepthook``: an unhandled exception dumps first, then the
+    previous hook (usually the default traceback printer) runs
+  * SIGTERM: the dump happens first, then the *previous* handler runs —
+    so the PR-3 :class:`~bigdl_tpu.checkpoint.preemption.PreemptionHandler`
+    installed before us still gets its flag set and the final preemption
+    checkpoint still commits
+
+Divergence dumps don't come through either hook: the
+:class:`~bigdl_tpu.observability.health.sentinels.HealthMonitor` calls
+:meth:`FlightRecorder.dump` directly before raising, so the dump exists
+even when a ``rollback`` policy swallows the exception.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from ..sinks import _json_default
+
+
+class FlightRecorder:
+    """Dumps ``recorder``'s ring to ``out_dir/flight_<ts>.json``."""
+
+    def __init__(self, recorder, out_dir: str, max_records: Optional[int] = None):
+        self.recorder = recorder
+        self.out_dir = out_dir
+        self.max_records = max_records
+        self.dumps: List[str] = []          # paths written, oldest first
+        self._dumped_keys = set()           # dedupe one failure's dumps
+        # RLock, not Lock: a signal delivered while dump() holds the
+        # lock runs the chained handler on the SAME thread, which dumps
+        # again — a plain Lock would self-deadlock through the scheduler
+        # grace window
+        self._lock = threading.RLock()
+        self._installed = False
+        self._prev_excepthook = None
+        self._prev_signals: Dict[int, Any] = {}
+
+    # -- the dump --------------------------------------------------------- #
+    def dump(self, reason: str, extra: Optional[Dict[str, Any]] = None,
+             key=None) -> Optional[str]:
+        """Write one atomic flight dump; returns its path.  ``key`` (e.g.
+        ``id(exc)``) dedupes: the training driver dumps a propagating
+        exception at the loop, and the chained excepthook would dump the
+        SAME failure again at process exit — the second call no-ops and
+        returns None."""
+        if key is not None:
+            with self._lock:
+                if key in self._dumped_keys:
+                    return None
+                self._dumped_keys.add(key)
+        rec = self.recorder
+        snap = rec.snapshot()
+        payload: Dict[str, Any] = {
+            "type": "flight",
+            "reason": str(reason),
+            "time": time.time(),
+            "last_step": rec.last_step(),
+            "step_age_s": rec.step_age(),
+            "counters": snap["counters"],
+            "gauges": snap["gauges"],
+            "records": rec.recent_records(self.max_records),
+        }
+        if extra:
+            payload.update(extra)
+        with self._lock:
+            os.makedirs(self.out_dir, exist_ok=True)
+            base = f"flight_{int(time.time() * 1e3)}"
+            path = os.path.join(self.out_dir, base + ".json")
+            n = 0
+            while os.path.exists(path):      # two dumps in the same ms
+                n += 1
+                path = os.path.join(self.out_dir, f"{base}_{n}.json")
+            tmp = f"{path}.tmp-{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, default=_json_default)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            try:        # directory entry durable too (same as manifest)
+                dfd = os.open(self.out_dir, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
+            except OSError:
+                pass
+            self.dumps.append(path)
+        return path
+
+    def _dump_quietly(self, reason: str, extra=None, key=None):
+        try:
+            self.dump(reason, extra, key=key)
+        except Exception as e:      # noqa: BLE001 — crash path
+            print(f"[flight] dump failed: {e!r}", file=sys.stderr)
+
+    # -- crash-path hooks -------------------------------------------------- #
+    def install(self, signals=(signal.SIGTERM,)) -> "FlightRecorder":
+        """Chain onto ``sys.excepthook`` and the given signals."""
+        if self._installed:
+            return self
+        prev_hook = sys.excepthook
+        self._prev_excepthook = prev_hook
+
+        def hook(exc_type, exc, tb):
+            self._dump_quietly(f"unhandled:{exc_type.__name__}",
+                               {"error": repr(exc)}, key=id(exc))
+            prev_hook(exc_type, exc, tb)
+
+        sys.excepthook = hook
+        try:
+            for s in signals:
+                prev = signal.getsignal(s)
+
+                def handler(signum, frame, _prev=prev):
+                    self._dump_quietly(f"signal:{signum}")
+                    if callable(_prev):
+                        _prev(signum, frame)
+                    elif (_prev == signal.SIG_DFL
+                          and signal.getsignal(signum) is handler):
+                        # the default disposition (terminate) must still
+                        # apply: restore it and re-deliver — dump-and-
+                        # ignore would eat the scheduler's grace window.
+                        # Only while we are the ACTIVE handler though:
+                        # if something installed over us and chained in
+                        # (the preemption handler), THAT owner decides
+                        # the disposition — terminating here would kill
+                        # its graceful final checkpoint
+                        signal.signal(signum, signal.SIG_DFL)
+                        signal.raise_signal(signum)
+                    # SIG_IGN: stay ignored
+
+                signal.signal(s, handler)
+                self._prev_signals[s] = prev
+        except ValueError:
+            # signal.signal only works on the main thread; excepthook
+            # chaining above still covers unhandled exceptions
+            print("[flight] not on main thread; signal hooks skipped")
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        if self._prev_excepthook is not None:
+            sys.excepthook = self._prev_excepthook
+            self._prev_excepthook = None
+        for s, prev in self._prev_signals.items():
+            try:
+                signal.signal(s, prev)
+            except ValueError:
+                pass
+        self._prev_signals.clear()
+        self._installed = False
+
+
+def read_flight(path: str) -> Dict[str, Any]:
+    """Parse one flight dump back (plain json.load, named for symmetry
+    with ``sinks.read_jsonl``)."""
+    with open(path) as f:
+        return json.load(f)

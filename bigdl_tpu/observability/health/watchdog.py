@@ -1,0 +1,212 @@
+"""Stall & straggler detection.
+
+A wedged training loop is invisible to record-based telemetry — the
+step that never finishes never emits.  :class:`StallWatchdog` is a
+daemon thread polling ``Recorder.step_age()`` (seconds since the
+pending step opened, or since the last one closed) against a **rolling
+budget**: p99 of the recent step durations × ``factor`` (floored, so a
+cold compile or an empty history can't trip it).  Crossing the budget:
+
+  * ``health/stalled`` gauge flips to 1 (what ``/healthz`` reports)
+  * one ``health_event`` record (``condition="stall"``) per episode —
+    recovery flips the gauge back and re-arms the event
+  * ``health/stall_seconds`` accrues while stalled
+
+Straggler attribution: step records under a multi-host
+:class:`SpmdTrainer` carry a ``host`` scalar; :func:`attribute_stragglers`
+groups records per host and names the slowest one and its skew vs the
+fleet median — the "which worker is dragging the synchronous step"
+question the BigDL paper answers with Spark's straggler metrics.  It
+needs records from MORE than one host in one list: a merged ring (one
+shared recorder/aggregated JSONL), or ``SpmdTrainer.straggler_report()``
+which does the cross-host gather; a single process's own ring yields
+None, and the watchdog's inline stall-event attribution is best-effort
+on whatever the local ring holds.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+def _p99(durs: List[float]) -> float:
+    s = sorted(durs)
+    return s[min(len(s) - 1, int(0.99 * (len(s) - 1) + 0.999999))]
+
+
+def attribute_stragglers(records: List[Dict[str, Any]]
+                         ) -> Optional[Dict[str, Any]]:
+    """Per-host mean step time from records carrying a ``host`` scalar.
+
+    Returns ``{"hosts": {host: mean_s}, "straggler": host,
+    "skew": slowest/median}`` or None when records aren't per-host
+    (single-process runs)."""
+    by_host: Dict[int, List[float]] = {}
+    for r in records:
+        if r.get("type") != "step":
+            continue
+        host = (r.get("scalars") or {}).get("host")
+        dur = r.get("dur")
+        if host is None or not isinstance(dur, (int, float)):
+            continue
+        by_host.setdefault(int(host), []).append(float(dur))
+    if len(by_host) < 2:
+        return None
+    means = {h: sum(v) / len(v) for h, v in by_host.items()}
+    ranked = sorted(means.items(), key=lambda kv: kv[1])
+    # lower-middle for even host counts: the slowest host must never be
+    # its own baseline (a 2-host fleet would always report skew 1.0)
+    median = ranked[(len(ranked) - 1) // 2][1]
+    slowest, slowest_mean = ranked[-1]
+    return {"hosts": means, "straggler": slowest,
+            "skew": slowest_mean / max(median, 1e-12)}
+
+
+class StallWatchdog:
+    """Background budget check over ``recorder``'s liveness signal."""
+
+    def __init__(self, recorder, factor: float = 5.0,
+                 min_history: int = 8, floor_seconds: float = 2.0,
+                 poll_interval: float = 0.25):
+        self.recorder = recorder
+        self.factor = float(factor)
+        self.min_history = int(min_history)
+        self.floor_seconds = float(floor_seconds)
+        self.poll_interval = float(poll_interval)
+        self._stop = threading.Event()
+        self._stalled = False
+        self._thread: Optional[threading.Thread] = None
+        self._stall_started: Optional[float] = None
+        self.stall_episodes = 0
+        # check_once runs on the polling thread AND every /healthz
+        # scrape thread: serialize the verdict state
+        self._check_lock = threading.Lock()
+        # a stopped watchdog (training finished) must not flag the
+        # ever-growing idle step_age as a stall; fresh instances are
+        # active so check_once works without a polling thread
+        self._active = True
+        # legitimate between-step work (validation, a sync checkpoint
+        # commit) suspends the verdict; _resumed_at re-baselines the
+        # idle age so the suspended interval can't trip the budget
+        # right after resume
+        self._suspend = 0
+        self._resumed_at: Optional[float] = None
+
+    # -- budget ------------------------------------------------------------ #
+    def budget(self) -> Optional[float]:
+        """Current stall budget in seconds: max(p99 × factor, floor);
+        None until ``min_history`` completed steps exist."""
+        durs = [r["dur"] for r in
+                self.recorder.recent_records(rec_type="step")
+                if isinstance(r.get("dur"), (int, float))]
+        if len(durs) < self.min_history:
+            return None
+        return max(_p99(durs) * self.factor, self.floor_seconds)
+
+    def check_once(self) -> bool:
+        """One poll; returns the current stalled verdict.  Public so
+        tests (and /healthz handlers without a running thread) can
+        evaluate the budget synchronously.  Thread-safe: the polling
+        thread and concurrent /healthz scrapes share the verdict."""
+        with self._check_lock:
+            return self._check_locked()
+
+    def suspended(self):
+        """Context manager marking legitimate between-step work (an
+        epoch-end validation pass, a synchronous checkpoint commit) so
+        a LONG one doesn't read as a wedged step loop.  Re-entrant; the
+        trainers wrap their validation/checkpoint blocks in it."""
+        @contextlib.contextmanager
+        def cm():
+            with self._check_lock:
+                self._suspend += 1
+            try:
+                yield
+            finally:
+                with self._check_lock:
+                    self._suspend -= 1
+                    self._resumed_at = time.time()
+        return cm()
+
+    def _check_locked(self) -> bool:
+        rec = self.recorder
+        if not self._active or self._suspend:
+            self._clear_stall()
+            return False
+        age = rec.step_age()
+        # time spent suspended is not loop inactivity: measure from the
+        # resume point until the next step record re-baselines properly
+        if (age is not None and self._resumed_at is not None
+                and not rec.step_in_flight()):
+            age = min(age, time.time() - self._resumed_at)
+        b = self.budget()
+        if age is not None and b is not None and age > b:
+            if not self._stalled:
+                self._stalled = True
+                self._stall_started = time.time()
+                self.stall_episodes += 1
+                rec.gauge("health/stalled", 1)
+                ev = {"condition": "stall", "step": rec.last_step(),
+                      "metric": "step_age_s", "value": age,
+                      "threshold": b, "action": "record"}
+                stragglers = attribute_stragglers(rec.recent_records())
+                if stragglers is not None:
+                    ev["straggler"] = stragglers["straggler"]
+                    ev["skew"] = stragglers["skew"]
+                rec.emit_record("health_event", **ev)
+                rec.inc("health/events")
+                rec.inc("health/stall")
+                print(f"[health] stall: step age {age:.2f}s exceeds "
+                      f"budget {b:.2f}s (p99×{self.factor:g})"
+                      + (f"; straggler host {ev['straggler']} "
+                         f"({ev['skew']:.2f}x median)"
+                         if "straggler" in ev else ""), flush=True)
+        elif self._stalled:
+            self._clear_stall()
+        return self._stalled
+
+    def _clear_stall(self):
+        if not self._stalled:
+            return
+        self._stalled = False
+        self.recorder.gauge("health/stalled", 0)
+        if self._stall_started is not None:
+            self.recorder.inc("health/stall_seconds",
+                              time.time() - self._stall_started)
+            self._stall_started = None
+
+    @property
+    def stalled(self) -> bool:
+        return self._stalled
+
+    # -- thread lifecycle --------------------------------------------------- #
+    def start(self) -> "StallWatchdog":
+        self._active = True
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._run, daemon=True,
+                                            name="health-watchdog")
+            self._thread.start()
+        return self
+
+    def _run(self):
+        while not self._stop.wait(self.poll_interval):
+            try:
+                self.check_once()
+            except Exception as e:   # the watchdog must never die silently
+                print(f"[health] watchdog check failed: {e!r}", flush=True)
+
+    def stop(self):
+        """Stop polling AND deactivate: a finished (or paused) loop is
+        not a stalled one, so subsequent direct check_once calls — e.g.
+        /healthz scrapes after training completed — report healthy."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            self._thread = None
+        with self._check_lock:
+            self._active = False
+            self._clear_stall()

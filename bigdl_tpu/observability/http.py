@@ -1,0 +1,185 @@
+"""Live introspection server: /metrics, /healthz, /records.
+
+The reference BigDL surfaces training state through Spark's live UI;
+the Recorder (PR 1) only *writes*.  :class:`IntrospectionServer` is the
+read side — a stdlib ``http.server`` daemon thread (no new
+dependencies) rendering the Recorder a scraper can poll while the job
+runs:
+
+  ``/metrics``   Prometheus text exposition
+                 (:func:`~bigdl_tpu.observability.sinks.render_prometheus`):
+                 counters, gauges, histogram summaries with quantiles
+  ``/healthz``   JSON liveness — last-step index and age, the stall
+                 watchdog's verdict and budget, writer-queue depths
+                 (dataloader / checkpoint in-flight / serving queues),
+                 serving shed rate, sentinel event counts.  HTTP 200
+                 when healthy, 503 when stalled or diverged, so a
+                 k8s-style probe needs no JSON parsing
+  ``/records``   the last-N records from the Recorder's ring
+                 (``?n=20&type=step``) — the live tail JSONL sinks only
+                 give you after the fact
+
+Attach with ``serve_metrics(port)`` on ``Optimizer`` / ``SpmdTrainer``
+/ ``ServingEngine``, or standalone::
+
+    from bigdl_tpu.observability.http import IntrospectionServer
+    srv = IntrospectionServer(rec, port=9100).start()   # port=0: ephemeral
+    # curl localhost:9100/metrics
+    srv.stop()
+
+Handlers only ever read snapshots under the Recorder's lock, so a
+scrape can't block or corrupt the step loop; ``ThreadingHTTPServer``
+keeps one slow scraper from starving the next probe.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from .sinks import _json_default, render_prometheus
+
+
+def _finite_json(obj):
+    """Strict-JSON encode: non-finite floats become the strings "NaN" /
+    "Inf" / "-Inf".  json.dumps would emit the bare token ``NaN``
+    (invalid RFC 8259) — and a NaN loss in the ring is EXACTLY the
+    record a health client wants to read, so it must stay parseable."""
+    def walk(v):
+        if isinstance(v, float) and not math.isfinite(v):
+            if math.isnan(v):
+                return "NaN"
+            return "Inf" if v > 0 else "-Inf"
+        if isinstance(v, dict):
+            return {k: walk(x) for k, x in v.items()}
+        if isinstance(v, (list, tuple)):
+            return [walk(x) for x in v]
+        return v
+    return json.dumps(walk(obj), default=_json_default)
+
+
+class IntrospectionServer:
+    """One Recorder's live read surface; start()/stop() lifecycle."""
+
+    def __init__(self, recorder, port: int = 0, host: str = "127.0.0.1",
+                 watchdog=None, monitor=None, namespace: str = "bigdl",
+                 records_default: int = 50):
+        self.recorder = recorder
+        self.host = host
+        self.port = int(port)           # 0 -> ephemeral, bound in start()
+        self.watchdog = watchdog
+        self.monitor = monitor
+        self.namespace = namespace
+        self.records_default = int(records_default)
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle --------------------------------------------------------- #
+    def start(self) -> "IntrospectionServer":
+        if self._server is not None:
+            return self
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):       # no per-scrape stderr spam
+                pass
+
+            def do_GET(self):
+                try:
+                    outer._route(self)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass                # scraper went away mid-response
+                except Exception as e:  # introspection must never crash
+                    try:
+                        self.send_error(500, repr(e))
+                    except Exception:
+                        pass
+
+        srv = ThreadingHTTPServer((self.host, self.port), Handler)
+        srv.daemon_threads = True
+        self._server = srv
+        self.port = srv.server_address[1]
+        self._thread = threading.Thread(target=srv.serve_forever,
+                                        daemon=True,
+                                        name=f"introspection:{self.port}")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        srv, self._server = self._server, None
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    # -- routing ----------------------------------------------------------- #
+    def _route(self, h: BaseHTTPRequestHandler):
+        parsed = urlparse(h.path)
+        if parsed.path == "/metrics":
+            body = render_prometheus(self.recorder, self.namespace)
+            self._reply(h, 200, body,
+                        "text/plain; version=0.0.4; charset=utf-8")
+        elif parsed.path == "/healthz":
+            payload = self.healthz()
+            self._reply(h, 200 if payload["ok"] else 503,
+                        _finite_json(payload), "application/json")
+        elif parsed.path == "/records":
+            q = parse_qs(parsed.query)
+            n = int(q["n"][0]) if q.get("n") else self.records_default
+            rec_type = q["type"][0] if q.get("type") else None
+            recs = self.recorder.recent_records(n, rec_type=rec_type)
+            self._reply(h, 200, _finite_json(recs), "application/json")
+        else:
+            h.send_error(404, "try /metrics, /healthz or /records")
+
+    @staticmethod
+    def _reply(h: BaseHTTPRequestHandler, code: int, body: str,
+               content_type: str):
+        data = body.encode("utf-8")
+        h.send_response(code)
+        h.send_header("Content-Type", content_type)
+        h.send_header("Content-Length", str(len(data)))
+        h.end_headers()
+        h.wfile.write(data)
+
+    # -- health verdict ----------------------------------------------------- #
+    def healthz(self) -> Dict[str, Any]:
+        """The /healthz JSON: liveness + queue depths + sentinel state.
+        ``ok`` is False when the watchdog says stalled or the monitor
+        has tripped a fatal condition."""
+        rec = self.recorder
+        snap = rec.snapshot()
+        gauges, counters = snap["gauges"], snap["counters"]
+        stalled = bool(gauges.get("health/stalled", 0))
+        budget = None
+        if self.watchdog is not None:
+            stalled = self.watchdog.check_once()
+            budget = self.watchdog.budget()
+        diverged = (self.monitor is not None and not self.monitor.healthy)
+        out: Dict[str, Any] = {
+            "ok": not (stalled or diverged),
+            "stalled": stalled,
+            "diverged": diverged,
+            "last_step": rec.last_step(),
+            "step_age_s": rec.step_age(),
+            "stall_budget_s": budget,
+            "health_events": counters.get("health/events", 0),
+            "writer_queue_depth": {
+                k: v for k, v in gauges.items()
+                if k in ("dataloader/queue_depth", "checkpoint/in_flight")
+                or k.startswith("serving.queue_depth.")},
+        }
+        requests = counters.get("serving.requests", 0)
+        if requests:
+            shed = (counters.get("serving.shed_queue_full", 0)
+                    + counters.get("serving.shed_deadline", 0))
+            out["shed_rate"] = shed / requests
+        return out

@@ -83,7 +83,8 @@ class Recorder:
     """
 
     def __init__(self, sinks=(), enabled: bool = True,
-                 annotate: bool = True, hist_sample_cap: int = 2048):
+                 annotate: bool = True, hist_sample_cap: int = 2048,
+                 keep_records: int = 256):
         self._lock = threading.Lock()
         self.sinks = list(sinks)
         self._enabled = bool(enabled)
@@ -106,6 +107,16 @@ class Recorder:
         self._n_records = 0
         self._trace_cfg = None        # (every_n, log_dir)
         self._tracing = False
+        # flight-recorder ring: the last `keep_records` emitted records
+        # (step + out-of-band), kept regardless of sinks so a crash dump
+        # and the /records endpoint work even for a sink-less recorder
+        self.keep_records = int(keep_records)
+        self._ring: deque = deque(maxlen=max(self.keep_records, 1))
+        # liveness: wall time the current step opened / the last step
+        # closed — what /healthz and the stall watchdog read
+        self._step_started_wall: Optional[float] = None
+        self._last_step_end: Optional[float] = None
+        self._last_step_index: Optional[int] = None
 
     # -- enable/disable -------------------------------------------------- #
     @property
@@ -192,30 +203,44 @@ class Recorder:
                        ) -> Optional[Dict[str, float]]:
         """``{"p50": ..., "p95": ..., "p99": ...}`` over the pending
         histogram's sample window, or None if nothing was observed.
-        Long-running consumers (the serving engine) read this without a
-        step loop; ``end_step`` folds the same numbers into the step
-        record."""
-        with self._lock:
-            s = self._hist_samples.get(name)
-            if not s:
-                return None
-            samples = sorted(s)
+        Long-running consumers (the serving engine, the /metrics
+        endpoint) read this without a step loop; ``end_step`` folds the
+        same numbers into the step record.  Unknown or empty names
+        return ``None`` — never raise — so health endpoints can probe
+        histograms that may not have been observed yet."""
+        try:
+            with self._lock:
+                s = self._hist_samples.get(name)
+                samples = sorted(s) if s else None
+        except TypeError:        # unhashable name: nothing recorded under it
+            return None
+        if not samples:
+            return None
         return {f"p{q:g}": _quantile(samples, q) for q in qs}
 
     def hist_summary(self, name: str) -> Optional[Dict[str, float]]:
-        """count/min/max/mean plus p50/p95/p99 of the pending histogram."""
-        with self._lock:
-            h = self._hists.get(name)
-            if h is None:
-                return None
-            s = self._hist_samples.get(name)
-            samples = sorted(s) if s else []
+        """count/min/max/mean plus p50/p95/p99 of the pending histogram;
+        ``None`` (never an exception) for unknown/empty names."""
+        try:
+            with self._lock:
+                h = self._hists.get(name)
+                if h is None or not h[0]:
+                    return None
+                s = self._hist_samples.get(name)
+                samples = sorted(s) if s else []
+        except TypeError:        # unhashable name
+            return None
         out = {"count": int(h[0]), "min": h[1], "max": h[2],
                "mean": h[3] / max(h[0], 1), "sumsq": h[4]}
         if samples:
             out.update({f"p{q:g}": _quantile(samples, q)
                         for q in (50.0, 95.0, 99.0)})
         return out
+
+    def hist_names(self) -> List[str]:
+        """Names with at least one observation in the pending step."""
+        with self._lock:
+            return list(self._hists)
 
     def span(self, name: str):
         """Context manager timing a region into the current step."""
@@ -241,6 +266,7 @@ class Recorder:
         with self._lock:
             self._step = step
             self._step_t0 = time.perf_counter()
+            self._step_started_wall = time.time()
         self._maybe_start_trace(step)
 
     def end_step(self, step: Optional[int] = None,
@@ -292,7 +318,11 @@ class Recorder:
             self._hist_samples.clear()
             self._step = None
             self._step_t0 = None
+            self._step_started_wall = None
+            self._last_step_end = rec["time"]
+            self._last_step_index = step
             self._n_records += 1
+            self._ring.append(rec)
             sinks = list(self.sinks)
         for s in sinks:
             s.emit(rec)
@@ -305,7 +335,10 @@ class Recorder:
         if not self._enabled:
             return None
         rec = {"type": rec_type, "time": time.time(), **fields}
-        for s in list(self.sinks):
+        with self._lock:
+            self._ring.append(rec)
+            sinks = list(self.sinks)
+        for s in sinks:
             s.emit(rec)
         return rec
 
@@ -323,6 +356,7 @@ class Recorder:
             self._hist_samples.clear()
             self._step = None
             self._step_t0 = None
+            self._step_started_wall = None
 
     # -- on-demand XLA profiles ------------------------------------------ #
     def trace_every(self, n_steps: int, log_dir: str):
@@ -359,6 +393,48 @@ class Recorder:
             return {"counters": dict(self._counters),
                     "gauges": dict(self._gauges)}
 
+    def recent_records(self, n: Optional[int] = None,
+                       rec_type: Optional[str] = None
+                       ) -> List[Dict[str, Any]]:
+        """The last ``n`` records (all kept ones when ``n`` is None) from
+        the bounded ring, oldest first; ``rec_type`` filters by the
+        record's ``type`` field.  This is the crash flight recorder's
+        source and what the /records endpoint serves."""
+        with self._lock:
+            recs = list(self._ring)
+        if rec_type is not None:
+            recs = [r for r in recs if r.get("type") == rec_type]
+        if n is None:
+            return recs
+        # n=0 means none (not all); negative/oversized n must not wrap
+        n = max(int(n), 0)
+        return recs[max(len(recs) - n, 0):] if n else []
+
+    def step_age(self) -> Optional[float]:
+        """Seconds since the pending step opened (a step is in flight) or
+        since the last step record was cut; ``None`` before any step.
+        The liveness signal: a healthy loop keeps this small, a stalled
+        one lets it grow without bound."""
+        with self._lock:
+            started, ended = self._step_started_wall, self._last_step_end
+        now = time.time()
+        if started is not None:
+            return now - started
+        if ended is not None:
+            return now - ended
+        return None
+
+    def step_in_flight(self) -> bool:
+        """True between start_step and end_step/abort_step — i.e. the
+        current step_age() measures a PENDING step, not idle time."""
+        with self._lock:
+            return self._step_started_wall is not None
+
+    def last_step(self) -> Optional[int]:
+        """Index of the newest completed step (None before the first)."""
+        with self._lock:
+            return self._last_step_index
+
     def summary(self) -> str:
         snap = self.snapshot()
         return json.dumps(snap, sort_keys=True)
@@ -389,6 +465,8 @@ def _quantile(sorted_samples: List[float], q: float) -> float:
     already-sorted list; kept dependency-free so the recorder never
     imports numpy on the hot path."""
     n = len(sorted_samples)
+    if n == 0:
+        return float("nan")
     if n == 1:
         return sorted_samples[0]
     pos = (q / 100.0) * (n - 1)

@@ -17,7 +17,9 @@ A sink is anything with ``emit(record: dict)`` (and optionally
 from __future__ import annotations
 
 import json
+import math
 import os
+import re
 import threading
 from typing import Any, Dict, List, Optional
 
@@ -130,6 +132,105 @@ def _json_default(v):
         return float(v)
     except (TypeError, ValueError):
         return repr(v)
+
+
+# -- Prometheus exposition rendering -------------------------------------- #
+# Not a Sink: Prometheus *pulls*, so the /metrics endpoint
+# (observability.http) renders the Recorder's current snapshot per
+# scrape instead of pushing records anywhere.
+
+_PROM_NAME_BAD = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def prometheus_name(name: str, namespace: str = "bigdl") -> str:
+    """Sanitize a recorder metric name into a legal Prometheus metric
+    name ``[a-zA-Z_:][a-zA-Z0-9_:]*`` under ``namespace``."""
+    out = _PROM_NAME_BAD.sub("_", str(name))
+    if out and out[0].isdigit():
+        out = "_" + out
+    return f"{namespace}_{out}" if namespace else out
+
+
+def prometheus_escape_help(text: str) -> str:
+    r"""Escape a HELP line: ``\`` -> ``\\`` and newline -> ``\n``."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def prometheus_escape_label(value: str) -> str:
+    r"""Escape a label value: ``\``, ``"`` and newline."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_value(v) -> str:
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f)
+
+
+def render_prometheus(recorder, namespace: str = "bigdl") -> str:
+    """Render ``recorder``'s counters, gauges and pending histograms as
+    Prometheus text exposition format (version 0.0.4).
+
+    Counters keep their monotonic semantics (``_total`` suffix added
+    when missing), gauges map 1:1, and each histogram renders as a
+    ``summary``: ``{quantile="..."}`` samples over the bounded recent
+    window plus exact ``_sum``/``_count``.  Per-model
+    ``serving.queue_depth.<model>`` gauges fold into ONE metric with a
+    ``model`` label so a fleet of models can't explode the metric
+    namespace."""
+    snap = recorder.snapshot()
+    lines: List[str] = []
+
+    for name in sorted(snap["counters"]):
+        metric = prometheus_name(name, namespace)
+        if not metric.endswith("_total"):
+            metric += "_total"
+        lines.append(f"# HELP {metric} "
+                     f"{prometheus_escape_help('counter ' + name)}")
+        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"{metric} {_prom_value(snap['counters'][name])}")
+
+    queue_depths = {}
+    for name in sorted(snap["gauges"]):
+        if name.startswith("serving.queue_depth."):
+            queue_depths[name[len("serving.queue_depth."):]] = \
+                snap["gauges"][name]
+            continue
+        metric = prometheus_name(name, namespace)
+        lines.append(f"# HELP {metric} "
+                     f"{prometheus_escape_help('gauge ' + name)}")
+        lines.append(f"# TYPE {metric} gauge")
+        lines.append(f"{metric} {_prom_value(snap['gauges'][name])}")
+    if queue_depths:
+        metric = prometheus_name("serving.queue_depth", namespace)
+        lines.append(f"# HELP {metric} rows queued per model")
+        lines.append(f"# TYPE {metric} gauge")
+        for model in sorted(queue_depths):
+            lines.append(
+                f'{metric}{{model="{prometheus_escape_label(model)}"}} '
+                f"{_prom_value(queue_depths[model])}")
+
+    for name in sorted(recorder.hist_names()):
+        summ = recorder.hist_summary(name)
+        if not summ:
+            continue
+        metric = prometheus_name(name, namespace)
+        lines.append(f"# HELP {metric} "
+                     f"{prometheus_escape_help('histogram ' + name)}")
+        lines.append(f"# TYPE {metric} summary")
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            if key in summ:
+                lines.append(f'{metric}{{quantile="{q}"}} '
+                             f"{_prom_value(summ[key])}")
+        lines.append(f"{metric}_sum "
+                     f"{_prom_value(summ['mean'] * summ['count'])}")
+        lines.append(f"{metric}_count {int(summ['count'])}")
+
+    return "\n".join(lines) + "\n" if lines else ""
 
 
 def read_jsonl(path: str) -> List[Dict[str, Any]]:

@@ -31,7 +31,8 @@ from jax import lax
 from ..nn.module import Ctx, Module, migrate_legacy_names
 from ..data.dataset import DataSet
 from ..data.minibatch import MiniBatch
-from ..observability import Recorder, null_recorder, set_recorder
+from ..observability import (DivergenceError, Recorder, null_recorder,
+                             set_recorder)
 from .optim_method import OptimMethod, SGD
 from .trigger import Trigger
 from .validation import ValidationMethod
@@ -95,12 +96,30 @@ def _tree_sq(tree, axis_name=None, sharded_mask=None):
     return sum(jnp.sum(g.astype(jnp.float32) ** 2) for g in leaves) + 0.0
 
 
+def _tree_nonfinite(tree, axis_name=None, sharded_mask=None):
+    """Global count of non-finite elements over a pytree's leaves (same
+    FSDP psum semantics as :func:`_tree_sq`)."""
+    def cnt(g):
+        return jnp.sum(~jnp.isfinite(g.astype(jnp.float32))
+                       ).astype(jnp.float32)
+    leaves = jax.tree_util.tree_leaves(tree)
+    if axis_name is not None and sharded_mask is not None:
+        mask = jax.tree_util.tree_leaves(sharded_mask)
+        c_sh = sum(cnt(g) for g, m in zip(leaves, mask) if m) + 0.0
+        c_rep = sum(cnt(g) for g, m in zip(leaves, mask) if not m) + 0.0
+        return jax.lax.psum(c_sh, axis_name) + c_rep
+    return sum(cnt(g) for g in leaves) + 0.0
+
+
 def health_scalars(grads, old_params, new_params, axis_name=None,
                    sharded_mask=None):
     """Training-health scalars computed ON DEVICE inside the step (a few
     reductions — negligible next to the backward): gradient global-norm,
-    post-update parameter norm, update norm, and the update/param ratio
-    (the classic 1e-3-ish learning-rate sanity signal)."""
+    post-update parameter norm, update norm, the update/param ratio
+    (the classic 1e-3-ish learning-rate sanity signal), and the
+    non-finite gradient-element count the NaN/Inf sentinel reads —
+    folded into the jitted step so health checking adds no host sync
+    beyond the one telemetry already pays."""
     gn = jnp.sqrt(_tree_sq(grads, axis_name, sharded_mask))
     pn = jnp.sqrt(_tree_sq(new_params, axis_name, sharded_mask))
     diff = jax.tree_util.tree_map(
@@ -108,7 +127,9 @@ def health_scalars(grads, old_params, new_params, axis_name=None,
         new_params, old_params)
     un = jnp.sqrt(_tree_sq(diff, axis_name, sharded_mask))
     return {"grad_norm": gn, "param_norm": pn, "update_norm": un,
-            "update_ratio": un / jnp.maximum(pn, 1e-12)}
+            "update_ratio": un / jnp.maximum(pn, 1e-12),
+            "nonfinite_grads": _tree_nonfinite(grads, axis_name,
+                                               sharded_mask)}
 
 
 def mask_frozen_grads(model: Module, grads):
@@ -347,6 +368,12 @@ class Optimizer:
         self._telemetry_health = True
         self._with_health = False     # does the built step return health?
         self._seen_sigs = set()       # (shape, dtype) sigs → recompile detect
+        # training-health layer (observability.health)
+        self._health_monitor = None
+        self._flight = None
+        self._watchdog = None
+        self._http_server = None
+        self._max_rollbacks = 2
 
     # -- fluent config, reference API ----------------------------------- #
     def set_optim_method(self, method):
@@ -446,9 +473,87 @@ class Optimizer:
         self._recorder.trace_every(n_steps, log_dir)
         return self
 
+    def set_health(self, policy: str = "warn", flight_dir=None,
+                   max_rollbacks: int = 2, stall_factor=None,
+                   install_crash_hooks: bool = True, **monitor_kw):
+        """Enable numeric-health sentinels over every step record:
+        NaN/Inf in loss or gradients, loss-spike (EWMA z-score), and
+        gradient-norm explosion — the device checks ride the step's
+        existing ``health_scalars`` output, so nothing extra syncs the
+        host.  ``policy`` is ``"warn"`` / ``"record"`` / ``"raise"``
+        (:class:`~bigdl_tpu.observability.DivergenceError`) /
+        ``"rollback"`` (restore the last committed checkpoint — needs
+        ``set_checkpoint`` — at most ``max_rollbacks`` times).
+
+        ``flight_dir`` arms the crash flight recorder: the Recorder's
+        recent-record ring is dumped atomically to ``flight_<ts>.json``
+        there on divergence, unhandled exception, or SIGTERM
+        (``install_crash_hooks`` chains excepthook/SIGTERM without
+        displacing the PR-3 preemption handler).  ``stall_factor``
+        additionally starts a :class:`StallWatchdog` with that p99
+        multiplier.  Extra kwargs reach
+        :class:`~bigdl_tpu.observability.HealthMonitor`."""
+        from ..observability.health import (FlightRecorder, HealthMonitor,
+                                           StallWatchdog)
+        if self._recorder is None:
+            self.set_telemetry(Recorder())
+        rec = self._recorder
+        if flight_dir is not None:
+            if self._flight is not None:     # reconfigure: one hook chain
+                self._flight.uninstall()
+            self._flight = FlightRecorder(rec, flight_dir)
+            if install_crash_hooks:
+                self._flight.install()
+        self._health_monitor = HealthMonitor(
+            policy=policy, recorder=rec, flight=self._flight, **monitor_kw)
+        self._max_rollbacks = int(max_rollbacks)
+        if stall_factor:
+            if self._watchdog is not None:   # re-budget: one thread only
+                self._watchdog.stop()
+            self._watchdog = StallWatchdog(rec,
+                                           factor=float(stall_factor)).start()
+        if self._http_server is not None:   # set_health after serve_metrics
+            self._http_server.monitor = self._health_monitor
+            self._http_server.watchdog = self._watchdog \
+                or self._http_server.watchdog
+        return self
+
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1",
+                      watchdog: bool = True):
+        """Start the live introspection HTTP server for this trainer's
+        recorder — ``/metrics`` (Prometheus), ``/healthz``, ``/records``
+        — on a daemon thread.  ``port=0`` binds an ephemeral port (read
+        it back from the returned server's ``.port``).  ``watchdog``
+        starts a stall watchdog so ``/healthz`` flips unhealthy when
+        the step loop wedges.  Returns the
+        :class:`~bigdl_tpu.observability.IntrospectionServer` (call
+        ``.stop()`` to shut it down)."""
+        from ..observability.health import StallWatchdog
+        from ..observability.http import IntrospectionServer
+        if self._recorder is None:
+            self.set_telemetry(Recorder())
+        if watchdog and self._watchdog is None:
+            self._watchdog = StallWatchdog(self._recorder).start()
+        if self._http_server is not None:   # reconfigure: no leaked
+            self._http_server.stop()        # thread/socket on the old port
+        self._http_server = IntrospectionServer(
+            self._recorder, port=port, host=host,
+            watchdog=self._watchdog,
+            monitor=self._health_monitor).start()
+        return self._http_server
+
     def _rec(self) -> Recorder:
         return self._recorder if self._recorder is not None \
             else null_recorder()
+
+    def _wd_suspended(self):
+        """Suspend the stall watchdog around legitimate between-step
+        work (validation, checkpoint commit) — a long pass there is not
+        a wedged step loop."""
+        if self._watchdog is None:
+            from contextlib import nullcontext
+            return nullcontext()
+        return self._watchdog.suspended()
 
     def _telemetry_active(self) -> bool:
         """Should the step being built compute health scalars?  A
@@ -515,7 +620,7 @@ class Optimizer:
         # writer thread; `checkpoint/*` counters and the in-flight gauge
         # track it).  host_snapshot, not a view: the step loop donates
         # these buffers and would mutate a lazy copy mid-write.
-        with self._rec().span("checkpoint.blocking"):
+        with self._wd_suspended(), self._rec().span("checkpoint.blocking"):
             host = host_snapshot((params, opt_state, model_state))
         # iterator position + loop rng make mid-epoch resume EXACT: the
         # epoch-seeded shuffle reproduces the order, batch_in_epoch says
@@ -528,7 +633,8 @@ class Optimizer:
                 "epoch_boundary": bool(epoch_boundary)}
         payload = self._ckpt_shards(host) if mgr.layout == "manifest" \
             else host
-        mgr.save(payload, meta, tag, sync=sync)
+        with self._wd_suspended():      # sync commits block the loop
+            mgr.save(payload, meta, tag, sync=sync)
 
     def load_checkpoint(self):
         """Restore the newest INTACT checkpoint (manifest or legacy file
@@ -563,7 +669,7 @@ class Optimizer:
     def _validate(self, params, model_state):
         if self.val_dataset is None or not self.val_methods:
             return None
-        with self._rec().span("validation"):
+        with self._wd_suspended(), self._rec().span("validation"):
             return self._validate_inner(params, model_state)
 
     def _validate_inner(self, params, model_state):
@@ -675,7 +781,21 @@ class Optimizer:
         if self._resume_rng is not None:
             rng = self._resume_rng
         self._loop_rng = rng
+        if self._watchdog is not None:
+            self._watchdog.start()      # no-op when already polling
 
+        try:
+            return self._optimize_loop(params, opt_state, model_state,
+                                       rng, step_fn, build_step)
+        finally:
+            if self._watchdog is not None:
+                # even when the loop raises (divergence, exhausted
+                # retries): a dead loop is not a stalled one, and the
+                # daemon must not pin /healthz at 503 forever
+                self._watchdog.stop()
+
+    def _optimize_loop(self, params, opt_state, model_state, rng,
+                       step_fn, build_step) -> Module:
         stop = False
         retries = 0
         while not stop:
@@ -692,8 +812,38 @@ class Optimizer:
                 params, opt_state, model_state, rng, step_fn, stop = \
                     self._run_epoch(params, opt_state, model_state, rng,
                                     step_fn, build_step)
+            except DivergenceError as e:
+                # sentinel-raised: never routed into the generic retry —
+                # rollback restores the last COMMITTED checkpoint (the
+                # flight dump already happened at raise time)
+                mon = self._health_monitor
+                if (mon is None or mon.policy != "rollback"
+                        or mon.rollbacks >= self._max_rollbacks
+                        or self.checkpoint_path is None):
+                    raise
+                if self._ckpt_mgr is not None:
+                    self._ckpt_mgr.wait()   # an in-flight write may be
+                    # the newest intact checkpoint — let it commit
+                restored = self.load_checkpoint()
+                if restored is None:
+                    raise
+                mon.rollbacks += 1
+                mon.reset_statistics()
+                mon.mark_recovered()
+                print(f"[health] rollback {mon.rollbacks}/"
+                      f"{self._max_rollbacks}: {e}; resumed from "
+                      f"iteration {self.state.iteration}", flush=True)
+                params, opt_state, model_state = restored
+                if self._resume_rng is not None:
+                    rng = self._resume_rng
             except Exception as e:
                 if retries >= self.max_retries or self._retry_cache is None:
+                    if self._flight is not None:
+                        # leave a post-mortem before propagating (keyed:
+                        # the chained excepthook won't dump it twice)
+                        self._flight._dump_quietly(
+                            f"exception:{type(e).__name__}",
+                            {"error": repr(e)}, key=id(e))
                     raise
                 retries += 1
                 host, epoch, iteration, rng = self._retry_cache
@@ -837,9 +987,15 @@ class Optimizer:
             self.metrics.add("dispatch time", dispatch)
             if self.train_summary is not None:
                 self._write_train_summary(params, opt_state)
-            fired_stop = self._fire_mid_epoch(params, opt_state, model_state)
+            # step record (and its health-sentinel check) BEFORE the
+            # iteration triggers: a diverged step must raise before the
+            # checkpoint trigger can commit its poisoned params — a
+            # rollback that restores NaN weights is no rollback.  (Spans
+            # from a mid-epoch checkpoint/validation now fold into the
+            # NEXT step's record, same as epoch-boundary ones always did.)
             if rec.enabled:
                 self._emit_step_record(rec, size, loss, opt_state, health)
+            fired_stop = self._fire_mid_epoch(params, opt_state, model_state)
             if fired_stop:
                 stop = True
                 break
@@ -895,10 +1051,11 @@ class Optimizer:
     def _emit_step_record(self, rec: Recorder, size, loss, opt_state,
                           health):
         """Fold this iteration's telemetry into one step record."""
-        if not rec.sinks:
+        if not rec.sinks and self._health_monitor is None:
             # trace-only recorder: keep the step/trace cadence but skip
             # the scalars — recording `loss` would host-sync the device
-            # every step for a record nobody consumes
+            # every step for a record nobody consumes (an attached
+            # health monitor IS a consumer: it needs the floats)
             rec.end_step(self.state.iteration)
             return
         raw = rec.gauge_value("collective/bytes_per_step")
@@ -918,7 +1075,11 @@ class Optimizer:
         if health:
             for k, v in health.items():
                 rec.scalar(k, v)
-        rec.end_step(self.state.iteration)
+        record = rec.end_step(self.state.iteration)
+        if self._health_monitor is not None and record is not None:
+            # sentinel checks over the floats end_step already produced;
+            # raise/rollback policies surface DivergenceError from here
+            self._health_monitor.check_record(record)
 
     def _fire_mid_epoch(self, params, opt_state, model_state) -> bool:
         """iteration-level triggers; returns True if training should end."""
@@ -930,6 +1091,10 @@ class Optimizer:
             self.save_checkpoint(params, opt_state, model_state,
                                  tag=f"preempt_iter_{st.iteration}",
                                  sync=True)
+            if self._flight is not None:
+                # post-commit dump rides alongside the preemption
+                # checkpoint: its counters show the final commit
+                self._flight._dump_quietly("preemption")
             print(f"[preemption] final checkpoint at iteration "
                   f"{st.iteration} committed; stopping cleanly", flush=True)
             return True

@@ -34,7 +34,8 @@ from . import mesh as mesh_lib
 from .ring_attention import ring_attention_shmap
 from ..models.transformer import TransformerLM
 from ..observability import collectives as _acct
-from ..observability import null_recorder, set_recorder
+from ..observability import (DivergenceError, Recorder, null_recorder,
+                             set_recorder)
 from ..optim.optimizer import make_accum_grads
 
 
@@ -100,6 +101,12 @@ class SpmdTrainer:
         self._seen_sigs = set()
         self._ckpt_layout = "orbax"
         self._ckpt_mgr = None
+        # training-health layer (observability.health)
+        self._health_monitor = None
+        self._flight = None
+        self._watchdog = None
+        self._http_server = None
+        self._max_rollbacks = 2
 
     # ------------------------------------------------------------------ #
     def _param_shardings(self, params):
@@ -228,6 +235,83 @@ class SpmdTrainer:
                 self.params, self.opt_state = params, opt_state
         return self
 
+    def set_health(self, policy: str = "warn", flight_dir=None,
+                   max_rollbacks: int = 2, stall_factor=None,
+                   install_crash_hooks: bool = True, **monitor_kw):
+        """Numeric-health sentinels over each step record (same layer as
+        ``Optimizer.set_health``): NaN/Inf, loss-spike, grad-explosion
+        detection riding the step's existing device→host results;
+        ``policy="rollback"`` needs ``set_checkpoint`` and restores the
+        newest intact checkpoint at most ``max_rollbacks`` times during
+        ``fit()``.  ``flight_dir`` arms the crash flight recorder."""
+        from ..observability.health import (FlightRecorder, HealthMonitor,
+                                           StallWatchdog)
+        if self._recorder is None:
+            self.set_telemetry(Recorder())
+        rec = self._recorder
+        if flight_dir is not None:
+            if self._flight is not None:     # reconfigure: one hook chain
+                self._flight.uninstall()
+            self._flight = FlightRecorder(rec, flight_dir)
+            if install_crash_hooks:
+                self._flight.install()
+        self._health_monitor = HealthMonitor(
+            policy=policy, recorder=rec, flight=self._flight, **monitor_kw)
+        self._max_rollbacks = int(max_rollbacks)
+        if stall_factor:
+            if self._watchdog is not None:
+                self._watchdog.stop()
+            self._watchdog = StallWatchdog(rec,
+                                           factor=float(stall_factor)).start()
+        if self._http_server is not None:
+            self._http_server.monitor = self._health_monitor
+            self._http_server.watchdog = self._watchdog \
+                or self._http_server.watchdog
+        return self
+
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1",
+                      watchdog: bool = True):
+        """Live introspection server (``/metrics`` ``/healthz``
+        ``/records``) for this trainer's recorder; see
+        ``Optimizer.serve_metrics``.  Returns the server."""
+        from ..observability.health import StallWatchdog
+        from ..observability.http import IntrospectionServer
+        if self._recorder is None:
+            self.set_telemetry(Recorder())
+        if watchdog and self._watchdog is None:
+            self._watchdog = StallWatchdog(self._recorder).start()
+        if self._http_server is not None:   # reconfigure: no leaked
+            self._http_server.stop()        # thread/socket on the old port
+        self._http_server = IntrospectionServer(
+            self._recorder, port=port, host=host,
+            watchdog=self._watchdog,
+            monitor=self._health_monitor).start()
+        return self._http_server
+
+    def straggler_report(self):
+        """Per-host step-time attribution — the "which worker drags the
+        synchronous step" answer.  Each process's recorder ring only
+        holds its OWN records, so under multi-host this does one
+        on-demand ``process_allgather`` of the local mean step time
+        (never on the step path) and attributes over the gathered
+        fleet; single-host (or merged-ring) setups attribute over the
+        local records and return None when there's nothing per-host."""
+        from ..observability.health import attribute_stragglers
+        recs = self._rec().recent_records(rec_type="step")
+        if jax.process_count() > 1:
+            durs = [r["dur"] for r in recs
+                    if isinstance(r.get("dur"), (int, float))]
+            if not durs:
+                return None
+            from jax.experimental import multihost_utils
+            gathered = np.asarray(multihost_utils.process_allgather(
+                jnp.asarray([float(np.mean(durs))]))).reshape(-1)
+            return attribute_stragglers(
+                [{"type": "step", "step": 0, "dur": float(m),
+                  "scalars": {"host": h}}
+                 for h, m in enumerate(gathered)])
+        return attribute_stragglers(self._rec().recent_records())
+
     def _rec(self):
         return self._recorder if self._recorder is not None \
             else null_recorder()
@@ -313,7 +397,13 @@ class SpmdTrainer:
             if health:
                 for k, v in health.items():
                     rec.scalar(k, v)
-            rec.end_step(self._step_count - 1)
+            if jax.process_count() > 1:
+                # per-host step records: what the stall watchdog's
+                # straggler attribution groups by
+                rec.scalar("host", jax.process_index())
+            record = rec.end_step(self._step_count - 1)
+            if self._health_monitor is not None and record is not None:
+                self._health_monitor.check_record(record)
         return loss
 
     def evaluate(self, batches, steps: Optional[int] = None):
@@ -641,11 +731,34 @@ class SpmdTrainer:
         ckpt = getattr(self, "_ckpt", None)
         summary = getattr(self, "_train_summary", None)
         t0 = time.time()
+        if self._watchdog is not None:
+            self._watchdog.start()      # re-arms after a previous fit()
         try:
             for i, (tokens, targets) in enumerate(batches):
                 if steps is not None and i >= steps:
                     break
-                loss = self.step(tokens, targets)
+                try:
+                    loss = self.step(tokens, targets)
+                except DivergenceError as e:
+                    mon = self._health_monitor
+                    if (mon is None or mon.policy != "rollback"
+                            or ckpt is None
+                            or mon.rollbacks >= self._max_rollbacks):
+                        raise
+                    if self._ckpt_mgr is not None:
+                        self._ckpt_mgr.wait()   # let an in-flight write
+                        # commit: it may be the newest intact checkpoint
+                    try:
+                        self.load_checkpoint(ckpt[0])
+                    except Exception:
+                        raise e     # no restorable checkpoint: diverge
+                    mon.rollbacks += 1
+                    mon.reset_statistics()
+                    mon.mark_recovered()
+                    print(f"[health] rollback {mon.rollbacks}/"
+                          f"{self._max_rollbacks}: {e}; resumed from "
+                          f"step {self._step_count}", flush=True)
+                    continue
                 if log_every and (i + 1) % log_every == 0:
                     print(f"step {i + 1}: loss={float(loss):.4f} "
                           f"({(i + 1) / (time.time() - t0):.2f} it/s)")
@@ -669,4 +782,8 @@ class SpmdTrainer:
                 # drain the async writer: every triggered checkpoint is
                 # committed and durable when fit() returns
                 self._ckpt_mgr.wait()
+            if self._watchdog is not None:
+                # a finished loop is not a stalled one: /healthz scrapes
+                # after fit() must not flag the growing idle step age
+                self._watchdog.stop()
         return [float(l) for l in losses]

@@ -73,6 +73,7 @@ class ServingEngine:
         self._threads: Dict[str, threading.Thread] = {}
         self._lock = threading.Lock()
         self._closed = False
+        self._http_server = None
         # if the engine is dropped without shutdown(), closing its
         # queues unparks the (weakly-bound) worker threads so they exit
         # instead of waiting forever on work that can never arrive
@@ -100,6 +101,20 @@ class ServingEngine:
             entry.warmed = True
         return self
 
+    def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
+        """Start the live introspection server for this engine's
+        recorder: ``/metrics`` (Prometheus — request/shed/recompile
+        counters, per-model queue-depth gauges, latency/batch-fill
+        summaries), ``/healthz`` (includes the shed rate), ``/records``.
+        ``port=0`` binds an ephemeral port (the returned server's
+        ``.port``); ``shutdown()`` stops it."""
+        from ..observability.http import IntrospectionServer
+        if self._http_server is not None:   # reconfigure: no leaked
+            self._http_server.stop()        # thread/socket on the old port
+        self._http_server = IntrospectionServer(
+            self.recorder, port=port, host=host).start()
+        return self._http_server
+
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop admissions, then either finish queued work (``drain=True``,
         graceful) or fail it fast with :class:`EngineClosedError`."""
@@ -107,6 +122,9 @@ class ServingEngine:
             self._closed = True
             queues = dict(self._queues)
             threads = dict(self._threads)
+        if self._http_server is not None:
+            self._http_server.stop()
+            self._http_server = None
         for q in queues.values():
             q.close()
         if not drain:

@@ -77,15 +77,43 @@ class EventWriter:
             self._f.close()
 
 
-def read_events(log_dir: str) -> List[bytes]:
+def _frame_at(data: bytes, i: int):
+    """Try to frame one TFRecord at offset ``i``: returns
+    ``(payload, next_offset)`` when both masked CRCs verify, else None."""
+    if i + 12 > len(data):
+        return None
+    header = data[i:i + 8]
+    (length,) = struct.unpack("<Q", header)
+    (hcrc,) = struct.unpack("<I", data[i + 8:i + 12])
+    if masked_crc32c(header) != hcrc:
+        return None
+    if i + 12 + length + 4 > len(data):
+        return None
+    payload = data[i + 12:i + 12 + length]
+    (pcrc,) = struct.unpack("<I", data[i + 12 + length:i + 16 + length])
+    if masked_crc32c(payload) != pcrc:
+        return None
+    return payload, i + 12 + length + 4
+
+
+def read_events(log_dir: str, salvage: bool = False):
     """All event payloads from every tfevents file in a dir, in file order.
 
     Both masked CRCs (header and payload) are verified per record, and
-    reading a file STOPS at the first corrupt record — a flipped length
-    would otherwise misframe the rest of the file into garbage payloads
-    (the TFRecord framing's whole point; ≙ tensorflow's
-    RecordReader::ReadRecord checksum handling)."""
+    by default reading a file STOPS at the first corrupt record — a
+    flipped length would otherwise misframe the rest of the file into
+    garbage payloads (the TFRecord framing's whole point; ≙ tensorflow's
+    RecordReader::ReadRecord checksum handling).
+
+    ``salvage=True`` keeps going instead: each corrupt region is counted
+    and skipped by scanning forward for the next offset whose header CRC
+    (and payload CRC) verify — the frame check IS the resync condition,
+    so a random 12-byte window almost never false-positives.  Returns
+    ``(payloads, n_corrupt)`` in this mode.  Post-mortem readers (e.g.
+    inspecting the telemetry of a hard-killed run) need the tail records
+    *after* a torn write, which strict mode by design never yields."""
     payloads = []
+    n_corrupt = 0
     for fname in sorted(os.listdir(log_dir)):
         if "tfevents" not in fname:
             continue
@@ -93,21 +121,21 @@ def read_events(log_dir: str) -> List[bytes]:
             data = f.read()
         i = 0
         while i + 12 <= len(data):
-            header = data[i:i + 8]
-            (length,) = struct.unpack("<Q", header)
-            (hcrc,) = struct.unpack("<I", data[i + 8:i + 12])
-            if masked_crc32c(header) != hcrc:
-                break  # corrupt length: nothing after it can be framed
-            if i + 12 + length + 4 > len(data):
-                break  # truncated tail record
-            payload = data[i + 12:i + 12 + length]
-            (pcrc,) = struct.unpack(
-                "<I", data[i + 12 + length:i + 16 + length])
-            if masked_crc32c(payload) != pcrc:
-                break  # corrupt payload
-            payloads.append(payload)
-            i += 12 + length + 4
-    return payloads
+            framed = _frame_at(data, i)
+            if framed is not None:
+                payloads.append(framed[0])
+                i = framed[1]
+                continue
+            if not salvage:
+                break
+            n_corrupt += 1
+            j = i + 1
+            while j + 12 <= len(data):
+                if _frame_at(data, j) is not None:
+                    break
+                j += 1
+            i = j           # loop re-frames at j, or falls off the end
+    return (payloads, n_corrupt) if salvage else payloads
 
 
 def read_scalar(log_dir: str, tag: str) -> List[Tuple[int, float, float]]:

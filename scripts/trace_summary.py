@@ -20,6 +20,15 @@ Two subcommands:
 
         python scripts/trace_summary.py steps /tmp/telemetry.jsonl [last_n]
 
+  health             health events and crash flight-recorder dumps as
+                     a table (condition, step, offending metric, action
+                     taken).  Accepts telemetry JSONL files,
+                     flight_<ts>.json dumps, or directories (scanned
+                     for both):
+
+        python scripts/trace_summary.py health /tmp/telemetry.jsonl
+        python scripts/trace_summary.py health /tmp/flight_dir
+
 CPU-only (no device access), so it is safe to run while the tunnel is
 wedged.
 """
@@ -188,6 +197,104 @@ def summarize_steps(steps, out=print, ck_summary=None):
             out(f"  {k:<34} {shown}")
 
 
+def load_health(paths):
+    """-> (events, flights) from telemetry JSONL files and
+    flight_<ts>.json dumps; a directory argument is scanned for both.
+    ``events`` are (source, record) health_event pairs — standalone
+    records from JSONL streams plus the ones embedded in each flight
+    dump's ring; ``flights`` are (path, dump) pairs."""
+    expanded = []
+    for p in paths:
+        if os.path.isdir(p):
+            expanded += sorted(glob.glob(os.path.join(p, "flight_*.json")))
+            expanded += sorted(glob.glob(os.path.join(p, "*.jsonl")))
+        else:
+            expanded.append(p)
+    events, flights = [], []
+    for p in expanded:
+        src = os.path.basename(p)
+        if p.endswith(".jsonl"):
+            with open(p) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("type") == "health_event":
+                        events.append((src, rec))
+            continue
+        try:
+            with open(p) as f:
+                dump = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"  (skipping {p}: {e})")
+            continue
+        if dump.get("type") != "flight":
+            continue
+        flights.append((p, dump))
+        for ev in dump.get("events", []):
+            events.append((src, ev))
+        for rec in dump.get("records", []):
+            if rec.get("type") == "health_event":
+                events.append((src, rec))
+    return events, flights
+
+
+def summarize_health(events, flights, out=print):
+    """Render the health-event table and flight-dump summaries."""
+    if not events and not flights:
+        out("no health events or flight dumps found")
+        return
+    if events:
+        # one event can appear both standalone and inside a dump's
+        # ring: dedupe on (condition, step, value) — value stringified,
+        # since NaN != NaN would defeat the dedupe for exactly the
+        # non_finite_loss events this table exists for
+        seen, rows = set(), []
+        for src, ev in events:
+            key = (ev.get("condition"), ev.get("step"),
+                   str(ev.get("value")))
+            if key in seen:
+                continue
+            seen.add(key)
+            rows.append((src, ev))
+        out("== health events ==")
+        out(f"  {'step':>6} {'condition':<18} {'metric':<16} "
+            f"{'value':>12} {'threshold':>12} {'action':<9} source")
+        for src, ev in rows:
+            step = ev.get("step")
+            thr = ev.get("threshold")
+            val = ev.get("value")
+            extra = (f"  straggler host {ev['straggler']} "
+                     f"({ev.get('skew', 0):.2f}x)"
+                     if "straggler" in ev else "")
+            out(f"  {'-' if step is None else step:>6} "
+                f"{ev.get('condition', '?'):<18} "
+                f"{ev.get('metric', '?'):<16} "
+                f"{'-' if val is None else format(val, '>12.5g'):>12} "
+                f"{'-' if thr is None else format(thr, '>12.5g'):>12} "
+                f"{ev.get('action', '?'):<9} {src}{extra}")
+    if flights:
+        out("\n== flight-recorder dumps ==")
+        for p, d in flights:
+            n_rec = len(d.get("records", []))
+            out(f"  {os.path.basename(p)}: reason={d.get('reason')}  "
+                f"last_step={d.get('last_step')}  "
+                f"ring_records={n_rec}  "
+                f"health_events={d.get('counters', {}).get('health/events', 0):.0f}")
+
+
+def main_health(argv):
+    if not argv:
+        raise SystemExit("usage: trace_summary.py health "
+                         "<telemetry.jsonl | flight.json | dir>...")
+    events, flights = load_health(argv)
+    summarize_health(events, flights)
+
+
 def main_xplane(argv):
     path = argv[0] if argv else "/tmp/tpu_trace"
     top_n = int(argv[1]) if len(argv) > 1 else 25
@@ -218,6 +325,8 @@ def main():
     argv = sys.argv[1:]
     if argv and argv[0] == "steps":
         main_steps(argv[1:])
+    elif argv and argv[0] == "health":
+        main_health(argv[1:])
     elif argv and argv[0] == "xplane":
         main_xplane(argv[1:])
     else:           # back-compat: bare path = xplane trace dir

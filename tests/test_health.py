@@ -1,0 +1,828 @@
+"""Training-health layer (ISSUE 4 tentpole): Prometheus rendering,
+the introspection HTTP server, NaN/stall sentinels, the crash flight
+recorder, and their wiring through Optimizer / ServingEngine."""
+import glob
+import json
+import math
+import re
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.data.dataset import DataSet
+from bigdl_tpu.data.minibatch import MiniBatch
+from bigdl_tpu.observability import (DivergenceError, FlightRecorder,
+                                     HealthMonitor, InMemorySink,
+                                     IntrospectionServer, Recorder,
+                                     StallWatchdog, render_prometheus)
+from bigdl_tpu.observability.health.flight import read_flight
+from bigdl_tpu.observability.health.watchdog import attribute_stragglers
+from bigdl_tpu.observability.sinks import (prometheus_escape_help,
+                                           prometheus_escape_label,
+                                           prometheus_name)
+from bigdl_tpu.optim import Adam, LocalOptimizer, SGD, Trigger
+
+
+def _get(url):
+    """(status, body) without raising on 5xx."""
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# --------------------------------------------------------------------- #
+# Recorder: ring buffer, step age, histogram never-raise regressions    #
+# --------------------------------------------------------------------- #
+def test_recent_records_ring_is_bounded_and_ordered():
+    rec = Recorder(annotate=False, keep_records=4)
+    for i in range(7):
+        rec.start_step(i)
+        rec.scalar("loss", float(i))
+        rec.end_step(i)
+    recs = rec.recent_records()
+    assert [r["step"] for r in recs] == [3, 4, 5, 6]
+    assert rec.recent_records(2)[0]["step"] == 5
+    rec.emit_record("health_event", condition="stall", step=6)
+    assert [r["type"] for r in rec.recent_records(rec_type="health_event")] \
+        == ["health_event"]
+    assert rec.last_step() == 6
+
+
+def test_recent_records_edge_counts():
+    rec = Recorder(annotate=False)
+    for i in range(3):
+        rec.start_step(i)
+        rec.end_step(i)
+    assert rec.recent_records(0) == []          # 0 means none, not all
+    assert rec.recent_records(-5) == []         # negative never wraps
+    assert len(rec.recent_records(99)) == 3     # oversized never wraps
+    assert len(rec.recent_records()) == 3
+
+
+def test_step_age_tracks_pending_and_completed_steps():
+    rec = Recorder(annotate=False)
+    assert rec.step_age() is None
+    rec.start_step(0)
+    time.sleep(0.02)
+    assert rec.step_age() >= 0.02          # in-flight step counts
+    rec.end_step(0)
+    age = rec.step_age()
+    assert age is not None and age < 1.0   # now measured from end_step
+
+
+def test_hist_accessors_never_raise_for_unknown_or_empty_names():
+    rec = Recorder(annotate=False)
+    assert rec.hist_quantiles("never_observed") is None
+    assert rec.hist_summary("never_observed") is None
+    rec.observe("h", 1.0)
+    rec.start_step(0)
+    rec.end_step(0)                        # clears pending histograms
+    assert rec.hist_quantiles("h") is None
+    assert rec.hist_summary("h") is None
+    # unhashable / bizarre names degrade to None, never a TypeError
+    assert rec.hist_quantiles(["not", "hashable"]) is None
+    assert rec.hist_summary({"nor": "this"}) is None
+    # disabled recorder: same contract
+    off = Recorder(enabled=False, annotate=False)
+    off.observe("h", 1.0)
+    assert off.hist_quantiles("h") is None and off.hist_summary("h") is None
+    # empty quantile tuple is a no-op, not an error
+    rec.observe("h2", 2.0)
+    assert rec.hist_quantiles("h2", qs=()) == {}
+
+
+# --------------------------------------------------------------------- #
+# Prometheus renderer                                                   #
+# --------------------------------------------------------------------- #
+_PROM_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_PROM_COMMENT = re.compile(
+    rf"^# (HELP|TYPE) {_PROM_NAME}( .*)?$")
+_PROM_SAMPLE = re.compile(
+    rf'^{_PROM_NAME}(\{{{_PROM_NAME}="(?:[^"\\]|\\.)*"'
+    rf'(,{_PROM_NAME}="(?:[^"\\]|\\.)*")*\}})? '
+    r"(NaN|[+-]Inf|[+-]?[0-9]+(\.[0-9]+)?([eE][+-]?[0-9]+)?)$")
+
+
+def _assert_valid_exposition(text):
+    """Golden-format assertion: every line must parse as a comment or a
+    sample of the Prometheus text exposition format."""
+    typed = {}
+    for line in text.strip().splitlines():
+        if line.startswith("#"):
+            assert _PROM_COMMENT.match(line), f"bad comment line: {line!r}"
+            parts = line.split(" ", 3)
+            if parts[1] == "TYPE":
+                typed[parts[2]] = parts[3]
+        else:
+            assert _PROM_SAMPLE.match(line), f"bad sample line: {line!r}"
+    return typed
+
+
+def test_render_prometheus_types_and_golden_parse():
+    rec = Recorder(annotate=False)
+    rec.inc("records_total", 64)
+    rec.inc("serving.requests", 3)          # gains the _total suffix
+    rec.gauge("dataloader/queue_depth", 2)
+    rec.gauge("serving.queue_depth.mnist", 5)
+    rec.observe("serving.latency_ms", 1.0)
+    rec.observe("serving.latency_ms", 3.0)
+    text = render_prometheus(rec)
+    typed = _assert_valid_exposition(text)
+    assert typed["bigdl_records_total"] == "counter"
+    assert typed["bigdl_serving_requests_total"] == "counter"
+    assert typed["bigdl_dataloader_queue_depth"] == "gauge"
+    assert typed["bigdl_serving_queue_depth"] == "gauge"
+    assert typed["bigdl_serving_latency_ms"] == "summary"
+    assert 'bigdl_serving_queue_depth{model="mnist"} 5.0' in text
+    assert 'bigdl_serving_latency_ms{quantile="0.5"} 2.0' in text
+    assert "bigdl_serving_latency_ms_count 2" in text
+    assert "bigdl_serving_latency_ms_sum 4.0" in text
+
+
+def test_render_prometheus_escaping_and_sanitization():
+    assert prometheus_name("serving.latency_ms") == "bigdl_serving_latency_ms"
+    assert prometheus_name("a/b-c d", namespace="") == "a_b_c_d"
+    assert prometheus_name("0weird", namespace="") == "_0weird"
+    assert prometheus_escape_help("a\\b\nc") == "a\\\\b\\nc"
+    assert prometheus_escape_label('sa"y\\hi\n') == 'sa\\"y\\\\hi\\n'
+    rec = Recorder(annotate=False)
+    rec.gauge('serving.queue_depth.we"ird\\model', 1)
+    rec.inc("weird metric-name/with everything", 2)
+    text = render_prometheus(rec)
+    _assert_valid_exposition(text)
+    assert '{model="we\\"ird\\\\model"}' in text
+
+
+def test_render_prometheus_nonfinite_values():
+    rec = Recorder(annotate=False)
+    rec.gauge("g_nan", float("nan"))
+    rec.gauge("g_inf", float("inf"))
+    text = render_prometheus(rec)
+    _assert_valid_exposition(text)
+    assert "bigdl_g_nan NaN" in text
+    assert "bigdl_g_inf +Inf" in text
+
+
+def test_render_prometheus_empty_recorder():
+    assert render_prometheus(Recorder(annotate=False)) == ""
+
+
+# --------------------------------------------------------------------- #
+# HealthMonitor sentinels                                               #
+# --------------------------------------------------------------------- #
+def _step_record(step, **scalars):
+    return {"type": "step", "step": step, "scalars": scalars}
+
+
+def test_monitor_trips_on_nonfinite_loss_and_grads():
+    rec = Recorder(annotate=False)
+    mon = HealthMonitor(policy="record", recorder=rec)
+    assert mon.check_record(_step_record(0, loss=1.0, grad_norm=1.0)) == []
+    evs = mon.check_record(_step_record(1, loss=float("nan")))
+    assert [e["condition"] for e in evs] == ["non_finite_loss"]
+    evs = mon.check_record(
+        _step_record(2, loss=1.0, grad_norm=float("inf")))
+    assert [e["condition"] for e in evs] == ["non_finite_grads"]
+    evs = mon.check_record(
+        _step_record(3, loss=1.0, grad_norm=1.0, nonfinite_grads=4.0))
+    assert [e["condition"] for e in evs] == ["non_finite_grads"]
+    # events mirrored to the recorder: counters + out-of-band records
+    assert rec.counter_value("health/events") == 3
+    assert len(rec.recent_records(rec_type="health_event")) == 3
+    assert not mon.healthy
+
+
+def test_monitor_loss_spike_zscore_and_reset():
+    mon = HealthMonitor(policy="record", warmup_steps=10, spike_zscore=6.0)
+    rng = np.random.RandomState(0)
+    for i in range(30):
+        assert mon.check_record(
+            _step_record(i, loss=2.0 + 0.05 * rng.randn())) == []
+    evs = mon.check_record(_step_record(30, loss=40.0))
+    assert [e["condition"] for e in evs] == ["loss_spike"]
+    assert evs[0]["value"] > 6.0
+    assert mon.healthy                    # advisory by default, not fatal
+    mon.reset_statistics()                # post-rollback: baseline forgotten
+    assert mon.check_record(_step_record(31, loss=40.0)) == []
+
+
+def test_monitor_grad_explosion_absolute_and_relative():
+    mon = HealthMonitor(policy="record", grad_norm_limit=10.0)
+    evs = mon.check_record(_step_record(0, loss=1.0, grad_norm=11.0))
+    assert [e["condition"] for e in evs] == ["grad_explosion"]
+    mon = HealthMonitor(policy="record", warmup_steps=5,
+                        grad_explosion_factor=50.0)
+    for i in range(10):
+        assert mon.check_record(
+            _step_record(i, loss=1.0, grad_norm=1.0)) == []
+    evs = mon.check_record(_step_record(10, loss=1.0, grad_norm=200.0))
+    assert [e["condition"] for e in evs] == ["grad_explosion"]
+
+
+def test_monitor_raise_policy_and_recovery_bookkeeping():
+    mon = HealthMonitor(policy="raise")
+    with pytest.raises(DivergenceError) as ei:
+        mon.check_record(_step_record(7, loss=float("inf")))
+    assert ei.value.events[0]["step"] == 7
+    assert not mon.healthy
+    mon.mark_recovered()
+    assert mon.healthy
+    with pytest.raises(ValueError):
+        HealthMonitor(policy="explode")
+
+
+def test_monitor_ignores_non_step_records():
+    mon = HealthMonitor(policy="raise")
+    assert mon.check_record({"type": "health_event"}) == []
+    assert mon.check_record({"type": "step", "scalars": None}) == []
+
+
+# --------------------------------------------------------------------- #
+# StallWatchdog                                                         #
+# --------------------------------------------------------------------- #
+def _seed_steps(rec, n=10, dur=0.01):
+    for i in range(n):
+        r = {"type": "step", "step": i, "dur": dur, "scalars": {}}
+        rec._ring.append(r)
+
+
+def test_watchdog_budget_and_stall_flip():
+    rec = Recorder(annotate=False)
+    wd = StallWatchdog(rec, factor=2.0, min_history=5, floor_seconds=0.05)
+    assert wd.budget() is None             # no history yet
+    _seed_steps(rec, n=10, dur=0.01)
+    assert wd.budget() == pytest.approx(0.05)   # floored
+    rec.start_step(10)                     # a step opens ... and wedges
+    assert not wd.check_once()             # age < budget so far
+    time.sleep(0.08)
+    assert wd.check_once()                 # past p99*k: stalled
+    assert rec.gauge_value("health/stalled") == 1
+    evs = rec.recent_records(rec_type="health_event")
+    assert evs and evs[-1]["condition"] == "stall"
+    rec.end_step(10)                       # loop resumed
+    assert not wd.check_once()
+    assert rec.gauge_value("health/stalled") == 0
+    assert rec.counter_value("health/stall_seconds") > 0
+    assert wd.stall_episodes == 1
+
+
+def test_watchdog_thread_detects_stall_from_background():
+    rec = Recorder(annotate=False)
+    _seed_steps(rec, n=10, dur=0.005)
+    wd = StallWatchdog(rec, factor=2.0, min_history=5, floor_seconds=0.05,
+                       poll_interval=0.02).start()
+    try:
+        rec.start_step(10)                 # wedge an in-flight step
+        deadline = time.time() + 5.0
+        while not wd.stalled and time.time() < deadline:
+            time.sleep(0.02)
+        assert wd.stalled
+    finally:
+        wd.stop()
+
+
+def test_watchdog_stop_deactivates_the_stall_verdict():
+    """A finished training loop is not a stalled one: after stop(),
+    direct check_once calls (the /healthz scrape path) must report
+    healthy no matter how large the idle step age grows."""
+    rec = Recorder(annotate=False)
+    _seed_steps(rec, n=10, dur=0.005)
+    wd = StallWatchdog(rec, factor=2.0, min_history=5, floor_seconds=0.03)
+    rec.start_step(10)
+    time.sleep(0.05)
+    assert wd.check_once()                 # wedged while active
+    wd.stop()                              # loop finished
+    assert not wd.check_once()             # idle age no longer a stall
+    assert rec.gauge_value("health/stalled") == 0
+    wd.start()                             # next run re-arms
+    assert wd.check_once()
+    wd.stop()
+
+
+def test_watchdog_suspension_covers_between_step_work():
+    """A long validation/checkpoint pass between steps must not read as
+    a wedged loop: suspended() masks it and re-baselines the idle age
+    on resume so the elapsed time can't trip the budget either."""
+    rec = Recorder(annotate=False)
+    _seed_steps(rec, n=10, dur=0.005)
+    rec.start_step(10)
+    rec.end_step(10)                    # real step: liveness clock runs
+    wd = StallWatchdog(rec, factor=2.0, min_history=5, floor_seconds=0.03)
+    with wd.suspended():               # "validation" longer than budget
+        time.sleep(0.06)
+        assert not wd.check_once()
+    assert not wd.check_once()          # resumed: age re-baselined
+    time.sleep(0.06)                    # ... but real idle still counts
+    assert wd.check_once()
+    rec.start_step(11)
+    rec.end_step(11)                    # a fresh step clears the stall
+    assert not wd.check_once()
+
+
+def test_straggler_attribution_from_per_host_records():
+    recs = []
+    for step in range(20):
+        for host, dur in ((0, 0.010), (1, 0.011), (2, 0.031)):
+            recs.append({"type": "step", "step": step, "dur": dur,
+                         "scalars": {"host": host}})
+    rep = attribute_stragglers(recs)
+    assert rep["straggler"] == 2
+    assert rep["skew"] == pytest.approx(0.031 / 0.011, rel=1e-6)
+    assert set(rep["hosts"]) == {0, 1, 2}
+    # single-host records: no attribution
+    assert attribute_stragglers(
+        [{"type": "step", "step": 0, "dur": 0.01,
+          "scalars": {"host": 0}}]) is None
+    assert attribute_stragglers([]) is None
+
+
+# --------------------------------------------------------------------- #
+# FlightRecorder                                                        #
+# --------------------------------------------------------------------- #
+def test_flight_dump_roundtrip_and_dedupe(tmp_path):
+    rec = Recorder(annotate=False, keep_records=8)
+    for i in range(12):
+        rec.start_step(i)
+        rec.scalar("loss", float(i))
+        rec.end_step(i)
+    rec.inc("records_total", 12)
+    fr = FlightRecorder(rec, str(tmp_path))
+    p = fr.dump("unit_test", {"note": "hello"})
+    d = read_flight(p)
+    assert d["type"] == "flight" and d["reason"] == "unit_test"
+    assert d["note"] == "hello"
+    assert d["last_step"] == 11
+    assert [r["step"] for r in d["records"]] == list(range(4, 12))
+    assert d["counters"]["records_total"] == 12
+    # no tmp litter from the atomic write
+    assert not list(tmp_path.glob("*.tmp-*"))
+    # keyed dumps dedupe; unkeyed ones never collide on the same ms
+    assert fr.dump("again", key="k1") is not None
+    assert fr.dump("again", key="k1") is None
+    assert fr.dump("again") != fr.dump("again")
+    assert len(fr.dumps) == 4    # initial + keyed-once + two unkeyed
+
+
+def test_flight_excepthook_chain_dumps_and_restores(tmp_path):
+    rec = Recorder(annotate=False)
+    rec.start_step(0)
+    rec.end_step(0)
+    fr = FlightRecorder(rec, str(tmp_path))
+    calls = []
+    prev = sys.excepthook
+    sys.excepthook = lambda *a: calls.append(a)
+    try:
+        fr.install(signals=())
+        err = RuntimeError("boom")
+        sys.excepthook(RuntimeError, err, None)
+        assert len(calls) == 1             # previous hook still ran
+        dumps = list(tmp_path.glob("flight_*.json"))
+        assert len(dumps) == 1
+        assert read_flight(str(dumps[0]))["reason"] == "unhandled:RuntimeError"
+        fr.uninstall()
+        assert sys.excepthook is not prev  # our lambda is restored
+        sys.excepthook(RuntimeError, err, None)
+        assert len(calls) == 2 and len(
+            list(tmp_path.glob("flight_*.json"))) == 1
+    finally:
+        sys.excepthook = prev
+
+
+def test_flight_sigterm_default_disposition_still_terminates(tmp_path):
+    """With no prior SIGTERM handler, the chained hook must dump and
+    then let the DEFAULT disposition terminate the process — dump-and-
+    ignore would eat the scheduler's kill grace window."""
+    import subprocess
+    code = f"""
+import os, signal, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+from bigdl_tpu.observability import FlightRecorder, Recorder
+rec = Recorder(annotate=False)
+rec.start_step(0); rec.end_step(0)
+FlightRecorder(rec, {str(tmp_path)!r}).install()
+os.kill(os.getpid(), signal.SIGTERM)
+time.sleep(5)
+print("SURVIVED")           # must never be reached
+"""
+    p = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=60)
+    assert "SURVIVED" not in p.stdout
+    assert p.returncode == -15             # killed by SIGTERM
+    assert len(list(tmp_path.glob("flight_*.json"))) == 1
+
+
+@pytest.mark.parametrize("flight_first", [True, False])
+def test_flight_and_preemption_sigterm_chain_both_orders(tmp_path,
+                                                         flight_first):
+    """Whichever of the flight recorder and the PR-3 preemption handler
+    installs second, one SIGTERM must BOTH set the preemption flag (the
+    final checkpoint path) and write a flight dump — and the process
+    must survive to do that work (the flight handler's default-
+    disposition restore must defer to the preemption owner)."""
+    import os
+    import signal
+    from bigdl_tpu.checkpoint import PreemptionHandler
+
+    rec = Recorder(annotate=False)
+    rec.start_step(0)
+    rec.end_step(0)
+    fr = FlightRecorder(rec, str(tmp_path))
+    ph = PreemptionHandler()
+    try:
+        if flight_first:
+            fr.install()
+            ph.install()
+        else:
+            ph.install()
+            fr.install()
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.05)                   # let the handler run
+        assert ph.requested
+        assert len(list(tmp_path.glob("flight_*.json"))) == 1
+    finally:
+        fr.uninstall()
+        ph.uninstall()
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+
+def test_flight_dump_is_signal_reentrant(tmp_path):
+    """A chained handler re-entering dump() on the same thread (signal
+    delivered mid-dump) must not deadlock on the recorder lock."""
+    rec = Recorder(annotate=False)
+    rec.start_step(0)
+    rec.end_step(0)
+    fr = FlightRecorder(rec, str(tmp_path))
+
+    class EvilRepr:
+        """Serialized under fr's lock; re-enters dump like a signal
+        handler interrupting the locked write would."""
+        fired = False
+
+        def __repr__(self):
+            if not EvilRepr.fired:
+                EvilRepr.fired = True
+                fr.dump("nested")
+            return "evil"
+
+    done = []
+
+    def run():
+        fr.dump("outer", {"evil": EvilRepr()})
+        done.append(True)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert done, "dump() self-deadlocked on re-entry"
+    assert len(list(tmp_path.glob("flight_*.json"))) == 2
+
+
+def test_set_health_twice_does_not_double_dump(tmp_path):
+    """Reconfiguring set_health must replace — not stack — the flight
+    recorder's crash hooks; one crash means one dump."""
+    x, y, model = _toy_problem()
+    opt = _make_opt(x, y, model, InMemorySink())
+    prev_hook = sys.excepthook
+    try:
+        opt.set_health(policy="warn", flight_dir=str(tmp_path))
+        opt.set_health(policy="raise", flight_dir=str(tmp_path))
+        err = RuntimeError("boom")
+        sys.excepthook(RuntimeError, err, None)
+        assert len(list(tmp_path.glob("flight_*.json"))) == 1
+    finally:
+        opt._flight.uninstall()
+        sys.excepthook = prev_hook
+
+
+# --------------------------------------------------------------------- #
+# IntrospectionServer                                                   #
+# --------------------------------------------------------------------- #
+def test_http_endpoints_metrics_healthz_records():
+    rec = Recorder(annotate=False)
+    rec.inc("records_total", 3)
+    rec.observe("lat_ms", 1.0)
+    for i in range(3):
+        rec.start_step(i)
+        rec.scalar("loss", 1.0)
+        rec.end_step(i)
+    srv = IntrospectionServer(rec).start()
+    try:
+        assert srv.port > 0
+        code, body = _get(srv.url("/metrics"))
+        assert code == 200
+        _assert_valid_exposition(body)
+        assert "bigdl_records_total 3.0" in body
+        code, body = _get(srv.url("/healthz"))
+        h = json.loads(body)
+        assert code == 200 and h["ok"] and h["last_step"] == 2
+        code, body = _get(srv.url("/records?n=2&type=step"))
+        assert code == 200
+        recs = json.loads(body)
+        assert [r["step"] for r in recs] == [1, 2]
+        code, _ = _get(srv.url("/nope"))
+        assert code == 404
+    finally:
+        srv.stop()
+
+
+def test_records_endpoint_is_strict_json_with_nonfinite_scalars():
+    """A NaN loss in the ring — the exact record a health client wants —
+    must still serve as RFC-8259-valid JSON (no bare NaN tokens)."""
+    rec = Recorder(annotate=False)
+    rec.start_step(0)
+    rec.scalar("loss", float("nan"))
+    rec.scalar("gn", float("inf"))
+    rec.end_step(0)
+    srv = IntrospectionServer(rec).start()
+    try:
+        code, body = _get(srv.url("/records?n=5"))
+        assert code == 200
+        assert "NaN" not in body.replace('"NaN"', "")   # only quoted
+        recs = json.loads(body)                         # strict parse
+        assert recs[0]["scalars"]["loss"] == "NaN"
+        assert recs[0]["scalars"]["gn"] == "Inf"
+    finally:
+        srv.stop()
+
+
+def test_serve_metrics_twice_stops_previous_server():
+    x, y, model = _toy_problem()
+    opt = _make_opt(x, y, model, InMemorySink())
+    first = opt.serve_metrics()
+    port1 = first.port
+    second = opt.serve_metrics()
+    try:
+        assert second.port != port1
+        with pytest.raises(Exception):      # old port no longer serves
+            urllib.request.urlopen(f"http://127.0.0.1:{port1}/healthz",
+                                   timeout=2)
+        code, _ = _get(second.url("/healthz"))
+        assert code in (200, 503)
+    finally:
+        second.stop()
+
+
+def test_healthz_unhealthy_on_stall_and_divergence():
+    rec = Recorder(annotate=False)
+    _seed_steps(rec, n=10, dur=0.005)
+    wd = StallWatchdog(rec, factor=2.0, min_history=5, floor_seconds=0.05)
+    mon = HealthMonitor(policy="record", recorder=rec)
+    srv = IntrospectionServer(rec, watchdog=wd, monitor=mon).start()
+    try:
+        code, _ = _get(srv.url("/healthz"))
+        assert code == 200
+        rec.start_step(10)                  # artificial wedge
+        time.sleep(0.08)
+        code, body = _get(srv.url("/healthz"))
+        assert code == 503 and json.loads(body)["stalled"]
+        rec.end_step(10)
+        code, _ = _get(srv.url("/healthz"))
+        assert code == 200
+        mon.check_record(_step_record(11, loss=float("nan")))
+        code, body = _get(srv.url("/healthz"))
+        assert code == 503 and json.loads(body)["diverged"]
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------------------- #
+# end-to-end: trainer integration                                       #
+# --------------------------------------------------------------------- #
+def _toy_problem(n=64, d=8, classes=3, poison_at=None):
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, d).astype(np.float32)
+    if poison_at is not None:
+        x[poison_at] = np.nan
+    y = (rng.randint(0, classes, n) + 1).astype(np.float32)
+    model = nn.Sequential(nn.Linear(d, classes), nn.LogSoftMax())
+    return x, y, model
+
+
+def _make_opt(x, y, model, sink, **health_kw):
+    rec = Recorder(sinks=[sink], annotate=False)
+    opt = (LocalOptimizer(model, DataSet.minibatch_arrays(x, y, 16,
+                                                          shuffle=False),
+                          nn.ClassNLLCriterion(), batch_size=16)
+           .set_optim_method(SGD(learning_rate=0.1))
+           .set_end_when(Trigger.max_epoch(1))
+           .set_telemetry(rec))
+    if health_kw:
+        opt.set_health(install_crash_hooks=False, **health_kw)
+    return opt
+
+
+def test_nan_injected_at_step_k_trips_event_at_step_k(tmp_path):
+    # poison one row of batch #2 (0-based) -> the sentinel must fire at
+    # exactly step 3 (1-based iterations) with a flight dump holding the
+    # preceding ring records
+    x, y, model = _toy_problem(poison_at=33)
+    sink = InMemorySink()
+    opt = _make_opt(x, y, model, sink, policy="raise",
+                    flight_dir=str(tmp_path))
+    with pytest.raises(DivergenceError) as ei:
+        opt.optimize()
+    conds = {e["condition"]: e["step"] for e in ei.value.events}
+    assert conds["non_finite_loss"] == 3
+    assert conds["non_finite_grads"] == 3
+    # on-device isfinite count saw the poisoned gradients
+    bad = [r for r in sink.records if r.get("type") == "step"
+           and r["step"] == 3][0]
+    assert bad["scalars"]["nonfinite_grads"] > 0
+    dumps = list(tmp_path.glob("flight_*.json"))
+    assert len(dumps) == 1
+    d = read_flight(str(dumps[0]))
+    assert d["reason"] == "divergence"
+    steps_in_ring = [r["step"] for r in d["records"]
+                     if r.get("type") == "step"]
+    assert steps_in_ring[-3:] == [1, 2, 3]   # preceding records preserved
+    # health_event records also reached the sink
+    evs = [r for r in sink.records if r.get("type") == "health_event"]
+    assert {e["condition"] for e in evs} == {"non_finite_loss",
+                                             "non_finite_grads"}
+
+
+class _PoisonOnce:
+    """Inject NaN into one batch, once — rollback must then succeed."""
+
+    def __init__(self, inner, inject_at):
+        self.inner, self.inject_at, self.armed = inner, inject_at, True
+
+    def data(self, train=True, epoch=None):
+        try:
+            it = self.inner.data(train=train, epoch=epoch)
+        except TypeError:
+            it = self.inner.data(train=train)
+        for i, mb in enumerate(it):
+            if self.armed and i == self.inject_at:
+                self.armed = False
+                xx = np.array(mb.get_input())
+                xx[0, 0] = np.nan
+                mb = MiniBatch(xx, mb.get_target())
+            yield mb
+
+
+def test_rollback_policy_resumes_from_last_committed_checkpoint(tmp_path):
+    x, y, model = _toy_problem()
+    inner = DataSet.minibatch_arrays(x, y, 16, shuffle=False)
+    sink = InMemorySink()
+    rec = Recorder(sinks=[sink], annotate=False)
+    opt = (LocalOptimizer(model, _PoisonOnce(inner, inject_at=2),
+                          nn.ClassNLLCriterion(), batch_size=16)
+           .set_optim_method(Adam(learning_rate=0.05))
+           .set_end_when(Trigger.max_epoch(2))
+           .set_telemetry(rec)
+           .set_checkpoint(str(tmp_path / "ck"),
+                           Trigger.several_iteration(1))
+           .set_health(policy="rollback", flight_dir=str(tmp_path),
+                       install_crash_hooks=False))
+    opt.optimize()
+    mon = opt._health_monitor
+    assert mon.rollbacks == 1
+    assert mon.healthy                     # recovered
+    assert {e["condition"] for e in mon.events} >= {"non_finite_loss"}
+    # a flight dump was left behind even though training survived
+    assert len(list(tmp_path.glob("flight_*.json"))) == 1
+    steps = [r for r in sink.records if r.get("type") == "step"]
+    # step 3 diverged, was re-run clean after restore, training finished
+    seen = [r["step"] for r in steps]
+    assert seen.count(3) == 2
+    assert seen[-1] == 8                   # 2 epochs x 4 batches
+    final_loss = steps[-1]["scalars"]["loss"]
+    assert math.isfinite(final_loss)
+    # the diverged step's poisoned params were never checkpointed: every
+    # post-rollback loss is finite
+    after = [r["scalars"]["loss"] for r in steps[seen.index(3) + 1:]]
+    assert all(math.isfinite(l) for l in after)
+
+
+def test_divergence_without_rollback_budget_propagates(tmp_path):
+    x, y, model = _toy_problem(poison_at=33)
+    sink = InMemorySink()
+    opt = _make_opt(x, y, model, sink, policy="rollback", max_rollbacks=0)
+    opt.set_checkpoint(str(tmp_path / "ck"), Trigger.several_iteration(1))
+    opt.serve_metrics()                    # arms the stall watchdog
+    with pytest.raises(DivergenceError):
+        opt.optimize()
+    # the watchdog was stopped on the raise path too: a dead loop must
+    # not pin /healthz at 503 as its idle age grows
+    assert not opt._watchdog._active
+    assert not opt._watchdog.check_once()
+    opt._http_server.stop()
+
+
+def test_warn_policy_keeps_training(capsys):
+    x, y, model = _toy_problem(poison_at=33)
+    sink = InMemorySink()
+    opt = _make_opt(x, y, model, sink, policy="warn")
+    opt.optimize()                         # no raise
+    assert "non_finite_loss" in capsys.readouterr().out
+    steps = [r["step"] for r in sink.records if r.get("type") == "step"]
+    assert steps[-1] == 4                  # all 4 batches ran
+
+
+def test_serve_metrics_on_running_trainer(tmp_path):
+    x, y, model = _toy_problem()
+    sink = InMemorySink()
+    opt = _make_opt(x, y, model, sink)
+    srv = opt.serve_metrics()
+    try:
+        opt.optimize()
+        code, body = _get(srv.url("/metrics"))
+        assert code == 200
+        _assert_valid_exposition(body)
+        assert "bigdl_records_total 64.0" in body
+        code, body = _get(srv.url("/healthz"))
+        assert code == 200
+        h = json.loads(body)
+        assert h["ok"] and h["last_step"] == 4
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------------------- #
+# end-to-end: trainer + serving engine on distinct ports                #
+# --------------------------------------------------------------------- #
+def test_trainer_and_serving_engine_serve_metrics_concurrently():
+    from bigdl_tpu.serving import ModelRegistry, ServingEngine
+    from bigdl_tpu.nn.module import Module
+
+    class Scale(Module):
+        def init(self, rng):
+            return {self.name: {"weight": jnp.ones(())}}
+
+        def apply(self, params, x, ctx):
+            return x * params[self.name]["weight"]
+
+    reg = ModelRegistry()
+    reg.register("m", Scale(), input_shape=(4,))
+    eng = ServingEngine(reg, max_batch=8, max_delay_ms=1.0)
+    eng.warmup()
+    esrv = eng.serve_metrics()
+
+    x, y, model = _toy_problem()
+    sink = InMemorySink()
+    opt = _make_opt(x, y, model, sink)
+    tsrv = opt.serve_metrics()
+    try:
+        assert esrv.port != tsrv.port
+        t = threading.Thread(target=opt.optimize)
+        t.start()
+        for _ in range(8):
+            eng.predict("m", np.ones((3, 4), np.float32))
+        t.join()
+        for srv, marker in ((esrv, "bigdl_serving_requests_total"),
+                            (tsrv, "bigdl_records_total")):
+            code, body = _get(srv.url("/metrics"))
+            assert code == 200
+            _assert_valid_exposition(body)
+            assert marker in body
+        code, body = _get(esrv.url("/healthz"))
+        h = json.loads(body)
+        assert code == 200 and h["ok"] and "shed_rate" in h
+        # latency summary visible live on the serving side
+        _, body = _get(esrv.url("/metrics"))
+        assert 'bigdl_serving_latency_ms{quantile="0.5"}' in body
+    finally:
+        tsrv.stop()
+        eng.shutdown()
+        assert eng._http_server is None    # shutdown stopped its server
+
+
+# --------------------------------------------------------------------- #
+# trace_summary health subcommand                                       #
+# --------------------------------------------------------------------- #
+def test_trace_summary_health_table(tmp_path):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary", "scripts/trace_summary.py")
+    ts = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ts)
+
+    rec = Recorder(annotate=False)
+    mon = HealthMonitor(policy="record", recorder=rec)
+    mon.check_record(_step_record(5, loss=float("nan")))
+    fr = FlightRecorder(rec, str(tmp_path))
+    fr.dump("divergence", {"events": mon.events})
+    jl = tmp_path / "telemetry.jsonl"
+    with open(jl, "w") as f:
+        for ev in mon.events:
+            f.write(json.dumps(ev) + "\n")
+
+    events, flights = ts.load_health([str(tmp_path)])
+    assert len(flights) == 1
+    assert any(e["condition"] == "non_finite_loss" for _, e in events)
+    lines = []
+    ts.summarize_health(events, flights, out=lines.append)
+    text = "\n".join(lines)
+    assert "non_finite_loss" in text
+    assert "reason=divergence" in text
+    # dedupe: the same event from the JSONL and the dump renders once
+    assert text.count("non_finite_loss") == 1

@@ -405,7 +405,8 @@ def test_telemetry_off_step_signature_unchanged():
     assert len(out_t) == 5
     assert float(out_t[3]) == pytest.approx(float(out[3]))
     assert set(out_t[4]) == {"grad_norm", "param_norm", "update_norm",
-                             "update_ratio"}
+                             "update_ratio", "nonfinite_grads"}
+    assert float(out_t[4]["nonfinite_grads"]) == 0.0   # clean step
 
 
 def test_disabled_recorder_compiles_plain_step():

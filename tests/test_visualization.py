@@ -74,6 +74,54 @@ def test_read_events_stops_at_corrupt_payload(tmp_path):
     assert len(event_writer.read_events(str(tmp_path))) == 3
 
 
+def test_read_events_salvage_skips_corrupt_record(tmp_path):
+    """salvage=True resyncs past a corrupt record and keeps the tail —
+    what a flight-recorder post-mortem needs after a hard kill — and
+    counts the corruption instead of silently absorbing it."""
+    w = event_writer.EventWriter(str(tmp_path))
+    for i in range(5):
+        w.add_scalar("Loss", float(i), i + 1)
+    w.close()
+    fname = [f for f in os.listdir(tmp_path) if "tfevents" in f][0]
+    path = tmp_path / fname
+    raw = bytearray(path.read_bytes())
+    off = 0
+    for _ in range(3):
+        (length,) = struct.unpack("<Q", raw[off:off + 8])
+        off += 12 + length + 4
+
+    # flipped payload byte: strict stops at 3, salvage recovers 5 of 6
+    raw[off + 12] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    payloads, n_corrupt = event_writer.read_events(str(tmp_path),
+                                                   salvage=True)
+    assert len(payloads) == 5 and n_corrupt == 1
+    assert len(event_writer.read_events(str(tmp_path))) == 3  # strict same
+
+    # corrupt the length word too: the frame check is the resync
+    # condition, so the tail is still found
+    raw[off + 12] ^= 0xFF
+    raw[off] ^= 0xFF
+    path.write_bytes(bytes(raw))
+    payloads, n_corrupt = event_writer.read_events(str(tmp_path),
+                                                   salvage=True)
+    assert len(payloads) == 5 and n_corrupt == 1
+
+    # truncated tail (torn write on crash): counted, nothing to resync to
+    path.write_bytes(bytes(raw[:len(raw) - 6]))
+    payloads, n_corrupt = event_writer.read_events(str(tmp_path),
+                                                   salvage=True)
+    assert len(payloads) == 4 and n_corrupt == 2
+
+    # an intact dir reports zero corruption
+    w2 = event_writer.EventWriter(str(tmp_path / "clean"))
+    w2.add_scalar("Loss", 1.0, 1)
+    w2.close()
+    payloads, n_corrupt = event_writer.read_events(
+        str(tmp_path / "clean"), salvage=True)
+    assert len(payloads) == 2 and n_corrupt == 0
+
+
 def test_read_scalar_roundtrip(tmp_path):
     w = event_writer.EventWriter(str(tmp_path))
     for i in range(5):
