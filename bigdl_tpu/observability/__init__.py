@@ -23,6 +23,11 @@ surface for the TPU rebuild:
     and loss-spike sentinels with warn/record/raise/rollback policies,
     a stall-and-straggler watchdog, and a crash flight recorder that
     dumps the recent-record ring on unhandled exception / SIGTERM.
+  * Cost/memory attribution (:mod:`~bigdl_tpu.observability.profile`):
+    XLA compile-time FLOPs/HBM capture feeding per-step ``perf/mfu``,
+    ``perf/hbm_bw_util`` and ``mem/peak_hbm_bytes`` scalars, a device
+    peak-spec table, live device-memory gauges, and per-request trace
+    IDs with Chrome-trace/Perfetto export via ``/trace``.
 
 Every span is also emitted as a ``jax.profiler.TraceAnnotation`` so the
 host-side phase structure lines up with device events in a TensorBoard /
@@ -48,11 +53,12 @@ from .health import (DivergenceError, FlightRecorder, HealthMonitor,
                      StallWatchdog)
 from . import collectives
 from . import health
+from . import profile
 
 __all__ = [
     "Recorder", "get_recorder", "set_recorder", "null_recorder",
     "Sink", "InMemorySink", "JsonlSink", "TensorBoardSink",
     "render_prometheus", "IntrospectionServer",
     "DivergenceError", "FlightRecorder", "HealthMonitor", "StallWatchdog",
-    "collectives", "health",
+    "collectives", "health", "profile",
 ]

@@ -18,6 +18,10 @@ runs:
   ``/records``   the last-N records from the Recorder's ring
                  (``?n=20&type=step``) — the live tail JSONL sinks only
                  give you after the fact
+  ``/trace``     Chrome-trace/Perfetto JSON of recent per-request span
+                 timelines (serving engines attach their trace ring via
+                 ``trace_source``; curl it to a file and load in
+                 ui.perfetto.dev)
 
 Attach with ``serve_metrics(port)`` on ``Optimizer`` / ``SpmdTrainer``
 / ``ServingEngine``, or standalone::
@@ -66,7 +70,7 @@ class IntrospectionServer:
 
     def __init__(self, recorder, port: int = 0, host: str = "127.0.0.1",
                  watchdog=None, monitor=None, namespace: str = "bigdl",
-                 records_default: int = 50):
+                 records_default: int = 50, trace_source=None):
         self.recorder = recorder
         self.host = host
         self.port = int(port)           # 0 -> ephemeral, bound in start()
@@ -74,6 +78,9 @@ class IntrospectionServer:
         self.monitor = monitor
         self.namespace = namespace
         self.records_default = int(records_default)
+        # zero-arg callable returning a Chrome-trace JSON string (e.g.
+        # ServingEngine.dump_chrome_trace); None -> /trace is 404
+        self.trace_source = trace_source
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -137,8 +144,18 @@ class IntrospectionServer:
             rec_type = q["type"][0] if q.get("type") else None
             recs = self.recorder.recent_records(n, rec_type=rec_type)
             self._reply(h, 200, _finite_json(recs), "application/json")
+        elif parsed.path == "/trace":
+            if self.trace_source is None:
+                h.send_error(404, "no per-request trace source attached "
+                                  "(serving engines expose one)")
+            else:
+                body = self.trace_source()
+                if not isinstance(body, str):
+                    body = json.dumps(body, default=_json_default)
+                self._reply(h, 200, body, "application/json")
         else:
-            h.send_error(404, "try /metrics, /healthz or /records")
+            h.send_error(404,
+                         "try /metrics, /healthz, /records or /trace")
 
     @staticmethod
     def _reply(h: BaseHTTPRequestHandler, code: int, body: str,

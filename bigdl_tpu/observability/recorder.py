@@ -117,6 +117,13 @@ class Recorder:
         self._step_started_wall: Optional[float] = None
         self._last_step_end: Optional[float] = None
         self._last_step_index: Optional[int] = None
+        # cost attribution (observability.profile): a StepCostModel
+        # whose scalars(dur) fold perf/mfu, perf/hbm_bw_util and
+        # mem/peak_hbm_bytes into every step record
+        self._cost_model = None
+        # gauge pollers: callables(recorder) refreshed before each
+        # snapshot()/end_step() — live device-memory stats and friends
+        self._gauge_pollers: List = []
 
     # -- enable/disable -------------------------------------------------- #
     @property
@@ -130,6 +137,31 @@ class Recorder:
     def add_sink(self, sink):
         self.sinks.append(sink)
         return self
+
+    def set_cost_model(self, model):
+        """Attach a cost model (anything with ``scalars(dur) -> dict``,
+        e.g. :class:`~bigdl_tpu.observability.profile.StepCostModel`);
+        ``end_step`` folds its derived efficiency scalars into every
+        step record.  ``None`` detaches."""
+        self._cost_model = model
+        return self
+
+    def add_gauge_poller(self, fn):
+        """Register ``fn(recorder)`` to refresh live gauges right before
+        each ``snapshot()`` / ``end_step()`` — i.e. on every /metrics
+        scrape and every step record.  Poller exceptions are swallowed:
+        a broken poller must never take down a scrape or the step
+        loop."""
+        self._gauge_pollers.append(fn)
+        return self
+
+    def _run_gauge_pollers(self):
+        # OUTSIDE the lock: pollers call self.gauge(), which locks
+        for fn in list(self._gauge_pollers):
+            try:
+                fn(self)
+            except Exception:
+                pass
 
     # -- primitives ------------------------------------------------------ #
     def inc(self, name: str, value: float = 1.0) -> float:
@@ -277,6 +309,7 @@ class Recorder:
         if not self._enabled:
             return None
         self._maybe_stop_trace()
+        self._run_gauge_pollers()
         with self._lock:
             if step is None:
                 step = self._step
@@ -284,6 +317,14 @@ class Recorder:
                    if self._step_t0 is not None else None)
             pend = dict(self._scalars)
             pend.update(scalars)
+            if self._cost_model is not None:
+                try:
+                    # pure arithmetic over the compiled cost capture —
+                    # safe under the lock; explicit scalars win ties
+                    for k, v in self._cost_model.scalars(dur).items():
+                        pend.setdefault(k, v)
+                except Exception:
+                    pass        # attribution must never kill a record
             rec: Dict[str, Any] = {
                 "type": "step",
                 "step": step,
@@ -367,16 +408,28 @@ class Recorder:
         return self
 
     def _maybe_start_trace(self, step):
+        if self._tracing:
+            # the previously traced step raised before end_step/
+            # abort_step could close the session: stop the stale trace
+            # now, or the profiler stays wedged — silently folding every
+            # remaining step into one giant capture — for the rest of
+            # the run
+            self._maybe_stop_trace()
         cfg = self._trace_cfg
-        if (cfg is None or self._tracing or step is None
-                or step % cfg[0] != 0):
+        if cfg is None or step is None or step % cfg[0] != 0:
             return
         import jax
         try:
             jax.profiler.start_trace(cfg[1])
             self._tracing = True
         except Exception:
-            pass        # profiling must never kill training
+            # start_trace may have opened a session before raising:
+            # never let the flag and the profiler disagree
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._tracing = False
 
     def _maybe_stop_trace(self):
         if not self._tracing:
@@ -384,11 +437,14 @@ class Recorder:
         import jax
         try:
             jax.profiler.stop_trace()
+        except Exception:
+            pass        # profiling must never kill training
         finally:
             self._tracing = False
 
     # -- introspection / teardown ---------------------------------------- #
     def snapshot(self) -> Dict[str, Dict[str, float]]:
+        self._run_gauge_pollers()
         with self._lock:
             return {"counters": dict(self._counters),
                     "gauges": dict(self._gauges)}

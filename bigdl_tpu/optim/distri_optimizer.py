@@ -210,6 +210,7 @@ class DistriOptimizer(Optimizer):
             step_fn, shardable = self._build_step(params_template, optim,
                                                   telemetry=telemetry)
             self._shardable = shardable
+            self._cost_pending = True   # new program: re-capture cost
             return step_fn
         return build_step
 

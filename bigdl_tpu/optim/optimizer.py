@@ -368,6 +368,10 @@ class Optimizer:
         self._telemetry_health = True
         self._with_health = False     # does the built step return health?
         self._seen_sigs = set()       # (shape, dtype) sigs → recompile detect
+        # static cost capture (observability.profile): harvest XLA
+        # cost/memory analysis once per step build, at first dispatch
+        self._capture_cost = True
+        self._cost_pending = False
         # training-health layer (observability.health)
         self._health_monitor = None
         self._flight = None
@@ -449,7 +453,8 @@ class Optimizer:
         self.prefetch_depth = depth
         return self
 
-    def set_telemetry(self, recorder: Recorder, health: bool = True):
+    def set_telemetry(self, recorder: Recorder, health: bool = True,
+                      capture_cost: bool = True):
         """Attach an observability Recorder: every iteration emits one
         step record (spans: data_fetch / h2d / train_step, compile
         detection; scalars: loss, learning rate, records/sec — plus
@@ -457,9 +462,26 @@ class Optimizer:
         inside the step).  Also installs ``recorder`` as the
         process-active recorder so DeviceLoader and collective
         accounting report to it (≙ optim/Metrics.scala, grown into a
-        first-class subsystem)."""
+        first-class subsystem).
+
+        ``capture_cost`` harvests XLA's compile-time cost/memory
+        analysis from the jitted step (once per step build, via an AOT
+        lowering at the first batch's avals) so every step record
+        additionally carries ``perf/mfu``, ``perf/hbm_bw_util`` and
+        ``mem/peak_hbm_bytes`` — or explicit ``*_unavailable`` markers
+        on backends without the analysis APIs.  Live ``mem/device.*``
+        gauges are refreshed from ``jax.local_devices()``
+        ``memory_stats()`` on every record/scrape.  Both opt-outs —
+        ``capture_cost=False`` and the ``BIGDL_PROFILE_CAPTURE=0`` env
+        kill switch — disable the capture AND the per-step memory
+        polling, keeping attribution entirely off the hot path."""
+        from ..observability.profile import (capture_enabled,
+                                             install_device_memory_poller)
         self._recorder = recorder
         self._telemetry_health = bool(health)
+        self._capture_cost = bool(capture_cost)
+        if self._capture_cost and capture_enabled():
+            install_device_memory_poller(recorder)
         set_recorder(recorder)
         return self
 
@@ -561,6 +583,20 @@ class Optimizer:
         guarantee covers device work too."""
         return (self._recorder is not None and self._recorder.enabled
                 and self._telemetry_health)
+
+    def _capture_step_cost(self, step_fn, args):
+        """Harvest XLA cost/memory analysis for the jitted step at these
+        args' avals (AOT lowering — real buffers untouched) and attach
+        the StepCostModel deriving per-step ``perf/mfu`` /
+        ``perf/hbm_bw_util`` / ``mem/peak_hbm_bytes``.  Best-effort by
+        contract: never raises, never blocks the loop beyond one
+        analysis pass (the ``profile.capture`` span measures it)."""
+        from ..observability import profile as _profile
+        rec = self._rec()
+        if (not self._capture_cost or not rec.enabled
+                or not _profile.capture_enabled()):
+            return
+        _profile.capture_and_attach(rec, step_fn, args, kind="train_step")
 
     def set_auto_retry(self, max_retries):
         """Retry a failed epoch from the last end-of-epoch state snapshot
@@ -746,6 +782,9 @@ class Optimizer:
                 fn = make_train_step(self.model, self.criterion, optim,
                                      self.mixed_precision,
                                      telemetry=telemetry)
+            # a rebuilt step is a new program: re-capture its cost at
+            # the next first dispatch
+            self._cost_pending = True
             return jax.jit(fn, donate_argnums=(0, 1, 2))
         return build_step
 
@@ -968,6 +1007,14 @@ class Optimizer:
                     # the trace-time collective accounting re-runs: reset
                     # the per-step gauges or volume double-counts forever
                     rec.reset_gauges("collective/")
+                    if self._cost_pending:
+                        # once per step build, at the first (full-batch)
+                        # signature — a ragged last batch would
+                        # under-report every following full step
+                        self._cost_pending = False
+                        self._capture_step_cost(
+                            step_fn, (params, opt_state, model_state,
+                                      x, y, sub))
             with rec.span(span_name):
                 out = step_fn(params, opt_state, model_state, x, y, sub)
             if self._with_health:

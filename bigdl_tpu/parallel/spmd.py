@@ -99,6 +99,9 @@ class SpmdTrainer:
         self._with_health = False
         self._hlo_accounted = False
         self._seen_sigs = set()
+        # static cost capture (observability.profile), once per init()
+        self._capture_cost = True
+        self._cost_pending = False
         self._ckpt_layout = "orbax"
         self._ckpt_mgr = None
         # training-health layer (observability.health)
@@ -211,18 +214,31 @@ class SpmdTrainer:
             return new_params, new_opt, loss
 
         self._step_fn = jax.jit(step, donate_argnums=(0, 1))
+        self._cost_pending = True   # new program: re-capture its cost
         return self
 
     # -- telemetry ------------------------------------------------------- #
-    def set_telemetry(self, recorder, health: bool = True):
+    def set_telemetry(self, recorder, health: bool = True,
+                      capture_cost: bool = True):
         """Attach an observability Recorder: each step() emits a step
         record (spans: h2d / train_step with compile detection; scalars:
         loss, tokens/sec, plus grad/param/update norms when ``health`` —
         the health variant changes the compiled program, so set this
         BEFORE init()/the first step).  Also installs ``recorder`` as
-        the process-active one."""
+        the process-active one.  ``capture_cost`` harvests XLA
+        cost/memory analysis from the compiled step (once per init(),
+        cache-served lowering at the first batch's shapes) so step
+        records carry ``perf/mfu`` / ``perf/hbm_bw_util`` /
+        ``mem/peak_hbm_bytes``, plus live ``mem/device.*`` gauges
+        (``capture_cost=False`` / ``BIGDL_PROFILE_CAPTURE=0`` disable
+        both the capture and the polling)."""
+        from ..observability.profile import (capture_enabled,
+                                             install_device_memory_poller)
         self._recorder = recorder
         self._telemetry_health = bool(health)
+        self._capture_cost = bool(capture_cost)
+        if self._capture_cost and capture_enabled():
+            install_device_memory_poller(recorder)
         set_recorder(recorder)
         if (self._step_fn is not None
                 and self._with_health != self._telemetry_active()):
@@ -322,6 +338,28 @@ class SpmdTrainer:
         return (self._recorder is not None and self._recorder.enabled
                 and self._telemetry_health)
 
+    def _capture_step_cost(self, tokens, targets, rng):
+        """Harvest XLA cost/memory analysis for the compiled GSPMD step
+        and attach the StepCostModel (per-step ``perf/mfu`` etc.).
+        Lowers with the CONCRETE placed arrays — abstract avals would
+        drop the shardings and analyze a different program; lowering
+        never reads or donates the buffers, and the compile is
+        cache-served against the dispatch about to happen.  Never
+        raises."""
+        from ..observability import profile as _profile
+        rec = self._rec()
+        if (not self._capture_cost or not rec.enabled
+                or not _profile.capture_enabled()):
+            return
+        try:
+            with rec.span("profile.capture"):
+                cost = _profile.capture_compiled(
+                    self._step_fn.lower(self.params, self.opt_state,
+                                        tokens, targets, rng).compile())
+        except Exception as e:
+            cost = {"unavailable": ["capture_failed"], "error": repr(e)}
+        _profile.attach_cost(rec, cost, kind="train_step")
+
     def account_collectives(self, tokens, targets):
         """Compile the current step for these shapes and parse the
         partitioned HLO for the collectives GSPMD actually inserted
@@ -377,6 +415,9 @@ class SpmdTrainer:
                 self._seen_sigs.add(sig)
                 span_name = "train_step_compile"
                 rec.scalar("recompile", 1.0)
+                if self._cost_pending:
+                    self._cost_pending = False
+                    self._capture_step_cost(tokens, targets, rng)
         with rec.span(span_name):
             out = self._step_fn(self.params, self.opt_state, tokens,
                                 targets, rng)

@@ -29,6 +29,17 @@ Telemetry goes through the PR-1 observability
   gauges      ``serving.queue_depth.<model>``
   histograms  ``serving.latency_ms`` (p50/p95/p99 via
               ``Recorder.hist_quantiles``), ``serving.batch_fill``
+
+Attribution (observability.profile) rides on top of the metrics:
+every admitted request carries a trace ID and a span timeline
+(admit → queue → batch_gather → compute → reply, shed requests ending
+in a terminal cause span) collected in a bounded ring —
+:meth:`ServingEngine.dump_chrome_trace` / the ``/trace`` route render
+it as Chrome-trace/Perfetto JSON.  Each AOT-compiled bucket's XLA
+cost/memory analysis is harvested at compile time into
+``entry.cost[bucket]`` and emitted as a ``profile`` record, so an
+operator can read FLOPs-per-bucket next to batch-fill and decide
+whether the ladder wastes compute on padding.
 """
 from __future__ import annotations
 
@@ -58,17 +69,24 @@ class ServingEngine:
                        requests shed with :class:`LoadShedError`
     ``recorder``       a Recorder; defaults to a fresh enabled one
                        (metrics are part of the serving contract)
+    ``trace_requests`` per-request span tracing into a bounded ring of
+                       ``trace_capacity`` completed traces (a few
+                       appends per request; the /trace export source)
     """
 
     def __init__(self, registry: ModelRegistry, *, max_batch: int = 32,
                  max_delay_ms: float = 5.0, max_queue_rows: int = 256,
-                 recorder: Optional[Recorder] = None):
+                 recorder: Optional[Recorder] = None,
+                 trace_requests: bool = True, trace_capacity: int = 512):
+        from ..observability.profile import TraceRing
         self.registry = registry
         self.ladder = BucketLadder(max_batch)
         self.max_delay = float(max_delay_ms) / 1e3
         self.max_queue_rows = int(max_queue_rows)
         self.recorder = recorder if recorder is not None \
             else Recorder(annotate=False)
+        self.trace_ring = TraceRing(trace_capacity) if trace_requests \
+            else None
         self._queues: Dict[str, BatchingQueue] = {}
         self._threads: Dict[str, threading.Thread] = {}
         self._lock = threading.Lock()
@@ -105,14 +123,18 @@ class ServingEngine:
         """Start the live introspection server for this engine's
         recorder: ``/metrics`` (Prometheus — request/shed/recompile
         counters, per-model queue-depth gauges, latency/batch-fill
-        summaries), ``/healthz`` (includes the shed rate), ``/records``.
-        ``port=0`` binds an ephemeral port (the returned server's
-        ``.port``); ``shutdown()`` stops it."""
+        summaries), ``/healthz`` (includes the shed rate), ``/records``,
+        and ``/trace`` (Chrome-trace JSON of recent per-request span
+        timelines).  ``port=0`` binds an ephemeral port (the returned
+        server's ``.port``); ``shutdown()`` stops it."""
         from ..observability.http import IntrospectionServer
         if self._http_server is not None:   # reconfigure: no leaked
             self._http_server.stop()        # thread/socket on the old port
+        trace_source = self.dump_chrome_trace \
+            if self.trace_ring is not None else None
         self._http_server = IntrospectionServer(
-            self.recorder, port=port, host=host).start()
+            self.recorder, port=port, host=host,
+            trace_source=trace_source).start()
         return self._http_server
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
@@ -129,10 +151,10 @@ class ServingEngine:
             q.close()
         if not drain:
             for q in queues.values():
-                for req in q.dump():
-                    req.future.set_exception(
-                        EngineClosedError("engine shut down before "
-                                          "this request ran"))
+                _fail_batch(q.dump(),
+                            EngineClosedError("engine shut down before "
+                                              "this request ran"),
+                            ring=self.trace_ring, span="closed")
         for t in threads.values():
             t.join(timeout)
         return self
@@ -148,6 +170,7 @@ class ServingEngine:
         instead of executed.  Raises :class:`LoadShedError` immediately
         when the queue is full (backpressure, not tail collapse).
         """
+        t_admit = time.monotonic()
         entry = self.registry.get(name)
         x, n, single = self._normalize(entry, x)
         if n > self.ladder.max_batch:
@@ -156,17 +179,40 @@ class ServingEngine:
                 "use predict() which splits")
         deadline = None if deadline_ms is None \
             else time.monotonic() + float(deadline_ms) / 1e3
-        req = Request(x, n, deadline=deadline)
+        ring = self.trace_ring
+        tr = ring.new_trace(entry.name) if ring is not None else None
+        req = Request(x, n, deadline=deadline, trace=tr)
+        if tr is not None:
+            tr.meta["rows"] = n
         # the worker always completes req.future (batched); a single-
         # sample caller gets a view that strips the batch dim back off
         fut = _UnbatchingFuture(req.future) if single else req.future
         rec = self.recorder
         rec.inc("serving.requests")
         q = self._ensure_worker(entry)
+        if tr is not None:
+            # every trace write BEFORE the put: the batcher may pop the
+            # request the instant it lands, and the queue handoff is the
+            # only ordering between this thread and the worker
+            now = time.monotonic()
+            tr.add_span("admit", t_admit, now)
+            tr.open("queue", now)   # closed by the batcher at pop
         try:
             q.put(req)
         except LoadShedError:
             rec.inc("serving.shed_queue_full")
+            if tr is not None:
+                now = time.monotonic()
+                tr.discard("queue")   # never entered the queue
+                tr.terminal("queue_full", now)
+                ring.finish(tr)
+            raise
+        except EngineClosedError:
+            if tr is not None:
+                tr.discard("queue")
+                tr.terminal("engine_closed", time.monotonic(),
+                            name="closed")
+                ring.finish(tr)
             raise
         rec.gauge(f"serving.queue_depth.{entry.name}", q.depth())
         return fut
@@ -248,14 +294,23 @@ class ServingEngine:
     def _run_batch(self, entry: ModelEntry, q: BatchingQueue,
                    batch: List[Request]):
         rec = self.recorder
+        ring = self.trace_ring
         now = time.monotonic()
         live = []
         for r in batch:
+            tr = r.trace
+            if tr is not None:
+                tr.close("queue", now)
             if r.expired(now):
                 rec.inc("serving.shed_deadline")
+                if tr is not None:
+                    tr.terminal("deadline", now)
+                    ring.finish(tr)
                 r.future.set_exception(LoadShedError(
                     "deadline", "expired before execution"))
             else:
+                if tr is not None:
+                    tr.open("batch_gather", now)
                 live.append(r)
         if not live:
             return
@@ -272,6 +327,16 @@ class ServingEngine:
             # to prevent — counted, never silent
             rec.inc("serving.recompiles")
             ex = self._compile(entry, bucket, x.shape[1:])
+        t_exec = time.monotonic()
+        for r in live:
+            tr = r.trace
+            if tr is not None:
+                # batch/bucket attribution: which company this request
+                # kept, and how much padding it paid for
+                tr.meta.update(bucket=bucket, batch_rows=rows,
+                               batch_requests=len(live))
+                tr.close("batch_gather", t_exec)
+                tr.open("compute", t_exec)
         snap = entry.snapshot          # one atomic read per batch
         with rec.span("serving.execute"):
             y = ex(snap.params, snap.state, jnp.asarray(x))
@@ -279,9 +344,20 @@ class ServingEngine:
         done = time.monotonic()
         off = 0
         for r in live:
+            tr = r.trace
+            if tr is not None:
+                tr.close("compute", done)
+                tr.open("reply", done)
             sl = jax.tree_util.tree_map(
                 lambda a, o=off, n=r.n: a[o:o + n], y)
             off += r.n
+            if tr is not None:
+                # finish the trace BEFORE completing the future (same
+                # contract as _fail_batch and the shed paths): a client
+                # unblocked by .result() that immediately scrapes
+                # /trace must see its own request
+                tr.close("reply", time.monotonic())
+                ring.finish(tr)
             r.future.set_result(sl)
             rec.observe("serving.latency_ms", (done - r.arrival) * 1e3)
         rec.inc("serving.batches")
@@ -317,11 +393,46 @@ class ServingEngine:
                 # zero-recompile contract vacuous
                 ex = jitted
         entry.compiled[bucket] = ex
+        self._capture_bucket_cost(entry, bucket, ex)
         if entry.input_shape is None:
             entry.input_shape = tuple(feature_shape)
         if warm:
             self.recorder.inc("serving.warmup_compiles")
         return ex
+
+    def _capture_bucket_cost(self, entry: ModelEntry, bucket: int, ex):
+        """Harvest XLA cost/memory analysis from a freshly compiled
+        bucket executable (AOT path only — the jit fallback exposes no
+        analysis) into ``entry.cost[bucket]`` plus one ``profile``
+        record, so per-bucket compute cost is attributable next to the
+        batch-fill metrics.  Best-effort: never raises."""
+        from ..observability import profile as _profile
+        if not _profile.capture_enabled():
+            return
+        if not (hasattr(ex, "cost_analysis")
+                or hasattr(ex, "memory_analysis")):
+            return              # jit-fallback wrapper, nothing to read
+        try:
+            cost = _profile.capture_compiled(ex)
+        except Exception:
+            return
+        entry.cost[bucket] = cost
+        self.recorder.emit_record("profile", kind="serving_bucket",
+                                  model=entry.name, bucket=bucket,
+                                  cost=cost)
+
+    # -- per-request trace export ------------------------------------------ #
+    def dump_chrome_trace(self) -> str:
+        """Chrome-trace/Perfetto JSON of the recent completed request
+        traces (one track per request, B/E span pairs, trace IDs and
+        batch/bucket attribution in args).  Save to a file and load in
+        chrome://tracing or https://ui.perfetto.dev; also served live
+        by the ``/trace`` route of :meth:`serve_metrics`."""
+        from ..observability.profile import dump_chrome_trace
+        traces = self.trace_ring.traces() if self.trace_ring is not None \
+            else []
+        meta = {"dropped_traces": getattr(self.trace_ring, "dropped", 0)}
+        return dump_chrome_trace(traces, extra_meta=meta)
 
 
 def _close_queues(queues: Dict[str, BatchingQueue]):
@@ -343,6 +454,8 @@ def _worker_loop(engine_ref, name: str, q: BatchingQueue, max_rows: int):
         eng = engine_ref()
         if eng is None:
             q.close()
+            # engine (and its trace ring) already collected: the traces
+            # die with it, nothing left to export them from
             _fail_batch(batch, EngineClosedError(
                 "engine was garbage-collected before this request ran"))
             return
@@ -350,21 +463,35 @@ def _worker_loop(engine_ref, name: str, q: BatchingQueue, max_rows: int):
             try:
                 entry = eng.registry.get(name)
             except KeyError as e:
-                _fail_batch(batch, e)
+                _fail_batch(batch, e, ring=eng.trace_ring)
                 continue
             try:
                 eng._run_batch(entry, q, batch)
             except Exception as e:   # the batcher thread must survive
                 eng.recorder.inc("serving.errors")
-                _fail_batch(batch, e)
+                _fail_batch(batch, e, ring=eng.trace_ring)
         finally:
             del eng       # never hold the engine across a blocking wait
 
 
-def _fail_batch(batch: List[Request], exc: BaseException):
+def _fail_batch(batch: List[Request], exc: BaseException, ring=None,
+                span: str = "error"):
+    """Complete every still-pending request exceptionally AND finish its
+    trace with a terminal cause span — the error path is exactly where
+    an operator reads /trace, so it must not go dark there.  Requests
+    already completed (e.g. deadline-shed inside a failed _run_batch,
+    traces already finished) are skipped via future.done()."""
     for r in batch:
-        if not r.future.done():
-            r.future.set_exception(exc)
+        if r.future.done():
+            continue
+        tr = r.trace
+        if ring is not None and tr is not None:
+            # finish the trace BEFORE completing the future: a client
+            # that reacts to the exception by scraping /trace must see
+            # this request's track
+            tr.terminal(type(exc).__name__, time.monotonic(), name=span)
+            ring.finish(tr)
+        r.future.set_exception(exc)
 
 
 class _UnbatchingFuture(Future):

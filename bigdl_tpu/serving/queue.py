@@ -43,16 +43,23 @@ class EngineClosedError(RuntimeError):
 
 
 class Request:
-    """One in-flight prediction: ``x`` is ``(n, *feature_shape)``."""
+    """One in-flight prediction: ``x`` is ``(n, *feature_shape)``.
 
-    __slots__ = ("x", "n", "future", "arrival", "deadline")
+    ``trace`` optionally carries a
+    :class:`~bigdl_tpu.observability.profile.RequestTrace` — the
+    per-request span timeline (admit → queue → batch_gather → compute →
+    reply) the engine exports as Chrome-trace JSON via ``/trace``."""
 
-    def __init__(self, x, n: int, deadline: Optional[float] = None):
+    __slots__ = ("x", "n", "future", "arrival", "deadline", "trace")
+
+    def __init__(self, x, n: int, deadline: Optional[float] = None,
+                 trace=None):
         self.x = x
         self.n = int(n)
         self.future: Future = Future()
         self.arrival = time.monotonic()
         self.deadline = deadline        # absolute monotonic seconds, or None
+        self.trace = trace
 
     def expired(self, now: Optional[float] = None) -> bool:
         return (self.deadline is not None

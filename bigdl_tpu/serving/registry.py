@@ -65,6 +65,9 @@ class ModelEntry:
         # constants, so a weight swap cannot reuse the compiled buckets
         self.inference_only = inference_only
         self.compiled: Dict[int, Any] = {}     # bucket -> executable
+        # bucket -> XLA cost/memory capture (observability.profile):
+        # what one execution of that bucket costs, harvested at compile
+        self.cost: Dict[int, Any] = {}
         self.warmed = False
         self.swap_lock = threading.Lock()
         # auto versions start at v2: v1 is the registration snapshot

@@ -43,6 +43,11 @@ from bigdl_tpu.models import resnet                        # noqa: E402
 from bigdl_tpu.optim import SGD                            # noqa: E402
 from bigdl_tpu.optim.optimizer import make_train_step      # noqa: E402
 from bigdl_tpu.nn.module import Ctx                        # noqa: E402
+from bigdl_tpu.observability.profile import peak_flops     # noqa: E402
+
+# MFU denominator: env override (BIGDL_PEAK_FLOPS) > device peak-spec
+# table > the historical TPU-v5e constant these scripts assumed
+PEAK_FLOPS = peak_flops(default=197e12)
 
 
 def lat():
@@ -226,7 +231,7 @@ def exp_C(batch=256):
     # scale measured time by weighted/unweighted flop ratio
     uflops = sum(2.0 * batch * (hw // s) ** 2 * co * ci * kh * kw
                  for (co, ci, kh, kw, s, hw, m) in R50_CONVS)
-    eff = uflops / t / 197e12 * 100
+    eff = uflops / t / PEAK_FLOPS * 100
     print(f"C conv floor   : {t*1e3:7.2f} ms for 1x-each "
           f"({uflops/1e9:.0f} GFLOP) -> {eff:5.1f}% MFU; "
           f"full-net fwd conv time ~= {t*flops/uflops*1e3:6.2f} ms",

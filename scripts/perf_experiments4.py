@@ -43,6 +43,11 @@ from bigdl_tpu import nn                                   # noqa: E402
 from bigdl_tpu.models import resnet                        # noqa: E402
 from bigdl_tpu.optim import SGD                            # noqa: E402
 from bigdl_tpu.optim.optimizer import make_train_step      # noqa: E402
+from bigdl_tpu.observability.profile import peak_flops     # noqa: E402
+
+# MFU denominator: env override (BIGDL_PEAK_FLOPS) > device peak-spec
+# table > the historical TPU-v5e constant these scripts assumed
+PEAK_FLOPS = peak_flops(default=197e12)
 
 
 def lat():
@@ -90,7 +95,7 @@ def run_full(label, batch=256, stem="conv", k=10, x_bf16=False,
         ts.append((time.perf_counter() - t0 - l) / k)
     t = float(np.median(ts))
     print(f"{label}: {t*1e3:7.2f} ms  {batch/t:8.0f} img/s  "
-          f"({batch*12.3e9/t/197e12*100:4.1f}% MFU)", flush=True)
+          f"({batch*12.3e9/t/PEAK_FLOPS*100:4.1f}% MFU)", flush=True)
     return t
 
 

@@ -29,6 +29,14 @@ Two subcommands:
         python scripts/trace_summary.py health /tmp/telemetry.jsonl
         python scripts/trace_summary.py health /tmp/flight_dir
 
+  profile            cost/memory attribution from the observability.
+                     profile capture: compiled FLOPs and peak-HBM per
+                     train step against the device peaks, measured MFU
+                     and HBM-bandwidth utilization over the step
+                     records, and per-bucket serving compute cost:
+
+        python scripts/trace_summary.py profile /tmp/telemetry.jsonl
+
 CPU-only (no device access), so it is safe to run while the tunnel is
 wedged.
 """
@@ -78,6 +86,20 @@ def summarize(xs, top_n=25):
     return out
 
 
+def iter_jsonl(path):
+    """Yield parsed records from a JsonlSink file; blank and corrupt
+    lines (a crashed writer's torn tail) are skipped."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue
+
+
 def load_steps(path, last_n=None):
     """(steps, checkpoint_summary) from a JsonlSink telemetry file.
 
@@ -85,19 +107,11 @@ def load_steps(path, last_n=None):
     totals (commits finishing after the last step record was cut would
     otherwise be invisible); None when the run didn't emit one."""
     steps, ck_summary = [], None
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if rec.get("type") == "step":
-                steps.append(rec)
-            elif rec.get("type") == "checkpoint_summary":
-                ck_summary = rec
+    for rec in iter_jsonl(path):
+        if rec.get("type") == "step":
+            steps.append(rec)
+        elif rec.get("type") == "checkpoint_summary":
+            ck_summary = rec
     return (steps[-last_n:] if last_n else steps), ck_summary
 
 
@@ -214,17 +228,8 @@ def load_health(paths):
     for p in expanded:
         src = os.path.basename(p)
         if p.endswith(".jsonl"):
-            with open(p) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except json.JSONDecodeError:
-                        continue
-                    if rec.get("type") == "health_event":
-                        events.append((src, rec))
+            events += [(src, rec) for rec in iter_jsonl(p)
+                       if rec.get("type") == "health_event"]
             continue
         try:
             with open(p) as f:
@@ -287,6 +292,112 @@ def summarize_health(events, flights, out=print):
                 f"health_events={d.get('counters', {}).get('health/events', 0):.0f}")
 
 
+def load_profile(path):
+    """(profile_records, steps) from a JsonlSink telemetry file."""
+    profiles, steps = [], []
+    for rec in iter_jsonl(path):
+        if rec.get("type") == "profile":
+            profiles.append(rec)
+        elif rec.get("type") == "step":
+            steps.append(rec)
+    return profiles, steps
+
+
+def _pct(x):
+    return f"{100.0 * x:5.1f}%"
+
+
+def summarize_profile(profiles, steps, out=print):
+    """Render the cost/memory attribution: compiled per-step cost vs
+    device peaks, measured efficiency over the step records, and the
+    per-bucket serving cost table."""
+    if not profiles and not steps:
+        out("no profile or step records")
+        return
+    train = [p for p in profiles if p.get("kind") == "train_step"]
+    if train:
+        p = train[-1]           # the newest program is the live one
+        cost = p.get("cost", {}) or {}
+        out("== train step (compiled cost) ==")
+        out(f"  device {p.get('device', '?')}   peak "
+            + (f"{p['peak_flops'] / 1e12:.0f} TFLOP/s"
+               if p.get("peak_flops") else "FLOP/s unknown")
+            + (f"   HBM {p['peak_hbm_bw'] / 1e9:.0f} GB/s"
+               if p.get("peak_hbm_bw") else "")
+            + (f"   capacity {_fmt_bytes(p['hbm_capacity'])}"
+               if p.get("hbm_capacity") else ""))
+        if cost.get("flops") is not None:
+            out(f"  flops/step         {cost['flops'] / 1e9:12.3f} GFLOP")
+        if cost.get("bytes_accessed") is not None:
+            out(f"  bytes accessed     "
+                f"{_fmt_bytes(cost['bytes_accessed']):>12}")
+        if cost.get("peak_hbm_bytes") is not None:
+            line = (f"  peak HBM           "
+                    f"{_fmt_bytes(cost['peak_hbm_bytes']):>12}")
+            if p.get("hbm_capacity"):
+                line += (" ("
+                         + _pct(cost["peak_hbm_bytes"]
+                                / p["hbm_capacity"]).strip()
+                         + " of device)")
+            out(line)
+            for k in ("argument_bytes", "output_bytes", "temp_bytes",
+                      "generated_code_bytes"):
+                if cost.get(k) is not None:
+                    out(f"    {k[:-6]:<16} {_fmt_bytes(cost[k]):>12}")
+        if cost.get("unavailable"):
+            out(f"  unavailable: {', '.join(cost['unavailable'])}")
+
+    # measured efficiency: the per-step scalars end_step derived
+    mfu = [s["scalars"]["perf/mfu"] for s in steps
+           if isinstance(s.get("scalars", {}).get("perf/mfu"),
+                         (int, float))]
+    bw = [s["scalars"]["perf/hbm_bw_util"] for s in steps
+          if isinstance(s.get("scalars", {}).get("perf/hbm_bw_util"),
+                        (int, float))]
+    if mfu or bw:
+        out("\n== measured efficiency (over step records) ==")
+        if mfu:
+            out(f"  MFU            mean {_pct(sum(mfu) / len(mfu))}   "
+                f"best {_pct(max(mfu))}   over {len(mfu)} steps")
+        if bw:
+            out(f"  HBM bw util    mean {_pct(sum(bw) / len(bw))}   "
+                f"best {_pct(max(bw))}")
+    elif steps:
+        marks = sorted({k for s in steps
+                        for k in s.get("scalars", {})
+                        if k.endswith("_unavailable")})
+        if marks:
+            out("\n== measured efficiency ==")
+            out(f"  unavailable on this backend: {', '.join(marks)}")
+
+    buckets = [p for p in profiles if p.get("kind") == "serving_bucket"]
+    if buckets:
+        out("\n== serving buckets (compiled cost per execution) ==")
+        out(f"  {'model':<14} {'bucket':>6} {'GFLOP':>10} "
+            f"{'peak HBM':>12}")
+        seen = {}
+        for p in buckets:       # newest capture per (model, bucket) wins
+            seen[(p.get("model"), p.get("bucket"))] = p
+        for (model, bucket), p in sorted(
+                seen.items(), key=lambda kv: (str(kv[0][0]),
+                                              kv[0][1] or 0)):
+            cost = p.get("cost", {}) or {}
+            flops = cost.get("flops")
+            peak = cost.get("peak_hbm_bytes")
+            out(f"  {str(model):<14} {bucket:>6} "
+                f"{flops / 1e9 if flops is not None else float('nan'):>10.4f} "
+                f"{_fmt_bytes(peak) if peak is not None else '-':>12}")
+
+
+def main_profile(argv):
+    if not argv:
+        raise SystemExit("usage: trace_summary.py profile "
+                         "<telemetry.jsonl>")
+    profiles, steps = load_profile(argv[0])
+    print(f"telemetry: {argv[0]}")
+    summarize_profile(profiles, steps)
+
+
 def main_health(argv):
     if not argv:
         raise SystemExit("usage: trace_summary.py health "
@@ -325,6 +436,8 @@ def main():
     argv = sys.argv[1:]
     if argv and argv[0] == "steps":
         main_steps(argv[1:])
+    elif argv and argv[0] == "profile":
+        main_profile(argv[1:])
     elif argv and argv[0] == "health":
         main_health(argv[1:])
     elif argv and argv[0] == "xplane":

@@ -20,6 +20,11 @@ from bigdl_tpu.models.transformer import (TransformerLM,        # noqa: E402
                                           TransformerConfig,
                                           lm_cross_entropy)
 from bigdl_tpu.optim import SGD                                 # noqa: E402
+from bigdl_tpu.observability.profile import peak_flops          # noqa: E402
+
+# MFU denominator: env override (BIGDL_PEAK_FLOPS) > device peak-spec
+# table > the historical TPU-v5e constant this script assumed
+PEAK_FLOPS = peak_flops(default=197e12)
 
 
 def lat():
@@ -76,7 +81,7 @@ def measure(B, T, n_layers=8, d_model=1024,
     n_params = sum(int(np.prod(x.shape))
                    for x in jax.tree_util.tree_leaves(params))
     flops_per_tok = 6 * n_params + 12 * n_layers * d_model * T
-    mfu = tok_s * flops_per_tok / 197e12 * 100
+    mfu = tok_s * flops_per_tok / PEAK_FLOPS * 100
     return tok_s, mfu
 
 

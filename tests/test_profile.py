@@ -1,0 +1,428 @@
+"""Cost/memory attribution profiler (ISSUE 5 tentpole): device
+peak-spec lookup, XLA cost/memory capture, the StepCostModel's derived
+efficiency scalars, Recorder integration (cost model + gauge pollers +
+the traced-step exception regression), Chrome-trace export format, and
+the trace_summary profile renderer."""
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu.observability import InMemorySink, Recorder, set_recorder
+from bigdl_tpu.observability.profile import (DeviceSpec, RequestTrace,
+                                             StepCostModel, TraceRing,
+                                             aot_capture,
+                                             capture_compiled,
+                                             chrome_trace_events,
+                                             device_spec,
+                                             dump_chrome_trace, lookup,
+                                             peak_flops,
+                                             poll_device_memory)
+
+
+# --------------------------------------------------------------------- #
+# device peak specs                                                     #
+# --------------------------------------------------------------------- #
+def test_spec_table_lookup_known_kinds():
+    assert lookup("TPU v5 lite").peak_flops == 197e12
+    assert lookup("TPU v5p").peak_flops == 459e12
+    assert lookup("TPU v4").hbm_capacity == 32 * 1024 ** 3
+    assert lookup("NVIDIA A100-SXM4-80GB").peak_flops == 312e12
+    # v5p must not be swallowed by the bare "tpu v5" row
+    assert lookup("tpu v5p").name == "TPU v5p"
+    unknown = lookup("cpu")
+    assert unknown.peak_flops is None and not unknown.complete()
+    assert unknown.name == "cpu"    # reports WHAT was measured
+
+
+def test_env_overrides_win(monkeypatch):
+    monkeypatch.setenv("BIGDL_PEAK_FLOPS", "123e12")
+    monkeypatch.setenv("BIGDL_PEAK_HBM_BW", "5e11")
+    spec = device_spec()
+    assert spec.peak_flops == 123e12
+    assert spec.peak_hbm_bw == 5e11
+    assert peak_flops() == 123e12
+    # malformed override degrades to the table, never raises
+    monkeypatch.setenv("BIGDL_PEAK_FLOPS", "not-a-number")
+    assert peak_flops(default=7.0) == 7.0   # CPU: no table peak
+
+
+def test_peak_flops_default_fallback(monkeypatch):
+    monkeypatch.delenv("BIGDL_PEAK_FLOPS", raising=False)
+    # on the CPU test backend there is no table peak: default rules
+    assert peak_flops(default=197e12) == 197e12
+    assert peak_flops() is None
+
+
+# --------------------------------------------------------------------- #
+# XLA capture                                                           #
+# --------------------------------------------------------------------- #
+def test_capture_compiled_real_executable():
+    def f(a, b):
+        return (a @ b).sum()
+    a = jnp.ones((32, 32))
+    compiled = jax.jit(f).lower(a, a).compile()
+    cost = capture_compiled(compiled)
+    # one (32,32)@(32,32) matmul = 2*32^3 = 65536 FLOPs at least
+    assert cost["flops"] >= 2 * 32 ** 3
+    assert cost["bytes_accessed"] > 0
+    assert cost["peak_hbm_bytes"] >= cost.get("argument_bytes", 0)
+    assert "unavailable" not in cost
+
+
+def test_aot_capture_uses_avals_not_buffers():
+    def f(a):
+        return a * 2.0
+    cost = aot_capture(jax.jit(f), jnp.ones((16, 4)))
+    assert cost.get("flops") is not None
+    # abstract lowering: same answer from a ShapeDtypeStruct
+    cost2 = aot_capture(jax.jit(f),
+                        jax.ShapeDtypeStruct((16, 4), jnp.float32))
+    assert cost2["flops"] == cost["flops"]
+
+
+def test_capture_degrades_without_analysis_apis():
+    class NoApis:
+        pass
+
+    class Broken:
+        def cost_analysis(self):
+            raise NotImplementedError
+        def memory_analysis(self):
+            raise RuntimeError("backend says no")
+
+    for ex in (NoApis(), Broken()):
+        cost = capture_compiled(ex)
+        assert set(cost["unavailable"]) == {"cost_analysis",
+                                            "memory_analysis"}
+
+
+# --------------------------------------------------------------------- #
+# StepCostModel scalars                                                 #
+# --------------------------------------------------------------------- #
+def test_cost_model_derives_efficiency_with_peaks():
+    spec = DeviceSpec("test", peak_flops=1e12, peak_hbm_bw=1e11,
+                      hbm_capacity=1e9)
+    model = StepCostModel({"flops": 1e9, "bytes_accessed": 1e7,
+                           "peak_hbm_bytes": 5e8}, spec)
+    s = model.scalars(dur=0.01)     # 1e9/0.01 = 1e11 FLOP/s = 10% MFU
+    assert s["perf/mfu"] == pytest.approx(0.1)
+    assert s["perf/hbm_bw_util"] == pytest.approx(0.01)
+    assert s["mem/peak_hbm_bytes"] == 5e8
+    assert s["mem/peak_hbm_frac"] == pytest.approx(0.5)
+    assert not any(k.endswith("_unavailable") for k in s)
+
+
+def test_cost_model_explicit_unavailable_markers():
+    # no peaks (CPU): flops known -> rate + marker, never a wrong MFU
+    s = StepCostModel({"flops": 1e9}, DeviceSpec("cpu")).scalars(0.5)
+    assert s["perf/flops_per_sec"] == pytest.approx(2e9)
+    assert s["perf/mfu_unavailable"] == 1.0
+    assert s["mem/peak_hbm_bytes_unavailable"] == 1.0
+    assert "perf/mfu" not in s
+    # nothing captured at all -> all three markers
+    s = StepCostModel({}, DeviceSpec("cpu")).scalars(0.5)
+    for k in ("perf/mfu_unavailable", "perf/hbm_bw_util_unavailable",
+              "mem/peak_hbm_bytes_unavailable"):
+        assert s[k] == 1.0
+
+
+# --------------------------------------------------------------------- #
+# Recorder integration                                                  #
+# --------------------------------------------------------------------- #
+def test_recorder_folds_cost_scalars_into_step_records():
+    rec = Recorder(sinks=[InMemorySink()], annotate=False)
+    rec.set_cost_model(StepCostModel(
+        {"flops": 1e9, "peak_hbm_bytes": 1e6},
+        DeviceSpec("t", peak_flops=1e12)))
+    rec.start_step(1)
+    r = rec.end_step(1)
+    assert r["scalars"]["perf/mfu"] > 0
+    assert r["scalars"]["mem/peak_hbm_bytes"] == 1e6
+    # explicit scalars win over derived ones
+    rec.start_step(2)
+    rec.scalar("perf/mfu", 0.42)
+    r = rec.end_step(2)
+    assert r["scalars"]["perf/mfu"] == 0.42
+
+
+def test_recorder_gauge_pollers_refresh_on_snapshot():
+    rec = Recorder(annotate=False)
+    calls = []
+
+    def poller(r):
+        calls.append(1)
+        r.gauge("mem/device.0.bytes_in_use", 123.0)
+
+    def broken(r):
+        raise RuntimeError("boom")
+
+    rec.add_gauge_poller(poller)
+    rec.add_gauge_poller(broken)        # must never surface
+    snap = rec.snapshot()
+    assert snap["gauges"]["mem/device.0.bytes_in_use"] == 123.0
+    rec.start_step(1)
+    r = rec.end_step(1)
+    assert r["gauges"]["mem/device.0.bytes_in_use"] == 123.0
+    assert len(calls) == 2              # snapshot + end_step
+
+
+def test_poll_device_memory_cpu_marks_unavailable():
+    rec = Recorder(annotate=False)
+    poll_device_memory(rec)
+    snap = rec.snapshot()
+    mem = {k: v for k, v in snap["gauges"].items()
+           if k.startswith("mem/device.")}
+    # CPU backends expose no memory_stats: the explicit marker, never
+    # silence (a real accelerator asserts the per-device gauges instead)
+    assert mem.get("mem/device.stats_unavailable") == 1.0 \
+        or any(k.endswith("bytes_in_use") for k in mem)
+
+
+def test_traced_step_exception_cannot_wedge_profiler(monkeypatch):
+    """ISSUE 5 satellite: an exception mid-traced-step used to leave
+    ``_tracing`` latched True forever — every later step silently folded
+    into one wedged profiler session."""
+    state = {"active": 0, "starts": 0}
+
+    def fake_start(log_dir):
+        state["active"] += 1
+        state["starts"] += 1
+
+    def fake_stop():
+        if not state["active"]:
+            raise RuntimeError("no trace running")
+        state["active"] -= 1
+
+    monkeypatch.setattr(jax.profiler, "start_trace", fake_start)
+    monkeypatch.setattr(jax.profiler, "stop_trace", fake_stop)
+    rec = Recorder(annotate=False).trace_every(1, "/tmp/ignored")
+    rec.start_step(0)
+    assert state["active"] == 1
+    # the traced step raises: end_step/abort_step never run, the
+    # exception unwinds past the recorder...
+    rec.start_step(1)           # ...the next step must recover:
+    assert state["active"] == 1         # stale session closed, new one up
+    assert state["starts"] == 2
+    rec.end_step(1)
+    assert state["active"] == 0
+
+    # and a stop_trace failure must not propagate out of end_step
+    rec2 = Recorder(annotate=False).trace_every(1, "/tmp/ignored")
+    monkeypatch.setattr(jax.profiler, "stop_trace",
+                        lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    rec2.start_step(0)
+    r = rec2.end_step(0)        # must not raise
+    assert r is not None
+    assert rec2._tracing is False
+
+
+# --------------------------------------------------------------------- #
+# optimizer end-to-end                                                  #
+# --------------------------------------------------------------------- #
+def _train_once(sink, monkeypatch=None, **telemetry_kw):
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import SGD
+    from bigdl_tpu.optim.optimizer import LocalOptimizer
+    from bigdl_tpu.optim.trigger import Trigger
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(48, 8).astype(np.float32)
+    y = (rng.randint(0, 3, 48) + 1).astype(np.float32)
+    model = nn.Sequential(nn.Linear(8, 3), nn.LogSoftMax())
+    try:
+        opt = (LocalOptimizer(model, (x, y), nn.ClassNLLCriterion(),
+                              batch_size=16)
+               .set_optim_method(SGD(learning_rate=0.1))
+               .set_end_when(Trigger.max_epoch(1))
+               .set_telemetry(Recorder(sinks=[sink], annotate=False),
+                              **telemetry_kw))
+        opt.optimize()
+    finally:
+        set_recorder(None)
+
+
+def test_optimizer_step_records_carry_attribution(monkeypatch):
+    monkeypatch.setenv("BIGDL_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("BIGDL_PEAK_HBM_BW", "1e11")
+    sink = InMemorySink()
+    _train_once(sink)
+    profiles = [r for r in sink.records if r.get("type") == "profile"]
+    assert len(profiles) == 1           # one capture per step build
+    cost = profiles[0]["cost"]
+    assert cost["flops"] > 0 and cost["peak_hbm_bytes"] > 0
+    assert profiles[0]["peak_flops"] == 1e12
+    steps = sink.steps()
+    assert len(steps) == 3
+    for s in steps:
+        assert s["scalars"]["perf/mfu"] > 0
+        assert s["scalars"]["perf/hbm_bw_util"] > 0
+        assert s["scalars"]["mem/peak_hbm_bytes"] == \
+            cost["peak_hbm_bytes"]
+    # gauges render on /metrics via snapshot()
+    last = steps[-1]["gauges"]
+    assert last["mem/peak_hbm_bytes"] == cost["peak_hbm_bytes"]
+    assert last["profile/flops_per_step"] == cost["flops"]
+
+
+def test_optimizer_without_peaks_emits_markers(monkeypatch):
+    monkeypatch.delenv("BIGDL_PEAK_FLOPS", raising=False)
+    monkeypatch.delenv("BIGDL_PEAK_HBM_BW", raising=False)
+    sink = InMemorySink()
+    _train_once(sink)
+    s = sink.steps()[0]["scalars"]
+    # CPU: compiled flops known, no peak -> explicit markers, never a
+    # silently-wrong MFU
+    assert s["perf/mfu_unavailable"] == 1.0
+    assert s["perf/flops_per_sec"] > 0
+    assert s["mem/peak_hbm_bytes"] > 0
+
+
+def test_capture_cost_optout(monkeypatch):
+    sink = InMemorySink()
+    _train_once(sink, capture_cost=False)
+    assert not [r for r in sink.records if r.get("type") == "profile"]
+    assert "perf/mfu" not in sink.steps()[0]["scalars"]
+    assert "perf/mfu_unavailable" not in sink.steps()[0]["scalars"]
+    # the opt-out covers the per-step device-memory polling too
+    assert not any(k.startswith("mem/device.")
+                   for k in sink.steps()[-1]["gauges"])
+
+
+def test_capture_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("BIGDL_PROFILE_CAPTURE", "0")
+    sink = InMemorySink()
+    _train_once(sink)
+    assert not [r for r in sink.records if r.get("type") == "profile"]
+    assert not any(k.startswith("mem/device.")
+                   for k in sink.steps()[-1]["gauges"])
+
+
+# --------------------------------------------------------------------- #
+# Chrome-trace export                                                   #
+# --------------------------------------------------------------------- #
+def _mk_trace(ring, trace_id, model, spans, cause=None):
+    tr = RequestTrace(trace_id, model)
+    for name, t0, t1 in spans:
+        tr.add_span(name, t0, t1)
+    if cause:
+        tr.terminal(cause, spans[-1][2] if spans else 0.0)
+    ring.finish(tr)
+    return tr
+
+
+def test_chrome_trace_golden_format():
+    ring = TraceRing(capacity=8)
+    _mk_trace(ring, "aaaa", "m", [("admit", 1.0, 1.001),
+                                  ("queue", 1.001, 1.003),
+                                  ("batch_gather", 1.003, 1.004),
+                                  ("compute", 1.004, 1.010),
+                                  ("reply", 1.010, 1.0101)])
+    _mk_trace(ring, "bbbb", "m", [("admit", 1.2, 1.201),
+                                  ("queue", 1.201, 1.25)],
+              cause="deadline")
+    doc = json.loads(dump_chrome_trace(ring.traces()))
+    evs = doc["traceEvents"]
+    assert doc["displayTimeUnit"] == "ms"
+    # B/E pairing: per (tid, name), every B has exactly one E after it
+    opens = {}
+    for e in evs:
+        if e["ph"] == "M":
+            continue
+        key = (e["tid"], e["name"])
+        if e["ph"] == "B":
+            assert key not in opens, f"unbalanced B for {key}"
+            opens[key] = e["ts"]
+            assert "trace_id" in e["args"]
+        elif e["ph"] == "E":
+            assert key in opens, f"E without B for {key}"
+            assert e["ts"] >= opens.pop(key)
+    assert not opens, f"unclosed spans: {opens}"
+    # per-request track naming + one trace id per tid
+    names = {e["tid"]: e["args"]["name"] for e in evs
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert any("aaaa" in n for n in names.values())
+    ids_by_tid = {}
+    for e in evs:
+        if e["ph"] == "B":
+            ids_by_tid.setdefault(e["tid"], set()).add(
+                e["args"]["trace_id"])
+    assert all(len(ids) == 1 for ids in ids_by_tid.values())
+    # the shed request carries its terminal cause
+    shed = [e for e in evs if e["ph"] == "B" and e["name"] == "shed"]
+    assert shed and shed[0]["args"]["cause"] == "deadline"
+
+
+def test_trace_ring_is_bounded():
+    ring = TraceRing(capacity=4)
+    for i in range(10):
+        _mk_trace(ring, f"t{i}", "m", [("admit", float(i), i + 0.1)])
+    assert len(ring) == 4
+    assert ring.dropped == 6
+    assert [t.trace_id for t in ring.traces()] == \
+        ["t6", "t7", "t8", "t9"]
+
+
+def test_open_close_discard_span_protocol():
+    tr = RequestTrace("x", "m")
+    tr.open("queue", 1.0)
+    tr.close("queue", 2.0)
+    tr.open("batch_gather", 2.0)
+    tr.discard("batch_gather")
+    tr.close("batch_gather", 3.0)       # no matching open: dropped
+    tr.close("never_opened", 4.0)
+    assert [s[0] for s in tr.spans] == ["queue"]
+    assert tr.spans[0][1:3] == (1.0, 2.0)
+
+
+# --------------------------------------------------------------------- #
+# trace_summary profile renderer                                        #
+# --------------------------------------------------------------------- #
+def test_trace_summary_profile_subcommand(tmp_path):
+    import importlib.util
+    import os
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary", os.path.join(os.path.dirname(__file__),
+                                      os.pardir, "scripts",
+                                      "trace_summary.py"))
+    ts = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ts)
+
+    path = tmp_path / "t.jsonl"
+    recs = [
+        {"type": "profile", "kind": "train_step", "device": "TPU v5e",
+         "peak_flops": 197e12, "peak_hbm_bw": 819e9,
+         "hbm_capacity": 16 * 1024 ** 3,
+         "cost": {"flops": 1e12, "bytes_accessed": 1e9,
+                  "peak_hbm_bytes": 2e9, "argument_bytes": 1.5e9,
+                  "output_bytes": 0.4e9, "temp_bytes": 0.1e9}},
+        {"type": "profile", "kind": "serving_bucket", "model": "m",
+         "bucket": 8, "cost": {"flops": 3.2e9,
+                               "peak_hbm_bytes": 1e6}},
+        {"type": "step", "step": 1, "dur": 0.01,
+         "scalars": {"perf/mfu": 0.41, "perf/hbm_bw_util": 0.2}},
+        {"type": "step", "step": 2, "dur": 0.01,
+         "scalars": {"perf/mfu": 0.43, "perf/hbm_bw_util": 0.3}},
+    ]
+    with open(path, "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    profiles, steps = ts.load_profile(str(path))
+    assert len(profiles) == 2 and len(steps) == 2
+    lines = []
+    ts.summarize_profile(profiles, steps, out=lines.append)
+    text = "\n".join(lines)
+    assert "TPU v5e" in text and "197 TFLOP/s" in text
+    assert "MFU" in text and "42.0%" in text        # mean of .41/.43
+    assert "serving buckets" in text
+    assert "m" in text and "3.2" in text.replace("3.2000", "3.2")
+
+    # unavailable markers render as an explicit statement
+    lines = []
+    ts.summarize_profile(
+        [], [{"type": "step", "step": 1, "dur": 0.1,
+              "scalars": {"perf/mfu_unavailable": 1.0}}],
+        out=lines.append)
+    assert any("unavailable" in ln for ln in lines)

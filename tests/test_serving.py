@@ -2,8 +2,11 @@
 deadline-driven batching, load shedding, hot-swap atomicity, graceful
 drain, the PredictionService rebase, and the predict_image
 stale-weights regression."""
+import json
 import threading
 import time
+import urllib.error
+import urllib.request
 
 import numpy as np
 import jax.numpy as jnp
@@ -412,3 +415,218 @@ def test_predict_image_output_layer_sees_fresh_weights():
     second = np.array(list(frame)[0]["predict"])
     np.testing.assert_allclose(second, 0.0, atol=1e-6)
     assert not np.allclose(first, 0.0)   # the old weights weren't zero
+
+
+# --------------------------------------------------------------------- #
+# per-request tracing (ISSUE 5: cost/memory attribution profiler)       #
+# --------------------------------------------------------------------- #
+def _trace_events(eng):
+    doc = json.loads(eng.dump_chrome_trace())
+    return doc["traceEvents"]
+
+
+def _spans_by_trace(events):
+    """{trace_id: [span names in B order]} from a chrome event list."""
+    out = {}
+    for e in events:
+        if e["ph"] == "B":
+            out.setdefault(e["args"]["trace_id"], []).append(e["name"])
+    return out
+
+
+def test_request_trace_admit_to_reply_one_trace_id():
+    reg, eng = make_engine(max_delay_ms=1.0)
+    try:
+        eng.warmup()
+        eng.predict("m", np.ones((3, 4), np.float32), timeout=30)
+        events = _trace_events(eng)
+        spans = _spans_by_trace(events)
+        assert len(spans) == 1
+        (tid, names), = spans.items()
+        assert names == ["admit", "queue", "batch_gather", "compute",
+                         "reply"]
+        # B/E pairs balance per (tid, name) with E.ts >= B.ts
+        opens = {}
+        for e in events:
+            if e["ph"] == "M":
+                continue
+            key = (e["tid"], e["name"])
+            if e["ph"] == "B":
+                opens[key] = e["ts"]
+            else:
+                assert e["ts"] >= opens.pop(key)
+        assert not opens
+        # batch/bucket attribution rides on every span
+        b = [e for e in events if e["ph"] == "B"
+             and e["name"] == "compute"][0]
+        assert b["args"]["bucket"] == 4 and b["args"]["rows"] == 3
+        assert b["args"]["model"] == "m"
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_deadline_shed_trace_carries_terminal_cause():
+    reg, eng = make_engine()
+    try:
+        eng.warmup()
+        f = eng.submit("m", np.ones((2, 4), np.float32), deadline_ms=0.0)
+        time.sleep(0.01)
+        with pytest.raises(LoadShedError):
+            f.result(timeout=30)
+        deadline = time.monotonic() + 10
+        while not len(eng.trace_ring):      # batcher finishes the trace
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        spans = _spans_by_trace(_trace_events(eng))
+        (tid, names), = spans.items()
+        assert names == ["admit", "queue", "shed"]
+        shed = [e for e in _trace_events(eng) if e["ph"] == "B"
+                and e["name"] == "shed"][0]
+        assert shed["args"]["cause"] == "deadline"
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_queue_full_shed_trace_terminal_at_admission():
+    reg, eng = make_engine(max_queue_rows=4, max_batch=4,
+                           max_delay_ms=1.0)
+    gate = threading.Event()
+    orig = eng._run_batch
+
+    def gated(entry, q, batch):
+        gate.wait(30)
+        orig(entry, q, batch)
+
+    eng._run_batch = gated
+    try:
+        eng.warmup()
+        blocker = eng.submit("m", np.ones((4, 4), np.float32))
+        deadline = time.monotonic() + 10
+        while eng._queues["m"].depth() > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        filler = eng.submit("m", np.ones((4, 4), np.float32))
+        with pytest.raises(LoadShedError):
+            eng.submit("m", np.ones((1, 4), np.float32))
+        # the shed trace is final BEFORE the worker ever saw it
+        shed_traces = [t for t in eng.trace_ring.traces()
+                       if t.meta.get("cause") == "queue_full"]
+        assert len(shed_traces) == 1
+        assert [s[0] for s in shed_traces[0].spans] == ["admit", "shed"]
+        gate.set()
+        blocker.result(timeout=30)
+        filler.result(timeout=30)
+    finally:
+        gate.set()
+        eng.shutdown(drain=True)
+
+
+def test_trace_endpoint_serves_chrome_json():
+    reg, eng = make_engine(max_delay_ms=1.0)
+    srv = None
+    try:
+        eng.warmup()
+        eng.predict("m", np.ones((2, 4), np.float32), timeout=30)
+        srv = eng.serve_metrics(port=0)
+        with urllib.request.urlopen(srv.url("/trace"), timeout=10) as r:
+            assert r.status == 200
+            assert r.headers["Content-Type"] == "application/json"
+            doc = json.loads(r.read().decode())
+        names = [e["name"] for e in doc["traceEvents"]
+                 if e["ph"] == "B"]
+        assert {"admit", "queue", "compute", "reply"} <= set(names)
+    finally:
+        eng.shutdown(drain=True)   # also stops the server
+
+
+def test_trace_disabled_engine_404s_and_costs_nothing():
+    reg, eng = make_engine(trace_requests=False)
+    srv = None
+    try:
+        eng.warmup()
+        eng.predict("m", np.ones((2, 4), np.float32), timeout=30)
+        assert eng.trace_ring is None
+        doc = json.loads(eng.dump_chrome_trace())
+        assert [e for e in doc["traceEvents"] if e["ph"] != "M"] == []
+        srv = eng.serve_metrics(port=0)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url("/trace"), timeout=10)
+        assert ei.value.code == 404
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_bucket_cost_captured_at_warmup():
+    reg, eng = make_engine(max_batch=8)
+    try:
+        eng.warmup()
+        entry = reg.get("m")
+        assert set(entry.cost) == {1, 2, 4, 8}
+        for bucket, cost in entry.cost.items():
+            if "unavailable" in cost:       # backend without the APIs
+                continue
+            assert cost["flops"] > 0
+        profs = eng.recorder.recent_records(rec_type="profile")
+        assert {p["bucket"] for p in profs} == {1, 2, 4, 8}
+        assert all(p["kind"] == "serving_bucket" and p["model"] == "m"
+                   for p in profs)
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_failed_batch_traces_carry_terminal_error():
+    """Review finding: a request that dies inside _run_batch must still
+    land in the trace ring with a terminal cause — the error path is
+    exactly where an operator reads /trace."""
+    reg, eng = make_engine(max_delay_ms=1.0)
+    orig = eng._run_batch
+
+    def broken(entry, q, batch):
+        raise RuntimeError("executable exploded")
+
+    eng._run_batch = broken
+    try:
+        eng.warmup()
+        f = eng.submit("m", np.ones((2, 4), np.float32))
+        with pytest.raises(RuntimeError):
+            f.result(timeout=30)
+        traces = [t for t in eng.trace_ring.traces()
+                  if t.meta.get("cause") == "RuntimeError"]
+        assert len(traces) == 1
+        names = [s[0] for s in traces[0].spans]
+        # queue closed at terminal time, then the error cause span
+        assert "queue" in names and names[-1] == "error"
+        assert eng.recorder.counter_value("serving.errors") == 1
+    finally:
+        eng._run_batch = orig
+        eng.shutdown(drain=True)
+
+
+def test_fast_shutdown_traces_carry_closed_cause():
+    reg, eng = make_engine(max_queue_rows=64, max_batch=4,
+                           max_delay_ms=200.0)
+    gate = threading.Event()
+    orig = eng._run_batch
+
+    def gated(entry, q, batch):
+        gate.wait(30)
+
+    eng._run_batch = gated
+    try:
+        eng.warmup()
+        eng.submit("m", np.ones((4, 4), np.float32))   # parks the worker
+        deadline = time.monotonic() + 10
+        while eng._queues["m"].depth() > 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.001)
+        f = eng.submit("m", np.ones((2, 4), np.float32))  # stays queued
+        eng.shutdown(drain=False, timeout=0.1)
+        with pytest.raises(EngineClosedError):
+            f.result(timeout=30)
+        closed = [t for t in eng.trace_ring.traces()
+                  if t.meta.get("cause") == "EngineClosedError"]
+        assert len(closed) == 1
+        assert [s[0] for s in closed[0].spans][-1] == "closed"
+    finally:
+        gate.set()
+        eng.shutdown(drain=False)
