@@ -23,6 +23,11 @@ property of the checkpoint format instead:
   * **fault injection** — :mod:`~bigdl_tpu.checkpoint.faults` kills the
     writer at configurable byte offsets so crash consistency is a
     tested property, not a hope
+  * **elastic reshard** — v2 manifests record the save-time mesh, and
+    :mod:`~bigdl_tpu.checkpoint.reshard` assembles global arrays from
+    whatever slice shards exist, so a checkpoint saved on one mesh
+    restores onto any other (``bigdl_tpu.elastic`` drives the full
+    shrink-on-preemption / regrow-on-capacity loop)
 
 Wired into ``optim.Optimizer.set_checkpoint`` (default) and
 ``parallel.spmd.SpmdTrainer`` (``layout="manifest"``).  See
@@ -35,10 +40,10 @@ from .manifest import (CheckpointError, Manifest, Shard, read_manifest,
 from .manager import CheckpointManager, host_snapshot
 from .preemption import PreemptionHandler
 from .writer import AsyncCheckpointWriter
-from . import faults
+from . import faults, reshard
 
 __all__ = [
     "CheckpointError", "Manifest", "Shard", "read_manifest", "scan",
     "verify", "CheckpointManager", "host_snapshot", "PreemptionHandler",
-    "AsyncCheckpointWriter", "faults",
+    "AsyncCheckpointWriter", "faults", "reshard",
 ]

@@ -35,7 +35,7 @@ import shutil
 import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
-from . import faults, manifest as mlib
+from . import faults, manifest as mlib, reshard
 from .manifest import DIR_PREFIX, Manifest, Shard, data_crc32c, safe_tag
 from .writer import AsyncCheckpointWriter
 
@@ -121,17 +121,26 @@ class CheckpointManager:
 
     # -- save ------------------------------------------------------------ #
     def save(self, payload, meta: Dict[str, Any], tag: str,
-             sync: bool = False):
+             sync: bool = False, mesh: Optional[Dict] = None,
+             owned=None):
         """Queue one checkpoint.  ``payload`` must already be HOST data
         (numpy leaves): for the "manifest" layout a ``{shard_name: tree}``
         dict, for "file" an arbitrary state tree.  ``sync=True`` (or a
         manager built with ``async_write=False``) blocks until the
-        checkpoint is committed."""
+        checkpoint is committed.
+
+        ``mesh`` (a :func:`..reshard.mesh_info` dict) is recorded in the
+        v2 manifest so restore can tell resume from reshard.  ``owned``
+        optionally names the shards THIS process writes (elastic sliced
+        saves, where each host owns its own fragment entries); the
+        default keeps the round-robin-by-sorted-name assignment."""
         if self.layout == "manifest":
             if not isinstance(payload, dict):
                 raise TypeError("manifest layout expects {shard_name: tree}")
             trees = dict(payload)
-            job = lambda: self._write_manifest_ckpt(trees, dict(meta), tag)
+            owned = None if owned is None else frozenset(owned)
+            job = lambda: self._write_manifest_ckpt(trees, dict(meta), tag,
+                                                    mesh=mesh, owned=owned)
         else:
             job = lambda: self._write_file_ckpt(payload, dict(meta), tag)
         if sync or not self.async_write:
@@ -165,7 +174,7 @@ class CheckpointManager:
         return "checkpoint.async_write" if self.async_write \
             else "checkpoint.write"
 
-    def _write_manifest_ckpt(self, trees, meta, tag):
+    def _write_manifest_ckpt(self, trees, meta, tag, mesh=None, owned=None):
         rec = self._rec()
         t0 = time.perf_counter()
         faults.begin_save()
@@ -185,21 +194,31 @@ class CheckpointManager:
         names = sorted(trees)
         shards, total = [], 0
         for i, name in enumerate(names):
-            if i % self.process_count != self.process_index:
+            if owned is not None:
+                if name not in owned:
+                    continue    # caller-decided ownership (elastic saves)
+            elif i % self.process_count != self.process_index:
                 continue        # per-host shard ownership
-            data = _serialize_tree(trees[name])
+            payload = trees[name]
+            data = _serialize_tree(payload)
             fname = f"shard{i:04d}.bin"
             fpath = os.path.join(d, fname)
             if os.path.exists(fpath):
                 os.remove(fpath)
             faults.guarded_write(fpath, data, kind="shard")
-            shards.append(Shard(name, fname, len(data), data_crc32c(data)))
+            if reshard.is_fragment_payload(payload):
+                shards.append(Shard(name, fname, len(data),
+                                    data_crc32c(data), kind="slices",
+                                    of=payload.get("of", name)))
+            else:
+                shards.append(Shard(name, fname, len(data),
+                                    data_crc32c(data)))
             total += len(data)
         if total:
             rec.inc("checkpoint/bytes_written", total)
         faults.on_pre_manifest()
         mf = Manifest(tag=str(tag), meta=meta, shards=shards,
-                      created=time.time())
+                      created=time.time(), mesh=mesh)
         if self.process_count > 1:
             mlib.write_manifest_part(d, self.process_index, mf)
             if self.process_index != 0:
@@ -300,12 +319,36 @@ class CheckpointManager:
                     pass
 
     # -- restore --------------------------------------------------------- #
-    def restore_latest(self) -> Optional[Tuple[str, Any, Dict]]:
+    @staticmethod
+    def _assemble_entries(trees, mf: Manifest):
+        """Collapse v2 sliced shards into their logical entries: group
+        every ``kind="slices"`` shard by its ``of`` name and reassemble
+        the global arrays; whole-tree shards pass through untouched."""
+        merged, groups = {}, {}
+        for s in mf.shards:
+            payload = trees[s.name]
+            if s.kind == "slices" or reshard.is_fragment_payload(payload):
+                logical = s.of or (payload.get("of")
+                                   if isinstance(payload, dict) else None)
+                groups.setdefault(logical or s.name, []).append(payload)
+            else:
+                merged[s.name] = payload
+        for logical, parts in groups.items():
+            merged[logical] = reshard.assemble(parts)
+        return merged
+
+    def restore_latest(self, with_manifest: bool = False
+                       ) -> Optional[Tuple]:
         """``("manifest", {shard: tree}, meta)`` or ``("file", state,
         meta)`` for the newest intact checkpoint, else None.  Waits for
         in-flight writes first, prefers the ``latest`` pointer's target
         when it verifies, and otherwise scans — a torn newest checkpoint
-        falls back to the next intact one."""
+        falls back to the next intact one.  Sliced (elastic) shards are
+        reassembled into global arrays, whatever mesh wrote them.
+
+        ``with_manifest=True`` appends the restored checkpoint's
+        :class:`Manifest` (None for the legacy file layout) — the
+        save-time mesh restorers reshard against."""
         self.wait()
         # shallow scan for ordering; the expensive full-CRC pass runs
         # per candidate below, so resume cost is O(restored checkpoint),
@@ -329,12 +372,17 @@ class CheckpointManager:
             try:
                 trees = {s.name: _load_payload_file(os.path.join(d, s.file))
                          for s in mf.shards}
+                trees = self._assemble_entries(trees, mf)
             except Exception as e:      # CRC passed but decode failed
                 print(f"[checkpoint] {d}: unreadable despite manifest "
                       f"({e!r}); trying older checkpoints")
                 continue
-            return ("manifest", trees, dict(mf.meta))
-        return self._restore_legacy_file()
+            out = ("manifest", trees, dict(mf.meta))
+            return out + (mf,) if with_manifest else out
+        legacy = self._restore_legacy_file()
+        if legacy is not None and with_manifest:
+            return legacy + (None,)
+        return legacy
 
     def _restore_legacy_file(self):
         paths = []

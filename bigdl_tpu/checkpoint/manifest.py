@@ -13,6 +13,22 @@ Multi-host writers (parallel/spmd.py) each contribute a
 ``MANIFEST.partK.json`` covering the shards they own; host 0 merges the
 parts into the final ``MANIFEST.json``, which remains the single commit
 point for the whole checkpoint.
+
+Format v2 (elastic resume) adds, without breaking v1 readers of v1
+files:
+
+  * ``mesh`` — the SAVE-TIME device mesh (ordered axis names/sizes,
+    device and process counts, see :func:`..reshard.mesh_info`), so
+    restore can tell an identical-topology resume from a reshard and
+    name both sides in its errors;
+  * per-shard ``kind``/``of`` — ``kind="slices"`` marks a shard holding
+    per-device array fragments with index maps (one shard per host per
+    logical entry) that restore reassembles into global arrays; the
+    default ``kind="tree"`` stays byte-compatible with v1 entries.
+
+v1 manifests (no mesh, tree shards only) remain fully readable and are
+treated as "mesh unknown": they restore onto an identical mesh exactly
+as before.
 """
 from __future__ import annotations
 
@@ -26,7 +42,7 @@ from typing import Dict, List, Optional, Tuple
 from ..utils.crc32c import mask
 
 FORMAT = "bigdl_tpu.checkpoint"
-VERSION = 1
+VERSION = 2        # v2: mesh metadata + sliced-shard entries (elastic)
 MANIFEST_NAME = "MANIFEST.json"
 PART_PREFIX = "MANIFEST.part"
 DIR_PREFIX = "ckpt_"
@@ -67,16 +83,28 @@ class Shard:
     file: str          # file name inside the checkpoint directory
     bytes: int
     crc32c: int        # masked CRC32C of the file contents
+    # v2 sliced shards: kind="slices" marks per-device array fragments
+    # (with index maps) of the logical entry named by ``of``; restore
+    # groups every slice shard with the same ``of`` and reassembles the
+    # global arrays.  kind="tree" (default) is the v1 whole-tree payload.
+    kind: str = "tree"
+    of: Optional[str] = None
 
     def to_json(self):
-        return {"name": self.name, "file": self.file,
-                "bytes": int(self.bytes), "crc32c": int(self.crc32c)}
+        out = {"name": self.name, "file": self.file,
+               "bytes": int(self.bytes), "crc32c": int(self.crc32c)}
+        if self.kind != "tree":
+            out["kind"] = self.kind
+        if self.of is not None:
+            out["of"] = self.of
+        return out
 
     @staticmethod
     def from_json(d):
         try:
             return Shard(str(d["name"]), str(d["file"]), int(d["bytes"]),
-                         int(d["crc32c"]))
+                         int(d["crc32c"]), str(d.get("kind", "tree")),
+                         None if d.get("of") is None else str(d["of"]))
         except (KeyError, TypeError, ValueError) as e:
             raise CheckpointError(f"malformed shard entry {d!r}") from e
 
@@ -87,11 +115,25 @@ class Manifest:
     meta: Dict = field(default_factory=dict)
     shards: List[Shard] = field(default_factory=list)
     created: float = 0.0
+    # v2: the SAVE-TIME mesh ({"axes": [[name, size], ...], "devices": n,
+    # "processes": k}); None on v1 manifests and non-mesh writers
+    mesh: Optional[Dict] = None
+    # version as READ from disk (None for freshly built manifests);
+    # to_json stamps the LOWEST version that can express the content,
+    # so plain tree-shard saves without mesh metadata stay readable by
+    # pre-v2 libraries in a mixed-version fleet
+    version: Optional[int] = None
 
     def to_json(self):
-        return {"format": FORMAT, "version": VERSION, "tag": self.tag,
-                "created": self.created, "meta": self.meta,
-                "shards": [s.to_json() for s in self.shards]}
+        v2 = self.mesh is not None or any(s.kind != "tree" or s.of
+                                          for s in self.shards)
+        out = {"format": FORMAT, "version": VERSION if v2 else 1,
+               "tag": self.tag, "created": self.created,
+               "meta": self.meta,
+               "shards": [s.to_json() for s in self.shards]}
+        if self.mesh is not None:
+            out["mesh"] = self.mesh
+        return out
 
     @staticmethod
     def from_json(d, where=""):
@@ -100,9 +142,12 @@ class Manifest:
         if d.get("version", 0) > VERSION:
             raise CheckpointError(
                 f"{where}: unsupported manifest version {d.get('version')}")
+        mesh = d.get("mesh")
         return Manifest(str(d.get("tag", "")), dict(d.get("meta", {})),
                         [Shard.from_json(s) for s in d.get("shards", [])],
-                        float(d.get("created", 0.0)))
+                        float(d.get("created", 0.0)),
+                        dict(mesh) if isinstance(mesh, dict) else None,
+                        int(d.get("version", 0)) or None)
 
     def sort_key(self) -> Tuple:
         """Newest-checkpoint ordering: training position, then wall time."""

@@ -1,0 +1,245 @@
+"""Elastic resharding: mesh metadata, array fragments, global assembly.
+
+A v1 manifest checkpoint stores each logical entry ("params/fc1",
+"opt_state") as one whole-tree shard of GLOBAL host arrays; restoring
+it onto a different mesh only needs a re-``device_put`` against the
+target shardings.  What it cannot express is a save where no single
+host holds a global array — the realistic multi-host fsdp/tp case.
+
+This module provides the v2 representation and the restore-side math:
+
+  * :func:`mesh_info` / :func:`same_mesh` / :func:`describe_delta` —
+    the save-time mesh recorded in MANIFEST.json and the actionable
+    "saved mesh X, target mesh Y" wording restore errors use;
+  * :func:`split_fragments` — per-leaf, per-device **replica-0 slices**
+    of a (possibly sharded) jax array tree, each with its global index
+    map.  Every distinct slice of every leaf is written by exactly one
+    host (jax assigns ``replica_id`` 0 to one device per slice), so the
+    union of all hosts' fragment shards is exactly one copy of the
+    global state, whatever the mesh looked like;
+  * :func:`assemble` — the inverse: merge fragment payloads from
+    *whatever shards exist* into global numpy arrays, verifying every
+    element is covered (a missing host's slices fail loudly, they do
+    not restore as zeros).
+
+Fragments carry owning copies (``np.array``, never ``np.asarray``):
+the step loop donates the source buffers, and the async writer must
+never serialize a view the next step scribbles over (the PR-3 hazard
+class).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .manifest import CheckpointError
+
+FRAGMENT_KEY = "__elastic_fragments__"
+FRAGMENT_VERSION = 1
+_LEAF = "__leaf__"      # skeleton placeholder (a string: stays a leaf)
+
+
+# --------------------------------------------------------------------- #
+# mesh metadata                                                          #
+# --------------------------------------------------------------------- #
+def mesh_info(mesh) -> Dict[str, Any]:
+    """JSON-able description of a ``jax.sharding.Mesh``: ordered axis
+    names/sizes plus device and process counts."""
+    import jax
+    axes = [[str(a), int(mesh.shape[a])] for a in mesh.axis_names]
+    return {"axes": axes,
+            "devices": int(np.prod([s for _, s in axes], dtype=np.int64)),
+            "processes": int(jax.process_count())}
+
+
+def mesh_axes(info: Optional[Dict]) -> Dict[str, int]:
+    """``{axis: size}`` from a :func:`mesh_info` dict (ordered)."""
+    return {str(n): int(s) for n, s in (info or {}).get("axes", [])}
+
+
+def same_mesh(a: Optional[Dict], b: Optional[Dict]) -> bool:
+    """Same topology: identical ordered axes and process count.  An
+    unknown side (v1 manifest) never counts as different — legacy
+    checkpoints keep restoring without mesh checks."""
+    if a is None or b is None:
+        return True
+    return (list(map(tuple, a.get("axes", [])))
+            == list(map(tuple, b.get("axes", [])))
+            and a.get("processes") == b.get("processes"))
+
+
+def fmt_mesh(info: Optional[Dict]) -> str:
+    """One shared human rendering of a :func:`mesh_info` dict (restore
+    errors, logs, and ckpt_inspect all use this — one schema, one
+    wording)."""
+    if info is None:
+        return "<unknown mesh (v1 manifest)>"
+    axes = "×".join(f"{n}={s}" for n, s in info.get("axes", []))
+    return (f"{{{axes or 'no axes'}}} ({info.get('devices', '?')} devices, "
+            f"{info.get('processes', '?')} process(es))")
+
+
+def describe_delta(saved: Optional[Dict], target: Optional[Dict]) -> str:
+    """Human-readable save→target mesh delta for logs and errors."""
+    parts = [f"saved on {fmt_mesh(saved)}, restoring onto "
+             f"{fmt_mesh(target)}"]
+    if saved is not None and target is not None:
+        sa, ta = mesh_axes(saved), mesh_axes(target)
+        changed = [f"{n} {sa.get(n, 1)}→{ta.get(n, 1)}"
+                   for n in dict.fromkeys(list(sa) + list(ta))
+                   if sa.get(n, 1) != ta.get(n, 1)]
+        if changed:
+            parts.append("axis deltas: " + ", ".join(changed))
+        if saved.get("devices") != target.get("devices"):
+            parts.append(f"device count {saved.get('devices')}→"
+                         f"{target.get('devices')}")
+    return "; ".join(parts)
+
+
+def explain_shape_delta(got, want, saved: Optional[Dict],
+                        target: Optional[Dict]) -> Optional[str]:
+    """If a restored leaf's shape mismatch looks like a per-host/LOCAL
+    array saved where a global one belongs (some dim off by exactly a
+    saved-mesh axis size or the device-count ratio), say so — the one
+    mismatch class a mesh delta explains.  Returns None otherwise."""
+    got, want = tuple(got), tuple(want)
+    if saved is None or len(got) != len(want):
+        return None
+    factors = {f"saved axis '{n}'": s for n, s in saved.get("axes", [])
+               if s > 1}
+    sd = saved.get("devices")
+    td = None if target is None else target.get("devices")
+    if sd and td and sd != td:
+        hi, lo = max(sd, td), min(sd, td)
+        if hi % lo == 0 and hi // lo > 1:
+            factors[f"device-count ratio {sd}:{td}"] = hi // lo
+    for dim, (g, w) in enumerate(zip(got, want)):
+        if g == w:
+            continue
+        for why, f in factors.items():
+            if g * f == w or w * f == g:
+                return (f"dim {dim} is off by exactly {f} ({why}): the "
+                        "checkpoint looks like a per-host LOCAL array "
+                        "saved where a global one belongs")
+    return None
+
+
+# --------------------------------------------------------------------- #
+# fragment payloads                                                      #
+# --------------------------------------------------------------------- #
+def is_fragment_payload(payload) -> bool:
+    return isinstance(payload, dict) and FRAGMENT_KEY in payload
+
+
+def all_array_leaves(tree) -> bool:
+    """Fragment saves need numeric/bool array leaves (jax/numpy/python
+    scalars); exotic leaves (bytes, strings, objects) stay on the
+    whole-tree shard path, whose pickle fallback round-trips them."""
+    import jax
+    for leaf in jax.tree_util.tree_leaves(tree):
+        if isinstance(leaf, jax.Array):
+            continue
+        try:
+            if np.asarray(leaf).dtype.kind not in "biufc":
+                return False
+        except Exception:
+            return False
+    return True
+
+
+def _bounds(index, shape) -> List[List[int]]:
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(dim)
+        if step != 1:
+            raise CheckpointError(f"non-contiguous shard slice {sl!r}")
+        out.append([int(start), int(stop)])
+    return out
+
+
+def split_fragments(tree, process_index: int = 0) -> Dict[str, Any]:
+    """This host's replica-0 slices of every leaf, with index maps.
+
+    The payload also carries the tree *skeleton* (leaves replaced by a
+    placeholder) so :func:`assemble` can rebuild the exact pytree
+    structure without the saver's templates.  Host-side non-jax leaves
+    are replicated by construction; process 0 writes them."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    skeleton = jax.tree_util.tree_unflatten(treedef, [_LEAF] * len(leaves))
+    frags = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, jax.Array):
+            shape = tuple(leaf.shape)
+            for sh in leaf.addressable_shards:
+                if sh.replica_id != 0:
+                    continue        # exactly one host owns each slice
+                frags.append({
+                    "leaf": i, "index": _bounds(sh.index, shape),
+                    "shape": list(shape), "dtype": str(leaf.dtype),
+                    "data": np.array(sh.data)})       # owning copy
+        elif process_index == 0:
+            a = np.array(leaf)                        # owning copy
+            frags.append({"leaf": i,
+                          "index": [[0, s] for s in a.shape],
+                          "shape": list(a.shape), "dtype": str(a.dtype),
+                          "data": a})
+    return {FRAGMENT_KEY: FRAGMENT_VERSION, "skeleton": skeleton,
+            "leaves": frags}
+
+
+def assemble(payloads: List[Dict[str, Any]]):
+    """Merge fragment payloads (any number of hosts, any save mesh)
+    into one tree of GLOBAL numpy arrays.  Every element of every leaf
+    must be covered by some fragment — partial coverage (a lost host's
+    shards) raises :class:`CheckpointError` instead of silently
+    restoring zeros."""
+    import jax
+    if not payloads:
+        raise CheckpointError("no fragment payloads to assemble")
+    for p in payloads:
+        if not is_fragment_payload(p):
+            raise CheckpointError("not an elastic fragment payload")
+        if p[FRAGMENT_KEY] > FRAGMENT_VERSION:
+            raise CheckpointError(
+                f"unsupported fragment version {p[FRAGMENT_KEY]}")
+    skeleton = payloads[0]["skeleton"]
+    marks, treedef = jax.tree_util.tree_flatten(skeleton)
+    n = len(marks)
+    by_leaf: List[List[Dict]] = [[] for _ in range(n)]
+    for p in payloads:
+        for f in p.get("leaves", []):
+            i = int(f["leaf"])
+            if not 0 <= i < n:
+                raise CheckpointError(f"fragment for unknown leaf {i}")
+            by_leaf[i].append(f)
+    # leaf-major: one bool coverage mask lives at a time (a full-model
+    # list of masks would add +25% of an f32 checkpoint to the restore
+    # peak — and restore runs exactly when capacity just shrank)
+    out: List[Optional[np.ndarray]] = [None] * n
+    for i, frags in enumerate(by_leaf):
+        if not frags:
+            raise CheckpointError(
+                f"leaf {i}: incomplete fragment coverage (entirely "
+                "missing) — a host's slice shards are absent")
+        shape = tuple(int(s) for s in frags[0]["shape"])
+        dtype = np.dtype(frags[0]["dtype"])
+        arr = np.zeros(shape, dtype)
+        seen = np.zeros(shape, bool)
+        for f in frags:
+            if tuple(int(s) for s in f["shape"]) != shape \
+                    or np.dtype(f["dtype"]) != dtype:
+                raise CheckpointError(
+                    f"leaf {i}: conflicting fragment metadata "
+                    f"{f['shape']}/{f['dtype']} vs {shape}/{dtype}")
+            sl = tuple(slice(int(s), int(e)) for s, e in f["index"])
+            arr[sl] = f["data"]
+            seen[sl] = True
+        if not seen.all():
+            raise CheckpointError(
+                f"leaf {i}: incomplete fragment coverage "
+                f"({int((~seen).sum())}/{seen.size} elements missing) "
+                "— a host's slice shards are absent")
+        out[i] = arr
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,21 @@
+"""bigdl_tpu.elastic — survive preemption by shrinking, not dying.
+
+The "seamless scaling of AI pipelines" story of BigDL 2.0
+(arXiv:2204.01715) made TPU-native: v2 manifest checkpoints record the
+save-time mesh and restore reassembles global arrays from whatever
+slice shards exist (:mod:`bigdl_tpu.checkpoint.reshard`), so the
+:class:`ElasticSupervisor` can commit a final checkpoint on SIGTERM,
+re-plan the largest mesh the surviving capacity supports
+(:func:`plan_mesh`, shrinking ``dp`` first), resume through the
+reshard path, and regrow when devices return — emitting ``elastic/*``
+counters and health events through the existing Recorder.
+
+See ``docs/checkpointing.md`` § Elastic resume.
+"""
+from __future__ import annotations
+
+from .plan import SHRINK_PRIORITY, plan_devices, plan_mesh
+from .supervisor import ElasticSupervisor
+
+__all__ = ["ElasticSupervisor", "plan_mesh", "plan_devices",
+           "SHRINK_PRIORITY"]

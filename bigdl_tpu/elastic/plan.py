@@ -1,0 +1,98 @@
+"""Mesh re-planning: fit a named-axis topology onto surviving capacity.
+
+The supervisor keeps one *template* mesh ({axis: size} at full
+capacity) and asks :func:`plan_mesh` what to run on whatever devices
+are still alive.  Axis names and order never change — every parameter
+PartitionSpec stays valid — and each axis size must be a **divisor of
+its template size**, so the model-divisibility constraints that held
+at full capacity (head counts, d_model multiples, global-batch
+splits) survive every shrink.
+
+Within those constraints the planner returns the **largest feasible
+mesh**: it searches the (small) divisor lattice exhaustively instead
+of walking one prime-factor chain — {dp: 6, tp: 4} on 8 surviving
+devices yields {dp: 2, tp: 4} (all 8 used), not the {dp: 1, tp: 4} a
+divide-by-smallest-prime greedy would strand itself at.  Ties on
+device count keep late-priority axes (tp, pp, sp) at full size and
+shrink ``dp`` first: a smaller data-parallel degree is pure same-math
+re-batching, while tp/sp sizes are entangled with model dimensions.
+
+Regrow is the same call with more devices: the plan monotonically
+approaches the template as capacity returns, and never exceeds it.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+# shrink order: batch-ish axes first, model-entangled axes last
+SHRINK_PRIORITY: Sequence[str] = ("dp", "fsdp", "sp", "pp", "tp")
+
+
+def _prod(axes: Dict[str, int]) -> int:
+    return int(np.prod(list(axes.values()), dtype=np.int64)) if axes else 1
+
+
+def _divisors(n: int):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def plan_mesh(n_devices: int, template: Dict[str, int],
+              min_axes: Optional[Dict[str, int]] = None) -> Dict[str, int]:
+    """Largest mesh ≤ ``template`` (axis-wise, divisor-constrained)
+    fitting ``n_devices``.
+
+    ``min_axes`` pins lower bounds (e.g. ``{"tp": 2}`` when a layer's
+    sharded dimension cannot be replicated); a shrink that would land
+    below a pin is illegal, never silently applied.  Raises
+    ``ValueError`` when no divisor combination fits — the caller
+    decides whether that is fatal or worth waiting out.
+    """
+    if n_devices < 1:
+        raise ValueError(f"no surviving capacity (n_devices={n_devices})")
+    axes = {str(k): int(v) for k, v in template.items()}
+    for k, v in axes.items():
+        if v < 1:
+            raise ValueError(f"template axis {k!r} has size {v}")
+    floors = {str(k): int(v) for k, v in (min_axes or {}).items()}
+    names = list(axes)
+    cand_lists = []
+    for k in names:
+        floor = max(1, floors.get(k, 1))
+        cands = [d for d in _divisors(axes[k]) if d >= floor]
+        if not cands:
+            raise ValueError(
+                f"axis {k!r}: no divisor of {axes[k]} meets its floor "
+                f"{floor}")
+        cand_lists.append(cands)
+    # preference on ties: keep LATE-priority axes (tp, pp, sp) at full
+    # size, shrink dp first — compare sizes in reverse priority order
+    rank = {a: i for i, a in enumerate(SHRINK_PRIORITY)}
+    order = sorted(range(len(names)),
+                   key=lambda i: -rank.get(names[i], len(SHRINK_PRIORITY)))
+    best = None
+    for combo in itertools.product(*cand_lists):
+        p = int(np.prod(combo, dtype=np.int64))
+        if p > n_devices:
+            continue
+        key = (p, tuple(combo[i] for i in order))
+        if best is None or key > best[0]:
+            best = (key, combo)
+    if best is None:
+        raise ValueError(
+            f"cannot shrink mesh {dict(template)} onto {n_devices} "
+            f"device(s) with floors {floors}")
+    return dict(zip(names, best[1]))
+
+
+def plan_devices(axes: Dict[str, int], devices) -> list:
+    """The device prefix a plan actually uses (stable ordering keeps
+    reshard layouts deterministic across replans)."""
+    need = _prod(axes)
+    devices = list(devices)
+    if need > len(devices):
+        raise ValueError(f"plan {axes} needs {need} devices, "
+                         f"have {len(devices)}")
+    return devices[:need]

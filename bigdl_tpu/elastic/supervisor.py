@@ -1,0 +1,276 @@
+"""Elastic supervisor: shrink on preemption, regrow on capacity.
+
+The PR-3 PreemptionHandler turns SIGTERM into "commit a final
+checkpoint and stop cleanly"; the PR-4 health layer turns a sick run
+into signals.  This module closes the loop: a retry/backoff state
+machine that, instead of letting a preempted or degraded job die,
+
+  1. **drains** — finishes the in-flight async write and commits a
+     final elastic (v2, mesh-recorded) checkpoint;
+  2. **re-plans** — asks :func:`.plan.plan_mesh` for the largest mesh
+     the *surviving* capacity supports (shrinking ``dp`` first);
+  3. **resumes** — rebuilds the trainer on the new mesh and restores
+     through the reshard path (global arrays are mesh-invariant, so a
+     shrink is a re-layout, not a loss of progress);
+  4. **regrows** — keeps polling capacity and, at a checkpoint
+     boundary, scales back up the same way when devices return.
+
+Capacity is an injected ``capacity_fn`` (default: ``jax.devices()``) —
+the seam where a cluster scheduler, the stall watchdog's straggler
+verdict, or a test harness reports which devices are usable.  Data is
+an injected ``batch_fn(step)`` so a rebuilt segment regenerates its
+batches deterministically (the stateless analogue of the PR-3 data
+cursor; fixed GLOBAL batch across replans keeps the math identical).
+
+Every transition lands in the Recorder as ``elastic/*`` counters and
+``elastic_event`` + ``health_event`` records, so /metrics and
+``trace_summary health`` show the shrink/regrow history.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from .plan import _prod, plan_devices, plan_mesh
+
+
+class ElasticSupervisor:
+    """Drive an :class:`~bigdl_tpu.parallel.spmd.SpmdTrainer` factory
+    through preemptions and capacity changes.
+
+    ``trainer_factory(mesh)`` must return a fresh, un-``init()``-ed
+    trainer for that mesh (the supervisor owns checkpoint wiring).
+    """
+
+    def __init__(self, trainer_factory, ckpt_dir: str,
+                 template: Dict[str, int], *,
+                 capacity_fn: Optional[Callable] = None,
+                 batch_fn: Optional[Callable] = None,
+                 recorder=None, ckpt_every: int = 50, keep: int = 3,
+                 shard_arrays: bool = True,
+                 min_axes: Optional[Dict[str, int]] = None,
+                 replan_every: int = 10, max_restarts: int = 5,
+                 backoff_base: float = 0.5, backoff_max: float = 30.0,
+                 handle_sigterm: bool = True):
+        self.trainer_factory = trainer_factory
+        self.ckpt_dir = str(ckpt_dir)
+        self.template = {str(k): int(v) for k, v in template.items()}
+        self.capacity_fn = capacity_fn
+        self.batch_fn = batch_fn
+        self._recorder = recorder
+        self.ckpt_every = int(ckpt_every)
+        self.keep = int(keep)
+        self.shard_arrays = bool(shard_arrays)
+        self.min_axes = dict(min_axes or {})
+        self.replan_every = int(replan_every)
+        self.max_restarts = int(max_restarts)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.handle_sigterm = bool(handle_sigterm)
+        self.state = "idle"
+        self.restarts = 0
+        self.trainer = None
+        self._stop = False
+        self._preemption = None
+
+    # ------------------------------------------------------------------ #
+    def _rec(self):
+        if self._recorder is not None:
+            return self._recorder
+        from ..observability import null_recorder
+        return null_recorder()
+
+    def _capacity(self) -> list:
+        import jax
+        cap = self.capacity_fn() if self.capacity_fn is not None \
+            else jax.devices()
+        if isinstance(cap, int):
+            cap = jax.devices()[:cap]
+        return list(cap)
+
+    def _event(self, kind: str, **fields):
+        rec = self._rec()
+        rec.inc(f"elastic/{kind}s" if not kind.endswith("s")
+                else f"elastic/{kind}")
+        rec.inc(f"health/elastic_{kind}")
+        rec.emit_record("elastic_event", kind=kind, state=self.state,
+                        **fields)
+        rec.emit_record("health_event", condition=f"elastic_{kind}",
+                        step=fields.get("step"), metric="elastic/devices",
+                        value=fields.get("devices"), threshold=None,
+                        action="elastic")
+
+    def _set_state(self, state: str):
+        self.state = state
+        self._rec().gauge("elastic/state_" + state, time.time())
+
+    def stop(self):
+        """Ask run() to commit a checkpoint and return at the next
+        step boundary (callable from any thread)."""
+        self._stop = True
+
+    # ------------------------------------------------------------------ #
+    def _build(self, axes, devices):
+        from ..parallel import mesh as mesh_lib
+        mesh = mesh_lib.create_mesh(dict(axes),
+                                    plan_devices(axes, devices))
+        trainer = self.trainer_factory(mesh)
+        if self._recorder is not None and trainer._recorder is None:
+            # one recorder across every segment: the trainer's
+            # elastic/reshard + checkpoint counters land in the same
+            # ring the supervisor's events do (set BEFORE init() — the
+            # health variant changes the compiled step)
+            trainer.set_telemetry(self._recorder)
+        trainer.set_checkpoint(self.ckpt_dir, every_steps=self.ckpt_every,
+                               keep=self.keep, layout="manifest",
+                               shard_arrays=self.shard_arrays)
+        trainer.init()
+        try:
+            trainer.load_checkpoint(self.ckpt_dir)
+            resumed = True
+        except FileNotFoundError:
+            resumed = False     # fresh run: nothing to restore yet
+        return trainer, resumed
+
+    def _teardown(self, trainer):
+        try:
+            if trainer._ckpt_mgr is not None:
+                trainer._ckpt_mgr.wait()
+        finally:
+            trainer.detach()
+
+    def run(self, batch_fn: Optional[Callable] = None,
+            steps: int = 100) -> list:
+        """Train to ``steps`` total steps across however many meshes it
+        takes; returns the per-step losses (recomputed steps — the tail
+        a failure rolled back — keep their latest value)."""
+        batch_fn = batch_fn or self.batch_fn
+        if batch_fn is None:
+            raise ValueError("no batch_fn: pass one here or at init")
+        self._stop = False      # re-arm: a stop()ped supervisor can run again
+        rec = self._rec()
+        if self.handle_sigterm:
+            from ..checkpoint import PreemptionHandler
+            if self._preemption is None:
+                self._preemption = PreemptionHandler()
+            self._preemption.install()
+        handler = self._preemption
+        losses: Dict[int, float] = {}
+        prev_axes = None
+        first_step = None
+        try:
+            while True:
+                self._set_state("planning")
+                devices = self._capacity()
+                axes = plan_mesh(len(devices), self.template,
+                                 self.min_axes)
+                rec.gauge("elastic/devices", _prod(axes))
+                for name, size in axes.items():
+                    rec.gauge(f"elastic/axis_{name}", size)
+                self._set_state("resuming")
+                try:
+                    trainer, resumed = self._build(axes, devices)
+                except Exception:
+                    if not self._backoff("build"):
+                        raise
+                    continue
+                if prev_axes is not None and axes != prev_axes:
+                    # emitted only AFTER a successful build: a failed
+                    # build's plan is a mesh the job never ran on, and
+                    # must not show up as a topology transition
+                    kind = "shrink" if _prod(axes) < _prod(prev_axes) \
+                        else "regrow"
+                    self._event(kind, from_axes=prev_axes, to_axes=axes,
+                                devices=_prod(axes))
+                    print(f"[elastic] {kind}: {prev_axes} -> {axes}",
+                          flush=True)
+                prev_axes = axes
+                self.trainer = trainer
+                if resumed:
+                    self._event("resume", step=trainer._step_count,
+                                devices=_prod(axes), axes=axes)
+                start = trainer._step_count
+                if first_step is None:
+                    first_step = start
+                outcome, fail = "completed", None
+                self._set_state("running")
+                try:
+                    for s in range(start, steps):
+                        if self._stop:
+                            outcome = "stopped"
+                            break
+                        if handler is not None and handler.requested:
+                            outcome = "preempted"
+                            break
+                        if (self.replan_every and s > start
+                                and (s - start) % self.replan_every == 0):
+                            new_axes = plan_mesh(len(self._capacity()),
+                                                 self.template,
+                                                 self.min_axes)
+                            if new_axes != axes:
+                                outcome = "replan"
+                                break
+                        tokens, targets = batch_fn(s)
+                        losses[s] = float(trainer.step(tokens, targets))
+                        rec.gauge("elastic/steps_done", s + 1)
+                        if (self.ckpt_every
+                                and (s + 1) % self.ckpt_every == 0
+                                and s + 1 < steps):
+                            trainer.save_checkpoint(self.ckpt_dir)
+                except Exception as e:      # noqa: BLE001 — retried
+                    outcome, fail = "failed", e
+                self._set_state("draining")
+                if outcome == "failed":
+                    self._teardown(self.trainer)
+                    self.trainer = None
+                    if not self._backoff("segment", fail):
+                        raise fail
+                    continue
+                # clean outcomes commit a final synchronous checkpoint:
+                # nothing after this point can lose a completed step.
+                # A zero-new-step resumed segment skips it — its state
+                # is bit-identical to the checkpoint just restored, and
+                # rewriting every shard would stall shutdown for a full
+                # write for zero progress
+                tag = f"preempt_step_{trainer._step_count}" \
+                    if outcome == "preempted" else None
+                if trainer._step_count > start or not resumed:
+                    trainer.save_checkpoint(self.ckpt_dir, sync=True,
+                                            tag=tag)
+                self._teardown(trainer)
+                self.trainer = None
+                self.restarts = 0           # a committed segment resets
+                if outcome == "preempted":
+                    self._event("preemption", step=trainer._step_count,
+                                devices=_prod(axes))
+                    print(f"[elastic] preempted at step "
+                          f"{trainer._step_count}; final checkpoint "
+                          "committed, re-planning from surviving "
+                          "capacity", flush=True)
+                    handler.reset()
+                    continue
+                if outcome == "replan":
+                    continue
+                self._set_state("idle")
+                return [losses[s]
+                        for s in range(first_step, max(losses) + 1)] \
+                    if losses else []
+        finally:
+            if self.handle_sigterm and handler is not None:
+                handler.uninstall()
+
+    def _backoff(self, what: str, exc: Exception = None) -> bool:
+        """Count a failure; sleep exponentially; False when retries are
+        exhausted (caller re-raises)."""
+        self.restarts += 1
+        self._event("failure", attempt=self.restarts, what=what,
+                    error=None if exc is None else repr(exc))
+        if self.restarts > self.max_restarts:
+            return False
+        delay = min(self.backoff_base * (2 ** (self.restarts - 1)),
+                    self.backoff_max)
+        print(f"[elastic] {what} failed ({exc!r}); retry "
+              f"{self.restarts}/{self.max_restarts} in {delay:.1f}s",
+              flush=True)
+        time.sleep(delay)
+        return True

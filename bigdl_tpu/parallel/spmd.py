@@ -104,6 +104,8 @@ class SpmdTrainer:
         self._cost_pending = False
         self._ckpt_layout = "orbax"
         self._ckpt_mgr = None
+        self._shard_arrays = False      # elastic sliced saves (v2)
+        self._preemption = None
         # training-health layer (observability.health)
         self._health_monitor = None
         self._flight = None
@@ -512,11 +514,18 @@ class SpmdTrainer:
         return mgr
 
     def _save_manifest_checkpoint(self, path: str, sync: bool = False,
-                                  keep=None, async_write=True):
+                                  keep=None, async_write=True, tag=None):
         """Async sharded checkpoint via bigdl_tpu.checkpoint: params per
         top-level module + opt_state as CRC32C'd shards committed by an
         atomic manifest.  Only the blocking device→host copy of the
-        OWNED shards runs on the step loop."""
+        OWNED shards runs on the step loop.
+
+        The manifest records this trainer's mesh (v2), so restore can
+        reshard onto a different one.  With ``shard_arrays`` each host
+        writes per-device replica-0 slices (with index maps) instead of
+        whole global trees — the representation that stays writable
+        when no host can address a global array."""
+        from ..checkpoint import reshard
         from ..checkpoint.manager import host_snapshot
         if self.params is None:
             raise ValueError("trainer not initialized; call init() first")
@@ -526,22 +535,40 @@ class SpmdTrainer:
                    for mod, sub in self.params.items()}
         logical["opt_state"] = self.opt_state
         names = sorted(logical)
+        shards, owned = {}, set()
         with self._rec().span("checkpoint.blocking"):
-            # snapshot ONLY the shards this host owns (round-robin by
-            # sorted name — the same assignment the manager applies);
-            # unowned entries stay None placeholders that keep shard
-            # indices aligned across hosts and are never serialized
-            shards = {
-                name: (host_snapshot(logical[name])
-                       if i % mgr.process_count == mgr.process_index
-                       else None)
-                for i, name in enumerate(names)}
+            for i, name in enumerate(names):
+                tree = logical[name]
+                if self._shard_arrays and reshard.all_array_leaves(tree):
+                    # one slice shard per host per entry: every host
+                    # enumerates every host's shard names (aligned file
+                    # indices) but materializes only its own fragments
+                    for k in range(mgr.process_count):
+                        pname = f"{name}@p{k:03d}"
+                        if k == mgr.process_index:
+                            frag = reshard.split_fragments(
+                                tree, process_index=k)
+                            frag["of"] = name
+                            shards[pname] = frag
+                            owned.add(pname)
+                        else:
+                            shards[pname] = None
+                elif i % mgr.process_count == mgr.process_index:
+                    # whole-tree global shard, round-robin ownership
+                    shards[name] = host_snapshot(tree)
+                    owned.add(name)
+                else:
+                    # unowned placeholder: keeps shard indices aligned
+                    # across hosts, never serialized
+                    shards[name] = None
         meta = {"step": self._step_count, "seed": self.seed,
                 "root": self.model.name}
-        mgr.save(shards, meta, tag=f"step_{self._step_count}", sync=sync)
+        mgr.save(shards, meta, tag=tag or f"step_{self._step_count}",
+                 sync=sync, mesh=reshard.mesh_info(self.mesh),
+                 owned=owned)
 
     def save_checkpoint(self, path: str, layout: Optional[str] = None,
-                        sync: bool = False):
+                        sync: bool = False, tag: Optional[str] = None):
         """Write params + optimizer state + step counter.
 
         ``layout="manifest"`` (or ``set_checkpoint(...,
@@ -559,13 +586,15 @@ class SpmdTrainer:
         if layout is None:
             layout = self._ckpt_layout
         if layout == "manifest":
-            return self._save_manifest_checkpoint(path, sync=sync)
+            return self._save_manifest_checkpoint(path, sync=sync, tag=tag)
         if self.params is None:
             raise ValueError("trainer not initialized; call init() first")
         # step-tagged snapshot + atomic 'latest' pointer (same crash-safe
         # pattern as Optimizer.save_checkpoint): a job killed mid-save
-        # never destroys the previous snapshot
-        tag_dir = os.path.join(path, f"step_{self._step_count}")
+        # never destroys the previous snapshot.  An explicit tag (e.g.
+        # the preemption path's preempt_step_<n>) names the dir, and
+        # _prune_checkpoints' step_<n> pattern never collects it
+        tag_dir = os.path.join(path, tag or f"step_{self._step_count}")
         save_pytree({"params": self.params, "opt_state": self.opt_state},
                     os.path.join(tag_dir, "state"), to_host=False)
         with open(os.path.join(tag_dir, "meta.json"), "w") as f:
@@ -604,14 +633,16 @@ class SpmdTrainer:
         from ..utils.serializer import load_pytree
         if self.params is None:
             self.init()
-        restored = self._manifest_manager(path).restore_latest()
+        restored = self._manifest_manager(path).restore_latest(
+            with_manifest=True)
         if restored is not None and restored[0] == "manifest":
-            _, trees, meta = restored
+            _, trees, meta, mf = restored
             raw = {"params": {k[len("params/"):]: v
                               for k, v in trees.items()
                               if k.startswith("params/")},
                    "opt_state": trees["opt_state"]}
-            return self._finish_restore(raw, meta, path)
+            return self._finish_restore(raw, meta, path,
+                                        saved_mesh=mf.mesh if mf else None)
         latest = os.path.join(path, "latest")
         if os.path.exists(latest):
             with open(latest) as f:
@@ -630,17 +661,33 @@ class SpmdTrainer:
         raw = load_pytree(os.path.join(root, "state"))
         return self._finish_restore(raw, meta, path)
 
-    def _finish_restore(self, raw, meta, path):
+    def _finish_restore(self, raw, meta, path, saved_mesh=None):
         """Validate a raw {params, opt_state} tree against this trainer
-        and place it: shared tail of the manifest and orbax loaders."""
+        and place it: shared tail of the manifest and orbax loaders.
+
+        ``saved_mesh`` (the v2 manifest's save-time mesh) arms the
+        reshard path: global arrays are mesh-invariant, so a topology
+        change is purely a re-layout — ``device_put`` against THIS
+        trainer's shardings — counted under ``elastic/*`` and recorded
+        as an ``elastic_event``.  Shape mismatches raise errors that
+        name both meshes and, when a mesh delta explains the mismatch,
+        say so."""
+        from ..checkpoint import reshard
         raw = self._rekey_root(raw, meta.get("root", self.model.name),
                                self.model.name)
+        target_mesh = reshard.mesh_info(self.mesh)
+        resharding = (saved_mesh is not None
+                      and not reshard.same_mesh(saved_mesh, target_mesh))
+        delta = reshard.describe_delta(saved_mesh, target_mesh)
         template = {"params": self.params, "opt_state": self.opt_state}
         if (jax.tree_util.tree_structure(raw)
                 != jax.tree_util.tree_structure(template)):
+            hint = f" (checkpoint {delta} — a mesh change never alters " \
+                   "the tree structure; this is a different model)" \
+                   if resharding else ""
             raise ValueError(
                 f"{path}: checkpoint tree does not match this trainer's "
-                "model (after root-name normalisation)")
+                f"model (after root-name normalisation){hint}")
 
         def dt(a):
             # dtype without materializing the leaf: np.asarray on a live
@@ -651,60 +698,108 @@ class SpmdTrainer:
 
         def check(v, t, where):
             if tuple(np.shape(v)) != tuple(np.shape(t)) or dt(v) != dt(t):
-                raise ValueError(
-                    f"{path}: leaf {jax.tree_util.keystr(where)} is "
-                    f"{np.shape(v)}/{dt(v)}, model expects "
-                    f"{np.shape(t)}/{dt(t)}")
+                msg = (f"{path}: leaf {jax.tree_util.keystr(where)} is "
+                       f"{np.shape(v)}/{dt(v)}, model expects "
+                       f"{np.shape(t)}/{dt(t)}")
+                why = reshard.explain_shape_delta(
+                    np.shape(v), np.shape(t), saved_mesh, target_mesh)
+                if why is not None:
+                    msg += (f". Explainable by the mesh delta — {why}. "
+                            f"Checkpoint {delta}. Re-save it with "
+                            "shard_arrays=True (elastic v2 slice shards "
+                            "carry global index maps) and restore will "
+                            "reassemble and reshard onto this mesh; see "
+                            "docs/checkpointing.md § Elastic resume.")
+                elif saved_mesh is not None:
+                    msg += (f". Checkpoint {delta}; global shapes are "
+                            "mesh-invariant, so this mismatch is NOT "
+                            "explained by the mesh change — the saved "
+                            "model differs from this trainer's.")
+                raise ValueError(msg)
             return v
 
         raw = jax.tree_util.tree_map_with_path(
             lambda w, v, t: check(v, t, w), raw, template)
+        rec = self._rec()
         shardings = self._param_shardings(self.params)
-        # place-then-own: device_put shards the host leaf during the
-        # transfer (no full-size unsharded device intermediate — the
-        # property the orbax save path promises), and the sharded
-        # jnp.array(copy=True) guarantees jax-owned buffers — device_put
-        # of an aligned numpy array can be zero-copy on CPU, and params
-        # are donated every step
-        self.params = jax.tree_util.tree_map(
-            lambda v, s: jnp.array(jax.device_put(np.asarray(v), s),
-                                   copy=True),
-            raw["params"], shardings)
-        # opt-state leaves stay UNCOMMITTED: at init they come out of jit
-        # the same way, and the next step call's jit dispatch places them
-        # against the params' shardings without the committed-device
-        # conflicts an explicit device_put would cause.  copy=True, not
-        # asarray: a zero-copy alias of the loader's numpy buffer must
-        # never reach the donating step (see Optimizer.load_checkpoint)
-        self.opt_state = jax.tree_util.tree_map(
-            lambda v: jnp.array(np.asarray(v), copy=True),
-            raw["opt_state"])
+        with rec.span("elastic.reshard" if resharding
+                      else "checkpoint.restore"):
+            # place-then-own: device_put shards the host leaf during the
+            # transfer (no full-size unsharded device intermediate — the
+            # property the orbax save path promises), and the sharded
+            # jnp.array(copy=True) guarantees jax-owned buffers —
+            # device_put of an aligned numpy array can be zero-copy on
+            # CPU, and params are donated every step
+            self.params = jax.tree_util.tree_map(
+                lambda v, s: jnp.array(jax.device_put(np.asarray(v), s),
+                                       copy=True),
+                raw["params"], shardings)
+            # opt-state leaves stay UNCOMMITTED: at init they come out of
+            # jit the same way, and the next step call's jit dispatch
+            # places them against the params' shardings without the
+            # committed-device conflicts an explicit device_put would
+            # cause — which is also what re-partitions Adam moments onto
+            # a changed mesh without spelling their layout out twice.
+            # copy=True, not asarray: a zero-copy alias of the loader's
+            # numpy buffer must never reach the donating step (see
+            # Optimizer.load_checkpoint)
+            self.opt_state = jax.tree_util.tree_map(
+                lambda v: jnp.array(np.asarray(v), copy=True),
+                raw["opt_state"])
+        if resharding:
+            n_leaves = len(jax.tree_util.tree_leaves(raw))
+            rec.inc("elastic/reshards")
+            rec.inc("elastic/resharded_leaves", n_leaves)
+            rec.emit_record("elastic_event", kind="reshard",
+                            step=meta.get("step"), saved_mesh=saved_mesh,
+                            target_mesh=target_mesh, leaves=n_leaves)
+            print(f"[elastic] resharded {n_leaves} leaves: {delta}",
+                  flush=True)
         self._step_count = meta["step"]
         self.seed = meta.get("seed", self.seed)
         return self
 
     def set_checkpoint(self, path: str, every_steps: int = 1000,
                        keep: int = 3, layout: str = "orbax",
-                       async_write: bool = True):
+                       async_write: bool = True,
+                       shard_arrays: bool = False,
+                       handle_preemption: bool = False):
         """Checkpoint every ``every_steps`` steps during fit(), retaining
         the newest ``keep`` snapshots (0 = keep all)
         (≙ Optimizer.setCheckpoint with a several_iteration trigger).
         ``layout="manifest"`` routes through bigdl_tpu.checkpoint:
         background sharded writes with per-host shard ownership and an
         atomic CRC-verified manifest commit; retention then runs in the
-        manager's GC."""
+        manager's GC.
+
+        ``shard_arrays`` (manifest layout) switches to elastic v2 slice
+        shards: each host writes per-device replica-0 array fragments
+        with global index maps, so restore can reassemble on ANY mesh —
+        the save mode that works even when no host addresses a global
+        array.  ``handle_preemption`` installs a SIGTERM handler (same
+        contract as ``Optimizer.set_checkpoint``): fit() finishes the
+        in-flight write, commits a final ``preempt_step_<n>`` checkpoint
+        synchronously, and returns cleanly."""
         if every_steps < 1:
             raise ValueError("every_steps must be >= 1")
         if keep < 0:
             raise ValueError("keep must be >= 0")
         if layout not in ("orbax", "manifest"):
             raise ValueError(f"unknown checkpoint layout {layout!r}")
+        if shard_arrays and layout != "manifest":
+            raise ValueError("shard_arrays requires layout='manifest'")
         self._ckpt = (path, int(every_steps), int(keep))
         self._ckpt_layout = layout
+        self._shard_arrays = bool(shard_arrays)
         if layout == "manifest":
             self._ckpt_mgr = None       # rebuild with this retention
             self._manifest_manager(path, keep=int(keep) or None,
                                    async_write=async_write)
+        if handle_preemption:
+            from ..checkpoint import PreemptionHandler
+            if self._preemption is None:
+                self._preemption = PreemptionHandler()
+            self._preemption.install()
         return self
 
     def _prune_checkpoints(self, path: str, keep: int):
@@ -803,6 +898,20 @@ class SpmdTrainer:
                 if log_every and (i + 1) % log_every == 0:
                     print(f"step {i + 1}: loss={float(loss):.4f} "
                           f"({(i + 1) / (time.time() - t0):.2f} it/s)")
+                if (self._preemption is not None
+                        and self._preemption.requested and ckpt):
+                    # SIGTERM: finish any in-flight async write, commit
+                    # a final checkpoint synchronously, stop cleanly —
+                    # the elastic supervisor (or the next job) resumes
+                    # it, on this mesh or a smaller one
+                    losses.append(loss)
+                    self.save_checkpoint(
+                        ckpt[0], sync=True,
+                        tag=f"preempt_step_{self._step_count}")
+                    print(f"[preemption] final checkpoint at step "
+                          f"{self._step_count} committed; stopping "
+                          "cleanly", flush=True)
+                    break
                 if ckpt and self._step_count % ckpt[1] == 0:
                     self.save_checkpoint(ckpt[0])
                     if self._ckpt_layout == "orbax":

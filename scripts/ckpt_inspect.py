@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Inspect a bigdl_tpu checkpoint root: list, describe, verify.
+
+  python scripts/ckpt_inspect.py list <root> [--json]
+  python scripts/ckpt_inspect.py describe <root> [--tag TAG] [--json]
+  python scripts/ckpt_inspect.py verify <root> [--tag TAG] [--shallow]
+                                               [--json]
+
+``list`` shows every committed checkpoint (tag, step/iteration,
+manifest version, save-time mesh, shard count, bytes, age) plus any
+TORN directories (present on disk, no valid manifest — they do not
+exist as checkpoints).  ``describe`` prints one checkpoint's mesh
+metadata, resume meta, and per-shard table (logical name, kind, file,
+bytes, CRC32C).  ``verify`` re-hashes every shard (deep CRC by
+default) and exits non-zero when anything fails.
+
+``--json`` prints a single parseable JSON document instead of tables —
+the mode supervisors and dashboards consume.
+
+Pure filesystem tool: nothing here touches a jax backend or device,
+so it is safe on a login node while the job runs.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from bigdl_tpu.checkpoint import manifest as mlib          # noqa: E402
+from bigdl_tpu.checkpoint.reshard import fmt_mesh, mesh_axes  # noqa: E402
+
+
+def _mesh_str(mesh):
+    return "-" if not mesh else fmt_mesh(mesh)
+
+
+def _read_all(root):
+    """Every ckpt_* directory, committed or torn (no verification)."""
+    out, torn = [], []
+    if not os.path.isdir(root):
+        return out, torn
+    for d in sorted(os.listdir(root)):
+        full = os.path.join(root, d)
+        if not (d.startswith(mlib.DIR_PREFIX) and os.path.isdir(full)):
+            continue
+        try:
+            out.append((full, mlib.read_manifest(full)))
+        except mlib.CheckpointError as e:
+            torn.append({"dir": d, "reason": str(e)})
+    out.sort(key=lambda e: e[1].sort_key())
+    return out, torn
+
+
+def _entry(d, mf, problems=None):
+    meta = mf.meta
+    step = meta.get("step", meta.get("iteration"))
+    e = {"dir": os.path.basename(d), "tag": mf.tag, "step": step,
+         "version": mf.version, "created": mf.created,
+         "mesh": mf.mesh, "shards": len(mf.shards),
+         "bytes": sum(s.bytes for s in mf.shards)}
+    if problems is not None:
+        e["intact"] = not problems
+        e["problems"] = problems
+    return e
+
+
+def cmd_list(root, args):
+    cands, torn = _read_all(root)
+    ptr = mlib.read_latest_pointer(root)
+    doc = {"root": root, "latest": ptr,
+           "checkpoints": [_entry(d, mf,
+                                  mlib.verify(d, mf, deep=False))
+                           for d, mf in cands],
+           "torn": torn}
+    if args.json:
+        print(json.dumps(doc, sort_keys=True))
+        return 0
+    now = time.time()
+    print(f"{root}: {len(doc['checkpoints'])} committed checkpoint(s), "
+          f"{len(torn)} torn dir(s), latest -> {ptr or '-'}")
+    fmt = "  {:<24} {:>6} {:>3} {:<26} {:>6} {:>10} {:>8} {}"
+    print(fmt.format("dir", "step", "v", "mesh", "shards", "bytes",
+                     "age_s", "state"))
+    for e in doc["checkpoints"]:
+        print(fmt.format(
+            e["dir"], str(e["step"]), str(e["version"]),
+            _mesh_str(e["mesh"]), e["shards"], e["bytes"],
+            int(now - e["created"]) if e["created"] else "-",
+            "ok" if e["intact"] else "TORN:" + e["problems"][0]))
+    for t in torn:
+        print(f"  {t['dir']:<24} TORN (no manifest): {t['reason']}")
+    return 0
+
+
+def _pick(root, tag):
+    cands, _ = _read_all(root)
+    if not cands:
+        print(f"{root}: no committed checkpoints", file=sys.stderr)
+        sys.exit(2)
+    if tag is None:
+        return cands[-1]
+    for d, mf in cands:
+        if mf.tag == tag or os.path.basename(d) == tag \
+                or os.path.basename(d) == mlib.DIR_PREFIX + tag:
+            return d, mf
+    print(f"{root}: no checkpoint tagged {tag!r}", file=sys.stderr)
+    sys.exit(2)
+
+
+def cmd_describe(root, args):
+    d, mf = _pick(root, args.tag)
+    doc = _entry(d, mf)
+    doc["meta"] = mf.meta
+    doc["shard_table"] = [s.to_json() for s in mf.shards]
+    if args.json:
+        print(json.dumps(doc, sort_keys=True))
+        return 0
+    print(f"{d} (tag {mf.tag}, manifest v{doc['version']})")
+    print(f"  mesh:  {_mesh_str(mf.mesh)}"
+          + (f"  axes={mesh_axes(mf.mesh)}" if mf.mesh else ""))
+    print(f"  meta:  {json.dumps(mf.meta, sort_keys=True)}")
+    print(f"  {len(mf.shards)} shard(s), {doc['bytes']} bytes:")
+    fmt = "    {:<32} {:<6} {:<14} {:>10} {:>12} {}"
+    print(fmt.format("name", "kind", "file", "bytes", "crc32c", "of"))
+    for s in mf.shards:
+        print(fmt.format(s.name, s.kind, s.file, s.bytes, s.crc32c,
+                         s.of or "-"))
+    return 0
+
+
+def cmd_verify(root, args):
+    deep = not args.shallow
+    if args.tag is not None:
+        picked = [_pick(root, args.tag)]
+        torn = []
+    else:
+        picked, torn = _read_all(root)
+    results = [_entry(d, mf, mlib.verify(d, mf, deep=deep))
+               for d, mf in picked]
+    ok = all(e["intact"] for e in results) and not torn
+    doc = {"root": root, "deep": deep, "ok": ok, "checkpoints": results,
+           "torn": torn}
+    if args.json:
+        print(json.dumps(doc, sort_keys=True))
+    else:
+        for e in results:
+            state = "ok" if e["intact"] else "; ".join(e["problems"])
+            print(f"{e['dir']}: {state}")
+        for t in torn:
+            print(f"{t['dir']}: TORN ({t['reason']})")
+        print(f"{'DEEP' if deep else 'shallow'} verify: "
+              f"{'all intact' if ok else 'FAILURES'}")
+    return 0 if ok else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("list", "describe", "verify"):
+        p = sub.add_parser(name)
+        p.add_argument("root")
+        p.add_argument("--json", action="store_true")
+        if name != "list":
+            p.add_argument("--tag", default=None)
+        if name == "verify":
+            p.add_argument("--shallow", action="store_true",
+                           help="existence+size only (skip CRC re-hash)")
+    args = ap.parse_args(argv)
+    return {"list": cmd_list, "describe": cmd_describe,
+            "verify": cmd_verify}[args.cmd](args.root, args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,6 +3,7 @@ training run with async manifest checkpointing, killable mid-write.
 
 Usage: python _ckpt_worker.py <ckpt_dir> <out.npz> [iters=<n>]
            [ckpt_every=<n>] [preempt] [step_sleep=<ms>]
+           [spmd] [mesh=dp4 | mesh=dp2,fsdp2] [shard_arrays]
 
 The parent arms BIGDL_CKPT_FAULT (see bigdl_tpu.checkpoint.faults) to
 hard-kill this process at a byte offset inside a shard or manifest
@@ -15,6 +16,14 @@ the worker exits 0.
 Every run auto-resumes from whatever intact checkpoint the directory
 holds, so the parent chains crashed runs and compares the final params
 of crash+resume against an uninterrupted run — bit for bit.
+
+`spmd` switches to the GSPMD trainer on an 8-virtual-device CPU mesh
+shaped by `mesh=` (e.g. dp4, dp2,fsdp2) with a per-step STATELESS
+batch generator (fixed GLOBAL batch whatever the mesh) — the elastic
+matrix: the parent kills a run on mesh A and resumes it on mesh B,
+asserting the loss curve continues.  `shard_arrays` saves elastic v2
+slice shards instead of whole-tree shards.  <out.npz> gains a
+`losses` array (the steps THIS run executed) next to the params.
 """
 import os
 import sys
@@ -28,8 +37,14 @@ def main():
     ckpt_every = int(opts.get("ckpt_every", 2))
     step_sleep = float(opts.get("step_sleep", 0)) / 1e3
     preempt = "preempt" in flags
+    spmd = "spmd" in flags
 
     os.environ["JAX_PLATFORMS"] = "cpu"
+    if spmd:
+        # BEFORE the jax import: the GSPMD matrix needs virtual devices
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_"
+                                     "device_count=8")
     import jax
     jax.config.update("jax_platforms", "cpu")
     try:
@@ -37,6 +52,10 @@ def main():
         _xb._backend_factories.pop("axon", None)
     except Exception:
         pass
+
+    if spmd:
+        return main_spmd(ckpt_dir, out, opts, flags, iters, ckpt_every,
+                         step_sleep, preempt)
 
     import time
 
@@ -88,6 +107,66 @@ def main():
                   jax.tree_util.tree_map(np.asarray, model._params))]
     np.savez(out, *leaves)
     print(f"WORKER DONE iteration={opt.state.iteration}", flush=True)
+
+
+def main_spmd(ckpt_dir, out, opts, flags, iters, ckpt_every, step_sleep,
+              preempt):
+    """GSPMD elastic matrix: train the mini transformer on the mesh
+    named by ``mesh=``, auto-resuming (and RESHARDING, when the
+    directory was written on a different mesh) from whatever intact
+    checkpoint exists."""
+    import time
+
+    import jax
+    import numpy as np
+    from bigdl_tpu.models import transformer as T
+    from bigdl_tpu.optim import Adam
+    from bigdl_tpu.parallel import mesh as mesh_lib
+    from bigdl_tpu.parallel.spmd import SpmdTrainer
+
+    axes = {}
+    for part in opts.get("mesh", "dp4").split(","):
+        name = part.rstrip("0123456789")
+        axes[name] = int(part[len(name):])
+    mesh = mesh_lib.create_mesh(axes)
+
+    # deterministic fixture: fixed init seed, stateless per-step batches
+    # with a FIXED GLOBAL batch — the same math on any mesh shape
+    model = T.build("tiny", dropout=0.0, n_layers=1, d_model=64,
+                    n_heads=2, d_ff=128, vocab_size=64, max_len=32)
+    tr = SpmdTrainer(model, Adam(learning_rate=1e-3), mesh=mesh,
+                     fsdp="fsdp" in axes, seed=0)
+    tr.set_checkpoint(ckpt_dir, every_steps=ckpt_every, keep=0,
+                      layout="manifest",
+                      shard_arrays="shard_arrays" in flags,
+                      handle_preemption=preempt)
+    tr.init()
+    try:
+        tr.load_checkpoint(ckpt_dir)
+        print(f"RESUME step={tr._step_count}", flush=True)
+    except FileNotFoundError:
+        pass
+
+    def batch(s):
+        rs = np.random.RandomState(1234 + s)
+        t = rs.randint(0, 64, (8, 17))
+        return t[:, :-1], t[:, 1:]
+
+    end = 10_000 if preempt else iters
+
+    def batches():
+        for s in range(tr._step_count, end):
+            # the parent synchronizes its SIGTERM on these lines
+            print(f"iter {s}", flush=True)
+            if step_sleep:
+                time.sleep(step_sleep)
+            yield batch(s)
+
+    losses = tr.fit(batches())
+    tr.detach()
+    leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(tr.params)]
+    np.savez(out, *leaves, losses=np.asarray(losses, np.float64))
+    print(f"WORKER DONE step={tr._step_count}", flush=True)
 
 
 if __name__ == "__main__":

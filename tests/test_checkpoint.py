@@ -262,7 +262,7 @@ def test_async_failure_does_not_kill_training(tmp_path, capsys):
 
     orig = mgr._write_manifest_ckpt
 
-    def broken(trees, meta, tag):
+    def broken(trees, meta, tag, **kw):
         raise OSError("disk on fire")
     mgr._write_manifest_ckpt = broken
     opt.optimize()                       # must complete

@@ -10,6 +10,11 @@ exist, and resume always lands on the newest intact one.
 
 The preemption test sends a real SIGTERM instead and asserts a clean
 exit with a final committed checkpoint.
+
+The ELASTIC matrix (slow: each leg compiles the GSPMD trainer in a
+fresh subprocess) kills a run on mesh A and resumes it on mesh B —
+SIGTERM preemption and mid-write kills both — asserting the loss
+curve CONTINUES across the reshard and no torn state survives.
 """
 import os
 import signal
@@ -127,6 +132,99 @@ def test_kill_first_save_resumes_from_scratch(tmp_path, baseline):
     r = _run_worker(ck, out, check_rc=0)
     assert "RESUME" not in r.stdout
     _assert_bit_identical(_params(out), baseline)
+
+
+def _run_spmd(ck, out, mesh, *args, fault=None, timeout=600,
+              check_rc=None):
+    return _run_worker(ck, out, "spmd", f"mesh={mesh}", "ckpt_every=2",
+                       *args, fault=fault, timeout=timeout,
+                       check_rc=check_rc)
+
+
+def _spmd_results(out):
+    """(params leaves, losses) from an spmd worker's npz."""
+    with np.load(str(out)) as z:
+        return ([z[k] for k in z.files if k != "losses"], z["losses"])
+
+
+@pytest.mark.slow
+def test_spmd_sigterm_then_resume_on_reshaped_mesh(tmp_path):
+    """SIGTERM a dp4 run mid-training, then resume it on dp2×fsdp2 —
+    same 4 partitions, relaid axes, fixed global batch: the reshard is
+    same-math AND bit-exact on this backend, so the resumed run's loss
+    curve and final params must equal an uninterrupted dp4 run's, bit
+    for bit, and no torn state may survive."""
+    ck, out = tmp_path / "ck", tmp_path / "params.npz"
+    ref = tmp_path / "ref.npz"
+    _run_spmd(tmp_path / "ck_ref", ref, "dp4", "iters=10", check_rc=0)
+    base_params, base_losses = _spmd_results(ref)
+    assert len(base_losses) == 10
+
+    p = subprocess.Popen(
+        [sys.executable, _WORKER, str(ck), str(out), _ITERS, "spmd",
+         "mesh=dp4", "ckpt_every=2", "preempt", "step_sleep=50"],
+        env=_worker_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 300
+        for line in p.stdout:
+            if line.startswith("iter 4") or time.time() > deadline:
+                break
+        p.send_signal(signal.SIGTERM)
+        rest = p.communicate(timeout=300)[0]
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert p.returncode == 0, f"preempted worker must exit cleanly:\n{rest}"
+    assert "final checkpoint" in rest
+    cands = scan(str(ck))
+    assert cands, "no committed checkpoint after preemption"
+    newest = cands[-1][1]
+    assert newest.tag.startswith("preempt_step_"), newest.tag
+    assert newest.mesh is not None and newest.mesh["axes"] == [["dp", 4]]
+    k = newest.meta["step"]
+    assert k >= 4
+
+    r = _run_spmd(ck, out, "dp2,fsdp2", "iters=10", check_rc=0)
+    assert f"RESUME step={k}" in r.stdout, r.stdout
+    assert "[elastic] resharded" in r.stdout, r.stdout
+    params, losses = _spmd_results(out)
+    # loss-curve continuation: the resumed segment reproduces the
+    # uninterrupted run's tail exactly
+    np.testing.assert_array_equal(losses, base_losses[k:])
+    _assert_bit_identical(params, base_params)
+
+
+@pytest.mark.slow
+def test_spmd_kill_mid_write_then_resume_on_smaller_mesh(tmp_path):
+    """Hard-kill a dp4 run 64 bytes into a slice shard of its second
+    save, then resume on HALF the devices (dp2).  The torn save must be
+    invisible, resume starts from the intact step-2 checkpoint and
+    reshards 4→2; a device-count change reassociates float reductions,
+    so continuation is same-math (tight allclose), not bit-exact —
+    exactly what docs/checkpointing.md promises."""
+    ck, out = tmp_path / "ck", tmp_path / "params.npz"
+    ref = tmp_path / "ref.npz"
+    _run_spmd(tmp_path / "ck_ref", ref, "dp4", "iters=8", "shard_arrays",
+              check_rc=0)
+    base_params, base_losses = _spmd_results(ref)
+
+    _run_spmd(ck, out, "dp4", "iters=8", "shard_arrays",
+              fault="1:bytes:64", check_rc=KILL_EXIT_CODE)
+    assert not out.exists()
+    intact = [m.meta["step"] for _, m in scan(str(ck))]
+    assert intact == [2], f"only step 2 should be committed: {intact}"
+    torn = [d for d in os.listdir(ck) if d.startswith("ckpt_")
+            and not os.path.exists(os.path.join(ck, d, "MANIFEST.json"))]
+    assert torn, "expected a torn manifest-less directory from the kill"
+
+    r = _run_spmd(ck, out, "dp2", "iters=8", "shard_arrays", check_rc=0)
+    assert "RESUME step=2" in r.stdout, r.stdout
+    assert "[elastic] resharded" in r.stdout, r.stdout
+    params, losses = _spmd_results(out)
+    np.testing.assert_allclose(losses, base_losses[2:], rtol=1e-4)
+    for a, b in zip(params, base_params):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
 
 
 def test_sigterm_preemption_commits_final_checkpoint(tmp_path):
