@@ -1,0 +1,43 @@
+"""bigdl_tpu.analysis — invariant checkers for our recurring bug classes.
+
+Three review-pass-tax bug families keep coming back in this codebase:
+zero-copy/donation aliasing (PR 3 fixed two real corruption bugs in
+snapshot/restore), lock- and signal-handler discipline (PR 4 took three
+review passes for SIGTERM chaining, RLock re-entrancy and watchdog lock
+ordering), and span/trace pairing (PR 5's wedged-profiler fix).  This
+package turns each of them into a *named, machine-checked rule* so the
+invariant is enforced by CI, not reviewer vigilance:
+
+  GL001  donation / aliasing        zero-copy views of device or host
+                                    buffers crossing an ownership line
+  GL002  host sync in the hot path  float()/.item()/np.asarray on
+                                    traced values or inside step loops
+  GL003  lock & signal discipline   shared attributes mutated with and
+                                    without the class lock; unchained
+                                    signal-handler installs
+  GL004  span / counter pairing     trace sessions opened without a
+                                    guaranteed close; counters emitted
+                                    under names the docs never declare
+  GL005  recompile hazards          time/RNG calls inside traced code,
+                                    mutable defaults behind static args
+
+Entry points:
+
+  :func:`run_lint` / ``scripts/graftlint.py``   the static checker
+  :mod:`.racecheck`                             runtime lock-order and
+                                                bare-shared-write harness
+  ``analysis/baseline.json``                    the committed suppression
+                                                baseline (every entry
+                                                justified inline); the CI
+                                                ``lint`` job fails on any
+                                                *new* violation and on
+                                                stale baseline entries
+"""
+from .baseline import Baseline, load_baseline
+from .engine import LintResult, run_lint
+from .racecheck import CheckedLock, RaceCheck, guard_fields, wrap_lock
+from .rules import ALL_RULES, Violation
+
+__all__ = ["ALL_RULES", "Baseline", "CheckedLock", "LintResult",
+           "RaceCheck", "Violation", "guard_fields", "load_baseline",
+           "run_lint", "wrap_lock"]

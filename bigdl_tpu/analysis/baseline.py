@@ -1,0 +1,106 @@
+"""Suppression baseline: the committed ledger of *known, justified*
+violations.
+
+The contract (mirrors the zero-new-violations CI gate):
+
+  * every entry names its rule, file, the offending line's stripped text
+    (the ``snippet`` — stable across unrelated line drift) and a
+    human-readable ``justification``;
+  * an entry suppresses every occurrence of that exact snippet in that
+    file for that rule;
+  * an entry that matches *nothing* is STALE and fails the run by
+    default — a fixed bug must take its suppression with it, so the
+    ledger can only shrink through fixes, never rot.
+"""
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from .rules import Violation
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    file: str
+    snippet: str
+    justification: str
+
+    def key(self) -> Tuple[str, str, str]:
+        return (self.rule, self.file.replace(os.sep, "/"), self.snippet)
+
+
+@dataclass
+class Baseline:
+    entries: List[BaselineEntry] = field(default_factory=list)
+    path: Optional[str] = None
+
+    def __post_init__(self):
+        self._by_key: Dict[Tuple[str, str, str], BaselineEntry] = {
+            e.key(): e for e in self.entries}
+        self._hits: Dict[Tuple[str, str, str], int] = {
+            k: 0 for k in self._by_key}
+
+    def match(self, v: Violation) -> Optional[BaselineEntry]:
+        """The entry suppressing ``v``, counting the hit; None if new."""
+        key = (v.rule, v.file.replace(os.sep, "/"), v.snippet)
+        e = self._by_key.get(key)
+        if e is not None:
+            self._hits[key] += 1
+        return e
+
+    def stale_entries(self) -> List[BaselineEntry]:
+        """Entries that matched nothing this run (call after matching)."""
+        return [self._by_key[k] for k, n in self._hits.items() if n == 0]
+
+
+def load_baseline(path: Optional[str] = None) -> Baseline:
+    """Load ``path`` (default: the committed ``analysis/baseline.json``);
+    a missing default file is an empty baseline, a missing explicit path
+    is an error."""
+    explicit = path is not None
+    path = path or DEFAULT_BASELINE
+    if not os.path.exists(path):
+        if explicit:
+            raise FileNotFoundError(f"baseline file not found: {path}")
+        return Baseline([], path=path)
+    with open(path, encoding="utf-8") as f:
+        raw = json.load(f)
+    entries = []
+    for e in raw.get("entries", []):
+        missing = {"rule", "file", "snippet", "justification"} - set(e)
+        if missing:
+            raise ValueError(
+                f"{path}: baseline entry {e.get('rule')}/{e.get('file')} "
+                f"missing {sorted(missing)} — every suppression must be "
+                "justified inline")
+        if not str(e["justification"]).strip():
+            raise ValueError(
+                f"{path}: empty justification for {e['rule']} in "
+                f"{e['file']} — say WHY the finding is safe")
+        entries.append(BaselineEntry(rule=str(e["rule"]),
+                                     file=str(e["file"]),
+                                     snippet=str(e["snippet"]),
+                                     justification=str(e["justification"])))
+    return Baseline(entries, path=path)
+
+
+def write_baseline(violations: List[Violation], path: str,
+                   justification: str = "TODO: justify or fix"):
+    """Bootstrap helper (``graftlint.py --write-baseline``): dump the
+    current findings as a baseline skeleton.  Committed entries must
+    replace the placeholder justification — load_baseline accepts it,
+    review should not."""
+    entries = [{"rule": v.rule, "file": v.file.replace(os.sep, "/"),
+                "snippet": v.snippet, "justification": justification}
+               for v in violations]
+    payload = {"version": 1, "entries": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2)
+        f.write("\n")

@@ -1,0 +1,180 @@
+"""The lint driver: collect files, run rules, apply the baseline.
+
+File scoping:
+
+  * ``__pycache__`` and the golden fixtures
+    (``tests/fixtures/graftlint``) are always skipped — the fixtures are
+    deliberately violating;
+  * ``library_only`` rules (GL002's hot-path heuristics, GL004's
+    docs-contract check) skip ``tests/`` and ``scripts/`` — a timing
+    script MUST host-sync and a test counter is not an operator
+    contract;
+  * files that fail to parse are reported as GL000 parse errors (a file
+    the checker cannot read is a file the invariants do not cover).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Iterable, List, Optional, Sequence, Tuple
+
+from .baseline import Baseline, BaselineEntry
+from .rules import ALL_RULES, Project, Rule, SourceFile, Violation
+
+EXCLUDE_PARTS = ("__pycache__", os.path.join("fixtures", "graftlint"))
+
+
+def collect_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d != "__pycache__"]
+            for fn in sorted(filenames):
+                full = os.path.join(dirpath, fn)
+                if fn.endswith(".py") and not any(
+                        part in full for part in EXCLUDE_PARTS):
+                    out.append(full)
+    return out
+
+
+from .rules.base import is_library_path as _is_library_file  # noqa: E402
+
+
+@dataclass
+class LintResult:
+    violations: List[Violation] = field(default_factory=list)  # NEW ones
+    suppressed: List[Tuple[Violation, BaselineEntry]] = \
+        field(default_factory=list)
+    stale_entries: List[BaselineEntry] = field(default_factory=list)
+    files_checked: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations and not self.stale_entries
+
+    def to_json(self) -> dict:
+        return {
+            "ok": self.ok,
+            "files_checked": self.files_checked,
+            "violations": [vars(v) for v in self.violations],
+            "suppressed": [
+                {**vars(v), "justification": e.justification}
+                for v, e in self.suppressed],
+            "stale_baseline_entries": [vars(e) for e in
+                                       self.stale_entries],
+        }
+
+
+def run_lint(paths: Sequence[str], rules: Optional[Iterable[Rule]] = None,
+             baseline: Optional[Baseline] = None,
+             root: Optional[str] = None) -> LintResult:
+    """Lint ``paths`` with ``rules`` (default: all five families)
+    against ``baseline``.  ``root`` anchors cross-file context (the
+    docs/ tree for GL004-c); default: the common parent of ``paths``."""
+    rules = list(rules) if rules is not None else list(ALL_RULES)
+    baseline = baseline if baseline is not None else Baseline([])
+    if root is None:
+        root = _guess_root(paths)
+    project = Project(root=root)
+    result = LintResult()
+    for path in collect_files(paths):
+        rel = os.path.relpath(path, root) if root else path
+        rel = rel.replace(os.sep, "/")
+        text = None
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            tree = ast.parse(text, filename=path)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            # GL000 goes through the SAME suppression/baseline path as
+            # every other rule: an unparseable-but-known file (vendored,
+            # templated) must be justifiable, not a permanent red
+            line = getattr(e, "lineno", 1) or 1
+            v = Violation("GL000", rel, line,
+                          f"file does not parse: {e}")
+            if text is not None:
+                lines = text.splitlines()
+                v.snippet = lines[line - 1].strip() \
+                    if 1 <= line <= len(lines) else ""
+                if _text_suppressed(lines, "GL000", line):
+                    continue
+            entry = baseline.match(v)
+            if entry is not None:
+                result.suppressed.append((v, entry))
+            else:
+                result.violations.append(v)
+            continue
+        src = SourceFile(path=rel, text=text, tree=tree)
+        result.files_checked += 1
+        library = _is_library_file(rel)
+        for rule in rules:
+            if rule.library_only and not library:
+                continue
+            for v in rule.check(src, project):
+                if src.suppressed(v.rule, v.line):
+                    continue
+                entry = baseline.match(v)
+                if entry is not None:
+                    result.suppressed.append((v, entry))
+                else:
+                    result.violations.append(v)
+    # staleness is judged only within this run's scope: a --rules or
+    # single-directory run must not damn (or tempt anyone to delete)
+    # entries belonging to rules/files it never looked at
+    active = {r.id for r in rules} | {"GL000"}
+    scopes = _rel_scopes(paths, root)
+    result.stale_entries = [
+        e for e in baseline.stale_entries()
+        if e.rule in active and _in_scope(e.file, scopes)]
+    result.violations.sort(key=lambda v: (v.file, v.line, v.rule))
+    return result
+
+
+def _text_suppressed(lines, rule: str, lineno: int) -> bool:
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines):
+            text = lines[ln - 1]
+            if "graftlint: disable=" in text:
+                codes = text.split("graftlint: disable=", 1)[1] \
+                    .split()[0].split(",")
+                if rule in codes or "all" in codes:
+                    return True
+    return False
+
+
+def _rel_scopes(paths: Sequence[str], root: Optional[str]) -> List[str]:
+    out = []
+    for p in paths:
+        rp = os.path.relpath(os.path.abspath(p),
+                             root) if root else p
+        rp = rp.replace(os.sep, "/").rstrip("/")
+        out.append("" if rp == "." else rp)
+    return out
+
+
+def _in_scope(file: str, scopes: List[str]) -> bool:
+    return any(s == "" or file == s or file.startswith(s + "/")
+               for s in scopes)
+
+
+def _guess_root(paths: Sequence[str]) -> str:
+    """The repo root: walk up from the first path to the dir holding
+    ``docs`` or ``.git``; fall back to the path's parent."""
+    start = os.path.abspath(paths[0] if paths else ".")
+    if os.path.isfile(start):
+        start = os.path.dirname(start)
+    cur = start
+    for _ in range(8):
+        if os.path.isdir(os.path.join(cur, "docs")) \
+                or os.path.isdir(os.path.join(cur, ".git")):
+            return cur
+        nxt = os.path.dirname(cur)
+        if nxt == cur:
+            break
+        cur = nxt
+    return os.path.dirname(start) or "."

@@ -1,0 +1,328 @@
+"""Runtime race-detection harness: instrumented locks + guarded fields.
+
+The static rules (GL003) catch lock discipline a parser can see; this
+module catches what only execution shows — the *order* locks nest in
+and the writes that happen with no lock held at all.  Two instruments:
+
+  :class:`CheckedLock`     a Lock/RLock wrapper recording, per thread,
+                           the stack of currently-held checked locks.
+                           Acquiring B while holding A (directly or
+                           through intermediates) adds the edge A→B to
+                           a global acquisition graph; any CYCLE in
+                           that graph — a plain A→B/B→A pair, or a
+                           longer ring spread across three threads —
+                           is a **lock-order inversion**: threads
+                           interleaving those paths deadlock.
+                           Detection needs only the orders to *occur*,
+                           not the deadlock itself, so a passing stress
+                           run still proves the ordering.
+
+  :func:`guard_fields`     swaps an object's class for a subclass whose
+                           ``__setattr__`` records a **bare write**
+                           whenever a guarded attribute is assigned
+                           while the object's checked lock is NOT held
+                           by the writing thread.
+
+Wiring an object under test::
+
+    rc = RaceCheck()
+    wrap_lock(engine, "_lock", rc)            # Lock -> CheckedLock
+    guard_fields(engine, "_lock", ["_closed", "_http_server"], rc)
+    ... run the stress scenario (threads submitting / shutting down /
+        scraping /metrics) ...
+    rc.assert_clean()     # raises with stacks on inversion/bare write
+
+The ServingEngine shutdown-vs-submit-vs-/metrics stress test in
+``tests/test_racecheck.py`` is the canonical use; PR 4's watchdog lock
+ordering and PR 2's engine teardown both earned their review passes the
+hard way this harness now automates.
+"""
+from __future__ import annotations
+
+import threading
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+def _sccs(adj: Dict[str, List[str]]) -> List[List[str]]:
+    """Tarjan strongly-connected components, iterative (lock graphs are
+    tiny, but no recursion limits on principle)."""
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Dict[str, bool] = {}
+    stack: List[str] = []
+    counter = [0]
+    out: List[List[str]] = []
+
+    for root in adj:
+        if root in index:
+            continue
+        work = [(root, iter(adj[root]))]
+        index[root] = low[root] = counter[0]
+        counter[0] += 1
+        stack.append(root)
+        on_stack[root] = True
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for nxt in it:
+                if nxt not in index:
+                    index[nxt] = low[nxt] = counter[0]
+                    counter[0] += 1
+                    stack.append(nxt)
+                    on_stack[nxt] = True
+                    work.append((nxt, iter(adj[nxt])))
+                    advanced = True
+                    break
+                if on_stack.get(nxt):
+                    low[node] = min(low[node], index[nxt])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack[w] = False
+                    comp.append(w)
+                    if w == node:
+                        break
+                out.append(comp)
+    return out
+
+
+def _stack(skip: int = 2, limit: int = 8) -> List[str]:
+    frames = traceback.extract_stack()[:-skip]
+    return [f"{f.filename.rsplit('/', 1)[-1]}:{f.lineno} {f.name}"
+            for f in frames[-limit:]]
+
+
+@dataclass
+class Inversion:
+    """One lock-order cycle: ``cycle`` lists the lock names on it (a
+    plain A/B inversion is the 2-name case; longer chains across three
+    or more threads are genuine deadlocks too), ``edges`` the observed
+    nestings inside the cycle with where each was first seen."""
+    cycle: List[str]
+    edges: List[Tuple[str, str, str]]       # (outer, inner, first site)
+
+    def render(self) -> str:
+        ring = " -> ".join(self.cycle + [self.cycle[0]])
+        sites = "; ".join(f"{a}->{b} at {site}"
+                          for a, b, site in self.edges)
+        return f"lock-order inversion: {ring} ({sites})"
+
+
+@dataclass
+class BareWrite:
+    obj: str
+    attr: str
+    lock: str
+    thread: str
+    stack: List[str]
+
+    def render(self) -> str:
+        return (f"bare shared-state write: {self.obj}.{self.attr} "
+                f"assigned on thread {self.thread!r} without "
+                f"{self.lock} held (at {self.stack[-1]})")
+
+
+class RaceCheck:
+    """One acquisition graph + finding sink shared by every instrument
+    of a scenario."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        # held-lock stack per thread (thread-local to THIS harness)
+        self._tls = threading.local()
+        # edge (outer, inner) -> first observed stack
+        self._edges: Dict[Tuple[str, str], List[str]] = {}
+        self.bare_writes: List[BareWrite] = []
+        self._names: Dict[str, int] = {}
+
+    def unique_name(self, base: str) -> str:
+        """``base`` on first use, ``base#2``/``base#3``… after: two
+        instruments of the same class+attr must not share a graph node,
+        or their mutual ordering degenerates into a self-edge."""
+        with self._mu:
+            n = self._names.get(base, 0) + 1
+            self._names[base] = n
+        return base if n == 1 else f"{base}#{n}"
+
+    # -- CheckedLock plumbing -------------------------------------------- #
+    def _held(self) -> List["CheckedLock"]:
+        if not hasattr(self._tls, "stack"):
+            self._tls.stack = []
+        return self._tls.stack
+
+    def _on_acquired(self, lock: "CheckedLock"):
+        held = self._held()
+        # edge from EVERY held lock, not just the innermost: holding A
+        # while taking C through an intermediate B is still an A-before-
+        # C ordering, and dropping it would hide A/C inversions
+        new_edges = [(h.name, lock.name) for h in held if h is not lock]
+        if new_edges:
+            with self._mu:
+                for edge in new_edges:
+                    self._edges.setdefault(edge, _stack())
+        held.append(lock)
+
+    def _on_released(self, lock: "CheckedLock"):
+        held = self._held()
+        # release order may not mirror acquire order; drop the newest
+        # matching entry
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    # -- findings -------------------------------------------------------- #
+    def inversions(self) -> List[Inversion]:
+        """Cycles in the acquisition-order graph.  Any strongly-
+        connected component with two or more locks means some set of
+        threads can each hold one lock of the component while waiting
+        for the next — a deadlock needs only the orders to have been
+        OBSERVED, across any threads, at any time."""
+        with self._mu:
+            edges = dict(self._edges)
+        adj: Dict[str, List[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        out = []
+        for comp in _sccs(adj):
+            # size-1 components count when they carry a SELF-edge: two
+            # distinct locks sharing one name (hand-built CheckedLocks;
+            # wrap_lock disambiguates via unique_name) nested in both
+            # orders collapse to exactly that shape — it must not pass
+            if len(comp) < 2 and (comp[0], comp[0]) not in edges:
+                continue
+            cset = set(comp)
+            cyc_edges = [(a, b, stk[-1]) for (a, b), stk in edges.items()
+                         if a in cset and b in cset]
+            out.append(Inversion(cycle=sorted(comp),
+                                 edges=sorted(cyc_edges)))
+        return out
+
+    def report(self) -> Dict[str, Any]:
+        return {"inversions": [vars(i) for i in self.inversions()],
+                "bare_writes": [vars(w) for w in self.bare_writes],
+                "edges": sorted(self._edges)}
+
+    def assert_clean(self):
+        problems = [i.render() for i in self.inversions()] \
+            + [w.render() for w in self.bare_writes]
+        if problems:
+            raise AssertionError("racecheck found:\n  "
+                                 + "\n  ".join(problems))
+
+
+class CheckedLock:
+    """Drop-in Lock/RLock wrapper feeding a :class:`RaceCheck`.
+
+    Exposes acquire/release/locked and the context-manager protocol, so
+    it substitutes for ``threading.Lock``/``RLock`` attributes and works
+    inside ``threading.Condition(lock=...)``.
+    """
+
+    def __init__(self, name: str, rc: RaceCheck, rlock: bool = False):
+        self.name = name
+        self._rc = rc
+        self._inner = threading.RLock() if rlock else threading.Lock()
+        self._holders: Dict[int, int] = {}      # ident -> depth
+        self._mu = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._inner.acquire(blocking, timeout) if timeout != -1 \
+            else self._inner.acquire(blocking)
+        if got:
+            ident = threading.get_ident()
+            with self._mu:
+                depth = self._holders.get(ident, 0)
+                self._holders[ident] = depth + 1
+            if depth == 0:      # re-entrant re-acquire adds no edge
+                self._rc._on_acquired(self)
+        return got
+
+    def release(self):
+        ident = threading.get_ident()
+        with self._mu:
+            depth = self._holders.get(ident, 0)
+            if depth <= 1:
+                self._holders.pop(ident, None)
+            else:
+                self._holders[ident] = depth - 1
+        if depth <= 1:
+            self._rc._on_released(self)
+        self._inner.release()
+
+    def held_by_current_thread(self) -> bool:
+        with self._mu:
+            return self._holders.get(threading.get_ident(), 0) > 0
+
+    def locked(self) -> bool:
+        with self._mu:
+            return bool(self._holders)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+def wrap_lock(obj, attr: str, rc: RaceCheck,
+              name: Optional[str] = None) -> CheckedLock:
+    """Replace ``obj.<attr>`` (a threading.Lock/RLock) with a
+    :class:`CheckedLock` reporting into ``rc``.  Must run while nothing
+    holds the lock (instrument before starting the scenario threads)."""
+    current = getattr(obj, attr)
+    if isinstance(current, CheckedLock):
+        return current
+    rlock = "RLock" in type(current).__name__ \
+        or "_RLock" in type(current).__name__
+    lock = CheckedLock(
+        rc.unique_name(name or f"{type(obj).__name__}.{attr}"), rc,
+        rlock=rlock)
+    setattr(obj, attr, lock)
+    return lock
+
+
+def guard_fields(obj, lock_attr: str, fields: Sequence[str],
+                 rc: RaceCheck):
+    """Record a :class:`BareWrite` whenever one of ``fields`` is
+    assigned on ``obj`` without ``obj.<lock_attr>`` (a CheckedLock —
+    call :func:`wrap_lock` first) held by the writing thread.
+
+    Implementation: the object's class is swapped for a one-off subclass
+    overriding ``__setattr__`` — instance state, methods and isinstance
+    checks against the original class are untouched."""
+    lock = getattr(obj, lock_attr)
+    if not isinstance(lock, CheckedLock):
+        raise TypeError(f"{lock_attr} is not a CheckedLock; call "
+                        "wrap_lock(obj, lock_attr, rc) first")
+    guarded = frozenset(fields)
+    base = type(obj)
+
+    def __setattr__(self, name, value):
+        if name in guarded:
+            lk = getattr(self, lock_attr, None)
+            if isinstance(lk, CheckedLock) \
+                    and not lk.held_by_current_thread():
+                rc.bare_writes.append(BareWrite(
+                    obj=type(self).__name__.replace("Guarded", "", 1),
+                    attr=name, lock=lock_attr,
+                    thread=threading.current_thread().name,
+                    stack=_stack()))
+        base.__setattr__(self, name, value)
+
+    sub = type("Guarded" + base.__name__, (base,),
+               {"__setattr__": __setattr__})
+    obj.__class__ = sub
+    return obj

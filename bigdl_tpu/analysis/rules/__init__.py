@@ -1,0 +1,15 @@
+"""Rule registry: one module per bug family, ordered by rule ID."""
+from .base import Project, Rule, SourceFile, Violation
+from .gl001_donation import GL001Donation
+from .gl002_host_sync import GL002HostSync
+from .gl003_locks import GL003Locks
+from .gl004_spans import GL004Spans
+from .gl005_recompile import GL005Recompile
+
+ALL_RULES = (GL001Donation(), GL002HostSync(), GL003Locks(),
+             GL004Spans(), GL005Recompile())
+
+RULES_BY_ID = {r.id: r for r in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_ID", "Project", "Rule", "SourceFile",
+           "Violation"]

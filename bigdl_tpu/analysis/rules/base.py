@@ -1,0 +1,302 @@
+"""Shared rule plumbing: the Violation record, the Rule interface, and
+the AST helpers every rule family leans on (parent links, jit-traced
+function discovery, lock-attribute discovery, with-lock containment).
+
+Rules are pure stdlib ``ast`` passes — no jax/numpy import — so the CI
+``lint`` job runs in seconds on a bare python.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+
+@dataclass
+class Violation:
+    """One finding.  ``snippet`` (the stripped source line) is the
+    baseline-matching key next to rule+file: line numbers drift with
+    unrelated edits, the offending line's text does not."""
+    rule: str
+    file: str                # path as given to the engine (repo-relative)
+    line: int
+    message: str
+    snippet: str = ""
+
+    def key(self):
+        return (self.rule, self.file, self.snippet)
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class SourceFile:
+    """One parsed file plus the per-file facts rules share."""
+    path: str                # as reported in violations
+    text: str
+    tree: ast.AST
+    lines: List[str] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.lines:
+            self.lines = self.text.splitlines()
+        add_parents(self.tree)
+
+    def line_at(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressed(self, rule: str, lineno: int) -> bool:
+        """Inline opt-out: ``# graftlint: disable=GL00x[,GL00y]`` on the
+        flagged line or the line directly above it."""
+        for ln in (lineno, lineno - 1):
+            text = self.line_at(ln)
+            if "graftlint: disable=" in text:
+                tail = text.split("graftlint: disable=", 1)[1]
+                codes = tail.split()[0].split(",")
+                if rule in codes or "all" in codes:
+                    return True
+        return False
+
+
+class Project:
+    """Cross-file context handed to every rule: where the repo root is
+    (for docs lookups) and lazily-loaded shared artifacts."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root
+        self._docs_text: Optional[str] = None
+
+    def docs_text(self) -> str:
+        """Concatenated text of every ``docs/*.md`` under the repo root
+        (the declared-metric-name universe GL004 checks against)."""
+        if self._docs_text is None:
+            import glob
+            import os
+            chunks = []
+            if self.root:
+                for p in sorted(glob.glob(os.path.join(self.root, "docs",
+                                                       "*.md"))):
+                    try:
+                        with open(p, encoding="utf-8") as f:
+                            chunks.append(f.read())
+                    except OSError:
+                        pass
+            self._docs_text = "\n".join(chunks)
+        return self._docs_text
+
+
+def is_library_path(path: str) -> bool:
+    """Library code vs tests/scripts/examples — some rules (or subrules)
+    only make sense for the former."""
+    norm = path.replace("\\", "/")
+    return not any(seg in norm for seg in ("tests/", "scripts/",
+                                           "examples/"))
+
+
+class Rule:
+    """One named invariant.  ``library_only`` rules skip tests/ and
+    scripts/ (e.g. a timing script *should* host-sync; a test loop
+    float()ing a loss is the test's assertion, not a hot path)."""
+    id = "GL000"
+    title = "base rule"
+    library_only = False
+
+    def check(self, src: SourceFile, project: Project) -> List[Violation]:
+        raise NotImplementedError
+
+    def violation(self, src: SourceFile, node: ast.AST, message: str
+                  ) -> Violation:
+        line = getattr(node, "lineno", 1)
+        return Violation(self.id, src.path, line, message,
+                         src.line_at(line))
+
+
+# --------------------------------------------------------------------- #
+# AST helpers                                                           #
+# --------------------------------------------------------------------- #
+def add_parents(tree: ast.AST):
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            child._gl_parent = parent       # type: ignore[attr-defined]
+
+
+def parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_gl_parent", None)
+
+
+def ancestors(node: ast.AST):
+    p = parent(node)
+    while p is not None:
+        yield p
+        p = parent(p)
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    for a in ancestors(node):
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return a
+    return None
+
+
+def enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    for a in ancestors(node):
+        if isinstance(a, ast.ClassDef):
+            return a
+    return None
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.tree_util.tree_map' for the matching Attribute/Name chain,
+    '' for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted_name(call.func)
+
+
+def is_call_to(node: ast.AST, *names: str) -> bool:
+    """True when ``node`` is a Call whose dotted name is one of ``names``
+    or ends with ``.<name>`` (so ``rec.inc`` matches ``inc``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    dn = call_name(node)
+    for n in names:
+        if dn == n or dn.endswith("." + n):
+            return True
+    return False
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# --------------------------------------------------------------------- #
+# jit-traced function discovery (GL002-A / GL005-A share this)          #
+# --------------------------------------------------------------------- #
+_JIT_NAMES = ("jit", "jax.jit", "pjit", "jax.pjit", "partial_jit")
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` / ``functools.partial(jax.jit, ...)``."""
+    dn = dotted_name(node)
+    if dn in _JIT_NAMES or dn.endswith(".jit") or dn.endswith(".pjit"):
+        return True
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        if fn in _JIT_NAMES or fn.endswith(".jit") or fn.endswith(".pjit"):
+            return True
+        if fn == "partial" or fn.endswith(".partial"):
+            return bool(node.args) and _is_jit_expr(node.args[0])
+    return False
+
+
+def traced_functions(tree: ast.AST) -> Set[ast.FunctionDef]:
+    """Every function the module hands to a jit: decorated with
+    ``@jax.jit`` (bare or partial), or whose name is later passed as the
+    first argument of a ``jax.jit(...)`` call in the same module.  Code
+    inside these runs under tracing — host syncs and wall-clock reads
+    there are the GL002/GL005 hazards."""
+    jitted_names: Set[str] = set()
+    decorated: Set[ast.FunctionDef] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node) \
+                and node.args and isinstance(node.args[0], ast.Name):
+            jitted_names.add(node.args[0].id)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec):
+                    decorated.add(node)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name in jitted_names:
+            decorated.add(node)
+    return decorated
+
+
+def in_traced_function(node: ast.AST, traced: Set[ast.FunctionDef]) -> bool:
+    fn = enclosing_function(node)
+    while fn is not None:
+        if fn in traced:
+            return True
+        fn = enclosing_function(fn)
+    return False
+
+
+# --------------------------------------------------------------------- #
+# lock discovery (GL003)                                                #
+# --------------------------------------------------------------------- #
+_LOCK_CTORS = ("Lock", "RLock", "Condition", "threading.Lock",
+               "threading.RLock", "threading.Condition")
+
+
+def lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """self.<attr> names assigned a threading lock/condition anywhere in
+    the class (usually ``__init__``)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Assign) or \
+                not isinstance(node.value, ast.Call):
+            continue
+        dn = call_name(node.value)
+        if not (dn in _LOCK_CTORS or dn.endswith(".Lock")
+                or dn.endswith(".RLock") or dn.endswith(".Condition")):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                out.add(tgt.attr)
+    return out
+
+
+def under_with_lock(node: ast.AST, locks: Set[str]) -> bool:
+    """True when ``node`` sits inside ``with self.<lock>:`` for any of
+    the class's locks (or inside a method following the ``*_locked``
+    naming convention — "caller holds the lock")."""
+    for a in ancestors(node):
+        if isinstance(a, ast.With):
+            for item in a.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Attribute) \
+                        and isinstance(ctx.value, ast.Name) \
+                        and ctx.value.id == "self" and ctx.attr in locks:
+                    return True
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if a.name.endswith("_locked"):
+                return True
+            return False        # stop at the method boundary
+    return False
+
+
+# --------------------------------------------------------------------- #
+# self-attribute writes (GL003)                                         #
+# --------------------------------------------------------------------- #
+def self_attr_writes(fn: ast.AST):
+    """Yield ``(attr_name, node)`` for every write to ``self.<attr>`` or
+    ``self.<attr>[...]`` in ``fn`` (excluding nested defs' own self)."""
+    for node in ast.walk(fn):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            targets = [node.target]
+        for tgt in targets:
+            base = tgt
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name) \
+                    and base.value.id == "self":
+                yield base.attr, node

@@ -1,0 +1,131 @@
+"""GL001 — donation / zero-copy aliasing.
+
+The PR-3 corruption class, both directions of it:
+
+  (a) device → host: ``np.asarray(jax_array)`` (or mapping ``np.asarray``
+      over a tree of them) can return a zero-copy VIEW of the device
+      buffer on CPU backends.  Hand that "snapshot" to an async writer
+      while the donating step loop keeps running and the view is
+      scribbled mid-write — the torn state even passes its own CRC,
+      because the CRC was computed over the torn bytes.
+
+  (b) host → device: ``jnp.asarray(host_buffer)`` can zero-copy ADOPT an
+      aligned host buffer (``np.load`` results, depending on zip layout —
+      which is why the original bug was flaky).  The first post-restore
+      donated step then donates memory numpy still owns, and Adam moments
+      fill with garbage.
+
+The owning spellings are ``np.array(...)`` / ``jnp.array(..., copy=True)``
+(see ``checkpoint.manager.host_snapshot`` and
+``SpmdTrainer._finish_restore``).  The rule flags:
+
+  GL001-a  ``tree_map(np.asarray, ...)`` — the exact shape the PR-3
+           snapshot bug had
+  GL001-b  ``np.asarray(x)`` inside a function that mentions donation or
+           lives on a snapshot/restore path (name contains snapshot /
+           restore / host_copy)
+  GL001-c  ``jnp.asarray(x)`` (direct or tree_mapped) inside a
+           restore/load-path function (name contains ``restore`` or
+           ``load``) — the owning spelling there is
+           ``jnp.array(..., copy=True)``
+
+Scoping: (a)/(b) — the snapshot-view hazards — apply to library code
+only; they need a concurrently-donating step, and tests materialize
+trees after training completes.  (c) applies everywhere: test worker
+harnesses genuinely restore and then train.
+
+Known limitation (documented, not hidden): the restore-path test is the
+function *name*, so a helper like ``_to_device`` called from a load path
+is not flagged — name helpers on ownership-critical paths accordingly.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import (Project, Rule, SourceFile, Violation, call_name,
+                   dotted_name, enclosing_function, is_library_path)
+
+_SNAPSHOT_HINTS = ("snapshot", "host_copy", "to_host")
+_RESTORE_HINTS = ("restore", "load")
+
+
+def _mentions_donation(fn: ast.AST) -> bool:
+    """The function itself passes donate_argnums/donate_argnames — not a
+    docstring mention, which would flag every comment about the rule."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.keyword) and node.arg \
+                and node.arg.startswith("donate"):
+            return True
+    return False
+
+
+def _is_np_asarray(name: str) -> bool:
+    """numpy's asarray only: ``jnp.asarray`` on traced values is a cast,
+    not a host view — it is handled by the restore-path check (c)."""
+    return name in ("np.asarray", "numpy.asarray")
+
+
+def _is_jnp_asarray(name: str) -> bool:
+    return name in ("jnp.asarray", "jax.numpy.asarray")
+
+
+class GL001Donation(Rule):
+    id = "GL001"
+    title = "donation / zero-copy aliasing"
+
+    def check(self, src: SourceFile, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        # the snapshot-VIEW hazards (a/b) need a concurrently-donating
+        # step; tests materialize trees after training completes, so
+        # those subrules are library-only.  The restore-ADOPTION hazard
+        # (c) stays on everywhere: worker harnesses restore, then train.
+        library = is_library_path(src.path)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            fn = enclosing_function(node)
+            fname = fn.name.lower() if fn is not None else ""
+            on_restore_path = any(h in fname for h in _RESTORE_HINTS)
+            # tree_map(asarray, ...) — one line converts a whole tree;
+            # which direction decides which subrule applies
+            if (name == "tree_map" or name.endswith(".tree_map")) \
+                    and node.args:
+                mapped = dotted_name(node.args[0])
+                if _is_np_asarray(mapped) and library:
+                    out.append(self.violation(
+                        src, node,
+                        "tree_map(np.asarray, ...) maps zero-copy views "
+                        "over a device tree; a donated step scribbles "
+                        "them mid-use — map an owning np.array instead "
+                        "(PR-3 snapshot corruption shape)"))
+                elif _is_jnp_asarray(mapped) and on_restore_path:
+                    out.append(self.violation(
+                        src, node,
+                        "tree_map(jnp.asarray, ...) on a restore path "
+                        "can zero-copy ADOPT aligned host buffers; the "
+                        "first donated step then corrupts state numpy "
+                        "still owns — map jnp.array(..., copy=True) "
+                        "(PR-3 restore corruption shape)"))
+                continue
+            # (b) np.asarray on a snapshot/donation path
+            if _is_np_asarray(name) and library:
+                hazardous = any(h in fname for h in _SNAPSHOT_HINTS) \
+                    or (fn is not None and _mentions_donation(fn))
+                if hazardous:
+                    out.append(self.violation(
+                        src, node,
+                        "np.asarray on a snapshot/donation path may be a "
+                        "zero-copy view of the device buffer; use "
+                        "np.array so the host copy owns its memory"))
+            # (c) jnp.asarray on a restore path without copy=True
+            if _is_jnp_asarray(name) and on_restore_path:
+                out.append(self.violation(
+                    src, node,
+                    "jnp.asarray on a restore path can zero-copy "
+                    "adopt the host buffer; the first donated step "
+                    "then corrupts state numpy still owns — use "
+                    "jnp.array(..., copy=True) (PR-3 restore "
+                    "corruption shape)"))
+        return out

@@ -1,0 +1,97 @@
+"""GL002 — host sync in the hot path.
+
+Two shapes:
+
+  GL002-a  a host-forcing call (``float()`` / ``.item()`` /
+           ``np.asarray`` / ``np.array`` / ``.block_until_ready()``) on a
+           traced value *inside a jitted function*.  Under tracing these
+           either raise (TracerConversionError) or — worse, inside
+           helpers that sometimes run eagerly — silently fence the
+           pipeline every call.
+
+  GL002-b  ``float()`` / ``.item()`` on a step result inside a per-step
+           loop in library code.  Each conversion is a device→host sync
+           that serializes dispatch against execution; the pattern that
+           keeps winning review comments is "collect device scalars,
+           convert once at the end" (``[float(l) for l in losses]`` after
+           the loop — see ``SpmdTrainer.fit``).  A deliberate
+           once-per-step sync (the telemetry contract: ``end_step`` folds
+           the floats sentinels already need) belongs in the baseline
+           with its justification, not hidden.
+
+``library_only``: timing scripts *must* sync (that is the measurement),
+and a test loop float()ing a loss is the assertion itself.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import (Project, Rule, SourceFile, Violation, call_name,
+                   in_traced_function, traced_functions)
+
+def _is_host_sync(call: ast.Call) -> bool:
+    name = call_name(call)
+    if name == "float" and call.args \
+            and not isinstance(call.args[0], ast.Constant):
+        return True
+    if name.endswith(".item") and not call.args:
+        return True
+    if name.endswith("block_until_ready"):
+        return True
+    # numpy (never jax.numpy: jnp.asarray is a traced op) conversions
+    if name in ("np.asarray", "np.array", "numpy.asarray", "numpy.array"):
+        return True
+    return False
+
+
+def _is_step_loop(loop: ast.For) -> bool:
+    """A for-loop whose body drives training/serving steps: it calls
+    ``*.step(...)`` or ``start_step``/``end_step``."""
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name.endswith(".step") or name.endswith("start_step") \
+                    or name.endswith("end_step"):
+                return True
+    return False
+
+
+class GL002HostSync(Rule):
+    id = "GL002"
+    title = "host sync in the hot path"
+    library_only = True
+
+    def check(self, src: SourceFile, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        traced = traced_functions(src.tree)
+        # (a) host syncs under tracing
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) and _is_host_sync(node) \
+                    and in_traced_function(node, traced):
+                out.append(self.violation(
+                    src, node,
+                    f"{call_name(node)}(...) inside a jitted function "
+                    "forces a host sync (or a tracer error) every call; "
+                    "keep the value on device and convert outside the "
+                    "traced region"))
+        # (b) per-step float()/item() in step loops
+        for loop in ast.walk(src.tree):
+            if not isinstance(loop, ast.For) or not _is_step_loop(loop):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                sync = (name == "float" and node.args
+                        and not isinstance(node.args[0], ast.Constant)) \
+                    or name.endswith(".item")
+                if sync and not in_traced_function(node, traced):
+                    out.append(self.violation(
+                        src, node,
+                        "per-step host sync inside a step loop "
+                        "serializes dispatch against execution; keep "
+                        "device scalars and convert once after the loop "
+                        "(or baseline the one deliberate telemetry sync "
+                        "with its justification)"))
+        return out

@@ -1,0 +1,149 @@
+"""GL003 — lock & signal-handler discipline.
+
+The PR-4 review-tax class.  Three shapes:
+
+  GL003-a  an instance attribute written both *inside* a
+           ``with self._lock`` block and *outside* one (in different
+           methods of the same class).  The unguarded write races the
+           guarded readers; the GIL makes each write atomic but not the
+           read-modify-write and check-then-act sequences around it.
+           The ``*_locked`` method-name suffix declares "caller holds
+           the lock" (kernel-style) and counts as guarded.
+
+  GL003-b  an attribute written from two or more methods with *no* lock
+           at any write site, in a class that owns a lock and guards
+           other attributes with it — mixed discipline.  Either the
+           attribute is thread-shared (guard it) or it is not (say so
+           in the baseline justification).
+
+  GL003-c  ``signal.signal(sig, handler)`` installing a locally-defined
+           handler while discarding the previous one — no chaining, no
+           restore.  PR 4 needed three review passes to get SIGTERM
+           chaining right between the PreemptionHandler and the
+           FlightRecorder; an unchained install silently eats whichever
+           of them ran first.  Saving the return value or calling
+           ``signal.getsignal`` first passes; restoring ``SIG_DFL`` /
+           ``SIG_IGN`` / a saved previous handler passes.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .base import (Project, Rule, SourceFile, Violation, dotted_name,
+                   enclosing_function, lock_attrs, self_attr_writes,
+                   under_with_lock)
+
+# attributes a class conventionally mutates single-threadedly at setup
+_SETUP_METHODS = ("__init__", "__post_init__", "__del__", "__enter__",
+                  "__exit__")
+
+
+class GL003Locks(Rule):
+    id = "GL003"
+    title = "lock & signal-handler discipline"
+
+    def check(self, src: SourceFile, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for cls in ast.walk(src.tree):
+            if isinstance(cls, ast.ClassDef):
+                out.extend(self._check_class(src, cls))
+        out.extend(self._check_signals(src))
+        return out
+
+    # -- a/b: shared-attribute discipline ------------------------------- #
+    def _check_class(self, src: SourceFile, cls: ast.ClassDef
+                     ) -> List[Violation]:
+        yield_list: List[Violation] = []
+        locks = lock_attrs(cls)
+        if not locks:
+            return yield_list
+        # per attribute: guarded / unguarded write sites (method, node)
+        guarded: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        unguarded: Dict[str, List[Tuple[str, ast.AST]]] = {}
+        for meth in cls.body:
+            if not isinstance(meth, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if meth.name in _SETUP_METHODS:
+                continue
+            for attr, node in self_attr_writes(meth):
+                if attr in locks:
+                    continue
+                fn = enclosing_function(node)
+                scope = fn.name if fn is not None else meth.name
+                if under_with_lock(node, locks) \
+                        or (fn is not None
+                            and fn.name.endswith("_locked")):
+                    guarded.setdefault(attr, []).append((scope, node))
+                else:
+                    unguarded.setdefault(attr, []).append((scope, node))
+        for attr, sites in unguarded.items():
+            if attr in guarded:
+                for scope, node in sites:
+                    out_v = self.violation(
+                        src, node,
+                        f"{cls.name}.{attr} is written under the lock in "
+                        f"{guarded[attr][0][0]}() but without it here in "
+                        f"{scope}(); guard every write (or rename the "
+                        "method *_locked if the caller holds it)")
+                    yield_list.append(out_v)
+            elif len({s for s, _ in sites}) >= 2:
+                # never guarded, but written from several methods in a
+                # lock-owning class: mixed discipline
+                scope0, node0 = sites[0]
+                yield_list.append(self.violation(
+                    src, node0,
+                    f"{cls.name}.{attr} is written from "
+                    f"{len({s for s, _ in sites})} methods "
+                    f"({', '.join(sorted({s for s, _ in sites}))}) with "
+                    "no lock held, in a class that lock-guards other "
+                    "state; guard it or justify why it is not shared"))
+        return yield_list
+
+    # -- c: unchained signal installs ----------------------------------- #
+    def _check_signals(self, src: SourceFile) -> List[Violation]:
+        out: List[Violation] = []
+        # handler names defined locally (def / lambda assignment)
+        local_defs: Set[str] = {
+            n.name for n in ast.walk(src.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func) != "signal.signal":
+                continue
+            if len(node.args) < 2:
+                continue
+            handler = node.args[1]
+            hname = dotted_name(handler)
+            if hname.endswith("SIG_DFL") or hname.endswith("SIG_IGN"):
+                continue            # disposition restore, not an install
+            installs = isinstance(handler, ast.Lambda) \
+                or (isinstance(handler, ast.Name)
+                    and handler.id in local_defs) \
+                or (isinstance(handler, ast.Attribute)
+                    and isinstance(handler.value, ast.Name)
+                    and handler.value.id == "self")
+            if not installs:
+                continue            # passing a saved prev back = restore
+            # chained if the return value is kept or getsignal is called
+            # in the same function
+            from .base import parent as _parent
+            if not isinstance(_parent(node), ast.Expr):
+                continue            # result assigned/used: prev saved
+            fn = enclosing_function(node)
+            scope = fn if fn is not None else src.tree
+            chained = any(
+                isinstance(n, ast.Call)
+                and dotted_name(n.func).endswith("getsignal")
+                for n in ast.walk(scope))
+            if not chained:
+                out.append(self.violation(
+                    src, node,
+                    "signal handler installed without saving the "
+                    "previous one — nothing to chain or restore; keep "
+                    "signal.signal's return value (or getsignal first) "
+                    "and call the prior handler (PR-4 SIGTERM-chaining "
+                    "shape)"))
+        return out

@@ -1,0 +1,112 @@
+"""GL004 — span/trace pairing and counter-name drift.
+
+  GL004-a  ``jax.profiler.start_trace(...)`` whose enclosing function has
+           no ``finally`` calling ``stop_trace``.  The PR-5 wedged-
+           profiler bug exactly: an exception mid-traced-step left the
+           session latched open forever, and every later capture
+           silently no-opped.  Code that pairs the session across calls
+           (a deliberate state machine like the Recorder's trace
+           sessions) baselines with a pointer at its recovery logic.
+
+  GL004-b  a trace/span *open* (``tr.open("name", ...)`` /
+           ``start_span``) in a file that never closes: no ``close`` /
+           ``terminal`` / ``discard`` call anywhere in the same file.
+           Pairing across threads (the serving queue handoff) is legal
+           but must be visible in the same file or justified in the
+           baseline.
+
+  GL004-c  a counter incremented (``rec.inc("name")``) under a constant
+           name that no ``docs/*.md`` file declares.  The metrics tables
+           in the docs are the operator contract — a counter that only
+           exists in the source is a dashboard nobody will ever build.
+           F-string names are skipped (not statically checkable);
+           ``prefix/*`` in the docs declares a family.
+
+``library_only``: fixtures and tests open fake spans on purpose, and
+test-only counters are not an operator contract.
+"""
+from __future__ import annotations
+
+import ast
+
+from typing import List
+
+from .base import (Project, Rule, SourceFile, Violation, call_name,
+                   const_str, enclosing_function)
+
+
+def _has_finally_with(fn: ast.AST, callee_suffix: str) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for n in node.finalbody:
+                for c in ast.walk(n):
+                    if isinstance(c, ast.Call) and call_name(c).endswith(
+                            callee_suffix):
+                        return True
+    return False
+
+
+def _declared(name: str, doc_text: str) -> bool:
+    """A counter is declared when its full name appears anywhere in the
+    docs, or a family glob covers it: ``health/*`` in the docs declares
+    every ``health/...`` counter."""
+    if name in doc_text:
+        return True
+    parts = name.split("/")
+    for i in range(1, len(parts)):
+        if "/".join(parts[:i]) + "/*" in doc_text:
+            return True
+    return False
+
+
+class GL004Spans(Rule):
+    id = "GL004"
+    title = "span/trace pairing & counter-name drift"
+    library_only = True
+
+    def check(self, src: SourceFile, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        text = src.text
+        has_close = (".close(" in text or ".terminal(" in text
+                     or ".discard(" in text or "stop_span" in text)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            # (a) profiler session without finally-guarded stop (exact
+            # last segment: `_maybe_start_trace` is a wrapper, not the
+            # session call)
+            if name.split(".")[-1] == "start_trace":
+                fn = enclosing_function(node)
+                if fn is None or not _has_finally_with(fn, "stop_trace"):
+                    out.append(self.violation(
+                        src, node,
+                        "profiler trace session started without a "
+                        "finally-guarded stop_trace; an exception here "
+                        "latches the session open and every later "
+                        "capture silently no-ops (PR-5 wedged-profiler "
+                        "shape)"))
+            # (b) span open with no close anywhere in the file
+            elif (name.endswith(".open") or name.endswith("start_span")) \
+                    and node.args and const_str(node.args[0]) is not None \
+                    and not has_close:
+                out.append(self.violation(
+                    src, node,
+                    f"span {const_str(node.args[0])!r} opened but this "
+                    "file never calls close/terminal/discard; pair it "
+                    "(or justify the cross-file handoff in the "
+                    "baseline)"))
+            # (c) counters under names the docs never declare
+            elif name.endswith(".inc") and node.args:
+                cname = const_str(node.args[0])
+                if cname is None:
+                    continue        # f-string / computed: not checkable
+                if not project.docs_text():
+                    continue        # no docs tree (fixture runs)
+                if not _declared(cname, project.docs_text()):
+                    out.append(self.violation(
+                        src, node,
+                        f"counter {cname!r} is emitted but no docs/*.md "
+                        "declares it; add it to the metrics table (the "
+                        "operator contract) or drop the counter"))
+        return out

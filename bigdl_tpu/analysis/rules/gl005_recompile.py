@@ -1,0 +1,124 @@
+"""GL005 — recompile & retrace hazards.
+
+  GL005-a  wall-clock or host-RNG calls (``time.time`` /
+           ``time.perf_counter`` / ``np.random.*`` / ``random.*``)
+           inside a jitted function.  Under tracing these bake ONE value
+           into the compiled program — the "why is my timestamp
+           constant" class — and when closed over as static they force a
+           retrace per call.  Use traced keys (``jax.random``) and time
+           outside the jit.
+
+  GL005-b  a function handed to ``jax.jit(..., static_argnums/names=...)``
+           whose static parameter has a *mutable default* (list / dict /
+           set literal).  Static args are hashed into the compile-cache
+           key; unhashable values raise at best and at worst every call
+           site builds a fresh object — a silent recompile per step.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from .base import (Project, Rule, SourceFile, Violation, call_name,
+                   in_traced_function, traced_functions)
+
+_CLOCK_RNG = ("time.time", "time.perf_counter", "time.monotonic",
+              "datetime.now", "random.random", "random.randint",
+              "random.uniform", "random.choice")
+
+
+def _is_clock_or_rng(call: ast.Call) -> bool:
+    name = call_name(call)
+    if name in _CLOCK_RNG:
+        return True
+    return ".random." in name and not name.startswith("jax") \
+        and "jax" not in name
+
+
+class GL005Recompile(Rule):
+    id = "GL005"
+    title = "recompile & retrace hazards"
+
+    def check(self, src: SourceFile, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        traced = traced_functions(src.tree)
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) and _is_clock_or_rng(node) \
+                    and in_traced_function(node, traced):
+                out.append(self.violation(
+                    src, node,
+                    f"{call_name(node)}() inside a jitted function is "
+                    "traced once and baked into the program as a "
+                    "constant; move clocks/host RNG outside the jit "
+                    "(use jax.random for traced randomness)"))
+        out.extend(self._check_static_args(src))
+        return out
+
+    # -- b: mutable defaults behind static args -------------------------- #
+    def _check_static_args(self, src: SourceFile) -> List[Violation]:
+        out: List[Violation] = []
+        fns: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in ast.walk(src.tree)
+            if isinstance(n, ast.FunctionDef)}
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if not (name.endswith(".jit") or name == "jit"
+                    or name.endswith(".pjit")):
+                continue
+            static_names, static_nums = [], []
+            for kw in node.keywords:
+                if kw.arg == "static_argnames":
+                    static_names = _const_list(kw.value)
+                elif kw.arg == "static_argnums":
+                    static_nums = _const_list(kw.value)
+            if not static_names and not static_nums:
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Name):
+                continue
+            fn = fns.get(node.args[0].id)
+            if fn is None:
+                continue
+            # positional params (posonly + regular) — static_argnums
+            # indexes into exactly this sequence; defaults align with
+            # its TAIL.  Keyword-only params (`*, cfg={}`) carry their
+            # defaults separately and are the idiomatic static_argnames
+            # spelling, so they must be inspected too
+            params = [a.arg for a in (fn.args.posonlyargs
+                                      + fn.args.args)]
+            defaults = fn.args.defaults
+            by_param = dict(zip(params[len(params) - len(defaults):],
+                                defaults))
+            for kwarg, dflt in zip(fn.args.kwonlyargs,
+                                   fn.args.kw_defaults):
+                if dflt is not None:
+                    by_param[kwarg.arg] = dflt
+            flagged = set()
+            for sname in static_names:
+                if isinstance(sname, str):
+                    flagged.add(sname)
+            for snum in static_nums:
+                if isinstance(snum, int) and 0 <= snum < len(params):
+                    flagged.add(params[snum])
+            for pname in sorted(flagged):
+                dflt = by_param.get(pname)
+                if isinstance(dflt, (ast.List, ast.Dict, ast.Set)) or (
+                        isinstance(dflt, ast.Call)
+                        and call_name(dflt) in ("list", "dict", "set")):
+                    out.append(self.violation(
+                        src, node,
+                        f"static arg {pname!r} of {fn.name}() defaults "
+                        "to a mutable (unhashable) object; static args "
+                        "are compile-cache keys — use a hashable "
+                        "(tuple/frozen) value or a retrace per call is "
+                        "the best case"))
+        return out
+
+
+def _const_list(node: ast.AST) -> List[Optional[object]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [getattr(e, "value", None) for e in node.elts]
+    if isinstance(node, ast.Constant):
+        return [node.value]
+    return []

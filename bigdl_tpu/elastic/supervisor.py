@@ -29,7 +29,7 @@ Every transition lands in the Recorder as ``elastic/*`` counters and
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, Optional
+from typing import Any, Callable, Dict, Optional
 
 from .plan import _prod, plan_devices, plan_mesh
 
@@ -155,7 +155,7 @@ class ElasticSupervisor:
                 self._preemption = PreemptionHandler()
             self._preemption.install()
         handler = self._preemption
-        losses: Dict[int, float] = {}
+        losses: Dict[int, Any] = {}     # device scalars until segment drain
         prev_axes = None
         first_step = None
         try:
@@ -211,14 +211,29 @@ class ElasticSupervisor:
                                 outcome = "replan"
                                 break
                         tokens, targets = batch_fn(s)
-                        losses[s] = float(trainer.step(tokens, targets))
+                        # device scalar, no float(): a per-step host
+                        # sync would serialize dispatch against
+                        # execution (GL002) — the floats are only
+                        # needed at segment boundaries, and the bulk
+                        # sync below runs before the mesh is torn down
+                        losses[s] = trainer.step(tokens, targets)
                         rec.gauge("elastic/steps_done", s + 1)
                         if (self.ckpt_every
                                 and (s + 1) % self.ckpt_every == 0
                                 and s + 1 < steps):
                             trainer.save_checkpoint(self.ckpt_dir)
+                    # one bulk device→host sync per SEGMENT (GL002):
+                    # the scalars must materialize before this mesh is
+                    # torn down — and inside the try, so a device lost
+                    # mid-drain is retried/replanned like any other
+                    # segment failure, not a supervisor death
+                    self._drain_losses(losses, strict=True)
                 except Exception as e:      # noqa: BLE001 — retried
                     outcome, fail = "failed", e
+                    # best effort on the failure path: keep what still
+                    # materializes, drop dead-mesh scalars (the resume
+                    # recomputes everything past the last checkpoint)
+                    self._drain_losses(losses, strict=False)
                 self._set_state("draining")
                 if outcome == "failed":
                     self._teardown(self.trainer)
@@ -252,12 +267,33 @@ class ElasticSupervisor:
                 if outcome == "replan":
                     continue
                 self._set_state("idle")
+                # `in losses`: a failed segment may have dropped dead-
+                # mesh scalars that no later resume recomputed (steps
+                # before its own mid-segment checkpoint)
                 return [losses[s]
-                        for s in range(first_step, max(losses) + 1)] \
+                        for s in range(first_step, max(losses) + 1)
+                        if s in losses] \
                     if losses else []
         finally:
             if self.handle_sigterm and handler is not None:
                 handler.uninstall()
+
+    @staticmethod
+    def _drain_losses(losses: Dict[int, Any], strict: bool):
+        """Materialize the segment's device scalars to floats in place.
+        ``strict=False`` (the segment-failure path) drops entries whose
+        buffers died with the mesh instead of raising — those steps are
+        recomputed past the restored checkpoint anyway."""
+        for k, v in list(losses.items()):
+            if isinstance(v, float):
+                continue
+            try:
+                losses[k] = float(v)
+            except Exception:
+                if strict:
+                    raise
+                losses.pop(k)
+        return losses
 
     def _backoff(self, what: str, exc: Exception = None) -> bool:
         """Count a failure; sleep exponentially; False when retries are

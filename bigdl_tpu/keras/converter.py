@@ -1034,8 +1034,10 @@ def _load_layer_weights(klayer, ws, params, state, schema="k1"):
         gamma, beta, mean, var = ws
         _set(params, bn, weight=gamma, bias=beta)
         import jax.numpy as jnp
-        state[bn.name] = {"running_mean": jnp.asarray(mean),
-                          "running_var": jnp.asarray(var)}
+        # owning copies (GL001): asarray could zero-copy adopt the h5
+        # buffers, and BN state is donated by the train step
+        state[bn.name] = {"running_mean": jnp.array(mean, copy=True),
+                          "running_var": jnp.array(var, copy=True)}
         return
     raise KerasConversionError(
         f"no weight adapter for layer {type(klayer).__name__}")

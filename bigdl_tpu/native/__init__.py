@@ -16,13 +16,23 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_HERE, "libbigdl_tpu_rt.so")
+# BIGDL_NATIVE_LIB points the loader at an alternative build of the
+# runtime — the sanitizer-instrumented libraries (`make asan` /
+# `make ubsan`) in CI's native-sanitizers job, or a locally-patched
+# build.  When set, it is authoritative: no on-demand `make` of the
+# stock library, so a sanitizer run can never silently test the
+# uninstrumented build.
+_LIB_ENV = "BIGDL_NATIVE_LIB"
+_LIB_OVERRIDE = os.environ.get(_LIB_ENV) or None
+_LIB_PATH = _LIB_OVERRIDE or os.path.join(_HERE, "libbigdl_tpu_rt.so")
 _lib = None
 _lib_lock = threading.Lock()
 
 
 def build(force: bool = False) -> bool:
     """Compile the native library in place. Returns True on success."""
+    if _LIB_OVERRIDE is not None:
+        return os.path.exists(_LIB_PATH)
     if os.path.exists(_LIB_PATH) and not force:
         return True
     try:
@@ -40,10 +50,17 @@ def load() -> Optional[ctypes.CDLL]:
         if _lib is not None:
             return _lib
         if not os.path.exists(_LIB_PATH) and not build():
+            if _LIB_OVERRIDE is not None:
+                raise FileNotFoundError(
+                    f"{_LIB_ENV}={_LIB_PATH} does not exist — refusing "
+                    "the silent fallback (a sanitizer run against the "
+                    "wrong library proves nothing)")
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
         except OSError:
+            if _LIB_OVERRIDE is not None:
+                raise
             return None
         lib.bigdl_crc32c.restype = ctypes.c_uint32
         lib.bigdl_crc32c.argtypes = [ctypes.c_char_p, ctypes.c_size_t,

@@ -772,8 +772,13 @@ class Module:
         from ..utils import serializer
         params, state = serializer.load_weights_file(path)
         params, state = migrate_legacy_names((params, state), self)
-        self._params = jax.tree_util.tree_map(jnp.asarray, params)
-        self._state = jax.tree_util.tree_map(jnp.asarray, state)
+        # jnp.array(copy=True), NOT jnp.asarray: asarray can zero-copy
+        # ADOPT an aligned np.load buffer, and a later donated train
+        # step would scribble over memory numpy still owns (GL001, the
+        # PR-3 restore corruption shape)
+        own = lambda v: jnp.array(v, copy=True)
+        self._params = jax.tree_util.tree_map(own, params)
+        self._state = jax.tree_util.tree_map(own, state)
         return self
 
     def __repr__(self):

@@ -133,7 +133,7 @@ class StallWatchdog:
     def _check_locked(self) -> bool:
         rec = self.recorder
         if not self._active or self._suspend:
-            self._clear_stall()
+            self._clear_stall_locked()
             return False
         age = rec.step_age()
         # time spent suspended is not loop inactivity: measure from the
@@ -164,10 +164,11 @@ class StallWatchdog:
                          f"({ev['skew']:.2f}x median)"
                          if "straggler" in ev else ""), flush=True)
         elif self._stalled:
-            self._clear_stall()
+            self._clear_stall_locked()
         return self._stalled
 
-    def _clear_stall(self):
+    def _clear_stall_locked(self):
+        # *_locked: every caller holds self._check_lock (GL003)
         if not self._stalled:
             return
         self._stalled = False
@@ -183,16 +184,28 @@ class StallWatchdog:
 
     # -- thread lifecycle --------------------------------------------------- #
     def start(self) -> "StallWatchdog":
-        self._active = True
-        if self._thread is None or not self._thread.is_alive():
-            self._stop.clear()
-            self._thread = threading.Thread(target=self._run, daemon=True,
-                                            name="health-watchdog")
-            self._thread.start()
+        # under the lock (GL003): _active and _thread are shared with
+        # stop() and the /healthz scrape path; starting the thread
+        # while holding it is safe — _run only needs the lock inside
+        # check_once, after its first poll sleep
+        with self._check_lock:
+            self._active = True
+            if self._thread is None or not self._thread.is_alive():
+                # a FRESH event per poller thread: reusing one event
+                # means a start() racing stop()'s join window could
+                # clear the flag before the old thread observed it —
+                # leaking a second poller forever.  Each thread only
+                # ever watches its own event
+                self._stop = threading.Event()
+                self._thread = threading.Thread(target=self._run,
+                                                args=(self._stop,),
+                                                daemon=True,
+                                                name="health-watchdog")
+                self._thread.start()
         return self
 
-    def _run(self):
-        while not self._stop.wait(self.poll_interval):
+    def _run(self, stop_ev: threading.Event):
+        while not stop_ev.wait(self.poll_interval):
             try:
                 self.check_once()
             except Exception as e:   # the watchdog must never die silently
@@ -202,11 +215,17 @@ class StallWatchdog:
         """Stop polling AND deactivate: a finished (or paused) loop is
         not a stalled one, so subsequent direct check_once calls — e.g.
         /healthz scrapes after training completed — report healthy."""
-        self._stop.set()
-        t = self._thread
-        if t is not None:
-            t.join(timeout=5.0)
+        with self._check_lock:
+            self._stop.set()        # the CURRENT thread's event
+            t = self._thread
             self._thread = None
+        if t is not None:
+            # join OUTSIDE the lock: the polling thread takes it in
+            # check_once, and joining while holding it would deadlock
+            t.join(timeout=5.0)
+            if t.is_alive():        # never silent: a leaked poller is
+                print("[health] watchdog thread did not stop within "
+                      "5s", flush=True)
         with self._check_lock:
             self._active = False
-            self._clear_stall()
+            self._clear_stall_locked()

@@ -688,8 +688,11 @@ class Optimizer:
         self.state.batch_in_epoch = meta.get("batch_in_epoch", 0)
         self._resume_skip = self.state.batch_in_epoch
         rng_saved = meta.get("rng")
+        # owning copy (GL001): jnp.asarray could zero-copy adopt the
+        # host buffer, and the step donates the rng key — same hazard
+        # the comment below fixes for the state leaves
         self._resume_rng = None if rng_saved is None else \
-            jnp.asarray(np.asarray(rng_saved, np.uint32))
+            jnp.array(np.asarray(rng_saved, np.uint32), copy=True)
         restored = migrate_legacy_names(state, self.model)
         # jnp.array(copy=True), NOT jnp.asarray: asarray can zero-copy an
         # ALIGNED numpy buffer (alignment of np.load output varies with

@@ -128,14 +128,30 @@ class ServingEngine:
         timelines).  ``port=0`` binds an ephemeral port (the returned
         server's ``.port``); ``shutdown()`` stops it."""
         from ..observability.http import IntrospectionServer
-        if self._http_server is not None:   # reconfigure: no leaked
-            self._http_server.stop()        # thread/socket on the old port
         trace_source = self.dump_chrome_trace \
             if self.trace_ring is not None else None
-        self._http_server = IntrospectionServer(
+        server = IntrospectionServer(
             self.recorder, port=port, host=host,
             trace_source=trace_source).start()
-        return self._http_server
+        # _http_server is shared with shutdown(): every read/write under
+        # self._lock (GL003), but stop() — which joins the serving
+        # thread — always runs outside it.  Last caller wins (the
+        # documented reconfigure semantics), shutdown wins terminally —
+        # and a raced caller gets an exception, never a dead server
+        # whose .port a scraper would be pointed at
+        while True:
+            with self._lock:
+                if self._closed:
+                    break
+                prev = self._http_server
+                if prev is None:
+                    self._http_server = server
+                    return server
+                self._http_server = None
+            prev.stop()     # reconfigure: no leaked thread/socket
+        server.stop()
+        raise EngineClosedError(
+            "engine shut down while serve_metrics was binding")
 
     def shutdown(self, drain: bool = True, timeout: Optional[float] = None):
         """Stop admissions, then either finish queued work (``drain=True``,
@@ -144,9 +160,9 @@ class ServingEngine:
             self._closed = True
             queues = dict(self._queues)
             threads = dict(self._threads)
-        if self._http_server is not None:
-            self._http_server.stop()
-            self._http_server = None
+            server, self._http_server = self._http_server, None
+        if server is not None:
+            server.stop()
         for q in queues.values():
             q.close()
         if not drain:

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""graftlint — the repo's invariant checker (rules GL001–GL005).
+
+Runs the AST rule suite from ``bigdl_tpu.analysis`` over the given
+paths, applies the committed suppression baseline, and exits non-zero
+on any NEW violation or any STALE baseline entry.
+
+    python scripts/graftlint.py bigdl_tpu/ scripts/ tests/
+    python scripts/graftlint.py bigdl_tpu/ --json       # machine output
+    python scripts/graftlint.py --list-rules
+    python scripts/graftlint.py bigdl_tpu/ --baseline none   # raw view
+
+Pure stdlib (ast only) — no jax/numpy needed, so the CI ``lint`` job
+runs on a bare python in seconds.  See docs/static_analysis.md for the
+rule catalog and the historical bug each rule encodes.
+
+Exit codes: 0 clean · 1 new violations / stale baseline · 2 usage.
+"""
+import argparse
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+# import `analysis` as a TOP-LEVEL package (bigdl_tpu/ on sys.path), not
+# as bigdl_tpu.analysis: the parent package's __init__ imports jax, and
+# this CLI must run on a bare python (the CI lint job installs nothing)
+sys.path.insert(0, os.path.join(_ROOT, "bigdl_tpu"))
+
+from analysis.baseline import (DEFAULT_BASELINE, Baseline,       # noqa: E402
+                               load_baseline, write_baseline)
+from analysis.engine import run_lint                             # noqa: E402
+from analysis.rules import ALL_RULES, RULES_BY_ID                # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint.py",
+        description="invariant checker: donation/aliasing, hot-path "
+                    "syncs, lock/signal discipline, span/counter "
+                    "pairing, recompile hazards")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: bigdl_tpu/)")
+    ap.add_argument("--baseline", default=None, metavar="PATH",
+                    help=f"suppression baseline (default: "
+                         f"{os.path.relpath(DEFAULT_BASELINE, _ROOT)}; "
+                         "'none' disables)")
+    ap.add_argument("--rules", default=None, metavar="GL001,GL003",
+                    help="comma-separated rule subset")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable JSON on stdout")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--write-baseline", metavar="PATH",
+                    help="dump current findings as a baseline skeleton "
+                         "(justifications must be filled in by hand)")
+    ap.add_argument("--allow-stale", action="store_true",
+                    help="do not fail on baseline entries that match "
+                         "nothing (local iteration only; CI never "
+                         "passes this)")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.id}  {r.title}"
+                  + ("  [library code only]" if r.library_only else ""))
+        return 0
+
+    paths = args.paths or [os.path.join(_ROOT, "bigdl_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"graftlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    rules = None
+    if args.rules:
+        want = [r.strip().upper() for r in args.rules.split(",") if r]
+        unknown = [w for w in want if w not in RULES_BY_ID]
+        if unknown:
+            print(f"graftlint: unknown rules {unknown}; have "
+                  f"{sorted(RULES_BY_ID)}", file=sys.stderr)
+            return 2
+        rules = [RULES_BY_ID[w] for w in want]
+
+    if args.baseline == "none":
+        baseline = Baseline([])
+    else:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"graftlint: bad baseline: {e}", file=sys.stderr)
+            return 2
+
+    result = run_lint(paths, rules=rules, baseline=baseline, root=_ROOT)
+    if args.allow_stale:
+        result.stale_entries = []
+
+    if args.write_baseline:
+        write_baseline(result.violations, args.write_baseline)
+        print(f"wrote {len(result.violations)} entries to "
+              f"{args.write_baseline} — fill in the justifications",
+              file=sys.stderr)
+
+    if args.json:
+        print(json.dumps(result.to_json(), indent=2))
+        return 0 if result.ok else 1
+
+    for v in result.violations:
+        print(v.render())
+        if v.snippet:
+            print(f"    {v.snippet}")
+    for e in result.stale_entries:
+        print(f"{e.file}: STALE baseline entry for {e.rule} "
+              f"({e.snippet!r}) — the finding is gone, remove the "
+              "suppression with it")
+    n, s, st = (len(result.violations), len(result.suppressed),
+                len(result.stale_entries))
+    print(f"graftlint: {result.files_checked} files, {n} new "
+          f"violation(s), {s} baselined, {st} stale baseline entr"
+          f"{'y' if st == 1 else 'ies'}")
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,27 @@
+"""Golden fixture: GL001 donation/aliasing — the PR-3 shapes.
+
+Never imported; parsed by test_graftlint.py.  Line numbers are asserted,
+so edits here must update the test's expectations.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def snapshot_for_writer(tree):
+    # EXACT PR-3 shape (1): zero-copy views handed to the async writer
+    return jax.tree_util.tree_map(np.asarray, tree)        # line 13
+
+
+def host_snapshot_leaf(v):
+    return np.asarray(v)                                   # line 17
+
+
+def restore_state(path):
+    blob = np.load(path)
+    # EXACT PR-3 shape (2): adopting an aligned host buffer on resume
+    return jnp.asarray(blob["params"])                     # line 23
+
+
+def load_weights(params):
+    return jax.tree_util.tree_map(jnp.asarray, params)     # line 27

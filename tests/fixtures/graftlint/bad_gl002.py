@@ -1,0 +1,20 @@
+"""Golden fixture: GL002 host syncs — in-jit and per-step-loop shapes."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def step(params, x):
+    y = (params * x).sum()
+    loss = float(y)                                        # line 10
+    host = np.asarray(y)                                   # line 11
+    return loss, host
+
+
+def train(trainer, batches):
+    losses = []
+    for i, batch in enumerate(batches):
+        out = trainer.step(batch)
+        losses.append(float(out))                          # line 19
+    return losses

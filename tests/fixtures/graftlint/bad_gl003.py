@@ -1,0 +1,36 @@
+"""Golden fixture: GL003 — mixed lock discipline and the PR-4
+unchained-SIGTERM shape."""
+import signal
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+        self._flag = False
+        self._mode = "idle"
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+            self._flag = True
+
+    def reset(self):
+        self._count = 0                                    # line 20
+        self._flag = False                                 # line 21
+
+    def set_mode(self, m):
+        self._mode = m                                     # line 24
+
+    def clear_mode(self):
+        self._mode = "idle"
+
+
+def install_handler():
+    def on_term(signum, frame):
+        raise SystemExit(0)
+
+    # EXACT PR-4 shape: installs over whatever was there — the
+    # preemption handler's final checkpoint never happens
+    signal.signal(signal.SIGTERM, on_term)                 # line 36

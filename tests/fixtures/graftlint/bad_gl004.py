@@ -1,0 +1,22 @@
+"""Golden fixture: GL004 — unpaired sessions/spans, undocumented
+counters.  The fixture test supplies a tmp docs/ tree declaring
+``serving.requests`` (and the ``elastic/*`` family) but NOT
+``serving.bogus_counter``."""
+import jax
+
+
+def capture(step, log_dir, rec):
+    jax.profiler.start_trace(log_dir)                      # line 9
+    run_step(step)
+    jax.profiler.stop_trace()      # not finally-guarded: PR-5 shape
+
+
+def admit(tr, rec):
+    tr.open("queue", 0.0)                                  # line 15
+    rec.inc("serving.requests")
+    rec.inc("serving.bogus_counter")                       # line 17
+    rec.inc("elastic/shrinks")
+
+
+def run_step(step):
+    return step

@@ -1,0 +1,27 @@
+"""Golden fixture: GL005 — clocks/RNG under tracing, mutable static
+defaults."""
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def noisy_step(params, x):
+    t0 = time.time()                                       # line 11
+    noise = np.random.normal(size=x.shape)                 # line 12
+    return params * x + noise, t0
+
+
+def scaled(x, cfg={"gain": 2.0}):
+    return x * cfg["gain"]
+
+
+scaled_jit = jax.jit(scaled, static_argnames=("cfg",))     # line 20
+
+
+def scaled_kw(x, *, cfg={"gain": 2.0}):
+    return x * cfg["gain"]
+
+
+scaled_kw_jit = jax.jit(scaled_kw, static_argnames=("cfg",))   # line 27

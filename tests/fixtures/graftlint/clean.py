@@ -1,0 +1,68 @@
+"""Golden NEGATIVE fixture: the owning/paired/chained spellings of every
+bad-fixture shape.  graftlint must report nothing here."""
+import signal
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def snapshot_for_writer(tree):
+    return jax.tree_util.tree_map(np.array, tree)       # owning copies
+
+
+def restore_state(blob):
+    # owning adoption: the donated step cannot scribble numpy memory
+    return jax.tree_util.tree_map(lambda v: jnp.array(v, copy=True),
+                                  blob)
+
+
+@jax.jit
+def step(params, x):
+    return (params * x).sum()        # device scalar stays on device
+
+
+def train(trainer, batches):
+    losses = [trainer.step(b) for b in batches]
+    return [float(l) for l in losses]      # one sync, after the loop
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def bump(self):
+        with self._lock:
+            self._count += 1
+
+    def reset(self):
+        with self._lock:
+            self._count = 0
+
+
+def install_handler():
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def on_term(signum, frame):
+        if callable(prev):
+            prev(signum, frame)      # chained: PR-4 discipline
+
+    signal.signal(signal.SIGTERM, on_term)
+
+
+def capture(step_i, log_dir):
+    jax.profiler.start_trace(log_dir)
+    try:
+        return step_i
+    finally:
+        jax.profiler.stop_trace()
+
+
+def admit(tr, rec):
+    tr.open("queue", 0.0)
+    try:
+        rec.inc("serving.requests")
+    finally:
+        tr.close("queue", 1.0)

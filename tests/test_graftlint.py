@@ -1,0 +1,253 @@
+"""graftlint (ISSUE 7 tentpole): golden-fixture positives for all five
+rule families (including the exact PR-3 aliasing and PR-4
+unchained-SIGTERM shapes), clean-fixture negatives, baseline mechanics
+(suppression, staleness, justification discipline), and the repo gate —
+the committed tree lints clean against the committed baseline, and every
+baseline entry is live."""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from bigdl_tpu.analysis import (Baseline, load_baseline, run_lint)
+from bigdl_tpu.analysis.baseline import BaselineEntry
+from bigdl_tpu.analysis.rules import RULES_BY_ID
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "graftlint")
+
+
+def lint_fixtures(tmp_path, files=None):
+    """Copy the golden fixtures (tests/ in their real location would
+    demote them to non-library scoping) plus a docs tree declaring
+    `serving.requests` and the `elastic/*` family, then lint."""
+    root = tmp_path / "proj"
+    (root / "docs").mkdir(parents=True)
+    (root / "docs" / "metrics.md").write_text(
+        "counters: `serving.requests`, the `elastic/*` family\n")
+    for f in files or os.listdir(FIXTURES):
+        if f.endswith(".py"):
+            shutil.copy(os.path.join(FIXTURES, f), root / f)
+    return run_lint([str(root)], root=str(root))
+
+
+def found(result, fname):
+    return {(v.rule, v.line) for v in result.violations
+            if v.file == fname}
+
+
+# --------------------------------------------------------------------- #
+# golden fixtures: one per family, exact rule/file/line                 #
+# --------------------------------------------------------------------- #
+def test_gl001_donation_fixture(tmp_path):
+    res = lint_fixtures(tmp_path, ["bad_gl001.py"])
+    assert found(res, "bad_gl001.py") == {
+        ("GL001", 13),   # tree_map(np.asarray, ...) — PR-3 shape (1)
+        ("GL001", 17),   # np.asarray on a snapshot path
+        ("GL001", 23),   # jnp.asarray on restore — PR-3 shape (2)
+        ("GL001", 27),   # tree_map(jnp.asarray) on a load path
+    }
+
+
+def test_gl002_host_sync_fixture(tmp_path):
+    res = lint_fixtures(tmp_path, ["bad_gl002.py"])
+    assert found(res, "bad_gl002.py") == {
+        ("GL002", 10),   # float() under tracing
+        ("GL002", 11),   # np.asarray under tracing
+        ("GL002", 19),   # per-step float() in a step loop
+    }
+
+
+def test_gl003_locks_fixture(tmp_path):
+    res = lint_fixtures(tmp_path, ["bad_gl003.py"])
+    assert found(res, "bad_gl003.py") == {
+        ("GL003", 20),   # _count written without the lock
+        ("GL003", 21),   # _flag written without the lock
+        ("GL003", 24),   # _mode: never guarded, multiple writers
+        ("GL003", 36),   # unchained SIGTERM install — PR-4 shape
+    }
+
+
+def test_gl004_spans_fixture(tmp_path):
+    res = lint_fixtures(tmp_path, ["bad_gl004.py"])
+    assert found(res, "bad_gl004.py") == {
+        ("GL004", 9),    # start_trace without finally stop — PR-5 shape
+        ("GL004", 15),   # span opened, file never closes
+        ("GL004", 17),   # undocumented counter (declared ones pass)
+    }
+
+
+def test_gl005_recompile_fixture(tmp_path):
+    res = lint_fixtures(tmp_path, ["bad_gl005.py"])
+    assert found(res, "bad_gl005.py") == {
+        ("GL005", 11),   # time.time() under tracing
+        ("GL005", 12),   # np.random under tracing
+        ("GL005", 20),   # mutable default behind static_argnames
+        ("GL005", 27),   # same, keyword-only spelling (`*, cfg={}`)
+    }
+
+
+def test_clean_fixture_is_clean(tmp_path):
+    res = lint_fixtures(tmp_path, ["clean.py"])
+    assert res.violations == [] and res.files_checked == 1
+
+
+def test_inline_suppression(tmp_path):
+    root = tmp_path / "proj"
+    root.mkdir()
+    (root / "mod.py").write_text(
+        "import jax\nimport numpy as np\n\n\n"
+        "def snapshot(tree):\n"
+        "    # graftlint: disable=GL001 — test opt-out\n"
+        "    return jax.tree_util.tree_map(np.asarray, tree)\n")
+    res = run_lint([str(root)], root=str(root))
+    assert res.violations == []
+
+
+# --------------------------------------------------------------------- #
+# baseline mechanics                                                    #
+# --------------------------------------------------------------------- #
+def test_baseline_suppresses_and_goes_stale(tmp_path):
+    res = lint_fixtures(tmp_path, ["bad_gl001.py"])
+    v = next(x for x in res.violations if x.line == 13)
+    entry = BaselineEntry(rule=v.rule, file=v.file, snippet=v.snippet,
+                          justification="fixture")
+    stale = BaselineEntry(rule="GL001", file="gone.py",
+                          snippet="x = 1", justification="fixture")
+    root = tmp_path / "proj"
+    res2 = run_lint([str(root)], root=str(root),
+                    baseline=Baseline([entry, stale]))
+    assert (v.rule, v.line) not in found(res2, "bad_gl001.py")
+    assert len(res2.suppressed) == 1
+    # the stale entry keeps the run failing: fixed bugs must take their
+    # suppression with them
+    assert [e.file for e in res2.stale_entries] == ["gone.py"]
+    assert not res2.ok
+
+
+def test_baseline_requires_justification(tmp_path):
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps({"entries": [
+        {"rule": "GL001", "file": "a.py", "snippet": "x",
+         "justification": "  "}]}))
+    with pytest.raises(ValueError, match="justification"):
+        load_baseline(str(p))
+    p.write_text(json.dumps({"entries": [
+        {"rule": "GL001", "file": "a.py", "snippet": "x"}]}))
+    with pytest.raises(ValueError, match="missing"):
+        load_baseline(str(p))
+
+
+def test_unparseable_file_is_a_finding(tmp_path):
+    root = tmp_path / "proj"
+    root.mkdir()
+    (root / "broken.py").write_text("def oops(:\n")
+    res = run_lint([str(root)], root=str(root))
+    assert [v.rule for v in res.violations] == ["GL000"]
+
+
+def test_gl000_honours_baseline_and_inline_suppression(tmp_path):
+    """An unparseable-but-known file (vendored, templated) must be
+    suppressible like any other finding — not a permanent red."""
+    root = tmp_path / "proj"
+    root.mkdir()
+    (root / "broken.py").write_text("def oops(:\n")
+    raw = run_lint([str(root)], root=str(root))
+    v = raw.violations[0]
+    entry = BaselineEntry(rule="GL000", file=v.file, snippet=v.snippet,
+                          justification="vendored template")
+    res = run_lint([str(root)], root=str(root),
+                   baseline=Baseline([entry]))
+    assert res.violations == [] and len(res.suppressed) == 1
+    (root / "broken.py").write_text(
+        "# graftlint: disable=GL000 — template\ndef oops(:\n")
+    res2 = run_lint([str(root)], root=str(root))
+    assert res2.violations == []
+
+
+def test_stale_check_scoped_to_run(tmp_path):
+    """A --rules or single-directory run must not report entries it
+    never looked at as stale (reported-then-deleted entries would break
+    the full CI run)."""
+    root = tmp_path / "proj"
+    (root / "pkg").mkdir(parents=True)
+    (root / "pkg" / "mod.py").write_text("x = 1\n")
+    out_of_rule = BaselineEntry(rule="GL001", file="pkg/mod.py",
+                                snippet="gone", justification="j")
+    out_of_path = BaselineEntry(rule="GL003", file="other/mod.py",
+                                snippet="gone", justification="j")
+    res = run_lint([str(root / "pkg")], root=str(root),
+                   rules=[RULES_BY_ID["GL003"]],
+                   baseline=Baseline([out_of_rule, out_of_path]))
+    assert res.stale_entries == [] and res.ok
+    # the full-scope equivalent still reports both as stale
+    res2 = run_lint([str(root)], root=str(root),
+                    baseline=Baseline([out_of_rule, out_of_path]))
+    assert len(res2.stale_entries) == 2 and not res2.ok
+
+
+# --------------------------------------------------------------------- #
+# the repo gate (the CI `lint` job's contract)                          #
+# --------------------------------------------------------------------- #
+def test_repo_lints_clean_against_committed_baseline():
+    res = run_lint([os.path.join(REPO, "bigdl_tpu"),
+                    os.path.join(REPO, "scripts"),
+                    os.path.join(REPO, "tests")],
+                   baseline=load_baseline(), root=REPO)
+    assert res.stale_entries == [], \
+        f"stale baseline entries: {res.stale_entries}"
+    assert res.violations == [], \
+        "new violations:\n" + "\n".join(v.render()
+                                        for v in res.violations)
+
+
+def test_every_baseline_entry_is_live():
+    """Removing any single baseline entry must make the lint fail: each
+    entry matches at least one real finding in today's tree (the ledger
+    cannot rot)."""
+    baseline = load_baseline()
+    assert baseline.entries, "committed baseline unexpectedly empty"
+    raw = run_lint([os.path.join(REPO, "bigdl_tpu"),
+                    os.path.join(REPO, "scripts"),
+                    os.path.join(REPO, "tests")],
+                   baseline=Baseline([]), root=REPO)
+    live = {v.key() for v in raw.violations}
+    for e in baseline.entries:
+        assert e.key() in live, \
+            f"baseline entry matches nothing (stale): {e}"
+
+
+def test_rule_registry_complete():
+    assert sorted(RULES_BY_ID) == ["GL001", "GL002", "GL003", "GL004",
+                                   "GL005"]
+
+
+# --------------------------------------------------------------------- #
+# CLI                                                                   #
+# --------------------------------------------------------------------- #
+def test_cli_json_output_machine_readable():
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftlint.py"),
+         os.path.join(REPO, "bigdl_tpu"), "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["ok"] is True
+    assert payload["violations"] == []
+    assert all(e["justification"] for e in payload["suppressed"])
+
+
+def test_cli_rule_subset_and_bad_rule():
+    ok = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftlint.py"),
+         os.path.join(REPO, "bigdl_tpu", "analysis"), "--rules", "GL005",
+         "--baseline", "none"],
+        capture_output=True, text=True, timeout=120)
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    bad = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftlint.py"),
+         "--rules", "GL999"], capture_output=True, text=True, timeout=120)
+    assert bad.returncode == 2

@@ -1,0 +1,262 @@
+"""racecheck harness (ISSUE 7): lock-order inversion detection on a
+deliberately-inverted order, bare-shared-write detection (including the
+pre-fix ServingEngine._http_server shape), instrumented-lock semantics,
+and the ServingEngine shutdown-vs-submit-vs-/metrics stress test driven
+through the harness."""
+import threading
+import time
+import urllib.request
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bigdl_tpu.analysis.racecheck import (CheckedLock, RaceCheck,
+                                          guard_fields, wrap_lock)
+from bigdl_tpu.nn.module import Module
+from bigdl_tpu.serving import ModelRegistry, ServingEngine
+
+
+# --------------------------------------------------------------------- #
+# harness unit tests                                                    #
+# --------------------------------------------------------------------- #
+def test_flags_deliberately_inverted_lock_order():
+    rc = RaceCheck()
+    a = CheckedLock("A", rc)
+    b = CheckedLock("B", rc)
+    # inversion detection needs only the ORDERS to occur, not an actual
+    # deadlock — sequential nesting is enough and deterministic
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    inv = rc.inversions()
+    assert len(inv) == 1
+    assert inv[0].cycle == ["A", "B"]
+    assert {(a, b) for a, b, _ in inv[0].edges} == {("A", "B"),
+                                                    ("B", "A")}
+    with pytest.raises(AssertionError, match="lock-order inversion"):
+        rc.assert_clean()
+
+
+def test_flags_inversion_through_an_intermediate_lock():
+    """Holding A through B while taking C is still an A-before-C
+    ordering: A→B→C nesting vs C→A must be a cycle finding."""
+    rc = RaceCheck()
+    a, b, c = (CheckedLock(n, rc) for n in "ABC")
+    with a:
+        with b:
+            with c:
+                pass
+    with c:
+        with a:
+            pass
+    inv = rc.inversions()
+    # one entangled component: C→A closes a ring through A→B→C too,
+    # so all three locks are in cyclic order — the pre-fix harness
+    # (innermost-edge only) saw no cycle at all here
+    assert len(inv) == 1 and inv[0].cycle == ["A", "B", "C"]
+    assert ("C", "A") in {(x, y) for x, y, _ in inv[0].edges}
+
+
+def test_flags_three_thread_cycle():
+    """A→B, B→C, C→A observed on three different threads: no pairwise
+    reversal anywhere, but the ring deadlocks — must be one 3-cycle."""
+    rc = RaceCheck()
+    a, b, c = (CheckedLock(n, rc) for n in "ABC")
+
+    def nest(outer, inner):
+        with outer:
+            with inner:
+                pass
+
+    for pair in ((a, b), (b, c), (c, a)):
+        t = threading.Thread(target=nest, args=pair)
+        t.start()
+        t.join()
+    inv = rc.inversions()
+    assert len(inv) == 1 and inv[0].cycle == ["A", "B", "C"]
+
+
+def test_same_name_locks_self_edge_is_flagged():
+    """Two hand-built locks sharing one name nested in both orders
+    collapse to a self-edge — still an inversion, never a pass."""
+    rc = RaceCheck()
+    a1 = CheckedLock("L", rc)
+    a2 = CheckedLock("L", rc)
+    with a1:
+        with a2:
+            pass
+    with a2:
+        with a1:
+            pass
+    inv = rc.inversions()
+    assert len(inv) == 1 and inv[0].cycle == ["L"]
+
+
+def test_wrap_lock_disambiguates_same_class_instances():
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+    rc = RaceCheck()
+    b1, b2 = Box(), Box()
+    l1 = wrap_lock(b1, "_lock", rc)
+    l2 = wrap_lock(b2, "_lock", rc)
+    assert l1.name != l2.name       # distinct graph nodes
+    with b1._lock:
+        with b2._lock:
+            pass
+    with b2._lock:
+        with b1._lock:
+            pass
+    inv = rc.inversions()
+    assert len(inv) == 1 and set(inv[0].cycle) == {l1.name, l2.name}
+
+
+def test_consistent_order_is_clean():
+    rc = RaceCheck()
+    a = CheckedLock("A", rc)
+    b = CheckedLock("B", rc)
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert rc.inversions() == []
+    rc.assert_clean()
+
+
+def test_rlock_reentry_adds_no_self_edge():
+    rc = RaceCheck()
+    a = CheckedLock("A", rc, rlock=True)
+    with a:
+        with a:         # re-entrant re-acquire must not edge A -> A
+            pass
+    assert rc.inversions() == []
+
+
+def test_checked_lock_still_mutually_excludes():
+    rc = RaceCheck()
+    lock = CheckedLock("L", rc)
+    state = {"n": 0}
+
+    def bump():
+        for _ in range(2000):
+            with lock:
+                state["n"] += 1
+
+    ts = [threading.Thread(target=bump) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    assert state["n"] == 8000
+
+
+def test_bare_write_detection():
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._value = 0
+
+    rc = RaceCheck()
+    box = Box()
+    wrap_lock(box, "_lock", rc)
+    guard_fields(box, "_lock", ["_value"], rc)
+    with box._lock:
+        box._value = 1          # guarded: fine
+    assert rc.bare_writes == []
+    box._value = 2              # bare: flagged
+    assert len(rc.bare_writes) == 1
+    assert rc.bare_writes[0].attr == "_value"
+    with pytest.raises(AssertionError, match="bare shared-state write"):
+        rc.assert_clean()
+
+
+def test_guard_fields_requires_wrapped_lock():
+    class Box:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+    with pytest.raises(TypeError, match="wrap_lock"):
+        guard_fields(Box(), "_lock", ["_x"], RaceCheck())
+
+
+# --------------------------------------------------------------------- #
+# the ServingEngine scenario                                            #
+# --------------------------------------------------------------------- #
+class Scale(Module):
+    def init(self, rng):
+        return {self.name: {"weight": jnp.ones(())}}
+
+    def apply(self, params, x, ctx):
+        return x * params[self.name]["weight"]
+
+
+def make_engine(**kw):
+    reg = ModelRegistry()
+    reg.register("m", Scale(), input_shape=(4,))
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("max_delay_ms", 1.0)
+    kw.setdefault("max_queue_rows", 64)
+    return reg, ServingEngine(reg, **kw)
+
+
+def test_harness_catches_the_prefix_http_server_shape():
+    """Regression guard for the GL003/racecheck satellite fix: an
+    UNGUARDED _http_server write (what serve_metrics/shutdown did before
+    this PR) must surface as a bare write."""
+    _, eng = make_engine()
+    rc = RaceCheck()
+    wrap_lock(eng, "_lock", rc)
+    guard_fields(eng, "_lock", ["_closed", "_http_server"], rc)
+    eng._http_server = None     # the pre-fix write pattern
+    assert [w.attr for w in rc.bare_writes] == ["_http_server"]
+    eng.shutdown(drain=False)
+
+
+def test_engine_shutdown_stress_under_racecheck():
+    """shutdown() racing concurrent submit() and a live /metrics scrape:
+    no lock-order inversion between the engine and recorder locks, and
+    every _closed/_http_server write holds the engine lock."""
+    _, eng = make_engine()
+    rc = RaceCheck()
+    wrap_lock(eng, "_lock", rc)
+    wrap_lock(eng.recorder, "_lock", rc, name="Recorder._lock")
+    guard_fields(eng, "_lock", ["_closed", "_http_server"], rc)
+    eng.warmup()
+    server = eng.serve_metrics(port=0)
+    url = f"http://127.0.0.1:{server.port}/metrics"
+    stop = threading.Event()
+    errors = []
+
+    def submitter():
+        x = np.ones((4,), np.float32)
+        while not stop.is_set():
+            try:
+                eng.submit("m", x).result(timeout=5.0)
+            except Exception as e:      # shedding/closing is expected
+                if type(e).__name__ not in ("LoadShedError",
+                                            "EngineClosedError"):
+                    errors.append(e)
+                if "EngineClosed" in type(e).__name__:
+                    return
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                urllib.request.urlopen(url, timeout=2.0).read()
+            except Exception:
+                return      # server stopped by shutdown: done
+
+    threads = [threading.Thread(target=submitter) for _ in range(4)] \
+        + [threading.Thread(target=scraper)]
+    [t.start() for t in threads]
+    time.sleep(0.5)
+    eng.shutdown(drain=True, timeout=10.0)      # races the loops
+    stop.set()
+    [t.join(timeout=10.0) for t in threads]
+    assert not any(t.is_alive() for t in threads)
+    assert errors == []
+    rc.assert_clean()
+    assert eng.recorder.counter_value("serving.requests") > 0
