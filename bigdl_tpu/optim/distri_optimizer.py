@@ -42,6 +42,9 @@ from ..parallel import mesh as mesh_lib
 from ..parallel.allreduce import (allreduce_gradients,
                                   reduce_scatter_gradients, allgather_params,
                                   shardable_mask_dim0)
+from ..parallel.bucketer import GradBucketer
+from ..parallel.zero import Zero1Layout, Zero1Optim
+from .optim_method import LAMB, LARS
 from .optimizer import (Optimizer, _mb_to_arrays, _ClippedOptim,
                         health_scalars, make_accum_grads,
                         mask_frozen_grads)
@@ -81,14 +84,45 @@ def fsdp_opt_state_specs(params_template, shardable, optim):
 class DistriOptimizer(Optimizer):
     def __init__(self, model, training_set, criterion, batch_size=None,
                  mesh: Optional[Mesh] = None, compress: Optional[str] = None,
-                 fsdp: bool = False, seed: int = 0):
+                 fsdp: bool = False, seed: int = 0, zero1: bool = False,
+                 bucket_bytes: Optional[int] = None,
+                 fused_optim: bool = False):
+        """Step-time knobs beyond the reference surface (all default-off;
+        the plain replicated dp step stays the default until a config's
+        parity suite passes — see docs/performance.md):
+
+        ``zero1``        ZeRO-1 sharded weight update: reduce-scatter
+                         grads, update only this replica's 1/N shard of
+                         params + optimizer state (moments live sharded,
+                         1/N memory), all-gather the updated params.
+                         Elementwise optimizers only; mutually exclusive
+                         with ``fsdp``.
+        ``bucket_bytes`` exchange gradients in flat buckets of this many
+                         bytes (per-bucket collectives the async
+                         scheduler overlaps with the tail of backward)
+                         instead of one monolithic all-reduce; with
+                         ``zero1`` it sizes the flat buckets of the
+                         non-dim0-shardable leaves.
+        ``fused_optim``  route the update through the single-pass Pallas
+                         kernels (``bigdl_tpu.kernels``) when the
+                         OptimMethod supports ``fused`` (SGD/Adam/AdamW).
+        """
         super().__init__(model, training_set, criterion,
                          batch_size=batch_size, seed=seed)
         self.mesh = mesh or mesh_lib.get_mesh()
         if "dp" not in self.mesh.axis_names:
             raise ValueError("DistriOptimizer mesh needs a 'dp' axis")
+        if zero1 and fsdp:
+            raise ValueError(
+                "zero1 and fsdp are mutually exclusive: fsdp already "
+                "shards params AND optimizer state (ZeRO-3); zero1 "
+                "shards only the update/optimizer state")
         self.compress = compress
         self.fsdp = fsdp
+        self.zero1 = bool(zero1)
+        self.bucket_bytes = bucket_bytes
+        self.fused_optim = bool(fused_optim)
+        self._z1: Optional[Zero1Layout] = None
 
     # ------------------------------------------------------------------ #
     def _build_step(self, params_template, optim, telemetry=False):
@@ -122,13 +156,28 @@ class DistriOptimizer(Optimizer):
         # n times then divided by n, i.e. added once)
         local_grads = make_accum_grads(local_loss, n_accum)
 
+        if self.zero1:
+            return self._build_step_zero1(params_template, optim,
+                                          local_grads, telemetry)
+
         if not self.fsdp:
+            bucketer = GradBucketer(params_template,
+                                    bucket_bytes=self.bucket_bytes) \
+                if self.bucket_bytes else None
+
             def step(params, opt_state, model_state, x, y, rng):
                 rng = jax.random.fold_in(rng, lax.axis_index("dp"))
                 (loss, upd), grads = local_grads(params, model_state,
                                                  x, y, rng)
                 grads = mask_frozen_grads(model, grads)
-                grads = allreduce_gradients(grads, "dp", compress=compress)
+                if bucketer is not None:
+                    # per-bucket collectives: XLA's async scheduler can
+                    # start each bucket's exchange before backward ends
+                    grads = bucketer.allreduce(grads, "dp",
+                                               compress=compress)
+                else:
+                    grads = allreduce_gradients(grads, "dp",
+                                                compress=compress)
                 new_params, new_opt = optim.update(grads, params, opt_state)
                 merged = dict(model_state)
                 merged.update(upd)
@@ -178,6 +227,54 @@ class DistriOptimizer(Optimizer):
             shard_map(step, self.mesh, specs_in, specs_out),
             donate_argnums=(0, 1, 2)), shardable
 
+    # ---- ZeRO-1: replicated params, sharded update + optimizer state -- #
+    def _build_step_zero1(self, params_template, optim, local_grads,
+                          telemetry):
+        """One shard_map'ped step: local fwd/bwd on REPLICATED params ->
+        reduce-scatter grads into shard space -> each replica updates
+        only its 1/N param shard with its 1/N optimizer-state shard ->
+        all-gather the updated params (arXiv:2004.13336).  Collective
+        volume equals the all-reduce (S·(n−1)/n each way); update FLOPs
+        and optimizer-state memory drop to 1/N."""
+        model = self.model
+        compress = self.compress
+        z1 = self._z1
+
+        def step(params, opt_state, model_state, x, y, rng):
+            rng = jax.random.fold_in(rng, lax.axis_index("dp"))
+            (loss, upd), grads = local_grads(params, model_state, x, y, rng)
+            grads = mask_frozen_grads(model, grads)
+            idx = lax.axis_index("dp")
+            g_sh = z1.scatter_grads(grads, "dp", compress=compress)
+            p_sh = z1.local_shard(params, idx)
+            new_p_sh, new_opt = optim.update(g_sh, p_sh, opt_state)
+            new_params = z1.gather_params(new_p_sh, "dp")
+            merged = dict(model_state)
+            merged.update(upd)
+            merged = lax.pmean(merged, "dp")
+            out = (new_params, new_opt, merged, lax.pmean(loss, "dp"))
+            if telemetry:
+                # every shard-space leaf holds 1/N of a global tensor:
+                # psum the shard norms so all replicas see global values
+                mask_sh = jax.tree_util.tree_map(lambda _: True, g_sh)
+                out += (health_scalars(g_sh, p_sh, new_p_sh,
+                                       axis_name="dp",
+                                       sharded_mask=mask_sh),)
+            return out
+
+        # optimizer state mirrors the shard space; derive its P("dp")
+        # specs by tree-path correspondence against the global shard
+        # space (every entry dim-0-sharded, scalars replicated)
+        sst = jax.eval_shape(z1.global_shard_space, params_template)
+        all_sharded = jax.tree_util.tree_map(lambda _: True, sst)
+        o_specs = fsdp_opt_state_specs(sst, all_sharded, optim.inner)
+        specs_in = (P(), o_specs, P(), P("dp"), P("dp"), P())
+        specs_out = (P(), o_specs, P(), P()) \
+            + ((P(),) if telemetry else ())
+        return jax.jit(
+            shard_map(step, self.mesh, specs_in, specs_out),
+            donate_argnums=(0, 1, 2)), None
+
     def _shard_params_host(self, params, shardable):
         """Slice host params to this shard layout for FSDP init (global view:
         jit handles placement; we just reshape logically sharded leaves)."""
@@ -187,6 +284,24 @@ class DistriOptimizer(Optimizer):
     # -- hook overrides: the epoch loop itself lives in Optimizer -------- #
     def _wrap_optim(self, params):
         optim = self.optim_method
+        if self.fused_optim:
+            if not hasattr(optim, "fused"):
+                raise ValueError(
+                    f"fused_optim=True: {type(optim).__name__} has no "
+                    "fused kernel (supported: SGD, Adam, AdamW)")
+            # shallow copy, never mutate the user's instance: the same
+            # OptimMethod reused in another optimizer WITHOUT the flag
+            # must keep the default (unfused) path
+            import copy
+            optim = copy.copy(optim)
+            optim.fused = True
+        if self.zero1 and isinstance(optim, (LARS, LAMB)):
+            raise ValueError(
+                f"zero1 cannot shard {type(optim).__name__}: its "
+                "per-TENSOR trust ratios need whole-tensor norms, and a "
+                "dim-0 shard's norm is not the tensor's norm.  Use fsdp "
+                "(whole tensors stay visible to the update) or an "
+                "elementwise optimizer (SGD/Adam/AdamW/...)")
         if self._grad_clip_norm or self._grad_clip_const:
             if self.fsdp:
                 # gradients inside shard_map are dim-0 shards: the L2 norm
@@ -196,9 +311,18 @@ class DistriOptimizer(Optimizer):
                 optim = _ClippedOptim(optim, self._grad_clip_norm,
                                       self._grad_clip_const, sum_axis="dp",
                                       sharded_mask=mask)
+            elif self.zero1:
+                # EVERY shard-space leaf holds 1/N of a global tensor:
+                # psum of all shard sums-of-squares IS the global norm
+                optim = _ClippedOptim(optim, self._grad_clip_norm,
+                                      self._grad_clip_const, sum_axis="dp")
             else:
                 optim = _ClippedOptim(optim, self._grad_clip_norm,
                                       self._grad_clip_const)
+        if self.zero1:
+            self._z1 = Zero1Layout(params, self.mesh.shape["dp"],
+                                   bucket_bytes=self.bucket_bytes)
+            optim = Zero1Optim(optim, self._z1)
         return optim
 
     def _make_step_builder(self, params_template, optim):
@@ -241,4 +365,9 @@ class DistriOptimizer(Optimizer):
             params)
 
     def _banner_suffix(self):
-        return f", dp={self.mesh.shape['dp']}" + (", fsdp" if self.fsdp else "")
+        return (f", dp={self.mesh.shape['dp']}"
+                + (", fsdp" if self.fsdp else "")
+                + (", zero1" if self.zero1 else "")
+                + (f", buckets={self.bucket_bytes}" if self.bucket_bytes
+                   else "")
+                + (", fused" if self.fused_optim else ""))

@@ -87,7 +87,7 @@ class SGD(OptimMethod):
     def __init__(self, learning_rate=1e-3, learning_rate_decay=0.0,
                  weight_decay=0.0, momentum=0.0, dampening=None,
                  nesterov=False, learning_rate_schedule: Optional[LearningRateSchedule] = None,
-                 learning_rates=None, weight_decays=None):
+                 learning_rates=None, weight_decays=None, fused=False):
         super().__init__()
         self.lr = learning_rate
         self.lr_decay = learning_rate_decay
@@ -96,6 +96,7 @@ class SGD(OptimMethod):
         self.dampening = momentum if dampening is None else dampening
         self.nesterov = nesterov
         self.schedule = learning_rate_schedule or Default()
+        self.fused = bool(fused)
         if nesterov and (momentum <= 0 or self.dampening != 0):
             raise ValueError(
                 "Nesterov momentum requires momentum > 0 and dampening = 0")
@@ -117,6 +118,16 @@ class SGD(OptimMethod):
     def update(self, grads, params, state):
         step = state["step"]
         clr = self.current_lr(step)
+        if getattr(self, "fused", False):
+            from ..kernels.fused_optim import fused_sgd_update
+            new_params, new_vel = fused_sgd_update(
+                params, grads, state.get("velocity"), clr=clr,
+                momentum=self.momentum, dampening=self.dampening,
+                nesterov=self.nesterov, weight_decay=self.weight_decay)
+            new_state = {"step": step + 1}
+            if new_vel is not None:
+                new_state["velocity"] = new_vel
+            return new_params, new_state
         if self.weight_decay > 0:
             grads = _tmap(lambda g, p: g + self.weight_decay * p, grads, params)
         new_state = {"step": step + 1}
@@ -139,12 +150,13 @@ class Adam(OptimMethod):
 
     def __init__(self, learning_rate=1e-3, learning_rate_decay=0.0,
                  beta1=0.9, beta2=0.999, epsilon=1e-8,
-                 learning_rate_schedule=None):
+                 learning_rate_schedule=None, fused=False):
         super().__init__()
         self.lr = learning_rate
         self.lr_decay = learning_rate_decay
         self.beta1, self.beta2, self.eps = beta1, beta2, epsilon
         self.schedule = learning_rate_schedule or Default()
+        self.fused = bool(fused)
 
     def init_state(self, params):
         return {"step": jnp.zeros((), jnp.int32),
@@ -155,7 +167,24 @@ class Adam(OptimMethod):
         step = state["step"]
         return self.schedule.rate(self, step) / (1.0 + step * self.lr_decay)
 
+    def _fused_update(self, grads, params, state, weight_decay=0.0):
+        """Single-pass Pallas update (kernels.fused_optim); math and op
+        order identical to the tree-map path — jit-for-jit bit parity."""
+        from ..kernels.fused_optim import fused_adam_update
+        step = state["step"]
+        t = step + 1
+        clr = self.schedule.rate(self, step) / (1.0 + step * self.lr_decay)
+        bc1 = 1.0 - self.beta1 ** t.astype(jnp.float32)
+        bc2 = 1.0 - self.beta2 ** t.astype(jnp.float32)
+        new_params, m, v = fused_adam_update(
+            params, grads, state["m"], state["v"], clr=clr, bc1=bc1,
+            bc2=bc2, beta1=self.beta1, beta2=self.beta2, eps=self.eps,
+            weight_decay=weight_decay)
+        return new_params, {"step": t, "m": m, "v": v}
+
     def update(self, grads, params, state):
+        if getattr(self, "fused", False):
+            return self._fused_update(grads, params, state)
         step = state["step"]
         t = step + 1
         clr = self.schedule.rate(self, step) / (1.0 + step * self.lr_decay)
@@ -180,6 +209,10 @@ class AdamW(Adam):
         self.weight_decay = weight_decay
 
     def update(self, grads, params, state):
+        if getattr(self, "fused", False):
+            # decoupled decay folded into the same kernel pass
+            return self._fused_update(grads, params, state,
+                                      weight_decay=self.weight_decay)
         clr = self.get_learning_rate(state)
         new_params, new_state = super().update(grads, params, state)
         new_params = _tmap(

@@ -4,6 +4,8 @@ from .mesh import (create_mesh, get_mesh, set_mesh, data_sharding,
                    replicated, shard_batch, init_distributed)
 from .allreduce import (allreduce_gradients, reduce_scatter_gradients,
                         allgather_params, shardable_mask_dim0)
+from .bucketer import GradBucketer
+from .zero import Zero1Layout, Zero1Optim
 from .ring_attention import ring_attention, ring_attention_shmap
 from .pipeline import pipeline_run, pipelined
 from .spmd import SpmdTrainer

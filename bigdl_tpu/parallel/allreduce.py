@@ -19,6 +19,7 @@ On TPU these become XLA collectives over the mesh:
 """
 from __future__ import annotations
 
+import logging
 from typing import Optional
 
 import jax
@@ -27,6 +28,31 @@ from jax import lax
 
 from ..observability import collectives as _acct
 from ._compat import axis_size
+
+log = logging.getLogger(__name__)
+
+
+def _path_str(path):
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path)
+
+
+def _report_dense_fallback(counter: str, names, op: str):
+    """Sharding coverage must be observable, not silent: leaves that fall
+    back to a dense per-leaf collective (dim 0 not divisible / masked
+    out) bump a ``comm/*`` counter once per trace and name themselves in
+    a debug log.  Runs at trace time — once per compiled program, so the
+    counter reads 'how many leaves the last-built step left unsharded'
+    (re-traces re-report, like the collective gauges)."""
+    if not names:
+        return
+    from ..observability.recorder import get_recorder
+    rec = get_recorder()
+    if rec.enabled:
+        rec.inc(counter, len(names))
+    log.debug("%s dense fallback for %d leaves (dim 0 not divisible by "
+              "the axis, or masked unsharded): %s", op, len(names),
+              ", ".join(names))
 
 
 def _cast(tree, dtype):
@@ -49,6 +75,12 @@ def allreduce_gradients(grads, axis_name: str = "dp",
     """Sum (or mean) gradients across the axis, optionally compressed to
     16-bit on the wire (≙ FP16CompressedTensor).  Call inside shard_map.
 
+    Compressed means ship the 1/n-scaled gradient (pre-scaled in fp32,
+    then cast): a raw 16-bit ring SUM of n shards can overflow fp16's
+    65504 range, and the same mean-on-the-wire rule keeps this path
+    numerically identical to the bucketed exchange
+    (:class:`~bigdl_tpu.parallel.bucketer.GradBucketer`).
+
     Accounts the ring all-reduce volume (raw and on-the-wire bytes) to
     the active telemetry recorder at trace time — shapes are static
     here, so the numbers are exact per executed step."""
@@ -61,11 +93,21 @@ def allreduce_gradients(grads, axis_name: str = "dp",
         _acct.account_collective(
             "allreduce", _acct.ring_allreduce_bytes(raw, n),
             _acct.ring_allreduce_bytes(wire, n))
-    if compress in ("fp16", "float16"):
-        grads = _cast(grads, jnp.float16)
-    elif compress in ("bf16", "bfloat16"):
-        grads = _cast(grads, jnp.bfloat16)
-    reduced = lax.pmean(grads, axis_name) if mean else lax.psum(grads, axis_name)
+    cast_to = {"fp16": jnp.float16, "float16": jnp.float16,
+               "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16}.get(compress)
+    if cast_to is not None:
+        if mean and n is not None:
+            grads = jax.tree_util.tree_map(
+                lambda g: (g.astype(jnp.float32) / n).astype(cast_to)
+                if jnp.issubdtype(g.dtype, jnp.floating) else g, grads)
+            reduced = lax.psum(grads, axis_name)
+        else:       # mean=False keeps sum semantics; n unknown outside
+            grads = _cast(grads, cast_to)      # a binding context
+            reduced = lax.pmean(grads, axis_name) if mean \
+                else lax.psum(grads, axis_name)
+    else:
+        reduced = lax.pmean(grads, axis_name) if mean \
+            else lax.psum(grads, axis_name)
     return jax.tree_util.tree_map(
         lambda g, d: g.astype(d), reduced, orig_dtypes)
 
@@ -83,11 +125,13 @@ def reduce_scatter_gradients(grads, axis_name: str = "dp", mean: bool = True,
     (S*(n-1)/n wire bytes), unscattered ones a full all-reduce."""
     n = axis_size(axis_name)
     rs_bytes, ar_bytes = [0], [0]
+    dense_leaves = []
 
-    def rs(g, s=None):
+    def rs(path, g, s=None):
         sharded = (g.ndim > 0 and g.shape[0] % n == 0) if s is None else s
         if not sharded:
             ar_bytes[0] += _acct.leaf_bytes(g)
+            dense_leaves.append(_path_str(path))
             return lax.pmean(g, axis_name) if mean else lax.psum(g, axis_name)
         rs_bytes[0] += _acct.leaf_bytes(g)
         out = lax.psum_scatter(g, axis_name, scatter_dimension=0,
@@ -95,9 +139,11 @@ def reduce_scatter_gradients(grads, axis_name: str = "dp", mean: bool = True,
         return out / n if mean else out
 
     if mask is None:
-        out = jax.tree_util.tree_map(rs, grads)
+        out = jax.tree_util.tree_map_with_path(rs, grads)
     else:
-        out = jax.tree_util.tree_map(rs, grads, mask)
+        out = jax.tree_util.tree_map_with_path(rs, grads, mask)
+    _report_dense_fallback("comm/unsharded_leaves", dense_leaves,
+                           "reduce_scatter_gradients")
     if rs_bytes[0]:
         _acct.account_collective(
             "reduce_scatter", _acct.ring_gather_bytes(rs_bytes[0], n),
@@ -116,17 +162,21 @@ def allgather_params(params, axis_name: str = "dp", mask=None):
     non-scalar leaf is gathered."""
     n = _axis_size_or_none(axis_name)
     ag_bytes = [0]
+    skipped_leaves = []
 
-    def ag(p, s=None):
+    def ag(path, p, s=None):
         if p.ndim == 0 or (s is not None and not s):
+            skipped_leaves.append(_path_str(path))
             return p
         ag_bytes[0] += _acct.leaf_bytes(p) * (n or 1)  # full gathered size
         return lax.all_gather(p, axis_name, axis=0, tiled=True)
 
     if mask is None:
-        out = jax.tree_util.tree_map(ag, params)
+        out = jax.tree_util.tree_map_with_path(ag, params)
     else:
-        out = jax.tree_util.tree_map(ag, params, mask)
+        out = jax.tree_util.tree_map_with_path(ag, params, mask)
+    _report_dense_fallback("comm/ungathered_leaves", skipped_leaves,
+                           "allgather_params")
     if ag_bytes[0] and n:
         _acct.account_collective(
             "allgather", _acct.ring_gather_bytes(ag_bytes[0], n),

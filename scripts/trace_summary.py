@@ -37,6 +37,17 @@ Two subcommands:
 
         python scripts/trace_summary.py profile /tmp/telemetry.jsonl
 
+  comm               per-step collective volume and count, pre/post
+                     compression, from the trace-time collective
+                     accounting gauges: per-op raw vs on-the-wire
+                     bytes (the fp16/bf16 compression ratio), the
+                     gradient-bucket count, cumulative exchange
+                     totals, and the sharding-coverage counters
+                     (comm/unsharded_leaves) — the one-command view of
+                     a bucketing/compression/zero1 delta:
+
+        python scripts/trace_summary.py comm /tmp/telemetry.jsonl [last_n]
+
 CPU-only (no device access), so it is safe to run while the tunnel is
 wedged.
 """
@@ -389,6 +400,97 @@ def summarize_profile(profiles, steps, out=print):
                 f"{_fmt_bytes(peak) if peak is not None else '-':>12}")
 
 
+def summarize_comm(steps, out=print):
+    """Render the collective-exchange table: per-op raw vs wire bytes
+    per step (compression observable as the ratio), bucket count, and
+    cumulative totals — all from the trace-time accounting the
+    allreduce/bucketer/zero1 paths report into the step records."""
+    if not steps:
+        out("no step records")
+        return
+    last = steps[-1]
+    gauges = last.get("gauges", {})
+    counters = last.get("counters", {})
+    n = len(steps)
+    out(f"steps: {n}")
+
+    ops = sorted({k[len("collective/"):-len("_bytes")]
+                  for k in gauges
+                  if k.startswith("collective/") and k.endswith("_bytes")
+                  and not k.endswith("_wire_bytes")
+                  and not k.endswith("_per_step")})
+    if ops:
+        out("\n== collectives per step (trace-time accounting, ring "
+            "wire bytes per chip) ==")
+        out(f"  {'op':<16} {'raw':>12} {'wire':>12} {'wire/raw':>9}")
+        for op in ops:
+            raw = gauges.get(f"collective/{op}_bytes", 0.0)
+            wire = gauges.get(f"collective/{op}_wire_bytes", 0.0)
+            ratio = wire / raw if raw else float("nan")
+            out(f"  {op:<16} {_fmt_bytes(raw):>12} {_fmt_bytes(wire):>12} "
+                f"{ratio:>8.2f}x")
+        tot_raw = gauges.get("collective/bytes_per_step", 0.0)
+        tot_wire = gauges.get("collective/wire_bytes_per_step", 0.0)
+        if tot_raw:
+            out(f"  {'TOTAL':<16} {_fmt_bytes(tot_raw):>12} "
+                f"{_fmt_bytes(tot_wire):>12} "
+                f"{tot_wire / tot_raw:>8.2f}x")
+    if gauges.get("collective/buckets"):
+        out(f"\n  gradient buckets/step: "
+            f"{gauges['collective/buckets']:.0f} "
+            "(per-bucket collectives — overlappable with backward)")
+
+    raw_tot = counters.get("collective/bytes_total", 0.0)
+    wire_tot = counters.get("collective/wire_bytes_total", 0.0)
+    if raw_tot:
+        # mean/step from the per-step gauges over the RETAINED window —
+        # the cumulative counters cover the whole run, so total/len()
+        # would inflate the mean when a last_n window is shown
+        raws = [s["gauges"]["collective/bytes_per_step"] for s in steps
+                if isinstance(s.get("gauges", {}).get(
+                    "collective/bytes_per_step"), (int, float))]
+        wires = [s["gauges"]["collective/wire_bytes_per_step"]
+                 for s in steps
+                 if isinstance(s.get("gauges", {}).get(
+                     "collective/wire_bytes_per_step"), (int, float))]
+        raw_mean = sum(raws) / len(raws) if raws else raw_tot / n
+        wire_mean = sum(wires) / len(wires) if wires else wire_tot / n
+        out("\n== cumulative exchange (counters: whole run; mean: shown "
+            "steps) ==")
+        out(f"  raw  {_fmt_bytes(raw_tot):>12}   "
+            f"mean/step {_fmt_bytes(raw_mean)}")
+        out(f"  wire {_fmt_bytes(wire_tot):>12}   "
+            f"mean/step {_fmt_bytes(wire_mean)}"
+            + (f"   saved {_pct(1 - wire_tot / raw_tot)} on the wire"
+               if wire_tot and wire_tot < raw_tot else ""))
+
+    unsh = counters.get("comm/unsharded_leaves", 0.0)
+    ungath = counters.get("comm/ungathered_leaves", 0.0)
+    if unsh or ungath:
+        out("\n== sharding coverage ==")
+        if unsh:
+            out(f"  comm/unsharded_leaves  {unsh:.0f}  (leaves dense-"
+                "all-reduced instead of reduce-scattered; names in the "
+                "debug log of bigdl_tpu.parallel.allreduce)")
+        if ungath:
+            out(f"  comm/ungathered_leaves {ungath:.0f}  (replicated "
+                "leaves skipped by allgather_params)")
+    if not ops and not raw_tot:
+        out("no collective accounting in these step records (single "
+            "device, or the GSPMD path — see SpmdTrainer."
+            "account_collectives)")
+
+
+def main_comm(argv):
+    if not argv:
+        raise SystemExit("usage: trace_summary.py comm "
+                         "<telemetry.jsonl> [last_n]")
+    last_n = int(argv[1]) if len(argv) > 1 else None
+    steps, _ = load_steps(argv[0], last_n)
+    print(f"telemetry: {argv[0]}")
+    summarize_comm(steps)
+
+
 def main_profile(argv):
     if not argv:
         raise SystemExit("usage: trace_summary.py profile "
@@ -436,6 +538,8 @@ def main():
     argv = sys.argv[1:]
     if argv and argv[0] == "steps":
         main_steps(argv[1:])
+    elif argv and argv[0] == "comm":
+        main_comm(argv[1:])
     elif argv and argv[0] == "profile":
         main_profile(argv[1:])
     elif argv and argv[0] == "health":

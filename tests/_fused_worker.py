@@ -1,0 +1,68 @@
+"""Subprocess worker: fused-vs-reference optimizer BITWISE parity.
+
+Run with ``XLA_FLAGS=--xla_cpu_use_thunk_runtime=false`` (the parent
+test sets it): on the legacy CPU runtime XLA's FMA-contraction choices
+are consistent across program structures, so the Pallas interpret-mode
+kernels must match the jitted tree-map reference bit for bit over a
+multi-step run.  (On the default thunk runtime contraction is decided
+per fusion cluster and the two — mathematically identical — programs
+legitimately differ by 1 ulp/step on Adam's params; the in-process
+tests cover that with a tight tolerance.)
+
+Prints one JSON line: {"ok": bool, "failures": [...]}.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bigdl_tpu.optim.optim_method import SGD, Adam, AdamW
+
+
+def main():
+    rng = np.random.RandomState(0)
+    params = {"a": {"weight": jnp.asarray(rng.randn(300, 7).astype(np.float32)),
+                    "bias": jnp.asarray(rng.randn(7).astype(np.float32))},
+              "b": {"weight": jnp.asarray(rng.randn(64, 64).astype(np.float32))}}
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.asarray(rng.randn(*p.shape).astype(np.float32)),
+        params)
+
+    cases = [
+        ("Adam", lambda f: Adam(1e-3, fused=f)),
+        ("AdamW", lambda f: AdamW(1e-3, weight_decay=0.01, fused=f)),
+        ("SGD", lambda f: SGD(0.05, fused=f)),
+        ("SGD-mom-wd", lambda f: SGD(0.05, momentum=0.9, weight_decay=1e-4,
+                                     fused=f)),
+        ("SGD-nesterov", lambda f: SGD(0.05, momentum=0.9, nesterov=True,
+                                       dampening=0, fused=f)),
+    ]
+    failures = []
+    for name, make in cases:
+        ref, fus = make(False), make(True)
+        s_r, s_f = ref.init_state(params), fus.init_state(params)
+        ur, uf = jax.jit(ref.update), jax.jit(fus.update)
+        p_r = p_f = params
+        for step in range(5):
+            p_r, s_r = ur(grads, p_r, s_r)
+            p_f, s_f = uf(grads, p_f, s_f)
+            for (path, a), (_, b) in zip(
+                    jax.tree_util.tree_flatten_with_path((p_r, s_r))[0],
+                    jax.tree_util.tree_flatten_with_path((p_f, s_f))[0]):
+                if not np.array_equal(np.asarray(a), np.asarray(b)):
+                    failures.append(
+                        f"{name} step {step} {jax.tree_util.keystr(path)} "
+                        f"maxdiff "
+                        f"{np.abs(np.asarray(a) - np.asarray(b)).max():.3g}")
+    print(json.dumps({"ok": not failures, "failures": failures[:20]}))
+
+
+if __name__ == "__main__":
+    main()
