@@ -24,6 +24,10 @@ from .imageframe import (ImageFeature, ImageFrame, FeatureTransformer,
                          PixelBytesToMat, MatToFloats, Pipeline,
                          LocalImageFrame, DistributedImageFrame,
                          FixExpand, SeqFileFolder)
+from .sharded import (ShardedRecordDataSet, plan_epoch, epoch_order,
+                      replan_cursors, iter_tfrecord_salvage,
+                      iter_seqfile_salvage, iter_fixed_records,
+                      count_records)
 from .text import (LabeledSentence, SentenceSplitter, SentenceTokenizer,
                    SentenceBiPadding, Dictionary, TextToLabeledSentence,
                    LabeledSentenceToSample, read_localfile, sentences_split,

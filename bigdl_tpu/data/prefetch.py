@@ -10,14 +10,79 @@ from __future__ import annotations
 
 import queue
 import threading
+import weakref
 from typing import Callable, Optional, Sequence
 
 import numpy as np
 
 from .dataset import DataSet
 from .minibatch import MiniBatch
+# one canonical copy of the stop-aware queue plumbing: the streaming
+# pipeline and this prefetcher must share the same abandonment
+# semantics or the two loaders' shutdown behavior diverges
+from .sharded import _finalize_stream as _stop_producer
+from .sharded import _put as _put_stop_aware
 
 _END = object()
+
+
+def _fill(make_source: Callable, q: "queue.Queue",
+          stop: threading.Event):
+    """EVERY put goes through the stop-aware helper, the terminal
+    sentinel included — a plain put of _END with a full queue and an
+    abandoned consumer would re-create the thread leak."""
+    try:
+        for item in make_source():
+            if not _put_stop_aware(q, item, stop):
+                return
+        _put_stop_aware(q, _END, stop)
+    except BaseException as e:          # surfaced on the consumer side
+        _put_stop_aware(q, (_END, e), stop)
+
+
+class _PrefetchIterator:
+    """Batch iterator whose fill thread can ALWAYS exit.
+
+    The old generator implementation blocked the producer on a plain
+    ``q.put``: a consumer that abandoned iteration early (break,
+    exception, dropped reference) left the thread parked on a full
+    queue forever — one leaked thread (plus ``depth`` pinned batches)
+    per abandoned epoch.  Every put is now stop-aware, ``close()`` (and
+    the generator-``finally`` of normal exhaustion) trips the stop
+    event, and a ``weakref.finalize`` backstop — the
+    ``serving/engine.py`` finalizer pattern — covers consumers that
+    never call close.
+    """
+
+    def __init__(self, make_source: Callable, depth: int):
+        self._q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._finalizer = weakref.finalize(self, _stop_producer,
+                                           self._stop)
+        # module-level target holding only (source, q, stop): a bound
+        # method would keep `self` reachable from the running thread
+        # and the GC finalizer could never fire while the thread lives
+        self._thread = threading.Thread(
+            target=_fill, args=(make_source, self._q, self._stop),
+            daemon=True, name="bigdl-prefetch")
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is _END:
+            self.close()
+            raise StopIteration
+        if isinstance(item, tuple) and len(item) == 2 \
+                and item[0] is _END:
+            self.close()
+            raise item[1]
+        return item
+
+    def close(self):
+        self._stop.set()
 
 
 class PrefetchedDataSet(DataSet):
@@ -36,32 +101,18 @@ class PrefetchedDataSet(DataSet):
         return getattr(self.base, "batches_per_epoch", lambda: None)()
 
     def data(self, train=True, epoch=None):
-        q: "queue.Queue" = queue.Queue(maxsize=self.depth)
-        error = []
-
-        def producer():
+        def make_source():
             try:
-                try:
-                    it = self.base.data(train, epoch=epoch)
-                except TypeError:
-                    it = self.base.data(train)
-                for item in it:
-                    q.put(item)
-            except BaseException as e:  # surfaced on the consumer side
-                error.append(e)
-            finally:
-                q.put(_END)
+                return self.base.data(train, epoch=epoch)
+            except TypeError:   # dataset without epoch-seeded shuffling
+                return self.base.data(train)
 
-        t = threading.Thread(target=producer, daemon=True,
-                             name="bigdl-prefetch")
-        t.start()
-        while True:
-            item = q.get()
-            if item is _END:
-                if error:
-                    raise error[0]
-                return
-            yield item
+        it = _PrefetchIterator(make_source, self.depth)
+        try:
+            for item in it:
+                yield item
+        finally:
+            it.close()      # break/exception/GC: unpark the fill thread
 
 
 class FileRecordDataSet(DataSet):

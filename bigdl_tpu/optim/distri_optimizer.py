@@ -133,7 +133,14 @@ class DistriOptimizer(Optimizer):
 
         n_accum = self._grad_accum
 
+        augment = self._device_augment
+
         def local_loss(p, model_state, x, y, rng):
+            # device-side augmentation on THIS shard's slice of the
+            # batch: per-shard rng is already folded by axis_index, so
+            # every image gets its own crop/flip stream (uint8 wire)
+            from .optimizer import apply_device_augment
+            x, rng = apply_device_augment(augment, x, rng)
             if mixed:
                 x = jax.tree_util.tree_map(
                     lambda a: a.astype(jnp.bfloat16)

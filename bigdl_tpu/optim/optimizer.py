@@ -143,15 +143,32 @@ def mask_frozen_grads(model: Module, grads):
             for name, sub in grads.items()}
 
 
+def apply_device_augment(augment, x, rng, training=True):
+    """Run a device-side augmentation (``data.device_augment``-style
+    callable) INSIDE the jitted step: the host ships raw uint8 and the
+    crop/flip/normalize math fuses into the step's XLA program.  Returns
+    ``(x, rng)`` — the augmentation key is split off the step's traced
+    rng (recompile-safe: no host clock or host RNG enters the trace),
+    so every step (and every resumed step, whose rng comes from the
+    checkpoint) sees its own deterministic stream."""
+    if augment is None:
+        return x, rng
+    rng, sub = jax.random.split(rng)
+    return augment(x, sub, training=training), rng
+
+
 def make_train_step(model: Module, criterion, optim_method: OptimMethod,
                     mixed_precision=False, extra_loss_fn=None,
-                    telemetry=False):
+                    telemetry=False, device_augment=None):
     """Build the pure fused train step; caller jits (and shard_maps) it.
 
     ``telemetry=True`` appends a dict of training-health device scalars
-    (:func:`health_scalars`) to the return tuple."""
+    (:func:`health_scalars`) to the return tuple.  ``device_augment``
+    folds a device-side augmentation into the step (uint8 on the wire;
+    see :func:`apply_device_augment`)."""
 
     def step(params, opt_state, model_state, x, y, rng):
+        x, rng = apply_device_augment(device_augment, x, rng)
         if mixed_precision:
             x = jax.tree_util.tree_map(
                 lambda a: a.astype(jnp.bfloat16)
@@ -259,7 +276,7 @@ def make_accum_grads(loss_fn, n_accum: int, weight_fn=None):
 def make_accum_train_step(model: Module, criterion,
                           optim_method: OptimMethod, n_accum: int,
                           mixed_precision=False, extra_loss_fn=None,
-                          telemetry=False):
+                          telemetry=False, device_augment=None):
     """Gradient-accumulation variant of make_train_step: the batch is
     split into ``n_accum`` microbatches, a ``lax.scan`` accumulates the
     mean gradient (and threads BN state through in order), and the
@@ -271,9 +288,11 @@ def make_accum_train_step(model: Module, criterion,
     if n_accum < 2:
         return make_train_step(model, criterion, optim_method,
                                mixed_precision, extra_loss_fn,
-                               telemetry=telemetry)
+                               telemetry=telemetry,
+                               device_augment=device_augment)
 
     def micro_loss(params, model_state, x, y, rng):
+        x, rng = apply_device_augment(device_augment, x, rng)
         if mixed_precision:
             x = jax.tree_util.tree_map(
                 lambda a: a.astype(jnp.bfloat16)
@@ -314,8 +333,13 @@ def make_accum_train_step(model: Module, criterion,
     return step
 
 
-def make_eval_step(model: Module):
+def make_eval_step(model: Module, device_augment=None):
     def step(params, model_state, x):
+        if device_augment is not None:
+            # eval-mode augmentation (center crop + normalize): rng is
+            # None positionally, honoring the documented
+            # (x, rng, training) -> x callable contract
+            x = device_augment(x, None, training=False)
         ctx = Ctx(state=model_state, training=False, rng_key=None)
         return model.apply(params, x, ctx)
     return step
@@ -361,7 +385,14 @@ class Optimizer:
         self.max_retries = 0
         self._resume_skip = 0        # batches to skip after mid-epoch resume
         self._resume_rng = None      # loop rng restored from checkpoint
+        # a restored data cursor positions the dataset itself; an empty
+        # first epoch then means "resumed at the boundary", not "no data"
+        self._cursor_resumed = False
         self.prefetch_depth = 0
+        # device-side augmentation compiled into the train step (the
+        # uint8-wire path: data/device_augment.DeviceAugment or any
+        # (x, rng, training) -> x callable)
+        self._device_augment = None
         self._retry_cache = None
         # telemetry (observability.Recorder); None = zero-cost no-op path
         self._recorder: Optional[Recorder] = None
@@ -449,8 +480,29 @@ class Optimizer:
     def set_prefetch(self, depth=2):
         """Stage minibatches to the device from a background thread,
         `depth` batches ahead (double buffering at the default; ≙ the
-        reference Engine's prefetching iterators)."""
+        reference Engine's prefetching iterators).  Self-staging
+        datasets (``data.sharded.ShardedRecordDataSet``) already
+        prefetch and place internally — they are never double-wrapped,
+        because a loader reading ahead of training would break the
+        exactly-once data cursor."""
         self.prefetch_depth = depth
+        return self
+
+    def set_device_augment(self, augment):
+        """Compile a device-side augmentation into the train step
+        (``data.device_augment.DeviceAugment`` or any
+        ``(x, rng, training) -> x`` callable): the host ships raw uint8
+        batches (4× smaller on the wire than fp32) and crop / flip /
+        normalize fuse into the step's XLA program.  The augmentation
+        key is split off the step's traced rng — recompile-safe, and a
+        resumed run (rng restored from the checkpoint) replays the
+        identical stream.  Takes effect at the next step build; call
+        before ``optimize()``."""
+        self._device_augment = augment
+        # the cached eval program baked the OLD augmentation in; a
+        # stale one would feed validation un-augmented (wrong shapes
+        # or silently wrong metrics)
+        self._eval_step = None
         return self
 
     def set_telemetry(self, recorder: Recorder, health: bool = True,
@@ -667,6 +719,12 @@ class Optimizer:
                 "rng": None if getattr(self, "_loop_rng", None) is None
                 else np.asarray(self._loop_rng).tolist(),
                 "epoch_boundary": bool(epoch_boundary)}
+        # deterministic data cursor (data/sharded.py): the exact read
+        # position of the last CONSUMED batch rides in the manifest, so
+        # resume re-positions the stream instead of replaying the epoch
+        # head — no sample re-seen, none skipped
+        if callable(getattr(self.dataset, "state", None)):
+            meta["data_cursor"] = self.dataset.state()
         payload = self._ckpt_shards(host) if mgr.layout == "manifest" \
             else host
         with self._wd_suspended():      # sync commits block the loop
@@ -687,6 +745,14 @@ class Optimizer:
         self.state.iteration = meta["iteration"]
         self.state.batch_in_epoch = meta.get("batch_in_epoch", 0)
         self._resume_skip = self.state.batch_in_epoch
+        cursor = meta.get("data_cursor")
+        if cursor is not None and callable(getattr(self.dataset,
+                                                   "restore", None)):
+            # the dataset re-positions ITSELF — skipping batches on top
+            # of the restored cursor would double-skip
+            self.dataset.restore(cursor)
+            self._resume_skip = 0
+            self._cursor_resumed = True
         rng_saved = meta.get("rng")
         # owning copy (GL001): jnp.asarray could zero-copy adopt the
         # host buffer, and the step donates the rng key — same hazard
@@ -715,7 +781,8 @@ class Optimizer:
         # jit once per optimizer: rebuilding the closure each call would
         # recompile the full eval program at every validation trigger
         if not hasattr(self, "_eval_step") or self._eval_step is None:
-            self._eval_step = jax.jit(make_eval_step(self.model))
+            self._eval_step = jax.jit(make_eval_step(
+                self.model, self._device_augment))
         eval_step = self._eval_step
         results = [None] * len(self.val_methods)
         for mb in self.val_dataset.data(train=False):
@@ -780,11 +847,13 @@ class Optimizer:
                 fn = make_accum_train_step(self.model, self.criterion,
                                            optim, n_accum,
                                            self.mixed_precision,
-                                           telemetry=telemetry)
+                                           telemetry=telemetry,
+                                           device_augment=self._device_augment)
             else:
                 fn = make_train_step(self.model, self.criterion, optim,
                                      self.mixed_precision,
-                                     telemetry=telemetry)
+                                     telemetry=telemetry,
+                                     device_augment=self._device_augment)
             # a rebuilt step is a new program: re-capture its cost at
             # the next first dispatch
             self._cost_pending = True
@@ -943,9 +1012,20 @@ class Optimizer:
         n_seen = 0
         skip = self._resume_skip
         self._resume_skip = 0
+        cursor_resumed = self._cursor_resumed
+        self._cursor_resumed = False
         self.state.batch_in_epoch = skip
 
         rec = self._rec()
+
+        self_staging = bool(getattr(self.dataset, "self_staging", False))
+        pipeline_places = self_staging and callable(
+            getattr(self.dataset, "set_place_fn", None))
+        if pipeline_places:
+            # the pipeline's staging thread runs the device placement
+            # `staging_depth` batches ahead — h2d overlaps the step
+            # without an extra loader layer
+            self.dataset.set_place_fn(lambda b: self._place_batch(*b))
 
         def staged():
             try:
@@ -957,14 +1037,28 @@ class Optimizer:
                     return
             for mb in it:
                 x, y = _mb_to_arrays(mb)
+                if isinstance(mb, MiniBatch):
+                    size = mb.size()
+                else:       # (x, y) tuple, e.g. a streaming pipeline
+                    size = int(jnp.shape(
+                        jax.tree_util.tree_leaves(x)[0])[0])
+                if pipeline_places:
+                    # already placed on the pipeline's staging thread;
+                    # re-placing here would add a per-batch tree_map
+                    # and book a meaningless ~0 h2d span
+                    yield (size, x, y)
+                    continue
                 # under prefetch this runs on the producer thread: the
                 # h2d span for batch N+1 overlaps step N by design
                 with rec.span("h2d"):
                     placed = self._place_batch(x, y)
-                yield (mb.size(),) + tuple(placed)
+                yield (size,) + tuple(placed)
 
         batches = staged()
-        if self.prefetch_depth:
+        if self.prefetch_depth and not self_staging:
+            # self-staging pipelines already prefetch + place internally;
+            # another read-ahead layer would advance their cursor past
+            # what training consumed and break exactly-once resume
             from ..data.device_loader import DeviceLoader
             batches = iter(DeviceLoader(batches, self.prefetch_depth,
                                         recorder=self._recorder))
@@ -1052,7 +1146,7 @@ class Optimizer:
         else:
             self.state.epoch_finished = True
             if n_seen == 0:
-                if skip == 0:
+                if skip == 0 and not cursor_resumed:
                     raise ValueError(
                         "dataset produced no batches (batch_size larger "
                         "than the dataset with drop_last, or empty data)")

@@ -106,6 +106,11 @@ class SpmdTrainer:
         self._ckpt_mgr = None
         self._shard_arrays = False      # elastic sliced saves (v2)
         self._preemption = None
+        # device-side input transform compiled into the step (the
+        # uint8-wire / device-augment hook for this path)
+        self._input_transform = None
+        # attached streaming dataset whose cursor rides in checkpoints
+        self._data_pipeline = None
         # training-health layer (observability.health)
         self._health_monitor = None
         self._flight = None
@@ -203,8 +208,14 @@ class SpmdTrainer:
         telemetry = self._telemetry_active()
         self._with_health = telemetry
         self._seen_sigs.clear()
+        transform = self._input_transform
 
         def step(params, opt_state, tokens, targets, rng):
+            if transform is not None:
+                # traced-rng split only (GL005: no host state in the
+                # trace); the transform fuses into the step program
+                rng, t_rng = jax.random.split(rng)
+                tokens = transform(tokens, t_rng)
             (loss, _), grads = grads_fn(params, {}, tokens, targets, rng)
             grads = mask_frozen_grads(model, grads)
             new_params, new_opt = optim.update(grads, params, opt_state)
@@ -251,6 +262,33 @@ class SpmdTrainer:
             self.init()
             if params is not None:
                 self.params, self.opt_state = params, opt_state
+        return self
+
+    def set_input_transform(self, fn):
+        """Compile ``fn(tokens, rng) -> tokens`` into the jitted step —
+        the device-side augmentation hook for this path (the host ships
+        the raw wire format, e.g. uint8, and the transform runs inside
+        the step's XLA program).  The rng is split off the step's
+        traced key: recompile-safe, deterministic across resume.  Like
+        ``set_telemetry(health=...)``, changing it after ``init()``
+        re-jits without losing training progress."""
+        self._input_transform = fn
+        if self._step_fn is not None:
+            params, opt_state = self.params, self.opt_state
+            self._step_fn = None
+            self.init()
+            if params is not None:
+                self.params, self.opt_state = params, opt_state
+        return self
+
+    def set_data_pipeline(self, dataset):
+        """Attach a cursor-capable streaming dataset
+        (``data.sharded.ShardedRecordDataSet``): every manifest
+        checkpoint then records ``dataset.state()`` — the exact read
+        position of the last consumed batch — and restore re-positions
+        the stream, so a preempted run never re-sees or skips a sample.
+        Feed ``fit(...)`` from ``dataset.stream()``."""
+        self._data_pipeline = dataset
         return self
 
     def set_health(self, policy: str = "warn", flight_dir=None,
@@ -563,6 +601,11 @@ class SpmdTrainer:
                     shards[name] = None
         meta = {"step": self._step_count, "seed": self.seed,
                 "root": self.model.name}
+        if self._data_pipeline is not None:
+            # the data cursor is mesh-independent (the pipeline feeds
+            # the GLOBAL batch), so it survives an elastic reshard
+            # unchanged — dp4→dp2 resumes the identical sample stream
+            meta["data_cursor"] = self._data_pipeline.state()
         mgr.save(shards, meta, tag=tag or f"step_{self._step_count}",
                  sync=sync, mesh=reshard.mesh_info(self.mesh),
                  owned=owned)
@@ -597,9 +640,12 @@ class SpmdTrainer:
         tag_dir = os.path.join(path, tag or f"step_{self._step_count}")
         save_pytree({"params": self.params, "opt_state": self.opt_state},
                     os.path.join(tag_dir, "state"), to_host=False)
+        meta = {"step": self._step_count, "seed": self.seed,
+                "root": self.model.name}
+        if self._data_pipeline is not None:
+            meta["data_cursor"] = self._data_pipeline.state()
         with open(os.path.join(tag_dir, "meta.json"), "w") as f:
-            json.dump({"step": self._step_count, "seed": self.seed,
-                       "root": self.model.name}, f)
+            json.dump(meta, f)
         tmp = os.path.join(path, "latest.tmp")
         with open(tmp, "w") as f:
             f.write(os.path.basename(tag_dir))   # relocatable pointer
@@ -757,6 +803,9 @@ class SpmdTrainer:
                   flush=True)
         self._step_count = meta["step"]
         self.seed = meta.get("seed", self.seed)
+        cursor = meta.get("data_cursor")
+        if cursor is not None and self._data_pipeline is not None:
+            self._data_pipeline.restore(cursor)
         return self
 
     def set_checkpoint(self, path: str, every_steps: int = 1000,

@@ -37,6 +37,17 @@ Two subcommands:
 
         python scripts/trace_summary.py profile /tmp/telemetry.jsonl
 
+  input              input-pipeline breakdown from the data/* telemetry
+                     of the sharded streaming loader: stall fraction
+                     (consumer blocked on an empty staging queue vs
+                     step time), decode throughput across the worker
+                     pool, h2d wire bytes per step, records read,
+                     salvage-resync bytes, and the staging queue depth
+                     — the one-command view of "is input feeding the
+                     roofline":
+
+        python scripts/trace_summary.py input /tmp/telemetry.jsonl [last_n]
+
   comm               per-step collective volume and count, pre/post
                      compression, from the trace-time collective
                      accounting gauges: per-op raw vs on-the-wire
@@ -481,6 +492,77 @@ def summarize_comm(steps, out=print):
             "account_collectives)")
 
 
+def summarize_input(steps, out=print):
+    """Render the input-pipeline breakdown: the data/* counters are
+    cumulative, so per-window deltas come from consecutive step records
+    (the first shown step is the baseline and is excluded from the
+    window — its own delta is unknowable from the records alone)."""
+    if not steps:
+        out("no step records")
+        return
+    have = [s for s in steps
+            if "data/input_stall_seconds" in s.get("counters", {})]
+    if not have:
+        out("no data/* input telemetry in these step records (not the "
+            "sharded streaming loader, or telemetry disabled)")
+        return
+    if len(have) < 2:
+        out("need >= 2 step records with data/* counters for a window")
+        return
+
+    def c(s, k):
+        return s.get("counters", {}).get(k, 0.0)
+
+    first, last = have[0], have[-1]
+    n = len(have) - 1
+    dur = sum(s.get("dur") or 0.0 for s in have[1:])
+    keys = ("data/input_stall_seconds", "data/decode_seconds",
+            "data/h2d_bytes", "data/records_read", "data/batches",
+            "data/resync_skipped_bytes")
+    d = {k: c(last, k) - c(first, k) for k in keys}
+    stall_frac = d["data/input_stall_seconds"] / max(dur, 1e-12)
+    out(f"steps in window: {n}   wall {dur:.3f} s   "
+        f"mean step {1e3 * dur / max(n, 1):.2f} ms")
+    out("\n== input pipeline (window deltas) ==")
+    out(f"  input stall        {1e3 * d['data/input_stall_seconds']:>10.2f}"
+        f" ms   {100.0 * stall_frac:5.2f}% of step time"
+        + ("   <- INPUT-BOUND" if stall_frac > 0.10 else ""))
+    out(f"  host decode        {1e3 * d['data/decode_seconds']:>10.2f} ms"
+        f"   (worker-pool total; overlaps the step)")
+    if d["data/records_read"]:
+        dec = d["data/decode_seconds"]
+        out(f"  decode throughput  "
+            f"{d['data/records_read'] / max(dec, 1e-12):>10.0f} rec/s "
+            f"of decode time   ({d['data/records_read']:.0f} records)")
+    out(f"  h2d wire           {_fmt_bytes(d['data/h2d_bytes']):>10}   "
+        f"({_fmt_bytes(d['data/h2d_bytes'] / max(n, 1))}/step)")
+    if d["data/resync_skipped_bytes"]:
+        out(f"  salvage resync     "
+            f"{_fmt_bytes(d['data/resync_skipped_bytes']):>10} skipped "
+            "over corrupt regions")
+    depths = [s["gauges"]["data/queue_depth"] for s in have
+              if isinstance(s.get("gauges", {}).get("data/queue_depth"),
+                            (int, float))]
+    if depths:
+        out(f"  staging queue      depth mean {sum(depths) / len(depths):.2f}"
+            f"   min {min(depths):.0f}  max {max(depths):.0f}   "
+            "(0 at pull = the step waited)")
+    out(f"\n  totals at last step: "
+        f"{c(last, 'data/records_read'):.0f} records, "
+        f"{c(last, 'data/batches'):.0f} batches, "
+        f"stall {c(last, 'data/input_stall_seconds'):.3f} s")
+
+
+def main_input(argv):
+    if not argv:
+        raise SystemExit("usage: trace_summary.py input "
+                         "<telemetry.jsonl> [last_n]")
+    last_n = int(argv[1]) if len(argv) > 1 else None
+    steps, _ = load_steps(argv[0], last_n)
+    print(f"telemetry: {argv[0]}")
+    summarize_input(steps)
+
+
 def main_comm(argv):
     if not argv:
         raise SystemExit("usage: trace_summary.py comm "
@@ -538,6 +620,8 @@ def main():
     argv = sys.argv[1:]
     if argv and argv[0] == "steps":
         main_steps(argv[1:])
+    elif argv and argv[0] == "input":
+        main_input(argv[1:])
     elif argv and argv[0] == "comm":
         main_comm(argv[1:])
     elif argv and argv[0] == "profile":

@@ -16,6 +16,8 @@ fresh subprocess) kills a run on mesh A and resumes it on mesh B —
 SIGTERM preemption and mid-write kills both — asserting the loss
 curve CONTINUES across the reshard and no torn state survives.
 """
+import glob
+import json
 import os
 import signal
 import subprocess
@@ -225,6 +227,148 @@ def test_spmd_kill_mid_write_then_resume_on_smaller_mesh(tmp_path):
     np.testing.assert_allclose(losses, base_losses[2:], rtol=1e-4)
     for a, b in zip(params, base_params):
         np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
+
+
+def _ledger_entries(path):
+    """Parsed ledger lines; a SIGKILL-torn final line is skipped (it
+    belongs to a batch whose step never happened)."""
+    entries = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entries.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return entries
+
+
+def _ledger_ids(entries, max_tag=None):
+    return [i for e in entries
+            if max_tag is None or e["tag"] <= max_tag
+            for i in e["ids"]]
+
+
+def _kill_worker_at(args, iter_line, sig=signal.SIGKILL, timeout=300,
+                    manifest_dir=None):
+    """Run the worker, hard-kill it once `iter <n>` appears on stdout;
+    returns collected stdout.  ``manifest_dir`` additionally waits for
+    at least one COMMITTED checkpoint manifest before killing — the
+    async writer races the kill otherwise, and a run killed before its
+    first commit has nothing to resume (a test-setup race, not the
+    property under test)."""
+    p = subprocess.Popen([sys.executable, _WORKER, *args],
+                         env=_worker_env(), stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + timeout
+        for line in p.stdout:
+            if line.startswith(f"iter {iter_line}") \
+                    or time.time() > deadline:
+                break
+        if manifest_dir is not None:
+            while time.time() < deadline and p.poll() is None:
+                if glob.glob(os.path.join(str(manifest_dir), "ckpt_*",
+                                          "MANIFEST.json")):
+                    break
+                time.sleep(0.05)
+        p.send_signal(sig)
+        rest = p.communicate(timeout=timeout)[0]
+    finally:
+        if p.poll() is None:
+            p.kill()
+    return rest, p.returncode
+
+
+def test_sigkill_data_cursor_resume_exact_sample_stream(tmp_path):
+    """SIGKILL mid-epoch with the sharded streaming pipeline: the data
+    cursor in the last committed checkpoint re-positions the stream, so
+    ledger(run1 up to the resume iteration) + ledger(run2) must be
+    BIT-IDENTICAL to the uninterrupted run's sample-ID stream — no
+    sample re-seen, none skipped — and the final params match too."""
+    data_dir = str(tmp_path / "shards")
+    # 160 records / batch 16 = 10 batches per epoch; 14 iterations
+    # cross the epoch boundary mid-epoch-2
+    ref_out = tmp_path / "ref.npz"
+    _run_worker(tmp_path / "ck_ref", ref_out, "data_cursor",
+                f"data_dir={data_dir}", "iters=14", check_rc=0)
+    ref_ids = _ledger_ids(_ledger_entries(str(ref_out) + ".ledger.jsonl"))
+    assert len(ref_ids) == 14 * 16
+
+    ck = tmp_path / "ck"
+    killed = tmp_path / "killed.npz"
+    _, rc = _kill_worker_at(
+        [str(ck), str(killed), _ITERS, "data_cursor",
+         f"data_dir={data_dir}", "iters=14", "step_sleep=25"],
+        iter_line=6, manifest_dir=ck)
+    assert rc == -signal.SIGKILL, rc
+    assert not killed.exists()
+    run1 = _ledger_entries(str(killed) + ".ledger.jsonl")
+    assert run1, "killed run pulled no batches?"
+
+    resumed = tmp_path / "resumed.npz"
+    r = _run_worker(ck, resumed, "data_cursor", f"data_dir={data_dir}",
+                    "iters=14", check_rc=0)
+    m = [l for l in r.stdout.splitlines() if l.startswith("RESUME")]
+    assert m, f"resume did not restore a checkpoint:\n{r.stdout}"
+    resume_iter = int(m[0].split("iteration=")[1].split()[0])
+    assert 0 < resume_iter < 14
+    run2 = _ledger_entries(str(resumed) + ".ledger.jsonl")
+    spliced = _ledger_ids(run1, max_tag=resume_iter) + _ledger_ids(run2)
+    assert spliced == ref_ids, (
+        f"sample stream diverged after SIGKILL-resume at iteration "
+        f"{resume_iter}: {len(spliced)} vs {len(ref_ids)} ids")
+    _assert_bit_identical(_params(resumed), _params(ref_out))
+
+
+@pytest.mark.slow
+def test_spmd_sigkill_data_cursor_dp4_to_dp2(tmp_path):
+    """The elastic variant: SIGKILL a dp4 run fed by the streaming
+    pipeline, resume on dp2.  The pipeline feeds the GLOBAL batch, so
+    the cursor is mesh-independent and the spliced sample-ID stream
+    must equal the uninterrupted dp4 run's bit for bit."""
+    data_dir = str(tmp_path / "shards")
+    ref_out = tmp_path / "ref.npz"
+    _run_spmd(tmp_path / "ck_ref", ref_out, "dp4", "data",
+              f"data_dir={data_dir}", "iters=10", check_rc=0)
+    ref_ids = _ledger_ids(_ledger_entries(str(ref_out) + ".ledger.jsonl"))
+    assert len(ref_ids) == 10 * 8
+
+    ck = tmp_path / "ck"
+    killed = tmp_path / "killed.npz"
+    _, rc = _kill_worker_at(
+        [str(ck), str(killed), _ITERS, "spmd", "mesh=dp4",
+         "ckpt_every=2", "data", f"data_dir={data_dir}", "iters=10",
+         "step_sleep=50"],
+        iter_line=5, timeout=600, manifest_dir=ck)
+    assert rc == -signal.SIGKILL, rc
+    run1 = _ledger_entries(str(killed) + ".ledger.jsonl")
+    assert run1
+
+    resumed = tmp_path / "resumed.npz"
+    r = _run_spmd(ck, resumed, "dp2", "data", f"data_dir={data_dir}",
+                  "iters=10", check_rc=0)
+    m = [l for l in r.stdout.splitlines() if l.startswith("RESUME")]
+    assert m, f"resume did not restore a checkpoint:\n{r.stdout}"
+    resume_step = int(m[0].split("step=")[1].split()[0])
+    assert 0 < resume_step < 10
+    assert "[elastic] resharded" in r.stdout, r.stdout
+    run2 = _ledger_entries(str(resumed) + ".ledger.jsonl")
+    # spmd tags are step indices: run1 consumed steps 0..k-1, run2
+    # starts at k — strictly-below splice (local mode is 1-based)
+    spliced = _ledger_ids(run1, max_tag=resume_step - 1) \
+        + _ledger_ids(run2)
+    assert spliced == ref_ids, (
+        f"dp4→dp2 sample stream diverged at step {resume_step}: "
+        f"{len(spliced)} vs {len(ref_ids)} ids")
+    # the curve is same-math across a device-count change (reassociated
+    # reductions): tight allclose, per docs/checkpointing.md
+    _, losses = _spmd_results(resumed)
+    _, ref_losses = _spmd_results(ref_out)
+    np.testing.assert_allclose(losses, ref_losses[resume_step:],
+                               rtol=1e-4)
 
 
 def test_sigterm_preemption_commits_final_checkpoint(tmp_path):

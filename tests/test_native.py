@@ -103,6 +103,53 @@ def test_prefetched_dataset_propagates_errors():
         list(PrefetchedDataSet(Exploding()).data())
 
 
+def test_prefetched_dataset_abandoned_consumer_stops_fill_thread():
+    """Regression: a consumer that breaks out (or drops the iterator)
+    used to strand the fill thread blocked on q.put forever — one
+    leaked thread plus `depth` pinned batches per abandoned epoch.
+    The stop-aware puts + GC finalizer must unpark it."""
+    import gc
+    import threading
+    import time
+    from bigdl_tpu.data.dataset import DataSet
+    from bigdl_tpu.data.prefetch import PrefetchedDataSet
+
+    rs = np.random.RandomState(0)
+    ds = DataSet.minibatch_arrays(rs.randn(64, 4).astype(np.float32),
+                                  rs.randn(64, 1).astype(np.float32),
+                                  batch_size=4)
+    # break mid-iteration: the generator's finally must close the fill
+    for i, _mb in enumerate(PrefetchedDataSet(ds, depth=2).data()):
+        if i == 1:
+            break
+    # the terminal-sentinel variant: a 3-batch source with depth=2 —
+    # the producer drains the source and parks on the FINAL q.put(_END)
+    # with the queue full; close must unpark that put too
+    small = DataSet.minibatch_arrays(
+        rs.randn(12, 4).astype(np.float32),
+        rs.randn(12, 1).astype(np.float32), batch_size=4)
+    it3 = PrefetchedDataSet(small, depth=2).data()
+    next(it3)
+    time.sleep(0.3)     # let the producer reach the sentinel put
+    it3.close()
+    del it3
+    # drop a RAW iterator without ever closing: only the GC finalizer
+    # can stop it, so the fill thread must not keep `self` reachable
+    from bigdl_tpu.data.prefetch import _PrefetchIterator
+    raw = _PrefetchIterator(lambda: iter(ds.data(train=False)), depth=2)
+    next(raw)
+    del raw
+    gc.collect()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.name == "bigdl-prefetch" and t.is_alive()]
+        if not leaked:
+            break
+        time.sleep(0.05)
+    assert not leaked, f"stranded prefetch threads: {leaked}"
+
+
 @needs_native
 def test_file_record_dataset_feeds_training(tmp_path):
     """CIFAR-binary-style records -> native prefetch -> decode -> train."""
