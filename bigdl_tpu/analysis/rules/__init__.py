@@ -5,9 +5,10 @@ from .gl002_host_sync import GL002HostSync
 from .gl003_locks import GL003Locks
 from .gl004_spans import GL004Spans
 from .gl005_recompile import GL005Recompile
+from .gl006_retry import GL006Retry
 
 ALL_RULES = (GL001Donation(), GL002HostSync(), GL003Locks(),
-             GL004Spans(), GL005Recompile())
+             GL004Spans(), GL005Recompile(), GL006Retry())
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}
 

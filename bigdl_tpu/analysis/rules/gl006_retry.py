@@ -1,0 +1,100 @@
+"""GL006 — naive retry loops & silently swallowed I/O errors.
+
+The bug family this PR's retry layer retires:
+
+  GL006-a  a ``while``/``for`` loop that calls ``time.sleep(<literal>)``
+           directly in its body — the constant-sleep retry/poll shape.
+           No backoff means a persistent failure burns CPU at a fixed
+           rate forever; no jitter means every worker retries in
+           lockstep (thundering herd on the shared filesystem the
+           failure came from); no deadline means the loop outlives the
+           caller's patience.  Use
+           :class:`bigdl_tpu.utils.retry.RetryPolicy` (exponential
+           backoff + full jitter + wall-clock deadline) for retries,
+           or ``Event.wait(timeout)`` for polls that should wake early.
+
+  GL006-b  ``except OSError: pass`` (or ``IOError``, or a tuple
+           containing either) — an I/O failure reduced to silence.
+           The checkpoint-GC shape: one un-deletable dir and the sweep
+           "works" while the disk quietly fills.  Log it and count it
+           (``rec.inc``), or classify it through the retry layer;
+           best-effort paths that really may ignore the error say so
+           in the baseline justification.
+
+Library-only: a test's poll loop is its synchronization, a timing
+script's sleep is its measurement, and test cleanup may ignore I/O
+errors by design.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .base import (Project, Rule, SourceFile, Violation, ancestors,
+                   call_name)
+
+_IO_EXC_NAMES = ("OSError", "IOError", "EnvironmentError")
+
+
+def _sleep_literal(call: ast.Call) -> bool:
+    if call_name(call) not in ("time.sleep", "sleep"):
+        return False
+    if not call.args:
+        return False
+    arg = call.args[0]
+    return isinstance(arg, ast.Constant) \
+        and isinstance(arg.value, (int, float))
+
+
+def _directly_in_loop(node: ast.AST) -> bool:
+    """True when the nearest loop/function ancestor is a loop: a sleep
+    inside a nested def is that function's business, not the loop's."""
+    for a in ancestors(node):
+        if isinstance(a, (ast.While, ast.For, ast.AsyncFor)):
+            return True
+        if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return False
+    return False
+
+
+def _names_io_error(expr: ast.AST) -> bool:
+    if expr is None:
+        return False
+    if isinstance(expr, ast.Tuple):
+        return any(_names_io_error(e) for e in expr.elts)
+    name = ""
+    if isinstance(expr, ast.Name):
+        name = expr.id
+    elif isinstance(expr, ast.Attribute):
+        name = expr.attr
+    return name in _IO_EXC_NAMES
+
+
+class GL006Retry(Rule):
+    id = "GL006"
+    title = "naive retry loops & swallowed I/O errors"
+    library_only = True
+
+    def check(self, src: SourceFile, project: Project) -> List[Violation]:
+        out: List[Violation] = []
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Call) and _sleep_literal(node) \
+                    and _directly_in_loop(node):
+                out.append(self.violation(
+                    src, node,
+                    "constant time.sleep() in a retry/poll loop: no "
+                    "backoff, no jitter, no deadline — use "
+                    "utils.retry.RetryPolicy for retries or "
+                    "Event.wait(timeout) for polls"))
+            if isinstance(node, ast.ExceptHandler) \
+                    and _names_io_error(node.type) \
+                    and all(isinstance(stmt, ast.Pass)
+                            for stmt in node.body):
+                out.append(self.violation(
+                    src, node,
+                    "except OSError: pass swallows an I/O failure "
+                    "silently; log + count it (rec.inc) or classify "
+                    "it via utils.retry — justify genuine best-effort "
+                    "paths in the baseline"))
+        return out

@@ -141,12 +141,25 @@ def _kill_offset_within(kind: str, nbytes: int) -> Optional[int]:
     return None
 
 
-def guarded_write(path: str, data: bytes, kind: str = "shard"):
+def guarded_write(path: str, data: bytes, kind: str = "shard",
+                  recorder=None):
     """Write ``data`` to a FRESH file at ``path`` (O_EXCL) with fsync,
     honoring the active fault plan.  On a planned kill, exactly the
     prefix up to the configured offset is flushed to disk before
-    ``os._exit`` — a maximally-torn file for resume to reject."""
+    ``os._exit`` — a maximally-torn file for resume to reject.
+
+    Both fault planes apply: the legacy ``BIGDL_CKPT_FAULT`` byte-offset
+    kill grammar above, and the repo-wide ``BIGDL_FAULT`` sites
+    ``ckpt.shard_write`` / ``ckpt.manifest`` (:mod:`bigdl_tpu.faults`),
+    whose ``err:``/``delay:``/``corrupt:`` modes model the *transient*
+    failures the retry layer must survive — an err raises before any
+    byte lands, so a retried write starts clean."""
+    from .. import faults as _plane
+    site = "ckpt.manifest" if "manifest" in kind else "ckpt.shard_write"
+    data, plane_kill = _plane.filter_write(site, data, recorder)
     kill_at = _kill_offset_within(kind, len(data))
+    if kill_at is None:
+        kill_at = plane_kill
     fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o666)
     try:
         if kill_at is not None:

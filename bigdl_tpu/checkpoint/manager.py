@@ -38,6 +38,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 from . import faults, manifest as mlib, reshard
 from .manifest import DIR_PREFIX, Manifest, Shard, data_crc32c, safe_tag
 from .writer import AsyncCheckpointWriter
+from ..utils.retry import RetryPolicy
 
 
 def host_snapshot(tree):
@@ -91,7 +92,7 @@ class CheckpointManager:
                  recorder_fn: Optional[Callable] = None,
                  max_pending: int = 2,
                  process_index: int = 0, process_count: int = 1,
-                 part_timeout: float = 120.0):
+                 part_timeout: float = 120.0, write_retries: int = 3):
         if layout not in ("manifest", "file"):
             raise ValueError(f"unknown checkpoint layout {layout!r}")
         if keep_last is not None and keep_last < 1:
@@ -112,6 +113,12 @@ class CheckpointManager:
         # thread, so writes+GC are serialized and FIFO-ordered
         self.writer = AsyncCheckpointWriter(max_pending=max_pending,
                                             recorder_fn=recorder_fn)
+        # transient write errors (EIO/ENOSPC blips) retry before the
+        # checkpoint counts as failed; EROFS/EACCES stay fatal — a
+        # read-only filesystem does not heal within a backoff budget
+        self._retry = RetryPolicy(max_attempts=max(1, int(write_retries)),
+                                  base=0.05, max_delay=1.0,
+                                  recorder_fn=recorder_fn, name="ckpt")
 
     def _rec(self):
         if self._rec_fn is None:
@@ -174,6 +181,17 @@ class CheckpointManager:
         return "checkpoint.async_write" if self.async_write \
             else "checkpoint.write"
 
+    def _write_shard_retrying(self, fpath: str, data: bytes):
+        """One shard write with transient-error retry.  Each attempt
+        starts clean: a failed earlier attempt (or a stale same-tag
+        leftover) may have left a partial O_EXCL file behind."""
+        def attempt():
+            if os.path.exists(fpath):
+                os.remove(fpath)
+            faults.guarded_write(fpath, data, kind="shard",
+                                 recorder=self._rec())
+        self._retry.run(attempt)
+
     def _write_manifest_ckpt(self, trees, meta, tag, mesh=None, owned=None):
         rec = self._rec()
         t0 = time.perf_counter()
@@ -203,9 +221,7 @@ class CheckpointManager:
             data = _serialize_tree(payload)
             fname = f"shard{i:04d}.bin"
             fpath = os.path.join(d, fname)
-            if os.path.exists(fpath):
-                os.remove(fpath)
-            faults.guarded_write(fpath, data, kind="shard")
+            self._write_shard_retrying(fpath, data)
             if reshard.is_fragment_payload(payload):
                 shards.append(Shard(name, fname, len(data),
                                     data_crc32c(data), kind="slices",
@@ -219,21 +235,24 @@ class CheckpointManager:
         faults.on_pre_manifest()
         mf = Manifest(tag=str(tag), meta=meta, shards=shards,
                       created=time.time(), mesh=mesh)
+        # manifest commits retry transient errors too: _write_json_atomic
+        # cleans up its tmp on failure, so every attempt starts fresh
         if self.process_count > 1:
-            mlib.write_manifest_part(d, self.process_index, mf)
+            self._retry.run(mlib.write_manifest_part, d,
+                            self.process_index, mf, recorder=rec)
             if self.process_index != 0:
                 return      # host 0 owns the commit + pointer + GC
             mf = mlib.merge_manifest_parts(d, self.process_count,
                                            timeout=self.part_timeout)
-            mlib.write_manifest(d, mf)
+            self._retry.run(mlib.write_manifest, d, mf, recorder=rec)
         else:
-            mlib.write_manifest(d, mf)
-        mlib.write_latest_pointer(self.root, os.path.basename(d))
+            self._retry.run(mlib.write_manifest, d, mf, recorder=rec)
+        self._write_pointer_safely(os.path.basename(d))
         dt = time.perf_counter() - t0
         rec.inc("checkpoint/committed")
         rec.inc("checkpoint/write_seconds", dt)
         rec.add_span(self._span_name(), dt)
-        self._gc_manifest(current=os.path.basename(d))
+        self._gc_safely(self._gc_manifest, current=os.path.basename(d))
 
     def _write_file_ckpt(self, state, meta, tag):
         rec = self._rec()
@@ -242,28 +261,84 @@ class CheckpointManager:
         path = os.path.join(self.root, f"checkpoint_{safe_tag(tag)}.bin")
         data = _serialize_tree({"state": state, "meta": meta})
         tmp = f"{path}.tmp-{os.getpid()}"
-        if os.path.exists(tmp):
-            os.remove(tmp)
-        try:
-            faults.guarded_write(tmp, data, kind="shard")
+
+        def attempt():
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            faults.guarded_write(tmp, data, kind="shard",
+                                 recorder=self._rec())
             os.replace(tmp, path)
+        try:
+            self._retry.run(attempt)
         finally:
             if os.path.exists(tmp):
                 os.remove(tmp)
         mlib.fsync_dir(self.root)
         # legacy pointer: the checkpoint FILE path (old tools read this)
-        mlib.write_latest_pointer(self.root, path)
+        self._write_pointer_safely(path)
         dt = time.perf_counter() - t0
         rec.inc("checkpoint/bytes_written", len(data))
         rec.inc("checkpoint/committed")
         rec.inc("checkpoint/write_seconds", dt)
         rec.add_span(self._span_name(), dt)
-        self._gc_file(current=path)
+        self._gc_safely(self._gc_file, current=path)
+
+    def _write_pointer_safely(self, value: str):
+        """The ``latest`` pointer is an optimization only — resume
+        falls back to scanning when it is missing or stale.  It is
+        written AFTER the manifest (the commit point) is durable, so a
+        pointer failure must not mark a complete, restorable checkpoint
+        failed: transient errors retry through the unified policy, and
+        an exhausted or fatal failure is logged + counted
+        (``checkpoint/pointer_skipped``) — the next commit rewrites the
+        pointer and resume scans in the meantime."""
+        try:
+            self._retry.run(mlib.write_latest_pointer, self.root, value)
+        except OSError as e:
+            self._rec().inc("checkpoint/pointer_skipped")
+            # best effort: drop the now-STALE pointer so resume scans
+            # newest-first instead of preferring the older checkpoint
+            # the un-updated pointer still names
+            try:
+                os.remove(os.path.join(self.root, mlib.LATEST_NAME))
+                stale = "stale pointer dropped"
+            except OSError:
+                stale = "stale pointer not removable either"
+            print(f"[checkpoint] latest-pointer update failed ({e!r}); "
+                  f"{stale}; the commit stands — resume scans "
+                  "manifests, the next commit rewrites the pointer",
+                  flush=True)
 
     # -- retention ------------------------------------------------------- #
     def _gc_enabled(self) -> bool:
         return (self.keep_last is not None
                 or self.keep_every_epochs is not None)
+
+    def _gc_remove(self, path: str, rmdir: bool = True):
+        """Remove one retention candidate; an un-deletable entry
+        (permission, ENOENT race with a concurrent cleaner) is logged
+        and counted — never silently ignored, never aborts the sweep.
+        The next sweep retries it."""
+        try:
+            if rmdir:
+                shutil.rmtree(path)
+            else:
+                os.remove(path)
+        except OSError as e:
+            self._rec().inc("checkpoint/gc_skipped")
+            print(f"[checkpoint] gc: could not remove {path} ({e!r}); "
+                  "skipped — the next sweep retries it", flush=True)
+
+    def _gc_safely(self, fn, current: str):
+        """The sweep runs after a successful commit: a GC failure must
+        not mark the checkpoint failed (or kill the writer job), only
+        announce itself."""
+        try:
+            fn(current=current)
+        except OSError as e:
+            self._rec().inc("checkpoint/gc_skipped")
+            print(f"[checkpoint] gc sweep failed ({e!r}); the commit "
+                  "stands, the next sweep retries", flush=True)
 
     def _gc_manifest(self, current: str):
         if not self._gc_enabled():
@@ -284,7 +359,7 @@ class CheckpointManager:
                     protect.add(os.path.basename(d))
         for d, _ in cands:
             if os.path.basename(d) not in protect:
-                shutil.rmtree(d, ignore_errors=True)
+                self._gc_remove(d)
         # torn leftovers (no valid manifest) from crashed writers.  Only
         # single-writer roots: with multiple hosts, a manifest-less dir
         # may be another host's save IN PROGRESS, not garbage
@@ -294,7 +369,7 @@ class CheckpointManager:
                 full = os.path.join(self.root, d)
                 if (d.startswith(DIR_PREFIX) and os.path.isdir(full)
                         and d not in intact and d not in protect):
-                    shutil.rmtree(full, ignore_errors=True)
+                    self._gc_remove(full)
 
     def _gc_file(self, current: str):
         if not self._gc_enabled() or not self.keep_last:
@@ -313,10 +388,7 @@ class CheckpointManager:
                     protect.add(os.path.abspath(p))
         for p in files[:-self.keep_last]:
             if os.path.abspath(p) not in protect:
-                try:
-                    os.remove(p)
-                except OSError:
-                    pass
+                self._gc_remove(p, rmdir=False)
 
     # -- restore --------------------------------------------------------- #
     @staticmethod
@@ -363,8 +435,18 @@ class CheckpointManager:
                 order.append(hit)
         order.extend(c for c in reversed(cands)
                      if not order or c[0] != order[0][0])
+        rec = self._rec()
         for d, mf in order:
             problems = mlib.verify(d, mf, deep=True)
+            if problems:
+                # one re-read before falling back a whole checkpoint:
+                # a deep-CRC mismatch can be a transient read blip
+                # (NFS/page-cache), and the next-older checkpoint costs
+                # real training progress.  A genuinely torn file fails
+                # the second pass identically.
+                rec.inc("retry/attempts")
+                rec.inc("checkpoint/verify_retries")
+                problems = mlib.verify(d, mf, deep=True)
             if problems:
                 print(f"[checkpoint] {d}: {problems[0]}; trying older "
                       "checkpoints")

@@ -173,7 +173,7 @@ def fsync_dir(path: str):
         os.close(fd)
 
 
-def _write_json_atomic(path: str, obj, kind: str):
+def _write_json_atomic(path: str, obj, kind: str, recorder=None):
     """tmp (fault-injectable, fsync'ed) + os.replace + dir fsync."""
     from . import faults
     data = json.dumps(obj, sort_keys=True).encode()
@@ -181,7 +181,7 @@ def _write_json_atomic(path: str, obj, kind: str):
     if os.path.exists(tmp):
         os.remove(tmp)
     try:
-        faults.guarded_write(tmp, data, kind=kind)
+        faults.guarded_write(tmp, data, kind=kind, recorder=recorder)
         os.replace(tmp, path)
     finally:
         if os.path.exists(tmp):
@@ -189,17 +189,21 @@ def _write_json_atomic(path: str, obj, kind: str):
     fsync_dir(os.path.dirname(path) or ".")
 
 
-def write_manifest(ckpt_dir: str, manifest: Manifest):
-    """Commit a checkpoint: the manifest write IS the commit point."""
+def write_manifest(ckpt_dir: str, manifest: Manifest, recorder=None):
+    """Commit a checkpoint: the manifest write IS the commit point.
+    ``recorder`` routes ckpt.manifest fault-injection counters to the
+    caller's telemetry (same contract as the shard writes)."""
     _write_json_atomic(os.path.join(ckpt_dir, MANIFEST_NAME),
-                       manifest.to_json(), kind="manifest")
+                       manifest.to_json(), kind="manifest",
+                       recorder=recorder)
 
 
-def write_manifest_part(ckpt_dir: str, part_index: int, manifest: Manifest):
+def write_manifest_part(ckpt_dir: str, part_index: int,
+                        manifest: Manifest, recorder=None):
     """One host's contribution (its owned shards); NOT a commit."""
     _write_json_atomic(
         os.path.join(ckpt_dir, f"{PART_PREFIX}{part_index}.json"),
-        manifest.to_json(), kind="manifest_part")
+        manifest.to_json(), kind="manifest_part", recorder=recorder)
 
 
 def merge_manifest_parts(ckpt_dir: str, n_parts: int,
@@ -301,9 +305,16 @@ def write_latest_pointer(root: str, value: str):
     """Atomically update the ``latest`` pointer (tmp + os.replace)."""
     path = os.path.join(root, LATEST_NAME)
     tmp = f"{path}.tmp-{os.getpid()}"
-    with open(tmp, "w") as f:
-        f.write(value)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "w") as f:
+            f.write(value)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # no tmp litter on any failure path — a stale latest.tmp-<pid>
+        # would otherwise survive until the next save from the same pid
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        raise
     fsync_dir(root)

@@ -49,12 +49,24 @@ Telemetry (``data/*`` family, registered in docs/observability.md):
 ``data/input_stall_seconds`` (consumer blocked on an empty staging
 queue — THE number this module exists to zero), ``data/queue_depth``,
 ``data/h2d_bytes``, ``data/decode_seconds``, ``data/records_read``,
-``data/resync_skipped_bytes``, ``data/batches``.
+``data/resync_skipped_bytes``, ``data/batches``,
+``data/files_skipped`` (shards abandoned after retries — degradation,
+never silence: each one also lands as a ``health_event``).
+
+Transient-fault posture: shard opens and record reads run under a
+:class:`~bigdl_tpu.utils.retry.RetryPolicy` — a transient EIO re-reads
+the file from the current record index (yielded-record indices are
+stable, so nothing is re-seen or skipped); on giveup (or a fatal errno
+like EACCES) the worker SKIPS that file with a loud
+``data/files_skipped`` count + health event instead of killing the
+epoch.  The ``data.shard_open`` / ``data.record_read`` sites of
+:mod:`bigdl_tpu.faults` make both paths testable.
 """
 from __future__ import annotations
 
 import os
 import queue
+import random
 import struct
 import threading
 import time
@@ -64,7 +76,9 @@ from typing import Callable, List, Optional, Sequence
 import numpy as np
 
 from .dataset import DataSet
+from .. import faults as faultplane
 from ..utils.crc32c import masked_crc32c
+from ..utils.retry import RetryPolicy
 
 CURSOR_VERSION = 1
 
@@ -76,6 +90,17 @@ _WEND = ("end",)     # batcher consumed a worker's terminal sentinel
 class _RaiseItem:
     def __init__(self, exc):
         self.exc = exc
+
+
+class _DecodeFailure(Exception):
+    """Wrapper that carries a user decode() exception PAST the worker's
+    I/O-error handling: a decode bug must surface at the consumer even
+    when it happens to raise OSError (a missing side file, say) — the
+    retry-then-skip degradation is for shard I/O only."""
+
+    def __init__(self, error):
+        super().__init__(repr(error))
+        self.error = error
 
 
 def _put(q: "queue.Queue", item, stop: threading.Event,
@@ -504,7 +529,8 @@ class ShardedRecordDataSet(DataSet):
                  drop_last: bool = True, shuffle: bool = True,
                  collate: Optional[Callable] = None,
                  place_fn: Optional[Callable] = None,
-                 decode_rng: bool = False, recorder=None):
+                 decode_rng: bool = False, recorder=None,
+                 read_retries: int = 3, retry_base: float = 0.05):
         if fmt not in ("tfrecord", "seqfile", "fixed"):
             raise ValueError(f"unknown shard format {fmt!r}")
         if fmt == "fixed" and not record_bytes:
@@ -532,6 +558,8 @@ class ShardedRecordDataSet(DataSet):
         self.place_fn = place_fn
         self.decode_rng = bool(decode_rng)
         self.recorder = recorder
+        self.read_retries = max(1, int(read_retries))
+        self.retry_base = float(retry_base)
         self._cursor: Optional[dict] = None
         self._size: Optional[int] = None
 
@@ -795,26 +823,86 @@ class ShardedRecordDataSet(DataSet):
         def on_skip(n):
             stats["skipped"] += n
 
+        # transient read errors retry per FILE from the current record
+        # index (yielded-record indices are stable across re-reads, so
+        # a retried file resumes exactly where it stopped — exactly-once
+        # survives the retry); the jitter RNG is seeded per worker so a
+        # resumed run schedules identically
+        policy = RetryPolicy(
+            max_attempts=self.read_retries, base=self.retry_base,
+            max_delay=0.5, rng=random.Random(self.seed * 31 + w),
+            recorder_fn=lambda: rec, name="data")
+
         try:
             for li, (fi, start) in enumerate(plan):
                 off = int(start)
-                for payload in self._records(int(fi), off, on_skip):
-                    t0 = time.perf_counter()
-                    if self.decode is None:
-                        sample = payload
-                    elif self.decode_rng:
-                        sample = self.decode(payload, self._record_rng(
-                            epoch, int(fi), off))
-                    else:
-                        sample = self.decode(payload)
-                    stats["decode"] += time.perf_counter() - t0
-                    stats["read"] += 1
-                    off += 1
-                    flush()
-                    if not _put(q, (sample, li, off), stop):
-                        return
-                    if stop.is_set():
-                        return
+                # a retried attempt re-SCANS bytes the failed attempt
+                # already salvaged past: replay the first `counted`
+                # skip bytes silently (they were accounted) and count
+                # only the excess.  Corrupt regions re-read in the same
+                # order with the same sizes, so a byte-level high-water
+                # mark is exact — no double count when the failure came
+                # late, no undercount when it came before the first
+                # yield
+                counted = [0]       # skip bytes accounted for this file
+                replayed = [0]      # skip bytes re-seen this attempt
+
+                def skip_gate(n, _on_skip=on_skip, _c=counted,
+                              _r=replayed):
+                    fresh = max(0, _r[0] + n - _c[0])
+                    _r[0] += n
+                    if fresh:
+                        _c[0] += fresh
+                        _on_skip(fresh)
+
+                def read_file(li=li, fi=int(fi), _r=replayed):
+                    nonlocal off
+                    _r[0] = 0
+                    faultplane.inject("data.shard_open", rec)
+                    for payload in self._records(fi, off, skip_gate):
+                        faultplane.inject("data.record_read", rec)
+                        t0 = time.perf_counter()
+                        try:
+                            if self.decode is None:
+                                sample = payload
+                            elif self.decode_rng:
+                                sample = self.decode(
+                                    payload,
+                                    self._record_rng(epoch, fi, off))
+                            else:
+                                sample = self.decode(payload)
+                        except BaseException as e:
+                            raise _DecodeFailure(e) from e
+                        stats["decode"] += time.perf_counter() - t0
+                        stats["read"] += 1
+                        off += 1
+                        flush()
+                        if not _put(q, (sample, li, off), stop):
+                            return False
+                        if stop.is_set():
+                            return False
+                    return True
+
+                try:
+                    alive = policy.run(read_file)
+                except _DecodeFailure as e:
+                    raise e.error       # code bug: surface, never skip
+                except OSError as e:
+                    # retries exhausted (or a fatal errno like EACCES):
+                    # degrade, never die — skip THIS file loudly and
+                    # keep streaming the rest of the plan
+                    rec.inc("data/files_skipped")
+                    rec.emit_record(
+                        "health_event", condition="data_file_skipped",
+                        step=None, metric="data/files_skipped",
+                        value=float(fi), threshold=None, action="skip")
+                    print(f"[data] worker {w}: skipping shard "
+                          f"{self.paths[int(fi)]} after retries "
+                          f"({e!r}); this epoch is degraded by that "
+                          "file's remaining records", flush=True)
+                    continue
+                if not alive:
+                    return
             _put(q, _END, stop)
         except BaseException as e:      # surfaced at the consumer
             _put(q, _RaiseItem(e), stop)

@@ -25,13 +25,57 @@ cursor; fixed GLOBAL batch across replans keeps the math identical).
 Every transition lands in the Recorder as ``elastic/*`` counters and
 ``elastic_event`` + ``health_event`` records, so /metrics and
 ``trace_summary health`` show the shrink/regrow history.
+
+**Hang-abort** (``hang_abort_grace=``): a step that never finishes is
+the failure mode retries can't see — the loop is blocked INSIDE
+``trainer.step``.  The supervisor arms the PR-4 :class:`StallWatchdog`
+with an escalation policy: grace seconds past stall detection, the
+watchdog dumps a flight record and the supervisor raises
+:class:`HangAbortError` *asynchronously in the step-loop thread*
+(``PyThreadState_SetAsyncExc`` — lands at the next bytecode boundary,
+so it aborts Python-level wedges: a stuck retry loop, a poisoned
+queue wait, an injected ``step.dispatch`` delay; a hang inside a
+native/XLA call is only interruptible at process level, which the
+flight dump serves).  The segment's existing failure path catches it:
+teardown, backoff, re-plan, resume from the last checkpoint — a wedged
+step becomes a replan instead of an operator page.
+
+Backoff runs through :class:`~bigdl_tpu.utils.retry.RetryPolicy`
+(``jitter=False`` reproduces the exact legacy
+``min(base * 2**(n-1), max)`` schedule — equivalence-tested), so
+supervisor restarts share the ``retry/*`` counters with every other
+retry loop in the repo.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Callable, Dict, Optional
 
 from .plan import _prod, plan_devices, plan_mesh
+from .. import faults as faultplane
+from ..utils.retry import RetryPolicy
+
+
+class HangAbortError(RuntimeError):
+    """Raised asynchronously in the supervisor's step loop when the
+    watchdog's hang-abort escalation fires; handled as a segment
+    failure (replan-and-resume), never propagated to the caller unless
+    restarts are exhausted."""
+
+
+def _async_raise(thread_ident: int, exc_type) -> bool:
+    """Raise ``exc_type`` in the thread with ``thread_ident`` at its
+    next bytecode boundary.  Returns False when the thread is gone (or
+    the interpreter refused) — the caller logs rather than assumes."""
+    import ctypes
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(thread_ident), ctypes.py_object(exc_type))
+    if res > 1:         # >1 = multiple states touched: undo, refuse
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(thread_ident), None)
+        return False
+    return res == 1
 
 
 class ElasticSupervisor:
@@ -51,7 +95,9 @@ class ElasticSupervisor:
                  min_axes: Optional[Dict[str, int]] = None,
                  replan_every: int = 10, max_restarts: int = 5,
                  backoff_base: float = 0.5, backoff_max: float = 30.0,
-                 handle_sigterm: bool = True):
+                 handle_sigterm: bool = True,
+                 hang_abort_grace: Optional[float] = None,
+                 watchdog=None, flight_dir: Optional[str] = None):
         self.trainer_factory = trainer_factory
         self.ckpt_dir = str(ckpt_dir)
         self.template = {str(k): int(v) for k, v in template.items()}
@@ -67,11 +113,27 @@ class ElasticSupervisor:
         self.backoff_base = float(backoff_base)
         self.backoff_max = float(backoff_max)
         self.handle_sigterm = bool(handle_sigterm)
+        # the unified backoff: jitter=False reproduces the legacy
+        # min(base * 2**(n-1), max) schedule bit-for-bit, and the
+        # retry/* counters make restarts observable next to every
+        # other retry loop in the repo
+        self.retry = RetryPolicy(max_attempts=self.max_restarts + 1,
+                                 base=self.backoff_base,
+                                 max_delay=self.backoff_max,
+                                 jitter=False, name="elastic",
+                                 recorder_fn=self._rec)
+        # hang-abort escalation: None = off (see module docstring)
+        self.hang_abort_grace = None if hang_abort_grace is None \
+            else float(hang_abort_grace)
+        self.watchdog = watchdog
+        self.flight_dir = flight_dir
         self.state = "idle"
         self.restarts = 0
         self.trainer = None
         self._stop = False
         self._preemption = None
+        self._loop_ident: Optional[int] = None
+        self._in_segment = False
 
     # ------------------------------------------------------------------ #
     def _rec(self):
@@ -108,6 +170,45 @@ class ElasticSupervisor:
         """Ask run() to commit a checkpoint and return at the next
         step boundary (callable from any thread)."""
         self._stop = True
+
+    # -- hang-abort ---------------------------------------------------- #
+    def _setup_watchdog(self):
+        """Build (or adopt) the stall watchdog and arm its hang-abort
+        escalation against this supervisor's step loop."""
+        if self.hang_abort_grace is None:
+            return None
+        wd = self.watchdog
+        if wd is None:
+            from ..observability.health import StallWatchdog
+            wd = StallWatchdog(self._rec(), poll_interval=0.1)
+            self.watchdog = wd
+        flight = None
+        if self.flight_dir is not None:
+            from ..observability.health import FlightRecorder
+            flight = FlightRecorder(self._rec(), self.flight_dir)
+        wd.set_escalation(self.hang_abort_grace,
+                          self._abort_wedged_step, flight=flight)
+        return wd
+
+    def _abort_wedged_step(self):
+        """Watchdog escalation callback (runs on the poll thread):
+        asynchronously raise HangAbortError in the step-loop thread so
+        the wedged segment fails into the normal replan path.  Outside
+        a running segment (the wedge resolved itself between the
+        verdict and this call) it only logs — the raise would land in
+        teardown/commit code that is making progress."""
+        ident = self._loop_ident
+        if not self._in_segment or ident is None:
+            print("[elastic] hang-abort requested outside a running "
+                  "segment; ignored", flush=True)
+            return
+        self._rec().inc("elastic/hang_aborts")
+        print("[elastic] hang-abort: raising HangAbortError in the "
+              "step loop — the wedged segment becomes a replan-and-"
+              "resume", flush=True)
+        if not _async_raise(ident, HangAbortError):
+            print("[elastic] hang-abort: could not signal the "
+                  "step-loop thread (already gone?)", flush=True)
 
     # ------------------------------------------------------------------ #
     def _build(self, axes, devices):
@@ -155,125 +256,183 @@ class ElasticSupervisor:
                 self._preemption = PreemptionHandler()
             self._preemption.install()
         handler = self._preemption
+        self._loop_ident = threading.get_ident()
+        wd = self._setup_watchdog()
         losses: Dict[int, Any] = {}     # device scalars until segment drain
         prev_axes = None
         first_step = None
         try:
             while True:
-                self._set_state("planning")
-                devices = self._capacity()
-                axes = plan_mesh(len(devices), self.template,
-                                 self.min_axes)
-                rec.gauge("elastic/devices", _prod(axes))
-                for name, size in axes.items():
-                    rec.gauge(f"elastic/axis_{name}", size)
-                self._set_state("resuming")
                 try:
-                    trainer, resumed = self._build(axes, devices)
-                except Exception:
-                    if not self._backoff("build"):
+                    self._set_state("planning")
+                    devices = self._capacity()
+                    axes = plan_mesh(len(devices), self.template,
+                                     self.min_axes)
+                    rec.gauge("elastic/devices", _prod(axes))
+                    for name, size in axes.items():
+                        rec.gauge(f"elastic/axis_{name}", size)
+                    self._set_state("resuming")
+                    try:
+                        trainer, resumed = self._build(axes, devices)
+                    except Exception:
+                        if not self._backoff("build"):
+                            raise
+                        continue
+                    if prev_axes is not None and axes != prev_axes:
+                        # emitted only AFTER a successful build: a failed
+                        # build's plan is a mesh the job never ran on, and
+                        # must not show up as a topology transition
+                        kind = "shrink" if _prod(axes) < _prod(prev_axes) \
+                            else "regrow"
+                        self._event(kind, from_axes=prev_axes, to_axes=axes,
+                                    devices=_prod(axes))
+                        print(f"[elastic] {kind}: {prev_axes} -> {axes}",
+                              flush=True)
+                    prev_axes = axes
+                    self.trainer = trainer
+                    if resumed:
+                        self._event("resume", step=trainer._step_count,
+                                    devices=_prod(axes), axes=axes)
+                    start = trainer._step_count
+                    if first_step is None:
+                        first_step = start
+                    outcome, fail = "completed", None
+                    self._set_state("running")
+                    if wd is not None:
+                        # armed only while the step loop runs: a long
+                        # rebuild/restore between segments must not read
+                        # as a wedged step and re-escalate
+                        wd.start()
+                    self._in_segment = True
+                    try:
+                        for s in range(start, steps):
+                            if self._stop:
+                                outcome = "stopped"
+                                break
+                            if handler is not None and handler.requested:
+                                outcome = "preempted"
+                                break
+                            if (self.replan_every and s > start
+                                    and (s - start) % self.replan_every == 0):
+                                new_axes = plan_mesh(len(self._capacity()),
+                                                     self.template,
+                                                     self.min_axes)
+                                if new_axes != axes:
+                                    outcome = "replan"
+                                    break
+                            tokens, targets = batch_fn(s)
+                            # the step.dispatch fault site: a delay: here
+                            # models the wedge class the hang-abort exists
+                            # for (and IS how the chaos matrix proves a
+                            # wedged step ends in a replan, not a page)
+                            faultplane.inject("step.dispatch", rec)
+                            # device scalar, no float(): a per-step host
+                            # sync would serialize dispatch against
+                            # execution (GL002) — the floats are only
+                            # needed at segment boundaries, and the bulk
+                            # sync below runs before the mesh is torn down
+                            if wd is not None and s == start:
+                                # every segment's first step compiles
+                                # (fresh trainer, possibly a new mesh) —
+                                # minutes of legitimate XLA work that
+                                # must not be read as a wedge and
+                                # hang-aborted into a replan loop.
+                                # Steps 2..N run under the full verdict
+                                with wd.suspended():
+                                    losses[s] = trainer.step(tokens,
+                                                             targets)
+                            else:
+                                losses[s] = trainer.step(tokens, targets)
+                            rec.gauge("elastic/steps_done", s + 1)
+                            if (self.ckpt_every
+                                    and (s + 1) % self.ckpt_every == 0
+                                    and s + 1 < steps):
+                                trainer.save_checkpoint(self.ckpt_dir)
+                        # one bulk device→host sync per SEGMENT (GL002):
+                        # the scalars must materialize before this mesh is
+                        # torn down — and inside the try, so a device lost
+                        # mid-drain is retried/replanned like any other
+                        # segment failure, not a supervisor death
+                        self._drain_losses(losses, strict=True)
+                    except Exception as e:      # noqa: BLE001 — retried
+                        # HangAbortError lands here too: a wedged step IS
+                        # a failed segment — teardown, backoff, replan
+                        outcome, fail = "failed", e
+                        # best effort on the failure path: keep what still
+                        # materializes, drop dead-mesh scalars (the resume
+                        # recomputes everything past the last checkpoint)
+                        self._drain_losses(losses, strict=False)
+                    finally:
+                        self._in_segment = False
+                        if wd is not None:
+                            wd.stop()
+                    self._set_state("draining")
+                    if outcome == "failed":
+                        self._teardown(self.trainer)
+                        self.trainer = None
+                        if not self._backoff("segment", fail):
+                            raise fail
+                        continue
+                    # clean outcomes commit a final synchronous checkpoint:
+                    # nothing after this point can lose a completed step.
+                    # A zero-new-step resumed segment skips it — its state
+                    # is bit-identical to the checkpoint just restored, and
+                    # rewriting every shard would stall shutdown for a full
+                    # write for zero progress
+                    tag = f"preempt_step_{trainer._step_count}" \
+                        if outcome == "preempted" else None
+                    if trainer._step_count > start or not resumed:
+                        trainer.save_checkpoint(self.ckpt_dir, sync=True,
+                                                tag=tag)
+                    self._teardown(trainer)
+                    self.trainer = None
+                    self.restarts = 0           # a committed segment resets
+                    if outcome == "preempted":
+                        self._event("preemption", step=trainer._step_count,
+                                    devices=_prod(axes))
+                        print(f"[elastic] preempted at step "
+                              f"{trainer._step_count}; final checkpoint "
+                              "committed, re-planning from surviving "
+                              "capacity", flush=True)
+                        handler.reset()
+                        continue
+                    if outcome == "replan":
+                        continue
+                    self._set_state("idle")
+                    # `in losses`: a failed segment may have dropped dead-
+                    # mesh scalars that no later resume recomputed (steps
+                    # before its own mid-segment checkpoint)
+                    return [losses[s]
+                            for s in range(first_step, max(losses) + 1)
+                            if s in losses] \
+                        if losses else []
+                except HangAbortError as e:
+                    # the async abort can land AFTER the step loop's
+                    # finally — the wedge released in the tiny window
+                    # between the verdict and the raise, so the
+                    # exception hit drain/commit/teardown code instead.
+                    # Wherever in the segment body it lands, it is ONE
+                    # segment failure, never a supervisor death.  A
+                    # deliberate re-raise after an exhausted backoff
+                    # passes straight through.
+                    if self.restarts > self.max_restarts:
+                        raise
+                    # mirror the inner failure path: materialize what
+                    # still lives BEFORE the mesh is torn down — an
+                    # abort that interrupted the inner drain would
+                    # otherwise leave device scalars whose buffers die
+                    # with the teardown in the final return value
+                    self._drain_losses(losses, strict=False)
+                    stale = self.trainer
+                    if stale is not None:
+                        try:
+                            self._teardown(stale)
+                        except Exception:
+                            pass
+                        self.trainer = None
+                    if not self._backoff("hang_abort", e):
                         raise
                     continue
-                if prev_axes is not None and axes != prev_axes:
-                    # emitted only AFTER a successful build: a failed
-                    # build's plan is a mesh the job never ran on, and
-                    # must not show up as a topology transition
-                    kind = "shrink" if _prod(axes) < _prod(prev_axes) \
-                        else "regrow"
-                    self._event(kind, from_axes=prev_axes, to_axes=axes,
-                                devices=_prod(axes))
-                    print(f"[elastic] {kind}: {prev_axes} -> {axes}",
-                          flush=True)
-                prev_axes = axes
-                self.trainer = trainer
-                if resumed:
-                    self._event("resume", step=trainer._step_count,
-                                devices=_prod(axes), axes=axes)
-                start = trainer._step_count
-                if first_step is None:
-                    first_step = start
-                outcome, fail = "completed", None
-                self._set_state("running")
-                try:
-                    for s in range(start, steps):
-                        if self._stop:
-                            outcome = "stopped"
-                            break
-                        if handler is not None and handler.requested:
-                            outcome = "preempted"
-                            break
-                        if (self.replan_every and s > start
-                                and (s - start) % self.replan_every == 0):
-                            new_axes = plan_mesh(len(self._capacity()),
-                                                 self.template,
-                                                 self.min_axes)
-                            if new_axes != axes:
-                                outcome = "replan"
-                                break
-                        tokens, targets = batch_fn(s)
-                        # device scalar, no float(): a per-step host
-                        # sync would serialize dispatch against
-                        # execution (GL002) — the floats are only
-                        # needed at segment boundaries, and the bulk
-                        # sync below runs before the mesh is torn down
-                        losses[s] = trainer.step(tokens, targets)
-                        rec.gauge("elastic/steps_done", s + 1)
-                        if (self.ckpt_every
-                                and (s + 1) % self.ckpt_every == 0
-                                and s + 1 < steps):
-                            trainer.save_checkpoint(self.ckpt_dir)
-                    # one bulk device→host sync per SEGMENT (GL002):
-                    # the scalars must materialize before this mesh is
-                    # torn down — and inside the try, so a device lost
-                    # mid-drain is retried/replanned like any other
-                    # segment failure, not a supervisor death
-                    self._drain_losses(losses, strict=True)
-                except Exception as e:      # noqa: BLE001 — retried
-                    outcome, fail = "failed", e
-                    # best effort on the failure path: keep what still
-                    # materializes, drop dead-mesh scalars (the resume
-                    # recomputes everything past the last checkpoint)
-                    self._drain_losses(losses, strict=False)
-                self._set_state("draining")
-                if outcome == "failed":
-                    self._teardown(self.trainer)
-                    self.trainer = None
-                    if not self._backoff("segment", fail):
-                        raise fail
-                    continue
-                # clean outcomes commit a final synchronous checkpoint:
-                # nothing after this point can lose a completed step.
-                # A zero-new-step resumed segment skips it — its state
-                # is bit-identical to the checkpoint just restored, and
-                # rewriting every shard would stall shutdown for a full
-                # write for zero progress
-                tag = f"preempt_step_{trainer._step_count}" \
-                    if outcome == "preempted" else None
-                if trainer._step_count > start or not resumed:
-                    trainer.save_checkpoint(self.ckpt_dir, sync=True,
-                                            tag=tag)
-                self._teardown(trainer)
-                self.trainer = None
-                self.restarts = 0           # a committed segment resets
-                if outcome == "preempted":
-                    self._event("preemption", step=trainer._step_count,
-                                devices=_prod(axes))
-                    print(f"[elastic] preempted at step "
-                          f"{trainer._step_count}; final checkpoint "
-                          "committed, re-planning from surviving "
-                          "capacity", flush=True)
-                    handler.reset()
-                    continue
-                if outcome == "replan":
-                    continue
-                self._set_state("idle")
-                # `in losses`: a failed segment may have dropped dead-
-                # mesh scalars that no later resume recomputed (steps
-                # before its own mid-segment checkpoint)
-                return [losses[s]
-                        for s in range(first_step, max(losses) + 1)
-                        if s in losses] \
-                    if losses else []
         finally:
             if self.handle_sigterm and handler is not None:
                 handler.uninstall()
@@ -296,15 +455,17 @@ class ElasticSupervisor:
         return losses
 
     def _backoff(self, what: str, exc: Exception = None) -> bool:
-        """Count a failure; sleep exponentially; False when retries are
-        exhausted (caller re-raises)."""
+        """Count a failure; sleep per the unified RetryPolicy schedule
+        (jitter off — identical to the legacy exponential); False when
+        retries are exhausted (caller re-raises)."""
         self.restarts += 1
         self._event("failure", attempt=self.restarts, what=what,
                     error=None if exc is None else repr(exc))
         if self.restarts > self.max_restarts:
+            self.retry.count_giveup()
             return False
-        delay = min(self.backoff_base * (2 ** (self.restarts - 1)),
-                    self.backoff_max)
+        delay = self.retry.delay_for(self.restarts)
+        self.retry.count_attempt()
         print(f"[elastic] {what} failed ({exc!r}); retry "
               f"{self.restarts}/{self.max_restarts} in {delay:.1f}s",
               flush=True)

@@ -1,0 +1,320 @@
+"""Repo-wide transient-fault injection plane.
+
+``checkpoint/faults.py`` proved the discipline for crash consistency:
+every durability claim is tested by actually injecting the failure.
+This package generalizes it from "kill the checkpoint writer" to the
+whole transient-fault surface — named injection *sites* across the
+checkpoint, data, serving, HTTP, and step-dispatch paths, armed from
+one environment variable, so every retry/degrade/abort claim in
+``docs/robustness.md`` is provable by a test that (a) asserts the fault
+actually fired (``fault/injected_total``) and (b) asserts the system
+survived it.
+
+Sites (the stable names tests and operators use)::
+
+    ckpt.shard_write    checkpoint shard payload write
+    ckpt.manifest       manifest / manifest-part commit write
+    data.shard_open     opening one shard file in a data worker
+    data.record_read    reading one record out of an open shard
+    serving.swap        registry weight hot-swap (validate + publish)
+    http.bind           introspection-server socket bind
+    step.dispatch       the supervisor's per-step dispatch
+
+Grammar (``BIGDL_FAULT`` env var or :func:`arm`)::
+
+    "<site>:<mode>[@<nth>]"   one spec; join several with ";"
+
+    modes:   err:<errno>      raise OSError(errno) — number or name
+                              (``err:EIO``, ``err:28``)
+             delay:<ms>       block for <ms> milliseconds (sleeps in
+                              small chunks, so a hang-abort's async
+                              exception can land mid-delay — a real
+                              wedge is abortable, a test one must be)
+             corrupt:<n>      flip <n> bytes of the write payload
+                              (write sites only; control sites no-op)
+             kill:<offset>    write sites: flush exactly <offset>
+                              payload bytes, then ``os._exit`` — the
+                              checkpoint/faults torn-write protocol.
+                              Control sites: immediate ``os._exit``
+
+    @<nth>:  which match fires.  ``@2`` fires ONLY on the 3rd match of
+             that site (0-based), ``@2+`` on every match from the 3rd
+             onward; omitted = every match.  Match counting is
+             thread-safe, so "fail exactly one shard read across a
+             4-worker pool" is expressible.
+
+The legacy ``BIGDL_CKPT_FAULT`` grammar (see
+:mod:`bigdl_tpu.checkpoint.faults`) keeps working unchanged — it is the
+byte-offset-precise alias for the two ``ckpt.*`` sites, and
+``guarded_write`` consults both planes.
+
+Every fired fault increments ``fault/injected_total`` (and the per-site
+``fault/injected.<site>``) on the recorder the site passes — or the
+process-global recorder when the site has none — plus a process-local
+count readable via :func:`injected_total` even with telemetry off.
+Tests assert these so "the run survived" can never silently mean "the
+fault never fired".
+"""
+from __future__ import annotations
+
+import errno as _errno
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+ENV_VAR = "BIGDL_FAULT"
+#: same exit code as checkpoint/faults — parents of kill tests match it
+KILL_EXIT_CODE = 42
+
+SITES = ("ckpt.shard_write", "ckpt.manifest", "data.shard_open",
+         "data.record_read", "serving.swap", "http.bind",
+         "step.dispatch")
+
+_MODES = ("err", "delay", "corrupt", "kill")
+
+
+class FaultSpec:
+    """One armed fault: site, mode, numeric argument, match selector."""
+
+    __slots__ = ("site", "mode", "arg", "nth", "onward", "hits", "fired")
+
+    def __init__(self, site: str, mode: str, arg: int,
+                 nth: Optional[int] = None, onward: bool = False):
+        if site not in SITES:
+            raise ValueError(f"unknown fault site {site!r}; "
+                             f"sites: {', '.join(SITES)}")
+        if mode not in _MODES:
+            raise ValueError(f"unknown fault mode {mode!r}; "
+                             f"modes: {', '.join(_MODES)}")
+        self.site = site
+        self.mode = mode
+        self.arg = int(arg)
+        self.nth = nth              # None = every match
+        self.onward = onward        # "@n+": from the nth match onward
+        self.hits = 0               # site matches observed
+        self.fired = 0              # faults actually injected
+
+    def __repr__(self):
+        sel = "" if self.nth is None else \
+            f"@{self.nth}{'+' if self.onward else ''}"
+        return f"{self.site}:{self.mode}:{self.arg}{sel}"
+
+
+def _parse_errno(text: str) -> int:
+    try:
+        return int(text)
+    except ValueError:
+        num = getattr(_errno, text.strip().upper(), None)
+        if isinstance(num, int):
+            return num
+        raise ValueError(f"unknown errno {text!r} in {ENV_VAR} spec")
+
+
+def parse(spec: str) -> List[FaultSpec]:
+    """Parse one ``BIGDL_FAULT`` value (possibly ``;``-joined) into
+    specs; raises ValueError with the offending fragment on bad input."""
+    out: List[FaultSpec] = []
+    for frag in spec.split(";"):
+        frag = frag.strip()
+        if not frag:
+            continue
+        nth, onward = None, False
+        body = frag
+        if "@" in frag:
+            body, sel = frag.rsplit("@", 1)
+            if sel.endswith("+"):
+                onward, sel = True, sel[:-1]
+            try:
+                nth = int(sel)
+            except ValueError:
+                raise ValueError(
+                    f"bad match selector {sel!r} in {ENV_VAR} spec "
+                    f"{frag!r} (want @<nth> or @<nth>+)") from None
+        parts = body.split(":")
+        if len(parts) != 3:
+            raise ValueError(
+                f"bad {ENV_VAR} spec {frag!r}: want "
+                "<site>:<mode>:<arg>[@<nth>[+]]")
+        site, mode, arg = parts
+        if mode == "err":
+            out.append(FaultSpec(site, mode, _parse_errno(arg), nth,
+                                 onward))
+        else:
+            try:
+                out.append(FaultSpec(site, mode, int(arg), nth, onward))
+            except ValueError:
+                raise ValueError(
+                    f"bad numeric argument {arg!r} in {ENV_VAR} spec "
+                    f"{frag!r}") from None
+    return out
+
+
+_lock = threading.Lock()
+_specs: Optional[List[FaultSpec]] = None
+_env_checked = False
+_counts: Dict[str, int] = {}
+
+
+def arm(spec) -> None:
+    """Arm programmatically: a spec string, a list of FaultSpecs, or
+    None to disarm.  Overrides the environment."""
+    global _specs, _env_checked
+    with _lock:
+        if spec is None:
+            _specs = None
+        elif isinstance(spec, str):
+            _specs = parse(spec)
+        else:
+            _specs = list(spec)
+        _env_checked = True     # explicit arm/disarm beats the env
+
+
+def disarm() -> None:
+    arm(None)
+
+
+def reset() -> None:
+    """Test seam: drop the plan, counts, and the env-read latch so the
+    next site check re-reads ``BIGDL_FAULT``."""
+    global _specs, _env_checked
+    with _lock:
+        _specs = None
+        _env_checked = False
+        _counts.clear()
+
+
+def injected_total(site: Optional[str] = None) -> int:
+    """Process-local fired-fault count (per site, or all sites) — the
+    recorder-free way for a subprocess to assert its fault fired."""
+    with _lock:
+        if site is not None:
+            return _counts.get(site, 0)
+        return sum(_counts.values())
+
+
+def _active() -> List[FaultSpec]:
+    global _env_checked, _specs
+    with _lock:
+        if not _env_checked:
+            _env_checked = True
+            env = os.environ.get(ENV_VAR)
+            if env:
+                _specs = parse(env)
+        return _specs or []
+
+
+def _match(site: str, exclude_modes=()) -> Optional[FaultSpec]:
+    """Thread-safe match counting; returns the spec that fires for this
+    occurrence of ``site``, or None.
+
+    EVERY armed spec for the site observes every occurrence (its
+    ``hits`` advances even when another spec fires first), so
+    ``"s:err:EIO@0;s:err:EIO@1"`` fires on occurrences 0 AND 1 — not
+    0 and 2.  When several specs select the same occurrence the first
+    armed one fires.  ``exclude_modes`` makes a spec ineligible to fire
+    at this call site (its hits still advance) — e.g. ``corrupt`` at a
+    control site has no payload to corrupt, and counting it as fired
+    would let a chaos assertion pass vacuously."""
+    if _specs is None and _env_checked:
+        return None             # fast path: disarmed (benign race)
+    _active()
+    with _lock:
+        if not _specs:
+            return None
+        fired: Optional[FaultSpec] = None
+        for s in _specs:
+            if s.site != site:
+                continue
+            n = s.hits
+            s.hits += 1
+            if fired is None and s.mode not in exclude_modes and (
+                    s.nth is None
+                    or (n >= s.nth if s.onward else n == s.nth)):
+                s.fired += 1
+                fired = s
+        if fired is not None:
+            _counts[site] = _counts.get(site, 0) + 1
+        return fired
+
+
+def _record(site: str, mode: str, recorder=None) -> None:
+    rec = recorder
+    if rec is None:
+        try:
+            from ..observability import get_recorder
+            rec = get_recorder()
+        except Exception:
+            return
+    try:
+        rec.inc("fault/injected_total")
+        rec.inc(f"fault/injected.{site}")
+        rec.emit_record("fault_event", site=site, mode=mode)
+    except Exception:
+        pass                    # telemetry must never mask the fault
+
+
+def _sleep_chunked(seconds: float) -> None:
+    # chunked so PyThreadState_SetAsyncExc (the hang-abort escalation
+    # path) can land between sleeps: an async exception raised during
+    # one long time.sleep only fires after the whole sleep returns
+    deadline = time.monotonic() + seconds
+    while True:
+        left = deadline - time.monotonic()
+        if left <= 0:
+            return
+        time.sleep(left if left < 0.05 else 0.05)
+
+
+def _raise_err(spec: FaultSpec, site: str):
+    raise OSError(spec.arg, f"injected fault at {site} "
+                            f"[{_errno.errorcode.get(spec.arg, spec.arg)}]")
+
+
+def inject(site: str, recorder=None) -> bool:
+    """Control-flow sites: raise ``err``, block ``delay``, die ``kill``
+    per the armed plan.  ``corrupt`` has no payload here: the spec is
+    ineligible (never fires, never counts — a counted no-op would let
+    a chaos assertion pass without any fault happening).  Returns True
+    when a (non-raising) fault fired."""
+    spec = _match(site, exclude_modes=("corrupt",))
+    if spec is None:
+        return False
+    _record(site, spec.mode, recorder)
+    if spec.mode == "err":
+        _raise_err(spec, site)
+    if spec.mode == "delay":
+        _sleep_chunked(spec.arg / 1e3)
+    elif spec.mode == "kill":
+        os._exit(KILL_EXIT_CODE)
+    return True
+
+
+def filter_write(site: str, data: bytes, recorder=None
+                 ) -> Tuple[bytes, Optional[int]]:
+    """Write sites: returns ``(payload, kill_offset)``.  ``err`` raises
+    before any byte lands, ``delay`` blocks, ``corrupt`` flips the last
+    ``n`` bytes (a torn-tail shape CRC verification must catch), and
+    ``kill`` hands the caller the offset for its flush-prefix-then-die
+    protocol (see ``checkpoint.faults.guarded_write``)."""
+    spec = _match(site)
+    if spec is None:
+        return data, None
+    _record(site, spec.mode, recorder)
+    if spec.mode == "err":
+        _raise_err(spec, site)
+    if spec.mode == "delay":
+        _sleep_chunked(spec.arg / 1e3)
+        return data, None
+    if spec.mode == "corrupt":
+        n = max(1, min(spec.arg, len(data))) if data else 0
+        if n:
+            tail = bytes(b ^ 0xFF for b in data[-n:])
+            data = data[:-n] + tail
+        return data, None
+    return data, min(max(spec.arg, 0), len(data))       # kill
+
+
+__all__ = ["ENV_VAR", "KILL_EXIT_CODE", "SITES", "FaultSpec", "parse",
+           "arm", "disarm", "reset", "injected_total", "inject",
+           "filter_write"]

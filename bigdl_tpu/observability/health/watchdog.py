@@ -12,6 +12,19 @@ cold compile or an empty history can't trip it).  Crossing the budget:
     recovery flips the gauge back and re-arms the event
   * ``health/stall_seconds`` accrues while stalled
 
+**Hang-abort escalation** closes the gap between seeing a wedge and
+surviving it: :meth:`StallWatchdog.set_escalation` arms a grace period
+past stall *detection* after which the watchdog dumps a flight record
+(every counter/gauge/recent record at the moment of the hang — the
+post-mortem an operator would otherwise reconstruct from memory),
+emits a ``hang_abort`` health event + ``health/hang_aborts`` count,
+and invokes an abort callback ONCE per stall episode.  The
+``ElasticSupervisor`` wires that callback to raise in its step loop,
+turning a wedged step into a replan-and-resume instead of an operator
+page; standalone users can wire ``os._exit`` style process abort for
+hangs stuck in native code.  The callback and flight dump run OFF the
+verdict lock, so a slow dump can't block concurrent /healthz scrapes.
+
 Straggler attribution: step records under a multi-host
 :class:`SpmdTrainer` carry a ``host`` scalar; :func:`attribute_stragglers`
 groups records per host and names the slowest one and its skew vs the
@@ -28,7 +41,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 
 def _p99(durs: List[float]) -> float:
@@ -93,6 +106,13 @@ class StallWatchdog:
         # right after resume
         self._suspend = 0
         self._resumed_at: Optional[float] = None
+        # hang-abort escalation (set_escalation): grace past stall
+        # detection, then flight dump + abort callback, once/episode
+        self._escalate_after: Optional[float] = None
+        self._esc_callback: Optional[Callable] = None
+        self._esc_flight = None
+        self._escalated = False         # this episode already escalated
+        self._esc_fire = False          # check_once: fire outside lock
 
     # -- budget ------------------------------------------------------------ #
     def budget(self) -> Optional[float]:
@@ -105,13 +125,32 @@ class StallWatchdog:
             return None
         return max(_p99(durs) * self.factor, self.floor_seconds)
 
+    def set_escalation(self, grace: float, callback: Optional[Callable],
+                       flight=None) -> "StallWatchdog":
+        """Arm hang-abort escalation: ``grace`` seconds after a stall is
+        DETECTED (i.e. budget + grace after the step wedged), dump a
+        flight record via ``flight`` (a FlightRecorder, or None) and
+        invoke ``callback()`` — once per stall episode; recovery
+        re-arms.  ``grace=None`` disarms."""
+        with self._check_lock:
+            self._escalate_after = None if grace is None else float(grace)
+            self._esc_callback = callback
+            self._esc_flight = flight
+            self._escalated = False
+        return self
+
     def check_once(self) -> bool:
         """One poll; returns the current stalled verdict.  Public so
         tests (and /healthz handlers without a running thread) can
         evaluate the budget synchronously.  Thread-safe: the polling
         thread and concurrent /healthz scrapes share the verdict."""
         with self._check_lock:
-            return self._check_locked()
+            verdict = self._check_locked()
+            fire = self._esc_fire
+            self._esc_fire = False
+        if fire:
+            self._escalate()
+        return verdict
 
     def suspended(self):
         """Context manager marking legitimate between-step work (an
@@ -165,10 +204,52 @@ class StallWatchdog:
                          if "straggler" in ev else ""), flush=True)
         elif self._stalled:
             self._clear_stall_locked()
+        if (self._stalled and self._escalate_after is not None
+                and not self._escalated
+                and self._stall_started is not None
+                and time.time() - self._stall_started
+                >= self._escalate_after):
+            # mark under the lock (one escalation per episode even with
+            # concurrent scrapes), FIRE outside it — the flight dump
+            # does real IO and the callback is arbitrary caller code
+            self._escalated = True
+            self._esc_fire = True
         return self._stalled
+
+    def _escalate(self):
+        """The hang-abort action (called OFF the verdict lock): flight
+        dump + health event + abort callback.  A failing dump must not
+        eat the abort — the callback is the part that un-wedges."""
+        rec = self.recorder
+        age = rec.step_age()
+        rec.inc("health/hang_aborts")
+        rec.inc("health/events")
+        rec.emit_record("health_event", condition="hang_abort",
+                        step=rec.last_step(), metric="step_age_s",
+                        value=age, threshold=self._escalate_after,
+                        action="abort")
+        print(f"[health] hang-abort: stalled past the "
+              f"{self._escalate_after:g}s escalation grace (step age "
+              f"{age if age is None else round(age, 2)}s); dumping "
+              "flight record and invoking the abort callback",
+              flush=True)
+        if self._esc_flight is not None:
+            try:
+                self._esc_flight.dump("hang_abort",
+                                      extra={"step_age_s": age})
+            except Exception as e:
+                print(f"[health] hang-abort flight dump failed: {e!r}",
+                      flush=True)
+        if self._esc_callback is not None:
+            try:
+                self._esc_callback()
+            except Exception as e:
+                print(f"[health] hang-abort callback failed: {e!r}",
+                      flush=True)
 
     def _clear_stall_locked(self):
         # *_locked: every caller holds self._check_lock (GL003)
+        self._escalated = False     # recovery re-arms the escalation
         if not self._stalled:
             return
         self._stalled = False
@@ -190,6 +271,12 @@ class StallWatchdog:
         # check_once, after its first poll sleep
         with self._check_lock:
             self._active = True
+            # re-baseline idle age from the moment of arming: with a
+            # shared recorder the last step record may predate a long
+            # stopped interval (the elastic supervisor's teardown/
+            # backoff/rebuild gap between segments), and that gap is
+            # not loop inactivity
+            self._resumed_at = time.time()
             if self._thread is None or not self._thread.is_alive():
                 # a FRESH event per poller thread: reusing one event
                 # means a start() racing stop()'s join window could

@@ -37,6 +37,7 @@ keeps one slow scraper from starving the next probe.
 """
 from __future__ import annotations
 
+import errno
 import json
 import math
 import threading
@@ -45,6 +46,7 @@ from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
 from .sinks import _json_default, render_prometheus
+from ..utils.retry import RetryPolicy
 
 
 def _finite_json(obj):
@@ -70,7 +72,8 @@ class IntrospectionServer:
 
     def __init__(self, recorder, port: int = 0, host: str = "127.0.0.1",
                  watchdog=None, monitor=None, namespace: str = "bigdl",
-                 records_default: int = 50, trace_source=None):
+                 records_default: int = 50, trace_source=None,
+                 bind_retries: int = 4):
         self.recorder = recorder
         self.host = host
         self.port = int(port)           # 0 -> ephemeral, bound in start()
@@ -81,6 +84,7 @@ class IntrospectionServer:
         # zero-arg callable returning a Chrome-trace JSON string (e.g.
         # ServingEngine.dump_chrome_trace); None -> /trace is 404
         self.trace_source = trace_source
+        self.bind_retries = int(bind_retries)
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -105,7 +109,21 @@ class IntrospectionServer:
                     except Exception:
                         pass
 
-        srv = ThreadingHTTPServer((self.host, self.port), Handler)
+        def bind():
+            from .. import faults as faultplane
+            faultplane.inject("http.bind", self.recorder)
+            return ThreadingHTTPServer((self.host, self.port), Handler)
+
+        # a fixed port just vacated by a predecessor (serve_metrics
+        # reconfiguration, a supervisor restart) can sit in TIME_WAIT
+        # for a beat: EADDRINUSE is the one transient bind error worth
+        # retrying — anything else (bad host, privileged port) is fatal
+        srv = RetryPolicy(
+            max_attempts=self.bind_retries, base=0.1, max_delay=1.0,
+            classify=lambda e: (isinstance(e, OSError)
+                                and e.errno == errno.EADDRINUSE),
+            recorder_fn=lambda: self.recorder, name="http.bind",
+        ).run(bind)
         srv.daemon_threads = True
         self._server = srv
         self.port = srv.server_address[1]

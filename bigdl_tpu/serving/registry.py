@@ -28,7 +28,9 @@ from typing import Any, Dict, Optional, Tuple
 import jax
 import numpy as np
 
+from .. import faults as faultplane
 from ..nn.module import Module
+from ..utils.retry import RetryPolicy
 
 
 class Snapshot:
@@ -84,6 +86,12 @@ class ModelRegistry:
     def __init__(self):
         self._entries: Dict[str, ModelEntry] = {}
         self._lock = threading.Lock()
+        # a hot-swap that hits a transient blip (weights streamed off
+        # storage, an injected serving.swap fault) retries briefly
+        # before the publisher sees a failure; the old snapshot keeps
+        # serving throughout, so retrying here is free of risk
+        self._swap_retry = RetryPolicy(max_attempts=3, base=0.01,
+                                       max_delay=0.2, name="serving.swap")
 
     # -- registration ----------------------------------------------------- #
     def register(self, name: str, model: Module, *,
@@ -166,10 +174,17 @@ class ModelRegistry:
             old = entry.snapshot
             new_params = old.params if params is None else params
             new_state = old.state if state is None else state
-            _check_same_avals(f"{name}.params", old.params, new_params)
-            _check_same_avals(f"{name}.state", old.state, new_state)
-            snap = Snapshot(new_params, new_state,
-                            version or entry.next_version())
+
+            def validate():
+                faultplane.inject("serving.swap")
+                _check_same_avals(f"{name}.params", old.params,
+                                  new_params)
+                _check_same_avals(f"{name}.state", old.state, new_state)
+                return Snapshot(new_params, new_state,
+                                version or entry.next_version())
+            # transient-only retries; a ValueError (shape/dtype drift)
+            # is fatal and raises with the old snapshot still serving
+            snap = self._swap_retry.run(validate)
             entry.snapshot = snap          # the atomic publish
             # keep the shell module coherent for non-serving callers
             entry.model._params = new_params

@@ -43,11 +43,15 @@ def save(obj, path: str, is_overwrite: bool = True):
                 pickle.dump(host, f, protocol=pickle.HIGHEST_PROTOCOL)
                 f.flush()
                 os.fsync(f.fileno())
+        os.replace(tmp, path)
     except BaseException:
-        if os.path.exists(tmp):   # no torn .tmp litter on failure
+        # no torn .tmp litter on ANY failure path — including a raise
+        # from os.replace itself (cross-device rename, permission),
+        # which previously left the O_EXCL tmp behind and made every
+        # subsequent save of the same path trip over it
+        if os.path.exists(tmp):
             os.remove(tmp)
         raise
-    os.replace(tmp, path)
 
 
 def load(path: str):

@@ -1,0 +1,179 @@
+"""Unified transient-fault retry: one policy object for every
+retry-with-backoff loop in the repo.
+
+The reference BigDL leans on Spark's task retry — a transient executor
+or storage error is re-run by the driver, never surfaced to the job
+(arXiv:1804.05839).  We have no driver, so every subsystem that touches
+the outside world (checkpoint writes, shard reads, socket binds, weight
+swaps, the elastic supervisor's rebuild loop) retries through THIS
+policy instead of hand-rolling its own:
+
+  * **exponential backoff + full jitter** — delay for retry ``n`` is
+    ``uniform(0, min(base * 2**(n-1), max_delay))`` off an injectable,
+    seedable RNG (``jitter=False`` gives the deterministic
+    ``min(base * 2**(n-1), max_delay)`` the elastic supervisor always
+    used — its rebase is behavior-preserving and tested as such);
+  * **bounded attempts AND a wall-clock deadline** — whichever trips
+    first ends the retry loop (a deadline of 2s with max_attempts=100
+    gives up at 2s: retrying past the caller's budget is just a slower
+    failure);
+  * **transient-vs-fatal classification** — the default classifier
+    treats EIO/ENOSPC/EAGAIN/EINTR/ETIMEDOUT/EBUSY/ESTALE (+
+    ``ConnectionError``/``TimeoutError``/``InterruptedError``) as
+    retryable and everything else (EROFS, EACCES, ENOENT, value
+    errors, code bugs) as fatal — fatal raises immediately, no sleep,
+    no counter;
+  * **observable** — each retry increments ``retry/attempts`` (and
+    ``retry/attempts.<name>``), each exhaustion ``retry/giveups``, on
+    the recorder from ``recorder_fn`` (default: the process recorder),
+    so "the fault was retried" is assertable, and a production log of
+    giveups is a metric, not a grep.
+
+The graftlint rule GL006 flags the hand-rolled alternative (constant
+``time.sleep`` in a retry loop, ``except OSError: pass``) so new code
+lands on this instead.
+"""
+from __future__ import annotations
+
+import errno
+import random
+import time
+from typing import Callable, Optional
+
+#: errnos worth retrying: the storage/net blips that clear on their own.
+#: EROFS/EACCES/EPERM/ENOENT are deliberately absent — a read-only or
+#: missing filesystem does not heal within a retry budget, and retrying
+#: it only delays the real error.
+TRANSIENT_ERRNOS = frozenset(
+    getattr(errno, name) for name in
+    ("EIO", "ENOSPC", "EAGAIN", "EINTR", "ETIMEDOUT", "EBUSY", "ESTALE",
+     "ECONNRESET", "ECONNABORTED", "ECONNREFUSED", "EPIPE")
+    if hasattr(errno, name))
+
+
+def default_classify(exc: BaseException) -> bool:
+    """True when ``exc`` is worth retrying."""
+    if isinstance(exc, (TimeoutError, InterruptedError, ConnectionError)):
+        return True
+    if isinstance(exc, OSError):
+        return exc.errno in TRANSIENT_ERRNOS
+    return False
+
+
+class RetryPolicy:
+    """Run callables with bounded, classified, jittered retries.
+
+    ``max_attempts``  total calls including the first (3 = 2 retries)
+    ``base``          first-retry backoff ceiling, seconds
+    ``max_delay``     backoff ceiling, seconds
+    ``deadline``      wall-clock budget from the first call; trumps
+                      ``max_attempts``
+    ``classify``      ``exc -> bool`` transient test (default above)
+    ``jitter``        full jitter (True) or deterministic exponential
+    ``rng``           ``random.Random`` (or int seed) the jitter draws
+                      from — seed it for reproducible test schedules
+    ``on_retry``      ``(attempt, exc, delay)`` hook before each sleep
+    ``name``          labels the per-call counters
+                      (``retry/attempts.<name>``)
+    ``recorder_fn``   zero-arg recorder supplier; default = the
+                      process-global recorder
+    ``sleep``         injectable for tests
+    """
+
+    def __init__(self, max_attempts: int = 3, base: float = 0.05,
+                 max_delay: float = 2.0,
+                 deadline: Optional[float] = None,
+                 classify: Optional[Callable[[BaseException], bool]] = None,
+                 jitter: bool = True, rng=None,
+                 on_retry: Optional[Callable] = None, name: str = "",
+                 recorder_fn: Optional[Callable] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.base = float(base)
+        self.max_delay = float(max_delay)
+        self.deadline = None if deadline is None else float(deadline)
+        self.classify = classify or default_classify
+        self.jitter = bool(jitter)
+        if rng is None or isinstance(rng, int):
+            rng = random.Random(rng)
+        self._rng = rng
+        self.on_retry = on_retry
+        self.name = name
+        self._rec_fn = recorder_fn
+        self._sleep = sleep
+
+    def _rec(self):
+        if self._rec_fn is not None:
+            rec = self._rec_fn()
+            if rec is not None:
+                return rec
+        from ..observability import get_recorder
+        return get_recorder()
+
+    def delay_for(self, attempt: int) -> float:
+        """Backoff before retry ``attempt`` (1-based).  With
+        ``jitter=False`` this is exactly the classic
+        ``min(base * 2**(attempt-1), max_delay)`` schedule."""
+        cap = min(self.base * (2 ** (max(attempt, 1) - 1)),
+                  self.max_delay)
+        if not self.jitter:
+            return cap
+        return self._rng.uniform(0.0, cap)
+
+    def run(self, fn: Callable, *args, **kwargs):
+        """Call ``fn`` until it returns, a fatal error raises, or the
+        attempt/deadline budget is exhausted (the last error re-raises
+        after a ``retry/giveups`` count)."""
+        start = time.monotonic()
+        attempt = 0
+        while True:
+            try:
+                return fn(*args, **kwargs)
+            except BaseException as e:      # noqa: BLE001 — classified below
+                attempt += 1
+                if not self.classify(e):
+                    raise               # fatal: no sleep, no counter
+                elapsed = time.monotonic() - start
+                exhausted = attempt >= self.max_attempts or (
+                    self.deadline is not None
+                    and elapsed >= self.deadline)
+                if exhausted:
+                    self._count("retry/giveups")
+                    raise
+                delay = self.delay_for(attempt)
+                if self.deadline is not None:
+                    # never sleep past the budget: the next (final)
+                    # attempt should run while time remains
+                    delay = min(delay,
+                                max(self.deadline - elapsed, 0.0))
+                self._count("retry/attempts")
+                if self.on_retry is not None:
+                    self.on_retry(attempt, e, delay)
+                if delay > 0:
+                    self._sleep(delay)
+
+    def count_attempt(self):
+        """Emit one ``retry/attempts`` (+ per-name split) — for callers
+        that drive their own retry state machine off :meth:`delay_for`
+        (the elastic supervisor's restart loop) so counter naming has
+        exactly one source of truth."""
+        self._count("retry/attempts")
+
+    def count_giveup(self):
+        """Emit one ``retry/giveups`` (+ per-name split); see
+        :meth:`count_attempt`."""
+        self._count("retry/giveups")
+
+    def _count(self, counter: str):
+        try:
+            rec = self._rec()
+            rec.inc(counter)
+            if self.name:
+                rec.inc(f"{counter}.{self.name}")
+        except Exception:
+            pass                # telemetry must never change the retry
+
+
+__all__ = ["RetryPolicy", "TRANSIENT_ERRNOS", "default_classify"]

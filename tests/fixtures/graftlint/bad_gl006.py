@@ -1,0 +1,42 @@
+"""Golden fixture: GL006 — constant-sleep retry loops, swallowed
+OSError.  The negatives at the bottom must stay unflagged."""
+import os
+import threading
+import time
+
+
+def fetch_with_retry(read):
+    for _ in range(5):
+        try:
+            return read()
+        except IOError:
+            time.sleep(0.5)                                # line 13
+    return None
+
+
+def poll_until(done):
+    while not done():
+        time.sleep(1)                                      # line 19
+
+
+def cleanup(path):
+    try:
+        os.remove(path)
+    except OSError:                                        # line 25
+        pass
+
+
+def negatives(done, delay):
+    ev = threading.Event()
+    while not done():
+        ev.wait(0.5)            # Event.wait can wake early: fine
+    while not done():
+        time.sleep(delay)       # variable delay: a policy decides it
+    for _ in range(3):
+        def helper():
+            time.sleep(0.1)     # nested def: the loop doesn't sleep
+        helper()
+    try:
+        os.remove("x")
+    except OSError as e:        # handled, not swallowed
+        print(e)

@@ -662,3 +662,44 @@ def test_supervisor_retries_with_backoff_then_raises(tmp_path):
     with pytest.raises(RuntimeError, match="on fire"):
         sup.run(bad_batch, steps=4)
     assert rec.counter_value("elastic/failures") == 3   # 2 retries + 1
+
+
+@pytest.mark.slow
+def test_supervisor_hang_abort_replans_instead_of_hanging(tmp_path):
+    """ISSUE 10 acceptance: a step.dispatch delay wedges one step far
+    past the stall budget; the watchdog escalates (flight dump + abort
+    callback), the supervisor raises HangAbortError in its own loop,
+    fails the segment, replans, resumes from the last checkpoint, and
+    COMPLETES — well before the injected delay would have released."""
+    import glob
+
+    import bigdl_tpu.faults as faults
+    from bigdl_tpu.observability.health import StallWatchdog
+
+    rec = Recorder(sinks=[InMemorySink()], annotate=False)
+    faults.reset()
+    faults.arm("step.dispatch:delay:120000@10")     # step 10: 2min wedge
+    wd = StallWatchdog(rec, factor=3.0, min_history=4,
+                       floor_seconds=0.6, poll_interval=0.05)
+    sup = ElasticSupervisor(
+        _factory, str(tmp_path / "ck"), {"dp": 2},
+        recorder=rec, ckpt_every=4, replan_every=100, backoff_base=0.05,
+        handle_sigterm=False, hang_abort_grace=0.3, watchdog=wd,
+        flight_dir=str(tmp_path / "flight"))
+    t0 = time.time()
+    try:
+        losses = sup.run(_batch, steps=16)
+        fired = faults.injected_total("step.dispatch")
+    finally:
+        faults.reset()
+    assert len(losses) == 16 and all(np.isfinite(losses))
+    assert time.time() - t0 < 110       # did NOT wait out the delay
+    assert fired == 1
+    assert rec.counter_value("elastic/hang_aborts") == 1
+    assert rec.counter_value("health/hang_aborts") == 1
+    assert rec.counter_value("elastic/failures") >= 1
+    assert rec.counter_value("elastic/resumes") >= 1
+    assert len(glob.glob(str(tmp_path / "flight" / "flight_*.json"))) == 1
+    evs = [r["condition"] for r in rec.recent_records()
+           if r.get("type") == "health_event"]
+    assert "hang_abort" in evs

@@ -1,0 +1,230 @@
+"""The repo-wide fault-injection plane (ISSUE 10 tentpole): BIGDL_FAULT
+grammar, nth-match selection, thread-safe match counting, counter
+emission, the write-site filter modes, and the guarded_write
+integration with the legacy BIGDL_CKPT_FAULT plane."""
+import errno
+import os
+import threading
+import time
+
+import pytest
+
+import bigdl_tpu.faults as faults
+from bigdl_tpu.observability import Recorder
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# --------------------------------------------------------------------- #
+# grammar                                                                #
+# --------------------------------------------------------------------- #
+def test_parse_modes_and_selectors():
+    specs = faults.parse("ckpt.shard_write:err:EIO@0;"
+                         "data.record_read:delay:250;"
+                         "data.shard_open:err:28@3+;"
+                         "ckpt.manifest:corrupt:16;"
+                         "step.dispatch:kill:0@1")
+    assert [s.site for s in specs] == [
+        "ckpt.shard_write", "data.record_read", "data.shard_open",
+        "ckpt.manifest", "step.dispatch"]
+    assert specs[0].mode == "err" and specs[0].arg == errno.EIO \
+        and specs[0].nth == 0 and not specs[0].onward
+    assert specs[1].mode == "delay" and specs[1].nth is None
+    assert specs[2].arg == errno.ENOSPC and specs[2].nth == 3 \
+        and specs[2].onward
+    assert specs[3].mode == "corrupt" and specs[3].arg == 16
+    assert specs[4].mode == "kill" and specs[4].nth == 1
+
+
+@pytest.mark.parametrize("bad", [
+    "nosuch.site:err:EIO",          # unknown site
+    "ckpt.shard_write:frob:1",      # unknown mode
+    "ckpt.shard_write:err:EWHAT",   # unknown errno name
+    "ckpt.shard_write:delay:soon",  # non-numeric arg
+    "ckpt.shard_write:err:EIO@x",   # bad selector
+    "ckpt.shard_write",             # no mode
+])
+def test_parse_rejects_bad_specs(bad):
+    with pytest.raises(ValueError):
+        faults.parse(bad)
+
+
+def test_env_var_arms_the_plane(monkeypatch):
+    monkeypatch.setenv(faults.ENV_VAR, "serving.swap:err:EIO@0")
+    faults.reset()          # drop the env-read latch
+    with pytest.raises(OSError):
+        faults.inject("serving.swap")
+    assert faults.injected_total("serving.swap") == 1
+    assert not faults.inject("serving.swap")    # @0 already consumed
+
+
+# --------------------------------------------------------------------- #
+# match selection + counting                                             #
+# --------------------------------------------------------------------- #
+def test_nth_fires_exactly_once():
+    faults.arm("step.dispatch:err:EIO@2")
+    fired = []
+    for _ in range(5):
+        try:
+            faults.inject("step.dispatch")
+            fired.append(False)
+        except OSError:
+            fired.append(True)
+    assert fired == [False, False, True, False, False]
+    assert faults.injected_total("step.dispatch") == 1
+    assert faults.injected_total() == 1
+
+
+def test_same_site_specs_share_the_occurrence_index():
+    """Two specs on one site each observe EVERY occurrence: @0;@1
+    fires on occurrences 0 and 1, not 0 and 2 (a firing spec must not
+    hide the occurrence from later specs' selectors)."""
+    faults.arm("step.dispatch:err:EIO@0;step.dispatch:err:ENOSPC@1")
+    errnos = []
+    for _ in range(4):
+        try:
+            faults.inject("step.dispatch")
+            errnos.append(None)
+        except OSError as e:
+            errnos.append(e.errno)
+    assert errnos == [errno.EIO, errno.ENOSPC, None, None]
+
+
+def test_corrupt_at_control_site_is_not_a_counted_noop():
+    """corrupt has no payload at a control site: it must neither fire
+    nor count — a counted no-op would let a chaos assertion pass with
+    no fault injected.  Its hits still advance the occurrence index
+    for other specs."""
+    faults.arm("step.dispatch:corrupt:8;step.dispatch:err:EIO@1")
+    assert faults.inject("step.dispatch") is False      # occurrence 0
+    assert faults.injected_total("step.dispatch") == 0
+    with pytest.raises(OSError):                        # occurrence 1
+        faults.inject("step.dispatch")
+    assert faults.injected_total("step.dispatch") == 1
+
+
+def test_onward_fires_from_nth():
+    faults.arm("step.dispatch:err:EIO@2+")
+    hits = 0
+    for _ in range(5):
+        try:
+            faults.inject("step.dispatch")
+        except OSError:
+            hits += 1
+    assert hits == 3
+
+
+def test_no_selector_fires_every_match_and_sites_are_independent():
+    faults.arm("step.dispatch:err:EIO")
+    for _ in range(3):
+        with pytest.raises(OSError):
+            faults.inject("step.dispatch")
+    assert faults.inject("serving.swap") is False   # other site untouched
+    assert faults.injected_total("step.dispatch") == 3
+
+
+def test_match_counting_is_thread_safe():
+    """16 threads × 50 calls against @37: exactly one firing, and every
+    call was counted (hits == 800)."""
+    faults.arm("step.dispatch:err:EIO@37")
+    fired = []
+    lock = threading.Lock()
+
+    def worker():
+        for _ in range(50):
+            try:
+                faults.inject("step.dispatch")
+            except OSError:
+                with lock:
+                    fired.append(1)
+
+    ts = [threading.Thread(target=worker) for _ in range(16)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert len(fired) == 1
+    assert faults.injected_total("step.dispatch") == 1
+    spec = faults._active()[0]
+    assert spec.hits == 800 and spec.fired == 1
+
+
+def test_recorder_counters_and_event():
+    rec = Recorder(annotate=False)
+    faults.arm("serving.swap:delay:1@0")
+    assert faults.inject("serving.swap", rec) is True
+    assert rec.counter_value("fault/injected_total") == 1
+    assert rec.counter_value("fault/injected.serving.swap") == 1
+    evs = rec.recent_records(rec_type="fault_event")
+    assert evs and evs[-1]["site"] == "serving.swap" \
+        and evs[-1]["mode"] == "delay"
+
+
+def test_delay_actually_blocks():
+    faults.arm("step.dispatch:delay:80@0")
+    t0 = time.perf_counter()
+    faults.inject("step.dispatch")
+    assert time.perf_counter() - t0 >= 0.07
+
+
+# --------------------------------------------------------------------- #
+# write-site filter                                                      #
+# --------------------------------------------------------------------- #
+def test_filter_write_err_raises_before_any_byte():
+    faults.arm("ckpt.shard_write:err:ENOSPC@0")
+    with pytest.raises(OSError) as e:
+        faults.filter_write("ckpt.shard_write", b"payload")
+    assert e.value.errno == errno.ENOSPC
+
+
+def test_filter_write_corrupt_flips_exactly_n_tail_bytes():
+    faults.arm("ckpt.shard_write:corrupt:4@0")
+    data = bytes(range(32))
+    out, kill = faults.filter_write("ckpt.shard_write", data)
+    assert kill is None and len(out) == len(data)
+    diff = [i for i in range(32) if out[i] != data[i]]
+    assert diff == [28, 29, 30, 31]
+    # disarmed (nth consumed): passthrough, bit-identical
+    out2, _ = faults.filter_write("ckpt.shard_write", data)
+    assert out2 == data
+
+
+def test_filter_write_kill_offset_is_clamped():
+    faults.arm("ckpt.shard_write:kill:1000000@0")
+    _, kill = faults.filter_write("ckpt.shard_write", b"x" * 64)
+    assert kill == 64
+
+
+def test_guarded_write_integration(tmp_path):
+    """The checkpoint writer's guarded_write consults the new plane:
+    err raises with NO file created (a retried attempt starts clean),
+    corrupt lands a CRC-detectable payload."""
+    from bigdl_tpu.checkpoint import faults as ckpt_faults
+    p = str(tmp_path / "shard.bin")
+    faults.arm("ckpt.shard_write:err:EIO@0")
+    with pytest.raises(OSError):
+        ckpt_faults.guarded_write(p, b"data", kind="shard")
+    assert not os.path.exists(p)
+    ckpt_faults.guarded_write(p, b"data", kind="shard")     # retry clean
+    with open(p, "rb") as f:
+        assert f.read() == b"data"
+
+    faults.arm("ckpt.manifest:corrupt:2@0")
+    p2 = str(tmp_path / "manifest.json")
+    ckpt_faults.guarded_write(p2, b"{\"a\": 1}", kind="manifest")
+    with open(p2, "rb") as f:
+        assert f.read() != b"{\"a\": 1}"
+
+
+def test_legacy_ckpt_fault_grammar_still_parses():
+    """BIGDL_CKPT_FAULT stays the byte-offset alias for the ckpt sites."""
+    from bigdl_tpu.checkpoint.faults import FaultPlan
+    plan = FaultPlan.parse("1:bytes:4096")
+    assert (plan.save_index, plan.point, plan.offset) == (1, "bytes", 4096)
+    assert FaultPlan.parse("0:pre_manifest").point == "pre_manifest"
+    assert FaultPlan.parse("sleep:50").sleep_s == pytest.approx(0.05)

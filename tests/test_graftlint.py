@@ -90,6 +90,15 @@ def test_gl005_recompile_fixture(tmp_path):
     }
 
 
+def test_gl006_retry_fixture(tmp_path):
+    res = lint_fixtures(tmp_path, ["bad_gl006.py"])
+    assert found(res, "bad_gl006.py") == {
+        ("GL006", 13),   # constant sleep in a retry loop
+        ("GL006", 19),   # constant sleep in a poll loop
+        ("GL006", 25),   # except OSError: pass
+    }
+
+
 def test_clean_fixture_is_clean(tmp_path):
     res = lint_fixtures(tmp_path, ["clean.py"])
     assert res.violations == [] and res.files_checked == 1
@@ -222,7 +231,7 @@ def test_every_baseline_entry_is_live():
 
 def test_rule_registry_complete():
     assert sorted(RULES_BY_ID) == ["GL001", "GL002", "GL003", "GL004",
-                                   "GL005"]
+                                   "GL005", "GL006"]
 
 
 # --------------------------------------------------------------------- #

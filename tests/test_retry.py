@@ -1,0 +1,177 @@
+"""RetryPolicy unit matrix (ISSUE 10): jitter bounds off a seeded RNG,
+deadline-beats-max_attempts, fatal-classifier short-circuit, counter
+emission, and the elastic supervisor's rebased-backoff equivalence
+with the legacy hand-rolled schedule."""
+import errno
+import random
+import time
+
+import pytest
+
+from bigdl_tpu.observability import Recorder
+from bigdl_tpu.utils.retry import (RetryPolicy, TRANSIENT_ERRNOS,
+                                   default_classify)
+
+
+def _policy(rec=None, **kw):
+    kw.setdefault("base", 0.01)
+    kw.setdefault("max_delay", 0.05)
+    kw.setdefault("sleep", lambda s: None)
+    if rec is not None:
+        kw.setdefault("recorder_fn", lambda: rec)
+    return RetryPolicy(**kw)
+
+
+def _flaky(n_failures, exc_factory=lambda: OSError(errno.EIO, "blip")):
+    state = {"calls": 0}
+
+    def fn():
+        state["calls"] += 1
+        if state["calls"] <= n_failures:
+            raise exc_factory()
+        return "ok"
+    fn.state = state
+    return fn
+
+
+# --------------------------------------------------------------------- #
+# classification                                                         #
+# --------------------------------------------------------------------- #
+def test_default_classifier_errno_split():
+    for e in ("EIO", "ENOSPC", "EAGAIN", "EINTR", "ETIMEDOUT"):
+        assert getattr(errno, e) in TRANSIENT_ERRNOS
+        assert default_classify(OSError(getattr(errno, e), "x"))
+    for e in ("EROFS", "EACCES", "EPERM", "ENOENT"):
+        assert not default_classify(OSError(getattr(errno, e), "x"))
+    assert default_classify(TimeoutError())
+    assert default_classify(ConnectionResetError())
+    assert not default_classify(ValueError("not i/o"))
+    assert not default_classify(KeyboardInterrupt())
+
+
+def test_transient_failure_is_retried_to_success():
+    rec = Recorder(annotate=False)
+    fn = _flaky(2)
+    assert _policy(rec, max_attempts=5).run(fn) == "ok"
+    assert fn.state["calls"] == 3
+    assert rec.counter_value("retry/attempts") == 2
+    assert rec.counter_value("retry/giveups") == 0
+
+
+def test_fatal_classifier_short_circuits():
+    """A fatal error raises from the FIRST attempt: no sleep, no retry
+    counter — retrying EROFS only delays the real failure."""
+    rec = Recorder(annotate=False)
+    slept = []
+    fn = _flaky(99, lambda: OSError(errno.EROFS, "read-only"))
+    with pytest.raises(OSError) as e:
+        _policy(rec, max_attempts=5, sleep=slept.append).run(fn)
+    assert e.value.errno == errno.EROFS
+    assert fn.state["calls"] == 1 and slept == []
+    assert rec.counter_value("retry/attempts") == 0
+    assert rec.counter_value("retry/giveups") == 0
+
+
+def test_exhaustion_counts_giveup_and_reraises_original():
+    rec = Recorder(annotate=False)
+    fn = _flaky(99)
+    with pytest.raises(OSError) as e:
+        _policy(rec, max_attempts=3, name="unit").run(fn)
+    assert e.value.errno == errno.EIO
+    assert fn.state["calls"] == 3          # total attempts, not retries
+    assert rec.counter_value("retry/attempts") == 2
+    assert rec.counter_value("retry/giveups") == 1
+    assert rec.counter_value("retry/attempts.unit") == 2
+    assert rec.counter_value("retry/giveups.unit") == 1
+
+
+# --------------------------------------------------------------------- #
+# backoff schedule                                                       #
+# --------------------------------------------------------------------- #
+def test_jitter_bounds_off_seeded_rng():
+    """Full jitter: delay for retry n is uniform(0, min(base*2^(n-1),
+    cap)) — bounded above by the exponential envelope, reproducible for
+    the same seed, different across seeds."""
+    p = RetryPolicy(base=0.1, max_delay=1.0, rng=random.Random(7))
+    caps = [min(0.1 * 2 ** (n - 1), 1.0) for n in range(1, 9)]
+    delays = [p.delay_for(n) for n in range(1, 9)]
+    for d, cap in zip(delays, caps):
+        assert 0.0 <= d <= cap
+    p2 = RetryPolicy(base=0.1, max_delay=1.0, rng=random.Random(7))
+    assert [p2.delay_for(n) for n in range(1, 9)] == delays
+    p3 = RetryPolicy(base=0.1, max_delay=1.0, rng=random.Random(8))
+    assert [p3.delay_for(n) for n in range(1, 9)] != delays
+    # int seed shorthand builds the same stream
+    p4 = RetryPolicy(base=0.1, max_delay=1.0, rng=7)
+    assert [p4.delay_for(n) for n in range(1, 9)] == delays
+
+
+def test_no_jitter_is_exact_exponential():
+    p = RetryPolicy(base=0.5, max_delay=30.0, jitter=False)
+    assert [p.delay_for(n) for n in range(1, 9)] == \
+        [0.5, 1.0, 2.0, 4.0, 8.0, 16.0, 30.0, 30.0]
+
+
+def test_deadline_wins_over_max_attempts():
+    """With a generous attempt budget but a tight wall clock, the
+    deadline ends the loop (and never sleeps past it)."""
+    rec = Recorder(annotate=False)
+    t0 = time.monotonic()
+    fn = _flaky(10_000)
+    with pytest.raises(OSError):
+        RetryPolicy(max_attempts=10_000, base=0.001, max_delay=0.01,
+                    deadline=0.15, recorder_fn=lambda: rec).run(fn)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 2.0                    # nowhere near 10k attempts
+    assert 1 < fn.state["calls"] < 10_000   # retried some, then gave up
+    assert rec.counter_value("retry/giveups") == 1
+
+
+def test_on_retry_hook_sees_attempt_exc_delay():
+    calls = []
+    fn = _flaky(2)
+    _policy(max_attempts=5,
+            on_retry=lambda a, e, d: calls.append((a, e.errno, d))
+            ).run(fn)
+    assert [c[0] for c in calls] == [1, 2]
+    assert all(c[1] == errno.EIO for c in calls)
+    assert all(0.0 <= c[2] <= 0.05 for c in calls)
+
+
+def test_custom_classifier_overrides_default():
+    fn = _flaky(1, lambda: ValueError("retry me anyway"))
+    assert _policy(max_attempts=3,
+                   classify=lambda e: isinstance(e, ValueError)
+                   ).run(fn) == "ok"
+
+
+# --------------------------------------------------------------------- #
+# supervisor rebase equivalence                                          #
+# --------------------------------------------------------------------- #
+def test_supervisor_backoff_matches_legacy_schedule():
+    """The ElasticSupervisor's RetryPolicy (jitter=False) reproduces the
+    legacy min(base * 2**(n-1), max) delays bit-for-bit, and _backoff
+    still returns False exactly when restarts exceed max_restarts."""
+    from bigdl_tpu.elastic.supervisor import ElasticSupervisor
+    rec = Recorder(annotate=False)
+    sup = ElasticSupervisor(lambda mesh: None, "/tmp/nowhere", {"dp": 2},
+                            recorder=rec, max_restarts=4,
+                            backoff_base=0.5, backoff_max=6.0,
+                            handle_sigterm=False)
+    legacy = [min(0.5 * 2 ** (n - 1), 6.0) for n in range(1, 5)]
+    assert [sup.retry.delay_for(n) for n in range(1, 5)] == legacy
+
+    slept = []
+    import bigdl_tpu.elastic.supervisor as sup_mod
+    orig_sleep = sup_mod.time.sleep
+    sup_mod.time.sleep = slept.append
+    try:
+        outcomes = [sup._backoff("unit", RuntimeError("x"))
+                    for _ in range(5)]
+    finally:
+        sup_mod.time.sleep = orig_sleep
+    assert outcomes == [True, True, True, True, False]
+    assert slept == legacy                  # 4 sleeps, then exhaustion
+    assert rec.counter_value("retry/attempts.elastic") == 4
+    assert rec.counter_value("retry/giveups.elastic") == 1
+    assert rec.counter_value("elastic/failures") == 5

@@ -1,0 +1,583 @@
+"""Transient-fault survival (ISSUE 10): checkpoint writes retry EIO and
+stay fatal on EROFS, restore re-reads a deep-CRC mismatch once,
+retention GC skips un-deletable dirs loudly, the data pipeline retries
+then degrades per file, http bind and serving swap retry, the
+utils/file tmp never leaks, and the watchdog escalates a hang into an
+abort callback + flight dump."""
+import errno
+import glob
+import json
+import os
+import struct
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import bigdl_tpu.faults as faults
+from bigdl_tpu.observability import Recorder
+from bigdl_tpu.utils.tfrecord import write_tfrecords
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _mk_manager(root, rec, **kw):
+    from bigdl_tpu.checkpoint import CheckpointManager
+    kw.setdefault("recorder_fn", lambda: rec)
+    return CheckpointManager(str(root), **kw)
+
+
+_TREE = {"model": {"w": np.arange(16, dtype=np.float32)}}
+
+
+# --------------------------------------------------------------------- #
+# checkpoint writes                                                      #
+# --------------------------------------------------------------------- #
+def test_ckpt_shard_write_retries_transient_eio(tmp_path):
+    rec = Recorder(annotate=False)
+    faults.arm("ckpt.shard_write:err:EIO@0")
+    m = _mk_manager(tmp_path, rec)
+    m.save(dict(_TREE), {"step": 1}, tag="t1", sync=True)
+    assert rec.counter_value("checkpoint/committed") == 1
+    assert rec.counter_value("checkpoint/failed") == 0
+    assert rec.counter_value("retry/attempts") >= 1
+    assert rec.counter_value("fault/injected_total") == 1
+    kind, trees, meta = m.restore_latest()
+    np.testing.assert_array_equal(trees["model"]["w"],
+                                  _TREE["model"]["w"])
+    m.close()
+
+
+def test_ckpt_manifest_write_retries_transient_enospc(tmp_path):
+    rec = Recorder(annotate=False)
+    faults.arm("ckpt.manifest:err:ENOSPC@0")
+    m = _mk_manager(tmp_path, rec)
+    m.save(dict(_TREE), {"step": 1}, tag="t1", sync=True)
+    assert rec.counter_value("checkpoint/committed") == 1
+    assert rec.counter_value("retry/attempts") >= 1
+    # manifest fault counters land on the MANAGER's recorder, same
+    # contract as the shard path (not only the process-global one)
+    assert rec.counter_value("fault/injected.ckpt.manifest") == 1
+    assert m.restore_latest() is not None
+    m.close()
+
+
+def test_ckpt_write_erofs_is_fatal_not_retried(tmp_path):
+    rec = Recorder(annotate=False)
+    faults.arm("ckpt.shard_write:err:EROFS")
+    m = _mk_manager(tmp_path, rec)
+    with pytest.raises(OSError) as e:
+        m.save(dict(_TREE), {"step": 1}, tag="t1", sync=True)
+    assert e.value.errno == errno.EROFS
+    assert rec.counter_value("retry/attempts") == 0
+    assert rec.counter_value("checkpoint/failed") == 1
+    m.close()
+
+
+def test_ckpt_async_transient_survives_off_loop(tmp_path):
+    """The async path: a transient EIO inside the writer thread retries
+    there and commits; training (the submitter) never sees it."""
+    rec = Recorder(annotate=False)
+    faults.arm("ckpt.shard_write:err:EIO@0")
+    m = _mk_manager(tmp_path, rec)
+    m.save(dict(_TREE), {"step": 1}, tag="t1")      # async
+    assert m.wait(30.0)
+    assert m.writer.last_error is None
+    assert rec.counter_value("checkpoint/committed") == 1
+    m.close()
+
+
+def test_restore_rereads_once_on_deep_crc_mismatch(tmp_path, monkeypatch):
+    """A transient verify failure re-reads before falling back a whole
+    checkpoint; a persistent one still falls back."""
+    from bigdl_tpu.checkpoint import manager as mgr_mod
+    rec = Recorder(annotate=False)
+    m = _mk_manager(tmp_path, rec)
+    m.save(dict(_TREE), {"step": 1}, tag="t1", sync=True)
+    m.save({"model": {"w": np.ones(4, np.float32)}}, {"step": 2},
+           tag="t2", sync=True)
+
+    real_verify = mgr_mod.mlib.verify
+    state = {"failures_left": 1}
+
+    def flaky_verify(d, mf, deep=True):
+        # only the deep restore-time pass blips: the shallow ordering
+        # scan also routes through verify and must stay clean
+        if deep and state["failures_left"] > 0:
+            state["failures_left"] -= 1
+            return ["transient read blip"]
+        return real_verify(d, mf, deep=deep)
+
+    monkeypatch.setattr(mgr_mod.mlib, "verify", flaky_verify)
+    kind, trees, meta = m.restore_latest()
+    assert meta["step"] == 2                # newest survived the blip
+    assert rec.counter_value("checkpoint/verify_retries") == 1
+
+    state["failures_left"] = 2              # t2 torn for real: falls back
+    kind, trees, meta = m.restore_latest()
+    assert meta["step"] == 1
+    m.close()
+
+
+def test_gc_skips_undeletable_dir_and_continues(tmp_path, monkeypatch):
+    """One un-removable torn/old dir must not abort the sweep: it is
+    logged + counted, every other candidate still collected, and a
+    later sweep (permission restored) removes it."""
+    from bigdl_tpu.checkpoint import manager as mgr_mod
+    rec = Recorder(annotate=False)
+    m = _mk_manager(tmp_path, rec, keep_last=1)
+    m.save(dict(_TREE), {"step": 1}, tag="t1", sync=True)
+
+    real_rmtree = mgr_mod.shutil.rmtree
+
+    def stubborn(path, *a, **kw):
+        if "t1" in os.path.basename(path):
+            raise PermissionError(errno.EACCES, "injected EACCES", path)
+        return real_rmtree(path, *a, **kw)
+
+    monkeypatch.setattr(mgr_mod.shutil, "rmtree", stubborn)
+    m.save(dict(_TREE), {"step": 2}, tag="t2", sync=True)
+    m.save(dict(_TREE), {"step": 3}, tag="t3", sync=True)
+    names = {os.path.basename(p)
+             for p in glob.glob(str(tmp_path / "ckpt_*"))}
+    assert any("t1" in n for n in names)        # stuck, but survived
+    assert not any("t2" in n for n in names)    # sweep continued past it
+    assert any("t3" in n for n in names)
+    assert rec.counter_value("checkpoint/gc_skipped") == 2  # one/sweep
+    monkeypatch.setattr(mgr_mod.shutil, "rmtree", real_rmtree)
+    m.save(dict(_TREE), {"step": 4}, tag="t4", sync=True)
+    names = {os.path.basename(p)
+             for p in glob.glob(str(tmp_path / "ckpt_*"))}
+    assert not any("t1" in n for n in names)    # next sweep got it
+    m.close()
+
+
+# --------------------------------------------------------------------- #
+# data pipeline                                                          #
+# --------------------------------------------------------------------- #
+def _shards(tmp_path, n_files=3, per_file=10):
+    paths, gid = [], 0
+    for f in range(n_files):
+        p = str(tmp_path / f"s{f}.tfr")
+        recs = []
+        for _ in range(per_file):
+            recs.append(struct.pack("<i", gid))
+            gid += 1
+        write_tfrecords(p, recs)
+        paths.append(p)
+    return paths
+
+
+def _decode(b):
+    return np.frombuffer(b, np.int32).copy(), None
+
+
+def _pull_ids(ds, epoch=0):
+    ids = []
+    for x, y in ds.data(train=True, epoch=epoch):
+        ids.extend(int(v) for v in np.asarray(x).ravel())
+    return ids
+
+
+def _mk_ds(paths, rec, **kw):
+    from bigdl_tpu.data.sharded import ShardedRecordDataSet
+    kw.setdefault("batch_size", 5)
+    kw.setdefault("n_workers", 2)
+    kw.setdefault("seed", 1)
+    kw.setdefault("retry_base", 0.001)
+    kw.setdefault("drop_last", False)
+    return ShardedRecordDataSet(paths, "tfrecord", _decode,
+                                recorder=rec, **kw)
+
+
+def test_data_record_read_transient_retries_exactly_once(tmp_path):
+    """A transient EIO mid-file re-reads from the current record index:
+    every record still delivered exactly once, nothing skipped."""
+    rec = Recorder(annotate=False)
+    faults.arm("data.record_read:err:EIO@7")
+    ids = _pull_ids(_mk_ds(_shards(tmp_path), rec))
+    assert sorted(ids) == list(range(30))
+    assert rec.counter_value("retry/attempts") >= 1
+    assert rec.counter_value("data/files_skipped") == 0
+    assert rec.counter_value("fault/injected.data.record_read") == 1
+
+
+def test_data_fatal_open_skips_one_file_loudly(tmp_path):
+    """EACCES is fatal: no retries, the file is skipped with a counter
+    and a health event, the rest of the epoch streams on."""
+    rec = Recorder(annotate=False)
+    faults.arm("data.shard_open:err:EACCES@0")
+    ids = _pull_ids(_mk_ds(_shards(tmp_path), rec))
+    assert len(ids) == 20 and len(set(ids)) == 20
+    assert rec.counter_value("data/files_skipped") == 1
+    evs = rec.recent_records(rec_type="health_event")
+    assert evs and evs[-1]["condition"] == "data_file_skipped" \
+        and evs[-1]["action"] == "skip"
+
+
+def test_data_exhausted_retries_degrade_not_die(tmp_path):
+    """EVERY open fails transiently: retries burn out per file, every
+    file is skipped, and the epoch ENDS (zero batches) instead of
+    killing the worker or hanging the consumer."""
+    rec = Recorder(annotate=False)
+    faults.arm("data.shard_open:err:EIO")
+    paths = _shards(tmp_path)
+    ids = _pull_ids(_mk_ds(paths, rec, read_retries=2))
+    assert ids == []
+    assert rec.counter_value("data/files_skipped") == len(paths)
+    assert rec.counter_value("retry/giveups") == len(paths)
+    assert rec.counter_value("retry/attempts") == len(paths)
+
+
+@pytest.mark.parametrize("exc", [
+    ValueError("decode bug"),
+    FileNotFoundError(errno.ENOENT, "missing side file"),
+])
+def test_data_decode_bugs_still_propagate(tmp_path, exc):
+    """Degradation is for shard I/O only: a decode exception is a code
+    bug and must surface at the consumer, not skip the file — EVEN when
+    the decode bug happens to raise OSError (a missing label/index side
+    file would otherwise silently empty the epoch)."""
+    from bigdl_tpu.data.sharded import ShardedRecordDataSet
+    rec = Recorder(annotate=False)
+
+    def bad_decode(b):
+        raise exc
+    ds = ShardedRecordDataSet(_shards(tmp_path), "tfrecord", bad_decode,
+                              batch_size=5, n_workers=1, seed=1,
+                              recorder=rec)
+    with pytest.raises(type(exc)):
+        for _ in ds.data(train=True, epoch=0):
+            pass
+    assert rec.counter_value("data/files_skipped") == 0
+
+
+def test_data_resync_bytes_not_double_counted_on_retry(tmp_path):
+    """A retried file re-SCANS the bytes before the resume record; the
+    corrupt region it already salvaged must not be re-counted into
+    data/resync_skipped_bytes (phantom corruption growth)."""
+    rec_clean = Recorder(annotate=False)
+    paths = _shards(tmp_path, n_files=1, per_file=12)
+    # corrupt a region early in the file (inside record 2's frame)
+    with open(paths[0], "r+b") as f:
+        data = f.read()
+        f.seek(40)
+        f.write(bytes(b ^ 0xFF for b in data[40:52]))
+    clean_ids = _pull_ids(_mk_ds(paths, rec_clean, n_workers=1))
+    baseline_skip = rec_clean.counter_value("data/resync_skipped_bytes")
+    assert baseline_skip > 0
+
+    rec = Recorder(annotate=False)
+    # transient fault well PAST the corrupt region: the retry's
+    # catch-up scan re-traverses it
+    faults.arm("data.record_read:err:EIO@6")
+    retried_ids = _pull_ids(_mk_ds(paths, rec, n_workers=1))
+    assert retried_ids == clean_ids
+    assert rec.counter_value("retry/attempts") >= 1
+    assert rec.counter_value("data/resync_skipped_bytes") \
+        == baseline_skip
+
+    # ...and no UNDERcount when the failed attempt died BEFORE any
+    # scan (open fault): the retry must still count the region once
+    rec2 = Recorder(annotate=False)
+    faults.arm("data.shard_open:err:EIO@0")
+    open_retry_ids = _pull_ids(_mk_ds(paths, rec2, n_workers=1))
+    assert open_retry_ids == clean_ids
+    assert rec2.counter_value("data/resync_skipped_bytes") \
+        == baseline_skip
+
+
+# --------------------------------------------------------------------- #
+# http bind + serving swap                                               #
+# --------------------------------------------------------------------- #
+def test_http_bind_retries_eaddrinuse(tmp_path):
+    from bigdl_tpu.observability.http import IntrospectionServer
+    rec = Recorder(annotate=False)
+    faults.arm("http.bind:err:EADDRINUSE@0")
+    srv = IntrospectionServer(rec, port=0).start()
+    try:
+        with urllib.request.urlopen(srv.url("/metrics"), timeout=5) as r:
+            assert r.status == 200
+    finally:
+        srv.stop()
+    assert rec.counter_value("retry/attempts.http.bind") == 1
+    assert rec.counter_value("fault/injected.http.bind") == 1
+
+
+def test_http_bind_other_errors_stay_fatal():
+    from bigdl_tpu.observability.http import IntrospectionServer
+    rec = Recorder(annotate=False)
+    faults.arm("http.bind:err:EACCES")
+    with pytest.raises(OSError) as e:
+        IntrospectionServer(rec, port=0).start()
+    assert e.value.errno == errno.EACCES
+    assert rec.counter_value("retry/attempts.http.bind") == 0
+
+
+def test_serving_swap_retries_transient(tmp_path):
+    import jax.numpy as jnp
+    from bigdl_tpu.nn.module import Module
+    from bigdl_tpu.serving import ModelRegistry
+
+    class Scale(Module):
+        def init(self, rng):
+            return {self.name: {"weight": jnp.ones(())}}
+
+        def apply(self, params, x, ctx):
+            return x * params[self.name]["weight"]
+
+    from bigdl_tpu.observability import set_recorder
+    rec = Recorder(annotate=False)
+    prev = set_recorder(rec)
+    try:
+        reg = ModelRegistry()
+        entry = reg.register("m", Scale())
+        name = list(entry.snapshot.params)[0]
+        faults.arm("serving.swap:err:EIO@0")
+        snap = reg.swap_weights(
+            "m", {name: {"weight": jnp.asarray(5.0)}})
+        assert entry.snapshot is snap
+        assert float(np.asarray(snap.params[name]["weight"])) == 5.0
+        assert rec.counter_value("retry/attempts.serving.swap") == 1
+        # fatal validation error still raises with the old snapshot live
+        with pytest.raises(ValueError):
+            reg.swap_weights("m", {name: {"weight": jnp.ones((3,))}})
+        assert entry.snapshot is snap
+    finally:
+        set_recorder(prev)
+
+
+# --------------------------------------------------------------------- #
+# utils/file tmp hygiene                                                 #
+# --------------------------------------------------------------------- #
+def _tmp_litter(d):
+    return [p for p in os.listdir(d) if ".tmp-" in p]
+
+
+def test_file_save_replace_failure_leaves_no_tmp(tmp_path, monkeypatch):
+    """os.replace raising (cross-device, permission) must unlink the
+    staged tmp — the old leak made every LATER save of the same path
+    trip over the stale O_EXCL file."""
+    from bigdl_tpu.utils import file as file_mod
+    target = str(tmp_path / "state.bin")
+
+    def bad_replace(src, dst):
+        raise OSError(errno.EXDEV, "injected cross-device link")
+
+    monkeypatch.setattr(file_mod.os, "replace", bad_replace)
+    with pytest.raises(OSError):
+        file_mod.save({"w": np.ones(4, np.float32)}, target)
+    assert _tmp_litter(tmp_path) == []
+    monkeypatch.undo()
+    file_mod.save({"w": np.ones(4, np.float32)}, target)    # now clean
+    assert _tmp_litter(tmp_path) == []
+    np.testing.assert_array_equal(file_mod.load(target)["w"],
+                                  np.ones(4, np.float32))
+
+
+def test_file_pickle_fallback_replace_failure_leaves_no_tmp(
+        tmp_path, monkeypatch):
+    """Same contract on the pickle-fallback path (objects the state
+    format cannot hold)."""
+    from bigdl_tpu.utils import file as file_mod
+    target = str(tmp_path / "obj.bin")
+    payload = {"fn": len, "data": {1, 2, 3}}    # unserializable: pickled
+
+    def bad_replace(src, dst):
+        raise OSError(errno.EXDEV, "injected cross-device link")
+
+    monkeypatch.setattr(file_mod.os, "replace", bad_replace)
+    with pytest.raises(OSError):
+        file_mod.save(payload, target)
+    assert _tmp_litter(tmp_path) == []
+    monkeypatch.undo()
+    file_mod.save(payload, target)
+    assert _tmp_litter(tmp_path) == [] and os.path.exists(target)
+    assert file_mod.load(target)["data"] == {1, 2, 3}
+
+
+def test_pointer_failure_does_not_fail_commit(tmp_path, monkeypatch):
+    """The latest pointer is written AFTER the manifest commit point:
+    its failure must not mark a complete, restorable checkpoint failed.
+    The stale pointer is dropped so resume scans newest-first."""
+    from bigdl_tpu.checkpoint import manager as mgr_mod
+    rec = Recorder(annotate=False)
+    m = _mk_manager(tmp_path, rec)
+    m.save(dict(_TREE), {"step": 1}, tag="t1", sync=True)
+
+    real_writer = mgr_mod.mlib.write_latest_pointer
+
+    def eacces_pointer(root, value):
+        raise PermissionError(errno.EACCES, "injected EACCES")
+
+    monkeypatch.setattr(mgr_mod.mlib, "write_latest_pointer",
+                        eacces_pointer)
+    m.save({"model": {"w": np.ones(4, np.float32)}}, {"step": 2},
+           tag="t2", sync=True)                     # must not raise
+    assert rec.counter_value("checkpoint/committed") == 2
+    assert rec.counter_value("checkpoint/failed") == 0
+    assert rec.counter_value("checkpoint/pointer_skipped") == 1
+    # the stale t1 pointer is gone: restore finds the NEWEST checkpoint
+    assert mgr_mod.mlib.read_latest_pointer(str(tmp_path)) is None
+    kind, trees, meta = m.restore_latest()
+    assert meta["step"] == 2
+
+    # a transient blip retries to success — the pointer lands
+    state = {"failures_left": 1}
+
+    def flaky_pointer(root, value):
+        if state["failures_left"] > 0:
+            state["failures_left"] -= 1
+            raise OSError(errno.EIO, "injected EIO")
+        return real_writer(root, value)
+
+    monkeypatch.setattr(mgr_mod.mlib, "write_latest_pointer",
+                        flaky_pointer)
+    m.save(dict(_TREE), {"step": 3}, tag="t3", sync=True)
+    assert rec.counter_value("checkpoint/pointer_skipped") == 1  # no new
+    assert "t3" in mgr_mod.mlib.read_latest_pointer(str(tmp_path))
+    m.close()
+
+
+def test_pointer_write_failure_leaves_no_tmp(tmp_path, monkeypatch):
+    """write_latest_pointer cleans its tmp on every failure path — the
+    same no-litter contract as utils/file.save, so a retried attempt
+    (or the next commit) starts clean."""
+    from bigdl_tpu.checkpoint import manifest as mlib
+
+    def bad_replace(src, dst):
+        raise OSError(errno.EIO, "injected EIO")
+
+    monkeypatch.setattr(mlib.os, "replace", bad_replace)
+    with pytest.raises(OSError):
+        mlib.write_latest_pointer(str(tmp_path), "ckpt_t1")
+    assert _tmp_litter(tmp_path) == []
+    monkeypatch.undo()
+    mlib.write_latest_pointer(str(tmp_path), "ckpt_t1")
+    assert mlib.read_latest_pointer(str(tmp_path)) == "ckpt_t1"
+
+
+# --------------------------------------------------------------------- #
+# watchdog hang-abort escalation                                         #
+# --------------------------------------------------------------------- #
+def _seed_steps(rec, n=10, dur=0.01):
+    for i in range(n):
+        rec._ring.append({"type": "step", "step": i, "dur": dur,
+                          "scalars": {}})
+
+
+def test_watchdog_escalates_once_per_episode(tmp_path):
+    from bigdl_tpu.observability import FlightRecorder
+    from bigdl_tpu.observability.health import StallWatchdog
+    rec = Recorder(annotate=False)
+    _seed_steps(rec)
+    fired = []
+    wd = StallWatchdog(rec, factor=2.0, min_history=5,
+                       floor_seconds=0.05)
+    wd.set_escalation(0.08, lambda: fired.append(1),
+                      flight=FlightRecorder(rec, str(tmp_path)))
+    rec.start_step(10)
+    time.sleep(0.06)
+    assert wd.check_once() and fired == []      # stalled, inside grace
+    time.sleep(0.1)
+    assert wd.check_once() and fired == [1]     # grace exhausted: abort
+    wd.check_once()
+    assert fired == [1]                         # once per episode
+    assert rec.counter_value("health/hang_aborts") == 1
+    evs = [r for r in rec.recent_records(rec_type="health_event")
+           if r["condition"] == "hang_abort"]
+    assert len(evs) == 1 and evs[0]["action"] == "abort"
+    dumps = glob.glob(str(tmp_path / "flight_*.json"))
+    assert len(dumps) == 1
+    with open(dumps[0]) as f:
+        assert json.load(f)["reason"] == "hang_abort"
+    rec.end_step(10)
+    assert not wd.check_once()                  # recovered
+
+
+def test_watchdog_escalation_rearms_after_recovery():
+    from bigdl_tpu.observability.health import StallWatchdog
+    rec = Recorder(annotate=False)
+    _seed_steps(rec)
+    fired = []
+    wd = StallWatchdog(rec, factor=2.0, min_history=5,
+                       floor_seconds=0.05)
+    wd.set_escalation(0.05, lambda: fired.append(1))
+    rec.start_step(10)
+    time.sleep(0.12)
+    wd.check_once()
+    time.sleep(0.06)
+    wd.check_once()
+    assert fired == [1]
+    rec.end_step(10)
+    wd.check_once()
+    # the slow step 10 raised the p99 budget: the second wedge must
+    # outlast the ADAPTED budget before detection, then the grace
+    rec.start_step(11)
+    time.sleep(0.45)
+    wd.check_once()
+    time.sleep(0.08)
+    wd.check_once()
+    assert fired == [1, 1]
+
+
+def test_watchdog_start_rebaselines_idle_age():
+    """start() measures idle age from the moment of arming: with a
+    shared recorder the last step record can predate a long legitimate
+    gap (the elastic supervisor's teardown/backoff/rebuild between
+    segments), and that gap must not read as a stall — let alone
+    escalate into aborting the fresh segment."""
+    from bigdl_tpu.observability.health import StallWatchdog
+    rec = Recorder(annotate=False)
+    _seed_steps(rec)
+    rec._last_step_end = time.time() - 100      # the inter-segment gap
+    wd = StallWatchdog(rec, factor=2.0, min_history=5,
+                       floor_seconds=0.05, poll_interval=60)
+    assert wd.check_once()          # un-rebaselined: the gap reads stalled
+    wd.stop()
+    wd.start()                      # re-arm for the next segment
+    assert not wd.check_once()      # the gap is not loop inactivity
+    wd.stop()
+
+
+def test_watchdog_suspended_blocks_escalation_during_long_step():
+    """The supervisor wraps every segment's FIRST step in suspended():
+    a fresh trainer's XLA compile can be minutes of legitimate work and
+    must neither flag a stall nor hang-abort a healthy segment."""
+    from bigdl_tpu.observability.health import StallWatchdog
+    rec = Recorder(annotate=False)
+    _seed_steps(rec)
+    fired = []
+    wd = StallWatchdog(rec, factor=2.0, min_history=5,
+                       floor_seconds=0.05)
+    wd.set_escalation(0.02, lambda: fired.append(1))
+    rec.start_step(10)              # the compiling first step, in flight
+    with wd.suspended():
+        time.sleep(0.12)            # way past budget (0.05s) + grace
+        assert not wd.check_once()
+        time.sleep(0.04)
+        assert not wd.check_once()
+    assert fired == []              # never escalated
+    rec.end_step(10)
+    assert not wd.check_once()
+
+
+def test_watchdog_escalation_callback_failure_is_contained():
+    from bigdl_tpu.observability.health import StallWatchdog
+    rec = Recorder(annotate=False)
+    _seed_steps(rec)
+    wd = StallWatchdog(rec, factor=2.0, min_history=5,
+                       floor_seconds=0.05)
+    wd.set_escalation(0.02, lambda: 1 / 0)
+    rec.start_step(10)
+    time.sleep(0.12)
+    wd.check_once()
+    time.sleep(0.04)
+    assert wd.check_once() is True      # verdict survives the bad cb
+    assert rec.counter_value("health/hang_aborts") == 1
