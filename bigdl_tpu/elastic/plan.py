@@ -39,6 +39,23 @@ def _divisors(n: int):
     return [d for d in range(1, n + 1) if n % d == 0]
 
 
+def _axis_candidates(axes: Dict[str, int],
+                     floors: Dict[str, int]) -> Dict[str, list]:
+    """Per-axis legal sizes: divisors of the template size that meet the
+    axis's floor.  ONE definition of what a legal axis size is — the
+    planner's search space and fleet admission's floor reservation must
+    never disagree.  Raises ``ValueError`` when an axis has none."""
+    out = {}
+    for k, v in axes.items():
+        floor = max(1, floors.get(k, 1))
+        cands = [d for d in _divisors(v) if d >= floor]
+        if not cands:
+            raise ValueError(
+                f"axis {k!r}: no divisor of {v} meets its floor {floor}")
+        out[k] = cands
+    return out
+
+
 def plan_mesh(n_devices: int, template: Dict[str, int],
               min_axes: Optional[Dict[str, int]] = None) -> Dict[str, int]:
     """Largest mesh ≤ ``template`` (axis-wise, divisor-constrained)
@@ -58,15 +75,8 @@ def plan_mesh(n_devices: int, template: Dict[str, int],
             raise ValueError(f"template axis {k!r} has size {v}")
     floors = {str(k): int(v) for k, v in (min_axes or {}).items()}
     names = list(axes)
-    cand_lists = []
-    for k in names:
-        floor = max(1, floors.get(k, 1))
-        cands = [d for d in _divisors(axes[k]) if d >= floor]
-        if not cands:
-            raise ValueError(
-                f"axis {k!r}: no divisor of {axes[k]} meets its floor "
-                f"{floor}")
-        cand_lists.append(cands)
+    cand_map = _axis_candidates(axes, floors)
+    cand_lists = [cand_map[k] for k in names]
     # preference on ties: keep LATE-priority axes (tp, pp, sp) at full
     # size, shrink dp first — compare sizes in reverse priority order
     rank = {a: i for i, a in enumerate(SHRINK_PRIORITY)}

@@ -97,7 +97,8 @@ class ElasticSupervisor:
                  backoff_base: float = 0.5, backoff_max: float = 30.0,
                  handle_sigterm: bool = True,
                  hang_abort_grace: Optional[float] = None,
-                 watchdog=None, flight_dir: Optional[str] = None):
+                 watchdog=None, flight_dir: Optional[str] = None,
+                 name: Optional[str] = None):
         self.trainer_factory = trainer_factory
         self.ckpt_dir = str(ckpt_dir)
         self.template = {str(k): int(v) for k, v in template.items()}
@@ -113,14 +114,22 @@ class ElasticSupervisor:
         self.backoff_base = float(backoff_base)
         self.backoff_max = float(backoff_max)
         self.handle_sigterm = bool(handle_sigterm)
+        # the fleet job name: labels this supervisor's events and retry
+        # counters so N jobs sharing one recorder stay attributable
+        self.name = None if name is None else str(name)
         # the unified backoff: jitter=False reproduces the legacy
         # min(base * 2**(n-1), max) schedule bit-for-bit, and the
         # retry/* counters make restarts observable next to every
-        # other retry loop in the repo
+        # other retry loop in the repo.  A named (fleet) supervisor
+        # splits them per job — retry/attempts.elastic.<job> — because
+        # N jobs sharing a recorder would otherwise collide on one
+        # retry/attempts.elastic counter
         self.retry = RetryPolicy(max_attempts=self.max_restarts + 1,
                                  base=self.backoff_base,
                                  max_delay=self.backoff_max,
-                                 jitter=False, name="elastic",
+                                 jitter=False,
+                                 name="elastic" if self.name is None
+                                 else f"elastic.{self.name}",
                                  recorder_fn=self._rec)
         # hang-abort escalation: None = off (see module docstring)
         self.hang_abort_grace = None if hang_abort_grace is None \
@@ -156,7 +165,7 @@ class ElasticSupervisor:
                 else f"elastic/{kind}")
         rec.inc(f"health/elastic_{kind}")
         rec.emit_record("elastic_event", kind=kind, state=self.state,
-                        **fields)
+                        job=self.name, **fields)
         rec.emit_record("health_event", condition=f"elastic_{kind}",
                         step=fields.get("step"), metric="elastic/devices",
                         value=fields.get("devices"), threshold=None,
@@ -260,6 +269,7 @@ class ElasticSupervisor:
         wd = self._setup_watchdog()
         losses: Dict[int, Any] = {}     # device scalars until segment drain
         prev_axes = None
+        prev_used = None                # the device list the plan ran on
         first_step = None
         try:
             while True:
@@ -268,6 +278,7 @@ class ElasticSupervisor:
                     devices = self._capacity()
                     axes = plan_mesh(len(devices), self.template,
                                      self.min_axes)
+                    used = plan_devices(axes, devices)
                     rec.gauge("elastic/devices", _prod(axes))
                     for name, size in axes.items():
                         rec.gauge(f"elastic/axis_{name}", size)
@@ -288,7 +299,18 @@ class ElasticSupervisor:
                                     devices=_prod(axes))
                         print(f"[elastic] {kind}: {prev_axes} -> {axes}",
                               flush=True)
+                    elif prev_used is not None and used != prev_used:
+                        # same mesh shape on a DIFFERENT device subset: a
+                        # fleet displacement (the pool handed these
+                        # devices to another job).  Same-math relayout —
+                        # the resumed curve is bit-identical — but it is
+                        # a placement transition operators must see
+                        self._event("displace", axes=axes,
+                                    devices=_prod(axes))
+                        print(f"[elastic] displace: {axes} moved to a new "
+                              "device subset", flush=True)
                     prev_axes = axes
+                    prev_used = used
                     self.trainer = trainer
                     if resumed:
                         self._event("resume", step=trainer._step_count,
@@ -314,10 +336,18 @@ class ElasticSupervisor:
                                 break
                             if (self.replan_every and s > start
                                     and (s - start) % self.replan_every == 0):
-                                new_axes = plan_mesh(len(self._capacity()),
+                                new_devices = self._capacity()
+                                new_axes = plan_mesh(len(new_devices),
                                                      self.template,
                                                      self.min_axes)
-                                if new_axes != axes:
+                                # a device-SET change at equal size is a
+                                # displacement (the pool reassigned us):
+                                # this mesh's devices now belong to
+                                # another job, so drain and rebuild on
+                                # the new subset just like a resize
+                                if (new_axes != axes
+                                        or plan_devices(new_axes,
+                                                        new_devices) != used):
                                     outcome = "replan"
                                     break
                             tokens, targets = batch_fn(s)

@@ -19,6 +19,9 @@ Sites (the stable names tests and operators use)::
     serving.swap        registry weight hot-swap (validate + publish)
     http.bind           introspection-server socket bind
     step.dispatch       the supervisor's per-step dispatch
+    fleet.place         the fleet scheduler computing/applying a placement
+    fleet.preempt       the fleet scheduler delivering a preemption
+                        (shrink/displace) to one job's capacity seam
 
 Grammar (``BIGDL_FAULT`` env var or :func:`arm`)::
 
@@ -69,7 +72,7 @@ KILL_EXIT_CODE = 42
 
 SITES = ("ckpt.shard_write", "ckpt.manifest", "data.shard_open",
          "data.record_read", "serving.swap", "http.bind",
-         "step.dispatch")
+         "step.dispatch", "fleet.place", "fleet.preempt")
 
 _MODES = ("err", "delay", "corrupt", "kill")
 

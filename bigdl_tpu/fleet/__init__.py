@@ -1,0 +1,24 @@
+"""bigdl_tpu.fleet — many jobs, one device pool.
+
+The fleet layer gang-places N independent
+:class:`~bigdl_tpu.elastic.ElasticSupervisor` jobs onto disjoint
+sub-meshes of one shared pool and keeps all of them alive through
+contention: a higher-priority arrival shrinks or displaces
+lower-priority jobs through their existing ``capacity_fn`` seam (the
+PR-6 drain → replan → resume path), completions hand capacity back
+(regrow), and a job whose ``min_axes`` floor fits surviving capacity
+is never killed by a fleet decision.  One aggregated ``/metrics`` +
+``/healthz`` covers the pool (per-job labels, worst-of verdict), and a
+shared persistent compile cache warm-starts re-placed jobs.
+
+See ``docs/robustness.md`` § Fleet.
+"""
+from __future__ import annotations
+
+from .pool import (DevicePool, FleetAdmissionError, FleetJob,
+                   FleetScheduler, enable_shared_compile_cache, min_plan,
+                   plan_fleet)
+
+__all__ = ["DevicePool", "FleetScheduler", "FleetJob",
+           "FleetAdmissionError", "plan_fleet", "min_plan",
+           "enable_shared_compile_cache"]

@@ -47,7 +47,7 @@ from __future__ import annotations
 
 from .recorder import Recorder, get_recorder, set_recorder, null_recorder
 from .sinks import (InMemorySink, JsonlSink, Sink, TensorBoardSink,
-                    render_prometheus)
+                    render_prometheus, render_prometheus_multi)
 from .http import IntrospectionServer
 from .health import (DivergenceError, FlightRecorder, HealthMonitor,
                      StallWatchdog)
@@ -58,7 +58,7 @@ from . import profile
 __all__ = [
     "Recorder", "get_recorder", "set_recorder", "null_recorder",
     "Sink", "InMemorySink", "JsonlSink", "TensorBoardSink",
-    "render_prometheus", "IntrospectionServer",
+    "render_prometheus", "render_prometheus_multi", "IntrospectionServer",
     "DivergenceError", "FlightRecorder", "HealthMonitor", "StallWatchdog",
     "collectives", "health", "profile",
 ]

@@ -50,7 +50,9 @@ class FlightRecorder:
         self._lock = threading.RLock()
         self._installed = False
         self._prev_excepthook = None
+        self._hook_fn = None                # our excepthook, for identity
         self._prev_signals: Dict[int, Any] = {}
+        self._sig_hooks: Dict[int, Any] = {}    # our handlers, for identity
 
     # -- the dump --------------------------------------------------------- #
     def dump(self, reason: str, extra: Optional[Dict[str, Any]] = None,
@@ -113,61 +115,91 @@ class FlightRecorder:
     # -- crash-path hooks -------------------------------------------------- #
     def install(self, signals=(signal.SIGTERM,)) -> "FlightRecorder":
         """Chain onto ``sys.excepthook`` and the given signals."""
-        if self._installed:
+        with self._lock:
+            if self._installed:
+                return self
+            prev_hook = sys.excepthook
+            self._prev_excepthook = prev_hook
+
+            def hook(exc_type, exc, tb):
+                self._dump_quietly(f"unhandled:{exc_type.__name__}",
+                                   {"error": repr(exc)}, key=id(exc))
+                prev_hook(exc_type, exc, tb)
+
+            sys.excepthook = hook
+            self._hook_fn = hook
+            try:
+                for s in signals:
+                    prev = signal.getsignal(s)
+
+                    def handler(signum, frame, _prev=prev):
+                        self._dump_quietly(f"signal:{signum}")
+                        if callable(_prev):
+                            _prev(signum, frame)
+                        elif (_prev == signal.SIG_DFL
+                              and signal.getsignal(signum) is handler):
+                            # the default disposition (terminate) must
+                            # still apply: restore it and re-deliver —
+                            # dump-and-ignore would eat the scheduler's
+                            # grace window.  Only while we are the
+                            # ACTIVE handler though: if something
+                            # installed over us and chained in (the
+                            # preemption handler), THAT owner decides
+                            # the disposition — terminating here would
+                            # kill its graceful final checkpoint
+                            signal.signal(signum, signal.SIG_DFL)
+                            signal.raise_signal(signum)
+                        # SIG_IGN: stay ignored
+
+                    signal.signal(s, handler)
+                    self._prev_signals[s] = prev
+                    self._sig_hooks[s] = handler
+            except ValueError:
+                # signal.signal only works on the main thread; excepthook
+                # chaining above still covers unhandled exceptions
+                print("[flight] not on main thread; signal hooks skipped")
+            self._installed = True
             return self
-        prev_hook = sys.excepthook
-        self._prev_excepthook = prev_hook
 
-        def hook(exc_type, exc, tb):
-            self._dump_quietly(f"unhandled:{exc_type.__name__}",
-                               {"error": repr(exc)}, key=id(exc))
-            prev_hook(exc_type, exc, tb)
-
-        sys.excepthook = hook
-        try:
-            for s in signals:
-                prev = signal.getsignal(s)
-
-                def handler(signum, frame, _prev=prev):
-                    self._dump_quietly(f"signal:{signum}")
-                    if callable(_prev):
-                        _prev(signum, frame)
-                    elif (_prev == signal.SIG_DFL
-                          and signal.getsignal(signum) is handler):
-                        # the default disposition (terminate) must still
-                        # apply: restore it and re-deliver — dump-and-
-                        # ignore would eat the scheduler's grace window.
-                        # Only while we are the ACTIVE handler though:
-                        # if something installed over us and chained in
-                        # (the preemption handler), THAT owner decides
-                        # the disposition — terminating here would kill
-                        # its graceful final checkpoint
-                        signal.signal(signum, signal.SIG_DFL)
-                        signal.raise_signal(signum)
-                    # SIG_IGN: stay ignored
-
-                signal.signal(s, handler)
-                self._prev_signals[s] = prev
-        except ValueError:
-            # signal.signal only works on the main thread; excepthook
-            # chaining above still covers unhandled exceptions
-            print("[flight] not on main thread; signal hooks skipped")
-        self._installed = True
-        return self
+    def _relink_displaced(self, s, prev):
+        try:    # lazy: observability must not hard-depend on checkpoint
+            from ...checkpoint.preemption import dispatcher
+        except ImportError:
+            return
+        dispatcher().relink_prev(s, self._sig_hooks.get(s), prev)
 
     def uninstall(self):
-        if not self._installed:
-            return
-        if self._prev_excepthook is not None:
-            sys.excepthook = self._prev_excepthook
-            self._prev_excepthook = None
-        for s, prev in self._prev_signals.items():
-            try:
-                signal.signal(s, prev)
-            except ValueError:
-                pass
-        self._prev_signals.clear()
-        self._installed = False
+        """Restore the dispositions we displaced — but ONLY where we are
+        still the active hook.  A later installer (e.g. the preemption
+        dispatcher hooking SIGTERM over us) owns the registration now;
+        blindly restoring our saved prev would silently unhook it —
+        every PreemptionHandler in the process would miss the
+        scheduler's kill grace window (same guard as the dispatcher's
+        own unregister)."""
+        with self._lock:
+            if not self._installed:
+                return
+            if self._prev_excepthook is not None:
+                if sys.excepthook is self._hook_fn:
+                    sys.excepthook = self._prev_excepthook
+                self._prev_excepthook = None
+                self._hook_fn = None
+            for s, prev in self._prev_signals.items():
+                try:
+                    if signal.getsignal(s) is self._sig_hooks.get(s):
+                        signal.signal(s, prev)
+                    else:
+                        # displaced: the preemption dispatcher may have
+                        # saved OUR handler as its chained prev — swap
+                        # in what we displaced, so the dead closure of
+                        # an uninstalled recorder is never called (or
+                        # restored to the OS) after teardown
+                        self._relink_displaced(s, prev)
+                except ValueError:
+                    pass
+            self._prev_signals.clear()
+            self._sig_hooks.clear()
+            self._installed = False
 
 
 def read_flight(path: str) -> Dict[str, Any]:

@@ -45,7 +45,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 from urllib.parse import parse_qs, urlparse
 
-from .sinks import _json_default, render_prometheus
+from .sinks import (_json_default, render_prometheus,
+                    render_prometheus_multi)
 from ..utils.retry import RetryPolicy
 
 
@@ -87,6 +88,11 @@ class IntrospectionServer:
         self.bind_retries = int(bind_retries)
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+        # fleet mode: named (recorder, watchdog, monitor) jobs this
+        # server aggregates next to its own recorder.  Plain dict with
+        # whole-value assignment only (GIL-atomic); scrapes iterate a
+        # dict() copy, so registration needs no lock of its own
+        self._jobs: Dict[str, Dict[str, Any]] = {}
 
     # -- lifecycle --------------------------------------------------------- #
     def start(self) -> "IntrospectionServer":
@@ -145,11 +151,35 @@ class IntrospectionServer:
     def url(self, path: str = "/metrics") -> str:
         return f"http://{self.host}:{self.port}{path}"
 
+    # -- fleet job registration -------------------------------------------- #
+    def add_job(self, name: str, recorder, watchdog=None,
+                monitor=None) -> "IntrospectionServer":
+        """Aggregate ``recorder`` into this server under a
+        ``job="<name>"`` label on every /metrics sample and a per-job
+        verdict in /healthz (the aggregated ``ok`` is the worst-of).
+        ``watchdog``/``monitor`` may be the object itself or a zero-arg
+        callable resolved per scrape — a supervisor builds its watchdog
+        lazily, after the job is registered."""
+        self._jobs[str(name)] = {"recorder": recorder,
+                                 "watchdog": watchdog,
+                                 "monitor": monitor}
+        return self
+
+    def remove_job(self, name: str):
+        self._jobs.pop(str(name), None)
+
     # -- routing ----------------------------------------------------------- #
     def _route(self, h: BaseHTTPRequestHandler):
         parsed = urlparse(h.path)
         if parsed.path == "/metrics":
-            body = render_prometheus(self.recorder, self.namespace)
+            jobs = dict(self._jobs)
+            if jobs:
+                sources = [(None, self.recorder)]
+                sources += [({"job": name}, j["recorder"])
+                            for name, j in jobs.items()]
+                body = render_prometheus_multi(sources, self.namespace)
+            else:
+                body = render_prometheus(self.recorder, self.namespace)
             self._reply(h, 200, body,
                         "text/plain; version=0.0.4; charset=utf-8")
         elif parsed.path == "/healthz":
@@ -186,19 +216,26 @@ class IntrospectionServer:
         h.wfile.write(data)
 
     # -- health verdict ----------------------------------------------------- #
-    def healthz(self) -> Dict[str, Any]:
-        """The /healthz JSON: liveness + queue depths + sentinel state.
-        ``ok`` is False when the watchdog says stalled or the monitor
-        has tripped a fatal condition."""
-        rec = self.recorder
+    @staticmethod
+    def _resolve(obj):
+        """A watchdog/monitor registered as a zero-arg provider (fleet
+        jobs build theirs lazily) resolves at scrape time."""
+        return obj() if callable(obj) else obj
+
+    def _verdict(self, rec, watchdog, monitor) -> Dict[str, Any]:
+        """One source's healthz payload: liveness + queue depths +
+        sentinel state.  ``ok`` is False when the watchdog says stalled
+        or the monitor has tripped a fatal condition."""
         snap = rec.snapshot()
         gauges, counters = snap["gauges"], snap["counters"]
         stalled = bool(gauges.get("health/stalled", 0))
         budget = None
-        if self.watchdog is not None:
-            stalled = self.watchdog.check_once()
-            budget = self.watchdog.budget()
-        diverged = (self.monitor is not None and not self.monitor.healthy)
+        watchdog = self._resolve(watchdog)
+        monitor = self._resolve(monitor)
+        if watchdog is not None:
+            stalled = watchdog.check_once()
+            budget = watchdog.budget()
+        diverged = (monitor is not None and not monitor.healthy)
         out: Dict[str, Any] = {
             "ok": not (stalled or diverged),
             "stalled": stalled,
@@ -217,4 +254,25 @@ class IntrospectionServer:
             shed = (counters.get("serving.shed_queue_full", 0)
                     + counters.get("serving.shed_deadline", 0))
             out["shed_rate"] = shed / requests
+        return out
+
+    def healthz(self) -> Dict[str, Any]:
+        """The /healthz JSON.  With registered fleet jobs the payload
+        grows a per-job verdict map and the top-level ``ok`` becomes the
+        WORST-OF: 503 iff the base source or any job is stalled or
+        diverged — one probe covers the whole pool."""
+        out = self._verdict(self.recorder, self.watchdog, self.monitor)
+        jobs = dict(self._jobs)
+        if not jobs:
+            return out
+        out["jobs"] = {}
+        stalled, diverged = out["stalled"], out["diverged"]
+        for name, j in jobs.items():
+            v = self._verdict(j["recorder"], j["watchdog"], j["monitor"])
+            out["jobs"][name] = v
+            stalled = stalled or v["stalled"]
+            diverged = diverged or v["diverged"]
+        out["stalled"] = stalled
+        out["diverged"] = diverged
+        out["ok"] = not (stalled or diverged)
         return out

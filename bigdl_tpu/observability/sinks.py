@@ -171,7 +171,96 @@ def _prom_value(v) -> str:
     return repr(f)
 
 
-def render_prometheus(recorder, namespace: str = "bigdl") -> str:
+def _prom_labels(labels: Optional[Dict[str, Any]]) -> str:
+    """``{k="v",...}`` sample-label block; empty string for no labels."""
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{prometheus_escape_label(v)}"'
+                     for k, v in labels.items())
+    return "{" + inner + "}"
+
+
+def _prom_group(groups: Dict[str, Dict[str, Any]], metric: str,
+                help_text: str, type_text: str) -> List[str]:
+    """The sample-line list for ``metric``, creating its HELP/TYPE group
+    on first sight — exposition format wants ONE header per metric even
+    when several labeled sources (fleet jobs) contribute samples."""
+    g = groups.get(metric)
+    if g is None:
+        g = groups[metric] = {"help": help_text, "type": type_text,
+                              "lines": []}
+    return g["lines"]
+
+
+def _collect_prometheus(recorder, namespace: str,
+                        labels: Optional[Dict[str, Any]],
+                        groups: Dict[str, Dict[str, Any]]) -> None:
+    """Fold one recorder's snapshot into ``groups`` (ordered metric →
+    header + sample lines), tagging every sample with ``labels``."""
+    snap = recorder.snapshot()
+    lab = dict(labels or {})
+
+    for name in sorted(snap["counters"]):
+        metric = prometheus_name(name, namespace)
+        if not metric.endswith("_total"):
+            metric += "_total"
+        _prom_group(groups, metric,
+                    prometheus_escape_help("counter " + name),
+                    "counter").append(
+            f"{metric}{_prom_labels(lab)} "
+            f"{_prom_value(snap['counters'][name])}")
+
+    queue_depths = {}
+    for name in sorted(snap["gauges"]):
+        if name.startswith("serving.queue_depth."):
+            queue_depths[name[len("serving.queue_depth."):]] = \
+                snap["gauges"][name]
+            continue
+        metric = prometheus_name(name, namespace)
+        _prom_group(groups, metric,
+                    prometheus_escape_help("gauge " + name),
+                    "gauge").append(
+            f"{metric}{_prom_labels(lab)} "
+            f"{_prom_value(snap['gauges'][name])}")
+    if queue_depths:
+        metric = prometheus_name("serving.queue_depth", namespace)
+        lines = _prom_group(groups, metric, "rows queued per model",
+                            "gauge")
+        for model in sorted(queue_depths):
+            lines.append(
+                f"{metric}{_prom_labels({**lab, 'model': model})} "
+                f"{_prom_value(queue_depths[model])}")
+
+    for name in sorted(recorder.hist_names()):
+        summ = recorder.hist_summary(name)
+        if not summ:
+            continue
+        metric = prometheus_name(name, namespace)
+        lines = _prom_group(groups, metric,
+                            prometheus_escape_help("histogram " + name),
+                            "summary")
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            if key in summ:
+                lines.append(
+                    f"{metric}{_prom_labels({**lab, 'quantile': q})} "
+                    f"{_prom_value(summ[key])}")
+        lines.append(f"{metric}_sum{_prom_labels(lab)} "
+                     f"{_prom_value(summ['mean'] * summ['count'])}")
+        lines.append(f"{metric}_count{_prom_labels(lab)} "
+                     f"{int(summ['count'])}")
+
+
+def _emit_prometheus(groups: Dict[str, Dict[str, Any]]) -> str:
+    lines: List[str] = []
+    for metric, g in groups.items():
+        lines.append(f"# HELP {metric} {g['help']}")
+        lines.append(f"# TYPE {metric} {g['type']}")
+        lines.extend(g["lines"])
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+def render_prometheus(recorder, namespace: str = "bigdl",
+                      labels: Optional[Dict[str, Any]] = None) -> str:
     """Render ``recorder``'s counters, gauges and pending histograms as
     Prometheus text exposition format (version 0.0.4).
 
@@ -181,56 +270,24 @@ def render_prometheus(recorder, namespace: str = "bigdl") -> str:
     window plus exact ``_sum``/``_count``.  Per-model
     ``serving.queue_depth.<model>`` gauges fold into ONE metric with a
     ``model`` label so a fleet of models can't explode the metric
-    namespace."""
-    snap = recorder.snapshot()
-    lines: List[str] = []
+    namespace.  ``labels`` tags every sample (e.g. ``{"job": name}``)."""
+    groups: Dict[str, Dict[str, Any]] = {}
+    _collect_prometheus(recorder, namespace, labels, groups)
+    return _emit_prometheus(groups)
 
-    for name in sorted(snap["counters"]):
-        metric = prometheus_name(name, namespace)
-        if not metric.endswith("_total"):
-            metric += "_total"
-        lines.append(f"# HELP {metric} "
-                     f"{prometheus_escape_help('counter ' + name)}")
-        lines.append(f"# TYPE {metric} counter")
-        lines.append(f"{metric} {_prom_value(snap['counters'][name])}")
 
-    queue_depths = {}
-    for name in sorted(snap["gauges"]):
-        if name.startswith("serving.queue_depth."):
-            queue_depths[name[len("serving.queue_depth."):]] = \
-                snap["gauges"][name]
-            continue
-        metric = prometheus_name(name, namespace)
-        lines.append(f"# HELP {metric} "
-                     f"{prometheus_escape_help('gauge ' + name)}")
-        lines.append(f"# TYPE {metric} gauge")
-        lines.append(f"{metric} {_prom_value(snap['gauges'][name])}")
-    if queue_depths:
-        metric = prometheus_name("serving.queue_depth", namespace)
-        lines.append(f"# HELP {metric} rows queued per model")
-        lines.append(f"# TYPE {metric} gauge")
-        for model in sorted(queue_depths):
-            lines.append(
-                f'{metric}{{model="{prometheus_escape_label(model)}"}} '
-                f"{_prom_value(queue_depths[model])}")
-
-    for name in sorted(recorder.hist_names()):
-        summ = recorder.hist_summary(name)
-        if not summ:
-            continue
-        metric = prometheus_name(name, namespace)
-        lines.append(f"# HELP {metric} "
-                     f"{prometheus_escape_help('histogram ' + name)}")
-        lines.append(f"# TYPE {metric} summary")
-        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
-            if key in summ:
-                lines.append(f'{metric}{{quantile="{q}"}} '
-                             f"{_prom_value(summ[key])}")
-        lines.append(f"{metric}_sum "
-                     f"{_prom_value(summ['mean'] * summ['count'])}")
-        lines.append(f"{metric}_count {int(summ['count'])}")
-
-    return "\n".join(lines) + "\n" if lines else ""
+def render_prometheus_multi(sources, namespace: str = "bigdl") -> str:
+    """One exposition over several recorders — the fleet's aggregated
+    ``/metrics``.  ``sources`` is an iterable of ``(labels, recorder)``
+    pairs (``labels`` None for the unlabeled base source); a metric
+    emitted by several sources renders under ONE ``HELP``/``TYPE``
+    header with one labeled sample per source, so per-job ``fleet/*``
+    and ``elastic/*`` counters stay distinct series instead of
+    colliding."""
+    groups: Dict[str, Dict[str, Any]] = {}
+    for labels, recorder in sources:
+        _collect_prometheus(recorder, namespace, labels, groups)
+    return _emit_prometheus(groups)
 
 
 def read_jsonl(path: str) -> List[Dict[str, Any]]:

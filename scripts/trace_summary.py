@@ -59,6 +59,16 @@ Two subcommands:
 
         python scripts/trace_summary.py comm /tmp/telemetry.jsonl [last_n]
 
+  fleet              per-job fleet/elastic event timelines from one or
+                     more telemetry JSONL streams (each job usually has
+                     its own recorder/sink): one chronological
+                     admit → place → preempt/displace → shrink →
+                     regrow → complete table across the pool, plus the
+                     per-job event sequence — the one-command view of
+                     "what did the scheduler do to my job":
+
+        python scripts/trace_summary.py fleet /tmp/fleet.jsonl /tmp/job_*.jsonl
+
 CPU-only (no device access), so it is safe to run while the tunnel is
 wedged.
 """
@@ -312,6 +322,77 @@ def summarize_health(events, flights, out=print):
                 f"last_step={d.get('last_step')}  "
                 f"ring_records={n_rec}  "
                 f"health_events={d.get('counters', {}).get('health/events', 0):.0f}")
+
+
+def load_fleet(paths):
+    """Chronologically-merged ``fleet_event`` + ``elastic_event``
+    records from telemetry JSONL files (directories are scanned for
+    ``*.jsonl``).  Several streams merge into one timeline — in a
+    fleet each job usually writes through its own recorder/sink."""
+    expanded = []
+    for p in paths:
+        if os.path.isdir(p):
+            expanded += sorted(glob.glob(os.path.join(p, "*.jsonl")))
+        else:
+            expanded.append(p)
+    events = []
+    for p in expanded:
+        src = os.path.basename(p)
+        events += [(src, rec) for rec in iter_jsonl(p)
+                   if rec.get("type") in ("fleet_event", "elastic_event")]
+    events.sort(key=lambda sr: sr[1].get("time") or 0.0)
+    return events
+
+
+def _fmt_axes(axes):
+    if not isinstance(axes, dict):
+        return "?"
+    return "x".join(f"{k}{v}" for k, v in axes.items())
+
+
+def summarize_fleet(events, out=print):
+    """Render the pool timeline and per-job event sequences."""
+    if not events:
+        out("no fleet or elastic events found")
+        return
+    t0 = min(ev.get("time") or 0.0 for _, ev in events)
+    jobs, seen = [], {}
+    out("== fleet timeline ==")
+    out(f"  {'t':>8}  {'job':<10} {'event':<12} detail")
+    for src, ev in events:
+        job = ev.get("job") or "-"
+        if job not in seen:
+            seen[job] = []
+            jobs.append(job)
+        kind = ev.get("kind", "?")
+        seen[job].append(kind)
+        parts = []
+        if ev.get("from_axes") is not None:
+            parts.append(f"{_fmt_axes(ev['from_axes'])} -> "
+                         f"{_fmt_axes(ev.get('to_axes'))}")
+        elif ev.get("axes") is not None:
+            parts.append(_fmt_axes(ev["axes"]))
+        elif ev.get("template") is not None:
+            parts.append(f"template {_fmt_axes(ev['template'])}")
+        if ev.get("devices") is not None:
+            parts.append(f"devices={ev['devices']:g}")
+        if ev.get("from_devices") is not None:
+            parts.append(f"(was {ev['from_devices']:g})")
+        if ev.get("step") is not None:
+            parts.append(f"step={ev['step']:g}")
+        if ev.get("steps") is not None:
+            parts.append(f"steps={ev['steps']:g}")
+        if ev.get("priority") is not None:
+            parts.append(f"prio={ev['priority']:g}")
+        if ev.get("reason"):
+            parts.append(f"[{ev['reason']}]")
+        if ev.get("error"):
+            parts.append(f"error={ev['error']}")
+        dt = (ev.get("time") or 0.0) - t0
+        out(f"  {dt:>+7.2f}s  {job:<10} {kind:<12} {' '.join(parts)}")
+    out("\n== per-job event sequence ==")
+    for job in jobs:
+        out(f"  {job}: {' -> '.join(seen[job])}")
 
 
 def load_profile(path):
@@ -582,6 +663,14 @@ def main_profile(argv):
     summarize_profile(profiles, steps)
 
 
+def main_fleet(argv):
+    if not argv:
+        raise SystemExit("usage: trace_summary.py fleet "
+                         "<telemetry.jsonl | dir>...")
+    events = load_fleet(argv)
+    summarize_fleet(events)
+
+
 def main_health(argv):
     if not argv:
         raise SystemExit("usage: trace_summary.py health "
@@ -628,6 +717,8 @@ def main():
         main_profile(argv[1:])
     elif argv and argv[0] == "health":
         main_health(argv[1:])
+    elif argv and argv[0] == "fleet":
+        main_fleet(argv[1:])
     elif argv and argv[0] == "xplane":
         main_xplane(argv[1:])
     else:           # back-compat: bare path = xplane trace dir

@@ -75,6 +75,46 @@ def test_plan_mesh_respects_floors_and_fails_loudly():
     assert plan_mesh(4, {"tp": 4}, {"tp": 3}) == {"tp": 4}
 
 
+def test_plan_mesh_partial_pool_shares():
+    """Fleet sub-pools: plans over the odd device counts a shared pool
+    hands out (the job's share, not a power-of-two world)."""
+    # floors exactly AT the share boundary: the plan IS the floor
+    assert plan_mesh(4, {"dp": 4, "tp": 2}, {"dp": 2, "tp": 2}) == \
+        {"dp": 2, "tp": 2}
+    with pytest.raises(ValueError):
+        plan_mesh(3, {"dp": 4, "tp": 2}, {"dp": 2, "tp": 2})
+    # shares that fit nothing but a floor'd minimum
+    assert plan_mesh(2, {"dp": 8, "fsdp": 2}, {"fsdp": 2}) == \
+        {"dp": 1, "fsdp": 2}
+    # two half-pool shares of the same template shrink identically —
+    # the tie-break (dp first, model axes last) is what makes two
+    # contending jobs land on the same shape
+    a = plan_mesh(4, {"dp": 4, "tp": 2})
+    b = plan_mesh(4, {"dp": 4, "tp": 2})
+    assert a == b == {"dp": 2, "tp": 2}
+    # 5-, 6-, 7-device shares of a dp8 template all land on the
+    # largest fitting divisor, never strand the job
+    assert [plan_mesh(n, {"dp": 8})["dp"] for n in (5, 6, 7)] == \
+        [4, 4, 4]
+
+
+def test_plan_devices_non_contiguous_subsets():
+    """The fleet hands jobs arbitrary (non-prefix, non-contiguous)
+    device subsets; plans must take a deterministic prefix OF THAT
+    SUBSET and reject shares that are too small — never reach outside
+    their assignment."""
+    devs = jax.devices()
+    share = [devs[1], devs[4], devs[6], devs[7]]    # scattered
+    from bigdl_tpu.elastic import plan_devices
+    used = plan_devices({"dp": 2}, share)
+    assert used == share[:2]
+    assert plan_devices({"dp": 2, "fsdp": 2}, share) == share
+    with pytest.raises(ValueError, match="needs 4"):
+        plan_devices({"dp": 4}, share[:3])
+    # determinism: same subset -> same prefix, independent of identity
+    assert plan_devices({"dp": 2}, list(share)) == used
+
+
 # --------------------------------------------------------------------- #
 # mesh metadata                                                          #
 # --------------------------------------------------------------------- #

@@ -453,6 +453,44 @@ def test_flight_and_preemption_sigterm_chain_both_orders(tmp_path,
         signal.signal(signal.SIGTERM, signal.SIG_DFL)
 
 
+def test_flight_uninstall_while_displaced_leaves_owner_hooked(tmp_path):
+    """fr.uninstall() after the preemption dispatcher hooked SIGTERM
+    over it must NOT restore its saved disposition — that would unhook
+    every PreemptionHandler in the process AND leave the dispatcher's
+    saved-prev stale, so the next install cycle believes it owns a hook
+    the OS no longer has and a real SIGTERM kills the process."""
+    import os
+    import signal
+    from bigdl_tpu.checkpoint import PreemptionHandler
+    from bigdl_tpu.checkpoint.preemption import dispatcher
+
+    rec = Recorder(annotate=False)
+    rec.start_step(0)
+    rec.end_step(0)
+    fr = FlightRecorder(rec, str(tmp_path))
+    ph = PreemptionHandler()
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)   # known baseline
+    try:
+        fr.install()
+        ph.install()                    # dispatcher hooks over flight
+        flight_hook = fr._sig_hooks[signal.SIGTERM]
+        fr.uninstall()                  # displaced: must leave the hook
+        assert signal.getsignal(signal.SIGTERM) is dispatcher()._hook
+        # ... AND unlink itself from the dispatcher's chained prev —
+        # the dead closure must never be chained or restored again
+        assert dispatcher()._os_prev[signal.SIGTERM] is not flight_hook
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(0.05)
+        assert ph.requested             # delivery still works
+        ph.uninstall()
+        # the dispatcher released to what FLIGHT displaced (SIG_DFL),
+        # not to the uninstalled recorder's handler
+        assert signal.getsignal(signal.SIGTERM) is signal.SIG_DFL
+    finally:
+        ph.uninstall()                  # idempotent cleanup
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+
 def test_flight_dump_is_signal_reentrant(tmp_path):
     """A chained handler re-entering dump() on the same thread (signal
     delivered mid-dump) must not deadlock on the recorder lock."""
