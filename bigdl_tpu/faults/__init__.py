@@ -17,6 +17,11 @@ Sites (the stable names tests and operators use)::
     data.shard_open     opening one shard file in a data worker
     data.record_read    reading one record out of an open shard
     serving.swap        registry weight hot-swap (validate + publish)
+    serving.compute     one serving batch execution (delay = a wedged
+                        replica, err = a failing one — what the
+                        replica-set failover chaos legs arm)
+    serving.publish     the canary publisher's staging step (the
+                        swap onto the canary replica)
     http.bind           introspection-server socket bind
     step.dispatch       the supervisor's per-step dispatch
     fleet.place         the fleet scheduler computing/applying a placement
@@ -71,8 +76,9 @@ ENV_VAR = "BIGDL_FAULT"
 KILL_EXIT_CODE = 42
 
 SITES = ("ckpt.shard_write", "ckpt.manifest", "data.shard_open",
-         "data.record_read", "serving.swap", "http.bind",
-         "step.dispatch", "fleet.place", "fleet.preempt")
+         "data.record_read", "serving.swap", "serving.compute",
+         "serving.publish", "http.bind", "step.dispatch",
+         "fleet.place", "fleet.preempt")
 
 _MODES = ("err", "delay", "corrupt", "kill")
 

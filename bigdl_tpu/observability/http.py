@@ -254,6 +254,15 @@ class IntrospectionServer:
             shed = (counters.get("serving.shed_queue_full", 0)
                     + counters.get("serving.shed_deadline", 0))
             out["shed_rate"] = shed / requests
+        # replica-set health (serving resilience): rotation state per
+        # replica, the healthy count, and the brownout flag — published
+        # as gauges by ReplicaSet.check_health, folded in here so one
+        # /healthz answers "how degraded is the serving fleet"
+        replicas = {k: v for k, v in gauges.items()
+                    if k.startswith("replica/")
+                    or k in ("serving/brownout", "serving/saturation")}
+        if replicas:
+            out["replicas"] = replicas
         return out
 
     def healthz(self) -> Dict[str, Any]:

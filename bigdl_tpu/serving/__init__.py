@@ -40,10 +40,16 @@ from .engine import ServingEngine
 from .queue import (BatchingQueue, EngineClosedError, LoadShedError,
                     Request)
 from .registry import ModelEntry, ModelRegistry, Snapshot
+from .replicas import (CanaryPublisher, CanaryRejectedError,
+                       NoHealthyReplicaError, OverloadController,
+                       ReplicaSet, build_replica_set)
 
 __all__ = [
     "BucketLadder", "BatchingQueue", "Request",
     "LoadShedError", "EngineClosedError",
     "ModelRegistry", "ModelEntry", "Snapshot",
     "ServingEngine",
+    "ReplicaSet", "CanaryPublisher", "OverloadController",
+    "CanaryRejectedError", "NoHealthyReplicaError",
+    "build_replica_set",
 ]

@@ -53,6 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import faults as faultplane
 from ..observability import Recorder
 from .buckets import BucketLadder
 from .queue import (BatchingQueue, EngineClosedError, LoadShedError,
@@ -252,6 +253,26 @@ class ServingEngine:
         return jax.tree_util.tree_map(
             lambda *ps: np.concatenate(ps, axis=0), *parts)
 
+    def pending_rows(self) -> int:
+        """Rows queued across this engine's models — the queue-depth
+        input to replica health scoring and saturation accounting."""
+        with self._lock:
+            queues = list(self._queues.values())
+        return sum(q.depth() for q in queues)
+
+    def max_queue_fill(self) -> float:
+        """Fill fraction of this engine's MOST saturated model queue,
+        in [0, 1] — the admission-pressure signal replica saturation
+        accounting uses.  The max (not a sum over queues) keeps the
+        signal stable when queues are created lazily: a brownout
+        spinning up the int8 entry's queue must not dilute — or
+        double — the denominator it is controlled by."""
+        with self._lock:
+            queues = list(self._queues.values())
+        if not queues:
+            return 0.0
+        return max(q.depth() for q in queues) / self.max_queue_rows
+
     def stats(self) -> Dict[str, Any]:
         """One flat dict of the serving counters plus latency
         percentiles and mean batch fill — what ``serve_bench`` prints."""
@@ -353,6 +374,13 @@ class ServingEngine:
                                batch_requests=len(live))
                 tr.close("batch_gather", t_exec)
                 tr.open("compute", t_exec)
+        # chaos seam: the per-batch compute fault site.  ``err`` fails
+        # the batch (counted serving.errors, requests complete
+        # exceptionally — a ReplicaSet fails them over), ``delay``
+        # wedges this batcher thread the way a stuck device call would
+        # (chunked sleep, so it stays abortable) — the shape the
+        # replica watchdog's wedge ejection exists for
+        faultplane.inject("serving.compute", rec)
         snap = entry.snapshot          # one atomic read per batch
         with rec.span("serving.execute"):
             y = ex(snap.params, snap.state, jnp.asarray(x))

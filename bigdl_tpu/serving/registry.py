@@ -57,7 +57,8 @@ class ModelEntry:
 
     def __init__(self, name: str, model: Module, snapshot: Snapshot,
                  input_shape: Optional[Tuple[int, ...]],
-                 dtype, inference_only: bool = False):
+                 dtype, inference_only: bool = False,
+                 calibration_data=None):
         self.name = name
         self.model = model
         self.snapshot = snapshot
@@ -66,6 +67,10 @@ class ModelEntry:
         # int8-rewritten modules carry frozen weights as jitted-in
         # constants, so a weight swap cannot reuse the compiled buckets
         self.inference_only = inference_only
+        # the calibration batches an int8 entry was quantized with —
+        # kept so a canary promotion can re-quantize the degrade entry
+        # from the NEW weights with the same activation scales
+        self.calibration_data = calibration_data
         self.compiled: Dict[int, Any] = {}     # bucket -> executable
         # bucket -> XLA cost/memory capture (observability.profile):
         # what one execution of that bucket costs, harvested at compile
@@ -125,7 +130,9 @@ class ModelRegistry:
             Snapshot(model._params, dict(model._state or {}),
                      version or "v1"),
             None if input_shape is None else tuple(input_shape),
-            np.dtype(dtype), inference_only=inference_only)
+            np.dtype(dtype), inference_only=inference_only,
+            calibration_data=calibration_data if quantize_int8
+            else None)
         with self._lock:
             if name in self._entries:
                 raise ValueError(f"model {name!r} already registered; "

@@ -1,26 +1,44 @@
-"""Closed-loop load generator for the bigdl_tpu.serving engine.
+"""Load generator for the bigdl_tpu.serving engine / replica set.
 
-C client threads each run a closed loop: pick a request size uniformly
-in [1, 17] (deliberately straddling bucket boundaries 1/2/4/8/16/32),
-submit, wait for the result, repeat — the classic closed-loop protocol
-where offered load self-regulates to the engine's service rate and the
-interesting numbers are the latency percentiles and the batch-fill
-ratio, not raw QPS.
+Two protocols:
+
+**Closed loop** (default): C client threads each pick a request size
+uniformly in [1, 17] (straddling bucket boundaries 1/2/4/8/16/32),
+submit, wait, repeat — offered load self-regulates to the engine's
+service rate and the interesting numbers are the latency percentiles
+and the batch-fill ratio, not raw QPS.
+
+**Open loop** (``--open-loop``): seeded Poisson arrivals at
+``--rate`` requests/s, independent of service rate — the protocol that
+actually reveals overload behavior, since a saturated server keeps
+*receiving* arrivals instead of silently slowing its own clients.
+``--trace`` shapes the arrival rate over the run:
+
+    steady      constant ``--rate``
+    burst       1x baseline with a 6x burst over the middle fifth
+    overload    1x for 30% of the run, then 4x sustained
+
+Arrivals are deterministic given ``--seed`` (inter-arrival draws and
+request sizes come from one seeded RNG), so a shed-rate or p99 claim is
+replayable: same seed + same trace = same offered sequence.
+``--replicas N`` drives a :class:`~bigdl_tpu.serving.ReplicaSet`
+instead of a bare engine (``--brownout`` adds the int8 degrade entry
+and reports the brownout fraction).
 
 Emits ONE machine-parseable JSON summary as the final stdout line
 (same contract as bench.py: the driver parses the LAST line)::
 
-  {"metric": "serve_bench", "backend": "cpu", "requests": 240,
-   "p50_ms": ..., "p95_ms": ..., "p99_ms": ..., "batch_fill": ...,
-   "shed": 0, "recompiles": 0, "throughput_rps": ..., ...}
+  {"metric": "serve_bench", "mode": "open_loop", "trace": "overload",
+   "seed": 0, "offered": 2000, "completed": ..., "shed": ...,
+   "shed_rate": ..., "p50_ms": ..., "p99_ms": ...,
+   "brownout_fraction": ..., ...}
 
 ``--smoke`` is the CI job: a small MLP on the CPU backend, asserting
 the engine's core SLO invariant — ZERO XLA recompiles after warmup —
 and exiting non-zero if it (or any response) is wrong.
 
-``--overload`` shrinks the queue and adds per-request deadlines so the
-shed path is exercised (the summary's ``shed`` goes positive instead
-of latency collapsing).
+``--overload`` (closed loop) shrinks the queue and adds per-request
+deadlines so the shed path is exercised.
 """
 import argparse
 import json
@@ -39,10 +57,28 @@ def parse_args():
                     help="CI mode: CPU backend, small load, assert "
                          "zero recompiles after warmup")
     ap.add_argument("--overload", action="store_true",
-                    help="tiny queue + tight deadlines to exercise "
-                         "load shedding")
+                    help="closed loop: tiny queue + tight deadlines to "
+                         "exercise load shedding")
+    ap.add_argument("--open-loop", action="store_true",
+                    help="seeded Poisson arrivals at --rate instead of "
+                         "closed-loop clients")
+    ap.add_argument("--rate", type=float, default=200.0,
+                    help="open loop: baseline arrival rate, req/s")
+    ap.add_argument("--duration", type=float, default=None,
+                    help="open loop: run length in seconds "
+                         "(default: 4 smoke, 10 full)")
+    ap.add_argument("--trace", choices=("steady", "burst", "overload"),
+                    default="steady",
+                    help="open loop: arrival-rate shape over the run")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="open loop: arrival/size RNG seed (replay key)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="serve through a ReplicaSet of N engines")
+    ap.add_argument("--brownout", action="store_true",
+                    help="replicas: register the int8 degrade entry and "
+                         "report the brownout fraction")
     ap.add_argument("--requests", type=int, default=None,
-                    help="total requests across all clients "
+                    help="closed loop: total requests across clients "
                          "(default: 240 smoke, 2000 full)")
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--max-batch", type=int, default=32)
@@ -56,7 +92,13 @@ def parse_args():
                     help="serve through the quantized int8 path")
     ap.add_argument("--max-size", type=int, default=17,
                     help="request sizes drawn from [1, max-size]")
-    return ap.parse_args()
+    args = ap.parse_args()
+    if args.int8 and args.replicas > 1:
+        # --int8 is the single-engine quantized serving path; in
+        # replica mode int8 exists as the brownout degrade entry
+        ap.error("--int8 serves a single quantized engine; with "
+                 "--replicas use --brownout (int8 degrade entry)")
+    return args
 
 
 ARGS = parse_args()
@@ -70,7 +112,15 @@ import jax                                                 # noqa: E402
 from bigdl_tpu import nn                                   # noqa: E402
 from bigdl_tpu.observability import Recorder               # noqa: E402
 from bigdl_tpu.serving import (LoadShedError,              # noqa: E402
-                               ModelRegistry, ServingEngine)
+                               ModelRegistry, OverloadController,
+                               ServingEngine, build_replica_set)
+
+#: --trace shapes as (start_fraction_of_run, rate_multiplier) phases
+TRACES = {
+    "steady": ((0.0, 1.0),),
+    "burst": ((0.0, 1.0), (0.4, 6.0), (0.6, 1.0)),
+    "overload": ((0.0, 1.0), (0.3, 4.0)),
+}
 
 
 def build_model(kind):
@@ -82,33 +132,122 @@ def build_model(kind):
     return model, (64,)
 
 
-def main():
-    a = ARGS
-    n_requests = a.requests if a.requests is not None \
-        else (240 if a.smoke else 2000)
-    if a.overload:
-        a.queue_rows = min(a.queue_rows, 2 * a.max_batch)
-        if a.deadline_ms is None:
-            a.deadline_ms = 50.0
-
-    model, input_shape = build_model(a.model)
-    model.evaluate()
-    rec = Recorder(annotate=False)
+def build_target(a, model, input_shape, rec):
+    """-> (target, engines): a ServingEngine or a ReplicaSet plus the
+    underlying engine list (for recompile accounting)."""
+    calib = [np.zeros((4,) + input_shape, np.float32)] \
+        if (a.int8 or a.brownout) else None
+    if a.replicas > 1:
+        # (--int8 is rejected with --replicas at parse time: the int8
+        # entry only exists here as the brownout degrade target)
+        rs = build_replica_set(
+            model, a.replicas, name="main", input_shape=input_shape,
+            int8_degrade=a.brownout, calibration_data=calib,
+            engine_kw=dict(max_batch=a.max_batch,
+                           max_delay_ms=a.delay_ms,
+                           max_queue_rows=a.queue_rows),
+            recorder=rec, health_interval=0.05,
+            controller=OverloadController(hold_s=0.2))
+        return rs, [r.engine for r in rs.replicas]
     reg = ModelRegistry()
-    calib = [np.zeros((4,) + input_shape, np.float32)] if a.int8 else None
     reg.register("main", model, input_shape=input_shape,
                  quantize_int8=a.int8, calibration_data=calib)
     eng = ServingEngine(reg, max_batch=a.max_batch,
                         max_delay_ms=a.delay_ms,
                         max_queue_rows=a.queue_rows, recorder=rec)
+    return eng, [eng]
 
-    t0 = time.perf_counter()
-    eng.warmup()
-    warm_s = time.perf_counter() - t0
-    print(f"# warmup: {rec.counter_value('serving.warmup_compiles'):.0f} "
-          f"bucket compiles in {warm_s:.1f}s "
-          f"(buckets {list(eng.ladder)})", flush=True)
 
+def mult_at(phases, frac):
+    m = phases[0][1]
+    for start, mult in phases:
+        if frac >= start:
+            m = mult
+    return m
+
+
+def run_open_loop(a, target, input_shape, duration, size_cap):
+    """Seeded Poisson arrival generator; returns (latencies, shed,
+    errors, offered).  Every submitted future is awaited, so
+    'offered = completed + shed + errors' is a closed ledger."""
+    rng = np.random.RandomState(a.seed)
+    phases = TRACES[a.trace]
+    lock = threading.Lock()
+    latencies, errors = [], []
+    shed = [0]
+    processed = [0]
+    pending = []
+    deadline_ms = a.deadline_ms
+
+    def on_done(t0, fut):
+        try:
+            fut.result()
+            dt = (time.perf_counter() - t0) * 1e3
+            with lock:
+                latencies.append(dt)
+        except LoadShedError:
+            with lock:
+                shed[0] += 1
+        except Exception as e:
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+        finally:
+            with lock:
+                processed[0] += 1
+
+    # arrival times are generated in VIRTUAL time (phase multiplier and
+    # termination both read t_virtual, never the wall clock), so the
+    # offered sequence — arrival times, sizes, total count — is exactly
+    # determined by (seed, trace, rate, duration); wall clock only
+    # paces the replay
+    t_start = time.perf_counter()
+    t_virtual = 0.0
+    offered = 0
+    while True:
+        rate = a.rate * mult_at(phases, t_virtual / duration)
+        t_virtual += rng.exponential(1.0 / rate)
+        if t_virtual >= duration:
+            break
+        # submit() never splits, so open-loop sizes stay on the ladder
+        n = int(rng.randint(1, size_cap + 1))
+        while True:
+            lag = t_start + t_virtual - time.perf_counter()
+            if lag <= 0:
+                break
+            time.sleep(min(lag, 0.01))
+        x = rng.rand(n, *input_shape).astype(np.float32)
+        offered += 1
+        t0 = time.perf_counter()
+        try:
+            fut = target.submit("main", x, deadline_ms=deadline_ms)
+        except LoadShedError:
+            with lock:
+                shed[0] += 1
+            continue
+        except Exception as e:
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+            continue
+        fut.add_done_callback(lambda f, t0=t0: on_done(t0, f))
+        pending.append(fut)
+    for f in pending:
+        try:
+            f.exception(timeout=120)
+        except Exception:
+            pass
+    # a future's waiters can wake before its done-callbacks have run:
+    # wait for every callback so the offered = completed + shed +
+    # errors ledger is closed before the summary is cut
+    t_end = time.monotonic() + 30
+    while time.monotonic() < t_end:
+        with lock:
+            if processed[0] >= len(pending):
+                break
+        time.sleep(0.005)
+    return latencies, shed[0], errors, offered
+
+
+def run_closed_loop(a, target, input_shape, n_requests):
     lock = threading.Lock()
     latencies, errors = [], []
     shed = [0]
@@ -125,8 +264,8 @@ def main():
             x = rng.rand(n, *input_shape).astype(np.float32)
             t = time.perf_counter()
             try:
-                y = eng.predict("main", x, timeout=120,
-                                deadline_ms=a.deadline_ms)
+                y = target.predict("main", x, timeout=120,
+                                   deadline_ms=a.deadline_ms)
                 dt = (time.perf_counter() - t) * 1e3
                 with lock:
                     latencies.append(dt)
@@ -140,40 +279,103 @@ def main():
                 with lock:
                     errors.append(f"{type(e).__name__}: {e}")
 
-    t0 = time.perf_counter()
     threads = [threading.Thread(target=client, args=(i,), daemon=True)
                for i in range(a.clients)]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
-    wall = time.perf_counter() - t0
-    eng.shutdown(drain=True)
+    return latencies, shed[0], errors, n_requests
 
-    stats = eng.stats()
+
+def main():
+    a = ARGS
+    if a.overload:
+        a.queue_rows = min(a.queue_rows, 2 * a.max_batch)
+        if a.deadline_ms is None:
+            a.deadline_ms = 50.0
+    if a.open_loop and a.deadline_ms is None:
+        a.deadline_ms = 250.0
+
+    model, input_shape = build_model(a.model)
+    model.evaluate()
+    rec = Recorder(annotate=False)
+    target, engines = build_target(a, model, input_shape, rec)
+
+    t0 = time.perf_counter()
+    target.warmup()
+    warm_s = time.perf_counter() - t0
+    warm = sum(e.recorder.counter_value("serving.warmup_compiles")
+               for e in engines)
+    ladder = engines[0].ladder
+    print(f"# warmup: {warm:.0f} bucket compiles in {warm_s:.1f}s "
+          f"(buckets {list(ladder)}, {len(engines)} replica(s))",
+          flush=True)
+
+    t0 = time.perf_counter()
+    if a.open_loop:
+        duration = a.duration if a.duration is not None \
+            else (4.0 if a.smoke else 10.0)
+        latencies, shed, errors, offered = run_open_loop(
+            a, target, input_shape, duration,
+            min(a.max_size, ladder.max_batch))
+    else:
+        offered = a.requests if a.requests is not None \
+            else (240 if a.smoke else 2000)
+        latencies, shed, errors, offered = run_closed_loop(
+            a, target, input_shape, offered)
+    wall = time.perf_counter() - t0
+    target.shutdown(drain=True)
+
     lat = np.asarray(latencies) if latencies else np.zeros(1)
-    engine_shed = int(stats["shed_queue_full"] + stats["shed_deadline"])
+    recompiles = sum(e.recorder.counter_value("serving.recompiles")
+                     for e in engines)
+    rows_total = sum(e.recorder.counter_value("serving.rows")
+                     for e in engines)
+    fills = [e.recorder.hist_summary("serving.batch_fill")
+             for e in engines]
+    fills = [f["mean"] for f in fills if f]
     summary = {
         "metric": "serve_bench",
+        "mode": "open_loop" if a.open_loop else "closed_loop",
         "backend": jax.default_backend(),
         "model": a.model + ("_int8" if a.int8 else ""),
-        "requests": n_requests,
+        "replicas": len(engines),
+        "requests": offered,
+        "offered": offered,
         "completed": len(latencies),
-        "clients": a.clients,
-        "max_batch": eng.ladder.max_batch,
+        "shed": int(shed),
+        "shed_rate": round(shed / max(offered, 1), 4),
+        "max_batch": ladder.max_batch,
         "delay_ms": a.delay_ms,
+        "deadline_ms": a.deadline_ms,
         "p50_ms": round(float(np.percentile(lat, 50)), 3),
         "p95_ms": round(float(np.percentile(lat, 95)), 3),
         "p99_ms": round(float(np.percentile(lat, 99)), 3),
-        "batch_fill": round(float(stats.get("batch_fill", 0.0)), 4),
-        "shed": engine_shed,
-        "recompiles": int(stats["recompiles"]),
-        "warmup_compiles": int(stats["warmup_compiles"]),
+        "batch_fill": round(float(np.mean(fills)) if fills else 0.0, 4),
+        "recompiles": int(recompiles),
+        "warmup_compiles": int(warm),
         "throughput_rps": round(len(latencies) / wall, 2),
-        "throughput_rows_per_sec": round(stats["rows"] / wall, 2),
+        "throughput_rows_per_sec": round(rows_total / wall, 2),
         "errors": len(errors),
         "smoke": bool(a.smoke),
     }
+    if a.open_loop:
+        summary.update({"trace": a.trace, "seed": a.seed,
+                        "rate": a.rate, "duration": round(wall, 2)})
+    if a.replicas > 1:
+        browned = rec.counter_value("serving/brownout_requests")
+        admitted = rec.counter_value("serving/requests")
+        summary.update({
+            "brownout_fraction": round(browned / max(admitted, 1), 4),
+            "shed_overload": int(rec.counter_value(
+                "serving/shed_overload")),
+            "shed_predicted": int(rec.counter_value(
+                "serving/shed_predicted")),
+            "failovers": int(rec.counter_value("replica/failovers")),
+        })
+    elif a.open_loop:
+        summary["brownout_fraction"] = 0.0
     for e in errors[:5]:
         print(f"# client error: {e}", file=sys.stderr, flush=True)
     ok = not errors
@@ -184,8 +386,16 @@ def main():
             print(f"# SMOKE FAIL: {summary['recompiles']} recompiles "
                   "after warmup", file=sys.stderr, flush=True)
             ok = False
-        if not a.overload and summary["completed"] != n_requests:
-            print(f"# SMOKE FAIL: {summary['completed']}/{n_requests} "
+        if a.open_loop:
+            # open loop: the ledger must close — every offered request
+            # either completed or ended in a counted shed
+            if summary["completed"] + summary["shed"] != offered:
+                print(f"# SMOKE FAIL: {summary['completed']} completed "
+                      f"+ {summary['shed']} shed != {offered} offered",
+                      file=sys.stderr, flush=True)
+                ok = False
+        elif not a.overload and summary["completed"] != offered:
+            print(f"# SMOKE FAIL: {summary['completed']}/{offered} "
                   "completed", file=sys.stderr, flush=True)
             ok = False
     print(json.dumps(summary), flush=True)

@@ -1,0 +1,324 @@
+#!/usr/bin/env python
+"""Serving-resilience chaos smoke (ISSUE 12 acceptance, CI
+``serving-chaos-smoke``): one open-loop load run over a 3-replica set
+during which
+
+  1. one replica is **hard-killed** mid-load (engine shut down without
+     drain — its queued work must fail over),
+  2. another replica is **wedged** via an armed
+     ``serving.compute:delay`` fault (the batcher thread blocks the way
+     a stuck device call would — the replica watchdog must eject it,
+     fail its in-flight requests over, and probe it back in once the
+     wedge releases), and
+  3. a **NaN-poisoned weight publication** is staged through the
+     CanaryPublisher (the canary must reject it and roll back, with
+     the old snapshot serving throughout).
+
+Asserted, in the strong form the ISSUE names:
+
+  * every admitted request either **completes within a bounded
+    latency** (far below the wedge duration — proving failover, not
+    wait-out) or ends in a terminal **shed** with a counted cause;
+    zero client-visible errors;
+  * **no NaN and no torn-snapshot output is ever returned**: every
+    completed response is bitwise identical to the pre-computed
+    reference outputs of the ONE snapshot that ever served (inputs are
+    drawn from a fixed pool, so responses are exactly checkable);
+  * the injected faults actually **fired** (``fault/injected_total``),
+    the wedge was ejected and failed over, the replica was
+    **re-admitted** by a probe after the wedge released;
+  * canary rejection + rollback happened exactly once, and
+    **post-rollback golden outputs are bit-identical** to the
+    pre-publication snapshot's on every surviving replica.
+
+Emits ONE machine-parseable JSON line last (the CI contract), after
+rendering the replica timeline with ``trace_summary.py serving``.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np                                         # noqa: E402
+import jax                                                 # noqa: E402
+
+from bigdl_tpu import faults, nn                           # noqa: E402
+from bigdl_tpu.observability import JsonlSink, Recorder    # noqa: E402
+from bigdl_tpu.serving import (CanaryPublisher,            # noqa: E402
+                               CanaryRejectedError, LoadShedError,
+                               build_replica_set)
+
+RATE = 120.0            # open-loop arrivals/s
+DURATION = 6.5          # load window, seconds
+DEADLINE_MS = 800.0     # leaves headroom past the 0.35s wedge budget,
+                        # so a wedge victim fails over INSIDE its SLO
+WEDGE_MS = 2500         # serving.compute delay; ejection must beat it
+MAX_LATENCY_MS = 2000.0  # completed requests must finish WELL under
+                         # the wedge — failover, not wait-out
+SIZES = (1, 2, 3, 5, 8)
+
+
+def build_set(jsonl_path):
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                          nn.Linear(32, 8))
+    model.evaluate()
+    model.ensure_initialized()
+    rec = Recorder(annotate=False, sinks=[JsonlSink(jsonl_path)])
+    rs = build_replica_set(
+        model, 3, name="main", input_shape=(16,),
+        engine_kw=dict(max_batch=8, max_delay_ms=2.0,
+                       max_queue_rows=64),
+        recorder=rec, wedge_after=0.35, health_interval=0.05,
+        probe_interval=0.1, probe_deadline_ms=2000.0)
+    return model, rec, rs
+
+
+def reference_outputs(model, pool):
+    """Bitwise reference responses of the CURRENT snapshot for every
+    pooled input — computed exactly the way the engine computes them
+    (the jitted eval fn over the same arrays)."""
+    refs = {}
+    for n, x in pool.items():
+        y, _ = model.run(model._params, jax.numpy.asarray(x),
+                        state=model._state, training=False)
+        refs[n] = np.asarray(y)
+    return refs
+
+
+def open_loop_load(rs, pool, results, t_end):
+    rng = np.random.RandomState(0)
+    sizes = sorted(pool)
+    lock = threading.Lock()
+    pending = []
+    offered = [0]
+
+    def on_done(n, t0, fut):
+        with lock:
+            try:
+                y = fut.result()
+                results["completed"].append(
+                    (n, (time.perf_counter() - t0) * 1e3, np.asarray(y)))
+            except LoadShedError as e:
+                results["shed"].append(e.reason)
+            except Exception as e:
+                results["errors"].append(f"{type(e).__name__}: {e}")
+            results["processed"] += 1
+
+    t_next = time.perf_counter()
+    while time.perf_counter() < t_end:
+        now = time.perf_counter()
+        if now < t_next:
+            time.sleep(min(t_next - now, 0.01))
+            continue
+        t_next += rng.exponential(1.0 / RATE)
+        n = sizes[int(rng.randint(len(sizes)))]
+        offered[0] += 1
+        t0 = time.perf_counter()
+        try:
+            fut = rs.submit("main", pool[n], deadline_ms=DEADLINE_MS)
+        except LoadShedError as e:
+            with lock:
+                results["shed"].append(e.reason)
+                results["processed"] += 1
+            continue
+        except Exception as e:
+            with lock:
+                results["errors"].append(f"{type(e).__name__}: {e}")
+                results["processed"] += 1
+            continue
+        fut.add_done_callback(
+            lambda f, n=n, t0=t0: on_done(n, t0, f))
+        pending.append(fut)
+    for f in pending:
+        try:
+            f.exception(timeout=60)
+        except Exception:
+            pass
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with lock:
+            if results["processed"] >= offered[0]:
+                break
+        time.sleep(0.01)
+    results["offered"] = offered[0]
+
+
+def _require(failures, cond, msg):
+    if not cond:
+        failures.append(msg)
+        print(f"[serving-chaos] FAILED: {msg}", flush=True)
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="serving_chaos_")
+    jsonl = os.path.join(tmp, "serving.jsonl")
+    model, rec, rs = build_set(jsonl)
+    pool = {n: np.random.RandomState(100 + n).rand(n, 16)
+            .astype(np.float32) for n in SIZES}
+    golden = np.random.RandomState(7).rand(8, 16).astype(np.float32)
+    pub = CanaryPublisher(rs, {"main": golden}, quiesce_timeout=2.0)
+
+    print("[serving-chaos] warming 3 replicas", flush=True)
+    rs.warmup()
+    rs.start()
+    refs = reference_outputs(model, pool)
+
+    results = {"completed": [], "shed": [], "errors": [],
+               "processed": 0, "offered": 0}
+    t_end = time.perf_counter() + DURATION
+    load = threading.Thread(target=open_loop_load,
+                            args=(rs, pool, results, t_end),
+                            daemon=True)
+    load.start()
+    failures = []
+    canary = {}
+
+    # -- the chaos timeline ------------------------------------------------ #
+    time.sleep(1.0)
+    print("[serving-chaos] t+1.0s: hard-killing replica 2", flush=True)
+    rs.kill(2)
+
+    time.sleep(1.0)
+    print(f"[serving-chaos] t+2.0s: arming serving.compute:delay:"
+          f"{WEDGE_MS}@0 (wedge the next batch)", flush=True)
+    faults.arm(f"serving.compute:delay:{WEDGE_MS}@0")
+
+    # the wedge must fire, the replica must be ejected, and — once the
+    # delay releases — probed back into rotation, all under load
+    deadline = time.monotonic() + 10
+    while rec.counter_value("replica/readmitted") < 1:
+        if time.monotonic() > deadline:
+            break
+        time.sleep(0.05)
+    _require(failures, faults.injected_total("serving.compute") == 1,
+             "the serving.compute wedge never fired")
+    _require(failures, rec.counter_value("replica/wedged") >= 1,
+             "no replica was ejected as wedged")
+    _require(failures, rec.counter_value("replica/readmitted") >= 1,
+             "the wedged replica was never probed back in")
+
+    # NaN-poisoned publication: the canary must reject + roll back,
+    # with golden outputs bit-identical before and after
+    live = [r for r in rs.replicas if r.index != 2]
+    before = {r.index: np.asarray(r.engine.predict("main", golden,
+                                                   timeout=30))
+              for r in live}
+    poisoned = jax.tree_util.tree_map(
+        lambda a: np.full_like(np.asarray(a), np.nan), model._params)
+    print("[serving-chaos] publishing NaN-poisoned weights through the "
+          "canary", flush=True)
+    try:
+        pub.publish("main", poisoned, dict(model._state or {}))
+        _require(failures, False,
+                 "poisoned publication was NOT rejected")
+    except CanaryRejectedError as e:
+        canary["rejected"] = True
+        canary["reason"] = e.reason
+        print(f"[serving-chaos] canary said no: {e}", flush=True)
+    after = {r.index: np.asarray(r.engine.predict("main", golden,
+                                                  timeout=30))
+             for r in live}
+    for idx in before:
+        _require(failures, np.array_equal(before[idx], after[idx]),
+                 f"replica {idx} outputs changed across the rejected "
+                 "publication (rollback not bit-identical)")
+    for idx, snap in ((r.index,
+                       r.engine.registry.get("main").snapshot)
+                      for r in live):
+        _require(failures, snap.version == "v1",
+                 f"replica {idx} serves {snap.version}, not the "
+                 "pre-publication snapshot")
+
+    load.join(timeout=60)
+    rs.shutdown(drain=True)
+
+    # -- the ledger -------------------------------------------------------- #
+    completed = results["completed"]
+    shed = results["shed"]
+    errors = results["errors"]
+    offered = results["offered"]
+    _require(failures, offered > 0 and load.is_alive() is False,
+             "load generator did not finish")
+    _require(failures,
+             len(completed) + len(shed) + len(errors) == offered,
+             f"ledger leak: {len(completed)}+{len(shed)}+{len(errors)}"
+             f" != {offered}")
+    _require(failures, not errors,
+             f"client-visible errors: {errors[:3]}")
+    bad_vals = bad_lat = 0
+    for n, lat_ms, y in completed:
+        if not np.array_equal(y, refs[n]):
+            bad_vals += 1
+        if lat_ms > MAX_LATENCY_MS:
+            bad_lat += 1
+    _require(failures, bad_vals == 0,
+             f"{bad_vals} responses were NOT bitwise from the serving "
+             "snapshot (NaN or torn read)")
+    _require(failures, bad_lat == 0,
+             f"{bad_lat} completions exceeded {MAX_LATENCY_MS}ms — "
+             "waited out the wedge instead of failing over")
+    _require(failures, rec.counter_value("replica/failovers") >= 1,
+             "no failover happened despite a kill and a wedge")
+    _require(failures,
+             rec.counter_value("serving/canary_rejected") == 1
+             and rec.counter_value("serving/canary_rollbacks") == 1,
+             "canary rejection/rollback not counted exactly once")
+    _require(failures, rec.counter_value("replica/killed") == 1,
+             "the killed replica was not recorded")
+
+    # final counter snapshot for the timeline renderer, then render it
+    snap = rec.snapshot()
+    rec.emit_record("serving_summary",
+                    counters={k: v for k, v in snap["counters"].items()
+                              if k.startswith(("replica/", "serving/",
+                                               "fault/"))})
+    rec.flush()
+    render = subprocess.run(
+        [sys.executable,
+         os.path.join(_REPO, "scripts", "trace_summary.py"),
+         "serving", jsonl],
+        capture_output=True, text=True, timeout=60)
+    print(render.stdout)
+    _require(failures, render.returncode == 0
+             and "resilience timeline" in render.stdout
+             and "eject" in render.stdout,
+             f"trace_summary serving failed: {render.stdout[-300:]}"
+             f"{render.stderr[-300:]}")
+
+    lats = sorted(lat for _, lat, _ in completed)
+    summary = {
+        "metric": "serving_chaos_smoke",
+        "ok": not failures,
+        "failures": failures,
+        "offered": offered,
+        "completed": len(completed),
+        "shed": len(shed),
+        "shed_causes": sorted(set(shed)),
+        "errors": len(errors),
+        "p50_ms": round(lats[len(lats) // 2], 2) if lats else None,
+        "p99_ms": round(lats[int(0.99 * (len(lats) - 1))], 2)
+        if lats else None,
+        "max_ms": round(lats[-1], 2) if lats else None,
+        "fault_injected": faults.injected_total(),
+        "wedged": rec.counter_value("replica/wedged"),
+        "failovers": rec.counter_value("replica/failovers"),
+        "readmitted": rec.counter_value("replica/readmitted"),
+        "canary_rejected": canary.get("rejected", False),
+        "canary_reason": canary.get("reason"),
+        "telemetry": jsonl,
+    }
+    print(json.dumps(summary), flush=True)
+    sys.exit(0 if not failures else 1)
+
+
+if __name__ == "__main__":
+    main()

@@ -59,6 +59,16 @@ Two subcommands:
 
         python scripts/trace_summary.py comm /tmp/telemetry.jsonl [last_n]
 
+  serving            per-replica health transitions from a ReplicaSet's
+                     telemetry JSONL: one chronological
+                     eject → probe → readmit / canary_stage →
+                     promote/reject / brownout enter/exit table, plus
+                     the per-replica transition sequence and the final
+                     resilience counters — the one-command view of
+                     "what did the replica set do under that fault":
+
+        python scripts/trace_summary.py serving /tmp/serving.jsonl
+
   fleet              per-job fleet/elastic event timelines from one or
                      more telemetry JSONL streams (each job usually has
                      its own recorder/sink): one chronological
@@ -395,6 +405,75 @@ def summarize_fleet(events, out=print):
         out(f"  {job}: {' -> '.join(seen[job])}")
 
 
+def load_serving(paths):
+    """Chronologically-merged ``replica_event`` + ``fault_event``
+    records from telemetry JSONL files (directories are scanned for
+    ``*.jsonl``), plus the last record's counter snapshot per stream."""
+    expanded = []
+    for p in paths:
+        if os.path.isdir(p):
+            expanded += sorted(glob.glob(os.path.join(p, "*.jsonl")))
+        else:
+            expanded.append(p)
+    events, counters = [], {}
+    for p in expanded:
+        src = os.path.basename(p)
+        for rec in iter_jsonl(p):
+            if rec.get("type") in ("replica_event", "fault_event"):
+                events.append((src, rec))
+            for k, v in (rec.get("counters") or {}).items():
+                if k.startswith(("replica/", "serving/")):
+                    counters[k] = v
+    events.sort(key=lambda sr: sr[1].get("time") or 0.0)
+    return events, counters
+
+
+def summarize_serving(events, counters, out=print):
+    """Render the replica-set timeline and per-replica sequences."""
+    if not events and not counters:
+        out("no replica_event records found (not a ReplicaSet "
+            "telemetry stream, or nothing happened)")
+        return
+    t0 = min((ev.get("time") or 0.0 for _, ev in events), default=0.0)
+    replicas, seen = [], {}
+    out("== serving resilience timeline ==")
+    out(f"  {'t':>8}  {'replica':<8} {'event':<15} detail")
+    for src, ev in events:
+        if ev.get("type") == "fault_event":
+            kind = f"fault:{ev.get('mode', '?')}"
+            rep = "-"
+            parts = [ev.get("site", "?")]
+        else:
+            kind = ev.get("kind", "?")
+            rep = ev.get("replica")
+            rep = "-" if rep is None else str(rep)
+            parts = []
+            if ev.get("reason"):
+                parts.append(f"[{ev['reason']}]")
+            if ev.get("model"):
+                parts.append(f"model={ev['model']}")
+            if ev.get("version"):
+                parts.append(f"version={ev['version']}")
+            if ev.get("replicas") is not None:
+                parts.append(f"replicas={ev['replicas']:g}")
+            if ev.get("saturation") is not None:
+                parts.append(f"saturation={ev['saturation']:.2f}")
+        if rep not in seen:
+            seen[rep] = []
+            replicas.append(rep)
+        seen[rep].append(kind)
+        dt = (ev.get("time") or 0.0) - t0
+        out(f"  {dt:>+7.2f}s  {rep:<8} {kind:<15} {' '.join(parts)}")
+    if replicas:
+        out("\n== per-replica transition sequence ==")
+        for rep in replicas:
+            out(f"  {rep}: {' -> '.join(seen[rep])}")
+    if counters:
+        out("\n== resilience counters (at last record) ==")
+        for k in sorted(counters):
+            out(f"  {k:<34} {counters[k]:.6g}")
+
+
 def load_profile(path):
     """(profile_records, steps) from a JsonlSink telemetry file."""
     profiles, steps = [], []
@@ -663,6 +742,14 @@ def main_profile(argv):
     summarize_profile(profiles, steps)
 
 
+def main_serving(argv):
+    if not argv:
+        raise SystemExit("usage: trace_summary.py serving "
+                         "<telemetry.jsonl | dir>...")
+    events, counters = load_serving(argv)
+    summarize_serving(events, counters)
+
+
 def main_fleet(argv):
     if not argv:
         raise SystemExit("usage: trace_summary.py fleet "
@@ -717,6 +804,8 @@ def main():
         main_profile(argv[1:])
     elif argv and argv[0] == "health":
         main_health(argv[1:])
+    elif argv and argv[0] == "serving":
+        main_serving(argv[1:])
     elif argv and argv[0] == "fleet":
         main_fleet(argv[1:])
     elif argv and argv[0] == "xplane":

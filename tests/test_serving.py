@@ -281,6 +281,102 @@ def test_hot_swap_atomicity_under_concurrent_requests():
         eng.shutdown(drain=True)
 
 
+class Affine(Module):
+    """y = x * scale + shift with TWO separate leaves: a torn read that
+    mixed `scale` from one snapshot with `shift` from another would
+    produce a value matching NEITHER version's reference.  Elementwise
+    only, so outputs are bitwise independent of how requests coalesce
+    into padded buckets — the bitwise-equality oracle stays exact."""
+
+    def init(self, rng):
+        return {self.name: {"scale": jnp.ones((4,)),
+                            "shift": jnp.zeros((4,))}}
+
+    def apply(self, params, x, ctx):
+        p = params[self.name]
+        return x * p["scale"] + p["shift"]
+
+
+def test_swap_race_every_response_bitwise_from_one_snapshot():
+    """Regression (ISSUE 12): swap_weights/sync_from_model racing
+    in-flight batches.  Every response must be BITWISE the output of
+    exactly one published snapshot — never a torn read mixing leaves of
+    two weight versions — and a swap that fails validation mid-race
+    must leave the prior snapshot serving."""
+    reg, eng = make_engine(Affine(), max_delay_ms=1.0)
+    try:
+        eng.warmup()
+        entry = reg.get("m")
+        key = list(entry.snapshot.params)[0]
+        w1 = {key: {"scale": jnp.asarray(np.float32(1.5)
+                                         * np.ones(4, np.float32)),
+                    "shift": jnp.asarray(np.float32(0.25)
+                                         * np.ones(4, np.float32))}}
+        w2 = {key: {"scale": jnp.asarray(np.float32(2.5)
+                                         * np.ones(4, np.float32)),
+                    "shift": jnp.asarray(np.float32(-0.75)
+                                         * np.ones(4, np.float32))}}
+        # references THROUGH the engine, per version and request size
+        sizes = (1, 3, 4)
+        xs = {n: np.random.RandomState(10 + n).rand(n, 4)
+              .astype(np.float32) for n in sizes}
+        refs = {}
+        for tag, w in (("v1", w1), ("v2", w2)):
+            reg.swap_weights("m", w)
+            refs[tag] = {n: np.asarray(eng.predict("m", xs[n],
+                                                   timeout=30))
+                         for n in sizes}
+        stop = threading.Event()
+        lock = threading.Lock()
+        bad, done = [], [0]
+
+        def client(seed):
+            rng = np.random.RandomState(seed)
+            while not stop.is_set():
+                n = sizes[int(rng.randint(len(sizes)))]
+                try:
+                    y = np.asarray(
+                        eng.submit("m", xs[n]).result(30))
+                except Exception as e:     # noqa: BLE001 — recorded
+                    bad.append(repr(e))
+                    return
+                if not (np.array_equal(y, refs["v1"][n])
+                        or np.array_equal(y, refs["v2"][n])):
+                    bad.append((n, y))
+                with lock:
+                    done[0] += 1
+
+        threads = [threading.Thread(target=client, args=(i,),
+                                    daemon=True) for i in range(4)]
+        for t in threads:
+            t.start()
+        for i in range(30):
+            reg.swap_weights("m", w2 if i % 2 == 0 else w1)
+            if i == 10:
+                # a racing INVALID swap must change nothing
+                before = entry.snapshot
+                leaf = list(before.params)[0]
+                with pytest.raises(ValueError):
+                    reg.swap_weights(
+                        "m", {leaf: {k: np.ones((3, 3), np.float32)
+                                     for k in before.params[leaf]}})
+                assert entry.snapshot is before
+            if i == 20:
+                # sync_from_model is the same publish path: mutate the
+                # shell in place and republish atomically
+                entry.model._params = w1
+                reg.sync_from_model("m")
+            time.sleep(0.003)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        assert done[0] > 0
+        assert not bad, f"torn/mixed-snapshot responses: {bad[:3]}"
+        assert eng.recorder.counter_value("serving.recompiles") == 0
+    finally:
+        eng.shutdown(drain=True)
+
+
 def test_swap_validation_is_atomic():
     reg, eng = make_engine()
     entry = reg.get("m")

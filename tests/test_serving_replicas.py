@@ -1,0 +1,481 @@
+"""Serving resilience (ISSUE 12 tentpole): replica-set routing and
+failover, wedge ejection + probe re-admission, the overload/brownout
+ladder, and canary weight publication with automatic rollback."""
+import threading
+import time
+import urllib.error
+import urllib.request
+import json
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from bigdl_tpu import faults, nn
+from bigdl_tpu.serving import (CanaryPublisher, CanaryRejectedError,
+                               LoadShedError, NoHealthyReplicaError,
+                               OverloadController, build_replica_set)
+
+
+def make_model():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m.evaluate()
+    m.ensure_initialized()
+    return m
+
+
+def make_rs(n=2, **kw):
+    kw.setdefault("engine_kw", dict(max_batch=4, max_delay_ms=1.0,
+                                    max_queue_rows=16))
+    kw.setdefault("health_interval", 0.05)
+    kw.setdefault("probe_interval", 0.05)
+    model = make_model()
+    rs = build_replica_set(model, n, name="m", input_shape=(4,), **kw)
+    rs.warmup()
+    return model, rs
+
+
+def wait_for(cond, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {msg}"
+        time.sleep(0.02)
+
+
+# --------------------------------------------------------------------- #
+# routing                                                               #
+# --------------------------------------------------------------------- #
+def test_replica_set_routes_and_answers_correctly():
+    model, rs = make_rs(2)
+    try:
+        x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+        y = rs.predict("m", x, timeout=30)
+        want, _ = model.run(model._params, jnp.asarray(x),
+                            state=model._state, training=False)
+        np.testing.assert_allclose(y, np.asarray(want), rtol=1e-5,
+                                   atol=1e-6)
+        big = rs.predict("m", np.ones((9, 4), np.float32), timeout=30)
+        assert np.shape(big) == (9, 2)     # split across submits
+        st = rs.stats()
+        assert st["requests"] >= 1 and st["dispatches"] >= 1
+        assert set(rs.health()) == {0, 1}
+        assert rs.healthy
+    finally:
+        rs.shutdown(drain=True)
+
+
+def test_bad_priority_rejected():
+    model, rs = make_rs(1)
+    try:
+        with pytest.raises(ValueError):
+            rs.submit("m", np.ones((1, 4), np.float32), priority="vip")
+    finally:
+        rs.shutdown(drain=True)
+
+
+# --------------------------------------------------------------------- #
+# failover                                                              #
+# --------------------------------------------------------------------- #
+def test_killed_replica_fails_over_and_stays_out():
+    model, rs = make_rs(2)
+    try:
+        rs.start()
+        rs.kill(1)
+        # every request still answers, via the survivor
+        for _ in range(4):
+            y = rs.predict("m", np.ones((2, 4), np.float32), timeout=30)
+            assert np.shape(y) == (2, 2)
+        assert rs.recorder.counter_value("replica/killed") == 1
+        assert rs.health()[1]["state"] == "ejected"
+        assert rs.health()[1]["reason"] == "killed"
+        assert rs.healthy
+        # killed replicas are never probed back in
+        time.sleep(0.3)
+        assert rs.health()[1]["state"] == "ejected"
+    finally:
+        rs.shutdown(drain=True)
+
+
+def test_total_outage_raises_not_hangs():
+    model, rs = make_rs(2)
+    try:
+        rs.start()
+        rs.kill(0)
+        rs.kill(1)
+        assert not rs.healthy
+        with pytest.raises(NoHealthyReplicaError):
+            rs.submit("m", np.ones((1, 4), np.float32))
+    finally:
+        rs.shutdown(drain=True)
+
+
+def test_wedged_replica_ejected_failed_over_probed_back():
+    model, rs = make_rs(2, wedge_after=0.2)
+    try:
+        rs.start()
+        faults.arm("serving.compute:delay:1500@0")
+        t0 = time.monotonic()
+        y = rs.submit("m", np.ones((2, 4), np.float32)).result(30)
+        elapsed = time.monotonic() - t0
+        assert np.shape(y) == (2, 2)
+        # the answer came from the failover peer, not from waiting out
+        # the 1.5s wedge
+        assert elapsed < 1.4, elapsed
+        rec = rs.recorder
+        assert faults.injected_total("serving.compute") == 1
+        assert rec.counter_value("replica/wedged") == 1
+        assert rec.counter_value("replica/failovers") >= 1
+        # once the wedge releases, the probe re-admits the replica
+        wait_for(lambda: rec.counter_value("replica/readmitted") >= 1,
+                 msg="probe re-admission")
+        assert all(h["state"] == "healthy"
+                   for h in rs.health().values())
+        # the wedged batch's late result was dropped, never delivered
+        wait_for(lambda: rec.counter_value("replica/stale_results") >= 1,
+                 msg="stale late result dropped")
+    finally:
+        faults.reset()
+        rs.shutdown(drain=True)
+
+
+def test_last_replica_never_health_ejected():
+    """A health verdict must not evict the sole survivor: a degraded
+    last replica (requests shed by deadline) beats a self-inflicted
+    total outage on a noisy verdict."""
+    model, rs = make_rs(1, wedge_after=0.15)
+    try:
+        rs.start()
+        faults.arm("serving.compute:delay:800@0")
+        f = rs.submit("m", np.ones((1, 4), np.float32))
+        # the wedge verdict fires but is deferred — the replica stays
+        # in rotation and the request completes once the wedge releases
+        wait_for(lambda: rs.recorder.counter_value(
+            "replica/eject_deferred") >= 1, msg="deferred verdict")
+        assert rs.health()[0]["state"] == "healthy"
+        assert rs.healthy
+        assert np.shape(f.result(30)) == (1, 2)
+        assert rs.recorder.counter_value("replica/ejected") == 0
+    finally:
+        faults.reset()
+        rs.shutdown(drain=True)
+
+
+def test_error_replica_ejected_then_probed_back():
+    model, rs = make_rs(2, eject_min_requests=3)
+    try:
+        rs.start()
+        bad = rs.replicas[0].engine
+        orig = bad._run_batch
+
+        def broken(entry, q, batch):
+            raise RuntimeError("replica 0 exploded")
+
+        bad._run_batch = broken
+        # clients never see the failure: every request fails over
+        for _ in range(6):
+            y = rs.predict("m", np.ones((1, 4), np.float32), timeout=30)
+            assert np.shape(y) == (1, 2)
+        rec = rs.recorder
+        wait_for(lambda: rs.health()[0]["state"] == "ejected",
+                 msg="error-rate ejection")
+        assert rs.health()[0]["reason"] == "errors"
+        assert rec.counter_value("replica/failovers") >= 1
+        bad._run_batch = orig          # the replica recovers
+        wait_for(lambda: rs.health()[0]["state"] == "healthy",
+                 msg="probe re-admission after recovery")
+        assert rec.counter_value("replica/readmitted") >= 1
+    finally:
+        rs.shutdown(drain=True)
+
+
+def test_failover_budget_caps_retry_storms():
+    model, rs = make_rs(2, failover_rate=0.0, failover_burst=0)
+    try:
+        rs.start()
+        bad = rs.replicas[0].engine
+
+        def broken(entry, q, batch):
+            raise RuntimeError("boom")
+
+        bad._run_batch = broken
+        rs.replicas[1].engine._run_batch = broken
+        with pytest.raises(RuntimeError):
+            rs.submit("m", np.ones((1, 4), np.float32)).result(30)
+        # zero tokens: the failure propagated instead of retrying
+        assert rs.recorder.counter_value("replica/failovers") == 0
+        assert rs.recorder.counter_value(
+            "replica/failover_exhausted") >= 1
+    finally:
+        rs.shutdown(drain=True)
+
+
+# --------------------------------------------------------------------- #
+# overload controller / brownout ladder                                 #
+# --------------------------------------------------------------------- #
+def test_overload_controller_priority_thresholds():
+    c = OverloadController()
+    assert c.admits("interactive", 0.99)
+    assert c.admits("normal", 0.5) and not c.admits("normal", 0.9)
+    assert c.admits("batch", 0.4) and not c.admits("batch", 0.6)
+
+
+def test_brownout_ladder_enter_hold_exit():
+    clock = [0.0]
+    c = OverloadController(brownout_enter=0.75, brownout_exit=0.35,
+                           hold_s=1.0, time_fn=lambda: clock[0])
+    assert c.update(0.8) is None          # starts the hold timer
+    clock[0] = 0.5
+    assert c.update(0.8) is None          # still inside the hold
+    clock[0] = 0.6
+    assert c.update(0.2) is None          # dip resets the timer
+    clock[0] = 1.0
+    assert c.update(0.8) is None
+    clock[0] = 2.1
+    assert c.update(0.8) == "enter" and c.browned
+    clock[0] = 2.2
+    assert c.update(0.5) is None          # above exit: stays browned
+    clock[0] = 3.0
+    assert c.update(0.2) is None          # exit hold starts
+    clock[0] = 4.1
+    assert c.update(0.2) == "exit" and not c.browned
+
+
+def test_priority_shed_under_saturation():
+    model, rs = make_rs(2, engine_kw=dict(max_batch=4, max_delay_ms=1.0,
+                                          max_queue_rows=8))
+    gates = []
+    try:
+        for rep in rs.replicas:
+            gate = threading.Event()
+            orig = rep.engine._run_batch
+
+            def gated(entry, q, batch, gate=gate, orig=orig):
+                gate.wait(30)
+                orig(entry, q, batch)
+
+            rep.engine._run_batch = gated
+            gates.append(gate)
+        # park both batchers, then fill the queues to 50% saturation
+        futs = [rs.submit("m", np.ones((4, 4), np.float32))
+                for _ in range(4)]
+        wait_for(lambda: sum(r.engine.pending_rows()
+                             for r in rs.replicas) >= 8,
+                 msg="queues filled")
+        with pytest.raises(LoadShedError) as ei:
+            rs.submit("m", np.ones((1, 4), np.float32),
+                      priority="batch")
+        assert ei.value.reason == "overload"
+        assert rs.recorder.counter_value("serving/shed_overload") == 1
+        # interactive traffic still admits at the same saturation
+        f = rs.submit("m", np.ones((1, 4), np.float32),
+                      priority="interactive")
+        for g in gates:
+            g.set()
+        for fut in futs + [f]:
+            fut.result(timeout=30)
+    finally:
+        for g in gates:
+            g.set()
+        rs.shutdown(drain=True)
+
+
+def test_brownout_routes_to_int8_degrade_entry():
+    model = make_model()
+    calib = [np.random.RandomState(0).rand(4, 4).astype(np.float32)]
+    rs = build_replica_set(
+        model, 1, name="m", input_shape=(4,), int8_degrade=True,
+        calibration_data=calib,
+        engine_kw=dict(max_batch=4, max_delay_ms=1.0))
+    rs.warmup()
+    try:
+        x = calib[0]
+        exact = rs.predict("m", x, timeout=30)
+        rs.controller.browned = True      # force the ladder's verdict
+        browned = rs.predict("m", x, timeout=30)
+        # int8 answers: close, but a different numeric path
+        np.testing.assert_allclose(browned, exact, rtol=0.2, atol=0.1)
+        assert not np.array_equal(np.asarray(browned),
+                                  np.asarray(exact))
+        assert rs.recorder.counter_value(
+            "serving/brownout_requests") >= 1
+    finally:
+        rs.shutdown(drain=True)
+
+
+# --------------------------------------------------------------------- #
+# canary publication                                                    #
+# --------------------------------------------------------------------- #
+def _scaled_params(model, factor):
+    return jax.tree_util.tree_map(
+        lambda a: (np.asarray(a) * np.float32(factor)).astype(
+            np.asarray(a).dtype), model._params)
+
+
+def test_canary_promotes_good_weights_fleet_wide():
+    model, rs = make_rs(3)
+    try:
+        golden = np.random.RandomState(1).rand(4, 4).astype(np.float32)
+        pub = CanaryPublisher(rs, {"m": golden}, drift_rtol=100.0)
+        snap = pub.publish("m", _scaled_params(model, 1.1),
+                           dict(model._state or {}))
+        for rep in rs.replicas:
+            entry = rep.engine.registry.get("m")
+            assert entry.snapshot.version == snap.version
+        rec = rs.recorder
+        assert rec.counter_value("serving/canary_promoted") == 1
+        assert rec.counter_value("serving/canary_rollbacks") == 0
+        # the canary went back into rotation
+        assert all(h["state"] == "healthy"
+                   for h in rs.health().values())
+    finally:
+        rs.shutdown(drain=True)
+
+
+def test_canary_promotion_refreshes_int8_degrade_entry():
+    """A promoted snapshot must reach the brownout degrade entry too:
+    browned-out requests after a publish serve the NEW model, not a
+    stale quantization of the old one."""
+    model = make_model()
+    calib = [np.random.RandomState(0).rand(4, 4).astype(np.float32)]
+    rs = build_replica_set(
+        model, 1, name="m", input_shape=(4,), int8_degrade=True,
+        calibration_data=calib,
+        engine_kw=dict(max_batch=4, max_delay_ms=1.0))
+    rs.warmup()
+    try:
+        golden = calib[0]
+        pub = CanaryPublisher(rs, {"m": golden}, drift_rtol=100.0)
+        snap = pub.publish("m", _scaled_params(model, 1.2),
+                           dict(model._state or {}))
+        entry8 = rs.replicas[0].engine.registry.get("m.int8")
+        assert entry8.snapshot.version == snap.version
+        assert rs.recorder.counter_value(
+            "serving/degrade_refreshed") == 1
+        exact = rs.predict("m", golden, timeout=30)
+        rs.controller.browned = True
+        browned = rs.predict("m", golden, timeout=30)
+        # the int8 answer tracks the NEW weights (a stale quantization
+        # of the 1.2x-smaller old weights would be ~1.4x off)
+        np.testing.assert_allclose(browned, exact, rtol=0.25,
+                                   atol=0.15)
+    finally:
+        rs.shutdown(drain=True)
+
+
+def test_canary_rejects_nan_and_rolls_back_bitwise():
+    model, rs = make_rs(2)
+    try:
+        golden = np.random.RandomState(2).rand(4, 4).astype(np.float32)
+        pub = CanaryPublisher(rs, {"m": golden})
+        before = [np.asarray(r.engine.predict("m", golden, timeout=30))
+                  for r in rs.replicas]
+        snaps = [r.engine.registry.get("m").snapshot
+                 for r in rs.replicas]
+        poisoned = jax.tree_util.tree_map(
+            lambda a: np.full_like(np.asarray(a), np.nan),
+            model._params)
+        with pytest.raises(CanaryRejectedError) as ei:
+            pub.publish("m", poisoned, dict(model._state or {}))
+        assert ei.value.reason == "non_finite"
+        after = [np.asarray(r.engine.predict("m", golden, timeout=30))
+                 for r in rs.replicas]
+        for b, a in zip(before, after):
+            assert np.array_equal(b, a)   # bit-identical rollback
+        # the non-canary replica's snapshot object never even changed
+        assert rs.replicas[1].engine.registry.get("m").snapshot \
+            is snaps[1]
+        rec = rs.recorder
+        assert rec.counter_value("serving/canary_rejected") == 1
+        assert rec.counter_value("serving/canary_rollbacks") == 1
+    finally:
+        rs.shutdown(drain=True)
+
+
+def test_canary_rejects_excessive_drift():
+    model, rs = make_rs(2)
+    try:
+        golden = np.random.RandomState(3).rand(4, 4).astype(np.float32)
+        pub = CanaryPublisher(rs, {"m": golden}, drift_rtol=0.01,
+                              drift_atol=1e-6)
+        with pytest.raises(CanaryRejectedError) as ei:
+            pub.publish("m", _scaled_params(model, 5.0),
+                        dict(model._state or {}))
+        assert ei.value.reason == "drift"
+        assert rs.replicas[0].engine.registry.get("m") \
+            .snapshot.version == "v1"
+    finally:
+        rs.shutdown(drain=True)
+
+
+def test_canary_publish_retries_transient_fault():
+    model, rs = make_rs(2)
+    try:
+        faults.arm("serving.publish:err:EIO@0")
+        golden = np.random.RandomState(4).rand(4, 4).astype(np.float32)
+        pub = CanaryPublisher(rs, {"m": golden}, drift_rtol=100.0)
+        snap = pub.publish("m", _scaled_params(model, 1.05),
+                           dict(model._state or {}))
+        assert faults.injected_total("serving.publish") == 1
+        assert rs.recorder.counter_value(
+            "retry/attempts.serving.publish") >= 1
+        for rep in rs.replicas:
+            assert rep.engine.registry.get("m").snapshot.version \
+                == snap.version
+    finally:
+        faults.reset()
+        rs.shutdown(drain=True)
+
+
+def test_publish_from_model_is_the_sync_bridge():
+    model, rs = make_rs(2)
+    try:
+        golden = np.random.RandomState(5).rand(4, 4).astype(np.float32)
+        pub = CanaryPublisher(rs, {"m": golden}, drift_rtol=100.0)
+        # the in-place Torch-shell update path: set_weights then sync
+        trainer_model = rs.replicas[0].engine.registry.get("m").model
+        trainer_model.set_weights(
+            [np.asarray(w) * np.float32(0.9)
+             for w in trainer_model.get_weights()])
+        snap = pub.publish_from_model("m")
+        y = rs.predict("m", golden, timeout=30)
+        want, _ = trainer_model.run(trainer_model._params,
+                                    jnp.asarray(golden),
+                                    state=trainer_model._state,
+                                    training=False)
+        np.testing.assert_allclose(y, np.asarray(want), rtol=1e-5,
+                                   atol=1e-6)
+        assert snap.version != "v1"
+    finally:
+        rs.shutdown(drain=True)
+
+
+# --------------------------------------------------------------------- #
+# aggregated observability                                              #
+# --------------------------------------------------------------------- #
+def test_replica_health_in_aggregated_metrics_and_healthz():
+    model, rs = make_rs(2)
+    try:
+        rs.start()
+        rs.predict("m", np.ones((2, 4), np.float32), timeout=30)
+        rs.check_health()
+        srv = rs.serve_metrics(port=0)
+        with urllib.request.urlopen(srv.url("/metrics"),
+                                    timeout=10) as r:
+            body = r.read().decode()
+        assert 'job="replica0"' in body and 'job="replica1"' in body
+        assert "replica_healthy_count" in body
+        with urllib.request.urlopen(srv.url("/healthz"),
+                                    timeout=10) as r:
+            payload = json.loads(r.read().decode())
+        assert payload["ok"]
+        assert payload["replicas"]["replica/healthy_count"] == 2
+        assert payload["replicas"]["replica/healthy.0"] == 1
+        # total outage: the set's monitor verdict turns /healthz 503
+        rs.kill(0)
+        rs.kill(1)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(srv.url("/healthz"), timeout=10)
+        assert ei.value.code == 503
+    finally:
+        rs.shutdown(drain=True)
