@@ -97,31 +97,64 @@ def describe_delta(saved: Optional[Dict], target: Optional[Dict]) -> str:
     return "; ".join(parts)
 
 
+# model-parallel axes RE-PARTITION tensors (a tp shard is a slice of a
+# weight, a pp shard a slice of the layer stack, an ep shard a slice of
+# the expert dim) — a shape mismatch matching one of these axes means
+# per-SHARD arrays were saved where global tensors belong.  Data axes
+# (dp/fsdp) replicate or 1-D-reshard the same global tensors.  Kept in
+# sync with bigdl_tpu.parallel.mesh.MODEL_AXES (not imported: this
+# module must stay usable from jax-free tools like ckpt_inspect).
+MODEL_AXES = ("sp", "tp", "pp", "ep")
+
+
 def explain_shape_delta(got, want, saved: Optional[Dict],
                         target: Optional[Dict]) -> Optional[str]:
     """If a restored leaf's shape mismatch looks like a per-host/LOCAL
-    array saved where a global one belongs (some dim off by exactly a
-    saved-mesh axis size or the device-count ratio), say so — the one
-    mismatch class a mesh delta explains.  Returns None otherwise."""
+    or per-shard array saved where a global one belongs (some dim off
+    by exactly a saved-mesh axis size or the device-count ratio), say
+    so — the one mismatch class a mesh delta explains, with the
+    wording keyed to the KIND of axis: a dp/fsdp factor reads as a
+    per-host local batch/shard array, a tp/pp/sp/ep factor as a
+    model-parallel partition slice.  Returns None otherwise."""
     got, want = tuple(got), tuple(want)
     if saved is None or len(got) != len(want):
         return None
-    factors = {f"saved axis '{n}'": s for n, s in saved.get("axes", [])
-               if s > 1}
+    factors = {f"saved axis '{n}'": (s, n)
+               for n, s in saved.get("axes", []) if s > 1}
     sd = saved.get("devices")
     td = None if target is None else target.get("devices")
     if sd and td and sd != td:
         hi, lo = max(sd, td), min(sd, td)
         if hi % lo == 0 and hi // lo > 1:
-            factors[f"device-count ratio {sd}:{td}"] = hi // lo
+            factors[f"device-count ratio {sd}:{td}"] = (hi // lo, None)
     for dim, (g, w) in enumerate(zip(got, want)):
         if g == w:
             continue
-        for why, f in factors.items():
-            if g * f == w or w * f == g:
-                return (f"dim {dim} is off by exactly {f} ({why}): the "
-                        "checkpoint looks like a per-host LOCAL array "
-                        "saved where a global one belongs")
+        hits = [(why, f, axis) for why, (f, axis) in factors.items()
+                if g * f == w or w * f == g]
+        if not hits:
+            continue
+        f = hits[0][1]
+        whys = " or ".join(why for why, _, _ in hits)
+        model_hits = [a for _, _, a in hits if a in MODEL_AXES]
+        data_hits = [a for _, _, a in hits
+                     if a is not None and a not in MODEL_AXES]
+        local = ("the checkpoint looks like a per-host LOCAL array "
+                 "saved where a global one belongs")
+        slice_ = ("a model-parallel axis re-partitions tensors, so the "
+                  "checkpoint looks like one shard's SLICE of the "
+                  "weight saved where the global tensor belongs")
+        if model_hits and not data_hits:
+            detail = f"'{model_hits[0]}': {slice_}"
+        elif model_hits:
+            # a composed mesh where several axes share the size: both
+            # readings are possible, name both — the fix (re-save with
+            # shard_arrays=True, restore reassembles via global index
+            # maps) is the same either way
+            detail = (f"{local} — or, via '{model_hits[0]}', {slice_}")
+        else:
+            detail = local
+        return f"dim {dim} is off by exactly {f} ({whys}): {detail}"
     return None
 
 

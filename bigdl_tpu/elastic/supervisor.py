@@ -93,6 +93,7 @@ class ElasticSupervisor:
                  recorder=None, ckpt_every: int = 50, keep: int = 3,
                  shard_arrays: bool = True,
                  min_axes: Optional[Dict[str, int]] = None,
+                 axis_costs: Optional[Dict[str, float]] = None,
                  replan_every: int = 10, max_restarts: int = 5,
                  backoff_base: float = 0.5, backoff_max: float = 30.0,
                  handle_sigterm: bool = True,
@@ -109,6 +110,10 @@ class ElasticSupervisor:
         self.keep = int(keep)
         self.shard_arrays = bool(shard_arrays)
         self.min_axes = dict(min_axes or {})
+        # per-axis shrink costs for 4-axis templates: replans shrink the
+        # cheapest viable axis (plan.AXIS_SHRINK_COST defaults; override
+        # when a job's tp/pp re-layout economics differ)
+        self.axis_costs = None if axis_costs is None else dict(axis_costs)
         self.replan_every = int(replan_every)
         self.max_restarts = int(max_restarts)
         self.backoff_base = float(backoff_base)
@@ -277,7 +282,7 @@ class ElasticSupervisor:
                     self._set_state("planning")
                     devices = self._capacity()
                     axes = plan_mesh(len(devices), self.template,
-                                     self.min_axes)
+                                     self.min_axes, self.axis_costs)
                     used = plan_devices(axes, devices)
                     rec.gauge("elastic/devices", _prod(axes))
                     for name, size in axes.items():
@@ -339,7 +344,8 @@ class ElasticSupervisor:
                                 new_devices = self._capacity()
                                 new_axes = plan_mesh(len(new_devices),
                                                      self.template,
-                                                     self.min_axes)
+                                                     self.min_axes,
+                                                     self.axis_costs)
                                 # a device-SET change at equal size is a
                                 # displacement (the pool reassigned us):
                                 # this mesh's devices now belong to

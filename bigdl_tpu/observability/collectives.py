@@ -107,40 +107,143 @@ def _group_size(line: str, default: int) -> int:
     return default
 
 
+def _parse_collective_line(s: str, n_shards: int):
+    """One stripped HLO line -> (op, result_bytes, wire_bytes, line) or
+    None for non-collective lines.  Shared by :func:`hlo_collective_ops`
+    and :func:`hlo_group_breakdown` so the two views never disagree on
+    what counts as a collective or what it weighs."""
+    # result type may be a long tuple containing /*index=N*/ comments
+    m = re.match(r"%?[\w.-]+ = (.*?) (all-reduce|all-gather|"
+                 r"reduce-scatter|collective-permute|all-to-all)"
+                 r"(-start)?\(", s)
+    if not m:
+        return None
+    shape_str, op, is_start = m.group(1), m.group(2), bool(m.group(3))
+    elems = _element_bytes(shape_str)
+    if is_start and len(elems) > 1:
+        # async form: the result tuple carries (operand, result[,
+        # context]) — only one element is the payload, the rest would
+        # double-count it (and ignore the matching -done).  The wire
+        # formulas below expect the RESULT size: the full tensor for
+        # all-gather (largest element), the 1/n SHARD for
+        # reduce-scatter (smallest — taking the operand here would
+        # overcount by a factor of n after the ×n below)
+        size = min(elems) if op == "reduce-scatter" else max(elems)
+    else:
+        size = sum(elems)
+    n = _group_size(s, n_shards)
+    f = (n - 1) / n if n > 1 else 0.0
+    if op == "all-reduce":
+        wire = 2 * size * f
+    elif op == "all-gather":
+        wire = size * f               # result is the full size
+    elif op == "reduce-scatter":
+        wire = size * f * n           # result is the 1/n shard
+    else:
+        wire = size                   # permute / all-to-all: ships ~S
+    return op, size, wire, s
+
+
 def hlo_collective_ops(hlo_text: str,
                        n_shards: int) -> List[Tuple[str, int, float]]:
     """[(op, result_bytes, wire_bytes_per_chip)] for every collective in
     a partitioned-HLO dump (``compiled.as_text()``)."""
     per_op = []
     for line in hlo_text.splitlines():
-        s = line.strip()
-        # result type may be a long tuple containing /*index=N*/ comments
-        m = re.match(r"%?[\w.-]+ = (.*?) (all-reduce|all-gather|"
-                     r"reduce-scatter|collective-permute|all-to-all)"
-                     r"(-start)?\(", s)
-        if not m:
-            continue
-        shape_str, op, is_start = m.group(1), m.group(2), bool(m.group(3))
-        elems = _element_bytes(shape_str)
-        if is_start and len(elems) > 1:
-            # async form: the result tuple carries (operand, result[,
-            # context]) — only the largest element is the payload, the
-            # rest would double-count it (and ignore the matching -done)
-            size = max(elems)
-        else:
-            size = sum(elems)
-        n = _group_size(s, n_shards)
-        f = (n - 1) / n if n > 1 else 0.0
-        if op == "all-reduce":
-            wire = 2 * size * f
-        elif op == "all-gather":
-            wire = size * f               # result is the full size
-        elif op == "reduce-scatter":
-            wire = size * f * n           # result is the 1/n shard
-        else:
-            wire = size
-        per_op.append((op, size, wire))
+        parsed = _parse_collective_line(line.strip(), n_shards)
+        if parsed is not None:
+            per_op.append(parsed[:3])
     return per_op
+
+
+# -- per-axis-group attribution (partitioned HLO) -------------------------- #
+def _replica_id_groups(line: str) -> Optional[List[Tuple[int, ...]]]:
+    """Concrete device-id groups of one collective line, from either the
+    explicit ``replica_groups={{0,1},{2,3}}`` form or the iota form
+    ``replica_groups=[G,S]<=[dims](T(perm))``.  None when the line has
+    no parseable group list (the collective spans everything)."""
+    m = re.search(r"replica_groups=\{(\{[\d,]+\}(?:,\s*\{[\d,]+\})*)\}",
+                  line)
+    if m:
+        return [tuple(int(x) for x in grp.split(","))
+                for grp in re.findall(r"\{([\d,]+)\}", m.group(1))]
+    m = re.search(r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\]"
+                  r"(?:T\(([\d,]+)\))?", line)
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        ids = np.arange(int(np.prod(dims, dtype=np.int64))).reshape(dims)
+        if m.group(4):
+            ids = ids.transpose([int(p) for p in m.group(4).split(",")])
+        return [tuple(int(x) for x in row) for row in ids.reshape(g, s)]
+    return None
+
+
+def _axes_list(mesh) -> List[Tuple[str, int]]:
+    """Ordered [(axis, size), ...] from a jax Mesh, a dict, or an
+    already-ordered pair list."""
+    if hasattr(mesh, "axis_names"):        # jax.sharding.Mesh
+        return [(str(a), int(mesh.shape[a])) for a in mesh.axis_names]
+    if isinstance(mesh, dict):
+        return [(str(k), int(v)) for k, v in mesh.items()]
+    return [(str(a), int(s)) for a, s in mesh]
+
+
+def replica_group_label(groups: Optional[List[Tuple[int, ...]]],
+                        mesh) -> str:
+    """Name the mesh-axis subset a replica-group set varies over.
+
+    The mesh's device ids are laid out row-major over its axes (how
+    ``create_mesh`` builds them), so a device id maps to axis
+    coordinates; the axes whose coordinate varies *within* a group are
+    the axes the collective communicates over.  Returns e.g. ``"dp"``,
+    ``"tp"``, ``"dp×pp"``, ``"all"`` (every axis >1 varies), or
+    ``"unattributed"`` when the ids don't fit the mesh."""
+    axes = _axes_list(mesh)
+    names = [n for n, _ in axes]
+    sizes = [s for _, s in axes]
+    total = int(np.prod(sizes, dtype=np.int64))
+    if groups is None:
+        return "all"
+    coords = np.stack(np.unravel_index(np.arange(total), sizes), axis=1)
+    varying = set()
+    for grp in groups:
+        if any(d < 0 or d >= total for d in grp):
+            return "unattributed"
+        cs = coords[list(grp)]
+        for i in range(len(names)):
+            if len(np.unique(cs[:, i])) > 1:
+                varying.add(i)
+    if not varying:
+        return "unattributed"       # singleton groups: no communication
+    if varying == {i for i, s in enumerate(sizes) if s > 1}:
+        return "all" if len(varying) > 1 else names[next(iter(varying))]
+    return "×".join(names[i] for i in sorted(varying))
+
+
+def hlo_group_breakdown(hlo_text: str, mesh) -> Dict[str, Dict[str, float]]:
+    """Per-axis-group wire volume of a partitioned HLO:
+    ``{group_label: {op: wire_bytes_per_chip, "wire_bytes": total}}``.
+
+    This is the measured counterpart of the trace-time
+    ``comm/group.<axis>.*`` gauges — on the GSPMD/partial-auto paths the
+    compiler owns the op choice, so the only honest per-group
+    attribution is to parse the replica groups it actually emitted and
+    map them back onto mesh axes."""
+    axes = _axes_list(mesh)
+    n_shards = int(np.prod([s for _, s in axes], dtype=np.int64))
+    out: Dict[str, Dict[str, float]] = {}
+    for line in hlo_text.splitlines():
+        s = line.strip()
+        parsed = _parse_collective_line(s, n_shards)
+        if parsed is None:
+            continue
+        op, _, wire, _ = parsed
+        label = replica_group_label(_replica_id_groups(s), axes)
+        d = out.setdefault(label, {"wire_bytes": 0.0})
+        d[op] = d.get(op, 0.0) + wire
+        d["wire_bytes"] += wire
+    return out
 
 
 def hlo_collective_bytes(hlo_text: str, n_shards: int) -> float:
@@ -150,7 +253,7 @@ def hlo_collective_bytes(hlo_text: str, n_shards: int) -> float:
 
 # -- trace-time reporting --------------------------------------------------- #
 def account_collective(op: str, raw_bytes: int, wire_bytes: float,
-                       recorder=None):
+                       recorder=None, group: Optional[str] = None):
     """Report one collective's static volume to the (active) recorder.
 
     Called at *trace time* from inside jitted step functions — shapes
@@ -161,6 +264,17 @@ def account_collective(op: str, raw_bytes: int, wire_bytes: float,
       ``collective/{op}_wire_bytes``  on-the-wire (post-compression) volume
       ``collective/bytes_per_step``   running total of raw volume
       ``collective/wire_bytes_per_step``  running total of wire volume
+
+    ``group`` names the parallelism group the exchange runs over (the
+    mesh axis or axis set, e.g. ``"dp"`` / ``"ep"`` / ``"dp×pp"``) and
+    additionally lands the volume in the per-group family — ACCUMULATED
+    across calls in one trace (a composed step issues several exchanges
+    per group; per-op gauges keep last-write semantics, the group view
+    must not):
+      ``comm/group.{group}.{op}_bytes`` / ``..._wire_bytes``
+      ``comm/group.{group}.wire_bytes_per_step``
+    Callers reset the ``comm/group.`` prefix alongside ``collective/``
+    when rebuilding a step (re-traces re-report).
     """
     if recorder is None:
         from .recorder import get_recorder
@@ -175,3 +289,10 @@ def account_collective(op: str, raw_bytes: int, wire_bytes: float,
     recorder.gauge("collective/wire_bytes_per_step",
                    recorder.gauge_value("collective/wire_bytes_per_step")
                    + float(wire_bytes))
+    if group is not None:
+        pre = f"comm/group.{group}."
+        for suffix, val in ((f"{op}_bytes", float(raw_bytes)),
+                            (f"{op}_wire_bytes", float(wire_bytes)),
+                            ("wire_bytes_per_step", float(wire_bytes))):
+            recorder.gauge(pre + suffix,
+                           recorder.gauge_value(pre + suffix) + val)

@@ -51,7 +51,8 @@ from .optimizer import (Optimizer, _mb_to_arrays, _ClippedOptim,
 from .trigger import Trigger
 
 
-def fsdp_opt_state_specs(params_template, shardable, optim):
+def fsdp_opt_state_specs(params_template, shardable, optim,
+                         spec: P = P("dp")):
     """PartitionSpecs for an OptimMethod's state under FSDP.
 
     Optimizer-state moment trees mirror the param tree structure (every
@@ -62,6 +63,11 @@ def fsdp_opt_state_specs(params_template, shardable, optim):
     non-moment buffers) stays replicated.  Matching on (shape, dtype)
     alone would wrongly dim-0-shard state belonging to a replicated
     param that happens to share shape+dtype with a sharded one.
+
+    ``spec`` is the PartitionSpec a *sharded* moment leaf takes —
+    ``P("dp")`` for the flat fsdp/zero1 paths, ``P(("pp", "dp"))`` for
+    the composed pipeline path where the shard space is additionally
+    stage-stacked on dim 0.
     """
     opt_state_template = jax.eval_shape(optim.init_state, params_template)
     p_paths, _ = jax.tree_util.tree_flatten_with_path(params_template)
@@ -74,7 +80,7 @@ def fsdp_opt_state_specs(params_template, shardable, optim):
         for i in range(len(path)):
             hit = by_path.get(tuple(path[i:]))
             if hit is not None and hit[0] == shape:
-                return P("dp") if hit[1] else P()
+                return spec if hit[1] else P()
         return P()
 
     return jax.tree_util.tree_map_with_path(spec_for_opt_leaf,
@@ -338,6 +344,7 @@ class DistriOptimizer(Optimizer):
             self._with_health = telemetry
             self._seen_sigs.clear()
             self._rec().reset_gauges("collective/")
+            self._rec().reset_gauges("comm/group.")
             step_fn, shardable = self._build_step(params_template, optim,
                                                   telemetry=telemetry)
             self._shardable = shardable

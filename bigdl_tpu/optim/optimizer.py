@@ -843,6 +843,7 @@ class Optimizer:
             # rebuilds re-trace: clear the trace-time collective gauges
             # so per-step volume is not double-counted
             self._rec().reset_gauges("collective/")
+            self._rec().reset_gauges("comm/group.")
             if n_accum > 1:
                 fn = make_accum_train_step(self.model, self.criterion,
                                            optim, n_accum,
@@ -1103,7 +1104,10 @@ class Optimizer:
                     # this call re-traces (e.g. a ragged last batch) and
                     # the trace-time collective accounting re-runs: reset
                     # the per-step gauges or volume double-counts forever
+                    # (comm/group.* has accumulate semantics — it would
+                    # inflate, not just go stale)
                     rec.reset_gauges("collective/")
+                    rec.reset_gauges("comm/group.")
                     if self._cost_pending:
                         # once per step build, at the first (full-batch)
                         # signature — a ragged last batch would

@@ -1,7 +1,9 @@
 """bigdl_tpu.parallel — mesh engine & collectives
 (≙ utils/Engine.scala + parameters/ package)."""
 from .mesh import (create_mesh, get_mesh, set_mesh, data_sharding,
-                   replicated, shard_batch, init_distributed)
+                   replicated, shard_batch, init_distributed,
+                   parse_template, DATA_AXES, MODEL_AXES)
+from .compose import ComposedConfig, build_trainer
 from .allreduce import (allreduce_gradients, reduce_scatter_gradients,
                         allgather_params, shardable_mask_dim0)
 from .bucketer import GradBucketer

@@ -71,7 +71,8 @@ def _axis_size_or_none(axis_name):
 
 
 def allreduce_gradients(grads, axis_name: str = "dp",
-                        compress: Optional[str] = None, mean: bool = True):
+                        compress: Optional[str] = None, mean: bool = True,
+                        group: Optional[str] = None):
     """Sum (or mean) gradients across the axis, optionally compressed to
     16-bit on the wire (≙ FP16CompressedTensor).  Call inside shard_map.
 
@@ -83,16 +84,21 @@ def allreduce_gradients(grads, axis_name: str = "dp",
 
     Accounts the ring all-reduce volume (raw and on-the-wire bytes) to
     the active telemetry recorder at trace time — shapes are static
-    here, so the numbers are exact per executed step."""
+    here, so the numbers are exact per executed step.  ``group`` names
+    the parallelism group for the ``comm/group.<axis>.*`` family
+    (defaults to the axis name on a composed mesh — pass explicitly
+    when ``axis_name`` is a tuple)."""
     orig_dtypes = jax.tree_util.tree_map(lambda g: g.dtype, grads)
     n = _axis_size_or_none(axis_name)
+    if group is None and isinstance(axis_name, str):
+        group = axis_name
     if n is not None:
         raw = _acct.tree_bytes(grads)
         wire_item = _acct.compressed_itemsize(compress)
         wire = _acct.tree_bytes(grads, wire_itemsize=wire_item)
         _acct.account_collective(
             "allreduce", _acct.ring_allreduce_bytes(raw, n),
-            _acct.ring_allreduce_bytes(wire, n))
+            _acct.ring_allreduce_bytes(wire, n), group=group)
     cast_to = {"fp16": jnp.float16, "float16": jnp.float16,
                "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16}.get(compress)
     if cast_to is not None:
@@ -113,7 +119,7 @@ def allreduce_gradients(grads, axis_name: str = "dp",
 
 
 def reduce_scatter_gradients(grads, axis_name: str = "dp", mean: bool = True,
-                             mask=None):
+                             mask=None, group: Optional[str] = None):
     """Each shard keeps 1/N of every sharded gradient leaf (scatter dim 0)
     — the FSDP half of the partitioned parameter server.  ``mask`` (a
     params-shaped tree of bools, e.g. from :func:`shardable_mask_dim0`)
@@ -124,6 +130,8 @@ def reduce_scatter_gradients(grads, axis_name: str = "dp", mean: bool = True,
     Trace-time accounting: scattered leaves ride a reduce-scatter
     (S*(n-1)/n wire bytes), unscattered ones a full all-reduce."""
     n = axis_size(axis_name)
+    if group is None and isinstance(axis_name, str):
+        group = axis_name
     rs_bytes, ar_bytes = [0], [0]
     dense_leaves = []
 
@@ -147,20 +155,23 @@ def reduce_scatter_gradients(grads, axis_name: str = "dp", mean: bool = True,
     if rs_bytes[0]:
         _acct.account_collective(
             "reduce_scatter", _acct.ring_gather_bytes(rs_bytes[0], n),
-            _acct.ring_gather_bytes(rs_bytes[0], n))
+            _acct.ring_gather_bytes(rs_bytes[0], n), group=group)
     if ar_bytes[0]:
         _acct.account_collective(
             "allreduce", _acct.ring_allreduce_bytes(ar_bytes[0], n),
-            _acct.ring_allreduce_bytes(ar_bytes[0], n))
+            _acct.ring_allreduce_bytes(ar_bytes[0], n), group=group)
     return out
 
 
-def allgather_params(params, axis_name: str = "dp", mask=None):
+def allgather_params(params, axis_name: str = "dp", mask=None,
+                     group: Optional[str] = None):
     """Rebuild full parameters from dim-0 shards (the getWeights fetch).
     ``mask`` marks which leaves are actually sharded (replicated leaves
     must NOT be gathered — that would tile N copies); without a mask any
     non-scalar leaf is gathered."""
     n = _axis_size_or_none(axis_name)
+    if group is None and isinstance(axis_name, str):
+        group = axis_name
     ag_bytes = [0]
     skipped_leaves = []
 
@@ -180,7 +191,7 @@ def allgather_params(params, axis_name: str = "dp", mask=None):
     if ag_bytes[0] and n:
         _acct.account_collective(
             "allgather", _acct.ring_gather_bytes(ag_bytes[0], n),
-            _acct.ring_gather_bytes(ag_bytes[0], n))
+            _acct.ring_gather_bytes(ag_bytes[0], n), group=group)
     return out
 
 

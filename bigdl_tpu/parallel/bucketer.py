@@ -106,14 +106,20 @@ class GradBucketer:
 
     # -- the exchange ------------------------------------------------------ #
     def allreduce(self, grads, axis_name: str = "dp",
-                  compress: Optional[str] = None, mean: bool = True):
+                  compress: Optional[str] = None, mean: bool = True,
+                  group: Optional[str] = None):
         """Per-bucket all-reduce of ``grads`` inside ``shard_map``.
 
         Trace-time accounting mirrors ``allreduce_gradients``:
         ``collective/allreduce_bytes`` raw vs ``_wire_bytes`` post-
         compression, plus a ``collective/buckets`` gauge with the
-        per-step collective count."""
+        per-step collective count.  ``group`` (default: the axis name)
+        attributes the volume to its parallelism group's
+        ``comm/group.<axis>.*`` family — on a composed mesh each axis
+        runs its own bucket stream, accounted separately."""
         n = axis_size(axis_name)
+        if group is None and isinstance(axis_name, str):
+            group = axis_name
         cast_to = _CAST.get(compress)
         vecs = self.pack(grads)
         raw = sum(_acct.leaf_bytes(v) for v in vecs)
@@ -122,11 +128,24 @@ class GradBucketer:
             v.shape[0] * wire_item for v in vecs)
         _acct.account_collective("allreduce",
                                  _acct.ring_allreduce_bytes(raw, n),
-                                 _acct.ring_allreduce_bytes(wire, n))
+                                 _acct.ring_allreduce_bytes(wire, n),
+                                 group=group)
         from ..observability.recorder import get_recorder
         rec = get_recorder()
         if rec.enabled:
-            rec.gauge("collective/buckets", float(len(vecs)))
+            # accumulated, like bytes_per_step: a composed/overlap-
+            # chunked step issues several bucket streams per trace, and
+            # last-write would under-report all but the final stream.
+            # The collective/ and comm/group. prefixes reset together
+            # on every rebuild AND re-trace, so single-stream paths
+            # read exactly as before
+            rec.gauge("collective/buckets",
+                      rec.gauge_value("collective/buckets")
+                      + float(len(vecs)))
+            if group is not None:
+                rec.gauge(f"comm/group.{group}.buckets",
+                          rec.gauge_value(f"comm/group.{group}.buckets")
+                          + float(len(vecs)))
 
         out = []
         for v in vecs:

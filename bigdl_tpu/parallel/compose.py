@@ -1,0 +1,112 @@
+"""One declarative template for composed dp×fsdp×tp×pp(+ep) training.
+
+The MULTICHIP dryruns prove every parallelism axis individually; this
+module is the production entry point that composes them: a single
+``ComposedConfig`` names the mesh template (``"dp2,tp2,pp2"``) and the
+roofline knobs (zero1 sharded update, bucketed/compressed dp-group
+collectives, fused optimizer kernels, bubble-overlapped gradient
+chunks), and :func:`build_trainer` picks the right engine:
+
+  * a ``pp`` axis > 1 -> :class:`~bigdl_tpu.parallel.pipeline.
+    PipelineLMTrainer` (manual GPipe schedule; dp manual, tp/sp auto) —
+    the path where zero1/bucketing/overlap are explicit collectives;
+  * otherwise -> :class:`~bigdl_tpu.parallel.spmd.SpmdTrainer` (GSPMD:
+    dp/fsdp/tp/sp/ep all auto) — zero1 rides sharding annotations
+    (arXiv:2004.13336) and the compiler owns the collectives, so the
+    manual bucket/compress knobs are rejected rather than ignored.
+
+Which win applies on which axis group, and what the parity taxonomy
+says about each, is documented in docs/distributed.md § Composed
+parallelism.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Union
+
+from . import mesh as mesh_lib
+
+
+@dataclass
+class ComposedConfig:
+    """Declarative composed-parallelism configuration.
+
+    ``template`` is the full-capacity mesh ({axis: size} or a template
+    string) — also what :func:`bigdl_tpu.elastic.plan_mesh` replans
+    from when capacity changes.
+    """
+    template: Union[str, Dict[str, int]]
+    zero1: bool = False
+    bucket_bytes: Optional[int] = None
+    compress: Optional[str] = None
+    fused_optim: bool = False
+    overlap_grad_chunks: int = 1
+    n_microbatches: int = 4
+    loss_chunk: Optional[int] = None
+    grad_accum: int = 1
+    min_fsdp_size: int = 2 ** 16
+    zero1_min_size: Optional[int] = None
+    clip_norm: Optional[float] = None
+    seed: int = 0
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def axes(self) -> Dict[str, int]:
+        return mesh_lib.parse_template(self.template)
+
+
+def build_trainer(model, optim, config: ComposedConfig, devices=None):
+    """Build the (un-``init()``-ed) trainer for a composed config.
+
+    Raises on knob/engine combinations that would silently degrade:
+    the GSPMD path has no manual dp bucket stream (the partitioner
+    owns the collectives), and the pipeline path has no fsdp layering
+    (stage params are stacked, ZeRO-3-by-sharding doesn't apply).
+    """
+    axes = config.axes()
+    mesh = mesh_lib.create_mesh(axes, devices)
+    if axes.get("pp", 1) > 1:
+        from .pipeline import PipelineLMTrainer
+        if "fsdp" in axes and axes["fsdp"] > 1:
+            raise ValueError(
+                "fsdp does not compose with the pipeline engine (stage "
+                "params are layer-stacked; use zero1 for the sharded "
+                "update, or drop pp and let SpmdTrainer layer fsdp)")
+        if config.grad_accum > 1:
+            raise ValueError(
+                "grad_accum is the GSPMD engine's microbatching; the "
+                "pipeline engine accumulates via n_microbatches (and "
+                "overlap_grad_chunks) — silently dropping it would "
+                "shrink the effective batch")
+        return PipelineLMTrainer(
+            model, optim, mesh,
+            n_microbatches=config.n_microbatches,
+            seed=config.seed, loss_chunk=config.loss_chunk,
+            zero1=config.zero1, bucket_bytes=config.bucket_bytes,
+            compress=config.compress, fused_optim=config.fused_optim,
+            overlap_grad_chunks=config.overlap_grad_chunks,
+            clip_norm=config.clip_norm, **config.extra)
+    from .spmd import SpmdTrainer
+    for knob in ("bucket_bytes", "compress", "fused_optim",
+                 "clip_norm"):
+        if getattr(config, knob):
+            raise ValueError(
+                f"{knob} is a manual-collective/update knob: the GSPMD "
+                "engine's collectives and update are compiler-owned "
+                "(set pp>1 for the manual pipeline engine, or drop the "
+                "knob)")
+    if config.overlap_grad_chunks > 1:
+        raise ValueError(
+            "overlap_grad_chunks schedules the GPipe bubble; it needs "
+            "a pp axis > 1")
+    if config.n_microbatches != ComposedConfig.n_microbatches:
+        raise ValueError(
+            "n_microbatches is the pipeline engine's schedule knob; "
+            "the GSPMD engine microbatches via grad_accum — silently "
+            "dropping it would change the schedule you asked for")
+    return SpmdTrainer(
+        model, optim, mesh=mesh,
+        fsdp=axes.get("fsdp", 1) > 1,
+        seed=config.seed, min_fsdp_size=config.min_fsdp_size,
+        grad_accum=config.grad_accum, loss_chunk=config.loss_chunk,
+        zero1=config.zero1, zero1_min_size=config.zero1_min_size,
+        **config.extra)

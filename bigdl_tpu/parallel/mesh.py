@@ -9,6 +9,7 @@ here the topology is a `jax.sharding.Mesh` over TPU chips.  Axes:
   tp    tensor parallel      (megatron-style sharded matmuls)
   sp    sequence parallel    (ring attention over ICI)
   pp    pipeline parallel    (microbatched ppermute stages)
+  ep    expert parallel      (MoE experts; dispatch/combine all-to-all)
 
 Axis order puts dp outermost so its collectives ride DCN across hosts while
 tp/sp stay on intra-slice ICI (the usual pod layout).  On a single host the
@@ -18,6 +19,7 @@ for tests (xla_force_host_platform_device_count).
 from __future__ import annotations
 
 import os
+import re
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
@@ -26,6 +28,55 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 _current_mesh: Optional[Mesh] = None
+
+# canonical axis vocabulary of the composed-mesh templates.  DATA axes
+# re-batch the same math (cheap to re-plan elastically); MODEL axes are
+# entangled with tensor layouts (expensive re-partitions) — the split
+# elastic/plan.py's shrink costs and checkpoint resharding advice key on.
+KNOWN_AXES = ("dp", "fsdp", "sp", "tp", "pp", "ep")
+DATA_AXES = ("dp", "fsdp")
+MODEL_AXES = ("sp", "tp", "pp", "ep")
+
+_TEMPLATE_RE = re.compile(r"([a-z]+)\s*[=:]?\s*(\d+)")
+
+
+def parse_template(template) -> Dict[str, int]:
+    """One declarative composed-mesh spelling -> ordered ``{axis: size}``.
+
+    Accepts a dict (returned normalized), or a string in any of the
+    usual spellings — ``"dp2x tp2 x pp2"``, ``"dp2,tp2,pp2"``,
+    ``"dp=2 tp=2 pp=2"``, ``"dp2×tp2×pp2"``.  Axis names must come from
+    the known vocabulary (catches ``pd2`` typos that would otherwise
+    build a mesh no PartitionSpec mentions); sizes must be >= 1.
+    """
+    if isinstance(template, dict):
+        pairs = [(str(k), int(v)) for k, v in template.items()]
+    else:
+        s = str(template).strip().lower()
+        # an 'x'/'×' right after a size digit is a separator, not the
+        # start of the next axis name — 'dp4xtp2' must parse as
+        # dp4 × tp2, never reject as "unknown axis 'xtp'"
+        s = re.sub(r"(?<=\d)\s*[x×*,]+\s*", " ", s)
+        pairs = [(n, int(v)) for n, v in _TEMPLATE_RE.findall(s)]
+        # every non-separator character must be consumed by some match:
+        # "dpp2" silently parsing as dp... must fail instead
+        leftover = _TEMPLATE_RE.sub("", s)
+        if not pairs or leftover.strip(" ,x×*") != "":
+            raise ValueError(
+                f"unparseable mesh template {template!r} (expected "
+                "e.g. 'dp2,tp2,pp2' or 'dp=2 x tp=2')")
+    out: Dict[str, int] = {}
+    for name, size in pairs:
+        if name not in KNOWN_AXES:
+            raise ValueError(
+                f"unknown mesh axis {name!r} in template {template!r} "
+                f"(known: {', '.join(KNOWN_AXES)})")
+        if name in out:
+            raise ValueError(f"duplicate axis {name!r} in {template!r}")
+        if size < 1:
+            raise ValueError(f"axis {name!r} has size {size}")
+        out[name] = size
+    return out
 
 
 def init_distributed(coordinator_address=None, num_processes=None,
@@ -37,11 +88,13 @@ def init_distributed(coordinator_address=None, num_processes=None,
                                    process_id)
 
 
-def create_mesh(axes: Optional[Dict[str, int]] = None,
-                devices=None) -> Mesh:
-    """Build a Mesh from {axis_name: size}; -1 sizes one axis from the
-    remaining device count."""
+def create_mesh(axes=None, devices=None) -> Mesh:
+    """Build a Mesh from {axis_name: size} or a template string
+    (:func:`parse_template`, e.g. ``"dp2,tp2,pp2"``); -1 sizes one axis
+    from the remaining device count."""
     devices = list(devices if devices is not None else jax.devices())
+    if isinstance(axes, str):
+        axes = parse_template(axes)
     axes = dict(axes or {"dp": len(devices)})
     known = 1
     wild = None

@@ -23,6 +23,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -118,12 +119,52 @@ class PipelineLMTrainer:
     via the megatron pspecs, sequence parallel by sharding the sequence
     dim of the token batch.
 
-    The optimizer update happens on the global (sharded) arrays outside
-    the shard_map — GSPMD keeps the pp layout for block params/moments.
+    By default the optimizer update happens on the global (sharded)
+    arrays outside the shard_map — GSPMD keeps the pp layout for block
+    params/moments.  The composed-mesh roofline knobs (all default-off,
+    same semantics as ``DistriOptimizer``; see docs/distributed.md §
+    Composed parallelism):
+
+    ``zero1``        ZeRO-1 over the **dp axis of the pp(/tp)-sharded
+                     model** (arXiv:2004.13336 composed with GPipe):
+                     grads reduce-scatter into each stage's shard space
+                     over dp, each (stage, dp-rank) updates only its
+                     1/dp slice with its 1/dp moment shard — optimizer
+                     state lives ``P(("pp", "dp"))``, 1/(pp·dp) per
+                     device by sharding metadata — and updated params
+                     ride an all-gather back.  Elementwise optimizers
+                     only; grad-clip/health norms psum over the right
+                     axis groups (rest over dp, blocks over dp×pp).
+    ``bucket_bytes`` exchange dp-group gradients in flat single-dtype
+                     buckets (one collective per bucket — the dp bucket
+                     stream, accounted ``comm/group.dp.*``); with
+                     ``zero1`` it sizes the flat shard-space buckets.
+    ``compress``     "fp16"/"bf16" dp-group wire compression (the mean
+                     travels, pre-scaled in fp32 — fp16-sum-safe).
+    ``fused_optim``  route the update through the Pallas kernels
+                     (``bigdl_tpu.kernels``) when the OptimMethod
+                     supports ``fused``.
+    ``overlap_grad_chunks``
+                     split the microbatch train into this many gradient
+                     chunks: each chunk runs its own GPipe schedule and
+                     issues its dp-group collectives as soon as its
+                     backward finishes — **under the next chunk's
+                     pipeline bubble** instead of after the last
+                     microbatch (XLA's async collectives overlap them
+                     with the next chunk's compute).  Must divide
+                     ``n_microbatches``.  Chunked accumulation
+                     reassociates the token-mean (documented-ulp class,
+                     see docs/checkpointing.md taxonomy).
+    ``clip_norm``    global-L2 gradient clipping, axis-group-scoped on
+                     the zero1 path (shard sums-of-squares psum'd over
+                     dp for the replicated rest, dp×pp for the stage
+                     shards).
     """
 
     def __init__(self, model, optim, mesh, n_microbatches=4, seed=0,
-                 loss_chunk=None):
+                 loss_chunk=None, zero1=False, bucket_bytes=None,
+                 compress=None, fused_optim=False, overlap_grad_chunks=1,
+                 clip_norm=None):
         if model.frozen_param_names():
             raise NotImplementedError(
                 "Module.freeze is not supported by PipelineLMTrainer "
@@ -143,6 +184,43 @@ class PipelineLMTrainer:
         if cfg.n_layers % self.n_stages:
             raise ValueError(
                 f"n_layers={cfg.n_layers} must divide by pp={self.n_stages}")
+        n_dp = mesh.shape.get("dp", 1)
+        if (zero1 or bucket_bytes or compress) and n_dp < 2:
+            raise ValueError(
+                "zero1/bucket_bytes/compress drive the dp-group gradient "
+                f"exchange: the mesh needs a dp axis > 1 (got dp={n_dp})")
+        if compress not in (None, "fp16", "float16", "bf16", "bfloat16"):
+            # a typo'd mode would silently train at full fp32 wire
+            raise ValueError(
+                f"unknown compress mode {compress!r} "
+                "(fp16/float16/bf16/bfloat16)")
+        if zero1:
+            from ..optim.optim_method import LAMB, LARS
+            if isinstance(optim, (LARS, LAMB)):
+                raise ValueError(
+                    f"zero1 cannot shard {type(optim).__name__}: its "
+                    "per-TENSOR trust ratios need whole-tensor norms, "
+                    "and a dim-0 shard's norm is not the tensor's norm")
+        self.zero1 = bool(zero1)
+        self.bucket_bytes = bucket_bytes
+        self.compress = compress
+        self.clip_norm = clip_norm
+        if fused_optim:
+            if not hasattr(optim, "fused"):
+                raise ValueError(
+                    f"fused_optim=True: {type(optim).__name__} has no "
+                    "fused kernel (supported: SGD, Adam, AdamW)")
+            import copy
+            # shallow copy, never mutate the user's instance (reuse
+            # elsewhere without the flag keeps the default path)
+            self.optim = optim = copy.copy(optim)
+            optim.fused = True
+        self.fused_optim = bool(fused_optim)
+        self.overlap_chunks = int(overlap_grad_chunks)
+        if self.overlap_chunks < 1 or n_microbatches % self.overlap_chunks:
+            raise ValueError(
+                f"overlap_grad_chunks={overlap_grad_chunks} must be >= 1 "
+                f"and divide n_microbatches={n_microbatches}")
         self.template = model.blocks[0]
         self._block_names = [b.name for b in model.blocks]
         # chunked head+loss on the last stage (same lever as
@@ -152,6 +230,12 @@ class PipelineLMTrainer:
         self.opt_state = None
         self._step_fn = None
         self._step_count = 0
+        self._recorder = None
+        self._telemetry_health = True
+        self._with_health = False
+        self._seen_sigs = set()
+        self._z1_rest = None
+        self._z1_blocks = None
 
     # -- param plumbing ------------------------------------------------ #
     def _rename(self, tree, src, dst):
@@ -217,27 +301,110 @@ class PipelineLMTrainer:
                 lambda l, sp: jax.device_put(
                     l, NamedSharding(self.mesh, sp)), blocks, blk_place,
                 is_leaf=lambda v: not isinstance(v, dict))}
-        self.opt_state = jax.jit(self.optim.init_state)(self.params)
+        if self.zero1:
+            self.opt_state = self._init_zero1_state(rest, blocks)
+        else:
+            self.opt_state = jax.jit(self.optim.init_state)(self.params)
         self._build()
         return self
 
+    # -- zero1 over the dp axis of the pp-sharded model ----------------- #
+    def _init_zero1_state(self, rest, blocks):
+        """Shard-space optimizer state for the composed zero1 path.
+
+        Two layouts, because a flat bucket must never mix pp-replicated
+        and pp-varying leaves: ``rest`` (embed/norm/head — identical on
+        every stage) sharded 1/dp, and the per-STAGE slice of the
+        stacked blocks sharded 1/dp within each stage.  The outside-jit
+        storage stacks every stage's shard space on dim 0, placed
+        ``P(("pp", "dp"))`` — by sharding metadata each device holds
+        exactly 1/(pp·dp) of the block moments, the composed-mesh
+        memory claim."""
+        from jax.sharding import NamedSharding
+        from ..optim.distri_optimizer import fsdp_opt_state_specs
+        from .zero import Zero1Layout
+        n_dp = self.mesh.shape["dp"]
+        S = self.n_stages
+        local_blocks = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(
+                (l.shape[0] // S,) + tuple(l.shape[1:]), l.dtype), blocks)
+        self._z1_rest = Zero1Layout(rest, n_dp,
+                                    bucket_bytes=self.bucket_bytes)
+        self._z1_blocks = Zero1Layout(local_blocks, n_dp,
+                                      bucket_bytes=self.bucket_bytes)
+        space_r = self._z1_rest.stacked_space_zeros(1)
+        space_b = self._z1_blocks.stacked_space_zeros(S)
+        every = lambda t: jax.tree_util.tree_map(lambda _: True, t)
+        self._o_specs = {
+            "rest": fsdp_opt_state_specs(space_r, every(space_r),
+                                         self.optim, spec=P("dp")),
+            "blocks": fsdp_opt_state_specs(space_b, every(space_b),
+                                           self.optim,
+                                           spec=P(("pp", "dp")))}
+        state = {"rest": jax.jit(self.optim.init_state)(space_r),
+                 "blocks": jax.jit(self.optim.init_state)(space_b)}
+        return jax.tree_util.tree_map(
+            lambda l, sp: jax.device_put(l, NamedSharding(self.mesh, sp)),
+            state, self._o_specs)
+
+    def _telemetry_active(self):
+        return (self._recorder is not None and self._recorder.enabled
+                and self._telemetry_health)
+
     def _build(self):
-        from ..models.transformer import (lm_cross_entropy,
-                                          chunked_token_nll)
+        from ..models.transformer import lm_token_nll, chunked_token_nll
         from ..nn.module import Ctx
+        from ..optim.optimizer import _tree_nonfinite, _tree_sq
+        from .allreduce import allreduce_gradients
+        from .bucketer import GradBucketer
         model, template, optim = self.model, self.template, self.optim
         cfg = model.cfg
         n_micro, mesh = self.n_micro, self.mesh
         has_dp = "dp" in mesh.axis_names
         has_sp = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
         loss_chunk = self.loss_chunk
+        zero1 = self.zero1
+        compress = self.compress
+        clip_norm = self.clip_norm
+        n_chunks = self.overlap_chunks
+        z1r, z1b = self._z1_rest, self._z1_blocks
+        telemetry = self._telemetry_active()
+        self._with_health = telemetry
+        self._seen_sigs.clear()
+        rec = self._recorder
+        if rec is not None and rec.enabled:
+            # re-traces re-report the trace-time accounting: reset the
+            # per-step gauge families so a rebuild never double-counts
+            rec.reset_gauges("collective/")
+            rec.reset_gauges("comm/group.")
+        bucketer_rest = bucketer_blocks = None
+        if self.bucket_bytes and not zero1:
+            # two dp bucket streams — one per param family — so a flat
+            # bucket never mixes pp-replicated rest leaves with
+            # pp-varying stage leaves (templates from the placed params:
+            # _build always runs after init() placed them)
+            S = self.n_stages
+            local_blocks_t = jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(
+                    (l.shape[0] // S,) + tuple(l.shape[1:]), l.dtype),
+                self.params["blocks"])
+            bucketer_rest = GradBucketer(self.params["rest"],
+                                         bucket_bytes=self.bucket_bytes)
+            bucketer_blocks = GradBucketer(local_blocks_t,
+                                           bucket_bytes=self.bucket_bytes)
 
-        def local(rest, blocks_stage, tokens, targets):
+        def chunk_loss(rest, blocks_stage, tokens_c, targets_c, m_chunk):
+            """(masked total NLL on the last stage, grads wrt rest and
+            this stage's blocks) for one gradient chunk of microbatches.
+            Differentiates the LOCAL masked total — a psum inside the
+            differentiated function would make every rank seed a
+            cotangent through it and scale all gradients by n_stages;
+            values are psum'd after the grad call."""
             def loss_fn(rest, blocks_stage):
                 ctx = Ctx(state={}, training=True, rng_key=None)
-                h = model.embed.apply(rest, tokens, ctx)
+                h = model.embed.apply(rest, tokens_c, ctx)
                 h = h.astype(jnp.dtype(cfg.dtype))
-                mbs = h.reshape((n_micro, -1) + h.shape[1:])
+                mbs = h.reshape((m_chunk, -1) + h.shape[1:])
 
                 def stage_fn(stage_params, x):
                     def body(hh, blk):
@@ -259,32 +426,126 @@ class PipelineLMTrainer:
                 # same semantics as TransformerLM.token_nll: a chunk
                 # covering the whole sequence means no chunking
                 if loss_chunk and loss_chunk < h_out.shape[1]:
-                    tot, cnt = chunked_token_nll(head_fn, h_out, targets,
-                                                 loss_chunk)
-                    loss = tot / jnp.maximum(cnt, 1.0)
+                    tot, _ = chunked_token_nll(head_fn, h_out, targets_c,
+                                               loss_chunk)
                 else:
-                    loss = lm_cross_entropy(head_fn(h_out), targets)
-                # differentiate the LOCAL masked contribution — putting a
-                # psum inside the differentiated function would make every
-                # rank seed a cotangent through it and scale all gradients
-                # by n_stages; the value is psum'd after the grad call
-                return loss * last_stage_mask("pp")
+                    tot, _ = lm_token_nll(head_fn(h_out), targets_c)
+                return tot * last_stage_mask("pp")
 
-            loss, (g_rest, g_blocks) = jax.value_and_grad(
-                loss_fn, argnums=(0, 1))(rest, blocks_stage)
-            loss = lax.psum(loss, "pp")
+            return jax.value_and_grad(loss_fn, argnums=(0, 1))(
+                rest, blocks_stage)
+
+        def exchange(g_rest, g_blocks):
+            """One gradient chunk's collectives: pp-group psum of the
+            stage-disjoint rest grads, then the dp-group exchange —
+            issued HERE, per chunk, so XLA's async scheduler can launch
+            them under the next chunk's pipeline compute instead of
+            serializing every exchange behind the last microbatch.
+            Returns (rest, blocks) grads — shard-space trees on the
+            zero1 path, replicated/per-stage trees otherwise."""
+            # rest grads live on different ranks (embed on stage 0,
+            # final norm + head on the last stage, zeros elsewhere):
+            # psum over pp combines the disjoint contributions into the
+            # replicated global gradient; block grads stay per-stage
+            g_rest = allreduce_gradients(g_rest, "pp", mean=False,
+                                         group="pp")
+            if not has_dp:
+                return g_rest, g_blocks
+            if zero1:
+                return (z1r.scatter_grads(g_rest, "dp",
+                                          compress=compress),
+                        z1b.scatter_grads(g_blocks, "dp",
+                                          compress=compress))
+            if bucketer_rest is not None:
+                return (bucketer_rest.allreduce(g_rest, "dp",
+                                                compress=compress),
+                        bucketer_blocks.allreduce(g_blocks, "dp",
+                                                  compress=compress))
+            return (allreduce_gradients(g_rest, "dp", compress=compress),
+                    allreduce_gradients(g_blocks, "dp",
+                                        compress=compress))
+
+        def grads_and_loss(rest, blocks_stage, tokens, targets):
+            """Chunked GPipe fwd/bwd + per-chunk collective issue.
+            Returns (local mean loss, exchanged rest grads, exchanged
+            block grads) — grads carry the 1/valid-token mean weighting,
+            applied per chunk BEFORE the exchange so a compressed wire
+            ships bounded per-token-scale values."""
+            rows = tokens.shape[0]
+            m_chunk = n_micro // n_chunks
+            if rows % n_chunks:
+                # unreachable via step() (which gates rows % n_micro,
+                # and n_chunks | n_micro), but a direct _step_fn caller
+                # must never silently drop the tail rows
+                raise ValueError(
+                    f"local batch {rows} must divide by "
+                    f"overlap_grad_chunks={n_chunks}")
+            rows_c = rows // n_chunks
+            # the mean denominator (valid-token count) is param-free:
+            # computed up front so per-chunk grads can be final-scaled
+            cnt = jnp.maximum(
+                jnp.sum((targets != -1).astype(jnp.float32)), 1.0)
+            tot_acc, gr_acc, gb_acc = 0.0, None, None
+            add = lambda a, b: a + b
+            for k in range(n_chunks):
+                tok_c = lax.slice_in_dim(tokens, k * rows_c,
+                                         (k + 1) * rows_c, axis=0)
+                tgt_c = lax.slice_in_dim(targets, k * rows_c,
+                                         (k + 1) * rows_c, axis=0)
+                tot, (g_rest, g_blocks) = chunk_loss(
+                    rest, blocks_stage, tok_c, tgt_c, m_chunk)
+                scale = lambda g: g / cnt
+                g_rest = jax.tree_util.tree_map(scale, g_rest)
+                g_blocks = jax.tree_util.tree_map(scale, g_blocks)
+                g_rest, g_blocks = exchange(g_rest, g_blocks)
+                tot_acc = tot_acc + tot
+                if gr_acc is None:
+                    gr_acc, gb_acc = g_rest, g_blocks
+                else:
+                    gr_acc = jax.tree_util.tree_map(add, gr_acc, g_rest)
+                    gb_acc = jax.tree_util.tree_map(add, gb_acc, g_blocks)
+            loss = lax.psum(tot_acc / cnt, "pp")
             if has_dp:
                 loss = lax.pmean(loss, "dp")
-            # rest grads live on different ranks (embed on stage 0, final
-            # norm + head on the last stage, zeros elsewhere): psum over
-            # pp combines the disjoint contributions into the replicated
-            # global gradient; block grads stay sharded per-stage
-            g_rest = jax.tree_util.tree_map(
-                lambda g: lax.psum(g, "pp"), g_rest)
-            if has_dp:
-                g_rest, g_blocks = jax.tree_util.tree_map(
-                    lambda g: lax.pmean(g, "dp"), (g_rest, g_blocks))
-            return loss, (g_rest, g_blocks)
+            return loss, gr_acc, gb_acc
+
+        def group_sq(fn, r, b, sharded):
+            """Axis-group-scoped global reduction: the rest family is
+            pp-REPLICATED (its zero1 dp shards psum over dp only — a pp
+            psum would count it n_stages times), the block family varies
+            over pp AND dp (psum over both on the zero1 shard space;
+            over pp alone on the replicated-grad path)."""
+            sr, sb = fn(r), fn(b)
+            if sharded:             # zero1 shard space: 1/dp slices
+                sr = lax.psum(sr, "dp")
+                sb = lax.psum(sb, ("dp", "pp") if has_dp else "pp")
+            else:
+                sb = lax.psum(sb, "pp")
+            return sr + sb
+
+        def scoped_health(g_r, g_b, old_r, old_b, new_r, new_b, sharded):
+            """health_scalars with per-axis-group psum scoping (the
+            composed-mesh variant of optimizer.health_scalars)."""
+            gn = jnp.sqrt(group_sq(_tree_sq, g_r, g_b, sharded))
+            pn = jnp.sqrt(group_sq(_tree_sq, new_r, new_b, sharded))
+            d = lambda a, o: jax.tree_util.tree_map(
+                lambda x, y: x.astype(jnp.float32) - y.astype(jnp.float32),
+                a, o)
+            un = jnp.sqrt(group_sq(_tree_sq, d(new_r, old_r),
+                                   d(new_b, old_b), sharded))
+            return {"grad_norm": gn, "param_norm": pn, "update_norm": un,
+                    "update_ratio": un / jnp.maximum(pn, 1e-12),
+                    "nonfinite_grads": group_sq(_tree_nonfinite, g_r,
+                                                g_b, sharded)}
+
+        def clip(g_r, g_b, sharded):
+            """Global-L2 clip with the same axis-group scoping."""
+            total = jnp.sqrt(group_sq(_tree_sq, g_r, g_b, sharded))
+            scale = jnp.minimum(1.0,
+                                clip_norm / jnp.maximum(total, 1e-12))
+            s = lambda g: g * scale
+            return (jax.tree_util.tree_map(s, g_r),
+                    jax.tree_util.tree_map(s, g_b))
 
         rest_specs = jax.tree_util.tree_map(lambda _: P(),
                                             self.params["rest"])
@@ -303,20 +564,131 @@ class PipelineLMTrainer:
         manual = None
         if self._has_tp() or has_sp:
             manual = {"pp"} | ({"dp"} if has_dp else set())
-        mapped = _shard_map(
-            local, mesh,
-            (rest_specs, blk_specs, tok_spec, tok_spec),
-            (P(), (rest_specs, blk_specs)),
-            manual_axes=manual)
 
-        def step(params, opt_state, tokens, targets):
-            loss, (g_rest, g_blocks) = mapped(
-                params["rest"], params["blocks"], tokens, targets)
-            grads = {"rest": g_rest, "blocks": g_blocks}
-            new_params, new_opt = optim.update(grads, params, opt_state)
-            return new_params, new_opt, loss
+        if zero1:
+            # the whole step — fwd/bwd, dp scatter, 1/dp-sharded update,
+            # dp gather — runs inside ONE shard_map: each (stage,
+            # dp-rank) touches only its shard-space slice of params and
+            # moments; tp/sp stay AUTO inside (the update is
+            # elementwise, trivially partitionable)
+            def local(rest, blocks_stage, opt_r, opt_b, tokens, targets):
+                loss, gsh_r, gsh_b = grads_and_loss(rest, blocks_stage,
+                                                    tokens, targets)
+                if clip_norm is not None:
+                    gsh_r, gsh_b = clip(gsh_r, gsh_b, sharded=True)
+                idx = lax.axis_index("dp")
+                psh_r = z1r.local_shard(rest, idx)
+                psh_b = z1b.local_shard(blocks_stage, idx)
+                new_pr, new_or = optim.update(gsh_r, psh_r, opt_r)
+                new_pb, new_ob = optim.update(gsh_b, psh_b, opt_b)
+                new_rest = z1r.gather_params(new_pr, "dp")
+                new_blocks = z1b.gather_params(new_pb, "dp")
+                out = (loss, new_rest, new_blocks, new_or, new_ob)
+                if telemetry:
+                    out += (scoped_health(gsh_r, gsh_b, psh_r, psh_b,
+                                          new_pr, new_pb, sharded=True),)
+                return out
+
+            out_specs = (P(), rest_specs, blk_specs,
+                         self._o_specs["rest"], self._o_specs["blocks"])
+            if telemetry:
+                out_specs += (P(),)
+            mapped = _shard_map(
+                local, mesh,
+                (rest_specs, blk_specs, self._o_specs["rest"],
+                 self._o_specs["blocks"], tok_spec, tok_spec),
+                out_specs, manual_axes=manual)
+
+            def step(params, opt_state, tokens, targets):
+                out = mapped(params["rest"], params["blocks"],
+                             opt_state["rest"], opt_state["blocks"],
+                             tokens, targets)
+                loss, new_rest, new_blocks, new_or, new_ob = out[:5]
+                res = ({"rest": new_rest, "blocks": new_blocks},
+                       {"rest": new_or, "blocks": new_ob}, loss)
+                if telemetry:
+                    res += (out[5],)
+                return res
+        else:
+            def local(rest, blocks_stage, tokens, targets):
+                loss, g_rest, g_blocks = grads_and_loss(
+                    rest, blocks_stage, tokens, targets)
+                if clip_norm is not None:
+                    g_rest, g_blocks = clip(g_rest, g_blocks,
+                                            sharded=False)
+                out = (loss, (g_rest, g_blocks))
+                if telemetry:
+                    out += (scoped_health(g_rest, g_blocks, rest,
+                                          blocks_stage, rest,
+                                          blocks_stage, sharded=False),)
+                return out
+
+            out_specs = (P(), (rest_specs, blk_specs))
+            if telemetry:
+                out_specs += (P(),)
+            mapped = _shard_map(
+                local, mesh,
+                (rest_specs, blk_specs, tok_spec, tok_spec),
+                out_specs, manual_axes=manual)
+
+            def step(params, opt_state, tokens, targets):
+                out = mapped(params["rest"], params["blocks"], tokens,
+                             targets)
+                loss, (g_rest, g_blocks) = out[:2]
+                grads = {"rest": g_rest, "blocks": g_blocks}
+                new_params, new_opt = optim.update(grads, params,
+                                                   opt_state)
+                res = (new_params, new_opt, loss)
+                if telemetry:
+                    # grad-norm scalars come from inside the shard_map
+                    # (scoped psums; param/update norms there use the
+                    # PRE-update params — the post-update norms the
+                    # sentinel wants are refined below on the global
+                    # arrays, where auto-jit reductions are global)
+                    health = dict(out[2])
+                    pn = jnp.sqrt(sum(
+                        jnp.sum(l.astype(jnp.float32) ** 2)
+                        for l in jax.tree_util.tree_leaves(new_params)))
+                    un = jnp.sqrt(sum(
+                        jnp.sum((a.astype(jnp.float32)
+                                 - b.astype(jnp.float32)) ** 2)
+                        for a, b in zip(
+                            jax.tree_util.tree_leaves(new_params),
+                            jax.tree_util.tree_leaves(params))))
+                    health["param_norm"] = pn
+                    health["update_norm"] = un
+                    health["update_ratio"] = un / jnp.maximum(pn, 1e-12)
+                    res += (health,)
+                return res
 
         self._step_fn = jax.jit(step, donate_argnums=(0, 1))
+
+    # -- telemetry ------------------------------------------------------ #
+    def set_telemetry(self, recorder, health: bool = True):
+        """Attach an observability Recorder (same contract as
+        ``SpmdTrainer.set_telemetry``): each step() emits a step record
+        (h2d / train_step spans with recompile detection; loss and
+        tokens/sec scalars, plus the axis-group-scoped grad/param/update
+        norms when ``health`` — the health variant changes the compiled
+        program).  Re-jits without losing training progress when called
+        after ``init()``.  Also installs ``recorder`` as the
+        process-active one, so the trace-time ``comm/group.<axis>.*``
+        accounting of the dp/pp exchanges lands in the same ring."""
+        from ..observability import set_recorder
+        self._recorder = recorder
+        self._telemetry_health = bool(health)
+        set_recorder(recorder)
+        if (self._step_fn is not None
+                and self._with_health != self._telemetry_active()):
+            self._step_fn = None
+            self._build()
+        return self
+
+    def _rec(self):
+        if self._recorder is not None:
+            return self._recorder
+        from ..observability import null_recorder
+        return null_recorder()
 
     # -- API ----------------------------------------------------------- #
     def step(self, tokens, targets):
@@ -347,9 +719,43 @@ class PipelineLMTrainer:
         else:
             spec = P("dp") if has_dp else P()
         sh = NamedSharding(self.mesh, spec)
-        tokens = jax.device_put(jnp.asarray(tokens), sh)
-        targets = jax.device_put(jnp.asarray(targets), sh)
-        self.params, self.opt_state, loss = self._step_fn(
-            self.params, self.opt_state, tokens, targets)
+        rec = self._rec()
+        rec.start_step(self._step_count)
+        with rec.span("h2d"):
+            tokens = jax.device_put(jnp.asarray(tokens), sh)
+            targets = jax.device_put(jnp.asarray(targets), sh)
+        span_name = "train_step"
+        if rec.enabled:
+            sig = (tuple(tokens.shape), str(tokens.dtype),
+                   tuple(targets.shape), str(targets.dtype))
+            if sig not in self._seen_sigs:
+                self._seen_sigs.add(sig)
+                span_name = "train_step_compile"
+                rec.scalar("recompile", 1.0)
+                # a new signature re-TRACES: the trace-time accounting
+                # re-reports, and the accumulate-semantics group gauges
+                # would double-count without a reset here
+                rec.reset_gauges("collective/")
+                rec.reset_gauges("comm/group.")
+        with rec.span(span_name):
+            out = self._step_fn(self.params, self.opt_state, tokens,
+                                targets)
+        if self._with_health:
+            self.params, self.opt_state, loss, health = out
+        else:
+            self.params, self.opt_state, loss = out
+            health = None
         self._step_count += 1
+        if rec.enabled:
+            wire = rec.gauge_value("collective/wire_bytes_per_step")
+            if wire:
+                rec.inc("collective/wire_bytes_total", wire)
+            n_tok = int(np.prod(np.shape(tokens)))
+            rec.inc("tokens_total", n_tok)
+            rec.scalar("records", n_tok)
+            rec.scalar("loss", loss)
+            if health:
+                for k, v in health.items():
+                    rec.scalar(k, v)
+            rec.end_step(self._step_count - 1)
         return loss

@@ -51,17 +51,25 @@ def _filter_spec(spec: P, mesh: Mesh) -> P:
     return P(*(keep(e) for e in spec))
 
 
-def _add_fsdp(spec: P, shape, mesh: Mesh, min_size: int = 2 ** 16) -> P:
-    """Layer 'fsdp' onto the first free, divisible dim of a large param."""
-    if "fsdp" not in mesh.axis_names or int(np.prod(shape)) < min_size:
+def _add_axis(spec: P, shape, mesh: Mesh, axis: str,
+              min_size: int = 2 ** 16) -> P:
+    """Layer ``axis`` onto the first free, divisible dim of a large
+    param — the one sharding-layering rule ('fsdp' onto params, 'dp'
+    onto optimizer moments for the zero1 annotation)."""
+    if axis not in mesh.axis_names or int(np.prod(shape)) < min_size:
         return spec
-    n = mesh.shape["fsdp"]
+    n = mesh.shape[axis]
     entries = list(spec) + [None] * (len(shape) - len(spec))
     for i, (e, s) in enumerate(zip(entries, shape)):
         if e is None and s % n == 0:
-            entries[i] = "fsdp"
+            entries[i] = axis
             break
     return P(*entries)
+
+
+def _add_fsdp(spec: P, shape, mesh: Mesh, min_size: int = 2 ** 16) -> P:
+    """Layer 'fsdp' onto the first free, divisible dim of a large param."""
+    return _add_axis(spec, shape, mesh, "fsdp", min_size)
 
 
 class SpmdTrainer:
@@ -71,12 +79,29 @@ class SpmdTrainer:
                  fsdp: bool = True, seed: int = 0,
                  ring_attention: Optional[bool] = None,
                  min_fsdp_size: int = 2 ** 16, grad_accum: int = 1,
-                 loss_chunk: Optional[int] = None):
+                 loss_chunk: Optional[int] = None, zero1: bool = False,
+                 zero1_min_size: Optional[int] = None):
         self.model = model
         self.optim = optim
         self.mesh = mesh or mesh_lib.get_mesh()
         self.seed = seed
         self.min_fsdp_size = min_fsdp_size
+        # ZeRO-1 by ANNOTATION (arXiv:2004.13336 — "automatic
+        # cross-replica sharding of weight update"): optimizer moments
+        # get 'dp' layered onto their first free, divisible dim via
+        # sharding metadata, and a with_sharding_constraint pins the
+        # updated state to the same layout — the GSPMD partitioner then
+        # shards the elementwise update math 1/dp and inserts the
+        # collectives itself.  Composes with tp (megatron pspecs) and
+        # fsdp (moments already carry the param's fsdp dim; dp lands on
+        # a different free dim).  Memory claim is enforced by the
+        # sharding metadata, inspectable on opt_state leaves.
+        if zero1 and self.mesh.shape.get("dp", 1) < 2:
+            raise ValueError("zero1 shards the update over the dp axis: "
+                             "the mesh needs dp > 1")
+        self.zero1 = bool(zero1)
+        self.zero1_min_size = (min_fsdp_size if zero1_min_size is None
+                               else int(zero1_min_size))
         cfg = model.cfg
         if ring_attention is None:
             ring_attention = cfg.use_ring_attention
@@ -140,6 +165,37 @@ class SpmdTrainer:
                 out[mod][k] = NamedSharding(self.mesh, spec)
         return out
 
+    def _zero1_opt_shardings(self, params, shardings, opt_state):
+        """Per-leaf NamedShardings for the zero1-annotated optimizer
+        state, as ``{leaf path: NamedSharding}`` for exactly the leaves
+        the annotation touches: a moment leaf whose tree-path suffix
+        names an existing param (and matches its shape) takes that
+        param's spec with 'dp' layered onto the first free divisible
+        dim.  Scalars and unmatched leaves are absent — they keep the
+        (uncommitted) placement init gave them, so jit dispatch stays
+        free to move them.  Path correspondence, not shape matching —
+        the ``fsdp_opt_state_specs`` rule."""
+        p_paths, _ = jax.tree_util.tree_flatten_with_path(params)
+        sh_leaves = jax.tree_util.tree_leaves(
+            shardings, is_leaf=lambda v: hasattr(v, "spec"))
+        by_path = {tuple(path): (tuple(leaf.shape), sh.spec)
+                   for (path, leaf), sh in zip(p_paths, sh_leaves)}
+
+        out = {}
+
+        def for_leaf(path, leaf):
+            shape = tuple(getattr(leaf, "shape", ()))
+            for i in range(len(path)):
+                hit = by_path.get(tuple(path[i:]))
+                if hit is not None and hit[0] == shape:
+                    spec = _add_axis(hit[1], shape, self.mesh, "dp",
+                                     self.zero1_min_size)
+                    out[tuple(path)] = NamedSharding(self.mesh, spec)
+                    return leaf
+
+        jax.tree_util.tree_map_with_path(for_leaf, opt_state)
+        return out
+
     def _batch_sharding(self):
         ba = self._batch_axes
         lead = ba if len(ba) > 1 else (ba[0] if ba else None)
@@ -181,6 +237,14 @@ class SpmdTrainer:
         self.params = jax.tree_util.tree_map(jax.device_put, params, shardings)
         # jitted with sharded params -> moments inherit the param shardings
         self.opt_state = jax.jit(self.optim.init_state)(self.params)
+        zero1_sh = None
+        if self.zero1:
+            zero1_sh = self._zero1_opt_shardings(params, shardings,
+                                                 self.opt_state)
+            self.opt_state = jax.tree_util.tree_map_with_path(
+                lambda path, leaf: jax.device_put(
+                    leaf, zero1_sh[tuple(path)])
+                if tuple(path) in zero1_sh else leaf, self.opt_state)
         model, optim = self.model, self.optim
 
         n_accum = self.grad_accum
@@ -219,6 +283,15 @@ class SpmdTrainer:
             (loss, _), grads = grads_fn(params, {}, tokens, targets, rng)
             grads = mask_frozen_grads(model, grads)
             new_params, new_opt = optim.update(grads, params, opt_state)
+            if zero1_sh is not None:
+                # pin the updated state to the 1/dp layout: without the
+                # constraint the partitioner may re-replicate moments to
+                # match the (replicated-over-dp) grads, silently undoing
+                # the memory win the annotation promises
+                new_opt = jax.tree_util.tree_map_with_path(
+                    lambda path, x: jax.lax.with_sharding_constraint(
+                        x, zero1_sh[tuple(path)])
+                    if tuple(path) in zero1_sh else x, new_opt)
             if telemetry:
                 # global arrays under full-auto jit: the norm reductions
                 # are already global, no explicit collective needed
@@ -424,13 +497,30 @@ class SpmdTrainer:
             by_op[op] = by_op.get(op, 0.0) + wire
         total = sum(by_op.values())
         rec.reset_gauges("collective/")
+        rec.reset_gauges("comm/group.")
         for op, wire in by_op.items():
             rec.gauge(f"collective/{op.replace('-', '_')}_wire_bytes",
                       wire)
         rec.gauge("collective/wire_bytes_per_step", total)
         rec.gauge("collective/bytes_per_step", total)
+        # per-axis-group attribution: map the replica groups the
+        # partitioner emitted back onto mesh axes — on this path the
+        # compiler owns the op choice, so the HLO is the only honest
+        # source of "which axis paid these bytes" (the MoE ep
+        # all-to-all, the fsdp gathers, the dp grad reduction each land
+        # in their own comm/group.<axis>.* family)
+        groups = _acct.hlo_group_breakdown(hlo, self.mesh)
+        for label, d in groups.items():
+            for op, wire in d.items():
+                if op == "wire_bytes":
+                    continue
+                rec.gauge(f"comm/group.{label}."
+                          f"{op.replace('-', '_')}_wire_bytes", wire)
+            rec.gauge(f"comm/group.{label}.wire_bytes_per_step",
+                      d["wire_bytes"])
         self._hlo_accounted = True
-        return {"ops": by_op, "wire_bytes_per_step": total}
+        return {"ops": by_op, "groups": groups,
+                "wire_bytes_per_step": total}
 
     def step(self, tokens, targets):
         if self._step_fn is None:

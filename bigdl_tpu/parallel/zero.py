@@ -73,6 +73,7 @@ class Zero1Layout:
         self.flat_names = [_path_str(with_path[i][0]) for i in flat_leaf_idx]
 
         self.shapes = [tuple(l.shape) for l in flat]
+        self.dtypes = [jnp.dtype(l.dtype) for l in flat]
 
         # flat buckets: group the non-dim0-shardable leaves (by dtype, so
         # a bucket round-trips exactly), split at bucket_bytes, pad each
@@ -122,11 +123,37 @@ class Zero1Layout:
                 "flat": [self._pack_bucket(leaves, bi)
                          for bi in range(len(self.buckets))]}
 
-    def spec_tree(self):
+    def spec_tree(self, axes=("dp",)):
         """PartitionSpecs of the global shard space: every entry is a
-        dim-0 shard over dp."""
-        return {"leaves": [P("dp")] * len(self.sharded_idx),
-                "flat": [P("dp")] * len(self.buckets)}
+        dim-0 shard over ``axes``.  On a composed mesh the shard space
+        of a pipeline stage's params is stacked over ``pp`` *and*
+        scattered over ``dp`` — ``axes=("pp", "dp")`` composes the two
+        on dim 0 (pp-major, matching shard_map's split order)."""
+        axes = tuple(axes)
+        spec = P(axes if len(axes) > 1 else axes[0])
+        return {"leaves": [spec] * len(self.sharded_idx),
+                "flat": [spec] * len(self.buckets)}
+
+    def stacked_space_zeros(self, n_stack: int = 1):
+        """Zero-filled GLOBAL shard space, stage-stacked on dim 0.
+
+        For a pp×dp composition the outside-jit storage of the shard
+        space stacks every pipeline stage's (per-stage) shard space on
+        dim 0 — ``n_stack`` = number of stages; sharded
+        ``P(("pp", "dp"))`` each device holds exactly its stage's 1/dp
+        slice.  Optimizer state initialized over this tree is correct
+        for every value-independent OptimMethod init (zeros/constant
+        moments — all of ours)."""
+        leaves = []
+        for i in self.sharded_idx:
+            sh = self.shapes[i]
+            leaves.append(jnp.zeros((n_stack * sh[0],) + tuple(sh[1:]),
+                                    self.dtypes[i]))
+        flat = []
+        for bi in range(len(self.buckets)):
+            dt, _, sizes, pad = self._bucket_meta(bi)
+            flat.append(jnp.zeros((n_stack * (sum(sizes) + pad),), dt))
+        return {"leaves": leaves, "flat": flat}
 
     def local_shard(self, tree, idx, axis_name="dp"):
         """This replica's 1/N slice of a replicated full tree (used for
@@ -149,7 +176,7 @@ class Zero1Layout:
 
     # -- collectives ------------------------------------------------------ #
     def scatter_grads(self, grads, axis_name="dp", compress=None,
-                      mean=True):
+                      mean=True, group=None):
         """Full (per-replica) grads -> this replica's shard-space slice of
         the reduced grads, via per-leaf/per-bucket ``psum_scatter``
         (S·(n−1)/n wire bytes vs the all-reduce's 2·S·(n−1)/n).
@@ -161,6 +188,8 @@ class Zero1Layout:
         the ``collective/reduce_scatter*`` gauges pre/post compression.
         """
         n = self.n
+        if group is None and isinstance(axis_name, str):
+            group = axis_name
         leaves = jax.tree_util.tree_leaves(grads)
         wire_item = _acct.compressed_itemsize(compress)
         cast_to = {"fp16": jnp.float16, "float16": jnp.float16,
@@ -193,13 +222,16 @@ class Zero1Layout:
             * wire_item for bi in range(len(self.buckets)))
         _acct.account_collective("reduce_scatter",
                                  _acct.ring_gather_bytes(raw[0], n),
-                                 _acct.ring_gather_bytes(wire, n))
+                                 _acct.ring_gather_bytes(wire, n),
+                                 group=group)
         return {"leaves": out_l, "flat": out_f}
 
-    def gather_params(self, shard_space, axis_name="dp"):
+    def gather_params(self, shard_space, axis_name="dp", group=None):
         """Updated shard-space params -> full replicated tree via
         per-leaf/per-bucket ``all_gather`` (the getWeights fetch)."""
         n = self.n
+        if group is None and isinstance(axis_name, str):
+            group = axis_name
         raw = [0]
 
         def ag(x):
@@ -221,7 +253,8 @@ class Zero1Layout:
                 off += sz
         _acct.account_collective("allgather",
                                  _acct.ring_gather_bytes(raw[0], n),
-                                 _acct.ring_gather_bytes(raw[0], n))
+                                 _acct.ring_gather_bytes(raw[0], n),
+                                 group=group)
         return jax.tree_util.tree_unflatten(self.treedef, full)
 
     # -- bookkeeping ------------------------------------------------------ #

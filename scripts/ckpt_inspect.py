@@ -3,6 +3,7 @@
 
   python scripts/ckpt_inspect.py list <root> [--json]
   python scripts/ckpt_inspect.py describe <root> [--tag TAG] [--json]
+                                 [--target-mesh dp2,tp2,pp2]
   python scripts/ckpt_inspect.py verify <root> [--tag TAG] [--shallow]
                                                [--json]
 
@@ -30,11 +31,68 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from bigdl_tpu.checkpoint import manifest as mlib          # noqa: E402
-from bigdl_tpu.checkpoint.reshard import fmt_mesh, mesh_axes  # noqa: E402
+from bigdl_tpu.checkpoint.reshard import (MODEL_AXES, describe_delta,
+                                          fmt_mesh, mesh_axes)  # noqa: E402
 
 
 def _mesh_str(mesh):
     return "-" if not mesh else fmt_mesh(mesh)
+
+
+def _parse_target_mesh(spec):
+    """``dp2,tp2,pp2``-style axis spec -> mesh_info-shaped dict.  A tiny
+    jax-free sibling of ``parallel.mesh.parse_template`` (this tool must
+    run on a login node with no jax backend)."""
+    import re
+    pairs = re.findall(r"([a-z]+)\s*[=:]?\s*(\d+)", spec.strip().lower())
+    leftover = re.sub(r"([a-z]+)\s*[=:]?\s*(\d+)", "", spec.strip().lower())
+    if not pairs or leftover.strip(" ,x×*") != "":
+        raise SystemExit(f"unparseable --target-mesh {spec!r} "
+                         "(expected e.g. dp2,tp2,pp2)")
+    known = ("dp", "fsdp") + tuple(MODEL_AXES)
+    seen = set()
+    for n, v in pairs:
+        # a typo'd axis/size must not render a confident bogus delta
+        if n not in known:
+            raise SystemExit(
+                f"unknown axis {n!r} in --target-mesh {spec!r} "
+                f"(known: {', '.join(known)})")
+        if n in seen:
+            raise SystemExit(f"duplicate axis {n!r} in --target-mesh "
+                             f"{spec!r}")
+        if int(v) < 1:
+            raise SystemExit(f"axis {n!r} has size {v} in --target-mesh "
+                             f"{spec!r}")
+        seen.add(n)
+    axes = [[n, int(v)] for n, v in pairs]
+    dev = 1
+    for _, v in axes:
+        dev *= v
+    return {"axes": axes, "devices": dev}
+
+
+def _render_target_delta(mf, target):
+    """Human lines for a describe --target-mesh request: the shared
+    describe_delta wording plus a per-axis shrink/regrow/re-partition
+    table readable on a 4-axis composed mesh."""
+    lines = [f"  delta: {describe_delta(mf.mesh, target)}"]
+    sa, ta = mesh_axes(mf.mesh), mesh_axes(target)
+    for name in dict.fromkeys(list(sa) + list(ta)):
+        s, t = sa.get(name, 1), ta.get(name, 1)
+        if s == t:
+            continue
+        kind = ("model-parallel RE-PARTITION (expensive: per-shard "
+                "tensor slices move)" if name in MODEL_AXES
+                else "data-parallel re-layout (cheap: replicated/1-D "
+                "resharded state)")
+        lines.append(f"    {name}: {s} -> {t}  [{kind}]")
+    if len(lines) == 1:
+        lines.append("    (same topology — plain restore, no reshard)")
+    elif not all(s.kind == "slices" for s in mf.shards):
+        lines.append("    note: whole-tree shards restore onto any mesh "
+                     "via re-layout; v2 slice shards (shard_arrays=True) "
+                     "are required only when no host holds global arrays")
+    return lines
 
 
 def _read_all(root):
@@ -115,12 +173,20 @@ def cmd_describe(root, args):
     doc = _entry(d, mf)
     doc["meta"] = mf.meta
     doc["shard_table"] = [s.to_json() for s in mf.shards]
+    target = None
+    if getattr(args, "target_mesh", None):
+        target = _parse_target_mesh(args.target_mesh)
+        doc["target_mesh"] = target
+        doc["target_delta"] = describe_delta(mf.mesh, target)
     if args.json:
         print(json.dumps(doc, sort_keys=True))
         return 0
     print(f"{d} (tag {mf.tag}, manifest v{doc['version']})")
     print(f"  mesh:  {_mesh_str(mf.mesh)}"
           + (f"  axes={mesh_axes(mf.mesh)}" if mf.mesh else ""))
+    if target is not None:
+        for line in _render_target_delta(mf, target):
+            print(line)
     print(f"  meta:  {json.dumps(mf.meta, sort_keys=True)}")
     print(f"  {len(mf.shards)} shard(s), {doc['bytes']} bytes:")
     fmt = "    {:<32} {:<6} {:<14} {:>10} {:>12} {}"
@@ -165,6 +231,10 @@ def main(argv=None):
         p.add_argument("--json", action="store_true")
         if name != "list":
             p.add_argument("--tag", default=None)
+        if name == "describe":
+            p.add_argument("--target-mesh", default=None, metavar="AXES",
+                           help="render the reshard delta onto this "
+                                "mesh (e.g. dp2,tp2,pp2)")
         if name == "verify":
             p.add_argument("--shallow", action="store_true",
                            help="existence+size only (skip CRC re-hash)")

@@ -611,6 +611,39 @@ def summarize_comm(steps, out=print):
             f"{gauges['collective/buckets']:.0f} "
             "(per-bucket collectives — overlappable with backward)")
 
+    # per-axis-group breakdown (composed meshes): which parallelism
+    # group pays which wire bytes — comm/group.<axis>.<op>_* gauges
+    # from the trace-time accounting (manual paths) or the HLO
+    # replica-group attribution (SpmdTrainer.account_collectives)
+    pre = "comm/group."
+    group_names = sorted({k[len(pre):].split(".", 1)[0]
+                          for k in gauges if k.startswith(pre)})
+    if group_names:
+        out("\n== per-axis-group exchange (one bucket/collective "
+            "stream per parallelism group) ==")
+        out(f"  {'group':<8} {'op':<18} {'raw':>12} {'wire':>12} "
+            f"{'wire/raw':>9}")
+        for g in group_names:
+            gpre = f"{pre}{g}."
+            gops = sorted({k[len(gpre):-len("_wire_bytes")]
+                           for k in gauges
+                           if k.startswith(gpre)
+                           and k.endswith("_wire_bytes")
+                           and not k.endswith("bytes_per_step")})
+            for op in gops:
+                raw = gauges.get(f"{gpre}{op}_bytes", 0.0)
+                wire = gauges.get(f"{gpre}{op}_wire_bytes", 0.0)
+                ratio = wire / raw if raw else float("nan")
+                out(f"  {g:<8} {op:<18} {_fmt_bytes(raw):>12} "
+                    f"{_fmt_bytes(wire):>12} {ratio:>8.2f}x")
+            tot = gauges.get(f"{gpre}wire_bytes_per_step", 0.0)
+            extra = ""
+            if gauges.get(f"{gpre}buckets"):
+                extra = (f"   ({gauges[f'{gpre}buckets']:.0f} "
+                         "buckets/step)")
+            out(f"  {g:<8} {'TOTAL wire':<18} {'':>12} "
+                f"{_fmt_bytes(tot):>12}{extra}")
+
     raw_tot = counters.get("collective/bytes_total", 0.0)
     wire_tot = counters.get("collective/wire_bytes_total", 0.0)
     if raw_tot:

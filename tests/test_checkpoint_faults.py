@@ -198,6 +198,64 @@ def test_spmd_sigterm_then_resume_on_reshaped_mesh(tmp_path):
 
 
 @pytest.mark.slow
+def test_spmd_sigterm_tp_dp_elastic_resume_shrinks_cheapest_axis(tmp_path):
+    """The composed-mesh elastic leg: SIGTERM a dp4×tp2 job mid-run,
+    replan onto HALF the devices, and resume.  plan_mesh's per-axis
+    shrink costs must choose the dp axis (cheap re-batching) over tp (a
+    model-entangled re-partition) — dp2×tp2, never dp4×tp1 — and the
+    resumed run must continue within the documented taxonomy: dp 4→2
+    halves the partitions of every dp reduction, so the curve/params
+    are same-math tight-allclose (tp unchanged keeps its layout), not
+    bit-exact."""
+    from bigdl_tpu.elastic import plan_mesh
+    ck, out = tmp_path / "ck", tmp_path / "params.npz"
+    ref = tmp_path / "ref.npz"
+    _run_spmd(tmp_path / "ck_ref", ref, "dp4,tp2", "iters=10",
+              "shard_arrays", check_rc=0)
+    base_params, base_losses = _spmd_results(ref)
+    assert len(base_losses) == 10
+
+    p = subprocess.Popen(
+        [sys.executable, _WORKER, str(ck), str(out), _ITERS, "spmd",
+         "mesh=dp4,tp2", "shard_arrays", "ckpt_every=2", "preempt",
+         "step_sleep=50"],
+        env=_worker_env(), stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        deadline = time.time() + 300
+        for line in p.stdout:
+            if line.startswith("iter 4") or time.time() > deadline:
+                break
+        p.send_signal(signal.SIGTERM)
+        rest = p.communicate(timeout=300)[0]
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert p.returncode == 0, f"preempted worker must exit cleanly:\n{rest}"
+    cands = scan(str(ck))
+    assert cands, "no committed checkpoint after preemption"
+    newest = cands[-1][1]
+    assert newest.mesh is not None \
+        and newest.mesh["axes"] == [["dp", 4], ["tp", 2]]
+    k = newest.meta["step"]
+    assert k >= 4
+
+    # the supervisor's choice on 4 surviving devices: shrink the CHEAP
+    # axis — tp keeps its floor'd full size, dp halves
+    resume_axes = plan_mesh(4, {"dp": 4, "tp": 2}, {"tp": 2})
+    assert resume_axes == {"dp": 2, "tp": 2}, resume_axes
+    mesh_arg = ",".join(f"{a}{s}" for a, s in resume_axes.items())
+    r = _run_spmd(ck, out, mesh_arg, "iters=10", "shard_arrays",
+                  check_rc=0)
+    assert f"RESUME step={k}" in r.stdout, r.stdout
+    assert "[elastic] resharded" in r.stdout, r.stdout
+    params, losses = _spmd_results(out)
+    np.testing.assert_allclose(losses, base_losses[k:], rtol=1e-4)
+    for a, b in zip(params, base_params):
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.slow
 def test_spmd_kill_mid_write_then_resume_on_smaller_mesh(tmp_path):
     """Hard-kill a dp4 run 64 bytes into a slice shard of its second
     save, then resume on HALF the devices (dp2).  The torn save must be

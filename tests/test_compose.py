@@ -1,0 +1,461 @@
+"""Composed dp×fsdp×tp×pp(+ep) parallelism: the mesh template API, the
+per-axis-group collective accounting, zero1 over the dp axis of a
+pp/tp-sharded model, the bucketed/overlapped dp exchange in the GPipe
+trainer, and the trace_summary per-group table.
+
+Parity discipline (docs/checkpointing.md taxonomy, extended by this
+PR): zero1 scatter+update+gather and bucketed-fp32 exchange are the
+SAME fp program as the pmean path on XLA CPU — asserted BITWISE against
+the plain trainer on the same mesh.  Overlap-chunked accumulation and
+16-bit wire compression reassociate/round — documented-ulp class,
+asserted tight-allclose, never hidden behind loose tolerances.
+
+Multi-step trainer tests are marked slow like every transformer-jit
+test (pre-existing XLA-CPU interleaving flakiness); CI runs them in the
+compose-smoke job.
+"""
+import io
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from bigdl_tpu.models.transformer import TransformerLM, TransformerConfig
+from bigdl_tpu.observability import Recorder, collectives as C
+from bigdl_tpu.optim import Adam, SGD
+from bigdl_tpu.optim.optim_method import LARS
+from bigdl_tpu.parallel import (ComposedConfig, build_trainer,
+                                parse_template)
+from bigdl_tpu.parallel import mesh as mesh_lib
+from bigdl_tpu.parallel.pipeline import PipelineLMTrainer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+
+# --------------------------------------------------------------------- #
+# declarative template                                                    #
+# --------------------------------------------------------------------- #
+def test_parse_template_spellings_and_rejections():
+    want = {"dp": 2, "tp": 2, "pp": 2}
+    for s in ("dp2,tp2,pp2", "dp2 x tp2 x pp2", "dp=2 tp=2 pp=2",
+              "dp2×tp2×pp2", "DP2, TP2, PP2", "dp2xtp2xpp2"):
+        assert parse_template(s) == want, s
+    assert parse_template({"dp": 2, "ep": 4}) == {"dp": 2, "ep": 4}
+    # order is preserved — it IS the mesh axis order
+    assert list(parse_template("tp2,dp4")) == ["tp", "dp"]
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        parse_template("pd2")
+    with pytest.raises(ValueError, match="unparseable"):
+        parse_template("dp2,junk")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_template("dp2,dp4")
+    with pytest.raises(ValueError, match="size 0"):
+        parse_template({"dp": 0})
+
+
+def test_create_mesh_accepts_template_string():
+    mesh = mesh_lib.create_mesh("dp2,pp2")
+    assert mesh.axis_names == ("dp", "pp")
+    assert mesh.shape == {"dp": 2, "pp": 2}
+
+
+def test_build_trainer_picks_engine_and_rejects_bad_knobs():
+    def model():
+        return TransformerLM(TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+            max_len=16, dropout=0.0))
+
+    tr = build_trainer(model(), SGD(learning_rate=0.1),
+                       ComposedConfig("dp2,pp2", zero1=True,
+                                      bucket_bytes=1 << 16,
+                                      compress="fp16",
+                                      n_microbatches=2))
+    assert type(tr).__name__ == "PipelineLMTrainer" and tr.zero1
+    tr = build_trainer(model(), SGD(learning_rate=0.1),
+                       ComposedConfig("dp2,fsdp2"))
+    assert type(tr).__name__ == "SpmdTrainer" and tr.fsdp
+    tr = build_trainer(model(), SGD(learning_rate=0.1),
+                       ComposedConfig("dp4,tp2", zero1=True))
+    assert type(tr).__name__ == "SpmdTrainer" and tr.zero1
+    # manual-collective knobs on the compiler-owned engine: loud error
+    with pytest.raises(ValueError, match="compiler-owned"):
+        build_trainer(model(), SGD(learning_rate=0.1),
+                      ComposedConfig("dp2,tp2", bucket_bytes=4))
+    with pytest.raises(ValueError, match="pp axis"):
+        build_trainer(model(), SGD(learning_rate=0.1),
+                      ComposedConfig("dp2,tp2", overlap_grad_chunks=2))
+    with pytest.raises(ValueError, match="fsdp does not compose"):
+        build_trainer(model(), SGD(learning_rate=0.1),
+                      ComposedConfig("fsdp2,pp2"))
+    # engine-mismatched schedule knobs must never silently degrade the
+    # effective batch/schedule
+    with pytest.raises(ValueError, match="grad_accum"):
+        build_trainer(model(), SGD(learning_rate=0.1),
+                      ComposedConfig("dp2,pp2", grad_accum=8,
+                                     n_microbatches=2))
+    with pytest.raises(ValueError, match="n_microbatches"):
+        build_trainer(model(), SGD(learning_rate=0.1),
+                      ComposedConfig("dp2,tp2", n_microbatches=16))
+
+
+def test_pipeline_knob_rejections():
+    def model():
+        return TransformerLM(TransformerConfig(
+            vocab_size=64, d_model=32, n_layers=2, n_heads=2, d_ff=64,
+            max_len=16, dropout=0.0))
+
+    no_dp = mesh_lib.create_mesh({"pp": 2})
+    with pytest.raises(ValueError, match="dp axis"):
+        PipelineLMTrainer(model(), SGD(learning_rate=0.1), no_dp,
+                          zero1=True)
+    with pytest.raises(ValueError, match="dp axis"):
+        PipelineLMTrainer(model(), SGD(learning_rate=0.1), no_dp,
+                          compress="fp16")
+    mesh = mesh_lib.create_mesh({"dp": 2, "pp": 2})
+    with pytest.raises(ValueError, match="whole-tensor norms"):
+        PipelineLMTrainer(model(), LARS(learning_rate=0.1), mesh,
+                          zero1=True)
+    with pytest.raises(ValueError, match="divide n_microbatches"):
+        PipelineLMTrainer(model(), SGD(learning_rate=0.1), mesh,
+                          n_microbatches=4, overlap_grad_chunks=3)
+    with pytest.raises(ValueError, match="no fused kernel"):
+        PipelineLMTrainer(model(), LARS(learning_rate=0.1), mesh,
+                          fused_optim=True)
+    # a typo'd compress mode must not silently train at fp32 wire
+    with pytest.raises(ValueError, match="unknown compress"):
+        PipelineLMTrainer(model(), SGD(learning_rate=0.1), mesh,
+                          compress="f16")
+
+
+# --------------------------------------------------------------------- #
+# per-group accounting: trace-time gauges + HLO attribution               #
+# --------------------------------------------------------------------- #
+def test_account_collective_group_gauges_accumulate():
+    rec = Recorder()
+    C.account_collective("allreduce", 100, 50, recorder=rec, group="dp")
+    C.account_collective("allreduce", 100, 50, recorder=rec, group="dp")
+    C.account_collective("all_to_all", 40, 40, recorder=rec, group="ep")
+    # per-group gauges ACCUMULATE across calls in one trace (a
+    # composed step issues several exchanges per group)...
+    assert rec.gauge_value("comm/group.dp.allreduce_wire_bytes") == 100
+    assert rec.gauge_value("comm/group.dp.wire_bytes_per_step") == 100
+    assert rec.gauge_value("comm/group.ep.all_to_all_wire_bytes") == 40
+    # ...while the ungrouped per-op gauge keeps last-write semantics
+    assert rec.gauge_value("collective/allreduce_wire_bytes") == 50
+
+
+def test_replica_group_axis_attribution():
+    """Device-id replica groups map back onto mesh axes for every HLO
+    spelling: explicit lists, iota, and iota-with-transpose."""
+    axes = [("dp", 2), ("tp", 2), ("pp", 2)]
+    # tp groups on the row-major dp×tp×pp layout: ids differ by 2
+    g = C._replica_id_groups(
+        "x = f32[8] all-reduce(f32[8] y), "
+        "replica_groups={{0,2},{1,3},{4,6},{5,7}}")
+    assert g == [(0, 2), (1, 3), (4, 6), (5, 7)]
+    assert C.replica_group_label(g, axes) == "tp"
+    # iota [4,2]<=[8]: consecutive pairs vary the innermost axis (pp)
+    g = C._replica_id_groups("replica_groups=[4,2]<=[8]")
+    assert C.replica_group_label(g, axes) == "pp"
+    # iota with transpose: groups of 4 spanning dp and pp
+    g = C._replica_id_groups("replica_groups=[2,4]<=[2,2,2]T(1,0,2)")
+    assert C.replica_group_label(g, axes) == "dp×pp"
+    # no group list = the whole mesh
+    assert C.replica_group_label(None, axes) == "all"
+    # every axis >1 varying reads as "all" too
+    g = [(0, 1, 2, 3, 4, 5, 6, 7)]
+    assert C.replica_group_label(g, axes) == "all"
+    # ids that don't fit the mesh: refuse, don't guess
+    assert C.replica_group_label([(0, 99)], axes) == "unattributed"
+    # size-1 axes never block the single-axis label
+    assert C.replica_group_label(
+        [(0, 1)], [("dp", 2), ("tp", 1)]) == "dp"
+
+
+def test_async_reduce_scatter_start_counts_the_shard():
+    """The async -start tuple carries (full operand, 1/n result): the
+    wire formula multiplies by n expecting the SHARD, so taking the
+    operand would overcount n×.  8 devices, 64-element f32 operand →
+    8-element shard: wire = 8·4 · 7/8 · 8 = 224 B, same as the sync
+    form's 64·4 · 7/8."""
+    sync = ("x = f32[8]{0} reduce-scatter(f32[64] y), "
+            "replica_groups=[1,8]<=[8], dimensions={0}")
+    start = ("x = (f32[64]{0}, f32[8]{0}) reduce-scatter-start"
+             "(f32[64] y), replica_groups=[1,8]<=[8], dimensions={0}")
+    (op_s, _, wire_s), = C.hlo_collective_ops(sync, 8)
+    (op_a, _, wire_a), = C.hlo_collective_ops(start, 8)
+    assert op_s == op_a == "reduce-scatter"
+    assert wire_s == wire_a == 224.0
+    # all-gather-start keeps the largest element (the full result)
+    ag = ("x = (f32[8]{0}, f32[64]{0}) all-gather-start(f32[8] y), "
+          "replica_groups=[1,8]<=[8], dimensions={0}")
+    (_, _, wire_ag), = C.hlo_collective_ops(ag, 8)
+    assert wire_ag == 64 * 4 * 7 / 8
+
+
+def test_hlo_group_breakdown_totals_match_flat_ops():
+    axes = {"dp": 2, "tp": 2, "pp": 2}
+    hlo = "\n".join([
+        "x = f32[8]{0} all-reduce(f32[8] y), "
+        "replica_groups={{0,2},{1,3},{4,6},{5,7}}",
+        "z = f32[8]{0} all-gather(f32[4] w), replica_groups=[4,2]<=[8], "
+        "dimensions={0}",
+        "not_a_collective = f32[8]{0} add(f32[8] a, f32[8] b)",
+    ])
+    groups = C.hlo_group_breakdown(hlo, axes)
+    assert set(groups) == {"tp", "pp"}
+    flat_total = sum(w for _, _, w in C.hlo_collective_ops(hlo, 8))
+    assert sum(d["wire_bytes"] for d in groups.values()) == flat_total
+    assert groups["tp"]["all-reduce"] == groups["tp"]["wire_bytes"]
+
+
+# --------------------------------------------------------------------- #
+# composed pipeline trainer                                               #
+# --------------------------------------------------------------------- #
+def _lm_model():
+    return TransformerLM(TransformerConfig(
+        vocab_size=64, d_model=32, n_layers=4, n_heads=4, d_ff=64,
+        max_len=16, dropout=0.0))
+
+
+def _lm_data(seed=0, batch=8):
+    rng = np.random.RandomState(seed)
+    tok = rng.randint(0, 64, (batch, 16)).astype(np.int32)
+    return tok, np.roll(tok, -1, axis=1).astype(np.int32)
+
+
+def _run_pipeline(steps=3, optim=None, axes=None, **kw):
+    tok, tgt = _lm_data()
+    mesh = mesh_lib.create_mesh(axes or {"dp": 2, "pp": 2})
+    tr = PipelineLMTrainer(_lm_model(),
+                           optim or SGD(learning_rate=0.1), mesh,
+                           n_microbatches=4, seed=3, **kw).init()
+    losses = [float(tr.step(tok, tgt)) for _ in range(steps)]
+    return losses, tr.merge(), tr
+
+
+def _assert_leaves_bitwise(a, b):
+    for x, y in zip(jax.tree_util.tree_leaves(a),
+                    jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+@pytest.mark.slow
+def test_pipeline_zero1_sgd_bitwise_and_bucketed_bitwise():
+    """zero1 scatter+sharded-update+gather over the dp axis of the
+    pp-sharded model, and the bucketed fp32 dp exchange, are the SAME
+    fp program as the pmean path on XLA CPU — bitwise, the taxonomy's
+    strongest class."""
+    base_l, base_p, _ = _run_pipeline()
+    z1_l, z1_p, _ = _run_pipeline(zero1=True)
+    assert z1_l == base_l
+    _assert_leaves_bitwise(base_p, z1_p)
+    bk_l, bk_p, _ = _run_pipeline(bucket_bytes=1 << 16)
+    assert bk_l == base_l
+    _assert_leaves_bitwise(base_p, bk_p)
+    # zero1 + fused SGD kernel: still bitwise (PR-8 kernel discipline)
+    zf_l, zf_p, _ = _run_pipeline(zero1=True, fused_optim=True)
+    assert zf_l == base_l
+    _assert_leaves_bitwise(base_p, zf_p)
+
+
+@pytest.mark.slow
+def test_pipeline_zero1_adam_matches_and_moments_are_sharded():
+    """Adam under composed zero1: trajectory matches the plain pp×dp
+    path, and the sharding METADATA proves the memory claim — block
+    moments live P(('pp','dp')) at 1/(pp·dp) per device, rest moments
+    P('dp') at 1/dp."""
+    base_l, base_p, _ = _run_pipeline(optim=Adam(1e-3))
+    z1_l, z1_p, tr = _run_pipeline(optim=Adam(1e-3), zero1=True)
+    np.testing.assert_allclose(z1_l, base_l, rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(base_p),
+                    jax.tree_util.tree_leaves(z1_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    for leaf in jax.tree_util.tree_leaves(tr.opt_state["blocks"]):
+        if leaf.ndim == 0:
+            continue
+        assert leaf.sharding.spec == P(("pp", "dp"))
+        per_dev = max(s.data.size for s in leaf.addressable_shards)
+        assert per_dev * 4 == leaf.size        # 1/(pp2·dp2)
+    for leaf in jax.tree_util.tree_leaves(tr.opt_state["rest"]):
+        if leaf.ndim == 0:
+            continue
+        assert leaf.sharding.spec == P("dp")
+        per_dev = max(s.data.size for s in leaf.addressable_shards)
+        assert per_dev * 2 == leaf.size        # 1/dp2
+
+
+@pytest.mark.slow
+def test_pipeline_overlap_chunks_and_fp16_are_ulp_class():
+    """Overlap-chunked accumulation and fp16 wire compression
+    reassociate/round: same math, tight-allclose — and the full
+    composed roofline stack (zero1+buckets+fp16+fused+overlap) trains
+    to the same curve."""
+    base_l, base_p, _ = _run_pipeline()
+    ov_l, ov_p, _ = _run_pipeline(overlap_grad_chunks=2)
+    np.testing.assert_allclose(ov_l, base_l, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(base_p),
+                    jax.tree_util.tree_leaves(ov_p)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    full_l, _, _ = _run_pipeline(zero1=True, bucket_bytes=1 << 16,
+                                 compress="fp16", fused_optim=True,
+                                 overlap_grad_chunks=2)
+    np.testing.assert_allclose(full_l, base_l, rtol=2e-3, atol=1e-3)
+
+
+@pytest.mark.slow
+def test_pipeline_group_accounting_and_scoped_health():
+    """The composed step's telemetry: dp-group scatter/gather + pp-group
+    psum land in comm/group.<axis>.*, fp16 halves exactly the dp
+    scatter wire bytes, and the health/clip norms psum over the right
+    axis groups (grad_norm == clip_norm after an active clip)."""
+    tok, tgt = _lm_data()
+    mesh = mesh_lib.create_mesh("dp2,pp2")
+    rec = Recorder()
+    tr = PipelineLMTrainer(_lm_model(), SGD(learning_rate=0.1), mesh,
+                           n_microbatches=4, seed=3, zero1=True,
+                           compress="fp16", clip_norm=0.5,
+                           overlap_grad_chunks=2)
+    tr.set_telemetry(rec)
+    tr.init()
+    for _ in range(2):
+        tr.step(tok, tgt)
+    g = rec.snapshot()["gauges"]
+    # dp scatter ships EXACTLY half the raw bytes (fp16 wire)
+    assert g["comm/group.dp.reduce_scatter_wire_bytes"] * 2 == \
+        g["comm/group.dp.reduce_scatter_bytes"]
+    # param gather is uncompressed by design
+    assert g["comm/group.dp.allgather_wire_bytes"] == \
+        g["comm/group.dp.allgather_bytes"]
+    # the pp-group rest-grad combine is its own family
+    assert g["comm/group.pp.allreduce_wire_bytes"] > 0
+    assert g["comm/group.dp.wire_bytes_per_step"] > 0
+    rec_step = rec.recent_records(rec_type="step")[-1]
+    # clip is ACTIVE at 0.5 on this model: the scoped global grad norm
+    # (rest psum'd over dp, blocks over dp×pp) comes back as exactly
+    # the clip threshold on every device
+    np.testing.assert_allclose(rec_step["scalars"]["grad_norm"], 0.5,
+                               rtol=1e-5)
+    assert rec_step["scalars"]["nonfinite_grads"] == 0.0
+    assert rec_step["scalars"]["update_norm"] > 0
+
+
+@pytest.mark.slow
+def test_spmd_zero1_annotation_on_tp_sharded_model():
+    """zero1 on the GSPMD engine (arXiv:2004.13336 by annotation): on
+    dp4×tp2 the Adam moments of the tp-sharded model carry a 'dp' dim
+    in their sharding metadata — 1/(dp·tp) bytes per device — while
+    the trajectory stays within the taxonomy's ulp class of the
+    unannotated run, and the HLO per-group breakdown attributes dp and
+    tp volume separately."""
+    from bigdl_tpu.models import transformer as T
+
+    def build():
+        return T.build("tiny", dropout=0.0, n_layers=2, d_model=64,
+                       n_heads=2, d_ff=128, vocab_size=64, max_len=32)
+
+    from bigdl_tpu.parallel.spmd import SpmdTrainer
+    rng = np.random.RandomState(0)
+    tok = rng.randint(0, 64, (8, 17))
+    x, y = tok[:, :-1], tok[:, 1:]
+
+    tr = SpmdTrainer(build(), Adam(1e-3),
+                     mesh=mesh_lib.create_mesh("dp4,tp2"), fsdp=False,
+                     seed=0, zero1=True, zero1_min_size=0)
+    tr.init()
+    z1_l = [float(tr.step(x, y)) for _ in range(3)]
+    tot = per = 0
+    sharded_over_dp = 0
+    for path, leaf in jax.tree_util.tree_flatten_with_path(
+            tr.opt_state)[0]:
+        if leaf.ndim == 0:
+            continue
+        tot += leaf.size
+        per += max(s.data.size for s in leaf.addressable_shards)
+        if "dp" in jax.tree_util.tree_leaves(
+                tuple(leaf.sharding.spec)):
+            sharded_over_dp += 1
+    assert sharded_over_dp > 0
+    # 1/(dp4·tp2) per device, up to the few odd-dim leaves whose free
+    # dims don't divide (they stay at their param's tp-only layout)
+    assert per / tot < 1 / 8 + 0.01, (per, tot)
+
+    ref = SpmdTrainer(build(), Adam(1e-3),
+                      mesh=mesh_lib.create_mesh("dp4,tp2"), fsdp=False,
+                      seed=0)
+    ref.init()
+    ref_l = [float(ref.step(x, y)) for _ in range(3)]
+    np.testing.assert_allclose(z1_l, ref_l, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(tr.params),
+                    jax.tree_util.tree_leaves(ref.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    res = tr.account_collectives(x, y)
+    assert "dp" in res["groups"] and "tp" in res["groups"]
+    assert res["groups"]["dp"]["wire_bytes"] > 0
+    assert res["groups"]["tp"]["wire_bytes"] > 0
+    # the recorder carries the same families for /metrics + trace_summary
+    assert tr._rec() is not None
+    tr.detach()
+    ref.detach()
+
+
+def test_spmd_zero1_requires_dp():
+    from bigdl_tpu.parallel.spmd import SpmdTrainer
+    with pytest.raises(ValueError, match="dp > 1"):
+        SpmdTrainer(_lm_model(), Adam(1e-3),
+                    mesh=mesh_lib.create_mesh({"tp": 2}), zero1=True)
+
+
+# --------------------------------------------------------------------- #
+# trace_summary per-group table                                           #
+# --------------------------------------------------------------------- #
+def test_trace_summary_comm_group_table_golden(tmp_path):
+    import trace_summary as ts
+    rec = {"type": "step", "step": 7,
+           "gauges": {"collective/allreduce_bytes": 2048.0,
+                      "collective/allreduce_wire_bytes": 1024.0,
+                      "collective/bytes_per_step": 2048.0,
+                      "collective/wire_bytes_per_step": 1024.0,
+                      "comm/group.dp.reduce_scatter_bytes": 4096.0,
+                      "comm/group.dp.reduce_scatter_wire_bytes": 2048.0,
+                      "comm/group.dp.allgather_bytes": 4096.0,
+                      "comm/group.dp.allgather_wire_bytes": 4096.0,
+                      "comm/group.dp.wire_bytes_per_step": 6144.0,
+                      "comm/group.dp.buckets": 6.0,
+                      "comm/group.ep.all_to_all_bytes": 512.0,
+                      "comm/group.ep.all_to_all_wire_bytes": 512.0,
+                      "comm/group.ep.wire_bytes_per_step": 512.0,
+                      "comm/group.pp.allreduce_bytes": 256.0,
+                      "comm/group.pp.allreduce_wire_bytes": 256.0,
+                      "comm/group.pp.wire_bytes_per_step": 256.0},
+           "counters": {"collective/bytes_total": 2048.0,
+                        "collective/wire_bytes_total": 1024.0}}
+    f = tmp_path / "t.jsonl"
+    f.write_text(json.dumps(rec) + "\n")
+    steps, _ = ts.load_steps(str(f))
+    buf = io.StringIO()
+    ts.summarize_comm(steps, out=lambda *a: print(*a, file=buf))
+    text = buf.getvalue()
+    assert "per-axis-group exchange" in text
+    # one row per (group, op), compression visible per group
+    assert "dp       reduce_scatter" in text and "0.50x" in text
+    assert "dp       allgather" in text
+    assert "ep       all_to_all" in text
+    assert "pp       allreduce" in text
+    # group totals + the dp bucket stream count
+    assert "6.0 KB" in text and "(6 buckets/step)" in text
+    # groups render in sorted order: dp before ep before pp
+    assert text.index("dp       reduce_scatter") \
+        < text.index("ep       all_to_all") \
+        < text.index("pp       allreduce")

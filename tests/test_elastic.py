@@ -98,6 +98,63 @@ def test_plan_mesh_partial_pool_shares():
         [4, 4, 4]
 
 
+def test_plan_mesh_axis_costs_on_4_axis_templates():
+    """The composed-mesh shrink policy: device-count ties break by
+    per-axis shrink COST, so a preempted 4-axis job sheds the cheapest
+    viable axis — never the divisor-greedy choice of whichever axis
+    happens to divide first."""
+    from bigdl_tpu.elastic.plan import AXIS_SHRINK_COST, shrink_cost
+    # 16-device template on 8 survivors: dp (cost 1/halving) is the
+    # one axis shrunk; fsdp/tp/pp stay whole
+    assert plan_mesh(8, {"dp": 2, "fsdp": 2, "tp": 2, "pp": 2}) == \
+        {"dp": 1, "fsdp": 2, "tp": 2, "pp": 2}
+    # on 4 survivors: dp gone AND fsdp halved (next-cheapest), tp/pp
+    # untouched — 1*1 + 2*1 = 3, vs e.g. dropping pp at cost 8
+    assert plan_mesh(4, {"dp": 2, "fsdp": 2, "tp": 2, "pp": 2}) == \
+        {"dp": 1, "fsdp": 1, "tp": 2, "pp": 2}
+    # the ISSUE-14 acceptance shape: dp4×tp2 on half capacity resumes
+    # dp2×tp2 (shrink dp), not dp4×tp1 (a tp re-partition)
+    assert plan_mesh(4, {"dp": 4, "tp": 2}) == {"dp": 2, "tp": 2}
+    # custom costs invert the preference per job...
+    assert plan_mesh(4, {"dp": 4, "tp": 2},
+                     axis_costs={"tp": 0.1}) == {"dp": 4, "tp": 1}
+    # ...but min_axes floors still gate whatever the costs say
+    assert plan_mesh(4, {"dp": 4, "tp": 2}, {"tp": 2},
+                     axis_costs={"tp": 0.1}) == {"dp": 2, "tp": 2}
+    # ep shrinks like pp (whole-expert moves), cheaper than tp
+    assert plan_mesh(4, {"dp": 2, "ep": 2, "tp": 2}) == \
+        {"dp": 1, "ep": 2, "tp": 2}
+    assert plan_mesh(2, {"ep": 2, "tp": 2}) == {"ep": 1, "tp": 2}
+    # the cost function itself: log2-per-halving, weighted
+    assert shrink_cost({"dp": 4, "tp": 2}, {"dp": 2, "tp": 2}) == 1.0
+    assert shrink_cost({"dp": 4, "tp": 2}, {"dp": 4, "tp": 1}) == \
+        AXIS_SHRINK_COST["tp"]
+    assert shrink_cost({"dp": 4}, {"dp": 4}) == 0.0
+
+
+def test_plan_mesh_cost_ties_with_non_contiguous_survivors():
+    """Cost tie-breaks stay deterministic on arbitrary survivor sets:
+    two jobs replanning over DIFFERENT scattered device subsets of the
+    same size land on the same mesh shape, and the plan consumes a
+    deterministic prefix of whatever subset it was handed."""
+    from bigdl_tpu.elastic import plan_devices
+    devs = jax.devices()
+    share_a = [devs[0], devs[3], devs[5], devs[6]]
+    share_b = [devs[7], devs[2], devs[1], devs[4]]
+    t = {"dp": 2, "fsdp": 2, "tp": 2}
+    plan_a = plan_mesh(len(share_a), t)
+    plan_b = plan_mesh(len(share_b), t)
+    assert plan_a == plan_b == {"dp": 1, "fsdp": 2, "tp": 2}
+    assert plan_devices(plan_a, share_a) == share_a
+    assert plan_devices(plan_b, share_b) == share_b
+    # flat custom costs make EVERY single-axis halving equal cost: the
+    # deterministic last-resort tie-break (keep late-priority axes
+    # whole) must still produce one answer
+    flat = {k: 1.0 for k in t}
+    assert plan_mesh(4, t, axis_costs=flat) == \
+        plan_mesh(4, t, axis_costs=flat) == {"dp": 1, "fsdp": 2, "tp": 2}
+
+
 def test_plan_devices_non_contiguous_subsets():
     """The fleet hands jobs arbitrary (non-prefix, non-contiguous)
     device subsets; plans must take a deterministic prefix OF THAT
@@ -147,6 +204,39 @@ def test_explain_shape_delta_names_the_axis():
                                        target) is None
     assert reshard.explain_shape_delta((4, 6), (16, 6), None,
                                        target) is None
+
+
+def test_explain_shape_delta_tp_mismatch_is_actionable():
+    """A tp-size mismatch on a 4-axis mesh must say it is a
+    model-parallel partition SLICE (re-partitioned tensors), not the
+    dp/fsdp 'per-host local array' wording — the axis KIND drives the
+    advice an operator acts on."""
+    saved = {"axes": [["dp", 2], ["tp", 4]], "devices": 8,
+             "processes": 1}
+    target = {"axes": [["dp", 2], ["tp", 4]], "devices": 8,
+              "processes": 1}
+    # dim 1 off by exactly tp=4 (unique to tp): a per-shard tp slice
+    why = reshard.explain_shape_delta((64, 8), (64, 32), saved, target)
+    assert why and "model-parallel" in why and "'tp'" in why \
+        and "SLICE" in why and "per-host LOCAL" not in why
+    # factor 2 matches dp only → the local-array wording
+    why_dp = reshard.explain_shape_delta((16, 32), (32, 32), saved,
+                                         target)
+    assert why_dp and "per-host LOCAL array" in why_dp \
+        and "SLICE" not in why_dp
+    # ambiguous factor on an all-size-2 composed mesh: BOTH readings
+    # named (the fix is the same either way)
+    four = {"axes": [["dp", 2], ["fsdp", 2], ["tp", 2], ["pp", 2]],
+            "devices": 16, "processes": 1}
+    why_both = reshard.explain_shape_delta((64, 16), (64, 32), four,
+                                           four)
+    assert why_both and "per-host LOCAL" in why_both \
+        and "SLICE" in why_both
+    # the 4-axis delta renders every changed axis readably
+    shrunk = {"axes": [["dp", 1], ["fsdp", 2], ["tp", 2], ["pp", 2]],
+              "devices": 8, "processes": 1}
+    d = reshard.describe_delta(four, shrunk)
+    assert "dp 2→1" in d and "16→8" in d
 
 
 # --------------------------------------------------------------------- #
@@ -341,6 +431,37 @@ def test_ckpt_inspect_json_modes(tmp_path):
     doc = json.loads(p.stdout.strip().splitlines()[-1])
     assert doc["tag"] == "step_2" and doc["shards"] == 1
     assert doc["shard_table"][0]["name"] == "params/fc"
+
+    # describe --target-mesh: the composed-mesh reshard preview — the
+    # shared delta wording plus a per-axis line classifying each change
+    # as a cheap data re-layout vs an expensive model re-partition
+    p = _inspect("describe", str(tmp_path), "--target-mesh",
+                 "dp2,tp2")
+    assert p.returncode == 0, p.stdout
+    assert "delta:" in p.stdout and "dp 4→2" in p.stdout
+    assert "dp: 4 -> 2" in p.stdout
+    assert "data-parallel re-layout (cheap" in p.stdout
+    assert "tp: 1 -> 2" in p.stdout
+    assert "model-parallel RE-PARTITION (expensive" in p.stdout
+    p = _inspect("describe", str(tmp_path), "--target-mesh", "dp2,tp2",
+                 "--json")
+    doc = json.loads(p.stdout.strip().splitlines()[-1])
+    assert reshard.mesh_axes(doc["target_mesh"]) == {"dp": 2, "tp": 2}
+    assert "dp 4→2" in doc["target_delta"]
+    # same topology: says so instead of inventing a delta table
+    p = _inspect("describe", str(tmp_path), "--target-mesh", "dp4")
+    assert "same topology" in p.stdout
+    # unparseable spec fails loudly
+    p = _inspect("describe", str(tmp_path), "--target-mesh", "nope")
+    assert p.returncode != 0 and "unparseable" in p.stdout
+    # a typo'd axis/size/duplicate must not render a confident bogus
+    # delta
+    p = _inspect("describe", str(tmp_path), "--target-mesh", "dp2,ttp2")
+    assert p.returncode != 0 and "unknown axis 'ttp'" in p.stdout
+    p = _inspect("describe", str(tmp_path), "--target-mesh", "dp0")
+    assert p.returncode != 0 and "size 0" in p.stdout
+    p = _inspect("describe", str(tmp_path), "--target-mesh", "dp2,dp4")
+    assert p.returncode != 0 and "duplicate axis" in p.stdout
 
     # deep verify: intact tree fails rc=1 because of the torn dir...
     p = _inspect("verify", str(tmp_path), "--json")
