@@ -20,6 +20,10 @@ Sites (the stable names tests and operators use)::
     serving.compute     one serving batch execution (delay = a wedged
                         replica, err = a failing one — what the
                         replica-set failover chaos legs arm)
+    serving.decode_step one continuous-batching decode step (delay = a
+                        wedged decode step, err = live requests fail
+                        and a ReplicaSet fails them over — the
+                        decode-smoke chaos leg arms this)
     serving.publish     the canary publisher's staging step (the
                         swap onto the canary replica)
     http.bind           introspection-server socket bind
@@ -77,8 +81,8 @@ KILL_EXIT_CODE = 42
 
 SITES = ("ckpt.shard_write", "ckpt.manifest", "data.shard_open",
          "data.record_read", "serving.swap", "serving.compute",
-         "serving.publish", "http.bind", "step.dispatch",
-         "fleet.place", "fleet.preempt")
+         "serving.decode_step", "serving.publish", "http.bind",
+         "step.dispatch", "fleet.place", "fleet.preempt")
 
 _MODES = ("err", "delay", "corrupt", "kill")
 

@@ -78,6 +78,24 @@ def apply_rope(x, positions, theta: float = 10000.0):
     return y.astype(x.dtype)
 
 
+def apply_rope_rows(x, positions, theta: float = 10000.0):
+    """:func:`apply_rope` with a PER-ROW position: x (B, H, 1, D),
+    positions (B,) — the continuous-batching decode shape, where every
+    batch row (slot) sits at its own global offset.  Same op sequence as
+    :func:`apply_rope` (freqs → angles → cos/sin → rotate) so a row here
+    is bitwise the row ``apply_rope`` would produce at that position."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # (B, D/2)
+    cos = jnp.cos(angles)[:, None, None, :]          # (B, 1, 1, D/2)
+    sin = jnp.sin(angles)[:, None, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    y1 = x1 * cos - x2 * sin
+    y2 = x1 * sin + x2 * cos
+    y = jnp.stack([y1, y2], axis=-1).reshape(x.shape)
+    return y.astype(x.dtype)
+
+
 class TokenEmbedding(Module):
     """0-based token embedding, vocab-sharded over tp (P('tp', None))
     and EXEMPT from fsdp layering (fsdp_exempt) — the weight is
@@ -204,6 +222,59 @@ class MultiHeadAttention(Module):
         o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, s, cfg.d_model)
         return jnp.dot(o, p["wo"].astype(dt)), {"k": ck, "v": cv}
 
+    # -- continuous-batching decode (per-row positions) ----------------- #
+    def project_qkv_rows(self, params, x, positions):
+        """Projections for ONE new token per batch row at per-row global
+        offsets: x (B, 1, d_model), positions (B,).  Returns q, k, v
+        each (B, H, 1, Dh) with RoPE applied to q/k at ``positions[b]``
+        — the slot-batched half of :meth:`apply_cached`, split out so a
+        paged KV cache can own the write/gather in between."""
+        cfg = self.cfg
+        p = self.own(params)
+        b = x.shape[0]
+        dt = x.dtype
+
+        def proj(w):
+            y = jnp.dot(x, w.astype(dt))
+            y = y.reshape(b, 1, cfg.n_heads, cfg.head_dim)
+            return jnp.transpose(y, (0, 2, 1, 3))        # (B, H, 1, Dh)
+
+        q = apply_rope_rows(proj(p["wq"]), positions, cfg.rope_theta)
+        k = apply_rope_rows(proj(p["wk"]), positions, cfg.rope_theta)
+        v = proj(p["wv"])
+        return q, k, v
+
+    def attend_window(self, params, q, k_win, v_win, positions):
+        """Single-token attention of q (B, H, 1, Dh) against an
+        externally gathered window k_win/v_win (B, H, W, Dh) — the
+        other half of :meth:`apply_cached`, with the same einsum /
+        scale / mask-value / softmax sequence so logits stay bitwise
+        comparable to the contiguous-cache path.  ``positions`` (B,)
+        is each row's token index; keys at ``k_pos > positions[b]``
+        (unwritten or other slots' future) are masked out."""
+        cfg = self.cfg
+        p = self.own(params)
+        b = q.shape[0]
+        dt = q.dtype
+        k_pos = jnp.arange(k_win.shape[2])
+        s_ = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                        k_win.astype(jnp.float32)) / np.sqrt(cfg.head_dim)
+        # same semantics as _attn_mask(positions, k_pos, pos+1, True)
+        # per row: causal (k <= q) subsumes the kv_len bound at s=1
+        mask = k_pos[None, :] <= positions[:, None]          # (B, W)
+        s_ = jnp.where(mask[:, None, None, :], s_, DEFAULT_MASK_VALUE)
+        w_ = jax.nn.softmax(s_, axis=-1)
+        # masked weights are exactly 0, but 0 * NaN = NaN: a recycled
+        # KV page can hold non-finite rows from a poisoned/rejected
+        # publication, and they must not leak through the value sum —
+        # scrub masked V rows (a no-op for finite stale data, so the
+        # bitwise parity with the contiguous path is preserved)
+        v_ = jnp.where(mask[:, None, :, None],
+                       v_win.astype(jnp.float32), 0.0)
+        o = jnp.einsum("bhqk,bhkd->bhqd", w_, v_).astype(dt)
+        o = jnp.transpose(o, (0, 2, 1, 3)).reshape(b, 1, cfg.d_model)
+        return jnp.dot(o, p["wo"].astype(dt))
+
 
 class SwiGLU(Module):
     """Gated MLP: (silu(x w1) * x w3) w2 — two column-sharded matmuls in,
@@ -272,6 +343,20 @@ class TransformerBlock(Module):
         h = x + a
         return h + self.mlp.apply(params, self.norm2.apply(params, h, ctx),
                                   ctx), cache
+
+    def apply_decode(self, params, x, ctx, positions, kv_io):
+        """Slot-batched single-token decode: x (B, 1, d_model),
+        positions (B,).  ``kv_io(attn_name, k_new, v_new) ->
+        (k_win, v_win)`` is the paged-KV seam — it writes this token's
+        k/v rows into the cache and returns the gathered attention
+        window (which must already contain the rows just written, the
+        same update-then-attend order :meth:`apply_cached` uses)."""
+        h = self.norm1.apply(params, x, ctx)
+        q, k, v = self.attn.project_qkv_rows(params, h, positions)
+        k_win, v_win = kv_io(self.attn.name, k, v)
+        h = x + self.attn.attend_window(params, q, k_win, v_win, positions)
+        return h + self.mlp.apply(params, self.norm2.apply(params, h, ctx),
+                                  ctx)
 
     def _drop(self, x, ctx):
         rate = self.cfg.dropout
@@ -425,6 +510,27 @@ class TransformerLM(Module):
             w = params[self.embed.name]["weight"]
             logits = jnp.dot(h, w.T.astype(h.dtype))
         return logits.astype(jnp.float32), new_cache
+
+    def decode_tokens(self, params, tokens, positions, kv_io):
+        """Continuous-batching decode core: one new token per slot.
+
+        ``tokens`` (B,) int32 are each slot's freshly emitted token,
+        ``positions`` (B,) its global index (== the slot's current
+        sequence length), and ``kv_io(attn_name, k_new, v_new) ->
+        (k_win, v_win)`` the paged-cache write/gather seam (see
+        :meth:`TransformerBlock.apply_decode`).  Returns fp32 logits
+        (B, V) for each slot's NEXT position.  Unlike
+        :meth:`apply_with_cache` every batch row advances at its own
+        offset, which is what lets a serving engine admit/retire
+        requests per decode step instead of per batch."""
+        cfg = self.cfg
+        ctx = Ctx(state={}, training=False, rng_key=None)
+        h = self.embed.apply(params, tokens[:, None], ctx)
+        h = h.astype(jnp.dtype(cfg.dtype))
+        for blk in self.blocks:
+            h = blk.apply_decode(params, h, ctx, positions, kv_io)
+        h = self.final_norm.apply(params, h, ctx)
+        return self.head_logits(params, h, ctx)[:, 0].astype(jnp.float32)
 
     def generate(self, params, prompt, max_new_tokens: int,
                  temperature: float = 0.0, rng=None,

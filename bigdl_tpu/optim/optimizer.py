@@ -460,6 +460,16 @@ class Optimizer:
         self.train_summary = summary
         return self
 
+    def set_weight_stream(self, publisher):
+        """Attach a live train→serve weight stream
+        (:class:`~bigdl_tpu.serving.WeightStreamPublisher`): its
+        trigger is evaluated per iteration and, on fire, the current
+        params are snapshotted (owning copies — the next step donates
+        the live buffers) and published to the serving target through
+        the canary gate.  ``None`` detaches."""
+        self._weight_stream = publisher
+        return self
+
     def set_val_summary(self, summary):
         self.val_summary = summary
         return self
@@ -1255,6 +1265,11 @@ class Optimizer:
                                    type(Trigger.every_epoch()))
                 and self.checkpoint_trigger(st)):
             self.save_checkpoint(params, opt_state, model_state)
+        stream = getattr(self, "_weight_stream", None)
+        if stream is not None:
+            # snapshot happens synchronously inside (owning copies);
+            # the publish itself rides the stream's worker thread
+            stream.maybe_publish(params, state=st)
         return (not isinstance(self.end_when, type(Trigger.max_epoch(1)))
                 and self.end_when(st))
 

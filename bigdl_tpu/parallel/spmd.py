@@ -967,6 +967,16 @@ class SpmdTrainer:
             if name != pointed:  # never delete the snapshot 'latest' names
                 shutil.rmtree(full, ignore_errors=True)
 
+    def set_weight_stream(self, publisher):
+        """Attach a live train→serve weight stream
+        (:class:`~bigdl_tpu.serving.WeightStreamPublisher`): evaluated
+        once per ``fit`` step against the global step count; on fire
+        the sharded params are snapshotted to owning host copies and
+        published through the canary gate off the step loop.  ``None``
+        detaches."""
+        self._weight_stream = publisher
+        return self
+
     def set_val_summary(self, summary):
         """ValidationSummary target for :meth:`evaluate` results (≙
         Optimizer.set_val_summary): each evaluate() writes Loss and
@@ -1057,6 +1067,14 @@ class SpmdTrainer:
                         # manifest layout: retention runs in the
                         # manager's own GC on the writer thread
                         self._prune_checkpoints(ckpt[0], ckpt[2])
+                stream = getattr(self, "_weight_stream", None)
+                if stream is not None:
+                    # owning host snapshot taken synchronously (the
+                    # next step donates params); publish rides the
+                    # stream worker.  loss stays on device — the shim
+                    # state only carries the step count
+                    stream.maybe_publish(self.params,
+                                         step=self._step_count)
                 losses.append(loss)
                 if summary is not None:
                     tokens_seen += int(np.prod(np.shape(tokens)))

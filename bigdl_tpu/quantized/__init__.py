@@ -44,6 +44,27 @@ def quantize_weights_symmetric(w: np.ndarray, axis: int = 0):
     return q, scale.astype(np.float32)
 
 
+def quantize_rows(x, axis: int = -1):
+    """In-graph (jnp) twin of :func:`quantize_weights_symmetric`:
+    symmetric int8 with a per-channel fp32 scale over ``axis``
+    (keepdims, so the dequant multiply broadcasts).  Used by the paged
+    KV cache's int8 option (``serving/kvcache.py``), where "channel" is
+    one (head, position) row of head_dim values and the scale must be
+    computed inside the jitted decode step."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.maximum(jnp.max(jnp.abs(xf), axis=axis, keepdims=True),
+                         1e-8)
+    scale = (absmax / 127.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rows(q, scale, dtype=jnp.float32):
+    """Jittable inverse of :func:`quantize_rows` (int8 × broadcast
+    scale → ``dtype``)."""
+    return q.astype(dtype) * scale.astype(dtype)
+
+
 def _quantize_activations(x, absmax=None):
     """Per-tensor symmetric int8, computed in-graph (runtime quantization,
     ≙ quantized Linear.scala updateOutput's input quantization)."""

@@ -18,6 +18,13 @@ and an unexpected shape means a multi-second recompile:
     optionally through the int8 path), per-request deadline
     propagation, graceful drain, and full
     :class:`~bigdl_tpu.observability.Recorder` wiring.
+  * :class:`DecodeEngine` + :class:`PagedKVCache` — token-streaming
+    continuous batching for LMs: requests join/leave the decode batch
+    per step, the KV cache is paged from a device pool (LRU eviction +
+    re-prefill, optional int8), per-token TTFT/inter-token SLO
+    accounting.
+  * :class:`WeightStreamPublisher` — Trigger-fired live train→serve
+    weight streaming through the canary gate.
 
 Quick start::
 
@@ -36,19 +43,24 @@ See ``docs/serving.md`` for architecture and tuning, and
 from __future__ import annotations
 
 from .buckets import BucketLadder
+from .decode import DecodeEngine, DecodeStream, build_decode_replica_set
 from .engine import ServingEngine
+from .kvcache import PagedKVCache, PagePoolError
 from .queue import (BatchingQueue, EngineClosedError, LoadShedError,
                     Request)
 from .registry import ModelEntry, ModelRegistry, Snapshot
 from .replicas import (CanaryPublisher, CanaryRejectedError,
                        NoHealthyReplicaError, OverloadController,
                        ReplicaSet, build_replica_set)
+from .stream import WeightStreamPublisher
 
 __all__ = [
     "BucketLadder", "BatchingQueue", "Request",
     "LoadShedError", "EngineClosedError",
     "ModelRegistry", "ModelEntry", "Snapshot",
     "ServingEngine",
+    "DecodeEngine", "DecodeStream", "PagedKVCache", "PagePoolError",
+    "build_decode_replica_set", "WeightStreamPublisher",
     "ReplicaSet", "CanaryPublisher", "OverloadController",
     "CanaryRejectedError", "NoHealthyReplicaError",
     "build_replica_set",

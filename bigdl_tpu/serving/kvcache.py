@@ -1,0 +1,307 @@
+"""Paged KV cache for continuous-batching decode.
+
+The contiguous ``TransformerLM.init_cache`` layout allocates
+``max_len`` key/value rows per sequence up front — fine for a fixed
+batch of equal-length generations, hopeless for a serving mix where a
+12-token answer and a 900-token answer share the batch: the short
+request strands ``max_len - 12`` rows of HBM for its whole lifetime.
+
+Here the cache is a device-resident **pool of fixed-size pages**
+(``page_size`` token rows each, one pool per attention layer) plus a
+host-side allocator.  Each slot (one running request) owns an ordered
+page table; a request's KV footprint is ``ceil(len / page_size)``
+pages and grows one page at a time as it decodes.  The jitted decode
+step never sees the allocator — it takes the page tables as a plain
+``(slots, max_pages)`` int32 input and:
+
+  * **writes** the new token's k/v rows at
+    ``(table[len // page_size], len % page_size)`` — a fixed-shape
+    scatter; dead slots carry table entries of ``-1``, whose writes
+    XLA **drops** (out-of-bounds scatter, ``mode="drop"``),
+  * **gathers** each slot's pages back into a contiguous attention
+    window ``(slots, heads, max_pages * page_size, head_dim)`` —
+    a fixed-shape gather; ``-1`` entries **fill** with zeros
+    (``mode="fill"``), exactly the zero rows an unwritten contiguous
+    cache would hold, which is what keeps paged logits bitwise equal
+    to the ``init_cache`` path (tests/test_decode.py pins this).
+
+Page tables are data, not shapes: admissions, retirements and
+evictions change *values* only, so one compiled decode program serves
+every batch composition — the zero-recompile discipline of the PR-2
+bucket ladder extended to the token-streaming path.
+
+``int8=True`` stores the pool as int8 with a per-(page, position,
+head) fp32 scale over the head_dim channel — the
+:func:`bigdl_tpu.quantized.quantize_rows` per-channel quantizer run
+inside the decode step — halving (vs bf16; 4x vs fp32) the KV bytes
+each decode step streams from HBM.  Drift is bounded and measured,
+never hidden (see docs/serving.md § Token streaming).
+
+Telemetry (``kv/*`` family, registered in docs/observability.md):
+``kv/page_allocs`` / ``kv/page_frees`` / ``kv/evictions`` counters,
+``kv/pages_in_use`` / ``kv/pool_fill`` / ``kv/peak_fill`` gauges.
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..observability import Recorder
+from ..quantized import dequantize_rows, quantize_rows
+
+
+class PagePoolError(RuntimeError):
+    """Allocator invariant violation (double free, foreign page)."""
+
+
+class PagedKVCache:
+    """Device page pool + host allocator + the jitted write/gather fns.
+
+    ``layer_names``   attention-module names (one k/v pool each)
+    ``n_heads`` / ``head_dim``  per-layer KV row geometry
+    ``n_pages``       pool size, in pages, shared by all slots
+    ``page_size``     token rows per page
+    ``n_slots``       concurrent sequences (page-table rows)
+    ``max_context``   longest sequence a slot may hold; rounded up to a
+                      page multiple; fixes the gather window
+                      ``max_pages_per_slot * page_size``
+    ``dtype``         pool dtype for the fp path (int8 path stores
+                      int8 + fp32 scales)
+    ``int8``          quantize KV rows on write, dequantize on gather
+
+    The allocator side (``alloc_for`` / ``free_slot``) is guarded by
+    one lock and keeps the invariant ``free + sum(owned) == n_pages``
+    with every page owned by at most one slot — tests/test_decode.py
+    asserts it across alloc/free/evict churn.
+    """
+
+    def __init__(self, layer_names: Sequence[str], *, n_heads: int,
+                 head_dim: int, n_pages: int, page_size: int = 16,
+                 n_slots: int = 8, max_context: int = 256,
+                 dtype=jnp.float32, int8: bool = False,
+                 recorder: Optional[Recorder] = None):
+        if page_size < 1 or n_pages < 1 or n_slots < 1:
+            raise ValueError("page_size, n_pages and n_slots must be >= 1")
+        self.layer_names = list(layer_names)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self.n_slots = int(n_slots)
+        self.max_pages_per_slot = math.ceil(max_context / page_size)
+        self.max_context = self.max_pages_per_slot * self.page_size
+        self.window = self.max_pages_per_slot * self.page_size
+        self.dtype = jnp.dtype(dtype)
+        self.int8 = bool(int8)
+        self.recorder = recorder if recorder is not None else Recorder(
+            annotate=False, enabled=False)
+        self._lock = threading.Lock()
+        # deterministic allocation order: lowest free page first
+        self._free: List[int] = list(range(self.n_pages))
+        self._owned: Dict[int, List[int]] = {s: [] for s in
+                                             range(self.n_slots)}
+        self.tables = np.full((self.n_slots, self.max_pages_per_slot),
+                              -1, np.int32)
+
+    # -- device pool ------------------------------------------------------ #
+    def init_pool(self):
+        """Zeroed device pool pytree: ``{layer: {"k", "v"[, "k_scale",
+        "v_scale"]}}`` with pages laid out ``(n_pages, page_size,
+        n_heads, head_dim)`` (scales ``(n_pages, page_size, n_heads,
+        1)``).  Zero pages read back as the zero rows of a fresh
+        contiguous cache."""
+        shape = (self.n_pages, self.page_size, self.n_heads, self.head_dim)
+        sshape = shape[:-1] + (1,)
+
+        def one():
+            if self.int8:
+                return {"k": jnp.zeros(shape, jnp.int8),
+                        "v": jnp.zeros(shape, jnp.int8),
+                        "k_scale": jnp.zeros(sshape, jnp.float32),
+                        "v_scale": jnp.zeros(sshape, jnp.float32)}
+            return {"k": jnp.zeros(shape, self.dtype),
+                    "v": jnp.zeros(shape, self.dtype)}
+
+        return {name: one() for name in self.layer_names}
+
+    # -- host allocator --------------------------------------------------- #
+    def pages_for(self, n_tokens: int) -> int:
+        return math.ceil(max(int(n_tokens), 0) / self.page_size)
+
+    def can_fit(self, n_tokens: int) -> bool:
+        with self._lock:
+            return self.pages_for(n_tokens) <= len(self._free)
+
+    def alloc_for(self, slot: int, n_tokens: int) -> bool:
+        """Grow ``slot``'s table to cover ``n_tokens`` token rows.
+        All-or-nothing: returns False (allocating nothing) when the
+        free list cannot cover the growth — the caller then evicts or
+        backpressures."""
+        need_pages = self.pages_for(n_tokens)
+        if need_pages > self.max_pages_per_slot:
+            raise ValueError(
+                f"slot {slot}: {n_tokens} tokens need {need_pages} pages "
+                f"> max_pages_per_slot {self.max_pages_per_slot} "
+                f"(max_context {self.max_context})")
+        with self._lock:
+            owned = self._owned[slot]
+            grow = need_pages - len(owned)
+            if grow <= 0:
+                return True
+            if grow > len(self._free):
+                return False
+            for _ in range(grow):
+                page = self._free.pop(0)
+                self.tables[slot, len(owned)] = page
+                owned.append(page)
+            self.recorder.inc("kv/page_allocs", grow)
+            self._publish_gauges_locked()
+            return True
+
+    def free_slot(self, slot: int, evict: bool = False) -> int:
+        """Return every page ``slot`` owns to the free list (retirement
+        or eviction); the table row resets to ``-1`` so in-flight
+        gathers read zeros and writes drop.  Returns the page count."""
+        with self._lock:
+            owned = self._owned[slot]
+            for page in owned:
+                if page in self._free:
+                    raise PagePoolError(
+                        f"double free: page {page} of slot {slot} is "
+                        "already on the free list")
+                self._free.append(page)
+            n = len(owned)
+            self._free.sort()
+            self._owned[slot] = []
+            self.tables[slot, :] = -1
+            if n:
+                self.recorder.inc("kv/page_frees", n)
+            if evict:
+                self.recorder.inc("kv/evictions")
+            self._publish_gauges_locked()
+            return n
+
+    def pages_in_use(self) -> int:
+        with self._lock:
+            return self.n_pages - len(self._free)
+
+    def fill(self) -> float:
+        """Pool fill fraction in [0, 1] — the ``kv/pool_fill`` gauge."""
+        with self._lock:
+            return (self.n_pages - len(self._free)) / self.n_pages
+
+    def check_invariants(self):
+        """Every page owned at most once and free+owned == n_pages
+        (test seam; raises :class:`PagePoolError` on violation)."""
+        with self._lock:
+            seen = list(self._free)
+            for slot, owned in self._owned.items():
+                seen += owned
+                for i, page in enumerate(owned):
+                    if self.tables[slot, i] != page:
+                        raise PagePoolError(
+                            f"table/ledger disagree at slot {slot}[{i}]")
+            if sorted(seen) != list(range(self.n_pages)):
+                raise PagePoolError(
+                    f"page ledger broken: {sorted(seen)} != "
+                    f"0..{self.n_pages - 1}")
+
+    def _publish_gauges_locked(self):
+        used = self.n_pages - len(self._free)
+        rec = self.recorder
+        rec.gauge("kv/pages_in_use", used)
+        fill = used / self.n_pages
+        rec.gauge("kv/pool_fill", fill)
+        if fill > rec.gauge_value("kv/peak_fill", 0.0):
+            rec.gauge("kv/peak_fill", fill)
+
+    # -- jitted write/gather (fixed shapes, traced) ------------------------ #
+    def _oob(self, idx):
+        """Map the host tables' ``-1`` free markers to ``n_pages`` —
+        genuinely out of bounds.  jax scatter/gather WRAP negative
+        indices (numpy semantics) *before* the drop/fill bounds check,
+        so a raw ``-1`` would silently alias the pool's LAST page: a
+        dead slot's write clobbered whichever request owned it.  A
+        positive out-of-range index is what ``mode="drop"`` /
+        ``mode="fill"`` actually drop/fill."""
+        return jnp.where(idx < 0, self.n_pages, idx)
+
+    def gather_window(self, layer_pool, tables):
+        """(k_win, v_win) each ``(slots, heads, window, head_dim)``
+        gathered from ``layer_pool`` through ``tables`` (slots,
+        max_pages); ``-1`` entries fill with zeros.  Pages concatenate
+        in table order, so a slot's window is exactly the contiguous
+        cache a ``init_cache``-path request would hold."""
+        tables = self._oob(tables)
+
+        def one(q, scale):
+            pages = jnp.take(q, tables, axis=0, mode="fill",
+                             fill_value=0)   # (S, P, page, H, Dh)
+            if scale is not None:
+                sc = jnp.take(scale, tables, axis=0, mode="fill",
+                              fill_value=0)
+                pages = dequantize_rows(pages, sc)
+            s, p, pg, h, d = pages.shape
+            return pages.transpose(0, 3, 1, 2, 4).reshape(s, h, p * pg, d)
+
+        return (one(layer_pool["k"], layer_pool.get("k_scale")),
+                one(layer_pool["v"], layer_pool.get("v_scale")))
+
+    def write_token(self, layer_pool, tables, lengths, k_new, v_new):
+        """Scatter one new k/v row per slot into the pool at
+        ``(table[len // page], len % page)``.  k_new/v_new are
+        ``(slots, heads, 1, head_dim)`` (the
+        :meth:`~bigdl_tpu.models.transformer.MultiHeadAttention.project_qkv_rows`
+        output); dead slots' ``-1`` page indices drop."""
+        pidx = self._oob(jnp.take_along_axis(
+            tables, (lengths // self.page_size)[:, None], axis=1)[:, 0])
+        off = lengths % self.page_size
+        out = dict(layer_pool)
+        for key, new in (("k", k_new), ("v", v_new)):
+            row = new[:, :, 0, :]                     # (S, H, Dh)
+            if self.int8:
+                q, sc = quantize_rows(row, axis=-1)
+                out[key] = layer_pool[key].at[pidx, off].set(
+                    q, mode="drop")
+                out[key + "_scale"] = layer_pool[key + "_scale"].at[
+                    pidx, off].set(sc, mode="drop")
+            else:
+                out[key] = layer_pool[key].at[pidx, off].set(
+                    row.astype(layer_pool[key].dtype), mode="drop")
+        return out
+
+    def write_prefill(self, layer_pool, table, k, v):
+        """Scatter a contiguous prefill's k/v ``(1, heads, Lb, head_dim)``
+        into the pages of ``table`` (``ceil(Lb / page_size)`` entries,
+        ``-1``-padded past the slot's allocation — those pages hold
+        only prompt-padding rows, which the per-slot attention mask
+        never exposes, so dropping them is exact)."""
+        pg = self.page_size
+        table = self._oob(table)
+        out = dict(layer_pool)
+        for key, arr in (("k", k), ("v", v)):
+            rows = jnp.transpose(arr[0], (1, 0, 2))   # (Lb, H, Dh)
+            lb = rows.shape[0]
+            n_pages = math.ceil(lb / pg)
+            if lb % pg:
+                rows = jnp.concatenate(
+                    [rows, jnp.zeros((n_pages * pg - lb,) + rows.shape[1:],
+                                     rows.dtype)], axis=0)
+            pages = rows.reshape(n_pages, pg, self.n_heads, self.head_dim)
+            if self.int8:
+                q, sc = quantize_rows(pages, axis=-1)
+                out[key] = layer_pool[key].at[table].set(q, mode="drop")
+                out[key + "_scale"] = layer_pool[key + "_scale"].at[
+                    table].set(sc, mode="drop")
+            else:
+                out[key] = layer_pool[key].at[table].set(
+                    pages.astype(layer_pool[key].dtype), mode="drop")
+        return out
+
+
+__all__ = ["PagedKVCache", "PagePoolError"]

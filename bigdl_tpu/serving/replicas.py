@@ -443,9 +443,15 @@ class ReplicaSet:
         """Synchronous convenience; splits inputs larger than the
         bucket ladder across submits like ``ServingEngine.predict``."""
         import jax
-        max_batch = self.replicas[0].engine.ladder.max_batch
+        eng0 = self.replicas[0].engine
+        max_batch = eng0.ladder.max_batch
         rows = self._rows_of(name, x)
-        if rows <= max_batch:
+        if rows <= max_batch or not getattr(eng0, "row_splittable", True):
+            # engines whose "rows" are a SEQUENCE (the decode engine: a
+            # prompt's tokens) must never be sliced into independent
+            # requests — a concatenation of three unrelated decodes is
+            # not a decode of the prompt.  Submit whole; the engine
+            # rejects over-long prompts loudly.
             return self.submit(name, x, deadline_ms=deadline_ms,
                                priority=priority).result(timeout)
         x = np.asarray(x)
@@ -1032,6 +1038,17 @@ class CanaryPublisher:
                 reason, detail = "non_finite", \
                     f"{int((~np.isfinite(got)).sum())} non-finite " \
                     "golden outputs"
+            elif np.issubdtype(got.dtype, np.integer):
+                # integer golden outputs are TOKEN IDS (a decode
+                # canary): magnitude drift over ids is meaningless and
+                # a legitimate weight update may change every token —
+                # the poison gate is the golden decode itself, which
+                # FAILS (engine non-finite-logits sentinel -> "error"
+                # reason) on a poisoned snapshot.  A changed output
+                # shape still rejects.
+                if got.shape != ref.shape:
+                    reason, detail = "drift", \
+                        f"golden decode shape {got.shape} != {ref.shape}"
             else:
                 drift = float(np.max(np.abs(got - ref)))
                 bound = self.drift_atol + self.drift_rtol \

@@ -39,6 +39,13 @@ and exiting non-zero if it (or any response) is wrong.
 
 ``--overload`` (closed loop) shrinks the queue and adds per-request
 deadlines so the shed path is exercised.
+
+``--decode`` switches to the token-streaming protocol: open-loop
+Poisson arrivals (same virtual-time replay discipline) with sampled
+prompt/output lengths against a continuous-batching
+:class:`~bigdl_tpu.serving.DecodeEngine` over a tiny TransformerLM —
+the summary line reports tokens/s, TTFT p50/p99, inter-token p50/p99,
+mean slot occupancy, KV-pool peak fill, and evictions.
 """
 import argparse
 import json
@@ -92,12 +99,44 @@ def parse_args():
                     help="serve through the quantized int8 path")
     ap.add_argument("--max-size", type=int, default=17,
                     help="request sizes drawn from [1, max-size]")
+    ap.add_argument("--decode", action="store_true",
+                    help="token-streaming mode: open-loop Poisson "
+                         "arrivals against a continuous-batching "
+                         "DecodeEngine (tiny TransformerLM); reports "
+                         "tokens/s, TTFT/inter-token percentiles, slot "
+                         "occupancy and KV-pool fill")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="decode: concurrent sequences in the step batch")
+    ap.add_argument("--page-size", type=int, default=8,
+                    help="decode: KV page size in token rows")
+    ap.add_argument("--pool-pages", type=int, default=None,
+                    help="decode: KV pool size (default: no eviction "
+                         "pressure; smaller pools evict)")
+    ap.add_argument("--max-context", type=int, default=96,
+                    help="decode: longest prompt+generation per slot")
+    ap.add_argument("--prompt-max", type=int, default=24,
+                    help="decode: prompt lengths drawn from [1, this]")
+    ap.add_argument("--out-max", type=int, default=32,
+                    help="decode: output lengths drawn from [1, this]")
+    ap.add_argument("--int8-kv", action="store_true",
+                    help="decode: int8-quantized KV pages")
     args = ap.parse_args()
     if args.int8 and args.replicas > 1:
         # --int8 is the single-engine quantized serving path; in
         # replica mode int8 exists as the brownout degrade entry
         ap.error("--int8 serves a single quantized engine; with "
                  "--replicas use --brownout (int8 degrade entry)")
+    if args.decode and args.replicas > 1:
+        ap.error("--decode benches a single engine; decode replica "
+                 "sets are exercised by scripts/decode_smoke.py")
+    if args.decode and (args.int8 or args.brownout
+                        or args.model != "mlp"):
+        # rejected, never silently ignored: a summary line must not
+        # attribute decode numbers to a configuration that never ran
+        # (the decode KV-quantization knob is --int8-kv)
+        ap.error("--decode serves a tiny TransformerLM: --int8/"
+                 "--brownout/--model do not apply (use --int8-kv for "
+                 "quantized KV pages)")
     return args
 
 
@@ -166,6 +205,25 @@ def mult_at(phases, frac):
     return m
 
 
+def virtual_arrivals(rng, rate, phases, duration):
+    """Seeded Poisson arrival times in VIRTUAL time — the phase
+    multiplier and termination read virtual time only, so the offered
+    sequence (arrival times + however many there are) is exactly
+    (seed, trace, rate, duration)-determined; wall clock only paces
+    the replay.  Exactly ONE rng.exponential per yielded arrival, so
+    callers interleave their own size/payload draws off the same rng
+    without perturbing the arrival sequence — both the request
+    open-loop and the decode bench share this generator so their
+    replay disciplines can never diverge."""
+    t_virtual = 0.0
+    while True:
+        r = rate * mult_at(phases, t_virtual / duration)
+        t_virtual += rng.exponential(1.0 / r)
+        if t_virtual >= duration:
+            return
+        yield t_virtual
+
+
 def run_open_loop(a, target, input_shape, duration, size_cap):
     """Seeded Poisson arrival generator; returns (latencies, shed,
     errors, offered).  Every submitted future is awaited, so
@@ -195,19 +253,9 @@ def run_open_loop(a, target, input_shape, duration, size_cap):
             with lock:
                 processed[0] += 1
 
-    # arrival times are generated in VIRTUAL time (phase multiplier and
-    # termination both read t_virtual, never the wall clock), so the
-    # offered sequence — arrival times, sizes, total count — is exactly
-    # determined by (seed, trace, rate, duration); wall clock only
-    # paces the replay
     t_start = time.perf_counter()
-    t_virtual = 0.0
     offered = 0
-    while True:
-        rate = a.rate * mult_at(phases, t_virtual / duration)
-        t_virtual += rng.exponential(1.0 / rate)
-        if t_virtual >= duration:
-            break
+    for t_virtual in virtual_arrivals(rng, a.rate, phases, duration):
         # submit() never splits, so open-loop sizes stay on the ladder
         n = int(rng.randint(1, size_cap + 1))
         while True:
@@ -288,8 +336,164 @@ def run_closed_loop(a, target, input_shape, n_requests):
     return latencies, shed[0], errors, n_requests
 
 
+def run_decode_bench(a):
+    """Open-loop token-streaming bench: seeded Poisson arrivals with
+    sampled prompt/output lengths against a continuous-batching
+    DecodeEngine.  Arrival times, prompt contents, and output budgets
+    are all drawn from one seeded RNG in VIRTUAL time, so the offered
+    trace is exactly (seed, trace, rate, duration)-determined — same
+    seed ⇒ same offered sequence, the PR-12 replay convention."""
+    import threading as _t
+    from bigdl_tpu.models import transformer as T
+    from bigdl_tpu.serving import DecodeEngine, ModelRegistry
+
+    model = T.build("tiny", dropout=0.0, n_layers=2,
+                    max_len=max(256, a.max_context))
+    reg = ModelRegistry()
+    reg.register("main", model)
+    rec = Recorder(annotate=False)
+    eng = DecodeEngine(reg, "main", slots=a.slots, page_size=a.page_size,
+                       pool_pages=a.pool_pages, max_context=a.max_context,
+                       max_prompt=a.prompt_max, max_new_tokens=a.out_max,
+                       max_waiting=a.queue_rows, int8_kv=a.int8_kv,
+                       recorder=rec)
+    t0 = time.perf_counter()
+    eng.warmup()
+    warm_s = time.perf_counter() - t0
+    warm = rec.counter_value("decode/warmup_compiles")
+    print(f"# decode warmup: {warm:.0f} compiles in {warm_s:.1f}s "
+          f"(prefill buckets {list(eng.ladder)}, {a.slots} slots, "
+          f"{eng.kv.n_pages}x{a.page_size} KV pages)", flush=True)
+
+    rng = np.random.RandomState(a.seed)
+    phases = TRACES[a.trace]
+    duration = a.duration if a.duration is not None \
+        else (4.0 if a.smoke else 10.0)
+    lock = _t.Lock()
+    totals, errors = [], []
+    shed = [0]
+    tokens_done = [0]
+    processed = [0]
+    pending = []
+    t_start = time.perf_counter()
+    offered = 0
+
+    # completion rides the Future's done-callback — no per-request
+    # consumer thread (at --rate x --duration requests, a thread each
+    # would hit OS limits and distort the latencies being measured);
+    # TTFT comes from the engine's own submit->first-token histogram
+    def on_done(f, t_sub, plen):
+        try:
+            out = f.result()
+            dt = (time.perf_counter() - t_sub) * 1e3
+            with lock:
+                totals.append(dt)
+                tokens_done[0] += len(out) - plen
+        except LoadShedError:
+            with lock:
+                shed[0] += 1
+        except Exception as e:
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+        finally:
+            with lock:
+                processed[0] += 1
+
+    for t_virtual in virtual_arrivals(rng, a.rate, phases, duration):
+        plen = int(rng.randint(1, a.prompt_max + 1))
+        olen = int(rng.randint(1, a.out_max + 1))
+        prompt = rng.randint(0, model.cfg.vocab_size, plen).astype(np.int32)
+        while True:
+            lag = t_start + t_virtual - time.perf_counter()
+            if lag <= 0:
+                break
+            time.sleep(min(lag, 0.01))
+        offered += 1
+        t_sub = time.perf_counter()
+        try:
+            fut = eng.submit("main", prompt, deadline_ms=a.deadline_ms,
+                             max_new_tokens=olen)
+        except LoadShedError:
+            with lock:
+                shed[0] += 1
+            continue
+        except Exception as e:
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+            continue
+        fut.add_done_callback(
+            lambda f, t_sub=t_sub, plen=plen: on_done(f, t_sub, plen))
+        pending.append(fut)
+    for f in pending:
+        try:
+            f.exception(timeout=120)
+        except Exception:
+            pass
+    # waiters can wake before done-callbacks ran: close the ledger
+    t_end = time.monotonic() + 30
+    while time.monotonic() < t_end:
+        with lock:
+            if processed[0] >= len(pending):
+                break
+        time.sleep(0.005)
+    wall = time.perf_counter() - t_start
+    eng.shutdown(drain=True)
+
+    st = eng.stats()
+    q = rec.hist_quantiles("decode/intertoken_ms", (50.0, 99.0)) or {}
+    qt = rec.hist_quantiles("decode/ttft_ms", (50.0, 99.0)) or {}
+    summary = {
+        "metric": "serve_bench",
+        "mode": "decode_open_loop",
+        "backend": jax.default_backend(),
+        "model": "tiny_lm" + ("_int8kv" if a.int8_kv else ""),
+        "trace": a.trace, "seed": a.seed, "rate": a.rate,
+        "duration": round(wall, 2),
+        "slots": a.slots, "page_size": a.page_size,
+        "pool_pages": eng.kv.n_pages,
+        "offered": offered, "completed": len(totals),
+        "shed": int(shed[0]),
+        "shed_rate": round(shed[0] / max(offered, 1), 4),
+        "tokens": int(tokens_done[0]),
+        "tokens_per_s": round(tokens_done[0] / wall, 2),
+        "ttft_p50_ms": round(qt.get("p50") or 0.0, 3),
+        "ttft_p99_ms": round(qt.get("p99") or 0.0, 3),
+        "intertoken_p50_ms": round(q.get("p50") or 0.0, 3),
+        "intertoken_p99_ms": round(q.get("p99") or 0.0, 3),
+        "occupancy": round(st["occupancy"], 4),
+        "kv_peak_fill": round(st["kv_peak_fill"], 4),
+        "evictions": int(st["evictions"]),
+        "recompiles": int(st["recompiles"]),
+        "warmup_compiles": int(warm),
+        "errors": len(errors),
+        "smoke": bool(a.smoke),
+    }
+    for e in errors[:5]:
+        print(f"# client error: {e}", file=sys.stderr, flush=True)
+    ok = not errors
+    if a.smoke:
+        if summary["recompiles"] != 0:
+            print(f"# SMOKE FAIL: {summary['recompiles']} decode "
+                  "recompiles after warmup", file=sys.stderr, flush=True)
+            ok = False
+        # errored requests are accounted (and already fail the run):
+        # "ledger open" must mean a future genuinely never resolved
+        if summary["completed"] + summary["shed"] \
+                + summary["errors"] != offered:
+            print(f"# SMOKE FAIL: ledger open "
+                  f"({summary['completed']}+{summary['shed']}+"
+                  f"{summary['errors']} != {offered})",
+                  file=sys.stderr, flush=True)
+            ok = False
+    print(json.dumps(summary), flush=True)
+    sys.exit(0 if ok else 1)
+
+
 def main():
     a = ARGS
+    if a.decode:
+        run_decode_bench(a)
+        return
     if a.overload:
         a.queue_rows = min(a.queue_rows, 2 * a.max_batch)
         if a.deadline_ms is None:

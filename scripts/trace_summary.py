@@ -62,10 +62,13 @@ Two subcommands:
   serving            per-replica health transitions from a ReplicaSet's
                      telemetry JSONL: one chronological
                      eject → probe → readmit / canary_stage →
-                     promote/reject / brownout enter/exit table, plus
-                     the per-replica transition sequence and the final
-                     resilience counters — the one-command view of
-                     "what did the replica set do under that fault":
+                     promote/reject / brownout enter/exit /
+                     stream:published/rejected table, plus the
+                     per-replica transition sequence and the final
+                     resilience counters — and, when decode-engine
+                     telemetry is present, the per-token SLO table
+                     (TTFT vs inter-token split) with the
+                     slot-occupancy/KV-fill timeline:
 
         python scripts/trace_summary.py serving /tmp/serving.jsonl
 
@@ -406,9 +409,10 @@ def summarize_fleet(events, out=print):
 
 
 def load_serving(paths):
-    """Chronologically-merged ``replica_event`` + ``fault_event``
-    records from telemetry JSONL files (directories are scanned for
-    ``*.jsonl``), plus the last record's counter snapshot per stream."""
+    """Chronologically-merged ``replica_event`` + ``fault_event`` +
+    ``decode_event`` + ``stream_event`` records from telemetry JSONL
+    files (directories are scanned for ``*.jsonl``), plus the last
+    record's counter snapshot per stream."""
     expanded = []
     for p in paths:
         if os.path.isdir(p):
@@ -419,20 +423,37 @@ def load_serving(paths):
     for p in expanded:
         src = os.path.basename(p)
         for rec in iter_jsonl(p):
-            if rec.get("type") in ("replica_event", "fault_event"):
+            if rec.get("type") in ("replica_event", "fault_event",
+                                   "decode_event", "stream_event"):
                 events.append((src, rec))
             for k, v in (rec.get("counters") or {}).items():
-                if k.startswith(("replica/", "serving/")):
+                if k.startswith(("replica/", "serving/", "decode/",
+                                 "kv/", "stream/")):
                     counters[k] = v
     events.sort(key=lambda sr: sr[1].get("time") or 0.0)
     return events, counters
 
 
 def summarize_serving(events, counters, out=print):
-    """Render the replica-set timeline and per-replica sequences."""
+    """Render the replica-set timeline, per-replica sequences, and —
+    when a decode engine's telemetry is present — the per-token SLO
+    table (TTFT vs inter-token split) and the occupancy timeline."""
     if not events and not counters:
         out("no replica_event records found (not a ReplicaSet "
             "telemetry stream, or nothing happened)")
+        return
+    decode_events = [(s, e) for s, e in events
+                     if e.get("type") == "decode_event"]
+    events = [(s, e) for s, e in events
+              if e.get("type") != "decode_event"]
+    _summarize_decode(decode_events, counters, out)
+    if not events:
+        # counters-only stream (a healthy run with zero transitions):
+        # the counter block below must still render
+        if counters:
+            out("== resilience counters (at last record) ==")
+            for k in sorted(counters):
+                out(f"  {k:<34} {counters[k]:.6g}")
         return
     t0 = min((ev.get("time") or 0.0 for _, ev in events), default=0.0)
     replicas, seen = [], {}
@@ -443,6 +464,18 @@ def summarize_serving(events, counters, out=print):
             kind = f"fault:{ev.get('mode', '?')}"
             rep = "-"
             parts = [ev.get("site", "?")]
+        elif ev.get("type") == "stream_event":
+            kind = f"stream:{ev.get('kind', '?')}"
+            rep = "-"
+            parts = []
+            if ev.get("model"):
+                parts.append(f"model={ev['model']}")
+            if ev.get("version"):
+                parts.append(f"version={ev['version']}")
+            if ev.get("reason"):
+                parts.append(f"[{ev['reason']}]")
+            if ev.get("error"):
+                parts.append(f"error={ev['error']}")
         else:
             kind = ev.get("kind", "?")
             rep = ev.get("replica")
@@ -472,6 +505,48 @@ def summarize_serving(events, counters, out=print):
         out("\n== resilience counters (at last record) ==")
         for k in sorted(counters):
             out(f"  {k:<34} {counters[k]:.6g}")
+
+
+def _summarize_decode(decode_events, counters, out):
+    """Decode-engine view: per-token SLO split and occupancy timeline
+    (from the engine's periodic ``decode_event`` records)."""
+    has_counters = any(k.startswith(("decode/", "kv/"))
+                       for k in counters)
+    if not decode_events and not has_counters:
+        return
+    out("== decode per-token SLO ==")
+    last = decode_events[-1][1] if decode_events else {}
+    ttft = last.get("ttft") or {}
+    inter = last.get("intertoken") or {}
+
+    def q(d, key):
+        v = d.get(key)
+        return f"{v:8.2f}" if isinstance(v, (int, float)) else "       -"
+
+    out(f"  ttft        p50 {q(ttft, 'p50')} ms   p99 "
+        f"{q(ttft, 'p99')} ms     (submit -> first token: queue + "
+        "prefill)")
+    out(f"  inter-token p50 {q(inter, 'p50')} ms   p99 "
+        f"{q(inter, 'p99')} ms     (steady-state decode cadence)")
+    keys = ("decode/requests", "decode/tokens", "decode/prefills",
+            "decode/readmissions", "decode/shed_deadline",
+            "decode/shed_queue_full", "kv/evictions")
+    present = [(k, counters[k]) for k in keys if k in counters]
+    if present:
+        out("  " + "  ".join(f"{k}={v:.6g}" for k, v in present))
+    if decode_events:
+        t0 = decode_events[0][1].get("time") or 0.0
+        out("\n== decode occupancy timeline ==")
+        out(f"  {'t':>8}  {'step':>6}  {'live':>7}  {'occ':>5}  "
+            f"{'kv_fill':>7}  {'queued':>6}")
+        for _, ev in decode_events:
+            dt = (ev.get("time") or 0.0) - t0
+            out(f"  {dt:>+7.2f}s  {ev.get('step', 0):>6.0f}  "
+                f"{ev.get('live', 0):>3.0f}/{ev.get('slots', 0):<3.0f} "
+                f"{ev.get('occupancy', 0.0):>5.2f}  "
+                f"{ev.get('kv_fill', 0.0):>7.2f}  "
+                f"{ev.get('queue_depth', 0):>6.0f}")
+    out("")
 
 
 def load_profile(path):
