@@ -618,6 +618,16 @@ class FleetScheduler:
             preemption.uninstall()
 
     # -- aggregated observability --------------------------------------- #
+    def telemetry_sources(self):
+        """``[(name, recorder), ...]``: the scheduler's ``fleet/*``
+        recorder plus every admitted job's — the one-call aggregator
+        attachment hook (``aggregator.add(scheduler, name="fleet")``)."""
+        with self._lock:
+            jobs = list(self._jobs.values())
+        return [("scheduler", self._rec())] + \
+            [(job.name, job.recorder) for job in jobs
+             if job.recorder is not None]
+
     def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
         """One aggregated introspection server over the whole pool:
         ``/metrics`` renders the scheduler's ``fleet/*`` counters

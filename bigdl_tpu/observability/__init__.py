@@ -23,6 +23,14 @@ surface for the TPU rebuild:
     and loss-spike sentinels with warn/record/raise/rollback policies,
     a stall-and-straggler watchdog, and a crash flight recorder that
     dumps the recent-record ring on unhandled exception / SIGTERM.
+  * Fleet telemetry plane: bounded time series with windowed reducers
+    (:mod:`~bigdl_tpu.observability.timeseries`, opt-in via
+    ``Recorder(keep_series=N)``, served at ``/series``), a multi-
+    endpoint scrape aggregator re-exposing one fleet ``/metrics`` with
+    ``source``/``stale`` labels
+    (:mod:`~bigdl_tpu.observability.aggregate`), and declarative SLOs
+    with dual-window error-budget burn-rate alerts
+    (:mod:`~bigdl_tpu.observability.slo`).
   * Cost/memory attribution (:mod:`~bigdl_tpu.observability.profile`):
     XLA compile-time FLOPs/HBM capture feeding per-step ``perf/mfu``,
     ``perf/hbm_bw_util`` and ``mem/peak_hbm_bytes`` scalars, a device
@@ -51,6 +59,9 @@ from .sinks import (InMemorySink, JsonlSink, Sink, TensorBoardSink,
 from .http import IntrospectionServer
 from .health import (DivergenceError, FlightRecorder, HealthMonitor,
                      StallWatchdog)
+from .timeseries import MetricSeries, SeriesStore
+from .aggregate import MetricsAggregator, parse_prometheus
+from .slo import SLObjective, SLOEngine, default_objectives
 from . import collectives
 from . import health
 from . import profile
@@ -60,5 +71,7 @@ __all__ = [
     "Sink", "InMemorySink", "JsonlSink", "TensorBoardSink",
     "render_prometheus", "render_prometheus_multi", "IntrospectionServer",
     "DivergenceError", "FlightRecorder", "HealthMonitor", "StallWatchdog",
+    "MetricSeries", "SeriesStore", "MetricsAggregator",
+    "parse_prometheus", "SLObjective", "SLOEngine", "default_objectives",
     "collectives", "health", "profile",
 ]

@@ -43,6 +43,7 @@ class FlightRecorder:
         self.max_records = max_records
         self.dumps: List[str] = []          # paths written, oldest first
         self._dumped_keys = set()           # dedupe one failure's dumps
+        self._pending: set = set()          # paths claimed mid-write
         # RLock, not Lock: a signal delivered while dump() holds the
         # lock runs the chained handler on the SAME thread, which dumps
         # again — a plain Lock would self-deadlock through the scheduler
@@ -86,15 +87,23 @@ class FlightRecorder:
             base = f"flight_{int(time.time() * 1e3)}"
             path = os.path.join(self.out_dir, base + ".json")
             n = 0
-            while os.path.exists(path):      # two dumps in the same ms
+            # two dumps in the same ms — including a re-entrant dump
+            # (signal mid-write) whose outer path has no file yet, only
+            # a _pending claim; a shared path would mean a shared tmp,
+            # and the inner os.replace would consume the outer's tmp
+            while os.path.exists(path) or path in self._pending:
                 n += 1
                 path = os.path.join(self.out_dir, f"{base}_{n}.json")
-            tmp = f"{path}.tmp-{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump(payload, f, default=_json_default)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, path)
+            self._pending.add(path)
+            try:
+                tmp = f"{path}.tmp-{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump(payload, f, default=_json_default)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+            finally:
+                self._pending.discard(path)
             try:        # directory entry durable too (same as manifest)
                 dfd = os.open(self.out_dir, os.O_RDONLY)
                 try:

@@ -22,6 +22,10 @@ runs:
                  timelines (serving engines attach their trace ring via
                  ``trace_source``; curl it to a file and load in
                  ui.perfetto.dev)
+  ``/series``    windowed time-series points
+                 (``?name=decode/ttft_ms/p99&window=300``) from a
+                 Recorder's ``keep_series=`` store or an aggregator's —
+                 no name lists the available series
 
 Attach with ``serve_metrics(port)`` on ``Optimizer`` / ``SpmdTrainer``
 / ``ServingEngine``, or standalone::
@@ -74,7 +78,8 @@ class IntrospectionServer:
     def __init__(self, recorder, port: int = 0, host: str = "127.0.0.1",
                  watchdog=None, monitor=None, namespace: str = "bigdl",
                  records_default: int = 50, trace_source=None,
-                 bind_retries: int = 4):
+                 bind_retries: int = 4, metrics_source=None,
+                 healthz_source=None, series_source=None):
         self.recorder = recorder
         self.host = host
         self.port = int(port)           # 0 -> ephemeral, bound in start()
@@ -86,6 +91,15 @@ class IntrospectionServer:
         # ServingEngine.dump_chrome_trace); None -> /trace is 404
         self.trace_source = trace_source
         self.bind_retries = int(bind_retries)
+        # overrides for a non-Recorder-backed surface (the fleet
+        # MetricsAggregator): zero-arg callables replacing the /metrics
+        # body and the /healthz payload
+        self.metrics_source = metrics_source
+        self.healthz_source = healthz_source
+        # a SeriesStore served at /series; defaults to the recorder's
+        # own (Recorder(keep_series=N)), resolved per request so a
+        # late-attached store is picked up
+        self.series_source = series_source
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         # fleet mode: named (recorder, watchdog, monitor) jobs this
@@ -172,20 +186,47 @@ class IntrospectionServer:
     def _route(self, h: BaseHTTPRequestHandler):
         parsed = urlparse(h.path)
         if parsed.path == "/metrics":
-            jobs = dict(self._jobs)
-            if jobs:
-                sources = [(None, self.recorder)]
-                sources += [({"job": name}, j["recorder"])
-                            for name, j in jobs.items()]
-                body = render_prometheus_multi(sources, self.namespace)
+            if self.metrics_source is not None:
+                body = self.metrics_source()
             else:
-                body = render_prometheus(self.recorder, self.namespace)
+                jobs = dict(self._jobs)
+                if jobs:
+                    sources = [(None, self.recorder)]
+                    sources += [({"job": name}, j["recorder"])
+                                for name, j in jobs.items()]
+                    body = render_prometheus_multi(sources,
+                                                   self.namespace)
+                else:
+                    body = render_prometheus(self.recorder,
+                                             self.namespace)
             self._reply(h, 200, body,
                         "text/plain; version=0.0.4; charset=utf-8")
         elif parsed.path == "/healthz":
-            payload = self.healthz()
+            payload = (self.healthz_source() if self.healthz_source
+                       is not None else self.healthz())
             self._reply(h, 200 if payload["ok"] else 503,
                         _finite_json(payload), "application/json")
+        elif parsed.path == "/series":
+            store = self.series_source
+            if store is None:
+                store = getattr(self.recorder, "series", None)
+            if store is None:
+                h.send_error(404, "no series store attached "
+                                  "(Recorder(keep_series=N) or an "
+                                  "aggregator expose one)")
+                return
+            q = parse_qs(parsed.query)
+            name = q["name"][0] if q.get("name") else None
+            window = float(q["window"][0]) if q.get("window") else None
+            if name is None:
+                payload = {"names": store.names()}
+            else:
+                payload = {"name": name, "window": window,
+                           "points": [[t, v] for t, v in
+                                      store.points(name, window)],
+                           "summary": store.summary(name, window)}
+            self._reply(h, 200, _finite_json(payload),
+                        "application/json")
         elif parsed.path == "/records":
             q = parse_qs(parsed.query)
             n = int(q["n"][0]) if q.get("n") else self.records_default
@@ -202,8 +243,8 @@ class IntrospectionServer:
                     body = json.dumps(body, default=_json_default)
                 self._reply(h, 200, body, "application/json")
         else:
-            h.send_error(404,
-                         "try /metrics, /healthz, /records or /trace")
+            h.send_error(404, "try /metrics, /healthz, /records, "
+                              "/series or /trace")
 
     @staticmethod
     def _reply(h: BaseHTTPRequestHandler, code: int, body: str,

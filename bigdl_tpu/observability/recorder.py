@@ -31,6 +31,7 @@ from __future__ import annotations
 import json
 import threading
 import time
+from bisect import bisect_left
 from collections import deque
 from typing import Any, Dict, List, Optional
 
@@ -84,7 +85,8 @@ class Recorder:
 
     def __init__(self, sinks=(), enabled: bool = True,
                  annotate: bool = True, hist_sample_cap: int = 2048,
-                 keep_records: int = 256):
+                 keep_records: int = 256, keep_series: int = 0,
+                 series_clock=None):
         self._lock = threading.Lock()
         self.sinks = list(sinks)
         self._enabled = bool(enabled)
@@ -124,6 +126,21 @@ class Recorder:
         # gauge pollers: callables(recorder) refreshed before each
         # snapshot()/end_step() — live device-memory stats and friends
         self._gauge_pollers: List = []
+        # opt-in time series: keep_series > 0 attaches a SeriesStore
+        # (that many points per metric) fed by end_step and
+        # series_tick(); series_clock injects virtual time for
+        # deterministic windowed math in tests
+        self.series = None
+        if keep_series:
+            from .timeseries import SeriesStore
+            self.series = SeriesStore(capacity=int(keep_series),
+                                      clock=series_clock)
+        # opt-in Prometheus histogram buckets: name (or "prefix/*"
+        # family) -> sorted upper bounds; per-bin counts live beside
+        # _hists and share its per-step lifecycle
+        self._hist_bucket_spec: Dict[str, tuple] = {}
+        self._hist_bucket_bounds: Dict[str, Optional[tuple]] = {}
+        self._hist_bucket_counts: Dict[str, List[int]] = {}
 
     # -- enable/disable -------------------------------------------------- #
     @property
@@ -230,6 +247,56 @@ class Recorder:
                 s = self._hist_samples[name] = deque(
                     maxlen=self.hist_sample_cap)
             s.append(v)
+            if self._hist_bucket_spec:
+                bounds = self._resolve_buckets(name)
+                if bounds is not None:
+                    c = self._hist_bucket_counts.get(name)
+                    if c is None:
+                        c = self._hist_bucket_counts[name] = \
+                            [0] * (len(bounds) + 1)
+                    c[bisect_left(bounds, v)] += 1
+
+    # -- Prometheus histogram buckets (opt-in) --------------------------- #
+    def set_hist_buckets(self, spec: Dict[str, Any]):
+        """Opt histograms into cumulative ``_bucket`` exposition.
+        ``spec`` maps an exact histogram name — or a ``"prefix/*"``
+        family — to its ``le`` upper bounds (sorted ascending; ``+Inf``
+        is implicit).  Exact names beat families; within families the
+        longest prefix wins.  Buckets are counted at ``observe`` time,
+        so ``_bucket`` lines stay exactly consistent with ``_count``
+        instead of being re-derived from the bounded sample window."""
+        with self._lock:
+            self._hist_bucket_spec = {
+                str(k): tuple(sorted(float(b) for b in v))
+                for k, v in spec.items()}
+            self._hist_bucket_bounds.clear()
+            self._hist_bucket_counts.clear()
+        return self
+
+    def _resolve_buckets(self, name: str) -> Optional[tuple]:
+        # caller holds the lock
+        if name in self._hist_bucket_bounds:
+            return self._hist_bucket_bounds[name]
+        bounds = self._hist_bucket_spec.get(name)
+        if bounds is None:
+            best = -1
+            for pat, b in self._hist_bucket_spec.items():
+                if pat.endswith("/*") and len(pat) > best \
+                        and name.startswith(pat[:-1]):
+                    bounds, best = b, len(pat)
+        self._hist_bucket_bounds[name] = bounds
+        return bounds
+
+    def hist_buckets(self, name: str):
+        """``(bounds, per_bin_counts)`` for an opted-in histogram with
+        observations this step, else ``None``.  ``per_bin_counts`` has
+        ``len(bounds) + 1`` entries (the last is the overflow bin);
+        renderers cumulate them into ``le``-labeled samples."""
+        with self._lock:
+            c = self._hist_bucket_counts.get(name)
+            if c is None:
+                return None
+            return (self._hist_bucket_bounds.get(name), list(c))
 
     def hist_quantiles(self, name: str, qs=(50.0, 95.0, 99.0)
                        ) -> Optional[Dict[str, float]]:
@@ -357,6 +424,7 @@ class Recorder:
             self._scalars.clear()
             self._hists.clear()
             self._hist_samples.clear()
+            self._hist_bucket_counts.clear()
             self._step = None
             self._step_t0 = None
             self._step_started_wall = None
@@ -365,8 +433,45 @@ class Recorder:
             self._n_records += 1
             self._ring.append(rec)
             sinks = list(self.sinks)
+        if self.series is not None:
+            self._feed_series(rec)
         for s in sinks:
             s.emit(rec)
+        return rec
+
+    def _feed_series(self, rec: Dict[str, Any]):
+        """Append one point per numeric scalar/counter/gauge (and per
+        histogram p50/p95/p99, as ``<name>/pXX``) to the attached
+        series store at its clock's current time."""
+        store = self.series
+        t = store.now()
+        for k, v in rec.get("scalars", {}).items():
+            if isinstance(v, (int, float)):
+                store.observe(k, v, t)
+        for k, v in rec.get("counters", {}).items():
+            store.observe(k, v, t)
+        for k, v in rec.get("gauges", {}).items():
+            store.observe(k, v, t)
+        for k, entry in rec.get("hist", {}).items():
+            for q in ("p50", "p95", "p99"):
+                if q in entry:
+                    store.observe(f"{k}/{q}", entry[q], t)
+
+    def series_tick(self):
+        """Snapshot counters, gauges and pending-histogram quantiles
+        into the attached series store WITHOUT cutting a step record —
+        how sources with no step loop (serving engines) or a periodic
+        poller grow a time dimension.  No-op without ``keep_series``."""
+        if self.series is None or not self._enabled:
+            return None
+        snap = self.snapshot()
+        rec = {"counters": snap["counters"], "gauges": snap["gauges"],
+               "hist": {}}
+        for name in self.hist_names():
+            qs = self.hist_quantiles(name)
+            if qs:
+                rec["hist"][name] = qs
+        self._feed_series(rec)
         return rec
 
     def emit_record(self, rec_type: str, **fields):
@@ -395,6 +500,7 @@ class Recorder:
             self._scalars.clear()
             self._hists.clear()
             self._hist_samples.clear()
+            self._hist_bucket_counts.clear()
             self._step = None
             self._step_t0 = None
             self._step_started_wall = None

@@ -231,19 +231,43 @@ def _collect_prometheus(recorder, namespace: str,
                 f"{metric}{_prom_labels({**lab, 'model': model})} "
                 f"{_prom_value(queue_depths[model])}")
 
+    hist_buckets = getattr(recorder, "hist_buckets", None)
     for name in sorted(recorder.hist_names()):
         summ = recorder.hist_summary(name)
         if not summ:
             continue
         metric = prometheus_name(name, namespace)
-        lines = _prom_group(groups, metric,
-                            prometheus_escape_help("histogram " + name),
-                            "summary")
-        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
-            if key in summ:
+        buckets = hist_buckets(name) if hist_buckets is not None else None
+        if buckets is not None and buckets[0] is not None:
+            # opted-in bucket spec: native TYPE histogram with
+            # cumulative le-labeled buckets counted at observe() time,
+            # so +Inf == _count exactly and external Prometheus can
+            # compute its own quantiles
+            bounds, bins = buckets
+            lines = _prom_group(groups, metric,
+                                prometheus_escape_help("histogram "
+                                                       + name),
+                                "histogram")
+            cum = 0
+            for le, n in zip(bounds, bins):
+                cum += n
                 lines.append(
-                    f"{metric}{_prom_labels({**lab, 'quantile': q})} "
-                    f"{_prom_value(summ[key])}")
+                    f"{metric}_bucket"
+                    f"{_prom_labels({**lab, 'le': _prom_value(le)})} "
+                    f"{cum}")
+            lines.append(
+                f"{metric}_bucket{_prom_labels({**lab, 'le': '+Inf'})} "
+                f"{cum + bins[-1]}")
+        else:
+            lines = _prom_group(groups, metric,
+                                prometheus_escape_help("histogram "
+                                                       + name),
+                                "summary")
+            for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+                if key in summ:
+                    lines.append(
+                        f"{metric}{_prom_labels({**lab, 'quantile': q})} "
+                        f"{_prom_value(summ[key])}")
         lines.append(f"{metric}_sum{_prom_labels(lab)} "
                      f"{_prom_value(summ['mean'] * summ['count'])}")
         lines.append(f"{metric}_count{_prom_labels(lab)} "

@@ -602,6 +602,14 @@ class Optimizer:
                 or self._http_server.watchdog
         return self
 
+    def telemetry_sources(self):
+        """``[("trainer", recorder)]`` — the fleet aggregator's
+        attachment hook (``aggregator.add(opt, name="train")``); a
+        recorder is created on demand like ``serve_metrics`` does."""
+        if self._recorder is None:
+            self.set_telemetry(Recorder())
+        return [("trainer", self._recorder)]
+
     def serve_metrics(self, port: int = 0, host: str = "127.0.0.1",
                       watchdog: bool = True):
         """Start the live introspection HTTP server for this trainer's
@@ -1209,11 +1217,13 @@ class Optimizer:
     def _emit_step_record(self, rec: Recorder, size, loss, opt_state,
                           health):
         """Fold this iteration's telemetry into one step record."""
-        if not rec.sinks and self._health_monitor is None:
+        if (not rec.sinks and self._health_monitor is None
+                and rec.series is None):
             # trace-only recorder: keep the step/trace cadence but skip
             # the scalars — recording `loss` would host-sync the device
             # every step for a record nobody consumes (an attached
-            # health monitor IS a consumer: it needs the floats)
+            # health monitor or keep_series= store IS a consumer: both
+            # need the floats)
             rec.end_step(self.state.iteration)
             return
         raw = rec.gauge_value("collective/bytes_per_step")

@@ -42,6 +42,7 @@ See ``docs/serving.md`` for architecture and tuning, and
 """
 from __future__ import annotations
 
+from . import arrivals
 from .buckets import BucketLadder
 from .decode import DecodeEngine, DecodeStream, build_decode_replica_set
 from .engine import ServingEngine
@@ -63,5 +64,5 @@ __all__ = [
     "build_decode_replica_set", "WeightStreamPublisher",
     "ReplicaSet", "CanaryPublisher", "OverloadController",
     "CanaryRejectedError", "NoHealthyReplicaError",
-    "build_replica_set",
+    "build_replica_set", "arrivals",
 ]

@@ -289,6 +289,12 @@ class DecodeEngine:
             t.join(timeout)
         return self
 
+    def telemetry_sources(self):
+        """``[(model_name, recorder)]`` — the aggregator attachment
+        hook (``aggregator.add(engine)`` scrapes the ``decode/*`` +
+        ``kv/*`` SLO families)."""
+        return [(self.model_name, self.recorder)]
+
     def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
         """Live introspection for this engine's recorder: ``/metrics``
         (``decode/*`` + ``kv/*`` per-token SLO families), ``/healthz``,

@@ -120,6 +120,12 @@ class ServingEngine:
             entry.warmed = True
         return self
 
+    def telemetry_sources(self):
+        """``[("serving", recorder)]`` — the aggregator attachment hook
+        (``aggregator.add(engine, name=...)`` scrapes the ``serving.*``
+        request/shed/latency families)."""
+        return [("serving", self.recorder)]
+
     def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
         """Start the live introspection server for this engine's
         recorder: ``/metrics`` (Prometheus — request/shed/recompile

@@ -343,6 +343,16 @@ class ReplicaSet:
             rep.engine.shutdown(drain=drain, timeout=timeout)
         return self
 
+    def telemetry_sources(self):
+        """``[(name, recorder), ...]`` for the fleet
+        :class:`~bigdl_tpu.observability.aggregate.MetricsAggregator`:
+        the set's own recorder (``replica/*`` rotation gauges) plus one
+        per replica — ``aggregator.add(replica_set, name="serve")``
+        attaches the whole set in one call."""
+        return [("set", self.recorder)] + \
+            [(f"replica{rep.index}", rep.engine.recorder)
+             for rep in self.replicas]
+
     def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
         """One aggregated introspection server for the whole set: the
         set's own recorder is the base source (``replica/*`` health

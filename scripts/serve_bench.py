@@ -77,6 +77,13 @@ def parse_args():
     ap.add_argument("--trace", choices=("steady", "burst", "overload"),
                     default="steady",
                     help="open loop: arrival-rate shape over the run")
+    ap.add_argument("--arrivals", choices=("poisson", "diurnal"),
+                    default="poisson",
+                    help="open loop: arrival process — plain seeded "
+                         "Poisson, or Poisson modulated by one "
+                         "raised-cosine day cycle over the run "
+                         "(composes with --trace; same seed => "
+                         "bit-identical offered trace)")
     ap.add_argument("--seed", type=int, default=0,
                     help="open loop: arrival/size RNG seed (replay key)")
     ap.add_argument("--replicas", type=int, default=1,
@@ -154,12 +161,17 @@ from bigdl_tpu.serving import (LoadShedError,              # noqa: E402
                                ModelRegistry, OverloadController,
                                ServingEngine, build_replica_set)
 
-#: --trace shapes as (start_fraction_of_run, rate_multiplier) phases
-TRACES = {
-    "steady": ((0.0, 1.0),),
-    "burst": ((0.0, 1.0), (0.4, 6.0), (0.6, 1.0)),
-    "overload": ((0.0, 1.0), (0.3, 4.0)),
-}
+# the arrival machinery lives in the library (importable without this
+# script's parse-time side effects); re-exported here for callers that
+# grew up against serve_bench's names
+from bigdl_tpu.serving.arrivals import (TRACES, diurnal_mult,  # noqa: E402
+                                        mult_at, virtual_arrivals)
+
+
+def arrival_rate_fn(a):
+    """--arrivals to the rate_fn virtual_arrivals composes with
+    --trace (None = plain Poisson)."""
+    return diurnal_mult if a.arrivals == "diurnal" else None
 
 
 def build_model(kind):
@@ -197,33 +209,6 @@ def build_target(a, model, input_shape, rec):
     return eng, [eng]
 
 
-def mult_at(phases, frac):
-    m = phases[0][1]
-    for start, mult in phases:
-        if frac >= start:
-            m = mult
-    return m
-
-
-def virtual_arrivals(rng, rate, phases, duration):
-    """Seeded Poisson arrival times in VIRTUAL time — the phase
-    multiplier and termination read virtual time only, so the offered
-    sequence (arrival times + however many there are) is exactly
-    (seed, trace, rate, duration)-determined; wall clock only paces
-    the replay.  Exactly ONE rng.exponential per yielded arrival, so
-    callers interleave their own size/payload draws off the same rng
-    without perturbing the arrival sequence — both the request
-    open-loop and the decode bench share this generator so their
-    replay disciplines can never diverge."""
-    t_virtual = 0.0
-    while True:
-        r = rate * mult_at(phases, t_virtual / duration)
-        t_virtual += rng.exponential(1.0 / r)
-        if t_virtual >= duration:
-            return
-        yield t_virtual
-
-
 def run_open_loop(a, target, input_shape, duration, size_cap):
     """Seeded Poisson arrival generator; returns (latencies, shed,
     errors, offered).  Every submitted future is awaited, so
@@ -255,7 +240,8 @@ def run_open_loop(a, target, input_shape, duration, size_cap):
 
     t_start = time.perf_counter()
     offered = 0
-    for t_virtual in virtual_arrivals(rng, a.rate, phases, duration):
+    for t_virtual in virtual_arrivals(rng, a.rate, phases, duration,
+                                      rate_fn=arrival_rate_fn(a)):
         # submit() never splits, so open-loop sizes stay on the ladder
         n = int(rng.randint(1, size_cap + 1))
         while True:
@@ -399,7 +385,8 @@ def run_decode_bench(a):
             with lock:
                 processed[0] += 1
 
-    for t_virtual in virtual_arrivals(rng, a.rate, phases, duration):
+    for t_virtual in virtual_arrivals(rng, a.rate, phases, duration,
+                                      rate_fn=arrival_rate_fn(a)):
         plen = int(rng.randint(1, a.prompt_max + 1))
         olen = int(rng.randint(1, a.out_max + 1))
         prompt = rng.randint(0, model.cfg.vocab_size, plen).astype(np.int32)
@@ -447,8 +434,8 @@ def run_decode_bench(a):
         "mode": "decode_open_loop",
         "backend": jax.default_backend(),
         "model": "tiny_lm" + ("_int8kv" if a.int8_kv else ""),
-        "trace": a.trace, "seed": a.seed, "rate": a.rate,
-        "duration": round(wall, 2),
+        "trace": a.trace, "arrivals": a.arrivals, "seed": a.seed,
+        "rate": a.rate, "duration": round(wall, 2),
         "slots": a.slots, "page_size": a.page_size,
         "pool_pages": eng.kv.n_pages,
         "offered": offered, "completed": len(totals),
@@ -565,8 +552,9 @@ def main():
         "smoke": bool(a.smoke),
     }
     if a.open_loop:
-        summary.update({"trace": a.trace, "seed": a.seed,
-                        "rate": a.rate, "duration": round(wall, 2)})
+        summary.update({"trace": a.trace, "arrivals": a.arrivals,
+                        "seed": a.seed, "rate": a.rate,
+                        "duration": round(wall, 2)})
     if a.replicas > 1:
         browned = rec.counter_value("serving/brownout_requests")
         admitted = rec.counter_value("serving/requests")

@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Fleet-telemetry/SLO chaos smoke (ISSUE 16 acceptance, CI
+``slo-smoke``): two CPU decode engines + one trainer under ONE
+:class:`MetricsAggregator`, then
+
+  1. **healthy baseline** — open client load on both engines while the
+     aggregator scrapes and the SLO engine evaluates: no breach;
+  2. **injected decode stall** — a ``serving.decode_step:delay`` fault
+     (the PR-10 site) wedges every decode step, blowing TTFT p99 past
+     the objective threshold: the dual-window burn-rate alert must
+     fire, appearing as (a) an ``slo_event`` record, (b) ``slo/*``
+     gauges on the fleet ``/metrics`` over real HTTP, and (c) in
+     ``trace_summary.py slo`` output;
+  3. **member death mid-scrape** — one engine's introspection server
+     is torn down while the aggregator keeps polling: the fleet
+     ``/metrics`` must KEEP serving (HTTP 200) with that source's last
+     samples retained and flagged ``stale="1"``, and ``/healthz`` must
+     flip to the worst-of 503 naming the stale source.
+
+Emits ONE machine-parseable JSON line last (the CI contract), after
+rendering the objective table with ``trace_summary.py slo``.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np                                         # noqa: E402
+
+from bigdl_tpu import faults, nn                           # noqa: E402
+from bigdl_tpu.data.dataset import DataSet                 # noqa: E402
+from bigdl_tpu.models import transformer as T              # noqa: E402
+from bigdl_tpu.observability import (JsonlSink,            # noqa: E402
+                                     MetricsAggregator, Recorder,
+                                     SLOEngine, SLObjective)
+from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger   # noqa: E402
+from bigdl_tpu.serving import DecodeEngine, ModelRegistry  # noqa: E402
+
+TTFT_MS = 250.0         # objective threshold; healthy CPU TTFT is far
+                        # below, the 600ms wedge far above
+WEDGE_MS = 600          # serving.decode_step delay per step
+WINDOW_S = 30.0         # SLO window (fast window = 2.5s)
+STALE_AFTER_S = 1.5     # aggregator staleness budget
+
+FAILURES = []
+
+
+def check(ok, msg):
+    print(f"# {'ok' if ok else 'FAIL'}: {msg}", flush=True)
+    if not ok:
+        FAILURES.append(msg)
+    return ok
+
+
+def build_engine(model):
+    reg = ModelRegistry()
+    reg.register("lm", model)
+    eng = DecodeEngine(reg, "lm", slots=4, page_size=8, max_context=64,
+                       max_prompt=16, max_new_tokens=8,
+                       recorder=Recorder(annotate=False))
+    eng.warmup()
+    return eng
+
+
+def drive(engines, rng, n, timeout=60.0):
+    """Submit n requests round-robin and wait for all of them."""
+    futs = []
+    for i in range(n):
+        eng = engines[i % len(engines)]
+        prompt = rng.randint(0, 256, int(rng.randint(2, 10))) \
+            .astype(np.int32)
+        futs.append(eng.submit("lm", prompt,
+                               max_new_tokens=int(rng.randint(2, 5))))
+    for f in futs:
+        f.result(timeout)
+
+
+def fetch(url):
+    with urllib.request.urlopen(url, timeout=5.0) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def main():
+    out_dir = tempfile.mkdtemp(prefix="slo_smoke_")
+    slo_jsonl = os.path.join(out_dir, "slo.jsonl")
+    rng = np.random.RandomState(0)
+
+    # -- the fleet: two decode engines + one trainer -------------------- #
+    model = T.build("tiny", dropout=0.0, n_layers=2, max_len=128)
+    eng_a = build_engine(model)
+    eng_b = build_engine(model)
+    srv_b = eng_b.serve_metrics(port=0)     # scraped over REAL http
+
+    x = np.random.RandomState(1).randn(16 * 20, 8).astype(np.float32)
+    y = (np.random.RandomState(2).randint(0, 3, 16 * 20) + 1) \
+        .astype(np.float32)
+    trainer = (LocalOptimizer(nn.Sequential(nn.Linear(8, 3),
+                                            nn.LogSoftMax()),
+                              DataSet.minibatch_arrays(x, y, 16,
+                                                       shuffle=False),
+                              nn.ClassNLLCriterion(), batch_size=16)
+               .set_optim_method(SGD(learning_rate=0.1))
+               .set_end_when(Trigger.max_epoch(1))
+               .set_telemetry(Recorder(annotate=False)))
+    train_thread = threading.Thread(target=trainer.optimize, daemon=True)
+    train_thread.start()
+
+    agg = MetricsAggregator(stale_after=STALE_AFTER_S)
+    agg.recorder.add_sink(JsonlSink(slo_jsonl))
+    agg.add(eng_a, name="engineA")
+    agg.add_endpoint("engineB", srv_b.url(""))
+    agg.add(trainer, name="train")
+    fleet = agg.serve(port=0)
+    print(f"# fleet surface on {fleet.url('')}", flush=True)
+
+    slo = SLOEngine(
+        agg.store,
+        [SLObjective("decode_ttft_p99", target=0.9, window=WINDOW_S,
+                     series=("*decode*ttft_ms/p99",), threshold=TTFT_MS,
+                     burn_alert=2.0)],
+        recorder=agg.recorder)
+
+    def tick():
+        agg.scrape()
+        return slo.evaluate()
+
+    # -- leg 1: healthy baseline --------------------------------------- #
+    for _ in range(4):
+        drive([eng_a, eng_b], rng, 8)
+        tick()
+        time.sleep(0.1)
+    healthy_p99 = eng_a.recorder.hist_quantiles(
+        "decode/ttft_ms", (99.0,))["p99"]
+    check(not slo.breached(),
+          f"baseline: no breach (ttft p99 {healthy_p99:.1f}ms "
+          f"< {TTFT_MS:.0f}ms)")
+
+    # -- leg 2: injected decode stall -> burn-rate breach --------------- #
+    faults.arm(f"serving.decode_step:delay:{WEDGE_MS}")
+    try:
+        deadline = time.time() + 60.0
+        while not slo.breached() and time.time() < deadline:
+            drive([eng_a, eng_b], rng, 4, timeout=120.0)
+            tick()
+    finally:
+        faults.disarm()
+    fault_p99 = eng_a.recorder.hist_quantiles(
+        "decode/ttft_ms", (99.0,))["p99"]
+    check(faults.injected_total() > 0, "fault actually fired")
+    check("decode_ttft_p99" in slo.breached(),
+          f"wedged decode breached the TTFT objective "
+          f"(p99 {fault_p99:.0f}ms)")
+    events = agg.recorder.recent_records(rec_type="slo_event")
+    check(any(e.get("kind") == "breach"
+              and e.get("objective") == "decode_ttft_p99"
+              for e in events),
+          "breach emitted as an slo_event record")
+
+    code, body = fetch(fleet.url("/metrics"))
+    check(code == 200 and
+          "bigdl_slo_decode_ttft_p99_breach 1.0" in body,
+          "breach visible as slo/* gauge on fleet /metrics over http")
+    check('source="engineB"' in body and 'source="train.trainer"' in body,
+          "fleet /metrics carries every source's samples")
+    _, series_body = fetch(fleet.url("/series?name="
+                                     + urllib.parse.quote(
+                                         "engineA.lm/bigdl_decode_ttft_ms"
+                                         "/p99")))
+    check(json.loads(series_body)["points"],
+          "/series serves the scraped ttft p99 points")
+
+    # -- leg 3: member death mid-scrape -> stale retention -------------- #
+    srv_b.stop()
+    eng_b.shutdown(drain=False)
+    deadline = time.time() + 15.0
+    while "engineB" not in agg.stale_sources() and time.time() < deadline:
+        agg.scrape()
+        time.sleep(0.3)
+    check("engineB" in agg.stale_sources(),
+          "dead member flagged stale after the scrape-age budget")
+    code, body = fetch(fleet.url("/metrics"))
+    stale_retained = any('source="engineB"' in ln and 'stale="1"' in ln
+                         for ln in body.splitlines())
+    check(code == 200 and stale_retained,
+          "fleet /metrics still serves (200) with the dead member's "
+          "last samples retained and flagged stale=\"1\"")
+    try:
+        code, hz = fetch(fleet.url("/healthz"))
+    except urllib.error.HTTPError as e:
+        code, hz = e.code, e.read().decode("utf-8")
+    hz = json.loads(hz)
+    check(code == 503 and not hz["ok"]
+          and "engineB" in hz["stale_sources"],
+          "worst-of /healthz is 503 naming the stale source")
+
+    # -- wrap up -------------------------------------------------------- #
+    train_thread.join(timeout=60.0)
+    slo.summary_record()
+    agg.recorder.flush()
+    eng_a.shutdown(drain=False)
+    agg.close()
+
+    print("# --- trace_summary slo ---", flush=True)
+    ts = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts",
+                                      "trace_summary.py"),
+         "slo", slo_jsonl],
+        capture_output=True, text=True, timeout=120)
+    print(ts.stdout, flush=True)
+    check(ts.returncode == 0
+          and "decode_ttft_p99" in ts.stdout
+          and "breach" in ts.stdout,
+          "trace_summary slo renders the breach")
+
+    summary = {
+        "metric": "slo_smoke",
+        "ok": not FAILURES,
+        "failures": FAILURES,
+        "ttft_p99_healthy_ms": round(healthy_p99, 2),
+        "ttft_p99_fault_ms": round(fault_p99, 2),
+        "breached": slo.breached(),
+        "slo_events": len(events),
+        "stale_sources": agg.stale_sources(),
+        "faults_injected": faults.injected_total(),
+        "jsonl": slo_jsonl,
+    }
+    print(json.dumps(summary), flush=True)
+    return 0 if not FAILURES else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -82,6 +82,17 @@ Two subcommands:
 
         python scripts/trace_summary.py fleet /tmp/fleet.jsonl /tmp/job_*.jsonl
 
+  slo                service-level-objective verdicts from the SLO
+                     engine's telemetry: the objective table
+                     (compliance %, error budget remaining, fast/slow
+                     burn rates, breach state) from the latest
+                     ``slo_summary`` record, plus the chronological
+                     breach/recovery timeline from ``slo_event``
+                     records — the one-command answer to "did we blow
+                     the TTFT budget, and when":
+
+        python scripts/trace_summary.py slo /tmp/slo.jsonl
+
 CPU-only (no device access), so it is safe to run while the tunnel is
 wedged.
 """
@@ -406,6 +417,71 @@ def summarize_fleet(events, out=print):
     out("\n== per-job event sequence ==")
     for job in jobs:
         out(f"  {job}: {' -> '.join(seen[job])}")
+
+
+def load_slo(paths):
+    """``slo_event`` transitions (chronological, source-tagged) plus
+    the LATEST ``slo_summary`` objective table from telemetry JSONL
+    files (directories are scanned for ``*.jsonl``)."""
+    expanded = []
+    for p in paths:
+        if os.path.isdir(p):
+            expanded += sorted(glob.glob(os.path.join(p, "*.jsonl")))
+        else:
+            expanded.append(p)
+    events, summaries = [], []
+    for p in expanded:
+        src = os.path.basename(p)
+        for rec in iter_jsonl(p):
+            if rec.get("type") == "slo_event":
+                events.append((src, rec))
+            elif rec.get("type") == "slo_summary":
+                summaries.append(rec)
+    events.sort(key=lambda sr: sr[1].get("time") or 0.0)
+    summaries.sort(key=lambda r: r.get("time") or 0.0)
+    return events, (summaries[-1] if summaries else None)
+
+
+def _slo_cells(r):
+    """compliance/budget/burn-fast/burn-slow cells for one objective
+    verdict (shared by the table and the timeline)."""
+    if r.get("no_data") or r.get("compliance") is None:
+        return ("no data", "-", "-", "-")
+    bf = r.get("burn_fast")
+    return (f"{100.0 * r['compliance']:.2f}%",
+            f"{100.0 * r['budget_remaining']:.1f}%",
+            "-" if bf is None else f"{bf:.2f}",
+            f"{r['burn_slow']:.2f}")
+
+
+def summarize_slo(events, summary, out=print):
+    """Render the objective table (from the latest ``slo_summary``)
+    and the breach/recovery timeline (from ``slo_event`` records)."""
+    if not events and summary is None:
+        out("no slo events or summaries found")
+        return
+    if summary is not None:
+        out("== SLO objectives ==")
+        out(f"  {'objective':<24} {'compliance':>10} {'budget':>8} "
+            f"{'burn(fast':>9}{'/slow)':<7} state")
+        for r in summary.get("objectives", []):
+            comp, budget, bf, bs = _slo_cells(r)
+            state = ("NO DATA" if r.get("no_data")
+                     else "BREACH" if r.get("breach") else "ok")
+            out(f"  {r.get('objective', '?'):<24} {comp:>10} "
+                f"{budget:>8} {bf:>9}/{bs:<6} {state}")
+    if events:
+        if summary is not None:
+            out("")
+        out("== breach timeline ==")
+        t0 = min(ev.get("time") or 0.0 for _, ev in events)
+        out(f"  {'t':>8}  {'objective':<24} {'event':<10} detail")
+        for _, ev in events:
+            comp, budget, bf, bs = _slo_cells(ev)
+            dt = (ev.get("time") or 0.0) - t0
+            out(f"  {dt:>+7.2f}s  {ev.get('objective', '?'):<24} "
+                f"{ev.get('kind', '?'):<10} compliance={comp} "
+                f"budget={budget} burn={bf}/{bs}")
 
 
 def load_serving(paths):
@@ -866,6 +942,14 @@ def main_fleet(argv):
     summarize_fleet(events)
 
 
+def main_slo(argv):
+    if not argv:
+        raise SystemExit("usage: trace_summary.py slo "
+                         "<telemetry.jsonl | dir>...")
+    events, summary = load_slo(argv)
+    summarize_slo(events, summary)
+
+
 def main_health(argv):
     if not argv:
         raise SystemExit("usage: trace_summary.py health "
@@ -916,6 +1000,8 @@ def main():
         main_serving(argv[1:])
     elif argv and argv[0] == "fleet":
         main_fleet(argv[1:])
+    elif argv and argv[0] == "slo":
+        main_slo(argv[1:])
     elif argv and argv[0] == "xplane":
         main_xplane(argv[1:])
     else:           # back-compat: bare path = xplane trace dir

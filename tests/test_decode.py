@@ -308,9 +308,19 @@ def test_pool_exhaustion_backpressure(lm):
                      max_new_tokens=8)
     # each request needs up to 2 pages at full length -> the pool only
     # runs a couple at once; the rest wait in the bounded queue, which
-    # sheds at the door once full
-    long = [e.submit("lm", np.arange(8, dtype=np.int32) + 1,
-                     max_new_tokens=8) for _ in range(3)]
+    # sheds at the door once full.  The first three must all land, but
+    # the 2-deep queue can shed them if the decode thread hasn't popped
+    # one yet (single-CPU scheduling), so retry those — the sustained
+    # oversubmission below still has to shed
+    long = []
+    deadline = time.time() + 30.0
+    while len(long) < 3:
+        try:
+            long.append(e.submit("lm", np.arange(8, dtype=np.int32) + 1,
+                                 max_new_tokens=8))
+        except LoadShedError:
+            assert time.time() < deadline, "admission never drained"
+            time.sleep(0.01)
     with pytest.raises(LoadShedError):
         for _ in range(8):
             long.append(e.submit("lm", np.arange(8, dtype=np.int32) + 1,

@@ -1,0 +1,756 @@
+"""Fleet telemetry plane (ISSUE 16): MetricSeries ring + windowed
+reducers, the Prometheus parser + scrape aggregator (merge, staleness,
+member death, round-trip), the SLO/error-budget engine against
+hand-computed fixtures, `_bucket` exposition, the /series route,
+diurnal arrivals determinism, and the trace_summary slo renderer."""
+import importlib.util
+import json
+import math
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from bigdl_tpu.observability import (IntrospectionServer, MetricSeries,
+                                     MetricsAggregator, Recorder,
+                                     SeriesStore, SLObjective, SLOEngine,
+                                     default_objectives, parse_prometheus,
+                                     render_prometheus)
+from bigdl_tpu.observability.aggregate import series_key
+from bigdl_tpu.observability.recorder import _quantile
+from bigdl_tpu.serving.arrivals import (TRACES, diurnal_mult, mult_at,
+                                        virtual_arrivals)
+
+_SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+def _load_trace_summary():
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary", os.path.join(_SCRIPTS, "trace_summary.py"))
+    ts = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ts)
+    return ts
+
+
+def _get(url):
+    """(status, body) without raising on 5xx."""
+    try:
+        with urllib.request.urlopen(url) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+# --------------------------------------------------------------------- #
+# MetricSeries: ring + windowed reducers                                 #
+# --------------------------------------------------------------------- #
+def test_series_ring_wraps_and_stays_chronological():
+    s = MetricSeries(capacity=4)
+    for i in range(10):
+        s.append(float(i), float(i * 10))
+    assert len(s) == 4
+    assert s.points() == [(6.0, 60.0), (7.0, 70.0), (8.0, 80.0),
+                          (9.0, 90.0)]
+    assert s.last() == (9.0, 90.0)
+
+
+def test_series_windowed_reducers_at_ring_wrap_boundary():
+    # capacity 5, 12 appends: the ring holds t=7..11; a window of 3s
+    # from now=11 keeps t=8..11 — the reducers must see exactly those,
+    # straddling the physical wrap point
+    s = MetricSeries(capacity=5)
+    for i in range(12):
+        s.append(float(i), float(i))
+    pts = s.points(window=3.0, now=11.0)
+    assert pts == [(8.0, 8.0), (9.0, 9.0), (10.0, 10.0), (11.0, 11.0)]
+    assert s.mean(3.0, now=11.0) == (8 + 9 + 10 + 11) / 4.0
+    assert s.delta(3.0, now=11.0) == 3.0
+    assert s.rate(3.0, now=11.0) == 1.0
+    assert s.vmin(3.0, now=11.0) == 8.0
+    assert s.vmax(3.0, now=11.0) == 11.0
+    assert s.quantile(50.0, 3.0, now=11.0) == \
+        _quantile([8.0, 9.0, 10.0, 11.0], 50.0)
+
+
+def test_series_reducers_never_raise_on_thin_data():
+    s = MetricSeries(capacity=8)
+    assert s.points() == []
+    assert s.mean() is None and s.delta() is None and s.rate() is None
+    assert s.quantile(99.0) is None and s.last() is None
+    s.append(5.0, 42.0)
+    assert s.mean() == 42.0
+    assert s.delta() is None          # one point has no slope
+    assert s.rate() is None
+    # zero elapsed time between two points: rate undefined, not inf
+    s.append(5.0, 43.0)
+    assert s.rate() is None
+
+
+def test_series_window_defaults_to_newest_timestamp():
+    s = MetricSeries(capacity=8)
+    s.append(100.0, 1.0)
+    s.append(109.0, 2.0)
+    # no explicit now: the window anchors at t=109, keeping both
+    assert s.points(window=10.0) == [(100.0, 1.0), (109.0, 2.0)]
+    assert s.points(window=5.0) == [(109.0, 2.0)]
+
+
+def test_series_store_clock_match_and_summary():
+    clk = [50.0]
+    st = SeriesStore(capacity=16, clock=lambda: clk[0])
+    st.observe("decode/ttft_ms/p99", 10.0)
+    clk[0] = 60.0
+    st.observe("decode/ttft_ms/p99", 20.0)
+    st.observe("replica0/bigdl_decode_ttft_ms/p99", 30.0)
+    st.observe("other", 1.0)
+    assert st.get("decode/ttft_ms/p99").points() == [(50.0, 10.0),
+                                                     (60.0, 20.0)]
+    # bare name matches exactly or as a /-suffix; globs match anywhere
+    assert st.match("decode/ttft_ms/p99") == ["decode/ttft_ms/p99"]
+    assert st.match("bigdl_decode_ttft_ms/p99") == \
+        ["replica0/bigdl_decode_ttft_ms/p99"]
+    assert st.match("*decode*ttft_ms/p99") == [
+        "decode/ttft_ms/p99", "replica0/bigdl_decode_ttft_ms/p99"]
+    summ = st.summary("decode/ttft_ms/p99")
+    assert summ["n"] == 2 and summ["mean"] == 15.0
+    assert summ["delta"] == 10.0 and summ["rate"] == 1.0
+    assert st.summary("missing") is None
+    assert st.summary("other")["n"] == 1
+
+
+# --------------------------------------------------------------------- #
+# Recorder keep_series= + /series route                                  #
+# --------------------------------------------------------------------- #
+def test_recorder_keep_series_feeds_store_from_end_step():
+    clk = [1000.0]
+    rec = Recorder(annotate=False, keep_series=32,
+                   series_clock=lambda: clk[0])
+    for step in range(3):
+        rec.start_step(step)
+        rec.inc("data/batches")
+        rec.gauge("queue", step)
+        rec.observe("lat_ms", 10.0 * (step + 1))
+        rec.end_step(step, loss=1.0 / (step + 1))
+        clk[0] += 5.0
+    st = rec.series
+    assert st.get("loss").points() == [(1000.0, 1.0), (1005.0, 0.5),
+                                       (1010.0, 1.0 / 3.0)]
+    assert st.get("data/batches").points()[-1] == (1010.0, 3.0)
+    assert st.get("queue").points()[-1] == (1010.0, 2.0)
+    # per-step histograms land as /p50 /p95 /p99 series
+    assert st.get("lat_ms/p99").points() == [(1000.0, 10.0),
+                                             (1005.0, 20.0),
+                                             (1010.0, 30.0)]
+
+
+def test_recorder_series_tick_without_step_loop():
+    clk = [0.0]
+    rec = Recorder(annotate=False, keep_series=8,
+                   series_clock=lambda: clk[0])
+    rec.inc("serving.requests", 5)
+    rec.observe("serving.latency_ms", 7.0)
+    rec.series_tick()
+    clk[0] = 2.0
+    rec.inc("serving.requests", 3)
+    rec.series_tick()
+    assert rec.series.get("serving.requests").points() == [(0.0, 5.0),
+                                                           (2.0, 8.0)]
+    assert rec.series.get("serving.latency_ms/p99").points() == \
+        [(0.0, 7.0), (2.0, 7.0)]
+    # disabled without keep_series
+    assert Recorder(annotate=False).series is None
+    assert Recorder(annotate=False).series_tick() is None
+
+
+def test_optimizer_feeds_series_without_sinks():
+    # a sink-less Recorder skips per-step scalars (recording loss
+    # host-syncs the device) — but an attached keep_series store is a
+    # consumer, so the loss curve must land in it
+    from bigdl_tpu import nn
+    from bigdl_tpu.optim import LocalOptimizer, SGD, Trigger
+    x = np.random.RandomState(0).randn(32, 4).astype(np.float32)
+    y = (np.random.RandomState(1).randint(0, 2, 32) + 1).astype(np.float32)
+    opt = (LocalOptimizer(nn.Sequential(nn.Linear(4, 2), nn.LogSoftMax()),
+                          (x, y), nn.ClassNLLCriterion(), batch_size=16)
+           .set_optim_method(SGD(learning_rate=0.1))
+           .set_end_when(Trigger.max_epoch(2))
+           .set_telemetry(Recorder(annotate=False, keep_series=32)))
+    opt.optimize()
+    loss = opt._recorder.series.get("loss")
+    assert loss is not None and len(loss) == 4     # 2 epochs x 2 steps
+    assert all(v > 0 for _, v in loss.points())
+
+
+def test_series_http_route():
+    clk = [10.0]
+    rec = Recorder(annotate=False, keep_series=8,
+                   series_clock=lambda: clk[0])
+    rec.start_step(0)
+    rec.end_step(0, loss=2.5)
+    srv = IntrospectionServer(rec).start()
+    try:
+        code, body = _get(srv.url("/series"))
+        assert code == 200 and "loss" in json.loads(body)["names"]
+        code, body = _get(srv.url("/series?name=loss&window=60"))
+        payload = json.loads(body)
+        assert code == 200
+        assert payload["points"] == [[10.0, 2.5]]
+        assert payload["summary"]["n"] == 1
+        code, body = _get(srv.url("/series?name=missing"))
+        assert json.loads(body)["points"] == []
+    finally:
+        srv.stop()
+
+
+def test_series_route_404_without_store():
+    srv = IntrospectionServer(Recorder(annotate=False)).start()
+    try:
+        code, _ = _get(srv.url("/series"))
+        assert code == 404
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------------------- #
+# Prometheus: _bucket exposition golden + parser round-trip              #
+# --------------------------------------------------------------------- #
+def test_bucket_exposition_golden_line_by_line():
+    rec = Recorder(annotate=False)
+    rec.set_hist_buckets({"decode/ttft_ms": (50, 100, 200)})
+    for v in (10.0, 60.0, 150.0, 400.0):
+        rec.observe("decode/ttft_ms", v)
+    rec.observe("other_ms", 3.0)        # not opted in: stays a summary
+    assert render_prometheus(rec).splitlines() == [
+        "# HELP bigdl_decode_ttft_ms histogram decode/ttft_ms",
+        "# TYPE bigdl_decode_ttft_ms histogram",
+        'bigdl_decode_ttft_ms_bucket{le="50.0"} 1',
+        'bigdl_decode_ttft_ms_bucket{le="100.0"} 2',
+        'bigdl_decode_ttft_ms_bucket{le="200.0"} 3',
+        'bigdl_decode_ttft_ms_bucket{le="+Inf"} 4',
+        "bigdl_decode_ttft_ms_sum 620.0",
+        "bigdl_decode_ttft_ms_count 4",
+        "# HELP bigdl_other_ms histogram other_ms",
+        "# TYPE bigdl_other_ms summary",
+        'bigdl_other_ms{quantile="0.5"} 3.0',
+        'bigdl_other_ms{quantile="0.95"} 3.0',
+        'bigdl_other_ms{quantile="0.99"} 3.0',
+        "bigdl_other_ms_sum 3.0",
+        "bigdl_other_ms_count 1",
+    ]
+
+
+def test_bucket_family_spec_and_step_lifecycle():
+    rec = Recorder(annotate=False)
+    rec.set_hist_buckets({"decode/*": (1.0, 2.0)})
+    rec.observe("decode/ttft_ms", 1.0)       # le is inclusive
+    rec.observe("decode/ttft_ms", 1.5)
+    rec.observe("decode/intertoken_ms", 9.0)
+    assert rec.hist_buckets("decode/ttft_ms") == ((1.0, 2.0), [1, 1, 0])
+    assert rec.hist_buckets("decode/intertoken_ms") == \
+        ((1.0, 2.0), [0, 0, 1])
+    assert rec.hist_buckets("unrelated") is None
+    # +Inf bucket always equals _count in the rendered exposition
+    text = render_prometheus(rec)
+    p = parse_prometheus(text)
+    by = {(n, tuple(sorted(l.items()))): v for n, l, v in p["samples"]}
+    assert by[("bigdl_decode_ttft_ms_bucket", (("le", "+Inf"),))] == \
+        by[("bigdl_decode_ttft_ms_count", ())]
+    # bucket counts share the per-step histogram lifecycle
+    rec.start_step(0)
+    rec.end_step(0)
+    assert rec.hist_buckets("decode/ttft_ms") is None
+
+
+def test_parse_prometheus_round_trip_with_escaped_labels():
+    rec = Recorder(annotate=False)
+    rec.inc("fault/injected", 2)
+    rec.gauge("mem/peak", float("nan"))
+    rec.gauge('weird"name\\x', 1.0)
+    text = render_prometheus(rec, labels={"job": 'a"b\\c\nd'})
+    p = parse_prometheus(text)
+    by = {n: (l, v) for n, l, v in p["samples"]}
+    labels, v = by["bigdl_fault_injected_total"]
+    assert labels == {"job": 'a"b\\c\nd'} and v == 2.0
+    assert math.isnan(by["bigdl_mem_peak"][1])
+    assert p["types"]["bigdl_fault_injected_total"] == "counter"
+
+
+def test_parse_prometheus_skips_malformed_lines():
+    text = ("# HELP x y\n# TYPE x gauge\nx 1.0\n"
+            "garbage line without value\n"
+            "123bad_name 2\n"
+            "ok_inf +Inf\n")
+    p = parse_prometheus(text)
+    names = [n for n, _, _ in p["samples"]]
+    assert names == ["x", "ok_inf"]
+    assert p["samples"][1][2] == float("inf")
+
+
+# --------------------------------------------------------------------- #
+# MetricsAggregator: merge, staleness, member death, round-trip          #
+# --------------------------------------------------------------------- #
+def _mk_replica(ttft_ms):
+    rec = Recorder(annotate=False)
+    rec.inc("decode/requests", 10)
+    for v in ttft_ms:
+        rec.observe("decode/ttft_ms", v)
+    return rec
+
+
+def test_aggregator_merges_sources_with_labels_and_series():
+    clk = [100.0]
+    agg = MetricsAggregator(clock=lambda: clk[0], stale_after=5.0)
+    agg.add_recorder("replica0", _mk_replica([10.0, 12.0]))
+    agg.add_recorder("replica1", _mk_replica([20.0, 22.0]))
+    out = agg.scrape()
+    assert out == {"time": 100.0, "sources": 2, "ok": 2, "errors": 0,
+                   "stale": []}
+    body = agg.render()
+    assert 'bigdl_decode_requests_total{source="replica0"} 10.0' in body
+    assert 'bigdl_decode_requests_total{source="replica1"} 10.0' in body
+    # summary quantiles flatten into /pXX series keyed per source
+    assert agg.store.get("replica0/bigdl_decode_ttft_ms/p99") is not None
+    assert agg.store.get("replica1/bigdl_decode_ttft_ms/p99") is not None
+
+
+def test_aggregated_metrics_reparse_through_own_parser():
+    agg = MetricsAggregator(clock=lambda: 1.0, stale_after=5.0)
+    agg.add_recorder("a", _mk_replica([10.0]))
+    agg.add_recorder("b", _mk_replica([20.0]))
+    agg.scrape()
+    p = parse_prometheus(agg.render())
+    reqs = [(l, v) for n, l, v in p["samples"]
+            if n == "bigdl_decode_requests_total"]
+    assert ({"source": "a"}, 10.0) in reqs
+    assert ({"source": "b"}, 10.0) in reqs
+    # one TYPE header per metric, suffix samples grouped under it
+    assert p["types"]["bigdl_decode_ttft_ms"] == "summary"
+    # and the aggregator's own telemetry rides along
+    assert any(n == "bigdl_agg_scrapes_total" for n, _, _ in p["samples"])
+
+
+def test_aggregator_staleness_retains_and_flags_then_recovers():
+    clk = [0.0]
+    healthy = [True]
+    rec = _mk_replica([10.0])
+
+    def fetch():
+        if not healthy[0]:
+            raise ConnectionError("member died mid-scrape")
+        return render_prometheus(rec)
+
+    agg = MetricsAggregator(clock=lambda: clk[0], stale_after=3.0)
+    agg.add_source("rep", fetch)
+    agg.scrape()
+    assert agg.stale_sources() == []
+    # member dies: scrapes fail, last samples retained, stale only
+    # once the age budget is exceeded
+    healthy[0] = False
+    clk[0] = 2.0
+    out = agg.scrape()
+    assert out["errors"] == 1 and out["stale"] == []      # within budget
+    assert 'source="rep"' in agg.render()
+    assert 'stale="1"' not in agg.render()
+    clk[0] = 4.0
+    out = agg.scrape()
+    assert out["stale"] == ["rep"]
+    body = agg.render()
+    assert 'bigdl_decode_requests_total{source="rep",stale="1"} 10.0' \
+        in body                                           # retained + flagged
+    hz = agg.healthz()
+    assert hz["ok"] is False and hz["stale_sources"] == ["rep"]
+    assert agg.recorder.counter_value("agg/scrape_errors") == 2.0
+    # member returns: flag clears on the next successful scrape
+    healthy[0] = True
+    clk[0] = 5.0
+    assert agg.scrape()["stale"] == []
+    assert 'stale="1"' not in agg.render()
+    assert agg.healthz()["ok"] is True
+
+
+def test_aggregator_member_death_over_real_http():
+    rec = _mk_replica([15.0])
+    srv = IntrospectionServer(rec).start()
+    port = srv.port
+    clk = [0.0]
+    agg = MetricsAggregator(clock=lambda: clk[0], stale_after=1.0)
+    agg.add_endpoint("member", f"http://127.0.0.1:{port}")
+    try:
+        assert agg.scrape()["ok"] == 1
+        srv.stop()                       # hard-kill the scraped server
+        clk[0] = 2.0
+        out = agg.scrape()
+        assert out["errors"] == 1 and out["stale"] == ["member"]
+        assert 'source="member",stale="1"' in agg.render()
+        # member restarts on the same port: next scrape readmits it
+        srv = IntrospectionServer(rec, port=port).start()
+        clk[0] = 3.0
+        assert agg.scrape()["stale"] == []
+    finally:
+        srv.stop()
+
+
+def test_aggregator_add_auto_detects_hooked_objects():
+    class Host:
+        def __init__(self):
+            self.r1 = Recorder(annotate=False)
+            self.r2 = Recorder(annotate=False)
+
+        def telemetry_sources(self):
+            return [("set", self.r1), ("replica0", self.r2)]
+
+    agg = MetricsAggregator(clock=lambda: 1.0)
+    agg.add(Host(), name="serve")
+    agg.add(Recorder(annotate=False), name="bare")
+    assert agg.source_names() == ["serve.set", "serve.replica0", "bare"]
+    with pytest.raises(TypeError):
+        agg.add(42)
+
+
+def test_serving_hosts_expose_telemetry_sources():
+    from bigdl_tpu import nn
+    from bigdl_tpu.serving import ModelRegistry, ServingEngine
+    reg = ModelRegistry()
+    reg.register("m", nn.Sequential(nn.Linear(4, 2)), input_shape=(4,))
+    eng = ServingEngine(reg, max_batch=4, max_delay_ms=1.0,
+                        recorder=Recorder(annotate=False))
+    try:
+        assert eng.telemetry_sources() == [("serving", eng.recorder)]
+    finally:
+        eng.shutdown(drain=False)
+
+
+def test_aggregator_series_filter_bounds_the_store():
+    agg = MetricsAggregator(
+        clock=lambda: 1.0,
+        series_filter=lambda key: "ttft" in key)
+    agg.add_recorder("r", _mk_replica([10.0]))
+    agg.scrape()
+    assert all("ttft" in n for n in agg.store.names())
+    assert agg.store.names() != []
+
+
+def test_aggregator_http_surface():
+    agg = MetricsAggregator(clock=lambda: 1.0, stale_after=100.0)
+    agg.add_recorder("rep", _mk_replica([10.0]))
+    agg.scrape()
+    srv = agg.serve(port=0)
+    try:
+        code, body = _get(srv.url("/metrics"))
+        assert code == 200 and 'source="rep"' in body
+        code, body = _get(srv.url("/healthz"))
+        assert code == 200 and json.loads(body)["ok"] is True
+        code, body = _get(
+            srv.url("/series?name=rep/bigdl_decode_ttft_ms/p99"))
+        assert code == 200 and json.loads(body)["points"]
+    finally:
+        agg.close()
+
+
+# --------------------------------------------------------------------- #
+# series_key naming                                                      #
+# --------------------------------------------------------------------- #
+def test_series_key_flattens_quantiles_and_sorts_labels():
+    assert series_key("r0", "bigdl_decode_ttft_ms",
+                      {"quantile": "0.99"}) == \
+        "r0/bigdl_decode_ttft_ms/p99"
+    assert series_key("r0", "bigdl_decode_ttft_ms",
+                      {"quantile": "0.5"}) == \
+        "r0/bigdl_decode_ttft_ms/p50"
+    assert series_key("r0", "m", {"b": "2", "a": "1"}) == "r0/m{a=1,b=2}"
+    # synthetic aggregation labels never leak into keys
+    assert series_key("r0", "m", {"source": "x", "stale": "1"}) == "r0/m"
+
+
+# --------------------------------------------------------------------- #
+# SLO engine: hand-computed burn-rate fixtures                           #
+# --------------------------------------------------------------------- #
+def test_slo_threshold_burn_rate_matches_hand_computed_fixture():
+    st = SeriesStore(capacity=64, clock=lambda: 120.0)
+    # 20 p99 points, one per 6s tick over a 120s window; the last 3
+    # exceed the 100ms threshold
+    for i in range(20):
+        st.observe("r0/decode_ttft_ms/p99",
+                   150.0 if i >= 17 else 50.0, t=6.0 * (i + 1))
+    obj = SLObjective("ttft", target=0.9, window=120.0,
+                      fast_window=18.0, threshold=100.0,
+                      series=("*decode_ttft_ms/p99",), burn_alert=2.0)
+    r = obj.evaluate(st, now=120.0)
+    # slow window [0, 120] holds all 20 points, 17 good
+    assert (r["good"], r["total"]) == (17.0, 20.0)
+    assert r["compliance"] == 17.0 / 20.0
+    assert r["burn_slow"] == (1.0 - 17.0 / 20.0) / (1.0 - 0.9)
+    assert r["budget_remaining"] == 1.0 - r["burn_slow"]
+    # fast window [102, 120] holds t=102..120 -> points 17..20 (i>=16),
+    # of which 3 are bad
+    assert r["burn_fast"] == (1.0 - 1.0 / 4.0) / (1.0 - 0.9)
+    # burn_slow 1.5 < alert 2.0: fast alone must NOT breach
+    assert r["breach"] is False
+    # one more bad point tips the slow window past the alert
+    st.observe("r0/decode_ttft_ms/p99", 150.0, t=120.0)
+    r2 = obj.evaluate(st, now=120.0)
+    assert r2["compliance"] == 17.0 / 21.0
+    assert r2["burn_slow"] == (1.0 - 17.0 / 21.0) / (1.0 - 0.9)
+    assert r2["burn_slow"] >= 1.9                      # ~1.90
+    # still below 2.0 -> no breach; this pins the dual-window AND
+    assert r2["breach"] is False
+    st.observe("r0/decode_ttft_ms/p99", 150.0, t=120.0)
+    r3 = obj.evaluate(st, now=120.0)
+    assert r3["burn_slow"] == (1.0 - 17.0 / 22.0) / (1.0 - 0.9)
+    assert r3["burn_slow"] > 2.0 and r3["burn_fast"] > 2.0
+    assert r3["breach"] is True
+
+
+def test_slo_ratio_mode_matches_hand_computed_fixture():
+    st = SeriesStore(capacity=64, clock=lambda: 100.0)
+    # counters sampled at t=0 and t=100: 100 requests, 8 shed
+    for t, (req, shed) in ((0.0, (0.0, 0.0)), (100.0, (100.0, 8.0))):
+        st.observe("r0/decode_requests_total", req, t=t)
+        st.observe("r0/decode_shed_deadline_total", shed, t=t)
+    obj = SLObjective("shed", target=0.95, window=200.0,
+                      fast_window=200.0,
+                      bad_series=("*shed_*",),
+                      total_series=("*requests*",), burn_alert=1.0)
+    r = obj.evaluate(st, now=100.0)
+    assert (r["good"], r["total"]) == (8.0, 100.0)    # bad, total deltas
+    assert r["compliance"] == 1.0 - 8.0 / 100.0
+    # bit-for-bit in the engine's own form: (1 - compliance)/(1 - target)
+    assert r["burn_slow"] == (1.0 - (1.0 - 8.0 / 100.0)) / (1.0 - 0.95)
+    assert r["breach"] is True
+
+
+def test_slo_no_data_never_breaches():
+    st = SeriesStore(clock=lambda: 10.0)
+    eng = SLOEngine(st, [SLObjective("x", target=0.9, window=60.0,
+                                     series=("*missing*",),
+                                     threshold=1.0)])
+    r = eng.evaluate()["x"]
+    assert r["no_data"] is True and r["breach"] is False
+    assert r["compliance"] is None and r["budget_remaining"] is None
+    assert eng.recorder.gauge_value("slo/x/no_data") == 1.0
+
+
+def test_slo_engine_emits_transition_events_and_gauges():
+    clk = [0.0]
+    st = SeriesStore(capacity=256, clock=lambda: clk[0])
+    obj = SLObjective("ttft", target=0.5, window=10.0, fast_window=10.0,
+                      series=("lat/p99",), threshold=100.0,
+                      burn_alert=1.5)
+    eng = SLOEngine(st, [obj], clock=lambda: clk[0])
+    # healthy points
+    for t in range(5):
+        st.observe("lat/p99", 10.0, t=float(t))
+    clk[0] = 4.0
+    assert eng.evaluate()["ttft"]["breach"] is False
+    assert eng.recorder.gauge_value("slo/ttft/breach") == 0.0
+    assert eng.recorder.recent_records(rec_type="slo_event") == []
+    # all-bad window: breach transition emits exactly one event
+    clk[0] = 20.0
+    for t in range(15, 21):
+        st.observe("lat/p99", 500.0, t=float(t))
+    assert eng.evaluate()["ttft"]["breach"] is True
+    assert eng.evaluate()["ttft"]["breach"] is True      # still breached
+    events = eng.recorder.recent_records(rec_type="slo_event")
+    assert [e["kind"] for e in events] == ["breach"]
+    assert events[0]["objective"] == "ttft"
+    assert eng.recorder.counter_value("slo/breaches") == 1.0
+    assert eng.recorder.gauge_value("slo/ttft/breach") == 1.0
+    assert eng.breached() == ["ttft"]
+    # recovery: window ages the bad points out via fresh good ones
+    clk[0] = 40.0
+    for t in range(31, 41):
+        st.observe("lat/p99", 10.0, t=float(t))
+    assert eng.evaluate()["ttft"]["breach"] is False
+    events = eng.recorder.recent_records(rec_type="slo_event")
+    assert [e["kind"] for e in events] == ["breach", "recovered"]
+    assert eng.recorder.counter_value("slo/recoveries") == 1.0
+    assert eng.breached() == []
+
+
+def test_slo_objective_validation():
+    with pytest.raises(ValueError):
+        SLObjective("x", target=0.9, window=1.0)          # no mode
+    with pytest.raises(ValueError):
+        SLObjective("x", target=0.9, window=1.0, series=("a",),
+                    bad_series=("b",), total_series=("c",))
+    with pytest.raises(ValueError):
+        SLObjective("x", target=0.9, window=1.0, series=("a",))
+    with pytest.raises(ValueError):
+        SLObjective("x", target=1.5, window=1.0, series=("a",),
+                    threshold=1.0)
+
+
+def test_default_objectives_match_both_naming_planes():
+    st = SeriesStore(clock=lambda: 0.0)
+    # raw recorder plane and aggregated plane for the same metric
+    st.observe("decode/ttft_ms/p99", 1.0, t=0.0)
+    st.observe("serve.replica0/bigdl_decode_ttft_ms/p99", 2.0, t=0.0)
+    objs = {o.name: o for o in default_objectives()}
+    ttft = objs["decode_ttft_p99"]
+    assert sorted(st.match(ttft.series)) == [
+        "decode/ttft_ms/p99",
+        "serve.replica0/bigdl_decode_ttft_ms/p99"]
+    assert set(objs) == {"decode_ttft_p99", "decode_intertoken_p99",
+                         "shed_rate", "checkpoint_writes"}
+
+
+# --------------------------------------------------------------------- #
+# end-to-end: aggregator fronting 2 replicas, injected stall, bit-for-   #
+# bit burn math, kill-one-mid-scrape                                     #
+# --------------------------------------------------------------------- #
+def test_e2e_breach_demo_with_stale_member():
+    clk = [0.0]
+    reps = [_mk_replica([]), _mk_replica([])]
+    alive = [True, True]
+
+    def fetcher(i):
+        def fetch():
+            if not alive[i]:
+                raise ConnectionError("killed mid-scrape")
+            return render_prometheus(reps[i])
+        return fetch
+
+    agg = MetricsAggregator(clock=lambda: clk[0], stale_after=5.0)
+    agg.add_source("replica0", fetcher(0))
+    agg.add_source("replica1", fetcher(1))
+    obj = SLObjective("decode_ttft_p99", target=0.9, window=40.0,
+                      fast_window=10.0, threshold=100.0,
+                      series=("*decode*ttft_ms/p99",), burn_alert=2.0)
+    slo = SLOEngine(agg.store, [obj], recorder=agg.recorder,
+                    clock=lambda: clk[0])
+    # 4 healthy scrape rounds (t=2..8): both replicas p99 = 50ms
+    for t in (2.0, 4.0, 6.0, 8.0):
+        clk[0] = t
+        for r in reps:
+            r.observe("decode/ttft_ms", 50.0)
+        agg.scrape()
+        assert slo.evaluate()["decode_ttft_p99"]["breach"] is False
+    # injected stall: both replicas observe wedged TTFTs; p99 of the
+    # cumulative window jumps past threshold for rounds t=10..16
+    for t in (10.0, 12.0, 14.0, 16.0):
+        clk[0] = t
+        for r in reps:
+            r.observe("decode/ttft_ms", 5000.0)
+        agg.scrape()
+        res = slo.evaluate()["decode_ttft_p99"]
+    # hand-computed, bit-for-bit: slow window [-24, 16] holds all 8
+    # rounds x 2 replicas = 16 points, 8 good; fast window [6, 16] is
+    # inclusive of t=6, so rounds t=6..16 -> 4 good + 8 bad of 12
+    assert (res["good"], res["total"]) == (8.0, 16.0)
+    assert res["compliance"] == 8.0 / 16.0
+    assert res["burn_slow"] == (1.0 - 8.0 / 16.0) / (1.0 - 0.9)
+    assert res["burn_fast"] == (1.0 - 4.0 / 12.0) / (1.0 - 0.9)
+    assert res["burn_slow"] == pytest.approx(5.0)
+    assert res["burn_fast"] == pytest.approx(20.0 / 3.0)
+    assert res["breach"] is True
+    assert [e["kind"] for e in
+            agg.recorder.recent_records(rec_type="slo_event")] == \
+        ["breach"]
+    # breach is visible on the fleet exposition as an slo/* gauge
+    assert "bigdl_slo_decode_ttft_p99_breach 1.0" in agg.render()
+    # kill replica1 mid-scrape: /metrics keeps serving with the dead
+    # source's last samples retained + flagged, never erroring or
+    # silently shrinking
+    alive[1] = False
+    clk[0] = 30.0
+    out = agg.scrape()
+    assert out["stale"] == ["replica1"]
+    body = agg.render()
+    assert 'source="replica1",stale="1"' in body
+    assert 'source="replica0"' in body
+    hz = agg.healthz()
+    assert hz["ok"] is False and hz["stale_sources"] == ["replica1"]
+
+
+# --------------------------------------------------------------------- #
+# diurnal arrivals: shared machinery, seeded determinism                 #
+# --------------------------------------------------------------------- #
+def test_diurnal_mult_shape():
+    assert diurnal_mult(0.0) == pytest.approx(0.25)
+    assert diurnal_mult(1.0) == pytest.approx(0.25)
+    assert diurnal_mult(0.5) == pytest.approx(3.0)
+    assert diurnal_mult(0.25) == pytest.approx((0.25 + 3.0) / 2.0)
+
+
+def test_diurnal_arrivals_deterministic_across_runs():
+    def run():
+        rng = np.random.RandomState(7)
+        return list(virtual_arrivals(rng, 50.0, TRACES["steady"], 4.0,
+                                     rate_fn=diurnal_mult))
+
+    a, b = run(), run()
+    assert a == b and len(a) > 0
+    # and genuinely different from the unmodulated Poisson trace
+    rng = np.random.RandomState(7)
+    plain = list(virtual_arrivals(rng, 50.0, TRACES["steady"], 4.0))
+    assert a != plain
+    # diurnal thins the edges of the run relative to the middle
+    mid = sum(1 for t in a if 1.0 <= t < 3.0)
+    edges = len(a) - mid
+    assert mid > edges
+
+
+def test_diurnal_composes_with_phase_traces():
+    rng = np.random.RandomState(3)
+    burst = list(virtual_arrivals(rng, 80.0, TRACES["burst"], 2.0,
+                                  rate_fn=diurnal_mult))
+    assert burst == sorted(burst)
+    assert all(0.0 < t < 2.0 for t in burst)
+    assert mult_at(TRACES["burst"], 0.5) == 6.0
+
+
+# --------------------------------------------------------------------- #
+# trace_summary slo renderer (golden)                                    #
+# --------------------------------------------------------------------- #
+def test_trace_summary_slo_golden(tmp_path):
+    ts = _load_trace_summary()
+    log = tmp_path / "slo.jsonl"
+    with open(log, "w") as f:
+        for rec in [
+            {"type": "slo_event", "time": 100.0, "kind": "breach",
+             "objective": "decode_ttft_p99", "compliance": 0.8,
+             "budget_remaining": -1.0, "burn_fast": 5.0,
+             "burn_slow": 2.0},
+            {"type": "step", "time": 101.0},          # ignored
+            {"type": "slo_event", "time": 130.5, "kind": "recovered",
+             "objective": "decode_ttft_p99", "compliance": 0.97,
+             "budget_remaining": 0.7, "burn_fast": 0.1,
+             "burn_slow": 0.3},
+            {"type": "slo_summary", "time": 140.0, "objectives": [
+                {"objective": "decode_ttft_p99", "compliance": 0.972,
+                 "budget_remaining": 0.44, "burn_fast": 0.21,
+                 "burn_slow": 0.28, "breach": False},
+                {"objective": "shed_rate", "no_data": True},
+            ]},
+        ]:
+            f.write(json.dumps(rec) + "\n")
+    lines = []
+    events, summary = ts.load_slo([str(tmp_path)])
+    ts.summarize_slo(events, summary, out=lines.append)
+    assert lines == [
+        "== SLO objectives ==",
+        "  objective                compliance   budget "
+        "burn(fast/slow)  state",
+        "  decode_ttft_p99              97.20%    44.0%      "
+        "0.21/0.28   ok",
+        "  shed_rate                   no data        -         "
+        "-/-      NO DATA",
+        "",
+        "== breach timeline ==",
+        "         t  objective                event      detail",
+        "    +0.00s  decode_ttft_p99          breach     "
+        "compliance=80.00% budget=-100.0% burn=5.00/2.00",
+        "   +30.50s  decode_ttft_p99          recovered  "
+        "compliance=97.00% budget=70.0% burn=0.10/0.30",
+    ]
+
+
+def test_trace_summary_slo_handles_empty_input(tmp_path):
+    ts = _load_trace_summary()
+    lines = []
+    events, summary = ts.load_slo([str(tmp_path)])
+    ts.summarize_slo(events, summary, out=lines.append)
+    assert lines == ["no slo events or summaries found"]
