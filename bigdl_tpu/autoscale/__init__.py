@@ -1,0 +1,21 @@
+"""bigdl_tpu.autoscale — SLO-driven autoscaling on one shared pool.
+
+The closing of ROADMAP's control loop: the telemetry plane (SLO
+burn rates, queue depth, decode occupancy) feeds a hysteresis-damped
+:class:`AutoscalePolicy`, whose sized decisions an
+:class:`AutoscaleController` actuates against the decode
+:class:`~bigdl_tpu.serving.ReplicaSet` and the shared fleet
+:class:`~bigdl_tpu.fleet.DevicePool` — co-scheduled training jobs
+elastically yield capacity at traffic peaks and take it back at
+troughs through their existing ``capacity_fn`` seam.
+
+See ``docs/autoscaling.md``.
+"""
+from __future__ import annotations
+
+from .controller import AutoscaleController
+from .policy import AutoscalePolicy, ScaleDecision
+from .signals import Signals, read_signals
+
+__all__ = ["AutoscaleController", "AutoscalePolicy", "ScaleDecision",
+           "Signals", "read_signals"]

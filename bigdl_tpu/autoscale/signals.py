@@ -1,0 +1,134 @@
+"""Signal collection for the autoscale control loop.
+
+One :func:`read_signals` call folds the three telemetry surfaces the
+policy consumes into a single :class:`Signals` snapshot:
+
+  * **SLO burn** — the worst ``burn_fast`` / ``burn_slow`` and the
+    breached-objective list, read from
+    :attr:`~bigdl_tpu.observability.slo.SLOEngine.last_results` (the
+    engine's cached verdicts) instead of re-running the window math —
+    the SLO engine owns the evaluation cadence, the policy only reads;
+  * **backlog** — summed queue depth across live replicas
+    (``*queue_depth*`` / ``*queue_rows*`` gauges in the series store);
+  * **utilisation** — mean decode slot occupancy and KV-pool fill.
+
+All reads are gauge ``last()`` values with a freshness window: a
+sample older than ``fresh`` seconds (against the STORE's clock) is
+treated as absent, so a scraper that died never feeds the policy a
+flattering stale zero.  Every field is ``None``-safe — "no data" is a
+distinct state the policy treats as "hold", never as "idle".
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+#: series-key patterns matched against BOTH naming planes — a raw
+#: recorder store (``decode/queue_depth``) and an aggregator store
+#: (``serve.replica0/bigdl_decode_queue_depth``)
+QUEUE_SERIES = ("*decode*queue_depth*", "*replica*queue_rows*")
+OCCUPANCY_SERIES = ("*decode*occupancy*",)
+KV_SERIES = ("*kv*fill*", "*pool*fill*")
+
+
+class Signals:
+    """One immutable-ish snapshot of everything the policy looks at."""
+
+    __slots__ = ("at", "burn_fast", "burn_slow", "breached", "no_data",
+                 "queue_depth", "occupancy", "kv_fill", "replicas")
+
+    def __init__(self, *, at: float, burn_fast: Optional[float] = None,
+                 burn_slow: Optional[float] = None,
+                 breached: Tuple[str, ...] = (), no_data: bool = True,
+                 queue_depth: Optional[float] = None,
+                 occupancy: Optional[float] = None,
+                 kv_fill: Optional[float] = None, replicas: int = 0):
+        self.at = float(at)
+        self.burn_fast = burn_fast
+        self.burn_slow = burn_slow
+        self.breached = tuple(breached)
+        self.no_data = bool(no_data)
+        self.queue_depth = queue_depth
+        self.occupancy = occupancy
+        self.kv_fill = kv_fill
+        self.replicas = int(replicas)
+
+    def as_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in self.__slots__}
+
+    def __repr__(self):
+        return (f"Signals(breached={list(self.breached)}, "
+                f"burn_fast={self.burn_fast}, "
+                f"queue_depth={self.queue_depth}, "
+                f"occupancy={self.occupancy}, replicas={self.replicas})")
+
+
+def _fresh_last(store, patterns: Sequence[str], now: float,
+                fresh: float):
+    """``[(key, value), ...]`` latest point per matching series, only
+    when the point is newer than ``now - fresh``."""
+    out = []
+    for key in store.match(patterns):
+        last = store.get(key).last()
+        if last is not None and last[0] >= now - fresh:
+            out.append((key, last[1]))
+    return out
+
+
+def read_signals(slo_engine=None, store=None, replica_set=None, *,
+                 now: Optional[float] = None, fresh: float = 30.0,
+                 queue_series: Sequence[str] = QUEUE_SERIES,
+                 occupancy_series: Sequence[str] = OCCUPANCY_SERIES,
+                 kv_series: Sequence[str] = KV_SERIES) -> Signals:
+    """Fold the SLO engine's cached verdicts + the series store's
+    freshest gauges + the replica set's live membership into one
+    :class:`Signals`.  Any surface may be absent (``None``); missing
+    surfaces yield ``None`` fields, never fabricated zeros."""
+    if store is None and slo_engine is not None:
+        store = slo_engine.store
+    if now is None:
+        now = float(store.now()) if store is not None \
+            else float(slo_engine.clock()) if slo_engine is not None \
+            else 0.0
+
+    burn_fast = burn_slow = None
+    breached = []
+    no_data = True
+    if slo_engine is not None and slo_engine.last_results:
+        for name, r in slo_engine.last_results.items():
+            if r.get("no_data"):
+                continue
+            no_data = False
+            bf, bs = r.get("burn_fast"), r.get("burn_slow")
+            if bf is not None and (burn_fast is None or bf > burn_fast):
+                burn_fast = bf
+            if bs is not None and (burn_slow is None or bs > burn_slow):
+                burn_slow = bs
+            if r.get("breach"):
+                breached.append(name)
+
+    queue_depth = occupancy = kv_fill = None
+    if store is not None:
+        qs = _fresh_last(store, queue_series, now, fresh)
+        if qs:
+            queue_depth = sum(v for _, v in qs)
+            no_data = False
+        occ = _fresh_last(store, occupancy_series, now, fresh)
+        if occ:
+            occupancy = sum(v for _, v in occ) / len(occ)
+            no_data = False
+        kv = _fresh_last(store, kv_series, now, fresh)
+        if kv:
+            kv_fill = sum(v for _, v in kv) / len(kv)
+
+    replicas = 0
+    if replica_set is not None:
+        from ..serving.replicas import TERMINAL_REASONS
+        replicas = sum(
+            1 for h in replica_set.health().values()
+            if not (h["state"] == "ejected"
+                    and h["reason"] in TERMINAL_REASONS))
+
+    return Signals(at=now, burn_fast=burn_fast, burn_slow=burn_slow,
+                   breached=sorted(breached), no_data=no_data,
+                   queue_depth=queue_depth, occupancy=occupancy,
+                   kv_fill=kv_fill, replicas=replicas)

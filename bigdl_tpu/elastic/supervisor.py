@@ -258,7 +258,15 @@ class ElasticSupervisor:
             steps: int = 100) -> list:
         """Train to ``steps`` total steps across however many meshes it
         takes; returns the per-step losses (recomputed steps — the tail
-        a failure rolled back — keep their latest value)."""
+        a failure rolled back — keep their latest value).
+
+        Capacity is read ONLY at planning points — the loop top and
+        the ``replan_every`` polls.  A capacity change landing between
+        them (a regrow arriving while a shrink's drain/commit is in
+        flight — the autoscaler returning borrowed devices) is
+        deferred to the next planning cycle, never interleaved with
+        the transition in progress (regression:
+        ``test_regrow_mid_drain_defers_to_next_planning_cycle``)."""
         batch_fn = batch_fn or self.batch_fn
         if batch_fn is None:
             raise ValueError("no batch_fn: pass one here or at init")

@@ -16,9 +16,9 @@ See ``docs/robustness.md`` § Fleet.
 from __future__ import annotations
 
 from .pool import (DevicePool, FleetAdmissionError, FleetJob,
-                   FleetScheduler, enable_shared_compile_cache, min_plan,
-                   plan_fleet)
+                   FleetScheduler, PoolExhaustedError,
+                   enable_shared_compile_cache, min_plan, plan_fleet)
 
 __all__ = ["DevicePool", "FleetScheduler", "FleetJob",
-           "FleetAdmissionError", "plan_fleet", "min_plan",
-           "enable_shared_compile_cache"]
+           "FleetAdmissionError", "PoolExhaustedError", "plan_fleet",
+           "min_plan", "enable_shared_compile_cache"]

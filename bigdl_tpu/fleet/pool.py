@@ -177,55 +177,162 @@ def enable_shared_compile_cache(path: str) -> str:
     return path
 
 
+class PoolExhaustedError(RuntimeError):
+    """A claim asked for more devices than the pool can give — the
+    loud rejection in an autoscaler/gang-planner race: exactly one
+    contender gets the last free device, the loser gets this (and no
+    partial gang)."""
+
+
 class DevicePool:
     """Per-device ownership ledger for one shared pool.
 
-    Bookkeeping only — it never touches jax state.  The scheduler is
-    the sole writer (under its lock); ``reassign`` swaps the whole
-    ownership map atomically so disjointness is an invariant, not a
-    hope."""
+    Bookkeeping only — it never touches jax state.  Two writer
+    disciplines share the ledger under one internal lock:
+
+      * the **gang planner** (:class:`FleetScheduler`) swaps whole
+        assignments with :meth:`reassign` over the *schedulable*
+        devices, so disjointness stays an invariant, not a hope;
+      * **incremental claimants** (the autoscale controller) take and
+        return devices one claim at a time with :meth:`claim` /
+        :meth:`release` / :meth:`transfer`.  Claimed devices leave the
+        schedulable set, so a fleet replan can never hand a decode
+        replica's device to a training job.
+
+    A race for the last free device has exactly one winner; the loser
+    gets :class:`PoolExhaustedError`, never a double-owned device.
+    ``release`` is idempotent — a retried drain path is safe."""
 
     def __init__(self, devices=None):
         if devices is None:
             import jax
             devices = jax.devices()
         self.devices = list(devices)
+        self._lock = threading.RLock()
         self._owner: Dict[Any, Optional[str]] = {d: None
                                                  for d in self.devices}
+        self._claims: set = set()       # owners registered via claim()
 
     @property
     def size(self) -> int:
         return len(self.devices)
 
     def owner_of(self, device) -> Optional[str]:
-        return self._owner.get(device)
+        with self._lock:
+            return self._owner.get(device)
 
     def owned_by(self, name: str) -> list:
-        return [d for d in self.devices if self._owner[d] == name]
+        with self._lock:
+            return [d for d in self.devices if self._owner[d] == name]
 
     def free(self) -> list:
-        return [d for d in self.devices if self._owner[d] is None]
+        with self._lock:
+            return [d for d in self.devices if self._owner[d] is None]
+
+    def schedulable(self) -> list:
+        """Devices the gang planner may assign: everything not held by
+        an incremental claimant (:meth:`claim`/:meth:`transfer`)."""
+        with self._lock:
+            return [d for d in self.devices
+                    if self._owner[d] is None
+                    or self._owner[d] not in self._claims]
+
+    def claim(self, name: str, n: int = 1) -> list:
+        """Atomically take ``n`` free devices for ``name`` (pool
+        order).  Raises :class:`PoolExhaustedError` — taking nothing —
+        when fewer than ``n`` are free: the loser of a last-device
+        race is told loudly instead of getting a partial gang."""
+        n = int(n)
+        if n <= 0:
+            raise ValueError("claim needs n >= 1")
+        with self._lock:
+            free = [d for d in self.devices if self._owner[d] is None]
+            if len(free) < n:
+                raise PoolExhaustedError(
+                    f"{name!r} asked for {n} device(s), only "
+                    f"{len(free)} free in a pool of {self.size}")
+            took = free[:n]
+            for d in took:
+                self._owner[d] = name
+            self._claims.add(str(name))
+            return took
+
+    def transfer(self, src: str, dst: str, n: int = 1,
+                 take: str = "tail") -> list:
+        """Atomically move ``n`` of ``src``'s devices to ``dst`` — the
+        elastic-yield move (a training job shedding capacity to the
+        serving tier at a traffic peak, and taking it back at the
+        trough).  ``take`` picks which end of ``src``'s holding moves:
+        ``"tail"`` (default) sheds spare/highest devices first;
+        ``"head"`` forces the victim's in-use prefix out, displacing
+        its mesh — the adversarial arrangement a rescale smoke uses to
+        prove the drain/relayout path.  Raises
+        :class:`PoolExhaustedError` when ``src`` holds fewer than
+        ``n`` — floors are the caller's policy, the ledger only
+        refuses to invent devices."""
+        n = int(n)
+        if n <= 0:
+            raise ValueError("transfer needs n >= 1")
+        with self._lock:
+            held = [d for d in self.devices if self._owner[d] == src]
+            if len(held) < n:
+                raise PoolExhaustedError(
+                    f"{src!r} holds {len(held)} device(s), cannot "
+                    f"yield {n}")
+            moved = held[:n] if take == "head" else held[-n:]
+            for d in moved:
+                self._owner[d] = dst
+            self._claims.add(str(dst))
+            if not any(o == src for o in self._owner.values()):
+                self._claims.discard(str(src))
+            return moved
 
     def reassign(self, assignment: Dict[str, Sequence]) -> None:
-        """Replace the whole ownership map with ``assignment``
-        (job → devices).  Rejects devices outside the pool and any
-        device claimed by two jobs — the gang-placement invariant."""
-        owner: Dict[Any, Optional[str]] = {d: None for d in self.devices}
-        for name, devs in assignment.items():
-            for d in devs:
-                if d not in owner:
-                    raise ValueError(f"{name!r} assigned a device "
-                                     "outside the pool")
-                if owner[d] is not None:
+        """Replace the gang-planned share of the ownership map with
+        ``assignment`` (job → devices).  Rejects devices outside the
+        pool and any device assigned to two jobs — the gang-placement
+        invariant.  Devices held by incremental claimants are
+        preserved as-is and may NOT appear in the assignment (the
+        planner must plan over :meth:`schedulable`)."""
+        with self._lock:
+            kept = {d: o for d, o in self._owner.items()
+                    if o in self._claims}
+            owner: Dict[Any, Optional[str]] = {d: kept.get(d)
+                                               for d in self.devices}
+            for name, devs in assignment.items():
+                if name in self._claims:
                     raise ValueError(
-                        f"device {d} assigned to both {owner[d]!r} "
-                        f"and {name!r}")
-                owner[d] = name
-        self._owner = owner
+                        f"{name!r} is an incremental claimant; the "
+                        "gang planner may not reassign it")
+                for d in devs:
+                    if d not in owner:
+                        raise ValueError(f"{name!r} assigned a device "
+                                         "outside the pool")
+                    if owner[d] is not None:
+                        raise ValueError(
+                            f"device {d} assigned to both "
+                            f"{owner[d]!r} and {name!r}")
+                    owner[d] = name
+            self._owner = owner
 
-    def release(self, name: str) -> None:
-        self._owner = {d: (None if o == name else o)
-                       for d, o in self._owner.items()}
+    def release(self, name: str, devices: Optional[Sequence] = None
+                ) -> list:
+        """Return ``devices`` (default: everything ``name`` holds) to
+        the free pool; returns what was actually freed.  Idempotent:
+        releasing devices the owner no longer holds — or holding
+        nothing at all — is a no-op, so drain paths can retry safely."""
+        with self._lock:
+            if devices is None:
+                victims = [d for d in self.devices
+                           if self._owner[d] == name]
+            else:
+                victims = [d for d in devices
+                           if self._owner.get(d) == name]
+            for d in victims:
+                self._owner[d] = None
+            if not any(o == name for o in self._owner.values()):
+                self._claims.discard(str(name))
+            return victims
 
 
 class FleetJob:
@@ -362,7 +469,7 @@ class FleetScheduler:
             specs = self._specs_locked() + [
                 (job.name, job.template, job.min_axes, job.priority)]
             try:
-                plan_fleet(self.pool.size, specs)
+                plan_fleet(len(self.pool.schedulable()), specs)
             except ValueError as e:
                 reject_reason = str(e)
             else:
@@ -454,27 +561,44 @@ class FleetScheduler:
         if not specs:
             self.pool.reassign({})
             return []
-        plans = plan_fleet(self.pool.size, specs)
         order = sorted(specs, key=lambda s: (-s[3],
                                              self._jobs[s[0]].seq))
-        # placement, canonical (priority, admit) order: a job KEEPS its
-        # current devices when its size is unchanged and no
-        # higher-priority job claimed them this round (no churn on a
-        # neighbor's completion); otherwise it takes the first
-        # unclaimed devices in pool order — so a high-priority arrival
-        # claims the pool prefix and displaces whoever held it
-        assignment: Dict[str, list] = {}
-        claimed: set = set()
-        for name, _t, _m, _p in order:
-            n = _prod(plans[name])
-            cur = self._jobs[name].devices
-            if len(cur) == n and not (set(cur) & claimed):
-                assignment[name] = list(cur)
-            else:
-                free = [d for d in self.pool.devices if d not in claimed]
-                assignment[name] = free[:n]
-            claimed.update(assignment[name])
-        self.pool.reassign(assignment)
+        # plan over the SCHEDULABLE share only: devices an incremental
+        # claimant (the autoscale controller) holds are not the gang
+        # planner's to hand out, and reassign() enforces that loudly.
+        # A claim can land BETWEEN the schedulable() snapshot and the
+        # reassign — the planner loses that race gracefully by
+        # replanning over the shrunken share (bounded: each retry is
+        # caused by a real concurrent claim)
+        for attempt in range(8):
+            schedulable = self.pool.schedulable()
+            plans = plan_fleet(len(schedulable), specs)
+            # placement, canonical (priority, admit) order: a job KEEPS
+            # its current devices when its size is unchanged and no
+            # higher-priority job claimed them this round (no churn on
+            # a neighbor's completion); otherwise it takes the first
+            # unclaimed devices in pool order — so a high-priority
+            # arrival claims the pool prefix and displaces whoever
+            # held it
+            assignment: Dict[str, list] = {}
+            claimed: set = set()
+            for name, _t, _m, _p in order:
+                n = _prod(plans[name])
+                cur = self._jobs[name].devices
+                if len(cur) == n and not (set(cur) & claimed) \
+                        and all(d in schedulable for d in cur):
+                    assignment[name] = list(cur)
+                else:
+                    free = [d for d in schedulable if d not in claimed]
+                    assignment[name] = free[:n]
+                claimed.update(assignment[name])
+            try:
+                self.pool.reassign(assignment)
+                break
+            except ValueError:
+                if attempt == 7:
+                    raise
+                self._rec().inc("fleet/plan_races")
         changes: List[Tuple[FleetJob, str, dict]] = []
         for name, devs in assignment.items():
             job = self._jobs[name]

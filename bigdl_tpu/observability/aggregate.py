@@ -252,6 +252,32 @@ class MetricsAggregator:
         with self._lock:
             self._sources.pop(str(name), None)
 
+    def remove_member(self, name: str, purge_series: bool = True) -> bool:
+        """Deliberate deregistration — the scale-DOWN path, as opposed
+        to a crash.  The source leaves the merged exposition AND (by
+        default) its retained samples leave the series store, so
+        ``stale="1"`` keeps meaning "crashed, dashboards should see
+        the gap" while a scaled-away member simply stops existing:
+        ``/healthz`` must not 503 forever over a replica the
+        autoscaler retired on purpose.  Members that die WITHOUT
+        deregistering keep the crash-retention behavior (samples
+        retained, flagged stale).  Idempotent: unknown names return
+        False.  Counted as ``agg/deregistered``."""
+        name = str(name)
+        with self._lock:
+            src = self._sources.pop(name, None)
+        if src is None:
+            return False
+        rec = self.recorder
+        rec.inc("agg/deregistered")
+        # drop the per-source gauges so the merged /metrics carries no
+        # ghost staleness verdict for a member that no longer exists
+        rec.reset_gauges(f"agg/stale.{name}")
+        rec.reset_gauges(f"agg/scrape_age_s.{name}")
+        if purge_series:
+            self.store.drop(f"{name}/*")
+        return True
+
     def source_names(self) -> List[str]:
         with self._lock:
             return list(self._sources)

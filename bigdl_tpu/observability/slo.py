@@ -206,6 +206,12 @@ class SLOEngine:
         self.clock = clock if clock is not None \
             else getattr(store, "now", time.time)
         self._breached: Dict[str, bool] = {}
+        #: the most recent :meth:`evaluate` results, by objective name —
+        #: consumers that must not re-run the window math (the
+        #: autoscale policy reading burn rates between its own ticks)
+        #: read this instead of calling evaluate() again
+        self.last_results: Dict[str, Dict[str, Any]] = {}
+        self.last_eval_at: Optional[float] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -246,6 +252,8 @@ class SLOEngine:
                                 eval_time=now, **r)
             if not r["no_data"]:
                 self._breached[obj.name] = r["breach"]
+        self.last_results = results
+        self.last_eval_at = now
         return results
 
     def breached(self) -> List[str]:

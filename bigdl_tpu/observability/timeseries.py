@@ -199,6 +199,22 @@ class SeriesStore:
                     break
         return out
 
+    def drop(self, patterns) -> int:
+        """Remove every series whose name matches ``patterns`` (same
+        semantics as :meth:`match`); returns how many were dropped.
+        The deregistration seam: when a fleet member is deliberately
+        scaled away its history leaves the store with it, so windowed
+        reducers (and the SLO engine on top) stop judging a replica
+        that no longer exists — as opposed to a *crashed* member,
+        whose series are retained so dashboards see the gap."""
+        victims = self.match(patterns)
+        dropped = 0
+        with self._lock:
+            for name in victims:
+                if self._series.pop(name, None) is not None:
+                    dropped += 1
+        return dropped
+
     def points(self, name: str, window: Optional[float] = None,
                now: Optional[float] = None) -> List[Tuple[float, float]]:
         s = self.get(name)

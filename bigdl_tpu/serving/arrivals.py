@@ -22,11 +22,28 @@ Shapes:
 
 ``serve_bench.py --arrivals diurnal`` composes it with any ``--trace``
 phases (multipliers multiply).
+
+Determinism / replay contract
+-----------------------------
+An arrival trace is a pure function of ``(seed, phases, rate,
+duration, arrivals-shape)``.  :func:`trace_record` captures exactly
+that tuple plus the realised arrival timestamps into a JSON-ready
+dict; :func:`replay_arrivals` iterates the recorded timestamps
+verbatim.  Because :func:`virtual_arrivals` consumes exactly one
+``rng.exponential`` per arrival and reads only virtual time, a replay
+from the artifact and a fresh generation from the recorded seed
+produce the **same** offered sequence — the artifact exists so a run
+can be reproduced without the generating code (autoscale smoke
+fixtures, cross-version bisects), not because regeneration drifts.
+Anything that perturbs the rng draw ORDER (an extra draw per arrival,
+a reordered size draw) breaks regeneration — replay from the artifact
+stays correct even then, which is why the smoke tests replay.
 """
 from __future__ import annotations
 
 import math
-from typing import Callable, Iterator, Optional, Sequence, Tuple
+from typing import (Any, Callable, Dict, Iterable, Iterator, List,
+                    Optional, Sequence, Tuple)
 
 #: --trace shapes as (start_fraction_of_run, rate_multiplier) phases
 TRACES = {
@@ -81,3 +98,57 @@ def virtual_arrivals(rng, rate: float, phases: Phases, duration: float,
         if t_virtual >= duration:
             return
         yield t_virtual
+
+
+def rate_at(frac: float, rate: float, phases: Phases,
+            rate_fn: Optional[Callable[[float], float]] = None
+            ) -> float:
+    """The instantaneous offered rate at ``frac`` of the run — the
+    same ``rate * mult_at(...) * rate_fn(frac)`` product
+    :func:`virtual_arrivals` samples from, exposed so trace artifacts
+    and the autoscale timeline can annotate load without re-deriving
+    the composition rule."""
+    r = rate * mult_at(phases, frac)
+    if rate_fn is not None:
+        r *= rate_fn(frac)
+    return r
+
+
+def trace_record(seed: int, rate: float, phases: Phases,
+                 duration: float, arrivals: Iterable[float], *,
+                 shape: str = "steady", rate_ticks: int = 32,
+                 rate_fn: Optional[Callable[[float], float]] = None
+                 ) -> Dict[str, Any]:
+    """The JSON-ready replay artifact for one offered trace: the
+    generating tuple (seed / rate / phases / duration / shape), a
+    ``rate_ticks``-point sample of the instantaneous rate curve (for
+    plotting — NOT needed for replay), and the realised arrival
+    timestamps in virtual seconds.  ``arrivals`` is materialised, so
+    pass the same list the run consumed."""
+    ts: List[float] = [float(t) for t in arrivals]
+    ticks = [{"frac": i / max(rate_ticks - 1, 1),
+              "rate": rate_at(i / max(rate_ticks - 1, 1), rate, phases,
+                              rate_fn)}
+             for i in range(rate_ticks)]
+    return {
+        "version": 1,
+        "seed": int(seed),
+        "rate": float(rate),
+        "shape": str(shape),
+        "phases": [[float(s), float(m)] for s, m in phases],
+        "duration": float(duration),
+        "n_arrivals": len(ts),
+        "rate_curve": ticks,
+        "arrivals_s": ts,
+    }
+
+
+def replay_arrivals(record: Dict[str, Any]) -> Iterator[float]:
+    """Iterate a :func:`trace_record` artifact's arrival timestamps
+    verbatim — the replay half of the determinism contract.  Raises
+    ``ValueError`` on an artifact this version cannot replay."""
+    if record.get("version") != 1:
+        raise ValueError(
+            f"unsupported arrival-trace version {record.get('version')!r}")
+    for t in record["arrivals_s"]:
+        yield float(t)

@@ -596,6 +596,14 @@ class DecodeEngine:
             elif not has_work:
                 if closed:
                     return False
+                # zero the load gauges while parked: occupancy is only
+                # written from live steps, so without this an idle
+                # engine scrapes its LAST in-flight value forever — a
+                # phantom load that wedges the autoscaler's
+                # calm/scale-down detection (same reasoning as the
+                # queue_depth gauge in _admit)
+                self.recorder.gauge("decode/live_slots", 0)
+                self.recorder.gauge("decode/occupancy", 0.0)
                 self._lock.wait(0.1)
                 return True
         if closed and not drain:

@@ -71,6 +71,11 @@ from .registry import ModelRegistry, Snapshot
 #: thresholds below are the saturation level at which each class sheds.
 PRIORITY_CLASSES = ("interactive", "normal", "batch")
 
+#: terminal ejection reasons — the probe loop never resurrects these.
+#: "killed" is the chaos/operator hard-kill; "scaled_down" is the
+#: autoscaler's graceful decommission (the engine drained first).
+TERMINAL_REASONS = ("killed", "scaled_down")
+
 
 class NoHealthyReplicaError(RuntimeError):
     """Every replica is ejected/killed — a total outage, distinct from
@@ -348,10 +353,17 @@ class ReplicaSet:
         :class:`~bigdl_tpu.observability.aggregate.MetricsAggregator`:
         the set's own recorder (``replica/*`` rotation gauges) plus one
         per replica — ``aggregator.add(replica_set, name="serve")``
-        attaches the whole set in one call."""
+        attaches the whole set in one call.  Terminally removed
+        replicas (killed / scaled down) are excluded; callers that
+        re-attach after a rescale should pair this with the
+        aggregator's ``remove_member`` for the departed names."""
+        with self._lock:
+            live = [rep for rep in self.replicas
+                    if not (rep.state == _Replica.EJECTED
+                            and rep.reason in TERMINAL_REASONS)]
         return [("set", self.recorder)] + \
             [(f"replica{rep.index}", rep.engine.recorder)
-             for rep in self.replicas]
+             for rep in live]
 
     def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
         """One aggregated introspection server for the whole set: the
@@ -494,7 +506,8 @@ class ReplicaSet:
                       "replica/dispatches", "replica/failovers",
                       "replica/failover_exhausted", "replica/ejected",
                       "replica/readmitted", "replica/wedged",
-                      "replica/stale_results")}
+                      "replica/stale_results", "replica/scaled_up",
+                      "replica/scaled_down")}
         out["brownout"] = bool(self.controller.browned)
         out["replicas"] = {r.index: r.engine.stats()
                            for r in self.replicas}
@@ -528,6 +541,64 @@ class ReplicaSet:
                 self.recorder.inc("replica/killed")
         if not already:
             rep.engine.shutdown(drain=False, timeout=1.0)
+        return self
+
+    # -- scaling seams ------------------------------------------------------ #
+    def add_replica(self, engine: ServingEngine, *,
+                    warm: bool = False) -> int:
+        """Admit a new engine into the set (the autoscaler's scale-up
+        seam).  The replica joins EJECTED with reason ``"joining"`` and
+        enters rotation only after the health loop's golden probe
+        passes — the same readmission path an ejected replica takes, so
+        a half-warmed engine never takes live traffic.  Returns the new
+        replica's index."""
+        if warm:
+            engine.warmup()     # compile outside the set lock
+        with self._lock:
+            if self._closed:
+                raise EngineClosedError("replica set is shut down")
+            index = len(self.replicas)
+            rep = _Replica(index, engine)
+            rep.state = _Replica.EJECTED
+            rep.reason = "joining"
+            rep.ejected_at = time.monotonic()
+            self.replicas.append(rep)
+        self.recorder.inc("replica/scaled_up")
+        self.recorder.emit_record("replica_event", kind="join",
+                                  replica=index)
+        print(f"[serving] replica {index} joining (probe-gated)",
+              flush=True)
+        return index
+
+    def decommission(self, index: int, *, drain: bool = True,
+                     timeout: Optional[float] = 5.0) -> "ReplicaSet":
+        """Gracefully remove one replica (the autoscaler's scale-down
+        seam): it leaves rotation for good — reason ``"scaled_down"``
+        is terminal, never probed back — and its engine drains before
+        shutdown so accepted work completes.  In-flight requests the
+        set already dispatched fail over through the normal budgeted
+        path.  Refuses to remove the last routable replica."""
+        rep = self.replicas[index]
+        with self._lock:
+            if rep.state == _Replica.EJECTED \
+                    and rep.reason in TERMINAL_REASONS:
+                return self                 # idempotent
+            if rep.state == _Replica.HEALTHY \
+                    and len(self._routable_locked()) <= 1:
+                raise ValueError(
+                    f"refusing to decommission replica {index}: it is "
+                    "the last replica in rotation")
+            if rep.state == _Replica.EJECTED:
+                # already out (probing back in): escalate to terminal
+                rep.reason = "scaled_down"
+                rep.probe = None
+                self.recorder.emit_record(
+                    "replica_event", kind="eject", replica=index,
+                    reason="scaled_down")
+            else:
+                self._eject_locked(rep, "scaled_down")
+            self.recorder.inc("replica/scaled_down")
+        rep.engine.shutdown(drain=drain, timeout=timeout)
         return self
 
     # -- internals: routing ------------------------------------------------ #
@@ -752,7 +823,7 @@ class ReplicaSet:
                             to_failover.append(flight)
             for rep in self.replicas:
                 if rep.state == _Replica.EJECTED \
-                        and rep.reason != "killed":
+                        and rep.reason not in TERMINAL_REASONS:
                     probes.append(rep)
             routable = self._routable_locked()
             sat = self._saturation_locked(routable) if routable else 1.0
@@ -844,7 +915,8 @@ class ReplicaSet:
 
     def _probe(self, rep: _Replica, now: float):
         with self._lock:
-            if rep.state != _Replica.EJECTED or rep.reason == "killed":
+            if rep.state != _Replica.EJECTED \
+                    or rep.reason in TERMINAL_REASONS:
                 return
             probe = rep.probe
             if probe is None:
@@ -884,8 +956,10 @@ class ReplicaSet:
                 ok = False
         with self._lock:
             rep.probe = None
-            if rep.state != _Replica.EJECTED or rep.reason == "killed":
-                return      # kill() raced the probe: stay out
+            if rep.state != _Replica.EJECTED \
+                    or rep.reason in TERMINAL_REASONS:
+                return      # kill()/decommission raced: stay out
+            was = rep.reason
             if not ok:
                 rep.last_probe_at = now
             else:
@@ -896,7 +970,7 @@ class ReplicaSet:
         if ok:
             self.recorder.inc("replica/readmitted")
             self.recorder.emit_record("replica_event", kind="readmit",
-                                      replica=rep.index)
+                                      replica=rep.index, was=was)
             print(f"[serving] replica {rep.index} re-admitted after a "
                   "healthy probe", flush=True)
         else:

@@ -1,0 +1,511 @@
+#!/usr/bin/env python
+"""SLO-driven autoscaler smoke (ISSUE 17 acceptance, CI
+``autoscale-smoke``): the closed loop breathing on one shared pool.
+
+**Leg A — serving breathes under a seeded diurnal trace.**  One CPU
+decode replica behind a :class:`ReplicaSet`, scraped by a
+:class:`MetricsAggregator` and judged by an :class:`SLOEngine`, with
+an :class:`AutoscaleController` closing the loop against a
+:class:`DevicePool`.  A seeded diurnal open-loop arrival trace
+(written to disk as the PR's replay artifact and verified to replay
+bit-exactly) ramps offered load from trough to ~3x peak and back; the
+per-step service time is pinned with the ``serving.decode_step`` chaos
+seam so the capacity arithmetic is machine-independent.  Asserts: at
+least one scale-up through the warmup/golden-probe readmission path,
+at least one scale-down through the drain-first decommission path,
+ZERO flaps (no direction reversal inside one ``cooldown_down``
+window), and that ``trace_summary.py autoscale`` renders the run.
+
+**Leg B — the co-scheduled trainer is bit-identical through
+borrow/return cycles.**  An :class:`ElasticSupervisor` trains over
+the pool's ``train`` share through the ``capacity_fn`` seam while the
+controller (configured with ``donor="train"``, ``donor_take="head"``)
+is driven through two synthetic peak/trough cycles: each scale-up
+finds the pool dry and BORROWS the trainer's in-use head device
+(displacing its mesh), each scale-down returns it (displacing back).
+The mesh SHAPE never changes — template ``{"dp": 2}`` over 4 devices
+— so every transition is the displacement class, which is same-math
+relayout: the run's per-step losses and final checkpoint digest must
+be bit-identical to a solo run that never rescaled.  (A dp-resize is
+deliberately NOT asserted bit-identical: changing the partition count
+recompiles the program and reassociates reductions — see
+docs/elastic.md.)
+
+Emits ONE machine-parseable JSON line last (the CI contract), after
+rendering the timeline with ``trace_summary.py autoscale``.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_"
+                                 "count=8").strip()
+
+import numpy as np                                         # noqa: E402
+
+from bigdl_tpu import faults                               # noqa: E402
+from bigdl_tpu.autoscale import (AutoscaleController,      # noqa: E402
+                                 AutoscalePolicy)
+from bigdl_tpu.fleet import DevicePool                     # noqa: E402
+from bigdl_tpu.models import transformer as T              # noqa: E402
+from bigdl_tpu.observability import (JsonlSink,            # noqa: E402
+                                     MetricsAggregator, Recorder,
+                                     SeriesStore, SLOEngine,
+                                     SLObjective)
+from bigdl_tpu.serving import (DecodeEngine,               # noqa: E402
+                               LoadShedError, ModelRegistry,
+                               NoHealthyReplicaError)
+from bigdl_tpu.serving.arrivals import (TRACES,            # noqa: E402
+                                        diurnal_mult, replay_arrivals,
+                                        trace_record, virtual_arrivals)
+from bigdl_tpu.serving.decode import \
+    build_decode_replica_set                               # noqa: E402
+
+from chaos_smoke import _digest                            # noqa: E402
+
+# -- leg A knobs ------------------------------------------------------ #
+SEED = 0
+RATE = 8.0              # baseline req/s; diurnal peak = 3x, trough .25x
+DURATION = 24.0         # seconds of offered trace
+STEP_PIN_MS = 30        # chaos-pinned decode step: capacity is
+                        # slots/(out_len * 30ms) ~= 16 req/s/replica,
+                        # independent of the host's actual speed
+OUT_TOKENS = 8
+SLOTS = 4
+TTFT_MS = 400.0
+COOLDOWN_UP = 2.0
+COOLDOWN_DOWN = 6.0     # the flap window the summary asserts on
+
+# -- leg B knobs ------------------------------------------------------ #
+B_STEPS = 60            # divisible by ckpt_every
+B_CKPT_EVERY = 4
+B_REPLAN_EVERY = 2
+B_CYCLES = 2
+
+FAILURES = []
+
+
+def check(ok, msg):
+    print(f"# {'ok' if ok else 'FAIL'}: {msg}", flush=True)
+    if not ok:
+        FAILURES.append(msg)
+    return ok
+
+
+def wait_for(cond, timeout, msg):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return check(False, f"timed out waiting: {msg}")
+
+
+# ===================================================================== #
+# leg A: serving breathes under the diurnal trace                       #
+# ===================================================================== #
+ENGINE_KW = dict(slots=SLOTS, page_size=8, max_context=64, max_prompt=8,
+                 max_new_tokens=OUT_TOKENS, max_waiting=512)
+
+
+def leg_a(out_dir):
+    serve_dir = os.path.join(out_dir, "serve")
+    os.makedirs(serve_dir, exist_ok=True)
+    model = T.build("tiny", dropout=0.0, n_layers=2, max_len=128)
+
+    rs = build_decode_replica_set(
+        model, 1, name="lm", engine_kw=ENGINE_KW,
+        recorder=Recorder(sinks=[JsonlSink(
+            os.path.join(serve_dir, "autoscale.jsonl"))],
+            annotate=False),
+        health_interval=0.1, probe_interval=0.1)
+    engines = [rs.replicas[0].engine]
+
+    def engine_factory():
+        reg = ModelRegistry()
+        reg.register("lm", model)
+        eng = DecodeEngine(reg, "lm", recorder=Recorder(annotate=False),
+                           **ENGINE_KW)
+        engines.append(eng)
+        return eng
+
+    rs.warmup()
+    rs.start()
+
+    agg = MetricsAggregator(stale_after=10.0)
+    agg.recorder.add_sink(JsonlSink(os.path.join(serve_dir,
+                                                 "slo.jsonl")))
+    agg.add(rs, name="serve")
+    slo = SLOEngine(
+        agg.store,
+        [SLObjective("decode_ttft_p99", target=0.9, window=15.0,
+                     series=("*decode*ttft_ms/p99",),
+                     threshold=TTFT_MS, burn_alert=2.0)],
+        recorder=agg.recorder)
+
+    pool = DevicePool(devices=["a0", "a1"])   # room for replicas 2 + 3
+    policy = AutoscalePolicy(min_replicas=1, max_replicas=3,
+                             occupancy_high=0.85, occupancy_low=0.3,
+                             queue_high=6.0, idle_ticks=2,
+                             cooldown_up=COOLDOWN_UP,
+                             cooldown_down=COOLDOWN_DOWN, max_step=1)
+    ctl = AutoscaleController(rs, engine_factory, policy, pool=pool,
+                              claimant="serve", slo_engine=slo,
+                              aggregator=agg, member_name="serve")
+
+    peak_replicas = [1]
+    stop_scrape = threading.Event()
+
+    def scrape_loop():
+        while not stop_scrape.wait(0.2):
+            try:
+                agg.scrape()
+                peak_replicas[0] = max(peak_replicas[0],
+                                       ctl.live_replicas())
+            except Exception:
+                pass
+
+    scraper = threading.Thread(target=scrape_loop, daemon=True)
+    scraper.start()
+    ctl.start(interval=0.4)
+
+    # -- the offered trace: generate, persist, verify replay ---------- #
+    rng = np.random.RandomState(SEED)
+    arrivals = list(virtual_arrivals(rng, RATE, TRACES["steady"],
+                                     DURATION, rate_fn=diurnal_mult))
+    art = trace_record(SEED, RATE, TRACES["steady"], DURATION, arrivals,
+                       shape="diurnal", rate_fn=diurnal_mult)
+    trace_path = os.path.join(out_dir, "arrival_trace.json")
+    with open(trace_path, "w") as f:
+        json.dump(art, f)
+    with open(trace_path) as f:
+        check(list(replay_arrivals(json.load(f))) == arrivals,
+              f"arrival-trace artifact replays bit-exactly "
+              f"({art['n_arrivals']} arrivals)")
+
+    # -- drive it ------------------------------------------------------ #
+    lock = threading.Lock()
+    done, shed, errors = [0], [0], []
+
+    def on_done(f):
+        try:
+            f.result()
+            with lock:
+                done[0] += 1
+        except LoadShedError:
+            with lock:
+                shed[0] += 1
+        except Exception as e:
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+
+    faults.arm(f"serving.decode_step:delay:{STEP_PIN_MS}")
+    offered = 0
+    futs = []
+    t_start = time.perf_counter()
+    try:
+        for t_virtual in replay_arrivals(art):
+            while True:
+                lag = t_start + t_virtual - time.perf_counter()
+                if lag <= 0:
+                    break
+                time.sleep(min(lag, 0.02))
+            plen = int(rng.randint(2, 9))
+            prompt = rng.randint(0, 256, plen).astype(np.int32)
+            offered += 1
+            try:
+                fut = rs.submit("lm", prompt)
+            except (LoadShedError, NoHealthyReplicaError):
+                with lock:
+                    shed[0] += 1
+                continue
+            fut.add_done_callback(on_done)
+            futs.append(fut)
+    finally:
+        faults.disarm()     # drain the backlog at full speed
+
+    drain_deadline = time.monotonic() + 90.0
+    for f in futs:
+        f.result(timeout=max(drain_deadline - time.monotonic(), 1.0))
+    check(not errors, f"no request errored across rescales "
+                      f"(first: {errors[:1]})")
+    check(done[0] + shed[0] == offered,
+          f"accounting: {done[0]} done + {shed[0]} shed "
+          f"== {offered} offered")
+
+    ups = lambda: rs.recorder.counter_value("autoscale/scale_ups")
+    downs = lambda: rs.recorder.counter_value("autoscale/scale_downs")
+    check(ups() >= 1, f"scaled up through the peak "
+                      f"(scale_ups={ups():.0f}, "
+                      f"peak replicas={peak_replicas[0]})")
+    # the falling edge: idle engines now advertise occupancy 0, the
+    # breach window slides out, and cooldown_down gates the shrink
+    wait_for(lambda: downs() >= 1, 45.0,
+             "scale-down after the trough (calm streak + cooldown)")
+
+    ctl.stop()
+    stop_scrape.set()
+    scraper.join(timeout=5.0)
+    slo.summary_record()
+
+    ttft_p99 = engines[0].recorder.hist_quantiles(
+        "decode/ttft_ms", (99.0,))["p99"]
+    events = rs.recorder.recent_records(rec_type="autoscale_event")
+    scalings = [(e.get("time") or 0.0,
+                 "up" if e["kind"] == "scale_up" else "down")
+                for e in events
+                if e.get("kind") in ("scale_up", "scale_down")]
+    scalings.sort()
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary", os.path.join(_REPO, "scripts",
+                                      "trace_summary.py"))
+    ts_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ts_mod)
+    flaps = ts_mod.count_flaps(scalings, COOLDOWN_DOWN)
+    check(flaps == 0,
+          f"zero flaps: no direction reversal < {COOLDOWN_DOWN:.0f}s "
+          f"apart across {len(scalings)} scalings")
+
+    rs.recorder.flush()
+    agg.recorder.flush()
+    rs.shutdown(drain=False)
+    agg.close()
+    return {"offered": offered, "completed": done[0], "shed": shed[0],
+            "scale_ups": int(ups()), "scale_downs": int(downs()),
+            "flaps": int(flaps), "peak_replicas": peak_replicas[0],
+            "ttft_p99_ms": round(float(ttft_p99), 1),
+            "trace": trace_path, "serve_dir": serve_dir}
+
+
+# ===================================================================== #
+# leg B: trainer bit-parity through borrow/return displacement cycles   #
+# ===================================================================== #
+def _train_factory(mesh):
+    from bigdl_tpu.optim import Adam
+    from bigdl_tpu.parallel.spmd import SpmdTrainer
+    model = T.build("tiny", dropout=0.0, n_layers=1, d_model=32,
+                    n_heads=2, d_ff=64, max_len=16, vocab_size=64)
+    return SpmdTrainer(model, Adam(learning_rate=1e-3), mesh=mesh,
+                       fsdp=False, seed=0)
+
+
+def _train_batch(s):
+    rs_ = np.random.RandomState(7000 + s)
+    t = rs_.randint(0, 64, (8, 17))
+    # pace the loop a little so the borrow/return choreography lands
+    # between planning polls instead of racing the whole run
+    time.sleep(0.02)
+    return t[:, :-1], t[:, 1:]
+
+
+def _ckpt_digest(ckpt_dir):
+    from bigdl_tpu.checkpoint import CheckpointManager
+    mgr = CheckpointManager(ckpt_dir)
+    kind, trees, meta = mgr.restore_latest()
+    mgr.close()
+    return _digest(trees)
+
+
+def _run_solo(out_dir, devices):
+    from bigdl_tpu.elastic import ElasticSupervisor
+    ck = os.path.join(out_dir, "ck_solo")
+    sup = ElasticSupervisor(_train_factory, ck, {"dp": 2},
+                            capacity_fn=lambda: list(devices),
+                            recorder=Recorder(annotate=False),
+                            ckpt_every=B_CKPT_EVERY,
+                            replan_every=B_REPLAN_EVERY,
+                            shard_arrays=True, handle_sigterm=False)
+    losses = sup.run(_train_batch, steps=B_STEPS)
+    return losses, _ckpt_digest(ck)
+
+
+def leg_b(out_dir):
+    import jax
+    from bigdl_tpu.elastic import ElasticSupervisor
+    from bigdl_tpu.serving import build_replica_set
+    from bigdl_tpu import nn
+
+    train_dir = os.path.join(out_dir, "train")
+    os.makedirs(train_dir, exist_ok=True)
+    devices = jax.devices()[:4]
+
+    print("# leg B: solo reference run", flush=True)
+    losses_solo, dig_solo = _run_solo(out_dir, devices)
+
+    print("# leg B: breathing run (autoscaler borrows the trainer's "
+          "head device)", flush=True)
+    pool = DevicePool(devices=devices)
+    pool.claim("train", 4)
+    rec_b = Recorder(sinks=[JsonlSink(os.path.join(train_dir,
+                                                   "elastic.jsonl"))],
+                     annotate=False)
+    ck_b = os.path.join(out_dir, "ck_breathing")
+    sup = ElasticSupervisor(_train_factory, ck_b, {"dp": 2},
+                            capacity_fn=lambda: pool.owned_by("train"),
+                            recorder=rec_b, ckpt_every=B_CKPT_EVERY,
+                            replan_every=B_REPLAN_EVERY,
+                            shard_arrays=True, handle_sigterm=False)
+    result = {}
+
+    def run():
+        result["losses"] = sup.run(_train_batch, steps=B_STEPS)
+
+    trainer_thread = threading.Thread(target=run, daemon=True)
+    trainer_thread.start()
+
+    # a cheap MLP replica set stands in for the serving tier: leg B is
+    # about the POOL choreography, leg A already proved the decode side
+    mlp = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    mlp.evaluate()
+    mlp.ensure_initialized()
+
+    def mlp_engine():
+        from bigdl_tpu.serving import ServingEngine
+        reg = ModelRegistry()
+        reg.register("m", mlp, input_shape=(4,))
+        return ServingEngine(reg, max_batch=4, max_delay_ms=1.0,
+                             recorder=Recorder(annotate=False))
+
+    rs = build_replica_set(
+        mlp, 1, name="m", input_shape=(4,),
+        recorder=Recorder(sinks=[JsonlSink(
+            os.path.join(train_dir, "autoscale.jsonl"))],
+            annotate=False),
+        health_interval=0.05, probe_interval=0.05)
+    rs.warmup()
+    rs.start()
+    store = SeriesStore()
+    ctl = AutoscaleController(
+        rs, mlp_engine,
+        AutoscalePolicy(min_replicas=1, max_replicas=2,
+                        occupancy_high=0.85, occupancy_low=0.3,
+                        idle_ticks=1, cooldown_up=0.05,
+                        cooldown_down=0.1),
+        pool=pool, claimant="serve", donor="train",
+        donor_take="head", store=store, member_name="serve")
+
+    displaces = lambda: rec_b.counter_value("elastic/displaces")
+    ups = lambda: rs.recorder.counter_value("autoscale/scale_ups")
+    downs = lambda: rs.recorder.counter_value("autoscale/scale_downs")
+
+    def tick_until(counter, target, occupancy, msg, timeout=60.0):
+        deadline = time.monotonic() + timeout
+        while counter() < target and time.monotonic() < deadline:
+            store.observe("decode/occupancy", occupancy)
+            ctl.tick()
+            time.sleep(0.05)
+        return check(counter() >= target, msg)
+
+    ok = True
+    for cycle in range(B_CYCLES):
+        n_disp = displaces()
+        ok = tick_until(ups, cycle + 1, 0.97,
+                        f"cycle {cycle}: peak borrowed the trainer's "
+                        "head device") and ok
+        ok = wait_for(lambda: displaces() > n_disp, 120.0,
+                      f"cycle {cycle}: trainer displaced onto the "
+                      "yielded subset") and ok
+        n_disp = displaces()
+        ok = tick_until(downs, cycle + 1, 0.02,
+                        f"cycle {cycle}: trough returned the "
+                        "device") and ok
+        ok = wait_for(lambda: displaces() > n_disp, 120.0,
+                      f"cycle {cycle}: trainer displaced back onto its "
+                      "regrown subset") and ok
+        if not ok:
+            break
+
+    trainer_thread.join(timeout=300.0)
+    check(not trainer_thread.is_alive(), "breathing run finished")
+    losses_b = result.get("losses") or []
+    dig_b = _ckpt_digest(ck_b) if not trainer_thread.is_alive() else ""
+
+    check(len(pool.owned_by("train")) == 4,
+          "every borrowed device went back to the trainer")
+    check(rec_b.counter_value("elastic/shrinks") == 0
+          and rec_b.counter_value("elastic/regrows") == 0,
+          "every transition was the displacement class (mesh shape "
+          "never changed)")
+    n_disp = displaces()
+    check(n_disp >= 2 * B_CYCLES,
+          f"borrow/return cycles displaced the mesh ({n_disp:.0f} "
+          f"displacements over {B_CYCLES} cycles)")
+    check(len(losses_b) == len(losses_solo) == B_STEPS,
+          f"both runs trained {B_STEPS} steps")
+    exact = (len(losses_b) == len(losses_solo)
+             and all(a == b for a, b in zip(losses_solo, losses_b)))
+    check(exact, "per-step losses bit-identical to the solo run")
+    check(dig_b == dig_solo and dig_solo != "",
+          f"final checkpoint digest bit-identical to solo "
+          f"({dig_solo[:16]}...)")
+
+    ctl.stop()
+    rs.recorder.flush()
+    rec_b.flush()
+    rs.shutdown(drain=False)
+    return {"displaces": int(n_disp), "borrow_cycles": B_CYCLES,
+            "parity": bool(exact and dig_b == dig_solo),
+            "digest": dig_solo[:16], "train_dir": train_dir,
+            "scale_ups": int(ups()), "scale_downs": int(downs())}
+
+
+# ===================================================================== #
+def main():
+    out_dir = tempfile.mkdtemp(prefix="autoscale_smoke_")
+    print(f"# workdir {out_dir}", flush=True)
+
+    a = leg_a(out_dir)
+    b = leg_b(out_dir)
+
+    print("# --- trace_summary autoscale ---", flush=True)
+    ts = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "scripts",
+                                      "trace_summary.py"),
+         "autoscale", a["serve_dir"], str(COOLDOWN_DOWN)],
+        capture_output=True, text=True, timeout=120)
+    print(ts.stdout, flush=True)
+    check(ts.returncode == 0 and "autoscale timeline" in ts.stdout
+          and "scale_up" in ts.stdout and "scale_down" in ts.stdout,
+          "trace_summary autoscale renders the serving timeline")
+    check("flaps" in ts.stdout
+          and any(ln.strip().endswith(": 0")
+                  for ln in ts.stdout.splitlines()
+                  if "flaps" in ln),
+          "trace_summary's flap detector agrees: zero flaps")
+
+    summary = {
+        "metric": "autoscale_smoke",
+        "ok": not FAILURES,
+        "failures": FAILURES,
+        "scale_ups": a["scale_ups"],
+        "scale_downs": a["scale_downs"],
+        "flaps": a["flaps"],
+        "peak_replicas": a["peak_replicas"],
+        "offered": a["offered"],
+        "completed": a["completed"],
+        "shed": a["shed"],
+        "ttft_p99_ms": a["ttft_p99_ms"],
+        "displaces": b["displaces"],
+        "parity": b["parity"],
+        "trace": a["trace"],
+        "workdir": out_dir,
+    }
+    print(json.dumps(summary), flush=True)
+    return 0 if not FAILURES else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -86,6 +86,12 @@ def parse_args():
                          "bit-identical offered trace)")
     ap.add_argument("--seed", type=int, default=0,
                     help="open loop: arrival/size RNG seed (replay key)")
+    ap.add_argument("--trace-out", type=str, default=None,
+                    help="open loop: write the offered arrival trace "
+                         "(seed, rate curve, per-arrival timestamps) "
+                         "to this JSON path for exact replay — see the "
+                         "determinism contract in "
+                         "bigdl_tpu/serving/arrivals.py")
     ap.add_argument("--replicas", type=int, default=1,
                     help="serve through a ReplicaSet of N engines")
     ap.add_argument("--brownout", action="store_true",
@@ -165,13 +171,29 @@ from bigdl_tpu.serving import (LoadShedError,              # noqa: E402
 # script's parse-time side effects); re-exported here for callers that
 # grew up against serve_bench's names
 from bigdl_tpu.serving.arrivals import (TRACES, diurnal_mult,  # noqa: E402
-                                        mult_at, virtual_arrivals)
+                                        mult_at, trace_record,
+                                        virtual_arrivals)
 
 
 def arrival_rate_fn(a):
     """--arrivals to the rate_fn virtual_arrivals composes with
     --trace (None = plain Poisson)."""
     return diurnal_mult if a.arrivals == "diurnal" else None
+
+
+def write_trace_artifact(a, duration, arrivals):
+    """--trace-out: persist the realised offered trace for exact
+    replay (determinism contract in bigdl_tpu/serving/arrivals.py)."""
+    if not a.trace_out:
+        return
+    art = trace_record(a.seed, a.rate, TRACES[a.trace], duration,
+                       arrivals, shape=a.trace,
+                       rate_fn=arrival_rate_fn(a))
+    art["process"] = a.arrivals
+    with open(a.trace_out, "w") as f:
+        json.dump(art, f)
+    print(f"[serve_bench] wrote arrival trace -> {a.trace_out} "
+          f"({art['n_arrivals']} arrivals)", flush=True)
 
 
 def build_model(kind):
@@ -240,8 +262,10 @@ def run_open_loop(a, target, input_shape, duration, size_cap):
 
     t_start = time.perf_counter()
     offered = 0
+    trace_ts = []
     for t_virtual in virtual_arrivals(rng, a.rate, phases, duration,
                                       rate_fn=arrival_rate_fn(a)):
+        trace_ts.append(t_virtual)
         # submit() never splits, so open-loop sizes stay on the ladder
         n = int(rng.randint(1, size_cap + 1))
         while True:
@@ -278,6 +302,7 @@ def run_open_loop(a, target, input_shape, duration, size_cap):
             if processed[0] >= len(pending):
                 break
         time.sleep(0.005)
+    write_trace_artifact(a, duration, trace_ts)
     return latencies, shed[0], errors, offered
 
 
@@ -385,8 +410,10 @@ def run_decode_bench(a):
             with lock:
                 processed[0] += 1
 
+    trace_ts = []
     for t_virtual in virtual_arrivals(rng, a.rate, phases, duration,
                                       rate_fn=arrival_rate_fn(a)):
+        trace_ts.append(t_virtual)
         plen = int(rng.randint(1, a.prompt_max + 1))
         olen = int(rng.randint(1, a.out_max + 1))
         prompt = rng.randint(0, model.cfg.vocab_size, plen).astype(np.int32)
@@ -424,6 +451,7 @@ def run_decode_bench(a):
                 break
         time.sleep(0.005)
     wall = time.perf_counter() - t_start
+    write_trace_artifact(a, duration, trace_ts)
     eng.shutdown(drain=True)
 
     st = eng.stats()

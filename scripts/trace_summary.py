@@ -93,6 +93,17 @@ Two subcommands:
 
         python scripts/trace_summary.py slo /tmp/slo.jsonl
 
+  autoscale          the autoscaler's decision timeline from
+                     ``autoscale_event`` records: replica count (as a
+                     bar) tracking the load signals each decision saw
+                     (occupancy, queue depth, burn rate), SLO breach
+                     markers inline, the decision counters, and the
+                     flap verdict (direction reversals closer than the
+                     flap window — zero when the policy's cooldowns
+                     are doing their job):
+
+        python scripts/trace_summary.py autoscale /tmp/serve.jsonl [flap_window_s]
+
 CPU-only (no device access), so it is safe to run while the tunnel is
 wedged.
 """
@@ -482,6 +493,105 @@ def summarize_slo(events, summary, out=print):
             out(f"  {dt:>+7.2f}s  {ev.get('objective', '?'):<24} "
                 f"{ev.get('kind', '?'):<10} compliance={comp} "
                 f"budget={budget} burn={bf}/{bs}")
+
+
+def load_autoscale(paths):
+    """Chronologically-merged ``autoscale_event`` records plus
+    ``slo_event`` breach markers and the last ``autoscale/*`` counter
+    snapshot from telemetry JSONL files (directories are scanned for
+    ``*.jsonl``)."""
+    expanded = []
+    for p in paths:
+        if os.path.isdir(p):
+            expanded += sorted(glob.glob(os.path.join(p, "*.jsonl")))
+        else:
+            expanded.append(p)
+    events, counters = [], {}
+    for p in expanded:
+        src = os.path.basename(p)
+        for rec in iter_jsonl(p):
+            if rec.get("type") in ("autoscale_event", "slo_event"):
+                events.append((src, rec))
+            for k, v in (rec.get("counters") or {}).items():
+                if k.startswith("autoscale/"):
+                    counters[k] = v
+    events.sort(key=lambda sr: sr[1].get("time") or 0.0)
+    return events, counters
+
+
+def count_flaps(scalings, window):
+    """Direction reversals (up→down or down→up) closer than ``window``
+    seconds apart — the flapping the policy's asymmetric cooldowns
+    must make impossible.  ``scalings`` is ``[(t, direction), ...]``
+    chronological."""
+    flaps = 0
+    for (t_prev, d_prev), (t, d) in zip(scalings, scalings[1:]):
+        if d != d_prev and (t - t_prev) < window:
+            flaps += 1
+    return flaps
+
+
+def _autoscale_load_cell(ev):
+    """Compact load annotation from the decision's signal snapshot."""
+    sig = ev.get("signals") or {}
+    parts = []
+    if sig.get("occupancy") is not None:
+        parts.append(f"occ={sig['occupancy']:.2f}")
+    if sig.get("queue_depth") is not None:
+        parts.append(f"queue={sig['queue_depth']:.0f}")
+    if sig.get("burn_fast") is not None:
+        parts.append(f"burn={sig['burn_fast']:.2f}")
+    if sig.get("breached"):
+        parts.append("breach=" + ",".join(sig["breached"]))
+    return " ".join(parts) or "-"
+
+
+def summarize_autoscale(events, counters, flap_window=30.0, out=print):
+    """Render the autoscale timeline — replica count (as a bar)
+    tracking load, with SLO breach markers inline — plus the decision
+    counters and the flap verdict."""
+    if not events and not counters:
+        out("no autoscale_event records found (no AutoscaleController "
+            "attached, or nothing happened)")
+        return
+    scalings = []
+    if events:
+        out("== autoscale timeline ==")
+        t0 = min(ev.get("time") or 0.0 for _, ev in events)
+        out(f"  {'t':>8}  {'replicas':<12} {'event':<12} "
+            "load / reason")
+        for _, ev in events:
+            dt = (ev.get("time") or 0.0) - t0
+            if ev.get("type") == "slo_event":
+                out(f"  {dt:>+7.2f}s  {'':<12} "
+                    f"{'slo_' + str(ev.get('kind', '?')):<12} "
+                    f"{ev.get('objective', '?')}")
+                continue
+            kind = ev.get("kind", "?")
+            n_after = ev.get("replicas_after")
+            bar = "#" * int(n_after or 0)
+            if kind in ("scale_up", "scale_down"):
+                scalings.append(
+                    (ev.get("time") or 0.0,
+                     "up" if kind == "scale_up" else "down"))
+            detail = _autoscale_load_cell(ev)
+            if ev.get("replica") is not None:
+                detail += f" replica={ev['replica']:g}"
+            if ev.get("reason"):
+                detail += f" [{ev['reason']}]"
+            if ev.get("error"):
+                detail += f" error={ev['error']}"
+            n_cell = (f"{bar:<8} {n_after:g}" if n_after is not None
+                      else "?")
+            out(f"  {dt:>+7.2f}s  {n_cell:<12} {kind:<12} {detail}")
+    out("\n== autoscale summary ==")
+    if counters:
+        out("  " + "  ".join(
+            f"{k.split('/', 1)[1]}={counters[k]:g}"
+            for k in sorted(counters)))
+    flaps = count_flaps(scalings, flap_window)
+    out(f"  scalings={len(scalings)}  flaps (direction reversal "
+        f"< {flap_window:g}s apart): {flaps}")
 
 
 def load_serving(paths):
@@ -950,6 +1060,22 @@ def main_slo(argv):
     summarize_slo(events, summary)
 
 
+def main_autoscale(argv):
+    if not argv:
+        raise SystemExit("usage: trace_summary.py autoscale "
+                         "<telemetry.jsonl | dir>... [flap_window_s]")
+    flap_window = 30.0
+    try:
+        flap_window = float(argv[-1])
+        argv = argv[:-1]
+    except ValueError:
+        pass
+    if not argv:
+        raise SystemExit("trace_summary.py autoscale: no paths given")
+    events, counters = load_autoscale(argv)
+    summarize_autoscale(events, counters, flap_window=flap_window)
+
+
 def main_health(argv):
     if not argv:
         raise SystemExit("usage: trace_summary.py health "
@@ -1002,6 +1128,8 @@ def main():
         main_fleet(argv[1:])
     elif argv and argv[0] == "slo":
         main_slo(argv[1:])
+    elif argv and argv[0] == "autoscale":
+        main_autoscale(argv[1:])
     elif argv and argv[0] == "xplane":
         main_xplane(argv[1:])
     else:           # back-compat: bare path = xplane trace dir

@@ -1,0 +1,393 @@
+"""SLO-driven autoscaler (ISSUE 17): policy hysteresis / cooldowns /
+floors against a fake clock, signal collection freshness, controller
+actuation against a live ReplicaSet + DevicePool (claim, donor borrow,
+blocked), replica-set scaling seams (probe-gated join, terminal
+decommission), and the trace_summary flap detector."""
+import importlib.util
+import os
+import time
+
+import numpy as np
+import pytest
+
+from bigdl_tpu import nn
+from bigdl_tpu.autoscale import (AutoscaleController, AutoscalePolicy,
+                                 Signals, read_signals)
+from bigdl_tpu.fleet import DevicePool, PoolExhaustedError
+from bigdl_tpu.observability import (InMemorySink, Recorder,
+                                     SeriesStore, SLObjective,
+                                     SLOEngine)
+from bigdl_tpu.serving import (ModelRegistry, ServingEngine,
+                               build_replica_set)
+
+_SCRIPTS = os.path.join(os.path.dirname(__file__), "..", "scripts")
+
+
+def _load_trace_summary():
+    spec = importlib.util.spec_from_file_location(
+        "trace_summary", os.path.join(_SCRIPTS, "trace_summary.py"))
+    ts = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ts)
+    return ts
+
+
+def sig(**kw):
+    kw.setdefault("at", 0.0)
+    kw.setdefault("no_data", False)
+    return Signals(**kw)
+
+
+def make_policy(**kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("idle_ticks", 3)
+    kw.setdefault("cooldown_up", 10.0)
+    kw.setdefault("cooldown_down", 40.0)
+    return AutoscalePolicy(**kw)
+
+
+# --------------------------------------------------------------------- #
+# policy: verdicts                                                      #
+# --------------------------------------------------------------------- #
+def test_policy_no_data_holds():
+    p = make_policy()
+    d = p.decide(Signals(at=0.0, no_data=True), 2, now=0.0)
+    assert d.direction == "hold" and d.reason == "no_data"
+
+
+def test_policy_pressure_triggers_scale_up():
+    p = make_policy()
+    for pressure in (dict(breached=("decode_ttft_p99",)),
+                     dict(occupancy=0.95),
+                     dict(queue_depth=30.0)):
+        p = make_policy()
+        d = p.decide(sig(**pressure), 2, now=0.0)
+        assert d.direction == "up" and d.delta == 1, pressure
+
+
+def test_policy_surge_steps_two_capped_at_max():
+    p = make_policy(burn_surge=6.0)
+    d = p.decide(sig(occupancy=0.95, burn_fast=8.0), 1, now=0.0)
+    assert d.direction == "up" and d.delta == 2
+    # one below the ceiling: the surge step clips to the room left
+    d = p.decide(sig(occupancy=0.95, burn_fast=8.0), 3, now=0.0)
+    assert d.direction == "up" and d.delta == 1
+    d = p.decide(sig(occupancy=0.95, burn_fast=8.0), 4, now=0.0)
+    assert d.direction == "hold" and d.reason.startswith("at_max")
+
+
+def test_policy_cooldown_up_blocks_until_elapsed():
+    p = make_policy(cooldown_up=10.0)
+    assert p.decide(sig(occupancy=0.95), 1, now=0.0).direction == "up"
+    p.mark_scaled("up", 0.0)
+    d = p.decide(sig(occupancy=0.95), 2, now=5.0)
+    assert d.direction == "hold" and "cooldown_up" in d.reason
+    assert p.decide(sig(occupancy=0.95), 2, now=10.0).direction == "up"
+
+
+def test_policy_blocked_actuation_does_not_burn_cooldown():
+    # decide() observes; only mark_scaled() commits — a scale-up the
+    # controller could not actuate (pool exhausted) must retry on the
+    # very next tick instead of waiting out an unearned cooldown
+    p = make_policy()
+    assert p.decide(sig(occupancy=0.95), 1, now=0.0).direction == "up"
+    assert p.decide(sig(occupancy=0.95), 1, now=1.0).direction == "up"
+
+
+def test_policy_scale_down_needs_streak_and_long_cooldown():
+    p = make_policy(idle_ticks=3, cooldown_up=10.0, cooldown_down=40.0)
+    p.mark_scaled("up", 0.0)
+    calm = dict(occupancy=0.05, queue_depth=0.0)
+    d1 = p.decide(sig(**calm), 2, now=20.0)
+    d2 = p.decide(sig(**calm), 2, now=25.0)
+    assert (d1.direction, d2.direction) == ("hold", "hold")
+    assert "idle" in d1.reason
+    # streak satisfied at tick 3, but still inside cooldown_down
+    d3 = p.decide(sig(**calm), 2, now=30.0)
+    assert d3.direction == "hold" and "cooldown_down" in d3.reason
+    d4 = p.decide(sig(**calm), 2, now=45.0)
+    assert d4.direction == "down" and d4.delta == 1
+
+
+def test_policy_dead_band_resets_idle_streak():
+    p = make_policy(idle_ticks=2, cooldown_down=0.0, cooldown_up=0.0)
+    calm = dict(occupancy=0.05, queue_depth=0.0)
+    mid = dict(occupancy=0.50, queue_depth=0.0)     # hysteresis gap
+    assert p.decide(sig(**calm), 2, now=0.0).direction == "hold"
+    assert p.decide(sig(**mid), 2, now=1.0).reason == "steady"
+    # the streak restarted: one more calm tick is not enough
+    assert p.decide(sig(**calm), 2, now=2.0).direction == "hold"
+    assert p.decide(sig(**calm), 2, now=3.0).direction == "down"
+
+
+def test_policy_floors():
+    p = make_policy(min_replicas=2, idle_ticks=1, cooldown_down=0.0,
+                    cooldown_up=0.0)
+    d = p.decide(sig(occupancy=0.05, queue_depth=0.0), 2, now=0.0)
+    assert d.direction == "hold" and d.reason == "at_min"
+
+
+def test_policy_invalid_knobs_rejected():
+    with pytest.raises(ValueError):
+        AutoscalePolicy(cooldown_up=30.0, cooldown_down=10.0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(occupancy_low=0.9, occupancy_high=0.5)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+
+
+# --------------------------------------------------------------------- #
+# signals                                                               #
+# --------------------------------------------------------------------- #
+def test_read_signals_folds_store_and_slo():
+    clk = [1000.0]
+    store = SeriesStore(clock=lambda: clk[0])
+    store.observe("decode/queue_depth", 12.0)
+    store.observe("decode/occupancy", 0.9)
+    store.observe("decode/ttft_ms/p99", 500.0)
+    eng = SLOEngine(store, [SLObjective(
+        "ttft", target=0.9, window=60.0, series=("*ttft*",),
+        threshold=100.0, burn_alert=2.0)], clock=lambda: clk[0])
+    eng.evaluate()
+    s = read_signals(eng, store)
+    assert s.queue_depth == 12.0 and s.occupancy == 0.9
+    assert s.breached == ("ttft",) and s.burn_fast is not None
+    assert not s.no_data
+
+
+def test_read_signals_ignores_stale_gauges():
+    clk = [1000.0]
+    store = SeriesStore(clock=lambda: clk[0])
+    store.observe("decode/occupancy", 0.9)
+    clk[0] += 100.0             # the scraper died 100s ago
+    s = read_signals(store=store, fresh=30.0)
+    assert s.occupancy is None and s.no_data
+
+
+# --------------------------------------------------------------------- #
+# replica-set scaling seams                                             #
+# --------------------------------------------------------------------- #
+def make_model():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    m.evaluate()
+    m.ensure_initialized()
+    return m
+
+
+def make_engine(model):
+    reg = ModelRegistry()
+    reg.register("m", model, input_shape=(4,))
+    return ServingEngine(reg, max_batch=4, max_delay_ms=1.0,
+                         max_queue_rows=16,
+                         recorder=Recorder(annotate=False))
+
+
+def make_rs(model, n=1, **kw):
+    kw.setdefault("engine_kw", dict(max_batch=4, max_delay_ms=1.0,
+                                    max_queue_rows=16))
+    kw.setdefault("health_interval", 0.05)
+    kw.setdefault("probe_interval", 0.05)
+    rs = build_replica_set(model, n, name="m", input_shape=(4,), **kw)
+    rs.warmup()
+    return rs
+
+
+def wait_for(cond, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while not cond():
+        assert time.monotonic() < deadline, f"timed out waiting: {msg}"
+        time.sleep(0.02)
+
+
+def test_add_replica_joins_through_probe_gate():
+    model = make_model()
+    rs = make_rs(model, 1)
+    try:
+        rs.start()
+        idx = rs.add_replica(make_engine(model), warm=True)
+        assert idx == 1
+        h = rs.health()[1]
+        assert h["state"] == "ejected" and h["reason"] == "joining"
+        wait_for(lambda: rs.health()[1]["state"] == "healthy",
+                 msg="joiner probed into rotation")
+        assert rs.recorder.counter_value("replica/scaled_up") == 1
+        y = rs.predict("m", np.ones((2, 4), np.float32), timeout=30)
+        assert np.shape(y) == (2, 2)
+    finally:
+        rs.shutdown(drain=True)
+
+
+def test_decommission_is_terminal_and_idempotent():
+    model = make_model()
+    rs = make_rs(model, 2)
+    try:
+        rs.start()
+        rs.decommission(1)
+        h = rs.health()[1]
+        assert h["state"] == "ejected" and h["reason"] == "scaled_down"
+        assert rs.recorder.counter_value("replica/scaled_down") == 1
+        # never probed back in
+        time.sleep(0.3)
+        assert rs.health()[1]["state"] == "ejected"
+        # idempotent; counters don't double
+        rs.decommission(1)
+        assert rs.recorder.counter_value("replica/scaled_down") == 1
+        # the last routable replica is sacred
+        with pytest.raises(ValueError):
+            rs.decommission(0)
+        # telemetry: the departed member no longer exports a source
+        names = [n for n, _ in rs.telemetry_sources()]
+        assert names == ["set", "replica0"]
+    finally:
+        rs.shutdown(drain=True)
+
+
+# --------------------------------------------------------------------- #
+# controller actuation                                                  #
+# --------------------------------------------------------------------- #
+def make_controller(model, rs, pool=None, store=None, **kw):
+    kw.setdefault("policy", make_policy(
+        idle_ticks=2, cooldown_up=5.0, cooldown_down=20.0))
+    return AutoscaleController(rs, lambda: make_engine(model),
+                               pool=pool, store=store, **kw)
+
+
+def test_controller_scales_up_then_down_against_pool():
+    clk = [0.0]
+    model = make_model()
+    rs = make_rs(model, 1,
+                 recorder=Recorder(sinks=[InMemorySink()],
+                                   annotate=False))
+    pool = DevicePool(devices=["d0", "d1", "d2"])
+    store = SeriesStore(clock=lambda: clk[0])
+    try:
+        rs.start()
+        ctl = make_controller(model, rs, pool=pool, store=store,
+                              claimant="serve")
+        store.observe("decode/occupancy", 0.95)
+        d = ctl.tick(now=0.0)
+        assert d.direction == "up"
+        assert pool.owned_by("serve") == ["d0"]
+        assert "serve" not in [None] and pool.schedulable() == \
+            ["d1", "d2"]
+        wait_for(lambda: rs.health()[1]["state"] == "healthy",
+                 msg="scaled-up replica in rotation")
+        assert ctl.live_replicas() == 2
+        # trough: calm ticks walk the hysteresis then scale down
+        clk[0] = 30.0
+        store.observe("decode/occupancy", 0.05)
+        store.observe("decode/queue_depth", 0.0)
+        assert ctl.tick(now=30.0).direction == "hold"
+        d = ctl.tick(now=31.0)
+        assert d.direction == "down"
+        assert rs.health()[1]["reason"] == "scaled_down"
+        assert pool.owned_by("serve") == []
+        rec = rs.recorder
+        assert rec.counter_value("autoscale/scale_ups") == 1
+        assert rec.counter_value("autoscale/scale_downs") == 1
+        kinds = [r["kind"] for r in
+                 rec.recent_records(rec_type="autoscale_event")]
+        assert kinds == ["scale_up", "scale_down"]
+    finally:
+        rs.shutdown(drain=True)
+
+
+def test_controller_borrows_from_donor_and_returns():
+    clk = [0.0]
+    model = make_model()
+    rs = make_rs(model, 1)
+    pool = DevicePool(devices=["d0", "d1"])
+    pool.claim("train", 2)              # the trainer owns everything
+    store = SeriesStore(clock=lambda: clk[0])
+    try:
+        rs.start()
+        ctl = make_controller(model, rs, pool=pool, store=store,
+                              claimant="serve", donor="train",
+                              donor_take="head")
+        store.observe("decode/occupancy", 0.95)
+        assert ctl.tick(now=0.0).direction == "up"
+        # borrowed the trainer's in-use prefix — its capacity_fn now
+        # sees one fewer device and yields at the next replan poll
+        assert pool.owned_by("train") == ["d1"]
+        assert pool.owned_by("serve") == ["d0"]
+        wait_for(lambda: rs.health()[1]["state"] == "healthy",
+                 msg="borrowed replica in rotation")
+        clk[0] = 30.0
+        store.observe("decode/occupancy", 0.05)
+        store.observe("decode/queue_depth", 0.0)
+        ctl.tick(now=30.0)
+        assert ctl.tick(now=31.0).direction == "down"
+        # the borrow went home: the trainer regrows
+        assert sorted(pool.owned_by("train")) == ["d0", "d1"]
+        assert pool.owned_by("serve") == []
+    finally:
+        rs.shutdown(drain=True)
+
+
+def test_controller_blocked_when_pool_dry_and_no_donor():
+    model = make_model()
+    rs = make_rs(model, 1,
+                 recorder=Recorder(sinks=[InMemorySink()],
+                                   annotate=False))
+    pool = DevicePool(devices=["d0"])
+    pool.claim("train", 1)
+    store = SeriesStore(clock=lambda: 0.0)
+    try:
+        rs.start()
+        ctl = make_controller(model, rs, pool=pool, store=store,
+                              claimant="serve")
+        store.observe("decode/occupancy", 0.95)
+        d = ctl.tick(now=0.0)
+        assert d.direction == "up"      # the decision fired...
+        assert ctl.live_replicas() == 1     # ...but nothing actuated
+        rec = rs.recorder
+        assert rec.counter_value("autoscale/blocked") == 1
+        assert [r["kind"] for r in
+                rec.recent_records(rec_type="autoscale_event")] == \
+            ["blocked"]
+        # the cooldown was not burned: the next tick retries
+        assert ctl.tick(now=1.0).direction == "up"
+        assert rec.counter_value("autoscale/blocked") == 2
+    finally:
+        rs.shutdown(drain=True)
+
+
+def test_controller_deregisters_scaled_down_member():
+    from bigdl_tpu.observability import MetricsAggregator
+    clk = [0.0]
+    model = make_model()
+    rs = make_rs(model, 1)
+    agg = MetricsAggregator(clock=lambda: clk[0], stale_after=5.0)
+    agg.add(rs, name="serve")
+    store = SeriesStore(clock=lambda: clk[0])
+    try:
+        rs.start()
+        ctl = make_controller(model, rs, store=store, aggregator=agg,
+                              member_name="serve")
+        store.observe("decode/occupancy", 0.95)
+        ctl.tick(now=0.0)
+        assert "serve.replica1" in agg.source_names()
+        clk[0] = 30.0
+        store.observe("decode/occupancy", 0.05)
+        store.observe("decode/queue_depth", 0.0)
+        ctl.tick(now=30.0)
+        ctl.tick(now=31.0)
+        # scaled away, not crashed: deregistered from the aggregator
+        assert "serve.replica1" not in agg.source_names()
+        assert agg.recorder.counter_value("agg/deregistered") == 1.0
+    finally:
+        rs.shutdown(drain=True)
+
+
+# --------------------------------------------------------------------- #
+# trace_summary: flap detection                                         #
+# --------------------------------------------------------------------- #
+def test_count_flaps():
+    ts = _load_trace_summary()
+    assert ts.count_flaps([], 30.0) == 0
+    assert ts.count_flaps([(0.0, "up"), (100.0, "down")], 30.0) == 0
+    assert ts.count_flaps([(0.0, "up"), (10.0, "down")], 30.0) == 1
+    assert ts.count_flaps([(0.0, "up"), (10.0, "up"),
+                           (15.0, "down"), (20.0, "up")], 30.0) == 2

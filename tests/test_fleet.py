@@ -35,8 +35,9 @@ import bigdl_tpu.faults as faults
 from bigdl_tpu.checkpoint import PreemptionHandler
 from bigdl_tpu.elastic import ElasticSupervisor
 from bigdl_tpu.fleet import (DevicePool, FleetAdmissionError,
-                             FleetScheduler, enable_shared_compile_cache,
-                             min_plan, plan_fleet)
+                             FleetScheduler, PoolExhaustedError,
+                             enable_shared_compile_cache, min_plan,
+                             plan_fleet)
 from bigdl_tpu.observability import (InMemorySink, IntrospectionServer,
                                      Recorder, render_prometheus,
                                      render_prometheus_multi)
@@ -146,6 +147,124 @@ def test_device_pool_ownership_ledger():
         pool.reassign({"a": [0], "b": [0]})
     with pytest.raises(ValueError, match="outside"):
         pool.reassign({"a": [99]})
+
+
+def test_pool_claim_race_last_device_one_winner():
+    # 8 claimants race for ONE free device: exactly one wins, every
+    # loser gets PoolExhaustedError, and no device is double-owned
+    pool = DevicePool([0])
+    results, errors = [], []
+    barrier = threading.Barrier(8)
+
+    def claimant(i):
+        barrier.wait()
+        try:
+            results.append((i, pool.claim(f"c{i}", 1)))
+        except PoolExhaustedError as e:
+            errors.append((i, e))
+
+    threads = [threading.Thread(target=claimant, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 1 and len(errors) == 7
+    winner, took = results[0]
+    assert took == [0] and pool.owner_of(0) == f"c{winner}"
+    assert pool.free() == []
+
+
+def test_pool_claims_carved_out_of_planner_view():
+    pool = DevicePool([0, 1, 2, 3])
+    pool.claim("serve", 1)
+    assert pool.schedulable() == [1, 2, 3]
+    # the planner can reassign the schedulable share...
+    pool.reassign({"job": [1, 2]})
+    assert pool.owned_by("serve") == [0]        # claim preserved
+    # ...but may neither name the claimant nor touch its device
+    with pytest.raises(ValueError, match="incremental claimant"):
+        pool.reassign({"serve": [3]})
+    with pytest.raises(ValueError, match="both"):
+        pool.reassign({"job": [0]})
+    # a claim never partially succeeds: asking beyond free() takes
+    # nothing
+    with pytest.raises(PoolExhaustedError):
+        pool.claim("serve2", 4)
+    assert pool.free() == [3]
+
+
+def test_pool_concurrent_claims_against_gang_replans():
+    # an autoscaler claiming/releasing while the gang planner swaps
+    # whole assignments: the ledger must never double-own a device
+    pool = DevicePool(list(range(6)))
+    stop = threading.Event()
+    bad = []
+
+    def autoscaler():
+        while not stop.is_set():
+            try:
+                pool.claim("serve", 1)
+            except PoolExhaustedError:
+                pass
+            pool.release("serve")
+
+    def planner():
+        while not stop.is_set():
+            sched = pool.schedulable()
+            half = len(sched) // 2
+            try:
+                pool.reassign({"a": sched[:half], "b": sched[half:]})
+            except ValueError:
+                # a claim landed between snapshot and swap — the real
+                # FleetScheduler retries; here we just note it's loud
+                pass
+            owners = [pool.owner_of(d) for d in pool.devices]
+            if len([o for o in owners if o == "serve"]) > 1:
+                bad.append(owners)
+
+    threads = [threading.Thread(target=autoscaler),
+               threading.Thread(target=planner)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not bad
+    pool.release("serve")
+    # every device accounted for exactly once
+    assert sorted(pool.free() + pool.owned_by("a")
+                  + pool.owned_by("b")) == list(range(6))
+
+
+def test_pool_release_idempotent_and_subset():
+    pool = DevicePool([0, 1, 2])
+    pool.claim("serve", 2)
+    assert pool.release("serve", [0]) == [0]
+    assert pool.release("serve", [0]) == []     # retry: no-op
+    assert pool.release("serve") == [1]
+    assert pool.release("serve") == []          # nothing held: no-op
+    assert pool.release("ghost") == []          # unknown owner: no-op
+    assert pool.free() == [0, 1, 2]
+    # a fully-released claimant leaves the claims set, so the planner
+    # sees the whole pool again
+    assert pool.schedulable() == [0, 1, 2]
+
+
+def test_pool_transfer_head_tail_and_floor():
+    pool = DevicePool([0, 1, 2, 3])
+    pool.claim("train", 3)
+    assert pool.transfer("train", "serve", 1, take="tail") == [2]
+    assert pool.transfer("train", "serve", 1, take="head") == [0]
+    assert pool.owned_by("train") == [1]
+    with pytest.raises(PoolExhaustedError, match="yield"):
+        pool.transfer("train", "serve", 2)
+    assert pool.owned_by("train") == [1]        # refusal took nothing
+    # emptied source leaves the claims set
+    pool.transfer("train", "serve", 1)
+    assert pool.owned_by("train") == []
+    assert sorted(pool.owned_by("serve")) == [0, 1, 2]
 
 
 # --------------------------------------------------------------------- #

@@ -371,6 +371,74 @@ def test_aggregator_staleness_retains_and_flags_then_recovers():
     assert agg.healthz()["ok"] is True
 
 
+def test_remove_member_drops_samples_series_and_verdict():
+    # deliberate scale-down: the member leaves the exposition AND the
+    # series store, never lingering as stale="1" — staleness means
+    # "crashed", not "scaled away"
+    clk = [0.0]
+    agg = MetricsAggregator(clock=lambda: clk[0], stale_after=3.0)
+    agg.add_recorder("replica0", _mk_replica([10.0]))
+    agg.add_recorder("replica1", _mk_replica([20.0]))
+    agg.scrape()
+    assert agg.store.get("replica1/bigdl_decode_ttft_ms/p99") is not None
+    assert agg.remove_member("replica1") is True
+    assert agg.source_names() == ["replica0"]
+    # retained samples are gone, not flagged
+    body = agg.render()
+    assert 'source="replica1"' not in body
+    assert agg.store.match("replica1/*") == []
+    assert agg.store.get("replica0/bigdl_decode_ttft_ms/p99") is not None
+    # and the verdict never 503s over the departed member, even long
+    # after its last scrape would have aged into staleness
+    clk[0] = 100.0
+    agg.scrape()
+    hz = agg.healthz()
+    assert hz["ok"] is True and hz["stale_sources"] == []
+    assert agg.recorder.counter_value("agg/deregistered") == 1.0
+    # idempotent: an unknown (already removed) member is a no-op
+    assert agg.remove_member("replica1") is False
+    assert agg.recorder.counter_value("agg/deregistered") == 1.0
+
+
+def test_remove_member_keeps_crash_retention_for_others():
+    # a member that dies WITHOUT deregistering keeps the crash
+    # semantics (samples retained + flagged stale) even while another
+    # member is deliberately removed
+    clk = [0.0]
+    healthy = [True]
+    rec = _mk_replica([10.0])
+
+    def fetch():
+        if not healthy[0]:
+            raise ConnectionError("crashed")
+        return render_prometheus(rec)
+
+    agg = MetricsAggregator(clock=lambda: clk[0], stale_after=3.0)
+    agg.add_source("crasher", fetch)
+    agg.add_recorder("scaled", _mk_replica([20.0]))
+    agg.scrape()
+    healthy[0] = False
+    agg.remove_member("scaled")
+    clk[0] = 4.0
+    out = agg.scrape()
+    assert out["stale"] == ["crasher"]
+    body = agg.render()
+    assert 'source="crasher",stale="1"' in body     # crash: retained
+    assert 'source="scaled"' not in body            # scale-down: gone
+    assert agg.store.match("crasher/*") != []
+    assert agg.healthz()["ok"] is False
+
+
+def test_remove_member_purge_series_opt_out():
+    agg = MetricsAggregator(clock=lambda: 1.0, stale_after=5.0)
+    agg.add_recorder("keep", _mk_replica([10.0]))
+    agg.scrape()
+    assert agg.remove_member("keep", purge_series=False) is True
+    # exposition forgets the member, the historical series survive
+    assert 'source="keep"' not in agg.render()
+    assert agg.store.match("keep/*") != []
+
+
 def test_aggregator_member_death_over_real_http():
     rec = _mk_replica([15.0])
     srv = IntrospectionServer(rec).start()
