@@ -1,0 +1,232 @@
+"""Sparse gradient application for embedding tables: touch only the
+rows a batch looked up.
+
+A dense optimizer step on a (V, D) table moves O(V·D) bytes and — on
+the wire — exchanges an O(V·D) gradient, even though a batch touches
+U << V rows.  Here the gradient is a :class:`SparseRowGrad` (ids,
+values) pytree that never materializes densely, and application mirrors
+``optim/optim_method.py`` term-for-term:
+
+  * **SGD** updates only the touched rows.  Because an untouched row's
+    dense gradient is exactly zero (``w - clr·0`` is the identity for
+    every float, including -0.0) and a touched row computes the same
+    ``w + (-clr)·g``, the sparse result is **bit-identical** to dense
+    SGD over ``grad.to_dense()`` — asserted, not approximated.
+  * **Adam** keeps full (m, v) moments (they are the zero1 shard space:
+    row ranges slice exactly, see :func:`zero1_row_bounds`) but applies
+    the gradient sparsely: touched-row moments run the exact dense
+    expressions on gathered rows (same FMA-contraction shape), untouched
+    moments decay with a plain ``β·m`` — bit-equal to the dense step's
+    ``β·m + (1-β)·0`` — and the bias-corrected update is the identical
+    dense expression.  On this CPU build that lands bitwise; the honest
+    contract across backends is the established ~1-ulp FMA-contraction
+    envelope (tests assert the tight bound, never loose tolerances).
+    ``lazy=True`` switches to LazyAdam semantics (untouched rows fully
+    frozen): cheaper, but *different math* — never bit-compared to
+    dense.
+
+Contract: appliers require the ids within one SparseRowGrad to be
+unique (-1 = padding, dropped) — exactly what the dedup-path backward
+produces.  :func:`combine_duplicates` folds a duplicated grad into that
+form with dense-order row sums.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class SparseRowGrad:
+    """Row-sparse table gradient: ``ids`` (N,) int32 0-based touched
+    rows (-1 = padding slot, ignored), ``values`` (N, D) their gradient
+    rows, ``n_rows`` the dense table height."""
+
+    def __init__(self, ids, values, n_rows: int):
+        self.ids = jnp.asarray(ids, jnp.int32)
+        self.values = jnp.asarray(values)
+        self.n_rows = int(n_rows)
+
+    def tree_flatten(self):
+        return (self.ids, self.values), self.n_rows
+
+    @classmethod
+    def tree_unflatten(cls, n_rows, children):
+        obj = cls.__new__(cls)
+        obj.ids, obj.values = children
+        obj.n_rows = n_rows
+        return obj
+
+    @property
+    def nnz(self):
+        return self.ids.shape[0]
+
+    def oob_ids(self):
+        """ids with padding (-1) remapped PAST the table: jnp scatters
+        wrap negative indices numpy-style, so -1 must become ``n_rows``
+        for ``mode="drop"`` to actually drop it."""
+        return jnp.where(self.ids >= 0, self.ids, self.n_rows)
+
+    def to_dense(self):
+        """Dense (V, D) gradient — duplicate ids accumulate in slot
+        order, matching what a dense backward would have produced."""
+        out = jnp.zeros((self.n_rows, self.values.shape[1]),
+                        self.values.dtype)
+        return out.at[self.oob_ids()].add(self.values, mode="drop")
+
+    @classmethod
+    def from_dense(cls, grad, ids):
+        """Sparse view of a dense gradient at the given unique rows."""
+        ids = jnp.asarray(ids, jnp.int32)
+        vals = jnp.take(grad, jnp.clip(ids, 0, grad.shape[0] - 1), axis=0)
+        vals = jnp.where((ids >= 0)[:, None], vals, 0.0)
+        return cls(ids, vals, grad.shape[0])
+
+    def wire_bytes(self):
+        """Host-side: bytes this gradient ships (ids + rows) vs the
+        ``n_rows * D * itemsize`` a dense exchange pays."""
+        return int(self.ids.size * 4
+                   + self.values.size * self.values.dtype.itemsize)
+
+    def __repr__(self):
+        return (f"SparseRowGrad(nnz={int(self.nnz)}, "
+                f"n_rows={self.n_rows}, dim={self.values.shape[-1]})")
+
+
+def combine_duplicates(grad: SparseRowGrad) -> SparseRowGrad:
+    """Fold duplicate ids into per-row sums (static shape: output keeps
+    N slots; non-first occurrences become -1 padding).  Row sums
+    accumulate in slot order — the same order a dense scatter-add sees,
+    so SGD over the combined grad stays bit-identical to dense."""
+    ids, vals = grad.ids, grad.values
+    n = ids.shape[0]
+    order = jnp.argsort(ids, stable=True)
+    sid = ids[order]
+    first = jnp.concatenate([jnp.ones((1,), bool), sid[1:] != sid[:-1]])
+    # segment index = rank of each unique run, in sorted order
+    seg = jnp.cumsum(first.astype(jnp.int32)) - 1
+    # sum within runs in ORIGINAL slot order: segment_sum over values
+    # taken in sorted order is ordered by `order`, which argsort keeps
+    # stable — dense scatter-add accumulates identically
+    summed = jax.ops.segment_sum(vals[order], seg, num_segments=n)
+    uniq_ids = jnp.full((n,), -1, jnp.int32).at[seg].set(sid, mode="drop")
+    uniq_ids = jnp.where(jnp.arange(n) <= seg[-1], uniq_ids, -1)
+    return SparseRowGrad(uniq_ids, summed, grad.n_rows)
+
+
+def touched_fraction(grad: SparseRowGrad, recorder=None) -> float:
+    """Static touched-rows fraction (padded slots included — the shape
+    the exchange actually pays), reported to ``embedding/*``."""
+    frac = grad.nnz / float(grad.n_rows)
+    if recorder is None:
+        from ..observability.recorder import get_recorder
+        recorder = get_recorder()
+    if recorder.enabled:
+        recorder.gauge("embedding/touched_rows_fraction", frac)
+    return frac
+
+
+class SparseSGD:
+    """Touched-rows SGD, mirroring ``optim_method.SGD``'s plain path
+    (learning-rate decay, no momentum — momentum state would dense-decay
+    like Adam's moments).  Bit-identical to dense SGD over
+    ``grad.to_dense()`` when ids are unique.
+
+    Bitwise mechanics: the touched rows are gathered, updated with the
+    *same expression* dense SGD applies (``p - clr * g`` — same
+    FMA-contraction opportunity, so XLA lowers both identically), and
+    scattered back; untouched rows are untouched, which dense SGD also
+    leaves bit-exact (``p - clr·0`` is the identity)."""
+
+    def __init__(self, learning_rate=1e-2, lr_decay=0.0):
+        self.learning_rate = float(learning_rate)
+        self.lr_decay = float(lr_decay)
+
+    def init_state(self, table):
+        return {"step": jnp.zeros((), jnp.int32)}
+
+    def update(self, table, grad: SparseRowGrad, state):
+        step = state["step"]
+        clr = self.learning_rate / (1.0 + step * self.lr_decay)
+        touched_fraction(grad)
+        sel = jnp.clip(grad.ids, 0, grad.n_rows - 1)
+        rows = jnp.take(table, sel, axis=0)
+        new_rows = rows - clr * grad.values.astype(table.dtype)
+        new = table.at[grad.oob_ids()].set(new_rows, mode="drop")
+        return new, {"step": state["step"] + 1}
+
+
+class SparseAdam:
+    """Adam with sparse gradient application (exact mode) — moments
+    decay densely, gradient terms land sparsely; same math as
+    ``optim_method.Adam``, documented-ulp program-structure drift.
+    ``lazy=True`` freezes untouched rows entirely (LazyAdam)."""
+
+    def __init__(self, learning_rate=1e-3, lr_decay=0.0, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, lazy=False):
+        self.learning_rate = float(learning_rate)
+        self.lr_decay = float(lr_decay)
+        self.beta1 = float(beta1)
+        self.beta2 = float(beta2)
+        self.epsilon = float(epsilon)
+        self.lazy = bool(lazy)
+
+    def init_state(self, table):
+        return {"step": jnp.zeros((), jnp.int32),
+                "m": jnp.zeros_like(table), "v": jnp.zeros_like(table)}
+
+    def update(self, table, grad: SparseRowGrad, state):
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        step = state["step"]
+        t = step + 1
+        clr = self.learning_rate / (1.0 + step * self.lr_decay)
+        bc1 = 1.0 - b1 ** t.astype(jnp.float32)
+        bc2 = 1.0 - b2 ** t.astype(jnp.float32)
+        ids, g = grad.oob_ids(), grad.values
+        touched_fraction(grad)
+        sel = jnp.clip(ids, 0, grad.n_rows - 1)
+        # touched rows run the exact dense expressions on gathered rows
+        # (same FMA-contraction shape as optim_method.Adam's tree-map)
+        m_rows = b1 * jnp.take(state["m"], sel, axis=0) + (1 - b1) * g
+        v_rows = b2 * jnp.take(state["v"], sel, axis=0) \
+            + (1 - b2) * g * g
+        if self.lazy:
+            # LazyAdam: moments and params move ONLY at touched rows —
+            # different semantics from dense Adam, never bit-compared
+            m = state["m"].at[ids].set(m_rows, mode="drop")
+            v = state["v"].at[ids].set(v_rows, mode="drop")
+            p_rows = jnp.take(table, sel, axis=0)
+            upd = p_rows - (clr * (m_rows / bc1)
+                            / (jnp.sqrt(v_rows / bc2) + eps)
+                            ).astype(table.dtype)
+            new = table.at[ids].set(upd, mode="drop")
+        else:
+            # exact Adam: untouched moments still decay (β·m — which a
+            # dense step computes bit-identically as β·m + (1-β)·0), so
+            # the dense-program update below sees bitwise-equal inputs
+            m = (b1 * state["m"]).at[ids].set(m_rows, mode="drop")
+            v = (b2 * state["v"]).at[ids].set(v_rows, mode="drop")
+            new = table - (clr * (m / bc1)
+                           / (jnp.sqrt(v / bc2) + eps)).astype(table.dtype)
+        return new, {"step": state["step"] + 1, "m": m, "v": v}
+
+
+def zero1_row_bounds(n_rows: int, rank: int, size: int):
+    """[lo, hi) row range rank owns in the zero1 shard space.  Table
+    rows are the natural shard unit: optimizer moments slice exactly on
+    row boundaries, so per-rank application of a row-range-filtered
+    SparseRowGrad concatenates bit-identically to full application
+    (asserted in tests) — embedding state composes with zero1 without a
+    flat repack."""
+    per = -(-n_rows // size)
+    lo = min(rank * per, n_rows)
+    return lo, min(lo + per, n_rows)
+
+
+def slice_grad_rows(grad: SparseRowGrad, lo: int, hi: int) -> SparseRowGrad:
+    """Restrict a SparseRowGrad to rows in [lo, hi), rebased to the
+    slice (static shape: out-of-range slots become -1 padding)."""
+    inside = (grad.ids >= lo) & (grad.ids < hi)
+    ids = jnp.where(inside, grad.ids - lo, -1)
+    vals = jnp.where(inside[:, None], grad.values, 0.0)
+    return SparseRowGrad(ids, vals, hi - lo)

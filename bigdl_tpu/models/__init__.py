@@ -1,2 +1,3 @@
 """bigdl_tpu.models — model zoo (≙ com.intel.analytics.bigdl.models)."""
-from . import autoencoder, inception, lenet, resnet, rnn, transformer, vgg
+from . import (autoencoder, inception, lenet, resnet, rnn, transformer,
+               two_tower, vgg)

@@ -1,0 +1,60 @@
+"""Two-tower retrieval model over embedding-bag towers.
+
+The canonical recommendation topology: a user tower and an item tower,
+each an embedding bag over a (possibly huge) id table, joined by a dot
+product and squashed to a click probability — trained against
+BCECriterion on MovieLens-style ``(uid_list, mid_list, label)``
+samples (see :mod:`bigdl_tpu.data.movielens`).
+
+The towers mean-combine their bags, so the ragged movie list (target +
+recent history) folds into one item vector regardless of history
+length.  The dense path below is the tier-1 CPU reference;
+:class:`bigdl_tpu.embedding.ShardedEmbeddingBag` is the bitwise-equal
+drop-in when the tables outgrow one device (tests assert the parity).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..embedding.sharded import dense_bag
+from ..nn.init import Xavier, init_tensor
+from ..nn.module import Module
+
+
+class TwoTower(Module):
+    """``x = (uids (B, Lu), mids (B, Lm))`` int32 1-based ids (0 = pad)
+    → ``sigmoid(<user_vec, item_vec>)`` of shape (B, 1)."""
+
+    def __init__(self, n_users: int, n_items: int, n_output: int = 16,
+                 combiner: str = "mean", name=None):
+        super().__init__(name=name)
+        self.n_users = int(n_users)
+        self.n_items = int(n_items)
+        self.n_output = int(n_output)
+        self.combiner = combiner
+
+    def init(self, rng):
+        ku, ki = jax.random.split(rng)
+        wu = init_tensor(self, ku, (self.n_users, self.n_output),
+                         self.n_users, self.n_output, Xavier())
+        wi = init_tensor(self, ki, (self.n_items, self.n_output),
+                         self.n_items, self.n_output, Xavier())
+        return {self.name: {"weight_user": wu, "weight_item": wi}}
+
+    def apply(self, params, x, ctx):
+        p = self.own(params)
+        uids, mids = x
+        u = dense_bag(p["weight_user"], uids, combiner=self.combiner)
+        m = dense_bag(p["weight_item"], mids, combiner=self.combiner)
+        logits = jnp.sum(u * m, axis=-1, keepdims=True)
+        # clip keeps BCE's log() finite at saturated predictions
+        return jnp.clip(jax.nn.sigmoid(logits), 1e-7, 1.0 - 1e-7)
+
+
+def build(n_users: int, n_items: int, n_output: int = 16,
+          combiner: str = "mean") -> TwoTower:
+    """Two-tower model sized for a rating table (ids are 1-based, so
+    tables hold ``n + 1`` rows and row 0 is never combined — padding)."""
+    return TwoTower(n_users + 1, n_items + 1, n_output, combiner,
+                    name="TwoTower")

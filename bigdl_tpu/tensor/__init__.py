@@ -253,13 +253,29 @@ def embedding_bag(weight, ids_sp: SparseTensor, per_id_weights=None,
 
     ``ids_sp.values`` are 1-based embedding ids; combiner ∈ sum|mean|sqrtn;
     ``max_norm > 0`` l2-clips each embedding before combining.
+
+    Out-of-range ids (< 1 or > weight rows) are a caller bug, not a
+    clamping opportunity: concrete ids raise ``IndexError`` eagerly;
+    under a trace (where python control flow can't fire) the offending
+    embeddings are NaN-poisoned so the error surfaces in the output
+    instead of silently reading row 0 or row V-1.
     """
     if combiner not in ("sum", "mean", "sqrtn"):
         raise ValueError(f"combiner must be sum|mean|sqrtn: {combiner}")
     n_rows = ids_sp.shape[0]
     rows = ids_sp.row_ids()
     ids = ids_sp.values.astype(jnp.int32) - 1
+    oob = (ids < 0) | (ids >= weight.shape[0])
+    try:
+        if bool(oob.any()):
+            bad = np.asarray(ids)[np.asarray(oob)][:4] + 1
+            raise IndexError(
+                f"embedding_bag: ids out of range for {weight.shape[0]}-row "
+                f"table (1-based, first offenders: {bad.tolist()})")
+    except jax.errors.TracerBoolConversionError:
+        pass    # traced ids: the NaN poison below carries the error
     emb = jnp.take(weight, jnp.clip(ids, 0, weight.shape[0] - 1), axis=0)
+    emb = jnp.where(oob[:, None], jnp.nan, emb)
     if max_norm > 0:
         norms = jnp.linalg.norm(emb, axis=-1, keepdims=True)
         emb = emb * jnp.minimum(1.0, max_norm / jnp.maximum(norms, 1e-7))

@@ -59,6 +59,15 @@ Two subcommands:
 
         python scripts/trace_summary.py comm /tmp/telemetry.jsonl [last_n]
 
+  embedding          sharded-embedding lookup economics from the
+                     embedding/* family: exchange wire bytes and id
+                     slots per step, host-dedup reduction (unique vs
+                     raw ids), bucket-ladder padding waste, and the
+                     touched-rows fraction sparse gradient application
+                     pays vs a dense step:
+
+        python scripts/trace_summary.py embedding /tmp/telemetry.jsonl [last_n]
+
   serving            per-replica health transitions from a ReplicaSet's
                      telemetry JSONL: one chronological
                      eject → probe → readmit / canary_stage →
@@ -1027,6 +1036,62 @@ def main_comm(argv):
     summarize_comm(steps)
 
 
+def summarize_embedding(steps, out=print):
+    """Render the sharded-embedding lookup economics: exchange wire
+    volume, dedup reduction, padding waste, touched-rows fraction —
+    the embedding/* family from dedup/pad/exchange/sparse-apply sites."""
+    if not steps:
+        out("no step records")
+        return
+    last = steps[-1]
+    g = last.get("gauges", {})
+    c = last.get("counters", {})
+    n = len(steps)
+    out(f"steps: {n}")
+
+    ex_bytes = g.get("embedding/lookup_exchange_bytes", 0.0)
+    ex_ids = g.get("embedding/exchange_ids", 0.0)
+    if ex_bytes or ex_ids:
+        out("\n== lookup exchange (per step, trace-time accounting) ==")
+        out(f"  wire            {_fmt_bytes(ex_bytes):>12}  "
+            f"(both all-to-all legs: ids out + embeddings back)")
+        out(f"  id slots        {ex_ids:12.0f}  (capacity x shards, "
+            "padding included)")
+
+    din = c.get("embedding/dedup_in_ids", 0.0)
+    dout = c.get("embedding/dedup_out_ids", 0.0)
+    if din:
+        out("\n== host dedup ==")
+        out(f"  ids in          {din:12.0f}")
+        out(f"  unique out      {dout:12.0f}   "
+            f"({100.0 * (1.0 - dout / din):.1f}% of the wire saved)")
+        out(f"  last-batch ratio {g.get('embedding/dedup_ratio', 0.0):.3f}")
+
+    slots = c.get("embedding/pad_slots", 0.0)
+    idsn = c.get("embedding/pad_ids", 0.0)
+    if slots:
+        out("\n== bucket-ladder padding ==")
+        out(f"  slots emitted   {slots:12.0f}   real ids {idsn:.0f}   "
+            f"cumulative waste {100.0 * (1.0 - idsn / slots):.1f}%")
+        out(f"  last-batch waste {g.get('embedding/padding_waste', 0.0):.3f}")
+
+    tf = g.get("embedding/touched_rows_fraction")
+    if tf is not None:
+        out("\n== sparse gradient application ==")
+        out(f"  touched rows    {100.0 * tf:11.2f}%  of the table — a "
+            f"dense step overpays {1.0 / max(tf, 1e-12):.0f}x")
+
+
+def main_embedding(argv):
+    if not argv:
+        raise SystemExit("usage: trace_summary.py embedding "
+                         "<telemetry.jsonl> [last_n]")
+    last_n = int(argv[1]) if len(argv) > 1 else None
+    steps, _ = load_steps(argv[0], last_n)
+    print(f"telemetry: {argv[0]}")
+    summarize_embedding(steps)
+
+
 def main_profile(argv):
     if not argv:
         raise SystemExit("usage: trace_summary.py profile "
@@ -1118,6 +1183,8 @@ def main():
         main_input(argv[1:])
     elif argv and argv[0] == "comm":
         main_comm(argv[1:])
+    elif argv and argv[0] == "embedding":
+        main_embedding(argv[1:])
     elif argv and argv[0] == "profile":
         main_profile(argv[1:])
     elif argv and argv[0] == "health":

@@ -1,7 +1,10 @@
 """bigdl_tpu.tensor unit tests (≙ tensor/DenseTensorSpec.scala,
 SparseTensorSpec.scala, QuantizedTensorSpec.scala): torch-style 1-based
 index helpers vs torch ground truth, sparse COO ops, int8 quantization."""
+import os
+
 import numpy as np
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -107,6 +110,71 @@ def test_embedding_bag_combiners():
     np.testing.assert_allclose(m[0], (W[1] + W[4]) / 2, rtol=1e-6)
     q = np.asarray(bt.embedding_bag(jnp.asarray(W), ids, combiner="sqrtn"))
     np.testing.assert_allclose(q[0], (W[1] + W[4]) / np.sqrt(2), rtol=1e-6)
+
+
+def test_embedding_bag_empty_bag_is_zero():
+    # bag 1 has no ids at all: sum combines to exactly 0, mean/sqrtn
+    # must not divide by zero
+    W = np.random.RandomState(0).randn(5, 4).astype(np.float32)
+    ids = bt.SparseTensor(np.array([[0, 0], [0, 1]], np.int32),
+                          np.array([1, 3], np.float32), (3, 2))
+    for combiner in ("sum", "mean", "sqrtn"):
+        y = np.asarray(bt.embedding_bag(jnp.asarray(W), ids,
+                                        combiner=combiner))
+        assert np.isfinite(y).all()
+        np.testing.assert_array_equal(y[1:], 0.0)
+
+
+def test_embedding_bag_duplicate_ids_in_one_bag():
+    # the same id twice in one bag counts twice (and mean divides by 2)
+    W = np.random.RandomState(1).randn(5, 4).astype(np.float32)
+    ids = bt.SparseTensor(np.array([[0, 0], [0, 1]], np.int32),
+                          np.array([3, 3], np.float32), (1, 2))
+    s = np.asarray(bt.embedding_bag(jnp.asarray(W), ids, combiner="sum"))
+    np.testing.assert_allclose(s[0], 2 * W[2], rtol=1e-6)
+    m = np.asarray(bt.embedding_bag(jnp.asarray(W), ids, combiner="mean"))
+    np.testing.assert_allclose(m[0], W[2], rtol=1e-6)
+
+
+def test_embedding_bag_out_of_range_raises():
+    # hardening: ids past the table (or < 1) raise loudly for concrete
+    # inputs instead of silently clipping to an existing row
+    W = jnp.zeros((5, 4), jnp.float32)
+    for bad in (0.0, 6.0, -1.0):
+        ids = bt.SparseTensor(np.array([[0, 0], [0, 1]], np.int32),
+                              np.array([1.0, bad], np.float32), (1, 2))
+        with pytest.raises(IndexError, match="out of range"):
+            bt.embedding_bag(W, ids)
+
+
+def test_embedding_bag_out_of_range_poisons_under_trace():
+    # inside jit, python raising can't fire — the offending output rows
+    # become NaN so the bug surfaces instead of reading a wrong row
+    W = jnp.asarray(np.random.RandomState(2).randn(5, 4), jnp.float32)
+
+    @jax.jit
+    def f(vals):
+        sp = bt.SparseTensor(np.array([[0, 1], [0, 0]]), vals, (2, 2))
+        return bt.embedding_bag(W, sp)
+
+    bad = np.asarray(f(jnp.array([1.0, 9.0])))
+    assert np.isnan(bad[1]).all() and np.isfinite(bad[0]).all()
+    ok = np.asarray(f(jnp.array([1.0, 2.0])))
+    assert np.isfinite(ok).all()
+
+
+def test_embedding_bag_gradients():
+    # AD gradients of the bag (valid ids) against finite differences,
+    # duplicate ids included — through the LookupTableSparse module so
+    # the shared gradient_checker drives it
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from gradient_checker import check_gradients
+    from bigdl_tpu import nn
+    ids = bt.SparseTensor(np.array([[0, 0, 0, 1], [0, 1, 2, 0]], np.int32),
+                          np.array([2, 4, 2, 1], np.float32), (2, 3))
+    for combiner in ("sum", "mean", "sqrtn"):
+        check_gradients(nn.LookupTableSparse(5, 4, combiner=combiner), ids)
 
 
 def test_sparse_concat():
