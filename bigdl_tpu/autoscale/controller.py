@@ -47,6 +47,8 @@ from typing import Any, Callable, List, Optional
 
 from .policy import AutoscalePolicy, ScaleDecision
 from .signals import Signals, read_signals
+from ..observability import tracing as trace_spine
+from ..observability.context import TraceContext
 
 
 class AutoscaleController:
@@ -122,16 +124,39 @@ class AutoscaleController:
                 rec.gauge("autoscale/queue_depth", sig.queue_depth)
             if sig.burn_fast is not None:
                 rec.gauge("autoscale/burn_fast", sig.burn_fast)
+            ctx = span = None
+            if decision.direction in ("up", "down"):
+                # one trace per actuating decision.  Its children are
+                # the slo.sample evidence events (backward edge: the
+                # exact samples that triggered it) and the pool
+                # claim/transfer spans (forward edge: the capacity it
+                # moved); the displaced trainer's replan links back to
+                # this ctx through the pool's actuation note.
+                tracer = trace_spine.get_tracer()
+                ctx = TraceContext.new_root()
+                span = tracer.begin(
+                    f"autoscale.{decision.direction}", ctx, child=False,
+                    subsystem="autoscale")
+                for ev in decision.evidence:
+                    tracer.event("slo.sample", ctx,
+                                 subsystem="autoscale",
+                                 kind=ev.get("kind"),
+                                 series=ev.get("series"),
+                                 sample_t=ev.get("t"),
+                                 value=ev.get("value"))
             if decision.direction == "up":
-                applied = self._scale_up_locked(decision, n)
+                applied = self._scale_up_locked(decision, n, ctx)
                 if applied:
                     self.policy.mark_scaled("up", now)
             elif decision.direction == "down":
-                applied = self._scale_down_locked(decision, n)
+                applied = self._scale_down_locked(decision, n, ctx)
                 if applied:
                     self.policy.mark_scaled("down", now)
             else:
                 rec.inc("autoscale/holds")
+            if span is not None:
+                span.end(reason=decision.reason, delta=decision.delta,
+                         applied=applied)
             return decision
 
     def _emit(self, kind: str, decision: ScaleDecision, n_before: int,
@@ -142,7 +167,7 @@ class AutoscaleController:
             signals=decision.signals.as_dict(), **extra)
 
     # -- actuation ---------------------------------------------------------- #
-    def _acquire_device_locked(self):
+    def _acquire_device_locked(self, ctx=None):
         """One device for a new replica: free pool first, then borrow
         from the donor (shrinking the trainer).  Raises
         :class:`~bigdl_tpu.fleet.PoolExhaustedError` when neither can
@@ -151,17 +176,18 @@ class AutoscaleController:
         if self.pool is None:
             return None
         try:
-            dev = self.pool.claim(self.claimant, 1)[0]
+            dev = self.pool.claim(self.claimant, 1, trace_ctx=ctx)[0]
         except PoolExhaustedError:
             if self.donor is None:
                 raise
             dev = self.pool.transfer(self.donor, self.claimant, 1,
-                                     take=self.donor_take)[0]
+                                     take=self.donor_take,
+                                     trace_ctx=ctx)[0]
             self._borrowed.append(dev)
         self._devices.append(dev)
         return dev
 
-    def _release_device_locked(self):
+    def _release_device_locked(self, ctx=None):
         """Return one device after a scale-down: borrowed capacity
         transfers back to the donor (the trainer regrows at its next
         capacity poll), owned capacity frees into the pool."""
@@ -171,19 +197,19 @@ class AutoscaleController:
         if self._borrowed:
             self._borrowed.pop()
             moved = self.pool.transfer(self.claimant, self.donor, 1,
-                                       take="tail")
+                                       take="tail", trace_ctx=ctx)
             return moved[0] if moved else dev
-        freed = self.pool.release(self.claimant, [dev])
+        freed = self.pool.release(self.claimant, [dev], trace_ctx=ctx)
         return freed[0] if freed else dev
 
     def _scale_up_locked(self, decision: ScaleDecision,
-                         n_before: int) -> int:
+                         n_before: int, ctx=None) -> int:
         from ..fleet.pool import PoolExhaustedError
         rec = self.recorder
         applied = 0
         for _ in range(decision.delta):
             try:
-                dev = self._acquire_device_locked()
+                dev = self._acquire_device_locked(ctx)
             except PoolExhaustedError as e:
                 rec.inc("autoscale/blocked")
                 self._emit("blocked", decision, n_before + applied,
@@ -200,11 +226,12 @@ class AutoscaleController:
                        n_before + applied, replica=idx,
                        device=repr(dev), borrowed=bool(
                            self._borrowed and
-                           self._borrowed[-1] is dev))
+                           self._borrowed[-1] is dev),
+                       trace_id=None if ctx is None else ctx.trace_id)
         return applied
 
     def _scale_down_locked(self, decision: ScaleDecision,
-                           n_before: int) -> int:
+                           n_before: int, ctx=None) -> int:
         from ..serving.replicas import TERMINAL_REASONS
         rec = self.recorder
         applied = 0
@@ -225,12 +252,13 @@ class AutoscaleController:
             if self.aggregator is not None:
                 self.aggregator.remove_member(
                     f"{self.member_name}.replica{victim}")
-            dev = self._release_device_locked()
+            dev = self._release_device_locked(ctx)
             applied += 1
             rec.inc("autoscale/scale_downs")
             self._emit("scale_down", decision, n_before - applied + 1,
                        n_before - applied, replica=victim,
-                       device=repr(dev))
+                       device=repr(dev),
+                       trace_id=None if ctx is None else ctx.trace_id)
         return applied
 
     # -- background loop ---------------------------------------------------- #

@@ -52,6 +52,13 @@ class ScaleDecision:
         self.at = float(at)
         self.signals = signals
 
+    @property
+    def evidence(self):
+        """The exact SLO-burn/occupancy/queue samples the signals
+        snapshot folded — what a decision trace's ``slo.sample``
+        child events cite (empty tuple when signals carry none)."""
+        return getattr(self.signals, "evidence", ())
+
     def as_dict(self) -> Dict[str, Any]:
         return {"direction": self.direction, "delta": self.delta,
                 "reason": self.reason, "at": self.at,

@@ -34,14 +34,16 @@ class Signals:
     """One immutable-ish snapshot of everything the policy looks at."""
 
     __slots__ = ("at", "burn_fast", "burn_slow", "breached", "no_data",
-                 "queue_depth", "occupancy", "kv_fill", "replicas")
+                 "queue_depth", "occupancy", "kv_fill", "replicas",
+                 "evidence")
 
     def __init__(self, *, at: float, burn_fast: Optional[float] = None,
                  burn_slow: Optional[float] = None,
                  breached: Tuple[str, ...] = (), no_data: bool = True,
                  queue_depth: Optional[float] = None,
                  occupancy: Optional[float] = None,
-                 kv_fill: Optional[float] = None, replicas: int = 0):
+                 kv_fill: Optional[float] = None, replicas: int = 0,
+                 evidence: Tuple[Dict[str, Any], ...] = ()):
         self.at = float(at)
         self.burn_fast = burn_fast
         self.burn_slow = burn_slow
@@ -51,9 +53,15 @@ class Signals:
         self.occupancy = occupancy
         self.kv_fill = kv_fill
         self.replicas = int(replicas)
+        # provenance: the EXACT samples/verdicts this snapshot folded —
+        # ``{"kind", "series", "t", "value"}`` per item — so a scale
+        # decision's trace can link to what actually triggered it
+        self.evidence = tuple(evidence)
 
     def as_dict(self) -> Dict[str, Any]:
-        return {k: getattr(self, k) for k in self.__slots__}
+        d = {k: getattr(self, k) for k in self.__slots__}
+        d["evidence"] = [dict(e) for e in self.evidence]
+        return d
 
     def __repr__(self):
         return (f"Signals(breached={list(self.breached)}, "
@@ -64,13 +72,15 @@ class Signals:
 
 def _fresh_last(store, patterns: Sequence[str], now: float,
                 fresh: float):
-    """``[(key, value), ...]`` latest point per matching series, only
-    when the point is newer than ``now - fresh``."""
+    """``[(key, t, value), ...]`` latest point per matching series, only
+    when the point is newer than ``now - fresh``.  The timestamp rides
+    along as provenance — it identifies the exact sample a scale
+    decision later cites as evidence."""
     out = []
     for key in store.match(patterns):
         last = store.get(key).last()
         if last is not None and last[0] >= now - fresh:
-            out.append((key, last[1]))
+            out.append((key, last[0], last[1]))
     return out
 
 
@@ -93,6 +103,7 @@ def read_signals(slo_engine=None, store=None, replica_set=None, *,
     burn_fast = burn_slow = None
     breached = []
     no_data = True
+    evidence = []
     if slo_engine is not None and slo_engine.last_results:
         for name, r in slo_engine.last_results.items():
             if r.get("no_data"):
@@ -105,20 +116,28 @@ def read_signals(slo_engine=None, store=None, replica_set=None, *,
                 burn_slow = bs
             if r.get("breach"):
                 breached.append(name)
+            evidence.append({"kind": "slo", "series": name, "t": now,
+                             "value": bf, "burn_slow": bs,
+                             "breach": bool(r.get("breach"))})
 
     queue_depth = occupancy = kv_fill = None
     if store is not None:
         qs = _fresh_last(store, queue_series, now, fresh)
         if qs:
-            queue_depth = sum(v for _, v in qs)
+            queue_depth = sum(v for _, _, v in qs)
             no_data = False
         occ = _fresh_last(store, occupancy_series, now, fresh)
         if occ:
-            occupancy = sum(v for _, v in occ) / len(occ)
+            occupancy = sum(v for _, _, v in occ) / len(occ)
             no_data = False
         kv = _fresh_last(store, kv_series, now, fresh)
         if kv:
-            kv_fill = sum(v for _, v in kv) / len(kv)
+            kv_fill = sum(v for _, _, v in kv) / len(kv)
+        for kind, rows in (("queue", qs), ("occupancy", occ),
+                           ("kv", kv)):
+            for key, t, v in rows:
+                evidence.append({"kind": kind, "series": key,
+                                 "t": t, "value": v})
 
     replicas = 0
     if replica_set is not None:
@@ -131,4 +150,5 @@ def read_signals(slo_engine=None, store=None, replica_set=None, *,
     return Signals(at=now, burn_fast=burn_fast, burn_slow=burn_slow,
                    breached=sorted(breached), no_data=no_data,
                    queue_depth=queue_depth, occupancy=occupancy,
-                   kv_fill=kv_fill, replicas=replicas)
+                   kv_fill=kv_fill, replicas=replicas,
+                   evidence=tuple(evidence))

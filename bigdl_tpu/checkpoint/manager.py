@@ -129,7 +129,7 @@ class CheckpointManager:
     # -- save ------------------------------------------------------------ #
     def save(self, payload, meta: Dict[str, Any], tag: str,
              sync: bool = False, mesh: Optional[Dict] = None,
-             owned=None):
+             owned=None, trace_ctx=None):
         """Queue one checkpoint.  ``payload`` must already be HOST data
         (numpy leaves): for the "manifest" layout a ``{shard_name: tree}``
         dict, for "file" an arbitrary state tree.  ``sync=True`` (or a
@@ -140,7 +140,13 @@ class CheckpointManager:
         v2 manifest so restore can tell resume from reshard.  ``owned``
         optionally names the shards THIS process writes (elastic sliced
         saves, where each host owns its own fragment entries); the
-        default keeps the round-robin-by-sorted-name assignment."""
+        default keeps the round-robin-by-sorted-name assignment.
+
+        ``trace_ctx`` (a
+        :class:`~bigdl_tpu.observability.context.TraceContext`) rides
+        on the job object to the writer thread, which records the
+        queue-wait and write there under the submitting step's trace
+        id — the step → async-writer half of the causal spine."""
         if self.layout == "manifest":
             if not isinstance(payload, dict):
                 raise TypeError("manifest layout expects {shard_name: tree}")
@@ -150,6 +156,8 @@ class CheckpointManager:
                                                     mesh=mesh, owned=owned)
         else:
             job = lambda: self._write_file_ckpt(payload, dict(meta), tag)
+        if trace_ctx is not None:
+            job.trace_ctx = trace_ctx
         if sync or not self.async_write:
             # raise THIS job's failure only — an earlier async write may
             # have failed (by design without killing training) and its
@@ -162,6 +170,8 @@ class CheckpointManager:
                 except BaseException as e:
                     box["err"] = e
                     raise
+            if trace_ctx is not None:
+                tracked.trace_ctx = trace_ctx
             self.writer.submit(tracked)
             self.writer.wait()
             if "err" in box:

@@ -11,6 +11,15 @@ same backpressure contract as the serving queue.
 A failed write must never kill training (≙ the old pickle-fallback
 rationale): errors are stored on ``last_error``, counted on the
 recorder, and printed; :meth:`wait` returns whether everything flushed.
+
+Tracing: a job carrying a ``trace_ctx`` attribute (a
+:class:`~bigdl_tpu.observability.context.TraceContext`, attached by
+``CheckpointManager.save``) gets two spans on the writer thread —
+``ckpt.queue`` (submit → dequeue: backpressure + FIFO wait) and
+``ckpt.write`` (the write itself) — under the SUBMITTER's trace id.
+The context and submit stamp ride on the job object through the same
+deque/Condition that orders the work, so the propagation is
+racecheck-clean by the handoff discipline.
 """
 from __future__ import annotations
 
@@ -18,6 +27,9 @@ import collections
 import threading
 import traceback
 from typing import Callable, Optional
+
+from ..observability import context as _trace_clock
+from ..observability import tracing as trace_spine
 
 
 class AsyncCheckpointWriter:
@@ -42,6 +54,13 @@ class AsyncCheckpointWriter:
     def submit(self, job: Callable[[], None]):
         """Enqueue one checkpoint job; blocks when ``max_pending``
         snapshots are already in flight (backpressure, not data loss)."""
+        try:
+            # stamp BEFORE the enqueue: the writer thread may pop the
+            # job the instant it lands, and the cv handoff is the only
+            # ordering between submitter and writer
+            job._trace_t_submit = _trace_clock.trace_now()
+        except AttributeError:
+            pass                      # e.g. a bound method; no stamp
         with self._cv:
             if self._closed:
                 raise RuntimeError("checkpoint writer is closed")
@@ -65,11 +84,29 @@ class AsyncCheckpointWriter:
                 if not self._jobs:
                     return          # closed and drained
                 job = self._jobs.popleft()
+            ctx = getattr(job, "trace_ctx", None)
+            t_start = _trace_clock.trace_now()
+            if ctx is not None:
+                t_sub = getattr(job, "_trace_t_submit", t_start)
+                trace_spine.get_tracer().record(trace_spine.Span(
+                    "ckpt.queue", ctx.child(), t_sub, t_start,
+                    subsystem="checkpoint"))
             try:
                 job()
+                if ctx is not None:
+                    trace_spine.get_tracer().record(trace_spine.Span(
+                        "ckpt.write", ctx.child(), t_start,
+                        _trace_clock.trace_now(),
+                        subsystem="checkpoint"))
             except BaseException as e:       # noqa: BLE001 — must survive
                 self.last_error = e
                 self._rec().inc("checkpoint/failed")
+                if ctx is not None:
+                    trace_spine.get_tracer().record(trace_spine.Span(
+                        "ckpt.write", ctx.child(), t_start,
+                        _trace_clock.trace_now(),
+                        subsystem="checkpoint",
+                        args={"error": repr(e)}))
                 print(f"[checkpoint] async write failed: {e!r}")
                 traceback.print_exc()
             finally:

@@ -54,6 +54,8 @@ from typing import Any, Callable, Dict, Optional
 
 from .plan import _prod, plan_devices, plan_mesh
 from .. import faults as faultplane
+from ..observability import tracing as trace_spine
+from ..observability.context import TraceContext
 from ..utils.retry import RetryPolicy
 
 
@@ -144,6 +146,11 @@ class ElasticSupervisor:
         self.state = "idle"
         self.restarts = 0
         self.trainer = None
+        # one TraceContext per run(): every state transition, trainer
+        # step, and checkpoint write records under its trace id.  Pre-
+        # set it to adopt an external trace; run() mints a root if None
+        self.trace_ctx: Optional[TraceContext] = None
+        self._state_span = None         # open span of the CURRENT state
         self._stop = False
         self._preemption = None
         self._loop_ident: Optional[int] = None
@@ -175,10 +182,33 @@ class ElasticSupervisor:
                         step=fields.get("step"), metric="elastic/devices",
                         value=fields.get("devices"), threshold=None,
                         action="elastic")
+        if self.trace_ctx is not None:
+            links = []
+            if kind in ("shrink", "regrow", "displace", "preemption"):
+                # the autoscaler/pool noted the decision context that
+                # moved this job's devices; the transition event links
+                # BACK to it — "this shrink was caused by that decision"
+                cause = trace_spine.take_actuation(self.name or "train")
+                if cause is not None:
+                    links.append((cause.trace_id, cause.span_id,
+                                  "caused_by"))
+            trace_spine.get_tracer().event(
+                f"elastic.{kind}", self.trace_ctx, subsystem="elastic",
+                links=links, **fields)
 
     def _set_state(self, state: str):
         self.state = state
         self._rec().gauge("elastic/state_" + state, time.time())
+        if self.trace_ctx is not None:
+            # contiguous state spans on the run's trace: the previous
+            # state ends exactly where the next begins, so the merged
+            # timeline (and critical-path attribution) has no gap
+            # between drain, replan, and resume.  Only the run() loop
+            # thread transitions state, so no lock is needed here.
+            if self._state_span is not None:
+                self._state_span.end()
+            self._state_span = trace_spine.get_tracer().begin(
+                f"elastic.{state}", self.trace_ctx, subsystem="elastic")
 
     def stop(self):
         """Ask run() to commit a checkpoint and return at the next
@@ -239,6 +269,11 @@ class ElasticSupervisor:
         trainer.set_checkpoint(self.ckpt_dir, every_steps=self.ckpt_every,
                                keep=self.keep, layout="manifest",
                                shard_arrays=self.shard_arrays)
+        if self.trace_ctx is not None \
+                and hasattr(trainer, "set_trace_context"):
+            # same trace id for the whole run: trainer steps and the
+            # async checkpoint writes record as children of it
+            trainer.set_trace_context(self.trace_ctx)
         trainer.init()
         try:
             trainer.load_checkpoint(self.ckpt_dir)
@@ -271,6 +306,8 @@ class ElasticSupervisor:
         if batch_fn is None:
             raise ValueError("no batch_fn: pass one here or at init")
         self._stop = False      # re-arm: a stop()ped supervisor can run again
+        if self.trace_ctx is None:
+            self.trace_ctx = TraceContext.new_root()
         rec = self._rec()
         if self.handle_sigterm:
             from ..checkpoint import PreemptionHandler
@@ -478,6 +515,9 @@ class ElasticSupervisor:
                         raise
                     continue
         finally:
+            if self._state_span is not None:
+                self._state_span.end()
+                self._state_span = None
             if self.handle_sigterm and handler is not None:
                 handler.uninstall()
 

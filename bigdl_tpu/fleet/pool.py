@@ -59,6 +59,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .. import faults as faultplane
 from ..elastic.plan import _axis_candidates, _prod, plan_mesh
+from ..observability import tracing as trace_spine
 from ..utils.retry import RetryPolicy
 
 
@@ -237,11 +238,16 @@ class DevicePool:
                     if self._owner[d] is None
                     or self._owner[d] not in self._claims]
 
-    def claim(self, name: str, n: int = 1) -> list:
+    def claim(self, name: str, n: int = 1, trace_ctx=None) -> list:
         """Atomically take ``n`` free devices for ``name`` (pool
         order).  Raises :class:`PoolExhaustedError` — taking nothing —
         when fewer than ``n`` are free: the loser of a last-device
-        race is told loudly instead of getting a partial gang."""
+        race is told loudly instead of getting a partial gang.
+
+        ``trace_ctx`` records the ledger move as a ``pool.claim`` span
+        under the caller's trace (an autoscale decision, a placement)
+        and notes the claimant's actuation context so its supervisor
+        can link the resulting transition back to the cause."""
         n = int(n)
         if n <= 0:
             raise ValueError("claim needs n >= 1")
@@ -255,10 +261,24 @@ class DevicePool:
             for d in took:
                 self._owner[d] = name
             self._claims.add(str(name))
-            return took
+        # span + actuation note OUTSIDE the ledger lock: tracing must
+        # never extend the pool's critical section
+        self._trace_move("pool.claim", trace_ctx, owners=(name,),
+                         n=n, devices=took)
+        return took
+
+    def _trace_move(self, op: str, ctx, owners: Tuple[str, ...],
+                    n: int, devices: Sequence):
+        if ctx is None:
+            return
+        trace_spine.get_tracer().event(
+            op, ctx, subsystem="fleet", owners=list(owners), n=int(n),
+            devices=[repr(d) for d in devices])
+        for owner in owners:
+            trace_spine.note_actuation(owner, ctx)
 
     def transfer(self, src: str, dst: str, n: int = 1,
-                 take: str = "tail") -> list:
+                 take: str = "tail", trace_ctx=None) -> list:
         """Atomically move ``n`` of ``src``'s devices to ``dst`` — the
         elastic-yield move (a training job shedding capacity to the
         serving tier at a traffic peak, and taking it back at the
@@ -285,7 +305,9 @@ class DevicePool:
             self._claims.add(str(dst))
             if not any(o == src for o in self._owner.values()):
                 self._claims.discard(str(src))
-            return moved
+        self._trace_move("pool.transfer", trace_ctx, owners=(src, dst),
+                         n=n, devices=moved)
+        return moved
 
     def reassign(self, assignment: Dict[str, Sequence]) -> None:
         """Replace the gang-planned share of the ownership map with
@@ -315,8 +337,8 @@ class DevicePool:
                     owner[d] = name
             self._owner = owner
 
-    def release(self, name: str, devices: Optional[Sequence] = None
-                ) -> list:
+    def release(self, name: str, devices: Optional[Sequence] = None,
+                trace_ctx=None) -> list:
         """Return ``devices`` (default: everything ``name`` holds) to
         the free pool; returns what was actually freed.  Idempotent:
         releasing devices the owner no longer holds — or holding
@@ -332,7 +354,10 @@ class DevicePool:
                 self._owner[d] = None
             if not any(o == name for o in self._owner.values()):
                 self._claims.discard(str(name))
-            return victims
+        if victims:
+            self._trace_move("pool.release", trace_ctx, owners=(name,),
+                             n=len(victims), devices=victims)
+        return victims
 
 
 class FleetJob:

@@ -37,6 +37,16 @@ surface for the TPU rebuild:
     peak-spec table, live device-memory gauges, and per-request trace
     IDs with Chrome-trace/Perfetto export via ``/trace``.
 
+  * Causal trace spine (:mod:`~bigdl_tpu.observability.tracing` +
+    :mod:`~bigdl_tpu.observability.context`): one W3C-shaped
+    ``TraceContext`` flowing admission → failover → decode on the
+    serve side and step → checkpoint writer → elastic transitions on
+    the train side, autoscale decisions causally linked to the SLO
+    samples that triggered them and the pool moves they caused, a
+    merged multi-subsystem Perfetto export on ONE clock domain
+    (``context.trace_now``), and per-trace critical-path latency
+    attribution (``scripts/trace_summary.py critical-path``).
+
 Every span is also emitted as a ``jax.profiler.TraceAnnotation`` so the
 host-side phase structure lines up with device events in a TensorBoard /
 Perfetto trace, and ``Recorder.trace_every(n)`` captures an on-demand
@@ -53,6 +63,10 @@ Quick start::
 """
 from __future__ import annotations
 
+from .context import TraceContext, trace_now
+from .tracing import (SpanStore, Tracer, critical_path, get_tracer,
+                      merge_perfetto, note_actuation, set_tracer,
+                      spans_from_chrome, take_actuation)
 from .recorder import Recorder, get_recorder, set_recorder, null_recorder
 from .sinks import (InMemorySink, JsonlSink, Sink, TensorBoardSink,
                     render_prometheus, render_prometheus_multi)
@@ -67,6 +81,9 @@ from . import health
 from . import profile
 
 __all__ = [
+    "TraceContext", "trace_now", "Tracer", "SpanStore",
+    "get_tracer", "set_tracer", "note_actuation", "take_actuation",
+    "merge_perfetto", "critical_path", "spans_from_chrome",
     "Recorder", "get_recorder", "set_recorder", "null_recorder",
     "Sink", "InMemorySink", "JsonlSink", "TensorBoardSink",
     "render_prometheus", "render_prometheus_multi", "IntrospectionServer",

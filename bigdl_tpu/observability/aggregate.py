@@ -173,6 +173,9 @@ class MetricsAggregator:
                                  clock=self.clock)
         self._lock = threading.Lock()
         self._sources: Dict[str, Dict[str, Any]] = {}
+        # label -> trace source (Tracer/SpanStore/TraceRing/engine with
+        # a .trace_ring) for the merged /trace document
+        self._trace_sources: Dict[str, Any] = {}
         self._server: Optional[IntrospectionServer] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -251,6 +254,35 @@ class MetricsAggregator:
     def remove_source(self, name: str):
         with self._lock:
             self._sources.pop(str(name), None)
+
+    # -- merged tracing ---------------------------------------------------- #
+    def add_trace_source(self, name: str, source) -> "MetricsAggregator":
+        """Register a span source for the merged ``/trace`` document: a
+        :class:`~.tracing.Tracer` / :class:`~.tracing.SpanStore`, a
+        serving :class:`~.profile.trace.TraceRing`, or an engine/
+        replica-set exposing ``trace_ring``.  Each source renders as
+        its own process row in Perfetto, all on the one
+        :func:`~.context.trace_now` clock domain."""
+        with self._lock:
+            self._trace_sources[str(name)] = source
+        return self
+
+    def remove_trace_source(self, name: str) -> bool:
+        with self._lock:
+            return self._trace_sources.pop(str(name), None) is not None
+
+    def trace_doc(self) -> str:
+        """One Chrome-trace/Perfetto JSON merging every registered
+        trace source — what ``/trace`` serves and ``trace_summary
+        critical-path`` consumes."""
+        from .tracing import merge_perfetto
+        with self._lock:
+            items = list(self._trace_sources.items())
+        resolved = []
+        for name, src in items:
+            rings = getattr(src, "trace_ring", None)
+            resolved.append((name, rings if rings is not None else src))
+        return merge_perfetto(resolved)
 
     def remove_member(self, name: str, purge_series: bool = True) -> bool:
         """Deliberate deregistration — the scale-DOWN path, as opposed
@@ -389,13 +421,16 @@ class MetricsAggregator:
               ) -> IntrospectionServer:
         """Start the fleet-level HTTP surface: ``/metrics`` renders the
         merged exposition, ``/healthz`` the worst-of verdict,
-        ``/series`` the scrape-fed store."""
+        ``/series`` the scrape-fed store, and ``/trace`` the merged
+        multi-subsystem Perfetto document (``?trace_id=`` filters to
+        one request/decision trace)."""
         if self._server is None:
             self._server = IntrospectionServer(
                 self.recorder, port=port, host=host,
                 namespace=self.namespace, metrics_source=self.render,
                 healthz_source=self.healthz,
-                series_source=self.store).start()
+                series_source=self.store,
+                trace_source=self.trace_doc).start()
         return self._server
 
     def start(self, interval: float = 5.0) -> "MetricsAggregator":

@@ -72,6 +72,43 @@ def _finite_json(obj):
     return json.dumps(walk(obj), default=_json_default)
 
 
+def _filter_trace(body, trace_id: str):
+    """Restrict a Chrome-trace document to one trace id: keep the
+    ``"M"`` metadata rows (process/thread names) and every B/E event
+    whose ``args.trace_id`` matches.  A body that isn't Chrome-trace
+    JSON passes through untouched — the filter must never 500 the
+    endpoint over an exotic trace_source."""
+    doc = body
+    if isinstance(doc, (str, bytes)):
+        try:
+            doc = json.loads(doc)
+        except ValueError:
+            return body
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        return body
+    events = [ev for ev in doc["traceEvents"]
+              if ev.get("ph") == "M"
+              or (ev.get("args") or {}).get("trace_id") == trace_id
+              or (ev.get("ph") == "E" and "args" not in ev)]
+    # an E event carries no args; keep it only when its B survived —
+    # pair per (pid, tid) stack to drop ends of filtered-out spans
+    kept, depth = [], {}
+    for ev in events:
+        key = (ev.get("pid"), ev.get("tid"))
+        if ev.get("ph") == "B":
+            depth[key] = depth.get(key, 0) + 1
+            kept.append(ev)
+        elif ev.get("ph") == "E" and "args" not in ev:
+            if depth.get(key, 0) > 0:
+                depth[key] -= 1
+                kept.append(ev)
+        else:
+            kept.append(ev)
+    out = dict(doc)
+    out["traceEvents"] = kept
+    return out
+
+
 class IntrospectionServer:
     """One Recorder's live read surface; start()/stop() lifecycle."""
 
@@ -239,6 +276,10 @@ class IntrospectionServer:
                                   "(serving engines expose one)")
             else:
                 body = self.trace_source()
+                q = parse_qs(parsed.query)
+                want = q["trace_id"][0] if q.get("trace_id") else None
+                if want is not None:
+                    body = _filter_trace(body, want)
                 if not isinstance(body, str):
                     body = json.dumps(body, default=_json_default)
                 self._reply(h, 200, body, "application/json")

@@ -15,9 +15,18 @@ emitted (not ``X`` complete events) so nested and zero-length spans
 render faithfully; each request gets its own ``tid`` track named after
 its trace ID.
 
-Timestamps are ``time.monotonic()`` seconds (the serving queue's native
-clock); the exporter rebases them to microseconds from the earliest
-event, which is all the trace viewers need.
+Timestamps are :func:`bigdl_tpu.observability.context.trace_now`
+seconds — ``time.monotonic()``, the repo's ONE trace clock (the serving
+queue's native clock); the exporter rebases them to microseconds from
+the earliest event, which is all the trace viewers need.  Because every
+subsystem stamps on the same clock, these per-request timelines merge
+skew-free with tracing spans from other subsystems via
+:func:`bigdl_tpu.observability.tracing.merge_perfetto`.
+
+A request admitted with an upstream :class:`~..context.TraceContext`
+(e.g. minted by the ReplicaSet front door) ADOPTS that trace id —
+``ring.new_trace(model, ctx=ctx)`` — so the same id names the request
+across the failover hop and into the decode slot lifetime.
 """
 from __future__ import annotations
 
@@ -27,6 +36,8 @@ import uuid
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from ..context import TraceContext
+
 
 class RequestTrace:
     """One request's span timeline.  Not thread-safe by itself: a trace
@@ -34,14 +45,16 @@ class RequestTrace:
     and then the single batcher thread (queue/gather/compute/reply),
     with the queue handoff ordering the two."""
 
-    __slots__ = ("trace_id", "model", "spans", "meta", "_open")
+    __slots__ = ("trace_id", "model", "spans", "meta", "_open", "ctx")
 
-    def __init__(self, trace_id: str, model: str):
+    def __init__(self, trace_id: str, model: str,
+                 ctx: Optional[TraceContext] = None):
         self.trace_id = trace_id
         self.model = model
         self.spans: List[tuple] = []     # (name, t0, t1, args|None)
         self.meta: Dict[str, Any] = {}
         self._open: Dict[str, float] = {}
+        self.ctx = ctx                   # upstream TraceContext, if any
 
     def add_span(self, name: str, t0: float, t1: float, **args):
         self.spans.append((name, t0, max(t1, t0), args or None))
@@ -83,7 +96,12 @@ class TraceRing:
         self._lock = threading.Lock()
         self.dropped = 0        # finished traces evicted by the bound
 
-    def new_trace(self, model: str) -> RequestTrace:
+    def new_trace(self, model: str,
+                  ctx: Optional[TraceContext] = None) -> RequestTrace:
+        """Mint a trace; with ``ctx`` the request adopts the upstream
+        trace id so one id spans admission → failover → decode."""
+        if ctx is not None:
+            return RequestTrace(ctx.trace_id, model, ctx=ctx)
         return RequestTrace(uuid.uuid4().hex[:16], model)
 
     def finish(self, trace: RequestTrace):

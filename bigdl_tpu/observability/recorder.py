@@ -35,6 +35,8 @@ from bisect import bisect_left
 from collections import deque
 from typing import Any, Dict, List, Optional
 
+from . import context as _trace_clock
+
 
 class _NullSpan:
     """Shared no-op context manager for disabled recorders."""
@@ -63,11 +65,14 @@ class _Span:
             import jax
             self._ann = jax.profiler.TraceAnnotation(self._name)
             self._ann.__enter__()
-        self._t0 = time.perf_counter()
+        # one trace clock across the repo (context.trace_now =
+        # time.monotonic); perf_counter here used to skew merged
+        # Perfetto timelines against the serving TraceRing's stamps
+        self._t0 = _trace_clock.trace_now()
         return self
 
     def __exit__(self, *exc):
-        dt = time.perf_counter() - self._t0
+        dt = _trace_clock.trace_now() - self._t0
         if self._ann is not None:
             self._ann.__exit__(*exc)
         self._rec._add_span(self._name, dt)
@@ -364,7 +369,7 @@ class Recorder:
             return
         with self._lock:
             self._step = step
-            self._step_t0 = time.perf_counter()
+            self._step_t0 = _trace_clock.trace_now()
             self._step_started_wall = time.time()
         self._maybe_start_trace(step)
 
@@ -380,7 +385,7 @@ class Recorder:
         with self._lock:
             if step is None:
                 step = self._step
-            dur = (time.perf_counter() - self._step_t0
+            dur = (_trace_clock.trace_now() - self._step_t0
                    if self._step_t0 is not None else None)
             pend = dict(self._scalars)
             pend.update(scalars)

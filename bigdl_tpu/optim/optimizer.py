@@ -396,6 +396,7 @@ class Optimizer:
         self._retry_cache = None
         # telemetry (observability.Recorder); None = zero-cost no-op path
         self._recorder: Optional[Recorder] = None
+        self._trace_ctx = None          # causal TraceContext, if adopted
         self._telemetry_health = True
         self._with_health = False     # does the built step return health?
         self._seen_sigs = set()       # (shape, dtype) sigs → recompile detect
@@ -545,6 +546,14 @@ class Optimizer:
         if self._capture_cost and capture_enabled():
             install_device_memory_poller(recorder)
         set_recorder(recorder)
+        return self
+
+    def set_trace_context(self, ctx, tracer=None):
+        """Adopt a causal :class:`~bigdl_tpu.observability.context.
+        TraceContext`: checkpoint saves carry a child of it to the
+        async writer thread (queue-wait + write spans under the
+        training run's trace id).  ``ctx=None`` detaches."""
+        self._trace_ctx = ctx
         return self
 
     def set_trace_every(self, n_steps: int, log_dir: str):
@@ -746,7 +755,9 @@ class Optimizer:
         payload = self._ckpt_shards(host) if mgr.layout == "manifest" \
             else host
         with self._wd_suspended():      # sync commits block the loop
-            mgr.save(payload, meta, tag, sync=sync)
+            mgr.save(payload, meta, tag, sync=sync,
+                     trace_ctx=self._trace_ctx.child()
+                     if self._trace_ctx is not None else None)
 
     def load_checkpoint(self):
         """Restore the newest INTACT checkpoint (manifest or legacy file

@@ -120,6 +120,8 @@ class SpmdTrainer:
         self._step_fn = None
         self._step_count = 0
         self._recorder = None
+        self._trace_ctx = None          # TraceContext from the supervisor
+        self._tracer = None             # None -> process default
         self._telemetry_health = True
         self._with_health = False
         self._hlo_accounted = False
@@ -337,6 +339,24 @@ class SpmdTrainer:
                 self.params, self.opt_state = params, opt_state
         return self
 
+    def set_trace_context(self, ctx, tracer=None):
+        """Adopt a causal :class:`~bigdl_tpu.observability.context.
+        TraceContext` (e.g. the elastic supervisor's run trace): each
+        ``step()`` records a ``train.step`` span under it and every
+        checkpoint save carries a child context to the async writer
+        thread, so step → queue-wait → write shows up as ONE trace.
+        ``ctx=None`` detaches.  ``tracer`` overrides the process
+        default span store."""
+        self._trace_ctx = ctx
+        if tracer is not None:
+            self._tracer = tracer
+        return self
+
+    def _trace_spine(self):
+        from ..observability import tracing as trace_spine
+        return self._tracer if self._tracer is not None \
+            else trace_spine.get_tracer()
+
     def set_input_transform(self, fn):
         """Compile ``fn(tokens, rng) -> tokens`` into the jitted step —
         the device-side augmentation hook for this path (the host ships
@@ -530,6 +550,10 @@ class SpmdTrainer:
         # mesh into our compiled step (compiled programs are unaffected)
         self.attach()
         rec = self._rec()
+        step_span = None
+        if self._trace_ctx is not None:
+            step_span = self._trace_spine().begin(
+                "train.step", self._trace_ctx, subsystem="train")
         rec.start_step(self._step_count)
         sh = self._batch_sharding()
         with rec.span("h2d"):
@@ -575,6 +599,8 @@ class SpmdTrainer:
             record = rec.end_step(self._step_count - 1)
             if self._health_monitor is not None and record is not None:
                 self._health_monitor.check_record(record)
+        if step_span is not None:
+            step_span.end(step=self._step_count - 1)
         return loss
 
     def evaluate(self, batches, steps: Optional[int] = None):
@@ -698,7 +724,9 @@ class SpmdTrainer:
             meta["data_cursor"] = self._data_pipeline.state()
         mgr.save(shards, meta, tag=tag or f"step_{self._step_count}",
                  sync=sync, mesh=reshard.mesh_info(self.mesh),
-                 owned=owned)
+                 owned=owned,
+                 trace_ctx=self._trace_ctx.child()
+                 if self._trace_ctx is not None else None)
 
     def save_checkpoint(self, path: str, layout: Optional[str] = None,
                         sync: bool = False, tag: Optional[str] = None):

@@ -330,19 +330,25 @@ class DecodeEngine:
     def submit(self, name: str, x, deadline_ms: Optional[float] = None,
                max_new_tokens: Optional[int] = None,
                temperature: float = 0.0,
-               eos_id: Optional[int] = None) -> Future:
+               eos_id: Optional[int] = None, trace_ctx=None) -> Future:
         """Enqueue one prompt; returns the Future of the full
         ``prompt + generated`` int32 array.  ``deadline_ms`` sheds the
         request when it expires before OR during decode (terminal
-        ``deadline`` trace span, then the future fails)."""
+        ``deadline`` trace span, then the future fails).  ``trace_ctx``
+        threads an upstream
+        :class:`~bigdl_tpu.observability.context.TraceContext` into the
+        slot-lifetime trace, so one trace id covers admission through
+        every per-token step."""
         return self.stream(name, x, deadline_ms=deadline_ms,
                            max_new_tokens=max_new_tokens,
-                           temperature=temperature, eos_id=eos_id).future
+                           temperature=temperature, eos_id=eos_id,
+                           trace_ctx=trace_ctx).future
 
     def stream(self, name: str, x, deadline_ms: Optional[float] = None,
                max_new_tokens: Optional[int] = None,
                temperature: float = 0.0,
-               eos_id: Optional[int] = None) -> DecodeStream:
+               eos_id: Optional[int] = None,
+               trace_ctx=None) -> DecodeStream:
         """Like :meth:`submit` but returns the :class:`DecodeStream`,
         whose :meth:`~DecodeStream.tokens` iterator yields tokens as
         the decode loop emits them."""
@@ -375,7 +381,8 @@ class DecodeEngine:
         rec.inc("decode/requests")
         rec.inc("serving.requests")
         ring = self.trace_ring
-        tr = ring.new_trace(self.model_name) if ring is not None else None
+        tr = ring.new_trace(self.model_name, ctx=trace_ctx) \
+            if ring is not None else None
         if tr is not None:
             tr.meta.update(prompt_len=int(prompt.size), max_new=max_new)
         deadline = None if deadline_ms is None \

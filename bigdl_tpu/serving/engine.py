@@ -183,8 +183,8 @@ class ServingEngine:
         return self
 
     # -- request path ----------------------------------------------------- #
-    def submit(self, name: str, x, deadline_ms: Optional[float] = None
-               ) -> Future:
+    def submit(self, name: str, x, deadline_ms: Optional[float] = None,
+               trace_ctx=None) -> Future:
         """Enqueue one request; returns its Future.
 
         ``x`` is one sample ``input_shape`` or a batch
@@ -192,6 +192,10 @@ class ServingEngine:
         propagates an SLO: requests still queued past it are shed
         instead of executed.  Raises :class:`LoadShedError` immediately
         when the queue is full (backpressure, not tail collapse).
+        ``trace_ctx`` (a
+        :class:`~bigdl_tpu.observability.context.TraceContext`) lets an
+        upstream hop — the ReplicaSet front door — thread its trace id
+        into this request's timeline.
         """
         t_admit = time.monotonic()
         entry = self.registry.get(name)
@@ -203,7 +207,8 @@ class ServingEngine:
         deadline = None if deadline_ms is None \
             else time.monotonic() + float(deadline_ms) / 1e3
         ring = self.trace_ring
-        tr = ring.new_trace(entry.name) if ring is not None else None
+        tr = ring.new_trace(entry.name, ctx=trace_ctx) \
+            if ring is not None else None
         req = Request(x, n, deadline=deadline, trace=tr)
         if tr is not None:
             tr.meta["rows"] = n

@@ -62,6 +62,8 @@ import numpy as np
 
 from .. import faults as faultplane
 from ..observability import Recorder
+from ..observability import tracing as trace_spine
+from ..observability.context import TraceContext
 from ..utils.retry import RetryPolicy
 from .engine import ServingEngine
 from .queue import EngineClosedError, LoadShedError
@@ -98,11 +100,12 @@ class _Flight:
     dispatches are dropped via the Future's own set-once contract."""
 
     __slots__ = ("name", "serve_name", "x", "rows", "deadline",
-                 "priority", "future", "attempts", "browned", "tried")
+                 "priority", "future", "attempts", "browned", "tried",
+                 "ctx")
 
     def __init__(self, name: str, serve_name: str, x, rows: int,
                  deadline: Optional[float], priority: str,
-                 browned: bool):
+                 browned: bool, ctx: Optional[TraceContext] = None):
         self.name = name
         self.serve_name = serve_name
         self.x = x
@@ -114,6 +117,9 @@ class _Flight:
         self.browned = browned
         self.tried: set = set()       # replica indices already tried —
         # a failover must not bounce back to the replica that failed it
+        self.ctx = ctx                # root TraceContext for this
+        # request — every dispatch (and failover re-dispatch) derives a
+        # child, so ONE trace id names the request across hops
 
     def remaining_ms(self, now: Optional[float] = None) -> Optional[float]:
         if self.deadline is None:
@@ -282,12 +288,14 @@ class ReplicaSet:
                  eject_error_rate: float = 0.5,
                  eject_min_requests: int = 4,
                  p99_outlier_factor: float = 8.0,
-                 p99_floor_ms: float = 250.0):
+                 p99_floor_ms: float = 250.0,
+                 tracer: Optional["trace_spine.Tracer"] = None):
         if not engines:
             raise ValueError("ReplicaSet needs at least one engine")
         self.replicas = [_Replica(i, e) for i, e in enumerate(engines)]
         self.recorder = recorder if recorder is not None \
             else Recorder(annotate=False)
+        self.tracer = tracer          # None -> process default at use
         self.wedge_after = float(wedge_after)
         self.max_failovers = int(max_failovers)
         self.failover_rate = float(failover_rate)
@@ -403,8 +411,14 @@ class ReplicaSet:
             return bool(self._routable_locked())
 
     # -- request path ------------------------------------------------------ #
+    @property
+    def _tracer(self) -> "trace_spine.Tracer":
+        return self.tracer if self.tracer is not None \
+            else trace_spine.get_tracer()
+
     def submit(self, name: str, x, deadline_ms: Optional[float] = None,
-               priority: str = "normal") -> Future:
+               priority: str = "normal",
+               trace_ctx: Optional[TraceContext] = None) -> Future:
         """Admit one request and dispatch it to the healthiest replica.
 
         Sheds with :class:`LoadShedError` reason ``"overload"`` when
@@ -412,6 +426,12 @@ class ReplicaSet:
         when ``deadline_ms`` cannot be met at the measured service
         rate, or ``"queue_full"`` when every healthy replica's queue is
         full; raises :class:`NoHealthyReplicaError` on total outage.
+
+        The front door is where a request's trace begins: a root
+        :class:`TraceContext` is minted here (or adopted from
+        ``trace_ctx``), every dispatch and failover hop derives a child
+        of it, and the engine-side request timeline records under the
+        SAME trace id.
         """
         if priority not in PRIORITY_CLASSES:
             raise ValueError(f"priority {priority!r} not in "
@@ -419,43 +439,52 @@ class ReplicaSet:
         self.start()
         rec = self.recorder
         rec.inc("serving/requests")
+        ctx = trace_ctx if trace_ctx is not None \
+            else TraceContext.new_root()
+        admit = self._tracer.begin("rs.admit", ctx, child=False,
+                                   subsystem="replicaset")
         now = time.monotonic()
         deadline = None if deadline_ms is None \
             else now + float(deadline_ms) / 1e3
-        rows = self._rows_of(name, x)
-        with self._lock:
-            routable = self._routable_locked()
-            if not routable:
-                raise NoHealthyReplicaError(
-                    "no healthy replica in rotation "
-                    f"({[(r.index, r.state, r.reason) for r in self.replicas]})")
-            sat = self._saturation_locked(routable)
-            rec.gauge("serving/saturation", sat)
-            if not self.controller.admits(priority, sat):
-                rec.inc("serving/shed_overload")
-                raise LoadShedError(
-                    "overload", f"saturation {sat:.2f} sheds priority "
-                                f"class {priority!r}")
-            if deadline_ms is not None and self._service_rate:
-                # _service_rate is the FLEET rows/s; the request will
-                # be served by one replica at ~rate/N, against the
-                # least-loaded replica's backlog
-                per_rate = self._service_rate / len(routable)
-                pending = min(r.engine.pending_rows() for r in routable)
-                wait_ms = (pending + rows) / per_rate * 1e3
-                if wait_ms > float(deadline_ms):
-                    rec.inc("serving/shed_predicted")
+        try:
+            rows = self._rows_of(name, x)
+            with self._lock:
+                routable = self._routable_locked()
+                if not routable:
+                    raise NoHealthyReplicaError(
+                        "no healthy replica in rotation "
+                        f"({[(r.index, r.state, r.reason) for r in self.replicas]})")
+                sat = self._saturation_locked(routable)
+                rec.gauge("serving/saturation", sat)
+                if not self.controller.admits(priority, sat):
+                    rec.inc("serving/shed_overload")
                     raise LoadShedError(
-                        "predicted",
-                        f"predicted wait {wait_ms:.0f}ms exceeds the "
-                        f"{deadline_ms:.0f}ms deadline at "
-                        f"{per_rate:.0f} rows/s/replica")
-            browned = self.controller.browned and name in self.degrade
-            serve_name = self.degrade[name] if browned else name
+                        "overload", f"saturation {sat:.2f} sheds priority "
+                                    f"class {priority!r}")
+                if deadline_ms is not None and self._service_rate:
+                    # _service_rate is the FLEET rows/s; the request will
+                    # be served by one replica at ~rate/N, against the
+                    # least-loaded replica's backlog
+                    per_rate = self._service_rate / len(routable)
+                    pending = min(r.engine.pending_rows() for r in routable)
+                    wait_ms = (pending + rows) / per_rate * 1e3
+                    if wait_ms > float(deadline_ms):
+                        rec.inc("serving/shed_predicted")
+                        raise LoadShedError(
+                            "predicted",
+                            f"predicted wait {wait_ms:.0f}ms exceeds the "
+                            f"{deadline_ms:.0f}ms deadline at "
+                            f"{per_rate:.0f} rows/s/replica")
+                browned = self.controller.browned and name in self.degrade
+                serve_name = self.degrade[name] if browned else name
+        except BaseException as e:
+            admit.end(shed=repr(e))
+            raise
         if browned:
             rec.inc("serving/brownout_requests")
         flight = _Flight(name, serve_name, x, rows, deadline, priority,
-                         browned)
+                         browned, ctx=ctx)
+        admit.end(model=name, priority=priority, rows=rows)
         self._dispatch(flight)
         return flight.future
 
@@ -659,7 +688,9 @@ class ReplicaSet:
             try:
                 inner = rep.engine.submit(
                     flight.serve_name, flight.x,
-                    deadline_ms=flight.remaining_ms())
+                    deadline_ms=flight.remaining_ms(),
+                    trace_ctx=flight.ctx.child()
+                    if flight.ctx is not None else None)
             except LoadShedError as e:
                 last_shed = e
                 continue
@@ -716,6 +747,14 @@ class ReplicaSet:
             return
         flight.attempts += 1
         rec.inc("replica/failovers")
+        if flight.ctx is not None:
+            # zero-length hop marker in the request's own trace: the
+            # merged timeline shows WHERE the retry happened between
+            # the failed replica's terminal span and the re-dispatch
+            self._tracer.event("rs.failover", flight.ctx,
+                               subsystem="replicaset",
+                               attempt=flight.attempts,
+                               cause=repr(cause))
         try:
             self._dispatch(flight)
         except Exception as e:

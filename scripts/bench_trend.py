@@ -1,0 +1,155 @@
+"""Render the BENCH_r* driver results into one trajectory table.
+
+Each nightly bench window writes one ``BENCH_rNN.json`` at the repo
+root.  The shapes are heterogeneous by design — the driver banks
+whatever the window produced:
+
+  * hardware rounds carry ``parsed`` (the final JSON line of
+    ``bench.py``: metric/value/unit/vs_baseline),
+  * wedged rounds carry ``rc != 0`` and a liveness-probe tail,
+  * proxy rounds (``"proxy": true``, the ROADMAP standing constraint
+    while the tunnel is down) carry per-smoke result objects
+    (perf_proxy_smoke, input_smoke, compose, decode, rec).
+
+This script folds all of them into one chronological table — round,
+mode (hardware / proxy / FAILED), and a one-line headline metric —
+so the performance trajectory reads at a glance instead of ten ad-hoc
+``jq`` invocations.  ``--markdown`` emits the same table as GitHub
+markdown for docs/performance.md.
+
+    python scripts/bench_trend.py                # repo-root BENCH_r*.json
+    python scripts/bench_trend.py --markdown
+    python scripts/bench_trend.py /path/with/benches
+
+CPU-only, stdlib-only.
+"""
+import glob
+import json
+import os
+import re
+import sys
+
+
+def load_rounds(root):
+    """[(round_number, path, doc)] sorted by round number; corrupt
+    files become (n, path, None) rows rather than aborting the table."""
+    out = []
+    for p in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if not m:
+            continue
+        n = int(m.group(1))
+        try:
+            with open(p) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            doc = None
+        out.append((n, p, doc))
+    out.sort(key=lambda r: r[0])
+    return out
+
+
+def _tail_date(doc):
+    """Window date scraped from the log tail's timestamps (the only
+    place wedged rounds record when they ran); '' when absent."""
+    m = re.search(r"(\d{4}-\d{2}-\d{2})", str(doc.get("tail", "")))
+    return m.group(1) if m else ""
+
+
+def headline(doc):
+    """One-line summary of whatever this round measured."""
+    if doc is None:
+        return "unreadable result file"
+    if doc.get("rc", 0) != 0:
+        tail = doc.get("tail", "")
+        if "liveness probe" in tail:
+            return "backend unreachable (liveness-probe timeout)"
+        return f"FAILED rc={doc.get('rc')}"
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and parsed.get("metric") \
+            and parsed.get("value") is not None:
+        line = f"{parsed['metric']} {parsed['value']:g}"
+        if parsed.get("unit"):
+            line += f" {parsed['unit']}"
+        if parsed.get("vs_baseline") is not None:
+            line += f" ({parsed['vs_baseline']:g}x vs baseline)"
+        return line
+    if isinstance(parsed, dict) and parsed.get("metric"):
+        keys = [k for k in ("bucketed_drop", "zero1_drop", "ok")
+                if k in parsed]
+        return parsed["metric"] + (
+            " " + " ".join(f"{k}={parsed[k]}" for k in keys)
+            if keys else "")
+    dt = doc.get("decode_throughput")
+    if isinstance(dt, dict):
+        return (f"decode {dt.get('continuous_tokens_per_s', 0):g} tok/s "
+                f"continuous ({dt.get('speedup', 0):g}x vs static), "
+                f"recompiles={dt.get('recompiles')}")
+    if doc.get("bench") == "compose_proxy_smoke":
+        cfgs = doc.get("configs", {})
+        blocked = sum(1 for c in cfgs.values()
+                      if isinstance(c, dict) and c.get("status"))
+        return (f"compose_proxy_smoke: {len(cfgs)} configs, "
+                f"{len(cfgs) - blocked} measured, {blocked} blocked")
+    if doc.get("metric") == "rec_smoke":
+        lx = doc.get("lookup_exchange", {})
+        return (f"rec_smoke dedup_ratio="
+                f"{lx.get('dedup_ratio', 0):.3f} "
+                f"int8_table_ratio="
+                f"{doc.get('table_bytes', {}).get('ratio', 0):g}x "
+                f"ok={doc.get('ok')}")
+    # note-only proxy rounds (e.g. input_smoke): first clause of the note
+    note = doc.get("note", "")
+    m = re.search(r"input-stall fraction ([\d.]+%)", note)
+    if m:
+        return f"input_smoke stall={m.group(1)} (vs baseline in note)"
+    if note:
+        return note.split(";")[0][:72]
+    return os.path.basename(str(doc.get("cmd", "?")))
+
+
+def mode(doc):
+    if doc is None:
+        return "?"
+    if doc.get("rc", 0) != 0:
+        return "FAILED"
+    return "proxy" if doc.get("proxy") else "hardware"
+
+
+def render(rounds, markdown=False, out=print):
+    if not rounds:
+        out("no BENCH_r*.json files found")
+        return
+    rows = [(f"r{n:02d}", _tail_date(doc) if doc else "",
+             mode(doc), headline(doc)) for n, _, doc in rounds]
+    if markdown:
+        out("| round | date | mode | headline |")
+        out("|-------|------|------|----------|")
+        for r, d, m, h in rows:
+            out(f"| {r} | {d or '-'} | {m} | {h} |")
+    else:
+        out(f"{'round':<6} {'date':<11} {'mode':<9} headline")
+        for r, d, m, h in rows:
+            out(f"{r:<6} {d or '-':<11} {m:<9} {h}")
+        n_hw = sum(1 for _, _, m, _ in rows if m == "hardware")
+        n_px = sum(1 for _, _, m, _ in rows if m == "proxy")
+        n_bad = sum(1 for _, _, m, _ in rows if m == "FAILED")
+        out(f"\n{len(rows)} rounds: {n_hw} hardware, {n_px} proxy, "
+            f"{n_bad} failed (proxy = CPU-measurable stand-ins while "
+            "the device tunnel is down; see ROADMAP.md)")
+
+
+def main():
+    argv = sys.argv[1:]
+    markdown = "--markdown" in argv
+    argv = [a for a in argv if a != "--markdown"]
+    root = argv[0] if argv else os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..")
+    render(load_rounds(root), markdown=markdown)
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except BrokenPipeError:
+        sys.exit(0)

@@ -41,6 +41,50 @@ def first_line(obj):
     return line.replace("|", "\\|")
 
 
+# subsystem packages indexed alongside the nn registry: their public
+# classes are the operational API (engines, supervisors, controllers)
+# that examples and runbooks reference
+SUBSYSTEMS = ("autoscale", "checkpoint", "elastic", "embedding",
+              "fleet", "observability", "serving")
+
+
+def subsystem_sections():
+    import importlib
+    lines = []
+    total = 0
+    for pkg in SUBSYSTEMS:
+        mod = importlib.import_module(f"bigdl_tpu.{pkg}")
+        rows = []
+        for name in sorted(dir(mod)):
+            if name.startswith("_"):
+                continue
+            try:
+                obj = getattr(mod, name)
+            except AttributeError:
+                continue
+            if not inspect.isclass(obj):
+                continue
+            home = getattr(obj, "__module__", "")
+            if not home.startswith("bigdl_tpu."):
+                continue
+            rows.append((name, first_line(obj) or "(no docstring)"))
+        if not rows:
+            continue
+        total += len(rows)
+        lines += [f"\n## `bigdl_tpu.{pkg}` ({len(rows)})", "",
+                  "| class | summary |", "|---|---|"]
+        lines += [f"| `{n}` | {s} |" for n, s in rows]
+    header = [
+        "",
+        f"\n# Subsystem API index ({total} classes)",
+        "",
+        "Public classes re-exported by each subsystem package — the "
+        "operational surface (engines, supervisors, controllers, "
+        "telemetry) the docs and smokes drive.",
+    ]
+    return header + lines, total
+
+
 def main():
     out_path = os.path.join(os.path.dirname(__file__), os.pardir,
                             "docs", "api.md")
@@ -83,10 +127,12 @@ def main():
             else:
                 summary = first_line(obj) or "(no docstring)"
             lines.append(f"| `{name}` | {summary} |")
+    sub_lines, sub_total = subsystem_sections()
+    lines += sub_lines
     with open(out_path, "w") as f:
         f.write("\n".join(lines) + "\n")
-    print(f"wrote {os.path.normpath(out_path)}: {len(exports)} classes, "
-          f"{len(groups)} groups")
+    print(f"wrote {os.path.normpath(out_path)}: {len(exports)} nn classes "
+          f"({len(groups)} groups) + {sub_total} subsystem classes")
 
 
 # pyspark classes that are py4j plumbing, not model components — each

@@ -113,6 +113,20 @@ Two subcommands:
 
         python scripts/trace_summary.py autoscale /tmp/serve.jsonl [flap_window_s]
 
+  critical-path      per-trace latency attribution from a merged
+                     Perfetto/Chrome-trace JSON document (the fleet
+                     aggregator's ``/trace`` endpoint, or
+                     ``merge_perfetto`` written to disk): for each
+                     trace id, the innermost-span boundary sweep
+                     splits end-to-end wall time across named spans,
+                     with an ``(untraced)`` row for uncovered gaps and
+                     a coverage fraction per trace — the one-command
+                     answer to "where did this request's / this
+                     shrink's latency go":
+
+        curl -s localhost:9300/trace > /tmp/trace.json
+        python scripts/trace_summary.py critical-path /tmp/trace.json [trace_id]
+
 CPU-only (no device access), so it is safe to run while the tunnel is
 wedged.
 """
@@ -1141,6 +1155,63 @@ def main_autoscale(argv):
     summarize_autoscale(events, counters, flap_window=flap_window)
 
 
+def load_trace_doc(path):
+    """Parsed Chrome-trace document from a file written by the fleet
+    aggregator's ``/trace`` endpoint or by ``merge_perfetto``."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise SystemExit(f"{path}: not a Chrome-trace JSON document "
+                         "(no traceEvents key)")
+    return doc
+
+
+def summarize_critical_path(doc, trace_id=None, out=print):
+    """Render per-trace critical-path attribution: every trace id in
+    the merged document gets a table splitting its end-to-end wall
+    time across the innermost covering spans, plus the coverage
+    fraction (share of the window attributed to NAMED spans)."""
+    # repo-rooted import so the script works from a checkout without
+    # installation, matching the other subcommands' zero-dep stance
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    from bigdl_tpu.observability.tracing import (critical_path,
+                                                 spans_from_chrome)
+    per_trace = spans_from_chrome(doc)
+    if trace_id is not None:
+        if trace_id not in per_trace:
+            raise SystemExit(f"trace {trace_id} not in document "
+                             f"({len(per_trace)} traces present)")
+        per_trace = {trace_id: per_trace[trace_id]}
+    if not per_trace:
+        out("no spans with trace ids in this document")
+        return
+    for tid in sorted(per_trace):
+        cp = critical_path(per_trace[tid])
+        total = cp["total"]
+        out(f"== trace {tid}  (end-to-end {1e3 * total:.2f} ms, "
+            f"{len(per_trace[tid])} spans) ==")
+        out(f"  {'span':<28} {'ms':>10} {'% e2e':>7}")
+        rows = sorted(cp["attribution"].items(),
+                      key=lambda kv: -kv[1])
+        for name, sec in rows:
+            pct = 100.0 * sec / max(total, 1e-12)
+            out(f"  {name:<28} {1e3 * sec:>10.3f} {pct:>6.1f}%")
+        out(f"  coverage: {100.0 * cp['coverage']:.1f}% of the "
+            "end-to-end window attributed to named spans")
+        out("")
+
+
+def main_critical_path(argv):
+    if not argv:
+        raise SystemExit("usage: trace_summary.py critical-path "
+                         "<trace.json> [trace_id]")
+    doc = load_trace_doc(argv[0])
+    trace_id = argv[1] if len(argv) > 1 else None
+    print(f"trace document: {argv[0]}")
+    summarize_critical_path(doc, trace_id)
+
+
 def main_health(argv):
     if not argv:
         raise SystemExit("usage: trace_summary.py health "
@@ -1197,6 +1268,8 @@ def main():
         main_slo(argv[1:])
     elif argv and argv[0] == "autoscale":
         main_autoscale(argv[1:])
+    elif argv and argv[0] == "critical-path":
+        main_critical_path(argv[1:])
     elif argv and argv[0] == "xplane":
         main_xplane(argv[1:])
     else:           # back-compat: bare path = xplane trace dir

@@ -1,0 +1,233 @@
+#!/usr/bin/env python
+"""Causal trace spine smoke (ISSUE 19 acceptance, CI ``tracing-smoke``):
+a chaos run whose every request exports as ONE connected trace.
+
+One CPU replica set (two replicas) behind a :class:`Tracer` front door
+takes a stream of requests while the run injects the two chaos events
+the spine must survive:
+
+  * **one replica kill** — replica 0's batch loop is broken mid-run, so
+    admissions fail over to the survivor; the ``rs.failover`` hop must
+    land on the SAME trace id the admission minted, and the flight's
+    engine-ring spans must join that trace through the queue handoff;
+  * **one autoscale shrink** — a seeded occupancy spike scales the tier
+    up (``pool.claim`` under the decision trace), then a calm streak
+    shrinks it back down through the drain-first decommission path;
+    both decisions must carry their triggering ``slo.sample`` evidence
+    as child events and their pool moves under the decision trace.
+
+Everything merges into one Perfetto document (per-source process rows,
+one clock domain); the script then asserts every admitted request's
+trace is COMPLETE (a terminal reply/shed/error span closes each ring
+timeline), that the failover trace attributes >=95% of its end-to-end
+window to named spans, and that ``trace_summary.py critical-path``
+renders the document with rc=0.
+
+Emits ONE machine-parseable JSON line last (the CI contract).
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in \
+        os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_"
+                                 "count=8").strip()
+
+import numpy as np                                         # noqa: E402
+
+from bigdl_tpu import nn                                   # noqa: E402
+from bigdl_tpu.autoscale import (AutoscaleController,      # noqa: E402
+                                 AutoscalePolicy)
+from bigdl_tpu.fleet import DevicePool                     # noqa: E402
+from bigdl_tpu.observability import (Recorder, SeriesStore,  # noqa: E402
+                                     Tracer, critical_path,
+                                     merge_perfetto, set_tracer,
+                                     spans_from_chrome)
+from bigdl_tpu.serving import (ModelRegistry,              # noqa: E402
+                               ServingEngine, build_replica_set)
+
+REQUESTS = 12
+FAILURES = []
+
+
+def check(ok, msg):
+    print(f"# {'ok' if ok else 'FAIL'}: {msg}", flush=True)
+    if not ok:
+        FAILURES.append(msg)
+    return ok
+
+
+def main():
+    out_dir = tempfile.mkdtemp(prefix="tracing_smoke_")
+    print(f"# workdir {out_dir}", flush=True)
+
+    model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    model.evaluate()
+    model.ensure_initialized()
+
+    def engine():
+        reg = ModelRegistry()
+        reg.register("m", model, input_shape=(4,))
+        return ServingEngine(reg, max_batch=4, max_delay_ms=1.0,
+                             max_queue_rows=64,
+                             recorder=Recorder(annotate=False))
+
+    tracer = Tracer()
+    set_tracer(tracer)      # decisions + pool moves record here too
+    rs = build_replica_set(
+        model, 2, name="m", input_shape=(4,),
+        recorder=Recorder(annotate=False),
+        health_interval=0.05, probe_interval=0.05,
+        eject_min_requests=1000)
+    rs.tracer = tracer
+    rs.warmup()
+    rs.start()
+
+    pool = DevicePool(devices=["a0", "a1"])
+    store = SeriesStore()
+    extra = []
+
+    def factory():
+        eng = engine()
+        extra.append(eng)
+        return eng
+
+    ctl = AutoscaleController(
+        rs, factory,
+        AutoscalePolicy(min_replicas=2, max_replicas=3, idle_ticks=1,
+                        cooldown_up=0.05, cooldown_down=0.05,
+                        max_step=1),
+        pool=pool, claimant="serve", store=store, member_name="serve")
+
+    try:
+        # -- warm traffic, then the replica kill ---------------------- #
+        for i in range(REQUESTS // 2):
+            rs.predict("m", np.ones((1, 4), np.float32), timeout=30)
+
+        def broken(entry, q, batch):
+            raise RuntimeError("chaos: replica 0 killed")
+
+        rs.replicas[0].engine._run_batch = broken
+        print("# chaos: replica 0 batch loop killed", flush=True)
+        for i in range(REQUESTS - REQUESTS // 2):
+            rs.predict("m", np.ones((1, 4), np.float32), timeout=30)
+        failovers = rs.recorder.counter_value("replica/failovers")
+        check(failovers >= 1,
+              f"requests failed over to the survivor ({failovers:.0f})")
+
+        # -- one autoscale up, then the shrink ------------------------ #
+        store.observe("decode/occupancy", 0.97)
+        up = ctl.tick()
+        check(up.direction == "up", f"seeded spike scaled up ({up})")
+        time.sleep(0.2)
+        down = None
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            store.observe("decode/occupancy", 0.02)
+            d = ctl.tick()
+            if d.direction == "down":
+                down = d
+                break
+            time.sleep(0.1)
+        check(down is not None, "calm streak shrank the tier back down")
+
+        # -- merge: one document, one clock, per-source rows ---------- #
+        sources = [("replicaset", tracer)]
+        for i, rep in enumerate(rs.replicas):
+            sources.append((f"replica{i}", rep.engine.trace_ring))
+        doc_str = merge_perfetto(sources)
+        doc = json.loads(doc_str)
+        trace_path = os.path.join(out_dir, "merged_trace.json")
+        with open(trace_path, "w") as f:
+            f.write(doc_str)
+
+        # every admitted request's ring timeline ends in a terminal span
+        incomplete = 0
+        ring_traces = 0
+        for _, src in sources[1:]:
+            for tr in src.traces():
+                ring_traces += 1
+                names = {n for n, _, _, _ in tr.spans}
+                if not names & {"reply", "shed", "error", "closed",
+                                "deadline"}:
+                    incomplete += 1
+        check(ring_traces >= REQUESTS and incomplete == 0,
+              f"all {ring_traces} ring traces complete "
+              f"({incomplete} missing a terminal span)")
+
+        # the failover trace: rs.admit + rs.failover + engine spans on
+        # one id, across >=2 process rows, >=95% named attribution
+        fo = [s for s in tracer.store.spans() if s.name == "rs.failover"]
+        check(bool(fo), "the kill produced an rs.failover hop event")
+        cov = 0.0
+        if fo:
+            tid = fo[0].trace_id
+            pids = {e["pid"] for e in doc["traceEvents"]
+                    if e["ph"] == "B"
+                    and e["args"].get("trace_id") == tid}
+            check(len(pids) >= 2,
+                  f"failover trace spans {len(pids)} process rows")
+            cp = critical_path(spans_from_chrome(doc)[tid])
+            cov = cp["coverage"]
+            check(cov >= 0.95,
+                  f"failover trace critical path {100 * cov:.1f}% named")
+
+        # both decisions carry evidence + pool moves on their trace
+        for name, move in (("autoscale.up", "pool.claim"),
+                           ("autoscale.down", "pool.release")):
+            roots = [s for s in tracer.store.spans() if s.name == name]
+            check(len(roots) == 1, f"one {name} decision span")
+            if roots:
+                spans = tracer.store.by_trace(roots[0].trace_id)
+                kinds = {s.name for s in spans}
+                check("slo.sample" in kinds and move in kinds,
+                      f"{name} trace carries slo.sample + {move} "
+                      f"({sorted(kinds)})")
+
+        # -- the CLI renders it --------------------------------------- #
+        ts = subprocess.run(
+            [sys.executable,
+             os.path.join(_REPO, "scripts", "trace_summary.py"),
+             "critical-path", trace_path],
+            capture_output=True, text=True, timeout=120)
+        sys.stdout.write(ts.stdout)
+        check(ts.returncode == 0 and "coverage" in ts.stdout,
+              f"trace_summary critical-path rc={ts.returncode}")
+
+        summary = {
+            "metric": "tracing_smoke",
+            "ok": not FAILURES,
+            "failures": FAILURES,
+            "requests": REQUESTS,
+            "failovers": int(failovers),
+            "scale_ups": int(rs.recorder.counter_value(
+                "autoscale/scale_ups")),
+            "scale_downs": int(rs.recorder.counter_value(
+                "autoscale/scale_downs")),
+            "ring_traces": ring_traces,
+            "incomplete_traces": incomplete,
+            "failover_coverage": round(float(cov), 4),
+            "critical_path_rc": ts.returncode,
+            "trace": trace_path,
+        }
+        print(json.dumps(summary), flush=True)
+        return 0 if not FAILURES else 1
+    finally:
+        ctl.stop()
+        rs.shutdown(drain=False)
+        for eng in extra:
+            eng.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
