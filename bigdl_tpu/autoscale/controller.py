@@ -145,11 +145,15 @@ class AutoscaleController:
                                  sample_t=ev.get("t"),
                                  value=ev.get("value"))
             if decision.direction == "up":
-                applied = self._scale_up_locked(decision, n, ctx)
+                from ..observability.goodput import ledger_phase
+                with ledger_phase(rec, "autoscale_transfer"):
+                    applied = self._scale_up_locked(decision, n, ctx)
                 if applied:
                     self.policy.mark_scaled("up", now)
             elif decision.direction == "down":
-                applied = self._scale_down_locked(decision, n, ctx)
+                from ..observability.goodput import ledger_phase
+                with ledger_phase(rec, "autoscale_transfer"):
+                    applied = self._scale_down_locked(decision, n, ctx)
                 if applied:
                     self.policy.mark_scaled("down", now)
             else:

@@ -61,11 +61,14 @@ class AsyncCheckpointWriter:
             job._trace_t_submit = _trace_clock.trace_now()
         except AttributeError:
             pass                      # e.g. a bound method; no stamp
+        waited = 0.0
         with self._cv:
             if self._closed:
                 raise RuntimeError("checkpoint writer is closed")
             while self._pending >= self.max_pending:
+                t0 = _trace_clock.trace_now()
                 self._cv.wait()
+                waited += _trace_clock.trace_now() - t0
             self._jobs.append(job)
             self._pending += 1
             self._rec().gauge("checkpoint/in_flight", self._pending)
@@ -75,6 +78,12 @@ class AsyncCheckpointWriter:
                                                 name=self._name, daemon=True)
                 self._thread.start()
             self._cv.notify_all()
+        if waited > 0.0:
+            # backpressure stalled the TRAINING thread: surface it as
+            # checkpoint.blocking span time so the goodput ledger books
+            # it as checkpoint_blocking, not silent goodput (outside
+            # the cv — recorder locking must not nest under it)
+            self._rec().add_span("checkpoint.blocking", waited)
 
     def _run(self):
         while True:

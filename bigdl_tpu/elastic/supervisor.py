@@ -198,7 +198,19 @@ class ElasticSupervisor:
 
     def _set_state(self, state: str):
         self.state = state
-        self._rec().gauge("elastic/state_" + state, time.time())
+        rec = self._rec()
+        rec.gauge("elastic/state_" + state, time.time())
+        led = rec.get_ledger()
+        if led is not None:
+            # the goodput ledger's background phase follows the state
+            # machine: draining -> preemption_drain, planning/resuming
+            # -> preemption_replan, running/idle -> idle (steps fold
+            # their own interval; only inter-step gaps land there)
+            from ..observability.goodput import STATE_BUCKETS
+            try:
+                led.declare(STATE_BUCKETS.get(state, "idle"))
+            except Exception:
+                pass    # attribution must never block a transition
         if self.trace_ctx is not None:
             # contiguous state spans on the run's trace: the previous
             # state ends exactly where the next begins, so the merged

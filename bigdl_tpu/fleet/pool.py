@@ -213,6 +213,17 @@ class DevicePool:
         self._owner: Dict[Any, Optional[str]] = {d: None
                                                  for d in self.devices}
         self._claims: set = set()       # owners registered via claim()
+        # device-second ownership accounting: a device claimed by
+        # NOBODY is pool idle — a capacity question for the fleet
+        # roll-up, never any job's badput (observability.goodput)
+        from ..observability.goodput import OwnershipLedger
+        self.goodput = OwnershipLedger(len(self.devices))
+
+    def _note_occupancy_locked(self):
+        # caller holds self._lock; the ownership ledger has its own
+        # lock (pool-lock -> ledger-lock, never the reverse)
+        claimed = sum(1 for o in self._owner.values() if o is not None)
+        self.goodput.note(claimed, len(self.devices))
 
     @property
     def size(self) -> int:
@@ -261,6 +272,7 @@ class DevicePool:
             for d in took:
                 self._owner[d] = name
             self._claims.add(str(name))
+            self._note_occupancy_locked()
         # span + actuation note OUTSIDE the ledger lock: tracing must
         # never extend the pool's critical section
         self._trace_move("pool.claim", trace_ctx, owners=(name,),
@@ -305,6 +317,7 @@ class DevicePool:
             self._claims.add(str(dst))
             if not any(o == src for o in self._owner.values()):
                 self._claims.discard(str(src))
+            self._note_occupancy_locked()
         self._trace_move("pool.transfer", trace_ctx, owners=(src, dst),
                          n=n, devices=moved)
         return moved
@@ -336,6 +349,7 @@ class DevicePool:
                             f"{owner[d]!r} and {name!r}")
                     owner[d] = name
             self._owner = owner
+            self._note_occupancy_locked()
 
     def release(self, name: str, devices: Optional[Sequence] = None,
                 trace_ctx=None) -> list:
@@ -354,6 +368,7 @@ class DevicePool:
                 self._owner[d] = None
             if not any(o == name for o in self._owner.values()):
                 self._claims.discard(str(name))
+            self._note_occupancy_locked()
         if victims:
             self._trace_move("pool.release", trace_ctx, owners=(name,),
                              n=len(victims), devices=victims)
@@ -777,16 +792,35 @@ class FleetScheduler:
             [(job.name, job.recorder) for job in jobs
              if job.recorder is not None]
 
+    def goodput_doc(self) -> Dict[str, Any]:
+        """Fleet-level device-second attribution: every job recorder's
+        attached :class:`~bigdl_tpu.observability.goodput.GoodputLedger`
+        snapshot rolled up with the pool's ownership ledger, so
+        unclaimed device-seconds surface as POOL idle, not any job's
+        badput.  Served at ``/goodput`` by :meth:`serve_metrics`."""
+        from ..observability.goodput import rollup
+        with self._lock:
+            jobs = list(self._jobs.values())
+        snaps = {}
+        for job in jobs:
+            rec = job.recorder
+            led = rec.get_ledger() if rec is not None else None
+            if led is not None:
+                snaps[job.name] = led.snapshot()
+        return rollup(snaps, self.pool.goodput.snapshot())
+
     def serve_metrics(self, port: int = 0, host: str = "127.0.0.1"):
         """One aggregated introspection server over the whole pool:
         ``/metrics`` renders the scheduler's ``fleet/*`` counters
         unlabeled plus every job's recorder under a ``job=<name>``
-        label, and ``/healthz`` returns 503 iff ANY job's verdict is
-        stalled or diverged (worst-of liveness)."""
+        label, ``/healthz`` returns 503 iff ANY job's verdict is
+        stalled or diverged (worst-of liveness), and ``/goodput`` the
+        fleet attribution roll-up (:meth:`goodput_doc`)."""
         from ..observability.http import IntrospectionServer
         if self._http is not None:
             self._http.stop()
-        srv = IntrospectionServer(self._rec(), port=port, host=host)
+        srv = IntrospectionServer(self._rec(), port=port, host=host,
+                                  goodput_source=self.goodput_doc)
         self._http = srv
         with self._lock:
             jobs = list(self._jobs.values())

@@ -75,6 +75,8 @@ from .health import (DivergenceError, FlightRecorder, HealthMonitor,
                      StallWatchdog)
 from .timeseries import MetricSeries, SeriesStore
 from .aggregate import MetricsAggregator, parse_prometheus
+from .goodput import (BUCKETS, GoodputLedger, OwnershipLedger,
+                      ledger_phase, rollup)
 from .slo import SLObjective, SLOEngine, default_objectives
 from . import collectives
 from . import health
@@ -88,6 +90,8 @@ __all__ = [
     "Sink", "InMemorySink", "JsonlSink", "TensorBoardSink",
     "render_prometheus", "render_prometheus_multi", "IntrospectionServer",
     "DivergenceError", "FlightRecorder", "HealthMonitor", "StallWatchdog",
+    "BUCKETS", "GoodputLedger", "OwnershipLedger", "ledger_phase",
+    "rollup",
     "MetricSeries", "SeriesStore", "MetricsAggregator",
     "parse_prometheus", "SLObjective", "SLOEngine", "default_objectives",
     "collectives", "health", "profile",

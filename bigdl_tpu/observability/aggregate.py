@@ -176,6 +176,13 @@ class MetricsAggregator:
         # label -> trace source (Tracer/SpanStore/TraceRing/engine with
         # a .trace_ring) for the merged /trace document
         self._trace_sources: Dict[str, Any] = {}
+        # job name -> GoodputLedger (or any object with snapshot());
+        # rolled up into the fleet /goodput document plus pool-level
+        # goodput/* gauges on the aggregator's own recorder
+        self._goodput_sources: Dict[str, Any] = {}
+        # the DevicePool's OwnershipLedger: unclaimed device-seconds
+        # are POOL idle, attributed separately from any job's badput
+        self._pool_ledger: Optional[Any] = None
         self._server: Optional[IntrospectionServer] = None
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -271,6 +278,51 @@ class MetricsAggregator:
         with self._lock:
             return self._trace_sources.pop(str(name), None) is not None
 
+    # -- goodput roll-up ---------------------------------------------------- #
+    def add_goodput(self, name: str, ledger) -> "MetricsAggregator":
+        """Register a job's :class:`~.goodput.GoodputLedger` (anything
+        with ``snapshot() -> dict``) for the fleet roll-up: the
+        ``/goodput`` document and pool-level ``goodput/*`` gauges."""
+        with self._lock:
+            self._goodput_sources[str(name)] = ledger
+        return self
+
+    def remove_goodput(self, name: str) -> bool:
+        with self._lock:
+            return self._goodput_sources.pop(str(name), None) is not None
+
+    def set_pool_ledger(self, ledger) -> "MetricsAggregator":
+        """Attach the DevicePool's :class:`~.goodput.OwnershipLedger`
+        so unclaimed device-seconds are attributed as POOL idle in the
+        roll-up, never as any job's badput."""
+        with self._lock:
+            self._pool_ledger = ledger
+        return self
+
+    def goodput_doc(self) -> Dict[str, Any]:
+        """The fleet goodput attribution — per-job ledger snapshots
+        rolled into summed buckets + pool idle + one goodput fraction
+        (:func:`~.goodput.rollup`).  Served at ``/goodput``; also
+        mirrors pool-level gauges onto the aggregator's recorder."""
+        from .goodput import rollup
+        with self._lock:
+            sources = list(self._goodput_sources.items())
+            pool = self._pool_ledger
+        jobs = {}
+        for name, led in sources:
+            try:
+                jobs[name] = led.snapshot()
+            except Exception:
+                continue    # one broken ledger must not kill the doc
+        doc = rollup(jobs, pool.snapshot() if pool is not None else None)
+        rec = self.recorder
+        rec.gauge("goodput/pool_fraction", doc["goodput_fraction"])
+        rec.gauge("goodput/pool_owned_s", doc["owned_s"])
+        rec.gauge("goodput/pool_idle_s", doc["pool_idle_s"])
+        for b, v in doc["buckets"].items():
+            rec.gauge(f"goodput/pool_{b}_s", v)
+        return doc
+
     def trace_doc(self) -> str:
         """One Chrome-trace/Perfetto JSON merging every registered
         trace source — what ``/trace`` serves and ``trace_summary
@@ -298,6 +350,7 @@ class MetricsAggregator:
         name = str(name)
         with self._lock:
             src = self._sources.pop(name, None)
+            self._goodput_sources.pop(name, None)
         if src is None:
             return False
         rec = self.recorder
@@ -357,6 +410,14 @@ class MetricsAggregator:
         stale = [n for n, s in sources if s["stale"]]
         rec.gauge("agg/sources", len(sources))
         rec.gauge("agg/stale_sources", len(stale))
+        with self._lock:
+            any_goodput = bool(self._goodput_sources
+                               or self._pool_ledger is not None)
+        if any_goodput:
+            try:
+                self.goodput_doc()    # refresh pool goodput/* gauges
+            except Exception:
+                pass    # attribution must never kill a scrape
         return {"time": now, "sources": len(sources), "ok": ok,
                 "errors": errs, "stale": stale}
 
@@ -430,6 +491,7 @@ class MetricsAggregator:
                 namespace=self.namespace, metrics_source=self.render,
                 healthz_source=self.healthz,
                 series_source=self.store,
+                goodput_source=self.goodput_doc,
                 trace_source=self.trace_doc).start()
         return self._server
 

@@ -26,6 +26,11 @@ runs:
                  (``?name=decode/ttft_ms/p99&window=300``) from a
                  Recorder's ``keep_series=`` store or an aggregator's —
                  no name lists the available series
+  ``/goodput``   the device-second attribution document — a job
+                 recorder's attached
+                 :class:`~bigdl_tpu.observability.goodput.GoodputLedger`
+                 snapshot, or (on an aggregator's server) the fleet
+                 roll-up with per-bucket badput and pool idle
 
 Attach with ``serve_metrics(port)`` on ``Optimizer`` / ``SpmdTrainer``
 / ``ServingEngine``, or standalone::
@@ -116,7 +121,8 @@ class IntrospectionServer:
                  watchdog=None, monitor=None, namespace: str = "bigdl",
                  records_default: int = 50, trace_source=None,
                  bind_retries: int = 4, metrics_source=None,
-                 healthz_source=None, series_source=None):
+                 healthz_source=None, series_source=None,
+                 goodput_source=None):
         self.recorder = recorder
         self.host = host
         self.port = int(port)           # 0 -> ephemeral, bound in start()
@@ -137,6 +143,10 @@ class IntrospectionServer:
         # own (Recorder(keep_series=N)), resolved per request so a
         # late-attached store is picked up
         self.series_source = series_source
+        # zero-arg callable returning the goodput attribution document
+        # (MetricsAggregator.goodput_doc, or a ledger's snapshot);
+        # defaults to the recorder's own attached ledger
+        self.goodput_source = goodput_source
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         # fleet mode: named (recorder, watchdog, monitor) jobs this
@@ -264,6 +274,20 @@ class IntrospectionServer:
                            "summary": store.summary(name, window)}
             self._reply(h, 200, _finite_json(payload),
                         "application/json")
+        elif parsed.path == "/goodput":
+            if self.goodput_source is not None:
+                payload = self.goodput_source()
+            else:
+                get_led = getattr(self.recorder, "get_ledger", None)
+                led = get_led() if get_led is not None else None
+                if led is None:
+                    h.send_error(404, "no goodput ledger attached "
+                                      "(rec.set_ledger(GoodputLedger) "
+                                      "or an aggregator expose one)")
+                    return
+                payload = led.snapshot()
+            self._reply(h, 200, _finite_json(payload),
+                        "application/json")
         elif parsed.path == "/records":
             q = parse_qs(parsed.query)
             n = int(q["n"][0]) if q.get("n") else self.records_default
@@ -285,7 +309,7 @@ class IntrospectionServer:
                 self._reply(h, 200, body, "application/json")
         else:
             h.send_error(404, "try /metrics, /healthz, /records, "
-                              "/series or /trace")
+                              "/series, /goodput or /trace")
 
     @staticmethod
     def _reply(h: BaseHTTPRequestHandler, code: int, body: str,

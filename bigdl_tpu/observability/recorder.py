@@ -128,6 +128,11 @@ class Recorder:
         # whose scalars(dur) fold perf/mfu, perf/hbm_bw_util and
         # mem/peak_hbm_bytes into every step record
         self._cost_model = None
+        # goodput attribution (observability.goodput): a GoodputLedger
+        # end_step folds span totals into (device-second buckets) and
+        # mirrors as goodput/* gauges; same no-new-host-syncs
+        # discipline as the cost model
+        self._ledger = None
         # gauge pollers: callables(recorder) refreshed before each
         # snapshot()/end_step() — live device-memory stats and friends
         self._gauge_pollers: List = []
@@ -167,6 +172,18 @@ class Recorder:
         step record.  ``None`` detaches."""
         self._cost_model = model
         return self
+
+    def set_ledger(self, ledger):
+        """Attach a :class:`~bigdl_tpu.observability.goodput
+        .GoodputLedger`; ``end_step`` folds each step's span totals
+        into its buckets and stamps ``goodput/*`` gauges.  ``None``
+        detaches."""
+        self._ledger = ledger
+        return self
+
+    def get_ledger(self):
+        """The attached goodput ledger, or None."""
+        return self._ledger
 
     def add_gauge_poller(self, fn):
         """Register ``fn(recorder)`` to refresh live gauges right before
@@ -371,6 +388,14 @@ class Recorder:
             self._step = step
             self._step_t0 = _trace_clock.trace_now()
             self._step_started_wall = time.time()
+        if self._ledger is not None:
+            try:
+                # close out the inter-step gap (background phase) so
+                # fold_step attributes only this step's own interval;
+                # outside our lock — recorder/ledger locks never nest
+                self._ledger.note_step_begin()
+            except Exception:
+                pass        # attribution must never kill the step loop
         self._maybe_start_trace(step)
 
     def end_step(self, step: Optional[int] = None,
@@ -438,6 +463,17 @@ class Recorder:
             self._n_records += 1
             self._ring.append(rec)
             sinks = list(self.sinks)
+        if self._ledger is not None:
+            try:
+                # the fold and the gauge mirror both run OUTSIDE the
+                # recorder lock (publish takes the ledger lock, then
+                # rec.gauge takes ours — strictly sequential, so the
+                # two locks never nest in either order)
+                self._ledger.fold_step(rec.get("dur"),
+                                       rec.get("spans") or {})
+                rec["goodput"] = self._ledger.publish(self)
+            except Exception:
+                pass        # attribution must never kill a record
         if self.series is not None:
             self._feed_series(rec)
         for s in sinks:

@@ -545,6 +545,15 @@ class Optimizer:
         self._capture_cost = bool(capture_cost)
         if self._capture_cost and capture_enabled():
             install_device_memory_poller(recorder)
+        if recorder.enabled and recorder.get_ledger() is None:
+            # goodput ledger: end_step folds data_fetch/h2d/compile/
+            # checkpoint.blocking spans into badput device-seconds, the
+            # residual step time is goodput (docs/observability.md,
+            # "Goodput & badput taxonomy")
+            from ..observability.goodput import GoodputLedger
+            import jax
+            recorder.set_ledger(GoodputLedger(
+                name="train", devices=jax.local_device_count()))
         set_recorder(recorder)
         return self
 

@@ -327,6 +327,20 @@ class SpmdTrainer:
         self._capture_cost = bool(capture_cost)
         if self._capture_cost and capture_enabled():
             install_device_memory_poller(recorder)
+        if recorder.enabled:
+            # goodput ledger over this trainer's whole mesh: end_step
+            # folds h2d/compile/checkpoint.blocking/elastic.reshard
+            # spans into badput, residual step time is goodput.  A
+            # rebuilt trainer (elastic replan) reuses the recorder's
+            # existing ledger — continuity across replans is the point
+            # — but must adopt the NEW mesh size
+            led = recorder.get_ledger()
+            if led is None:
+                from ..observability.goodput import GoodputLedger
+                recorder.set_ledger(GoodputLedger(
+                    name="train", devices=int(self.mesh.devices.size)))
+            else:
+                led.set_devices(int(self.mesh.devices.size))
         set_recorder(recorder)
         if (self._step_fn is not None
                 and self._with_health != self._telemetry_active()):

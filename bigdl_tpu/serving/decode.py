@@ -217,6 +217,13 @@ class DecodeEngine:
         self.eos_id = eos_id
         self.recorder = recorder if recorder is not None \
             else Recorder(annotate=False)
+        if self.recorder.enabled and self.recorder.get_ledger() is None:
+            # goodput attribution: the decode loop folds every elapsed
+            # interval by slot occupancy (goodput/queue_wait/idle), so
+            # the engine owns its device (1 until multi-device decode)
+            from ..observability.goodput import GoodputLedger
+            self.recorder.set_ledger(GoodputLedger(
+                name=f"decode:{model_name}", devices=1))
         self.trace_ring = TraceRing(trace_capacity) if trace_requests \
             else None
         self.report_every = int(report_every)
@@ -266,7 +273,9 @@ class DecodeEngine:
         if name is not None and name != self.model_name:
             raise KeyError(f"DecodeEngine serves {self.model_name!r}, "
                            f"not {name!r}")
-        with self.recorder.span("decode.warmup"):
+        from ..observability.goodput import ledger_phase
+        with self.recorder.span("decode.warmup"), \
+                ledger_phase(self.recorder, "compile_warmup"):
             for bucket in self.ladder:
                 self._program("prefill", bucket)
             self._program("decode")
@@ -476,7 +485,9 @@ class DecodeEngine:
             # post-warmup compile: the token-SLO violation the bucket
             # ladder exists to prevent — counted, never silent
             self.recorder.inc("decode/recompiles")
-        prog = self._compile(kind, bucket)
+        from ..observability.goodput import ledger_phase
+        with ledger_phase(self.recorder, "compile_warmup"):
+            prog = self._compile(kind, bucket)
         with self._lock:
             self._programs[key] = prog
         return prog
@@ -611,6 +622,12 @@ class DecodeEngine:
                 # queue_depth gauge in _admit)
                 self.recorder.gauge("decode/live_slots", 0)
                 self.recorder.gauge("decode/occupancy", 0.0)
+                led = self.recorder.get_ledger()
+                if led is not None:
+                    # parked time folds to the background phase (idle,
+                    # or whatever a producer declared) instead of being
+                    # smeared into the next step's occupancy split
+                    led.note_step_begin()
                 self._lock.wait(0.1)
                 return True
         if closed and not drain:
@@ -765,6 +782,10 @@ class DecodeEngine:
             return
         now = time.monotonic()
         rec.inc("decode/prefills")
+        led = rec.get_ledger()
+        if led is not None:
+            # a prefill is productive single-sequence compute
+            led.fold_split({"goodput": 1.0})
         req.slot = slot
         self._live[slot] = req
         # slot arrays (_lengths/_last_tokens/_admitted_at) are decode-
@@ -864,6 +885,18 @@ class DecodeEngine:
         # health must see a long generation as work, not a wedge
         rec.gauge("decode/live_slots", n_live)
         rec.gauge("decode/occupancy", n_live / self.slots)
+        led = rec.get_ledger()
+        if led is not None:
+            # the goodput fold: this step's interval splits by slot
+            # occupancy — live slots are goodput, spare slots backed by
+            # queued work are queue_wait (capacity idling while admitted
+            # work waits on pages), the rest is honest idle
+            with self._lock:
+                depth = len(self._waiting)
+            spare = self.slots - n_live
+            led.fold_split({"goodput": n_live,
+                            "queue_wait": min(spare, depth),
+                            "idle": max(spare - depth, 0)})
         for slot in live_slots:
             self._lengths[slot] += 1
             req = self._live[slot]

@@ -86,6 +86,13 @@ class ServingEngine:
         self.max_queue_rows = int(max_queue_rows)
         self.recorder = recorder if recorder is not None \
             else Recorder(annotate=False)
+        if self.recorder.enabled and self.recorder.get_ledger() is None:
+            # goodput attribution: each executed batch folds its
+            # interval by fill (padding rows are idle capacity), warmup
+            # and recompiles land in compile_warmup via ledger phases
+            from ..observability.goodput import GoodputLedger
+            self.recorder.set_ledger(GoodputLedger(name="serving",
+                                                   devices=1))
         self.trace_ring = TraceRing(trace_capacity) if trace_requests \
             else None
         self._queues: Dict[str, BatchingQueue] = {}
@@ -112,7 +119,9 @@ class ServingEngine:
                 raise ValueError(
                     f"warmup({entry.name!r}): register with input_shape= "
                     "so dummy batches can be built")
-            with self.recorder.span("serving.warmup"):
+            from ..observability.goodput import ledger_phase
+            with self.recorder.span("serving.warmup"), \
+                    ledger_phase(self.recorder, "compile_warmup"):
                 for bucket in self.ladder:
                     if bucket not in entry.compiled:
                         self._compile(entry, bucket, entry.input_shape,
@@ -374,7 +383,14 @@ class ServingEngine:
             # post-warmup compile: the SLO violation the ladder exists
             # to prevent — counted, never silent
             rec.inc("serving.recompiles")
-            ex = self._compile(entry, bucket, x.shape[1:])
+            from ..observability.goodput import ledger_phase
+            with ledger_phase(rec, "compile_warmup"):
+                ex = self._compile(entry, bucket, x.shape[1:])
+        led = rec.get_ledger()
+        if led is not None:
+            # flush the inter-batch gap to the background phase so the
+            # batch fold below attributes only its own interval
+            led.note_step_begin()
         t_exec = time.monotonic()
         for r in live:
             tr = r.trace
@@ -418,6 +434,10 @@ class ServingEngine:
         rec.inc("serving.batches")
         rec.inc("serving.rows", rows)
         rec.observe("serving.batch_fill", rows / bucket)
+        if led is not None:
+            # the batch's interval splits by fill: real rows are
+            # goodput, padding rows are capacity idling in the bucket
+            led.fold_split({"goodput": rows, "idle": bucket - rows})
         rec.gauge(f"serving.queue_depth.{entry.name}", q.depth())
 
     def _compile(self, entry: ModelEntry, bucket: int, feature_shape,

@@ -295,6 +295,14 @@ class ReplicaSet:
         self.replicas = [_Replica(i, e) for i, e in enumerate(engines)]
         self.recorder = recorder if recorder is not None \
             else Recorder(annotate=False)
+        if self.recorder.enabled and self.recorder.get_ledger() is None:
+            # control-plane ledger (one host "device"): failover
+            # re-dispatch, golden-probe readmission, and brownout windows
+            # land here; per-device serving time lives on each engine's
+            # OWN recorder ledger, so the two never double-book
+            from ..observability.goodput import GoodputLedger
+            self.recorder.set_ledger(GoodputLedger(name="serve",
+                                                   devices=1))
         self.tracer = tracer          # None -> process default at use
         self.wedge_after = float(wedge_after)
         self.max_failovers = int(max_failovers)
@@ -755,8 +763,10 @@ class ReplicaSet:
                                subsystem="replicaset",
                                attempt=flight.attempts,
                                cause=repr(cause))
+        from ..observability.goodput import ledger_phase
         try:
-            self._dispatch(flight)
+            with ledger_phase(rec, "failover"):
+                self._dispatch(flight)
         except Exception as e:
             self._complete(flight, exc=e)
 
@@ -875,11 +885,19 @@ class ReplicaSet:
             rec.gauge("serving/brownout", 1)
             rec.emit_record("replica_event", kind="brownout_enter",
                             saturation=sat)
+            led = rec.get_ledger()
+            if led is not None:
+                # browned wall time is badput on the set's control-plane
+                # ledger until the exit flips the background back
+                led.declare("brownout")
         elif transition == "exit":
             rec.inc("serving/brownout_exit")
             rec.gauge("serving/brownout", 0)
             rec.emit_record("replica_event", kind="brownout_exit",
                             saturation=sat)
+            led = rec.get_ledger()
+            if led is not None:
+                led.declare("idle")
         for flight in to_failover:
             self._failover(flight, LoadShedError(
                 "wedged", "replica ejected as wedged mid-request"))
@@ -966,13 +984,15 @@ class ReplicaSet:
             else:
                 launch = False
         if launch:
+            from ..observability.goodput import ledger_phase
             name, x = self._probe_input_for(rep)
             if name is None:
                 return
             self.recorder.inc("replica/probes")
             try:
-                fut = rep.engine.submit(
-                    name, x, deadline_ms=self.probe_deadline_ms)
+                with ledger_phase(self.recorder, "probe_readmission"):
+                    fut = rep.engine.submit(
+                        name, x, deadline_ms=self.probe_deadline_ms)
             except (LoadShedError, EngineClosedError):
                 self.recorder.inc("replica/probe_failures")
                 with self._lock:

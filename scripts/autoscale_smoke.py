@@ -260,6 +260,33 @@ def leg_a(out_dir):
     scraper.join(timeout=5.0)
     slo.summary_record()
 
+    # -- goodput ledger: conservation + named badput ------------------- #
+    # the set-level (control-plane) ledger books the autoscaler's
+    # actuation; every decode engine's ledger books its own occupancy
+    # split — each must conserve device-seconds within 1%
+    set_snap = rs.recorder.get_ledger().snapshot()
+    check(set_snap["conservation_error"] <= 0.01,
+          f"set ledger conserves: buckets sum to owned within 1% "
+          f"(err {100 * set_snap['conservation_error']:.3f}%)")
+    check(set_snap["buckets"]["autoscale_transfer"] > 0.0,
+          f"autoscale_transfer badput is non-zero and named "
+          f"({set_snap['buckets']['autoscale_transfer']:.3f} dev-s)")
+    eng_snaps = [e.recorder.get_ledger().snapshot() for e in engines
+                 if e.recorder.get_ledger() is not None]
+    check(bool(eng_snaps) and all(
+        s["conservation_error"] <= 0.01 for s in eng_snaps),
+        f"every decode-engine ledger conserves within 1% "
+        f"({len(eng_snaps)} engines, worst "
+        f"{100 * max(s['conservation_error'] for s in eng_snaps):.3f}%)")
+    check(sum(s["buckets"]["goodput"] for s in eng_snaps) > 0.0,
+          "decode goodput (live-slot device-seconds) is non-zero")
+    check(sum(s["buckets"]["compile_warmup"] for s in eng_snaps) > 0.0,
+          "decode compile/warmup badput is non-zero and named")
+    goodput_a = {
+        "set": set_snap,
+        "engines": {f"decode{i}": s for i, s in enumerate(eng_snaps)},
+    }
+
     ttft_p99 = engines[0].recorder.hist_quantiles(
         "decode/ttft_ms", (99.0,))["p99"]
     events = rs.recorder.recent_records(rec_type="autoscale_event")
@@ -287,7 +314,8 @@ def leg_a(out_dir):
             "scale_ups": int(ups()), "scale_downs": int(downs()),
             "flaps": int(flaps), "peak_replicas": peak_replicas[0],
             "ttft_p99_ms": round(float(ttft_p99), 1),
-            "trace": trace_path, "serve_dir": serve_dir}
+            "trace": trace_path, "serve_dir": serve_dir,
+            "goodput": goodput_a}
 
 
 # ===================================================================== #
@@ -452,6 +480,28 @@ def leg_b(out_dir):
           f"final checkpoint digest bit-identical to solo "
           f"({dig_solo[:16]}...)")
 
+    # goodput ledger on the breathing trainer: conservation, plus the
+    # displacement cycles' replan badput and the device→host snapshot
+    # copies, each individually non-zero and named
+    led_b = rec_b.get_ledger()
+    snap_b = led_b.snapshot() if led_b is not None else None
+    check(snap_b is not None and snap_b["owned_s"] > 0.0,
+          "trainer recorder carries a goodput ledger with owned time")
+    if snap_b is not None:
+        check(snap_b["conservation_error"] <= 0.01,
+              f"trainer ledger conserves within 1% "
+              f"(err {100 * snap_b['conservation_error']:.3f}%)")
+        check(snap_b["buckets"]["preemption_replan"] > 0.0,
+              f"preemption_replan badput is non-zero and named "
+              f"({snap_b['buckets']['preemption_replan']:.3f} dev-s)")
+        check(snap_b["buckets"]["checkpoint_blocking"] > 0.0,
+              f"checkpoint_blocking badput is non-zero and named "
+              f"({snap_b['buckets']['checkpoint_blocking']:.3f} dev-s)")
+        check(snap_b["buckets"]["goodput"] > 0.0
+              and snap_b["goodput_fraction"] > 0.0,
+              f"trainer goodput fraction "
+              f"{snap_b['goodput_fraction']:.3f} > 0")
+
     ctl.stop()
     rs.recorder.flush()
     rec_b.flush()
@@ -459,7 +509,8 @@ def leg_b(out_dir):
     return {"displaces": int(n_disp), "borrow_cycles": B_CYCLES,
             "parity": bool(exact and dig_b == dig_solo),
             "digest": dig_solo[:16], "train_dir": train_dir,
-            "scale_ups": int(ups()), "scale_downs": int(downs())}
+            "scale_ups": int(ups()), "scale_downs": int(downs()),
+            "goodput": snap_b}
 
 
 # ===================================================================== #
@@ -502,6 +553,10 @@ def main():
         "parity": b["parity"],
         "trace": a["trace"],
         "workdir": out_dir,
+        "autoscale_transfer_s": round(
+            a["goodput"]["set"]["buckets"]["autoscale_transfer"], 4),
+        "train_goodput_fraction": round(
+            (b["goodput"] or {}).get("goodput_fraction", 0.0), 4),
     }
     print(json.dumps(summary), flush=True)
     return 0 if not FAILURES else 1

@@ -15,10 +15,16 @@ This script folds all of them into one chronological table — round,
 mode (hardware / proxy / FAILED), and a one-line headline metric —
 so the performance trajectory reads at a glance instead of ten ad-hoc
 ``jq`` invocations.  ``--markdown`` emits the same table as GitHub
-markdown for docs/performance.md.
+markdown for docs/performance.md; ``--json`` emits the NORMALIZED rows
+(:func:`normalize_rounds` — every schema, r01 hardware through the
+divergent r08 ``configs`` / r09 ``decode_throughput`` / r10
+``lookup_exchange`` shapes, flattened to one ``{round, date, mode,
+metrics}`` form) for the regression sentinel
+(``bigdl_tpu/observability/regress.py``).
 
     python scripts/bench_trend.py                # repo-root BENCH_r*.json
     python scripts/bench_trend.py --markdown
+    python scripts/bench_trend.py --json
     python scripts/bench_trend.py /path/with/benches
 
 CPU-only, stdlib-only.
@@ -116,6 +122,85 @@ def mode(doc):
     return "proxy" if doc.get("proxy") else "hardware"
 
 
+def _flat_metrics(doc):
+    """Pull the numeric measurements out of ONE round doc, whatever its
+    schema, as a flat ``{name: value}`` dict.  This is where the
+    divergent r08/r09/r10 shapes stop being special: ``configs``
+    (compose_proxy_smoke), ``decode_throughput``/``churn``/
+    ``weight_stream`` (decode_smoke) and ``lookup_exchange``/
+    ``table_bytes``/``two_tower``/``grad_update_bytes`` (rec_smoke)
+    all flatten to dotted keys next to the r01–r07 ``parsed`` ones."""
+    out = {}
+
+    def take(prefix, obj):
+        if isinstance(obj, dict):
+            for k, v in obj.items():
+                take(f"{prefix}.{k}" if prefix else str(k), v)
+        elif isinstance(obj, bool):
+            out[prefix] = 1.0 if obj else 0.0
+        elif isinstance(obj, (int, float)):
+            out[prefix] = float(obj)
+
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict):
+        take("", {k: v for k, v in parsed.items()
+                  if k not in ("metric", "unit", "proxy")})
+    for section in ("decode_throughput", "churn", "weight_stream",
+                    "lookup_exchange", "table_bytes", "two_tower",
+                    "grad_update_bytes"):
+        if isinstance(doc.get(section), dict):
+            take(section, doc[section])
+    cfgs = doc.get("configs")
+    if isinstance(cfgs, dict):        # r08: per-config sub-docs
+        out["configs.total"] = float(len(cfgs))
+        out["configs.blocked"] = float(sum(
+            1 for c in cfgs.values()
+            if isinstance(c, dict) and c.get("status")))
+        out["configs.measured"] = out["configs.total"] \
+            - out["configs.blocked"]
+        for cname, c in cfgs.items():
+            if isinstance(c, dict):
+                take(f"configs.{cname}",
+                     {k: v for k, v in c.items()
+                      if k not in ("status", "detail")})
+    if "ok" in doc:
+        out["ok"] = 1.0 if doc.get("ok") else 0.0
+    return out
+
+
+def normalize_rounds(rounds):
+    """Fold heterogeneous ``load_rounds`` output into one row shape per
+    round: ``{"round", "date", "mode", "metric", "headline",
+    "metrics"}`` — the trajectory schema the regression sentinel
+    consumes.  Wedged/corrupt rounds keep a row (``mode`` FAILED/?, an
+    empty metrics dict) so the trajectory shows the gap instead of
+    silently skipping it."""
+    rows = []
+    for n, path, doc in rounds:
+        if doc is None:
+            rows.append({"round": n, "date": "", "mode": "?",
+                         "metric": None, "headline":
+                         "unreadable result file", "metrics": {}})
+            continue
+        parsed = doc.get("parsed")
+        metric = (parsed.get("metric") if isinstance(parsed, dict)
+                  else None) or doc.get("metric") or doc.get("bench")
+        if metric is None and doc.get("cmd"):
+            # r09 shape: no metric key anywhere; the smoke script's
+            # basename is the stable identity ("decode_smoke")
+            metric = os.path.splitext(
+                os.path.basename(str(doc["cmd"]).split()[-1]))[0]
+        rows.append({
+            "round": n,
+            "date": _tail_date(doc),
+            "mode": mode(doc),
+            "metric": metric,
+            "headline": headline(doc),
+            "metrics": {} if doc.get("rc", 0) != 0 else _flat_metrics(doc),
+        })
+    return rows
+
+
 def render(rounds, markdown=False, out=print):
     if not rounds:
         out("no BENCH_r*.json files found")
@@ -142,10 +227,16 @@ def render(rounds, markdown=False, out=print):
 def main():
     argv = sys.argv[1:]
     markdown = "--markdown" in argv
-    argv = [a for a in argv if a != "--markdown"]
+    as_json = "--json" in argv
+    argv = [a for a in argv if a not in ("--markdown", "--json")]
     root = argv[0] if argv else os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "..")
-    render(load_rounds(root), markdown=markdown)
+    rounds = load_rounds(root)
+    if as_json:
+        print(json.dumps(normalize_rounds(rounds), indent=2,
+                         sort_keys=True))
+    else:
+        render(rounds, markdown=markdown)
 
 
 if __name__ == "__main__":

@@ -132,6 +132,13 @@ def _emit(fl, rec, digests):
             "resumes": j.recorder.counter_value("elastic/resumes"),
             "failures": j.recorder.counter_value("elastic/failures"),
         } for name, j in jobs.items()},
+        # per-job goodput ledger snapshots (attached by the trainer's
+        # set_telemetry): the parent asserts conservation and that the
+        # preemption/checkpoint badput the matrix injects is named
+        "goodput": {name: (j.recorder.get_ledger().snapshot()
+                           if j.recorder.get_ledger() is not None
+                           else None)
+                    for name, j in jobs.items()},
     }
     print("FLEET_RESULT " + json.dumps(out), flush=True)
 
@@ -281,6 +288,25 @@ def main():
     _require("contention",
              cont["digests"]["b"] == solo_b["digests"]["b"],
              "preempted job's params diverged from its solo run")
+
+    # goodput ledgers: every job's buckets must sum to its owned
+    # device-seconds within 1%, and the badput the contention case
+    # injects — B's preemption drain + replan, the checkpoint copies —
+    # must land in its own named bucket, not vanish into idle
+    for jname in ("a", "b"):
+        led = (cont.get("goodput") or {}).get(jname)
+        _require("contention", led is not None and led["owned_s"] > 0,
+                 f"job {jname} carries a goodput ledger with owned time")
+        _require("contention", led["conservation_error"] <= 0.01,
+                 f"job {jname} ledger conservation: buckets sum to "
+                 f"owned within 1% (err "
+                 f"{100 * led['conservation_error']:.3f}%)")
+    b_led = cont["goodput"]["b"]
+    for bucket in ("preemption_drain", "preemption_replan",
+                   "checkpoint_blocking", "goodput"):
+        _require("contention", b_led["buckets"][bucket] > 0.0,
+                 f"b's {bucket} device-seconds must be non-zero "
+                 f"(got {b_led['buckets'][bucket]!r})")
 
     # the timeline must render: the trace_summary fleet view over the
     # contention case's per-recorder JSONL streams

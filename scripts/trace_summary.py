@@ -113,6 +113,22 @@ Two subcommands:
 
         python scripts/trace_summary.py autoscale /tmp/serve.jsonl [flap_window_s]
 
+  goodput            the goodput waterfall from ledger telemetry:
+                     total owned device-seconds, one loss row per
+                     badput bucket (compile/warmup, input stall,
+                     checkpoint blocking, preemption drain/replan/
+                     reshard, failover, probe, queue wait, brownout,
+                     autoscale transfer), pool-idle when a fleet
+                     roll-up is given, the goodput fraction, and a
+                     named verdict on the largest untraced gap.
+                     Accepts telemetry JSONL (the attached per-step
+                     ledger snapshot or the goodput/* gauge mirror)
+                     and /goodput JSON documents:
+
+        python scripts/trace_summary.py goodput /tmp/telemetry.jsonl
+        curl -s localhost:9300/goodput > /tmp/g.json
+        python scripts/trace_summary.py goodput /tmp/g.json
+
   critical-path      per-trace latency attribution from a merged
                      Perfetto/Chrome-trace JSON document (the fleet
                      aggregator's ``/trace`` endpoint, or
@@ -188,6 +204,51 @@ def iter_jsonl(path):
                 yield json.loads(line)
             except json.JSONDecodeError:
                 continue
+
+
+def expand_jsonl_paths(paths, extra_glob=None):
+    """Expand directory arguments into their ``*.jsonl`` files (plus
+    ``extra_glob`` matches, listed first), keeping explicit file paths
+    as-is — the shared bootstrap of every multi-stream subcommand."""
+    expanded = []
+    for p in paths:
+        if os.path.isdir(p):
+            if extra_glob:
+                expanded += sorted(glob.glob(os.path.join(p, extra_glob)))
+            expanded += sorted(glob.glob(os.path.join(p, "*.jsonl")))
+        else:
+            expanded.append(p)
+    return expanded
+
+
+def load_events(paths, types, counter_prefixes=None):
+    """``(events, counters)``: source-tagged records of the given
+    ``types`` chronologically merged across streams, plus the last
+    counter snapshot filtered by prefix — the shared load path of the
+    serving/fleet/autoscale subcommands."""
+    events, counters = [], {}
+    for p in expand_jsonl_paths(paths):
+        src = os.path.basename(p)
+        for rec in iter_jsonl(p):
+            if rec.get("type") in types:
+                events.append((src, rec))
+            if counter_prefixes:
+                for k, v in (rec.get("counters") or {}).items():
+                    if k.startswith(counter_prefixes):
+                        counters[k] = v
+    events.sort(key=lambda sr: sr[1].get("time") or 0.0)
+    return events, counters
+
+
+def steps_argv(argv, sub):
+    """Usage-checked ``(path, last_n)`` preamble shared by the
+    step-table subcommands (steps/input/comm/embedding)."""
+    if not argv:
+        raise SystemExit(f"usage: trace_summary.py {sub} "
+                         "<telemetry.jsonl> [last_n]")
+    last_n = int(argv[1]) if len(argv) > 1 else None
+    print(f"telemetry: {argv[0]}")
+    return argv[0], last_n
 
 
 def load_steps(path, last_n=None):
@@ -307,13 +368,7 @@ def load_health(paths):
     ``events`` are (source, record) health_event pairs — standalone
     records from JSONL streams plus the ones embedded in each flight
     dump's ring; ``flights`` are (path, dump) pairs."""
-    expanded = []
-    for p in paths:
-        if os.path.isdir(p):
-            expanded += sorted(glob.glob(os.path.join(p, "flight_*.json")))
-            expanded += sorted(glob.glob(os.path.join(p, "*.jsonl")))
-        else:
-            expanded.append(p)
+    expanded = expand_jsonl_paths(paths, extra_glob="flight_*.json")
     events, flights = [], []
     for p in expanded:
         src = os.path.basename(p)
@@ -387,18 +442,7 @@ def load_fleet(paths):
     records from telemetry JSONL files (directories are scanned for
     ``*.jsonl``).  Several streams merge into one timeline — in a
     fleet each job usually writes through its own recorder/sink."""
-    expanded = []
-    for p in paths:
-        if os.path.isdir(p):
-            expanded += sorted(glob.glob(os.path.join(p, "*.jsonl")))
-        else:
-            expanded.append(p)
-    events = []
-    for p in expanded:
-        src = os.path.basename(p)
-        events += [(src, rec) for rec in iter_jsonl(p)
-                   if rec.get("type") in ("fleet_event", "elastic_event")]
-    events.sort(key=lambda sr: sr[1].get("time") or 0.0)
+    events, _ = load_events(paths, ("fleet_event", "elastic_event"))
     return events
 
 
@@ -457,14 +501,8 @@ def load_slo(paths):
     """``slo_event`` transitions (chronological, source-tagged) plus
     the LATEST ``slo_summary`` objective table from telemetry JSONL
     files (directories are scanned for ``*.jsonl``)."""
-    expanded = []
-    for p in paths:
-        if os.path.isdir(p):
-            expanded += sorted(glob.glob(os.path.join(p, "*.jsonl")))
-        else:
-            expanded.append(p)
     events, summaries = [], []
-    for p in expanded:
+    for p in expand_jsonl_paths(paths):
         src = os.path.basename(p)
         for rec in iter_jsonl(p):
             if rec.get("type") == "slo_event":
@@ -523,23 +561,8 @@ def load_autoscale(paths):
     ``slo_event`` breach markers and the last ``autoscale/*`` counter
     snapshot from telemetry JSONL files (directories are scanned for
     ``*.jsonl``)."""
-    expanded = []
-    for p in paths:
-        if os.path.isdir(p):
-            expanded += sorted(glob.glob(os.path.join(p, "*.jsonl")))
-        else:
-            expanded.append(p)
-    events, counters = [], {}
-    for p in expanded:
-        src = os.path.basename(p)
-        for rec in iter_jsonl(p):
-            if rec.get("type") in ("autoscale_event", "slo_event"):
-                events.append((src, rec))
-            for k, v in (rec.get("counters") or {}).items():
-                if k.startswith("autoscale/"):
-                    counters[k] = v
-    events.sort(key=lambda sr: sr[1].get("time") or 0.0)
-    return events, counters
+    return load_events(paths, ("autoscale_event", "slo_event"),
+                       ("autoscale/",))
 
 
 def count_flaps(scalings, window):
@@ -622,25 +645,10 @@ def load_serving(paths):
     ``decode_event`` + ``stream_event`` records from telemetry JSONL
     files (directories are scanned for ``*.jsonl``), plus the last
     record's counter snapshot per stream."""
-    expanded = []
-    for p in paths:
-        if os.path.isdir(p):
-            expanded += sorted(glob.glob(os.path.join(p, "*.jsonl")))
-        else:
-            expanded.append(p)
-    events, counters = [], {}
-    for p in expanded:
-        src = os.path.basename(p)
-        for rec in iter_jsonl(p):
-            if rec.get("type") in ("replica_event", "fault_event",
-                                   "decode_event", "stream_event"):
-                events.append((src, rec))
-            for k, v in (rec.get("counters") or {}).items():
-                if k.startswith(("replica/", "serving/", "decode/",
-                                 "kv/", "stream/")):
-                    counters[k] = v
-    events.sort(key=lambda sr: sr[1].get("time") or 0.0)
-    return events, counters
+    return load_events(paths, ("replica_event", "fault_event",
+                               "decode_event", "stream_event"),
+                       ("replica/", "serving/", "decode/",
+                        "kv/", "stream/"))
 
 
 def summarize_serving(events, counters, out=print):
@@ -1031,22 +1039,14 @@ def summarize_input(steps, out=print):
 
 
 def main_input(argv):
-    if not argv:
-        raise SystemExit("usage: trace_summary.py input "
-                         "<telemetry.jsonl> [last_n]")
-    last_n = int(argv[1]) if len(argv) > 1 else None
-    steps, _ = load_steps(argv[0], last_n)
-    print(f"telemetry: {argv[0]}")
+    path, last_n = steps_argv(argv, "input")
+    steps, _ = load_steps(path, last_n)
     summarize_input(steps)
 
 
 def main_comm(argv):
-    if not argv:
-        raise SystemExit("usage: trace_summary.py comm "
-                         "<telemetry.jsonl> [last_n]")
-    last_n = int(argv[1]) if len(argv) > 1 else None
-    steps, _ = load_steps(argv[0], last_n)
-    print(f"telemetry: {argv[0]}")
+    path, last_n = steps_argv(argv, "comm")
+    steps, _ = load_steps(path, last_n)
     summarize_comm(steps)
 
 
@@ -1097,12 +1097,8 @@ def summarize_embedding(steps, out=print):
 
 
 def main_embedding(argv):
-    if not argv:
-        raise SystemExit("usage: trace_summary.py embedding "
-                         "<telemetry.jsonl> [last_n]")
-    last_n = int(argv[1]) if len(argv) > 1 else None
-    steps, _ = load_steps(argv[0], last_n)
-    print(f"telemetry: {argv[0]}")
+    path, last_n = steps_argv(argv, "embedding")
+    steps, _ = load_steps(path, last_n)
     summarize_embedding(steps)
 
 
@@ -1153,6 +1149,130 @@ def main_autoscale(argv):
         raise SystemExit("trace_summary.py autoscale: no paths given")
     events, counters = load_autoscale(argv)
     summarize_autoscale(events, counters, flap_window=flap_window)
+
+
+def load_goodput(paths):
+    """Per-source ledger snapshots for the goodput waterfall.
+
+    Accepts telemetry JSONL streams (the LAST record carrying an
+    attached ``goodput`` snapshot wins; streams without one fall back
+    to their last ``goodput/*`` gauge mirror) and plain JSON documents
+    from a ``/goodput`` endpoint (a single ledger snapshot or a fleet
+    roll-up).  Returns ``(jobs, pool)`` — ``pool`` is the ownership
+    snapshot when a roll-up document carried one."""
+    jobs, pool = {}, None
+    for p in expand_jsonl_paths(paths, extra_glob="*.json"):
+        src = os.path.basename(p)
+        if not p.endswith(".jsonl"):
+            try:
+                with open(p) as f:
+                    doc = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                print(f"  (skipping {p}: {e})")
+                continue
+            if not isinstance(doc, dict):
+                continue
+            if "jobs" in doc:           # a rollup(): unpack its jobs
+                for name, snap in (doc.get("jobs") or {}).items():
+                    jobs[name] = snap
+                if doc.get("pool"):
+                    pool = doc["pool"]
+            elif "buckets" in doc:      # a single ledger snapshot
+                jobs[doc.get("name") or src] = doc
+            continue
+        snap, gauges = None, {}
+        for rec in iter_jsonl(p):
+            if isinstance(rec.get("goodput"), dict):
+                snap = rec["goodput"]
+            for k, v in (rec.get("gauges") or {}).items():
+                if k.startswith("goodput/"):
+                    gauges[k] = v
+        if snap is None and gauges:
+            # rebuild from the gauge mirror GoodputLedger.publish wrote
+            snap = {
+                "name": src,
+                "devices": gauges.get("goodput/devices", 1),
+                "owned_s": gauges.get("goodput/owned_s", 0.0),
+                "goodput_fraction": gauges.get("goodput/fraction", 0.0),
+                "buckets": {k[len("goodput/"):-2]: v
+                            for k, v in gauges.items()
+                            if k.endswith("_s")
+                            and k != "goodput/owned_s"},
+            }
+        if snap is not None:
+            jobs[snap.get("name") or src] = snap
+    return jobs, pool
+
+
+def summarize_goodput(jobs, pool=None, out=print):
+    """Render the goodput waterfall: total owned device-seconds at the
+    top, one loss row per non-empty badput bucket, the goodput line at
+    the bottom — and a named verdict on the top untraced gap (the
+    largest non-goodput bucket, ``idle`` meaning unattributed)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    from bigdl_tpu.observability.goodput import BUCKETS, rollup
+    if not jobs:
+        out("no goodput ledger snapshots found (no ledger attached, or "
+            "telemetry predates the goodput family)")
+        return
+    roll = rollup(jobs, pool)
+    owned = roll["owned_s"]
+    if owned <= 0.0:
+        out("ledger present but zero owned device-seconds")
+        return
+    out(f"== goodput waterfall ({len(jobs)} job"
+        f"{'s' if len(jobs) != 1 else ''}"
+        + (", pool ownership" if pool else "") + ") ==")
+    out(f"  {'':<2}{'bucket':<22} {'dev-s':>12} {'% owned':>8}")
+    out(f"  {'':<2}{'owned':<22} {owned:>12.3f} {100.0:>7.1f}%")
+    losses = []
+    for b in BUCKETS:
+        if b == "goodput":
+            continue
+        v = roll["buckets"].get(b, 0.0)
+        if v > 0.0:
+            losses.append((b, v))
+            out(f"  - {b:<22} {v:>12.3f} "
+                f"{100.0 * v / owned:>7.1f}%")
+    if pool and roll["pool_idle_s"] > 0.0:
+        losses.append(("pool_idle", roll["pool_idle_s"]))
+        out(f"  - {'pool_idle':<22} {roll['pool_idle_s']:>12.3f} "
+            f"{100.0 * roll['pool_idle_s'] / owned:>7.1f}%")
+    good = roll["buckets"].get("goodput", 0.0)
+    out(f"  = {'goodput':<22} {good:>12.3f} "
+        f"{100.0 * roll['goodput_fraction']:>7.1f}%")
+    out(f"  conservation error: "
+        f"{100.0 * roll['conservation_error']:.3f}%")
+    if losses:
+        top, v = max(losses, key=lambda kv: kv[1])
+        what = ("unattributed owned time — instrument the producer"
+                if top == "idle" else
+                "devices claimed by no job — a scheduling gap"
+                if top == "pool_idle" else "attributed badput")
+        out(f"  top gap: {top} ({v:.3f} dev-s, "
+            f"{100.0 * v / owned:.1f}% of owned) — {what}")
+    if len(jobs) > 1:
+        out("\n== per-job ledgers ==")
+        out(f"  {'job':<18} {'devices':>7} {'owned':>12} "
+            f"{'goodput':>8} {'top badput':<22}")
+        for name in sorted(jobs):
+            s = jobs[name]
+            bk = {b: v for b, v in (s.get("buckets") or {}).items()
+                  if b != "goodput" and v > 0.0}
+            top = max(bk, key=bk.get) if bk else "-"
+            out(f"  {name:<18} {s.get('devices', 0):>7g} "
+                f"{s.get('owned_s', 0.0):>12.3f} "
+                f"{100.0 * s.get('goodput_fraction', 0.0):>7.1f}% "
+                f"{top:<22}")
+
+
+def main_goodput(argv):
+    if not argv:
+        raise SystemExit("usage: trace_summary.py goodput "
+                         "<telemetry.jsonl | goodput.json | dir>...")
+    jobs, pool = load_goodput(argv)
+    summarize_goodput(jobs, pool)
 
 
 def load_trace_doc(path):
@@ -1237,41 +1357,32 @@ def main_xplane(argv):
 
 
 def main_steps(argv):
-    if not argv:
-        raise SystemExit("usage: trace_summary.py steps "
-                         "<telemetry.jsonl> [last_n]")
-    last_n = int(argv[1]) if len(argv) > 1 else None
-    steps, ck_summary = load_steps(argv[0], last_n)
-    print(f"telemetry: {argv[0]}")
+    path, last_n = steps_argv(argv, "steps")
+    steps, ck_summary = load_steps(path, last_n)
     summarize_steps(steps, ck_summary=ck_summary)
+
+
+SUBCOMMANDS = {
+    "steps": main_steps,
+    "input": main_input,
+    "comm": main_comm,
+    "embedding": main_embedding,
+    "profile": main_profile,
+    "health": main_health,
+    "serving": main_serving,
+    "fleet": main_fleet,
+    "slo": main_slo,
+    "autoscale": main_autoscale,
+    "goodput": main_goodput,
+    "critical-path": main_critical_path,
+    "xplane": main_xplane,
+}
 
 
 def main():
     argv = sys.argv[1:]
-    if argv and argv[0] == "steps":
-        main_steps(argv[1:])
-    elif argv and argv[0] == "input":
-        main_input(argv[1:])
-    elif argv and argv[0] == "comm":
-        main_comm(argv[1:])
-    elif argv and argv[0] == "embedding":
-        main_embedding(argv[1:])
-    elif argv and argv[0] == "profile":
-        main_profile(argv[1:])
-    elif argv and argv[0] == "health":
-        main_health(argv[1:])
-    elif argv and argv[0] == "serving":
-        main_serving(argv[1:])
-    elif argv and argv[0] == "fleet":
-        main_fleet(argv[1:])
-    elif argv and argv[0] == "slo":
-        main_slo(argv[1:])
-    elif argv and argv[0] == "autoscale":
-        main_autoscale(argv[1:])
-    elif argv and argv[0] == "critical-path":
-        main_critical_path(argv[1:])
-    elif argv and argv[0] == "xplane":
-        main_xplane(argv[1:])
+    if argv and argv[0] in SUBCOMMANDS:
+        SUBCOMMANDS[argv[0]](argv[1:])
     else:           # back-compat: bare path = xplane trace dir
         main_xplane(argv)
 
